@@ -1,0 +1,29 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework with the
+capabilities of the Deeplearning4j stack, built on jax/XLA/Pallas/pjit.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+- ``ndarray``  — eager INDArray-style tensor API over jax (ref: ND4J
+  ``org.nd4j.linalg.api.ndarray.INDArray`` / ``Nd4j`` factory).
+- ``ops``      — op registry with shape functions + XLA lowerings and Pallas
+  kernels (ref: libnd4j declarable ops).
+- ``autodiff`` — SameDiff-style define-then-run graph engine whose executor
+  emits jax-traceable programs compiled whole-graph by XLA (ref:
+  ``org.nd4j.autodiff.samediff.SameDiff``).
+- ``nn``       — layer/config DSL, MultiLayerNetwork & ComputationGraph
+  (ref: ``org.deeplearning4j.nn.*``).
+- ``optim``    — updaters, schedules, Solver, listener bus (ref:
+  ``org.nd4j.linalg.learning.*``, ``org.deeplearning4j.optimize.*``).
+- ``data``     — DataSet/iterators/normalizers + DataVec-style ETL (ref:
+  ``org.nd4j.linalg.dataset.*``, ``org.datavec.*``).
+- ``eval``     — evaluation suites (ref: ``org.nd4j.evaluation.*``).
+- ``parallel`` — device-mesh distributed training: TrainingMaster facade,
+  DP/TP/PP/SP over jax.sharding (ref: ``org.deeplearning4j.spark.*``,
+  ``ParallelWrapper``; transport replaced by XLA collectives).
+- ``models``   — model zoo (ref: ``org.deeplearning4j.zoo``).
+- ``utils``    — serialization, checkpointing, common helpers.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.ndarray import nd  # noqa: F401

@@ -1,0 +1,2 @@
+"""Graph engine + validation (ref: org.nd4j.autodiff)."""
+from deeplearning4j_tpu.autodiff import validation

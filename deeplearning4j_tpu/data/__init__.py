@@ -1,0 +1,9 @@
+"""Data pipeline (ref: org.nd4j.linalg.dataset, org.datavec)."""
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    ArrayDataSetIterator, AsyncDataSetIterator, DataSetIterator,
+    ListDataSetIterator, MultipleEpochsIterator)
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize,
+    VGG16ImagePreProcessor)
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator

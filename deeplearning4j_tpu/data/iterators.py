@@ -1,0 +1,192 @@
+"""DataSet iterators, analog of
+``org.nd4j.linalg.dataset.api.iterator.DataSetIterator`` and DL4J's
+``AsyncDataSetIterator`` (host-side prefetch thread overlapping ETL with the
+device step — the same process-internal boundary as the reference's
+AsyncDataSetIterator, SURVEY 3.1)."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base iterator protocol (ref: DataSetIterator)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self):
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    # camelCase parity
+    hasNext = has_next
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over a pre-built list of DataSets (ref: ListDataSetIterator)."""
+
+    def __init__(self, datasets: Sequence[DataSet], batch_size: Optional[int] = None):
+        if batch_size is not None and len(datasets) == 1:
+            datasets = datasets[0].batch_by(batch_size)
+        self._list: List[DataSet] = list(datasets)
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._list)
+
+    def next(self) -> DataSet:
+        ds = self._list[self._pos]
+        self._pos += 1
+        return ds
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self._list[0].num_examples() if self._list else 0
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Minibatch over big arrays, optional shuffle per epoch."""
+
+    def __init__(self, features, labels, batch_size: int, shuffle: bool = False, seed: int = 0,
+                 features_mask=None, labels_mask=None, drop_last: bool = False):
+        self._ds = DataSet(features, labels, features_mask, labels_mask)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self.drop_last = drop_last
+        self._order = np.arange(self._ds.num_examples())
+        self._pos = 0
+        self._maybe_shuffle()
+
+    def _maybe_shuffle(self):
+        if self.shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            self._order = rng.permutation(self._ds.num_examples())
+
+    def has_next(self) -> bool:
+        remaining = self._ds.num_examples() - self._pos
+        return remaining >= (self.batch_size if self.drop_last else 1)
+
+    def next(self) -> DataSet:
+        idx = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        d = self._ds
+        return DataSet(d.features[idx], d.labels[idx],
+                       None if d.features_mask is None else d.features_mask[idx],
+                       None if d.labels_mask is None else d.labels_mask[idx])
+
+    def reset(self):
+        self._pos = 0
+        self._epoch += 1
+        self._maybe_shuffle()
+
+    def batch(self) -> int:
+        return self.batch_size
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (ref: AsyncDataSetIterator;
+    queue-based producer/consumer, bounded buffer)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, backing: DataSetIterator, queue_size: int = 4):
+        self._backing = backing
+        self._queue_size = queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._next_item = None
+        self._start()
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._stop = threading.Event()
+
+        def producer():
+            try:
+                while self._backing.has_next() and not self._stop.is_set():
+                    self._queue.put(self._backing.next())
+            finally:
+                self._queue.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+        self._advance()
+
+    def _advance(self):
+        item = self._queue.get()
+        self._next_item = None if item is self._SENTINEL else item
+
+    def has_next(self) -> bool:
+        return self._next_item is not None
+
+    def next(self) -> DataSet:
+        ds = self._next_item
+        self._advance()
+        return ds
+
+    def reset(self):
+        self._stop.set()
+        # drain so the producer can exit
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        self._backing.reset()
+        self._start()
+
+    def batch(self) -> int:
+        return self._backing.batch()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """(ref: MultipleEpochsIterator) — repeat a backing iterator N times."""
+
+    def __init__(self, epochs: int, backing: DataSetIterator):
+        self._backing = backing
+        self._epochs = epochs
+        self._cur = 0
+
+    def has_next(self) -> bool:
+        if self._backing.has_next():
+            return True
+        if self._cur + 1 < self._epochs:
+            self._cur += 1
+            self._backing.reset()
+            return self._backing.has_next()
+        return False
+
+    def next(self) -> DataSet:
+        return self._backing.next()
+
+    def reset(self):
+        self._cur = 0
+        self._backing.reset()
+
+    def batch(self) -> int:
+        return self._backing.batch()

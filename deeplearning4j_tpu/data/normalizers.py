@@ -1,0 +1,166 @@
+"""Data normalizers, analog of ``org.nd4j.linalg.dataset.api.preprocessor``
+(NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler,
+VGG16ImagePreProcessor)."""
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataNormalization:
+    def fit(self, source):
+        """Accepts a DataSet or an iterator of DataSets."""
+        if isinstance(source, DataSet):
+            self._fit_arrays([source.features])
+        else:
+            source.reset()
+            feats = [ds.features for ds in source]
+            self._fit_arrays(feats)
+            source.reset()
+        return self
+
+    def _fit_arrays(self, arrays):
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        ds.features = self._transform_array(ds.features)
+        return ds
+
+    def pre_process(self, ds: DataSet) -> DataSet:
+        return self.transform(ds)
+
+    preProcess = pre_process
+
+    def _transform_array(self, x):
+        raise NotImplementedError
+
+    def revert(self, ds: DataSet) -> DataSet:
+        ds.features = self._revert_array(ds.features)
+        return ds
+
+    def _revert_array(self, x):
+        raise NotImplementedError
+
+    # serialization hooks used by ModelSerializer
+    def state_dict(self) -> dict:
+        return {}
+
+    def load_state_dict(self, d: dict):
+        pass
+
+
+class NormalizerStandardize(DataNormalization):
+    """Zero-mean unit-variance per feature (ref: NormalizerStandardize)."""
+
+    def __init__(self):
+        self.mean = None
+        self.std = None
+
+    def _fit_arrays(self, arrays):
+        x = np.concatenate([a.reshape(a.shape[0], -1) for a in arrays])
+        self.mean = x.mean(axis=0)
+        self.std = x.std(axis=0) + 1e-8
+
+    def _transform_array(self, x):
+        shape = x.shape
+        flat = x.reshape(shape[0], -1)
+        return ((flat - self.mean) / self.std).reshape(shape).astype(x.dtype)
+
+    def _revert_array(self, x):
+        shape = x.shape
+        flat = x.reshape(shape[0], -1)
+        return (flat * self.std + self.mean).reshape(shape).astype(x.dtype)
+
+    def state_dict(self):
+        return {"type": "standardize", "mean": self.mean, "std": self.std}
+
+    def load_state_dict(self, d):
+        self.mean, self.std = d["mean"], d["std"]
+
+
+class NormalizerMinMaxScaler(DataNormalization):
+    """Scale to [min, max] (ref: NormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min = None
+        self.data_max = None
+
+    def _fit_arrays(self, arrays):
+        x = np.concatenate([a.reshape(a.shape[0], -1) for a in arrays])
+        self.data_min = x.min(axis=0)
+        self.data_max = x.max(axis=0)
+
+    def _transform_array(self, x):
+        shape = x.shape
+        flat = x.reshape(shape[0], -1)
+        scale = (self.data_max - self.data_min)
+        scale = np.where(scale == 0, 1.0, scale)
+        unit = (flat - self.data_min) / scale
+        return (unit * (self.max_range - self.min_range) + self.min_range).reshape(shape).astype(x.dtype)
+
+    def _revert_array(self, x):
+        shape = x.shape
+        flat = x.reshape(shape[0], -1)
+        unit = (flat - self.min_range) / (self.max_range - self.min_range)
+        return (unit * (self.data_max - self.data_min) + self.data_min).reshape(shape).astype(x.dtype)
+
+    def state_dict(self):
+        return {"type": "minmax", "min_range": self.min_range, "max_range": self.max_range,
+                "data_min": self.data_min, "data_max": self.data_max}
+
+    def load_state_dict(self, d):
+        self.min_range, self.max_range = d["min_range"], d["max_range"]
+        self.data_min, self.data_max = d["data_min"], d["data_max"]
+
+
+class ImagePreProcessingScaler(DataNormalization):
+    """Pixel [0,255] → [a,b] without fitting (ref: ImagePreProcessingScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0, max_pixel: float = 255.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.max_pixel = max_pixel
+
+    def fit(self, source):
+        return self
+
+    def _fit_arrays(self, arrays):
+        pass
+
+    def _transform_array(self, x):
+        return (x / self.max_pixel * (self.max_range - self.min_range) + self.min_range).astype(np.float32)
+
+    def _revert_array(self, x):
+        return ((x - self.min_range) / (self.max_range - self.min_range) * self.max_pixel).astype(np.float32)
+
+    def state_dict(self):
+        return {"type": "image", "min_range": self.min_range, "max_range": self.max_range,
+                "max_pixel": self.max_pixel}
+
+    def load_state_dict(self, d):
+        self.min_range, self.max_range, self.max_pixel = d["min_range"], d["max_range"], d["max_pixel"]
+
+
+class VGG16ImagePreProcessor(DataNormalization):
+    """Subtract ImageNet channel means, RGB order, NHWC (ref:
+    VGG16ImagePreProcessor — reference means BGR/NCHW; layout diverges)."""
+
+    MEANS = np.asarray([123.68, 116.779, 103.939], dtype=np.float32)
+
+    def fit(self, source):
+        return self
+
+    def _fit_arrays(self, arrays):
+        pass
+
+    def _transform_array(self, x):
+        return (x - self.MEANS).astype(np.float32)
+
+    def _revert_array(self, x):
+        return (x + self.MEANS).astype(np.float32)
+
+    def state_dict(self):
+        return {"type": "vgg16"}

@@ -1,0 +1,6 @@
+"""Eager tensor API: ``NDArray`` + the ``nd`` factory (ref: INDArray / Nd4j)."""
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+from deeplearning4j_tpu.ndarray import factory as nd
+from deeplearning4j_tpu.ndarray import dtypes
+
+__all__ = ["NDArray", "nd", "dtypes"]

@@ -1,0 +1,65 @@
+"""Data-type registry mirroring ND4J's ``org.nd4j.linalg.api.buffer.DataType``.
+
+The reference enumerates DOUBLE, FLOAT, HALF, BFLOAT16, LONG, INT, SHORT,
+BYTE, UBYTE, UINT16/32/64, BOOL, UTF8, COMPRESSED (ref:
+nd4j-api DataType enum). On TPU the native compute types are bfloat16 /
+float32 (f32 accumulation on the MXU) and int8/int32; everything maps onto a
+jnp dtype. UTF8/COMPRESSED are host-side concepts and intentionally absent.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical names, lowercase — ``DataType.FLOAT`` in the reference == float32
+DOUBLE = jnp.float64
+FLOAT = jnp.float32
+HALF = jnp.float16
+BFLOAT16 = jnp.bfloat16
+LONG = jnp.int64
+INT = jnp.int32
+SHORT = jnp.int16
+BYTE = jnp.int8
+UBYTE = jnp.uint8
+UINT16 = jnp.uint16
+UINT32 = jnp.uint32
+UINT64 = jnp.uint64
+BOOL = jnp.bool_
+
+_NAME_TO_DTYPE = {
+    "double": DOUBLE, "float64": DOUBLE,
+    "float": FLOAT, "float32": FLOAT,
+    "half": HALF, "float16": HALF,
+    "bfloat16": BFLOAT16, "bf16": BFLOAT16,
+    "long": LONG, "int64": LONG,
+    "int": INT, "int32": INT,
+    "short": SHORT, "int16": SHORT,
+    "byte": BYTE, "int8": BYTE,
+    "ubyte": UBYTE, "uint8": UBYTE,
+    "uint16": UINT16, "uint32": UINT32, "uint64": UINT64,
+    "bool": BOOL,
+}
+
+FLOATING_DTYPES = (jnp.float64, jnp.float32, jnp.float16, jnp.bfloat16)
+
+
+def resolve(dtype) -> jnp.dtype:
+    """Accept a string name, numpy/jnp dtype, or python type; return jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+        return jnp.dtype(_NAME_TO_DTYPE[key])
+    if dtype in (float,):
+        return jnp.dtype(FLOAT)
+    if dtype in (int,):
+        return jnp.dtype(INT)
+    if dtype in (bool,):
+        return jnp.dtype(BOOL)
+    return jnp.dtype(dtype)
+
+
+def is_floating(dtype) -> bool:
+    return np.issubdtype(resolve(dtype), np.floating) or resolve(dtype) == jnp.bfloat16

@@ -1,0 +1,180 @@
+"""``nd`` — the array factory, analog of ``org.nd4j.linalg.factory.Nd4j``.
+
+The reference's ``Nd4j`` is a ~7k-line static factory whose backend is chosen
+by classpath ServiceLoader (``Nd4jBackend#load``). Here the "backend" is the
+jax platform (tpu/cpu), selected by ``JAX_PLATFORMS`` / available devices —
+the same user-facing contract: user code never names a backend.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import dtypes as _dt
+from deeplearning4j_tpu.ndarray import random as _rng
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+
+_default_dtype = jnp.dtype(jnp.float32)
+
+
+def setDefaultDataType(dtype):
+    """Ref: Nd4j.setDefaultDataTypes."""
+    global _default_dtype
+    _default_dtype = jnp.dtype(_dt.resolve(dtype))
+
+
+def defaultFloatingPointType():
+    return _default_dtype
+
+
+def backend() -> str:
+    """The active compute platform (ref: Nd4jBackend discovery)."""
+    return jax.default_backend()
+
+
+def _shape(args) -> tuple:
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(args[0])
+    return tuple(int(a) for a in args)
+
+
+# ------------------------------------------------------------------ creation
+def create(data, dtype=None) -> NDArray:
+    arr = jnp.asarray(_unwrap(data) if isinstance(data, NDArray) else data)
+    if dtype is not None:
+        arr = arr.astype(_dt.resolve(dtype))
+    elif arr.dtype == jnp.float64 and not jax.config.jax_enable_x64:
+        arr = arr.astype(_default_dtype)
+    return NDArray(arr)
+
+
+def array(data, dtype=None) -> NDArray:
+    return create(data, dtype)
+
+
+def zeros(*shape, dtype=None) -> NDArray:
+    return NDArray(jnp.zeros(_shape(shape), dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def ones(*shape, dtype=None) -> NDArray:
+    return NDArray(jnp.ones(_shape(shape), dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def full(shape, value, dtype=None) -> NDArray:
+    return NDArray(jnp.full(tuple(shape), value, dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def valueArrayOf(shape, value, dtype=None) -> NDArray:
+    return full(shape, value, dtype)
+
+
+def zerosLike(a) -> NDArray:
+    return NDArray(jnp.zeros_like(_unwrap(a)))
+
+
+def onesLike(a) -> NDArray:
+    return NDArray(jnp.ones_like(_unwrap(a)))
+
+
+def eye(n, m=None, dtype=None) -> NDArray:
+    return NDArray(jnp.eye(n, m, dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def arange(*args, dtype=None) -> NDArray:
+    return NDArray(jnp.arange(*args, dtype=_dt.resolve(dtype)))
+
+
+def linspace(start, stop, num, dtype=None) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def scalar(value, dtype=None) -> NDArray:
+    return NDArray(jnp.asarray(value, dtype=_dt.resolve(dtype) or (_default_dtype if isinstance(value, float) else None)))
+
+
+def empty(dtype=None) -> NDArray:
+    return NDArray(jnp.zeros((0,), dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+# ---------------------------------------------------------------------- rng
+def rand(*shape, dtype=None, seed: Optional[int] = None) -> NDArray:
+    """U[0,1). Ref: Nd4j.rand."""
+    key = jax.random.key(seed) if seed is not None else _rng.next_key()
+    return NDArray(jax.random.uniform(key, _shape(shape), dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def randn(*shape, dtype=None, seed: Optional[int] = None) -> NDArray:
+    """N(0,1). Ref: Nd4j.randn."""
+    key = jax.random.key(seed) if seed is not None else _rng.next_key()
+    return NDArray(jax.random.normal(key, _shape(shape), dtype=_dt.resolve(dtype) or _default_dtype))
+
+
+def randint(low, high, shape, seed: Optional[int] = None) -> NDArray:
+    key = jax.random.key(seed) if seed is not None else _rng.next_key()
+    return NDArray(jax.random.randint(key, tuple(shape), low, high))
+
+
+def shuffle(a, seed: Optional[int] = None) -> NDArray:
+    key = jax.random.key(seed) if seed is not None else _rng.next_key()
+    return NDArray(jax.random.permutation(key, _unwrap(a), axis=0))
+
+
+def getRandom() -> _rng.Random:
+    return _rng.get_random()
+
+
+def setSeed(seed: int):
+    _rng.set_seed(seed)
+
+
+# ------------------------------------------------------------------ combine
+def concat(dim: int, *arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(jnp.concatenate([_unwrap(a) for a in arrays], axis=dim))
+
+
+def stack(dim: int, *arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=dim))
+
+
+def vstack(*arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(jnp.vstack([_unwrap(a) for a in arrays]))
+
+
+def hstack(*arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(jnp.hstack([_unwrap(a) for a in arrays]))
+
+
+def where(cond, x=None, y=None) -> NDArray:
+    if x is None:
+        return NDArray(jnp.stack(jnp.where(_unwrap(cond)), axis=-1))
+    return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+
+def pad(a, pad_width, mode="constant", constant_values=0) -> NDArray:
+    if mode == "constant":
+        return NDArray(jnp.pad(_unwrap(a), pad_width, mode=mode, constant_values=constant_values))
+    return NDArray(jnp.pad(_unwrap(a), pad_width, mode=mode))
+
+
+def gather(a, indices, axis=0) -> NDArray:
+    return NDArray(jnp.take(_unwrap(a), _unwrap(indices), axis=axis))
+
+
+def sort(a, axis=-1, descending=False) -> NDArray:
+    out = jnp.sort(_unwrap(a), axis=axis)
+    return NDArray(jnp.flip(out, axis=axis) if descending else out)
+
+
+def diag(a) -> NDArray:
+    return NDArray(jnp.diag(_unwrap(a)))

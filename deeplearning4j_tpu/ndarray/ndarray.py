@@ -1,0 +1,418 @@
+"""Eager NDArray: the INDArray-equivalent tensor type.
+
+Reference surface: ``org.nd4j.linalg.api.ndarray.INDArray`` (~700 methods) and
+``BaseNDArray`` (nd4j/nd4j-api-parent/nd4j-api). This is a TPU-first
+re-design, not a translation:
+
+- Storage is an immutable jax array (``_buf``); "in-place" mutators
+  (``addi``/``assign``/``putScalar``/…) functionally rebind the buffer. Under
+  the hood every eager op is an XLA-compiled primitive; the training hot path
+  never uses this eager layer (whole-step jit, see nn/multilayer.py).
+- The reference's strided *views with write-through* (``x.get(interval)``,
+  slices sharing storage) cannot exist over immutable buffers, so views are a
+  logical algebra: a view records (base, index); reads slice lazily, writes
+  scatter into the base via ``buf.at[idx].set`` and propagate up the view
+  chain. Semantics match the reference for the supported (basic-indexing)
+  view forms; advanced-indexing reads return copies (documented divergence).
+- dtype promotion follows jax/numpy rules rather than ND4J's custom table;
+  ``Nd4j.defaultFloatingPointType`` maps to the factory default dtype.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray import dtypes as _dt
+
+Index = Any
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x.buf()
+    return x
+
+
+class NDArray:
+    """Dense n-dimensional array over a jax buffer with eager DL4J-style API."""
+
+    __slots__ = ("_buf", "_base", "_index")
+    __array_priority__ = 100  # our ops win over numpy's in mixed expressions
+
+    def __init__(self, buf, base: Optional["NDArray"] = None, index: Index = None):
+        if base is None:
+            self._buf = jnp.asarray(buf)
+        else:
+            self._buf = None  # views read lazily from base
+        self._base = base
+        self._index = index
+
+    # ------------------------------------------------------------------ core
+    def buf(self) -> jax.Array:
+        """The underlying jax array (materializes views)."""
+        if self._base is not None:
+            return self._base.buf()[self._index]
+        return self._buf
+
+    def is_view(self) -> bool:
+        return self._base is not None
+
+    def _write(self, new_buf) -> "NDArray":
+        """Rebind this array's contents; views scatter into their base."""
+        if self._base is not None:
+            self._base._write(self._base.buf().at[self._index].set(new_buf))
+        else:
+            self._buf = jnp.asarray(new_buf)
+        return self
+
+    # ----------------------------------------------------------- properties
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.buf().shape)
+
+    @property
+    def dtype(self):
+        return self.buf().dtype
+
+    def rank(self) -> int:
+        return self.buf().ndim
+
+    @property
+    def ndim(self) -> int:
+        return self.buf().ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    def isScalar(self) -> bool:
+        return self.rank() == 0 or self.length() == 1
+
+    def isVector(self) -> bool:
+        return self.rank() == 1 or (self.rank() == 2 and 1 in self.shape)
+
+    def isMatrix(self) -> bool:
+        return self.rank() == 2
+
+    def rows(self) -> int:
+        return self.shape[0]
+
+    def columns(self) -> int:
+        return self.shape[1]
+
+    # ------------------------------------------------------------- convert
+    def toNumpy(self) -> np.ndarray:
+        return np.asarray(self.buf())
+
+    def __array__(self, dtype=None):
+        a = self.toNumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self.buf().item()
+
+    def __int__(self):
+        return int(self.buf())
+
+    def __float__(self):
+        return float(self.buf())
+
+    def __bool__(self):
+        return bool(self.buf())
+
+    def __len__(self):
+        return self.shape[0]
+
+    def getDouble(self, *idx) -> float:
+        return float(self.buf()[tuple(idx)] if idx else self.buf())
+
+    def getInt(self, *idx) -> int:
+        return int(self.buf()[tuple(idx)] if idx else self.buf())
+
+    def castTo(self, dtype) -> "NDArray":
+        return NDArray(self.buf().astype(_dt.resolve(dtype)))
+
+    def dup(self) -> "NDArray":
+        """Detached copy (views materialize)."""
+        return NDArray(self.buf())
+
+    def detach(self) -> "NDArray":
+        return self.dup()
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, idx) -> "NDArray":
+        idx = tuple(_unwrap(i) for i in idx) if isinstance(idx, tuple) else _unwrap(idx)
+        if _is_basic_index(idx):
+            return NDArray(None, base=self, index=idx)
+        return NDArray(self.buf()[idx])  # advanced indexing → copy
+
+    def __setitem__(self, idx, value):
+        idx = tuple(_unwrap(i) for i in idx) if isinstance(idx, tuple) else _unwrap(idx)
+        self._write(self.buf().at[idx].set(_unwrap(value)))
+
+    def get(self, *idx) -> "NDArray":
+        """Reference: INDArray#get(INDArrayIndex...) — returns a live view."""
+        return self.__getitem__(idx if len(idx) != 1 else idx[0])
+
+    def put(self, idx, value) -> "NDArray":
+        self.__setitem__(idx, value)
+        return self
+
+    def getScalar(self, *idx) -> "NDArray":
+        return NDArray(self.buf()[tuple(idx)])
+
+    def putScalar(self, idx, value) -> "NDArray":
+        if isinstance(idx, (int, np.integer)):
+            idx = (int(idx),)
+        self._write(self.buf().at[tuple(idx)].set(value))
+        return self
+
+    def getRow(self, i: int) -> "NDArray":
+        return self[i]
+
+    def getColumn(self, i: int) -> "NDArray":
+        return self[:, i]
+
+    def putRow(self, i: int, row) -> "NDArray":
+        return self.put(i, row)
+
+    def putColumn(self, i: int, col) -> "NDArray":
+        return self.put((slice(None), i), col)
+
+    def slice_(self, i: int, dim: int = 0) -> "NDArray":
+        idx = (slice(None),) * dim + (i,)
+        return self.__getitem__(idx)
+
+    def tensorAlongDimension(self, i: int, *dims) -> "NDArray":
+        """TAD: the i-th sub-tensor spanning `dims` (ref: shape::TAD)."""
+        keep = [d for d in range(self.rank()) if d not in dims]
+        out = self.buf()
+        # move kept dims to front, flatten them, take i-th
+        perm = keep + sorted(dims)
+        out = jnp.transpose(out, perm)
+        lead = int(np.prod([self.shape[d] for d in keep])) if keep else 1
+        out = out.reshape((lead,) + tuple(self.shape[d] for d in sorted(dims)))
+        return NDArray(out[i])
+
+    # --------------------------------------------------------------- shape
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(self.buf().reshape(shape))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(self.buf().ravel())
+
+    def flatten(self) -> "NDArray":
+        return self.ravel()
+
+    def transpose(self, *axes) -> "NDArray":
+        if not axes:
+            return NDArray(self.buf().T)
+        return self.permute(*axes)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def permute(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return NDArray(jnp.transpose(self.buf(), axes))
+
+    def swapAxes(self, a: int, b: int) -> "NDArray":
+        return NDArray(jnp.swapaxes(self.buf(), a, b))
+
+    def broadcast(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.broadcast_to(self.buf(), shape))
+
+    def repeat(self, repeats, axis: Optional[int] = None) -> "NDArray":
+        return NDArray(jnp.repeat(self.buf(), repeats, axis=axis))
+
+    def tile(self, reps) -> "NDArray":
+        return NDArray(jnp.tile(self.buf(), reps))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self.buf(), axis=axis))
+
+    def expandDims(self, axis: int) -> "NDArray":
+        return NDArray(jnp.expand_dims(self.buf(), axis))
+
+    # ---------------------------------------------------------- arithmetic
+    def _binary(self, other, fn) -> "NDArray":
+        return NDArray(fn(self.buf(), _unwrap(other)))
+
+    def _binary_i(self, other, fn) -> "NDArray":
+        res = fn(self.buf(), _unwrap(other))
+        return self._write(jnp.asarray(res, dtype=self.dtype) if res.dtype != self.dtype else res)
+
+    def add(self, other):  return self._binary(other, jnp.add)
+    def sub(self, other):  return self._binary(other, jnp.subtract)
+    def mul(self, other):  return self._binary(other, jnp.multiply)
+    def div(self, other):  return self._binary(other, jnp.divide)
+    def rsub(self, other): return self._binary(other, lambda a, b: b - a)
+    def rdiv(self, other): return self._binary(other, lambda a, b: b / a)
+    def fmod(self, other): return self._binary(other, jnp.fmod)
+
+    def addi(self, other):  return self._binary_i(other, jnp.add)
+    def subi(self, other):  return self._binary_i(other, jnp.subtract)
+    def muli(self, other):  return self._binary_i(other, jnp.multiply)
+    def divi(self, other):  return self._binary_i(other, jnp.divide)
+    def rsubi(self, other): return self._binary_i(other, lambda a, b: b - a)
+    def rdivi(self, other): return self._binary_i(other, lambda a, b: b / a)
+
+    def neg(self):  return NDArray(-self.buf())
+    def negi(self): return self._write(-self.buf())
+
+    def assign(self, other) -> "NDArray":
+        val = _unwrap(other)
+        val = jnp.broadcast_to(jnp.asarray(val, dtype=self.dtype), self.shape)
+        return self._write(val)
+
+    # python operators
+    __add__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __truediv__ = div
+    __radd__ = add
+    __rsub__ = rsub
+    __rmul__ = mul
+    __rtruediv__ = rdiv
+    __neg__ = neg
+
+    def __pow__(self, p):  return NDArray(self.buf() ** _unwrap(p))
+
+    def __matmul__(self, other): return self.mmul(other)
+
+    # broadcast-with-dimension ops (ref: INDArray#addRowVector etc.)
+    def addRowVector(self, v):  return self._binary(v, lambda a, b: a + b.reshape(1, -1))
+    def addColumnVector(self, v): return self._binary(v, lambda a, b: a + b.reshape(-1, 1))
+    def mulRowVector(self, v):  return self._binary(v, lambda a, b: a * b.reshape(1, -1))
+    def mulColumnVector(self, v): return self._binary(v, lambda a, b: a * b.reshape(-1, 1))
+    def subRowVector(self, v):  return self._binary(v, lambda a, b: a - b.reshape(1, -1))
+    def subColumnVector(self, v): return self._binary(v, lambda a, b: a - b.reshape(-1, 1))
+    def divRowVector(self, v):  return self._binary(v, lambda a, b: a / b.reshape(1, -1))
+    def divColumnVector(self, v): return self._binary(v, lambda a, b: a / b.reshape(-1, 1))
+
+    # ------------------------------------------------------------- matmuls
+    def mmul(self, other) -> "NDArray":
+        """Matrix multiply → MXU. bf16 inputs accumulate in f32 (TPU-native)."""
+        a, b = self.buf(), _unwrap(other)
+        prefer = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+        return NDArray(jnp.matmul(a, b, preferred_element_type=prefer))
+
+    def mmuli(self, other) -> "NDArray":
+        return self._write(self.mmul(other).buf())
+
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self.buf(), _unwrap(other)))
+
+    def tensorMmul(self, other, axes) -> "NDArray":
+        return NDArray(jnp.tensordot(self.buf(), _unwrap(other), axes=axes))
+
+    # ----------------------------------------------------------- reductions
+    def _reduce(self, fn, dim, keepdims=False):
+        axis = None if dim is None else (tuple(dim) if isinstance(dim, (tuple, list)) else dim)
+        out = fn(self.buf(), axis=axis, keepdims=keepdims) if axis is not None else fn(self.buf())
+        return NDArray(out) if getattr(out, "ndim", 0) else NDArray(jnp.asarray(out))
+
+    def sum(self, dim=None, keepdims=False):  return self._reduce(jnp.sum, dim, keepdims)
+    def mean(self, dim=None, keepdims=False): return self._reduce(jnp.mean, dim, keepdims)
+    def prod(self, dim=None, keepdims=False): return self._reduce(jnp.prod, dim, keepdims)
+    def max(self, dim=None, keepdims=False):  return self._reduce(jnp.max, dim, keepdims)
+    def min(self, dim=None, keepdims=False):  return self._reduce(jnp.min, dim, keepdims)
+
+    def std(self, dim=None, keepdims=False, bias_corrected=True):
+        ddof = 1 if bias_corrected else 0
+        fn = lambda a, axis=None, keepdims=False: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdims)
+        return self._reduce(fn, dim, keepdims)
+
+    def var(self, dim=None, keepdims=False, bias_corrected=True):
+        ddof = 1 if bias_corrected else 0
+        fn = lambda a, axis=None, keepdims=False: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdims)
+        return self._reduce(fn, dim, keepdims)
+
+    def norm1(self, dim=None, keepdims=False):
+        return self._reduce(lambda a, axis=None, keepdims=False: jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdims), dim, keepdims)
+
+    def norm2(self, dim=None, keepdims=False):
+        return self._reduce(lambda a, axis=None, keepdims=False: jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdims)), dim, keepdims)
+
+    def normmax(self, dim=None, keepdims=False):
+        return self._reduce(lambda a, axis=None, keepdims=False: jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims), dim, keepdims)
+
+    def argMax(self, dim=None):
+        return NDArray(jnp.argmax(self.buf(), axis=dim))
+
+    def argMin(self, dim=None):
+        return NDArray(jnp.argmin(self.buf(), axis=dim))
+
+    def cumsum(self, dim=0):  return NDArray(jnp.cumsum(self.buf(), axis=dim))
+    def cumprod(self, dim=0): return NDArray(jnp.cumprod(self.buf(), axis=dim))
+
+    def sumNumber(self):  return float(jnp.sum(self.buf()))
+    def meanNumber(self): return float(jnp.mean(self.buf()))
+    def maxNumber(self):  return float(jnp.max(self.buf()))
+    def minNumber(self):  return float(jnp.min(self.buf()))
+
+    # ---------------------------------------------------------- comparisons
+    def gt(self, other):  return self._binary(other, jnp.greater)
+    def gte(self, other): return self._binary(other, jnp.greater_equal)
+    def lt(self, other):  return self._binary(other, jnp.less)
+    def lte(self, other): return self._binary(other, jnp.less_equal)
+    def eq(self, other):  return self._binary(other, jnp.equal)
+    def neq(self, other): return self._binary(other, jnp.not_equal)
+
+    __gt__ = gt
+    __ge__ = gte
+    __lt__ = lt
+    __le__ = lte
+
+    def equalsWithEps(self, other, eps=1e-5) -> bool:
+        o = _unwrap(other)
+        if tuple(o.shape) != self.shape:
+            return False
+        return bool(jnp.all(jnp.abs(self.buf().astype(jnp.float32) - o.astype(jnp.float32)) <= eps))
+
+    def equals(self, other) -> bool:
+        return self.equalsWithEps(other, 1e-5)
+
+    def __eq__(self, other):
+        if isinstance(other, (NDArray, np.ndarray, jax.Array, int, float)):
+            return self.eq(other)
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (NDArray, np.ndarray, jax.Array, int, float)):
+            return self.neq(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return id(self)
+
+    # ------------------------------------------------------------- display
+    def __repr__(self):
+        return f"NDArray{self.shape}<{self.dtype}>\n{np.array2string(self.toNumpy(), precision=4, suppress_small=True)}"
+
+    def shapeInfoToString(self) -> str:
+        return f"rank={self.rank()}, shape={list(self.shape)}, dtype={self.dtype}"
+
+    # jax interop: let jnp.* consume NDArray directly
+    def __jax_array__(self):
+        return self.buf()
+
+
+def _is_basic_index(idx) -> bool:
+    items = idx if isinstance(idx, tuple) else (idx,)
+    for it in items:
+        if isinstance(it, (int, np.integer, slice, type(Ellipsis), type(None))):
+            continue
+        return False
+    return True

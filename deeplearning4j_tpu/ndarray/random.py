@@ -1,0 +1,51 @@
+"""Stateful RNG facade over jax's splittable threefry keys.
+
+Reference: ``org.nd4j.linalg.api.rng.DefaultRandom`` / ``Nd4j.getRandom()``,
+``Nd4j.rand``/``randn`` with an optional seed. DL4J's RNG is stateful and
+global; jax's is functional. The parity layer keeps a process-global key that
+is split on every draw, so eager calls behave statefully while every draw is
+reproducible from ``set_seed``. Jitted training code never uses this — it
+threads explicit keys (see nn/multilayer.py).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Random:
+    """Stateful splittable RNG. Thread-safe via a lock (eager path only)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.setSeed(seed)
+
+    def setSeed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+
+    def getSeed(self) -> int:
+        return self._seed
+
+    def nextKey(self) -> jax.Array:
+        """Split off a fresh subkey, advancing internal state."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+_global = Random(0)
+
+
+def get_random() -> Random:
+    return _global
+
+
+def set_seed(seed: int):
+    _global.setSeed(seed)
+
+
+def next_key() -> jax.Array:
+    return _global.nextKey()

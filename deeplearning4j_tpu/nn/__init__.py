@@ -1,0 +1,5 @@
+"""Layer/model API (ref: org.deeplearning4j.nn.*)."""
+from deeplearning4j_tpu.nn.conf.configuration import (
+    MultiLayerConfiguration, NeuralNetConfiguration, BackpropType)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork

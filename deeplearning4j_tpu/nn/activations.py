@@ -1,0 +1,45 @@
+"""Activation registry, analog of ``org.nd4j.linalg.activations.Activation``
+enum + ``IActivation`` impls. Names match the reference enum (case-insensitive).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_ACTIVATIONS = {
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leakyrelu": lambda x: jax.nn.leaky_relu(x, 0.01),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "sigmoid": jax.nn.sigmoid,
+    "hardsigmoid": jax.nn.hard_sigmoid,
+    "tanh": jnp.tanh,
+    "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    "rectifiedtanh": lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "logsoftmax": lambda x: jax.nn.log_softmax(x, axis=-1),
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "cube": lambda x: x ** 3,
+    "swish": jax.nn.silu,
+    "mish": jax.nn.mish,
+    "thresholdedrelu": lambda x: jnp.where(x > 1.0, x, 0.0),
+}
+
+
+def get(name):
+    if callable(name):
+        return name
+    key = str(name).lower().replace("_", "")
+    if key not in _ACTIVATIONS:
+        raise ValueError(f"Unknown activation: {name!r} (have {sorted(_ACTIVATIONS)})")
+    return _ACTIVATIONS[key]
+
+
+def names():
+    return sorted(_ACTIVATIONS)

@@ -1,0 +1,981 @@
+"""Layer configuration classes + their functional TPU implementations.
+
+Reference split: config classes live in ``org.deeplearning4j.nn.conf.layers``
+and runtime impls in ``org.deeplearning4j.nn.layers.**`` (SURVEY D1/D3).
+TPU-first collapse: one dataclass per layer carries BOTH the JSON-serializable
+config and the pure-functional ``init_params``/``apply`` pair, because there
+is no per-layer runtime object — the whole network traces into one XLA
+program. "Hand-written backward per layer" (reference) is replaced by jax
+autodiff over the traced forward.
+
+Conventions:
+- activations NHWC (conv), (N, T, C) (recurrent) — see conf/inputs.py.
+- ``apply(params, x, training, rng, state)`` returns ``(y, new_state)``;
+  ``state`` carries batch-norm running stats (the only stateful layer).
+- ``dropout`` field is the RETAIN probability, matching the reference's
+  ``dropOut(double)`` semantics.
+- param dict insertion order defines the flat-param-vector layout
+  (ref: MultiLayerNetwork#init parameter flattening, SURVEY 3.2).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import losses as _loss
+from deeplearning4j_tpu.nn import weights as _winit
+from deeplearning4j_tpu.nn.conf.inputs import InputType, conv_out_size
+from deeplearning4j_tpu.ops.registry import exec_op
+
+_LAYER_TYPES: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    _LAYER_TYPES[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: dict) -> "Layer":
+    d = dict(d)
+    cls = _LAYER_TYPES[d.pop("@layer")]
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: _revive(k, v) for k, v in d.items() if k in field_names})
+
+
+def _revive(k, v):
+    if isinstance(v, list):
+        return tuple(v)
+    return v
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base layer config (ref: conf.layers.Layer / BaseLayer)."""
+    name: Optional[str] = None
+    # trainable-layer hyperparams; None = inherit network default
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None     # retain probability
+    bias_init: float = 0.0
+
+    # ---------------- config protocol
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in dataclasses.asdict(self).items()
+             if not k.startswith("_") and (v is not None or k in ("name",))}
+        d["@layer"] = type(self).__name__
+        return d
+
+    def apply_global_defaults(self, defaults: dict):
+        """Fill None fields from NeuralNetConfiguration global defaults."""
+        for k in ("activation", "weight_init", "l1", "l2", "dropout"):
+            if getattr(self, k, None) is None and defaults.get(k) is not None:
+                setattr(self, k, defaults[k])
+        if self.activation is None:
+            self.activation = "identity"
+        if self.weight_init is None:
+            self.weight_init = "xavier"
+
+    # ---------------- shape protocol
+    def set_n_in(self, input_type: InputType):
+        pass
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    # ---------------- param protocol
+    def init_params(self, key) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def has_params(self) -> bool:
+        return bool(self.param_shapes())
+
+    def param_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        """Ordered name->shape; defines flat-vector layout."""
+        return {}
+
+    def n_params(self) -> int:
+        import numpy as np
+        return int(sum(np.prod(s) for s in self.param_shapes().values()))
+
+    # ---------------- execution protocol
+    def apply(self, params, x, training=False, rng=None, state=None):
+        raise NotImplementedError
+
+    def _maybe_dropout(self, x, training, rng):
+        """Input dropout, reference retain-prob semantics."""
+        if training and self.dropout is not None and self.dropout < 1.0 and rng is not None:
+            return exec_op("dropout_inverted", x, rng, p=self.dropout)
+        return x
+
+    def _act(self, z):
+        return _act.get(self.activation or "identity")(z)
+
+
+# --------------------------------------------------------------------- dense
+@register_layer
+@dataclasses.dataclass
+class DenseLayer(Layer):
+    """Fully connected (ref: conf.layers.DenseLayer / layers.feedforward.dense)."""
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    has_bias: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.array_elements()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            # dense applied per-timestep (ref: FeedForwardToRnnPreProcessor behavior)
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)
+
+    def param_shapes(self):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        p = {"W": _winit.init(self.weight_init, key, (self.n_in, self.n_out), self.n_in, self.n_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        if x.ndim > 2 and x.shape[-1] != self.n_in:
+            x = x.reshape(x.shape[0], -1)  # implicit CNN→FF flatten (ref: preprocessor)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (ref: conf.layers.OutputLayer / layers.BaseOutputLayer)."""
+    loss_function: str = "mcxent"
+
+    def loss(self, params, x, labels, mask=None, training=False, rng=None, state=None):
+        """Score contribution. Uses the fused logits form when available."""
+        x = self._maybe_dropout(x, training, rng)
+        if x.ndim > 2 and x.shape[-1] != self.n_in:
+            x = x.reshape(x.shape[0], -1)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        fused = _loss.get_fused(self.loss_function, self.activation)
+        if fused is not None:
+            return fused(z, labels, mask)
+        return _loss.get(self.loss_function)(self._act(z), labels, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class LossLayer(Layer):
+    """Loss without params (ref: conf.layers.LossLayer)."""
+    loss_function: str = "mse"
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return self._act(x), state
+
+    def loss(self, params, x, labels, mask=None, training=False, rng=None, state=None):
+        fused = _loss.get_fused(self.loss_function, self.activation or "identity")
+        if fused is not None:
+            return fused(x, labels, mask)
+        return _loss.get(self.loss_function)(self._act(x), labels, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return self._act(x), state
+
+
+@register_layer
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return self._maybe_dropout(x, training, rng), state
+
+
+# ------------------------------------------------------------------- conv2d
+@dataclasses.dataclass
+class _ConvBase(Layer):
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Any = 0                       # int, (int,int), or "same"
+    dilation: Tuple[int, int] = (1, 1)
+    n_in: Optional[int] = None             # input channels
+    n_out: Optional[int] = None            # output channels
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.dilation = _pair(self.dilation)
+        if not isinstance(self.padding, str):
+            self.padding = _pair(self.padding)
+
+    def _lax_padding(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        return [(p, p) for p in self.padding]
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.channels
+
+    def _spatial_out(self, input_type: InputType):
+        same = isinstance(self.padding, str) and self.padding.lower() == "same"
+        ph, pw = (0, 0) if same else self.padding
+        h = conv_out_size(input_type.height, self.kernel_size[0], self.stride[0], ph, self.dilation[0], same)
+        w = conv_out_size(input_type.width, self.kernel_size[1], self.stride[1], pw, self.dilation[1], same)
+        return h, w
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvolutionLayer(_ConvBase):
+    """2-D convolution, NHWC/HWIO (ref: conf.layers.ConvolutionLayer,
+    libnd4j conv2d — whose cuDNN/oneDNN overrides are played by XLA:TPU)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        h, w = self._spatial_out(input_type)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        kh, kw = self.kernel_size
+        fan_in = kh * kw * self.n_in
+        fan_out = kh * kw * self.n_out
+        p = {"W": _winit.init(self.weight_init, key, (kh, kw, self.n_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = exec_op("conv2d", x, params["W"], params.get("b"),
+                    strides=self.stride, padding=self._lax_padding(), dilation=self.dilation)
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Deconvolution2D(_ConvBase):
+    """Transposed conv (ref: conf.layers.Deconvolution2D)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        same = isinstance(self.padding, str) and self.padding.lower() == "same"
+        if same:
+            h = input_type.height * self.stride[0]
+            w = input_type.width * self.stride[1]
+        else:
+            ph, pw = self.padding
+            h = self.stride[0] * (input_type.height - 1) + self.kernel_size[0] - 2 * ph
+            w = self.stride[1] * (input_type.width - 1) + self.kernel_size[1] - 2 * pw
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        kh, kw = self.kernel_size
+        fan_in = kh * kw * self.n_in
+        fan_out = kh * kw * self.n_out
+        p = {"W": _winit.init(self.weight_init, key, (kh, kw, self.n_in, self.n_out), fan_in, fan_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        pad = self.padding.upper() if isinstance(self.padding, str) else [(p, p) for p in self.padding]
+        z = lax.conv_transpose(x, params["W"], strides=self.stride, padding=pad,
+                               dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution2D(_ConvBase):
+    """Depthwise-separable conv (ref: conf.layers.SeparableConvolution2D)."""
+    depth_multiplier: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        h, w = self._spatial_out(input_type)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {
+            "dW": (kh, kw, self.n_in, self.depth_multiplier),
+            "pW": (1, 1, self.n_in * self.depth_multiplier, self.n_out),
+        }
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(key)
+        p = {
+            "dW": _winit.init(self.weight_init, k1, (kh, kw, self.n_in, self.depth_multiplier),
+                              kh * kw * self.n_in, kh * kw * self.depth_multiplier),
+            "pW": _winit.init(self.weight_init, k2, (1, 1, self.n_in * self.depth_multiplier, self.n_out),
+                              self.n_in * self.depth_multiplier, self.n_out),
+        }
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = exec_op("depthwise_conv2d", x, params["dW"], strides=self.stride,
+                    padding=self._lax_padding(), dilation=self.dilation)
+        z = exec_op("conv2d", z, params["pW"], params.get("b"), strides=(1, 1), padding="VALID")
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (ref: conf.layers.SubsamplingLayer; MAX/AVG/PNORM)."""
+    pooling_type: str = "max"
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Any = 0
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        if not isinstance(self.padding, str):
+            self.padding = _pair(self.padding)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        same = isinstance(self.padding, str) and self.padding.lower() == "same"
+        ph, pw = (0, 0) if same else self.padding
+        h = conv_out_size(input_type.height, self.kernel_size[0], self.stride[0], ph, 1, same)
+        w = conv_out_size(input_type.width, self.kernel_size[1], self.stride[1], pw, 1, same)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        pad = self.padding.upper() if isinstance(self.padding, str) else self.padding
+        op = {"max": "maxpool2d", "avg": "avgpool2d", "pnorm": "pnormpool2d"}[self.pooling_type.lower()]
+        kw = {"pnorm": self.pnorm} if self.pooling_type.lower() == "pnorm" else {}
+        return exec_op(op, x, kernel=self.kernel_size, strides=self.stride, padding=pad, **kw), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Upsampling2D(Layer):
+    size: Tuple[int, int] = (2, 2)
+
+    def __post_init__(self):
+        self.size = _pair(self.size)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1], input_type.channels)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return exec_op("upsampling2d", x, size=self.size), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    padding: Tuple[int, int, int, int] = (1, 1, 1, 1)  # top,bottom,left,right
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self.padding
+        return InputType.convolutional(input_type.height + t + b, input_type.width + l + r,
+                                       input_type.channels)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Cropping2D(Layer):
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self.cropping
+        return InputType.convolutional(input_type.height - t - b, input_type.width - l - r,
+                                       input_type.channels)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        t, b, l, r = self.cropping
+        return x[:, t:x.shape[1] - b or None, l:x.shape[2] - r or None, :], state
+
+
+@register_layer
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """(ref: conf.layers.GlobalPoolingLayer) — pools CNN spatial dims or RNN time."""
+    pooling_type: str = "max"
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+    def apply(self, params, x, training=False, rng=None, state=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            return jnp.max(x, axis=axes), state
+        if pt == "avg":
+            if mask is not None and x.ndim == 3:
+                m = mask[..., None]
+                return jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0), state
+            return jnp.mean(x, axis=axes), state
+        if pt == "sum":
+            return jnp.sum(x, axis=axes), state
+        if pt == "pnorm":
+            return jnp.sum(jnp.abs(x) ** 2, axis=axes) ** 0.5, state
+        raise ValueError(self.pooling_type)
+
+
+# ------------------------------------------------------------ normalization
+@register_layer
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """(ref: conf.layers.BatchNormalization / layers.normalization) — the only
+    stateful layer: running mean/var carried in `state`, updated in the
+    jitted train step (decay semantics match the reference's)."""
+    n_out: Optional[int] = None    # feature count, inferred
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_out is None:
+            self.n_out = input_type.channels if input_type.kind in ("cnn", "cnn3d") else input_type.size
+
+    def param_shapes(self):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": (self.n_out,), "beta": (self.n_out,)}
+
+    def init_params(self, key):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.ones((self.n_out,)), "beta": jnp.zeros((self.n_out,))}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.n_out,)), "var": jnp.ones((self.n_out,))}
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        out = exec_op("batchnorm", x, mean, var,
+                      params.get("gamma"), params.get("beta"), epsilon=self.eps)
+        return out, new_state
+
+
+@register_layer
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return exec_op("lrn", x, depth_radius=self.n // 2, bias=self.k,
+                       alpha=self.alpha, beta=self.beta), state
+
+
+# ---------------------------------------------------------------- embedding
+@register_layer
+@dataclasses.dataclass
+class EmbeddingLayer(Layer):
+    """Index → vector (ref: conf.layers.EmbeddingLayer). Input: (N,) ints or
+    (N,1); gather replaces the reference's one-hot-matmul trick."""
+    n_in: Optional[int] = None   # vocab
+    n_out: Optional[int] = None
+    has_bias: bool = False
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.array_elements()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def param_shapes(self):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        p = {"W": _winit.init(self.weight_init, key, (self.n_in, self.n_out), self.n_in, self.n_out)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,))
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[1] == 1:
+            idx = idx[:, 0]
+        z = params["W"][idx]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(EmbeddingLayer):
+    """(N, T) ints → (N, T, C) (ref: conf.layers.EmbeddingSequenceLayer)."""
+    input_length: int = -1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self.input_length)
+
+
+# ---------------------------------------------------------------- recurrent
+@dataclasses.dataclass
+class _RnnBase(Layer):
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+
+    def apply_global_defaults(self, defaults: dict):
+        # recurrent layers default to tanh, not identity (ref: LSTM/SimpleRnn
+        # constructors) — identity would silently drop the nonlinearity
+        if self.activation is None and defaults.get("activation") is None:
+            self.activation = "tanh"
+        super().apply_global_defaults(defaults)
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def initial_carry(self, batch: int):
+        raise NotImplementedError
+
+    def step(self, params, carry, x_t):
+        raise NotImplementedError
+
+    def run(self, params, x, carry0, mask=None):
+        """Scan over time: (N,T,C) + carry → ((N,T,H), final carry). Masked
+        steps freeze the carry and zero the output (ref: mask semantics in
+        LSTMHelpers / BaseRecurrentLayer)."""
+        def scan_fn(carry, inp):
+            if mask is not None:
+                x_t, m_t = inp
+            else:
+                x_t, m_t = inp, None
+            new_carry, h = self.step(params, carry, x_t)
+            if m_t is not None:
+                m = m_t[:, None]
+                new_carry = tuple(jnp.where(m, n, o) for n, o in zip(new_carry, carry))
+                h = h * m
+            return new_carry, h
+
+        xs = jnp.swapaxes(x, 0, 1)  # (T, N, C) scan layout
+        inputs = (xs, jnp.swapaxes(mask, 0, 1)) if mask is not None else xs
+        carry, hs = lax.scan(scan_fn, carry0, inputs)
+        return jnp.swapaxes(hs, 0, 1), carry
+
+    def apply(self, params, x, training=False, rng=None, state=None, mask=None):
+        x = self._maybe_dropout(x, training, rng)
+        out, _ = self.run(params, x, self.initial_carry(x.shape[0]), mask=mask)
+        return out, state
+
+
+@register_layer
+@dataclasses.dataclass
+class LSTM(_RnnBase):
+    """Fused-gate LSTM over lax.scan (ref: conf.layers.LSTM /
+    layers.recurrent.LSTMHelpers — one (x,h)@W matmul per step feeds the MXU;
+    time loop is a compiled scan, not a Java loop)."""
+    forget_gate_bias_init: float = 1.0
+
+    def param_shapes(self):
+        # order W (input), RW (recurrent), b — matches reference flat layout
+        return {"W": (self.n_in, 4 * self.n_out),
+                "RW": (self.n_out, 4 * self.n_out),
+                "b": (4 * self.n_out,)}
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        h = self.n_out
+        b = jnp.zeros((4 * h,))
+        # gate order i,f,g,o — forget-gate bias init (ref: forgetGateBiasInit)
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        return {
+            "W": _winit.init(self.weight_init, k1, (self.n_in, 4 * h), self.n_in, h),
+            "RW": _winit.init(self.weight_init, k2, (h, 4 * h), h, h),
+            "b": b,
+        }
+
+    def initial_carry(self, batch: int):
+        return (jnp.zeros((batch, self.n_out)), jnp.zeros((batch, self.n_out)))
+
+    def step(self, params, carry, x_t):
+        h_prev, c_prev = carry
+        z = x_t @ params["W"] + h_prev @ params["RW"] + params["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * self._act(c)
+        return (h, c), h
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (ref: conf.layers.GravesLSTM — the
+    char-RNN BASELINE config's layer)."""
+
+    def param_shapes(self):
+        shapes = dict(super().param_shapes())
+        shapes["pI"] = (self.n_out,)
+        shapes["pF"] = (self.n_out,)
+        shapes["pO"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        p = super().init_params(key)
+        p["pI"] = jnp.zeros((self.n_out,))
+        p["pF"] = jnp.zeros((self.n_out,))
+        p["pO"] = jnp.zeros((self.n_out,))
+        return p
+
+    def step(self, params, carry, x_t):
+        h_prev, c_prev = carry
+        z = x_t @ params["W"] + h_prev @ params["RW"] + params["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["pI"] * c_prev)
+        f = jax.nn.sigmoid(f + params["pF"] * c_prev)
+        c = f * c_prev + i * jnp.tanh(g)
+        o = jax.nn.sigmoid(o + params["pO"] * c)
+        h = o * self._act(c)
+        return (h, c), h
+
+
+@register_layer
+@dataclasses.dataclass
+class GRU(_RnnBase):
+    """(ref: conf.layers.GRU — upstream has GRU via SameDiff/gruCell op)."""
+
+    def param_shapes(self):
+        return {"W": (self.n_in, 3 * self.n_out),
+                "RW": (self.n_out, 3 * self.n_out),
+                "b": (3 * self.n_out,)}
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        h = self.n_out
+        return {
+            "W": _winit.init(self.weight_init, k1, (self.n_in, 3 * h), self.n_in, h),
+            "RW": _winit.init(self.weight_init, k2, (h, 3 * h), h, h),
+            "b": jnp.zeros((3 * h,)),
+        }
+
+    def initial_carry(self, batch: int):
+        return (jnp.zeros((batch, self.n_out)),)
+
+    def step(self, params, carry, x_t):
+        (h_prev,) = carry
+        hn = self.n_out
+        zx = x_t @ params["W"] + params["b"]
+        zh = h_prev @ params["RW"]
+        r = jax.nn.sigmoid(zx[..., :hn] + zh[..., :hn])
+        u = jax.nn.sigmoid(zx[..., hn:2 * hn] + zh[..., hn:2 * hn])
+        n = self._act(zx[..., 2 * hn:] + r * zh[..., 2 * hn:])
+        h = (1 - u) * n + u * h_prev
+        return (h,), h
+
+
+@register_layer
+@dataclasses.dataclass
+class SimpleRnn(_RnnBase):
+    """Vanilla RNN (ref: conf.layers.SimpleRnn)."""
+
+    def param_shapes(self):
+        return {"W": (self.n_in, self.n_out),
+                "RW": (self.n_out, self.n_out),
+                "b": (self.n_out,)}
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": _winit.init(self.weight_init, k1, (self.n_in, self.n_out), self.n_in, self.n_out),
+            "RW": _winit.init(self.weight_init, k2, (self.n_out, self.n_out), self.n_out, self.n_out),
+            "b": jnp.zeros((self.n_out,)),
+        }
+
+    def initial_carry(self, batch: int):
+        return (jnp.zeros((batch, self.n_out)),)
+
+    def step(self, params, carry, x_t):
+        (h_prev,) = carry
+        h = self._act(x_t @ params["W"] + h_prev @ params["RW"] + params["b"])
+        return (h,), h
+
+
+@register_layer
+@dataclasses.dataclass
+class Bidirectional(Layer):
+    """Wrapper running a recurrent layer both directions (ref:
+    conf.layers.recurrent.Bidirectional; modes CONCAT/ADD/MUL/AVERAGE)."""
+    fwd: Optional[dict] = None   # serialized inner layer conf
+    mode: str = "concat"
+
+    _fwd_layer: Any = dataclasses.field(default=None, repr=False, compare=False)
+    _bwd_layer: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    @staticmethod
+    def wrap(inner: _RnnBase, mode: str = "concat") -> "Bidirectional":
+        b = Bidirectional(fwd=inner.to_dict(), mode=mode)
+        b._materialize()
+        return b
+
+    def _materialize(self):
+        if self._fwd_layer is None and self.fwd is not None:
+            self._fwd_layer = layer_from_dict(self.fwd)
+            self._bwd_layer = layer_from_dict(self.fwd)
+
+    def apply_global_defaults(self, defaults):
+        super().apply_global_defaults(defaults)
+        self._materialize()
+        self._fwd_layer.apply_global_defaults(defaults)
+        self._bwd_layer.apply_global_defaults(defaults)
+
+    def set_n_in(self, input_type: InputType):
+        self._materialize()
+        self._fwd_layer.set_n_in(input_type)
+        self._bwd_layer.set_n_in(input_type)
+        self.fwd = self._fwd_layer.to_dict()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self._fwd_layer.output_type(input_type)
+        if self.mode == "concat":
+            return InputType.recurrent(inner.size * 2, inner.timeseries_length)
+        return inner
+
+    def param_shapes(self):
+        self._materialize()
+        shapes = {}
+        for k, v in self._fwd_layer.param_shapes().items():
+            shapes["f_" + k] = v
+        for k, v in self._bwd_layer.param_shapes().items():
+            shapes["b_" + k] = v
+        return shapes
+
+    def init_params(self, key):
+        k1, k2 = jax.random.split(key)
+        p = {}
+        for k, v in self._fwd_layer.init_params(k1).items():
+            p["f_" + k] = v
+        for k, v in self._bwd_layer.init_params(k2).items():
+            p["b_" + k] = v
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None, mask=None):
+        fp = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
+        bp = {k[2:]: v for k, v in params.items() if k.startswith("b_")}
+        out_f, _ = self._fwd_layer.apply(fp, x, training, rng, None, mask=mask)
+        x_rev = jnp.flip(x, axis=1)
+        m_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        out_b, _ = self._bwd_layer.apply(bp, x_rev, training, rng, None, mask=m_rev)
+        out_b = jnp.flip(out_b, axis=1)
+        if self.mode == "concat":
+            return jnp.concatenate([out_f, out_b], axis=-1), state
+        if self.mode == "add":
+            return out_f + out_b, state
+        if self.mode == "mul":
+            return out_f * out_b, state
+        if self.mode == "average":
+            return 0.5 * (out_f + out_b), state
+        raise ValueError(self.mode)
+
+
+@register_layer
+@dataclasses.dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output head on (N,T,C) (ref: conf.layers.RnnOutputLayer)."""
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timeseries_length)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+    def loss(self, params, x, labels, mask=None, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        fused = _loss.get_fused(self.loss_function, self.activation)
+        if fused is not None:
+            return fused(z, labels, mask)
+        return _loss.get(self.loss_function)(self._act(z), labels, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class LastTimeStep(Layer):
+    """Wrapper collapsing (N,T,C) → (N,C) at last (masked) step (ref:
+    conf.layers.recurrent.LastTimeStep)."""
+    inner: Optional[dict] = None
+    _inner_layer: Any = dataclasses.field(default=None, repr=False, compare=False)
+
+    @staticmethod
+    def wrap(inner: Layer) -> "LastTimeStep":
+        l = LastTimeStep(inner=inner.to_dict())
+        l._materialize()
+        return l
+
+    def _materialize(self):
+        if self._inner_layer is None and self.inner is not None:
+            self._inner_layer = layer_from_dict(self.inner)
+
+    def apply_global_defaults(self, defaults):
+        super().apply_global_defaults(defaults)
+        self._materialize()
+        self._inner_layer.apply_global_defaults(defaults)
+
+    def set_n_in(self, input_type):
+        self._materialize()
+        self._inner_layer.set_n_in(input_type)
+        self.inner = self._inner_layer.to_dict()
+
+    def output_type(self, input_type):
+        t = self._inner_layer.output_type(input_type)
+        return InputType.feed_forward(t.size)
+
+    def param_shapes(self):
+        self._materialize()
+        return self._inner_layer.param_shapes()
+
+    def init_params(self, key):
+        return self._inner_layer.init_params(key)
+
+    def apply(self, params, x, training=False, rng=None, state=None, mask=None):
+        out, state = self._inner_layer.apply(params, x, training, rng, state, mask=mask)
+        if mask is not None:
+            last = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+            return out[jnp.arange(out.shape[0]), last], state
+        return out[:, -1], state
+
+
+# ---------------------------------------------------------------- attention
+@register_layer
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over (N,T,C) (ref: conf.layers.SelfAttentionLayer
+    wrapping SameDiff MultiHeadDotProductAttention). projectInput adds QKV+out
+    projections."""
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    project_input: bool = True
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.size
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.head_size is None:
+            self.head_size = self.n_out // self.n_heads
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out if self.project_input else self.n_in,
+                                   input_type.timeseries_length)
+
+    def param_shapes(self):
+        if not self.project_input:
+            return {}
+        hs = self.n_heads * self.head_size
+        return {"Wq": (self.n_in, hs), "Wk": (self.n_in, hs),
+                "Wv": (self.n_in, hs), "Wo": (hs, self.n_out)}
+
+    def init_params(self, key):
+        if not self.project_input:
+            return {}
+        ks = jax.random.split(key, 4)
+        hs = self.n_heads * self.head_size
+        return {
+            "Wq": _winit.init(self.weight_init, ks[0], (self.n_in, hs), self.n_in, hs),
+            "Wk": _winit.init(self.weight_init, ks[1], (self.n_in, hs), self.n_in, hs),
+            "Wv": _winit.init(self.weight_init, ks[2], (self.n_in, hs), self.n_in, hs),
+            "Wo": _winit.init(self.weight_init, ks[3], (hs, self.n_out), hs, self.n_out),
+        }
+
+    def apply(self, params, x, training=False, rng=None, state=None, mask=None):
+        n, t, _ = x.shape
+        if self.project_input:
+            q = (x @ params["Wq"]).reshape(n, t, self.n_heads, self.head_size).transpose(0, 2, 1, 3)
+            k = (x @ params["Wk"]).reshape(n, t, self.n_heads, self.head_size).transpose(0, 2, 1, 3)
+            v = (x @ params["Wv"]).reshape(n, t, self.n_heads, self.head_size).transpose(0, 2, 1, 3)
+        else:
+            q = k = v = x[:, None]  # single head
+        attn_mask = None
+        if mask is not None:
+            attn_mask = mask[:, None, None, :].astype(bool)  # (N,1,1,T) key mask
+        out = exec_op("dot_product_attention", q, k, v, mask=attn_mask)
+        out = out.transpose(0, 2, 1, 3).reshape(n, t, -1)
+        if self.project_input:
+            out = out @ params["Wo"]
+        return self._act(out), state

@@ -1,0 +1,328 @@
+"""ComputationGraph — the DAG-network runtime.
+
+Reference: ``org.deeplearning4j.nn.graph.ComputationGraph`` (~5k lines,
+SURVEY D4). TPU-first redesign mirrors MultiLayerNetwork: the topological
+forward + loss + backward + updater sequence is ONE donated-buffer XLA
+program compiled per (shapes, config). Multiple inputs/outputs supported;
+score = sum of all output-layer losses (reference semantics).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.multilayer import _grad_transform
+from deeplearning4j_tpu.nn import params as _flat
+
+_MASK_AWARE = (L._RnnBase, L.Bidirectional, L.LastTimeStep, L.SelfAttentionLayer,
+               L.GlobalPoolingLayer)
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+def _ds_masks(ds, which: str):
+    """Masks from DataSet (singular attrs) or MultiDataSet (plural attrs)."""
+    return _as_tuple(getattr(ds, f"{which}_masks", None) or
+                     getattr(ds, f"{which}_mask", None))
+
+
+class ComputationGraph:
+    """DAG net: init → fit/output/evaluate (ref-parity surface)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._params: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._states: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._param_shapes: Dict[str, Dict[str, tuple]] = {}
+        self._opt = _grad_transform(conf)
+        self._opt_state = None
+        self._iteration = 0
+        self._epoch = 0
+        self._score = float("nan")
+        self._listeners = []
+        self._key = jax.random.key(conf.seed)
+        self._initialized = False
+        self._frozen: set = set()          # transfer-learning frozen layer names
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "ComputationGraph":
+        key = jax.random.key(self.conf.seed)
+        for name in self.conf.topo_order:
+            node = self.conf.nodes[name]
+            if node.layer is None:
+                continue
+            key, sub = jax.random.split(key)
+            self._param_shapes[name] = dict(node.layer.param_shapes())
+            self._params[name] = node.layer.init_params(sub) if node.layer.has_params() else {}
+            st = node.layer.init_state()
+            if st:
+                self._states[name] = st
+        self._opt_state = self._opt.init(self._params)
+        self._initialized = True
+        return self
+
+    # ------------------------------------------------------------- param API
+    def numParams(self) -> int:
+        return _flat.num_params(self._param_shapes)
+
+    def paramTable(self) -> Dict[str, NDArray]:
+        out = {}
+        for lname in self._params:
+            for pname, arr in self._params[lname].items():
+                out[f"{lname}_{pname}"] = NDArray(arr)
+        return out
+
+    def getParam(self, key: str) -> NDArray:
+        lname, pname = key.rsplit("_", 1)
+        return NDArray(self._params[lname][pname])
+
+    def param_tree(self):
+        return self._params
+
+    def set_param_tree(self, tree):
+        self._params = tree
+
+    def state_tree(self):
+        return self._states
+
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners[0]) if len(listeners) == 1 and isinstance(
+            listeners[0], (list, tuple)) else list(listeners)
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, states, inputs: Sequence[jnp.ndarray], training, rng,
+                 masks=None, collect=False):
+        """Topological trace of the DAG (ref: ComputationGraph#feedForward over
+        topologicalSortOrder). Returns ({name: activation}, new_states)."""
+        acts: Dict[str, jnp.ndarray] = {}
+        new_states = dict(states)
+        from deeplearning4j_tpu.nn.multilayer import _maybe_unflatten_input
+        in_types = list(self.conf.input_types) or [None] * len(self.conf.network_inputs)
+        for name, x, it in zip(self.conf.network_inputs, inputs, in_types):
+            acts[name] = _maybe_unflatten_input(x, it)
+        mask = None
+        if masks:
+            mask = masks[0]
+        for li, name in enumerate(self.conf.topo_order):
+            node = self.conf.nodes[name]
+            srcs = [acts[s] for s in node.inputs]
+            if node.layer is not None:
+                lrng = jax.random.fold_in(rng, li) if rng is not None else None
+                lst = states.get(name)
+                kwargs = {}
+                if mask is not None and isinstance(node.layer, _MASK_AWARE):
+                    kwargs["mask"] = mask
+                h, st = node.layer.apply(params.get(name, {}), srcs[0],
+                                         training=training, rng=lrng, state=lst, **kwargs)
+                if lst is not None and st is not None:
+                    new_states[name] = st
+                acts[name] = h
+            else:
+                acts[name] = node.vertex.apply(srcs)
+        return acts, new_states
+
+    def _output_layer_names(self) -> List[str]:
+        return self.conf.network_outputs
+
+    def _regularization_penalty(self, params):
+        penalty = 0.0
+        for name in self.conf.topo_order:
+            node = self.conf.nodes[name]
+            if node.layer is None:
+                continue
+            l1 = getattr(node.layer, "l1", None)
+            l2 = getattr(node.layer, "l2", None)
+            if not l1 and not l2:
+                continue
+            for pname, arr in params.get(name, {}).items():
+                if pname.lower().startswith(("b", "beta", "gamma", "p")):
+                    continue
+                if l1:
+                    penalty = penalty + l1 * jnp.sum(jnp.abs(arr))
+                if l2:
+                    penalty = penalty + 0.5 * l2 * jnp.sum(jnp.square(arr))
+        return penalty
+
+    def _loss_fn(self, params, states, inputs, labels, masks, label_masks, rng):
+        acts, new_states = self._forward(params, states, inputs, True, rng, masks=masks)
+        total = 0.0
+        for i, out_name in enumerate(self.conf.network_outputs):
+            node = self.conf.nodes[out_name]
+            if node.layer is None or not hasattr(node.layer, "loss"):
+                raise ValueError(
+                    f"Network output {out_name!r} is not a loss-bearing layer "
+                    f"(OutputLayer/LossLayer); cannot train (ref: ComputationGraph "
+                    f"requires IOutputLayer outputs for fit)")
+            # output nodes are OutputLayer/LossLayer-style: compute loss on
+            # their PRE-layer input activation
+            src = acts[node.inputs[0]]
+            lm = label_masks[i] if label_masks and i < len(label_masks) else None
+            lrng = jax.random.fold_in(rng, 1000 + i) if rng is not None else None
+            total = total + node.layer.loss(params.get(out_name, {}), src, labels[i],
+                                            mask=lm, training=True, rng=lrng)
+        total = total + self._regularization_penalty(params)
+        return total, new_states
+
+    # ------------------------------------------------------------ train step
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3))
+    def _train_step(self, params, opt_state, states, inputs, labels, masks, label_masks, rng):
+        (loss, new_states), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            params, states, inputs, labels, masks, label_masks, rng)
+        if self._frozen:
+            grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in self._frozen else g)
+                     for k, g in grads.items()}
+        updates, opt_state = self._opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_states, loss
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(inputs, labels) | fit(DataSet/MultiDataSet) | fit(iterator)."""
+        if labels is not None:
+            self._fit_batch(_as_tuple(data), _as_tuple(labels))
+            return self
+        if hasattr(data, "features"):
+            self._fit_batch(_as_tuple(data.features), _as_tuple(data.labels),
+                            _ds_masks(data, "features"), _ds_masks(data, "labels"))
+            return self
+        for _ in range(epochs):
+            for lst in self._listeners:
+                lst.on_epoch_start(self, self._epoch)
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(_as_tuple(ds.features), _as_tuple(ds.labels),
+                                _ds_masks(ds, "features"), _ds_masks(ds, "labels"))
+            for lst in self._listeners:
+                lst.on_epoch_end(self, self._epoch)
+            self._epoch += 1
+        return self
+
+    def _fit_batch(self, inputs, labels, fmasks=(), lmasks=()):
+        if not self._initialized:
+            self.init()
+        inputs = tuple(jnp.asarray(_unwrap(x)) for x in inputs)
+        labels = tuple(jnp.asarray(_unwrap(y)) for y in labels)
+        fmasks = tuple(jnp.asarray(_unwrap(m)) for m in fmasks if m is not None) or None
+        lmasks = tuple(jnp.asarray(_unwrap(m)) for m in lmasks if m is not None) or None
+        self._key, rng = jax.random.split(self._key)
+        self._params, self._opt_state, self._states, loss = self._train_step(
+            self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng)
+        self._score = float(loss)
+        self._iteration += 1
+        for lst in self._listeners:
+            lst.iteration_done(self, self._iteration, self._epoch, self._score)
+
+    # ------------------------------------------------------------- inference
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _output_jit(self, params, states, inputs, masks):
+        acts, _ = self._forward(params, states, inputs, False, None, masks=masks)
+        return tuple(acts[n] for n in self.conf.network_outputs)
+
+    def output(self, *inputs, masks=None):
+        """Forward pass → output activations; single output unwrapped
+        (ref: ComputationGraph#output / #outputSingle)."""
+        if not self._initialized:
+            self.init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        arrs = tuple(jnp.asarray(_unwrap(x)) for x in inputs)
+        masks = None if masks is None else tuple(jnp.asarray(_unwrap(m)) for m in masks)
+        outs = self._output_jit(self._params, self._states, arrs, masks)
+        outs = tuple(NDArray(o) for o in outs)
+        return outs[0] if len(outs) == 1 else outs
+
+    outputSingle = output
+
+    def feedForward(self, *inputs, train: bool = False) -> Dict[str, NDArray]:
+        """All vertex activations by name (ref: #feedForward returning map)."""
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        arrs = tuple(jnp.asarray(_unwrap(x)) for x in inputs)
+        acts, _ = self._forward(self._params, self._states, arrs, train,
+                                self._key if train else None)
+        return {k: NDArray(v) for k, v in acts.items()}
+
+    def predict(self, *inputs):
+        out = self.output(*inputs)
+        return NDArray(jnp.argmax(out.buf(), axis=-1))
+
+    def score(self, dataset=None) -> float:
+        if dataset is None:
+            return self._score
+        inputs = _as_tuple(dataset.features)
+        labels = _as_tuple(dataset.labels)
+        loss, _ = self._loss_fn(self._params, self._states,
+                                tuple(jnp.asarray(_unwrap(x)) for x in inputs),
+                                tuple(jnp.asarray(_unwrap(y)) for y in labels),
+                                None, None, None)
+        return float(loss)
+
+    # ------------------------------------------------------------- evaluation
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.classification import Evaluation
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(*_as_tuple(ds.features))
+            if isinstance(out, tuple):
+                out = out[0]
+            labels = _as_tuple(ds.labels)[0]
+            ev.eval(labels, out, mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path, save_updater: bool = True):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        ModelSerializer.write_model(self, path, save_updater)
+
+    @staticmethod
+    def load(path, load_updater: bool = True) -> "ComputationGraph":
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        return ModelSerializer.restore_computation_graph(path, load_updater)
+
+    # ---------------------------------------------------------------- misc
+    def summary(self) -> str:
+        lines = [f"{'name':<28}{'type':<26}{'nParams':>10}  inputs"]
+        total = 0
+        for name in self.conf.topo_order:
+            node = self.conf.nodes[name]
+            if node.layer is not None:
+                n = node.layer.n_params()
+                total += n
+                lines.append(f"{name:<28}{type(node.layer).__name__:<26}{n:>10}  {node.inputs}")
+            else:
+                lines.append(f"{name:<28}{type(node.vertex).__name__:<26}{0:>10}  {node.inputs}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    def getIterationCount(self) -> int:
+        return self._iteration
+
+    def getEpochCount(self) -> int:
+        return self._epoch
+
+    def clone(self) -> "ComputationGraph":
+        net = ComputationGraph(ComputationGraphConfiguration.from_json(self.conf.to_json()))
+        net.init()
+        net._params = jax.tree.map(lambda a: a, self._params)
+        net._states = jax.tree.map(lambda a: a, self._states)
+        return net

@@ -1,0 +1,146 @@
+"""Loss functions, analog of ``org.nd4j.linalg.lossfunctions.LossFunctions``
+(MCXENT, NEGATIVELOGLIKELIHOOD, MSE, XENT, …) + ``ILossFunction`` impls.
+
+Each loss: fn(predictions, labels, mask) -> scalar mean loss. `predictions`
+are POST-activation outputs (the reference computes loss on activated
+output); for the softmax+NLL pair we fuse into a logits-based stable form
+when the output layer tells us the pre-activation (see layers.OutputLayer).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked_mean(per_example, mask):
+    """Mean over batch; per-timestep masks weight accordingly (ref:
+    ILossFunction#computeScoreArray mask semantics)."""
+    if mask is not None:
+        m = mask.reshape(mask.shape + (1,) * (per_example.ndim - mask.ndim))
+        per_example = per_example * m
+        return jnp.sum(per_example) / (jnp.maximum(jnp.sum(m), 1.0) * (per_example[0].size // max(1, m[0].size) if m.ndim < per_example.ndim else 1))
+    return jnp.mean(jnp.sum(per_example.reshape(per_example.shape[0], -1), axis=-1) if per_example.ndim > 1 else per_example)
+
+
+def mse(pred, labels, mask=None):
+    return _masked_mean(jnp.square(pred - labels), mask)
+
+
+def l2(pred, labels, mask=None):
+    return _masked_mean(jnp.square(pred - labels), mask)
+
+
+def mae(pred, labels, mask=None):
+    return _masked_mean(jnp.abs(pred - labels), mask)
+
+
+def l1(pred, labels, mask=None):
+    return _masked_mean(jnp.abs(pred - labels), mask)
+
+
+def negativeloglikelihood(pred, labels, mask=None):
+    """NLL over probabilities (post-softmax), one-hot or soft labels."""
+    eps = 1e-10
+    return _masked_mean(-labels * jnp.log(pred + eps), mask)
+
+
+mcxent = negativeloglikelihood  # multi-class cross entropy == NLL on softmax out
+
+
+def mcxent_logits(logits, labels, mask=None):
+    """Fused stable form used when the output activation is softmax."""
+    per = -labels * jax.nn.log_softmax(logits, axis=-1)
+    return _masked_mean(per, mask)
+
+
+def xent(pred, labels, mask=None):
+    """Binary cross-entropy on sigmoid outputs."""
+    eps = 1e-10
+    per = -(labels * jnp.log(pred + eps) + (1 - labels) * jnp.log(1 - pred + eps))
+    return _masked_mean(per, mask)
+
+
+def xent_logits(logits, labels, mask=None):
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _masked_mean(per, mask)
+
+
+def hinge(pred, labels, mask=None):
+    """labels ±1."""
+    return _masked_mean(jnp.maximum(0.0, 1.0 - labels * pred), mask)
+
+
+def squared_hinge(pred, labels, mask=None):
+    return _masked_mean(jnp.square(jnp.maximum(0.0, 1.0 - labels * pred)), mask)
+
+
+def kl_divergence(pred, labels, mask=None):
+    eps = 1e-10
+    return _masked_mean(labels * (jnp.log(labels + eps) - jnp.log(pred + eps)), mask)
+
+
+def poisson(pred, labels, mask=None):
+    eps = 1e-10
+    return _masked_mean(pred - labels * jnp.log(pred + eps), mask)
+
+
+def cosine_proximity(pred, labels, mask=None):
+    p = pred / (jnp.linalg.norm(pred, axis=-1, keepdims=True) + 1e-10)
+    l_ = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + 1e-10)
+    return -jnp.mean(jnp.sum(p * l_, axis=-1))
+
+
+def mean_squared_logarithmic_error(pred, labels, mask=None):
+    return _masked_mean(jnp.square(jnp.log1p(pred) - jnp.log1p(labels)), mask)
+
+
+def mape(pred, labels, mask=None):
+    return _masked_mean(100.0 * jnp.abs((labels - pred) / (jnp.abs(labels) + 1e-10)), mask)
+
+
+def wasserstein(pred, labels, mask=None):
+    return _masked_mean(pred * labels, mask)
+
+
+def sparse_mcxent(logits, labels, mask=None):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(per)
+
+
+_LOSSES = {
+    "mse": mse, "l2": l2, "mae": mae, "l1": l1,
+    "negativeloglikelihood": negativeloglikelihood, "nll": negativeloglikelihood,
+    "mcxent": mcxent, "xent": xent, "hinge": hinge, "squaredhinge": squared_hinge,
+    "kldivergence": kl_divergence, "reconstructioncrossentropy": xent,
+    "poisson": poisson, "cosineproximity": cosine_proximity,
+    "meansquaredlogarithmicerror": mean_squared_logarithmic_error, "msle": mean_squared_logarithmic_error,
+    "meanabsolutepercentageerror": mape, "mape": mape,
+    "wasserstein": wasserstein, "sparsemcxent": sparse_mcxent,
+}
+
+# stable logits-form pairs: (loss, output_activation) -> fused fn
+_FUSED = {
+    ("mcxent", "softmax"): mcxent_logits,
+    ("negativeloglikelihood", "softmax"): mcxent_logits,
+    ("nll", "softmax"): mcxent_logits,
+    ("xent", "sigmoid"): xent_logits,
+    ("sparsemcxent", "softmax"): sparse_mcxent,
+}
+
+
+def get(name):
+    if callable(name):
+        return name
+    key = str(name).lower().replace("_", "")
+    if key not in _LOSSES:
+        raise ValueError(f"Unknown loss: {name!r} (have {sorted(_LOSSES)})")
+    return _LOSSES[key]
+
+
+def get_fused(loss_name, activation_name):
+    """Return (fused_logits_loss or None)."""
+    key = (str(loss_name).lower().replace("_", ""), str(activation_name).lower())
+    return _FUSED.get(key)

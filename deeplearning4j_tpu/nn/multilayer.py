@@ -1,0 +1,421 @@
+"""MultiLayerNetwork — the sequential-network runtime.
+
+Reference: ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork`` (~4k lines;
+SURVEY D2, call stack 3.1/3.2). TPU-first redesign of its hot loop: instead
+of per-op JNI dispatch through Solver → layer.activate → executioner, the
+ENTIRE ``computeGradientAndScore + updater`` sequence is ONE donated-buffer
+XLA program, compiled once per (shape, training-config) and cached. The
+eager `feedForward`/`output` APIs and the flat-param contract (net.params()
+write-through view) are preserved for parity; TBPTT runs the jitted step per
+time-chunk with carried RNN state (lax.scan inside, host loop across chunks).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.nn import params as _flat
+from deeplearning4j_tpu.nn.conf.configuration import BackpropType, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+_MASK_AWARE = (L._RnnBase, L.Bidirectional, L.LastTimeStep, L.SelfAttentionLayer, L.GlobalPoolingLayer)
+
+
+def _maybe_unflatten_input(x, input_type):
+    """ref: FeedForwardToCnnPreProcessor — a ``convolutional_flat`` input type
+    means callers feed (N, H*W*C) rows that conv stacks consume as NHWC."""
+    if input_type is not None and input_type.kind == "cnn_flat" and x.ndim == 2:
+        return x.reshape(x.shape[0], input_type.height, input_type.width,
+                         input_type.channels)
+    return x
+
+
+def _grad_transform(conf: MultiLayerConfiguration) -> optax.GradientTransformation:
+    """Updater + gradient clipping/normalization chain (ref: BaseOptimizer
+    clipping + BaseMultiLayerUpdater, SURVEY D5/D6)."""
+    chain = []
+    gn = (conf.grad_normalization or "").lower().replace("_", "")
+    t = conf.grad_norm_threshold
+    if gn in ("clipelementwiseabsolutevalue",):
+        chain.append(optax.clip(t))
+    elif gn in ("clipl2perlayer", "clipl2perparamtype"):
+        chain.append(_clip_l2_per_leaf(t))
+    elif gn in ("renormalizel2perlayer",):
+        chain.append(_renorm_l2_per_leaf())
+    elif gn in ("clipl2global", "clipbyglobalnorm"):
+        chain.append(optax.clip_by_global_norm(t))
+    chain.append(conf.updater.to_optax())
+    return optax.chain(*chain)
+
+
+def _clip_l2_per_leaf(threshold):
+    def update(grads, state, params=None):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)) + 1e-12)
+            return jnp.where(n > threshold, g * (threshold / n), g)
+        return jax.tree.map(clip, grads), state
+    return optax.GradientTransformation(lambda p: optax.EmptyState(), update)
+
+
+def _renorm_l2_per_leaf():
+    def update(grads, state, params=None):
+        def renorm(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)) + 1e-12)
+            return g / n
+        return jax.tree.map(renorm, grads), state
+    return optax.GradientTransformation(lambda p: optax.EmptyState(), update)
+
+
+class MultiLayerNetwork:
+    """Sequential net: init → fit/output/evaluate (ref-parity surface)."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[L.Layer] = conf.layers
+        self._params: _flat.ParamTree = {}
+        self._states: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._param_shapes: Dict[str, Dict[str, tuple]] = {}
+        self._opt = _grad_transform(conf)
+        self._opt_state = None
+        self._iteration = 0
+        self._epoch = 0
+        self._score = float("nan")
+        self._listeners = []
+        self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep carries
+        self._last_batch_size = 0
+        self._key = jax.random.key(conf.seed)
+        self._initialized = False
+
+    # ------------------------------------------------------------------ init
+    def init(self) -> "MultiLayerNetwork":
+        """Allocate parameters (ref: MultiLayerNetwork#init; flat layout
+        contract per SURVEY 3.2 — ordering = layer idx, then param order)."""
+        key = jax.random.key(self.conf.seed)
+        for i, layer in enumerate(self.layers):
+            lkey = str(i)
+            key, sub = jax.random.split(key)
+            self._param_shapes[lkey] = dict(layer.param_shapes())
+            if layer.has_params():
+                self._params[lkey] = layer.init_params(sub)
+            else:
+                self._params[lkey] = {}
+            st = layer.init_state()
+            if st:
+                self._states[lkey] = st
+        self._opt_state = self._opt.init(self._params)
+        self._initialized = True
+        return self
+
+    # ------------------------------------------------------------- param API
+    def numParams(self) -> int:
+        return _flat.num_params(self._param_shapes)
+
+    def params(self) -> NDArray:
+        """Write-through flat param vector (ref contract: a view)."""
+        return _flat.params_view(self)
+
+    def getParam(self, key: str) -> NDArray:
+        lidx, pname = key.split("_", 1)
+        return NDArray(self._params[lidx][pname])
+
+    def setParams(self, flat) -> None:
+        self._params = _flat.unflatten_params(jnp.asarray(_unwrap(flat)), self._param_shapes)
+
+    def paramTable(self) -> Dict[str, NDArray]:
+        """{"0_W": ..., "0_b": ...} (ref: Model#paramTable naming)."""
+        out = {}
+        for lkey in self._params:
+            for pname, arr in self._params[lkey].items():
+                out[f"{lkey}_{pname}"] = NDArray(arr)
+        return out
+
+    def param_tree(self):
+        return self._params
+
+    def set_param_tree(self, tree):
+        self._params = tree
+
+    def state_tree(self):
+        return self._states
+
+    # ---------------------------------------------------------- listener API
+    def setListeners(self, *listeners):
+        self._listeners = list(listeners[0]) if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)) else list(listeners)
+
+    def addListeners(self, *listeners):
+        self._listeners.extend(listeners)
+
+    def getListeners(self):
+        return self._listeners
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, states, x, training, rng, mask=None, carries=None,
+                 collect=False, up_to=None):
+        """Trace the layer stack. `carries`: {layer_idx: carry} for TBPTT /
+        streaming; returns (activations list | final activation, new_states,
+        new_carries)."""
+        acts = []
+        new_states = dict(states)
+        new_carries = {}
+        h = _maybe_unflatten_input(x, self.conf.input_type)
+        n_layers = len(self.layers) if up_to is None else up_to
+        for i, layer in enumerate(self.layers[:n_layers]):
+            lkey = str(i)
+            lp = params.get(lkey, {})
+            lst = states.get(lkey)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            kwargs = {}
+            if mask is not None and isinstance(layer, _MASK_AWARE):
+                kwargs["mask"] = mask
+            if isinstance(layer, L._RnnBase) and carries is not None:
+                carry0 = carries.get(lkey)
+                if carry0 is None:
+                    carry0 = layer.initial_carry(h.shape[0])
+                h_in = layer._maybe_dropout(h, training, lrng)
+                h, carry = layer.run(lp, h_in, carry0, mask=mask)
+                new_carries[lkey] = carry
+            else:
+                h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)
+                if lst is not None and st is not None:
+                    new_states[lkey] = st
+            if collect:
+                acts.append(h)
+        return (acts if collect else h), new_states, new_carries
+
+    def _regularization_penalty(self, params):
+        """L1/L2 on weight params only (ref: BaseLayer regularization applies
+        to W-type params, not biases)."""
+        penalty = 0.0
+        for i, layer in enumerate(self.layers):
+            l1 = getattr(layer, "l1", None)
+            l2 = getattr(layer, "l2", None)
+            if not l1 and not l2:
+                continue
+            for pname, arr in params.get(str(i), {}).items():
+                if pname.lower().startswith(("b", "beta", "gamma", "p")):
+                    continue
+                if l1:
+                    penalty = penalty + l1 * jnp.sum(jnp.abs(arr))
+                if l2:
+                    penalty = penalty + 0.5 * l2 * jnp.sum(jnp.square(arr))
+        return penalty
+
+    def _loss_fn(self, params, states, x, labels, mask, label_mask, rng, carries=None):
+        h, new_states, new_carries = self._forward(
+            params, states, x, True, rng, mask=mask, carries=carries,
+            up_to=len(self.layers) - 1)
+        out_layer = self.layers[-1]
+        lkey = str(len(self.layers) - 1)
+        lrng = jax.random.fold_in(rng, len(self.layers) - 1) if rng is not None else None
+        loss = out_layer.loss(params.get(lkey, {}), h, labels, mask=label_mask,
+                              training=True, rng=lrng)
+        loss = loss + self._regularization_penalty(params)
+        return loss, (new_states, new_carries)
+
+    # ------------------------------------------------------------ train step
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3))
+    def _train_step(self, params, opt_state, states, x, labels, mask, label_mask, rng, carries):
+        (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(params, states, x, labels, mask, label_mask, rng, carries)
+        updates, opt_state = self._opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_states, loss, new_carries
+
+    def computeGradientAndScore(self, x, labels, mask=None, label_mask=None):
+        """Eager gradient computation (ref: Model#computeGradientAndScore).
+        Returns (score, grads pytree)."""
+        x, labels = jnp.asarray(_unwrap(x)), jnp.asarray(_unwrap(labels))
+        self._key, rng = jax.random.split(self._key)
+        (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            self._params, self._states, x, labels,
+            None if mask is None else jnp.asarray(_unwrap(mask)),
+            None if label_mask is None else jnp.asarray(_unwrap(label_mask)), rng, None)
+        self._score = float(loss)
+        return self._score, grads
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(x, y) | fit(DataSet) | fit(iterator[, epochs]) (ref surface)."""
+        if labels is not None:
+            self._fit_batch(data, labels)
+            return self
+        if hasattr(data, "features"):  # DataSet
+            self._fit_batch(data.features, data.labels,
+                            getattr(data, "features_mask", None),
+                            getattr(data, "labels_mask", None))
+            return self
+        # iterator protocol
+        for ep in range(epochs):
+            for lst in self._listeners:
+                lst.on_epoch_start(self, self._epoch)
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                self._fit_batch(ds.features, ds.labels,
+                                getattr(ds, "features_mask", None),
+                                getattr(ds, "labels_mask", None))
+            for lst in self._listeners:
+                lst.on_epoch_end(self, self._epoch)
+            self._epoch += 1
+        return self
+
+    def _fit_batch(self, x, y, fmask=None, lmask=None):
+        if not self._initialized:
+            self.init()
+        x = jnp.asarray(_unwrap(x))
+        y = jnp.asarray(_unwrap(y))
+        fmask = None if fmask is None else jnp.asarray(_unwrap(fmask))
+        lmask = None if lmask is None else jnp.asarray(_unwrap(lmask))
+        self._last_batch_size = x.shape[0]
+        if (self.conf.backprop_type == BackpropType.TruncatedBPTT and x.ndim == 3):
+            self._fit_tbptt(x, y, fmask, lmask)
+        else:
+            self._key, rng = jax.random.split(self._key)
+            self._params, self._opt_state, self._states, loss, _ = self._train_step(
+                self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None)
+            self._score = float(loss)
+            self._iteration += 1
+            for lst in self._listeners:
+                lst.iteration_done(self, self._iteration, self._epoch, self._score)
+
+    def _fit_tbptt(self, x, y, fmask, lmask):
+        """Truncated BPTT (ref: MultiLayerNetwork#doTruncatedBPTT): chunk the
+        time axis, carry RNN state across chunks, gradients stop at chunk
+        boundaries (carries enter the next jitted step as constants)."""
+        t_total = x.shape[1]
+        fwd = self.conf.tbptt_fwd_length
+        carries = {}
+        for start in range(0, t_total, fwd):
+            end = min(start + fwd, t_total)
+            x_chunk = x[:, start:end]
+            y_chunk = y[:, start:end] if y.ndim == 3 else y
+            fm = fmask[:, start:end] if fmask is not None else None
+            lm = lmask[:, start:end] if lmask is not None else None
+            self._key, rng = jax.random.split(self._key)
+            self._params, self._opt_state, self._states, loss, carries = self._train_step(
+                self._params, self._opt_state, self._states, x_chunk, y_chunk, fm, lm, rng,
+                carries)
+            self._score = float(loss)
+            self._iteration += 1
+            for lst in self._listeners:
+                lst.iteration_done(self, self._iteration, self._epoch, self._score)
+
+    # ------------------------------------------------------------- inference
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _output_jit(self, params, states, x, mask):
+        h, _, _ = self._forward(params, states, x, False, None, mask=mask)
+        return h
+
+    def output(self, x, train: bool = False, mask=None) -> NDArray:
+        """Forward pass returning output-layer activations (ref: #output)."""
+        if not self._initialized:
+            self.init()
+        x = jnp.asarray(_unwrap(x))
+        mask = None if mask is None else jnp.asarray(_unwrap(mask))
+        return NDArray(self._output_jit(self._params, self._states, x, mask))
+
+    def feedForward(self, x, train: bool = False) -> List[NDArray]:
+        """All layer activations incl. input (ref: #feedForward)."""
+        x = jnp.asarray(_unwrap(x))
+        acts, _, _ = self._forward(self._params, self._states, x, train,
+                                   self._key if train else None, collect=True)
+        return [NDArray(x)] + [NDArray(a) for a in acts]
+
+    def predict(self, x) -> NDArray:
+        """Argmax class predictions (ref: #predict)."""
+        return NDArray(jnp.argmax(self.output(x).buf(), axis=-1))
+
+    def score(self, dataset=None) -> float:
+        """Last minibatch score, or score of a given DataSet (ref: #score)."""
+        if dataset is None:
+            return self._score
+        x = jnp.asarray(_unwrap(dataset.features))
+        y = jnp.asarray(_unwrap(dataset.labels))
+        loss, _ = self._loss_fn(self._params, self._states, x, y, None, None, None, None)
+        return float(loss)
+
+    # ----------------------------------------------------------- rnn streaming
+    def rnnTimeStep(self, x) -> NDArray:
+        """Stateful streaming inference (ref: #rnnTimeStep): carries hidden
+        state across calls; input (N, T, C) or (N, C) for single step."""
+        x = jnp.asarray(_unwrap(x))
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        carries = self._rnn_state or {}
+        h, _, new_carries = self._forward(self._params, self._states, x, False, None,
+                                          carries=carries)
+        self._rnn_state = {**carries, **new_carries}
+        return NDArray(h[:, -1] if single and h.ndim == 3 else h)
+
+    def rnnClearPreviousState(self):
+        self._rnn_state = {}
+
+    def rnnGetPreviousState(self, layer_idx: int):
+        return self._rnn_state.get(str(layer_idx))
+
+    # ------------------------------------------------------------- evaluation
+    def evaluate(self, iterator):
+        """Classification evaluation over an iterator (ref: #evaluate)."""
+        from deeplearning4j_tpu.eval.classification import Evaluation
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
+        return ev
+
+    def evaluateRegression(self, iterator):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        ev = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, out)
+        return ev
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path, save_updater: bool = True):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        ModelSerializer.write_model(self, path, save_updater)
+
+    @staticmethod
+    def load(path, load_updater: bool = True) -> "MultiLayerNetwork":
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        return ModelSerializer.restore_multi_layer_network(path, load_updater)
+
+    # ---------------------------------------------------------------- misc
+    def summary(self) -> str:
+        lines = [f"{'idx':<4}{'layer':<28}{'nParams':>10}  out"]
+        it = self.conf.input_type
+        for i, layer in enumerate(self.layers):
+            out_t = layer.output_type(it) if it is not None else None
+            it = out_t if out_t is not None else it
+            lines.append(f"{i:<4}{type(layer).__name__:<28}{layer.n_params():>10}  "
+                         f"{out_t.batch_shape() if out_t else '?'}")
+        lines.append(f"Total params: {self.numParams()}")
+        return "\n".join(lines)
+
+    def getIterationCount(self) -> int:
+        return self._iteration
+
+    def getEpochCount(self) -> int:
+        return self._epoch
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(MultiLayerConfiguration.from_json(self.conf.to_json()))
+        net.init()
+        net._params = jax.tree.map(lambda a: a, self._params)
+        net._states = jax.tree.map(lambda a: a, self._states)
+        return net

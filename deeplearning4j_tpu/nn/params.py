@@ -1,0 +1,82 @@
+"""Flat parameter vector ↔ pytree bridge.
+
+Reference behavior (load-bearing, SURVEY 3.2): ``MultiLayerNetwork#init``
+allocates ONE contiguous parameter vector; every layer's param table holds
+*views* into it, so ``net.params()`` / ``net.setParams`` / param averaging /
+threshold encoding all operate on a single array.
+
+TPU-first: the physical currency is a pytree ``{layer_idx: {name: array}}``
+(shardable per-leaf by GSPMD). This module preserves the *logical* flat
+contract: deterministic ordering (layer index, then param-dict insertion
+order), pack/unpack, and a write-through NDArray over the network's params.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+
+ParamTree = Dict[str, Dict[str, jnp.ndarray]]
+
+
+def param_layout(shapes_per_layer: Dict[str, Dict[str, Tuple[int, ...]]]):
+    """[(layer_key, param_name, shape, offset, size)] in canonical order."""
+    layout = []
+    off = 0
+    for lkey in shapes_per_layer:
+        for pname, shape in shapes_per_layer[lkey].items():
+            size = int(np.prod(shape)) if shape else 1
+            layout.append((lkey, pname, tuple(shape), off, size))
+            off += size
+    return layout, off
+
+
+def flatten_params(params: ParamTree) -> jnp.ndarray:
+    """Pack to a single flat vector (ref: net.params())."""
+    leaves = []
+    for lkey in params:
+        for pname in params[lkey]:
+            leaves.append(params[lkey][pname].ravel())
+    if not leaves:
+        return jnp.zeros((0,))
+    return jnp.concatenate(leaves)
+
+
+def unflatten_params(flat, shapes_per_layer) -> ParamTree:
+    """Unpack a flat vector into the pytree (ref: net.setParams)."""
+    layout, total = param_layout(shapes_per_layer)
+    if flat.shape[0] != total:
+        raise ValueError(f"Expected flat vector of length {total}, got {flat.shape[0]}")
+    out: ParamTree = {}
+    for lkey, pname, shape, off, size in layout:
+        out.setdefault(lkey, {})[pname] = jax.lax.dynamic_slice(flat, (off,), (size,)).reshape(shape)
+    return out
+
+
+def num_params(shapes_per_layer) -> int:
+    _, total = param_layout(shapes_per_layer)
+    return total
+
+
+class _ModelParamAdapter:
+    """NDArray view 'base' that reads/writes a model's param pytree, giving
+    ``net.params()`` reference write-through semantics
+    (e.g. ``net.params().muli(0.9)`` scales the live model)."""
+
+    def __init__(self, model):
+        self._model = model
+
+    def buf(self):
+        return flatten_params(self._model._params)
+
+    def _write(self, new_buf):
+        self._model._params = unflatten_params(jnp.asarray(new_buf), self._model._param_shapes)
+
+
+def params_view(model) -> NDArray:
+    adapter = _ModelParamAdapter(model)
+    return NDArray(None, base=adapter, index=slice(None))

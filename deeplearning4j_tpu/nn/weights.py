@@ -1,0 +1,52 @@
+"""Weight initialization, analog of ``org.deeplearning4j.nn.weights.WeightInit``
+enum + ``WeightInitUtil``. fan_in/fan_out follow the reference's definitions
+(for conv: fan_in = kh*kw*in_ch, fan_out = kh*kw*out_ch).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def init(name, key, shape, fan_in: float, fan_out: float, dtype=jnp.float32):
+    name = str(name).lower()
+    if name in ("zero", "zeros"):
+        return jnp.zeros(shape, dtype)
+    if name in ("one", "ones"):
+        return jnp.ones(shape, dtype)
+    if name == "constant":
+        return jnp.zeros(shape, dtype)
+    if name == "normal":  # ref: N(0, 1/sqrt(fanIn))
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if name == "uniform":  # ref: U[-a, a], a = 1/sqrt(fanIn)
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "xavier":  # ref: Glorot normal, var = 2/(fanIn+fanOut)
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if name == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if name in ("relu", "he", "he_normal"):  # ref RELU: var = 2/fanIn
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if name in ("relu_uniform", "he_uniform"):
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if name == "lecun_uniform":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "identity":
+        if len(shape) == 2 and shape[0] == shape[1]:
+            return jnp.eye(shape[0], dtype=dtype)
+        raise ValueError("IDENTITY init requires square 2-D shape")
+    if name in ("var_scaling_normal_fan_avg",):
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    raise ValueError(f"Unknown weight init: {name!r}")

@@ -1,0 +1,6 @@
+"""Op library: registry + standard XLA lowerings + Pallas platform kernels."""
+from deeplearning4j_tpu.ops import registry
+from deeplearning4j_tpu.ops import standard  # noqa: F401 — populates registry
+from deeplearning4j_tpu.ops import transforms
+
+__all__ = ["registry", "standard", "transforms"]

@@ -1,0 +1,86 @@
+"""Op registry: named ops with jax-traceable bodies + shape inference.
+
+Reference: libnd4j's declarable-op registry (``DeclarableOp`` +
+``ops/declarable/headers/*.h`` registrations, ~500 ops) and the Java mirror
+op classes (``DynamicCustomOp``). TPU-first redesign: an op is a named,
+jax-traceable callable; "shape function" is ``jax.eval_shape`` over the body
+(the compiler computes what the reference hand-wrote per op); execution is
+whatever jit context the caller is tracing in — ops never dispatch one by one
+across a runtime boundary.
+
+The registry is the shared vocabulary for the SameDiff-style graph engine
+(autodiff/), the TF/ONNX importers, and Pallas platform overrides (the
+analog of libnd4j's PlatformHelper cuDNN/oneDNN swap-in, SURVEY.md N4).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable                     # (*arrays, **attrs) -> array | tuple of arrays
+    num_outputs: int = 1
+    aliases: tuple = ()
+    # platform override (e.g. a Pallas kernel). When set and enabled, used
+    # instead of `fn` — the PlatformHelper analog.
+    platform_fn: Optional[Callable] = None
+
+    def __call__(self, *args, **attrs):
+        fn = self.platform_fn if (self.platform_fn is not None and _platform_overrides_enabled) else self.fn
+        return fn(*args, **attrs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_platform_overrides_enabled = True
+
+
+def register(name: str, fn: Callable = None, *, num_outputs: int = 1, aliases: Sequence[str] = ()):
+    """Register an op. Usable as decorator or direct call."""
+    def do_register(f):
+        op = OpDef(name=name, fn=f, num_outputs=num_outputs, aliases=tuple(aliases))
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return f
+    if fn is not None:
+        return do_register(fn)
+    return do_register
+
+
+def register_platform(name: str, fn: Callable):
+    """Attach an accelerated override (Pallas kernel) to an existing op."""
+    _REGISTRY[name].platform_fn = fn
+
+
+def set_platform_overrides(enabled: bool):
+    """Global toggle, used by crosscheck tests (Pallas vs XLA-builtin)."""
+    global _platform_overrides_enabled
+    _platform_overrides_enabled = enabled
+
+
+def get(name: str) -> OpDef:
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown op: {name!r}. {len(names())} ops registered.")
+    return _REGISTRY[name]
+
+
+def has(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def names() -> list:
+    return sorted({op.name for op in _REGISTRY.values()})
+
+
+def exec_op(name: str, *args, **attrs):
+    return get(name)(*args, **attrs)
+
+
+def infer_shape(name: str, *args, **attrs):
+    """Shape inference without execution (ref: DeclarableOp#calculateOutputShape)."""
+    return jax.eval_shape(lambda *a: get(name)(*a, **attrs), *args)

@@ -1,0 +1,643 @@
+"""Standard op library registrations — the TPU equivalents of libnd4j's
+declarable ops (``libnd4j/include/ops/declarable/generic/**``).
+
+Convention: arrays are jnp arrays (tracing-friendly); attrs are python
+scalars/tuples (static under jit). NHWC is the native conv layout on TPU
+(the reference is NCHW-first; importers transpose at the boundary).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import register
+
+# --------------------------------------------------------------- arithmetic
+register("add", lambda a, b: a + b, aliases=["Add"])
+register("sub", lambda a, b: a - b, aliases=["Sub", "subtract"])
+register("mul", lambda a, b: a * b, aliases=["Mul", "multiply"])
+register("div", lambda a, b: a / b, aliases=["Div", "RealDiv", "truediv"])
+register("floordiv", lambda a, b: jnp.floor_divide(a, b), aliases=["FloorDiv"])
+register("mod", lambda a, b: jnp.mod(a, b), aliases=["FloorMod", "Mod"])
+register("pow", lambda a, b: jnp.power(a, b), aliases=["Pow"])
+register("squaredsubtract", lambda a, b: jnp.square(a - b), aliases=["SquaredDifference"])
+register("maximum", jnp.maximum, aliases=["Maximum"])
+register("minimum", jnp.minimum, aliases=["Minimum"])
+register("neg", jnp.negative, aliases=["Neg"])
+register("reciprocal", jnp.reciprocal, aliases=["Reciprocal"])
+
+# --------------------------------------------------------------- elementwise
+for _n, _f, _al in [
+    ("abs", jnp.abs, ["Abs"]), ("exp", jnp.exp, ["Exp"]), ("log", jnp.log, ["Log"]),
+    ("log1p", jnp.log1p, ["Log1p"]), ("sqrt", jnp.sqrt, ["Sqrt"]),
+    ("rsqrt", lax.rsqrt, ["Rsqrt"]), ("square", jnp.square, ["Square"]),
+    ("sin", jnp.sin, ["Sin"]), ("cos", jnp.cos, ["Cos"]), ("tan", jnp.tan, ["Tan"]),
+    ("asin", jnp.arcsin, ["Asin"]), ("acos", jnp.arccos, ["Acos"]), ("atan", jnp.arctan, ["Atan"]),
+    ("sinh", jnp.sinh, ["Sinh"]), ("cosh", jnp.cosh, ["Cosh"]), ("tanh", jnp.tanh, ["Tanh"]),
+    ("asinh", jnp.arcsinh, []), ("acosh", jnp.arccosh, []), ("atanh", jnp.arctanh, []),
+    ("erf", jax.scipy.special.erf, ["Erf"]), ("erfc", jax.scipy.special.erfc, ["Erfc"]),
+    ("floor", jnp.floor, ["Floor"]), ("ceil", jnp.ceil, ["Ceil"]),
+    ("round", jnp.round, ["Round"]), ("sign", jnp.sign, ["Sign"]),
+    ("isnan", jnp.isnan, ["IsNan"]), ("isinf", jnp.isinf, ["IsInf"]),
+    ("isfinite", jnp.isfinite, ["IsFinite"]),
+]:
+    register(_n, _f, aliases=_al)
+
+register("clipbyvalue", lambda x, lo=None, hi=None, clip_value_min=None, clip_value_max=None:
+         jnp.clip(x, lo if lo is not None else clip_value_min, hi if hi is not None else clip_value_max),
+         aliases=["ClipByValue", "clip_by_value"])
+
+
+@register("clipbynorm", aliases=["ClipByNorm"])
+def _clipbynorm(x, clipnorm=1.0):
+    n = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(n > clipnorm, x * (clipnorm / n), x)
+
+
+# -------------------------------------------------------------- activations
+register("sigmoid", jax.nn.sigmoid, aliases=["Sigmoid"])
+register("relu", jax.nn.relu, aliases=["Relu"])
+register("relu6", jax.nn.relu6, aliases=["Relu6"])
+register("elu", jax.nn.elu, aliases=["Elu"])
+register("selu", jax.nn.selu, aliases=["Selu"])
+register("gelu", jax.nn.gelu, aliases=["Gelu"])
+register("softplus", jax.nn.softplus, aliases=["Softplus"])
+register("softsign", jax.nn.soft_sign, aliases=["Softsign"])
+register("swish", jax.nn.silu, aliases=["silu"])
+register("mish", jax.nn.mish)
+register("hard_sigmoid", jax.nn.hard_sigmoid, aliases=["HardSigmoid"])
+register("hard_tanh", lambda x: jnp.clip(x, -1.0, 1.0), aliases=["HardTanh"])
+register("leakyrelu", lambda x, alpha=0.01: jax.nn.leaky_relu(x, negative_slope=alpha),
+         aliases=["LeakyRelu", "leaky_relu"])
+register("prelu", lambda x, alpha: jnp.where(x >= 0, x, alpha * x), aliases=["PRelu"])
+register("cube", lambda x: x ** 3)
+register("rationaltanh", lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0))
+register("rectifiedtanh", lambda x: jnp.maximum(0.0, jnp.tanh(x)))
+register("thresholdedrelu", lambda x, theta=1.0: jnp.where(x > theta, x, 0.0))
+register("softmax", lambda x, axis=-1: jax.nn.softmax(x, axis=axis), aliases=["Softmax"])
+register("log_softmax", lambda x, axis=-1: jax.nn.log_softmax(x, axis=axis), aliases=["LogSoftmax"])
+
+
+# --------------------------------------------------------------- reductions
+def _red(fn):
+    def op(x, axis=None, keepdims=False, keep_dims=None):
+        kd = keepdims if keep_dims is None else keep_dims
+        if isinstance(axis, (list,)):
+            axis = tuple(axis)
+        return fn(x, axis=axis, keepdims=kd)
+    return op
+
+register("reduce_sum", _red(jnp.sum), aliases=["Sum", "sum"])
+register("reduce_mean", _red(jnp.mean), aliases=["Mean", "mean"])
+register("reduce_max", _red(jnp.max), aliases=["Max"])
+register("reduce_min", _red(jnp.min), aliases=["Min"])
+register("reduce_prod", _red(jnp.prod), aliases=["Prod"])
+register("reduce_norm1", _red(lambda x, axis=None, keepdims=False: jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)))
+register("reduce_norm2", _red(lambda x, axis=None, keepdims=False: jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=keepdims))))
+register("reduce_normmax", _red(lambda x, axis=None, keepdims=False: jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims)))
+register("reduce_variance", lambda x, axis=None, keepdims=False, bias_corrected=False:
+         jnp.var(x, axis=axis, ddof=1 if bias_corrected else 0, keepdims=keepdims))
+register("reduce_stdev", lambda x, axis=None, keepdims=False, bias_corrected=False:
+         jnp.std(x, axis=axis, ddof=1 if bias_corrected else 0, keepdims=keepdims))
+register("reduce_logsumexp", lambda x, axis=None, keepdims=False:
+         jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+register("argmax", lambda x, axis=None: jnp.argmax(x, axis=axis), aliases=["ArgMax"])
+register("argmin", lambda x, axis=None: jnp.argmin(x, axis=axis), aliases=["ArgMin"])
+register("cumsum", lambda x, axis=0, exclusive=False, reverse=False:
+         _cum(jnp.cumsum, x, axis, exclusive, reverse, 0.0), aliases=["Cumsum"])
+register("cumprod", lambda x, axis=0, exclusive=False, reverse=False:
+         _cum(jnp.cumprod, x, axis, exclusive, reverse, 1.0), aliases=["Cumprod"])
+
+
+def _cum(fn, x, axis, exclusive, reverse, init):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = fn(x, axis=axis)
+    if exclusive:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad, constant_values=init)
+        out = lax.slice_in_dim(out, 0, x.shape[axis], axis=axis)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+# -------------------------------------------------------------------- shape
+register("reshape", lambda x, shape: jnp.reshape(x, tuple(int(s) for s in shape)), aliases=["Reshape"])
+register("transpose", lambda x, perm=None: jnp.transpose(x, perm), aliases=["Transpose", "permute"])
+register("squeeze", lambda x, axis=None: jnp.squeeze(x, axis=tuple(axis) if isinstance(axis, list) else axis), aliases=["Squeeze"])
+register("expand_dims", lambda x, axis=0: jnp.expand_dims(x, axis), aliases=["ExpandDims"])
+register("concat", lambda *xs, axis=0: jnp.concatenate(xs, axis=axis), aliases=["Concat", "ConcatV2"])
+register("stack", lambda *xs, axis=0: jnp.stack(xs, axis=axis), aliases=["Stack", "Pack"])
+register("unstack", lambda x, axis=0, num=None: tuple(jnp.moveaxis(x, axis, 0)),
+         num_outputs=-1, aliases=["Unstack", "Unpack"])
+register("tile", lambda x, reps: jnp.tile(x, tuple(int(r) for r in reps)), aliases=["Tile"])
+register("flip", lambda x, axis: jnp.flip(x, axis), aliases=["ReverseV2", "reverse"])
+register("slice", lambda x, begin, size: lax.dynamic_slice(x, tuple(int(b) for b in begin),
+                                                           tuple(x.shape[i] - int(begin[i]) if int(s) == -1 else int(s)
+                                                                 for i, s in enumerate(size))),
+         aliases=["Slice"])
+register("strided_slice", lambda x, begin, end, strides=None:
+         x[tuple(slice(int(b), int(e), int(s) if strides is not None else 1)
+                 for b, e, s in zip(begin, end, strides if strides is not None else [1] * len(begin)))],
+         aliases=["StridedSlice"])
+register("gather", lambda x, indices, axis=0: jnp.take(x, indices, axis=axis), aliases=["Gather", "GatherV2"])
+register("gather_nd", lambda x, indices: x[tuple(jnp.moveaxis(indices, -1, 0))], aliases=["GatherNd"])
+
+
+@register("scatter_update", aliases=["ScatterUpdate"])
+def _scatter_update(ref, indices, updates):
+    return ref.at[indices].set(updates)
+
+
+@register("scatter_add", aliases=["ScatterAdd"])
+def _scatter_add(ref, indices, updates):
+    return ref.at[indices].add(updates)
+
+
+register("pad", lambda x, paddings, mode="CONSTANT", constant_values=0:
+         jnp.pad(x, tuple(tuple(int(v) for v in p) for p in paddings),
+                 mode={"CONSTANT": "constant", "REFLECT": "reflect", "SYMMETRIC": "symmetric"}.get(str(mode).upper(), mode),
+                 **({"constant_values": constant_values} if str(mode).upper() == "CONSTANT" else {})),
+         aliases=["Pad", "PadV2"])
+register("shape_of", lambda x: jnp.asarray(x.shape, dtype=jnp.int32), aliases=["Shape"])
+register("size", lambda x: jnp.asarray(x.size, dtype=jnp.int32), aliases=["Size"])
+register("rank", lambda x: jnp.asarray(x.ndim, dtype=jnp.int32), aliases=["Rank"])
+register("cast", lambda x, dtype: x.astype(dtype), aliases=["Cast"])
+register("identity", lambda x: x, aliases=["Identity"])
+register("fill", lambda shape, value: jnp.full(tuple(int(s) for s in shape), value), aliases=["Fill"])
+register("zeros_like", jnp.zeros_like, aliases=["ZerosLike"])
+register("ones_like", jnp.ones_like, aliases=["OnesLike"])
+register("linspace", lambda start, stop, num: jnp.linspace(start, stop, int(num)), aliases=["LinSpace"])
+register("range", lambda start, limit, delta: jnp.arange(start, limit, delta), aliases=["Range"])
+register("one_hot", lambda indices, depth, on_value=1.0, off_value=0.0, axis=-1:
+         jax.nn.one_hot(indices, int(depth), axis=axis) * (on_value - off_value) + off_value,
+         aliases=["OneHot", "onehot"])
+register("where", lambda cond, x=None, y=None: jnp.where(cond, x, y) if x is not None
+         else jnp.stack(jnp.nonzero(cond), axis=-1), aliases=["Where", "Select", "SelectV2"])
+register("broadcast_to", lambda x, shape: jnp.broadcast_to(x, tuple(int(s) for s in shape)), aliases=["BroadcastTo"])
+register("space_to_depth", lambda x, block_size=2: _space_to_depth(x, int(block_size)), aliases=["SpaceToDepth"])
+register("depth_to_space", lambda x, block_size=2: _depth_to_space(x, int(block_size)), aliases=["DepthToSpace"])
+
+
+def _space_to_depth(x, b):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b, b, w // b, b, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, c * b * b)
+
+
+def _depth_to_space(x, b):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, b, b, c // (b * b))
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h * b, w * b, c // (b * b))
+
+
+# -------------------------------------------------------------- comparisons
+register("equals", lambda a, b: a == b, aliases=["Equal", "eq"])
+register("not_equals", lambda a, b: a != b, aliases=["NotEqual", "neq"])
+register("greater", lambda a, b: a > b, aliases=["Greater", "gt"])
+register("greater_equal", lambda a, b: a >= b, aliases=["GreaterEqual", "gte"])
+register("less", lambda a, b: a < b, aliases=["Less", "lt"])
+register("less_equal", lambda a, b: a <= b, aliases=["LessEqual", "lte"])
+register("boolean_and", jnp.logical_and, aliases=["LogicalAnd"])
+register("boolean_or", jnp.logical_or, aliases=["LogicalOr"])
+register("boolean_not", jnp.logical_not, aliases=["LogicalNot"])
+register("boolean_xor", jnp.logical_xor, aliases=["LogicalXor"])
+
+
+# ------------------------------------------------------------------- linalg
+@register("matmul", aliases=["MatMul", "mmul", "BatchMatMul", "BatchMatMulV2"])
+def _matmul(a, b, transpose_a=False, transpose_b=False, transA=None, transB=None):
+    ta = transpose_a if transA is None else bool(transA)
+    tb = transpose_b if transB is None else bool(transB)
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    prefer = jnp.float32 if a.dtype in (jnp.bfloat16, jnp.float16) else None
+    return jnp.matmul(a, b, preferred_element_type=prefer)
+
+
+register("tensordot", lambda a, b, axes: jnp.tensordot(a, b, axes=axes), aliases=["tensormmul"])
+register("diag", jnp.diag, aliases=["Diag"])
+register("diag_part", jnp.diagonal, aliases=["DiagPart"])
+register("matrix_inverse", jnp.linalg.inv, aliases=["MatrixInverse"])
+register("matrix_determinant", jnp.linalg.det, aliases=["MatrixDeterminant"])
+register("cholesky", jnp.linalg.cholesky, aliases=["Cholesky"])
+register("qr", jnp.linalg.qr, num_outputs=2, aliases=["Qr"])
+register("svd", lambda x, full_matrices=False: jnp.linalg.svd(x, full_matrices=full_matrices),
+         num_outputs=3, aliases=["Svd"])
+register("trace", jnp.trace, aliases=["Trace"])
+register("lstsq", lambda a, b: jnp.linalg.lstsq(a, b)[0])
+register("triangular_solve", lambda a, b, lower=True: jax.scipy.linalg.solve_triangular(a, b, lower=lower))
+register("solve", jnp.linalg.solve, aliases=["MatrixSolve"])
+register("matrix_band_part", lambda x, lower, upper: _band_part(x, int(lower), int(upper)),
+         aliases=["MatrixBandPart"])
+
+
+def _band_part(x, lower, upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.logical_and(
+        (i - j) <= (lower if lower >= 0 else m),
+        (j - i) <= (upper if upper >= 0 else n))
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+# ------------------------------------------------------------- convolutions
+# NHWC / NWC / NDHWC layouts — TPU-native. Weights: HWIO (spatial..., in, out).
+def _conv_nd(x, w, strides, padding, dilation, dims, feature_group_count=1):
+    num = {1: ("NWC", "WIO", "NWC"), 2: ("NHWC", "HWIO", "NHWC"), 3: ("NDHWC", "DHWIO", "NDHWC")}[dims]
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=num,
+        feature_group_count=feature_group_count,
+        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None)
+
+
+def _pad_attr(padding, kernel, strides, dilation=None):
+    """Map DL4J/TF padding attrs to lax padding."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        padding = (padding,) * len(kernel)
+    if all(isinstance(p, (tuple, list)) for p in padding):
+        return [(int(lo), int(hi)) for lo, hi in padding]
+    return [(int(p), int(p)) for p in padding]
+
+
+@register("conv1d", aliases=["Conv1D"])
+def conv1d(x, w, b=None, stride=1, padding="SAME", dilation=1):
+    out = _conv_nd(x, w, (int(stride),), _pad_attr(padding, (0,), None), (int(dilation),), 1)
+    return out + b if b is not None else out
+
+
+@register("conv2d", aliases=["Conv2D"])
+def conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1), groups=1):
+    strides = (strides, strides) if isinstance(strides, int) else tuple(strides)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    out = _conv_nd(x, w, strides, _pad_attr(padding, (0, 0), None), dilation, 2,
+                   feature_group_count=int(groups))
+    return out + b if b is not None else out
+
+
+@register("conv3d", aliases=["Conv3D"])
+def conv3d(x, w, b=None, strides=(1, 1, 1), padding="SAME", dilation=(1, 1, 1)):
+    out = _conv_nd(x, w, tuple(strides), _pad_attr(padding, (0, 0, 0), None), tuple(dilation), 3)
+    return out + b if b is not None else out
+
+
+@register("depthwise_conv2d", aliases=["DepthwiseConv2dNative", "sconv2d_depthwise"])
+def depthwise_conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1)):
+    # w: (H, W, C, multiplier) → grouped conv with C groups
+    h, ww, c, m = w.shape
+    w2 = w.reshape(h, ww, 1, c * m)
+    out = _conv_nd(x, w2, tuple(strides), _pad_attr(padding, (0, 0), None), tuple(dilation), 2,
+                   feature_group_count=c)
+    return out + b if b is not None else out
+
+
+@register("deconv2d", aliases=["Conv2DTranspose", "Conv2DBackpropInput"])
+def deconv2d(x, w, b=None, strides=(1, 1), padding="SAME"):
+    strides = (strides, strides) if isinstance(strides, int) else tuple(strides)
+    pad = padding.upper() if isinstance(padding, str) else [(int(p), int(p)) for p in ((padding, padding) if isinstance(padding, int) else padding)]
+    out = lax.conv_transpose(x, w, strides=strides, padding=pad,
+                             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + b if b is not None else out
+
+
+def _pool(x, kind, window, strides, padding, dims):
+    init, fn = {"max": (-np.inf, lax.max), "sum": (0.0, lax.add)}[kind]
+    window = (1,) + tuple(window) + (1,)
+    strides = (1,) + tuple(strides) + (1,)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        if all(isinstance(p, (tuple, list)) for p in padding):
+            spatial = tuple((int(lo), int(hi)) for lo, hi in padding)
+        else:
+            spatial = tuple((int(p), int(p)) for p in padding)
+        pad = ((0, 0),) + spatial + ((0, 0),)
+    # init must stay a concrete scalar: a traced/Array init routes
+    # reduce_window onto the generic variadic primitive, which has no
+    # reverse-mode rule under jit∘grad linearization.
+    return lax.reduce_window(x, np.asarray(init, x.dtype), fn, window, strides, pad)
+
+
+@register("maxpool2d", aliases=["MaxPool", "max_pool_2d", "MaxPoolV2"])
+def maxpool2d(x, kernel=(2, 2), strides=None, padding="VALID"):
+    kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    strides = kernel if strides is None else ((strides, strides) if isinstance(strides, int) else tuple(strides))
+    return _pool(x, "max", kernel, strides, padding, 2)
+
+
+@register("avgpool2d", aliases=["AvgPool", "avg_pool_2d"])
+def avgpool2d(x, kernel=(2, 2), strides=None, padding="VALID"):
+    kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    strides = kernel if strides is None else ((strides, strides) if isinstance(strides, int) else tuple(strides))
+    s = _pool(x, "sum", kernel, strides, padding, 2)
+    if isinstance(padding, str) and padding.upper() == "VALID":
+        return s / (kernel[0] * kernel[1])
+    ones = jnp.ones_like(x)
+    counts = _pool(ones, "sum", kernel, strides, padding, 2)
+    return s / counts
+
+
+def _norm_pool_args(kernel, strides, dims):
+    kernel = (kernel,) * dims if isinstance(kernel, int) else tuple(kernel)
+    if strides is None:
+        strides = kernel
+    else:
+        strides = (strides,) * dims if isinstance(strides, int) else tuple(strides)
+    return kernel, strides
+
+
+@register("pnormpool2d")
+def pnormpool2d(x, kernel=(2, 2), strides=None, padding="VALID", pnorm=2):
+    kernel, strides = _norm_pool_args(kernel, strides, 2)
+    s = _pool(jnp.abs(x) ** pnorm, "sum", kernel, strides, padding, 2)
+    return s ** (1.0 / pnorm)
+
+
+@register("maxpool3d", aliases=["MaxPool3D"])
+def maxpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID"):
+    kernel, strides = _norm_pool_args(kernel, strides, 3)
+    return _pool(x, "max", kernel, strides, padding, 3)
+
+
+@register("avgpool3d", aliases=["AvgPool3D"])
+def avgpool3d(x, kernel=(2, 2, 2), strides=None, padding="VALID"):
+    kernel, strides = _norm_pool_args(kernel, strides, 3)
+    s = _pool(x, "sum", kernel, strides, padding, 3)
+    if isinstance(padding, str) and padding.upper() == "VALID":
+        return s / (kernel[0] * kernel[1] * kernel[2])
+    counts = _pool(jnp.ones_like(x), "sum", kernel, strides, padding, 3)
+    return s / counts
+
+
+@register("global_avgpool2d")
+def global_avgpool2d(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+@register("upsampling2d", aliases=["ResizeNearestNeighbor"])
+def upsampling2d(x, size=2):
+    size = (size, size) if isinstance(size, int) else tuple(size)
+    return jnp.repeat(jnp.repeat(x, size[0], axis=1), size[1], axis=2)
+
+
+@register("resize_bilinear", aliases=["ResizeBilinear"])
+def resize_bilinear(x, size):
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, int(size[0]), int(size[1]), c), method="bilinear")
+
+
+@register("im2col")
+def im2col(x, kernel, strides=(1, 1), padding="VALID"):
+    """Patch extraction (ref: libnd4j im2col helper); NHWC → (N, OH, OW, KH*KW*C)."""
+    kh, kw = kernel
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(strides),
+        padding.upper() if isinstance(padding, str) else [(p, p) for p in padding],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return patches
+
+
+# ------------------------------------------------------------ normalization
+@register("batchnorm", aliases=["FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"])
+def batchnorm(x, mean, variance, gamma=None, beta=None, epsilon=1e-5, axis=-1):
+    shp = [1] * x.ndim
+    shp[axis] = x.shape[axis]
+    inv = lax.rsqrt(variance.astype(jnp.float32) + epsilon).reshape(shp).astype(x.dtype)
+    out = (x - mean.reshape(shp).astype(x.dtype)) * inv
+    if gamma is not None:
+        out = out * gamma.reshape(shp).astype(x.dtype)
+    if beta is not None:
+        out = out + beta.reshape(shp).astype(x.dtype)
+    return out
+
+
+@register("layer_norm", aliases=["LayerNorm"])
+def layer_norm(x, gamma=None, beta=None, axis=-1, epsilon=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if gamma is not None:
+        out = out * gamma
+    if beta is not None:
+        out = out + beta
+    return out
+
+
+@register("lrn", aliases=["LRN"])
+def lrn(x, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    sq = jnp.square(x)
+    d = int(depth_radius)
+    pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(d, d)])
+    window = jnp.stack([pad[..., i:i + x.shape[-1]] for i in range(2 * d + 1)], axis=0).sum(axis=0)
+    return x / jnp.power(bias + alpha * window, beta)
+
+
+@register("standardize")
+def standardize(x, axis=-1, epsilon=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    std = jnp.std(x, axis=axis, keepdims=True)
+    return (x - mean) / (std + epsilon)
+
+
+@register("l2_normalize", aliases=["L2Normalize"])
+def l2_normalize(x, axis=-1, epsilon=1e-12):
+    return x * lax.rsqrt(jnp.maximum(jnp.sum(jnp.square(x), axis=axis, keepdims=True), epsilon))
+
+
+# ------------------------------------------------------------------- losses
+@register("softmax_cross_entropy", aliases=["SoftmaxCrossEntropyWithLogits"])
+def softmax_cross_entropy(logits, labels, axis=-1):
+    return -jnp.sum(labels * jax.nn.log_softmax(logits, axis=axis), axis=axis)
+
+
+@register("sparse_softmax_cross_entropy", aliases=["SparseSoftmaxCrossEntropyWithLogits"])
+def sparse_softmax_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+
+@register("sigmoid_cross_entropy")
+def sigmoid_cross_entropy(logits, labels):
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+# ---------------------------------------------------------------- recurrent
+@register("lstm_cell", aliases=["LSTMBlockCell"])
+def lstm_cell(x, h_prev, c_prev, w, b, forget_bias=1.0):
+    """One fused LSTM step. w: (input+hidden, 4*hidden) gate order i,f,g,o —
+    a single MXU matmul per step (ref: libnd4j lstmLayer/lstmBlockCell)."""
+    z = jnp.concatenate([x, h_prev], axis=-1) @ w + b
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+@register("gru_cell", aliases=["GRUCell"])
+def gru_cell(x, h_prev, w_rz, w_h, b_rz, b_h):
+    """GRU step. w_rz: (input+hidden, 2*hidden); w_h: (input+hidden, hidden)."""
+    xh = jnp.concatenate([x, h_prev], axis=-1)
+    rz = jax.nn.sigmoid(xh @ w_rz + b_rz)
+    r, z = jnp.split(rz, 2, axis=-1)
+    h_tilde = jnp.tanh(jnp.concatenate([x, r * h_prev], axis=-1) @ w_h + b_h)
+    return (1.0 - z) * h_tilde + z * h_prev
+
+
+@register("sru_cell")
+def sru_cell(x, c_prev, w, b):
+    z = x @ w
+    xt, f, r = jnp.split(z, 3, axis=-1)
+    bf, br = jnp.split(b, 2, axis=-1)
+    f = jax.nn.sigmoid(f + bf)
+    r = jax.nn.sigmoid(r + br)
+    c = f * c_prev + (1 - f) * xt
+    h = r * jnp.tanh(c) + (1 - r) * x[..., :c.shape[-1]]
+    return h, c
+
+
+# ---------------------------------------------------------------- attention
+@register("dot_product_attention", aliases=["MultiHeadDotProductAttention"])
+def dot_product_attention(q, k, v, mask=None, scaled=True):
+    """(..., heads, seq, d) attention; softmax in f32 for bf16 stability."""
+    d = q.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", q, k,
+                        preferred_element_type=jnp.float32)
+    if scaled:
+        scores = scores / jnp.sqrt(jnp.asarray(d, scores.dtype))
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("...qk,...kd->...qd", w, v)
+
+
+# ------------------------------------------------------------------- random
+@register("dropout")
+def dropout(x, key, rate=0.5):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+@register("random_normal", aliases=["RandomStandardNormal"])
+def random_normal(key, shape, mean=0.0, stddev=1.0, dtype=jnp.float32):
+    return mean + stddev * jax.random.normal(key, tuple(shape), dtype)
+
+
+@register("random_uniform", aliases=["RandomUniform"])
+def random_uniform(key, shape, minval=0.0, maxval=1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, tuple(shape), dtype, minval, maxval)
+
+
+@register("random_bernoulli")
+def random_bernoulli(key, shape, p=0.5):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(jnp.float32)
+
+
+@register("dropout_inverted")
+def dropout_inverted(x, key, p=0.5):
+    """DL4J dropout semantics: p = RETAIN probability (ref: Dropout layer docs)."""
+    mask = jax.random.bernoulli(key, p, x.shape)
+    return jnp.where(mask, x / p, jnp.zeros_like(x))
+
+
+# -------------------------------------------------------------- image / misc
+@register("non_max_suppression", aliases=["NonMaxSuppressionV3"])
+def non_max_suppression(boxes, scores, max_output_size=10, iou_threshold=0.5, score_threshold=-jnp.inf):
+    """Sequential greedy NMS as lax.scan over fixed max_output_size (static
+    shapes — returns padded indices with -1; ref: generic/image ops)."""
+    n = boxes.shape[0]
+    ys1, xs1, ys2, xs2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    y1 = jnp.minimum(ys1, ys2); y2 = jnp.maximum(ys1, ys2)
+    x1 = jnp.minimum(xs1, xs2); x2 = jnp.maximum(xs1, xs2)
+    areas = (y2 - y1) * (x2 - x1)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j]); xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j]); xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(0.0, yy2 - yy1) * jnp.maximum(0.0, xx2 - xx1)
+        return inter / (areas[i] + areas[j] - inter + 1e-9)
+
+    def body(carry, _):
+        valid, = carry
+        masked = jnp.where(valid, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = valid[best] & (masked[best] >= score_threshold)
+        idx = jnp.where(ok, best, -1)
+        ious = jax.vmap(lambda j: iou(best, j))(jnp.arange(n))
+        valid = valid & (ious <= iou_threshold) & ok
+        return (valid,), idx
+
+    (_,), out = lax.scan(body, (jnp.ones(n, bool),), None, length=int(max_output_size))
+    return out
+
+
+@register("confusion_matrix", aliases=["ConfusionMatrix"])
+def confusion_matrix(labels, predictions, num_classes):
+    idx = labels.astype(jnp.int32) * num_classes + predictions.astype(jnp.int32)
+    counts = jnp.bincount(idx, length=num_classes * num_classes)
+    return counts.reshape(num_classes, num_classes)
+
+
+@register("top_k", aliases=["TopKV2", "TopK"], num_outputs=2)
+def top_k(x, k=1, sorted=True):
+    return lax.top_k(x, int(k))
+
+
+@register("in_top_k", aliases=["InTopKV2"])
+def in_top_k(predictions, targets, k=1):
+    _, idx = lax.top_k(predictions, int(k))
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@register("segment_sum", aliases=["SegmentSum"])
+def segment_sum(data, segment_ids, num_segments=None):
+    n = int(num_segments) if num_segments is not None else int(segment_ids.max()) + 1
+    return jax.ops.segment_sum(data, segment_ids, n)
+
+
+@register("sequence_mask", aliases=["SequenceMask"])
+def sequence_mask(lengths, maxlen=None):
+    m = int(maxlen) if maxlen is not None else int(lengths.max())
+    return jnp.arange(m)[None, :] < lengths[:, None]
+
+
+@register("reverse_sequence", aliases=["ReverseSequence"])
+def reverse_sequence(x, seq_lengths, seq_axis=1, batch_axis=0):
+    x = jnp.moveaxis(x, (batch_axis, seq_axis), (0, 1))
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    rev = seq_lengths[:, None] - 1 - idx
+    gather_idx = jnp.where(idx < seq_lengths[:, None], rev, idx)
+    out = jnp.take_along_axis(x, gather_idx.reshape(gather_idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return jnp.moveaxis(out, (0, 1), (batch_axis, seq_axis))
+
+
+# ----------------------------------------------- threshold codec (Strom 2015)
+@register("encode_threshold")
+def encode_threshold(grad, threshold=1e-3):
+    """Sparse 1-bit gradient encoding: returns (flat sign int8, mask, residual).
+    Ref: libnd4j encode_threshold / EncodedGradientsAccumulator (SURVEY N9/D7).
+    On-TPU gradient sync uses dense allreduce instead; this codec exists for
+    the DCN cross-slice path and API parity. Dense-mask representation —
+    XLA-friendly static shapes (index lists are host-side concepts)."""
+    flat = grad.ravel()
+    over = jnp.abs(flat) >= threshold
+    signs = jnp.where(over, jnp.sign(flat), 0.0).astype(jnp.int8)
+    residual = jnp.where(over, flat - jnp.sign(flat) * threshold, flat)
+    return signs, residual
+
+
+@register("decode_threshold")
+def decode_threshold(signs, threshold=1e-3, shape=None):
+    out = signs.astype(jnp.float32) * threshold
+    return out.reshape(tuple(shape)) if shape is not None else out

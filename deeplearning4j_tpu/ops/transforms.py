@@ -1,0 +1,151 @@
+"""Eager elementwise/transform ops, analog of
+``org.nd4j.linalg.ops.transforms.Transforms`` plus the commonly used
+``Nd4j.math`` surface. Bodies are XLA-lowered jnp calls — the reference's
+hand-written loop families (libnd4j loops/cpu/transform_*.hpp) collapse into
+the compiler (SURVEY.md N2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+
+
+def _u1(fn):
+    def op(x, *args, **kwargs):
+        return NDArray(fn(_unwrap(x), *[_unwrap(a) for a in args], **kwargs))
+    return op
+
+
+# --- strict transforms
+exp = _u1(jnp.exp)
+log = _u1(jnp.log)
+log1p = _u1(jnp.log1p)
+expm1 = _u1(jnp.expm1)
+sqrt = _u1(jnp.sqrt)
+cbrt = _u1(jnp.cbrt)
+abs = _u1(jnp.abs)
+sign = _u1(jnp.sign)
+floor = _u1(jnp.floor)
+ceil = _u1(jnp.ceil)
+round = _u1(jnp.round)
+sin = _u1(jnp.sin)
+cos = _u1(jnp.cos)
+tan = _u1(jnp.tan)
+asin = _u1(jnp.arcsin)
+acos = _u1(jnp.arccos)
+atan = _u1(jnp.arctan)
+sinh = _u1(jnp.sinh)
+cosh = _u1(jnp.cosh)
+tanh = _u1(jnp.tanh)
+atanh = _u1(jnp.arctanh)
+asinh = _u1(jnp.arcsinh)
+acosh = _u1(jnp.arccosh)
+reciprocal = _u1(jnp.reciprocal)
+square = _u1(jnp.square)
+erf = _u1(jax.scipy.special.erf)
+erfc = _u1(jax.scipy.special.erfc)
+
+
+def pow(x, p):
+    return NDArray(jnp.power(_unwrap(x), _unwrap(p)))
+
+
+def max(x, y):
+    return NDArray(jnp.maximum(_unwrap(x), _unwrap(y)))
+
+
+def min(x, y):
+    return NDArray(jnp.minimum(_unwrap(x), _unwrap(y)))
+
+
+def clip(x, lo, hi):
+    return NDArray(jnp.clip(_unwrap(x), lo, hi))
+
+
+def atan2(y, x):
+    return NDArray(jnp.arctan2(_unwrap(y), _unwrap(x)))
+
+
+def isNaN(x):
+    return NDArray(jnp.isnan(_unwrap(x)))
+
+
+def isInf(x):
+    return NDArray(jnp.isinf(_unwrap(x)))
+
+
+# --- neural activations (ref: Transforms + libnd4j generic/transforms)
+sigmoid = _u1(jax.nn.sigmoid)
+relu = _u1(jax.nn.relu)
+relu6 = _u1(jax.nn.relu6)
+elu = _u1(jax.nn.elu)
+selu = _u1(jax.nn.selu)
+gelu = _u1(jax.nn.gelu)
+softplus = _u1(jax.nn.softplus)
+softsign = _u1(jax.nn.soft_sign)
+hardSigmoid = _u1(jax.nn.hard_sigmoid)
+hardTanh = _u1(lambda x: jnp.clip(x, -1.0, 1.0))
+swish = _u1(jax.nn.silu)
+mish = _u1(jax.nn.mish)
+
+
+def leakyRelu(x, alpha=0.01):
+    return NDArray(jax.nn.leaky_relu(_unwrap(x), negative_slope=alpha))
+
+
+def softmax(x, axis=-1):
+    return NDArray(jax.nn.softmax(_unwrap(x), axis=axis))
+
+
+def logSoftmax(x, axis=-1):
+    return NDArray(jax.nn.log_softmax(_unwrap(x), axis=axis))
+
+
+def logSumExp(x, axis=None):
+    return NDArray(jax.scipy.special.logsumexp(_unwrap(x), axis=axis))
+
+
+def step(x):
+    return NDArray((_unwrap(x) > 0).astype(jnp.float32))
+
+
+# --- distance / similarity (ref: Transforms#cosineSim etc.)
+def cosineSim(a, b) -> float:
+    a, b = _unwrap(a).ravel(), _unwrap(b).ravel()
+    return float(jnp.vdot(a, b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b)))
+
+
+def cosineDistance(a, b) -> float:
+    return 1.0 - cosineSim(a, b)
+
+
+def euclideanDistance(a, b) -> float:
+    return float(jnp.linalg.norm(_unwrap(a).ravel() - _unwrap(b).ravel()))
+
+
+def manhattanDistance(a, b) -> float:
+    return float(jnp.sum(jnp.abs(_unwrap(a).ravel() - _unwrap(b).ravel())))
+
+
+def hammingDistance(a, b) -> float:
+    return float(jnp.sum(_unwrap(a).ravel() != _unwrap(b).ravel()))
+
+
+def jaccardDistance(a, b) -> float:
+    a, b = _unwrap(a).ravel(), _unwrap(b).ravel()
+    mn = jnp.sum(jnp.minimum(a, b))
+    mx = jnp.sum(jnp.maximum(a, b))
+    return float(1.0 - mn / mx)
+
+
+# --- normalization
+def unitVec(x):
+    b = _unwrap(x)
+    return NDArray(b / jnp.linalg.norm(b))
+
+
+def normalizeZeroMeanAndUnitVariance(x):
+    b = _unwrap(x)
+    return NDArray((b - jnp.mean(b, axis=0)) / (jnp.std(b, axis=0) + 1e-12))

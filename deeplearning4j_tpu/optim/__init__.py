@@ -1,0 +1,5 @@
+"""Updaters, schedules, listeners (ref: org.nd4j.linalg.learning, org.deeplearning4j.optimize)."""
+from deeplearning4j_tpu.optim.updaters import (
+    Adam, AdamW, AdaDelta, AdaGrad, AdaMax, AMSGrad, Nadam, Nesterovs, NoOp,
+    RmsProp, Sgd, Updater)
+from deeplearning4j_tpu.optim import schedules, listeners

@@ -1,0 +1,114 @@
+"""Training listener bus, analog of
+``org.deeplearning4j.optimize.api.TrainingListener`` and impls
+(ScoreIterationListener, PerformanceListener, EvaluativeListener,
+CheckpointListener, TimeIterationListener — SURVEY §5.5).
+
+Listeners fire at iteration granularity on the host, outside the jitted
+step — the XLA-era equivalent of the reference's listener callbacks around
+``Solver#optimize``.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, epoch: int, score: float):
+        pass
+
+    def on_epoch_start(self, model, epoch: int):
+        pass
+
+    def on_epoch_end(self, model, epoch: int):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_backward_pass(self, model):
+        pass
+
+    def on_gradient_calculation(self, model):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (ref: ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %.6f", iteration, score)
+
+
+class PerformanceListener(TrainingListener):
+    """Examples/sec + iterations/sec (ref: PerformanceListener)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_batch = report_batch
+        self._last_time = None
+        self._last_iter = None
+        self._examples = 0
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        batch = getattr(model, "_last_batch_size", 0)
+        self._examples += batch
+        if iteration % self.frequency == 0:
+            if self._last_time is not None:
+                dt = now - self._last_time
+                iters = iteration - self._last_iter
+                if dt > 0:
+                    log.info("iteration %d: %.1f iters/sec, %.1f examples/sec, score=%.6f",
+                             iteration, iters / dt, self._examples / dt, score)
+            self._last_time = now
+            self._last_iter = iteration
+            self._examples = 0
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (ref: TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int):
+        self.total = total_iterations
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, epoch, score):
+        elapsed = time.perf_counter() - self.start
+        if iteration > 0:
+            remaining = elapsed / iteration * (self.total - iteration)
+            log.info("iteration %d/%d, ETA %.0fs", iteration, self.total, remaining)
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (ref: EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency: int = 100):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration > 0 and iteration % self.frequency == 0:
+            self.iterator.reset()
+            self.last_evaluation = model.evaluate(self.iterator)
+            log.info("Evaluation at iteration %d:\n%s", iteration, self.last_evaluation.stats())
+
+
+class CollectScoresListener(TrainingListener):
+    """Score history in memory (ref: CollectScoresIterationListener)."""
+
+    def __init__(self):
+        self.scores = []
+        self.iterations = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        self.iterations.append(iteration)
+        self.scores.append(float(score))

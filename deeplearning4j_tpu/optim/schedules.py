@@ -1,0 +1,152 @@
+"""Learning-rate schedules, analog of ``org.nd4j.linalg.schedule.ISchedule``
+impls (MapSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+SigmoidSchedule, StepSchedule, CycleSchedule). ScheduleType ITERATION is the
+native unit (a jitted step == one iteration); EPOCH schedules take
+iterations_per_epoch at build time.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+_SCHEDULES = {}
+
+
+def _register(cls):
+    _SCHEDULES[cls.__name__.lower()] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class Schedule:
+    def value_at(self, iteration):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.value_at(step)
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@schedule"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _SCHEDULES[d.pop("@schedule").lower()]
+        return cls(**d)
+
+
+@_register
+@dataclasses.dataclass
+class FixedSchedule(Schedule):
+    value: float = 1e-3
+
+    def value_at(self, it):
+        return self.value
+
+
+@_register
+@dataclasses.dataclass
+class MapSchedule(Schedule):
+    """values[i] applies from iteration i onward (ref: MapSchedule)."""
+    values: Dict[int, float] = dataclasses.field(default_factory=dict)
+
+    def value_at(self, it):
+        keys = sorted(int(k) for k in self.values)
+        out = jnp.asarray(float(self.values[keys[0]] if not isinstance(next(iter(self.values)), str) else self.values[str(keys[0])]))
+        vals = {int(k): float(v) for k, v in self.values.items()}
+        for k in keys:
+            out = jnp.where(it >= k, vals[k], out)
+        return out
+
+
+@_register
+@dataclasses.dataclass
+class ExponentialSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.99
+
+    def value_at(self, it):
+        return self.initial_value * jnp.power(self.gamma, it)
+
+
+@_register
+@dataclasses.dataclass
+class InverseSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.01
+    power: float = 1.0
+
+    def value_at(self, it):
+        return self.initial_value / jnp.power(1.0 + self.gamma * it, self.power)
+
+
+@_register
+@dataclasses.dataclass
+class PolySchedule(Schedule):
+    initial_value: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def value_at(self, it):
+        frac = jnp.clip(it / self.max_iter, 0.0, 1.0)
+        return self.initial_value * jnp.power(1.0 - frac, self.power)
+
+
+@_register
+@dataclasses.dataclass
+class SigmoidSchedule(Schedule):
+    initial_value: float = 1e-3
+    gamma: float = 0.01
+    step_size: int = 100
+
+    def value_at(self, it):
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (it - self.step_size)))
+
+
+@_register
+@dataclasses.dataclass
+class StepSchedule(Schedule):
+    initial_value: float = 1e-3
+    decay_rate: float = 0.1
+    step: int = 1000
+
+    def value_at(self, it):
+        return self.initial_value * jnp.power(self.decay_rate, jnp.floor(it / self.step))
+
+
+@_register
+@dataclasses.dataclass
+class CosineSchedule(Schedule):
+    """Warmup-free cosine decay (TPU-era addition; no reference analog)."""
+    initial_value: float = 1e-3
+    max_iter: int = 10000
+    final_value: float = 0.0
+
+    def value_at(self, it):
+        frac = jnp.clip(it / self.max_iter, 0.0, 1.0)
+        return self.final_value + 0.5 * (self.initial_value - self.final_value) * (1 + jnp.cos(math.pi * frac))
+
+
+@_register
+@dataclasses.dataclass
+class WarmupSchedule(Schedule):
+    """Linear warmup wrapping another schedule (transformer fine-tune staple)."""
+    warmup_iters: int = 100
+    then_value: float = 1e-3
+
+    def value_at(self, it):
+        warm = self.then_value * (it + 1) / max(1, self.warmup_iters)
+        return jnp.where(it < self.warmup_iters, warm, self.then_value)
+
+
+def resolve(lr) -> Schedule:
+    if isinstance(lr, Schedule):
+        return lr
+    if isinstance(lr, dict) and "@schedule" in lr:
+        return Schedule.from_dict(lr)
+    return FixedSchedule(float(lr))

@@ -1,0 +1,182 @@
+"""Updaters (optimizers), analog of ``org.nd4j.linalg.learning.config.IUpdater``
+(Sgd, Adam, AdaMax, Nadam, AMSGrad, Nesterovs, RMSProp, AdaGrad, AdaDelta,
+NoOp) and their stateful ``GradientUpdater`` twins.
+
+TPU-first redesign: each updater is a declarative config that lowers to an
+optax GradientTransformation — the "stateful updater mutating a flat state
+view" (ref: BaseMultiLayerUpdater/UpdaterBlock, SURVEY D6/3.2) becomes
+optimizer state as a pytree carried through the jitted train step. The flat
+state view survives as a *logical* contract via nn.params.FlatParams.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+from deeplearning4j_tpu.optim import schedules as _sched
+
+_UPDATERS = {}
+
+
+def _register(cls):
+    _UPDATERS[cls.__name__.lower()] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class Updater:
+    learning_rate: object = 1e-3
+
+    def lr_schedule(self):
+        sched = _sched.resolve(self.learning_rate)
+        return lambda step: sched.value_at(step)
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        if isinstance(self.learning_rate, _sched.Schedule):
+            d["learning_rate"] = self.learning_rate.to_dict()
+        d["@updater"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _UPDATERS[d.pop("@updater").lower()]
+        if isinstance(d.get("learning_rate"), dict):
+            d["learning_rate"] = _sched.Schedule.from_dict(d["learning_rate"])
+        return cls(**d)
+
+
+@_register
+@dataclasses.dataclass
+class Sgd(Updater):
+    learning_rate: object = 0.1
+
+    def to_optax(self):
+        return optax.sgd(self.lr_schedule())
+
+
+@_register
+@dataclasses.dataclass
+class Nesterovs(Updater):
+    learning_rate: object = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self):
+        return optax.sgd(self.lr_schedule(), momentum=self.momentum, nesterov=True)
+
+
+@_register
+@dataclasses.dataclass
+class Adam(Updater):
+    learning_rate: object = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adam(self.lr_schedule(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class AdamW(Updater):
+    learning_rate: object = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 0.01
+
+    def to_optax(self):
+        return optax.adamw(self.lr_schedule(), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon, weight_decay=self.weight_decay)
+
+
+@_register
+@dataclasses.dataclass
+class AdaMax(Updater):
+    learning_rate: object = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adamax(self.lr_schedule(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class Nadam(Updater):
+    learning_rate: object = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.nadam(self.lr_schedule(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class AMSGrad(Updater):
+    learning_rate: object = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.amsgrad(self.lr_schedule(), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class RmsProp(Updater):
+    learning_rate: object = 1e-3
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.rmsprop(self.lr_schedule(), decay=self.rms_decay, eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class AdaGrad(Updater):
+    learning_rate: object = 1e-1
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adagrad(self.lr_schedule(), eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class AdaDelta(Updater):
+    learning_rate: object = 1.0  # unused by the rule itself (ref parity)
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+
+
+@_register
+@dataclasses.dataclass
+class NoOp(Updater):
+    learning_rate: object = 0.0
+
+    def to_optax(self):
+        return optax.set_to_zero()
+
+
+def resolve(u) -> Updater:
+    if isinstance(u, Updater):
+        return u
+    if isinstance(u, dict) and "@updater" in u:
+        return Updater.from_dict(u)
+    raise TypeError(f"Cannot resolve updater from {u!r}")

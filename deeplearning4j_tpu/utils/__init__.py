@@ -1,0 +1,2 @@
+"""Serialization, checkpointing, helpers."""
+from deeplearning4j_tpu.utils.serialization import ModelSerializer

@@ -1,0 +1,27 @@
+"""Test harness config: force an 8-device virtual CPU mesh so sharding /
+multi-chip paths are exercised without TPU hardware (the analog of the
+reference's localhost-Aeron / local[N]-Spark test trick, SURVEY.md §4).
+
+NOTE: this container pre-imports jax via a sitecustomize that registers a
+remote-TPU PJRT plugin and sets JAX_PLATFORMS=axon, so env-var setdefault is
+too late — we must override the live jax config BEFORE any backend
+initialization (safe: backends initialize lazily on first device/computation
+access).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    from deeplearning4j_tpu.ndarray import random as rng
+    rng.set_seed(12345)
+    yield

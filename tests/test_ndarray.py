@@ -1,0 +1,260 @@
+"""NDArray core tests (ref test model: nd4j-backends/nd4j-tests Nd4jTestsC)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nd
+from deeplearning4j_tpu.ndarray import NDArray
+from deeplearning4j_tpu.ops import transforms as T
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        a = nd.zeros(2, 3)
+        assert a.shape == (2, 3)
+        assert a.sumNumber() == 0.0
+        b = nd.ones(4)
+        assert b.sumNumber() == 4.0
+        c = nd.full((2, 2), 7.0)
+        assert c.meanNumber() == 7.0
+
+    def test_create_from_list(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.getDouble(1, 0) == 3.0
+
+    def test_arange_linspace_eye(self):
+        assert nd.arange(5).length() == 5
+        assert nd.linspace(0, 1, 11).getDouble(10) == pytest.approx(1.0)
+        assert nd.eye(3).sumNumber() == 3.0
+
+    def test_dtypes(self):
+        a = nd.zeros(2, 2, dtype="bfloat16")
+        assert str(a.dtype) == "bfloat16"
+        b = a.castTo("float32")
+        assert str(b.dtype) == "float32"
+
+    def test_rand_reproducible(self):
+        a = nd.rand(3, 3, seed=42)
+        b = nd.rand(3, 3, seed=42)
+        assert a.equals(b)
+
+    def test_stateful_rng(self):
+        nd.setSeed(7)
+        a = nd.randn(4)
+        b = nd.randn(4)
+        assert not a.equals(b)  # state advanced
+        nd.setSeed(7)
+        assert nd.randn(4).equals(a)  # reproducible from seed
+
+
+class TestArithmetic:
+    def test_add_sub_mul_div(self):
+        a = nd.create([1.0, 2.0, 3.0])
+        b = nd.create([4.0, 5.0, 6.0])
+        assert a.add(b).equals(nd.create([5.0, 7.0, 9.0]))
+        assert b.sub(a).equals(nd.create([3.0, 3.0, 3.0]))
+        assert a.mul(b).equals(nd.create([4.0, 10.0, 18.0]))
+        assert b.div(a).equals(nd.create([4.0, 2.5, 2.0]))
+
+    def test_operators(self):
+        a = nd.create([1.0, 2.0])
+        assert (a + 1).equals(nd.create([2.0, 3.0]))
+        assert (2 * a).equals(nd.create([2.0, 4.0]))
+        assert (1 - a).equals(nd.create([0.0, -1.0]))
+        assert (-a).equals(nd.create([-1.0, -2.0]))
+
+    def test_inplace_i_variants(self):
+        a = nd.create([1.0, 2.0, 3.0])
+        a.addi(10.0)
+        assert a.equals(nd.create([11.0, 12.0, 13.0]))
+        a.muli(2.0).subi(2.0)
+        assert a.equals(nd.create([20.0, 22.0, 24.0]))
+
+    def test_broadcasting(self):
+        a = nd.ones(3, 4)
+        row = nd.create([1.0, 2.0, 3.0, 4.0])
+        out = a.addRowVector(row)
+        assert out.shape == (3, 4)
+        assert out.getDouble(2, 3) == 5.0
+        col = nd.create([10.0, 20.0, 30.0])
+        out2 = a.mulColumnVector(col)
+        assert out2.getDouble(1, 0) == 20.0
+
+    def test_mmul(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.eye(2)
+        assert a.mmul(b).equals(a)
+        v = nd.create([1.0, 1.0])
+        assert a.mmul(v).equals(nd.create([3.0, 7.0]))
+
+    def test_mmul_bf16_accumulates_f32(self):
+        a = nd.ones(8, 8, dtype="bfloat16")
+        out = a.mmul(a)
+        assert out.getDouble(0, 0) == 8.0
+        assert str(out.dtype) == "float32"
+
+
+class TestReductions:
+    def test_sum_mean_dim(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum().item() == 10.0
+        assert a.sum(0).equals(nd.create([4.0, 6.0]))
+        assert a.mean(1).equals(nd.create([1.5, 3.5]))
+
+    def test_std_var_bias_correction(self):
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        # DL4J default is bias-corrected (n-1), matching numpy ddof=1
+        assert a.std().item() == pytest.approx(np.std([1, 2, 3, 4], ddof=1))
+        assert a.var(bias_corrected=False).item() == pytest.approx(np.var([1, 2, 3, 4]))
+
+    def test_norms(self):
+        a = nd.create([3.0, -4.0])
+        assert a.norm1().item() == 7.0
+        assert a.norm2().item() == 5.0
+        assert a.normmax().item() == 4.0
+
+    def test_argmax(self):
+        a = nd.create([[1.0, 5.0, 2.0], [7.0, 0.0, 3.0]])
+        assert a.argMax(1).toNumpy().tolist() == [1, 0]
+        assert int(a.argMax()) == 3
+
+    def test_cumsum(self):
+        assert nd.create([1.0, 2.0, 3.0]).cumsum(0).equals(nd.create([1.0, 3.0, 6.0]))
+
+
+class TestShape:
+    def test_reshape_transpose_permute(self):
+        a = nd.arange(6).reshape(2, 3)
+        assert a.shape == (2, 3)
+        assert a.T.shape == (3, 2)
+        b = nd.arange(24).reshape(2, 3, 4).permute(2, 0, 1)
+        assert b.shape == (4, 2, 3)
+
+    def test_ravel_squeeze_expand(self):
+        a = nd.zeros(2, 1, 3)
+        assert a.ravel().shape == (6,)
+        assert a.squeeze(1).shape == (2, 3)
+        assert a.expandDims(0).shape == (1, 2, 1, 3)
+
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 3), nd.zeros(2, 3)
+        assert nd.concat(0, a, b).shape == (4, 3)
+        assert nd.concat(1, a, b).shape == (2, 6)
+        assert nd.stack(0, a, b).shape == (2, 2, 3)
+        assert nd.vstack(a, b).shape == (4, 3)
+        assert nd.hstack(a, b).shape == (2, 6)
+
+    def test_tad(self):
+        a = nd.arange(24).reshape(2, 3, 4)
+        t = a.tensorAlongDimension(0, 1, 2)
+        assert t.shape == (3, 4)
+        assert t.equals(a[0])
+
+
+class TestViewsAndIndexing:
+    """The hard part (SURVEY §7): view write-through semantics."""
+
+    def test_basic_view_read(self):
+        a = nd.arange(12).reshape(3, 4)
+        row = a.getRow(1)
+        assert row.toNumpy().tolist() == [4, 5, 6, 7]
+
+    def test_view_write_through(self):
+        a = nd.zeros(3, 4)
+        row = a.getRow(1)
+        row.assign(5.0)
+        assert a.sum().item() == 20.0  # write propagated to base
+
+    def test_view_inplace_arithmetic_propagates(self):
+        a = nd.ones(4, 4)
+        sub = a[1:3, 1:3]
+        sub.addi(10.0)
+        assert a.getDouble(1, 1) == 11.0
+        assert a.getDouble(0, 0) == 1.0
+        assert a.sumNumber() == 16 + 40
+
+    def test_nested_view_propagation(self):
+        a = nd.zeros(4, 4)
+        block = a[0:2]          # view of a
+        cell = block[1, 2:4]    # view of view
+        cell.assign(3.0)
+        assert a.getDouble(1, 2) == 3.0
+        assert a.getDouble(1, 3) == 3.0
+        assert a.sumNumber() == 6.0
+
+    def test_putscalar_get(self):
+        a = nd.zeros(2, 2)
+        a.putScalar((0, 1), 42.0)
+        assert a.getDouble(0, 1) == 42.0
+        assert a.getScalar(0, 1).item() == 42.0
+
+    def test_put_column(self):
+        a = nd.zeros(3, 3)
+        a.putColumn(2, nd.create([1.0, 2.0, 3.0]))
+        assert a.getColumn(2).toNumpy().tolist() == [1.0, 2.0, 3.0]
+
+    def test_setitem(self):
+        a = nd.zeros(3, 3)
+        a[0] = 1.0
+        a[2, 2] = 9.0
+        assert a.sumNumber() == 12.0
+
+    def test_dup_detaches(self):
+        a = nd.ones(2, 2)
+        b = a.getRow(0).dup()
+        b.assign(100.0)
+        assert a.sumNumber() == 4.0  # dup broke the view link
+
+    def test_assign_broadcasts(self):
+        a = nd.zeros(2, 3)
+        a.assign(7.0)
+        assert a.meanNumber() == 7.0
+
+
+class TestComparisons:
+    def test_gt_lt(self):
+        a = nd.create([1.0, 5.0, 3.0])
+        assert a.gt(2.0).toNumpy().tolist() == [False, True, True]
+        assert a.lt(3.5).toNumpy().tolist() == [True, False, True]
+
+    def test_equals_with_eps(self):
+        a = nd.create([1.0, 2.0])
+        b = nd.create([1.0 + 1e-7, 2.0])
+        assert a.equalsWithEps(b, 1e-5)
+        assert not a.equals(nd.create([1.0, 3.0]))
+
+
+class TestTransforms:
+    def test_activations(self):
+        x = nd.create([-1.0, 0.0, 1.0])
+        assert T.relu(x).toNumpy().tolist() == [0.0, 0.0, 1.0]
+        assert T.sigmoid(nd.zeros(1)).item() == pytest.approx(0.5)
+        assert T.tanh(nd.zeros(1)).item() == 0.0
+        np.testing.assert_allclose(T.softmax(nd.create([1.0, 1.0])).toNumpy(), [0.5, 0.5], rtol=1e-6)
+
+    def test_exp_log_roundtrip(self):
+        x = nd.create([0.5, 1.0, 2.0])
+        assert T.log(T.exp(x)).equalsWithEps(x, 1e-4)
+
+    def test_distances(self):
+        a = nd.create([1.0, 0.0])
+        b = nd.create([0.0, 1.0])
+        assert T.euclideanDistance(a, b) == pytest.approx(np.sqrt(2))
+        assert T.cosineSim(a, b) == pytest.approx(0.0)
+        assert T.manhattanDistance(a, b) == 2.0
+
+    def test_unitvec(self):
+        v = T.unitVec(nd.create([3.0, 4.0]))
+        assert v.norm2().item() == pytest.approx(1.0)
+
+
+class TestInterop:
+    def test_numpy_roundtrip(self):
+        x = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        a = nd.create(x)
+        np.testing.assert_array_equal(a.toNumpy(), x)
+
+    def test_jnp_consumes_ndarray(self):
+        import jax.numpy as jnp
+        a = nd.ones(2, 2)
+        assert float(jnp.sum(a.buf())) == 4.0

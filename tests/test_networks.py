@@ -1,0 +1,206 @@
+"""Network-level regression tests (MultiLayerNetwork + ComputationGraph).
+
+Covers the seams found by the round-1 e2e verification and code review:
+conv padding forms, cnn_flat input reshape, pool autodiff under jit,
+wrapper-layer serialization, ComputationGraph save/load, mask plumbing.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import (
+    BackpropType, MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    Bidirectional, ConvolutionLayer, DenseLayer, GRU, LastTimeStep, LSTM,
+    OutputLayer, RnnOutputLayer, SimpleRnn, SubsamplingLayer)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph_conf import (
+    ComputationGraphConfiguration, ElementWiseVertex, MergeVertex)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.ops.registry import exec_op
+
+
+def _lenet_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(123).updater(Adam(1e-3)).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3, stride=1, activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=2, stride=2))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="negativeloglikelihood"))
+            .set_input_type(InputType.convolutional_flat(8, 8, 1))
+            .build())
+
+
+class TestConvNetTraining:
+    def test_cnn_flat_input_trains_jitted(self):
+        """cnn_flat (N, H*W*C) rows reshape to NHWC; pooling differentiates
+        under jit∘grad (regression: reduce_window init as traced array)."""
+        net = MultiLayerNetwork(_lenet_conf()).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((16, 64), dtype=np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y)
+        s0 = net.score()
+        for _ in range(10):
+            net.fit(x, y)
+        assert net.score() < s0
+
+    def test_conv_padding_int_pair_forms(self):
+        x = jnp.ones((2, 8, 8, 3))
+        w = jnp.ones((3, 3, 3, 4))
+        a = exec_op("conv2d", x, w, None, strides=(1, 1), padding=1)
+        b = exec_op("conv2d", x, w, None, strides=(1, 1), padding=(1, 1))
+        c = exec_op("conv2d", x, w, None, strides=(1, 1), padding=[(1, 1), (1, 1)])
+        assert a.shape == b.shape == c.shape == (2, 8, 8, 4)
+
+    def test_pool_int_strides_all_variants(self):
+        x = jnp.ones((1, 8, 8, 2))
+        assert exec_op("maxpool2d", x, kernel=2, strides=2).shape == (1, 4, 4, 2)
+        assert exec_op("pnormpool2d", x, kernel=2, strides=2).shape == (1, 4, 4, 2)
+        x3 = jnp.ones((1, 8, 8, 8, 2))
+        assert exec_op("maxpool3d", x3, kernel=2, strides=2).shape == (1, 4, 4, 4, 2)
+        assert exec_op("avgpool3d", x3, kernel=2, strides=2).shape == (1, 4, 4, 4, 2)
+
+    def test_avgpool_same_border_counts(self):
+        """SAME-padded average pooling divides by real window sizes at borders."""
+        x = jnp.ones((1, 3, 3, 1))
+        out2 = exec_op("avgpool2d", x, kernel=(2, 2), strides=(2, 2), padding="SAME")
+        np.testing.assert_allclose(np.asarray(out2), 1.0, rtol=1e-6)
+        x3 = jnp.ones((1, 3, 3, 3, 1))
+        out3 = exec_op("avgpool3d", x3, kernel=(2, 2, 2), strides=(2, 2, 2), padding="SAME")
+        np.testing.assert_allclose(np.asarray(out3), 1.0, rtol=1e-6)
+
+
+class TestWrapperSerialization:
+    def test_bidirectional_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3)).list()
+                .layer(Bidirectional.wrap(LSTM(n_out=8), mode="concat"))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax",
+                                      loss_function="negativeloglikelihood"))
+                .set_input_type(InputType.recurrent(6, 10))
+                .build())
+        restored = MultiLayerConfiguration.from_json(conf.to_json())
+        net = MultiLayerNetwork(restored).init()
+        assert net.numParams() > 0
+        x = np.random.default_rng(0).random((2, 10, 6), dtype=np.float32)
+        out = net.output(x)
+        assert out.shape == (2, 10, 4)
+
+    def test_last_time_step_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3)).list()
+                .layer(LastTimeStep.wrap(SimpleRnn(n_out=8)))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(4, 7))
+                .build())
+        restored = MultiLayerConfiguration.from_json(conf.to_json())
+        net = MultiLayerNetwork(restored).init()
+        out = net.output(np.ones((3, 7, 4), np.float32))
+        assert out.shape == (3, 2)
+
+    def test_rnn_default_activation_is_tanh(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(GRU(n_out=4))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .set_input_type(InputType.recurrent(3, 5))
+                .build())
+        assert conf.layers[0].activation == "tanh"
+
+
+class TestComputationGraph:
+    def _two_branch(self):
+        return (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(12))
+                .add_layer("a", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("b", DenseLayer(n_out=8, activation="tanh"), "in")
+                .add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="negativeloglikelihood"), "sum")
+                .set_outputs("out")
+                .build())
+
+    def test_fit_and_output(self):
+        cg = ComputationGraph(self._two_branch()).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((8, 12), dtype=np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        cg.fit(x, y)
+        s0 = cg.score()
+        for _ in range(15):
+            cg.fit(x, y)
+        assert cg.score() < s0
+        assert cg.output(x).shape == (8, 3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        cg = ComputationGraph(self._two_branch()).init()
+        x = np.random.default_rng(0).random((4, 12), dtype=np.float32)
+        a = cg.output(x).toNumpy()
+        p = str(tmp_path / "cg.zip")
+        cg.save(p)
+        cg2 = ComputationGraph.load(p)
+        np.testing.assert_allclose(a, cg2.output(x).toNumpy(), rtol=1e-5)
+
+    def test_vertex_output_rejected_for_fit(self):
+        g = (NeuralNetConfiguration.builder().updater(Adam(1e-2))
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.feed_forward(4))
+             .add_layer("a", DenseLayer(n_out=4, activation="relu"), "in")
+             .add_vertex("m", MergeVertex(), "a")
+             .set_outputs("m")
+             .build())
+        cg = ComputationGraph(g).init()
+        with pytest.raises(ValueError, match="loss-bearing"):
+            cg.fit(np.ones((2, 4), np.float32), np.ones((2, 4), np.float32))
+
+    def test_multidataset_masks_reach_loss(self):
+        """MultiDataSet plural mask attrs must flow into the loss."""
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        g = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+             .graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.recurrent(4, 6))
+             .add_layer("rnn", SimpleRnn(n_out=8), "in")
+             .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                              loss_function="mcxent"), "rnn")
+             .set_outputs("out")
+             .build())
+        rng = np.random.default_rng(0)
+        x = rng.random((4, 6, 4), dtype=np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 6))]
+        mask = np.ones((4, 6), np.float32)
+        mask[:, 3:] = 0.0
+        # corrupt only the masked-out label region; first-step score must be
+        # identical iff the mask actually reaches the loss
+        y2 = y.copy()
+        y2[:, 3:] = 1.0 - y2[:, 3:]
+        cg_a = ComputationGraph(g).init()
+        cg_a.fit(MultiDataSet([x], [y], features_masks=[mask], labels_masks=[mask]))
+        cg_b = ComputationGraph(ComputationGraphConfiguration.from_json(g.to_json())).init()
+        cg_b.fit(MultiDataSet([x], [y2], features_masks=[mask], labels_masks=[mask]))
+        assert cg_a.score() == pytest.approx(cg_b.score(), rel=1e-6)
+
+
+class TestGraphConfValidation:
+    def test_cycle_detection(self):
+        with pytest.raises(ValueError, match="cycle"):
+            (NeuralNetConfiguration.builder().graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_in=4, n_out=4), "b")
+             .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+             .set_outputs("b")
+             .build())
+
+    def test_unknown_input_rejected(self):
+        with pytest.raises(ValueError, match="unknown"):
+            (NeuralNetConfiguration.builder().graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_in=4, n_out=4), "nonexistent")
+             .set_outputs("a")
+             .build())

@@ -1,0 +1,211 @@
+"""Op registry + standard op tests (ref model: libnd4j DeclarableOpsTests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import registry
+
+
+def ex(name, *args, **kw):
+    return registry.exec_op(name, *args, **kw)
+
+
+class TestRegistry:
+    def test_lookup_and_alias(self):
+        assert registry.has("matmul")
+        assert registry.get("MatMul") is registry.get("matmul")
+        with pytest.raises(KeyError):
+            registry.get("definitely_not_an_op")
+
+    def test_shape_inference(self):
+        a = jnp.zeros((4, 8))
+        b = jnp.zeros((8, 16))
+        out = registry.infer_shape("matmul", a, b)
+        assert out.shape == (4, 16)
+
+    def test_registry_size(self):
+        assert len(registry.names()) > 120
+
+
+class TestConv:
+    def test_conv2d_same_shape(self):
+        x = jnp.ones((2, 8, 8, 3))
+        w = jnp.ones((3, 3, 3, 16)) * 0.01
+        out = ex("conv2d", x, w, strides=(1, 1), padding="SAME")
+        assert out.shape == (2, 8, 8, 16)
+
+    def test_conv2d_valid_stride(self):
+        x = jnp.ones((1, 28, 28, 1))
+        w = jnp.ones((5, 5, 1, 20))
+        out = ex("conv2d", x, w, strides=(1, 1), padding="VALID")
+        assert out.shape == (1, 24, 24, 20)
+        # interior of an all-ones conv = kernel volume
+        assert float(out[0, 0, 0, 0]) == 25.0
+
+    def test_conv2d_int_padding(self):
+        x = jnp.ones((1, 8, 8, 4))
+        w = jnp.ones((3, 3, 4, 4))
+        out = ex("conv2d", x, w, strides=(2, 2), padding=1)
+        assert out.shape == (1, 4, 4, 4)
+
+    def test_depthwise(self):
+        x = jnp.ones((1, 8, 8, 6))
+        w = jnp.ones((3, 3, 6, 2))
+        out = ex("depthwise_conv2d", x, w, padding="SAME")
+        assert out.shape == (1, 8, 8, 12)
+
+    def test_deconv2d_upsamples(self):
+        x = jnp.ones((1, 4, 4, 8))
+        w = jnp.ones((2, 2, 8, 16)) * 0.1
+        out = ex("deconv2d", x, w, strides=(2, 2), padding="VALID")
+        assert out.shape == (1, 8, 8, 16)
+
+    def test_conv1d_conv3d(self):
+        assert ex("conv1d", jnp.ones((2, 10, 4)), jnp.ones((3, 4, 8)), padding="SAME").shape == (2, 10, 8)
+        assert ex("conv3d", jnp.ones((1, 4, 4, 4, 2)), jnp.ones((2, 2, 2, 2, 4)), padding="SAME").shape == (1, 4, 4, 4, 4)
+
+    def test_pools(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        mx = ex("maxpool2d", x, kernel=(2, 2))
+        assert mx.shape == (1, 2, 2, 1)
+        assert float(mx[0, 0, 0, 0]) == 5.0
+        av = ex("avgpool2d", x, kernel=(2, 2))
+        assert float(av[0, 0, 0, 0]) == 2.5
+
+    def test_avgpool_same_counts_edges(self):
+        x = jnp.ones((1, 3, 3, 1))
+        av = ex("avgpool2d", x, kernel=(2, 2), strides=(1, 1), padding="SAME")
+        # with edge-count correction all values stay 1.0
+        np.testing.assert_allclose(np.asarray(av), 1.0, rtol=1e-6)
+
+    def test_upsampling(self):
+        x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+        up = ex("upsampling2d", x, size=2)
+        assert up.shape == (1, 4, 4, 1)
+        assert float(up[0, 1, 1, 0]) == 0.0
+        assert float(up[0, 2, 2, 0]) == 3.0
+
+    def test_im2col(self):
+        x = jnp.ones((1, 4, 4, 2))
+        patches = ex("im2col", x, kernel=(2, 2))
+        assert patches.shape == (1, 3, 3, 8)
+
+
+class TestNorm:
+    def test_batchnorm_normalizes(self):
+        x = jax.random.normal(jax.random.key(0), (16, 8)) * 3 + 5
+        mean = jnp.mean(x, axis=0)
+        var = jnp.var(x, axis=0)
+        out = ex("batchnorm", x, mean, var, epsilon=1e-5)
+        np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=0)), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(jnp.std(out, axis=0)), 1.0, atol=1e-2)
+
+    def test_layer_norm(self):
+        x = jax.random.normal(jax.random.key(1), (4, 10)) * 2 + 1
+        out = ex("layer_norm", x)
+        np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=-1)), 0.0, atol=1e-4)
+
+
+class TestRecurrent:
+    def test_lstm_cell_shapes_and_bounds(self):
+        b, i, h = 2, 4, 8
+        x = jnp.ones((b, i))
+        w = jax.random.normal(jax.random.key(0), (i + h, 4 * h)) * 0.1
+        bias = jnp.zeros((4 * h,))
+        h1, c1 = ex("lstm_cell", x, jnp.zeros((b, h)), jnp.zeros((b, h)), w, bias)
+        assert h1.shape == (b, h) and c1.shape == (b, h)
+        assert float(jnp.max(jnp.abs(h1))) < 1.0  # tanh-bounded
+
+    def test_gru_cell(self):
+        b, i, h = 2, 3, 5
+        x = jnp.ones((b, i))
+        out = ex("gru_cell", x, jnp.zeros((b, h)),
+                 jax.random.normal(jax.random.key(0), (i + h, 2 * h)) * 0.1,
+                 jax.random.normal(jax.random.key(1), (i + h, h)) * 0.1,
+                 jnp.zeros((2 * h,)), jnp.zeros((h,)))
+        assert out.shape == (b, h)
+
+
+class TestAttention:
+    def test_attention_identity_values(self):
+        # uniform scores → output = mean of values
+        q = jnp.zeros((1, 2, 4, 8))
+        k = jnp.zeros((1, 2, 4, 8))
+        v = jnp.arange(64.0).reshape(1, 2, 4, 8)
+        out = ex("dot_product_attention", q, k, v)
+        np.testing.assert_allclose(np.asarray(out[0, 0, 0]), np.asarray(jnp.mean(v[0, 0], axis=0)), rtol=1e-5)
+
+    def test_attention_mask(self):
+        q = jax.random.normal(jax.random.key(0), (1, 1, 4, 8))
+        k = jax.random.normal(jax.random.key(1), (1, 1, 4, 8))
+        v = jax.random.normal(jax.random.key(2), (1, 1, 4, 8))
+        causal = jnp.tril(jnp.ones((4, 4), bool))
+        out = ex("dot_product_attention", q, k, v, mask=causal)
+        # first query position can only attend to first key
+        np.testing.assert_allclose(np.asarray(out[0, 0, 0]), np.asarray(v[0, 0, 0]), rtol=1e-4)
+
+
+class TestLossesMisc:
+    def test_softmax_xent_matches_manual(self):
+        logits = jnp.asarray([[2.0, 1.0, 0.0]])
+        labels = jnp.asarray([[1.0, 0.0, 0.0]])
+        loss = ex("softmax_cross_entropy", logits, labels)
+        manual = -jax.nn.log_softmax(logits)[0, 0]
+        assert float(loss[0]) == pytest.approx(float(manual), rel=1e-6)
+
+    def test_sparse_xent(self):
+        logits = jnp.asarray([[2.0, 1.0, 0.0], [0.0, 3.0, 0.0]])
+        labels = jnp.asarray([0, 1])
+        loss = ex("sparse_softmax_cross_entropy", logits, labels)
+        assert loss.shape == (2,)
+
+    def test_one_hot(self):
+        oh = ex("one_hot", jnp.asarray([0, 2]), 3)
+        np.testing.assert_array_equal(np.asarray(oh), [[1, 0, 0], [0, 0, 1]])
+
+    def test_confusion_matrix(self):
+        cm = ex("confusion_matrix", jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]), 2)
+        np.testing.assert_array_equal(np.asarray(cm), [[1, 0], [1, 1]])
+
+    def test_top_k(self):
+        vals, idx = ex("top_k", jnp.asarray([1.0, 9.0, 3.0, 7.0]), k=2)
+        assert np.asarray(vals).tolist() == [9.0, 7.0]
+        assert np.asarray(idx).tolist() == [1, 3]
+
+    def test_nms(self):
+        boxes = jnp.asarray([[0, 0, 1, 1], [0, 0, 1.05, 1.05], [2, 2, 3, 3]], dtype=jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        idx = ex("non_max_suppression", boxes, scores, max_output_size=3, iou_threshold=0.5)
+        kept = [i for i in np.asarray(idx).tolist() if i >= 0]
+        assert kept == [0, 2]  # box 1 suppressed by box 0
+
+    def test_sequence_mask_reverse(self):
+        m = ex("sequence_mask", jnp.asarray([1, 3]), maxlen=3)
+        np.testing.assert_array_equal(np.asarray(m), [[True, False, False], [True, True, True]])
+        x = jnp.asarray([[[1.0], [2.0], [3.0]]])
+        r = ex("reverse_sequence", x, jnp.asarray([2]))
+        np.testing.assert_allclose(np.asarray(r[0, :, 0]), [2.0, 1.0, 3.0])
+
+
+class TestThresholdCodec:
+    def test_roundtrip_with_residual(self):
+        g = jnp.asarray([0.5, -0.002, 0.0001, -0.7])
+        signs, residual = ex("encode_threshold", g, threshold=0.01)
+        decoded = ex("decode_threshold", signs, threshold=0.01)
+        np.testing.assert_allclose(np.asarray(decoded), [0.01, 0.0, 0.0, -0.01])
+        # decoded + residual == original (lossless accumulation invariant)
+        np.testing.assert_allclose(np.asarray(decoded + residual), np.asarray(g), rtol=1e-6)
+
+
+class TestJitCompat:
+    def test_ops_trace_under_jit(self):
+        @jax.jit
+        def f(x, w):
+            h = ex("conv2d", x, w, padding="SAME")
+            h = ex("relu", h)
+            h = ex("maxpool2d", h, kernel=(2, 2))
+            return ex("reduce_mean", h)
+
+        out = f(jnp.ones((1, 8, 8, 3)), jnp.ones((3, 3, 3, 4)))
+        assert out.shape == ()
