@@ -1,0 +1,100 @@
+"""Round benchmark — prints ONE JSON line for the driver.
+
+Measures flagship TransformerLM training throughput (tokens/sec) on the
+available accelerator (real TPU chip via the axon platform when present;
+falls back to CPU and says so). BASELINE.md records no published reference
+numbers (`BASELINE.json "published": {}`), so ``vs_baseline`` is the ratio
+against the previous round's value persisted in ``.bench_history.json``
+(1.0 on the first round).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    platform = None
+    try:
+        devices = jax.devices()
+        platform = devices[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+        platform = devices[0].platform
+
+    import optax
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+
+    on_tpu = platform not in ("cpu",)
+    cfg = TransformerConfig(
+        vocab_size=8192,
+        n_layers=4 if on_tpu else 2,
+        n_heads=8 if on_tpu else 4,
+        d_model=512 if on_tpu else 128,
+        max_len=512 if on_tpu else 128,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+    )
+    batch = 16 if on_tpu else 4
+    model = TransformerLM(cfg, mesh=None)
+    params = model.init_params(jax.random.key(0))
+    opt = optax.adamw(3e-4)
+    opt_state = jax.jit(opt.init)(params)
+    step = model.make_train_step(opt)
+
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_len)), jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    # warmup/compile
+    params, opt_state, loss = step(params, opt_state, toks, tgts)
+    jax.block_until_ready(loss)
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, toks, tgts)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * cfg.max_len * iters / dt
+
+    hist_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_history.json")
+    prev = None
+    try:
+        with open(hist_path) as f:
+            hist = json.load(f)
+        # only compare like-for-like: a CPU-fallback round must not read as a
+        # regression against a TPU round (configs differ per platform)
+        if hist.get("platform") == platform:
+            prev = hist.get("tokens_per_sec")
+    except Exception:
+        pass
+    vs = tokens_per_sec / prev if prev else 1.0
+    try:
+        with open(hist_path, "w") as f:
+            json.dump({"tokens_per_sec": tokens_per_sec, "platform": platform}, f)
+    except Exception:
+        pass
+
+    print(json.dumps({
+        "metric": "transformer_lm_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(vs, 3),
+        "platform": platform,
+        "config": {"layers": cfg.n_layers, "d_model": cfg.d_model,
+                   "seq": cfg.max_len, "batch": batch,
+                   "dtype": str(cfg.dtype.__name__ if hasattr(cfg.dtype, "__name__") else cfg.dtype)},
+        "loss": float(loss),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,198 @@
+"""TransformerLM — the flagship distributed model.
+
+The reference's nearest analogs are the SameDiff attention ops
+(``MultiHeadDotProductAttention``) behind ``SelfAttentionLayer`` and the
+TF-import BERT fine-tune path (SURVEY 3.5); upstream has no native
+transformer LM and no model/sequence parallelism. This model is the
+framework's showcase for the net-new axes: built as a pure-functional param
+pytree (not MLN layers) so every matmul carries explicit TP sharding
+annotations, attention routes through ring attention when a ``seq`` axis is
+present, and the whole train step jits into one GSPMD program.
+
+Sharding map (Megatron-style):
+- embeddings  (V, C):      P(None, 'model')
+- attn qkvo   (C, C):      qkv P(None, 'model') / out P('model', None)
+- mlp up/down (C, 4C)/(4C, C): up P(None, 'model') / down P('model', None)
+- activations (B, T, C):   P('data', 'seq', None)
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from deeplearning4j_tpu.parallel.ring import ring_attention, _plain_attention
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    d_model: int = 128
+    d_ff: Optional[int] = None
+    max_len: int = 256
+    dropout: float = 0.0
+    dtype: Any = jnp.float32          # bfloat16 on real TPU runs
+    causal: bool = True
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        assert self.d_model % self.n_heads == 0
+
+
+class TransformerLM:
+    """Decoder-only LM over a device mesh."""
+
+    def __init__(self, config: TransformerConfig, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------ params
+    def init_params(self, key) -> Dict:
+        c = self.config
+        k = jax.random.split(key, 4 + c.n_layers)
+        scale = 0.02
+        params = {
+            "tok_emb": jax.random.normal(k[0], (c.vocab_size, c.d_model)) * scale,
+            "pos_emb": jax.random.normal(k[1], (c.max_len, c.d_model)) * scale,
+            "ln_f": {"g": jnp.ones((c.d_model,)), "b": jnp.zeros((c.d_model,))},
+            "blocks": [],
+        }
+        for i in range(c.n_layers):
+            kk = jax.random.split(k[4 + i], 6)
+            blk = {
+                "ln1": {"g": jnp.ones((c.d_model,)), "b": jnp.zeros((c.d_model,))},
+                "ln2": {"g": jnp.ones((c.d_model,)), "b": jnp.zeros((c.d_model,))},
+                "attn": {
+                    "wq": jax.random.normal(kk[0], (c.d_model, c.d_model)) * scale,
+                    "wk": jax.random.normal(kk[1], (c.d_model, c.d_model)) * scale,
+                    "wv": jax.random.normal(kk[2], (c.d_model, c.d_model)) * scale,
+                    "wo": jax.random.normal(kk[3], (c.d_model, c.d_model)) * scale,
+                },
+                "mlp": {
+                    "w_up": jax.random.normal(kk[4], (c.d_model, c.d_ff)) * scale,
+                    "b_up": jnp.zeros((c.d_ff,)),
+                    "w_down": jax.random.normal(kk[5], (c.d_ff, c.d_model)) * scale,
+                    "b_down": jnp.zeros((c.d_model,)),
+                },
+            }
+            params["blocks"].append(blk)
+        params = jax.tree.map(lambda a: a.astype(jnp.float32), params)
+        return params
+
+    def param_shardings(self, mesh: Mesh):
+        """PartitionSpec pytree (Megatron column/row split over ``model``)."""
+        has_tp = MODEL_AXIS in mesh.axis_names
+        col = P(None, MODEL_AXIS) if has_tp else P()
+        row = P(MODEL_AXIS, None) if has_tp else P()
+        rep = P()
+
+        def blk():
+            return {
+                "ln1": {"g": rep, "b": rep}, "ln2": {"g": rep, "b": rep},
+                "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+                "mlp": {"w_up": col, "b_up": P(MODEL_AXIS) if has_tp else rep,
+                        "w_down": row, "b_down": rep},
+            }
+        spec = {
+            "tok_emb": col, "pos_emb": rep,
+            "ln_f": {"g": rep, "b": rep},
+            "blocks": [blk() for _ in range(self.config.n_layers)],
+        }
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), spec,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    # ----------------------------------------------------------------- forward
+    def _ln(self, p, x):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+
+    def _attn(self, p, x, mesh):
+        c = self.config
+        b, t, _ = x.shape
+        h, hd = c.n_heads, c.d_model // c.n_heads
+        q = (x @ p["wq"]).reshape(b, t, h, hd)
+        k = (x @ p["wk"]).reshape(b, t, h, hd)
+        v = (x @ p["wv"]).reshape(b, t, h, hd)
+        if mesh is not None and SEQ_AXIS in mesh.axis_names:
+            o = ring_attention(q, k, v, mesh, causal=c.causal)
+        else:
+            o = _plain_attention(q, k, v, causal=c.causal)
+        return o.reshape(b, t, c.d_model) @ p["wo"]
+
+    def _constrain(self, x):
+        """Activation sharding hint: (B, T, C) → ('data', 'seq', None)."""
+        if self.mesh is None:
+            return x
+        axes = [DATA_AXIS if DATA_AXIS in self.mesh.axis_names else None,
+                SEQ_AXIS if SEQ_AXIS in self.mesh.axis_names else None, None]
+        return lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(*axes)))
+
+    def _dropout(self, x, rng, i):
+        if rng is None or self.config.dropout <= 0.0:
+            return x
+        keep = 1.0 - self.config.dropout
+        mask = jax.random.bernoulli(jax.random.fold_in(rng, i), keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def apply(self, params, tokens, rng=None):
+        """tokens (B, T) int32 → logits (B, T, V). ``rng`` enables dropout
+        (training mode); None = inference."""
+        c = self.config
+        t = tokens.shape[1]
+        x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
+        x = self._dropout(x.astype(c.dtype), rng, 0)
+        x = self._constrain(x)
+        for li, blk in enumerate(params["blocks"]):
+            a = self._attn(blk["attn"], self._ln(blk["ln1"], x), self.mesh)
+            x = x + self._dropout(a, rng, 2 * li + 1)
+            x = self._constrain(x)
+            hdn = self._ln(blk["ln2"], x) @ blk["mlp"]["w_up"] + blk["mlp"]["b_up"]
+            hdn = jax.nn.gelu(hdn)
+            m = hdn @ blk["mlp"]["w_down"] + blk["mlp"]["b_down"]
+            x = x + self._dropout(m, rng, 2 * li + 2)
+            x = self._constrain(x)
+        x = self._ln(params["ln_f"], x)
+        return (x @ params["tok_emb"].T).astype(jnp.float32)
+
+    # ------------------------------------------------------------------- loss
+    def loss_fn(self, params, tokens, targets, rng=None):
+        logits = self.apply(params, tokens, rng=rng)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def make_train_step(self, optimizer):
+        """One whole-graph jitted step (fwd+bwd+allreduce+update). Pass
+        ``rng`` to enable dropout."""
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, opt_state, tokens, targets, rng=None):
+            loss, grads = jax.value_and_grad(self.loss_fn)(
+                params, tokens, targets, rng)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+        return step
+
+
+def make_sharded_lm(config: TransformerConfig, mesh: Mesh, optimizer=None,
+                    seed: int = 0):
+    """Build model + sharded params + opt state on the mesh."""
+    optimizer = optimizer or optax.adamw(3e-4)
+    model = TransformerLM(config, mesh)
+    params = model.init_params(jax.random.key(seed))
+    params = jax.device_put(params, model.param_shardings(mesh))
+    opt_state = jax.jit(optimizer.init)(params)
+    return model, params, opt_state, optimizer

@@ -1,0 +1,16 @@
+"""Distributed training over TPU device meshes (SURVEY §2.4/§5.8).
+
+The reference's transport stack (Spark control plane + Aeron UDP gradient
+mesh + threshold codec) is replaced wholesale by XLA collectives over
+ICI/DCN emitted from sharding annotations — see mesh.py for the axis map,
+trainer.py for the engine, master.py for the reference-parity facades, and
+ring.py for sequence parallelism (net-new vs reference).
+"""
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS, MeshSpec)
+from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
+    ParallelInference, ParallelWrapper, ShardedTrainer)
+from deeplearning4j_tpu.parallel.master import (  # noqa: F401
+    DistributedConfig, ParameterAveragingTrainingMaster, SharedTrainingMaster,
+    SparkComputationGraph, SparkDl4jMultiLayer, TrainingMaster)
+from deeplearning4j_tpu.parallel.ring import ring_attention  # noqa: F401

@@ -1,0 +1,166 @@
+"""TrainingMaster facades — reference-parity distributed entry points.
+
+Reference: ``org.deeplearning4j.spark.api.TrainingMaster`` with impls
+``ParameterAveragingTrainingMaster`` (SURVEY P2) and
+``SharedTrainingMaster`` (P3, the flagship: threshold-encoded async gradient
+sharing over an Aeron UDP mesh) driven through ``SparkDl4jMultiLayer`` /
+``SparkComputationGraph``.
+
+TPU-native redesign (SURVEY §5.8 north star): the TrainingMaster API shape
+survives as a thin facade that (a) builds the device mesh, (b) shards the
+input pipeline over the ``data`` axis, and (c) runs the whole step as one
+GSPMD program whose gradient allreduce rides ICI within a slice and DCN
+across slices. Spark, Aeron, the threshold codec, and the accumulator are
+deleted — there is no transport code to configure. Multi-host bootstrap is
+``jax.distributed.initialize`` (the ``VoidConfiguration`` analog is
+``DistributedConfig`` below).
+
+Semantics divergence (documented, BASELINE.md): updates are synchronous and
+dense; ``ParameterAveragingTrainingMaster(averaging_frequency=N)`` degrades
+to sync-every-step, which strictly dominates it in convergence per step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from deeplearning4j_tpu.parallel.mesh import MeshSpec
+from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """Multi-host bootstrap knobs (ref: VoidConfiguration — ports/mask/
+    controller address → coordinator address/process ids)."""
+    coordinator_address: Optional[str] = None   # "host:port" of process 0
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    def initialize(self):
+        """ref: the Spark/Aeron bootstrap; here jax.distributed (PJRT DCN)."""
+        if self.coordinator_address is not None:
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id)
+
+
+class TrainingMaster:
+    """Base facade: owns MeshSpec + batch policy."""
+
+    def __init__(self, batch_size_per_worker: int = 32, workers: Optional[int] = None,
+                 tensor_parallel: bool = False):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.workers = workers
+        self.tensor_parallel = tensor_parallel
+
+    def mesh_spec(self) -> MeshSpec:
+        return MeshSpec.data_parallel(self.workers or -1)
+
+    def make_trainer(self, net) -> ShardedTrainer:
+        return ShardedTrainer(net, self.mesh_spec(),
+                              tensor_parallel=self.tensor_parallel)
+
+
+class SharedTrainingMaster(TrainingMaster):
+    """ref: org.deeplearning4j.spark.parameterserver.training.SharedTrainingMaster.
+
+    Threshold/residual knobs are accepted for source-compat and ignored —
+    the codec exists only for the optional cross-DCN path (Pallas op
+    ``encode_threshold`` in ops/standard.py keeps behavioral parity where
+    a sparse path is explicitly wanted)."""
+
+    def __init__(self, batch_size_per_worker: int = 32, workers: Optional[int] = None,
+                 threshold: float = 1e-3, threshold_algorithm=None,
+                 workers_per_node: Optional[int] = None, **_ignored):
+        super().__init__(batch_size_per_worker, workers or workers_per_node)
+        self.threshold = threshold
+
+    class Builder:
+        def __init__(self, *args):
+            self._kw = {}
+
+        def batch_size_per_worker(self, n):
+            self._kw["batch_size_per_worker"] = n
+            return self
+
+        batchSizePerWorker = batch_size_per_worker
+
+        def workers_per_node(self, n):
+            self._kw["workers"] = n
+            return self
+
+        workersPerNode = workers_per_node
+
+        def threshold_algorithm(self, a):
+            self._kw["threshold_algorithm"] = a
+            return self
+
+        thresholdAlgorithm = threshold_algorithm
+
+        def build(self):
+            return SharedTrainingMaster(**self._kw)
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """ref: org.deeplearning4j.spark.impl.paramavg.ParameterAveragingTrainingMaster.
+    Sync dense allreduce every step subsumes periodic averaging."""
+
+    def __init__(self, batch_size_per_worker: int = 32, workers: Optional[int] = None,
+                 averaging_frequency: int = 1, **_ignored):
+        super().__init__(batch_size_per_worker, workers)
+        self.averaging_frequency = averaging_frequency
+
+    class Builder:
+        def __init__(self, *args):
+            self._kw = {}
+
+        def batch_size_per_worker(self, n):
+            self._kw["batch_size_per_worker"] = n
+            return self
+
+        def averaging_frequency(self, n):
+            self._kw["averaging_frequency"] = n
+            return self
+
+        averagingFrequency = averaging_frequency
+
+        def build(self):
+            return ParameterAveragingTrainingMaster(**self._kw)
+
+
+class SparkDl4jMultiLayer:
+    """ref: org.deeplearning4j.spark.impl.multilayer.SparkDl4jMultiLayer.
+    The SparkContext slot is accepted for parity and unused (no Spark in the
+    TPU path; data distribution is the input pipeline's job)."""
+
+    def __init__(self, sc, net_or_conf, training_master: TrainingMaster):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if not hasattr(net_or_conf, "fit"):
+            net_or_conf = MultiLayerNetwork(net_or_conf)
+        self.network = net_or_conf
+        self.training_master = training_master
+        self._trainer = training_master.make_trainer(self.network)
+
+    def fit(self, data, epochs: int = 1):
+        self._trainer.fit(data, epochs=epochs)
+        return self.network
+
+    def get_network(self):
+        return self.network
+
+    getNetwork = get_network
+
+
+class SparkComputationGraph(SparkDl4jMultiLayer):
+    """ref: org.deeplearning4j.spark.impl.graph.SparkComputationGraph."""
+
+    def __init__(self, sc, net_or_conf, training_master: TrainingMaster):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if not hasattr(net_or_conf, "fit"):
+            net_or_conf = ComputationGraph(net_or_conf)
+        self.network = net_or_conf
+        self.training_master = training_master
+        self._trainer = training_master.make_trainer(self.network)

@@ -1,0 +1,83 @@
+"""Device-mesh construction — the communication-topology layer.
+
+Replaces the reference's transport stack (Aeron UDP mesh in
+``org.nd4j.parameterserver.distributed.v2.transport.impl.AeronUdpTransport`` +
+``util.MeshOrganizer`` spanning tree, SURVEY J13/P9): on TPU the "mesh" is
+the physical ICI torus exposed through ``jax.sharding.Mesh``, and collectives
+are emitted by the compiler — there is no user-level transport to organize.
+
+Axis conventions (used by sharding rules framework-wide):
+- ``data``  — data parallelism (batch sharding, gradient allreduce)
+- ``model`` — tensor parallelism (intra-layer weight sharding)
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``stage`` — pipeline parallelism
+- ``expert``— expert parallelism (MoE)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+STAGE_AXIS = "stage"
+EXPERT_AXIS = "expert"
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Declarative mesh: ordered {axis_name: size}; one size may be -1
+    ("take the rest"), mirroring the reference's implicit worker count
+    (``SharedTrainingMaster.Builder#workersPerNode``)."""
+    axes: Dict[str, int]
+
+    @staticmethod
+    def data_parallel(n: int = -1) -> "MeshSpec":
+        return MeshSpec({DATA_AXIS: n})
+
+    @staticmethod
+    def dp_tp(data: int = -1, model: int = 1) -> "MeshSpec":
+        return MeshSpec({DATA_AXIS: data, MODEL_AXIS: model})
+
+    @staticmethod
+    def dp_tp_sp(data: int = -1, model: int = 1, seq: int = 1) -> "MeshSpec":
+        return MeshSpec({DATA_AXIS: data, MODEL_AXIS: model, SEQ_AXIS: seq})
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = dict(self.axes)
+        fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+        wild = [k for k, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError("At most one mesh axis may be -1")
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        if int(np.prod(list(sizes.values()))) != n_devices:
+            raise ValueError(f"Mesh {sizes} != {n_devices} devices")
+        return sizes
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolve(len(devices))
+        arr = np.asarray(devices).reshape(*sizes.values())
+        return Mesh(arr, tuple(sizes.keys()))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch dim sharded over every data-like axis present."""
+    axes = [a for a in (DATA_AXIS,) if a in mesh.axis_names]
+    return NamedSharding(mesh, P(tuple(axes) if axes else None))
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)

@@ -1,0 +1,55 @@
+"""Parameter-sharding rules: layer param pytree → PartitionSpec pytree.
+
+This is the TP (tensor-parallel) policy layer — net-new capability vs the
+reference (SURVEY P4: absent upstream). Megatron-style column sharding of
+matmul weights over the ``model`` axis; XLA GSPMD propagates activations and
+inserts the allreduce/allgather collectives.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+
+
+def param_pspec(pname: str, ndim: int, model_axis: str = MODEL_AXIS) -> P:
+    """Default tensor-parallel rule for one parameter.
+
+    - 2D kernels  (in, out)        → shard out over ``model`` (column parallel)
+    - 4D conv     (H, W, I, O)     → shard O over ``model``
+    - recurrent RW and norm/scale params → replicated (recurrent TP would
+      put a collective inside the scan body; deliberately avoided)
+    - biases matching a sharded out-dim → sharded to stay aligned
+    """
+    if pname.startswith(("RW", "gamma", "beta", "mean", "var", "p")):
+        return P()
+    if ndim == 2:
+        return P(None, model_axis)
+    if ndim == 4:
+        return P(None, None, None, model_axis)
+    if ndim == 1 and pname.startswith("b"):
+        return P(model_axis)
+    return P()
+
+
+def tp_shardings(params, mesh: Mesh, enable: bool = True):
+    """NamedSharding pytree for a {layer: {param: array}} tree."""
+    def one(path, leaf):
+        pname = str(path[-1].key) if hasattr(path[-1], "key") else ""
+        if not enable or MODEL_AXIS not in mesh.axis_names:
+            return NamedSharding(mesh, P())
+        spec = param_pspec(pname, leaf.ndim)
+        # don't shard dims that aren't divisible — GSPMD requires it
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        ok = all(
+            ax is None or leaf.shape[i] % sizes.get(ax, 1) == 0
+            for i, ax in enumerate(spec))
+        return NamedSharding(mesh, spec if ok else P())
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def replicate_tree(tree, mesh: Mesh):
+    return jax.tree.map(lambda _: NamedSharding(mesh, P()), tree)
