@@ -1,2 +1,4 @@
 """Graph engine + validation (ref: org.nd4j.autodiff)."""
-from deeplearning4j_tpu.autodiff import validation
+from deeplearning4j_tpu.autodiff import validation  # noqa: F401
+from deeplearning4j_tpu.autodiff.samediff import (  # noqa: F401
+    SameDiff, SDVariable, TrainingConfig, VariableType)

@@ -1,0 +1,925 @@
+"""SameDiff-equivalent define-then-run graph engine, TPU-first.
+
+Reference surface: ``org.nd4j.autodiff.samediff.SameDiff`` (~6k lines),
+``SDVariable``, namespaced op factories (``SDBaseOps``, ``SDNN``, ``SDCNN``,
+``SDRNN``, ``SDLoss``, ``SDMath``), ``TrainingConfig``, ``SameDiff#fit``,
+``SameDiff#output``, ``SameDiff#save/load`` (SURVEY.md J6/J7, call stack
+§3.3).
+
+TPU-first redesign (the load-bearing difference): the reference executes its
+graph **op-at-a-time in Java**, each op crossing JNI into libnd4j
+(``AbstractSession#output`` → ``InferenceSession#doExec`` →
+``NativeOpExecutioner``). Here the topological walk *emits* a single
+jax-traceable function over the whole graph, which XLA compiles and fuses
+once per (output-set, placeholder-shapes) signature — the graph interpreter
+becomes an HLO emitter, per SURVEY §3.3's "north star". Backward graphs are
+not hand-assembled from per-op ``doDiff`` rules; ``jax.grad`` of the emitted
+program plays that role (``SameDiff#createGradFunction`` analog).
+
+Serialization: the reference persists FlatBuffers (``SameDiff#asFlatBuffers``,
+schema shared with libnd4j's C++ graph runtime). We persist the same logical
+content — op graph + variable kinds + values + training config — as a zip of
+``graph.json`` + ``values.npz`` (documented divergence: no C++ graph
+executor exists to share a schema with; XLA is the executor).
+"""
+from __future__ import annotations
+
+import enum
+import io
+import json
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops import registry as op_registry
+import deeplearning4j_tpu.ops  # noqa: F401  (trigger op registrations)
+
+
+class VariableType(enum.Enum):
+    """Mirror of ``org.nd4j.autodiff.samediff.VariableType``."""
+
+    VARIABLE = "VARIABLE"        # trainable, persisted
+    CONSTANT = "CONSTANT"        # non-trainable, persisted
+    PLACEHOLDER = "PLACEHOLDER"  # fed at exec time
+    ARRAY = "ARRAY"              # op output, computed
+
+
+class SDVariable:
+    """Symbolic graph variable (ref: ``org.nd4j.autodiff.samediff.SDVariable``).
+
+    Holds no data for ARRAY type; VARIABLE/CONSTANT values live in the owning
+    ``SameDiff``'s value store. Arithmetic operators create graph ops.
+    """
+
+    def __init__(self, sd: "SameDiff", name: str, var_type: VariableType,
+                 shape: Optional[Tuple[int, ...]], dtype):
+        self.sd = sd
+        self.name = name
+        self.var_type = var_type
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    # ---- graph-building arithmetic ------------------------------------
+    def _bin(self, op: str, other, reverse=False):
+        other = self.sd._lift(other)
+        a, b = (other, self) if reverse else (self, other)
+        return self.sd._op(op, a, b)
+
+    def __add__(self, o): return self._bin("add", o)
+    def __radd__(self, o): return self._bin("add", o, reverse=True)
+    def __sub__(self, o): return self._bin("sub", o)
+    def __rsub__(self, o): return self._bin("sub", o, reverse=True)
+    def __mul__(self, o): return self._bin("mul", o)
+    def __rmul__(self, o): return self._bin("mul", o, reverse=True)
+    def __truediv__(self, o): return self._bin("div", o)
+    def __rtruediv__(self, o): return self._bin("div", o, reverse=True)
+    def __pow__(self, o): return self._bin("pow", o)
+    def __neg__(self): return self.sd._op("neg", self)
+    def __matmul__(self, o): return self.mmul(o)
+
+    # comparison → boolean arrays (as in SDVariable#gt etc.)
+    def gt(self, o): return self._bin("greater", o)
+    def gte(self, o): return self._bin("greater_equal", o)
+    def lt(self, o): return self._bin("less", o)
+    def lte(self, o): return self._bin("less_equal", o)
+    def eq(self, o): return self._bin("equals", o)
+    def neq(self, o): return self._bin("not_equals", o)
+
+    # common method-style ops (SDVariable convenience methods)
+    def add(self, o): return self.__add__(o)
+    def sub(self, o): return self.__sub__(o)
+    def mul(self, o): return self.__mul__(o)
+    def div(self, o): return self.__truediv__(o)
+    def rdiv(self, o): return self.__rtruediv__(o)
+    def mmul(self, o): return self.sd._op("matmul", self, self.sd._lift(o))
+    def dot(self, o): return self.sd._op("tensordot", self, self.sd._lift(o), axes=1)
+    def transpose(self, *perm):
+        return self.sd._op("transpose", self, axes=list(perm) or None)
+    def permute(self, *perm): return self.transpose(*perm)
+    def reshape(self, *shape):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self.sd._op("reshape", self, shape=list(shape))
+    def sum(self, *axis, keepdims=False):
+        return self.sd._op("reduce_sum", self, axis=list(axis) or None, keepdims=keepdims)
+    def mean(self, *axis, keepdims=False):
+        return self.sd._op("reduce_mean", self, axis=list(axis) or None, keepdims=keepdims)
+    def max(self, *axis, keepdims=False):
+        return self.sd._op("reduce_max", self, axis=list(axis) or None, keepdims=keepdims)
+    def min(self, *axis, keepdims=False):
+        return self.sd._op("reduce_min", self, axis=list(axis) or None, keepdims=keepdims)
+    def prod(self, *axis, keepdims=False):
+        return self.sd._op("reduce_prod", self, axis=list(axis) or None, keepdims=keepdims)
+    def std(self, *axis, keepdims=False):
+        return self.sd._op("reduce_stdev", self, axis=list(axis) or None, keepdims=keepdims)
+    def norm2(self, *axis, keepdims=False):
+        return self.sd._op("reduce_norm2", self, axis=list(axis) or None, keepdims=keepdims)
+    def argmax(self, axis=-1): return self.sd._op("argmax", self, axis=axis)
+    def argmin(self, axis=-1): return self.sd._op("argmin", self, axis=axis)
+    def squeeze(self, axis=None): return self.sd._op("squeeze", self, axis=axis)
+    def cast(self, dtype): return self.sd._op("cast", self, dtype=np.dtype(dtype).name)
+    def rank(self): return len(self.shape) if self.shape is not None else None
+    def get(self, *slices): return self.__getitem__(slices if len(slices) > 1 else slices[0])
+
+    def __getitem__(self, idx):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        begins, ends, strides, squeeze_axes = [], [], [], []
+        for ax, s in enumerate(idx):
+            if isinstance(s, int):
+                begins.append(s); ends.append(s + 1); strides.append(1)
+                squeeze_axes.append(ax)
+            elif isinstance(s, slice):
+                dim = self.shape[ax] if self.shape is not None else None
+                begins.append(s.start if s.start is not None else 0)
+                ends.append(s.stop if s.stop is not None else (dim if dim is not None else 2**31 - 1))
+                strides.append(s.step if s.step is not None else 1)
+            else:
+                raise TypeError(f"Unsupported index {s!r}")
+        out = self.sd._op("strided_slice", self, begin=begins, end=ends,
+                          strides=strides)
+        if squeeze_axes:
+            out = self.sd._op("squeeze", out, axis=squeeze_axes)
+        return out
+
+    # ---- graph metadata ------------------------------------------------
+    def rename(self, new_name: str) -> "SDVariable":
+        self.sd._rename(self.name, new_name)
+        return self
+
+    def convert_to_constant(self):
+        self.var_type = VariableType.CONSTANT
+        return self
+
+    def convert_to_variable(self):
+        self.var_type = VariableType.VARIABLE
+        return self
+
+    def eval(self, placeholders: Optional[Dict[str, Any]] = None):
+        """Compute this variable's value (``SDVariable#eval``)."""
+        return self.sd.output(placeholders or {}, [self.name])[self.name]
+
+    def get_arr(self):
+        if self.var_type in (VariableType.VARIABLE, VariableType.CONSTANT):
+            return self.sd._values[self.name]
+        return self.eval()
+
+    def set_arr(self, value):
+        value = jnp.asarray(value)
+        if self.var_type not in (VariableType.VARIABLE, VariableType.CONSTANT):
+            raise ValueError(f"{self.name} is {self.var_type}, has no stored array")
+        self.sd._values[self.name] = value
+        self.shape = tuple(value.shape)
+        self.sd._invalidate_cache()
+        return self
+
+    def __repr__(self):
+        return (f"SDVariable(name={self.name!r}, type={self.var_type.value}, "
+                f"shape={self.shape})")
+
+
+class OpNode:
+    """One node of the op graph (ref: ``samediff.internal.SameDiffOp``)."""
+
+    __slots__ = ("name", "op_name", "inputs", "outputs", "attrs", "fn")
+
+    def __init__(self, name, op_name, inputs, outputs, attrs, fn=None):
+        self.name = name
+        self.op_name = op_name
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.attrs = dict(attrs)
+        self.fn = fn  # only for non-serializable lambda ops
+
+    def to_dict(self):
+        if self.fn is not None:
+            raise ValueError(
+                f"op {self.name!r} wraps a Python lambda and cannot be "
+                f"serialized; rebuild it from registered ops")
+        return {"name": self.name, "op": self.op_name, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": self.attrs}
+
+
+class TrainingConfig:
+    """Ref: ``org.nd4j.autodiff.samediff.TrainingConfig``.
+
+    ``updater`` is an optax GradientTransformation or one of our
+    ``optim.updaters`` config objects (which expose ``.to_optax()``).
+    """
+
+    def __init__(self, updater=None, l1=0.0, l2=0.0,
+                 data_set_feature_mapping: Sequence[str] = (),
+                 data_set_label_mapping: Sequence[str] = (),
+                 loss_variables: Sequence[str] = ()):
+        self.updater = updater
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+        self.data_set_feature_mapping = list(data_set_feature_mapping)
+        self.data_set_label_mapping = list(data_set_label_mapping)
+        self.loss_variables = list(loss_variables)
+
+    def to_optax(self):
+        import optax
+        u = self.updater
+        if u is None:
+            return optax.sgd(1e-3)
+        if hasattr(u, "to_optax"):
+            return u.to_optax()
+        return u
+
+    def to_dict(self):
+        u = self.updater
+        return {"l1": self.l1, "l2": self.l2,
+                "featureMapping": self.data_set_feature_mapping,
+                "labelMapping": self.data_set_label_mapping,
+                "lossVariables": self.loss_variables,
+                "updater": getattr(u, "to_dict", lambda: None)()}
+
+
+def _ns(owner):
+    """Bind an op-namespace class to a SameDiff instance."""
+    class Bound:
+        def __init__(self, sd):
+            self.sd = sd
+        def __getattr__(self, item):
+            raise AttributeError(item)
+    return Bound
+
+
+class _Namespace:
+    def __init__(self, sd: "SameDiff"):
+        self.sd = sd
+
+    def _op(self, name, *args, **attrs):
+        args = [self.sd._lift(a) for a in args]
+        return self.sd._op(name, *args, **attrs)
+
+
+class SDMath(_Namespace):
+    """Ref: ``SDMath`` / ``SDBaseOps`` transform ops."""
+
+    def __getattr__(self, item):
+        # generic fall-through: any registered unary/binary op by name
+        if op_registry.has(item):
+            def call(*args, **attrs):
+                return self._op(item, *args, **attrs)
+            return call
+        raise AttributeError(item)
+
+    def square(self, x): return self._op("square", x)
+    def abs(self, x): return self._op("abs", x)
+    def exp(self, x): return self._op("exp", x)
+    def log(self, x): return self._op("log", x)
+    def sqrt(self, x): return self._op("sqrt", x)
+    def tanh(self, x): return self._op("tanh", x)
+    def cos(self, x): return self._op("cos", x)
+    def sin(self, x): return self._op("sin", x)
+    def pow(self, x, p): return self._op("pow", x, p)
+    def neg(self, x): return self._op("neg", x)
+    def max(self, a, b): return self._op("maximum", a, b)
+    def min(self, a, b): return self._op("minimum", a, b)
+    def isnan(self, x): return self._op("isnan", x)
+    def confusion_matrix(self, labels, pred, num_classes):
+        return self._op("confusion_matrix", labels, pred, num_classes=num_classes)
+
+
+class SDNN(_Namespace):
+    """Ref: ``SDNN`` (org.nd4j.autodiff.samediff.ops.SDNN)."""
+
+    def relu(self, x): return self._op("relu", x)
+    def relu6(self, x): return self._op("relu6", x)
+    def gelu(self, x): return self._op("gelu", x)
+    def elu(self, x): return self._op("elu", x)
+    def selu(self, x): return self._op("selu", x)
+    def sigmoid(self, x): return self._op("sigmoid", x)
+    def tanh(self, x): return self._op("tanh", x)
+    def softmax(self, x, axis=-1): return self._op("softmax", x, axis=axis)
+    def log_softmax(self, x, axis=-1): return self._op("log_softmax", x, axis=axis)
+    def softplus(self, x): return self._op("softplus", x)
+    def swish(self, x): return self._op("swish", x)
+    def leakyrelu(self, x, alpha=0.01): return self._op("leakyrelu", x, alpha=alpha)
+    def linear(self, x, w, b=None):
+        out = self._op("matmul", x, w)
+        return out + b if b is not None else out
+    def layer_norm(self, x, gamma=None, beta=None, axis=-1, epsilon=1e-5):
+        args = [x] + [a for a in (gamma, beta) if a is not None]
+        return self._op("layer_norm", *args, axis=axis, epsilon=epsilon)
+    def batch_norm(self, x, mean, var, gamma, beta, epsilon=1e-5, axis=-1):
+        return self._op("batchnorm", x, mean, var, gamma, beta,
+                        epsilon=epsilon, axis=axis)
+    def dropout(self, x, p, seed=0):
+        return self.sd._random_op("dropout_inverted", x, p=p, seed=seed)
+    def multi_head_dot_product_attention(self, q, k, v, mask=None, scaled=True):
+        args = [q, k, v] + ([mask] if mask is not None else [])
+        return self._op("dot_product_attention", *args, scaled=scaled)
+    def pad(self, x, paddings, value=0.0):
+        return self._op("pad", x, paddings=paddings, value=value)
+
+
+class SDCNN(_Namespace):
+    """Ref: ``SDCNN``."""
+
+    def conv2d(self, x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1)):
+        args = [x, w] + ([b] if b is not None else [])
+        return self._op("conv2d", *args, strides=list(strides), padding=padding,
+                        dilation=list(dilation))
+    def deconv2d(self, x, w, b=None, strides=(1, 1), padding="SAME"):
+        args = [x, w] + ([b] if b is not None else [])
+        return self._op("deconv2d", *args, strides=list(strides), padding=padding)
+    def depthwise_conv2d(self, x, w, b=None, strides=(1, 1), padding="SAME"):
+        args = [x, w] + ([b] if b is not None else [])
+        return self._op("depthwise_conv2d", *args, strides=list(strides), padding=padding)
+    def max_pooling2d(self, x, kernel=(2, 2), strides=None, padding="VALID"):
+        return self._op("maxpool2d", x, kernel=list(kernel),
+                        strides=list(strides) if strides else None, padding=padding)
+    def avg_pooling2d(self, x, kernel=(2, 2), strides=None, padding="VALID"):
+        return self._op("avgpool2d", x, kernel=list(kernel),
+                        strides=list(strides) if strides else None, padding=padding)
+    def upsampling2d(self, x, size=2): return self._op("upsampling2d", x, size=size)
+    def im2col(self, x, kernel, strides=(1, 1), padding="VALID"):
+        return self._op("im2col", x, kernel=list(kernel), strides=list(strides),
+                        padding=padding)
+    def space_to_depth(self, x, block): return self._op("space_to_depth", x, block_size=block)
+    def depth_to_space(self, x, block): return self._op("depth_to_space", x, block_size=block)
+
+
+class SDRNN(_Namespace):
+    """Ref: ``SDRNN`` — cell-level ops; full sequences via lax.scan in layers."""
+
+    def lstm_cell(self, x, h, c, w, b, forget_bias=1.0):
+        return self._op("lstm_cell", x, h, c, w, b, forget_bias=forget_bias,
+                        n_out=2)
+    def gru_cell(self, x, h, w_rz, w_h, b_rz, b_h):
+        return self._op("gru_cell", x, h, w_rz, w_h, b_rz, b_h)
+    def sru_cell(self, x, c, w, b):
+        return self._op("sru_cell", x, c, w, b, n_out=2)
+
+
+class SDLoss(_Namespace):
+    """Ref: ``SDLoss``. Each returns a scalar mean loss by default."""
+
+    def mse(self, labels, predictions):
+        return ((predictions - labels) * (predictions - labels)).mean()
+    def mean_squared_error(self, labels, predictions):
+        return self.mse(labels, predictions)
+    def l2_loss(self, x):
+        return (x * x).sum() * 0.5
+    def absolute_difference(self, labels, predictions):
+        return self._op("abs", predictions - labels).mean()
+    def softmax_cross_entropy(self, labels, logits, axis=-1):
+        return self._op("softmax_cross_entropy", logits, labels, axis=axis).mean()
+    def sparse_softmax_cross_entropy(self, labels, logits):
+        return self._op("sparse_softmax_cross_entropy", logits, labels).mean()
+    def sigmoid_cross_entropy(self, labels, logits):
+        return self._op("sigmoid_cross_entropy", logits, labels).mean()
+    def log_loss(self, labels, predictions, epsilon=1e-7):
+        p = self._op("clipbyvalue", predictions, clip_value_min=epsilon,
+                     clip_value_max=1.0 - epsilon)
+        term = labels * self._op("log", p) + (1.0 - labels) * self._op("log", 1.0 - p)
+        return -term.mean()
+    def cosine_distance(self, labels, predictions, axis=-1):
+        a = self._op("l2_normalize", labels, axis=axis)
+        b = self._op("l2_normalize", predictions, axis=axis)
+        return (1.0 - (a * b).sum(axis)).mean()
+    def huber_loss(self, labels, predictions, delta=1.0):
+        err = predictions - labels
+        abs_err = self._op("abs", err)
+        quad = self._op("minimum", abs_err, delta)
+        return (0.5 * quad * quad + delta * (abs_err - quad)).mean()
+    def hinge_loss(self, labels, predictions):
+        # labels in {0,1} → {-1,1}
+        sign = labels * 2.0 - 1.0
+        return self._op("relu", 1.0 - sign * predictions).mean()
+
+
+class SDLinalg(_Namespace):
+    def cholesky(self, x): return self._op("cholesky", x)
+    def svd(self, x): return self._op("svd", x, n_out=3)
+    def qr(self, x): return self._op("qr", x, n_out=2)
+    def solve(self, a, b): return self._op("solve", a, b)
+    def inverse(self, x): return self._op("matrix_inverse", x)
+    def det(self, x): return self._op("matrix_determinant", x)
+    def matmul(self, a, b, transpose_a=False, transpose_b=False):
+        return self._op("matmul", a, b, transpose_a=transpose_a,
+                        transpose_b=transpose_b)
+
+
+class SDRandom(_Namespace):
+    """Ref: ``SDRandom``. Random ops fold a per-node counter into the base
+    RNG key supplied at execution time (exec arg ``rng_seed``), so graphs stay
+    deterministic per seed without a stateful RNG in the graph."""
+
+    def normal(self, mean, stddev, shape, seed=0):
+        return self.sd._random_op("random_normal", shape=list(shape), mean=mean,
+                                  stddev=stddev, seed=seed)
+    def uniform(self, low, high, shape, seed=0):
+        return self.sd._random_op("random_uniform", shape=list(shape),
+                                  minval=low, maxval=high, seed=seed)
+    def bernoulli(self, p, shape, seed=0):
+        return self.sd._random_op("random_bernoulli", shape=list(shape), p=p,
+                                  seed=seed)
+
+
+_RANDOM_OPS = {"random_normal", "random_uniform", "random_bernoulli",
+               "dropout", "dropout_inverted"}
+
+
+class SameDiff:
+    """The graph builder + session owner (ref: ``SameDiff`` class).
+
+    Create with ``SameDiff.create()``; build variables and ops; execute with
+    ``output``/``exec``; train with ``fit`` after ``set_training_config``.
+    """
+
+    def __init__(self):
+        self._vars: Dict[str, SDVariable] = {}
+        self._values: Dict[str, jnp.ndarray] = {}
+        self._ops: List[OpNode] = []
+        self._producer: Dict[str, OpNode] = {}   # var name -> producing op
+        self._name_counter: Dict[str, int] = {}
+        self._loss_variables: List[str] = []
+        self.training_config: Optional[TrainingConfig] = None
+        self._compiled_cache: Dict[Any, Callable] = {}
+        self._train_step = None
+        self._train_sig = None
+        self._opt_state = None
+        self.listeners: List[Any] = []
+        self.epoch_count = 0
+        self.iteration_count = 0
+        # namespaces
+        self.math = SDMath(self)
+        self.nn = SDNN(self)
+        self.cnn = SDCNN(self)
+        self.rnn = SDRNN(self)
+        self.loss = SDLoss(self)
+        self.linalg = SDLinalg(self)
+        self.random = SDRandom(self)
+
+    # ---- creation -----------------------------------------------------
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    def _unique(self, base: str) -> str:
+        if base not in self._vars and base not in self._name_counter:
+            self._name_counter[base] = 0
+            return base
+        n = self._name_counter.get(base, 0) + 1
+        self._name_counter[base] = n
+        return f"{base}:{n}"
+
+    def _register(self, v: SDVariable) -> SDVariable:
+        self._vars[v.name] = v
+        return v
+
+    def var(self, name: str, shape=None, dtype=jnp.float32, init=None,
+            weight_init=None) -> SDVariable:
+        """Trainable variable. ``init`` may be a concrete array or a
+        weight-init name from ``nn.weights`` (e.g. 'xavier', 'relu')."""
+        name = self._unique(name)
+        if init is not None and not isinstance(init, str):
+            arr = jnp.asarray(init, dtype)
+            shape = arr.shape
+        else:
+            if shape is None:
+                raise ValueError("var() needs a shape or a concrete init array")
+            scheme = init if isinstance(init, str) else (weight_init or "xavier")
+            from deeplearning4j_tpu.nn import weights as _w
+            shape = tuple(shape)
+            fan_in = shape[0] if len(shape) >= 2 else max(1, int(np.prod(shape)))
+            fan_out = shape[-1] if len(shape) >= 2 else fan_in
+            arr = _w.init(scheme, jax.random.key(abs(hash(name)) % (2**31)),
+                          shape, fan_in, fan_out, dtype)
+        v = SDVariable(self, name, VariableType.VARIABLE, tuple(arr.shape), arr.dtype)
+        self._values[name] = arr
+        self._invalidate_cache()
+        return self._register(v)
+
+    def constant(self, value, name: str = "const") -> SDVariable:
+        arr = jnp.asarray(value)
+        name = self._unique(name)
+        v = SDVariable(self, name, VariableType.CONSTANT, tuple(arr.shape), arr.dtype)
+        self._values[name] = arr
+        self._invalidate_cache()
+        return self._register(v)
+
+    def placeholder(self, name: str, shape=None, dtype=jnp.float32) -> SDVariable:
+        name = self._unique(name)
+        v = SDVariable(self, name, VariableType.PLACEHOLDER, shape, dtype)
+        return self._register(v)
+
+    # DL4J-style aliases
+    def variable(self, *a, **k): return self.var(*a, **k)
+    def one(self, name, shape): return self.constant(jnp.ones(shape), name)
+    def zero(self, name, shape): return self.constant(jnp.zeros(shape), name)
+
+    def _lift(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            if x.sd is not self:
+                raise ValueError("variable belongs to a different SameDiff")
+            return x
+        return self.constant(x)
+
+    # ---- op creation ---------------------------------------------------
+    def _op(self, op_name: str, *inputs: SDVariable, n_out: int = 1,
+            name: str = None, **attrs):
+        opdef = op_registry.get(op_name)
+        node_name = self._unique(name or op_name)
+        n_out = max(n_out, opdef.num_outputs)
+        out_names = ([node_name] if n_out == 1
+                     else [f"{node_name}#{i}" for i in range(n_out)])
+        node = OpNode(node_name, op_name, [v.name for v in inputs], out_names,
+                      attrs)
+        self._ops.append(node)
+        # shape inference via eval_shape over abstract inputs
+        try:
+            in_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in inputs]
+            out_aval = jax.eval_shape(lambda *xs: opdef.fn(*xs, **attrs), *in_avals)
+        except Exception:
+            out_aval = None
+        outs = []
+        for i, on in enumerate(out_names):
+            if out_aval is None:
+                shape, dtype = None, jnp.float32
+            elif n_out == 1:
+                shape, dtype = out_aval.shape, out_aval.dtype
+            else:
+                shape, dtype = out_aval[i].shape, out_aval[i].dtype
+            ov = SDVariable(self, on, VariableType.ARRAY, shape, dtype)
+            self._register(ov)
+            self._producer[on] = node
+            outs.append(ov)
+        self._invalidate_cache()
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    def _random_op(self, op_name: str, *inputs, **attrs):
+        """Random ops get a deterministic per-node key derived from the
+        execution-time base seed (see SDRandom docstring)."""
+        attrs["__random_index__"] = len(self._ops)
+        return self._op(op_name, *inputs, **attrs)
+
+    def lambda_op(self, fn: Callable, *inputs: SDVariable, n_out: int = 1,
+                  name: str = "lambda"):
+        """Embed an arbitrary jax-traceable function as a graph node.
+
+        Non-serializable (``save`` will refuse); the escape hatch the
+        reference provides via ``SameDiffLambdaLayer``/custom ops.
+        """
+        node_name = self._unique(name)
+        out_names = ([node_name] if n_out == 1
+                     else [f"{node_name}#{i}" for i in range(n_out)])
+        node = OpNode(node_name, "__lambda__", [v.name for v in inputs],
+                      out_names, {}, fn=fn)
+        self._ops.append(node)
+        outs = []
+        try:
+            in_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in inputs]
+            out_aval = jax.eval_shape(fn, *in_avals)
+        except Exception:
+            out_aval = None
+        for i, on in enumerate(out_names):
+            if out_aval is None:
+                shape, dtype = None, jnp.float32
+            elif n_out == 1:
+                shape, dtype = out_aval.shape, out_aval.dtype
+            else:
+                shape, dtype = out_aval[i].shape, out_aval[i].dtype
+            ov = SDVariable(self, on, VariableType.ARRAY, shape, dtype)
+            self._register(ov)
+            self._producer[on] = node
+            outs.append(ov)
+        self._invalidate_cache()
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    # ---- introspection -------------------------------------------------
+    def get_variable(self, name: str) -> SDVariable:
+        return self._vars[name]
+
+    def has_variable(self, name: str) -> bool:
+        return name in self._vars
+
+    def variables(self) -> List[SDVariable]:
+        return list(self._vars.values())
+
+    def variable_names(self) -> List[str]:
+        return list(self._vars.keys())
+
+    def trainable_names(self) -> List[str]:
+        return [n for n, v in self._vars.items()
+                if v.var_type == VariableType.VARIABLE]
+
+    def placeholders(self) -> List[str]:
+        return [n for n, v in self._vars.items()
+                if v.var_type == VariableType.PLACEHOLDER]
+
+    def ops(self) -> List[OpNode]:
+        return list(self._ops)
+
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self._vars)} variables, {len(self._ops)} ops"]
+        for v in self._vars.values():
+            if v.var_type != VariableType.ARRAY:
+                lines.append(f"  {v.var_type.value:<12} {v.name:<24} {v.shape}")
+        for op in self._ops:
+            lines.append(f"  op {op.op_name:<20} {op.inputs} -> {op.outputs}")
+        return "\n".join(lines)
+
+    def _rename(self, old: str, new: str):
+        if new in self._vars:
+            raise ValueError(f"variable {new!r} already exists")
+        v = self._vars.pop(old)
+        v.name = new
+        self._vars[new] = v
+        if old in self._values:
+            self._values[new] = self._values.pop(old)
+        if old in self._producer:
+            self._producer[new] = self._producer.pop(old)
+        for op in self._ops:
+            op.inputs = [new if i == old else i for i in op.inputs]
+            op.outputs = [new if o == old else o for o in op.outputs]
+        self._loss_variables = [new if n == old else n for n in self._loss_variables]
+        self._invalidate_cache()
+
+    def set_loss_variables(self, *names):
+        self._loss_variables = [n.name if isinstance(n, SDVariable) else n
+                                for n in names]
+        self._invalidate_cache()
+
+    def set_training_config(self, config: TrainingConfig):
+        self.training_config = config
+        if config.loss_variables and not self._loss_variables:
+            self._loss_variables = list(config.loss_variables)
+        self._train_step = None
+        self._opt_state = None
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+
+    def _invalidate_cache(self):
+        self._compiled_cache.clear()
+        self._train_step = None
+
+    # ---- emission (the AbstractSession topo-walk → HLO emitter) --------
+    def _needed_ops(self, outputs: Sequence[str]) -> List[OpNode]:
+        """Ops needed to compute `outputs`, in graph order."""
+        needed_vars = set(outputs)
+        needed_ops: List[OpNode] = []
+        for op in reversed(self._ops):
+            if any(o in needed_vars for o in op.outputs):
+                needed_ops.append(op)
+                needed_vars.update(op.inputs)
+        return list(reversed(needed_ops))
+
+    def _emit(self, outputs: Sequence[str]) -> Callable:
+        """Build fn(values: dict, placeholders: dict, rng_seed) -> tuple.
+
+        One pass over the (pruned) op list in insertion order — insertion
+        order is topological by construction in a define-then-run builder.
+        """
+        ops = self._needed_ops(outputs)
+
+        def fn(values: Dict[str, jnp.ndarray],
+               placeholders: Dict[str, jnp.ndarray],
+               rng_seed=0):
+            env: Dict[str, jnp.ndarray] = {}
+            env.update(values)
+            env.update(placeholders)
+            base_key = jax.random.key(rng_seed) if not isinstance(
+                rng_seed, jax.Array) or jnp.issubdtype(
+                jnp.asarray(rng_seed).dtype, jnp.integer) else rng_seed
+            for op in ops:
+                args = [env[i] for i in op.inputs]
+                if op.fn is not None:
+                    res = op.fn(*args)
+                else:
+                    attrs = dict(op.attrs)
+                    ridx = attrs.pop("__random_index__", None)
+                    opdef = op_registry.get(op.op_name)
+                    if ridx is not None:
+                        key = jax.random.fold_in(base_key, ridx)
+                        node_seed = attrs.pop("seed", 0)
+                        if node_seed:
+                            key = jax.random.fold_in(key, node_seed)
+                        if op.op_name in ("dropout", "dropout_inverted"):
+                            res = opdef(args[0], key, **attrs)
+                        else:
+                            res = opdef(key, **attrs)
+                    else:
+                        res = opdef(*args, **attrs)
+                if len(op.outputs) == 1:
+                    env[op.outputs[0]] = res
+                else:
+                    for on, r in zip(op.outputs, res):
+                        env[on] = r
+            return tuple(env[o] for o in outputs)
+
+        return fn
+
+    # ---- execution ----------------------------------------------------
+    def output(self, placeholders: Dict[str, Any],
+               outputs: Union[str, Sequence[str], None] = None,
+               rng_seed: int = 0) -> Dict[str, jnp.ndarray]:
+        """Whole-graph jitted inference (ref: ``SameDiff#output``).
+
+        Compiled once per (outputs, placeholder shape/dtype) signature and
+        cached — repeated calls hit the XLA executable directly.
+        """
+        if outputs is None:
+            produced = {o for op in self._ops for o in op.outputs}
+            consumed = {i for op in self._ops for i in op.inputs}
+            outputs = sorted(produced - consumed)
+        if isinstance(outputs, str):
+            outputs = [outputs]
+        outputs = [o.name if isinstance(o, SDVariable) else o for o in outputs]
+        ph = {k: jnp.asarray(v) for k, v in (placeholders or {}).items()}
+        missing = [p for p in self.placeholders()
+                   if p not in ph and any(
+                       p in op.inputs for op in self._needed_ops(outputs))]
+        if missing:
+            raise ValueError(f"missing placeholders: {missing}")
+        key = (tuple(outputs),
+               tuple(sorted((k, v.shape, str(v.dtype)) for k, v in ph.items())))
+        if key not in self._compiled_cache:
+            emitted = self._emit(outputs)
+            self._compiled_cache[key] = jax.jit(emitted)
+        res = self._compiled_cache[key](self._values, ph, rng_seed)
+        return dict(zip(outputs, res))
+
+    def exec(self, placeholders=None, *outputs):
+        return self.output(placeholders or {}, list(outputs) or None)
+
+    def batch_output(self, placeholders, outputs):
+        return self.output(placeholders, outputs)
+
+    # ---- gradients ----------------------------------------------------
+    def calculate_gradients(self, placeholders: Dict[str, Any],
+                            wrt: Sequence[str] = None,
+                            rng_seed: int = 0) -> Dict[str, jnp.ndarray]:
+        """Ref: ``SameDiff#calculateGradients``. Backward graph = jax.grad of
+        the emitted forward program (replaces createGradFunction/doDiff)."""
+        if not self._loss_variables:
+            raise ValueError("no loss variables set (set_loss_variables)")
+        wrt = list(wrt) if wrt else self.trainable_names()
+        emitted = self._emit(self._loss_variables)
+        ph = {k: jnp.asarray(v) for k, v in (placeholders or {}).items()}
+
+        def loss_fn(train_vals, fixed_vals):
+            outs = emitted({**fixed_vals, **train_vals}, ph, rng_seed)
+            return sum(jnp.sum(o) for o in outs)
+
+        train_vals = {n: self._values[n] for n in wrt}
+        fixed_vals = {n: v for n, v in self._values.items() if n not in train_vals}
+        grads = jax.jit(jax.grad(loss_fn))(train_vals, fixed_vals)
+        return grads
+
+    grad = calculate_gradients
+
+    # ---- training -----------------------------------------------------
+    def _build_train_step(self, ph_sig):
+        import optax
+        tc = self.training_config
+        opt = tc.to_optax()
+        loss_names = list(self._loss_variables)
+        emitted = self._emit(loss_names)
+        trainable = self.trainable_names()
+        l1, l2 = tc.l1, tc.l2
+
+        def step(train_vals, fixed_vals, opt_state, ph, rng_seed):
+            def loss_fn(tv):
+                outs = emitted({**fixed_vals, **tv}, ph, rng_seed)
+                loss = sum(jnp.sum(o) for o in outs)
+                if l2:
+                    loss = loss + l2 * sum(jnp.sum(p * p) for p in tv.values())
+                if l1:
+                    loss = loss + l1 * sum(jnp.sum(jnp.abs(p)) for p in tv.values())
+                return loss
+            loss, grads = jax.value_and_grad(loss_fn)(train_vals)
+            updates, opt_state = opt.update(grads, opt_state, train_vals)
+            train_vals = optax.apply_updates(train_vals, updates)
+            return train_vals, opt_state, loss
+
+        jitted = jax.jit(step, donate_argnums=(0, 2))
+        init_state = opt.init({n: self._values[n] for n in trainable})
+        return jitted, init_state
+
+    def fit(self, data=None, epochs: int = 1, batch_size: int = None,
+            rng_seed: int = 0):
+        """Train (ref: ``SameDiff#fit``). ``data`` is a DataSet/
+        MultiDataSet, an iterator of them, or a dict of placeholder arrays.
+
+        Placeholder binding follows TrainingConfig's
+        dataSetFeatureMapping/dataSetLabelMapping, as in the reference.
+        """
+        if self.training_config is None:
+            raise ValueError("call set_training_config first")
+        tc = self.training_config
+        losses = []
+
+        def batches():
+            from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+            if isinstance(data, dict):
+                yield {k: jnp.asarray(v) for k, v in data.items()}
+                return
+            it = data
+            if isinstance(it, (DataSet, MultiDataSet)):
+                it = [it]
+            for ds in it:
+                feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                    else [ds.features]
+                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                    else [ds.labels]
+                ph = {}
+                for name, arr in zip(tc.data_set_feature_mapping, feats):
+                    ph[name] = jnp.asarray(arr)
+                for name, arr in zip(tc.data_set_label_mapping, labs):
+                    ph[name] = jnp.asarray(arr)
+                yield ph
+
+        trainable = self.trainable_names()
+        for epoch in range(epochs):
+            for ph in batches():
+                sig = tuple(sorted((k, v.shape, str(v.dtype))
+                                   for k, v in ph.items()))
+                if self._train_step is None or self._train_sig != sig:
+                    self._train_step, self._opt_state = self._build_train_step(sig)
+                    self._train_sig = sig
+                train_vals = {n: self._values[n] for n in trainable}
+                fixed_vals = {n: v for n, v in self._values.items()
+                              if n not in train_vals}
+                train_vals, self._opt_state, loss = self._train_step(
+                    train_vals, fixed_vals, self._opt_state, ph,
+                    rng_seed + self.iteration_count)
+                self._values.update(train_vals)
+                loss = float(loss)
+                losses.append(loss)
+                self.iteration_count += 1
+                for lst in self.listeners:
+                    if hasattr(lst, "iteration_done"):
+                        lst.iteration_done(self, self.iteration_count, loss)
+            self.epoch_count += 1
+            for lst in self.listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self, self.epoch_count)
+        # output()'s cache holds stale self._values copies only by reference —
+        # values dict is passed per call, so no invalidation needed here.
+        return losses
+
+    # ---- serialization -------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "format": "deeplearning4j_tpu.samediff/1",
+            "variables": [
+                {"name": v.name, "type": v.var_type.value,
+                 "shape": list(v.shape) if v.shape is not None else None,
+                 "dtype": np.dtype(v.dtype).name}
+                for v in self._vars.values()],
+            "ops": [op.to_dict() for op in self._ops],
+            "lossVariables": self._loss_variables,
+            "trainingConfig": (self.training_config.to_dict()
+                               if self.training_config else None),
+        }
+
+    def save(self, path: str, save_updater_state: bool = False):
+        """Persist graph + values (ref: ``SameDiff#save`` FlatBuffers zip)."""
+        d = self.to_dict()
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("graph.json", json.dumps(d, indent=1))
+            buf = io.BytesIO()
+            np.savez(buf, **{k: np.asarray(v) for k, v in self._values.items()})
+            zf.writestr("values.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        sd = SameDiff()
+        with zipfile.ZipFile(path) as zf:
+            d = json.loads(zf.read("graph.json"))
+            with zf.open("values.npz") as f:
+                values = dict(np.load(io.BytesIO(f.read())))
+        for vd in d["variables"]:
+            v = SDVariable(sd, vd["name"], VariableType(vd["type"]),
+                           tuple(vd["shape"]) if vd["shape"] is not None else None,
+                           np.dtype(vd["dtype"]))
+            sd._vars[v.name] = v
+            if v.name in values and v.var_type in (VariableType.VARIABLE,
+                                                   VariableType.CONSTANT):
+                sd._values[v.name] = jnp.asarray(values[v.name])
+        for od in d["ops"]:
+            node = OpNode(od["name"], od["op"], od["inputs"], od["outputs"],
+                          od["attrs"])
+            sd._ops.append(node)
+            for o in node.outputs:
+                sd._producer[o] = node
+        sd._loss_variables = d.get("lossVariables", [])
+        # name counters: make future names unique past loaded ones
+        for n in sd._vars:
+            base = n.split(":")[0].split("#")[0]
+            cur = sd._name_counter.get(base, 0)
+            try:
+                suffix = int(n.split(":")[1]) if ":" in n else 0
+            except ValueError:
+                suffix = 0
+            sd._name_counter[base] = max(cur, suffix)
+        return sd

@@ -114,9 +114,13 @@ class TransformerLM:
 
     # ----------------------------------------------------------------- forward
     def _ln(self, p, x):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        return (x - mu) * lax.rsqrt(var + 1e-5) * p["g"] + p["b"]
+        # layernorm statistics in f32 regardless of compute dtype
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * lax.rsqrt(var + 1e-5)
+        y = y * p["g"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+        return y.astype(x.dtype)
 
     def _attn(self, p, x, mesh):
         c = self.config
@@ -152,6 +156,12 @@ class TransformerLM:
         (training mode); None = inference."""
         c = self.config
         t = tokens.shape[1]
+        if c.dtype != jnp.float32:
+            # mixed precision: f32 master params (init_params), compute in
+            # c.dtype — the grads/updates stay f32 on the outside
+            params = jax.tree.map(
+                lambda a: a.astype(c.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
         x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
         x = self._dropout(x.astype(c.dtype), rng, 0)
         x = self._constrain(x)
@@ -165,7 +175,8 @@ class TransformerLM:
             x = x + self._dropout(m, rng, 2 * li + 2)
             x = self._constrain(x)
         x = self._ln(params["ln_f"], x)
-        return (x @ params["tok_emb"].T).astype(jnp.float32)
+        return jnp.matmul(x, params["tok_emb"].T,
+                          preferred_element_type=jnp.float32)
 
     # ------------------------------------------------------------------- loss
     def loss_fn(self, params, tokens, targets, rng=None):

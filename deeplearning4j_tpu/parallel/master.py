@@ -48,20 +48,28 @@ class DistributedConfig:
 
 
 class TrainingMaster:
-    """Base facade: owns MeshSpec + batch policy."""
+    """Base facade: owns MeshSpec + batch policy.
+
+    ``tensor_parallel`` may be True (model axis of 2) or an int (the model
+    axis size); the remaining devices form the ``data`` axis.
+    """
 
     def __init__(self, batch_size_per_worker: int = 32, workers: Optional[int] = None,
-                 tensor_parallel: bool = False):
+                 tensor_parallel=False):
         self.batch_size_per_worker = batch_size_per_worker
         self.workers = workers
         self.tensor_parallel = tensor_parallel
 
     def mesh_spec(self) -> MeshSpec:
+        if self.tensor_parallel:
+            model = (int(self.tensor_parallel)
+                     if not isinstance(self.tensor_parallel, bool) else 2)
+            return MeshSpec.dp_tp(data=self.workers or -1, model=model)
         return MeshSpec.data_parallel(self.workers or -1)
 
     def make_trainer(self, net) -> ShardedTrainer:
         return ShardedTrainer(net, self.mesh_spec(),
-                              tensor_parallel=self.tensor_parallel)
+                              tensor_parallel=bool(self.tensor_parallel))
 
 
 class SharedTrainingMaster(TrainingMaster):
@@ -131,21 +139,70 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             return ParameterAveragingTrainingMaster(**self._kw)
 
 
+def _rebatch(data, target: int):
+    """Re-chunk a stream of DataSets to ``target`` examples per step
+    (the batch_size_per_worker × data-axis-size policy). Tuple-valued
+    (MultiDataSet) batches pass through unchanged."""
+    import numpy as np
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    buf_x, buf_y, n = [], [], 0
+    has_labels = True
+    for ds in data:
+        x, y = ds.features, ds.labels
+        if (isinstance(x, (tuple, list)) or ds.features_mask is not None
+                or getattr(ds, "labels_mask", None) is not None):
+            yield ds  # masks/multi-input: don't re-split, preserve alignment
+            continue
+        buf_x.append(np.asarray(x))
+        has_labels = y is not None
+        if has_labels:
+            buf_y.append(np.asarray(y))
+        n += buf_x[-1].shape[0]
+        while n >= target:
+            X = np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0]
+            Y = (np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0]) \
+                if has_labels else None
+            yield DataSet(X[:target], Y[:target] if has_labels else None)
+            buf_x = [X[target:]] if X.shape[0] > target else []
+            buf_y = ([Y[target:]] if Y.shape[0] > target else []) if has_labels else []
+            n -= target
+    if n:
+        yield DataSet(np.concatenate(buf_x) if len(buf_x) > 1 else buf_x[0],
+                      (np.concatenate(buf_y) if len(buf_y) > 1 else buf_y[0])
+                      if has_labels else None)
+
+
 class SparkDl4jMultiLayer:
     """ref: org.deeplearning4j.spark.impl.multilayer.SparkDl4jMultiLayer.
     The SparkContext slot is accepted for parity and unused (no Spark in the
     TPU path; data distribution is the input pipeline's job)."""
 
-    def __init__(self, sc, net_or_conf, training_master: TrainingMaster):
+    _net_cls = None  # set per subclass
+
+    def _wrap_conf(self, net_or_conf):
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(net_or_conf)
+
+    def __init__(self, sc, net_or_conf, training_master: TrainingMaster):
         if not hasattr(net_or_conf, "fit"):
-            net_or_conf = MultiLayerNetwork(net_or_conf)
+            net_or_conf = self._wrap_conf(net_or_conf)
         self.network = net_or_conf
         self.training_master = training_master
         self._trainer = training_master.make_trainer(self.network)
 
     def fit(self, data, epochs: int = 1):
-        self._trainer.fit(data, epochs=epochs)
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, axis_size
+        tm = self.training_master
+        if hasattr(data, "__iter__") and not hasattr(data, "shape"):
+            target = tm.batch_size_per_worker * axis_size(self._trainer.mesh,
+                                                          DATA_AXIS)
+            for _ in range(epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                self._trainer.fit(list(_rebatch(data, target)), epochs=1)
+        else:
+            self._trainer.fit(data, epochs=epochs)
         return self.network
 
     def get_network(self):
@@ -157,10 +214,6 @@ class SparkDl4jMultiLayer:
 class SparkComputationGraph(SparkDl4jMultiLayer):
     """ref: org.deeplearning4j.spark.impl.graph.SparkComputationGraph."""
 
-    def __init__(self, sc, net_or_conf, training_master: TrainingMaster):
+    def _wrap_conf(self, net_or_conf):
         from deeplearning4j_tpu.nn.graph import ComputationGraph
-        if not hasattr(net_or_conf, "fit"):
-            net_or_conf = ComputationGraph(net_or_conf)
-        self.network = net_or_conf
-        self.training_master = training_master
-        self._trainer = training_master.make_trainer(self.network)
+        return ComputationGraph(net_or_conf)

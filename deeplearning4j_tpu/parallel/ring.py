@@ -23,7 +23,8 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import SEQ_AXIS
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, SEQ_AXIS, axis_size)
 
 
 def _block_attn_update(q, k, v, m, l, o, q_start, k_start, causal, scale):
@@ -51,7 +52,8 @@ def _block_attn_update(q, k, v, m, l, o, q_start, k_start, causal, scale):
     return m_new, l_new, o_new
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          vary_axes=()):
     """Per-shard body under shard_map. q/k/v: (B, T/P, H, D) local blocks."""
     p_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -63,9 +65,11 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, tq), jnp.float32)
     o0 = jnp.zeros((b, tq, h, d), jnp.float32)
-    # mark accumulators device-varying over the ring axis so the fori_loop
-    # carry type matches the body output (shard_map vma typing)
-    m0, l0, o0 = (lax.pvary(a, (axis_name,)) for a in (m0, l0, o0))
+    # mark accumulators device-varying over every axis the block inputs vary
+    # on, so the fori_loop carry type matches the body output (shard_map vma
+    # typing)
+    vary = tuple(vary_axes) or (axis_name,)
+    m0, l0, o0 = (lax.pcast(a, vary, to="varying") for a in (m0, l0, o0))
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
     def body(i, carry):
@@ -90,12 +94,18 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = SEQ_AXIS,
     """Sequence-sharded attention. q/k/v: (B, T, H, D) GLOBAL shapes, sharded
     (or shardable) on T over ``seq_axis``. Returns (B, T, H, D) with the same
     sharding. Falls back to plain attention when the axis is absent/size 1."""
-    if seq_axis not in mesh.axis_names or dict(
-            zip(mesh.axis_names, mesh.devices.shape))[seq_axis] == 1:
+    if seq_axis not in mesh.axis_names or axis_size(mesh, seq_axis) == 1:
         return _plain_attention(q, k, v, causal)
-    spec = P(None, seq_axis, None, None)
+    # keep batch sharded over 'data' and heads over 'model' inside the ring —
+    # replicating them here would make every device recompute the global batch
+    batch_ax = DATA_AXIS if axis_size(mesh, DATA_AXIS) > 1 else None
+    head_ax = (MODEL_AXIS if axis_size(mesh, MODEL_AXIS) > 1
+               and q.shape[2] % axis_size(mesh, MODEL_AXIS) == 0 else None)
+    spec = P(batch_ax, seq_axis, head_ax, None)
+    vary = tuple(a for a in (batch_ax, seq_axis, head_ax) if a is not None)
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=seq_axis, causal=causal),
+        functools.partial(_ring_attention_local, axis_name=seq_axis,
+                          causal=causal, vary_axes=vary),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 

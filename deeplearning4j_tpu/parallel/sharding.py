@@ -12,7 +12,7 @@ from typing import Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS
+from deeplearning4j_tpu.parallel.mesh import MODEL_AXIS, axis_size
 
 
 def param_pspec(pname: str, ndim: int, model_axis: str = MODEL_AXIS) -> P:
@@ -43,9 +43,8 @@ def tp_shardings(params, mesh: Mesh, enable: bool = True):
             return NamedSharding(mesh, P())
         spec = param_pspec(pname, leaf.ndim)
         # don't shard dims that aren't divisible — GSPMD requires it
-        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         ok = all(
-            ax is None or leaf.shape[i] % sizes.get(ax, 1) == 0
+            ax is None or leaf.shape[i] % axis_size(mesh, ax) == 0
             for i, ax in enumerate(spec))
         return NamedSharding(mesh, spec if ok else P())
     return jax.tree_util.tree_map_with_path(one, params)

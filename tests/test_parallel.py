@@ -151,3 +151,37 @@ class TestGraftEntry:
     def test_dryrun_multichip_8(self):
         import __graft_entry__ as ge
         ge.dryrun_multichip(8)
+
+
+class TestTrainingMasterFixes:
+    """Regressions from round-1 code review: tensor_parallel no-op,
+    batch_size_per_worker ignored, bf16 config inert."""
+
+    def test_tensor_parallel_builds_model_axis(self):
+        from deeplearning4j_tpu.parallel.master import TrainingMaster
+        tm = TrainingMaster(tensor_parallel=True)
+        sizes = tm.mesh_spec().resolve(8)
+        assert sizes["model"] == 2 and sizes["data"] == 4
+        tm4 = TrainingMaster(tensor_parallel=4)
+        assert tm4.mesh_spec().resolve(8)["model"] == 4
+
+    def test_rebatch_honors_batch_size(self):
+        from deeplearning4j_tpu.parallel.master import _rebatch
+        from deeplearning4j_tpu.data.dataset import DataSet
+        dss = [DataSet(np.ones((16, 3), np.float32), np.ones((16, 2), np.float32))
+               for _ in range(4)]
+        out = list(_rebatch(iter(dss), 24))
+        assert [d.features.shape[0] for d in out] == [24, 24, 16]
+        assert all(d.labels.shape[0] == d.features.shape[0] for d in out)
+
+    def test_bf16_config_used_in_compute(self):
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        cfg = TransformerConfig(vocab_size=32, n_layers=1, n_heads=2,
+                                d_model=16, max_len=8, dtype=jnp.bfloat16)
+        m = TransformerLM(cfg)
+        p = m.init_params(jax.random.key(0))
+        toks = jnp.zeros((2, 8), jnp.int32)
+        out = m.apply(p, toks)
+        assert out.dtype == jnp.float32  # logits in f32
+        assert "bf16" in str(jax.make_jaxpr(lambda p, t: m.apply(p, t))(p, toks))
