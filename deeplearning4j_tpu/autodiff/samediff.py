@@ -28,6 +28,7 @@ import enum
 import io
 import json
 import zipfile
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -130,7 +131,12 @@ class SDVariable:
         begins, ends, strides, squeeze_axes = [], [], [], []
         for ax, s in enumerate(idx):
             if isinstance(s, int):
-                begins.append(s); ends.append(s + 1); strides.append(1)
+                if s == -1:
+                    # end=0 would make an empty slice; 2**31-1 = "to the end"
+                    begins.append(s); ends.append(2**31 - 1)
+                else:
+                    begins.append(s); ends.append(s + 1)
+                strides.append(1)
                 squeeze_axes.append(ax)
             elif isinstance(s, slice):
                 dim = self.shape[ax] if self.shape is not None else None
@@ -238,15 +244,15 @@ class TrainingConfig:
                 "lossVariables": self.loss_variables,
                 "updater": getattr(u, "to_dict", lambda: None)()}
 
-
-def _ns(owner):
-    """Bind an op-namespace class to a SameDiff instance."""
-    class Bound:
-        def __init__(self, sd):
-            self.sd = sd
-        def __getattr__(self, item):
-            raise AttributeError(item)
-    return Bound
+    @staticmethod
+    def from_dict(d: dict) -> "TrainingConfig":
+        from deeplearning4j_tpu.optim.updaters import Updater
+        upd = Updater.from_dict(d["updater"]) if d.get("updater") else None
+        return TrainingConfig(
+            updater=upd, l1=d.get("l1", 0.0), l2=d.get("l2", 0.0),
+            data_set_feature_mapping=d.get("featureMapping", ()),
+            data_set_label_mapping=d.get("labelMapping", ()),
+            loss_variables=d.get("lossVariables", ()))
 
 
 class _Namespace:
@@ -446,6 +452,8 @@ class SameDiff:
         self._train_step = None
         self._train_sig = None
         self._opt_state = None
+        self._pending_opt_leaves = None
+        self._seed = 12345
         self.listeners: List[Any] = []
         self.epoch_count = 0
         self.iteration_count = 0
@@ -491,7 +499,10 @@ class SameDiff:
             shape = tuple(shape)
             fan_in = shape[0] if len(shape) >= 2 else max(1, int(np.prod(shape)))
             fan_out = shape[-1] if len(shape) >= 2 else fan_in
-            arr = _w.init(scheme, jax.random.key(abs(hash(name)) % (2**31)),
+            # stable per-name seed (Python's hash() is salted per process)
+            name_seed = zlib.crc32(name.encode("utf-8"))
+            arr = _w.init(scheme, jax.random.fold_in(
+                              jax.random.key(self._seed), name_seed),
                           shape, fan_in, fan_out, dtype)
         v = SDVariable(self, name, VariableType.VARIABLE, tuple(arr.shape), arr.dtype)
         self._values[name] = arr
@@ -735,9 +746,10 @@ class SameDiff:
             outputs = [outputs]
         outputs = [o.name if isinstance(o, SDVariable) else o for o in outputs]
         ph = {k: jnp.asarray(v) for k, v in (placeholders or {}).items()}
+        needed_inputs = {i for op in self._needed_ops(outputs)
+                         for i in op.inputs}
         missing = [p for p in self.placeholders()
-                   if p not in ph and any(
-                       p in op.inputs for op in self._needed_ops(outputs))]
+                   if p not in ph and p in needed_inputs]
         if missing:
             raise ValueError(f"missing placeholders: {missing}")
         key = (tuple(outputs),
@@ -803,6 +815,15 @@ class SameDiff:
 
         jitted = jax.jit(step, donate_argnums=(0, 2))
         init_state = opt.init({n: self._values[n] for n in trainable})
+        if self._pending_opt_leaves is not None:
+            # updater state loaded from a checkpoint: rehydrate into the
+            # freshly-built optax tree structure (ref: SameDiff#load restoring
+            # updater moments so Adam state survives resume)
+            treedef = jax.tree.structure(init_state)
+            leaves = [jnp.asarray(l) for l in self._pending_opt_leaves]
+            if len(leaves) == treedef.num_leaves:
+                init_state = jax.tree.unflatten(treedef, leaves)
+            self._pending_opt_leaves = None
         return jitted, init_state
 
     def fit(self, data=None, epochs: int = 1, batch_size: int = None,
@@ -838,11 +859,22 @@ class SameDiff:
                     ph[name] = jnp.asarray(arr)
                 yield ph
 
+        # a one-shot iterator would silently yield nothing on epochs 2..N —
+        # materialize it once (reference iterators have reset(); support both)
+        import collections.abc as _abc
+        if (epochs > 1 and isinstance(data, _abc.Iterator)
+                and not hasattr(data, "reset")):
+            data = list(data)
+
         trainable = self.trainable_names()
         for epoch in range(epochs):
+            if epoch > 0 and hasattr(data, "reset"):
+                data.reset()
             for ph in batches():
-                sig = tuple(sorted((k, v.shape, str(v.dtype))
-                                   for k, v in ph.items()))
+                # rebuild only when the *graph* changes (trainable set / loss
+                # set); batch-shape changes hit jax.jit's own signature cache
+                # and must NOT reset optimizer state
+                sig = (tuple(trainable), tuple(self._loss_variables))
                 if self._train_step is None or self._train_sig != sig:
                     self._train_step, self._opt_state = self._build_train_step(sig)
                     self._train_sig = sig
@@ -890,14 +922,25 @@ class SameDiff:
             buf = io.BytesIO()
             np.savez(buf, **{k: np.asarray(v) for k, v in self._values.items()})
             zf.writestr("values.npz", buf.getvalue())
+            if save_updater_state and self._opt_state is not None:
+                leaves = jax.tree.leaves(self._opt_state)
+                buf = io.BytesIO()
+                np.savez(buf, **{f"leaf{i}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})
+                zf.writestr("updater.npz", buf.getvalue())
 
     @staticmethod
     def load(path: str) -> "SameDiff":
         sd = SameDiff()
+        opt_leaves = None
         with zipfile.ZipFile(path) as zf:
             d = json.loads(zf.read("graph.json"))
             with zf.open("values.npz") as f:
                 values = dict(np.load(io.BytesIO(f.read())))
+            if "updater.npz" in zf.namelist():
+                with zf.open("updater.npz") as f:
+                    raw = dict(np.load(io.BytesIO(f.read())))
+                opt_leaves = [raw[f"leaf{i}"] for i in range(len(raw))]
         for vd in d["variables"]:
             v = SDVariable(sd, vd["name"], VariableType(vd["type"]),
                            tuple(vd["shape"]) if vd["shape"] is not None else None,
@@ -913,6 +956,9 @@ class SameDiff:
             for o in node.outputs:
                 sd._producer[o] = node
         sd._loss_variables = d.get("lossVariables", [])
+        if d.get("trainingConfig"):
+            sd.training_config = TrainingConfig.from_dict(d["trainingConfig"])
+        sd._pending_opt_leaves = opt_leaves
         # name counters: make future names unique past loaded ones
         for n in sd._vars:
             base = n.split(":")[0].split("#")[0]

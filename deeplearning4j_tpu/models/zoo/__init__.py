@@ -1,0 +1,20 @@
+"""Model zoo (ref: deeplearning4j-zoo, SURVEY D11).
+
+Programmatic architectures mirroring ``org.deeplearning4j.zoo.model.*``,
+built on the config DSL so each trains as one jitted XLA program.
+"""
+from deeplearning4j_tpu.models.zoo.base import PretrainedType, ZooModel
+from deeplearning4j_tpu.models.zoo.cnn_small import (
+    AlexNet, LeNet, SimpleCNN, TextGenerationLSTM)
+from deeplearning4j_tpu.models.zoo.vgg import VGG16, VGG19
+from deeplearning4j_tpu.models.zoo.resnet import ResNet50
+from deeplearning4j_tpu.models.zoo.squeezenet import SqueezeNet
+from deeplearning4j_tpu.models.zoo.darknet import Darknet19, TinyYOLO, YOLO2
+from deeplearning4j_tpu.models.zoo.unet import UNet
+from deeplearning4j_tpu.models.zoo.xception import Xception
+
+__all__ = [
+    "ZooModel", "PretrainedType", "LeNet", "SimpleCNN", "AlexNet",
+    "TextGenerationLSTM", "VGG16", "VGG19", "ResNet50", "SqueezeNet",
+    "Darknet19", "TinyYOLO", "YOLO2", "UNet", "Xception",
+]

@@ -1,0 +1,67 @@
+"""Model-zoo base (ref: org.deeplearning4j.zoo.ZooModel / ZooType, SURVEY D11).
+
+The reference downloads pretrained weights from Azure blobs; this build runs
+in a zero-egress environment, so ``init_pretrained`` loads from a local cache
+directory instead (same role as the reference's ``~/.deeplearning4j`` cache)
+and raises with a clear message when the artifact is absent.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+class PretrainedType:
+    IMAGENET = "imagenet"
+    IMAGENETLARGE = "imagenetlarge"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
+class ZooModel:
+    """Base for programmatic zoo architectures (ref: zoo.ZooModel)."""
+
+    #: subclasses set: default input shape (H, W, C)
+    input_shape: Tuple[int, int, int] = (224, 224, 3)
+    num_classes: int = 1000
+
+    def conf(self):
+        """The network configuration (MultiLayerConfiguration or
+        ComputationGraphConfiguration)."""
+        raise NotImplementedError
+
+    def init_model(self):
+        """Build + init the runtime network (ref: ZooModel#init)."""
+        conf = self.conf()
+        # graph configs carry network_inputs; sequential ones don't
+        if hasattr(conf, "network_inputs"):
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            return ComputationGraph(conf).init()
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+    # reference API alias
+    init = init_model
+
+    def pretrained_cache_dir(self) -> str:
+        return os.environ.get(
+            "DL4J_TPU_ZOO_CACHE",
+            os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu", "zoo"))
+
+    def init_pretrained(self, pretrained_type: str = PretrainedType.IMAGENET):
+        """ref: ZooModel#initPretrained — local-cache only (zero egress)."""
+        path = os.path.join(self.pretrained_cache_dir(),
+                            f"{type(self).__name__.lower()}_{pretrained_type}.zip")
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"No pretrained weights for {type(self).__name__} "
+                f"({pretrained_type}) at {path}. This environment has no "
+                f"network egress; place the checkpoint there manually.")
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        return ModelSerializer.restore(path)
+
+    def pretrained_available(self, pretrained_type: str) -> bool:
+        return os.path.exists(os.path.join(
+            self.pretrained_cache_dir(),
+            f"{type(self).__name__.lower()}_{pretrained_type}.zip"))

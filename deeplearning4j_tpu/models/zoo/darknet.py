@@ -1,0 +1,169 @@
+"""Darknet19, TinyYOLO, YOLO2 (ref: org.deeplearning4j.zoo.model.{Darknet19,
+TinyYOLO,YOLO2}, SURVEY D11; Darknet19 is a BASELINE config).
+
+Darknet conv unit = conv(no bias) + batchnorm + leakyrelu(0.1), exactly the
+reference's ``Darknet19#addLayers`` helper semantics.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
+    OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.optim.updaters import Adam, Nesterovs
+from deeplearning4j_tpu.models.zoo.base import ZooModel
+
+# YOLOv2 VOC anchor priors (grid units) — same constants as the reference
+_TINY_YOLO_PRIORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38),
+                     (9.42, 5.11), (16.62, 10.52))
+_YOLO2_PRIORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+                 (7.88282, 3.52778), (9.77052, 9.16828))
+
+
+def _dark_conv(g, name, inp, n_out, kernel=(3, 3)):
+    """conv(no-bias) + BN + leaky-relu — ref Darknet19#addLayers."""
+    g.add_layer(name, ConvolutionLayer(kernel_size=kernel, padding="same",
+                                       n_out=n_out, has_bias=False,
+                                       activation="identity"), inp)
+    g.add_layer(name + "_bn", BatchNormalization(), name)
+    g.add_layer(name + "_act", ActivationLayer(activation="leakyrelu:0.1"),
+                name + "_bn")
+    return name + "_act"
+
+
+def _maxpool(g, name, inp, stride=2):
+    g.add_layer(name, SubsamplingLayer(kernel_size=(2, 2),
+                                       stride=(stride, stride),
+                                       padding="same" if stride == 1 else 0),
+                inp)
+    return name
+
+
+def _darknet19_trunk(g, inp):
+    """The 18 conv layers shared by Darknet19 / YOLO2."""
+    x = _dark_conv(g, "cnn1", inp, 32)
+    x = _maxpool(g, "pool1", x)
+    x = _dark_conv(g, "cnn2", x, 64)
+    x = _maxpool(g, "pool2", x)
+    x = _dark_conv(g, "cnn3", x, 128)
+    x = _dark_conv(g, "cnn4", x, 64, (1, 1))
+    x = _dark_conv(g, "cnn5", x, 128)
+    x = _maxpool(g, "pool3", x)
+    x = _dark_conv(g, "cnn6", x, 256)
+    x = _dark_conv(g, "cnn7", x, 128, (1, 1))
+    x = _dark_conv(g, "cnn8", x, 256)
+    x = _maxpool(g, "pool4", x)
+    x = _dark_conv(g, "cnn9", x, 512)
+    x = _dark_conv(g, "cnn10", x, 256, (1, 1))
+    x = _dark_conv(g, "cnn11", x, 512)
+    x = _dark_conv(g, "cnn12", x, 256, (1, 1))
+    x = _dark_conv(g, "cnn13", x, 512)
+    x = _maxpool(g, "pool5", x)
+    x = _dark_conv(g, "cnn14", x, 1024)
+    x = _dark_conv(g, "cnn15", x, 512, (1, 1))
+    x = _dark_conv(g, "cnn16", x, 1024)
+    x = _dark_conv(g, "cnn17", x, 512, (1, 1))
+    x = _dark_conv(g, "cnn18", x, 1024)
+    return x
+
+
+class Darknet19(ZooModel):
+    """Classification Darknet-19 (ref: zoo.model.Darknet19)."""
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = tuple(input_shape)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-3, 0.9))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        x = _darknet19_trunk(g, "input")
+        g.add_layer("cnn19", ConvolutionLayer(kernel_size=(1, 1),
+                                              n_out=self.num_classes,
+                                              activation="identity"), x)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), "cnn19")
+        g.add_layer("output", OutputLayer(n_in=self.num_classes,
+                                          n_out=self.num_classes,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "avgpool")
+        return g.set_outputs("output").build()
+
+
+class TinyYOLO(ZooModel):
+    """ref: zoo.model.TinyYOLO — 9-conv trunk + YOLOv2 head."""
+    input_shape = (416, 416, 3)
+
+    def __init__(self, num_classes: int = 20, seed: int = 123,
+                 input_shape=(416, 416, 3), priors=_TINY_YOLO_PRIORS):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = tuple(input_shape)
+        self.priors = priors
+
+    def conf(self):
+        h, w, c = self.input_shape
+        nb = len(self.priors)
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        x = "input"
+        for i, n_out in enumerate((16, 32, 64, 128, 256), start=1):
+            x = _dark_conv(g, f"cnn{i}", x, n_out)
+            x = _maxpool(g, f"pool{i}", x)
+        x = _dark_conv(g, "cnn6", x, 512)
+        x = _maxpool(g, "pool6", x, stride=1)
+        x = _dark_conv(g, "cnn7", x, 1024)
+        x = _dark_conv(g, "cnn8", x, 1024)
+        g.add_layer("detect_conv",
+                    ConvolutionLayer(kernel_size=(1, 1),
+                                     n_out=nb * (5 + self.num_classes),
+                                     activation="identity"), x)
+        g.add_layer("yolo", Yolo2OutputLayer(boxes=self.priors), "detect_conv")
+        return g.set_outputs("yolo").build()
+
+
+class YOLO2(ZooModel):
+    """ref: zoo.model.YOLO2 — Darknet19 trunk + passthrough-free YOLOv2 head."""
+    input_shape = (416, 416, 3)
+
+    def __init__(self, num_classes: int = 20, seed: int = 123,
+                 input_shape=(416, 416, 3), priors=_YOLO2_PRIORS):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = tuple(input_shape)
+        self.priors = priors
+
+    def conf(self):
+        h, w, c = self.input_shape
+        nb = len(self.priors)
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Adam(1e-3))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        x = _darknet19_trunk(g, "input")
+        x = _dark_conv(g, "cnn19", x, 1024)
+        x = _dark_conv(g, "cnn20", x, 1024)
+        g.add_layer("detect_conv",
+                    ConvolutionLayer(kernel_size=(1, 1),
+                                     n_out=nb * (5 + self.num_classes),
+                                     activation="identity"), x)
+        g.add_layer("yolo", Yolo2OutputLayer(boxes=self.priors), "detect_conv")
+        return g.set_outputs("yolo").build()

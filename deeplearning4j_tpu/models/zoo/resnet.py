@@ -1,0 +1,100 @@
+"""ResNet50 (ref: org.deeplearning4j.zoo.model.ResNet50#graphBuilder —
+the BASELINE ComputationGraph config; SURVEY D11).
+
+Identity + bottleneck conv blocks as a ComputationGraph DAG with
+ElementWiseVertex(add) skip connections; the full graph traces into a single
+XLA program so residual adds fuse with the surrounding convs on the MXU.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GlobalPoolingLayer, OutputLayer, SubsamplingLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.graph_conf import ElementWiseVertex
+from deeplearning4j_tpu.optim.updaters import Nesterovs
+from deeplearning4j_tpu.models.zoo.base import ZooModel
+
+
+class ResNet50(ZooModel):
+    input_shape = (224, 224, 3)
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = tuple(input_shape)
+
+    # ----- blocks (ref: ResNet50#convBlock / #identityBlock)
+    def _conv_bn_act(self, g, name, inp, n_out, kernel, stride=(1, 1),
+                     padding=(0, 0), act=True):
+        g.add_layer(name, ConvolutionLayer(kernel_size=kernel, stride=stride,
+                                           padding=padding, n_out=n_out,
+                                           activation="identity"), inp)
+        g.add_layer(name + "_bn", BatchNormalization(), name)
+        if act:
+            g.add_layer(name + "_relu", ActivationLayer(activation="relu"),
+                        name + "_bn")
+            return name + "_relu"
+        return name + "_bn"
+
+    def _identity_block(self, g, stage, block, inp, filters):
+        f1, f2, f3 = filters
+        p = f"res{stage}{block}"
+        x = self._conv_bn_act(g, p + "_2a", inp, f1, (1, 1))
+        x = self._conv_bn_act(g, p + "_2b", x, f2, (3, 3), padding="same")
+        x = self._conv_bn_act(g, p + "_2c", x, f3, (1, 1), act=False)
+        g.add_vertex(p + "_add", ElementWiseVertex(op="add"), x, inp)
+        g.add_layer(p + "_out", ActivationLayer(activation="relu"), p + "_add")
+        return p + "_out"
+
+    def _conv_block(self, g, stage, block, inp, filters, stride=(2, 2)):
+        f1, f2, f3 = filters
+        p = f"res{stage}{block}"
+        x = self._conv_bn_act(g, p + "_2a", inp, f1, (1, 1), stride=stride)
+        x = self._conv_bn_act(g, p + "_2b", x, f2, (3, 3), padding="same")
+        x = self._conv_bn_act(g, p + "_2c", x, f3, (1, 1), act=False)
+        sc = self._conv_bn_act(g, p + "_1", inp, f3, (1, 1), stride=stride,
+                               act=False)
+        g.add_vertex(p + "_add", ElementWiseVertex(op="add"), x, sc)
+        g.add_layer(p + "_out", ActivationLayer(activation="relu"), p + "_add")
+        return p + "_out"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-1, 0.9))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+        # stem
+        g.add_layer("pad1", ZeroPaddingLayer(padding=(3, 3, 3, 3)), "input")
+        x = self._conv_bn_act(g, "conv1", "pad1", 64, (7, 7), stride=(2, 2))
+        g.add_layer("pool1", SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                              padding=1), x)
+        x = "pool1"
+        # stage 2
+        x = self._conv_block(g, 2, "a", x, (64, 64, 256), stride=(1, 1))
+        x = self._identity_block(g, 2, "b", x, (64, 64, 256))
+        x = self._identity_block(g, 2, "c", x, (64, 64, 256))
+        # stage 3
+        x = self._conv_block(g, 3, "a", x, (128, 128, 512))
+        for blk in "bcd":
+            x = self._identity_block(g, 3, blk, x, (128, 128, 512))
+        # stage 4
+        x = self._conv_block(g, 4, "a", x, (256, 256, 1024))
+        for blk in "bcdef":
+            x = self._identity_block(g, 4, blk, x, (256, 256, 1024))
+        # stage 5
+        x = self._conv_block(g, 5, "a", x, (512, 512, 2048))
+        x = self._identity_block(g, 5, "b", x, (512, 512, 2048))
+        x = self._identity_block(g, 5, "c", x, (512, 512, 2048))
+        # head
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("output", OutputLayer(n_out=self.num_classes,
+                                          activation="softmax",
+                                          loss_function="mcxent"), "avgpool")
+        return g.set_outputs("output").build()

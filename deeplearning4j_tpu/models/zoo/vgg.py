@@ -1,0 +1,48 @@
+"""VGG16 / VGG19 (ref: org.deeplearning4j.zoo.model.{VGG16,VGG19}, SURVEY D11;
+BASELINE configs include VGG16)."""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.optim.updaters import Nesterovs
+from deeplearning4j_tpu.models.zoo.base import ZooModel
+
+# channel plan per block: (n_convs, n_out)
+_VGG16_BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+_VGG19_BLOCKS = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+class VGG16(ZooModel):
+    input_shape = (224, 224, 3)
+    _blocks = _VGG16_BLOCKS
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = tuple(input_shape)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(Nesterovs(1e-2, 0.9))
+             .weight_init("relu")
+             .activation("relu")
+             .list())
+        for n_convs, n_out in self._blocks:
+            for _ in range(n_convs):
+                b.layer(ConvolutionLayer(kernel_size=(3, 3), padding="same",
+                                         n_out=n_out))
+            b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        b.layer(DenseLayer(n_out=4096, dropout=0.5))
+        b.layer(DenseLayer(n_out=4096, dropout=0.5))
+        b.layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                            loss_function="mcxent"))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+class VGG19(VGG16):
+    _blocks = _VGG19_BLOCKS

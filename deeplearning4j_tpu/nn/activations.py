@@ -36,6 +36,17 @@ def get(name):
     if callable(name):
         return name
     key = str(name).lower().replace("_", "")
+    # parametrized forms, e.g. "leakyrelu:0.1" (ref: ActivationLReLU(alpha))
+    if ":" in key:
+        base, arg = key.split(":", 1)
+        alpha = float(arg)
+        if base == "leakyrelu":
+            return lambda x: jax.nn.leaky_relu(x, alpha)
+        if base == "elu":
+            return lambda x: jax.nn.elu(x, alpha)
+        if base == "thresholdedrelu":
+            return lambda x: jnp.where(x > alpha, x, 0.0)
+        raise ValueError(f"Unknown parametrized activation: {name!r}")
     if key not in _ACTIVATIONS:
         raise ValueError(f"Unknown activation: {name!r} (have {sorted(_ACTIVATIONS)})")
     return _ACTIVATIONS[key]

@@ -158,7 +158,7 @@ class DenseLayer(Layer):
 
     def apply(self, params, x, training=False, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        if x.ndim > 2 and x.shape[-1] != self.n_in:
+        if x.ndim >= 4 or (x.ndim == 3 and x.shape[-1] != self.n_in):
             x = x.reshape(x.shape[0], -1)  # implicit CNN→FF flatten (ref: preprocessor)
         z = x @ params["W"]
         if self.has_bias:
@@ -175,7 +175,7 @@ class OutputLayer(DenseLayer):
     def loss(self, params, x, labels, mask=None, training=False, rng=None, state=None):
         """Score contribution. Uses the fused logits form when available."""
         x = self._maybe_dropout(x, training, rng)
-        if x.ndim > 2 and x.shape[-1] != self.n_in:
+        if x.ndim >= 4 or (x.ndim == 3 and x.shape[-1] != self.n_in):
             x = x.reshape(x.shape[0], -1)
         z = x @ params["W"]
         if self.has_bias:

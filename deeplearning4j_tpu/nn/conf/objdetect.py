@@ -1,0 +1,223 @@
+"""Object-detection output layer — YOLOv2 loss head.
+
+Reference: ``org.deeplearning4j.nn.layers.objdetect.Yolo2OutputLayer`` +
+``org.deeplearning4j.nn.conf.layers.objdetect.Yolo2OutputLayer`` (SURVEY D3).
+Label format follows the reference: per grid cell, 4 box values
+(x1,y1,x2,y2 in *grid-cell units*) + C class one-hot; a cell contains an
+object iff its class one-hot is non-zero. We carry labels NHWC:
+``(N, H, W, 4+C)`` (the reference is NCHW ``(N, 4+C, H, W)``).
+
+TPU-first: the whole loss — anchor responsibility assignment (argmax IoU
+over the B anchor priors), coord/confidence/class terms — is one fused,
+branch-free jax computation; no per-cell Java loops.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, register_layer
+
+
+def _box_iou_wh(wh1, wh2):
+    """IoU of two boxes that share a center; inputs broadcastable (..., 2)."""
+    inter = jnp.minimum(wh1[..., 0], wh2[..., 0]) * jnp.minimum(wh1[..., 1], wh2[..., 1])
+    a1 = wh1[..., 0] * wh1[..., 1]
+    a2 = wh2[..., 0] * wh2[..., 1]
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-9)
+
+
+def box_iou_xyxy(b1, b2):
+    """IoU of (...,4) boxes given as x1,y1,x2,y2."""
+    x1 = jnp.maximum(b1[..., 0], b2[..., 0])
+    y1 = jnp.maximum(b1[..., 1], b2[..., 1])
+    x2 = jnp.minimum(b1[..., 2], b2[..., 2])
+    y2 = jnp.minimum(b1[..., 3], b2[..., 3])
+    inter = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
+    a1 = jnp.maximum(b1[..., 2] - b1[..., 0], 0.0) * jnp.maximum(b1[..., 3] - b1[..., 1], 0.0)
+    a2 = jnp.maximum(b2[..., 2] - b2[..., 0], 0.0) * jnp.maximum(b2[..., 3] - b2[..., 1], 0.0)
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-9)
+
+
+@register_layer
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection loss (ref: layers.objdetect.Yolo2OutputLayer#computeScore).
+
+    ``boxes``: (B, 2) anchor priors (w, h) in grid-cell units.
+    Input activations: (N, H, W, B*(5+C)).
+    """
+    boxes: Optional[Sequence[Tuple[float, float]]] = None
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    def __post_init__(self):
+        if self.boxes is not None:
+            self.boxes = tuple(tuple(float(v) for v in b) for b in self.boxes)
+
+    @property
+    def n_boxes(self) -> int:
+        return len(self.boxes)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _split(self, x):
+        """(N,H,W,B*(5+C)) → tx,ty,tw,th,tc (N,H,W,B) each + logits (N,H,W,B,C)."""
+        n, h, w, d = x.shape
+        b = self.n_boxes
+        c = d // b - 5
+        x = x.reshape(n, h, w, b, 5 + c)
+        return x[..., 0], x[..., 1], x[..., 2], x[..., 3], x[..., 4], x[..., 5:]
+
+    def activate_detections(self, x):
+        """Decoded predictions: centers/sizes in grid units, obj conf, class probs.
+
+        Returns (xy (N,H,W,B,2), wh (N,H,W,B,2), conf (N,H,W,B), prob (N,H,W,B,C)).
+        Matches reference ``YoloUtils#activate`` decode: sigmoid on xy/conf,
+        exp(t)*anchor on wh, softmax on classes.
+        """
+        tx, ty, tw, th, tc, cls = self._split(x)
+        n, h, w, b = tx.shape
+        cy, cx = jnp.meshgrid(jnp.arange(h, dtype=x.dtype),
+                              jnp.arange(w, dtype=x.dtype), indexing="ij")
+        px = jax_sigmoid(tx) + cx[None, :, :, None]
+        py = jax_sigmoid(ty) + cy[None, :, :, None]
+        anchors = jnp.asarray(self.boxes, dtype=x.dtype)        # (B,2)
+        pw = jnp.exp(tw) * anchors[None, None, None, :, 0]
+        ph = jnp.exp(th) * anchors[None, None, None, :, 1]
+        conf = jax_sigmoid(tc)
+        prob = jnp.exp(cls - jnp.max(cls, axis=-1, keepdims=True))
+        prob = prob / jnp.sum(prob, axis=-1, keepdims=True)
+        return (jnp.stack([px, py], -1), jnp.stack([pw, ph], -1), conf, prob)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return x, state
+
+    def loss(self, params, x, labels, mask=None, training=False, rng=None, state=None):
+        tx, ty, tw, th, tc, cls = self._split(x)
+        n, h, w, b = tx.shape
+        lb = labels[..., :4]                                     # (N,H,W,4) x1,y1,x2,y2
+        lcls = labels[..., 4:]                                   # (N,H,W,C)
+        obj = (jnp.sum(lcls, axis=-1) > 0).astype(x.dtype)       # (N,H,W)
+
+        gt_w = lb[..., 2] - lb[..., 0]
+        gt_h = lb[..., 3] - lb[..., 1]
+        gt_cx = 0.5 * (lb[..., 0] + lb[..., 2])
+        gt_cy = 0.5 * (lb[..., 1] + lb[..., 3])
+
+        # responsible anchor per object cell: max IoU of (w,h) priors vs GT size
+        anchors = jnp.asarray(self.boxes, dtype=x.dtype)         # (B,2)
+        iou_prior = _box_iou_wh(anchors[None, None, None, :, :],
+                                jnp.stack([gt_w, gt_h], -1)[..., None, :])  # (N,H,W,B)
+        resp = jnp.argmax(iou_prior, axis=-1)                    # (N,H,W)
+        resp_1h = jax_one_hot(resp, b, x.dtype)                  # (N,H,W,B)
+        resp_mask = resp_1h * obj[..., None]
+
+        # decoded predictions (grid units)
+        xy, wh, conf, prob = self.activate_detections(x)
+        cy, cx = jnp.meshgrid(jnp.arange(h, dtype=x.dtype),
+                              jnp.arange(w, dtype=x.dtype), indexing="ij")
+
+        # coordinate loss on (sigmoid offsets, sqrt sizes) — ref uses sqrt(w),sqrt(h)
+        px_off = xy[..., 0] - cx[None, :, :, None]
+        py_off = xy[..., 1] - cy[None, :, :, None]
+        gx_off = (gt_cx - cx[None])[..., None]
+        gy_off = (gt_cy - cy[None])[..., None]
+        coord = (px_off - gx_off) ** 2 + (py_off - gy_off) ** 2
+        coord = coord + (jnp.sqrt(jnp.maximum(wh[..., 0], 1e-9))
+                         - jnp.sqrt(jnp.maximum(gt_w, 0.0))[..., None]) ** 2
+        coord = coord + (jnp.sqrt(jnp.maximum(wh[..., 1], 1e-9))
+                         - jnp.sqrt(jnp.maximum(gt_h, 0.0))[..., None]) ** 2
+        coord_loss = self.lambda_coord * jnp.sum(coord * resp_mask)
+
+        # confidence: target = IoU(pred, gt) for responsible anchors, 0 otherwise
+        pred_xyxy = jnp.concatenate([xy - wh / 2, xy + wh / 2], axis=-1)  # (N,H,W,B,4)
+        iou = box_iou_xyxy(pred_xyxy, lb[..., None, :])
+        conf_obj = jnp.sum(((conf - jax_stop_grad(iou)) ** 2) * resp_mask)
+        conf_noobj = self.lambda_no_obj * jnp.sum((conf ** 2) * (1.0 - resp_mask))
+
+        # class loss: squared error on softmax probs (ref default)
+        cls_loss = jnp.sum(((prob - lcls[..., None, :]) ** 2)
+                           * resp_mask[..., None])
+
+        total = coord_loss + conf_obj + conf_noobj + cls_loss
+        return total / n
+
+
+def jax_sigmoid(x):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+def jax_one_hot(idx, n, dtype):
+    return (idx[..., None] == jnp.arange(n)).astype(dtype)
+
+
+def jax_stop_grad(x):
+    import jax
+    return jax.lax.stop_gradient(x)
+
+
+# --------------------------------------------------------------- inference
+@dataclasses.dataclass
+class DetectedObject:
+    """ref: org.deeplearning4j.nn.layers.objdetect.DetectedObject."""
+    example: int
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    predicted_class: int
+    confidence: float
+
+    def top_left(self):
+        return (self.center_x - self.width / 2, self.center_y - self.height / 2)
+
+    def bottom_right(self):
+        return (self.center_x + self.width / 2, self.center_y + self.height / 2)
+
+
+def get_predicted_objects(layer: Yolo2OutputLayer, activations,
+                          threshold: float = 0.5):
+    """ref: YoloUtils#getPredictedObjects — decode + confidence filter."""
+    import numpy as np
+    xy, wh, conf, prob = (np.asarray(v) for v in
+                          layer.activate_detections(jnp.asarray(activations)))
+    score = conf[..., None] * prob                                # (N,H,W,B,C)
+    out = []
+    n, h, w, b = conf.shape
+    for ex in range(n):
+        idx = np.argwhere(conf[ex] > threshold)
+        for (i, j, k) in idx:
+            c = int(np.argmax(prob[ex, i, j, k]))
+            out.append(DetectedObject(ex, float(xy[ex, i, j, k, 0]),
+                                      float(xy[ex, i, j, k, 1]),
+                                      float(wh[ex, i, j, k, 0]),
+                                      float(wh[ex, i, j, k, 1]),
+                                      c, float(conf[ex, i, j, k])))
+    return out
+
+
+def non_max_suppression(objects, iou_threshold: float = 0.45):
+    """ref: YoloUtils#nms — greedy per-class NMS on DetectedObject list."""
+    import numpy as np
+    kept = []
+    by_key = {}
+    for o in objects:
+        by_key.setdefault((o.example, o.predicted_class), []).append(o)
+    for group in by_key.values():
+        group = sorted(group, key=lambda o: -o.confidence)
+        while group:
+            best = group.pop(0)
+            kept.append(best)
+            rest = []
+            bx = np.array([*best.top_left(), *best.bottom_right()])
+            for o in group:
+                ox = np.array([*o.top_left(), *o.bottom_right()])
+                if float(box_iou_xyxy(jnp.asarray(bx), jnp.asarray(ox))) < iou_threshold:
+                    rest.append(o)
+            group = rest
+    return kept

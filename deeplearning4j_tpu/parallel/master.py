@@ -147,15 +147,20 @@ def _rebatch(data, target: int):
     from deeplearning4j_tpu.data.dataset import DataSet
 
     buf_x, buf_y, n = [], [], 0
-    has_labels = True
+    has_labels = None
     for ds in data:
         x, y = ds.features, ds.labels
         if (isinstance(x, (tuple, list)) or ds.features_mask is not None
                 or getattr(ds, "labels_mask", None) is not None):
             yield ds  # masks/multi-input: don't re-split, preserve alignment
             continue
+        if has_labels is None:
+            has_labels = y is not None
+        elif has_labels != (y is not None):
+            raise ValueError(
+                "mixed labeled/unlabeled DataSets in one stream cannot be "
+                "re-batched without misaligning features and labels")
         buf_x.append(np.asarray(x))
-        has_labels = y is not None
         if has_labels:
             buf_y.append(np.asarray(y))
         n += buf_x[-1].shape[0]

@@ -1,0 +1,165 @@
+"""Zoo architecture tests (ref test analog: org.deeplearning4j.zoo.TestInstantiation).
+
+Each model is built at a reduced input resolution (the configs infer shapes
+from InputType) and run forward on a tiny batch; param counts are checked to
+be in the right ballpark for the full-size models.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import zoo
+
+
+def _forward(model, shape, n=2):
+    net = model.init_model()
+    x = np.random.RandomState(0).rand(n, *shape).astype("float32")
+    out = net.output(x) if hasattr(net, "network_inputs") or True else None
+    return net, out
+
+
+def test_lenet_mnist():
+    m = zoo.LeNet()
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(2, 28, 28, 1).astype("float32")
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    # ~431k params in the classic LeNet-20/50/500 shape
+    assert 400_000 < net.numParams() < 500_000
+
+
+def test_simple_cnn_forward():
+    m = zoo.SimpleCNN(num_classes=5, input_shape=(32, 32, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype("float32")
+    assert np.asarray(net.output(x)).shape == (2, 5)
+
+
+def test_alexnet_small_input():
+    m = zoo.AlexNet(num_classes=10, input_shape=(67, 67, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(1, 67, 67, 3).astype("float32")
+    assert np.asarray(net.output(x)).shape == (1, 10)
+
+
+def test_vgg16_param_count():
+    # full-size VGG16 has ~138M params
+    m = zoo.VGG16()
+    conf = m.conf()
+    n = sum(l.n_params() for l in conf.layers)
+    assert 130e6 < n < 145e6
+
+
+def test_vgg16_forward_small():
+    m = zoo.VGG16(num_classes=7, input_shape=(64, 64, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype("float32")
+    assert np.asarray(net.output(x)).shape == (1, 7)
+
+
+def test_vgg19_builds():
+    conf = zoo.VGG19(num_classes=10, input_shape=(64, 64, 3)).conf()
+    assert len(conf.layers) == len(zoo.VGG16(10, input_shape=(64, 64, 3)).conf().layers) + 3
+
+
+def test_resnet50_param_count_and_forward():
+    m = zoo.ResNet50()
+    conf = m.conf()
+    n = sum(nd.layer.n_params() for nd in conf.nodes.values()
+            if nd.layer is not None)
+    # reference ResNet50 ≈ 25.6M params
+    assert 24e6 < n < 27e6
+    small = zoo.ResNet50(num_classes=6, input_shape=(64, 64, 3))
+    net = small.init_model()
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype("float32")
+    assert np.asarray(net.output(x)).shape == (1, 6)
+
+
+def test_squeezenet_forward():
+    m = zoo.SqueezeNet(num_classes=9, input_shape=(96, 96, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(1, 96, 96, 3).astype("float32")
+    assert np.asarray(net.output(x)).shape == (1, 9)
+
+
+def test_darknet19_forward():
+    m = zoo.Darknet19(num_classes=11, input_shape=(64, 64, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype("float32")
+    assert np.asarray(net.output(x)).shape == (1, 11)
+
+
+def test_unet_forward():
+    m = zoo.UNet(input_shape=(64, 64, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype("float32")
+    out = np.asarray(net.output(x))
+    assert out.shape == (1, 64, 64, 1)
+    assert (out >= 0).all() and (out <= 1).all()
+
+
+def test_xception_builds():
+    conf = zoo.Xception(num_classes=10, input_shape=(128, 128, 3)).conf()
+    n = sum(nd.layer.n_params() for nd in conf.nodes.values()
+            if nd.layer is not None)
+    # reference Xception ≈ 22.9M params (at 1000 classes it's ~22.9M;
+    # at 10 classes the head shrinks)
+    assert 18e6 < n < 25e6
+
+
+def test_text_generation_lstm():
+    m = zoo.TextGenerationLSTM(total_unique_characters=30)
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(2, 7, 30).astype("float32")
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 7, 30)
+
+
+def test_tiny_yolo_forward_and_loss():
+    m = zoo.TinyYOLO(num_classes=3, input_shape=(64, 64, 3))
+    net = m.init_model()
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype("float32")
+    out = np.asarray(net.output(x))
+    # 64/32 = 2x2 grid, 5 anchors * (5+3) = 40 channels
+    assert out.shape == (1, 2, 2, 40)
+
+
+def test_yolo2_loss_decreases():
+    from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+    import jax, jax.numpy as jnp
+    layer = Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 2.0)))
+    layer.apply_global_defaults({})
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 4, 2 * 7).astype("float32"))
+    labels = np.zeros((2, 4, 4, 4 + 2), dtype="float32")
+    # one object in cell (1,2) of example 0, class 0
+    labels[0, 1, 2] = [2.2, 1.3, 2.8, 1.9, 1.0, 0.0]
+    labels = jnp.asarray(labels)
+    loss0 = float(layer.loss(None, x, labels))
+    assert np.isfinite(loss0) and loss0 > 0
+    # gradient descent on the activations should reduce the loss
+    g = jax.grad(lambda a: layer.loss(None, a, labels))
+    xa = x
+    for _ in range(50):
+        xa = xa - 0.1 * g(xa)
+    assert float(layer.loss(None, xa, labels)) < loss0 * 0.5
+
+
+def test_yolo_nms_and_decode():
+    from deeplearning4j_tpu.nn.conf import objdetect as od
+    layer = od.Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 2.0)))
+    x = np.zeros((1, 2, 2, 2 * 7), dtype="float32")
+    x[0, 0, 0, 4] = 5.0   # anchor 0 confident
+    x[0, 0, 0, 11] = 5.0  # anchor 1 confident, same cell → overlapping boxes
+    objs = od.get_predicted_objects(layer, x, threshold=0.5)
+    assert len(objs) == 2
+    kept = od.non_max_suppression(objs, iou_threshold=0.2)
+    assert len(kept) <= len(objs)
+
+
+def test_zoo_pretrained_raises_without_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_ZOO_CACHE", str(tmp_path))
+    m = zoo.LeNet()
+    assert not m.pretrained_available(zoo.PretrainedType.MNIST)
+    with pytest.raises(FileNotFoundError):
+        m.init_pretrained(zoo.PretrainedType.MNIST)
