@@ -871,10 +871,11 @@ class SameDiff:
             if epoch > 0 and hasattr(data, "reset"):
                 data.reset()
             for ph in batches():
-                # rebuild only when the *graph* changes (trainable set / loss
-                # set); batch-shape changes hit jax.jit's own signature cache
-                # and must NOT reset optimizer state
-                sig = (tuple(trainable), tuple(self._loss_variables))
+                # rebuild when the graph (trainable set / loss set) or the
+                # training config changes; batch-shape changes hit jax.jit's
+                # own signature cache and must NOT reset optimizer state
+                sig = (tuple(trainable), tuple(self._loss_variables),
+                       json.dumps(tc.to_dict(), sort_keys=True, default=str))
                 if self._train_step is None or self._train_sig != sig:
                     self._train_step, self._opt_state = self._build_train_step(sig)
                     self._train_sig = sig

@@ -1,0 +1,23 @@
+"""DataVec-equivalent ETL layer (ref: datavec/ modules, SURVEY E1-E3).
+
+Record readers produce lists of ``Writable`` values; ``TransformProcess``
+applies schema-typed column transforms; ``LocalTransformExecutor`` runs them;
+the image pipeline decodes/augments to NHWC arrays ready for the device.
+"""
+from deeplearning4j_tpu.datavec.writable import (
+    BooleanWritable, DoubleWritable, FloatWritable, IntWritable, LongWritable,
+    NDArrayWritable, Text, Writable)
+from deeplearning4j_tpu.datavec.schema import Schema
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.records import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    FileSplit, LineRecordReader, ListStringSplit, RecordReader)
+from deeplearning4j_tpu.datavec.local import LocalTransformExecutor
+
+__all__ = [
+    "Writable", "IntWritable", "LongWritable", "FloatWritable",
+    "DoubleWritable", "BooleanWritable", "Text", "NDArrayWritable",
+    "Schema", "TransformProcess", "RecordReader", "CSVRecordReader",
+    "LineRecordReader", "CollectionRecordReader", "CSVSequenceRecordReader",
+    "FileSplit", "ListStringSplit", "LocalTransformExecutor",
+]

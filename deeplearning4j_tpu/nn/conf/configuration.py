@@ -186,6 +186,16 @@ class MultiLayerConfiguration:
     grad_normalization: Optional[str] = None
     grad_norm_threshold: float = 1.0
 
+    def recompute_shapes(self):
+        """Re-run config-time shape inference after layer edits
+        (used by transfer learning's graph surgery)."""
+        input_type = self.input_type
+        for layer in self.layers:
+            layer.apply_global_defaults({})
+            if input_type is not None:
+                layer.set_n_in(input_type)
+                input_type = layer.output_type(input_type)
+
     def to_json(self) -> str:
         return json.dumps({
             "layers": [l.to_dict() for l in self.layers],

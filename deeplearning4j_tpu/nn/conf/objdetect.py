@@ -186,7 +186,6 @@ def get_predicted_objects(layer: Yolo2OutputLayer, activations,
     import numpy as np
     xy, wh, conf, prob = (np.asarray(v) for v in
                           layer.activate_detections(jnp.asarray(activations)))
-    score = conf[..., None] * prob                                # (N,H,W,B,C)
     out = []
     n, h, w, b = conf.shape
     for ex in range(n):

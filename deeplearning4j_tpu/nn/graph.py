@@ -181,14 +181,20 @@ class ComputationGraph:
         return total, new_states
 
     # ------------------------------------------------------------ train step
-    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3))
-    def _train_step(self, params, opt_state, states, inputs, labels, masks, label_masks, rng):
+    @functools.partial(jax.jit, static_argnums=(0, 9), donate_argnums=(1, 2, 3))
+    def _train_step(self, params, opt_state, states, inputs, labels, masks, label_masks, rng,
+                    frozen=frozenset()):
         (loss, new_states), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
             params, states, inputs, labels, masks, label_masks, rng)
-        if self._frozen:
-            grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in self._frozen else g)
+        if frozen:
+            grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in frozen else g)
                      for k, g in grads.items()}
         updates, opt_state = self._opt.update(grads, opt_state, params)
+        if frozen:
+            # zero the *updates* too: decoupled weight decay (e.g. adamw)
+            # contributes updates even with zero gradients
+            updates = {k: (jax.tree.map(jnp.zeros_like, u) if k in frozen else u)
+                       for k, u in updates.items()}
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_states, loss
 
@@ -224,7 +230,8 @@ class ComputationGraph:
         lmasks = tuple(jnp.asarray(_unwrap(m)) for m in lmasks if m is not None) or None
         self._key, rng = jax.random.split(self._key)
         self._params, self._opt_state, self._states, loss = self._train_step(
-            self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng)
+            self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng,
+            frozenset(self._frozen))
         self._score = float(loss)
         self._iteration += 1
         for lst in self._listeners:

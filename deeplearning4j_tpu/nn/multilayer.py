@@ -91,6 +91,7 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._listeners = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep carries
+        self._frozen: set = set()              # transfer-learning frozen layer idxs
         self._last_batch_size = 0
         self._key = jax.random.key(conf.seed)
         self._initialized = False
@@ -222,11 +223,20 @@ class MultiLayerNetwork:
         return loss, (new_states, new_carries)
 
     # ------------------------------------------------------------ train step
-    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3))
-    def _train_step(self, params, opt_state, states, x, labels, mask, label_mask, rng, carries):
+    @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(1, 2, 3))
+    def _train_step(self, params, opt_state, states, x, labels, mask, label_mask, rng, carries,
+                    frozen=frozenset()):
         (loss, (new_states, new_carries)), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(params, states, x, labels, mask, label_mask, rng, carries)
+        if frozen:
+            grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in frozen else g)
+                     for k, g in grads.items()}
         updates, opt_state = self._opt.update(grads, opt_state, params)
+        if frozen:
+            # zero the *updates* too: decoupled weight decay (e.g. adamw)
+            # contributes updates even with zero gradients
+            updates = {k: (jax.tree.map(jnp.zeros_like, u) if k in frozen else u)
+                       for k, u in updates.items()}
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_states, loss, new_carries
 
@@ -281,7 +291,8 @@ class MultiLayerNetwork:
         else:
             self._key, rng = jax.random.split(self._key)
             self._params, self._opt_state, self._states, loss, _ = self._train_step(
-                self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None)
+                self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None,
+                frozenset(self._frozen))
             self._score = float(loss)
             self._iteration += 1
             for lst in self._listeners:
@@ -303,7 +314,7 @@ class MultiLayerNetwork:
             self._key, rng = jax.random.split(self._key)
             self._params, self._opt_state, self._states, loss, carries = self._train_step(
                 self._params, self._opt_state, self._states, x_chunk, y_chunk, fm, lm, rng,
-                carries)
+                carries, frozenset(self._frozen))
             self._score = float(loss)
             self._iteration += 1
             for lst in self._listeners:

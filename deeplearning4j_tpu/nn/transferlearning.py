@@ -1,0 +1,226 @@
+"""Transfer learning: graph surgery on trained networks.
+
+Reference: ``org.deeplearning4j.nn.transferlearning.{TransferLearning,
+TransferLearningHelper,FineTuneConfiguration}`` (SURVEY D8).
+
+TPU-first: "freezing" is not a wrapper layer (the reference's FrozenLayer) —
+frozen layers simply have their gradients zeroed inside the jitted train
+step, so XLA dead-code-eliminates their whole backward sub-graph; the
+featurize path jit-compiles only the frozen prefix once.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Global overrides applied to every layer of the fine-tuned net
+    (ref: transferlearning.FineTuneConfiguration)."""
+    updater: object = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    seed: Optional[int] = None
+
+    def _apply_to_conf(self, conf):
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.seed is not None:
+            conf.seed = self.seed
+        for layer in getattr(conf, "layers", []) or []:
+            self._apply_to_layer(layer)
+        for node in getattr(conf, "nodes", {}).values():
+            if getattr(node, "layer", None) is not None:
+                self._apply_to_layer(node.layer)
+
+    def _apply_to_layer(self, layer: Layer):
+        for k in ("l1", "l2", "dropout", "activation"):
+            v = getattr(self, k)
+            if v is not None and hasattr(layer, k):
+                setattr(layer, k, v)
+
+
+class TransferLearning:
+    """ref: TransferLearning.Builder (MultiLayerNetwork) /
+    TransferLearning.GraphBuilder (ComputationGraph)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if not net._initialized:
+                raise ValueError("source network must be initialized")
+            self._src = net
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._nout_replace: Dict[int, tuple] = {}
+            self._remove_from: Optional[int] = None
+            self._appended: List[Layer] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        fineTuneConfiguration = fine_tune_configuration
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] inclusive (ref:
+            Builder#setFeatureExtractor)."""
+            self._freeze_until = layer_idx
+            return self
+
+        setFeatureExtractor = set_feature_extractor
+
+        def nout_replace(self, layer_idx: int, n_out: int,
+                         weight_init: str = "xavier"):
+            """Change a layer's output width, re-initializing it and the next
+            layer's inputs (ref: Builder#nOutReplace)."""
+            self._nout_replace[layer_idx] = (n_out, weight_init)
+            return self
+
+        nOutReplace = nout_replace
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        removeOutputLayer = remove_output_layer
+
+        def remove_layers_from_output(self, n: int):
+            self._remove_from = len(self._src.layers) - n
+            return self
+
+        removeLayersFromOutput = remove_layers_from_output
+
+        def add_layer(self, layer: Layer):
+            self._appended.append(layer)
+            return self
+
+        addLayer = add_layer
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._src
+            conf = MultiLayerConfiguration.from_json(src.conf.to_json())
+            layers = list(conf.layers)
+            keep = len(layers) if self._remove_from is None else self._remove_from
+            layers = layers[:keep] + list(self._appended)
+            reinit = set(range(keep, len(layers)))
+            # nOut replacement re-inits that layer and widens the next
+            for idx, (n_out, winit) in self._nout_replace.items():
+                layers[idx].n_out = n_out
+                layers[idx].weight_init = winit
+                reinit.add(idx)
+                if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                    layers[idx + 1].n_in = None  # re-infer
+                    reinit.add(idx + 1)
+            conf.layers = layers
+            if self._fine_tune is not None:
+                self._fine_tune._apply_to_conf(conf)
+            # re-run shape inference over the edited stack
+            conf.recompute_shapes()
+            new = MultiLayerNetwork(conf).init()
+            # copy weights for retained, un-reinitialized layers
+            for i in range(min(keep, len(layers))):
+                if i in reinit:
+                    continue
+                if str(i) in src._params and src._params[str(i)]:
+                    new._params[str(i)] = jax.tree.map(jnp.array,
+                                                       src._params[str(i)])
+                if str(i) in src._states:
+                    new._states[str(i)] = jax.tree.map(jnp.array,
+                                                       src._states[str(i)])
+            new._opt_state = new._opt.init(new._params)
+            if self._freeze_until is not None:
+                new._frozen = {str(i) for i in range(self._freeze_until + 1)}
+            return new
+
+    class GraphBuilder:
+        def __init__(self, graph: ComputationGraph):
+            if not graph._initialized:
+                raise ValueError("source graph must be initialized")
+            self._src = graph
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen: set = set()
+            self._reinit: set = set()
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        fineTuneConfiguration = fine_tune_configuration
+
+        def set_feature_extractor(self, *vertex_names: str):
+            """Freeze the named vertices and everything upstream of them
+            (ref: GraphBuilder#setFeatureExtractor)."""
+            conf = self._src.conf
+            # walk upstream
+            frontier = list(vertex_names)
+            while frontier:
+                name = frontier.pop()
+                if name in self._frozen or name in conf.network_inputs:
+                    continue
+                self._frozen.add(name)
+                node = conf.nodes.get(name)
+                if node is not None:
+                    frontier.extend(node.inputs)
+            return self
+
+        setFeatureExtractor = set_feature_extractor
+
+        def reinit_layer(self, *names: str):
+            self._reinit.update(names)
+            return self
+
+        def build(self) -> ComputationGraph:
+            from deeplearning4j_tpu.nn.graph_conf import (
+                ComputationGraphConfiguration)
+            src = self._src
+            conf = ComputationGraphConfiguration.from_json(src.conf.to_json())
+            if self._fine_tune is not None:
+                self._fine_tune._apply_to_conf(conf)
+            new = ComputationGraph(conf).init()
+            for name, p in src._params.items():
+                if name in self._reinit:
+                    continue
+                if p:
+                    new._params[name] = jax.tree.map(jnp.array, p)
+            for name, s in src._states.items():
+                if name not in self._reinit:
+                    new._states[name] = jax.tree.map(jnp.array, s)
+            new._opt_state = new._opt.init(new._params)
+            new._frozen = set(self._frozen)
+            return new
+
+
+class TransferLearningHelper:
+    """Featurize through the frozen prefix once, train only the head
+    (ref: transferlearning.TransferLearningHelper)."""
+
+    def __init__(self, net, frozen_until=None):
+        if isinstance(net, MultiLayerNetwork):
+            self.net = net
+            self.frozen_until = (frozen_until if frozen_until is not None
+                                 else max((int(i) for i in net._frozen),
+                                          default=-1))
+        else:
+            raise TypeError("TransferLearningHelper supports MultiLayerNetwork")
+
+    def featurize(self, dataset):
+        """Run inputs through the frozen prefix (ref: #featurize)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        x = jnp.asarray(dataset.features if hasattr(dataset, "features")
+                        else dataset)
+        acts = self.net.feedForward(x, train=False)
+        feat = acts[self.frozen_until + 1]
+        labels = getattr(dataset, "labels", None)
+        return DataSet(feat, labels)

@@ -112,3 +112,68 @@ class CollectScoresListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch, score):
         self.iterations.append(iteration)
         self.scores.append(float(score))
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic rotating checkpoints with a retention policy
+    (ref: org.deeplearning4j.optimize.listeners.CheckpointListener, SURVEY 5.4).
+
+    Saves ``checkpoint_<n>_<Model>.zip`` into ``directory`` every N
+    iterations / epochs / minutes, keeping the last ``keep_last`` (plus every
+    ``keep_every``-th) like the reference's builder options.
+    """
+
+    def __init__(self, directory, save_every_n_iterations=None,
+                 save_every_n_epochs=None, save_every_n_minutes=None,
+                 keep_last=3, keep_every=None):
+        import os
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_iters = save_every_n_iterations
+        self.every_epochs = save_every_n_epochs
+        self.every_secs = (save_every_n_minutes * 60.0
+                           if save_every_n_minutes else None)
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self._count = 0
+        self._saved = []          # [(count, path)]
+        self._last_time = None
+
+    def _save(self, model):
+        import os
+        self._count += 1
+        name = f"checkpoint_{self._count}_{type(model).__name__}.zip"
+        path = os.path.join(self.directory, name)
+        model.save(path)
+        self._saved.append((self._count, path))
+        # retention: keep last N + every keep_every-th
+        removable = self._saved[:-self.keep_last] if self.keep_last else []
+        for cnt, p in list(removable):
+            if self.keep_every and cnt % self.keep_every == 0:
+                continue
+            if os.path.exists(p):
+                os.remove(p)
+            self._saved.remove((cnt, p))
+
+    def iteration_done(self, model, iteration, epoch, score):
+        import time
+        if self.every_iters and iteration > 0 and \
+                iteration % self.every_iters == 0:
+            self._save(model)
+        if self.every_secs is not None:
+            now = time.time()
+            if self._last_time is None:
+                self._last_time = now
+            elif now - self._last_time >= self.every_secs:
+                self._save(model)
+                self._last_time = now
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
+            self._save(model)
+
+    def last_checkpoint(self):
+        return self._saved[-1][1] if self._saved else None
+
+    def available_checkpoints(self):
+        return [p for _, p in self._saved]

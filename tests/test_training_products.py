@@ -1,0 +1,164 @@
+"""Transfer learning (D8), early stopping (D14), CheckpointListener (5.4).
+
+Reference test analogs: org.deeplearning4j.nn.transferlearning.TransferLearning*Test,
+org.deeplearning4j.earlystopping.TestEarlyStopping.
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper)
+from deeplearning4j_tpu.optim.earlystopping import (
+    ClassificationScoreCalculator, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition)
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+def _net(seed=1, n_out=3):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Adam(1e-2)).weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(DenseLayer(n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=n_out, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()).init()
+
+
+def _toy_data(n=64, seed=0, classes=3):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4).astype("f4")
+    y = (X.sum(axis=1) * classes / 4).astype(int) % classes
+    return DataSet(X, np.eye(classes)[y].astype("f4"))
+
+
+def test_transfer_freeze_keeps_params():
+    src = _net()
+    ds = _toy_data()
+    new = (TransferLearning.Builder(src)
+           .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(1e-2)))
+           .set_feature_extractor(1)
+           .build())
+    w0_before = np.asarray(new._params["0"]["W"])
+    w2_before = np.asarray(new._params["2"]["W"])
+    new.fit(ds.features, ds.labels, epochs=3)
+    assert np.allclose(np.asarray(new._params["0"]["W"]), w0_before)
+    assert not np.allclose(np.asarray(new._params["2"]["W"]), w2_before)
+
+
+def test_transfer_copies_weights():
+    src = _net()
+    new = TransferLearning.Builder(src).set_feature_extractor(0).build()
+    assert np.allclose(np.asarray(new._params["1"]["W"]),
+                       np.asarray(src._params["1"]["W"]))
+
+
+def test_transfer_nout_replace_and_new_head():
+    src = _net(n_out=3)
+    new = (TransferLearning.Builder(src)
+           .set_feature_extractor(1)
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=5, activation="softmax",
+                                  loss_function="mcxent"))
+           .build())
+    out = np.asarray(new.output(np.random.rand(2, 4).astype("f4")))
+    assert out.shape == (2, 5)
+    # frozen trunk weights are the source's
+    assert np.allclose(np.asarray(new._params["0"]["W"]),
+                       np.asarray(src._params["0"]["W"]))
+
+
+def test_transfer_helper_featurize():
+    src = _net()
+    src._frozen = {"0"}
+    helper = TransferLearningHelper(src)
+    ds = _toy_data(8)
+    feat = helper.featurize(ds)
+    assert np.asarray(feat.features).shape == (8, 8)
+
+
+def test_early_stopping_max_epochs(tmp_path):
+    net = _net()
+    train = _toy_data(64, seed=0)
+    val = [_toy_data(32, seed=1)]
+    conf = (EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+            .model_saver(InMemoryModelSaver())
+            .build())
+    res = EarlyStoppingTrainer(conf, net, [train]).fit()
+    assert res.total_epochs <= 4
+    assert res.best_model is not None
+    assert res.best_model_score is not None
+    # best model scores on validation at least as well as when started
+    assert res.best_model_score <= max(res.score_vs_epoch.values()) + 1e-9
+
+
+def test_early_stopping_patience_stops_early():
+    net = _net()
+    train = _toy_data(32)
+    val = [_toy_data(32, seed=2)]
+    conf = (EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val))
+            .epoch_termination_conditions(
+                MaxEpochsTerminationCondition(100),
+                ScoreImprovementEpochTerminationCondition(2, 1e9))
+            .build())
+    res = EarlyStoppingTrainer(conf, net, [train]).fit()
+    # improvement threshold 1e9 is unreachable → stop after patience+1 evals
+    assert res.total_epochs <= 4
+
+
+def test_early_stopping_score_explosion():
+    net = _net()
+    conf = (EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator([_toy_data(16)]))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+            .iteration_termination_conditions(
+                MaxScoreIterationTerminationCondition(1e-12))
+            .build())
+    res = EarlyStoppingTrainer(conf, net, [_toy_data(32)]).fit()
+    assert res.termination_reason == "IterationTerminationCondition"
+    assert res.total_epochs == 1
+
+
+def test_early_stopping_local_file_saver(tmp_path):
+    net = _net()
+    conf = (EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator([_toy_data(16, seed=3)]))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(2))
+            .model_saver(LocalFileModelSaver(str(tmp_path)))
+            .build())
+    res = EarlyStoppingTrainer(conf, net, [_toy_data(32)]).fit()
+    assert os.path.exists(os.path.join(str(tmp_path), "bestModel.bin"))
+    best = res.get_best_model()
+    out = np.asarray(best.output(np.random.rand(2, 4).astype("f4")))
+    assert out.shape == (2, 3)
+
+
+def test_checkpoint_listener_rotation(tmp_path):
+    from deeplearning4j_tpu.optim.listeners import CheckpointListener
+    net = _net()
+    cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                            keep_last=2)
+    net.setListeners(cl)
+    ds = _toy_data(32)
+    net.fit([ds] * 4, epochs=3)   # 12 iterations → 6 saves, keep last 2
+    files = glob.glob(os.path.join(str(tmp_path), "checkpoint_*.zip"))
+    assert len(files) == 2
+    assert cl.last_checkpoint() in files
+    restored = MultiLayerNetwork.load(cl.last_checkpoint())
+    assert restored.numParams() == net.numParams()

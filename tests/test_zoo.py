@@ -10,13 +10,6 @@ import pytest
 from deeplearning4j_tpu.models import zoo
 
 
-def _forward(model, shape, n=2):
-    net = model.init_model()
-    x = np.random.RandomState(0).rand(n, *shape).astype("float32")
-    out = net.output(x) if hasattr(net, "network_inputs") or True else None
-    return net, out
-
-
 def test_lenet_mnist():
     m = zoo.LeNet()
     net = m.init_model()
