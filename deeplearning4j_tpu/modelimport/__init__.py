@@ -1,0 +1,5 @@
+"""Model import (ref: deeplearning4j-modelimport + samediff-import,
+SURVEY D12/J8)."""
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+__all__ = ["KerasModelImport"]

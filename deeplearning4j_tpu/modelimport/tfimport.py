@@ -1,0 +1,377 @@
+"""TensorFlow GraphDef import into the SameDiff-equivalent graph engine.
+
+Reference: ``nd4j/samediff-import/samediff-import-tensorflow`` (Kotlin
+``OpMappingRegistry``/``ImportGraph``) and the older
+``org.nd4j.imports.graphmapper.tf.TFGraphMapper`` (SURVEY J8).
+
+Same architecture as the reference: a per-op mapping-rule registry walks the
+GraphDef topologically, turning each node into graph-engine ops. Structural
+inputs (axes, shapes, perms, paddings) are constant-folded at import time —
+the reference does the same through its "input frameworks" attribute
+resolution. The imported graph then executes as ONE jitted XLA program
+(where the reference interprets op-by-op through the JNI executioner).
+
+Protobuf parsing uses the tensorflow pip package's generated proto classes
+only (no session/runtime); import fails with a clear message without it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+_RULES: Dict[str, Callable] = {}
+
+
+def mapping_rule(*op_types):
+    """ref: OpMappingRegistry rule registration."""
+    def deco(fn):
+        for t in op_types:
+            _RULES[t] = fn
+        return fn
+    return deco
+
+
+class TFImportError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------------ attrs
+def _parse_attrs(node) -> dict:
+    out = {}
+    for k, v in node.attr.items():
+        field = v.WhichOneof("value")
+        if field == "b":
+            out[k] = v.b
+        elif field == "i":
+            out[k] = int(v.i)
+        elif field == "f":
+            out[k] = float(v.f)
+        elif field == "s":
+            out[k] = v.s.decode("utf-8", "ignore")
+        elif field == "type":
+            out[k] = int(v.type)
+        elif field == "shape":
+            out[k] = [d.size for d in v.shape.dim]
+        elif field == "list":
+            lv = v.list
+            if lv.i:
+                out[k] = [int(x) for x in lv.i]
+            elif lv.f:
+                out[k] = [float(x) for x in lv.f]
+            elif lv.s:
+                out[k] = [x.decode() for x in lv.s]
+            elif lv.b:
+                out[k] = list(lv.b)
+            else:
+                out[k] = []
+        elif field == "tensor":
+            out[k] = v.tensor
+    return out
+
+
+_TF_DTYPES = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+              5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
+              14: np.dtype("bfloat16") if hasattr(np, "bfloat16") else np.float32,
+              19: np.float16}
+
+
+def _dtype_of(enum: int):
+    try:
+        import ml_dtypes
+        if enum == 14:
+            return np.dtype(ml_dtypes.bfloat16)
+    except ImportError:
+        pass
+    if enum in _TF_DTYPES:
+        return np.dtype(_TF_DTYPES[enum])
+    raise TFImportError(f"Unsupported TF dtype enum {enum}")
+
+
+def _tensor_to_ndarray(tensor_proto) -> np.ndarray:
+    """TensorProto → numpy without the TF runtime."""
+    dtype = _dtype_of(int(tensor_proto.dtype))
+    shape = [d.size for d in tensor_proto.tensor_shape.dim]
+    if tensor_proto.tensor_content:
+        return np.frombuffer(tensor_proto.tensor_content,
+                             dtype=dtype).reshape(shape).copy()
+    for field in ("float_val", "double_val", "int_val", "int64_val",
+                  "bool_val", "half_val"):
+        vals = list(getattr(tensor_proto, field, []))
+        if vals:
+            arr = np.asarray(vals, dtype=dtype)
+            n = int(np.prod(shape)) if shape else 1
+            if arr.size == 1 and n > 1:
+                arr = np.full(shape, arr[0], dtype=dtype)
+            return arr.reshape(shape)
+    return np.zeros(shape, dtype=dtype)
+
+
+# ------------------------------------------------------------------ mapper
+class _ImportCtx:
+    def __init__(self, sd: SameDiff):
+        self.sd = sd
+        self.vars: Dict[str, SDVariable] = {}     # tf tensor name -> SDVariable
+        self.consts: Dict[str, np.ndarray] = {}   # tf node name -> numpy
+
+    def const_value(self, ref: str) -> np.ndarray:
+        name = ref.split(":")[0]
+        if name not in self.consts:
+            raise TFImportError(
+                f"op input {ref!r} must be a constant for import "
+                f"(structural argument)")
+        return self.consts[name]
+
+
+def _pool_args(attrs):
+    k = attrs.get("ksize", [1, 1, 1, 1])
+    s = attrs.get("strides", [1, 1, 1, 1])
+    if attrs.get("data_format", "NHWC") != "NHWC":
+        raise TFImportError("only NHWC supported")
+    return tuple(k[1:3]), tuple(s[1:3]), attrs.get("padding", "VALID")
+
+
+def _register_default_rules():
+    E = lambda ctx, name, *a, **kw: ctx.sd._op(name, *a, **kw)
+
+    @mapping_rule("Placeholder", "PlaceholderWithDefault")
+    def _ph(ctx, node, inputs, attrs):
+        shape = attrs.get("shape")
+        shape = tuple(None if d in (-1, 0) and i == 0 else (None if d == -1 else d)
+                      for i, d in enumerate(shape or ())) or None
+        dt = _dtype_of(attrs.get("dtype", 1))
+        return ctx.sd.placeholder(node.name, shape, dt)
+
+    @mapping_rule("Const")
+    def _const(ctx, node, inputs, attrs):
+        arr = _tensor_to_ndarray(attrs["value"])
+        ctx.consts[node.name] = arr
+        return ctx.sd.constant(arr, name=node.name)
+
+    @mapping_rule("Identity", "StopGradient", "PreventGradient", "Snapshot")
+    def _ident(ctx, node, inputs, attrs):
+        # emit a real identity op so the TF node name stays addressable as a
+        # graph output (XLA elides it at compile time)
+        return ctx.sd._op("Identity", inputs[0])
+
+    # elementwise binaries/unaries ride the registry's TF aliases directly
+    _PASSTHRU = [
+        "Add", "AddV2", "Sub", "Mul", "RealDiv", "Maximum", "Minimum",
+        "SquaredDifference", "Pow", "Neg", "FloorDiv", "FloorMod",
+        "Relu", "Relu6", "Elu", "Selu", "Sigmoid", "Tanh", "Softplus",
+        "Softsign", "Gelu",
+    ]
+    for op in _PASSTHRU:
+        @mapping_rule(op)
+        def _ew(ctx, node, inputs, attrs, _op=op):
+            alias = {"AddV2": "Add"}.get(_op, _op)
+            return ctx.sd._op(alias, *inputs)
+
+    for op, fn in [("Sqrt", "sqrt"), ("Rsqrt", "rsqrt"), ("Exp", "exp"),
+                   ("Log", "log"), ("Abs", "abs"), ("Square", "square"),
+                   ("Sign", "sign"), ("Floor", "floor"), ("Ceil", "ceil"),
+                   ("Round", "round"), ("Erf", "erf")]:
+        @mapping_rule(op)
+        def _un(ctx, node, inputs, attrs, _fn=fn):
+            return ctx.sd._op(_fn, inputs[0])
+
+    @mapping_rule("LeakyRelu")
+    def _leaky(ctx, node, inputs, attrs):
+        return ctx.sd._op("LeakyRelu", inputs[0],
+                          alpha=attrs.get("alpha", 0.2))
+
+    @mapping_rule("MatMul", "BatchMatMul", "BatchMatMulV2")
+    def _mm(ctx, node, inputs, attrs):
+        return ctx.sd._op("MatMul", inputs[0], inputs[1],
+                          transpose_a=attrs.get("transpose_a",
+                                                attrs.get("adj_x", False)),
+                          transpose_b=attrs.get("transpose_b",
+                                                attrs.get("adj_y", False)))
+
+    @mapping_rule("BiasAdd")
+    def _bias(ctx, node, inputs, attrs):
+        if attrs.get("data_format", "NHWC") != "NHWC":
+            raise TFImportError("BiasAdd: only NHWC supported")
+        return ctx.sd._op("Add", inputs[0], inputs[1])
+
+    @mapping_rule("Softmax", "LogSoftmax")
+    def _sm(ctx, node, inputs, attrs):
+        return ctx.sd._op(node.op, inputs[0])
+
+    @mapping_rule("Mean", "Sum", "Max", "Min", "Prod")
+    def _red(ctx, node, inputs, attrs):
+        axis = ctx.const_value(node.input[1])
+        axis = tuple(int(a) for a in np.atleast_1d(axis))
+        return ctx.sd._op(node.op, inputs[0], axis=axis,
+                          keepdims=attrs.get("keep_dims", False))
+
+    @mapping_rule("ArgMax", "ArgMin")
+    def _arg(ctx, node, inputs, attrs):
+        axis = int(ctx.const_value(node.input[1])) if len(node.input) > 1 else -1
+        return ctx.sd._op(node.op, inputs[0], axis=axis)
+
+    @mapping_rule("Reshape")
+    def _reshape(ctx, node, inputs, attrs):
+        shape = [int(s) for s in ctx.const_value(node.input[1])]
+        return ctx.sd._op("Reshape", inputs[0], shape=shape)
+
+    @mapping_rule("Transpose")
+    def _transpose(ctx, node, inputs, attrs):
+        perm = [int(p) for p in ctx.const_value(node.input[1])]
+        return ctx.sd._op("Transpose", inputs[0], perm=perm)
+
+    @mapping_rule("Squeeze")
+    def _squeeze(ctx, node, inputs, attrs):
+        dims = attrs.get("squeeze_dims") or None
+        return ctx.sd._op("Squeeze", inputs[0],
+                          axis=list(dims) if dims else None)
+
+    @mapping_rule("ExpandDims")
+    def _expand(ctx, node, inputs, attrs):
+        axis = int(ctx.const_value(node.input[1]))
+        return ctx.sd._op("ExpandDims", inputs[0], axis=axis)
+
+    @mapping_rule("ConcatV2", "Concat")
+    def _concat(ctx, node, inputs, attrs):
+        axis = int(ctx.const_value(node.input[-1]))
+        return ctx.sd._op("Concat", *inputs[:-1], axis=axis)
+
+    @mapping_rule("Pack")
+    def _pack(ctx, node, inputs, attrs):
+        return ctx.sd._op("Stack", *inputs, axis=attrs.get("axis", 0))
+
+    @mapping_rule("Pad", "PadV2")
+    def _pad(ctx, node, inputs, attrs):
+        pads = [[int(v) for v in row]
+                for row in ctx.const_value(node.input[1])]
+        return ctx.sd._op("Pad", inputs[0], paddings=pads)
+
+    @mapping_rule("Cast")
+    def _cast(ctx, node, inputs, attrs):
+        return ctx.sd._op("Cast", inputs[0],
+                          dtype=_dtype_of(attrs["DstT"]).name)
+
+    @mapping_rule("Conv2D")
+    def _conv(ctx, node, inputs, attrs):
+        if attrs.get("data_format", "NHWC") != "NHWC":
+            raise TFImportError("Conv2D: only NHWC supported")
+        strides = tuple(attrs.get("strides", [1, 1, 1, 1])[1:3])
+        dil = tuple(attrs.get("dilations", [1, 1, 1, 1])[1:3])
+        return ctx.sd._op("conv2d", inputs[0], inputs[1],
+                          strides=strides, padding=attrs.get("padding", "SAME"),
+                          dilation=dil)
+
+    @mapping_rule("DepthwiseConv2dNative")
+    def _dwconv(ctx, node, inputs, attrs):
+        strides = tuple(attrs.get("strides", [1, 1, 1, 1])[1:3])
+        return ctx.sd._op("DepthwiseConv2dNative", inputs[0], inputs[1],
+                          strides=strides,
+                          padding=attrs.get("padding", "SAME"))
+
+    @mapping_rule("MaxPool", "MaxPoolV2")
+    def _maxpool(ctx, node, inputs, attrs):
+        k, s, p = _pool_args(attrs)
+        return ctx.sd._op("MaxPool", inputs[0], kernel=k, strides=s, padding=p)
+
+    @mapping_rule("AvgPool")
+    def _avgpool(ctx, node, inputs, attrs):
+        k, s, p = _pool_args(attrs)
+        return ctx.sd._op("AvgPool", inputs[0], kernel=k, strides=s, padding=p)
+
+    @mapping_rule("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+    def _fbn(ctx, node, inputs, attrs):
+        if attrs.get("is_training", True) and len(node.input) >= 5:
+            # inference import of a graph exported in training mode still
+            # carries moving stats as inputs 3/4 — use them
+            pass
+        x, scale, offset, mean, var = inputs[:5]
+        return ctx.sd._op("batchnorm", x, mean, var, scale, offset,
+                          epsilon=attrs.get("epsilon", 1e-3))
+
+    @mapping_rule("StridedSlice")
+    def _ss(ctx, node, inputs, attrs):
+        begin = [int(v) for v in ctx.const_value(node.input[1])]
+        end = [int(v) for v in ctx.const_value(node.input[2])]
+        strides = [int(v) for v in ctx.const_value(node.input[3])]
+        for m in ("ellipsis_mask", "new_axis_mask"):
+            if attrs.get(m, 0):
+                raise TFImportError(f"StridedSlice {m} unsupported")
+        bm = attrs.get("begin_mask", 0)
+        em = attrs.get("end_mask", 0)
+        sm = attrs.get("shrink_axis_mask", 0)
+        for i in range(len(begin)):
+            if bm & (1 << i):
+                begin[i] = 0
+            if em & (1 << i):
+                end[i] = 2**31 - 1
+        out = ctx.sd._op("StridedSlice", inputs[0], begin=begin, end=end,
+                         strides=strides)
+        shrink = [i for i in range(len(begin)) if sm & (1 << i)]
+        if shrink:
+            out = ctx.sd._op("Squeeze", out, axis=shrink)
+        return out
+
+
+_register_default_rules()
+
+
+class TFGraphMapper:
+    """ref: TFGraphMapper#importGraph — GraphDef → SameDiff."""
+
+    @staticmethod
+    def import_graph(graph_def, ignore_nodes=()) -> SameDiff:
+        gd = _as_graph_def(graph_def)
+        sd = SameDiff.create()
+        ctx = _ImportCtx(sd)
+        skip = set(ignore_nodes)
+        for node in gd.node:
+            if node.name in skip or node.op == "NoOp":
+                continue
+            rule = _RULES.get(node.op)
+            if rule is None:
+                raise TFImportError(
+                    f"No mapping rule for TF op {node.op!r} (node "
+                    f"{node.name!r}); register one with "
+                    f"@tfimport.mapping_rule({node.op!r})")
+            inputs = []
+            for ref in node.input:
+                if ref.startswith("^"):      # control edge — execution order
+                    continue                 # is given by topo order already
+                key = ref if ":" in ref else ref + ":0"
+                if key not in ctx.vars:
+                    raise TFImportError(
+                        f"node {node.name!r} consumes unknown tensor {ref!r} "
+                        f"(GraphDef not topologically ordered?)")
+                inputs.append(ctx.vars[key])
+            attrs = _parse_attrs(node)
+            out = rule(ctx, node, inputs, attrs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for i, o in enumerate(outs):
+                ctx.vars[f"{node.name}:{i}"] = o
+            # canonical graph name: rename single-output ops to the tf name
+            if len(outs) == 1 and outs[0].name != node.name \
+                    and node.name not in ctx.sd._vars:
+                outs[0].rename(node.name)
+        return sd
+
+    importGraph = import_graph
+
+
+def _as_graph_def(graph_def):
+    if hasattr(graph_def, "node"):
+        return graph_def
+    try:
+        from tensorflow.core.framework import graph_pb2
+    except ImportError as e:
+        raise TFImportError(
+            "TF GraphDef parsing needs the tensorflow protos "
+            "(pip tensorflow)") from e
+    gd = graph_pb2.GraphDef()
+    if isinstance(graph_def, (str, bytes)) and not isinstance(graph_def, bytes):
+        with open(graph_def, "rb") as f:
+            gd.ParseFromString(f.read())
+    else:
+        gd.ParseFromString(graph_def)
+    return gd

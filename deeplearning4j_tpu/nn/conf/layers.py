@@ -707,21 +707,33 @@ class GravesLSTM(LSTM):
 @register_layer
 @dataclasses.dataclass
 class GRU(_RnnBase):
-    """(ref: conf.layers.GRU — upstream has GRU via SameDiff/gruCell op)."""
+    """(ref: conf.layers.GRU — upstream has GRU via SameDiff/gruCell op).
+
+    Gate order (r, u, n); the reset gate applies *after* the recurrent
+    matmul (CuDNN/Keras ``reset_after=True`` formulation — one fused MXU
+    matmul per step). ``recurrent_bias`` adds the separate recurrent bias
+    of that formulation (used by Keras import)."""
+    recurrent_bias: bool = False
 
     def param_shapes(self):
-        return {"W": (self.n_in, 3 * self.n_out),
-                "RW": (self.n_out, 3 * self.n_out),
-                "b": (3 * self.n_out,)}
+        shapes = {"W": (self.n_in, 3 * self.n_out),
+                  "RW": (self.n_out, 3 * self.n_out),
+                  "b": (3 * self.n_out,)}
+        if self.recurrent_bias:
+            shapes["bR"] = (3 * self.n_out,)
+        return shapes
 
     def init_params(self, key):
         k1, k2 = jax.random.split(key)
         h = self.n_out
-        return {
+        p = {
             "W": _winit.init(self.weight_init, k1, (self.n_in, 3 * h), self.n_in, h),
             "RW": _winit.init(self.weight_init, k2, (h, 3 * h), h, h),
             "b": jnp.zeros((3 * h,)),
         }
+        if self.recurrent_bias:
+            p["bR"] = jnp.zeros((3 * h,))
+        return p
 
     def initial_carry(self, batch: int):
         return (jnp.zeros((batch, self.n_out)),)
@@ -731,6 +743,8 @@ class GRU(_RnnBase):
         hn = self.n_out
         zx = x_t @ params["W"] + params["b"]
         zh = h_prev @ params["RW"]
+        if self.recurrent_bias:
+            zh = zh + params["bR"]
         r = jax.nn.sigmoid(zx[..., :hn] + zh[..., :hn])
         u = jax.nn.sigmoid(zx[..., hn:2 * hn] + zh[..., hn:2 * hn])
         n = self._act(zx[..., 2 * hn:] + r * zh[..., 2 * hn:])

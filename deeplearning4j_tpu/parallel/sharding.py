@@ -24,7 +24,7 @@ def param_pspec(pname: str, ndim: int, model_axis: str = MODEL_AXIS) -> P:
       put a collective inside the scan body; deliberately avoided)
     - biases matching a sharded out-dim → sharded to stay aligned
     """
-    if pname.startswith(("RW", "gamma", "beta", "mean", "var", "p")):
+    if pname.startswith(("RW", "bR", "gamma", "beta", "mean", "var", "p")):
         return P()
     if ndim == 2:
         return P(None, model_axis)

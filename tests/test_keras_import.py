@@ -1,0 +1,132 @@
+"""Keras H5 import e2e (ref analog:
+org.deeplearning4j.nn.modelimport.keras.e2e.KerasModelEndToEndTest —
+build in Keras, save h5, import, compare outputs numerically)."""
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport
+
+
+def _save(model, tmp_path, name="m.h5"):
+    p = os.path.join(str(tmp_path), name)
+    model.save(p)
+    return p
+
+
+def test_sequential_dense(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((6,)),
+        tf.keras.layers.Dense(12, activation="relu"),
+        tf.keras.layers.Dense(4, activation="softmax"),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(0).rand(5, 6).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_sequential_cnn_with_bn(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((12, 12, 3)),
+        tf.keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        tf.keras.layers.BatchNormalization(),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Conv2D(4, 3, padding="valid"),
+        tf.keras.layers.Activation("relu"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(5, activation="softmax"),
+    ])
+    # burn in some non-trivial BN statistics
+    m.compile("adam", "categorical_crossentropy")
+    rng = np.random.RandomState(1)
+    m.fit(rng.rand(32, 12, 12, 3), np.eye(5)[rng.randint(0, 5, 32)],
+          epochs=1, verbose=0)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = rng.rand(3, 12, 12, 3).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+def test_sequential_separable_conv(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((10, 10, 3)),
+        tf.keras.layers.SeparableConv2D(6, 3, padding="same",
+                                        activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(2),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(2).rand(2, 10, 10, 3).astype("f4")
+    assert np.allclose(np.asarray(net.output(x)), m.predict(x, verbose=0),
+                       atol=1e-5)
+
+
+def test_sequential_lstm(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((7, 5)),
+        tf.keras.layers.LSTM(9, return_sequences=True),
+        tf.keras.layers.LSTM(4, return_sequences=False),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(3).rand(2, 7, 5).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+def test_sequential_gru(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((6, 4)),
+        tf.keras.layers.GRU(8, return_sequences=False),
+        tf.keras.layers.Dense(2),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(4).rand(2, 6, 4).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+def test_functional_model_with_add_and_concat(tmp_path):
+    inp = tf.keras.Input((8,))
+    a = tf.keras.layers.Dense(16, activation="relu", name="branch_a")(inp)
+    b = tf.keras.layers.Dense(16, activation="tanh", name="branch_b")(inp)
+    added = tf.keras.layers.Add(name="added")([a, b])
+    cat = tf.keras.layers.Concatenate(name="cat")([a, added])
+    out = tf.keras.layers.Dense(3, activation="softmax", name="out")(cat)
+    model = tf.keras.Model(inp, out)
+    net = KerasModelImport.import_keras_model_and_weights(
+        _save(model, tmp_path))
+    x = np.random.RandomState(5).rand(4, 8).astype("f4")
+    expected = model.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_imported_model_is_trainable(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((4,)),
+        tf.keras.layers.Dense(8, activation="relu"),
+        tf.keras.layers.Dense(2, activation="softmax"),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 4).astype("f4")
+    Y = np.eye(2)[(X.sum(1) > 2).astype(int)].astype("f4")
+    from deeplearning4j_tpu.data.dataset import DataSet
+    s0 = net.score(DataSet(X, Y))
+    net.fit(X, Y, epochs=20)
+    assert net.score(DataSet(X, Y)) < s0

@@ -1,0 +1,146 @@
+"""TF GraphDef import conformance (ref analog:
+org.nd4j.imports.TFGraphs.TFGraphTestAllSameDiff — golden graphs built with
+TF, replayed through import and compared numerically against TF's output)."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tfimport import (TFGraphMapper,
+                                                     TFImportError)
+
+
+def _graph_def(fn, input_specs):
+    """Trace a python fn into a frozen GraphDef with placeholders."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    cf = tf.function(fn).get_concrete_function(
+        *[tf.TensorSpec(s, tf.float32, name=n) for n, s in input_specs])
+    frozen = convert_variables_to_constants_v2(cf)
+    return frozen.graph.as_graph_def(), frozen
+
+
+def _check(fn, feeds, out_index=0, atol=1e-5):
+    specs = [(k, v.shape) for k, v in feeds.items()]
+    gd, frozen = _graph_def(fn, specs)
+    expected = frozen(**{k: tf.constant(v) for k, v in feeds.items()})
+    expected = [np.asarray(t) for t in (
+        expected if isinstance(expected, (list, tuple)) else [expected])]
+    sd = TFGraphMapper.import_graph(gd)
+    out_name = frozen.graph.get_operations()[-1].name
+    # frozen funcs end with Identity outputs; find their producer names
+    outputs = [op.name for op in frozen.graph.get_operations()
+               if op.type == "Identity" and not op.name.startswith("^")]
+    got = sd.output(feeds, outputs[-1] if outputs else out_name)
+    got_arr = list(got.values())[0]
+    assert np.allclose(got_arr, expected[out_index], atol=atol), \
+        np.abs(np.asarray(got_arr) - expected[out_index]).max()
+    return sd
+
+
+def test_mlp_graph():
+    w1 = tf.constant(np.random.RandomState(0).randn(6, 8).astype("f4"))
+    b1 = tf.constant(np.zeros(8, "f4"))
+    w2 = tf.constant(np.random.RandomState(1).randn(8, 3).astype("f4"))
+
+    def fn(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.matmul(h, w2))
+
+    x = np.random.RandomState(2).rand(4, 6).astype("f4")
+    _check(fn, {"x": x})
+
+
+def test_conv_pool_graph():
+    k = tf.constant(np.random.RandomState(0).randn(3, 3, 2, 4).astype("f4") * 0.1)
+
+    def fn(x):
+        y = tf.nn.conv2d(x, k, strides=1, padding="SAME")
+        y = tf.nn.relu(y)
+        y = tf.nn.max_pool2d(y, 2, 2, "VALID")
+        return tf.reduce_mean(y, axis=[1, 2])
+
+    x = np.random.RandomState(1).rand(2, 8, 8, 2).astype("f4")
+    _check(fn, {"x": x})
+
+
+def test_elementwise_and_reshape():
+    def fn(x):
+        y = tf.reshape(x, [-1, 12])
+        y = tf.transpose(y)             # (12, N)
+        y = tf.square(y) - tf.exp(y * 0.1)
+        return tf.reduce_sum(y, axis=0, keepdims=True)
+
+    x = np.random.RandomState(3).rand(3, 4, 3).astype("f4")
+    _check(fn, {"x": x}, atol=1e-4)
+
+
+def test_concat_pad_slice():
+    def fn(a, b):
+        c = tf.concat([a, b], axis=1)
+        c = tf.pad(c, [[0, 0], [1, 1]])
+        return c[:, 1:-1]
+
+    a = np.random.RandomState(4).rand(2, 3).astype("f4")
+    b = np.random.RandomState(5).rand(2, 2).astype("f4")
+    _check(fn, {"a": a, "b": b})
+
+
+def test_batchnorm_inference_graph():
+    g = tf.constant(np.random.RandomState(0).rand(5).astype("f4") + 0.5)
+    be = tf.constant(np.random.RandomState(1).randn(5).astype("f4"))
+    mu = tf.constant(np.random.RandomState(2).randn(5).astype("f4"))
+    var = tf.constant(np.random.RandomState(3).rand(5).astype("f4") + 0.5)
+
+    def fn(x):
+        return tf.nn.batch_normalization(x, mu, var, be, g, 1e-3)
+
+    x = np.random.RandomState(6).rand(4, 5).astype("f4")
+    _check(fn, {"x": x}, atol=1e-4)
+
+
+def test_unknown_op_raises_with_rule_hint():
+    gd, _ = _graph_def(lambda x: tf.raw_ops.Atan(x=x), [("x", (2,))])
+    with pytest.raises(TFImportError, match="mapping rule"):
+        TFGraphMapper.import_graph(gd)
+
+
+def test_imported_graph_is_trainable():
+    """Import, mark a constant trainable, fine-tune — the BERT-path shape
+    (import then sd.fit) at toy scale."""
+    rng = np.random.RandomState(0)
+    w = tf.constant(rng.randn(4, 2).astype("f4") * 0.1)
+
+    def fn(x):
+        return tf.nn.softmax(tf.matmul(x, w))
+
+    gd, frozen = _graph_def(fn, [("x", (None, 4))])
+    sd = TFGraphMapper.import_graph(gd)
+    # promote the imported weight constant to a trainable variable
+    const_names = [n for n, v in sd._vars.items()
+                   if v.var_type.value == "CONSTANT"
+                   and v.shape == (4, 2)]
+    assert const_names
+    sd.convert_to_variable(const_names[0]) if hasattr(sd, "convert_to_variable") \
+        else sd._vars[const_names[0]].__setattr__(
+            "var_type", type(sd._vars[const_names[0]].var_type).VARIABLE)
+
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.data.dataset import DataSet
+    outputs = [op.name for op in frozen.graph.get_operations()
+               if op.type == "Identity"]
+    out = outputs[-1]
+    X = rng.rand(32, 4).astype("f4")
+    # bias-free linear model → boundary must pass through the origin
+    Y = np.eye(2)[(X @ [1.0, -1.0, 0.5, -0.5] > 0).astype(int)].astype("f4")
+    lab = sd.placeholder("label", (None, 2))
+    pred = sd._vars[out] if out in sd._vars else None
+    assert pred is not None
+    loss = sd.loss.log_loss(lab, pred)
+    loss.rename("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(0.05), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["label"], loss_variables=["loss"]))
+    losses = sd.fit(DataSet(X, Y), epochs=40)
+    assert losses[-1] < losses[0] * 0.9
