@@ -1,0 +1,10 @@
+"""Clustering, nearest-neighbor search, manifold learning, graph embeddings
+(ref: deeplearning4j-nearestneighbors-parent + deeplearning4j-manifold +
+deeplearning4j-graph — SURVEY D17/D18)."""
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+from deeplearning4j_tpu.clustering.deepwalk import DeepWalk, GraphFactory
+
+__all__ = ["KMeansClustering", "VPTree", "BarnesHutTsne", "DeepWalk",
+           "GraphFactory"]

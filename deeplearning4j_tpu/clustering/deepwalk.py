@@ -1,0 +1,134 @@
+"""DeepWalk graph embeddings
+(ref: org.deeplearning4j.graph.models.deepwalk.DeepWalk + graph.api.*,
+SURVEY D18): uniform random walks over the graph feed the same jitted SGNS
+trainer as Word2Vec — vertices are "words", walks are "sentences"."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence import CollectionSentenceIterator
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class Graph:
+    """Minimal undirected graph (ref: org.deeplearning4j.graph.graph.Graph)."""
+
+    def __init__(self, num_vertices: int):
+        self.n = num_vertices
+        self.adj: List[List[int]] = [[] for _ in range(num_vertices)]
+
+    def add_edge(self, a: int, b: int, directed: bool = False):
+        self.adj[a].append(b)
+        if not directed:
+            self.adj[b].append(a)
+
+    addEdge = add_edge
+
+    def num_vertices(self) -> int:
+        return self.n
+
+    numVertices = num_vertices
+
+    def get_connected_vertices(self, v: int) -> List[int]:
+        return self.adj[v]
+
+    getConnectedVertices = get_connected_vertices
+
+
+class GraphFactory:
+    @staticmethod
+    def from_edge_list(num_vertices: int,
+                       edges: Sequence[Tuple[int, int]],
+                       directed: bool = False) -> Graph:
+        g = Graph(num_vertices)
+        for a, b in edges:
+            g.add_edge(a, b, directed)
+        return g
+
+
+class DeepWalk:
+    """ref API: DeepWalk.Builder().vectorSize(d).windowSize(w).build();
+    initialize(graph); fit(walk_iterator) — here fit(graph) runs walks
+    internally."""
+
+    def __init__(self, vector_size: int = 64, window_size: int = 5,
+                 walk_length: int = 40, walks_per_vertex: int = 10,
+                 learning_rate: float = 0.025, seed: int = 0,
+                 epochs: int = 1, negative: int = 5):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.epochs = epochs
+        self.negative = negative
+        self._w2v: Optional[Word2Vec] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def _set(self, k, v):
+            self._kw[k] = v
+            return self
+
+        def vector_size(self, v): return self._set("vector_size", v)
+        vectorSize = vector_size
+        def window_size(self, v): return self._set("window_size", v)
+        windowSize = window_size
+        def walk_length(self, v): return self._set("walk_length", v)
+        walkLength = walk_length
+        def walks_per_vertex(self, v): return self._set("walks_per_vertex", v)
+        walksPerVertex = walks_per_vertex
+        def negative_sample(self, v): return self._set("negative", v)
+        negativeSample = negative_sample
+        def learning_rate(self, v): return self._set("learning_rate", v)
+        learningRate = learning_rate
+        def seed(self, v): return self._set("seed", v)
+        def epochs(self, v): return self._set("epochs", v)
+
+        def build(self):
+            return DeepWalk(**self._kw)
+
+    def _walks(self, graph: Graph, rng) -> List[str]:
+        sentences = []
+        order = np.arange(graph.num_vertices())
+        for _ in range(self.walks_per_vertex):
+            rng.shuffle(order)
+            for start in order:
+                walk = [int(start)]
+                for _ in range(self.walk_length - 1):
+                    nbrs = graph.get_connected_vertices(walk[-1])
+                    if not nbrs:
+                        break
+                    walk.append(int(nbrs[rng.randint(len(nbrs))]))
+                sentences.append(" ".join(str(v) for v in walk))
+        return sentences
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        rng = np.random.RandomState(self.seed)
+        sentences = self._walks(graph, rng)
+        self._w2v = Word2Vec(
+            layer_size=self.vector_size, window_size=self.window_size,
+            min_word_frequency=1, epochs=self.epochs,
+            negative=self.negative, learning_rate=self.learning_rate,
+            sample=0.0, seed=self.seed,
+            iterator=CollectionSentenceIterator(sentences))
+        self._w2v.fit()
+        return self
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self._w2v.get_word_vector(str(v))
+
+    getVertexVector = get_vertex_vector
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._w2v.similarity(str(a), str(b))
+
+    def verticies_nearest(self, v: int, top_n: int = 5) -> List[int]:
+        return [int(w) for w in self._w2v.words_nearest(str(v), top_n)]
+
+    verticesNearest = verticies_nearest

@@ -1,0 +1,110 @@
+"""KMeans (ref: org.deeplearning4j.clustering.kmeans.KMeansClustering,
+SURVEY D17). Lloyd iterations as one jitted program per step: the (N, K)
+distance block is a single MXU matmul, assignment + centroid update are
+fused reductions — no per-point Java loops."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class Point:
+    def __init__(self, idx, array):
+        self.id = idx
+        self.array = np.asarray(array)
+
+
+class Cluster:
+    def __init__(self, center, points):
+        self.center = np.asarray(center)
+        self.points = points
+
+    def get_center(self):
+        return self.center
+
+    getCenter = get_center
+
+
+class ClusterSet:
+    def __init__(self, clusters: List[Cluster]):
+        self.clusters = clusters
+
+    def get_clusters(self):
+        return self.clusters
+
+    getClusters = get_clusters
+
+
+class KMeansClustering:
+    """ref API: KMeansClustering.setup(k, maxIter, distance) →
+    applyTo(points)."""
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 distance: str = "euclidean", seed: int = 0,
+                 tol: float = 1e-6):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.distance = distance
+        self.seed = seed
+        self.tol = tol
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100,
+              distance: str = "euclidean", seed: int = 0) -> "KMeansClustering":
+        return KMeansClustering(k, max_iterations, distance, seed)
+
+    def apply_to(self, points) -> ClusterSet:
+        import jax
+        import jax.numpy as jnp
+
+        X = np.asarray([p.array if isinstance(p, Point) else p
+                        for p in points], dtype=np.float32)
+        n, d = X.shape
+        rng = np.random.RandomState(self.seed)
+        # kmeans++ init (ref uses random; ++ strictly improves)
+        centers = [X[rng.randint(n)]]
+        for _ in range(1, self.k):
+            d2 = np.min([((X - c) ** 2).sum(1) for c in centers], axis=0)
+            total = d2.sum()
+            if total <= 0:      # duplicates / k > distinct points
+                centers.append(X[rng.randint(n)])
+            else:
+                centers.append(X[rng.choice(n, p=d2 / total)])
+        C = jnp.asarray(np.stack(centers))
+        Xd = jnp.asarray(X)
+        cosine = self.distance.lower().startswith("cos")
+
+        @jax.jit
+        def step(C):
+            if cosine:
+                Xn = Xd / (jnp.linalg.norm(Xd, axis=1, keepdims=True) + 1e-12)
+                Cn = C / (jnp.linalg.norm(C, axis=1, keepdims=True) + 1e-12)
+                dist = 1.0 - Xn @ Cn.T
+            else:
+                dist = (jnp.sum(Xd * Xd, 1)[:, None]
+                        - 2.0 * Xd @ C.T + jnp.sum(C * C, 1)[None, :])
+            assign = jnp.argmin(dist, axis=1)
+            onehot = jax.nn.one_hot(assign, self.k, dtype=Xd.dtype)
+            counts = jnp.maximum(onehot.sum(0), 1.0)
+            newC = (onehot.T @ Xd) / counts[:, None]
+            # keep empty clusters where they were
+            newC = jnp.where((onehot.sum(0) > 0)[:, None], newC, C)
+            return newC, assign
+
+        assign = None
+        for _ in range(self.max_iterations):
+            newC, assign = step(C)
+            if float(jnp.max(jnp.abs(newC - C))) < self.tol:
+                C = newC
+                break
+            C = newC
+        assign = np.asarray(assign)
+        C = np.asarray(C)
+        clusters = []
+        for ci in range(self.k):
+            idx = np.where(assign == ci)[0]
+            clusters.append(Cluster(C[ci], [Point(int(i), X[i]) for i in idx]))
+        return ClusterSet(clusters)
+
+    applyTo = apply_to

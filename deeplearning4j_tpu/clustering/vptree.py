@@ -1,0 +1,100 @@
+"""VP-tree exact nearest neighbors
+(ref: org.deeplearning4j.clustering.vptree.VPTree, SURVEY D17)."""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("index", "threshold", "inside", "outside", "bucket")
+
+    def __init__(self, index, threshold=0.0, inside=None, outside=None,
+                 bucket=None):
+        self.index = index
+        self.threshold = threshold
+        self.inside = inside
+        self.outside = outside
+        self.bucket = bucket      # leaf bucket for degenerate splits
+
+
+class VPTree:
+    """Exact metric-tree k-NN (Euclidean or cosine distance)."""
+
+    def __init__(self, items, distance: str = "euclidean", seed: int = 0):
+        self.items = np.asarray(items, dtype=np.float32)
+        self.distance = distance
+        self._cos = distance.lower().startswith("cos")
+        if self._cos:
+            norm = np.linalg.norm(self.items, axis=1, keepdims=True)
+            self._normed = self.items / np.maximum(norm, 1e-12)
+        self._rng = np.random.RandomState(seed)
+        self.root = self._build(list(range(len(self.items))))
+
+    def _dist_many(self, q: np.ndarray, idx) -> np.ndarray:
+        if self._cos:
+            qn = q / max(np.linalg.norm(q), 1e-12)
+            return 1.0 - self._normed[idx] @ qn
+        diff = self.items[idx] - q
+        return np.sqrt(np.sum(diff * diff, axis=1))
+
+    def _build(self, idx: List[int]) -> Optional[_Node]:
+        if not idx:
+            return None
+        if len(idx) == 1:
+            return _Node(idx[0])
+        vp_pos = self._rng.randint(len(idx))
+        vp = idx.pop(vp_pos)
+        d = self._dist_many(self.items[vp], idx)
+        median = float(np.median(d))
+        inside = [i for i, di in zip(idx, d) if di <= median]
+        outside = [i for i, di in zip(idx, d) if di > median]
+        if not outside and len(inside) > 1:
+            # degenerate split (duplicate points / equal distances): store a
+            # linear-scan leaf bucket instead of recursing once per point
+            return _Node(vp, median, bucket=inside)
+        return _Node(vp, median, self._build(inside), self._build(outside))
+
+    def knn(self, query, k: int = 1) -> Tuple[List[int], List[float]]:
+        """Indices + distances of the k nearest items (ref: VPTree#search)."""
+        q = np.asarray(query, dtype=np.float32)
+        heap: List[Tuple[float, int]] = []   # max-heap by -distance
+        tau = [np.inf]
+
+        def consider(i, d):
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, i))
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            elif d < tau[0]:
+                heapq.heapreplace(heap, (-d, i))
+                tau[0] = -heap[0][0]
+
+        def search(node):
+            if node is None:
+                return
+            d = float(self._dist_many(q, [node.index])[0])
+            consider(node.index, d)
+            if node.bucket is not None:
+                for i, di in zip(node.bucket,
+                                 self._dist_many(q, node.bucket)):
+                    consider(i, float(di))
+                return
+            if node.inside is None and node.outside is None:
+                return
+            if d <= node.threshold:
+                search(node.inside)
+                if d + tau[0] > node.threshold:
+                    search(node.outside)
+            else:
+                search(node.outside)
+                if d - tau[0] <= node.threshold:
+                    search(node.inside)
+
+        search(self.root)
+        out = sorted(((-nd, i) for nd, i in heap))
+        return [i for _, i in out], [d for d, _ in out]
+
+    search = knn

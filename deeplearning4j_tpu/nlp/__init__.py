@@ -1,0 +1,15 @@
+"""NLP: word/paragraph embeddings, tokenization, vocab
+(ref: deeplearning4j-nlp — SURVEY D15)."""
+from deeplearning4j_tpu.nlp.tokenization import (CommonPreprocessor,
+                                                 DefaultTokenizerFactory)
+from deeplearning4j_tpu.nlp.sentence import (BasicLineIterator,
+                                             CollectionSentenceIterator)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = ["DefaultTokenizerFactory", "CommonPreprocessor",
+           "BasicLineIterator", "CollectionSentenceIterator",
+           "VocabCache", "VocabWord", "Word2Vec", "ParagraphVectors",
+           "WordVectorSerializer"]

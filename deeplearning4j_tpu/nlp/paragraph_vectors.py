@@ -1,0 +1,152 @@
+"""ParagraphVectors (doc2vec), PV-DBOW flavor.
+
+Reference: ``org.deeplearning4j.models.paragraphvectors.ParagraphVectors``
+(SURVEY D15). PV-DBOW: each label/document vector is trained to predict the
+words of its document via the same SGNS objective as Word2Vec — here the doc
+vectors simply join the jitted SGNS batch as extra "center" rows.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class LabelledDocument:
+    """ref: text.documentiterator.LabelledDocument."""
+
+    def __init__(self, content: str, labels):
+        self.content = content
+        self.labels = [labels] if isinstance(labels, str) else list(labels)
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, documents: Optional[Sequence[LabelledDocument]] = None,
+                 **kwargs):
+        kwargs.pop("iterator", None)
+        super().__init__(**kwargs)
+        self.documents = list(documents or [])
+        self.doc_vectors: Dict[str, np.ndarray] = {}
+
+    class Builder(Word2Vec.Builder):
+        def iterate_documents(self, docs):
+            return self._set("documents", docs)
+
+        def build(self) -> "ParagraphVectors":
+            return ParagraphVectors(**self._kw)
+
+    def fit(self):
+        import jax.numpy as jnp
+        if self.cbow:
+            # cbow_step swaps center/context, which would index doc rows
+            # (>= V) into the V-row syn1 table
+            raise NotImplementedError(
+                "ParagraphVectors implements PV-DBOW only; PV-DM (cbow) is "
+                "not supported — construct without cbow=True")
+        rng = np.random.RandomState(self.seed)
+        tf = self.tokenizer_factory
+        doc_tokens = [tf.create(d.content).get_tokens() for d in self.documents]
+        self.vocab = VocabCache.build(doc_tokens, self.min_word_frequency)
+        V, D = self.vocab.num_words(), self.layer_size
+        labels = []
+        for d in self.documents:
+            labels.extend(l for l in d.labels if l not in labels)
+        L = len(labels)
+        self._labels = labels
+        # rows [0,V) = words, rows [V, V+L) = doc vectors — one table, one
+        # jitted step for both (the reference trains them jointly too)
+        syn0 = jnp.asarray((rng.rand(V + L, D).astype(np.float32) - 0.5) / D)
+        syn1 = jnp.zeros((V, D), dtype=jnp.float32)
+        acc0 = jnp.zeros((V + L, D), dtype=jnp.float32)
+        acc1 = jnp.zeros((V, D), dtype=jnp.float32)
+        table = self.vocab.unigram_table()
+        step = self._build_step()
+
+        label_idx = {l: V + i for i, l in enumerate(labels)}
+        keep = self.vocab.subsample_keep_prob(self.sample)
+        base_pairs = []
+        for d, toks in zip(self.documents, doc_tokens):
+            widx = [self.vocab.index_of(t) for t in toks]
+            widx = [i for i in widx if i >= 0]
+            for l in d.labels:
+                li = label_idx[l]
+                base_pairs.extend((li, w) for w in widx)
+        base_pairs = np.asarray(base_pairs, dtype=np.int32)
+        for _ in range(max(self.epochs, 1) * max(self.iterations, 1)):
+            if keep is not None and len(base_pairs):
+                # frequent-word subsampling per pass, as Word2Vec does —
+                # without it every doc vector aligns with the stopwords
+                mask = rng.rand(len(base_pairs)) < keep[base_pairs[:, 1]]
+                pairs = base_pairs[mask]
+            else:
+                pairs = base_pairs.copy()
+            rng.shuffle(pairs)
+            for off in range(0, len(pairs), self.batch_size):
+                chunk = pairs[off:off + self.batch_size]
+                negs = rng.choice(V, size=(len(chunk), self.negative),
+                                  p=table).astype(np.int32)
+                syn0, syn1, acc0, acc1 = step(
+                    syn0, syn1, acc0, acc1, jnp.asarray(chunk[:, 0]),
+                    jnp.asarray(chunk[:, 1]), jnp.asarray(negs),
+                    np.float32(self.learning_rate))
+        full = np.asarray(syn0)
+        self.syn0 = full[:V]
+        self.syn1neg = np.asarray(syn1)
+        self.doc_vectors = {l: full[V + i] for i, l in enumerate(labels)}
+        return self
+
+    # ---------------------------------------------------------------- lookup
+    def get_looked_up_vector(self, label: str) -> Optional[np.ndarray]:
+        return self.doc_vectors.get(label)
+
+    lookupVector = get_looked_up_vector
+
+    def infer_vector(self, text: str, steps: int = 50,
+                     lr: float = 0.05) -> np.ndarray:
+        """Gradient-fit a fresh doc vector against frozen word outputs
+        (ref: ParagraphVectors#inferVector)."""
+        import jax
+        import jax.numpy as jnp
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        widx = np.array([self.vocab.index_of(t) for t in toks])
+        widx = widx[widx >= 0].astype(np.int32)
+        rng = np.random.RandomState(self.seed)
+        v = jnp.asarray((rng.rand(self.layer_size).astype(np.float32) - 0.5)
+                        / self.layer_size)
+        syn1 = jnp.asarray(self.syn1neg)
+        table = self.vocab.unigram_table()
+        V = self.vocab.num_words()
+
+        @jax.jit
+        def step(v, words, negs):
+            def loss_fn(v):
+                pos = syn1[words] @ v
+                neg = jnp.einsum("nkd,d->nk", syn1[negs], v)
+                return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                         + jnp.sum(jax.nn.log_sigmoid(-neg)))
+            g = jax.grad(loss_fn)(v)
+            return v - lr * g
+
+        for _ in range(steps):
+            if len(widx) == 0:
+                break
+            negs = rng.choice(V, size=(len(widx), self.negative),
+                              p=table).astype(np.int32)
+            v = step(v, jnp.asarray(widx), jnp.asarray(negs))
+        return np.asarray(v)
+
+    inferVector = infer_vector
+
+    def nearest_labels(self, text_or_vec, top_n: int = 5) -> List[str]:
+        v = (self.infer_vector(text_or_vec)
+             if isinstance(text_or_vec, str) else np.asarray(text_or_vec))
+        from deeplearning4j_tpu.nlp.word2vec import _cos
+        sims = [(l, _cos(v, dv)) for l, dv in self.doc_vectors.items()]
+        sims.sort(key=lambda p: -p[1])
+        return [l for l, _ in sims[:top_n]]
+
+    nearestLabels = nearest_labels

@@ -1,0 +1,66 @@
+"""Sentence iterators (ref: org.deeplearning4j.text.sentenceiterator.*)."""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+
+class SentenceIterator:
+    def next_sentence(self) -> str:
+        raise NotImplementedError
+
+    nextSentence = next_sentence
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    hasNext = has_next
+
+    def reset(self):
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_sentence()
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    """ref: CollectionSentenceIterator — in-memory sentences."""
+
+    def __init__(self, sentences: Iterable[str]):
+        self._sentences: List[str] = list(sentences)
+        self._pos = 0
+
+    def next_sentence(self):
+        s = self._sentences[self._pos]
+        self._pos += 1
+        return s
+
+    def has_next(self):
+        return self._pos < len(self._sentences)
+
+    def reset(self):
+        self._pos = 0
+
+
+class BasicLineIterator(SentenceIterator):
+    """ref: BasicLineIterator — one sentence per file line."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lines = None
+        self._pos = 0
+        self.reset()
+
+    def reset(self):
+        with open(self.path) as f:
+            self._lines = [l.rstrip("\n") for l in f if l.strip()]
+        self._pos = 0
+
+    def next_sentence(self):
+        s = self._lines[self._pos]
+        self._pos += 1
+        return s
+
+    def has_next(self):
+        return self._pos < len(self._lines)

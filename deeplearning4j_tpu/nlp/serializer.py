@@ -1,0 +1,54 @@
+"""Word-vector serialization (ref: org.deeplearning4j.models.embeddings.
+loader.WordVectorSerializer — the classic word2vec text format)."""
+from __future__ import annotations
+
+import gzip
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word_vectors(model: Word2Vec, path: str):
+        """word2vec text format: header 'V D', then 'word v1 .. vD'."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt") as f:
+            V, D = model.syn0.shape
+            f.write(f"{V} {D}\n")
+            for i in range(V):
+                w = model.vocab.word_at_index(i)
+                vec = " ".join(f"{v:.6f}" for v in model.syn0[i])
+                f.write(f"{w} {vec}\n")
+
+    writeWordVectors = write_word_vectors
+
+    @staticmethod
+    def read_word_vectors(path: str) -> Word2Vec:
+        """ref: WordVectorSerializer#readWord2VecModel (text)."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as f:
+            header = f.readline().split()
+            V, D = int(header[0]), int(header[1])
+            words, vecs = [], np.zeros((V, D), dtype=np.float32)
+            for i in range(V):
+                parts = f.readline().rstrip("\n").split(" ")
+                # parse from the right: n-gram tokens may contain spaces
+                words.append(" ".join(parts[:-D]))
+                vecs[i] = [float(x) for x in parts[-D:]]
+        model = Word2Vec(layer_size=D)
+        vc = VocabCache()
+        for i, w in enumerate(words):
+            vw = VocabWord(w, count=V - i, index=i)
+            vc._words[w] = vw
+            vc._by_index.append(vw)
+        model.vocab = vc
+        model.syn0 = vecs
+        model.syn1neg = np.zeros_like(vecs)
+        return model
+
+    readWord2VecModel = read_word_vectors
+    loadTxtVectors = read_word_vectors

@@ -1,0 +1,82 @@
+"""Tokenizers (ref: org.deeplearning4j.text.tokenization.tokenizerfactory.
+DefaultTokenizerFactory + preprocessor.CommonPreprocessor, SURVEY D15)."""
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation/digits (ref: CommonPreprocessor)."""
+
+    _PATTERN = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PATTERN.sub("", token).lower()
+
+    preProcess = pre_process
+
+
+class Tokenizer:
+    def __init__(self, text: str, preprocessor=None):
+        toks = text.split()
+        if preprocessor is not None:
+            toks = [preprocessor.pre_process(t) for t in toks]
+        self._tokens = [t for t in toks if t]
+        self._pos = 0
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    countTokens = count_tokens
+
+    def has_more_tokens(self) -> bool:
+        return self._pos < len(self._tokens)
+
+    hasMoreTokens = has_more_tokens
+
+    def next_token(self) -> str:
+        t = self._tokens[self._pos]
+        self._pos += 1
+        return t
+
+    nextToken = next_token
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+    getTokens = get_tokens
+
+
+class DefaultTokenizerFactory:
+    """Whitespace tokenizer factory (ref: DefaultTokenizerFactory)."""
+
+    def __init__(self):
+        self._preprocessor = None
+
+    def set_token_pre_processor(self, p):
+        self._preprocessor = p
+
+    setTokenPreProcessor = set_token_pre_processor
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(text, self._preprocessor)
+
+
+class NGramTokenizerFactory(DefaultTokenizerFactory):
+    """ref: NGramTokenizerFactory — emits n-grams joined by spaces."""
+
+    def __init__(self, min_n: int = 1, max_n: int = 2):
+        super().__init__()
+        self.min_n = min_n
+        self.max_n = max_n
+
+    def create(self, text: str) -> Tokenizer:
+        base = Tokenizer(text, self._preprocessor).get_tokens()
+        grams = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                grams.append(" ".join(base[i:i + n]))
+        t = Tokenizer("", None)
+        t._tokens = grams
+        return t

@@ -1,0 +1,93 @@
+"""Vocabulary cache (ref: org.deeplearning4j.models.word2vec.wordstore.
+inmemory.AbstractCache + VocabWord, SURVEY D15)."""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabWord:
+    """ref: models.word2vec.VocabWord."""
+
+    def __init__(self, word: str, count: int = 1, index: int = -1):
+        self.word = word
+        self.count = count
+        self.index = index
+
+    def __repr__(self):
+        return f"VocabWord({self.word!r}, count={self.count}, idx={self.index})"
+
+
+class VocabCache:
+    """Frequency-ordered vocab with min-frequency filtering."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+
+    @staticmethod
+    def build(token_streams: Iterable[List[str]],
+              min_word_frequency: int = 1) -> "VocabCache":
+        counts: Dict[str, int] = {}
+        for toks in token_streams:
+            for t in toks:
+                counts[t] = counts.get(t, 0) + 1
+        vc = VocabCache()
+        ordered = sorted(((c, w) for w, c in counts.items()
+                          if c >= min_word_frequency),
+                         key=lambda p: (-p[0], p[1]))
+        for i, (c, w) in enumerate(ordered):
+            vw = VocabWord(w, c, i)
+            vc._words[w] = vw
+            vc._by_index.append(vw)
+        return vc
+
+    def num_words(self) -> int:
+        return len(self._by_index)
+
+    numWords = num_words
+
+    def contains_word(self, word: str) -> bool:
+        return word in self._words
+
+    containsWord = contains_word
+
+    def index_of(self, word: str) -> int:
+        vw = self._words.get(word)
+        return vw.index if vw else -1
+
+    indexOf = index_of
+
+    def word_at_index(self, idx: int) -> str:
+        return self._by_index[idx].word
+
+    wordAtIndex = word_at_index
+
+    def word_frequency(self, word: str) -> int:
+        vw = self._words.get(word)
+        return vw.count if vw else 0
+
+    wordFrequency = word_frequency
+
+    def words(self) -> List[str]:
+        return [vw.word for vw in self._by_index]
+
+    def counts(self) -> np.ndarray:
+        return np.array([vw.count for vw in self._by_index], dtype=np.float64)
+
+    def unigram_table(self, power: float = 0.75) -> np.ndarray:
+        """Negative-sampling distribution ∝ count^0.75 (Mikolov 2013; the
+        reference builds the same table natively in the sg/cbow kernels)."""
+        p = self.counts() ** power
+        return p / p.sum()
+
+    def subsample_keep_prob(self, sample: float) -> Optional[np.ndarray]:
+        """Word-keep probabilities for frequent-word subsampling
+        (ref: Word2Vec `sampling` config; word2vec.c formula)."""
+        if not sample:
+            return None
+        freqs = self.counts()
+        ratio = freqs / freqs.sum() / sample
+        keep = (np.sqrt(ratio) + 1.0) / np.maximum(ratio, 1e-12)
+        return np.minimum(keep, 1.0)

@@ -1,0 +1,154 @@
+"""NLP (D15), clustering/NN-search/t-SNE (D17), DeepWalk (D18) tests
+(ref analogs: Word2VecTests, KMeansTest, VPTreeTest, BarnesHutTsneTest,
+DeepWalkGradientCheck)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory, ParagraphVectors,
+                                    VocabCache, Word2Vec,
+                                    WordVectorSerializer)
+from deeplearning4j_tpu.nlp.paragraph_vectors import LabelledDocument
+
+
+CORPUS = (
+    ["the cat sat on the mat", "a cat and a dog play", "the dog sat on a log",
+     "cats and dogs are pets", "the king rules the kingdom",
+     "a queen rules beside the king", "the royal king and queen wave",
+     "kingdom of the king and his queen"] * 20
+)
+
+
+def test_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("The CAT, sat!").get_tokens()
+    assert toks == ["the", "cat", "sat"]
+
+
+def test_vocab_cache():
+    streams = [s.split() for s in ["a a a b b c", "a b"]]
+    vc = VocabCache.build(streams, min_word_frequency=2)
+    assert vc.num_words() == 2
+    assert vc.word_at_index(0) == "a"        # most frequent first
+    assert vc.index_of("c") == -1
+    assert vc.word_frequency("a") == 4
+    table = vc.unigram_table()
+    assert abs(table.sum() - 1.0) < 1e-9 and table[0] > table[1]
+
+
+def test_word2vec_semantic_similarity():
+    w2v = (Word2Vec.Builder()
+           .layer_size(32).window_size(3).min_word_frequency(2)
+           .epochs(25).negative_sample(5).learning_rate(0.1)
+           .seed(7).sampling(0.01)
+           .iterate(CollectionSentenceIterator(CORPUS))
+           .build())
+    w2v.fit()
+    assert w2v.has_word("king") and w2v.has_word("cat")
+    # co-occurring words end up closer than unrelated ones
+    assert w2v.similarity("king", "queen") > w2v.similarity("king", "cat")
+    near = w2v.words_nearest("dog", top_n=5)
+    assert len(near) == 5 and "dog" not in near
+
+
+def test_word2vec_cbow_runs():
+    w2v = Word2Vec(layer_size=16, window_size=2, epochs=2, cbow=True,
+                   sample=0.0, iterator=CollectionSentenceIterator(CORPUS[:40]))
+    w2v.fit()
+    assert w2v.syn0.shape[1] == 16
+
+
+def test_word_vector_serializer_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=8, epochs=1, sample=0.0,
+                   iterator=CollectionSentenceIterator(CORPUS[:20]))
+    w2v.fit()
+    p = os.path.join(str(tmp_path), "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+    w = w2v.vocab.word_at_index(0)
+    assert np.allclose(loaded.get_word_vector(w), w2v.get_word_vector(w),
+                       atol=1e-5)
+
+
+def test_paragraph_vectors():
+    docs = ([LabelledDocument("king queen rules kingdom crown throne", "royal"),
+             LabelledDocument("royal king queen kingdom crown palace", "royal2"),
+             LabelledDocument("cat dog plays mat fetch fur", "pets"),
+             LabelledDocument("cats dogs pets fetch paw fur", "pets2")] * 10)
+    pv = ParagraphVectors(documents=docs, layer_size=24, epochs=80,
+                          learning_rate=0.15, seed=3, sample=0.0,
+                          min_word_frequency=2, batch_size=512)
+    pv.fit()
+    v_royal = pv.get_looked_up_vector("royal")
+    v_royal2 = pv.get_looked_up_vector("royal2")
+    v_pets = pv.get_looked_up_vector("pets")
+    cos = lambda a, b: a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+    assert cos(v_royal, v_royal2) > cos(v_royal, v_pets)
+    inferred = pv.infer_vector("king queen kingdom crown")
+    assert pv.nearest_labels(inferred, top_n=2)[0] in ("royal", "royal2")
+
+
+def test_kmeans():
+    from deeplearning4j_tpu.clustering import KMeansClustering
+    rng = np.random.RandomState(0)
+    X = np.concatenate([rng.randn(50, 3) + c for c in ([0, 0, 0], [8, 8, 8],
+                                                       [-8, 8, 0])])
+    km = KMeansClustering.setup(3, max_iterations=50, seed=1)
+    cs = km.apply_to(X)
+    assert len(cs.get_clusters()) == 3
+    sizes = sorted(len(c.points) for c in cs.get_clusters())
+    assert sizes == [50, 50, 50]
+    centers = np.stack([c.get_center() for c in cs.get_clusters()])
+    # each true center matched within 1.0
+    for true in ([0, 0, 0], [8, 8, 8], [-8, 8, 0]):
+        assert np.min(np.linalg.norm(centers - true, axis=1)) < 1.0
+
+
+def test_vptree_matches_bruteforce():
+    from deeplearning4j_tpu.clustering import VPTree
+    rng = np.random.RandomState(2)
+    X = rng.rand(200, 8).astype("f4")
+    tree = VPTree(X)
+    q = rng.rand(8).astype("f4")
+    idx, dists = tree.knn(q, k=5)
+    brute = np.argsort(np.linalg.norm(X - q, axis=1))[:5]
+    assert set(idx) == set(brute.tolist())
+    assert dists == sorted(dists)
+
+
+def test_tsne_separates_clusters():
+    from deeplearning4j_tpu.clustering import BarnesHutTsne
+    rng = np.random.RandomState(3)
+    X = np.concatenate([rng.randn(30, 10) + 0, rng.randn(30, 10) + 12])
+    tsne = (BarnesHutTsne.Builder().set_max_iter(250).perplexity(10)
+            .number_dimension(2).seed(0).build())
+    Y = tsne.fit(X)
+    assert Y.shape == (60, 2)
+    a, b = Y[:30], Y[30:]
+    inter = np.linalg.norm(a.mean(0) - b.mean(0))
+    intra = (np.linalg.norm(a - a.mean(0), axis=1).mean()
+             + np.linalg.norm(b - b.mean(0), axis=1).mean()) / 2
+    assert inter > 2 * intra
+
+
+def test_deepwalk_embeds_communities():
+    from deeplearning4j_tpu.clustering import DeepWalk, GraphFactory
+    # two 6-cliques joined by one edge
+    edges = []
+    for base in (0, 6):
+        for i in range(6):
+            for j in range(i + 1, 6):
+                edges.append((base + i, base + j))
+    edges.append((0, 6))
+    g = GraphFactory.from_edge_list(12, edges)
+    dw = (DeepWalk.Builder().vector_size(16).window_size(3).seed(5)
+          .epochs(8).build())
+    dw.fit(g)
+    assert dw.get_vertex_vector(3).shape == (16,)
+    # same-clique similarity beats cross-clique
+    assert dw.similarity(1, 2) > dw.similarity(1, 8)
