@@ -1,0 +1,23 @@
+"""Hyperparameter optimization (ref: arbiter/ — SURVEY E5)."""
+from deeplearning4j_tpu.arbiter.parameter import (ContinuousParameterSpace,
+                                                  DiscreteParameterSpace,
+                                                  FixedValue,
+                                                  IntegerParameterSpace,
+                                                  ParameterSpace)
+from deeplearning4j_tpu.arbiter.space import MultiLayerSpace
+from deeplearning4j_tpu.arbiter.generator import (
+    GridSearchCandidateGenerator, RandomSearchGenerator)
+from deeplearning4j_tpu.arbiter.runner import (DataSetLossScoreFunction,
+                                               EvaluationScoreFunction,
+                                               LocalOptimizationRunner,
+                                               MaxCandidatesCondition,
+                                               MaxTimeCondition,
+                                               OptimizationConfiguration)
+
+__all__ = ["ParameterSpace", "ContinuousParameterSpace",
+           "IntegerParameterSpace", "DiscreteParameterSpace", "FixedValue",
+           "MultiLayerSpace", "RandomSearchGenerator",
+           "GridSearchCandidateGenerator", "LocalOptimizationRunner",
+           "OptimizationConfiguration", "DataSetLossScoreFunction",
+           "EvaluationScoreFunction", "MaxCandidatesCondition",
+           "MaxTimeCondition"]

@@ -1,0 +1,52 @@
+"""Candidate generators (ref: org.deeplearning4j.arbiter.optimize.generator.
+{RandomSearchGenerator,GridSearchCandidateGenerator}, SURVEY E5)."""
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+
+class CandidateGenerator:
+    def __init__(self, space):
+        self.space = space
+
+    def __iter__(self) -> Iterator:
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    def __init__(self, space, seed: int = 0):
+        super().__init__(space)
+        self.rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        n = self.space.num_parameters()
+        while True:
+            yield self.space.candidate(list(self.rng.rand(n)))
+
+
+class GridSearchCandidateGenerator(CandidateGenerator):
+    """ref: GridSearchCandidateGenerator — discretize each space into
+    ``discretization_count`` points, enumerate the product."""
+
+    def __init__(self, space, discretization_count: int = 3,
+                 mode: str = "Sequential", seed: int = 0):
+        super().__init__(space)
+        self.count = discretization_count
+        self.mode = mode
+        self.rng = np.random.RandomState(seed)
+
+    def __iter__(self):
+        spaces = self.space.spaces()
+        axes = []
+        for s in spaces:
+            vals = s.grid_values(self.count)
+            # represent each grid value by the u that produces it
+            axes.append([(i + 0.5) / len(vals) for i in range(len(vals))])
+        combos = list(itertools.product(*axes))
+        if self.mode.lower().startswith("random"):
+            self.rng.shuffle(combos)
+        for combo in combos:
+            yield self.space.candidate(list(combo))

@@ -1,0 +1,88 @@
+"""Parameter spaces (ref: org.deeplearning4j.arbiter.optimize.api.
+ParameterSpace + impls under ...parameter.{continuous,discrete,integer},
+SURVEY E5).
+
+Each space maps a uniform [0,1) draw to a value — the same "leaf indices
+into a random vector" design the reference uses, which makes grid and random
+generators share one interface.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, List, Sequence
+
+
+class ParameterSpace:
+    def value_for(self, u: float):
+        """Map u ∈ [0,1) to a parameter value."""
+        raise NotImplementedError
+
+    def grid_values(self, n: int) -> List[Any]:
+        return [self.value_for((i + 0.5) / n) for i in range(n)]
+
+    @property
+    def is_leaf(self) -> bool:
+        return True
+
+
+class FixedValue(ParameterSpace):
+    def __init__(self, value):
+        self.value = value
+
+    def value_for(self, u):
+        return self.value
+
+    def grid_values(self, n):
+        return [self.value]
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    """ref: parameter.continuous.ContinuousParameterSpace (uniform or log)."""
+
+    def __init__(self, min_value: float, max_value: float,
+                 log_scale: bool = False):
+        self.min = min_value
+        self.max = max_value
+        self.log_scale = log_scale
+
+    def value_for(self, u):
+        if self.log_scale:
+            lo, hi = math.log(self.min), math.log(self.max)
+            return math.exp(lo + u * (hi - lo))
+        return self.min + u * (self.max - self.min)
+
+
+class IntegerParameterSpace(ParameterSpace):
+    """ref: parameter.integer.IntegerParameterSpace (inclusive bounds)."""
+
+    def __init__(self, min_value: int, max_value: int):
+        self.min = min_value
+        self.max = max_value
+
+    def value_for(self, u):
+        return self.min + int(u * (self.max - self.min + 1) * 0.9999999)
+
+    def grid_values(self, n):
+        span = self.max - self.min + 1
+        if n >= span:
+            return list(range(self.min, self.max + 1))
+        return sorted({self.value_for((i + 0.5) / n) for i in range(n)})
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    """ref: parameter.discrete.DiscreteParameterSpace."""
+
+    def __init__(self, *values):
+        self.values = list(values[0]) if len(values) == 1 \
+            and isinstance(values[0], (list, tuple)) else list(values)
+
+    def value_for(self, u):
+        return self.values[min(int(u * len(self.values)),
+                               len(self.values) - 1)]
+
+    def grid_values(self, n):
+        return list(self.values)
+
+
+def as_space(v) -> ParameterSpace:
+    return v if isinstance(v, ParameterSpace) else FixedValue(v)

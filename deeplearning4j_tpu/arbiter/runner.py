@@ -1,0 +1,147 @@
+"""Optimization runner + score functions + termination conditions
+(ref: org.deeplearning4j.arbiter.optimize.runner.LocalOptimizationRunner,
+...scoring.ScoreFunction impls, ...api.termination.*, SURVEY E5)."""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, List, Optional
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+# --------------------------------------------------------- score functions
+class ScoreFunction:
+    minimize = True
+
+    def score(self, net, data) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossScoreFunction(ScoreFunction):
+    """Average loss on a held-out set (ref: score.impl.DataSetLossScoreFunction)."""
+
+    minimize = True
+
+    def score(self, net, data):
+        total, n = 0.0, 0
+        if hasattr(data, "reset"):
+            data.reset()
+        for ds in data:
+            total += net.score(ds)
+            n += 1
+        return total / max(n, 1)
+
+
+class EvaluationScoreFunction(ScoreFunction):
+    """Maximize an Evaluation metric (ref: score.impl.EvaluationScoreFunction)."""
+
+    minimize = False
+
+    def __init__(self, metric: str = "accuracy"):
+        self.metric = metric
+
+    def score(self, net, data):
+        if hasattr(data, "reset"):
+            data.reset()
+        ev = net.evaluate(data)
+        return float(getattr(ev, self.metric)())
+
+
+# ---------------------------------------------------- termination conditions
+class MaxCandidatesCondition:
+    def __init__(self, n: int):
+        self.n = n
+
+    def terminate(self, result) -> bool:
+        return result.num_candidates >= self.n
+
+
+class MaxTimeCondition:
+    def __init__(self, seconds: float):
+        self.seconds = seconds
+        self._start = None
+
+    def initialize(self):
+        """Anchor the clock at optimization start (called per execute())."""
+        self._start = time.time()
+
+    def terminate(self, result) -> bool:
+        if self._start is None:
+            self._start = time.time()
+        return time.time() - self._start > self.seconds
+
+
+# ------------------------------------------------------------------ config
+@dataclasses.dataclass
+class OptimizationConfiguration:
+    """ref: OptimizationConfiguration.Builder."""
+    candidate_generator: Any = None
+    score_function: ScoreFunction = None
+    termination_conditions: List[Any] = dataclasses.field(default_factory=list)
+    train_data: Any = None
+    test_data: Any = None
+    epochs: int = 1
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    index: int
+    conf: Any
+    score: float
+    model: Any = None
+
+
+class _RunnerState:
+    def __init__(self):
+        self.num_candidates = 0
+
+
+class LocalOptimizationRunner:
+    """Sequential candidate execution (ref: LocalOptimizationRunner; the
+    reference's thread pool buys nothing when each candidate's training is
+    already one compiled device program)."""
+
+    def __init__(self, config: OptimizationConfiguration,
+                 net_factory: Callable = None):
+        self.config = config
+        self.net_factory = net_factory or \
+            (lambda conf: MultiLayerNetwork(conf).init())
+        self.results: List[CandidateResult] = []
+
+    def execute(self) -> CandidateResult:
+        cfg = self.config
+        state = _RunnerState()
+        best: Optional[CandidateResult] = None
+        minimize = cfg.score_function.minimize
+        for t in cfg.termination_conditions:
+            if hasattr(t, "initialize"):
+                t.initialize()
+        for i, conf in enumerate(cfg.candidate_generator):
+            if any(t.terminate(state) for t in cfg.termination_conditions):
+                break
+            net = self.net_factory(conf)
+            train = cfg.train_data
+            if hasattr(train, "reset"):
+                train.reset()
+            net.fit(train, epochs=cfg.epochs)
+            score = cfg.score_function.score(net, cfg.test_data)
+            res = CandidateResult(i, conf, score, net)
+            state.num_candidates += 1
+            if best is None or (score < best.score if minimize
+                                else score > best.score):
+                if best is not None:
+                    best.model = None   # keep only the best model's params
+                best = res
+            else:
+                res.model = None
+            self.results.append(res)
+        if best is None:
+            raise RuntimeError("no candidates were executed")
+        return best
+
+    def best_result(self) -> CandidateResult:
+        minimize = self.config.score_function.minimize
+        return (min if minimize else max)(self.results, key=lambda r: r.score)
+
+    bestResult = best_result

@@ -1,0 +1,124 @@
+"""MultiLayerSpace: a hyperparameter space over the config DSL
+(ref: org.deeplearning4j.arbiter.MultiLayerSpace + layer spaces under
+org.deeplearning4j.arbiter.layers, SURVEY E5)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.arbiter.parameter import (ParameterSpace, as_space)
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+
+
+class LayerSpace:
+    """A layer config whose fields may be ParameterSpaces
+    (ref: arbiter.layers.DenseLayerSpace etc. — generalized: any Layer class
+    plus a dict of fixed-or-space kwargs)."""
+
+    def __init__(self, layer_cls, **kwargs):
+        self.layer_cls = layer_cls
+        self.kwargs = {k: as_space(v) for k, v in kwargs.items()}
+
+    def num_parameters(self) -> int:
+        return len(self.kwargs)
+
+    def materialize(self, draws: List[float]):
+        vals = {k: space.value_for(u)
+                for (k, space), u in zip(self.kwargs.items(), draws)}
+        return self.layer_cls(**vals)
+
+    def spaces(self) -> List[ParameterSpace]:
+        return list(self.kwargs.values())
+
+
+def DenseLayerSpace(**kw):
+    return LayerSpace(L.DenseLayer, **kw)
+
+
+def OutputLayerSpace(**kw):
+    return LayerSpace(L.OutputLayer, **kw)
+
+
+class MultiLayerSpace:
+    """ref: MultiLayerSpace.Builder — candidate index/draw vector →
+    MultiLayerConfiguration."""
+
+    def __init__(self, layer_spaces: List[LayerSpace],
+                 updater_space: Optional[Dict[str, Any]] = None,
+                 seed: int = 12345, input_type: Optional[InputType] = None,
+                 weight_init: str = "xavier"):
+        self.layer_spaces = layer_spaces
+        self.updater_space = {k: as_space(v)
+                              for k, v in (updater_space or {}).items()}
+        self.seed = seed
+        self.input_type = input_type
+        self.weight_init = weight_init
+
+    class Builder:
+        def __init__(self):
+            self._layers: List[LayerSpace] = []
+            self._kw: Dict[str, Any] = {}
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def updater(self, learning_rate, kind="adam"):
+            self._kw["updater_space"] = {"learning_rate": learning_rate,
+                                         "kind": kind}
+            return self
+
+        def weight_init(self, w):
+            self._kw["weight_init"] = w
+            return self
+
+        def add_layer(self, layer_space: LayerSpace):
+            self._layers.append(layer_space)
+            return self
+
+        addLayer = add_layer
+
+        def set_input_type(self, t: InputType):
+            self._kw["input_type"] = t
+            return self
+
+        setInputType = set_input_type
+
+        def build(self) -> "MultiLayerSpace":
+            return MultiLayerSpace(self._layers, **self._kw)
+
+    # ------------------------------------------------------------- sampling
+    def spaces(self) -> List[ParameterSpace]:
+        out = list(self.updater_space.values())
+        for ls in self.layer_spaces:
+            out.extend(ls.spaces())
+        return out
+
+    def num_parameters(self) -> int:
+        return len(self.spaces())
+
+    numParameters = num_parameters
+
+    def candidate(self, draws: List[float]):
+        """Draw vector (one u per leaf space) → MultiLayerConfiguration."""
+        i = 0
+        upd_vals = {}
+        for k, space in self.updater_space.items():
+            upd_vals[k] = space.value_for(draws[i])
+            i += 1
+        kind = upd_vals.pop("kind", "adam")
+        lr = upd_vals.pop("learning_rate", 1e-3)
+        updater = Sgd(lr) if str(kind).lower() == "sgd" else Adam(lr)
+
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(updater)
+             .weight_init(self.weight_init).list())
+        for ls in self.layer_spaces:
+            n = ls.num_parameters()
+            b.layer(ls.materialize(draws[i:i + n]))
+            i += n
+        if self.input_type is not None:
+            b.set_input_type(self.input_type)
+        return b.build()

@@ -86,7 +86,9 @@ class BarnesHutTsne:
         X = np.asarray(X, dtype=np.float32)
         n = X.shape[0]
         perp = min(self.perplexity, (n - 1) / 3.0)
-        D2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+        # gram trick: O(N^2) memory, not O(N^2 * d)
+        sq = np.sum(X * X, axis=1)
+        D2 = np.maximum(sq[:, None] - 2.0 * (X @ X.T) + sq[None, :], 0.0)
         P = _binary_search_perplexity(D2, perp)
         P = (P + P.T) / (2.0 * n)
         P = np.maximum(P, 1e-12)

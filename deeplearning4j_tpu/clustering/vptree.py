@@ -35,8 +35,12 @@ class VPTree:
 
     def _dist_many(self, q: np.ndarray, idx) -> np.ndarray:
         if self._cos:
+            # search in sqrt(2-2cos) — Euclidean over normalized vectors, a
+            # true metric with the same ranking; 1-cos violates the triangle
+            # inequality the pruning bounds rely on (knn converts back)
             qn = q / max(np.linalg.norm(q), 1e-12)
-            return 1.0 - self._normed[idx] @ qn
+            return np.sqrt(np.maximum(2.0 - 2.0 * (self._normed[idx] @ qn),
+                                      0.0))
         diff = self.items[idx] - q
         return np.sqrt(np.sum(diff * diff, axis=1))
 
@@ -95,6 +99,9 @@ class VPTree:
 
         search(self.root)
         out = sorted(((-nd, i) for nd, i in heap))
-        return [i for _, i in out], [d for d, _ in out]
+        dists = [d for d, _ in out]
+        if self._cos:
+            dists = [d * d / 2.0 for d in dists]   # back to 1-cos
+        return [i for _, i in out], dists
 
     search = knn

@@ -13,7 +13,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabCache
-from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _pad_batch
 
 
 class LabelledDocument:
@@ -27,9 +27,14 @@ class LabelledDocument:
 class ParagraphVectors(Word2Vec):
     def __init__(self, documents: Optional[Sequence[LabelledDocument]] = None,
                  **kwargs):
-        kwargs.pop("iterator", None)
+        iterator = kwargs.pop("iterator", None)
         super().__init__(**kwargs)
         self.documents = list(documents or [])
+        if iterator is not None and not self.documents:
+            # reference behavior: iterate(SentenceIterator) labels each
+            # sentence as its own document DOC_<n>
+            self.documents = [LabelledDocument(s, f"DOC_{i}")
+                              for i, s in enumerate(iterator)]
         self.doc_vectors: Dict[str, np.ndarray] = {}
 
     class Builder(Word2Vec.Builder):
@@ -52,6 +57,9 @@ class ParagraphVectors(Word2Vec):
         doc_tokens = [tf.create(d.content).get_tokens() for d in self.documents]
         self.vocab = VocabCache.build(doc_tokens, self.min_word_frequency)
         V, D = self.vocab.num_words(), self.layer_size
+        if V == 0:
+            raise ValueError("empty vocabulary (no documents, or all words "
+                             "below min_word_frequency)")
         labels = []
         for d in self.documents:
             labels.extend(l for l in d.labels if l not in labels)
@@ -87,12 +95,12 @@ class ParagraphVectors(Word2Vec):
             rng.shuffle(pairs)
             for off in range(0, len(pairs), self.batch_size):
                 chunk = pairs[off:off + self.batch_size]
-                negs = rng.choice(V, size=(len(chunk), self.negative),
-                                  p=table).astype(np.int32)
+                chunk, negs, weights = _pad_batch(
+                    chunk, self.batch_size, self.negative, V, table, rng)
                 syn0, syn1, acc0, acc1 = step(
                     syn0, syn1, acc0, acc1, jnp.asarray(chunk[:, 0]),
                     jnp.asarray(chunk[:, 1]), jnp.asarray(negs),
-                    np.float32(self.learning_rate))
+                    np.float32(self.learning_rate), jnp.asarray(weights))
         full = np.asarray(syn0)
         self.syn0 = full[:V]
         self.syn1neg = np.asarray(syn1)
@@ -126,8 +134,12 @@ class ParagraphVectors(Word2Vec):
             def loss_fn(v):
                 pos = syn1[words] @ v
                 neg = jnp.einsum("nkd,d->nk", syn1[negs], v)
+                # mask negatives colliding with the positive word (same
+                # guard as the training step)
+                neg_term = jnp.where(negs == words[:, None], 0.0,
+                                     jax.nn.log_sigmoid(-neg))
                 return -(jnp.sum(jax.nn.log_sigmoid(pos))
-                         + jnp.sum(jax.nn.log_sigmoid(-neg)))
+                         + jnp.sum(neg_term))
             g = jax.grad(loss_fn)(v)
             return v - lr * g
 

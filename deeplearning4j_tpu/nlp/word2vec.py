@@ -19,6 +19,20 @@ from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 
 
+def _pad_batch(chunk, batch_size, negative, V, table, rng):
+    """Pad a trailing partial batch to the fixed batch size with zero-weight
+    rows — the jitted step then compiles exactly once per batch shape."""
+    negs = rng.choice(V, size=(len(chunk), negative), p=table).astype(np.int32)
+    n = len(chunk)
+    weights = np.ones(n, dtype=np.float32)
+    if n < batch_size:
+        pad = batch_size - n
+        chunk = np.concatenate([chunk, np.zeros((pad, 2), np.int32)])
+        negs = np.concatenate([negs, np.zeros((pad, negative), np.int32)])
+        weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+    return chunk, negs, weights
+
+
 def _cos(a: np.ndarray, b: np.ndarray) -> float:
     """Cosine similarity with zero-vector guard (shared by the nlp lookups)."""
     return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
@@ -124,9 +138,8 @@ class Word2Vec:
         import jax
         import jax.numpy as jnp
 
-        neg = self.negative
-
-        def sg_step(syn0, syn1, acc0, acc1, center, context, negs, lr):
+        def sg_step(syn0, syn1, acc0, acc1, center, context, negs, lr,
+                    weights):
             """One SGNS batch: B centers, B contexts, (B, neg) negatives.
 
             Per-pair gradients are scatter-summed per table row and applied
@@ -147,6 +160,7 @@ class Word2Vec:
                 [jnp.zeros((negs.shape[0], 1), bool),
                  negs == context[:, None]], axis=1)
             g = jnp.where(collide, 0.0, g)
+            g = g * weights[:, None]   # zero rows padding the last batch
             d_vc = jnp.einsum("bk,bkd->bd", g, v_t)
             d_vt = jnp.einsum("bk,bd->bkd", g, v_c).reshape(-1, v_c.shape[-1])
             flat_t = tgt.reshape(-1)
@@ -158,11 +172,13 @@ class Word2Vec:
             syn1 = syn1 + lr * G1 * jax.lax.rsqrt(acc1 + 1e-10)
             return syn0, syn1, acc0, acc1
 
-        def cbow_step(syn0, syn1, acc0, acc1, center, context, negs, lr):
+        def cbow_step(syn0, syn1, acc0, acc1, center, context, negs, lr,
+                      weights):
             """CBOW with window collapsed to one context word per pair keeps
             the same batch layout; mean-of-window is approximated by the
             pair-expansion (each context contributes an update)."""
-            return sg_step(syn0, syn1, acc0, acc1, context, center, negs, lr)
+            return sg_step(syn0, syn1, acc0, acc1, context, center, negs, lr,
+                           weights)
 
         return jax.jit(cbow_step if self.cbow else sg_step,
                        donate_argnums=(0, 1, 2, 3))
@@ -193,14 +209,15 @@ class Word2Vec:
                 pairs = self._training_pairs(sents, rng)
                 for off in range(0, len(pairs), self.batch_size):
                     chunk = pairs[off:off + self.batch_size]
-                    negs = rng.choice(V, size=(len(chunk), self.negative),
-                                      p=table).astype(np.int32)
+                    chunk, negs, weights = _pad_batch(
+                        chunk, self.batch_size, self.negative, V, table, rng)
                     syn0, syn1, acc0, acc1 = step(
                         syn0, syn1, acc0, acc1,
                         jnp.asarray(chunk[:, 0]),
                         jnp.asarray(chunk[:, 1]),
                         jnp.asarray(negs),
-                        np.float32(lr))
+                        np.float32(lr),
+                        jnp.asarray(weights))
                 done += 1
         self.syn0 = np.asarray(syn0)
         self.syn1neg = np.asarray(syn1)

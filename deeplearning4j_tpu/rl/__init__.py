@@ -1,0 +1,13 @@
+"""Reinforcement learning (ref: rl4j — SURVEY E4)."""
+from deeplearning4j_tpu.rl.mdp import (CartPole, DiscreteSpace, GridWorld,
+                                       MDP, ObservationSpace)
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+from deeplearning4j_tpu.rl.qlearning import (DQNPolicy, EpsGreedy,
+                                             QLearningConfiguration,
+                                             QLearningDiscreteDense)
+from deeplearning4j_tpu.rl.a2c import A2CDiscreteDense, A2CConfiguration
+
+__all__ = ["MDP", "ObservationSpace", "DiscreteSpace", "CartPole",
+           "GridWorld", "ExpReplay", "Transition", "QLearningConfiguration",
+           "QLearningDiscreteDense", "EpsGreedy", "DQNPolicy",
+           "A2CDiscreteDense", "A2CConfiguration"]

@@ -1,0 +1,166 @@
+"""Advantage actor-critic (A2C), discrete actions.
+
+Reference: ``org.deeplearning4j.rl4j.learning.async.a3c.discrete.
+A3CDiscrete(Dense)`` (SURVEY E4). The reference's A3C runs asynchronous
+worker threads against a shared model (Hogwild-style); on TPU the idiomatic
+equivalent is synchronous A2C — n-step rollouts batched into one jitted
+update (async param races buy nothing when the step is a single compiled
+program).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP
+
+
+@dataclasses.dataclass
+class A2CConfiguration:
+    """ref: A3CDiscrete.A3CConfiguration fields (async knobs dropped)."""
+    seed: int = 123
+    max_epoch_step: int = 500
+    max_step: int = 20_000
+    n_step: int = 16
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+
+
+class A2CDiscreteDense:
+    def __init__(self, mdp: MDP, conf: A2CConfiguration,
+                 hidden: List[int] = (64,)):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.mdp = mdp
+        self.conf = conf
+        self.rng = np.random.RandomState(conf.seed)
+        self.n_actions = mdp.get_action_space().get_size()
+        n_in = int(np.prod(mdp.get_observation_space().get_shape()))
+
+        key = jax.random.key(conf.seed)
+        sizes = [n_in] + list(hidden)
+        params = {}
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            params[f"W{i}"] = jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a)
+            params[f"b{i}"] = jnp.zeros((b,))
+        key, k1, k2 = jax.random.split(key, 3)
+        params["Wpi"] = jax.random.normal(k1, (sizes[-1], self.n_actions)) * 0.01
+        params["bpi"] = jnp.zeros((self.n_actions,))
+        params["Wv"] = jax.random.normal(k2, (sizes[-1], 1)) * 0.01
+        params["bv"] = jnp.zeros((1,))
+        self.params = params
+        self._opt = optax.adam(conf.learning_rate)
+        self._opt_state = self._opt.init(params)
+        n_hidden = len(hidden)
+
+        def trunk(p, x):
+            h = x.reshape((x.shape[0], -1))
+            for i in range(n_hidden):
+                h = jnp.tanh(h @ p[f"W{i}"] + p[f"b{i}"])
+            return h
+
+        def heads(p, x):
+            h = trunk(p, x)
+            logits = h @ p["Wpi"] + p["bpi"]
+            value = (h @ p["Wv"] + p["bv"])[:, 0]
+            return logits, value
+
+        ec, vc = conf.entropy_coef, conf.value_coef
+
+        def loss_fn(p, obs, actions, returns):
+            logits, value = heads(p, obs)
+            logp = jax.nn.log_softmax(logits)
+            logp_a = logp[jnp.arange(logp.shape[0]), actions]
+            adv = returns - value
+            pi_loss = -jnp.mean(logp_a * jax.lax.stop_gradient(adv))
+            v_loss = jnp.mean(adv * adv)
+            entropy = -jnp.mean(jnp.sum(jnp.exp(logp) * logp, axis=1))
+            return pi_loss + vc * v_loss - ec * entropy
+
+        @jax.jit
+        def train_step(p, opt_state, obs, actions, returns):
+            loss, grads = jax.value_and_grad(loss_fn)(p, obs, actions, returns)
+            updates, opt_state = self._opt.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        self._train_step = train_step
+        self._heads = jax.jit(heads)
+        self._jnp = jnp
+
+    def _policy_value(self, obs):
+        logits, value = self._heads(self.params,
+                                    self._jnp.asarray(obs[None]))
+        logits = np.asarray(logits)[0]
+        e = np.exp(logits - logits.max())
+        return e / e.sum(), float(np.asarray(value)[0])
+
+    def next_action(self, obs) -> int:
+        probs, _ = self._policy_value(np.asarray(obs, np.float32))
+        return int(self.rng.choice(self.n_actions, p=probs))
+
+    def play(self, mdp: MDP = None, max_steps: int = 10_000) -> float:
+        """Greedy episode reward with the current policy."""
+        mdp = mdp or self.mdp.new_instance()
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            probs, _ = self._policy_value(np.asarray(obs, np.float32))
+            reply = mdp.step(int(np.argmax(probs)))
+            total += reply.reward
+            obs = reply.observation
+            if reply.done:
+                break
+        return total
+
+    def train(self) -> List[float]:
+        conf = self.conf
+        episode_rewards = []
+        steps = 0
+        obs = self.mdp.reset()
+        ep_reward, ep_steps = 0.0, 0
+        while steps < conf.max_step:
+            # n-step rollout
+            buf_obs, buf_act, buf_rew, buf_done = [], [], [], []
+            boot_obs = None   # obs to bootstrap from on truncation
+            for _ in range(conf.n_step):
+                action = self.next_action(obs)
+                reply = self.mdp.step(action)
+                buf_obs.append(np.asarray(obs, np.float32))
+                buf_act.append(action)
+                buf_rew.append(reply.reward)
+                buf_done.append(reply.done)
+                obs = reply.observation
+                ep_reward += reply.reward
+                ep_steps += 1
+                steps += 1
+                if reply.done or ep_steps >= conf.max_epoch_step:
+                    # bootstrap from the truncated episode's LAST observation,
+                    # not the fresh reset state
+                    boot_obs = reply.observation
+                    episode_rewards.append(ep_reward)
+                    obs = self.mdp.reset()
+                    ep_reward, ep_steps = 0.0, 0
+                    break
+            # bootstrap + discounted returns
+            if buf_done[-1]:
+                R = 0.0
+            else:
+                src = boot_obs if boot_obs is not None else obs
+                _, R = self._policy_value(np.asarray(src, np.float32))
+            returns = np.zeros(len(buf_rew), dtype=np.float32)
+            for i in reversed(range(len(buf_rew))):
+                R = buf_rew[i] + conf.gamma * R * (1.0 - float(buf_done[i]))
+                returns[i] = R
+            self.params, self._opt_state, _ = self._train_step(
+                self.params, self._opt_state,
+                self._jnp.asarray(np.stack(buf_obs)),
+                self._jnp.asarray(np.asarray(buf_act, np.int32)),
+                self._jnp.asarray(returns))
+        return episode_rewards

@@ -1,0 +1,195 @@
+"""MDP interface + built-in environments.
+
+Reference: ``org.deeplearning4j.rl4j.mdp.MDP`` and the space classes in
+``org.deeplearning4j.rl4j.space`` (SURVEY E4). The reference binds to
+gym/ALE/Malmo through native adapters (zero-egress here), so the classic
+control environments are implemented natively: CartPole matches the standard
+cart-pole dynamics; GridWorld is a deterministic debugging MDP.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class ObservationSpace:
+    def __init__(self, shape: Tuple[int, ...], low=None, high=None):
+        self.shape = tuple(shape)
+        self.low = low
+        self.high = high
+
+    def get_shape(self):
+        return self.shape
+
+    getShape = get_shape
+
+
+class DiscreteSpace:
+    """ref: rl4j.space.DiscreteSpace."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def get_size(self) -> int:
+        return self.size
+
+    getSize = get_size
+
+    def random_action(self, rng) -> int:
+        return int(rng.randint(self.size))
+
+    randomAction = random_action
+
+
+class StepReply:
+    """ref: org.deeplearning4j.gym.StepReply."""
+
+    def __init__(self, observation, reward: float, done: bool, info=None):
+        self.observation = observation
+        self.reward = reward
+        self.done = done
+        self.info = info or {}
+
+    def get_observation(self):
+        return self.observation
+
+    def get_reward(self):
+        return self.reward
+
+    def is_done(self):
+        return self.done
+
+
+class MDP:
+    """ref: rl4j.mdp.MDP — reset/step/isDone/close + spaces."""
+
+    def get_observation_space(self) -> ObservationSpace:
+        raise NotImplementedError
+
+    getObservationSpace = get_observation_space
+
+    def get_action_space(self) -> DiscreteSpace:
+        raise NotImplementedError
+
+    getActionSpace = get_action_space
+
+    def reset(self):
+        raise NotImplementedError
+
+    def step(self, action: int) -> StepReply:
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    isDone = is_done
+
+    def close(self):
+        pass
+
+    def new_instance(self) -> "MDP":
+        raise NotImplementedError
+
+    newInstance = new_instance
+
+
+class CartPole(MDP):
+    """Classic cart-pole balancing (standard control dynamics; the reference
+    reaches it via gym-java-client)."""
+
+    GRAVITY = 9.8
+    MASS_CART = 1.0
+    MASS_POLE = 0.1
+    LENGTH = 0.5          # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_THRESHOLD = 12 * 2 * math.pi / 360
+    X_THRESHOLD = 2.4
+    MAX_STEPS = 500
+
+    def __init__(self, seed: int = 0):
+        self.rng = np.random.RandomState(seed)
+        self.state = None
+        self.steps = 0
+        self.done = True
+
+    def get_observation_space(self):
+        return ObservationSpace((4,))
+
+    def get_action_space(self):
+        return DiscreteSpace(2)
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self.steps = 0
+        self.done = False
+        return self.state.astype(np.float32).copy()
+
+    def step(self, action: int) -> StepReply:
+        x, x_dot, theta, theta_dot = self.state
+        force = self.FORCE_MAG if action == 1 else -self.FORCE_MAG
+        total_mass = self.MASS_CART + self.MASS_POLE
+        pm_len = self.MASS_POLE * self.LENGTH
+        cos_t, sin_t = math.cos(theta), math.sin(theta)
+        temp = (force + pm_len * theta_dot ** 2 * sin_t) / total_mass
+        theta_acc = (self.GRAVITY * sin_t - cos_t * temp) / \
+            (self.LENGTH * (4.0 / 3.0 - self.MASS_POLE * cos_t ** 2 / total_mass))
+        x_acc = temp - pm_len * theta_acc * cos_t / total_mass
+        x += self.TAU * x_dot
+        x_dot += self.TAU * x_acc
+        theta += self.TAU * theta_dot
+        theta_dot += self.TAU * theta_acc
+        self.state = np.array([x, x_dot, theta, theta_dot])
+        self.steps += 1
+        self.done = bool(abs(x) > self.X_THRESHOLD
+                         or abs(theta) > self.THETA_THRESHOLD
+                         or self.steps >= self.MAX_STEPS)
+        return StepReply(self.state.astype(np.float32).copy(), 1.0, self.done)
+
+    def is_done(self):
+        return self.done
+
+    def new_instance(self):
+        return CartPole(seed=int(self.rng.randint(2 ** 31)))
+
+
+class GridWorld(MDP):
+    """1-D corridor: start left, +1 at the right end, -0.01 per step.
+    Deterministic — handy for exact-convergence tests (ref: rl4j's toy MDPs
+    under rl4j-core test fixtures)."""
+
+    def __init__(self, length: int = 8):
+        self.length = length
+        self.pos = 0
+        self.done = True
+
+    def get_observation_space(self):
+        return ObservationSpace((self.length,))
+
+    def get_action_space(self):
+        return DiscreteSpace(2)   # 0 left, 1 right
+
+    def _obs(self):
+        v = np.zeros(self.length, dtype=np.float32)
+        v[self.pos] = 1.0
+        return v
+
+    def reset(self):
+        self.pos = 0
+        self.done = False
+        return self._obs()
+
+    def step(self, action):
+        self.pos = max(0, self.pos - 1) if action == 0 \
+            else min(self.length - 1, self.pos + 1)
+        self.done = self.pos == self.length - 1
+        reward = 1.0 if self.done else -0.01
+        return StepReply(self._obs(), reward, self.done)
+
+    def is_done(self):
+        return self.done
+
+    def new_instance(self):
+        return GridWorld(self.length)

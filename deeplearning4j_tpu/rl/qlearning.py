@@ -1,0 +1,228 @@
+"""Deep Q-learning (sync, discrete actions).
+
+Reference: ``org.deeplearning4j.rl4j.learning.sync.qlearning.discrete.
+QLearningDiscrete(Dense)`` + ``QLearning.QLConfiguration``, policy classes
+``EpsGreedy``/``DQNPolicy`` (SURVEY E4). Double DQN and dueling heads are
+supported like the reference's configuration flags.
+
+TPU-first: the TD-target computation and the gradient step run as one jitted
+program over the replay batch; the target network is a param pytree copy.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
+
+
+@dataclasses.dataclass
+class QLearningConfiguration:
+    """ref: QLearning.QLConfiguration builder fields."""
+    seed: int = 123
+    max_epoch_step: int = 500
+    max_step: int = 10_000
+    exp_rep_max_size: int = 150_000
+    batch_size: int = 64
+    target_dqn_update_freq: int = 100
+    update_start: int = 100
+    reward_factor: float = 1.0
+    gamma: float = 0.99
+    error_clamp: float = 1.0
+    min_epsilon: float = 0.05
+    epsilon_nb_step: int = 3000
+    double_dqn: bool = True
+    learning_rate: float = 1e-3
+
+
+class EpsGreedy:
+    """ref: rl4j.policy.EpsGreedy — linear epsilon decay."""
+
+    def __init__(self, conf: QLearningConfiguration, rng):
+        self.conf = conf
+        self.rng = rng
+        self.step = 0
+
+    def epsilon(self) -> float:
+        c = self.conf
+        frac = min(1.0, self.step / max(c.epsilon_nb_step, 1))
+        return 1.0 + (c.min_epsilon - 1.0) * frac
+
+    def next_action(self, q_values: np.ndarray) -> int:
+        self.step += 1
+        if self.rng.rand() < self.epsilon():
+            return int(self.rng.randint(len(q_values)))
+        return int(np.argmax(q_values))
+
+    nextAction = next_action
+
+    def next_action_lazy(self, n_actions: int, q_supplier) -> int:
+        """Decide explore-vs-exploit BEFORE computing Q — skips the device
+        round-trip for the exploration fraction of steps."""
+        self.step += 1
+        if self.rng.rand() < self.epsilon():
+            return int(self.rng.randint(n_actions))
+        return int(np.argmax(q_supplier()))
+
+
+class DQNPolicy:
+    """Greedy policy over a trained Q-network (ref: rl4j.policy.DQNPolicy)."""
+
+    def __init__(self, learner: "QLearningDiscreteDense"):
+        self.learner = learner
+
+    def next_action(self, observation) -> int:
+        return int(np.argmax(self.learner.q_values(observation)))
+
+    nextAction = next_action
+
+    def play(self, mdp: MDP, max_steps: int = 10_000) -> float:
+        """Run one greedy episode, return total reward (ref: Policy#play)."""
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            reply = mdp.step(self.next_action(obs))
+            total += reply.reward
+            obs = reply.observation
+            if reply.done:
+                break
+        return total
+
+
+class QLearningDiscreteDense:
+    """ref: QLearningDiscreteDense — dense-observation DQN trainer."""
+
+    def __init__(self, mdp: MDP, conf: QLearningConfiguration,
+                 hidden: List[int] = (64, 64), dueling: bool = False):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.mdp = mdp
+        self.conf = conf
+        self.dueling = dueling
+        self.rng = np.random.RandomState(conf.seed)
+        self.n_actions = mdp.get_action_space().get_size()
+        obs_shape = mdp.get_observation_space().get_shape()
+        n_in = int(np.prod(obs_shape))
+        self.replay = ExpReplay(conf.exp_rep_max_size, conf.batch_size,
+                                conf.seed)
+
+        # params: list of (W, b) per layer; dueling adds V/A heads
+        key = jax.random.key(conf.seed)
+        sizes = [n_in] + list(hidden)
+        params = {}
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            params[f"W{i}"] = jax.random.normal(k, (a, b)) * np.sqrt(2.0 / a)
+            params[f"b{i}"] = jnp.zeros((b,))
+        key, k1, k2 = jax.random.split(key, 3)
+        if dueling:
+            params["Wv"] = jax.random.normal(k1, (sizes[-1], 1)) * 0.01
+            params["bv"] = jnp.zeros((1,))
+            params["Wa"] = jax.random.normal(k2, (sizes[-1], self.n_actions)) * 0.01
+            params["ba"] = jnp.zeros((self.n_actions,))
+        else:
+            params["Wq"] = jax.random.normal(k1, (sizes[-1], self.n_actions)) * 0.01
+            params["bq"] = jnp.zeros((self.n_actions,))
+        self.params = params
+        self.target_params = jax.tree.map(jnp.array, params)
+        self._opt = optax.adam(conf.learning_rate)
+        self._opt_state = self._opt.init(params)
+        n_hidden = len(hidden)
+
+        def q_fn(p, x):
+            h = x.reshape((x.shape[0], -1))
+            for i in range(n_hidden):
+                h = jnp.maximum(h @ p[f"W{i}"] + p[f"b{i}"], 0.0)
+            if dueling:
+                v = h @ p["Wv"] + p["bv"]
+                a = h @ p["Wa"] + p["ba"]
+                return v + a - jnp.mean(a, axis=1, keepdims=True)
+            return h @ p["Wq"] + p["bq"]
+
+        gamma, clamp = conf.gamma, conf.error_clamp
+        double = conf.double_dqn
+
+        def loss_fn(p, tp, obs, actions, rewards, next_obs, dones):
+            q = q_fn(p, obs)
+            q_taken = q[jnp.arange(q.shape[0]), actions]
+            q_next_t = q_fn(tp, next_obs)
+            if double:
+                best = jnp.argmax(q_fn(p, next_obs), axis=1)
+                q_next = q_next_t[jnp.arange(q_next_t.shape[0]), best]
+            else:
+                q_next = jnp.max(q_next_t, axis=1)
+            target = rewards + gamma * q_next * (1.0 - dones)
+            td = q_taken - jax.lax.stop_gradient(target)
+            if clamp:
+                # Huber: linear outside the clamp — clipping td before
+                # squaring would zero the gradient for large errors and
+                # terminal-state signal would never propagate
+                a = jnp.abs(td)
+                return jnp.mean(jnp.where(a <= clamp, 0.5 * td * td,
+                                          clamp * (a - 0.5 * clamp)))
+            return jnp.mean(td * td)
+
+        @jax.jit
+        def train_step(p, opt_state, tp, obs, actions, rewards, next_obs, dones):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                p, tp, obs, actions, rewards, next_obs, dones)
+            updates, opt_state = self._opt.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+            return p, opt_state, loss
+
+        self._train_step = train_step
+        self._q_fn = jax.jit(q_fn)
+        self._jnp = jnp
+
+    # ------------------------------------------------------------------ api
+    def q_values(self, observation) -> np.ndarray:
+        obs = np.asarray(observation, dtype=np.float32)[None]
+        return np.asarray(self._q_fn(self.params, self._jnp.asarray(obs)))[0]
+
+    def get_policy(self) -> DQNPolicy:
+        return DQNPolicy(self)
+
+    getPolicy = get_policy
+
+    def train(self, on_episode=None) -> List[float]:
+        """Run until conf.max_step env steps; returns per-episode rewards
+        (ref: SyncLearning#train loop + TrainingListener hooks)."""
+        import jax
+        conf = self.conf
+        eps = EpsGreedy(conf, self.rng)
+        episode_rewards = []
+        steps = 0
+        while steps < conf.max_step:
+            obs = self.mdp.reset()
+            ep_reward, ep_steps = 0.0, 0
+            while not self.mdp.is_done() and ep_steps < conf.max_epoch_step \
+                    and steps < conf.max_step:
+                action = eps.next_action_lazy(
+                    self.n_actions, lambda: self.q_values(obs))
+                reply = self.mdp.step(action)
+                self.replay.store(Transition(
+                    np.asarray(obs, np.float32), action,
+                    reply.reward * conf.reward_factor,
+                    np.asarray(reply.observation, np.float32),
+                    reply.done))
+                obs = reply.observation
+                ep_reward += reply.reward
+                ep_steps += 1
+                steps += 1
+                if steps >= conf.update_start and len(self.replay) >= conf.batch_size:
+                    batch = self.replay.get_batch()
+                    self.params, self._opt_state, _ = self._train_step(
+                        self.params, self._opt_state, self.target_params,
+                        *[self._jnp.asarray(b) for b in batch])
+                if steps % conf.target_dqn_update_freq == 0:
+                    self.target_params = jax.tree.map(self._jnp.array,
+                                                      self.params)
+            episode_rewards.append(ep_reward)
+            if on_episode is not None:
+                on_episode(len(episode_rewards), ep_reward)
+        return episode_rewards

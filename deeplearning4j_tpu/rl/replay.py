@@ -1,0 +1,50 @@
+"""Experience replay (ref: org.deeplearning4j.rl4j.learning.sync.ExpReplay +
+Transition, SURVEY E4)."""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+
+class Transition(NamedTuple):
+    observation: np.ndarray
+    action: int
+    reward: float
+    next_observation: np.ndarray
+    done: bool
+
+
+class ExpReplay:
+    """Ring-buffer replay memory with uniform sampling."""
+
+    def __init__(self, max_size: int = 150_000, batch_size: int = 32,
+                 seed: int = 0):
+        self.max_size = max_size
+        self.batch_size = batch_size
+        self.rng = np.random.RandomState(seed)
+        self._store: List[Transition] = []
+        self._pos = 0
+
+    def store(self, t: Transition):
+        if len(self._store) < self.max_size:
+            self._store.append(t)
+        else:
+            self._store[self._pos] = t
+        self._pos = (self._pos + 1) % self.max_size
+
+    def __len__(self):
+        return len(self._store)
+
+    def get_batch(self, batch_size: Optional[int] = None):
+        """Stacked arrays (obs, actions, rewards, next_obs, dones)."""
+        n = batch_size or self.batch_size
+        idx = self.rng.randint(0, len(self._store), size=n)
+        ts = [self._store[i] for i in idx]
+        return (np.stack([t.observation for t in ts]).astype(np.float32),
+                np.asarray([t.action for t in ts], dtype=np.int32),
+                np.asarray([t.reward for t in ts], dtype=np.float32),
+                np.stack([t.next_observation for t in ts]).astype(np.float32),
+                np.asarray([t.done for t in ts], dtype=np.float32))
+
+    getBatch = get_batch

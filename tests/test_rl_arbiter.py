@@ -1,0 +1,175 @@
+"""RL (E4) and hyperparameter search (E5) tests
+(ref analogs: rl4j QLearningDiscreteTest / PolicyTest; arbiter
+TestRandomSearch / TestGridSearch)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (A2CConfiguration, A2CDiscreteDense,
+                                   CartPole, DQNPolicy, ExpReplay, GridWorld,
+                                   QLearningConfiguration,
+                                   QLearningDiscreteDense, Transition)
+
+
+def test_cartpole_dynamics():
+    env = CartPole(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0
+    while not env.is_done():
+        r = env.step(1)
+        total += 1
+        assert r.reward == 1.0
+    assert 1 < total < 500   # always-right fails fast but not instantly
+
+
+def test_replay_buffer():
+    rep = ExpReplay(max_size=10, batch_size=4, seed=0)
+    for i in range(25):
+        rep.store(Transition(np.full(3, i, np.float32), i % 2, float(i),
+                             np.full(3, i + 1, np.float32), False))
+    assert len(rep) == 10
+    obs, act, rew, nobs, done = rep.get_batch()
+    assert obs.shape == (4, 3) and rew.min() >= 15   # only recent kept
+
+
+def test_dqn_gridworld_learns():
+    conf = QLearningConfiguration(seed=0, max_step=3000, batch_size=32,
+                                  update_start=50, target_dqn_update_freq=100,
+                                  epsilon_nb_step=1500, gamma=0.95,
+                                  learning_rate=5e-3, max_epoch_step=60)
+    learner = QLearningDiscreteDense(GridWorld(8), conf, hidden=[32])
+    learner.train()
+    policy = learner.get_policy()
+    # greedy policy should walk straight right: 7 steps, reward 1 - 6*0.01
+    reward = policy.play(GridWorld(8), max_steps=20)
+    assert reward > 0.9
+
+
+def test_dqn_cartpole_improves():
+    conf = QLearningConfiguration(seed=3, max_step=6000, batch_size=64,
+                                  update_start=200, target_dqn_update_freq=200,
+                                  epsilon_nb_step=3000, learning_rate=1e-3,
+                                  max_epoch_step=200)
+    learner = QLearningDiscreteDense(CartPole(seed=1), conf, hidden=[64, 64])
+    rewards = learner.train()
+    early = np.mean(rewards[:5])
+    policy_reward = np.mean([learner.get_policy().play(CartPole(seed=100 + i))
+                             for i in range(5)])
+    assert policy_reward > early
+    assert policy_reward > 50
+
+
+def test_dueling_double_dqn_builds():
+    conf = QLearningConfiguration(seed=0, max_step=300, update_start=50,
+                                  double_dqn=True, max_epoch_step=50)
+    learner = QLearningDiscreteDense(GridWorld(5), conf, hidden=[16],
+                                     dueling=True)
+    learner.train()
+    q = learner.q_values(GridWorld(5).reset())
+    assert q.shape == (2,)
+
+
+def test_a2c_gridworld_learns():
+    conf = A2CConfiguration(seed=1, max_step=8000, n_step=8, gamma=0.95,
+                            learning_rate=3e-3, max_epoch_step=60)
+    agent = A2CDiscreteDense(GridWorld(6), conf, hidden=[32])
+    agent.train()
+    assert agent.play(GridWorld(6), max_steps=20) > 0.9
+
+
+# ------------------------------------------------------------------ arbiter
+def _toy_iter(seed=0, n=96):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4).astype("f4")
+    y = (X @ [2.0, -1.0, 1.0, -2.0] > 0).astype(int)
+    return [DataSet(X, np.eye(2)[y].astype("f4"))]
+
+
+def test_parameter_spaces():
+    from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                            DiscreteParameterSpace,
+                                            IntegerParameterSpace)
+    c = ContinuousParameterSpace(0.001, 0.1, log_scale=True)
+    assert 0.001 <= c.value_for(0.0) < c.value_for(0.999) <= 0.1
+    i = IntegerParameterSpace(8, 32)
+    vals = {i.value_for(u) for u in np.linspace(0, 0.999, 50)}
+    assert min(vals) == 8 and max(vals) == 32
+    d = DiscreteParameterSpace("relu", "tanh")
+    assert d.value_for(0.1) == "relu" and d.value_for(0.9) == "tanh"
+    assert d.grid_values(5) == ["relu", "tanh"]
+
+
+def test_random_search_finds_good_lr():
+    from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                            DataSetLossScoreFunction,
+                                            IntegerParameterSpace,
+                                            LocalOptimizationRunner,
+                                            MaxCandidatesCondition,
+                                            OptimizationConfiguration,
+                                            RandomSearchGenerator)
+    from deeplearning4j_tpu.arbiter.space import (DenseLayerSpace,
+                                                  MultiLayerSpace,
+                                                  OutputLayerSpace)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    space = (MultiLayerSpace.Builder()
+             .seed(1)
+             .updater(ContinuousParameterSpace(1e-3, 1e-1, log_scale=True))
+             .add_layer(DenseLayerSpace(n_in=4,
+                                        n_out=IntegerParameterSpace(8, 24),
+                                        activation="relu"))
+             .add_layer(OutputLayerSpace(n_out=2, activation="softmax",
+                                        loss_function="mcxent"))
+             .set_input_type(InputType.feed_forward(4))
+             .build())
+    # every kwarg is a leaf space (fixed ones are FixedValue leaves):
+    # updater {lr, kind} + dense {n_in, n_out, activation} + out {n_out,
+    # activation, loss_function}
+    assert space.num_parameters() == 8
+
+    conf = OptimizationConfiguration(
+        candidate_generator=RandomSearchGenerator(space, seed=2),
+        score_function=DataSetLossScoreFunction(),
+        termination_conditions=[MaxCandidatesCondition(4)],
+        train_data=_toy_iter(0), test_data=_toy_iter(1), epochs=30)
+    runner = LocalOptimizationRunner(conf)
+    best = runner.execute()
+    assert len(runner.results) == 4
+    assert best.score == min(r.score for r in runner.results)
+    assert best.score < 0.5   # the best of 4 should fit this separable toy
+
+
+def test_grid_search_enumerates():
+    from deeplearning4j_tpu.arbiter import (DiscreteParameterSpace,
+                                            EvaluationScoreFunction,
+                                            GridSearchCandidateGenerator,
+                                            LocalOptimizationRunner,
+                                            MaxCandidatesCondition,
+                                            OptimizationConfiguration)
+    from deeplearning4j_tpu.arbiter.space import (DenseLayerSpace,
+                                                  MultiLayerSpace,
+                                                  OutputLayerSpace)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    space = (MultiLayerSpace.Builder()
+             .seed(1).updater(0.05)
+             .add_layer(DenseLayerSpace(
+                 n_in=4, n_out=8,
+                 activation=DiscreteParameterSpace("relu", "tanh")))
+             .add_layer(OutputLayerSpace(n_out=2, activation="softmax",
+                                        loss_function="mcxent"))
+             .set_input_type(InputType.feed_forward(4))
+             .build())
+    gen = GridSearchCandidateGenerator(space, discretization_count=2)
+    candidates = list(gen)
+    acts = {c.layers[0].activation for c in candidates}
+    assert acts == {"relu", "tanh"}
+
+    conf = OptimizationConfiguration(
+        candidate_generator=GridSearchCandidateGenerator(space, 2),
+        score_function=EvaluationScoreFunction("accuracy"),
+        termination_conditions=[MaxCandidatesCondition(100)],
+        train_data=_toy_iter(0), test_data=_toy_iter(1), epochs=25)
+    best = LocalOptimizationRunner(conf).execute()
+    assert best.score > 0.8
