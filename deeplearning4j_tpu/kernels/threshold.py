@@ -1,0 +1,97 @@
+"""Threshold gradient codec (Strom 2015).
+
+Reference: the ``encode_threshold`` / ``decode_threshold`` native ops in
+libnd4j's compression group + ``EncodedGradientsAccumulator`` residual logic
+(SURVEY N9/D7). On TPU, in-slice gradient exchange is dense allreduce over
+ICI (the codec is deliberately NOT used there — SURVEY 2.4 P9); this codec
+is kept for the DCN cross-slice path and for behavioral parity with the
+reference's gradient-sharing stack.
+
+Encoding (reference format): a fixed-capacity int32 buffer; entry 0 holds
+the element count, entries [1..n] hold ±(flat_index+1) — positive for
+values >= +threshold, negative for <= -threshold. Values are clamped to
+±threshold and SUBTRACTED from the residual by the caller (see
+parallel/master.py's accumulator).
+
+Shapes are static everywhere (capacity-bounded via jnp.nonzero's ``size``),
+so encode/decode jit cleanly.
+
+Three codec forms exist by design, one per transport boundary:
+- this module: the sparse ±(idx+1) *wire format* (what crosses DCN), jitted;
+- ``native/`` host_ops.cpp: the same wire format on the host CPU (NIC-side);
+- ``ops/standard.py`` encode_threshold: a *dense sign-mask* device form for
+  in-graph use where XLA needs static dense shapes (no wire compatibility
+  intended — convert with ``sparse_from_dense``/``dense_from_sparse``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def threshold_encode(updates: jnp.ndarray, threshold: float,
+                     capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Encode |values| >= threshold into a sparse int32 buffer.
+
+    Returns (encoded (capacity+1,) int32, residual_after) where
+    residual_after = updates minus the ±threshold mass that was encoded.
+    At most ``capacity`` elements are encoded (first by flat index, like the
+    reference's capped buffer); the rest stay in the residual.
+    """
+    flat = updates.reshape(-1)
+    hit = jnp.abs(flat) >= threshold
+    idx = jnp.nonzero(hit, size=capacity, fill_value=-1)[0]
+    valid = idx >= 0
+    n = jnp.sum(valid.astype(jnp.int32))
+    safe_idx = jnp.maximum(idx, 0)
+    sign = jnp.sign(flat[safe_idx])
+    entries = jnp.where(valid, (safe_idx + 1) * sign.astype(jnp.int32), 0)
+    encoded = jnp.concatenate([n[None], entries.astype(jnp.int32)])
+    # subtract encoded mass from the residual
+    delta = jnp.zeros_like(flat).at[safe_idx].add(
+        jnp.where(valid, sign * threshold, 0.0))
+    return encoded, (flat - delta).reshape(updates.shape)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def threshold_decode(encoded: jnp.ndarray, threshold: float,
+                     shape: Tuple[int, ...]) -> jnp.ndarray:
+    """Decode a sparse buffer back to a dense ±threshold update tensor."""
+    entries = encoded[1:]
+    n = encoded[0]
+    slot = jnp.arange(entries.shape[0])
+    valid = (slot < n) & (entries != 0)
+    idx = jnp.abs(entries) - 1
+    safe_idx = jnp.where(valid, idx, 0)
+    vals = jnp.where(valid, jnp.sign(entries).astype(jnp.float32) * threshold,
+                     0.0)
+    size = 1
+    for s in shape:
+        size *= s
+    dense = jnp.zeros((size,), jnp.float32).at[safe_idx].add(vals)
+    return dense.reshape(shape)
+
+
+def sparse_from_dense(signs: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Convert ops/standard.py's dense sign-mask form to the wire format."""
+    idx = jnp.nonzero(signs != 0, size=capacity, fill_value=-1)[0]
+    valid = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    entries = jnp.where(valid,
+                        (safe + 1) * signs[safe].astype(jnp.int32), 0)
+    n = jnp.sum(valid.astype(jnp.int32))
+    return jnp.concatenate([n[None], entries.astype(jnp.int32)])
+
+
+def dense_from_sparse(encoded: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Wire format back to a dense int8 sign mask."""
+    entries = encoded[1:]
+    valid = entries != 0
+    idx = jnp.abs(entries) - 1
+    safe = jnp.where(valid, idx, 0)
+    vals = jnp.where(valid, jnp.sign(entries), 0).astype(jnp.int8)
+    return jnp.zeros((size,), jnp.int8).at[safe].max(vals)

@@ -31,6 +31,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from deeplearning4j_tpu.parallel.ring import ring_attention, _plain_attention
 
+# attention backend override: None = auto (flash kernel on TPU, XLA attention
+# elsewhere — interpret-mode pallas is slow on CPU); True/False forces it
+FLASH_ATTENTION: Optional[bool] = None
+
+
+def _use_flash_attention() -> bool:
+    if FLASH_ATTENTION is not None:
+        return FLASH_ATTENTION
+    return jax.default_backend() == "tpu"
+
 
 @dataclasses.dataclass
 class TransformerConfig:
@@ -131,6 +141,14 @@ class TransformerLM:
         v = (x @ p["wv"]).reshape(b, t, h, hd)
         if mesh is not None and SEQ_AXIS in mesh.axis_names:
             o = ring_attention(q, k, v, mesh, causal=c.causal)
+        elif _use_flash_attention():
+            # Pallas flash kernel: O(T·d) memory (ref of N4's platform
+            # override hook — kernel swapped in when the platform supports it)
+            from deeplearning4j_tpu.kernels import flash_attention
+            o4 = flash_attention(q.transpose(0, 2, 1, 3),
+                                 k.transpose(0, 2, 1, 3),
+                                 v.transpose(0, 2, 1, 3), causal=c.causal)
+            o = o4.transpose(0, 2, 1, 3)
         else:
             o = _plain_attention(q, k, v, causal=c.causal)
         return o.reshape(b, t, c.d_model) @ p["wo"]

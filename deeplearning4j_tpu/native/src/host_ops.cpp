@@ -1,0 +1,182 @@
+// Host-side native ops for the TPU framework.
+//
+// Role (SURVEY N5/N9/E1): the reference keeps its runtime-adjacent hot loops
+// in C++ (libnd4j's NativeOps C ABI). On TPU the device math belongs to
+// XLA/Pallas, but host-side work — the threshold gradient codec used on the
+// DCN cross-slice path, and ETL parsing feeding the input pipeline — still
+// benefits from native code. This library exposes a flat C ABI consumed via
+// ctypes (the JavaCPP-preset analog).
+//
+// Build: `make` in deeplearning4j_tpu/native (g++ -O3 -fPIC -shared).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Threshold codec (Strom 2015) — format matches kernels/threshold.py:
+// out[0] = count, out[1..] = ±(flat_index+1). Returns number encoded.
+// Residual is updated in place (encoded mass subtracted).
+// ---------------------------------------------------------------------------
+int64_t threshold_encode(float* residual, int64_t n, float threshold,
+                         int32_t* out, int64_t capacity) {
+    int64_t count = 0;
+    for (int64_t i = 0; i < n && count < capacity; ++i) {
+        float v = residual[i];
+        if (v >= threshold) {
+            out[1 + count++] = (int32_t)(i + 1);
+            residual[i] = v - threshold;
+        } else if (v <= -threshold) {
+            out[1 + count++] = -(int32_t)(i + 1);
+            residual[i] = v + threshold;
+        }
+    }
+    out[0] = (int32_t)count;
+    for (int64_t i = 1 + count; i < capacity + 1; ++i) out[i] = 0;
+    return count;
+}
+
+// Accumulate a decoded buffer into `target` (+= ±threshold per entry).
+int64_t threshold_decode(const int32_t* encoded, float threshold,
+                         float* target, int64_t n) {
+    int32_t count = encoded[0];
+    for (int32_t c = 0; c < count; ++c) {
+        int32_t e = encoded[1 + c];
+        if (e == 0) continue;
+        int64_t idx = (e > 0 ? e : -e) - 1;
+        if (idx >= n) continue;
+        target[idx] += (e > 0 ? threshold : -threshold);
+    }
+    return count;
+}
+
+// ---------------------------------------------------------------------------
+// CSV fast path: parse a whole file of delimiter-separated floats.
+// Two-phase API: csv_count sizes the output, csv_parse fills it.
+// Non-numeric fields parse as NaN (callers handle categorical columns in
+// Python — the numeric bulk is the hot part).
+// ---------------------------------------------------------------------------
+static char* read_file(const char* path, int64_t* out_len) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return nullptr;
+    fseek(f, 0, SEEK_END);
+    long len = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    char* buf = (char*)malloc(len + 1);
+    if (!buf) { fclose(f); return nullptr; }
+    size_t rd = fread(buf, 1, len, f);
+    fclose(f);
+    buf[rd] = '\0';
+    *out_len = (int64_t)rd;
+    return buf;
+}
+
+// Returns rows; writes max columns to *cols. -1 on I/O error.
+int64_t csv_count(const char* path, char delim, int64_t skip_rows,
+                  int64_t* cols) {
+    int64_t len;
+    char* buf = read_file(path, &len);
+    if (!buf) return -1;
+    int64_t rows = 0, cur_cols = 1, max_cols = 0, row_i = 0;
+    bool line_empty = true;
+    for (int64_t i = 0; i < len; ++i) {
+        char c = buf[i];
+        if (c == '\n') {
+            if (!line_empty && row_i >= skip_rows) {
+                ++rows;
+                if (cur_cols > max_cols) max_cols = cur_cols;
+            }
+            if (!line_empty) ++row_i;
+            cur_cols = 1;
+            line_empty = true;
+        } else if (c == delim) {
+            ++cur_cols;
+            line_empty = false;   // a delimiter-only line is a row of NaNs
+        } else if (c != '\r' && c != ' ' && c != '\t') {
+            line_empty = false;
+        }
+    }
+    if (!line_empty && row_i >= skip_rows) {
+        ++rows;
+        if (cur_cols > max_cols) max_cols = cur_cols;
+    }
+    free(buf);
+    *cols = max_cols;
+    return rows;
+}
+
+// Fills out[rows*cols] row-major. Returns rows parsed, -1 on error.
+int64_t csv_parse(const char* path, char delim, int64_t skip_rows,
+                  float* out, int64_t rows, int64_t cols) {
+    int64_t len;
+    char* buf = read_file(path, &len);
+    if (!buf) return -1;
+    int64_t row = 0, row_i = 0;
+    char* p = buf;
+    char* end = buf + len;
+    while (p < end && row < rows) {
+        // find line end
+        char* nl = (char*)memchr(p, '\n', end - p);
+        char* line_end = nl ? nl : end;
+        // blank line? (delimiters count as content — matches csv_count)
+        bool blank = true;
+        for (char* q = p; q < line_end; ++q)
+            if (*q != '\r' && *q != ' ' && *q != '\t') { blank = false; break; }
+        if (!blank) {
+            if (row_i >= skip_rows) {
+                // terminate the line so strtof cannot read past it into the
+                // next row (e.g. a trailing empty field before '\n')
+                char saved = *line_end;
+                *line_end = '\0';
+                int64_t col = 0;
+                char* q = p;
+                while (q <= line_end && col < cols) {
+                    char* endptr;
+                    float v = strtof(q, &endptr);
+                    if (endptr == q) v = NAN;   // non-numeric/empty field
+                    out[row * cols + col] = v;
+                    ++col;
+                    // advance to next delimiter
+                    char* dq = q;
+                    while (dq < line_end && *dq != delim) ++dq;
+                    if (dq >= line_end) break;
+                    q = dq + 1;
+                }
+                for (; col < cols; ++col) out[row * cols + col] = NAN;
+                *line_end = saved;
+                ++row;
+            }
+            ++row_i;
+        }
+        if (!nl) break;
+        p = nl + 1;
+    }
+    free(buf);
+    return row;
+}
+
+// ---------------------------------------------------------------------------
+// Fisher-Yates shuffle of row indices (the shuffle-buffer hot loop).
+// ---------------------------------------------------------------------------
+void shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+    uint64_t s = seed ? seed : 0x9E3779B97F4A7C15ULL;
+    for (int64_t i = n - 1; i > 0; --i) {
+        // splitmix64
+        s += 0x9E3779B97F4A7C15ULL;
+        uint64_t z = s;
+        z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+        z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+        z = z ^ (z >> 31);
+        int64_t j = (int64_t)(z % (uint64_t)(i + 1));
+        int64_t t = idx[i];
+        idx[i] = idx[j];
+        idx[j] = t;
+    }
+}
+
+}  // extern "C"

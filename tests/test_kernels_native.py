@@ -1,0 +1,166 @@
+"""Pallas kernel crosschecks (the cuDNN-crosscheck analog, SURVEY §4) and
+native host-ops tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.kernels import (flash_attention, threshold_decode,
+                                        threshold_encode)
+from deeplearning4j_tpu.kernels.flash_attention import naive_attention
+
+
+def _qkv(b, t, d, seed=0, dtype="float32"):
+    rng = np.random.RandomState(seed)
+    return tuple(jnp.asarray(rng.randn(b, t, d).astype(dtype)) * 0.3
+                 for _ in range(3))
+
+
+class TestFlashAttention:
+    def test_matches_naive(self):
+        q, k, v = _qkv(2, 64, 16)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = naive_attention(q, k, v)
+        assert np.allclose(out, ref, atol=1e-5), np.abs(out - ref).max()
+
+    def test_causal_matches_naive(self):
+        q, k, v = _qkv(2, 48, 8, seed=1)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = naive_attention(q, k, v, causal=True)
+        assert np.allclose(out, ref, atol=1e-5)
+
+    def test_ragged_seq_blocks(self):
+        # seq length not divisible by block size
+        q, k, v = _qkv(1, 50, 8, seed=2)
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = naive_attention(q, k, v)
+        assert np.allclose(out, ref, atol=1e-5)
+
+    def test_4d_input(self):
+        rng = np.random.RandomState(3)
+        q, k, v = (jnp.asarray(rng.randn(2, 4, 32, 8).astype("f4")) * 0.3
+                   for _ in range(3))
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        assert out.shape == (2, 4, 32, 8)
+
+    def test_gradients_match_naive(self):
+        q, k, v = _qkv(1, 32, 8, seed=4)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=16, block_k=16) ** 2)
+
+        def loss_naive(q, k, v):
+            return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gn, "qkv"):
+            assert np.allclose(a, b, atol=1e-4), (name, np.abs(a - b).max())
+
+    def test_inside_jit_and_memory_shape(self):
+        q, k, v = _qkv(1, 128, 16, seed=5)
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=64,
+                                                    block_k=64))
+        out = f(q, k, v)
+        assert np.allclose(out, naive_attention(q, k, v), atol=1e-5)
+
+
+class TestThresholdCodec:
+    def test_roundtrip(self):
+        rng = np.random.RandomState(0)
+        g = jnp.asarray(rng.randn(50).astype("f4"))
+        enc, residual = threshold_encode(g, 1.0, capacity=64)
+        dec = threshold_decode(enc, 1.0, (50,))
+        # decoded + residual reconstructs the original exactly
+        assert np.allclose(np.asarray(dec) + np.asarray(residual),
+                           np.asarray(g), atol=1e-6)
+        n = int(enc[0])
+        assert n == int(np.sum(np.abs(np.asarray(g)) >= 1.0))
+
+    def test_capacity_cap(self):
+        g = jnp.ones((100,)) * 5.0
+        enc, residual = threshold_encode(g, 1.0, capacity=10)
+        assert int(enc[0]) == 10
+        dec = threshold_decode(enc, 1.0, (100,))
+        assert float(jnp.sum(dec)) == pytest.approx(10.0)
+        # unencoded elements keep full residual; encoded keep 4.0
+        assert float(jnp.max(residual)) == pytest.approx(5.0)
+        assert float(jnp.min(residual)) == pytest.approx(4.0)
+
+    def test_jit_static_shapes(self):
+        g = jnp.asarray(np.random.RandomState(1).randn(4, 8).astype("f4"))
+        enc, res = threshold_encode(g, 0.5, capacity=16)
+        assert enc.shape == (17,)
+        assert res.shape == (4, 8)
+        dec = threshold_decode(enc, 0.5, (4, 8))
+        assert dec.shape == (4, 8)
+
+
+import shutil
+
+_HAS_GXX = shutil.which("g++") is not None
+
+
+class TestNativeHostOps:
+    def test_library_builds(self):
+        from deeplearning4j_tpu import native
+        if not _HAS_GXX:
+            pytest.skip("no g++ toolchain; numpy fallback is the designed path")
+        assert native.is_native(), "g++ build of host ops failed"
+
+    def test_threshold_host_matches_jax(self):
+        from deeplearning4j_tpu import native
+        rng = np.random.RandomState(2)
+        g = rng.randn(64).astype("f4")
+        enc_h, res_h = native.threshold_encode_host(g, 1.0, 32)
+        enc_j, res_j = threshold_encode(jnp.asarray(g), 1.0, 32)
+        assert enc_h[0] == int(enc_j[0])
+        assert set(enc_h[1:1 + enc_h[0]]) == \
+            set(int(x) for x in np.asarray(enc_j[1:]) if x != 0)
+        assert np.allclose(res_h, np.asarray(res_j), atol=1e-6)
+        # decode accumulates into target
+        dec = native.threshold_decode_host(enc_h, 1.0, np.zeros(64, "f4"))
+        assert np.allclose(dec + res_h, g, atol=1e-6)
+
+    def test_csv_native(self, tmp_path):
+        from deeplearning4j_tpu import native
+        p = tmp_path / "d.csv"
+        p.write_text("# header\n1.5,2,3\n4,hello,6\n\n7,8,9\n")
+        arr = native.csv_read_floats(str(p), skip_rows=1)
+        assert arr.shape == (3, 3)
+        assert arr[0, 0] == pytest.approx(1.5)
+        assert np.isnan(arr[1, 1])
+        assert arr[2, 2] == pytest.approx(9.0)
+
+    def test_shuffle_indices(self):
+        from deeplearning4j_tpu import native
+        a = native.shuffle_indices(100, seed=7)
+        b = native.shuffle_indices(100, seed=7)
+        c = native.shuffle_indices(100, seed=8)
+        assert np.array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert sorted(a.tolist()) == list(range(100))
+
+
+def test_transformer_flash_path_matches_plain():
+    """Forcing the flash backend must not change TransformerLM outputs
+    (the cuDNN-crosscheck analog at model level)."""
+    import deeplearning4j_tpu.models.transformer as tr
+    import numpy as np
+    cfg = tr.TransformerConfig(vocab_size=64, n_layers=1, n_heads=2,
+                               d_model=16, d_ff=32, max_len=32,
+                               dtype="float32")
+    model = tr.TransformerLM(cfg)
+    params = model.init_params(jax.random.key(0))
+    tokens = np.random.RandomState(0).randint(0, 64, (2, 16)).astype("i4")
+    try:
+        tr.FLASH_ATTENTION = False
+        out_plain = np.asarray(model.apply(params, tokens))
+        tr.FLASH_ATTENTION = True
+        out_flash = np.asarray(model.apply(params, tokens))
+    finally:
+        tr.FLASH_ATTENTION = None
+    assert np.allclose(out_plain, out_flash, atol=2e-4), \
+        np.abs(out_plain - out_flash).max()
