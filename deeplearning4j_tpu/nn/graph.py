@@ -202,11 +202,15 @@ class ComputationGraph:
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(inputs, labels) | fit(DataSet/MultiDataSet) | fit(iterator)."""
         if labels is not None:
-            self._fit_batch(_as_tuple(data), _as_tuple(labels))
+            for _ in range(epochs):
+                self._fit_batch(_as_tuple(data), _as_tuple(labels))
             return self
         if hasattr(data, "features"):
-            self._fit_batch(_as_tuple(data.features), _as_tuple(data.labels),
-                            _ds_masks(data, "features"), _ds_masks(data, "labels"))
+            for _ in range(epochs):
+                self._fit_batch(_as_tuple(data.features),
+                                _as_tuple(data.labels),
+                                _ds_masks(data, "features"),
+                                _ds_masks(data, "labels"))
             return self
         for _ in range(epochs):
             for lst in self._listeners:

@@ -256,12 +256,14 @@ class MultiLayerNetwork:
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x, y) | fit(DataSet) | fit(iterator[, epochs]) (ref surface)."""
         if labels is not None:
-            self._fit_batch(data, labels)
+            for _ in range(epochs):
+                self._fit_batch(data, labels)
             return self
         if hasattr(data, "features"):  # DataSet
-            self._fit_batch(data.features, data.labels,
-                            getattr(data, "features_mask", None),
-                            getattr(data, "labels_mask", None))
+            for _ in range(epochs):
+                self._fit_batch(data.features, data.labels,
+                                getattr(data, "features_mask", None),
+                                getattr(data, "labels_mask", None))
             return self
         # iterator protocol
         for ep in range(epochs):

@@ -1,0 +1,153 @@
+"""ParallelInference: batched serving facade.
+
+Reference: ``org.deeplearning4j.parallelism.ParallelInference`` (SURVEY P8) —
+per-device model replicas with INSTANT / BATCHED modes. TPU-first collapse:
+there is ONE compiled program; "replicas" are the mesh's data-axis shards,
+and XLA already pipelines concurrent calls. What survives is the *dynamic
+batching* queue: BATCHED mode coalesces concurrent small requests into one
+device call (padding to the configured batch size so the executable is
+reused), which is where serving throughput on an accelerator comes from.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class InferenceMode:
+    INSTANT = "INSTANT"
+    BATCHED = "BATCHED"
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class ParallelInference:
+    """ref API: ParallelInference.Builder(model).inferenceMode(...)
+    .batchLimit(n).queueLimit(n).build(); output(x)."""
+
+    def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
+                 batch_limit: int = 32, queue_limit: int = 64,
+                 max_wait_ms: float = 5.0):
+        self.model = model
+        self.mode = inference_mode
+        self.batch_limit = batch_limit
+        self.max_wait_ms = max_wait_ms
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if self.mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._serve_loop,
+                                            daemon=True)
+            self._worker.start()
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def inference_mode(self, mode):
+            self._kw["inference_mode"] = mode
+            return self
+
+        inferenceMode = inference_mode
+
+        def batch_limit(self, n):
+            self._kw["batch_limit"] = n
+            return self
+
+        batchLimit = batch_limit
+
+        def queue_limit(self, n):
+            self._kw["queue_limit"] = n
+            return self
+
+        queueLimit = queue_limit
+
+        def build(self):
+            return ParallelInference(self._model, **self._kw)
+
+    # ----------------------------------------------------------------- api
+    def output(self, x) -> np.ndarray:
+        x = np.asarray(x)
+        if self.mode == InferenceMode.INSTANT:
+            return np.asarray(self.model.output(x))
+        if self._stop.is_set():
+            raise RuntimeError("ParallelInference has been shut down")
+        req = _Request(x)
+        self._queue.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def shutdown(self):
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=2.0)
+        # fail any requests that were still queued so callers never hang
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.error = RuntimeError("ParallelInference shut down")
+            req.event.set()
+
+    # ---------------------------------------------------------- batch loop
+    def _serve_loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            import time as _time
+            batch: List[_Request] = [first]
+            total = first.x.shape[0]
+            # coalesce within ONE wait window, never exceeding batch_limit
+            # (exceeding it would skip the fixed-shape padding and trigger
+            # an XLA recompile per distinct total)
+            deadline = _time.monotonic() + self.max_wait_ms / 1e3
+            while total < self.batch_limit:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if total + nxt.x.shape[0] > self.batch_limit:
+                    # too big for this batch — run it in the next one
+                    self._queue.put(nxt)
+                    break
+                batch.append(nxt)
+                total += nxt.x.shape[0]
+            try:
+                X = np.concatenate([r.x for r in batch], axis=0)
+                n = X.shape[0]
+                # pad to batch_limit so the compiled executable is reused
+                if n < self.batch_limit:
+                    pad = np.zeros((self.batch_limit - n,) + X.shape[1:],
+                                   X.dtype)
+                    X = np.concatenate([X, pad], axis=0)
+                out = np.asarray(self.model.output(X))[:n]
+                off = 0
+                for r in batch:
+                    k = r.x.shape[0]
+                    r.result = out[off:off + k]
+                    off += k
+                    r.event.set()
+            except Exception as e:             # surface errors to callers
+                for r in batch:
+                    r.error = e
+                    r.event.set()

@@ -1,0 +1,152 @@
+"""Eager op profiler + NaN/Inf panic modes.
+
+Reference: ``org.nd4j.linalg.profiler.{OpProfiler,ProfilerConfig}`` with
+NAN_PANIC / INF_PANIC modes, and libnd4j's ``Environment::setDebug/Verbose``
+(SURVEY J12, 5.1). On TPU, per-op wall time only exists on the *eager* path
+(inside jit there are no per-op boundaries — use ``jax.profiler`` traces for
+compiled code, and ``jax.config.jax_debug_nans`` for in-jit NaN panics; both
+are toggled by :func:`ProfilerConfig.apply`). This profiler instruments the
+registry's eager ``exec_op`` dispatch, which is exactly the layer the
+reference instrumented.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import registry as _registry
+
+
+@dataclasses.dataclass
+class ProfilerConfig:
+    """ref: ProfilerConfig builder flags."""
+    op_timing: bool = False          # aggregate wall time per op name
+    check_for_nan: bool = False      # NAN_PANIC: raise on non-finite output
+    check_for_inf: bool = False     # INF_PANIC
+    verbose: bool = False            # print each eager op (Environment::setVerbose)
+
+    def apply(self):
+        """Also flip the jit-level knobs where they exist (both ways —
+        leaving jax_debug_nans on would tax every later jit globally)."""
+        jax.config.update("jax_debug_nans", bool(self.check_for_nan))
+        return self
+
+
+@dataclasses.dataclass
+class OpStats:
+    invocations: int = 0
+    total_seconds: float = 0.0
+
+    @property
+    def average_ms(self) -> float:
+        return (self.total_seconds / self.invocations * 1e3
+                if self.invocations else 0.0)
+
+
+class OpProfiler:
+    """Singleton-style profiler over the eager exec_op path
+    (ref: OpProfiler#getInstance)."""
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self):
+        self.config = ProfilerConfig()
+        self.stats: Dict[str, OpStats] = collections.defaultdict(OpStats)
+        self._installed = False
+        self._orig_exec = None
+
+    @classmethod
+    def get_instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = OpProfiler()
+        return cls._instance
+
+    getInstance = get_instance
+
+    # ----------------------------------------------------------- lifecycle
+    def set_config(self, config: ProfilerConfig):
+        self.config = config
+        config.apply()
+        if (config.op_timing or config.check_for_nan or config.check_for_inf
+                or config.verbose):
+            self._install()
+        else:
+            self._uninstall()
+        return self
+
+    setConfig = set_config
+
+    def _install(self):
+        if self._installed:
+            return
+        self._orig_exec = _registry.exec_op
+        profiler = self
+
+        def profiled_exec(name, *args, **attrs):
+            t0 = time.perf_counter() if profiler.config.op_timing else None
+            out = profiler._orig_exec(name, *args, **attrs)
+            if t0 is not None:
+                # eager timing: block on the result like the reference's
+                # per-op sync (inside jit this wrapper never runs)
+                jax.block_until_ready(out)
+                st = profiler.stats[name]
+                st.invocations += 1
+                st.total_seconds += time.perf_counter() - t0
+            if profiler.config.verbose:
+                print(f"[op] {name}")
+            if profiler.config.check_for_nan or profiler.config.check_for_inf:
+                profiler._panic_check(name, out)
+            return out
+
+        _registry.exec_op = profiled_exec
+        # layers.py did `from registry import exec_op` and holds its own
+        # reference — patch that binding too (the only other consumer)
+        import deeplearning4j_tpu.nn.conf.layers as layers_mod
+        layers_mod.exec_op = profiled_exec
+        self._installed = True
+
+    def _uninstall(self):
+        if not self._installed:
+            return
+        _registry.exec_op = self._orig_exec
+        import deeplearning4j_tpu.nn.conf.layers as layers_mod
+        layers_mod.exec_op = self._orig_exec
+        self._installed = False
+
+    def _panic_check(self, name, out):
+        # only meaningful on concrete (eager) arrays; traced values skip
+        leaves = out if isinstance(out, (tuple, list)) else [out]
+        for leaf in leaves:
+            if leaf is None or isinstance(leaf, jax.core.Tracer):
+                continue
+            arr = jnp.asarray(leaf)
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                continue
+            if self.config.check_for_nan and bool(jnp.any(jnp.isnan(arr))):
+                raise FloatingPointError(
+                    f"NAN_PANIC: op {name!r} produced NaN")
+            if self.config.check_for_inf and bool(jnp.any(jnp.isinf(arr))):
+                raise FloatingPointError(
+                    f"INF_PANIC: op {name!r} produced Inf")
+
+    # ------------------------------------------------------------- reports
+    def reset(self):
+        self.stats.clear()
+
+    def print_results(self) -> str:
+        lines = [f"{'op':<28}{'calls':>8}{'total ms':>12}{'avg ms':>10}"]
+        for name, st in sorted(self.stats.items(),
+                               key=lambda kv: -kv[1].total_seconds):
+            lines.append(f"{name:<28}{st.invocations:>8}"
+                         f"{st.total_seconds * 1e3:>12.2f}"
+                         f"{st.average_ms:>10.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    printResults = print_results

@@ -1,0 +1,63 @@
+"""Throughput/bandwidth tracking
+(ref: org.nd4j.linalg.api.ops.performance.PerformanceTracker +
+listeners.PerformanceListener internals, SURVEY J12)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class PerformanceTracker:
+    """Examples/sec + host↔device byte accounting. The reference tracks
+    memcpy bandwidth per device; here transfers are whatever crosses the
+    PJRT boundary — callers report them via ``add_transfer_bytes``."""
+
+    _instance: Optional["PerformanceTracker"] = None
+
+    def __init__(self):
+        self.reset()
+
+    @classmethod
+    def get_instance(cls) -> "PerformanceTracker":
+        if cls._instance is None:
+            cls._instance = PerformanceTracker()
+        return cls._instance
+
+    getInstance = get_instance
+
+    def reset(self):
+        self._start = time.time()
+        self.examples = 0
+        self.iterations = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+
+    def record_iteration(self, batch_size: int):
+        self.examples += batch_size
+        self.iterations += 1
+
+    def add_transfer_bytes(self, host_to_device: int = 0,
+                           device_to_host: int = 0):
+        self.h2d_bytes += host_to_device
+        self.d2h_bytes += device_to_host
+
+    addMemoryTransaction = add_transfer_bytes
+
+    @property
+    def elapsed(self) -> float:
+        return max(time.time() - self._start, 1e-9)
+
+    def examples_per_second(self) -> float:
+        return self.examples / self.elapsed
+
+    def iterations_per_second(self) -> float:
+        return self.iterations / self.elapsed
+
+    def bandwidth_mb_s(self) -> float:
+        return (self.h2d_bytes + self.d2h_bytes) / self.elapsed / 1e6
+
+    def summary(self) -> str:
+        return (f"{self.examples} examples in {self.elapsed:.1f}s "
+                f"({self.examples_per_second():.1f} ex/s, "
+                f"{self.iterations_per_second():.2f} it/s, "
+                f"{self.bandwidth_mb_s():.1f} MB/s transfers)")

@@ -1,0 +1,156 @@
+"""Training UI server (ref: org.deeplearning4j.ui.VertxUIServer, SURVEY D16).
+
+A dependency-free ``http.server`` that renders the attached StatsStorage:
+score-vs-iteration chart (inline SVG), per-layer parameter/update summary
+table, and a JSON API (``/train/sessions``, ``/train/updates?sid=``) —
+the same surfaces the reference's Vert.x app exposes, minus the JS bundle.
+"""
+from __future__ import annotations
+
+import html as _html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+
+def _svg_line_chart(xs, ys, width=720, height=240, pad=36) -> str:
+    if not xs:
+        return "<svg/>"
+    xmin, xmax = min(xs), max(xs)
+    ymin, ymax = min(ys), max(ys)
+    if ymax == ymin:
+        ymax = ymin + 1
+    pts = []
+    for x, y in zip(xs, ys):
+        px = pad + (x - xmin) / max(xmax - xmin, 1e-12) * (width - 2 * pad)
+        py = height - pad - (y - ymin) / (ymax - ymin) * (height - 2 * pad)
+        pts.append(f"{px:.1f},{py:.1f}")
+    return (
+        f'<svg width="{width}" height="{height}" '
+        f'xmlns="http://www.w3.org/2000/svg">'
+        f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
+        f'<polyline fill="none" stroke="#1f77b4" stroke-width="1.5" '
+        f'points="{" ".join(pts)}"/>'
+        f'<text x="{pad}" y="16" font-size="12">score '
+        f'(min {ymin:.4g}, max {ymax:.4g})</text></svg>')
+
+
+class UIServer:
+    """ref API: UIServer.getInstance().attach(statsStorage)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    getInstance = get_instance
+
+    def attach(self, storage):
+        self._storages.append(storage)
+
+    def detach(self, storage):
+        self._storages.remove(storage)
+
+    # --------------------------------------------------------------- render
+    def _sessions(self):
+        out = []
+        for st in self._storages:
+            out.extend(st.list_session_ids())
+        return out
+
+    def _updates(self, sid):
+        for st in self._storages:
+            ups = st.get_all_updates(sid)
+            if ups:
+                return ups
+        return []
+
+    def render_overview(self, sid: Optional[str] = None) -> str:
+        sessions = self._sessions()
+        if sid is None and sessions:
+            sid = sessions[-1]
+        ups = self._updates(sid) if sid else []
+        xs = [u["iteration"] for u in ups]
+        ys = [u["score"] for u in ups]
+        rows = ""
+        if ups and "parameters" in ups[-1]:
+            for name, s in ups[-1]["parameters"].items():
+                upd = ups[-1].get("updates", {}).get(name, {})
+                ratio = (upd.get("meanMagnitude", 0.0)
+                         / max(s.get("meanMagnitude", 0.0), 1e-12))
+                rows += (f"<tr><td>{_html.escape(str(name))}</td>"
+                         f"<td>{s.get('meanMagnitude', 0):.3e}</td>"
+                         f"<td>{s.get('stdev', 0):.3e}</td>"
+                         f"<td>{ratio:.3e}</td></tr>")
+        from urllib.parse import quote
+        session_links = " ".join(
+            f'<a href="/?sid={quote(s)}">{_html.escape(s)}</a>'
+            for s in sessions)
+        safe_sid = _html.escape(sid) if sid else "no session"
+        return (
+            "<html><head><title>DL4J-TPU Training UI</title></head><body>"
+            f"<h2>Training UI</h2><p>Sessions: {session_links}</p>"
+            f"<h3>{safe_sid} — {len(ups)} updates</h3>"
+            + _svg_line_chart(xs, ys)
+            + "<h3>Layer parameters (latest)</h3>"
+              "<table border=1 cellpadding=4><tr><th>param</th>"
+              "<th>mean |w|</th><th>stdev</th><th>update/param ratio</th>"
+              f"</tr>{rows}</table>"
+              "</body></html>")
+
+    # --------------------------------------------------------------- serve
+    def start(self):
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                if parsed.path == "/train/sessions":
+                    body = json.dumps(ui._sessions()).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/train/updates":
+                    sid = q.get("sid", [None])[0]
+                    body = json.dumps(ui._updates(sid)).encode()
+                    ctype = "application/json"
+                else:
+                    sid = q.get("sid", [None])[0]
+                    body = ui.render_overview(sid).encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def get_address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    getAddress = get_address

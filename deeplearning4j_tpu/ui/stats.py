@@ -1,0 +1,73 @@
+"""StatsListener: full training telemetry into a StatsStorage
+(ref: org.deeplearning4j.ui.model.stats.StatsListener, SURVEY D16/5.5).
+
+Collects per-iteration score plus per-layer parameter/update summaries
+(mean magnitude, stdev, min/max and histograms — what the reference's UI
+charts). Collection happens at host-callback granularity (after the jitted
+step returns), so the compiled program is untouched.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optim.listeners import TrainingListener
+
+
+def _summary(arr: np.ndarray, bins: int = 20) -> dict:
+    arr = np.asarray(arr, dtype=np.float64).ravel()
+    if arr.size == 0:
+        return {}
+    hist, edges = np.histogram(arr, bins=bins)
+    return {
+        "meanMagnitude": float(np.mean(np.abs(arr))),
+        "mean": float(arr.mean()),
+        "stdev": float(arr.std()),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+        "histogramCounts": hist.tolist(),
+        "histogramEdges": [float(edges[0]), float(edges[-1])],
+    }
+
+
+class StatsListener(TrainingListener):
+    def __init__(self, storage, update_frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 collect_histograms: bool = True):
+        self.storage = storage
+        self.update_frequency = max(update_frequency, 1)
+        self.session_id = session_id or f"session_{int(time.time() * 1e3)}"
+        self.collect_histograms = collect_histograms
+        self._last_params: Optional[Dict[str, np.ndarray]] = None
+        self._t0 = time.time()
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.update_frequency:
+            return
+        record = {
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "score": float(score),
+            "timestamp": time.time(),
+            "wallSeconds": time.time() - self._t0,
+        }
+        if self.collect_histograms and hasattr(model, "paramTable"):
+            params = {}
+            layers = {}
+            updates = {}
+            for name, arr in model.paramTable().items():
+                a = np.asarray(arr.toNumpy() if hasattr(arr, "toNumpy")
+                               else arr)
+                params[name] = a
+                layers[name] = _summary(a)
+                if self._last_params is not None and \
+                        name in self._last_params and \
+                        self._last_params[name].shape == a.shape:
+                    updates[name] = _summary(a - self._last_params[name])
+            record["parameters"] = layers
+            if updates:
+                record["updates"] = updates
+            self._last_params = params
+        self.storage.put_update(self.session_id, record)

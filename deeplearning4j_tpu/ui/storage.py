@@ -1,0 +1,101 @@
+"""Stats storage backends
+(ref: org.deeplearning4j.ui.model.storage.{InMemoryStatsStorage,
+FileStatsStorage} + api.storage.StatsStorage, SURVEY D16).
+
+Records are plain dicts; the file backend is JSON-lines (the reference's
+MapDB file plays the same append-log role). Listeners attach to be notified
+of new records — the router mechanism behind the live UI.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class BaseStatsStorage:
+    def __init__(self):
+        self._listeners: List[Callable] = []
+        self._lock = threading.Lock()
+
+    # ---- write path
+    def put_update(self, session_id: str, record: dict):
+        record = dict(record)
+        record["sessionId"] = session_id
+        self._store(record)
+        for cb in list(self._listeners):
+            cb(record)
+
+    putUpdate = put_update
+
+    def register_stats_storage_listener(self, cb: Callable):
+        self._listeners.append(cb)
+
+    registerStatsStorageListener = register_stats_storage_listener
+
+    # ---- read path
+    def list_session_ids(self) -> List[str]:
+        return sorted({r["sessionId"] for r in self._all()})
+
+    listSessionIDs = list_session_ids
+
+    def get_all_updates(self, session_id: str) -> List[dict]:
+        return [r for r in self._all() if r["sessionId"] == session_id]
+
+    getAllUpdates = get_all_updates
+
+    def get_latest_update(self, session_id: str) -> Optional[dict]:
+        ups = self.get_all_updates(session_id)
+        return ups[-1] if ups else None
+
+    getLatestUpdate = get_latest_update
+
+    # ---- backend protocol
+    def _store(self, record: dict):
+        raise NotImplementedError
+
+    def _all(self) -> List[dict]:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class InMemoryStatsStorage(BaseStatsStorage):
+    def __init__(self):
+        super().__init__()
+        self._records: List[dict] = []
+
+    def _store(self, record):
+        with self._lock:
+            self._records.append(record)
+
+    def _all(self):
+        with self._lock:
+            return list(self._records)
+
+
+class FileStatsStorage(BaseStatsStorage):
+    """Append-only JSON-lines file."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if not os.path.exists(path):
+            open(path, "w").close()
+
+    def _store(self, record):
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+
+    def _all(self):
+        with self._lock:
+            out = []
+            with open(self.path) as f:
+                for line in f:
+                    if line.strip():
+                        out.append(json.loads(line))
+            return out

@@ -1,0 +1,69 @@
+"""Crash forensics dump (ref: org.deeplearning4j.util.CrashReportingUtil,
+SURVEY 5.5 — on OOM the reference writes memory/workspace/config dumps)."""
+from __future__ import annotations
+
+import datetime
+import os
+import platform
+import traceback
+from typing import Optional
+
+
+class CrashReportingUtil:
+    crash_dump_dir: Optional[str] = None
+    enabled: bool = True
+
+    @classmethod
+    def crash_dump_output_directory(cls, path: str):
+        cls.crash_dump_dir = path
+
+    crashDumpOutputDirectory = crash_dump_output_directory
+
+    @classmethod
+    def write_memory_crash_dump(cls, model=None,
+                                exception: Optional[BaseException] = None) -> str:
+        """Write a diagnostic dump; returns the file path
+        (ref: #writeMemoryCrashDump)."""
+        if not cls.enabled:
+            return ""
+        out_dir = cls.crash_dump_dir or os.getcwd()
+        os.makedirs(out_dir, exist_ok=True)
+        stamp = datetime.datetime.now().strftime("%Y%m%d%H%M%S")
+        path = os.path.join(out_dir, f"dl4jtpu-memory-crash-dump-{stamp}.txt")
+        lines = [
+            f"DL4J-TPU crash dump {stamp}",
+            f"host: {platform.node()} ({platform.platform()})",
+            "",
+        ]
+        try:
+            import jax
+            lines.append(f"jax backend: {jax.default_backend()}")
+            for d in jax.devices():
+                stats = {}
+                try:
+                    stats = d.memory_stats() or {}
+                except Exception:
+                    pass
+                lines.append(
+                    f"  device {d.id} ({d.platform}): "
+                    f"in_use={stats.get('bytes_in_use', 'n/a')} "
+                    f"limit={stats.get('bytes_limit', 'n/a')}")
+        except Exception as e:
+            lines.append(f"jax devices unavailable: {e}")
+        if exception is not None:
+            lines.append("\nexception:")
+            lines.extend(traceback.format_exception(exception))
+        if model is not None:
+            lines.append("\nmodel:")
+            try:
+                lines.append(f"  type: {type(model).__name__}")
+                lines.append(f"  numParams: {model.numParams()}")
+                if hasattr(model, "summary"):
+                    lines.append(model.summary())
+            except Exception as e:
+                lines.append(f"  summary unavailable: {e}")
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        return path
+
+    writeMemoryCrashDump = write_memory_crash_dump

@@ -1,0 +1,161 @@
+"""UI/stats (D16), profiler (J12), ParallelInference (P8), crash dumps (5.5)."""
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+def _net():
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+        .weight_init("xavier").list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(4)).build()).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4).astype("f4")
+    return DataSet(X, np.eye(3)[rng.randint(0, 3, n)].astype("f4"))
+
+
+def test_stats_listener_memory_storage():
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.setListeners(StatsListener(storage, session_id="s1"))
+    net.fit([_data()] * 3, epochs=2)
+    ups = storage.get_all_updates("s1")
+    assert len(ups) == 6
+    assert all("score" in u and "parameters" in u for u in ups)
+    p = ups[-1]["parameters"]
+    assert "0_W" in p and "meanMagnitude" in p["0_W"]
+    assert "updates" in ups[-1]          # param deltas from iteration 2 on
+    assert storage.list_session_ids() == ["s1"]
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    from deeplearning4j_tpu.ui import FileStatsStorage
+    path = os.path.join(str(tmp_path), "stats.jsonl")
+    st = FileStatsStorage(path)
+    st.put_update("a", {"iteration": 1, "score": 0.5})
+    st.put_update("a", {"iteration": 2, "score": 0.4})
+    st2 = FileStatsStorage(path)       # reopen
+    assert len(st2.get_all_updates("a")) == 2
+    assert st2.get_latest_update("a")["score"] == 0.4
+
+
+def test_ui_server_serves_overview_and_json():
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       UIServer)
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.setListeners(StatsListener(storage, session_id="web"))
+    net.fit(_data(), epochs=3)
+    server = UIServer(port=0).start()
+    try:
+        server.attach(storage)
+        html = urllib.request.urlopen(
+            server.get_address() + "/?sid=web", timeout=5).read().decode()
+        assert "Training UI" in html and "<svg" in html and "0_W" in html
+        sessions = json.loads(urllib.request.urlopen(
+            server.get_address() + "/train/sessions", timeout=5).read())
+        assert sessions == ["web"]
+        ups = json.loads(urllib.request.urlopen(
+            server.get_address() + "/train/updates?sid=web", timeout=5).read())
+        assert len(ups) == 3
+    finally:
+        server.stop()
+
+
+def test_op_profiler_timing_and_panic():
+    from deeplearning4j_tpu.ops.registry import exec_op as raw_exec
+    from deeplearning4j_tpu.profiler import OpProfiler, ProfilerConfig
+    import jax.numpy as jnp
+    prof = OpProfiler.get_instance()
+    prof.reset()
+    prof.set_config(ProfilerConfig(op_timing=True))
+    try:
+        from deeplearning4j_tpu.ops import registry
+        registry.exec_op("relu", jnp.asarray([-1.0, 2.0]))
+        registry.exec_op("relu", jnp.asarray([3.0]))
+        assert prof.stats["relu"].invocations == 2
+        assert prof.stats["relu"].total_seconds > 0
+        report = prof.print_results()
+        assert "relu" in report
+        # INF panic
+        prof.set_config(ProfilerConfig(check_for_inf=True))
+        with pytest.raises(FloatingPointError, match="INF_PANIC"):
+            registry.exec_op("log", jnp.asarray([0.0]))
+    finally:
+        prof.set_config(ProfilerConfig())      # uninstall
+    from deeplearning4j_tpu.ops import registry
+    assert registry.exec_op is raw_exec
+
+
+def test_performance_tracker():
+    from deeplearning4j_tpu.profiler import PerformanceTracker
+    t = PerformanceTracker()
+    t.record_iteration(32)
+    t.record_iteration(32)
+    t.add_transfer_bytes(host_to_device=1024)
+    assert t.examples == 64
+    assert t.examples_per_second() > 0
+    assert "64 examples" in t.summary()
+
+
+def test_parallel_inference_batched_and_instant():
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+    net = _net()
+    x = np.random.RandomState(0).rand(4, 4).astype("f4")
+    direct = np.asarray(net.output(x))
+
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.INSTANT).build())
+    assert np.allclose(pi.output(x), direct, atol=1e-6)
+
+    pb = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED).batch_limit(8).build())
+    try:
+        import threading
+        results = {}
+
+        def call(i, xs):
+            results[i] = pb.output(xs)
+
+        threads = [threading.Thread(target=call, args=(i, x[i:i + 2]))
+                   for i in range(0, 4, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = np.concatenate([results[0], results[2]])
+        assert np.allclose(got, direct, atol=1e-5)
+    finally:
+        pb.shutdown()
+
+
+def test_crash_reporting(tmp_path):
+    from deeplearning4j_tpu.utils.crash_reporting import CrashReportingUtil
+    CrashReportingUtil.crash_dump_output_directory(str(tmp_path))
+    net = _net()
+    try:
+        raise MemoryError("synthetic OOM")
+    except MemoryError as e:
+        path = CrashReportingUtil.write_memory_crash_dump(net, e)
+    assert os.path.exists(path)
+    content = open(path).read()
+    assert "synthetic OOM" in content
+    assert "numParams" in content
