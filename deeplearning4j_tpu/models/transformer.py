@@ -36,10 +36,35 @@ from deeplearning4j_tpu.parallel.ring import ring_attention, _plain_attention
 FLASH_ATTENTION: Optional[bool] = None
 
 
+_FLASH_LOWERS: Optional[bool] = None
+
+
+def _flash_lowers() -> bool:
+    """One-time capability probe: does the Pallas kernel actually compile and
+    run on this backend? Cached for the process lifetime."""
+    global _FLASH_LOWERS
+    if _FLASH_LOWERS is None:
+        try:
+            from deeplearning4j_tpu.kernels import flash_attention
+            x = jnp.ones((1, 1, 128, 64), jnp.bfloat16)
+            jax.block_until_ready(flash_attention(x, x, x, causal=True))
+            _FLASH_LOWERS = True
+        except Exception:
+            _FLASH_LOWERS = False
+    return _FLASH_LOWERS
+
+
 def _use_flash_attention() -> bool:
     if FLASH_ATTENTION is not None:
         return FLASH_ATTENTION
-    return jax.default_backend() == "tpu"
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    if backend == "axon":
+        # remote-TPU PJRT tunnel — a real TPU, but Mosaic lowering through
+        # the tunnel is not guaranteed; probe once and fall back to XLA
+        return _flash_lowers()
+    return False
 
 
 @dataclasses.dataclass
