@@ -117,13 +117,19 @@ class ResizeImageTransform(ImageTransform):
 
     def transform(self, image):
         from PIL import Image
-        sq = image[..., 0] if image.shape[-1] == 1 else image
-        img = Image.fromarray(sq.astype(np.uint8))
-        out = np.asarray(img.resize((self.w, self.h), Image.BILINEAR),
-                         dtype=np.float32)
-        if out.ndim == 2:
-            out = out[..., None]
-        return out
+        # float-preserving resize: one PIL 'F'-mode pass per channel, so
+        # already-normalized or transformed float inputs are never clipped
+        # or quantized through uint8
+        img = np.asarray(image, dtype=np.float32)
+        if img.ndim == 2:
+            img = img[..., None]
+        chans = [
+            np.asarray(Image.fromarray(img[..., c], mode="F")
+                       .resize((self.w, self.h), Image.BILINEAR),
+                       dtype=np.float32)
+            for c in range(img.shape[-1])
+        ]
+        return np.stack(chans, axis=-1)
 
 
 class FlipImageTransform(ImageTransform):

@@ -69,24 +69,45 @@ class _H5Weights:
     Keras 2 (`layer/layer/kernel:0`) and Keras 3 (`layer/model/layer/kernel`)."""
 
     def __init__(self, h5file):
+        # full group path (relative to the top-level layer) → array, so
+        # nested submodels with several sub-layers can never collide
         self.by_layer: Dict[str, Dict[str, np.ndarray]] = {}
         root = h5file["model_weights"] if "model_weights" in h5file else h5file
 
-        def walk(group, top):
+        def walk(group, top, prefix=""):
             for k in group:
                 item = group[k]
+                name = k.split(":")[0]
                 if hasattr(item, "shape"):
-                    name = k.split(":")[0]
-                    self.by_layer.setdefault(top, {})[name] = np.asarray(item)
+                    self.by_layer.setdefault(top, {})[prefix + name] = \
+                        np.asarray(item)
                 else:
-                    walk(item, top)
+                    walk(item, top, prefix=prefix + name + "/")
 
         for top in root:
             if hasattr(root[top], "keys"):
                 walk(root[top], top)
 
     def get(self, layer_name: str) -> Dict[str, np.ndarray]:
-        return self.by_layer.get(layer_name, {})
+        """Weights for one layer, keyed by leaf name ('kernel', 'bias', …)
+        where unambiguous; full paths are always present. Ambiguous leaf
+        names (nested submodels with several sub-layers) raise rather than
+        silently loading the last-walked weight."""
+        by_path = self.by_layer.get(layer_name, {})
+        out: Dict[str, np.ndarray] = dict(by_path)
+        leaves: Dict[str, list] = {}
+        for path in by_path:
+            leaves.setdefault(path.rsplit("/", 1)[-1], []).append(path)
+        for leaf, paths in leaves.items():
+            if leaf in out:      # a top-level dataset already owns this name
+                continue
+            if len(paths) > 1:
+                raise UnsupportedKerasConfigurationException(
+                    f"Ambiguous weight name {leaf!r} in layer "
+                    f"{layer_name!r}: {sorted(paths)} — nested submodel "
+                    f"layouts must be addressed by full path")
+            out[leaf] = by_path[paths[0]]
+        return out
 
 
 # ------------------------------------------------------------ layer mapping

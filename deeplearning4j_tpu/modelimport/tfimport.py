@@ -301,11 +301,16 @@ def _register_default_rules():
         bm = attrs.get("begin_mask", 0)
         em = attrs.get("end_mask", 0)
         sm = attrs.get("shrink_axis_mask", 0)
+        begin = [None if bm & (1 << i) else b for i, b in enumerate(begin)]
+        end = [None if em & (1 << i) else e for i, e in enumerate(end)]
         for i in range(len(begin)):
-            if bm & (1 << i):
-                begin[i] = 0
-            if em & (1 << i):
-                end[i] = 2**31 - 1
+            if sm & (1 << i):
+                # TF shrink: take exactly the element at begin[i] (stride is
+                # irrelevant). begin=-1 must map to end=None, not end=0.
+                b = begin[i] if begin[i] is not None else 0
+                begin[i] = b
+                end[i] = b + 1 if b != -1 else None
+                strides[i] = 1
         out = ctx.sd._op("StridedSlice", inputs[0], begin=begin, end=end,
                          strides=strides)
         shrink = [i for i in range(len(begin)) if sm & (1 << i)]

@@ -140,10 +140,17 @@ register("slice", lambda x, begin, size: lax.dynamic_slice(x, tuple(int(b) for b
                                                            tuple(x.shape[i] - int(begin[i]) if int(s) == -1 else int(s)
                                                                  for i, s in enumerate(size))),
          aliases=["Slice"])
-register("strided_slice", lambda x, begin, end, strides=None:
-         x[tuple(slice(int(b), int(e), int(s) if strides is not None else 1)
-                 for b, e, s in zip(begin, end, strides if strides is not None else [1] * len(begin)))],
-         aliases=["StridedSlice"])
+def _strided_slice(x, begin, end, strides=None):
+    # None entries mean "full extent in the stride's direction" (Python slice
+    # semantics) — the TF importer maps begin_mask/end_mask to None so that
+    # negative strides (x[::-1]) and end-of-axis shrinks (x[-1]) work
+    strides = strides if strides is not None else [1] * len(begin)
+    as_int = lambda v: None if v is None else int(v)
+    return x[tuple(slice(as_int(b), as_int(e), as_int(s))
+                   for b, e, s in zip(begin, end, strides))]
+
+
+register("strided_slice", _strided_slice, aliases=["StridedSlice"])
 register("gather", lambda x, indices, axis=0: jnp.take(x, indices, axis=axis), aliases=["Gather", "GatherV2"])
 register("gather_nd", lambda x, indices: x[tuple(jnp.moveaxis(indices, -1, 0))], aliases=["GatherNd"])
 

@@ -9,7 +9,9 @@ ring.py for sequence parallelism (net-new vs reference).
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, SEQ_AXIS, STAGE_AXIS, MeshSpec)
 from deeplearning4j_tpu.parallel.trainer import (  # noqa: F401
-    ParallelInference, ParallelWrapper, ShardedTrainer)
+    ParallelWrapper, ShardedTrainer)
+from deeplearning4j_tpu.parallel.inference import (  # noqa: F401
+    InferenceMode, ParallelInference)
 from deeplearning4j_tpu.parallel.master import (  # noqa: F401
     DistributedConfig, ParameterAveragingTrainingMaster, SharedTrainingMaster,
     SparkComputationGraph, SparkDl4jMultiLayer, TrainingMaster)

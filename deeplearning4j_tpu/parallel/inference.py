@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -38,14 +39,29 @@ class ParallelInference:
 
     def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
-                 max_wait_ms: float = 5.0):
+                 max_wait_ms: float = 5.0, workers: Optional[int] = None):
         self.model = model
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
+        # workers: shard the forward over the first N devices (the
+        # reference's per-device replicas become one data-parallel SPMD
+        # program); None = single-program forward on the default device
+        self._trainer = None
+        if workers is not None:
+            import jax
+            from deeplearning4j_tpu.parallel.mesh import MeshSpec
+            from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+            n = workers or len(jax.devices())
+            self._trainer = ShardedTrainer(model, MeshSpec.data_parallel(n),
+                                           devices=jax.devices()[:n])
+            self._n_dev = n
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # serializes enqueue vs shutdown-drain so a request can never be
+        # enqueued after the drain and hang forever
+        self._lock = threading.Lock()
         if self.mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._serve_loop,
                                             daemon=True)
@@ -74,18 +90,43 @@ class ParallelInference:
 
         queueLimit = queue_limit
 
+        def workers(self, n):
+            self._kw["workers"] = n
+            return self
+
         def build(self):
             return ParallelInference(self._model, **self._kw)
 
     # ----------------------------------------------------------------- api
+    def _forward(self, x: np.ndarray) -> np.ndarray:
+        if self._trainer is None:
+            return np.asarray(self.model.output(x))
+        # pad ragged batches up to the device count so the sharded program
+        # always sees a divisible leading axis
+        pad = (-x.shape[0]) % self._n_dev
+        if pad:
+            xp = np.concatenate(
+                [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+            return np.asarray(self._trainer.output(xp))[: x.shape[0]]
+        return np.asarray(self._trainer.output(x))
+
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
         if self.mode == InferenceMode.INSTANT:
-            return np.asarray(self.model.output(x))
-        if self._stop.is_set():
-            raise RuntimeError("ParallelInference has been shut down")
+            return self._forward(x)
         req = _Request(x)
-        self._queue.put(req)
+        while True:
+            # non-blocking put under the lock: a blocking put here would
+            # hold the lock while the queue is full and deadlock shutdown()
+            with self._lock:
+                if self._stop.is_set():
+                    raise RuntimeError("ParallelInference has been shut down")
+                try:
+                    self._queue.put_nowait(req)
+                    break
+                except queue.Full:
+                    pass
+            time.sleep(0.001)
         req.event.wait()
         if req.error is not None:
             raise req.error
@@ -96,22 +137,28 @@ class ParallelInference:
         if self._worker is not None:
             self._worker.join(timeout=2.0)
         # fail any requests that were still queued so callers never hang
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            req.error = RuntimeError("ParallelInference shut down")
-            req.event.set()
+        with self._lock:
+            while True:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                req.error = RuntimeError("ParallelInference shut down")
+                req.event.set()
 
     # ---------------------------------------------------------- batch loop
     def _serve_loop(self):
+        import time as _time
+
+        held: Optional[_Request] = None  # overflow from the previous window
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            import time as _time
+            if held is not None:
+                first, held = held, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
             batch: List[_Request] = [first]
             total = first.x.shape[0]
             # coalesce within ONE wait window, never exceeding batch_limit
@@ -127,8 +174,11 @@ class ParallelInference:
                 except queue.Empty:
                     break
                 if total + nxt.x.shape[0] > self.batch_limit:
-                    # too big for this batch — run it in the next one
-                    self._queue.put(nxt)
+                    # too big for this batch: hold it locally to seed the
+                    # next one — putting it back on a bounded queue that
+                    # producers may have refilled would deadlock the sole
+                    # consumer (and break FIFO order)
+                    held = nxt
                     break
                 batch.append(nxt)
                 total += nxt.x.shape[0]
@@ -140,7 +190,7 @@ class ParallelInference:
                     pad = np.zeros((self.batch_limit - n,) + X.shape[1:],
                                    X.dtype)
                     X = np.concatenate([X, pad], axis=0)
-                out = np.asarray(self.model.output(X))[:n]
+                out = self._forward(X)[:n]
                 off = 0
                 for r in batch:
                     k = r.x.shape[0]
@@ -151,3 +201,6 @@ class ParallelInference:
                 for r in batch:
                     r.error = e
                     r.event.set()
+        if held is not None:                   # don't strand the overflow
+            held.error = RuntimeError("ParallelInference shut down")
+            held.event.set()

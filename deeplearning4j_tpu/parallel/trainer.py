@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.ndarray.ndarray import _unwrap
 from deeplearning4j_tpu.parallel import mesh as _mesh
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, DATA_AXIS
 from deeplearning4j_tpu.parallel.sharding import replicate_tree, tp_shardings
@@ -191,26 +191,3 @@ class _PWBuilder:
 
     def build(self) -> ParallelWrapper:
         return ParallelWrapper(self._model, self._workers, self._prefetch, self._avg_freq)
-
-
-class ParallelInference:
-    """Batched multi-device inference facade
-    (ref: ``org.deeplearning4j.parallelism.ParallelInference`` — SURVEY P8).
-    Requests are answered through a data-sharded jitted forward; the
-    reference's per-device replicas + queue become one SPMD program."""
-
-    def __init__(self, model, workers: Optional[int] = None, batch_limit: int = 32):
-        n = workers or len(jax.devices())
-        self._trainer = ShardedTrainer(model, MeshSpec.data_parallel(n),
-                                       devices=jax.devices()[:n])
-        self.batch_limit = batch_limit
-
-    def output(self, x):
-        x = jnp.asarray(_unwrap(x))
-        n_dev = int(np.prod(self._trainer.mesh.devices.shape))
-        pad = (-x.shape[0]) % n_dev
-        if pad:
-            xp = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)])
-            out = self._trainer.output(xp)
-            return NDArray(out.buf()[: x.shape[0]]) if isinstance(out, NDArray) else out[: x.shape[0]]
-        return self._trainer.output(x)

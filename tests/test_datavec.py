@@ -230,3 +230,17 @@ def test_train_from_csv_end_to_end(tmp_path):
     it.reset()
     ev = net.evaluate(it)
     assert ev.accuracy() > 0.9
+
+
+def test_resize_transform_preserves_floats():
+    """ADVICE r1: resize must not round-trip floats through uint8."""
+    from deeplearning4j_tpu.datavec.image import ResizeImageTransform
+
+    img = np.random.default_rng(0).random((8, 8, 3)).astype(np.float32)  # [0,1]
+    out = ResizeImageTransform(4, 4).transform(img)
+    assert out.shape == (4, 4, 3)
+    assert out.max() > 0.2, "normalized input was quantized to zeros"
+    # constant image resizes exactly, including non-integer values
+    const = np.full((6, 6, 1), 0.37, np.float32)
+    out2 = ResizeImageTransform(3, 3).transform(const)
+    assert np.allclose(out2, 0.37, atol=1e-6)

@@ -130,3 +130,32 @@ def test_imported_model_is_trainable(tmp_path):
     s0 = net.score(DataSet(X, Y))
     net.fit(X, Y, epochs=20)
     assert net.score(DataSet(X, Y)) < s0
+
+
+def test_h5_nested_submodel_weights_do_not_collide(tmp_path):
+    """ADVICE r1: nested wrapper layers with several sub-layers must not
+    silently last-wins on leaf dataset names."""
+    import h5py
+
+    from deeplearning4j_tpu.modelimport.keras import (
+        UnsupportedKerasConfigurationException, _H5Weights)
+
+    p = str(tmp_path / "w.h5")
+    with h5py.File(p, "w") as f:
+        g = f.create_group("model_weights").create_group("wrapper")
+        a = g.create_group("dense_a")
+        a.create_dataset("kernel:0", data=np.ones((2, 2), "f4"))
+        b = g.create_group("dense_b")
+        b.create_dataset("kernel:0", data=np.zeros((2, 2), "f4") + 7.0)
+        top = f["model_weights"].create_group("simple")
+        top.create_dataset("kernel:0", data=np.full((3, 3), 2.0, "f4"))
+
+    with h5py.File(p, "r") as f:
+        w = _H5Weights(f)
+        simple = w.get("simple")
+        assert np.allclose(simple["kernel"], 2.0)
+        import pytest as _pytest
+        with _pytest.raises(UnsupportedKerasConfigurationException):
+            w.get("wrapper")
+        # full paths remain addressable
+        assert np.allclose(w.by_layer["wrapper"]["dense_b/kernel"], 7.0)

@@ -159,3 +159,61 @@ def test_crash_reporting(tmp_path):
     content = open(path).read()
     assert "synthetic OOM" in content
     assert "numParams" in content
+
+
+def test_parallel_inference_overflow_under_load_no_deadlock():
+    """ADVICE r1: oversized requests must be held locally, never re-queued
+    onto the bounded queue (deadlock); many concurrent clients with a tiny
+    queue_limit exercise exactly that path."""
+    import threading
+
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+    net = _net()
+    x = np.random.RandomState(1).rand(32, 4).astype("f4")
+    direct = np.asarray(net.output(x))
+
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED)
+          .batch_limit(4).queue_limit(2).build())
+    results = {}
+    errors = []
+
+    def call(i, n):
+        try:
+            results[i] = pi.output(x[i:i + n])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    # mix of sizes incl. 3-row requests that overflow a partly-filled batch
+    sizes = [1, 3, 2, 3, 1, 3, 2, 1, 3, 2, 3, 1, 3, 2, 1, 1]
+    offs, threads = 0, []
+    for n in sizes:
+        threads.append(threading.Thread(target=call, args=(offs, n)))
+        offs += n
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "deadlocked"
+        assert not errors, errors
+        offs = 0
+        for n in sizes:
+            assert np.allclose(results[offs], direct[offs:offs + n],
+                               atol=1e-5), offs
+            offs += n
+    finally:
+        pi.shutdown()
+
+
+def test_parallel_inference_shutdown_fails_pending_cleanly():
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+    net = _net()
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED).build())
+    pi.shutdown()
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError):
+        pi.output(np.zeros((1, 4), "f4"))

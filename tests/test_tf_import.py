@@ -144,3 +144,29 @@ def test_imported_graph_is_trainable():
         data_set_label_mapping=["label"], loss_variables=["loss"]))
     losses = sd.fit(DataSet(X, Y), epochs=40)
     assert losses[-1] < losses[0] * 0.9
+
+
+def test_strided_slice_negative_stride_and_shrink():
+    """ADVICE r1: x[::-1] (negative stride + begin/end masks) and x[-1]
+    (negative-begin shrink dim) must match TF, not produce empty slices."""
+
+    def rev(x):
+        return x[::-1] + 1.0
+
+    x = np.arange(12, dtype="f4").reshape(4, 3)
+    _check(rev, {"x": x})
+
+    def last(x):
+        return x[-1] * 2.0
+
+    _check(last, {"x": x})
+
+    def mid(x):
+        return x[1:3, ::-1]
+
+    _check(mid, {"x": x})
+
+    def shrink_col(x):
+        return x[:, -1]
+
+    _check(shrink_col, {"x": x})
