@@ -88,6 +88,11 @@ class SDVariable:
     def lte(self, o): return self._bin("less_equal", o)
     def eq(self, o): return self._bin("equals", o)
     def neq(self, o): return self._bin("not_equals", o)
+    __gt__ = gt
+    __ge__ = gte
+    __lt__ = lt
+    __le__ = lte
+    # (__eq__ stays identity — variables live in dict keys)
 
     # common method-style ops (SDVariable convenience methods)
     def add(self, o): return self.__add__(o)
@@ -190,23 +195,35 @@ class SDVariable:
 class OpNode:
     """One node of the op graph (ref: ``samediff.internal.SameDiffOp``)."""
 
-    __slots__ = ("name", "op_name", "inputs", "outputs", "attrs", "fn")
+    __slots__ = ("name", "op_name", "inputs", "outputs", "attrs", "fn",
+                 "subgraphs")
 
-    def __init__(self, name, op_name, inputs, outputs, attrs, fn=None):
+    def __init__(self, name, op_name, inputs, outputs, attrs, fn=None,
+                 subgraphs=None):
         self.name = name
         self.op_name = op_name
         self.inputs = list(inputs)
         self.outputs = list(outputs)
         self.attrs = dict(attrs)
         self.fn = fn  # only for non-serializable lambda ops
+        # control-flow bodies: {"true"/"false"} for __cond__,
+        # {"cond"/"body"} for __while__ — nested SameDiff graphs
+        self.subgraphs = subgraphs
 
     def to_dict(self):
         if self.fn is not None:
             raise ValueError(
                 f"op {self.name!r} wraps a Python lambda and cannot be "
                 f"serialized; rebuild it from registered ops")
-        return {"name": self.name, "op": self.op_name, "inputs": self.inputs,
-                "outputs": self.outputs, "attrs": self.attrs}
+        d = {"name": self.name, "op": self.op_name, "inputs": self.inputs,
+             "outputs": self.outputs, "attrs": self.attrs}
+        if self.subgraphs:
+            # subgraph VALUES ride the enclosing graph's npz (binary), keyed
+            # "__sub__/<op>/<branch>/<var>" — only structure goes in the json
+            d["subgraphs"] = {
+                k: {"graph": sg.to_dict(), "outputs": sg._branch_outputs}
+                for k, sg in self.subgraphs.items()}
+        return d
 
 
 class TrainingConfig:
@@ -447,6 +464,7 @@ class SameDiff:
         self._producer: Dict[str, OpNode] = {}   # var name -> producing op
         self._name_counter: Dict[str, int] = {}
         self._loss_variables: List[str] = []
+        self._branch_outputs: List[str] = []   # set when used as a CF body
         self.training_config: Optional[TrainingConfig] = None
         self._compiled_cache: Dict[Any, Callable] = {}
         self._train_step = None
@@ -605,6 +623,94 @@ class SameDiff:
         self._invalidate_cache()
         return outs[0] if n_out == 1 else tuple(outs)
 
+    # ---- control flow ---------------------------------------------------
+    @staticmethod
+    def _build_body(builder: Callable, operands: Sequence[SDVariable]):
+        """Trace ``builder(sub_sd, *arg_phs)`` into a nested SameDiff whose
+        placeholders arg0..argN mirror the operands."""
+        sub = SameDiff.create()
+        phs = [sub.placeholder(f"arg{i}", v.shape, v.dtype)
+               for i, v in enumerate(operands)]
+        out = builder(sub, *phs)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        sub._branch_outputs = [o.name for o in outs]
+        return sub, outs
+
+    def _cf_node(self, op_name, name, inputs, subgraphs, out_templates):
+        """Register a control-flow OpNode whose output shapes/dtypes come
+        from the branch's traced outputs."""
+        node_name = self._unique(name or op_name.strip("_"))
+        n_out = len(out_templates)
+        out_names = ([node_name] if n_out == 1
+                     else [f"{node_name}#{i}" for i in range(n_out)])
+        node = OpNode(node_name, op_name, [v.name for v in inputs],
+                      out_names, {}, subgraphs=subgraphs)
+        self._ops.append(node)
+        outs = []
+        for on, tmpl in zip(out_names, out_templates):
+            ov = SDVariable(self, on, VariableType.ARRAY, tmpl.shape,
+                            tmpl.dtype)
+            self._register(ov)
+            self._producer[on] = node
+            outs.append(ov)
+        self._invalidate_cache()
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    def if_cond(self, pred: SDVariable, true_body: Callable,
+                false_body: Callable, *operands: SDVariable,
+                name: str = None):
+        """Conditional (ref: ``SameDiff#ifCond``; TF If/StatelessIf).
+
+        ``true_body``/``false_body`` are ``fn(sub_sd, *args) -> var(s)``
+        builders traced into nested graphs; lowers to ``lax.cond`` (both
+        branches compiled, predicate selects on device — XLA-friendly,
+        differentiable). Branches must return matching shapes/dtypes.
+        """
+        pred = self._lift(pred)
+        operands = [self._lift(o) for o in operands]
+        t_sd, t_outs = self._build_body(true_body, operands)
+        f_sd, f_outs = self._build_body(false_body, operands)
+        if [(o.shape, np.dtype(o.dtype)) for o in t_outs] != \
+                [(o.shape, np.dtype(o.dtype)) for o in f_outs]:
+            raise ValueError("if_cond branches must return matching "
+                             "shapes/dtypes")
+        return self._cf_node("__cond__", name, [pred] + operands,
+                             {"true": t_sd, "false": f_sd}, t_outs)
+
+    ifCond = if_cond
+
+    def while_loop(self, cond_body: Callable, loop_body: Callable,
+                   *loop_vars: SDVariable, name: str = None):
+        """While loop (ref: ``SameDiff#whileLoop``; TF While/StatelessWhile).
+
+        ``cond_body(sub_sd, *state) -> scalar bool``;
+        ``loop_body(sub_sd, *state) -> new state`` (same shapes/dtypes).
+        Lowers to ``lax.while_loop`` — forward-only (XLA while is not
+        reverse-differentiable; use a scan-style unrolled body for training,
+        same restriction as the reference's TF-imported while graphs).
+        """
+        loop_vars = [self._lift(v) for v in loop_vars]
+        c_sd, c_outs = self._build_body(cond_body, loop_vars)
+        if len(c_outs) != 1:
+            raise ValueError("while_loop cond must return one scalar")
+        b_sd, b_outs = self._build_body(loop_body, loop_vars)
+        if len(b_outs) != len(loop_vars):
+            raise ValueError("while_loop body must return one var per "
+                             "loop var")
+        mismatched = [
+            (v.name, v.shape, np.dtype(v.dtype), o.shape, np.dtype(o.dtype))
+            for v, o in zip(loop_vars, b_outs)
+            if (v.shape, np.dtype(v.dtype)) != (o.shape, np.dtype(o.dtype))]
+        if mismatched:
+            raise ValueError(
+                f"while_loop body must preserve loop-var shapes/dtypes; "
+                f"mismatches (var, init shape/dtype, body shape/dtype): "
+                f"{mismatched}")
+        return self._cf_node("__while__", name, loop_vars,
+                             {"cond": c_sd, "body": b_sd}, b_outs)
+
+    whileLoop = while_loop
+
     # ---- introspection -------------------------------------------------
     def get_variable(self, name: str) -> SDVariable:
         return self._vars[name]
@@ -703,7 +809,30 @@ class SameDiff:
                 jnp.asarray(rng_seed).dtype, jnp.integer) else rng_seed
             for op in ops:
                 args = [env[i] for i in op.inputs]
-                if op.fn is not None:
+                if op.op_name == "__cond__":
+                    t_fn = op.subgraphs["true"]._branch_fn()
+                    f_fn = op.subgraphs["false"]._branch_fn()
+                    pred = jnp.squeeze(args[0]).astype(bool)
+                    res = jax.lax.cond(pred, t_fn, f_fn, *args[1:])
+                    if len(op.outputs) == 1 and isinstance(res, tuple):
+                        res = res[0]
+                elif op.op_name == "__while__":
+                    c_fn = op.subgraphs["cond"]._branch_fn()
+                    b_fn = op.subgraphs["body"]._branch_fn()
+
+                    def _body(st, _b=b_fn, _n=len(args)):
+                        r = _b(*st)
+                        r = r if isinstance(r, tuple) else (r,)
+                        # carry must keep the init structure/dtypes exactly
+                        return tuple(jnp.asarray(x).astype(s.dtype)
+                                     for x, s in zip(r, st))
+
+                    res = jax.lax.while_loop(
+                        lambda st: jnp.squeeze(c_fn(*st)).astype(bool),
+                        _body, tuple(args))
+                    if len(op.outputs) == 1:
+                        res = res[0]
+                elif op.fn is not None:
                     res = op.fn(*args)
                 else:
                     attrs = dict(op.attrs)
@@ -728,6 +857,19 @@ class SameDiff:
             return tuple(env[o] for o in outputs)
 
         return fn
+
+    def _branch_fn(self) -> Callable:
+        """Executor for a control-flow body: g(*args) over placeholders
+        arg0..argN, closing over this subgraph's constant values."""
+        outs = self._branch_outputs
+        emit = self._emit(outs)
+
+        def g(*xs):
+            ph = {f"arg{i}": x for i, x in enumerate(xs)}
+            res = emit(self._values, ph, 0)
+            return res if len(outs) > 1 else res[0]
+
+        return g
 
     # ---- execution ----------------------------------------------------
     def output(self, placeholders: Dict[str, Any],
@@ -915,13 +1057,24 @@ class SameDiff:
                                if self.training_config else None),
         }
 
+    def _gather_values(self, prefix: str = "") -> Dict[str, np.ndarray]:
+        """This graph's values plus all control-flow subgraph values,
+        flattened under npz-safe prefixed keys."""
+        out = {prefix + n: np.asarray(v) for n, v in self._values.items()}
+        for op in self._ops:
+            if op.subgraphs:
+                for k, sg in op.subgraphs.items():
+                    out.update(sg._gather_values(
+                        f"{prefix}__sub__/{op.name}/{k}/"))
+        return out
+
     def save(self, path: str, save_updater_state: bool = False):
         """Persist graph + values (ref: ``SameDiff#save`` FlatBuffers zip)."""
         d = self.to_dict()
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("graph.json", json.dumps(d, indent=1))
             buf = io.BytesIO()
-            np.savez(buf, **{k: np.asarray(v) for k, v in self._values.items()})
+            np.savez(buf, **self._gather_values())
             zf.writestr("values.npz", buf.getvalue())
             if save_updater_state and self._opt_state is not None:
                 leaves = jax.tree.leaves(self._opt_state)
@@ -932,7 +1085,6 @@ class SameDiff:
 
     @staticmethod
     def load(path: str) -> "SameDiff":
-        sd = SameDiff()
         opt_leaves = None
         with zipfile.ZipFile(path) as zf:
             d = json.loads(zf.read("graph.json"))
@@ -942,6 +1094,14 @@ class SameDiff:
                 with zf.open("updater.npz") as f:
                     raw = dict(np.load(io.BytesIO(f.read())))
                 opt_leaves = [raw[f"leaf{i}"] for i in range(len(raw))]
+        sd = SameDiff._restore(d, values)
+        sd._pending_opt_leaves = opt_leaves
+        return sd
+
+    @staticmethod
+    def _restore(d: dict, values: Dict[str, np.ndarray]) -> "SameDiff":
+        """Rebuild a SameDiff (or a control-flow subgraph) from its dict."""
+        sd = SameDiff()
         for vd in d["variables"]:
             v = SDVariable(sd, vd["name"], VariableType(vd["type"]),
                            tuple(vd["shape"]) if vd["shape"] is not None else None,
@@ -951,15 +1111,24 @@ class SameDiff:
                                                    VariableType.CONSTANT):
                 sd._values[v.name] = jnp.asarray(values[v.name])
         for od in d["ops"]:
+            subgraphs = None
+            if od.get("subgraphs"):
+                subgraphs = {}
+                for k, sub_d in od["subgraphs"].items():
+                    p = f"__sub__/{od['name']}/{k}/"
+                    sub_vals = {n[len(p):]: v for n, v in values.items()
+                                if n.startswith(p)}
+                    sub = SameDiff._restore(sub_d["graph"], sub_vals)
+                    sub._branch_outputs = list(sub_d["outputs"])
+                    subgraphs[k] = sub
             node = OpNode(od["name"], od["op"], od["inputs"], od["outputs"],
-                          od["attrs"])
+                          od["attrs"], subgraphs=subgraphs)
             sd._ops.append(node)
             for o in node.outputs:
                 sd._producer[o] = node
         sd._loss_variables = d.get("lossVariables", [])
         if d.get("trainingConfig"):
             sd.training_config = TrainingConfig.from_dict(d["trainingConfig"])
-        sd._pending_opt_leaves = opt_leaves
         # name counters: make future names unique past loaded ones
         for n in sd._vars:
             base = n.split(":")[0].split("#")[0]

@@ -69,6 +69,8 @@ def _parse_attrs(node) -> dict:
                 out[k] = []
         elif field == "tensor":
             out[k] = v.tensor
+        elif field == "func":
+            out[k] = v.func.name
     return out
 
 
@@ -111,18 +113,38 @@ def _tensor_to_ndarray(tensor_proto) -> np.ndarray:
 
 # ------------------------------------------------------------------ mapper
 class _ImportCtx:
-    def __init__(self, sd: SameDiff):
+    def __init__(self, sd: SameDiff, library: Optional[dict] = None):
         self.sd = sd
         self.vars: Dict[str, SDVariable] = {}     # tf tensor name -> SDVariable
         self.consts: Dict[str, np.ndarray] = {}   # tf node name -> numpy
+        self.library: Dict[str, object] = library or {}  # FunctionDefs by name
 
     def const_value(self, ref: str) -> np.ndarray:
-        name = ref.split(":")[0]
-        if name not in self.consts:
-            raise TFImportError(
-                f"op input {ref!r} must be a constant for import "
-                f"(structural argument)")
-        return self.consts[name]
+        key = _fq(ref)
+        name, idx = key.rsplit(":", 1)
+        # bare-name cache: Const/Range rules (single-output nodes only)
+        if idx == "0" and name in self.consts:
+            return self.consts[name]
+        if key in self.consts:
+            return self.consts[key]
+        # constant-fold a structural subgraph (Shape→StridedSlice→Pack etc.):
+        # if the producing var depends only on constants, evaluate it through
+        # the graph engine (the reference resolves these via its attribute-
+        # resolution pass; here the real lowering does the arithmetic).
+        # Eager _emit, NOT sd.output — folding must not pay one fresh XLA
+        # compile per structural argument on BERT-sized graphs.
+        var = self.vars.get(key)
+        if var is not None:
+            try:
+                fn = self.sd._emit([var.name])
+                arr = np.asarray(fn(self.sd._values, {}, 0)[0])
+                self.consts[key] = arr
+                return arr
+            except Exception:
+                pass
+        raise TFImportError(
+            f"op input {ref!r} must be a constant (or constant-foldable) "
+            f"for import (structural argument)")
 
 
 def _pool_args(attrs):
@@ -162,6 +184,8 @@ def _register_default_rules():
         "SquaredDifference", "Pow", "Neg", "FloorDiv", "FloorMod",
         "Relu", "Relu6", "Elu", "Selu", "Sigmoid", "Tanh", "Softplus",
         "Softsign", "Gelu",
+        "Greater", "GreaterEqual", "Less", "LessEqual", "Equal", "NotEqual",
+        "LogicalAnd", "LogicalOr", "LogicalNot", "Select", "SelectV2",
     ]
     for op in _PASSTHRU:
         @mapping_rule(op)
@@ -172,7 +196,7 @@ def _register_default_rules():
     for op, fn in [("Sqrt", "sqrt"), ("Rsqrt", "rsqrt"), ("Exp", "exp"),
                    ("Log", "log"), ("Abs", "abs"), ("Square", "square"),
                    ("Sign", "sign"), ("Floor", "floor"), ("Ceil", "ceil"),
-                   ("Round", "round"), ("Erf", "erf")]:
+                   ("Round", "round"), ("Erf", "erf"), ("Erfc", "erfc")]:
         @mapping_rule(op)
         def _un(ctx, node, inputs, attrs, _fn=fn):
             return ctx.sd._op(_fn, inputs[0])
@@ -295,31 +319,238 @@ def _register_default_rules():
         begin = [int(v) for v in ctx.const_value(node.input[1])]
         end = [int(v) for v in ctx.const_value(node.input[2])]
         strides = [int(v) for v in ctx.const_value(node.input[3])]
-        for m in ("ellipsis_mask", "new_axis_mask"):
-            if attrs.get(m, 0):
-                raise TFImportError(f"StridedSlice {m} unsupported")
         bm = attrs.get("begin_mask", 0)
         em = attrs.get("end_mask", 0)
         sm = attrs.get("shrink_axis_mask", 0)
-        begin = [None if bm & (1 << i) else b for i, b in enumerate(begin)]
-        end = [None if em & (1 << i) else e for i, e in enumerate(end)]
-        for i in range(len(begin)):
-            if sm & (1 << i):
-                # TF shrink: take exactly the element at begin[i] (stride is
-                # irrelevant). begin=-1 must map to end=None, not end=0.
-                b = begin[i] if begin[i] is not None else 0
-                begin[i] = b
-                end[i] = b + 1 if b != -1 else None
-                strides[i] = 1
-        out = ctx.sd._op("StridedSlice", inputs[0], begin=begin, end=end,
-                         strides=strides)
-        shrink = [i for i in range(len(begin)) if sm & (1 << i)]
-        if shrink:
-            out = ctx.sd._op("Squeeze", out, axis=shrink)
+        nm = attrs.get("new_axis_mask", 0)
+        elm = attrs.get("ellipsis_mask", 0)
+        if inputs[0].shape is None:
+            raise TFImportError("StridedSlice needs a statically-known rank")
+        rank = len(inputs[0].shape)
+        nspec = len(begin)
+        # number of input dims the ellipsis expands into
+        n_real = sum(1 for i in range(nspec)
+                     if not (nm >> i) & 1 and not (elm >> i) & 1)
+        ell_fill = rank - n_real
+        # decompose into: one strided slice over input dims (None = full
+        # extent in the stride's direction), then Squeeze for shrink dims,
+        # then ExpandDims for new axes — mirroring TF's spec-entry walk
+        sl_begin, sl_end, sl_str = [], [], []
+        squeeze_dims, new_axis_pos = [], []
+        out_dim = 0
+        for i in range(nspec):
+            if (nm >> i) & 1:
+                new_axis_pos.append(out_dim)
+                out_dim += 1
+                continue
+            if (elm >> i) & 1:
+                for _ in range(ell_fill):
+                    sl_begin.append(None); sl_end.append(None)
+                    sl_str.append(1)
+                    out_dim += 1
+                continue
+            b = None if (bm >> i) & 1 else begin[i]
+            e = None if (em >> i) & 1 else end[i]
+            if (sm >> i) & 1:
+                # shrink: take exactly the element at begin[i]; begin=-1
+                # must map to end=None, not end=0
+                bb = b if b is not None else 0
+                sl_begin.append(bb)
+                sl_end.append(bb + 1 if bb != -1 else None)
+                sl_str.append(1)
+                squeeze_dims.append(len(sl_begin) - 1)
+                continue
+            sl_begin.append(b); sl_end.append(e); sl_str.append(strides[i])
+            out_dim += 1
+        while len(sl_begin) < rank:      # unspecified trailing dims
+            sl_begin.append(None); sl_end.append(None); sl_str.append(1)
+            out_dim += 1
+        out = ctx.sd._op("StridedSlice", inputs[0], begin=sl_begin,
+                         end=sl_end, strides=sl_str)
+        if squeeze_dims:
+            out = ctx.sd._op("Squeeze", out, axis=squeeze_dims)
+        for pos in new_axis_pos:         # ascending: prior inserts accounted
+            out = ctx.sd._op("ExpandDims", out, axis=pos)
         return out
+
+    # ---------------- BERT-class breadth (ref: OpMappingRegistry long tail)
+    @mapping_rule("Gather", "GatherV2")
+    def _gather(ctx, node, inputs, attrs):
+        if attrs.get("batch_dims", 0):
+            raise TFImportError("Gather: batch_dims unsupported")
+        axis = 0
+        if node.op == "GatherV2" and len(node.input) > 2:
+            axis = int(ctx.const_value(node.input[2]))
+        return ctx.sd._op("Gather", inputs[0], inputs[1], axis=axis)
+
+    @mapping_rule("GatherNd")
+    def _gather_nd(ctx, node, inputs, attrs):
+        return ctx.sd._op("GatherNd", inputs[0], inputs[1])
+
+    @mapping_rule("Slice")
+    def _slice(ctx, node, inputs, attrs):
+        begin = [int(v) for v in ctx.const_value(node.input[1])]
+        size = [int(v) for v in ctx.const_value(node.input[2])]
+        return ctx.sd._op("Slice", inputs[0], begin=begin, size=size)
+
+    @mapping_rule("Split")
+    def _split(ctx, node, inputs, attrs):
+        # TF Split input order: (axis, value)
+        axis = int(ctx.const_value(node.input[0]))
+        n = int(attrs["num_split"])
+        return ctx.sd._op("Split", inputs[-1], num_split=n, axis=axis,
+                          n_out=n)
+
+    @mapping_rule("SplitV")
+    def _split_v(ctx, node, inputs, attrs):
+        sizes = [int(v) for v in ctx.const_value(node.input[1])]
+        axis = int(ctx.const_value(node.input[2]))
+        return ctx.sd._op("SplitV", inputs[0], size_splits=sizes, axis=axis,
+                          n_out=len(sizes))
+
+    @mapping_rule("Unpack")
+    def _unpack(ctx, node, inputs, attrs):
+        n = int(attrs["num"])
+        return ctx.sd._op("Unstack", inputs[0], axis=attrs.get("axis", 0),
+                          num=n, n_out=n)
+
+    @mapping_rule("OneHot")
+    def _one_hot(ctx, node, inputs, attrs):
+        depth = int(ctx.const_value(node.input[1]))
+        on = float(ctx.const_value(node.input[2]))
+        off = float(ctx.const_value(node.input[3]))
+        return ctx.sd._op("OneHot", inputs[0], depth=depth, on_value=on,
+                          off_value=off, axis=attrs.get("axis", -1))
+
+    @mapping_rule("Einsum")
+    def _einsum(ctx, node, inputs, attrs):
+        return ctx.sd._op("Einsum", *inputs, equation=attrs["equation"])
+
+    @mapping_rule("Tile")
+    def _tile(ctx, node, inputs, attrs):
+        reps = [int(v) for v in ctx.const_value(node.input[1])]
+        return ctx.sd._op("Tile", inputs[0], reps=reps)
+
+    @mapping_rule("Fill")
+    def _fill(ctx, node, inputs, attrs):
+        dims = [int(v) for v in ctx.const_value(node.input[0])]
+        try:
+            val = ctx.const_value(node.input[1])
+            return ctx.sd.constant(np.full(dims, val), name=node.name)
+        except TFImportError:
+            # dynamic fill value: broadcast it against a ones tensor of the
+            # value's own dtype (TF Fill output dtype == value dtype)
+            ones = ctx.sd.constant(np.ones(dims, np.dtype(inputs[1].dtype)))
+            return ctx.sd._op("Mul", ones, inputs[1])
+
+    @mapping_rule("Shape")
+    def _shape(ctx, node, inputs, attrs):
+        shp = inputs[0].shape
+        if shp is not None and all(d is not None for d in shp):
+            # fold statically-known shapes so downstream structural args
+            # (Reshape targets computed via Shape→Slice→Pack) stay constant
+            arr = np.asarray(shp, np.int32)
+            ctx.consts[node.name] = arr
+            return ctx.sd.constant(arr, name=node.name)
+        return ctx.sd._op("Shape", inputs[0])
+
+    @mapping_rule("Range")
+    def _range(ctx, node, inputs, attrs):
+        start, limit, delta = (ctx.const_value(node.input[i])
+                               for i in range(3))
+        arr = np.arange(np.asarray(start).item(), np.asarray(limit).item(),
+                        np.asarray(delta).item(),
+                        dtype=np.asarray(start).dtype)
+        ctx.consts[node.name] = arr
+        return ctx.sd.constant(arr, name=node.name)
+
+    @mapping_rule("ReverseV2")
+    def _reverse(ctx, node, inputs, attrs):
+        axis = [int(v) for v in np.atleast_1d(ctx.const_value(node.input[1]))]
+        return ctx.sd._op("ReverseV2", inputs[0], axis=axis)
+
+    # -------- functional control flow (ref: Enter/Exit/Merge/Switch legacy
+    # frames collapse to TF2's If/While, which map onto SameDiff's
+    # lax.cond/lax.while_loop composite ops)
+    @mapping_rule("StatelessIf", "If")
+    def _if(ctx, node, inputs, attrs):
+        then_f = ctx.library.get(attrs["then_branch"])
+        else_f = ctx.library.get(attrs["else_branch"])
+        if then_f is None or else_f is None:
+            raise TFImportError(f"If branch functions not in graph library "
+                                f"({attrs.get('then_branch')}, "
+                                f"{attrs.get('else_branch')})")
+        return ctx.sd.if_cond(inputs[0],
+                              _fdef_builder(then_f, ctx.library),
+                              _fdef_builder(else_f, ctx.library),
+                              *inputs[1:], name=node.name)
+
+    @mapping_rule("StatelessWhile", "While")
+    def _while(ctx, node, inputs, attrs):
+        cond_f = ctx.library.get(attrs["cond"])
+        body_f = ctx.library.get(attrs["body"])
+        if cond_f is None or body_f is None:
+            raise TFImportError("While cond/body functions not in library")
+        return ctx.sd.while_loop(_fdef_builder(cond_f, ctx.library),
+                                 _fdef_builder(body_f, ctx.library),
+                                 *inputs, name=node.name)
 
 
 _register_default_rules()
+
+
+def _fq(ref: str) -> str:
+    """Normalize a tensor ref to 'node:index'. GraphDef refs are 'node' or
+    'node:i'; FunctionDef refs are 'arg', 'node:out_name:i'."""
+    if ref.count(":") >= 2:                 # FunctionDef 3-part form
+        parts = ref.split(":")
+        return f"{parts[0]}:{parts[-1]}"
+    return ref if ":" in ref else ref + ":0"
+
+
+def _map_nodes(ctx: _ImportCtx, nodes, skip=frozenset()):
+    """Shared per-node rule walk for GraphDef.node and FunctionDef.node_def."""
+    for node in nodes:
+        if node.name in skip or node.op == "NoOp":
+            continue
+        rule = _RULES.get(node.op)
+        if rule is None:
+            raise TFImportError(
+                f"No mapping rule for TF op {node.op!r} (node "
+                f"{node.name!r}); register one with "
+                f"@tfimport.mapping_rule({node.op!r})")
+        inputs = []
+        for ref in node.input:
+            if ref.startswith("^"):      # control edge — execution order
+                continue                 # is given by topo order already
+            key = _fq(ref)
+            if key not in ctx.vars:
+                raise TFImportError(
+                    f"node {node.name!r} consumes unknown tensor {ref!r} "
+                    f"(GraphDef not topologically ordered?)")
+            inputs.append(ctx.vars[key])
+        attrs = _parse_attrs(node)
+        out = rule(ctx, node, inputs, attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for i, o in enumerate(outs):
+            ctx.vars[f"{node.name}:{i}"] = o
+        # canonical graph name: rename single-output ops to the tf name
+        if len(outs) == 1 and outs[0].name != node.name \
+                and node.name not in ctx.sd._vars:
+            outs[0].rename(node.name)
+
+
+def _fdef_builder(fdef, library):
+    """FunctionDef → a control-flow body builder fn(sub_sd, *args)."""
+    def build(sub_sd, *args):
+        ctx = _ImportCtx(sub_sd, library=library)
+        for i, arg in enumerate(fdef.signature.input_arg):
+            ctx.vars[f"{arg.name}:0"] = args[i]
+        _map_nodes(ctx, fdef.node_def)
+        outs = [ctx.vars[_fq(fdef.ret[oarg.name])]
+                for oarg in fdef.signature.output_arg]
+        return outs if len(outs) > 1 else outs[0]
+    return build
 
 
 class TFGraphMapper:
@@ -329,36 +560,11 @@ class TFGraphMapper:
     def import_graph(graph_def, ignore_nodes=()) -> SameDiff:
         gd = _as_graph_def(graph_def)
         sd = SameDiff.create()
-        ctx = _ImportCtx(sd)
-        skip = set(ignore_nodes)
-        for node in gd.node:
-            if node.name in skip or node.op == "NoOp":
-                continue
-            rule = _RULES.get(node.op)
-            if rule is None:
-                raise TFImportError(
-                    f"No mapping rule for TF op {node.op!r} (node "
-                    f"{node.name!r}); register one with "
-                    f"@tfimport.mapping_rule({node.op!r})")
-            inputs = []
-            for ref in node.input:
-                if ref.startswith("^"):      # control edge — execution order
-                    continue                 # is given by topo order already
-                key = ref if ":" in ref else ref + ":0"
-                if key not in ctx.vars:
-                    raise TFImportError(
-                        f"node {node.name!r} consumes unknown tensor {ref!r} "
-                        f"(GraphDef not topologically ordered?)")
-                inputs.append(ctx.vars[key])
-            attrs = _parse_attrs(node)
-            out = rule(ctx, node, inputs, attrs)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            for i, o in enumerate(outs):
-                ctx.vars[f"{node.name}:{i}"] = o
-            # canonical graph name: rename single-output ops to the tf name
-            if len(outs) == 1 and outs[0].name != node.name \
-                    and node.name not in ctx.sd._vars:
-                outs[0].rename(node.name)
+        library = {f.signature.name: f
+                   for f in getattr(gd, "library", ()).function} \
+            if gd.HasField("library") else {}
+        ctx = _ImportCtx(sd, library=library)
+        _map_nodes(ctx, gd.node, skip=set(ignore_nodes))
         return sd
 
     importGraph = import_graph

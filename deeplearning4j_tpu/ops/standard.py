@@ -152,6 +152,12 @@ def _strided_slice(x, begin, end, strides=None):
 
 register("strided_slice", _strided_slice, aliases=["StridedSlice"])
 register("gather", lambda x, indices, axis=0: jnp.take(x, indices, axis=axis), aliases=["Gather", "GatherV2"])
+register("split", lambda x, num_split=2, axis=0: tuple(jnp.split(x, int(num_split), axis=axis)),
+         num_outputs=-1, aliases=["Split"])
+register("split_v", lambda x, size_splits, axis=0:
+         tuple(jnp.split(x, list(np.cumsum([int(s) for s in size_splits[:-1]])), axis=axis)),
+         num_outputs=-1, aliases=["SplitV"])
+register("einsum", lambda *xs, equation: jnp.einsum(equation, *xs), aliases=["Einsum"])
 register("gather_nd", lambda x, indices: x[tuple(jnp.moveaxis(indices, -1, 0))], aliases=["GatherNd"])
 
 

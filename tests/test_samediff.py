@@ -255,3 +255,65 @@ class TestNamespaces:
         p = e / e.sum(-1, keepdims=True)
         ref = -(lab * np.log(p)).sum(-1).mean()
         assert abs(out - ref) < 1e-5
+
+
+class TestControlFlow:
+    """ref: SameDiff#ifCond/#whileLoop (SURVEY control-flow gap, VERDICT
+    weak #8) — lax.cond/lax.while_loop composite ops with nested graphs."""
+
+    def test_if_cond_both_branches(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,), np.float32)
+        out = sd.if_cond(x.sum() > 0.0, lambda s, a: a * 2.0,
+                         lambda s, a: a - 1.0, x).rename("out")
+        pos = sd.output({"x": np.array([1., 2., 3.], "f4")}, "out")["out"]
+        neg = sd.output({"x": np.array([-1., -2., -3.], "f4")}, "out")["out"]
+        assert np.allclose(pos, [2., 4., 6.])
+        assert np.allclose(neg, [-2., -3., -4.])
+
+    def test_if_cond_shape_mismatch_raises(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,), np.float32)
+        with pytest.raises(ValueError, match="matching"):
+            sd.if_cond(x.sum() > 0.0, lambda s, a: a.sum(),
+                       lambda s, a: a * 1.0, x)
+
+    def test_while_loop_accumulates(self):
+        sd = SameDiff.create()
+        i0 = sd.constant(np.int32(0), name="i0")
+        a0 = sd.constant(np.float32(0.0), name="a0")
+        _, acc = sd.while_loop(lambda s, i, a: i < 10,
+                               lambda s, i, a: (i + 1, a + 2.0), i0, a0)
+        acc.rename("acc")
+        assert float(sd.output({}, "acc")["acc"]) == 20.0
+
+    def test_control_flow_serialization_roundtrip(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (3,), np.float32)
+        sd.if_cond(x.sum() > 0.0, lambda s, a: a * 2.0,
+                   lambda s, a: a - 1.0, x).rename("out")
+        p = str(tmp_path / "cf.zip")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        feed = {"x": np.array([1., 2., 3.], "f4")}
+        assert np.allclose(sd2.output(feed, "out")["out"],
+                           sd.output(feed, "out")["out"])
+
+    def test_gradient_flows_through_cond(self):
+        from deeplearning4j_tpu.optim.updaters import Adam
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2,), np.float32)
+        w = sd.var("w", init=np.ones(2, np.float32))
+        sd.if_cond(x.sum() > 0, lambda s, a, ww: (a * ww).sum(),
+                   lambda s, a, ww: (a * ww * 2.0).sum(), x, w).rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.1), data_set_feature_mapping=["x"]))
+        losses = sd.fit({"x": np.array([1., 1.], "f4")}, epochs=3)
+        assert losses[-1] < losses[0]
+
+    def test_while_loop_dtype_mismatch_raises(self):
+        sd = SameDiff.create()
+        i0 = sd.constant(np.int32(9), name="i0")
+        with pytest.raises(ValueError, match="preserve"):
+            sd.while_loop(lambda s, i: i > 0, lambda s, i: i / 2.0, i0)
