@@ -807,21 +807,29 @@ class SameDiff:
             base_key = jax.random.key(rng_seed) if not isinstance(
                 rng_seed, jax.Array) or jnp.issubdtype(
                 jnp.asarray(rng_seed).dtype, jnp.integer) else rng_seed
-            for op in ops:
+            for op_idx, op in enumerate(ops):
                 args = [env[i] for i in op.inputs]
                 if op.op_name == "__cond__":
                     t_fn = op.subgraphs["true"]._branch_fn()
                     f_fn = op.subgraphs["false"]._branch_fn()
                     pred = jnp.squeeze(args[0]).astype(bool)
-                    res = jax.lax.cond(pred, t_fn, f_fn, *args[1:])
+                    # thread a per-node key so random ops inside branches
+                    # follow the execution-time seed
+                    key = jax.random.fold_in(base_key, 1 + op_idx)
+                    res = jax.lax.cond(
+                        pred,
+                        lambda a: t_fn(*a[:-1], rng_seed=a[-1]),
+                        lambda a: f_fn(*a[:-1], rng_seed=a[-1]),
+                        (*args[1:], key))
                     if len(op.outputs) == 1 and isinstance(res, tuple):
                         res = res[0]
                 elif op.op_name == "__while__":
                     c_fn = op.subgraphs["cond"]._branch_fn()
                     b_fn = op.subgraphs["body"]._branch_fn()
+                    key = jax.random.fold_in(base_key, 1 + op_idx)
 
-                    def _body(st, _b=b_fn, _n=len(args)):
-                        r = _b(*st)
+                    def _body(st, _b=b_fn, _k=key):
+                        r = _b(*st, rng_seed=_k)
                         r = r if isinstance(r, tuple) else (r,)
                         # carry must keep the init structure/dtypes exactly
                         return tuple(jnp.asarray(x).astype(s.dtype)
@@ -864,9 +872,9 @@ class SameDiff:
         outs = self._branch_outputs
         emit = self._emit(outs)
 
-        def g(*xs):
+        def g(*xs, rng_seed=0):
             ph = {f"arg{i}": x for i, x in enumerate(xs)}
-            res = emit(self._values, ph, 0)
+            res = emit(self._values, ph, rng_seed)
             return res if len(outs) > 1 else res[0]
 
         return g

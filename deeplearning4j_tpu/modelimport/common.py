@@ -1,0 +1,20 @@
+"""Shared importer helpers (ref: ``samediff-import-api`` — the layer both
+the TF and ONNX importers build on)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def fold_constant(sd, var) -> Optional[np.ndarray]:
+    """Evaluate ``var`` if it depends only on constants; None otherwise.
+
+    Eager ``_emit`` (no jit) — folding must not pay one XLA compile per
+    structural argument on large imported graphs.
+    """
+    try:
+        fn = sd._emit([var.name])
+        return np.asarray(fn(sd._values, {}, 0)[0])
+    except Exception:
+        return None

@@ -130,18 +130,14 @@ class _ImportCtx:
         # constant-fold a structural subgraph (Shape→StridedSlice→Pack etc.):
         # if the producing var depends only on constants, evaluate it through
         # the graph engine (the reference resolves these via its attribute-
-        # resolution pass; here the real lowering does the arithmetic).
-        # Eager _emit, NOT sd.output — folding must not pay one fresh XLA
-        # compile per structural argument on BERT-sized graphs.
+        # resolution pass; here the real lowering does the arithmetic)
         var = self.vars.get(key)
         if var is not None:
-            try:
-                fn = self.sd._emit([var.name])
-                arr = np.asarray(fn(self.sd._values, {}, 0)[0])
+            from deeplearning4j_tpu.modelimport.common import fold_constant
+            arr = fold_constant(self.sd, var)
+            if arr is not None:
                 self.consts[key] = arr
                 return arr
-            except Exception:
-                pass
         raise TFImportError(
             f"op input {ref!r} must be a constant (or constant-foldable) "
             f"for import (structural argument)")
@@ -324,10 +320,17 @@ def _register_default_rules():
         sm = attrs.get("shrink_axis_mask", 0)
         nm = attrs.get("new_axis_mask", 0)
         elm = attrs.get("ellipsis_mask", 0)
-        if inputs[0].shape is None:
-            raise TFImportError("StridedSlice needs a statically-known rank")
-        rank = len(inputs[0].shape)
         nspec = len(begin)
+        if inputs[0].shape is not None:
+            rank = len(inputs[0].shape)
+        elif not elm:
+            # rank only matters for ellipsis expansion / trailing fill;
+            # without it, unspecified trailing dims are simply left unsliced
+            rank = nspec - bin(nm & ((1 << nspec) - 1)).count("1")
+        else:
+            raise TFImportError(
+                "StridedSlice with ellipsis_mask needs a statically-known "
+                "input rank")
         # number of input dims the ellipsis expands into
         n_real = sum(1 for i in range(nspec)
                      if not (nm >> i) & 1 and not (elm >> i) & 1)
@@ -417,10 +420,14 @@ def _register_default_rules():
     @mapping_rule("OneHot")
     def _one_hot(ctx, node, inputs, attrs):
         depth = int(ctx.const_value(node.input[1]))
-        on = float(ctx.const_value(node.input[2]))
-        off = float(ctx.const_value(node.input[3]))
+        # .item() keeps the native python type; output dtype follows the
+        # node's T attr (int OneHot must stay int)
+        on = ctx.const_value(node.input[2]).item()
+        off = ctx.const_value(node.input[3]).item()
+        dt = _dtype_of(attrs["T"]).name if "T" in attrs else None
         return ctx.sd._op("OneHot", inputs[0], depth=depth, on_value=on,
-                          off_value=off, axis=attrs.get("axis", -1))
+                          off_value=off, axis=attrs.get("axis", -1),
+                          dtype=dt)
 
     @mapping_rule("Einsum")
     def _einsum(ctx, node, inputs, attrs):

@@ -27,6 +27,8 @@ register("maximum", jnp.maximum, aliases=["Maximum"])
 register("minimum", jnp.minimum, aliases=["Minimum"])
 register("neg", jnp.negative, aliases=["Neg"])
 register("reciprocal", jnp.reciprocal, aliases=["Reciprocal"])
+# C-style truncating division (ONNX Div on ints truncates toward zero)
+register("truncate_div", lax.div, aliases=["TruncateDiv"])
 
 # --------------------------------------------------------------- elementwise
 for _n, _f, _al in [
@@ -186,9 +188,13 @@ register("zeros_like", jnp.zeros_like, aliases=["ZerosLike"])
 register("ones_like", jnp.ones_like, aliases=["OnesLike"])
 register("linspace", lambda start, stop, num: jnp.linspace(start, stop, int(num)), aliases=["LinSpace"])
 register("range", lambda start, limit, delta: jnp.arange(start, limit, delta), aliases=["Range"])
-register("one_hot", lambda indices, depth, on_value=1.0, off_value=0.0, axis=-1:
-         jax.nn.one_hot(indices, int(depth), axis=axis) * (on_value - off_value) + off_value,
-         aliases=["OneHot", "onehot"])
+def _one_hot(indices, depth, on_value=1.0, off_value=0.0, axis=-1, dtype=None):
+    out = jax.nn.one_hot(indices, int(depth), axis=axis) \
+        * (on_value - off_value) + off_value
+    return out.astype(dtype) if dtype is not None else out
+
+
+register("one_hot", _one_hot, aliases=["OneHot", "onehot"])
 register("where", lambda cond, x=None, y=None: jnp.where(cond, x, y) if x is not None
          else jnp.stack(jnp.nonzero(cond), axis=-1), aliases=["Where", "Select", "SelectV2"])
 register("broadcast_to", lambda x, shape: jnp.broadcast_to(x, tuple(int(s) for s in shape)), aliases=["BroadcastTo"])
@@ -296,6 +302,55 @@ def conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 1), groups
     out = _conv_nd(x, w, strides, _pad_attr(padding, (0, 0), None), dilation, 2,
                    feature_group_count=int(groups))
     return out + b if b is not None else out
+
+
+# NCHW variants for the ONNX import path (ONNX is NCHW/OIHW-native; XLA's
+# layout assignment makes these TPU-efficient without host transposes)
+@register("conv2d_nchw")
+def conv2d_nchw(x, w, b=None, strides=(1, 1), padding=((0, 0), (0, 0)),
+                dilation=(1, 1), groups=1):
+    out = lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=_pad_attr(padding, (0, 0), None),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(groups),
+        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None)
+    return out + b.reshape(1, -1, 1, 1) if b is not None else out
+
+
+def _pool_nchw(x, reducer, init, kernel, strides, padding):
+    return lax.reduce_window(
+        x, init, reducer, window_dimensions=(1, 1) + tuple(kernel),
+        window_strides=(1, 1) + tuple(strides),
+        padding=((0, 0), (0, 0)) + tuple(tuple(p) for p in padding))
+
+
+@register("maxpool2d_nchw")
+def maxpool2d_nchw(x, kernel=(2, 2), strides=(2, 2), padding=((0, 0), (0, 0))):
+    return _pool_nchw(x, lax.max, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                      else jnp.iinfo(x.dtype).min, kernel, strides, padding)
+
+
+@register("avgpool2d_nchw")
+def avgpool2d_nchw(x, kernel=(2, 2), strides=(2, 2), padding=((0, 0), (0, 0)),
+                   count_include_pad=False):
+    s = _pool_nchw(x, lax.add, 0.0, kernel, strides, padding)
+    if count_include_pad:
+        return s / float(np.prod(kernel))
+    cnt = _pool_nchw(jnp.ones_like(x), lax.add, 0.0, kernel, strides, padding)
+    return s / cnt
+
+
+@register("global_avgpool_nchw")
+def global_avgpool_nchw(x):
+    return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@register("batchnorm_nchw")
+def batchnorm_nchw(x, scale, offset, mean, var, epsilon=1e-5):
+    shp = (1, -1) + (1,) * (x.ndim - 2)
+    inv = lax.rsqrt(var.astype(jnp.float32) + epsilon).reshape(shp).astype(x.dtype)
+    return (x - mean.reshape(shp)) * inv * scale.reshape(shp) + offset.reshape(shp)
 
 
 @register("conv3d", aliases=["Conv3D"])
