@@ -1,0 +1,155 @@
+"""Systematic finite-difference gradient checks over EVERY layer class.
+
+Ref: ``org.deeplearning4j.gradientcheck.GradientCheckTests`` /
+``GradCheckUtil`` — the reference gates every layer through central-FD
+double-precision checks; this module does the same via
+``autodiff.validation.grad_check`` (f64, central differences), with a
+coverage gate so new layer classes cannot ship unchecked.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.validation import grad_check
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+
+R = np.random.RandomState
+F32 = np.float32
+
+
+def _x(shape, seed=0, scale=1.0):
+    return (R(seed).randn(*shape) * scale).astype(F32)
+
+
+# name → (layer factory, input array, {opts}). Inputs: FF (N,C),
+# CNN (N,H,W,C) NHWC, RNN (N,T,C). opts: train (training mode),
+# int_input (no input grads), mask (rnn mask array)
+SPECS = {
+    "DenseLayer": (lambda: L.DenseLayer(n_in=4, n_out=3), _x((3, 4)), {}),
+    "OutputLayer": (lambda: L.OutputLayer(n_in=4, n_out=3), _x((3, 4)), {}),
+    "LossLayer": (lambda: L.LossLayer(), _x((3, 4)), {}),
+    "ActivationLayer": (lambda: L.ActivationLayer(activation="tanh"),
+                        _x((3, 4)), {}),
+    "DropoutLayer": (lambda: L.DropoutLayer(dropout=0.5), _x((3, 4)), {}),
+    "ConvolutionLayer": (lambda: L.ConvolutionLayer(
+        kernel_size=(3, 3), n_in=2, n_out=3), _x((2, 5, 5, 2)), {}),
+    "Deconvolution2D": (lambda: L.Deconvolution2D(
+        kernel_size=(3, 3), stride=(2, 2), n_in=2, n_out=3),
+        _x((2, 3, 3, 2)), {}),
+    "SeparableConvolution2D": (lambda: L.SeparableConvolution2D(
+        kernel_size=(3, 3), n_in=2, n_out=3, depth_multiplier=2),
+        _x((2, 5, 5, 2)), {}),
+    "SubsamplingLayer": (lambda: L.SubsamplingLayer(
+        pooling_type="avg", kernel_size=(2, 2), stride=(2, 2)),
+        _x((2, 4, 4, 2)), {}),
+    "SubsamplingLayerMax": (lambda: L.SubsamplingLayer(
+        pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+        _x((2, 4, 4, 2)), {}),
+    "Upsampling2D": (lambda: L.Upsampling2D(size=(2, 2)),
+                     _x((2, 3, 3, 2)), {}),
+    "ZeroPaddingLayer": (lambda: L.ZeroPaddingLayer(padding=(1, 1)),
+                         _x((2, 3, 3, 2)), {}),
+    "Cropping2D": (lambda: L.Cropping2D(cropping=(1, 1)),
+                   _x((2, 5, 5, 2)), {}),
+    "GlobalPoolingLayer": (lambda: L.GlobalPoolingLayer(pooling_type="avg"),
+                           _x((2, 4, 4, 2)), {}),
+    "BatchNormalization": (lambda: L.BatchNormalization(n_out=3),
+                           _x((4, 3)), {"train": True}),
+    "BatchNormalizationInference": (lambda: L.BatchNormalization(n_out=3),
+                                    _x((4, 3)), {}),
+    "LocalResponseNormalization": (lambda: L.LocalResponseNormalization(),
+                                   _x((2, 3, 3, 4)), {}),
+    "EmbeddingLayer": (lambda: L.EmbeddingLayer(n_in=7, n_out=4),
+                       R(1).randint(0, 7, (5,)), {"int_input": True}),
+    "EmbeddingSequenceLayer": (lambda: L.EmbeddingSequenceLayer(
+        n_in=7, n_out=4), R(1).randint(0, 7, (3, 6)), {"int_input": True}),
+    "LSTM": (lambda: L.LSTM(n_in=3, n_out=4), _x((2, 5, 3)), {}),
+    "GravesLSTM": (lambda: L.GravesLSTM(n_in=3, n_out=4), _x((2, 5, 3)), {}),
+    "GRU": (lambda: L.GRU(n_in=3, n_out=4), _x((2, 5, 3)), {}),
+    "SimpleRnn": (lambda: L.SimpleRnn(n_in=3, n_out=4), _x((2, 5, 3)), {}),
+    "Bidirectional": (lambda: L.Bidirectional.wrap(
+        L.LSTM(n_in=3, n_out=4), mode="concat"), _x((2, 5, 3)), {}),
+    "RnnOutputLayer": (lambda: L.RnnOutputLayer(n_in=4, n_out=3),
+                       _x((2, 5, 4)), {}),
+    "LastTimeStep": (lambda: L.LastTimeStep.wrap(L.LSTM(n_in=3, n_out=4)),
+                     _x((2, 5, 3)), {}),
+    "SelfAttentionLayer": (lambda: L.SelfAttentionLayer(
+        n_in=4, n_out=4, n_heads=2, head_size=2), _x((2, 5, 4)), {}),
+    "MaskedLSTM": (lambda: L.LSTM(n_in=3, n_out=4), _x((2, 5, 3)),
+                   {"mask": np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
+                                     F32)}),
+}
+
+
+def _check(layer, x, opts):
+    layer.apply_global_defaults({"activation": "tanh",
+                                 "weight_init": "xavier"})
+    params = layer.init_params(jax.random.key(0))
+    state = layer.init_state() or None
+    training = opts.get("train", False)
+    mask = opts.get("mask")
+    int_input = opts.get("int_input", False)
+
+    def run(p, xx):
+        kw = {}
+        if mask is not None:
+            kw["mask"] = jnp.asarray(mask)
+        out = layer.apply(p, xx, training=training, state=state, **kw)
+        if isinstance(out, tuple):
+            out = out[0]
+        # tanh bounds the output so FD stays in a well-scaled regime
+        return jnp.sum(jnp.tanh(out))
+
+    if int_input:
+        fn = lambda p: run(p, jnp.asarray(x))
+        tree = params
+    else:
+        fn = lambda t: run(t["params"], t["x"])
+        tree = {"params": params, "x": jnp.asarray(x)}
+    assert grad_check(fn, tree, subset=8, max_rel_error=2e-3)
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_layer_gradcheck(name):
+    factory, x, opts = SPECS[name]
+    _check(factory(), x, opts)
+
+
+def test_yolo2_loss_gradcheck():
+    """Yolo2 is a loss head: check d(loss)/d(activations)."""
+    boxes = [(1.0, 1.5), (2.0, 1.0)]
+    lyr = Yolo2OutputLayer(boxes=boxes)
+    lyr.apply_global_defaults({})
+    n, h, w, b, c = 1, 3, 3, 2, 2
+    x = _x((n, h, w, b * (5 + c)), seed=3, scale=0.3)
+    r = R(4)
+    labels = np.zeros((n, h, w, 4 + c), F32)
+    labels[0, 1, 1] = [0.8, 0.9, 2.1, 2.4, 1.0, 0.0]
+
+    def fn(tree):
+        return jnp.asarray(
+            lyr.loss(None, tree["x"], jnp.asarray(labels))).sum()
+
+    assert grad_check(fn, {"x": jnp.asarray(x)}, subset=12,
+                      max_rel_error=2e-3)
+
+
+def test_every_layer_class_is_gradchecked():
+    """Coverage gate: a layer class added to nn/conf/layers.py without a
+    gradcheck spec (or explicit exemption) fails here."""
+    checked = {type(f()).__name__ for f, _, _ in SPECS.values()}
+    exempt = {
+        "Layer", "_ConvBase", "_RnnBase",   # abstract bases
+    }
+    all_classes = {
+        name for name, obj in vars(L).items()
+        if isinstance(obj, type) and issubclass(obj, L.Layer)
+        and dataclasses.is_dataclass(obj)
+    }
+    missing = all_classes - checked - exempt
+    assert not missing, f"layer classes without gradcheck: {sorted(missing)}"
