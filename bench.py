@@ -46,28 +46,59 @@ def probe_accelerator():
                   f"{(r.stderr or r.stdout).strip()[-2000:]}")
 
 
-def measure_tokens_per_sec(step, params, opt_state, toks, tgts, iters, repeats):
-    """Warmup/compile once, then median tokens/sec over ``repeats`` timed
-    windows of ``iters`` steps. Shared by the model under test and the flax
-    denominator so the measurement can never drift between them."""
-    import jax
+class StepTimer:
+    """Warmup once, then expose one-window timing so the model under test
+    and the flax denominator can be measured INTERLEAVED (A,B,A,B…) — a
+    sequential A…A,B…B layout lets any machine-load drift between the two
+    phases masquerade as a model difference."""
 
-    n_tokens = toks.shape[0] * toks.shape[1]
-    params, opt_state, loss = step(params, opt_state, toks, tgts)
-    jax.block_until_ready(loss)
-    runs = []
-    for _ in range(repeats):
+    def __init__(self, step, params, opt_state, toks, tgts, iters):
+        import jax
+
+        self._jax = jax
+        self.step = step
+        self.state = (params, opt_state)
+        self.toks, self.tgts = toks, tgts
+        self.iters = iters
+        self.n_tokens = toks.shape[0] * toks.shape[1]
+        self.loss = None
+        self.runs = []
+        self._warm()
+
+    def _warm(self):
+        p, s = self.state
+        p, s, loss = self.step(p, s, self.toks, self.tgts)
+        self._jax.block_until_ready(loss)
+        self.state = (p, s)
+        self.loss = loss
+
+    def run_window(self):
+        p, s = self.state
         t0 = time.perf_counter()
-        for _ in range(iters):
-            params, opt_state, loss = step(params, opt_state, toks, tgts)
-        jax.block_until_ready(loss)
-        runs.append(n_tokens * iters / (time.perf_counter() - t0))
-    return statistics.median(runs), loss
+        for _ in range(self.iters):
+            p, s, loss = self.step(p, s, self.toks, self.tgts)
+        self._jax.block_until_ready(loss)
+        self.runs.append(self.n_tokens * self.iters
+                         / (time.perf_counter() - t0))
+        self.state = (p, s)
+        self.loss = loss
+
+    def tokens_per_sec(self):
+        return statistics.median(self.runs)
 
 
-def flax_baseline_tokens_per_sec(cfg, batch, iters, repeats):
+def measure_tokens_per_sec(step, params, opt_state, toks, tgts, iters, repeats):
+    """Single-model path (used when the flax denominator is unavailable)."""
+    timer = StepTimer(step, params, opt_state, toks, tgts, iters)
+    for _ in range(repeats):
+        timer.run_window()
+    return timer.tokens_per_sec(), timer.loss
+
+
+def flax_baseline_timer(cfg, batch, iters):
     """Same-shape decoder LM in plain flax.linen + optax — the BASELINE.md
-    'JAX/Flax reference' denominator, measured on the same chip in-process."""
+    'JAX/Flax reference' denominator, measured on the same chip in-process
+    (returns a warm StepTimer for interleaved measurement)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -132,9 +163,7 @@ def flax_baseline_tokens_per_sec(cfg, batch, iters, repeats):
         up, s = opt.update(g, s, p)
         return optax.apply_updates(p, up), s, loss
 
-    tps, _ = measure_tokens_per_sec(step, params, opt_state, toks, tgts,
-                                    iters, repeats)
-    return tps
+    return StepTimer(step, params, opt_state, toks, tgts, iters)
 
 
 def main():
@@ -187,23 +216,28 @@ def main():
 
     iters = 20 if on_tpu else 5
     repeats = 3
-    tokens_per_sec, loss = measure_tokens_per_sec(
-        step, params, opt_state, toks, tgts, iters, repeats)
+    ours = StepTimer(step, params, opt_state, toks, tgts, iters)
+
+    # --- plain-Flax denominator on the same chip, measured INTERLEAVED ---
+    flax_timer = None
+    try:
+        flax_timer = flax_baseline_timer(cfg, batch, iters)
+    except Exception as e:  # measured best-effort; failure is reported, not hidden
+        print(f"[bench] flax baseline failed: {e!r}", file=sys.stderr)
+
+    for _ in range(repeats):
+        ours.run_window()
+        if flax_timer is not None:
+            flax_timer.run_window()
+    tokens_per_sec, loss = ours.tokens_per_sec(), ours.loss
+    flax_tps = flax_timer.tokens_per_sec() if flax_timer else None
+    vs_flax = (tokens_per_sec / flax_tps) if flax_tps else None
 
     # --- MFU: train FLOPs/token ≈ 6·N_params + 12·L·T·d (attention term) ---
     n_params = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params))
     flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.max_len * cfg.d_model
     peak = PEAK_FLOPS.get(platform)
     mfu = (tokens_per_sec * flops_per_token / peak) if peak else None
-
-    # --- plain-Flax denominator on the same chip ---
-    vs_flax = None
-    flax_tps = None
-    try:
-        flax_tps = flax_baseline_tokens_per_sec(cfg, batch, iters, repeats)
-        vs_flax = tokens_per_sec / flax_tps
-    except Exception as e:  # measured best-effort; failure is reported, not hidden
-        print(f"[bench] flax baseline failed: {e!r}", file=sys.stderr)
 
     out = {
         "metric": "transformer_lm_train_tokens_per_sec",

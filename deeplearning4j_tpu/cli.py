@@ -1,0 +1,23 @@
+"""Console entry points (the packaging story — SURVEY E7).
+
+The reference is a library with no CLI; the one operational surface worth a
+console script is the round benchmark, exposed as ``dl4j-tpu-bench``.
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def bench_main():
+    """Run the repo-root ``bench.py`` (or the packaged copy's directory)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = os.path.join(here, "bench.py")
+    if not os.path.exists(bench):
+        print("bench.py not found next to the package; run from a source "
+              "checkout", file=sys.stderr)
+        return 1
+    sys.argv = ["bench.py"]
+    runpy.run_path(bench, run_name="__main__")
+    return 0

@@ -3,8 +3,9 @@
 deeplearning4j-graph — SURVEY D17/D18)."""
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
 from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
 from deeplearning4j_tpu.clustering.deepwalk import DeepWalk, GraphFactory
 
-__all__ = ["KMeansClustering", "VPTree", "BarnesHutTsne", "DeepWalk",
-           "GraphFactory"]
+__all__ = ["KMeansClustering", "VPTree", "RandomProjectionLSH",
+           "BarnesHutTsne", "DeepWalk", "GraphFactory"]

@@ -1,0 +1,93 @@
+"""Locality-sensitive hashing for approximate nearest neighbours.
+
+Reference: ``org.deeplearning4j.clustering.lsh.RandomProjectionLSH``
+(deeplearning4j-nearestneighbors — SURVEY D17): signed random projections
+(SimHash) over a set of hash tables; candidates = points sharing a bucket in
+any table, re-ranked by exact distance.
+
+TPU-first: hashing the whole corpus is ONE matmul per table batch
+((N, D) @ (D, bits) on the MXU) followed by a bit-pack; queries hash the
+same way. Bucket lookup stays on the host (hash maps are not an XLA shape).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class RandomProjectionLSH:
+    """SimHash ANN index (ref API: RandomProjectionLSH(hashLength, numTables,
+    dim); #makeIndex, #search)."""
+
+    def __init__(self, hash_length: int = 12, num_tables: int = 4,
+                 dim: int = None, seed: int = 0):
+        self.hash_length = hash_length
+        self.num_tables = num_tables
+        self.dim = dim
+        self.seed = seed
+        self._planes = None          # (T, D, bits)
+        self._tables: List[Dict[int, List[int]]] = []
+        self._data: np.ndarray = None
+
+    def _hash(self, x: np.ndarray) -> np.ndarray:
+        """(N, D) → (T, N) bucket keys via one (N,D)@(D,bits) matmul per
+        table (jitted batch on device)."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def proj(x, planes):
+            # (T, N, bits) sign bits in one einsum
+            s = jnp.einsum("nd,tdb->tnb", x, planes) >= 0
+            weights = jnp.asarray(1 << np.arange(self.hash_length),
+                                  jnp.uint32)
+            return jnp.sum(s.astype(jnp.uint32) * weights, axis=-1)
+
+        return np.asarray(proj(jnp.asarray(x, jnp.float32),
+                               jnp.asarray(self._planes, jnp.float32)))
+
+    def make_index(self, data) -> "RandomProjectionLSH":
+        data = np.asarray(data, np.float32)
+        n, d = data.shape
+        if self.dim is None:
+            self.dim = d
+        rng = np.random.default_rng(self.seed)
+        self._planes = rng.normal(
+            size=(self.num_tables, self.dim, self.hash_length))
+        self._data = data
+        keys = self._hash(data)                       # (T, N)
+        self._tables = []
+        for t in range(self.num_tables):
+            tbl: Dict[int, List[int]] = {}
+            for i, k in enumerate(keys[t]):
+                tbl.setdefault(int(k), []).append(i)
+            self._tables.append(tbl)
+        return self
+
+    makeIndex = make_index
+
+    def _candidates(self, q: np.ndarray) -> np.ndarray:
+        keys = self._hash(q[None])                    # (T, 1)
+        cand = set()
+        for t in range(self.num_tables):
+            cand.update(self._tables[t].get(int(keys[t, 0]), ()))
+        return np.fromiter(cand, dtype=np.int64) if cand else np.zeros(0, np.int64)
+
+    def search(self, query, k: int = 1) -> Tuple[List[int], List[float]]:
+        """k approximate nearest neighbours: bucket candidates re-ranked by
+        exact euclidean distance (falls back to brute force when the buckets
+        are empty — matching the reference's behavior of never returning
+        nothing for a valid query)."""
+        q = np.asarray(query, np.float32).reshape(-1)
+        cand = self._candidates(q)
+        if len(cand) == 0:
+            cand = np.arange(len(self._data))
+        d = np.linalg.norm(self._data[cand] - q[None], axis=1)
+        order = np.argsort(d)[:k]
+        return [int(cand[i]) for i in order], [float(d[i]) for i in order]
+
+    def bucket(self, query) -> np.ndarray:
+        """All candidate indices sharing a bucket with the query (ref:
+        #bucket)."""
+        return self._candidates(np.asarray(query, np.float32).reshape(-1))

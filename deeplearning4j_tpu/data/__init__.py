@@ -7,3 +7,6 @@ from deeplearning4j_tpu.data.normalizers import (
     ImagePreProcessingScaler, NormalizerMinMaxScaler, NormalizerStandardize,
     VGG16ImagePreProcessor)
 from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.data.vision import (
+    Cifar10DataSetIterator, CifarDataSetIterator, EmnistDataSetIterator,
+    TinyImageNetDataSetIterator)

@@ -21,3 +21,6 @@ __all__ = [
     "LineRecordReader", "CollectionRecordReader", "CSVSequenceRecordReader",
     "FileSplit", "ListStringSplit", "LocalTransformExecutor",
 ]
+from deeplearning4j_tpu.datavec.arrow import ArrowConverter, ArrowRecordReader  # noqa: E402
+
+__all__ += ["ArrowConverter", "ArrowRecordReader"]

@@ -1,0 +1,8 @@
+"""Interop: run live TensorFlow / ONNX-Runtime sessions on NDArrays
+(ref: nd4j-tensorflow / nd4j-onnxruntime ``GraphRunner`` — SURVEY J15.
+Interop, NOT import: the external runtime executes the graph; arrays cross
+the boundary zero-copy via numpy).
+"""
+from deeplearning4j_tpu.interop.runners import GraphRunner, OnnxRuntimeRunner
+
+__all__ = ["GraphRunner", "OnnxRuntimeRunner"]

@@ -12,9 +12,11 @@ from deeplearning4j_tpu.models.zoo.squeezenet import SqueezeNet
 from deeplearning4j_tpu.models.zoo.darknet import Darknet19, TinyYOLO, YOLO2
 from deeplearning4j_tpu.models.zoo.unet import UNet
 from deeplearning4j_tpu.models.zoo.xception import Xception
+from deeplearning4j_tpu.models.zoo.inception import InceptionResNetV1, NASNet
 
 __all__ = [
     "ZooModel", "PretrainedType", "LeNet", "SimpleCNN", "AlexNet",
     "TextGenerationLSTM", "VGG16", "VGG19", "ResNet50", "SqueezeNet",
     "Darknet19", "TinyYOLO", "YOLO2", "UNet", "Xception",
+    "InceptionResNetV1", "NASNet",
 ]

@@ -45,7 +45,10 @@ class LeNet(ZooModel):
                 .layer(DenseLayer(n_out=500, activation="relu"))
                 .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
                                    loss_function="mcxent"))
-                .set_input_type(InputType.convolutional(h, w, c))
+                # convolutional_flat, matching the reference LeNetMNIST
+                # example contract: MnistDataSetIterator feeds (N, 784) rows
+                # (4-D NHWC input still passes through untouched)
+                .set_input_type(InputType.convolutional_flat(h, w, c))
                 .build())
 
 
@@ -126,11 +129,14 @@ class AlexNet(ZooModel):
 class TextGenerationLSTM(ZooModel):
     """ref: zoo.model.TextGenerationLSTM — char-level 2xLSTM(256)."""
 
-    def __init__(self, total_unique_characters: int = 47, seed: int = 123):
+    def __init__(self, total_unique_characters: int = 47, seed: int = 123,
+                 tbptt_length: int = 50):
         self.n_chars = total_unique_characters
         self.seed = seed
+        self.tbptt_length = tbptt_length
 
     def conf(self):
+        from deeplearning4j_tpu.nn.conf.configuration import BackpropType
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
                 .updater(Adam(1e-3))
@@ -140,5 +146,7 @@ class TextGenerationLSTM(ZooModel):
                 .layer(LSTM(n_out=256, activation="tanh"))
                 .layer(RnnOutputLayer(n_out=self.n_chars, activation="softmax",
                                       loss_function="mcxent"))
+                .backprop_type(BackpropType.TruncatedBPTT)
+                .t_bptt_length(self.tbptt_length)
                 .set_input_type(InputType.recurrent(self.n_chars))
                 .build())

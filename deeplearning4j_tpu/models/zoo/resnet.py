@@ -21,10 +21,13 @@ class ResNet50(ZooModel):
     input_shape = (224, 224, 3)
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(224, 224, 3)):
+                 input_shape=(224, 224, 3), updater=None):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        # ref parity: ZooModel builders accept an updater override
+        # (ResNet50.builder().updater(...)); default matches the reference
+        self.updater = updater
 
     # ----- blocks (ref: ResNet50#convBlock / #identityBlock)
     def _conv_bn_act(self, g, name, inp, n_out, kernel, stride=(1, 1),
@@ -65,7 +68,7 @@ class ResNet50(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-1, 0.9))
+             .updater(self.updater or Nesterovs(1e-1, 0.9))
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")

@@ -1,0 +1,336 @@
+// PJRT C-API shim — the nd4j-tpu backend's native runtime layer.
+//
+// Reference: libnd4j's flat NativeOps C ABI (legacy/NativeOps.h) is the JNI
+// surface the Java backends wrap (SURVEY N5); its TPU-native equivalent is
+// this shim over the PJRT C API (pjrt_c_api.h): load a PJRT plugin
+// (libtpu.so, or any other conforming plugin), create a client, compile an
+// MLIR (StableHLO) program, move host buffers, execute, read back. The
+// Python binding (native/pjrt.py) plays the JavaCPP-preset role (SURVEY
+// N10) over this ABI via ctypes.
+//
+// Error contract: every entry point that can fail takes (char* err, int
+// errlen); on failure it copies a NUL-terminated message and returns
+// NULL/-1. No exceptions cross the ABI.
+
+#include <dlfcn.h>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+void set_err(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+// Returns true (and fills err) if e is an error; frees e.
+bool consume_error(const PJRT_Api* api, PJRT_Error* e, char* err, int errlen,
+                   const char* where) {
+  if (e == nullptr) return false;
+  PJRT_Error_Message_Args margs;
+  std::memset(&margs, 0, sizeof(margs));
+  margs.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  margs.error = e;
+  api->PJRT_Error_Message(&margs);
+  set_err(err, errlen, std::string(where) + ": " +
+                           std::string(margs.message, margs.message_size));
+  PJRT_Error_Destroy_Args dargs;
+  std::memset(&dargs, 0, sizeof(dargs));
+  dargs.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  dargs.error = e;
+  api->PJRT_Error_Destroy(&dargs);
+  return true;
+}
+
+struct ShimClient {
+  const PJRT_Api* api;
+  PJRT_Client* client;
+};
+
+struct ShimExecutable {
+  const PJRT_Api* api;
+  PJRT_Client* client;
+  PJRT_LoadedExecutable* exec;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- plugin
+// dlopen a PJRT plugin and return its PJRT_Api* (NULL + err on failure).
+const void* nd4j_pjrt_load_plugin(const char* path, char* err, int errlen) {
+  void* handle = dlopen(path, RTLD_NOW | RTLD_LOCAL);
+  if (!handle) {
+    set_err(err, errlen, std::string("dlopen failed: ") + dlerror());
+    return nullptr;
+  }
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get_api = reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (!get_api) {
+    set_err(err, errlen,
+            std::string("GetPjrtApi symbol not found: ") + dlerror());
+    return nullptr;
+  }
+  const PJRT_Api* api = get_api();
+  if (!api) {
+    set_err(err, errlen, "GetPjrtApi returned NULL");
+    return nullptr;
+  }
+  return api;
+}
+
+int nd4j_pjrt_api_version(const void* api_ptr, int* major, int* minor) {
+  auto api = static_cast<const PJRT_Api*>(api_ptr);
+  if (!api) return -1;
+  *major = api->pjrt_api_version.major_version;
+  *minor = api->pjrt_api_version.minor_version;
+  return 0;
+}
+
+// ---------------------------------------------------------------- client
+void* nd4j_pjrt_client_create(const void* api_ptr, char* err, int errlen) {
+  auto api = static_cast<const PJRT_Api*>(api_ptr);
+  PJRT_Client_Create_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  if (consume_error(api, api->PJRT_Client_Create(&args), err, errlen,
+                    "PJRT_Client_Create")) {
+    return nullptr;
+  }
+  return new ShimClient{api, args.client};
+}
+
+void nd4j_pjrt_client_destroy(void* client_ptr) {
+  auto sc = static_cast<ShimClient*>(client_ptr);
+  if (!sc) return;
+  PJRT_Client_Destroy_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+  args.client = sc->client;
+  sc->api->PJRT_Client_Destroy(&args);
+  delete sc;
+}
+
+int nd4j_pjrt_platform_name(void* client_ptr, char* buf, int buflen) {
+  auto sc = static_cast<ShimClient*>(client_ptr);
+  PJRT_Client_PlatformName_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+  args.client = sc->client;
+  if (sc->api->PJRT_Client_PlatformName(&args) != nullptr) return -1;
+  size_t n = args.platform_name_size;
+  if (n + 1 > static_cast<size_t>(buflen)) n = buflen - 1;
+  std::memcpy(buf, args.platform_name, n);
+  buf[n] = '\0';
+  return static_cast<int>(n);
+}
+
+int nd4j_pjrt_device_count(void* client_ptr) {
+  auto sc = static_cast<ShimClient*>(client_ptr);
+  PJRT_Client_AddressableDevices_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  args.client = sc->client;
+  if (sc->api->PJRT_Client_AddressableDevices(&args) != nullptr) return -1;
+  return static_cast<int>(args.num_addressable_devices);
+}
+
+// --------------------------------------------------------------- compile
+// mlir: StableHLO module text or bytecode. compile_options: serialized
+// CompileOptionsProto bytes (produced by the Python binding).
+void* nd4j_pjrt_compile(void* client_ptr, const char* mlir, int64_t mlir_size,
+                        const char* compile_options, int64_t options_size,
+                        char* err, int errlen) {
+  auto sc = static_cast<ShimClient*>(client_ptr);
+  PJRT_Program program;
+  std::memset(&program, 0, sizeof(program));
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = const_cast<char*>(mlir);
+  program.code_size = static_cast<size_t>(mlir_size);
+  static const char kFormat[] = "mlir";
+  program.format = kFormat;
+  program.format_size = sizeof(kFormat) - 1;
+
+  PJRT_Client_Compile_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  args.client = sc->client;
+  args.program = &program;
+  args.compile_options = compile_options;
+  args.compile_options_size = static_cast<size_t>(options_size);
+  if (consume_error(sc->api, sc->api->PJRT_Client_Compile(&args), err, errlen,
+                    "PJRT_Client_Compile")) {
+    return nullptr;
+  }
+  return new ShimExecutable{sc->api, sc->client, args.executable};
+}
+
+void nd4j_pjrt_executable_destroy(void* exec_ptr) {
+  auto se = static_cast<ShimExecutable*>(exec_ptr);
+  if (!se) return;
+  PJRT_LoadedExecutable_Destroy_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+  args.executable = se->exec;
+  se->api->PJRT_LoadedExecutable_Destroy(&args);
+  delete se;
+}
+
+// --------------------------------------------------------------- execute
+// Single-device execute: n_in f32 dense inputs (data/shape/rank), n_out f32
+// outputs copied into caller-provided dense buffers (sized by the caller).
+int nd4j_pjrt_execute_f32(void* exec_ptr, const float** in_data,
+                          const int64_t* const* in_dims,
+                          const int32_t* in_ranks, int32_t n_in,
+                          float** out_data, const int64_t* out_elems,
+                          int32_t n_out, char* err, int errlen) {
+  auto se = static_cast<ShimExecutable*>(exec_ptr);
+  const PJRT_Api* api = se->api;
+
+  PJRT_Client_AddressableDevices_Args dev_args;
+  std::memset(&dev_args, 0, sizeof(dev_args));
+  dev_args.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  dev_args.client = se->client;
+  if (consume_error(api, api->PJRT_Client_AddressableDevices(&dev_args), err,
+                    errlen, "AddressableDevices")) {
+    return -1;
+  }
+  if (dev_args.num_addressable_devices == 0) {
+    set_err(err, errlen, "no addressable devices");
+    return -1;
+  }
+  PJRT_Device* device = dev_args.addressable_devices[0];
+
+  // host → device
+  std::vector<PJRT_Buffer*> inputs(n_in, nullptr);
+  for (int i = 0; i < n_in; ++i) {
+    PJRT_Client_BufferFromHostBuffer_Args bargs;
+    std::memset(&bargs, 0, sizeof(bargs));
+    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+    bargs.client = se->client;
+    bargs.data = in_data[i];
+    bargs.type = PJRT_Buffer_Type_F32;
+    bargs.dims = in_dims[i];
+    bargs.num_dims = static_cast<size_t>(in_ranks[i]);
+    bargs.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableOnlyDuringCall;
+    bargs.device = device;
+    if (consume_error(api, api->PJRT_Client_BufferFromHostBuffer(&bargs), err,
+                      errlen, "BufferFromHostBuffer")) {
+      return -1;
+    }
+    if (bargs.done_with_host_buffer) {
+      PJRT_Event_Await_Args eargs;
+      std::memset(&eargs, 0, sizeof(eargs));
+      eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+      eargs.event = bargs.done_with_host_buffer;
+      api->PJRT_Event_Await(&eargs);
+      PJRT_Event_Destroy_Args edargs;
+      std::memset(&edargs, 0, sizeof(edargs));
+      edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+      edargs.event = bargs.done_with_host_buffer;
+      api->PJRT_Event_Destroy(&edargs);
+    }
+    inputs[i] = bargs.buffer;
+  }
+
+  // execute (one device)
+  PJRT_ExecuteOptions opts;
+  std::memset(&opts, 0, sizeof(opts));
+  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+  std::vector<PJRT_Buffer*> outs(n_out, nullptr);
+  PJRT_Buffer* const* arg_list = inputs.data();
+  PJRT_Buffer** out_list = outs.data();
+  PJRT_Event* done_event = nullptr;
+
+  PJRT_LoadedExecutable_Execute_Args xargs;
+  std::memset(&xargs, 0, sizeof(xargs));
+  xargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  xargs.executable = se->exec;
+  xargs.options = &opts;
+  xargs.argument_lists = &arg_list;
+  xargs.num_devices = 1;
+  xargs.num_args = static_cast<size_t>(n_in);
+  xargs.output_lists = &out_list;
+  xargs.device_complete_events = &done_event;
+  xargs.execute_device = device;
+  int rc = 0;
+  if (consume_error(api, api->PJRT_LoadedExecutable_Execute(&xargs), err,
+                    errlen, "Execute")) {
+    rc = -1;
+  }
+  if (rc == 0 && done_event) {
+    PJRT_Event_Await_Args eargs;
+    std::memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+    eargs.event = done_event;
+    if (consume_error(api, api->PJRT_Event_Await(&eargs), err, errlen,
+                      "Execute await")) {
+      rc = -1;
+    }
+    PJRT_Event_Destroy_Args edargs;
+    std::memset(&edargs, 0, sizeof(edargs));
+    edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    edargs.event = done_event;
+    api->PJRT_Event_Destroy(&edargs);
+  }
+
+  // device → host
+  for (int o = 0; rc == 0 && o < n_out; ++o) {
+    PJRT_Buffer_ToHostBuffer_Args targs;
+    std::memset(&targs, 0, sizeof(targs));
+    targs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+    targs.src = outs[o];
+    targs.dst = out_data[o];
+    targs.dst_size = static_cast<size_t>(out_elems[o]) * sizeof(float);
+    if (consume_error(api, api->PJRT_Buffer_ToHostBuffer(&targs), err, errlen,
+                      "ToHostBuffer")) {
+      rc = -1;
+      break;
+    }
+    if (targs.event) {
+      PJRT_Event_Await_Args eargs;
+      std::memset(&eargs, 0, sizeof(eargs));
+      eargs.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+      eargs.event = targs.event;
+      if (consume_error(api, api->PJRT_Event_Await(&eargs), err, errlen,
+                        "ToHostBuffer await")) {
+        rc = -1;
+      }
+      PJRT_Event_Destroy_Args edargs;
+      std::memset(&edargs, 0, sizeof(edargs));
+      edargs.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+      edargs.event = targs.event;
+      api->PJRT_Event_Destroy(&edargs);
+    }
+  }
+
+  // free buffers
+  for (PJRT_Buffer* b : inputs) {
+    if (!b) continue;
+    PJRT_Buffer_Destroy_Args dargs;
+    std::memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = b;
+    api->PJRT_Buffer_Destroy(&dargs);
+  }
+  for (PJRT_Buffer* b : outs) {
+    if (!b) continue;
+    PJRT_Buffer_Destroy_Args dargs;
+    std::memset(&dargs, 0, sizeof(dargs));
+    dargs.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    dargs.buffer = b;
+    api->PJRT_Buffer_Destroy(&dargs);
+  }
+  return rc;
+}
+
+}  // extern "C"

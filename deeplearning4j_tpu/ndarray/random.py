@@ -15,7 +15,14 @@ import jax
 
 
 class Random:
-    """Stateful splittable RNG. Thread-safe via a lock (eager path only)."""
+    """Stateful splittable RNG. Thread-safe via a lock (eager path only).
+
+    Key creation is LAZY: materialising a jax PRNG key initialises the XLA
+    backend, and this module is imported at package-import time — an eager
+    key would lock the backend before ``jax.distributed.initialize`` or a
+    ``jax.config.update("jax_platforms", ...)`` can run (the multi-host
+    bootstrap and the driver's CPU-forced dryrun both depend on import
+    staying backend-free)."""
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
@@ -24,7 +31,7 @@ class Random:
     def setSeed(self, seed: int):
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.key(int(seed))
+            self._key = None            # materialised on first draw
 
     def getSeed(self) -> int:
         return self._seed
@@ -32,6 +39,8 @@ class Random:
     def nextKey(self) -> jax.Array:
         """Split off a fresh subkey, advancing internal state."""
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 

@@ -7,9 +7,11 @@ from deeplearning4j_tpu.nlp.sentence import (BasicLineIterator,
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.fasttext import FastText
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
 __all__ = ["DefaultTokenizerFactory", "CommonPreprocessor",
            "BasicLineIterator", "CollectionSentenceIterator",
            "VocabCache", "VocabWord", "Word2Vec", "ParagraphVectors",
-           "WordVectorSerializer"]
+           "Glove", "FastText", "WordVectorSerializer"]

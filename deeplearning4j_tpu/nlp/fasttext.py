@@ -1,0 +1,269 @@
+"""FastText — subword (character n-gram) SGNS word vectors.
+
+Reference: ``org.deeplearning4j.models.fasttext.FastText`` (a JFastText
+wrapper — SURVEY D15). Since the reference delegates to a native library,
+this is a from-scratch TPU-native implementation of the fastText skipgram
+model (Bojanowski et al.): a word's input vector is the MEAN of its word
+embedding and its character n-gram embeddings (hashed into a fixed bucket
+table), trained with negative sampling. The batch step is one jitted
+program: gather (B, 1+max_ngrams, D) subword rows, mean, the SGNS logit
+block on the MXU, and scatter-add updates back to word + bucket tables.
+
+OOV words get vectors from their n-grams alone — the capability that
+motivates fastText over word2vec.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence import (CollectionSentenceIterator,
+                                             SentenceIterator)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import _cos
+
+
+def _fnv1a(s: str) -> int:
+    """FNV-1a 32-bit — fastText's n-gram hashing function."""
+    h = 2166136261
+    for ch in s.encode("utf-8"):
+        h = (h ^ ch) * 16777619 & 0xFFFFFFFF
+    return h
+
+
+class FastText:
+    """Builder-configured fastText trainer (ref API surface: FastText.Builder
+    ... .build(); fit(); getWordVector works for OOV words)."""
+
+    def __init__(self, layer_size=100, window_size=5, min_word_frequency=1,
+                 epochs=1, negative=5, learning_rate=0.05, min_n=3, max_n=6,
+                 bucket=2_000_000, sample=1e-3, seed=42, batch_size=1024,
+                 max_ngrams=20,
+                 iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.negative = negative
+        self.learning_rate = learning_rate
+        self.min_n = min_n
+        self.max_n = max_n
+        self.bucket = bucket
+        self.sample = sample
+        self.seed = seed
+        self.batch_size = batch_size
+        self.max_ngrams = max_ngrams
+        self.iterator = iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        # input table rows: [0, V) words, [V, V+bucket) n-gram buckets
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1neg: Optional[np.ndarray] = None
+        self._word_subwords: Optional[np.ndarray] = None  # (V, 1+max_ngrams)
+        self._word_subword_mask: Optional[np.ndarray] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def _set(self, k, v):
+            self._kw[k] = v
+            return self
+
+        def layer_size(self, v): return self._set("layer_size", v)
+        def window_size(self, v): return self._set("window_size", v)
+        def min_word_frequency(self, v): return self._set("min_word_frequency", v)
+        def epochs(self, v): return self._set("epochs", v)
+        def negative_sample(self, v): return self._set("negative", v)
+        def learning_rate(self, v): return self._set("learning_rate", v)
+        def min_n(self, v): return self._set("min_n", v)
+        def max_n(self, v): return self._set("max_n", v)
+        def bucket(self, v): return self._set("bucket", v)
+        def seed(self, v): return self._set("seed", v)
+        def batch_size(self, v): return self._set("batch_size", v)
+        def iterate(self, it): return self._set("iterator", it)
+        def tokenizer_factory(self, tf): return self._set("tokenizer_factory", tf)
+
+        layerSize = layer_size
+        windowSize = window_size
+        minWordFrequency = min_word_frequency
+        learningRate = learning_rate
+        batchSize = batch_size
+        tokenizerFactory = tokenizer_factory
+
+        def build(self) -> "FastText":
+            return FastText(**self._kw)
+
+    # ---------------------------------------------------------------- ngrams
+    def _ngram_ids(self, word: str) -> List[int]:
+        """Hashed bucket ids for <word>'s character n-grams (rows offset by
+        the vocab size)."""
+        w = f"<{word}>"
+        ids = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(w) - n + 1):
+                ids.append(self._v + _fnv1a(w[i:i + n]) % self.bucket)
+        return ids[: self.max_ngrams]
+
+    def _subword_table(self):
+        """(V, 1+max_ngrams) subword-row ids per word + float mask."""
+        V = self._v
+        k = 1 + self.max_ngrams
+        tbl = np.zeros((V, k), np.int32)
+        msk = np.zeros((V, k), np.float32)
+        for i in range(V):
+            ids = [i] + self._ngram_ids(self.vocab.word_at_index(i))
+            tbl[i, :len(ids)] = ids
+            msk[i, :len(ids)] = 1.0
+        return tbl, msk
+
+    # -------------------------------------------------------------- training
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        def step(syn0, syn1, acc0, acc1, sub_ids, sub_mask, context, negs,
+                 lr, weights):
+            """SGNS where the center vector is the masked mean of subword
+            rows; the center gradient scatters back to every subword row."""
+            rows = syn0[sub_ids]                         # (B, K, D)
+            denom = jnp.sum(sub_mask, axis=1, keepdims=True)  # (B, 1)
+            v_c = jnp.sum(rows * sub_mask[:, :, None], axis=1) / denom
+            tgt = jnp.concatenate([context[:, None], negs], axis=1)
+            v_t = syn1[tgt]                              # (B, 1+neg, D)
+            score = jnp.einsum("bd,bkd->bk", v_c, v_t)
+            label = jnp.zeros_like(score).at[:, 0].set(1.0)
+            g = label - jax.nn.sigmoid(score)
+            collide = jnp.concatenate(
+                [jnp.zeros((negs.shape[0], 1), bool),
+                 negs == context[:, None]], axis=1)
+            g = jnp.where(collide, 0.0, g) * weights[:, None]
+            d_vc = jnp.einsum("bk,bkd->bd", g, v_t)      # (B, D)
+            d_rows = (d_vc[:, None, :] * sub_mask[:, :, None]
+                      / denom[:, :, None])               # (B, K, D)
+            d_vt = jnp.einsum("bk,bd->bkd", g, v_c).reshape(-1, v_c.shape[-1])
+            G0 = jnp.zeros_like(syn0).at[sub_ids.reshape(-1)].add(
+                d_rows.reshape(-1, v_c.shape[-1]))
+            G1 = jnp.zeros_like(syn1).at[tgt.reshape(-1)].add(d_vt)
+            acc0 = acc0 + G0 * G0
+            acc1 = acc1 + G1 * G1
+            syn0 = syn0 + lr * G0 * jax.lax.rsqrt(acc0 + 1e-10)
+            syn1 = syn1 + lr * G1 * jax.lax.rsqrt(acc1 + 1e-10)
+            return syn0, syn1, acc0, acc1
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def fit(self) -> "FastText":
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(self.seed)
+        token_streams = [self.tokenizer_factory.create(s).get_tokens()
+                         for s in self.iterator]
+        self.vocab = VocabCache.build(token_streams, self.min_word_frequency)
+        self._v = V = self.vocab.num_words()
+        if V == 0:
+            raise ValueError("empty vocabulary")
+        D = self.layer_size
+        rows = V + self.bucket
+        self._word_subwords, self._word_subword_mask = self._subword_table()
+        syn0 = jnp.asarray((rng.rand(rows, D).astype(np.float32) - 0.5) / D)
+        syn1 = jnp.zeros((V, D), jnp.float32)
+        acc0 = jnp.zeros((rows, D), jnp.float32)
+        acc1 = jnp.zeros((V, D), jnp.float32)
+        table = self.vocab.unigram_table()
+        step = self._build_step()
+
+        # reuse word2vec's host-side pair generation
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        w2v = Word2Vec(window_size=self.window_size, sample=self.sample)
+        w2v.vocab = self.vocab
+        sents = w2v._corpus_indices(token_streams)
+        B = self.batch_size
+        for _ in range(self.epochs):
+            pairs = w2v._training_pairs(sents, rng)
+            for off in range(0, len(pairs), B):
+                chunk = pairs[off:off + B]
+                n = len(chunk)
+                negs = rng.choice(V, size=(n, self.negative),
+                                  p=table).astype(np.int32)
+                weights = np.ones(n, np.float32)
+                if n < B:
+                    pad = B - n
+                    chunk = np.concatenate([chunk,
+                                            np.zeros((pad, 2), np.int32)])
+                    negs = np.concatenate(
+                        [negs, np.zeros((pad, self.negative), np.int32)])
+                    weights = np.concatenate([weights,
+                                              np.zeros(pad, np.float32)])
+                sub_ids = self._word_subwords[chunk[:, 0]]
+                sub_mask = self._word_subword_mask[chunk[:, 0]]
+                syn0, syn1, acc0, acc1 = step(
+                    syn0, syn1, acc0, acc1,
+                    jnp.asarray(sub_ids), jnp.asarray(sub_mask),
+                    jnp.asarray(chunk[:, 1]), jnp.asarray(negs),
+                    np.float32(self.learning_rate), jnp.asarray(weights))
+        self.syn0 = np.asarray(syn0)
+        self.syn1neg = np.asarray(syn1)
+        return self
+
+    # ----------------------------------------------------------------- lookup
+    def _word_vector_rows(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word) if self.vocab is not None else -1
+        if i >= 0:
+            ids = self._word_subwords[i]
+            msk = self._word_subword_mask[i]
+            return (self.syn0[ids] * msk[:, None]).sum(0) / msk.sum()
+        ids = self._ngram_ids(word)          # OOV: n-grams only
+        if not ids:
+            return None
+        return self.syn0[np.asarray(ids)].mean(0)
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        return self._word_vector_rows(word)
+
+    getWordVector = get_word_vector
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    hasWord = has_word
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return _cos(va, vb)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        if v is None:
+            return []
+        V = self.vocab.num_words()
+        mat = np.stack([self._word_vector_rows(self.vocab.word_at_index(i))
+                        for i in range(V)])
+        norms = mat / (np.linalg.norm(mat, axis=1, keepdims=True) + 1e-12)
+        sims = norms @ (v / (np.linalg.norm(v) + 1e-12))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+    wordsNearest = words_nearest
+
+    @staticmethod
+    def from_sentences(sentences: Sequence[str], **kwargs) -> "FastText":
+        return FastText(iterator=CollectionSentenceIterator(sentences),
+                        **kwargs).fit()

@@ -1,0 +1,247 @@
+"""GloVe — global co-occurrence-factorisation word vectors.
+
+Reference: ``org.deeplearning4j.models.glove.Glove`` (+ Builder,
+``AbstractCoOccurrences`` for the count pass) — SURVEY D15. The reference
+trains per-pair on the host with AdaGrad; TPU-first redesign: the
+co-occurrence pass stays on the host (string work), the weighted
+least-squares updates run as ONE jitted program per shuffled batch of
+nonzero co-occurrence cells — embed gathers, fused elementwise loss, and
+scatter-add AdaGrad updates, the same shape of program as Word2Vec's SGNS
+step.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence import (CollectionSentenceIterator,
+                                             SentenceIterator)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import _cos
+
+
+class Glove:
+    """Builder-configured GloVe trainer (ref API: Glove.Builder ... .build();
+    fit(); similarity/wordsNearest like Word2Vec)."""
+
+    def __init__(self, layer_size=100, window_size=5, min_word_frequency=1,
+                 epochs=5, learning_rate=0.05, x_max=100.0, alpha=0.75,
+                 symmetric=True, shuffle=True, seed=42, batch_size=4096,
+                 iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self.seed = seed
+        self.batch_size = batch_size
+        self.iterator = iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None   # final vectors: w + w̃
+
+    # ---------------------------------------------------------------- builder
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def _set(self, k, v):
+            self._kw[k] = v
+            return self
+
+        def layer_size(self, v): return self._set("layer_size", v)
+        def window_size(self, v): return self._set("window_size", v)
+        def min_word_frequency(self, v): return self._set("min_word_frequency", v)
+        def epochs(self, v): return self._set("epochs", v)
+        def learning_rate(self, v): return self._set("learning_rate", v)
+        def x_max(self, v): return self._set("x_max", v)
+        def alpha(self, v): return self._set("alpha", v)
+        def symmetric(self, v): return self._set("symmetric", v)
+        def shuffle(self, v): return self._set("shuffle", v)
+        def seed(self, v): return self._set("seed", v)
+        def batch_size(self, v): return self._set("batch_size", v)
+        def iterate(self, it): return self._set("iterator", it)
+        def tokenizer_factory(self, tf): return self._set("tokenizer_factory", tf)
+
+        # camelCase reference aliases
+        layerSize = layer_size
+        windowSize = window_size
+        minWordFrequency = min_word_frequency
+        learningRate = learning_rate
+        xMax = x_max
+        batchSize = batch_size
+        tokenizerFactory = tokenizer_factory
+
+        def build(self) -> "Glove":
+            return Glove(**self._kw)
+
+    # ----------------------------------------------------------- cooccurrence
+    def _cooccurrences(self, token_streams) -> Tuple[np.ndarray, np.ndarray]:
+        """Nonzero co-occurrence cells: (N, 2) [i, j] int32 + (N,) float32
+        counts, 1/distance weighting within the window (ref:
+        AbstractCoOccurrences)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        for toks in token_streams:
+            idx = [self.vocab.index_of(t) for t in toks]
+            idx = [i for i in idx if i >= 0]
+            n = len(idx)
+            for pos in range(n):
+                for off in range(1, self.window_size + 1):
+                    c = pos + off
+                    if c >= n:
+                        break
+                    w = 1.0 / off
+                    key = (idx[pos], idx[c])
+                    counts[key] = counts.get(key, 0.0) + w
+                    if self.symmetric:
+                        key_r = (idx[c], idx[pos])
+                        counts[key_r] = counts.get(key_r, 0.0) + w
+        if not counts:
+            return np.zeros((0, 2), np.int32), np.zeros((0,), np.float32)
+        cells = np.asarray(list(counts.keys()), dtype=np.int32)
+        vals = np.asarray(list(counts.values()), dtype=np.float32)
+        return cells, vals
+
+    # -------------------------------------------------------------- training
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        x_max, alpha = self.x_max, self.alpha
+
+        def step(W, Wc, b, bc, accW, accWc, accb, accbc,
+                 wi, wj, logx, fx, lr, weights):
+            """One AdaGrad batch over co-occurrence cells:
+            J = Σ f(X_ij)·(w_i·w̃_j + b_i + b̃_j − log X_ij)²."""
+            vi = W[wi]                       # (B, D)
+            vj = Wc[wj]                      # (B, D)
+            diff = (jnp.einsum("bd,bd->b", vi, vj) + b[wi] + bc[wj] - logx)
+            g = fx * diff * weights          # (B,)
+            d_vi = g[:, None] * vj
+            d_vj = g[:, None] * vi
+            GW = jnp.zeros_like(W).at[wi].add(d_vi)
+            GWc = jnp.zeros_like(Wc).at[wj].add(d_vj)
+            Gb = jnp.zeros_like(b).at[wi].add(g)
+            Gbc = jnp.zeros_like(bc).at[wj].add(g)
+            accW = accW + GW * GW
+            accWc = accWc + GWc * GWc
+            accb = accb + Gb * Gb
+            accbc = accbc + Gbc * Gbc
+            W = W - lr * GW * jax.lax.rsqrt(accW + 1e-8)
+            Wc = Wc - lr * GWc * jax.lax.rsqrt(accWc + 1e-8)
+            b = b - lr * Gb * jax.lax.rsqrt(accb + 1e-8)
+            bc = bc - lr * Gbc * jax.lax.rsqrt(accbc + 1e-8)
+            loss = 0.5 * jnp.sum(fx * diff * diff * weights)
+            return W, Wc, b, bc, accW, accWc, accb, accbc, loss
+
+        return jax.jit(step, donate_argnums=tuple(range(8)))
+
+    def fit(self) -> "Glove":
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(self.seed)
+        token_streams = [self.tokenizer_factory.create(s).get_tokens()
+                         for s in self.iterator]
+        self.vocab = VocabCache.build(token_streams, self.min_word_frequency)
+        V, D = self.vocab.num_words(), self.layer_size
+        if V == 0:
+            raise ValueError("empty vocabulary")
+        cells, vals = self._cooccurrences(token_streams)
+        if len(cells) == 0:
+            raise ValueError("no co-occurrences (corpus too small?)")
+        logx_all = np.log(vals)
+        fx_all = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(
+            np.float32)
+
+        W = jnp.asarray((rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        Wc = jnp.asarray((rng.rand(V, D).astype(np.float32) - 0.5) / D)
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        accW = jnp.zeros((V, D), jnp.float32)
+        accWc = jnp.zeros((V, D), jnp.float32)
+        accb = jnp.zeros((V,), jnp.float32)
+        accbc = jnp.zeros((V,), jnp.float32)
+        step = self._build_step()
+        B = self.batch_size
+        self.losses: List[float] = []
+        for _ in range(self.epochs):
+            order = rng.permutation(len(cells)) if self.shuffle else np.arange(
+                len(cells))
+            ep_loss = 0.0
+            for off in range(0, len(order), B):
+                sel = order[off:off + B]
+                n = len(sel)
+                wi = np.zeros(B, np.int32)
+                wj = np.zeros(B, np.int32)
+                logx = np.zeros(B, np.float32)
+                fx = np.zeros(B, np.float32)
+                weights = np.zeros(B, np.float32)
+                wi[:n] = cells[sel, 0]
+                wj[:n] = cells[sel, 1]
+                logx[:n] = logx_all[sel]
+                fx[:n] = fx_all[sel]
+                weights[:n] = 1.0
+                (W, Wc, b, bc, accW, accWc, accb, accbc, loss) = step(
+                    W, Wc, b, bc, accW, accWc, accb, accbc,
+                    jnp.asarray(wi), jnp.asarray(wj), jnp.asarray(logx),
+                    jnp.asarray(fx), np.float32(self.learning_rate),
+                    jnp.asarray(weights))
+                ep_loss += float(loss)
+            self.losses.append(ep_loss)
+        # GloVe paper: final vectors are the sum of the two tables
+        self.syn0 = np.asarray(W) + np.asarray(Wc)
+        return self
+
+    # ----------------------------------------------------------------- lookup
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else self.syn0[i]
+
+    getWordVector = get_word_vector
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    hasWord = has_word
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return _cos(va, vb)
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        if v is None:
+            return []
+        norms = self.syn0 / (np.linalg.norm(self.syn0, axis=1, keepdims=True)
+                             + 1e-12)
+        sims = norms @ (v / (np.linalg.norm(v) + 1e-12))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+    wordsNearest = words_nearest
+
+    @staticmethod
+    def from_sentences(sentences: Sequence[str], **kwargs) -> "Glove":
+        return Glove(iterator=CollectionSentenceIterator(sentences),
+                     **kwargs).fit()

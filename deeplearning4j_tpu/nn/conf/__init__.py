@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.nn.conf import variational as _variational  # noqa: F401 — registers VariationalAutoencoder in the layer registry

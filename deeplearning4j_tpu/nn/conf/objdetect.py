@@ -134,10 +134,15 @@ class Yolo2OutputLayer(Layer):
                          - jnp.sqrt(jnp.maximum(gt_h, 0.0))[..., None]) ** 2
         coord_loss = self.lambda_coord * jnp.sum(coord * resp_mask)
 
-        # confidence: target = IoU(pred, gt) for responsible anchors, 0 otherwise
+        # confidence: target = IoU(pred, gt) for responsible anchors, 0
+        # otherwise. The IoU is NOT stop-gradiented: the reference
+        # differentiates the confidence term through the predicted-box IoU
+        # (Yolo2OutputLayer#computeBackpropGradientAndScore computes
+        # dIOU/d{xy,wh} explicitly), and its YoloGradientCheckTests gate on
+        # that — a detached target fails central-difference checks.
         pred_xyxy = jnp.concatenate([xy - wh / 2, xy + wh / 2], axis=-1)  # (N,H,W,B,4)
         iou = box_iou_xyxy(pred_xyxy, lb[..., None, :])
-        conf_obj = jnp.sum(((conf - jax_stop_grad(iou)) ** 2) * resp_mask)
+        conf_obj = jnp.sum(((conf - iou) ** 2) * resp_mask)
         conf_noobj = self.lambda_no_obj * jnp.sum((conf ** 2) * (1.0 - resp_mask))
 
         # class loss: squared error on softmax probs (ref default)
@@ -154,11 +159,6 @@ def jax_sigmoid(x):
 
 def jax_one_hot(idx, n, dtype):
     return (idx[..., None] == jnp.arange(n)).astype(dtype)
-
-
-def jax_stop_grad(x):
-    import jax
-    return jax.lax.stop_gradient(x)
 
 
 # --------------------------------------------------------------- inference

@@ -1,0 +1,178 @@
+"""Variational autoencoder layer (ref:
+``org.deeplearning4j.nn.conf.layers.variational.VariationalAutoencoder`` +
+runtime ``org.deeplearning4j.nn.layers.variational.VariationalAutoencoder``,
+SURVEY D3).
+
+Reference semantics preserved:
+- supervised forward (``apply``) emits the MEAN of q(z|x) — the layer acts as
+  a deterministic encoder inside a larger net once pretrained;
+- unsupervised pretraining maximises the ELBO: E_q[log p(x|z)] − KL(q‖p) with
+  the reparameterisation trick, ``num_samples`` MC samples;
+- pluggable reconstruction distributions (Gaussian with learned variance,
+  Bernoulli) — the reference's ``ReconstructionDistribution`` hierarchy;
+- reference param naming: ``e{i}W/e{i}b`` (encoder), ``pZXMeanW/b``,
+  ``pZXLogStd2W/b`` (posterior), ``d{i}W/d{i}b`` (decoder), ``pXZW/b``
+  (reconstruction head).
+
+TPU-first: the whole pretrain step (encode → sample → decode → ELBO → update)
+traces into one XLA program; MC samples are batched via the leading axis, not
+a Python loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations as _act
+from deeplearning4j_tpu.nn import weights as _winit
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class VariationalAutoencoder(Layer):
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None                      # latent size
+    encoder_layer_sizes: Tuple[int, ...] = (100,)
+    decoder_layer_sizes: Tuple[int, ...] = (100,)
+    reconstruction_distribution: str = "gaussian"    # "gaussian" | "bernoulli"
+    pzx_activation: str = "identity"                 # activation on posterior stats
+    num_samples: int = 1
+
+    def __post_init__(self):
+        self.encoder_layer_sizes = tuple(self.encoder_layer_sizes)
+        self.decoder_layer_sizes = tuple(self.decoder_layer_sizes)
+
+    # ------------------------------------------------------------ shape/info
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.array_elements()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+    def _recon_params_size(self) -> int:
+        if self.reconstruction_distribution == "gaussian":
+            return 2 * self.n_in      # mean + log-variance per input unit
+        if self.reconstruction_distribution == "bernoulli":
+            return self.n_in          # logits
+        raise ValueError(self.reconstruction_distribution)
+
+    def param_shapes(self) -> Dict[str, tuple]:
+        shapes = {}
+        prev = self.n_in
+        for i, sz in enumerate(self.encoder_layer_sizes):
+            shapes[f"e{i}W"] = (prev, sz)
+            shapes[f"e{i}b"] = (sz,)
+            prev = sz
+        shapes["pZXMeanW"] = (prev, self.n_out)
+        shapes["pZXMeanb"] = (self.n_out,)
+        shapes["pZXLogStd2W"] = (prev, self.n_out)
+        shapes["pZXLogStd2b"] = (self.n_out,)
+        prev = self.n_out
+        for i, sz in enumerate(self.decoder_layer_sizes):
+            shapes[f"d{i}W"] = (prev, sz)
+            shapes[f"d{i}b"] = (sz,)
+            prev = sz
+        shapes["pXZW"] = (prev, self._recon_params_size())
+        shapes["pXZb"] = (self._recon_params_size(),)
+        return shapes
+
+    def init_params(self, key):
+        p = {}
+        for name, shape in self.param_shapes().items():
+            key, sub = jax.random.split(key)
+            if name.endswith("b"):
+                p[name] = jnp.full(shape, self.bias_init)
+            else:
+                p[name] = _winit.init(self.weight_init, sub, shape, shape[0], shape[1])
+        return p
+
+    # ------------------------------------------------------------- internals
+    def _encode(self, params, x):
+        act = _act.get(self.activation or "identity")
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = act(h @ params[f"e{i}W"] + params[f"e{i}b"])
+        pzx = _act.get(self.pzx_activation)
+        mean = pzx(h @ params["pZXMeanW"] + params["pZXMeanb"])
+        log_var = pzx(h @ params["pZXLogStd2W"] + params["pZXLogStd2b"])
+        return mean, log_var
+
+    def _decode(self, params, z):
+        act = _act.get(self.activation or "identity")
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = act(h @ params[f"d{i}W"] + params[f"d{i}b"])
+        return h @ params["pXZW"] + params["pXZb"]
+
+    def _recon_neg_log_prob(self, dist_params, x):
+        """−log p(x|z) summed over features, per example."""
+        if self.reconstruction_distribution == "gaussian":
+            mean, log_var = jnp.split(dist_params, 2, axis=-1)
+            log_var = jnp.clip(log_var, -10.0, 10.0)
+            return 0.5 * jnp.sum(
+                log_var + jnp.log(2 * jnp.pi)
+                + jnp.square(x - mean) / jnp.exp(log_var), axis=-1)
+        # bernoulli: stable BCE-with-logits
+        logits = dist_params
+        return jnp.sum(jnp.maximum(logits, 0) - logits * x
+                       + jnp.log1p(jnp.exp(-jnp.abs(logits))), axis=-1)
+
+    # ------------------------------------------------------------- execution
+    def apply(self, params, x, training=False, rng=None, state=None):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        x = self._maybe_dropout(x, training, rng)
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO, mean over batch (ref: VariationalAutoencoder
+        #computeGradientAndScore in pretrain mode)."""
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        mean, log_var = self._encode(params, x)
+        kl = -0.5 * jnp.sum(1.0 + log_var - jnp.square(mean) - jnp.exp(log_var),
+                            axis=-1)
+        eps = jax.random.normal(rng, (self.num_samples,) + mean.shape, mean.dtype)
+        z = mean[None] + jnp.exp(0.5 * log_var)[None] * eps   # (S, N, latent)
+        dist = self._decode(params, z.reshape(-1, self.n_out))
+        nll = self._recon_neg_log_prob(dist, jnp.tile(x, (self.num_samples, 1)))
+        nll = nll.reshape(self.num_samples, -1).mean(axis=0)
+        return jnp.mean(nll + kl)
+
+    # ---------------------------------------------------- reference surface
+    def reconstruct(self, params, x, rng=None):
+        """x → decoder output at the posterior mean (ref:
+        #reconstructionProbability's deterministic analog /
+        #generateAtMeanGivenZ(activate(x)))."""
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        mean, _ = self._encode(params, x)
+        dist = self._decode(params, mean)
+        if self.reconstruction_distribution == "gaussian":
+            return jnp.split(dist, 2, axis=-1)[0]
+        return jax.nn.sigmoid(dist)
+
+    def generate_at_mean_given_z(self, params, z):
+        """Latent → reconstruction mean (ref: #generateAtMeanGivenZ)."""
+        dist = self._decode(params, jnp.asarray(z))
+        if self.reconstruction_distribution == "gaussian":
+            return jnp.split(dist, 2, axis=-1)[0]
+        return jax.nn.sigmoid(dist)
+
+    def reconstruction_error(self, params, x):
+        """Per-example −log p(x|z=mean) (ref: #reconstructionError)."""
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        mean, _ = self._encode(params, x)
+        dist = self._decode(params, mean)
+        return self._recon_neg_log_prob(dist, x)

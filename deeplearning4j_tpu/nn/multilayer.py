@@ -27,7 +27,9 @@ from deeplearning4j_tpu.nn.conf import layers as L
 
 log = logging.getLogger("deeplearning4j_tpu")
 
-_MASK_AWARE = (L._RnnBase, L.Bidirectional, L.LastTimeStep, L.SelfAttentionLayer, L.GlobalPoolingLayer)
+_MASK_AWARE = (L._RnnBase, L.Bidirectional, L.LastTimeStep, L.SelfAttentionLayer,
+               L.GlobalPoolingLayer, L.LearnedSelfAttentionLayer,
+               L.RecurrentAttentionLayer)
 
 
 def _maybe_unflatten_input(x, input_type):
@@ -91,6 +93,7 @@ class MultiLayerNetwork:
         self._score = float("nan")
         self._listeners = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep carries
+        self._last_input = None                # StatsListener activation hist
         self._frozen: set = set()              # transfer-learning frozen layer idxs
         self._last_batch_size = 0
         self._key = jax.random.key(conf.seed)
@@ -288,6 +291,12 @@ class MultiLayerNetwork:
         fmask = None if fmask is None else jnp.asarray(_unwrap(fmask))
         lmask = None if lmask is None else jnp.asarray(_unwrap(lmask))
         self._last_batch_size = x.shape[0]
+        # pinned only when a listener collects activation histograms —
+        # otherwise a large device batch would stay referenced for the
+        # lifetime of the net
+        if any(getattr(l, "collect_activations", False)
+               for l in self._listeners):
+            self._last_input = x
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT and x.ndim == 3):
             self._fit_tbptt(x, y, fmask, lmask)
         else:
@@ -321,6 +330,60 @@ class MultiLayerNetwork:
             self._iteration += 1
             for lst in self._listeners:
                 lst.iteration_done(self, self._iteration, self._epoch, self._score)
+
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, data, epochs: int = 1):
+        """Layerwise unsupervised pretraining of every pretrainable layer
+        (ref: MultiLayerNetwork#pretrain)."""
+        for i, layer in enumerate(self.layers):
+            if getattr(layer, "is_pretrain_layer", lambda: False)():
+                self.pretrainLayer(i, data, epochs)
+        return self
+
+    def pretrainLayer(self, layer_idx: int, data, epochs: int = 1):
+        """Unsupervised pretraining of one layer (ref:
+        MultiLayerNetwork#pretrainLayer): activations of layers < idx feed the
+        layer's ``pretrain_loss`` (e.g. the VAE negative ELBO); only that
+        layer's params update. The whole step — upstream forward, loss, grad,
+        updater — is one jitted XLA program."""
+        if not self._initialized:
+            self.init()
+        layer = self.layers[layer_idx]
+        if not hasattr(layer, "pretrain_loss"):
+            raise ValueError(f"layer {layer_idx} ({type(layer).__name__}) is "
+                             "not pretrainable")
+        lkey = str(layer_idx)
+        opt = _grad_transform(self.conf)
+        lparams = self._params[lkey]
+        opt_state = opt.init(lparams)
+
+        @jax.jit
+        def step(lp, ostate, x, rng):
+            def loss_fn(lp):
+                h, _, _ = self._forward(self._params, self._states, x, False,
+                                        None, up_to=layer_idx)
+                return layer.pretrain_loss(lp, h, rng)
+            loss, g = jax.value_and_grad(loss_fn)(lp)
+            updates, ostate = opt.update(g, ostate, lp)
+            return optax.apply_updates(lp, updates), ostate, loss
+
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            batches = [data] if hasattr(data, "features") or isinstance(
+                data, (np.ndarray, jnp.ndarray, NDArray)) else data
+            for ds in batches:
+                x = jnp.asarray(_unwrap(ds.features if hasattr(ds, "features")
+                                        else ds))
+                self._key, rng = jax.random.split(self._key)
+                lparams, opt_state, loss = step(lparams, opt_state, x, rng)
+                self._score = float(loss)
+                self._iteration += 1
+                for lst in self._listeners:
+                    lst.iteration_done(self, self._iteration, self._epoch,
+                                       self._score)
+        self._params[lkey] = lparams
+        return self
 
     # ------------------------------------------------------------- inference
     @functools.partial(jax.jit, static_argnums=(0,))

@@ -1,6 +1,7 @@
 """Op library: registry + standard XLA lowerings + Pallas platform kernels."""
 from deeplearning4j_tpu.ops import registry
 from deeplearning4j_tpu.ops import standard  # noqa: F401 — populates registry
+from deeplearning4j_tpu.ops import extended  # noqa: F401 — long-tail ops
 from deeplearning4j_tpu.ops import transforms
 
-__all__ = ["registry", "standard", "transforms"]
+__all__ = ["registry", "standard", "extended", "transforms"]

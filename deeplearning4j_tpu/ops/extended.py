@@ -1,0 +1,586 @@
+"""Extended op library — the libnd4j declarable-op long tail
+(``libnd4j/include/ops/declarable/generic/**`` groups not covered by
+``standard.py``: absolute-statistics reductions, segment/scatter families,
+bitwise, image color/resize/patch ops, special functions, random
+distributions, loss ops, sequence-layer RNN ops — SURVEY N3, VERDICT r1 LoC
+diagnostic "op library ~145 vs ~500").
+
+Same conventions as ``standard.py``: arrays traced, attrs static, NHWC.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import exec_op, register
+
+# ----------------------------------------------------- elementwise long tail
+for _n, _f, _al in [
+    ("expm1", jnp.expm1, ["Expm1"]),
+    ("log2", lambda x: jnp.log2(x), ["Log2"]),
+    ("log10", lambda x: jnp.log10(x), ["Log10"]),
+    ("rint", jnp.rint, ["Rint"]),
+    ("trunc", jnp.trunc, ["Trunc"]),
+    ("atan2", jnp.arctan2, ["Atan2", "tr_atan2"]),
+    ("hypot", jnp.hypot, []),
+    ("lgamma", jax.scipy.special.gammaln, ["Lgamma"]),
+    ("digamma", jax.scipy.special.digamma, ["Digamma"]),
+    ("erfinv", jax.scipy.special.erfinv, ["Erfinv"]),
+    ("sigmoid_derivative",
+     lambda x: jax.nn.sigmoid(x) * (1 - jax.nn.sigmoid(x)), []),
+    ("tanh_derivative", lambda x: 1 - jnp.tanh(x) ** 2, []),
+]:
+    register(_n, _f, aliases=_al)
+
+register("rsub", lambda a, b: b - a, aliases=["reversesubtract", "RSub"])
+register("rdiv", lambda a, b: b / a, aliases=["reversedivide", "RDiv"])
+register("divide_no_nan",
+         lambda a, b: jnp.where(b == 0, jnp.zeros_like(a * b), a / b),
+         aliases=["DivNoNan"])
+register("igamma", jax.scipy.special.gammainc, aliases=["Igamma"])
+register("igammac", jax.scipy.special.gammaincc, aliases=["Igammac"])
+register("betainc", jax.scipy.special.betainc, aliases=["Betainc"])
+
+
+@register("polygamma", aliases=["Polygamma"])
+def _polygamma(n, x):
+    return jax.scipy.special.polygamma(jnp.asarray(n, jnp.int32), x)
+
+
+@register("isclose", aliases=["ApproxEquals"])
+def _isclose(a, b, rtol=1e-5, atol=1e-8):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol)
+
+
+register("is_non_decreasing",
+         lambda x: jnp.all(jnp.ravel(x)[1:] >= jnp.ravel(x)[:-1]),
+         aliases=["IsNonDecreasing"])
+register("is_strictly_increasing",
+         lambda x: jnp.all(jnp.ravel(x)[1:] > jnp.ravel(x)[:-1]),
+         aliases=["IsStrictlyIncreasing"])
+
+
+# ------------------------------------------------- absolute-value reductions
+register("reduce_amax", lambda x, axis=None, keepdims=False:
+         jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims), aliases=["amax"])
+register("reduce_amin", lambda x, axis=None, keepdims=False:
+         jnp.min(jnp.abs(x), axis=axis, keepdims=keepdims), aliases=["amin"])
+register("reduce_amean", lambda x, axis=None, keepdims=False:
+         jnp.mean(jnp.abs(x), axis=axis, keepdims=keepdims), aliases=["amean"])
+register("reduce_asum", lambda x, axis=None, keepdims=False:
+         jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims), aliases=["asum"])
+register("count_nonzero", lambda x, axis=None, keepdims=False:
+         jnp.count_nonzero(x, axis=axis, keepdims=keepdims),
+         aliases=["CountNonZero"])
+register("count_zero", lambda x, axis=None, keepdims=False:
+         jnp.sum((x == 0), axis=axis, keepdims=keepdims),
+         aliases=["CountZero"])
+register("zero_fraction", lambda x: jnp.mean((x == 0).astype(jnp.float32)),
+         aliases=["ZeroFraction"])
+register("argamax", lambda x, axis=None: jnp.argmax(jnp.abs(x), axis=axis),
+         aliases=["absargmax"])
+register("argamin", lambda x, axis=None: jnp.argmin(jnp.abs(x), axis=axis),
+         aliases=["absargmin"])
+
+
+@register("entropy", aliases=["Entropy"])
+def _entropy(p, axis=None):
+    """−Σ p·log p (ref: reduce ops entropy)."""
+    q = jnp.where(p > 0, p, 1.0)
+    return -jnp.sum(p * jnp.log(q), axis=axis)
+
+
+@register("log_entropy", aliases=["LogEntropy"])
+def _log_entropy(p, axis=None):
+    return jnp.log(_entropy(p, axis=axis))
+
+
+@register("shannon_entropy", aliases=["ShannonEntropy", "shannonentropy"])
+def _shannon_entropy(p, axis=None):
+    q = jnp.where(p > 0, p, 1.0)
+    return -jnp.sum(p * jnp.log2(q), axis=axis)
+
+
+@register("moments", num_outputs=2, aliases=["Moments"])
+def _moments(x, axes=None, keepdims=False):
+    axes = tuple(axes) if axes is not None else None
+    return (jnp.mean(x, axis=axes, keepdims=keepdims),
+            jnp.var(x, axis=axes, keepdims=keepdims))
+
+
+@register("normalize_moments", num_outputs=2, aliases=["NormalizeMoments"])
+def _normalize_moments(counts, mean_ss, var_ss, shift=0.0):
+    mean = mean_ss / counts + shift
+    var = var_ss / counts - jnp.square(mean_ss / counts)
+    return mean, var
+
+
+@register("reduce_dot", aliases=["dot"])
+def _reduce_dot(a, b, axis=None, keepdims=False):
+    return jnp.sum(a * b, axis=axis, keepdims=keepdims)
+
+
+@register("cosine_similarity", aliases=["CosineSimilarity"])
+def _cosine_similarity(a, b, axis=-1):
+    num = jnp.sum(a * b, axis=axis)
+    den = (jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis))
+    return num / jnp.maximum(den, 1e-12)
+
+
+register("cosine_distance", lambda a, b, axis=-1:
+         1.0 - _cosine_similarity(a, b, axis=axis),
+         aliases=["CosineDistance"])
+register("euclidean_distance", lambda a, b, axis=-1:
+         jnp.sqrt(jnp.sum(jnp.square(a - b), axis=axis)),
+         aliases=["EuclideanDistance"])
+register("manhattan_distance", lambda a, b, axis=-1:
+         jnp.sum(jnp.abs(a - b), axis=axis), aliases=["ManhattanDistance"])
+register("hamming_distance", lambda a, b, axis=None:
+         jnp.sum((a != b), axis=axis), aliases=["HammingDistance"])
+register("jaccard_distance", lambda a, b, axis=-1:
+         1.0 - (jnp.sum(jnp.minimum(a, b), axis=axis)
+                / jnp.maximum(jnp.sum(jnp.maximum(a, b), axis=axis), 1e-12)),
+         aliases=["JaccardDistance"])
+
+
+# ------------------------------------------------------------- shape / index
+register("eye", lambda n, m=None, dtype=jnp.float32:
+         jnp.eye(n, m if m is not None else n, dtype=dtype), aliases=["Eye"])
+register("repeat", lambda x, repeats, axis=None:
+         jnp.repeat(x, repeats, axis=axis), aliases=["Repeat"])
+register("roll", lambda x, shift, axis=None: jnp.roll(x, shift, axis=axis),
+         aliases=["Roll"])
+register("rot90", lambda x, k=1, axes=(0, 1): jnp.rot90(x, k, axes=axes))
+register("invert_permutation", lambda p: jnp.argsort(p),
+         aliases=["InvertPermutation"])
+register("meshgrid", lambda *xs, indexing="xy":
+         jnp.meshgrid(*xs, indexing=indexing), aliases=["Meshgrid"])
+register("size_at", lambda x, dim: x.shape[dim], aliases=["SizeAt"])
+register("searchsorted", lambda sorted_seq, values, side="left":
+         jnp.searchsorted(sorted_seq, values, side=side),
+         aliases=["SearchSorted"])
+register("bincount", lambda x, weights=None, minlength=0:
+         jnp.bincount(jnp.ravel(x), weights=weights, minlength=minlength,
+                      length=None),
+         aliases=["Bincount"])
+
+
+@register("histogram_fixed_width", aliases=["HistogramFixedWidth"])
+def _histogram_fixed_width(x, value_range, nbins=100):
+    lo, hi = value_range[0], value_range[1]
+    idx = jnp.clip(((x - lo) / (hi - lo) * nbins).astype(jnp.int32), 0,
+                   nbins - 1)
+    return jnp.zeros(nbins, jnp.int32).at[jnp.ravel(idx)].add(1)
+
+
+@register("unique", num_outputs=2, aliases=["Unique"])
+def _unique(x, size=None):
+    """Values + inverse indices. ``size`` makes it jit-compatible (padded
+    with the max value, reference semantics are host-eager anyway)."""
+    if size is None:
+        vals, inv = np.unique(np.asarray(x), return_inverse=True)
+        return jnp.asarray(vals), jnp.asarray(inv.reshape(np.shape(x)))
+    vals = jnp.unique(x, size=size, fill_value=jnp.max(x))
+    inv = jnp.searchsorted(vals, jnp.ravel(x)).reshape(jnp.shape(x))
+    return vals, inv
+
+
+@register("unique_with_counts", num_outputs=3, aliases=["UniqueWithCounts"])
+def _unique_with_counts(x):
+    vals, inv, counts = np.unique(np.asarray(x), return_inverse=True,
+                                  return_counts=True)
+    return (jnp.asarray(vals), jnp.asarray(inv.reshape(np.shape(x))),
+            jnp.asarray(counts))
+
+
+@register("listdiff", num_outputs=2, aliases=["ListDiff", "setdiff1d"])
+def _listdiff(x, y):
+    x_np, y_np = np.asarray(x), np.asarray(y)
+    mask = ~np.isin(x_np, y_np)
+    return jnp.asarray(x_np[mask]), jnp.asarray(np.nonzero(mask)[0])
+
+
+@register("dynamic_partition", aliases=["DynamicPartition"])
+def _dynamic_partition(x, partitions, num_partitions):
+    x_np, p_np = np.asarray(x), np.asarray(partitions)
+    return [jnp.asarray(x_np[p_np == i]) for i in range(num_partitions)]
+
+
+@register("dynamic_stitch", aliases=["DynamicStitch"])
+def _dynamic_stitch(indices, values):
+    n = int(max(np.max(np.asarray(i)) for i in indices)) + 1
+    first = np.asarray(values[0])
+    out = np.zeros((n,) + first.shape[1:], first.dtype)
+    for idx, val in zip(indices, values):
+        out[np.asarray(idx)] = np.asarray(val)
+    return jnp.asarray(out)
+
+
+# --------------------------------------------------------- segment / scatter
+for _nm, _red in [("segment_max", "max"), ("segment_min", "min"),
+                  ("segment_prod", "prod"), ("segment_mean", "mean")]:
+    def _make(red):
+        def f(data, segment_ids, num_segments=None):
+            n = (int(num_segments) if num_segments is not None
+                 else int(np.asarray(segment_ids).max()) + 1)
+            if red == "mean":
+                s = jax.ops.segment_sum(data, segment_ids, n)
+                c = jax.ops.segment_sum(jnp.ones_like(data), segment_ids, n)
+                return s / jnp.maximum(c, 1)
+            fn = {"max": jax.ops.segment_max, "min": jax.ops.segment_min,
+                  "prod": jax.ops.segment_prod}[red]
+            return fn(data, segment_ids, n)
+        return f
+    register(_nm, _make(_red),
+             aliases=["Segment" + _red.capitalize(),
+                      "unsorted_" + _nm, "Unsorted" + _nm.title().replace("_", "")])
+
+register("unsorted_segment_sqrt_n",
+         lambda data, segment_ids, num_segments:
+         jax.ops.segment_sum(data, segment_ids, int(num_segments))
+         / jnp.sqrt(jnp.maximum(jax.ops.segment_sum(
+             jnp.ones_like(data), segment_ids, int(num_segments)), 1)),
+         aliases=["UnsortedSegmentSqrtN"])
+
+register("scatter_sub", lambda ref, idx, upd: ref.at[idx].add(-upd),
+         aliases=["ScatterSub"])
+register("scatter_mul", lambda ref, idx, upd: ref.at[idx].multiply(upd),
+         aliases=["ScatterMul"])
+register("scatter_div", lambda ref, idx, upd: ref.at[idx].divide(upd),
+         aliases=["ScatterDiv"])
+register("scatter_max", lambda ref, idx, upd: ref.at[idx].max(upd),
+         aliases=["ScatterMax"])
+register("scatter_min", lambda ref, idx, upd: ref.at[idx].min(upd),
+         aliases=["ScatterMin"])
+
+
+@register("scatter_nd", aliases=["ScatterNd"])
+def _scatter_nd(indices, updates, shape):
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    return out.at[tuple(jnp.moveaxis(indices, -1, 0))].add(updates)
+
+
+register("scatter_nd_add",
+         lambda ref, indices, upd:
+         ref.at[tuple(jnp.moveaxis(indices, -1, 0))].add(upd),
+         aliases=["ScatterNdAdd", "TensorScatterAdd"])
+register("scatter_nd_update",
+         lambda ref, indices, upd:
+         ref.at[tuple(jnp.moveaxis(indices, -1, 0))].set(upd),
+         aliases=["ScatterNdUpdate", "TensorScatterUpdate"])
+register("scatter_nd_sub",
+         lambda ref, indices, upd:
+         ref.at[tuple(jnp.moveaxis(indices, -1, 0))].add(-upd),
+         aliases=["ScatterNdSub", "TensorScatterSub"])
+
+
+# ------------------------------------------------------------------- bitwise
+register("bitwise_and", jnp.bitwise_and, aliases=["BitwiseAnd", "bitwise_and_"])
+register("bitwise_or", jnp.bitwise_or, aliases=["BitwiseOr"])
+register("bitwise_xor", jnp.bitwise_xor, aliases=["BitwiseXor"])
+register("toggle_bits", jnp.bitwise_not, aliases=["ToggleBits", "bitwise_not"])
+register("shift_bits", jnp.left_shift, aliases=["ShiftBits", "LeftShift"])
+register("rshift_bits", jnp.right_shift, aliases=["RShiftBits", "RightShift"])
+
+
+@register("cyclic_shift_bits", aliases=["CyclicShiftBits"])
+def _cyclic_shift_bits(x, shift):
+    nbits = x.dtype.itemsize * 8
+    shift = shift % nbits
+    ux = x.astype(jnp.uint32) if nbits == 32 else x
+    out = (ux << shift) | (ux >> (nbits - shift))
+    return out.astype(x.dtype)
+
+
+@register("bits_hamming_distance", aliases=["BitsHammingDistance"])
+def _bits_hamming_distance(a, b):
+    return jnp.sum(jax.lax.population_count(jnp.bitwise_xor(a, b)))
+
+
+register("bitcast", lambda x, dtype: lax.bitcast_convert_type(x, dtype),
+         aliases=["Bitcast"])
+
+
+# --------------------------------------------------------------------- image
+def _resize(x, size, method):
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, int(size[0]), int(size[1]), c), method)
+
+
+register("resize_nearest_neighbor",
+         lambda x, size: _resize(x, size, "nearest"),
+         aliases=["ResizeNearestNeighbor"])
+register("resize_bicubic", lambda x, size: _resize(x, size, "cubic"),
+         aliases=["ResizeBicubic"])
+register("resize_area", lambda x, size: _resize(x, size, "linear"),
+         aliases=["ResizeArea"])   # XLA has no true area; linear is closest
+
+
+@register("crop_and_resize", aliases=["CropAndResize"])
+def _crop_and_resize(image, boxes, box_indices, crop_size):
+    """Normalised-coordinate box crops resized to ``crop_size`` (ref/TF
+    semantics, bilinear)."""
+    ch, cw = int(crop_size[0]), int(crop_size[1])
+    n, h, w, c = image.shape
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        ys = y1 * (h - 1) + jnp.linspace(0.0, 1.0, ch) * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.linspace(0.0, 1.0, cw) * (x2 - x1) * (w - 1)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        img = image[bi]
+        tl = img[y0][:, x0]
+        tr = img[y0][:, x1i]
+        bl = img[y1i][:, x0]
+        br = img[y1i][:, x1i]
+        return (tl * (1 - wy) * (1 - wx) + tr * (1 - wy) * wx
+                + bl * wy * (1 - wx) + br * wy * wx)
+
+    return jax.vmap(one)(jnp.asarray(boxes, jnp.float32),
+                         jnp.asarray(box_indices, jnp.int32))
+
+
+@register("extract_image_patches", aliases=["ExtractImagePatches"])
+def _extract_image_patches(x, ksizes, strides, rates=(1, 1), padding="VALID"):
+    kh, kw = ksizes
+    out = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(strides),
+        padding.upper() if isinstance(padding, str) else padding,
+        rhs_dilation=tuple(rates),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    # lax returns channels-major patch layout (C, kh, kw); reference/TF wants
+    # (kh, kw, C) — transpose the patch dim
+    n, oh, ow, _ = out.shape
+    c = x.shape[-1]
+    out = out.reshape(n, oh, ow, c, kh * kw).transpose(0, 1, 2, 4, 3)
+    return out.reshape(n, oh, ow, kh * kw * c)
+
+
+register("rgb_to_grayscale",
+         lambda x: jnp.sum(x * jnp.asarray([0.2989, 0.587, 0.114], x.dtype),
+                           axis=-1, keepdims=True),
+         aliases=["RgbToGrayscale", "rgb_to_grs"])
+
+
+@register("rgb_to_hsv", aliases=["RgbToHsv"])
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    d = mx - mn
+    safe_d = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        d == 0, 0.0,
+        jnp.where(mx == r, jnp.mod((g - b) / safe_d, 6.0),
+                  jnp.where(mx == g, (b - r) / safe_d + 2.0,
+                            (r - g) / safe_d + 4.0))) / 6.0
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@register("hsv_to_rgb", aliases=["HsvToRgb"])
+def _hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+                   [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+_YUV = np.array([[0.299, 0.587, 0.114],
+                 [-0.14714119, -0.28886916, 0.43601035],
+                 [0.61497538, -0.51496512, -0.10001026]], np.float32)
+register("rgb_to_yuv", lambda x: jnp.einsum("...c,rc->...r", x,
+                                            jnp.asarray(_YUV, x.dtype)),
+         aliases=["RgbToYuv"])
+register("yuv_to_rgb", lambda x: jnp.einsum("...c,rc->...r", x,
+                                            jnp.asarray(np.linalg.inv(_YUV),
+                                                        x.dtype)),
+         aliases=["YuvToRgb"])
+register("adjust_contrast",
+         lambda x, factor: (x - jnp.mean(x, axis=(-3, -2), keepdims=True))
+         * factor + jnp.mean(x, axis=(-3, -2), keepdims=True),
+         aliases=["AdjustContrast", "AdjustContrastV2"])
+
+
+@register("adjust_saturation", aliases=["AdjustSaturation"])
+def _adjust_saturation(x, factor):
+    hsv = _rgb_to_hsv(x)
+    hsv = hsv.at[..., 1].set(jnp.clip(hsv[..., 1] * factor, 0.0, 1.0))
+    return _hsv_to_rgb(hsv)
+
+
+@register("adjust_hue", aliases=["AdjustHue"])
+def _adjust_hue(x, delta):
+    hsv = _rgb_to_hsv(x)
+    hsv = hsv.at[..., 0].set(jnp.mod(hsv[..., 0] + delta, 1.0))
+    return _hsv_to_rgb(hsv)
+
+
+# ------------------------------------------------------------------- random
+register("random_gamma", lambda key, alpha, shape=None, dtype=jnp.float32:
+         jax.random.gamma(key, alpha,
+                          shape=tuple(shape) if shape else None).astype(dtype),
+         aliases=["RandomGamma"])
+register("random_poisson", lambda key, lam, shape=None, dtype=jnp.float32:
+         jax.random.poisson(key, lam,
+                            shape=tuple(shape) if shape else None)
+         .astype(dtype), aliases=["RandomPoisson", "RandomPoissonV2"])
+register("random_exponential", lambda key, rate, shape, dtype=jnp.float32:
+         (jax.random.exponential(key, tuple(shape)) / rate).astype(dtype),
+         aliases=["RandomExponential"])
+register("random_shuffle", lambda key, x: jax.random.permutation(key, x),
+         aliases=["RandomShuffle"])
+register("random_categorical",
+         lambda key, logits, num_samples:
+         jax.random.categorical(key, logits, shape=(logits.shape[0],
+                                                    int(num_samples))),
+         aliases=["Multinomial", "multinomial"])
+
+
+# ------------------------------------------------------------------- linalg
+register("matrix_diag", lambda d: jnp.apply_along_axis(jnp.diag, -1, d)
+         if d.ndim > 1 else jnp.diag(d), aliases=["MatrixDiag"])
+register("matrix_set_diag",
+         lambda x, d: x.at[..., jnp.arange(d.shape[-1]),
+                           jnp.arange(d.shape[-1])].set(d),
+         aliases=["MatrixSetDiag"])
+register("cross", jnp.cross, aliases=["Cross"])
+register("logdet", lambda x: jnp.linalg.slogdet(x)[1], aliases=["Logdet"])
+register("lu", lambda x: jax.scipy.linalg.lu(x), aliases=["Lu"])
+register("self_adjoint_eig", lambda x: jnp.linalg.eigh(x),
+         aliases=["SelfAdjointEigV2", "eigh"])
+register("matrix_transpose", lambda x: jnp.swapaxes(x, -1, -2),
+         aliases=["MatrixTranspose", "adjoint"])
+register("batched_gemm", lambda a, b: jnp.matmul(a, b),
+         aliases=["BatchedGemm", "batch_matmul", "BatchMatMul",
+                  "BatchMatMulV2"])
+
+
+# ------------------------------------------------------------------ loss ops
+def _apply_weights_and_reduce(per, weights, reduction):
+    if weights is not None:
+        per = per * weights
+    if reduction in ("mean", "MEAN_BY_WEIGHT", "weighted_mean"):
+        den = (jnp.sum(jnp.broadcast_to(weights, per.shape))
+               if weights is not None else per.size)
+        return jnp.sum(per) / jnp.maximum(den, 1e-12)
+    if reduction in ("sum", "SUM"):
+        return jnp.sum(per)
+    return per     # "none"
+
+
+@register("huber_loss", aliases=["HuberLoss"])
+def _huber_loss(labels, predictions, weights=None, delta=1.0,
+                reduction="mean"):
+    err = jnp.abs(predictions - labels)
+    per = jnp.where(err <= delta, 0.5 * err * err,
+                    delta * err - 0.5 * delta * delta)
+    return _apply_weights_and_reduce(per, weights, reduction)
+
+
+@register("log_loss", aliases=["LogLoss"])
+def _log_loss(labels, predictions, weights=None, epsilon=1e-7,
+              reduction="mean"):
+    p = jnp.clip(predictions, epsilon, 1 - epsilon)
+    per = -(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+    return _apply_weights_and_reduce(per, weights, reduction)
+
+
+@register("absolute_difference_loss", aliases=["AbsoluteDifference"])
+def _absolute_difference_loss(labels, predictions, weights=None,
+                              reduction="mean"):
+    return _apply_weights_and_reduce(jnp.abs(predictions - labels), weights,
+                                     reduction)
+
+
+@register("mean_sqerr_loss", aliases=["MeanSqerrLoss"])
+def _mean_sqerr_loss(labels, predictions, weights=None, reduction="mean"):
+    return _apply_weights_and_reduce(jnp.square(predictions - labels),
+                                     weights, reduction)
+
+
+@register("hinge_loss", aliases=["HingeLoss"])
+def _hinge_loss(labels, logits, weights=None, reduction="mean"):
+    signed = 2.0 * labels - 1.0
+    return _apply_weights_and_reduce(jnp.maximum(0.0, 1.0 - signed * logits),
+                                     weights, reduction)
+
+
+@register("cosine_distance_loss", aliases=["CosineDistanceLoss"])
+def _cosine_distance_loss(labels, predictions, weights=None, axis=-1,
+                          reduction="mean"):
+    per = 1.0 - jnp.sum(labels * predictions, axis=axis, keepdims=True)
+    return _apply_weights_and_reduce(per, weights, reduction)
+
+
+@register("weighted_cross_entropy_with_logits",
+          aliases=["WeightedCrossEntropyWithLogits"])
+def _weighted_ce(labels, logits, pos_weight):
+    log_w = 1 + (pos_weight - 1) * labels
+    return (1 - labels) * logits + log_w * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        + jnp.maximum(-logits, 0.0))
+
+
+# ------------------------------------------------------------ nn extensions
+register("bias_add", lambda x, b: x + b, aliases=["BiasAdd"])
+register("xw_plus_b", lambda x, w, b: x @ w + b, aliases=["XwPlusB"])
+register("relu_layer", lambda x, w, b: jax.nn.relu(x @ w + b),
+         aliases=["ReluLayer"])
+register("embedding_lookup", lambda params, ids: params[ids],
+         aliases=["EmbeddingLookup"])
+
+
+@register("lstm_layer", num_outputs=2, aliases=["LSTMLayer", "lstmLayer"])
+def _lstm_layer(x, h0, c0, w, b, forget_bias=0.0):
+    """Full-sequence LSTM over (N,T,C) via lax.scan of the fused cell (ref:
+    declarable/recurrent/lstmLayer.cpp). Returns (outputs (N,T,H),
+    (hN, cN))."""
+    def step(carry, xt):
+        h, c = carry
+        h, c = exec_op("lstm_cell", xt, h, c, w, b, forget_bias=forget_bias)
+        return (h, c), h
+
+    (hN, cN), ys = lax.scan(step, (h0, c0), jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), (hN, cN)
+
+
+@register("gru_layer", num_outputs=2, aliases=["GRULayer", "gruLayer"])
+def _gru_layer(x, h0, w_rz, w_h, b_rz, b_h):
+    """Full-sequence GRU over (N,T,C) via lax.scan of the fused cell.
+    Returns (outputs (N,T,H), hN)."""
+    def step(h, xt):
+        h = exec_op("gru_cell", xt, h, w_rz, w_h, b_rz, b_h)
+        return h, h
+
+    hN, ys = lax.scan(step, h0, jnp.swapaxes(x, 0, 1))
+    return jnp.swapaxes(ys, 0, 1), hN
+
+
+# ----------------------------------------------------------------- sequence
+@register("reverse", aliases=["Reverse", "ReverseV2"])
+def _reverse(x, axis):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=axis)
+
+
+@register("trapz", aliases=[])
+def _trapz(y, x=None, axis=-1):
+    return jnp.trapezoid(y, x=x, axis=axis)

@@ -480,7 +480,8 @@ def im2col(x, kernel, strides=(1, 1), padding="VALID"):
 def batchnorm(x, mean, variance, gamma=None, beta=None, epsilon=1e-5, axis=-1):
     shp = [1] * x.ndim
     shp[axis] = x.shape[axis]
-    inv = lax.rsqrt(variance.astype(jnp.float32) + epsilon).reshape(shp).astype(x.dtype)
+    acc = jnp.promote_types(x.dtype, jnp.float32)   # ≥f32; keeps f64 exact
+    inv = lax.rsqrt(variance.astype(acc) + epsilon).reshape(shp).astype(x.dtype)
     out = (x - mean.reshape(shp).astype(x.dtype)) * inv
     if gamma is not None:
         out = out * gamma.reshape(shp).astype(x.dtype)
@@ -576,10 +577,13 @@ def sru_cell(x, c_prev, w, b):
 # ---------------------------------------------------------------- attention
 @register("dot_product_attention", aliases=["MultiHeadDotProductAttention"])
 def dot_product_attention(q, k, v, mask=None, scaled=True):
-    """(..., heads, seq, d) attention; softmax in f32 for bf16 stability."""
+    """(..., heads, seq, d) attention; softmax accumulates in at least f32
+    for bf16 stability (f64 inputs keep f64 — the gradcheck harness runs
+    this layer in double precision)."""
     d = q.shape[-1]
+    acc = jnp.promote_types(q.dtype, jnp.float32)
     scores = jnp.einsum("...qd,...kd->...qk", q, k,
-                        preferred_element_type=jnp.float32)
+                        preferred_element_type=acc)
     if scaled:
         scores = scores / jnp.sqrt(jnp.asarray(d, scores.dtype))
     if mask is not None:

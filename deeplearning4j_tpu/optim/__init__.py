@@ -3,3 +3,6 @@ from deeplearning4j_tpu.optim.updaters import (
     Adam, AdamW, AdaDelta, AdaGrad, AdaMax, AMSGrad, Nadam, Nesterovs, NoOp,
     RmsProp, Sgd, Updater)
 from deeplearning4j_tpu.optim import schedules, listeners
+from deeplearning4j_tpu.optim.solvers import (  # noqa: E402
+    ConjugateGradient, LBFGS, LineGradientDescent, Solver,
+    StochasticGradientDescent)

@@ -1,0 +1,207 @@
+"""Solver + convex-style optimizers (ref:
+``org.deeplearning4j.optimize.Solver`` and
+``org.deeplearning4j.optimize.solvers.{BaseOptimizer,
+StochasticGradientDescent,LineGradientDescent,ConjugateGradient,LBFGS}`` —
+SURVEY D5).
+
+Reference semantics: the Solver wraps an optimizer that calls
+``computeGradientAndScore`` and applies updates; SGD is the practical path,
+while line-search/CG/LBFGS iterate on the single FLAT param vector. TPU-first
+mapping: SGD delegates to the net's donated-buffer jitted step (stack 3.1 is
+already one XLA program); the second-order optimizers run their direction/
+line-search logic on the flat vector on the host, with each score/gradient
+evaluation a jitted device call — the same host/device split the reference
+has (Java logic over native evals).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import params as _flat
+
+
+def _score_and_flat_grad(net, x, y):
+    score, grads = net.computeGradientAndScore(x, y)
+    return score, np.asarray(_flat.flatten_params(grads))
+
+
+def _set_flat(net, vec: np.ndarray):
+    net._params = _flat.unflatten_params(jnp.asarray(vec, jnp.float32),
+                                         net._param_shapes)
+
+
+def _get_flat(net) -> np.ndarray:
+    return np.asarray(_flat.flatten_params(net._params))
+
+
+class BaseOptimizer:
+    """ref: solvers.BaseOptimizer — iteration loop + listener dispatch."""
+
+    def __init__(self, net, max_iterations: int = 10):
+        self.net = net
+        self.max_iterations = max_iterations
+
+    def optimize(self, x, y) -> bool:
+        raise NotImplementedError
+
+    def _iteration_done(self, score):
+        net = self.net
+        net._score = float(score)
+        net._iteration += 1
+        for lst in net._listeners:
+            lst.iteration_done(net, net._iteration, net._epoch, net._score)
+
+
+class StochasticGradientDescent(BaseOptimizer):
+    """ref: solvers.StochasticGradientDescent — one updater step per call;
+    delegates to the net's jitted train step (fwd+bwd+updater fused)."""
+
+    def optimize(self, x, y) -> bool:
+        self.net._fit_batch(x, y)
+        return True
+
+
+def _backtracking_line_search(net, x, y, p, f0, g0, alpha0=1.0, c1=1e-4,
+                              shrink=0.5, max_steps=20):
+    """Armijo backtracking along direction p from the current params (ref:
+    solvers.BackTrackLineSearch)."""
+    theta0 = _get_flat(net)
+    slope = float(g0 @ p)
+    alpha = alpha0
+    for _ in range(max_steps):
+        _set_flat(net, theta0 + alpha * p)
+        score, _ = net.computeGradientAndScore(x, y)
+        if score <= f0 + c1 * alpha * slope:
+            return alpha, score
+        alpha *= shrink
+    _set_flat(net, theta0)     # no acceptable step
+    return 0.0, f0
+
+
+class LineGradientDescent(BaseOptimizer):
+    """ref: solvers.LineGradientDescent — steepest descent + line search."""
+
+    def optimize(self, x, y) -> bool:
+        for _ in range(self.max_iterations):
+            f0, g = _score_and_flat_grad(self.net, x, y)
+            p = -g
+            alpha, score = _backtracking_line_search(self.net, x, y, p, f0, g)
+            if alpha == 0.0:
+                self._iteration_done(f0)
+                return False
+            self._iteration_done(score)
+        return True
+
+
+class ConjugateGradient(BaseOptimizer):
+    """ref: solvers.ConjugateGradient — Polak-Ribière nonlinear CG with
+    automatic restart when the direction loses descent."""
+
+    def optimize(self, x, y) -> bool:
+        f0, g = _score_and_flat_grad(self.net, x, y)
+        p = -g
+        for _ in range(self.max_iterations):
+            if float(g @ p) >= 0:      # not a descent direction → restart
+                p = -g
+            alpha, score = _backtracking_line_search(self.net, x, y, p, f0, g)
+            if alpha == 0.0:
+                self._iteration_done(f0)
+                return False
+            f1, g_new = _score_and_flat_grad(self.net, x, y)
+            beta = max(0.0, float(g_new @ (g_new - g)) /
+                       max(float(g @ g), 1e-12))   # PR+
+            p = -g_new + beta * p
+            g, f0 = g_new, f1
+            self._iteration_done(score)
+        return True
+
+
+class LBFGS(BaseOptimizer):
+    """ref: solvers.LBFGS — limited-memory BFGS (two-loop recursion, history
+    ``m``) with Armijo line search on the flat vector."""
+
+    def __init__(self, net, max_iterations: int = 10, m: int = 10):
+        super().__init__(net, max_iterations)
+        self.m = m
+
+    def optimize(self, x, y) -> bool:
+        s_hist, y_hist = [], []
+        f0, g = _score_and_flat_grad(self.net, x, y)
+        theta = _get_flat(self.net)
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = g.copy()
+            alphas = []
+            for s, yv in reversed(list(zip(s_hist, y_hist))):
+                rho = 1.0 / max(float(yv @ s), 1e-12)
+                a = rho * float(s @ q)
+                alphas.append((a, rho, s, yv))
+                q = q - a * yv
+            if y_hist:
+                s, yv = s_hist[-1], y_hist[-1]
+                q = q * (float(s @ yv) / max(float(yv @ yv), 1e-12))
+            for a, rho, s, yv in reversed(alphas):
+                b = rho * float(yv @ q)
+                q = q + (a - b) * s
+            p = -q
+            alpha, score = _backtracking_line_search(self.net, x, y, p, f0, g)
+            if alpha == 0.0:
+                self._iteration_done(f0)
+                return False
+            theta_new = _get_flat(self.net)
+            f1, g_new = _score_and_flat_grad(self.net, x, y)
+            s_hist.append(theta_new - theta)
+            y_hist.append(g_new - g)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            theta, g, f0 = theta_new, g_new, f1
+            self._iteration_done(score)
+        return True
+
+
+_ALGOS = {
+    "sgd": StochasticGradientDescent,
+    "stochastic_gradient_descent": StochasticGradientDescent,
+    "line_gradient_descent": LineGradientDescent,
+    "conjugate_gradient": ConjugateGradient,
+    "lbfgs": LBFGS,
+}
+
+
+class Solver:
+    """ref: org.deeplearning4j.optimize.Solver (+ .Builder): chooses the
+    optimization algorithm and drives it."""
+
+    def __init__(self, net, algorithm: str = "sgd",
+                 max_iterations: int = 10):
+        cls = _ALGOS[algorithm.lower()]
+        self.optimizer = cls(net, max_iterations=max_iterations)
+
+    def optimize(self, x, y) -> bool:
+        return self.optimizer.optimize(x, y)
+
+    class Builder:
+        def __init__(self):
+            self._net = None
+            self._algo = "sgd"
+            self._iters = 10
+
+        def model(self, net):
+            self._net = net
+            return self
+
+        def configure(self, algorithm: str):
+            self._algo = algorithm
+            return self
+
+        def max_iterations(self, n: int):
+            self._iters = n
+            return self
+
+        def build(self) -> "Solver":
+            return Solver(self._net, self._algo, self._iters)

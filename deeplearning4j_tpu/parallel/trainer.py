@@ -63,6 +63,24 @@ class ShardedTrainer:
             return None
         if isinstance(x, (tuple, list)):
             return type(x)(self._shard_batch(e) for e in x)
+        if jax.process_count() > 1 and DATA_AXIS in self.mesh.axis_names:
+            # multi-host (DCN) path: each process feeds its LOCAL partition
+            # (ref: SharedTrainingWorker consumes worker-local RDD
+            # partitions); assemble the global sharded batch across hosts
+            x = np.asarray(_unwrap(x))
+            n_shards = _mesh.axis_size(self.mesh, DATA_AXIS)
+            per_proc = max(1, n_shards // jax.process_count())
+            if x.shape[0] % per_proc != 0:
+                # replicating would need identical values on every process,
+                # which a process-local partition is not — fail loudly
+                # instead of training on silently inconsistent data
+                raise ValueError(
+                    f"multi-host batch: local partition of {x.shape[0]} "
+                    f"examples is not divisible by the {per_proc} data "
+                    f"shards this process owns; feed equal-sized divisible "
+                    f"partitions per process")
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(DATA_AXIS)), x)
         x = jnp.asarray(_unwrap(x))
         n_data = _mesh.axis_size(self.mesh, DATA_AXIS)
         # an indivisible (e.g. final partial) batch replicates instead of

@@ -15,6 +15,29 @@ from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
 
+def _svg_histogram(counts, lo, hi, width=220, height=80, title="") -> str:
+    """Small bar chart of a histogram summary (the reference UI's per-layer
+    param/update/activation histograms)."""
+    if not counts:
+        return "<svg/>"
+    n = len(counts)
+    cmax = max(max(counts), 1)
+    bw = width / n
+    bars = "".join(
+        f'<rect x="{i * bw:.1f}" y="{height - 14 - c / cmax * (height - 22):.1f}" '
+        f'width="{max(bw - 1, 1):.1f}" '
+        f'height="{c / cmax * (height - 22):.1f}" fill="#ff7f0e"/>'
+        for i, c in enumerate(counts))
+    return (f'<svg width="{width}" height="{height}" '
+            f'xmlns="http://www.w3.org/2000/svg">'
+            f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
+            f'{bars}'
+            f'<text x="2" y="{height - 3}" font-size="9">{lo:.3g}</text>'
+            f'<text x="{width - 40}" y="{height - 3}" font-size="9">{hi:.3g}</text>'
+            f'<text x="2" y="10" font-size="10">{_html.escape(title)}</text>'
+            f'</svg>')
+
+
 def _svg_line_chart(xs, ys, width=720, height=240, pad=36) -> str:
     if not xs:
         return "<svg/>"
@@ -89,10 +112,27 @@ class UIServer:
                 upd = ups[-1].get("updates", {}).get(name, {})
                 ratio = (upd.get("meanMagnitude", 0.0)
                          / max(s.get("meanMagnitude", 0.0), 1e-12))
+                p_hist = _svg_histogram(
+                    s.get("histogramCounts", []),
+                    *(s.get("histogramEdges", [0, 0])), title="param")
+                u_hist = _svg_histogram(
+                    upd.get("histogramCounts", []),
+                    *(upd.get("histogramEdges", [0, 0])), title="update")
                 rows += (f"<tr><td>{_html.escape(str(name))}</td>"
                          f"<td>{s.get('meanMagnitude', 0):.3e}</td>"
                          f"<td>{s.get('stdev', 0):.3e}</td>"
-                         f"<td>{ratio:.3e}</td></tr>")
+                         f"<td>{ratio:.3e}</td>"
+                         f"<td>{p_hist}</td><td>{u_hist}</td></tr>")
+        act_rows = ""
+        if ups and "activations" in ups[-1]:
+            for name, s in ups[-1]["activations"].items():
+                a_hist = _svg_histogram(
+                    s.get("histogramCounts", []),
+                    *(s.get("histogramEdges", [0, 0])), title="act")
+                act_rows += (f"<tr><td>{_html.escape(str(name))}</td>"
+                             f"<td>{s.get('mean', 0):.3e}</td>"
+                             f"<td>{s.get('stdev', 0):.3e}</td>"
+                             f"<td>{a_hist}</td></tr>")
         from urllib.parse import quote
         session_links = " ".join(
             f'<a href="/?sid={quote(s)}">{_html.escape(s)}</a>'
@@ -106,8 +146,13 @@ class UIServer:
             + "<h3>Layer parameters (latest)</h3>"
               "<table border=1 cellpadding=4><tr><th>param</th>"
               "<th>mean |w|</th><th>stdev</th><th>update/param ratio</th>"
+              "<th>param histogram</th><th>update histogram</th>"
               f"</tr>{rows}</table>"
-              "</body></html>")
+            + ("<h3>Layer activations (latest)</h3>"
+               "<table border=1 cellpadding=4><tr><th>layer</th>"
+               "<th>mean</th><th>stdev</th><th>histogram</th>"
+               f"</tr>{act_rows}</table>" if act_rows else "")
+            + "</body></html>")
 
     # --------------------------------------------------------------- serve
     def start(self):

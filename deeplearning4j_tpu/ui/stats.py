@@ -35,12 +35,18 @@ def _summary(arr: np.ndarray, bins: int = 20) -> dict:
 class StatsListener(TrainingListener):
     def __init__(self, storage, update_frequency: int = 1,
                  session_id: Optional[str] = None,
-                 collect_histograms: bool = True):
+                 collect_histograms: bool = True,
+                 collect_activations: bool = False):
         self.storage = storage
         self.update_frequency = max(update_frequency, 1)
         self.session_id = session_id or f"session_{int(time.time() * 1e3)}"
         self.collect_histograms = collect_histograms
+        # activation histograms re-run the forward pass on the last batch at
+        # reporting granularity — opt-in, like the reference's
+        # StatsUpdateConfiguration histogram toggles
+        self.collect_activations = collect_activations
         self._last_params: Optional[Dict[str, np.ndarray]] = None
+        self._pushed_activations: Optional[dict] = None
         self._t0 = time.time()
 
     def iteration_done(self, model, iteration, epoch, score):
@@ -70,4 +76,31 @@ class StatsListener(TrainingListener):
             if updates:
                 record["updates"] = updates
             self._last_params = params
+        if self.collect_activations:
+            if self._pushed_activations is not None:
+                # activations handed to the bus via on_forward_pass win —
+                # no recompute needed
+                record["activations"] = self._pushed_activations
+                self._pushed_activations = None
+            elif (hasattr(model, "feedForward")
+                  and getattr(model, "_last_input", None) is not None):
+                acts = model.feedForward(model._last_input)
+                names = ["input"] + [f"{i}_{type(l).__name__}" for i, l in
+                                     enumerate(getattr(model, "layers", []))]
+                record["activations"] = {
+                    (names[i] if i < len(names) else str(i)): _summary(
+                        np.asarray(a.toNumpy() if hasattr(a, "toNumpy")
+                                   else a))
+                    for i, a in enumerate(acts)}
         self.storage.put_update(self.session_id, record)
+
+    def on_forward_pass(self, model, activations):
+        """Reference hook parity (StatsListener#onForwardPass): summaries of
+        activations handed to the listener bus directly are attached to the
+        NEXT iteration_done record (taking precedence over recompute)."""
+        if not self.collect_activations:
+            return
+        self._pushed_activations = {
+            str(i): _summary(np.asarray(a.toNumpy() if hasattr(a, "toNumpy")
+                                        else a))
+            for i, a in enumerate(activations)}

@@ -1,0 +1,55 @@
+"""GravesLSTM char-RNN language model with TBPTT (BASELINE config[2]).
+
+Trains the zoo TextGenerationLSTM on a small embedded corpus and samples
+text. Run: python examples/char_lstm.py [--epochs N]
+"""
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.optim.listeners import ScoreIterationListener
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+) * 50
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--seq", type=int, default=64)
+    args = ap.parse_args()
+
+    chars = sorted(set(CORPUS))
+    idx = {c: i for i, c in enumerate(chars)}
+    data = np.asarray([idx[c] for c in CORPUS], np.int64)
+
+    m = zoo.TextGenerationLSTM(total_unique_characters=len(chars),
+                               tbptt_length=32)
+    net = m.init_model()
+    net.setListeners(ScoreIterationListener(20))
+
+    seq = args.seq
+    n = (len(data) - 1) // seq
+    x_idx = data[: n * seq].reshape(n, seq)
+    y_idx = data[1 : n * seq + 1].reshape(n, seq)
+    eye = np.eye(len(chars), dtype=np.float32)
+    net.fit(eye[x_idx], eye[y_idx], epochs=args.epochs)
+
+    # sample with the streaming rnnTimeStep API (ref: rnn examples)
+    net.rnnClearPreviousState()
+    rng = np.random.default_rng(0)
+    ch = idx["t"]
+    out = ["t"]
+    for _ in range(120):
+        p = np.asarray(net.rnnTimeStep(eye[None, ch]).buf()).ravel()
+        ch = int(rng.choice(len(chars), p=p / p.sum()))
+        out.append(chars[ch])
+    print("sample:", "".join(out))
+
+
+if __name__ == "__main__":
+    main()

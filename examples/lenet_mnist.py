@@ -1,0 +1,31 @@
+"""LeNet MNIST — the dl4j-examples LeNetMNIST config (BASELINE config[0]).
+
+Run: python examples/lenet_mnist.py [--epochs N]
+"""
+import argparse
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.optim.listeners import ScoreIterationListener
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=128)
+    args = ap.parse_args()
+
+    net = zoo.LeNet().init_model()
+    net.setListeners(ScoreIterationListener(50))
+    train = MnistDataSetIterator(args.batch, train=True)
+    test = MnistDataSetIterator(args.batch, train=False)
+    if train.synthetic:
+        print("note: no MNIST files under ~/.deeplearning4j_tpu/mnist — "
+              "using the deterministic synthetic digits")
+    net.fit(train, epochs=args.epochs)
+    ev = net.evaluate(test)
+    print(ev.stats())
+
+
+if __name__ == "__main__":
+    main()

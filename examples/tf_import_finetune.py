@@ -1,0 +1,63 @@
+"""TF-import fine-tune — the BERT-path shape (BASELINE config[3]): export a
+frozen attention-encoder GraphDef from live TF, import into the
+SameDiff-style graph engine, attach a loss head, and fine-tune with sd.fit.
+
+Requires tensorflow (the dev environment has it).
+"""
+import numpy as np
+
+
+def main():
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    d, classes = 16, 2
+    rng = np.random.RandomState(0)
+    wq = tf.constant(rng.randn(d, d).astype("f4") * 0.2)
+    wk = tf.constant(rng.randn(d, d).astype("f4") * 0.2)
+    wv = tf.constant(rng.randn(d, d).astype("f4") * 0.2)
+    wh = tf.constant(rng.randn(d, classes).astype("f4") * 0.2)
+
+    @tf.function
+    def encoder(x):
+        q, k, v = x @ wq, x @ wk, x @ wv
+        s = tf.matmul(q, k, transpose_b=True) / np.sqrt(float(d))
+        a = tf.nn.softmax(s) @ v
+        h = tf.reduce_mean(a + x, axis=1)
+        return tf.nn.softmax(h @ wh)
+
+    frozen = convert_variables_to_constants_v2(encoder.get_concrete_function(
+        tf.TensorSpec((None, 8, d), tf.float32, name="x")))
+    gd = frozen.graph.as_graph_def()
+    sd = TFGraphMapper.import_graph(gd)
+    print(f"imported {len(gd.node)} TF nodes")
+
+    # promote imported weight constants to trainable variables
+    for name, var in list(sd._vars.items()):
+        if var.var_type.value == "CONSTANT" and var.shape in ((d, d),
+                                                              (d, classes)):
+            var.var_type = type(var.var_type).VARIABLE
+
+    out = [op.name for op in frozen.graph.get_operations()
+           if op.type == "Identity"][-1]
+    lab = sd.placeholder("label", (None, classes))
+    loss = sd.loss.log_loss(lab, sd._vars[out])
+    loss.rename("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["label"], loss_variables=["loss"]))
+
+    x = rng.rand(64, 8, d).astype("f4")
+    y = np.eye(classes)[rng.randint(0, classes, 64)].astype("f4")
+    losses = sd.fit(DataSet(x, y), epochs=20)
+    print(f"fine-tune loss: {losses[0]:.4f} -> {losses[-1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

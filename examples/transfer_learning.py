@@ -1,0 +1,28 @@
+"""Transfer learning: freeze a trained feature extractor, swap the head
+(ref: dl4j-examples transfer-learning on zoo models).
+"""
+import numpy as np
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+
+def main():
+    base = zoo.LeNet().init_model()
+    base.fit(MnistDataSetIterator(128, train=True, num_examples=2048))
+
+    # new 5-class task: keep conv features, replace the classifier head
+    net = (TransferLearning.Builder(base)
+           .set_feature_extractor(2)          # freeze layers 0..2
+           .nout_replace(len(base.layers) - 1, 5)
+           .build())
+    rng = np.random.default_rng(0)
+    x = rng.random((256, 784), dtype=np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 256)]
+    net.fit(x, y, epochs=3)
+    print("fine-tuned head; score:", net.score())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Variational autoencoder: unsupervised pretraining + reconstruction
+(ref: dl4j-examples VariationalAutoEncoderExample).
+"""
+import numpy as np
+
+from deeplearning4j_tpu.data import MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam
+
+
+def main():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).updater(Adam(1e-3)).list()
+            .layer(VariationalAutoencoder(
+                n_out=2, encoder_layer_sizes=(256,),
+                decoder_layer_sizes=(256,), activation="relu",
+                reconstruction_distribution="bernoulli"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss_function="mcxent"))
+            .set_input_type(InputType.feed_forward(784))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    it = MnistDataSetIterator(128, train=True, num_examples=4096)
+    for epoch in range(3):
+        it.reset()
+        for ds in it:
+            net.pretrainLayer(0, (np.asarray(ds.features) > 0.5)
+                              .astype(np.float32))
+        print(f"epoch {epoch}: -ELBO = {net.score():.3f}")
+
+    vae = net.layers[0]
+    x = (np.asarray(next(iter(it)).features) > 0.5).astype(np.float32)
+    recon = vae.reconstruct(net.param_tree()["0"], x[:8])
+    print("recon error:",
+          float(np.mean((np.asarray(recon) - x[:8]) ** 2)))
+
+
+if __name__ == "__main__":
+    main()

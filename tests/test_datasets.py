@@ -1,0 +1,147 @@
+"""Dataset fetcher/iterator tests (ref analogs: the
+``org.deeplearning4j.datasets.fetchers`` + iterator-impl tests — SURVEY D13,
+VERDICT r1 missing #6).
+
+Real-format parsing is exercised with locally generated fixture files in
+each dataset's standard binary layout (zero-egress stand-in for the
+reference's downloaded archives); synthetic fallbacks are checked for
+shape/API and learnability.
+"""
+import gzip
+import struct
+
+import numpy as np
+
+from deeplearning4j_tpu.data import (Cifar10DataSetIterator,
+                                     EmnistDataSetIterator,
+                                     MnistDataSetIterator,
+                                     TinyImageNetDataSetIterator)
+
+
+class TestCifar10:
+    def test_synthetic_fallback_shapes(self, tmp_path):
+        it = Cifar10DataSetIterator(32, train=True, data_dir=str(tmp_path),
+                                    num_examples=128)
+        assert it.synthetic
+        ds = it.next()
+        assert ds.features.shape == (32, 32, 32, 3)
+        assert ds.labels.shape == (32, 10)
+        assert 0.0 <= float(np.min(ds.features)) <= float(np.max(ds.features)) <= 1.0
+
+    def test_reads_standard_binary_batches(self, tmp_path):
+        base = tmp_path / "cifar10"
+        base.mkdir()
+        rng = np.random.default_rng(0)
+        rows = []
+        for i in range(1, 6):
+            lab = rng.integers(0, 10, 20, dtype=np.uint8)[:, None]
+            img = rng.integers(0, 256, (20, 3072), dtype=np.uint8)
+            (base / f"data_batch_{i}.bin").write_bytes(
+                np.concatenate([lab, img], axis=1).tobytes())
+            rows.append((lab, img))
+        it = Cifar10DataSetIterator(10, train=True, data_dir=str(tmp_path))
+        assert not it.synthetic
+        assert it._ds.features.shape == (100, 32, 32, 3)
+        # first row of batch 1 round-trips: planar RGB → HWC
+        lab0, img0 = rows[0][0][0, 0], rows[0][1][0]
+        expect = img0.reshape(3, 32, 32).transpose(1, 2, 0) / 255.0
+        np.testing.assert_allclose(it._ds.features[0], expect, atol=1e-6)
+        assert int(np.argmax(it._ds.labels[0])) == int(lab0)
+
+    def test_synthetic_is_learnable(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        it = Cifar10DataSetIterator(64, train=True, data_dir=str(tmp_path),
+                                    num_examples=256)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(3e-3)).list()
+                .layer(ConvolutionLayer(kernel_size=3, n_out=8,
+                                        activation="relu", padding="same"))
+                .layer(SubsamplingLayer(kernel_size=2, stride=2))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.convolutional(32, 32, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=6)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.5          # chance = 0.1
+
+
+class TestEmnist:
+    def test_variant_class_counts(self, tmp_path):
+        for which, n in [("digits", 10), ("letters", 26), ("balanced", 47),
+                         ("byclass", 62)]:
+            it = EmnistDataSetIterator(which, 16, data_dir=str(tmp_path),
+                                       num_examples=64)
+            assert it.synthetic
+            assert it.num_classes() == n
+            ds = it.next()
+            assert ds.features.shape == (16, 784)
+            assert ds.labels.shape == (16, n)
+
+    def test_reads_idx_files_with_letters_reindex(self, tmp_path):
+        base = tmp_path / "emnist"
+        base.mkdir()
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, (30, 28, 28), dtype=np.uint8)
+        labels = (rng.integers(0, 26, 30, dtype=np.uint8) + 1)  # 1-indexed
+        with gzip.open(base / "emnist-letters-train-images-idx3-ubyte.gz",
+                       "wb") as f:
+            f.write(struct.pack(">I", 0x803) + struct.pack(">III", 30, 28, 28)
+                    + imgs.tobytes())
+        with gzip.open(base / "emnist-letters-train-labels-idx1-ubyte.gz",
+                       "wb") as f:
+            f.write(struct.pack(">I", 0x801) + struct.pack(">I", 30)
+                    + labels.tobytes())
+        it = EmnistDataSetIterator("letters", 10, train=True,
+                                   data_dir=str(tmp_path))
+        assert not it.synthetic
+        assert it._ds.labels.shape == (30, 26)
+        assert int(np.argmax(it._ds.labels[0])) == int(labels[0]) - 1
+
+    def test_unknown_variant_raises(self, tmp_path):
+        import pytest
+        with pytest.raises(ValueError):
+            EmnistDataSetIterator("nope", 8, data_dir=str(tmp_path))
+
+
+class TestTinyImageNet:
+    def test_synthetic_fallback(self, tmp_path):
+        it = TinyImageNetDataSetIterator(16, data_dir=str(tmp_path),
+                                         num_examples=64, num_classes=20)
+        assert it.synthetic
+        ds = it.next()
+        assert ds.features.shape == (16, 64, 64, 3)
+        assert ds.labels.shape == (16, 20)
+
+    def test_reads_directory_layout(self, tmp_path):
+        from PIL import Image
+        base = tmp_path / "tiny-imagenet-200"
+        rng = np.random.default_rng(2)
+        wnids = ["n001", "n002"]
+        for w in wnids:
+            d = base / "train" / w / "images"
+            d.mkdir(parents=True)
+            for i in range(3):
+                arr = rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{w}_{i}.JPEG")
+        it = TinyImageNetDataSetIterator(2, train=True,
+                                         data_dir=str(tmp_path),
+                                         num_classes=2)
+        assert not it.synthetic
+        assert it._ds.features.shape == (6, 64, 64, 3)
+        assert sorted(np.argmax(it._ds.labels, 1).tolist()) == [0, 0, 0, 1, 1, 1]
+
+
+def test_mnist_iterator_api_unchanged():
+    it = MnistDataSetIterator(25, train=False, num_examples=100)
+    ds = it.next()
+    assert ds.features.shape == (25, 784)
+    assert ds.labels.shape == (25, 10)

@@ -83,6 +83,19 @@ SPECS = {
     "MaskedLSTM": (lambda: L.LSTM(n_in=3, n_out=4), _x((2, 5, 3)),
                    {"mask": np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
                                      F32)}),
+    "LearnedSelfAttentionLayer": (lambda: L.LearnedSelfAttentionLayer(
+        n_in=4, n_out=4, n_heads=2, head_size=2, n_queries=3),
+        _x((2, 5, 4)), {}),
+    "RecurrentAttentionLayer": (lambda: L.RecurrentAttentionLayer(
+        n_in=3, n_out=4, n_heads=2, head_size=2), _x((2, 5, 3)), {}),
+    "Convolution1DLayer": (lambda: L.Convolution1DLayer(
+        kernel_size=3, n_in=2, n_out=3), _x((2, 6, 2)), {}),
+    "Convolution1DCausal": (lambda: L.Convolution1DLayer(
+        kernel_size=3, n_in=2, n_out=3, padding="causal", dilation=2),
+        _x((2, 6, 2)), {}),
+    "Convolution3D": (lambda: L.Convolution3D(
+        kernel_size=(2, 2, 2), n_in=2, n_out=2), _x((2, 3, 3, 3, 2)), {}),
+    "CnnLossLayer": (lambda: L.CnnLossLayer(), _x((2, 3, 3, 2)), {}),
 }
 
 
@@ -137,6 +150,54 @@ def test_yolo2_loss_gradcheck():
 
     assert grad_check(fn, {"x": jnp.asarray(x)}, subset=12,
                       max_rel_error=2e-3)
+
+
+def test_cnn_loss_layer_gradcheck():
+    """CnnLossLayer is a loss head: check d(loss)/d(activations) incl. a
+    per-pixel mask."""
+    lyr = L.CnnLossLayer(loss_function="mcxent")
+    lyr.apply_global_defaults({"activation": "softmax"})
+    x = _x((2, 3, 3, 4), seed=5, scale=0.5)
+    r = R(6)
+    labels = np.eye(4, dtype=F32)[r.randint(0, 4, (2, 3, 3))]
+    mask = r.randint(0, 2, (2, 3, 3)).astype(F32)
+
+    def fn(tree):
+        return jnp.asarray(lyr.loss(None, tree["x"], jnp.asarray(labels),
+                                    mask=jnp.asarray(mask)))
+
+    assert grad_check(fn, {"x": jnp.asarray(x)}, subset=12,
+                      max_rel_error=2e-3)
+
+
+def test_vae_pretrain_loss_gradcheck():
+    """VAE negative-ELBO gradcheck over ALL params (encoder, posterior,
+    decoder, reconstruction head) with a fixed reparameterisation rng."""
+    from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder
+    vae = VariationalAutoencoder(n_in=4, n_out=2, encoder_layer_sizes=(5,),
+                                 decoder_layer_sizes=(5,),
+                                 reconstruction_distribution="gaussian")
+    vae.apply_global_defaults({"activation": "tanh", "weight_init": "xavier"})
+    params = vae.init_params(jax.random.key(0))
+    x = jnp.asarray(_x((3, 4), seed=7, scale=0.5))
+    rng = jax.random.key(42)
+
+    assert grad_check(lambda p: vae.pretrain_loss(p, x, rng), params,
+                      subset=6, max_rel_error=2e-3)
+
+
+def test_vae_bernoulli_pretrain_loss_gradcheck():
+    from deeplearning4j_tpu.nn.conf.variational import VariationalAutoencoder
+    vae = VariationalAutoencoder(n_in=4, n_out=2, encoder_layer_sizes=(5,),
+                                 decoder_layer_sizes=(5,),
+                                 reconstruction_distribution="bernoulli")
+    vae.apply_global_defaults({"activation": "tanh", "weight_init": "xavier"})
+    params = vae.init_params(jax.random.key(0))
+    x = jnp.asarray((R(8).rand(3, 4) > 0.5).astype(F32))
+    rng = jax.random.key(42)
+
+    assert grad_check(lambda p: vae.pretrain_loss(p, x, rng), params,
+                      subset=6, max_rel_error=2e-3)
 
 
 def test_every_layer_class_is_gradchecked():

@@ -258,3 +258,72 @@ class TestInterop:
         import jax.numpy as jnp
         a = nd.ones(2, 2)
         assert float(jnp.sum(a.buf())) == 4.0
+
+
+class TestINDArraySurfaceLongTail:
+    """INDArray long-tail methods (ref: org.nd4j.linalg.api.ndarray.INDArray
+    — predicates, conversions, i-variant broadcasts, absolute reductions,
+    distances, conditional replacement)."""
+
+    def test_predicates_and_meta(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.arange(6, dtype="f4").reshape(2, 3))
+        assert a.isSquare() is False and not a.isEmpty()
+        assert NDArray(np.ones((3, 3))).isSquare()
+        assert NDArray(np.ones((1, 5))).isRowVector()
+        assert NDArray(np.ones((5, 1))).isColumnVector()
+        assert a.isR() and not a.isZ()
+        assert a.ordering() == "c" and a.offset() == 0
+        assert a.stride() == (3, 1)
+        assert not a.isAttached()
+
+    def test_conversions(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.arange(6, dtype="f4").reshape(2, 3))
+        assert a.toDoubleVector().dtype == np.float64
+        assert a.toIntVector().tolist() == [0, 1, 2, 3, 4, 5]
+        assert a.toFloatMatrix().shape == (2, 3)
+
+    def test_inplace_broadcast_variants(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.ones((2, 3), dtype="f4"))
+        a.addiRowVector(np.array([1., 2., 3.], dtype="f4"))
+        np.testing.assert_allclose(a.toNumpy()[0], [2, 3, 4])
+        a.muliColumnVector(np.array([2., 10.], dtype="f4"))
+        np.testing.assert_allclose(a.toNumpy()[1], [20, 30, 40])
+
+    def test_absolute_reductions_and_numbers(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.array([[-3., 1.], [2., -4.]], dtype="f4"))
+        assert a.amaxNumber() == 4.0 and a.aminNumber() == 1.0
+        assert float(a.asum().item()) == 10.0
+        np.testing.assert_allclose(a.ameanNumber(), 2.5)
+        np.testing.assert_allclose(a.norm2Number(), np.sqrt(30), rtol=1e-6)
+        np.testing.assert_allclose(a.prodNumber(), 24.0)
+
+    def test_distances(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.array([1., 2.], dtype="f4"))
+        b = np.array([4., 6.], dtype="f4")
+        assert a.distance1(b) == 7.0
+        assert a.distance2(b) == 5.0
+        assert a.squaredDistance(b) == 25.0
+
+    def test_replace_where_and_get_where(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.array([-1., 2., -3., 4.], dtype="f4"))
+        a.replaceWhere(np.zeros(4, dtype="f4"), ("lessthan", 0.0))
+        np.testing.assert_allclose(a.toNumpy(), [0, 2, 0, 4])
+        got = NDArray(np.array([1., 5., 2.], dtype="f4")).getWhere(
+            None, ("greaterthan", 1.5))
+        np.testing.assert_allclose(got.toNumpy(), [5., 2.])
+
+    def test_rows_columns_subarray(self):
+        from deeplearning4j_tpu.ndarray.ndarray import NDArray
+        a = NDArray(np.arange(12, dtype="f4").reshape(3, 4))
+        np.testing.assert_allclose(a.getRows(0, 2).toNumpy(),
+                                   [[0, 1, 2, 3], [8, 9, 10, 11]])
+        np.testing.assert_allclose(a.getColumns(1, 3).toNumpy(),
+                                   [[1, 3], [5, 7], [9, 11]])
+        np.testing.assert_allclose(a.subArray((1, 1), (2, 2)).toNumpy(),
+                                   [[5, 6], [9, 10]])

@@ -152,3 +152,69 @@ def test_deepwalk_embeds_communities():
     assert dw.get_vertex_vector(3).shape == (16,)
     # same-clique similarity beats cross-clique
     assert dw.similarity(1, 2) > dw.similarity(1, 8)
+
+
+def test_glove_cooccurrence_structure():
+    """GloVe factorises the co-occurrence matrix, so on a tiny corpus the
+    learned structure is FIRST-order: words that directly co-occur
+    (king–rules, king–queen) score above never-co-occurring pairs
+    (king–mat)."""
+    from deeplearning4j_tpu.nlp import Glove
+    glove = (Glove.Builder()
+             .layer_size(24).window_size(4).min_word_frequency(2)
+             .epochs(60).learning_rate(0.05).x_max(10.0)
+             .seed(11).batch_size(512)
+             .iterate(CollectionSentenceIterator(CORPUS))
+             .build())
+    glove.fit()
+    assert glove.has_word("king") and glove.has_word("cat")
+    assert glove.losses[-1] < glove.losses[0]  # WLS objective decreases
+    assert glove.similarity("king", "rules") > glove.similarity("king", "mat")
+    assert glove.similarity("king", "queen") > glove.similarity("king", "mat")
+    near = glove.words_nearest("king", top_n=5)
+    assert len(near) == 5 and "king" not in near
+    assert {"rules", "royal", "queen", "kingdom"} & set(near)
+
+
+def test_fasttext_subwords_and_oov():
+    from deeplearning4j_tpu.nlp import FastText
+    ft = (FastText.Builder()
+          .layer_size(24).window_size(3).min_word_frequency(2)
+          .epochs(15).learning_rate(0.1).bucket(5000)
+          .min_n(3).max_n(5).seed(13)
+          .iterate(CollectionSentenceIterator(CORPUS))
+          .build())
+    ft.fit()
+    assert ft.has_word("king")
+    # in-vocab similarity reflects co-occurrence
+    assert ft.similarity("king", "queen") > ft.similarity("king", "cat")
+    # OOV vector comes from character n-grams and is usable
+    assert not ft.has_word("kingly")
+    v = ft.get_word_vector("kingly")
+    assert v is not None and v.shape == (24,) and np.isfinite(v).all()
+    # shared n-grams make the OOV form closer to its stem than to random words
+    assert ft.similarity("kingly", "king") > ft.similarity("kingly", "mat")
+
+
+def test_lsh_approximate_nn():
+    """RandomProjectionLSH recall vs exact search (ref:
+    RandomProjectionLSHTest): the true NN must appear in the top-k for the
+    overwhelming majority of queries, and exact re-ranking orders results."""
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(500, 16)).astype(np.float32)
+    from deeplearning4j_tpu.clustering import RandomProjectionLSH
+    lsh = RandomProjectionLSH(hash_length=10, num_tables=8, seed=5)
+    lsh.make_index(data)
+
+    hits = 0
+    for qi in range(40):
+        q = data[qi] + rng.normal(size=16).astype(np.float32) * 0.01
+        idx, dist = lsh.search(q, k=5)
+        exact = int(np.argmin(np.linalg.norm(data - q[None], axis=1)))
+        assert dist == sorted(dist)
+        if exact in idx:
+            hits += 1
+    assert hits >= 35  # ≥ 87% recall on near-duplicate queries
+
+    # bucket() returns candidates containing the point itself
+    assert 7 in lsh.bucket(data[7])

@@ -162,3 +162,139 @@ def test_checkpoint_listener_rotation(tmp_path):
     assert cl.last_checkpoint() in files
     restored = MultiLayerNetwork.load(cl.last_checkpoint())
     assert restored.numParams() == net.numParams()
+
+
+class TestPreemption:
+    """Preemption-safe training (SURVEY 5.3 — exceeds the reference's
+    Spark-retry story): signal latch → boundary checkpoint → clean stop →
+    resume with optimizer state."""
+
+    def _conf(self):
+        from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+        return (NeuralNetConfiguration.builder()
+                .seed(5).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+
+    def test_signal_checkpoints_and_resumes(self, tmp_path):
+        import os
+        import signal
+
+        import numpy as np
+
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.utils.preemption import (
+            PreemptionHandler, PreemptionSafeListener, TrainingPreempted,
+            find_final_checkpoint, resume_or_new)
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+
+        handler = PreemptionHandler(signals=(signal.SIGUSR1,)).install()
+        try:
+            net = MultiLayerNetwork(self._conf()).init()
+            lst = PreemptionSafeListener(handler, str(tmp_path))
+            net.addListeners(lst)
+            # a REAL signal delivered to the process mid-training
+            net.fit(x, y)
+            os.kill(os.getpid(), signal.SIGUSR1)
+            with __import__("pytest").raises(TrainingPreempted) as exc:
+                for _ in range(50):
+                    net.fit(x, y)
+            assert exc.value.checkpoint_path == lst.checkpoint_path
+            assert find_final_checkpoint(str(tmp_path)) is not None
+            it_stop = net.getIterationCount()
+            assert it_stop < 51      # stopped early, not after all 50
+
+            # restart path: state (params, Adam moments, iteration) survives
+            net2, resumed = resume_or_new(str(tmp_path), self._conf)
+            assert resumed
+            assert net2.getIterationCount() == it_stop
+            np.testing.assert_allclose(
+                np.asarray(net2.params().buf()),
+                np.asarray(net.params().buf()), atol=1e-6)
+            handler.clear()
+            s0 = net2.score(
+                __import__("deeplearning4j_tpu.data.dataset",
+                           fromlist=["DataSet"]).DataSet(x, y))
+            for _ in range(10):
+                net2.fit(x, y)
+            assert net2.score() < s0     # training continues productively
+        finally:
+            handler.uninstall()
+
+    def test_fresh_start_when_no_checkpoint(self, tmp_path):
+        from deeplearning4j_tpu.utils.preemption import resume_or_new
+        net, resumed = resume_or_new(str(tmp_path / "empty"), self._conf)
+        assert not resumed and net.numParams() > 0
+
+
+class TestSolvers:
+    """Second-order optimizer shell (ref: solvers.{LineGradientDescent,
+    ConjugateGradient,LBFGS} + BackTrackLineSearch — SURVEY D5)."""
+
+    def _net_and_data(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optim.updaters import Sgd
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(0.1)).list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss_function="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        return net, x, y
+
+    def test_each_algorithm_reduces_score(self):
+        from deeplearning4j_tpu.optim.solvers import Solver
+        for algo in ("line_gradient_descent", "conjugate_gradient", "lbfgs"):
+            net, x, y = self._net_and_data()
+            s0, _ = net.computeGradientAndScore(x, y)
+            solver = (Solver.Builder().model(net).configure(algo)
+                      .max_iterations(8).build())
+            solver.optimize(x, y)
+            s1, _ = net.computeGradientAndScore(x, y)
+            assert s1 < s0, f"{algo}: {s1} !< {s0}"
+
+    def test_lbfgs_beats_single_sgd_step(self):
+        from deeplearning4j_tpu.optim.solvers import Solver
+        net, x, y = self._net_and_data()
+        sgd_net, _, _ = self._net_and_data()
+        sgd_net._fit_batch(x, y)
+        s_sgd = sgd_net.score(
+            __import__("deeplearning4j_tpu.data.dataset",
+                       fromlist=["DataSet"]).DataSet(x, y))
+        Solver(net, "lbfgs", max_iterations=10).optimize(x, y)
+        s_lbfgs, _ = net.computeGradientAndScore(x, y)
+        assert s_lbfgs < s_sgd
+
+    def test_solver_iteration_counter_and_listeners(self):
+        from deeplearning4j_tpu.optim.solvers import Solver
+        net, x, y = self._net_and_data()
+        seen = []
+
+        class Probe:
+            def iteration_done(self, model, it, ep, score):
+                seen.append(score)
+
+            def on_epoch_start(self, *a): pass
+            def on_epoch_end(self, *a): pass
+
+        net.addListeners(Probe())
+        Solver(net, "conjugate_gradient", max_iterations=5).optimize(x, y)
+        assert len(seen) == 5 and net.getIterationCount() == 5
