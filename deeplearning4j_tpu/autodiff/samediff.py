@@ -446,6 +446,56 @@ class SDRandom(_Namespace):
                                   seed=seed)
 
 
+class SDBitwise(_Namespace):
+    """Ref: ``SDBitwise`` (nd4j bitwise op namespace)."""
+
+    def and_(self, a, b): return self._op("bitwise_and", a, b)
+    def or_(self, a, b): return self._op("bitwise_or", a, b)
+    def xor(self, a, b): return self._op("bitwise_xor", a, b)
+    def left_shift(self, x, n): return self._op("shift_bits", x, n)
+    def right_shift(self, x, n): return self._op("rshift_bits", x, n)
+    def left_shift_cyclic(self, x, n):
+        return self._op("cyclic_shift_bits", x, n)
+    def bits_hamming_distance(self, a, b):
+        return self._op("bits_hamming_distance", a, b)
+    bitwiseAnd, bitwiseOr, bitwiseXor = and_, or_, xor
+    leftShift, rightShift = left_shift, right_shift
+
+
+class SDImage(_Namespace):
+    """Ref: ``SDImage`` (nd4j image op namespace)."""
+
+    def resize_bilinear(self, x, h, w):
+        # size is a static attr (shapes must be concrete under jit)
+        return self._op("resize_bilinear", x, size=(h, w))
+    def resize_nearest(self, x, h, w):
+        return self._op("resize_nearest_neighbor", x, size=(h, w))
+    def resize_bicubic(self, x, h, w):
+        return self._op("resize_bicubic", x, size=(h, w))
+    def crop_and_resize(self, image, boxes, box_indices, crop_h, crop_w):
+        return self._op("crop_and_resize", image, boxes, box_indices,
+                        crop_size=(crop_h, crop_w))
+    def extract_image_patches(self, x, kh, kw, sh, sw, rh=1, rw=1,
+                              same_mode=False):
+        return self._op("extract_image_patches", x, ksizes=(kh, kw),
+                        strides=(sh, sw), rates=(rh, rw),
+                        padding="SAME" if same_mode else "VALID")
+    def rgb_to_hsv(self, x): return self._op("rgb_to_hsv", x)
+    def hsv_to_rgb(self, x): return self._op("hsv_to_rgb", x)
+    def rgb_to_yuv(self, x): return self._op("rgb_to_yuv", x)
+    def yuv_to_rgb(self, x): return self._op("yuv_to_rgb", x)
+    def adjust_contrast(self, x, factor):
+        return self._op("adjust_contrast", x, factor)
+    def adjust_saturation(self, x, factor):
+        return self._op("adjust_saturation", x, factor)
+    def adjust_hue(self, x, delta): return self._op("adjust_hue", x, delta)
+    def non_max_suppression(self, boxes, scores, max_out, iou_threshold=0.5,
+                            score_threshold=float("-inf")):
+        return self._op("non_max_suppression", boxes, scores,
+                        max_output_size=max_out, iou_threshold=iou_threshold,
+                        score_threshold=score_threshold)
+
+
 _RANDOM_OPS = {"random_normal", "random_uniform", "random_bernoulli",
                "dropout", "dropout_inverted"}
 
@@ -483,6 +533,8 @@ class SameDiff:
         self.loss = SDLoss(self)
         self.linalg = SDLinalg(self)
         self.random = SDRandom(self)
+        self.bitwise = SDBitwise(self)
+        self.image = SDImage(self)
 
     # ---- creation -----------------------------------------------------
     @staticmethod

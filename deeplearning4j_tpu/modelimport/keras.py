@@ -88,11 +88,14 @@ class _H5Weights:
             if hasattr(root[top], "keys"):
                 walk(root[top], top)
 
-    def get(self, layer_name: str) -> Dict[str, np.ndarray]:
+    def get(self, layer_name: str,
+            allow_ambiguous_leaves: bool = False) -> Dict[str, np.ndarray]:
         """Weights for one layer, keyed by leaf name ('kernel', 'bias', …)
         where unambiguous; full paths are always present. Ambiguous leaf
         names (nested submodels with several sub-layers) raise rather than
-        silently loading the last-walked weight."""
+        silently loading the last-walked weight — unless the caller handles
+        full paths itself (``allow_ambiguous_leaves``, e.g. the
+        Bidirectional loader filters on forward_/backward_ prefixes)."""
         by_path = self.by_layer.get(layer_name, {})
         out: Dict[str, np.ndarray] = dict(by_path)
         leaves: Dict[str, list] = {}
@@ -102,6 +105,8 @@ class _H5Weights:
             if leaf in out:      # a top-level dataset already owns this name
                 continue
             if len(paths) > 1:
+                if allow_ambiguous_leaves:
+                    continue     # full paths remain available
                 raise UnsupportedKerasConfigurationException(
                     f"Ambiguous weight name {leaf!r} in layer "
                     f"{layer_name!r}: {sorted(paths)} — nested submodel "
@@ -193,6 +198,78 @@ def _map_layer(cls: str, cfg: dict):
     if cls == "Embedding":
         return L.EmbeddingSequenceLayer(name=name, n_in=cfg["input_dim"],
                                         n_out=cfg["output_dim"])
+    if cls in ("Conv1D", "Convolution1D"):
+        pad = cfg.get("padding", "valid")
+        pad = {"valid": 0, "same": "same", "causal": "causal"}[pad]
+        ks = cfg["kernel_size"]
+        return L.Convolution1DLayer(
+            name=name, n_out=cfg["filters"],
+            kernel_size=ks[0] if isinstance(ks, (list, tuple)) else ks,
+            stride=(cfg.get("strides", [1]) or [1])[0]
+            if isinstance(cfg.get("strides", 1), (list, tuple))
+            else cfg.get("strides", 1),
+            dilation=(cfg.get("dilation_rate", [1]) or [1])[0]
+            if isinstance(cfg.get("dilation_rate", 1), (list, tuple))
+            else cfg.get("dilation_rate", 1),
+            padding=pad, activation=act, has_bias=use_bias)
+    if cls in ("Conv3D", "Convolution3D"):
+        return L.Convolution3D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            dilation=tuple(cfg.get("dilation_rate", (1, 1, 1))),
+            padding=_padding(cfg), activation=act, has_bias=use_bias)
+    if cls == "LayerNormalization":
+        axis = cfg.get("axis", -1)
+        if axis not in (-1, [-1]):
+            raise UnsupportedKerasConfigurationException(
+                "LayerNormalization only supports axis=-1")
+        return L.LayerNormalization(name=name, eps=cfg.get("epsilon", 1e-3))
+    if cls == "LeakyReLU":
+        alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return L.ActivationLayer(name=name,
+                                 activation=f"leakyrelu:{alpha}")
+    if cls == "ELU":
+        return L.ActivationLayer(name=name, activation="elu")
+    if cls == "ReLU":
+        max_value = cfg.get("max_value")
+        slope = cfg.get("negative_slope", 0.0) or 0.0
+        if cfg.get("threshold", 0.0):
+            raise UnsupportedKerasConfigurationException(
+                "ReLU threshold != 0 is not supported")
+        if slope:
+            return L.ActivationLayer(name=name,
+                                     activation=f"leakyrelu:{slope}")
+        if max_value == 6.0:
+            return L.ActivationLayer(name=name, activation="relu6")
+        if max_value is not None:
+            raise UnsupportedKerasConfigurationException(
+                f"ReLU max_value={max_value} unsupported (only None/6.0)")
+        return L.ActivationLayer(name=name, activation="relu")
+    if cls == "Softmax":
+        return L.ActivationLayer(name=name, activation="softmax")
+    if cls == "TimeDistributed":
+        # TimeDistributed(Dense) == our per-timestep dense on rnn input
+        inner = cfg["layer"]
+        mapped = _map_layer(inner["class_name"], inner["config"])
+        if not isinstance(mapped, L.DenseLayer):
+            raise UnsupportedKerasConfigurationException(
+                "TimeDistributed only supported around Dense")
+        mapped.name = name or mapped.name
+        return mapped
+    if cls == "Bidirectional":
+        inner = cfg["layer"]
+        mapped = _map_layer(inner["class_name"], inner["config"])
+        wrapped = mapped
+        if isinstance(mapped, L.LastTimeStep):
+            wrapped = mapped._inner_layer
+        mode = {"concat": "concat", "sum": "add", "ave": "average",
+                "mul": "mul"}.get(cfg.get("merge_mode", "concat"), "concat")
+        bi = L.Bidirectional.wrap(wrapped, mode=mode)
+        bi.name = name
+        if isinstance(mapped, L.LastTimeStep):
+            return L.LastTimeStep.wrap(bi)
+        return bi
     if cls in ("LSTM", "GRU", "SimpleRNN"):
         ctor = {"LSTM": L.LSTM, "GRU": L.GRU, "SimpleRNN": L.SimpleRnn}[cls]
         kw = {}
@@ -264,6 +341,24 @@ def _load_weights_into(layer, w: Dict[str, np.ndarray], params: dict,
                     params[lkey]["b"] = jnp.asarray(reorder_b(b))
     elif isinstance(layer, (L.EmbeddingLayer, L.EmbeddingSequenceLayer)):
         put("W", "embeddings")
+    elif isinstance(layer, L.LayerNormalization):
+        put("gamma", "gamma")
+        put("beta", "beta")
+    elif isinstance(layer, L.Bidirectional):
+        # Keras nests weights per direction; our params are flat
+        # "f_<name>"/"b_<name>" keys (Bidirectional.param_shapes)
+        layer._materialize()
+        for ours_prefix, theirs_prefix in (("f_", "forward_"),
+                                           ("b_", "backward_")):
+            sub = {k.split("/")[-1]: v for k, v in w.items()
+                   if k.startswith(theirs_prefix)
+                   or f"/{theirs_prefix}" in k}
+            if sub:
+                inner_params = {}
+                _load_weights_into(layer._fwd_layer, sub, inner_params,
+                                   {}, "x")
+                for pname, val in inner_params.get("x", {}).items():
+                    params.setdefault(lkey, {})[ours_prefix + pname] = val
     else:
         put("W", "kernel")
         put("b", "bias")
@@ -276,6 +371,9 @@ def _input_type_from_config(cfg_layers: List[dict]) -> Optional[InputType]:
         shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
         if shape:
             dims = [d for d in shape[1:]]
+            if len(dims) == 4:
+                return InputType.convolutional3d(dims[0], dims[1], dims[2],
+                                                 dims[3])
             if len(dims) == 3:
                 return InputType.convolutional(dims[0], dims[1], dims[2])
             if len(dims) == 2:
@@ -332,7 +430,9 @@ class KerasModelImport:
             from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
             net = MultiLayerNetwork(conf).init()
             for i, (lyr, kname) in enumerate(mapped):
-                _load_weights_into(lyr, weights.get(kname), net._params,
+                _load_weights_into(
+                    lyr, weights.get(kname, allow_ambiguous_leaves=isinstance(
+                        lyr, L.Bidirectional)), net._params,
                                    net._states, str(i))
             net._opt_state = net._opt.init(net._params)
             return net
@@ -410,7 +510,9 @@ class KerasModelImport:
             from deeplearning4j_tpu.nn.graph import ComputationGraph
             net = ComputationGraph(conf).init()
             for kname, lyr in mapped.items():
-                _load_weights_into(lyr, weights.get(kname), net._params,
+                _load_weights_into(
+                    lyr, weights.get(kname, allow_ambiguous_leaves=isinstance(
+                        lyr, L.Bidirectional)), net._params,
                                    net._states, kname)
             net._opt_state = net._opt.init(net._params)
             return net

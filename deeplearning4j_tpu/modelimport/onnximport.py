@@ -409,6 +409,174 @@ def _register_onnx_rules():
                           dtype=np.dtype(values.dtype).name)
 
 
+    # ---------------------------------------------------- opset long tail
+    for o, r in [
+        ("Tan", "tan"), ("Asin", "asin"), ("Acos", "acos"), ("Atan", "atan"),
+        ("Sinh", "sinh"), ("Cosh", "cosh"), ("Asinh", "asinh"),
+        ("Acosh", "acosh"), ("Atanh", "atanh"), ("Xor", "boolean_xor"),
+        ("Selu", "selu"), ("Mish", "mish"), ("Expm1", "expm1"),
+    ]:
+        passthru(o, r)
+
+    @onnx_rule("Sum")          # variadic elementwise ops
+    def _vsum(ctx, node, inputs, attrs):
+        out = inputs[0]
+        for v in inputs[1:]:
+            out = ctx.sd._op("Add", out, v)
+        return out
+
+    @onnx_rule("Mean")
+    def _vmean(ctx, node, inputs, attrs):
+        out = inputs[0]
+        for v in inputs[1:]:
+            out = ctx.sd._op("Add", out, v)
+        return ctx.sd._op("Mul", out, ctx.sd.constant(
+            np.float32(1.0 / len(inputs))))
+
+    @onnx_rule("Mod")
+    def _mod(ctx, node, inputs, attrs):
+        if attrs.get("fmod", 0):
+            raise ONNXImportError("Mod with fmod=1 (C-style) unsupported; "
+                                  "only integer/floor Mod")
+        return ctx.sd._op("FloorMod", *inputs)
+
+    @onnx_rule("HardSwish")
+    def _hard_swish(ctx, node, inputs, attrs):
+        # onnx: x · max(0, min(1, x/6 + 1/2))
+        x = inputs[0]
+        ax = ctx.sd._op("Mul", x, ctx.sd.constant(np.float32(1.0 / 6.0)))
+        axb = ctx.sd._op("Add", ax, ctx.sd.constant(np.float32(0.5)))
+        return ctx.sd._op("Mul", x, ctx.sd._op("clipbyvalue", axb,
+                                               lo=0.0, hi=1.0))
+
+    @onnx_rule("HardSigmoid")
+    def _hard_sigmoid(ctx, node, inputs, attrs):
+        # onnx: max(0, min(1, alpha·x + beta))
+        alpha = attrs.get("alpha", 0.2)
+        beta = attrs.get("beta", 0.5)
+        ax = ctx.sd._op("Mul", inputs[0], ctx.sd.constant(np.float32(alpha)))
+        axb = ctx.sd._op("Add", ax, ctx.sd.constant(np.float32(beta)))
+        return ctx.sd._op("clipbyvalue", axb, lo=0.0, hi=1.0)
+
+    @onnx_rule("PRelu")
+    def _prelu_rule(ctx, node, inputs, attrs):
+        x, slope = inputs
+        neg = ctx.sd._op("Mul", ctx.sd._op("minimum", x,
+                                           ctx.sd.constant(np.float32(0.0))),
+                         slope)
+        pos = ctx.sd._op("Relu", x)
+        return ctx.sd._op("Add", pos, neg)
+
+    @onnx_rule("ThresholdedRelu")
+    def _trelu(ctx, node, inputs, attrs):
+        return ctx.sd._op("thresholdedrelu", inputs[0],
+                          theta=attrs.get("alpha", 1.0))
+
+    @onnx_rule("CumSum")
+    def _cumsum(ctx, node, inputs, attrs):
+        axis = int(ctx.const(node["input"][1]))
+        return ctx.sd._op("cumsum", inputs[0], axis=axis,
+                          exclusive=bool(attrs.get("exclusive", 0)),
+                          reverse=bool(attrs.get("reverse", 0)))
+
+    @onnx_rule("TopK")
+    def _topk(ctx, node, inputs, attrs):
+        k = int(ctx.const(node["input"][1]))
+        if attrs.get("axis", -1) not in (-1,):
+            raise ONNXImportError("TopK only supports the last axis")
+        if not attrs.get("largest", 1):
+            raise ONNXImportError("TopK largest=0 (smallest-k) unsupported")
+        return ctx.sd._op("top_k", inputs[0], k=k, n_out=2)
+
+    @onnx_rule("GatherND")
+    def _gather_nd(ctx, node, inputs, attrs):
+        if attrs.get("batch_dims", 0):
+            raise ONNXImportError("GatherND batch_dims unsupported")
+        return ctx.sd._op("gather_nd", inputs[0], inputs[1])
+
+    @onnx_rule("ScatterND")
+    def _scatter_nd(ctx, node, inputs, attrs):
+        return ctx.sd._op("scatter_nd_update", *inputs)
+
+    @onnx_rule("InstanceNormalization")
+    def _instancenorm(ctx, node, inputs, attrs):
+        # NCHW: normalize over spatial dims per channel per example
+        x, scale, b = inputs
+        eps = attrs.get("epsilon", 1e-5)
+        mean = ctx.sd._op("reduce_mean", x, axis=(2, 3), keepdims=True)
+        var = ctx.sd._op("reduce_variance", x, axis=(2, 3), keepdims=True)
+        xc = ctx.sd._op("Sub", x, mean)
+        denom = ctx.sd._op("sqrt", ctx.sd._op(
+            "Add", var, ctx.sd.constant(np.float32(eps))))
+        xn = ctx.sd._op("RealDiv", xc, denom)
+        s4 = ctx.sd._op("reshape", scale, shape=[1, -1, 1, 1])
+        b4 = ctx.sd._op("reshape", b, shape=[1, -1, 1, 1])
+        return ctx.sd._op("Add", ctx.sd._op("Mul", xn, s4), b4)
+
+    @onnx_rule("LayerNormalization")
+    def _layernorm_rule(ctx, node, inputs, attrs):
+        if attrs.get("axis", -1) != -1:
+            raise ONNXImportError("LayerNormalization only supports axis=-1")
+        x, scale = inputs[0], inputs[1]
+        b = inputs[2] if len(inputs) > 2 else None
+        out = ctx.sd._op("layer_norm", x, scale,
+                         b if b is not None else
+                         ctx.sd.constant(np.zeros(1, np.float32)),
+                         epsilon=attrs.get("epsilon", 1e-5))
+        return out
+
+    @onnx_rule("DepthToSpace")
+    def _d2s(ctx, node, inputs, attrs):
+        # our op is NHWC; onnx is NCHW — transpose around it
+        bs = attrs.get("blocksize", 2)
+        nhwc = ctx.sd._op("transpose", inputs[0], perm=[0, 2, 3, 1])
+        out = ctx.sd._op("depth_to_space", nhwc, block_size=bs)
+        return ctx.sd._op("transpose", out, perm=[0, 3, 1, 2])
+
+    @onnx_rule("SpaceToDepth")
+    def _s2d(ctx, node, inputs, attrs):
+        bs = attrs.get("blocksize", 2)
+        nhwc = ctx.sd._op("transpose", inputs[0], perm=[0, 2, 3, 1])
+        out = ctx.sd._op("space_to_depth", nhwc, block_size=bs)
+        return ctx.sd._op("transpose", out, perm=[0, 3, 1, 2])
+
+    @onnx_rule("ReduceL1")
+    def _reduce_l1(ctx, node, inputs, attrs):
+        axes = attrs.get("axes")
+        return ctx.sd._op("reduce_norm1", inputs[0],
+                          axis=tuple(axes) if axes else None,
+                          keepdims=bool(attrs.get("keepdims", 1)))
+
+    @onnx_rule("ReduceL2")
+    def _reduce_l2(ctx, node, inputs, attrs):
+        axes = attrs.get("axes")
+        return ctx.sd._op("reduce_norm2", inputs[0],
+                          axis=tuple(axes) if axes else None,
+                          keepdims=bool(attrs.get("keepdims", 1)))
+
+    @onnx_rule("Resize")
+    def _resize(ctx, node, inputs, attrs):
+        mode = attrs.get("mode", "nearest")
+        ins = node["input"]
+        # sizes (input 3) preferred; else scales (input 2)
+        if len(ins) > 3 and ins[3]:
+            sizes = [int(v) for v in ctx.const(ins[3])]
+            out_h, out_w = sizes[2], sizes[3]
+        elif len(ins) > 2 and ins[2]:
+            scales = [float(v) for v in ctx.const(ins[2])]
+            shape = ctx.vars[ins[0]].shape
+            out_h = int(shape[2] * scales[2])
+            out_w = int(shape[3] * scales[3])
+        else:
+            raise ONNXImportError("Resize needs sizes or scales")
+        op = {"nearest": "resize_nearest_neighbor",
+              "linear": "resize_bilinear",
+              "cubic": "resize_bicubic"}.get(mode, "resize_bilinear")
+        nhwc = ctx.sd._op("transpose", inputs[0], perm=[0, 2, 3, 1])
+        out = ctx.sd._op(op, nhwc, size=(out_h, out_w))
+        return ctx.sd._op("transpose", out, perm=[0, 3, 1, 2])
+
+
 _register_onnx_rules()
 
 

@@ -168,7 +168,8 @@ def _register_default_rules():
         ctx.consts[node.name] = arr
         return ctx.sd.constant(arr, name=node.name)
 
-    @mapping_rule("Identity", "StopGradient", "PreventGradient", "Snapshot")
+    @mapping_rule("Identity", "StopGradient", "PreventGradient", "Snapshot",
+                  "CheckNumerics")
     def _ident(ctx, node, inputs, attrs):
         # emit a real identity op so the TF node name stays addressable as a
         # graph output (XLA elides it at compile time)
@@ -220,7 +221,7 @@ def _register_default_rules():
     def _sm(ctx, node, inputs, attrs):
         return ctx.sd._op(node.op, inputs[0])
 
-    @mapping_rule("Mean", "Sum", "Max", "Min", "Prod")
+    @mapping_rule("Mean", "Sum", "Max", "Min", "Prod", "All", "Any")
     def _red(ctx, node, inputs, attrs):
         axis = ctx.const_value(node.input[1])
         axis = tuple(int(a) for a in np.atleast_1d(axis))
@@ -519,6 +520,15 @@ def _map_nodes(ctx: _ImportCtx, nodes, skip=frozenset()):
     """Shared per-node rule walk for GraphDef.node and FunctionDef.node_def."""
     for node in nodes:
         if node.name in skip or node.op == "NoOp":
+            continue
+        if node.op == "Assert":
+            # debug-only; Assert's output is never consumed as a tensor
+            # (CheckNumerics, by contrast, is an inline identity and routes
+            # through the Identity rule below)
+            continue
+        if node.op == "Const" and int(node.attr["dtype"].type) == 7:
+            # DT_STRING constants only ever feed Assert/summary nodes in
+            # inference graphs — nothing numeric can consume them
             continue
         rule = _RULES.get(node.op)
         if rule is None:

@@ -65,3 +65,15 @@ class ZooModel:
         return os.path.exists(os.path.join(
             self.pretrained_cache_dir(),
             f"{type(self).__name__.lower()}_{pretrained_type}.zip"))
+
+    def save_pretrained(self, net, pretrained_type: str) -> str:
+        """Publish a trained net into the local pretrained cache — the
+        producer side of ``init_pretrained`` (the reference's equivalent is
+        uploading to its blob store; zero egress makes the cache the store).
+        Returns the written path."""
+        cache = self.pretrained_cache_dir()
+        os.makedirs(cache, exist_ok=True)
+        path = os.path.join(
+            cache, f"{type(self).__name__.lower()}_{pretrained_type}.zip")
+        net.save(path)
+        return path

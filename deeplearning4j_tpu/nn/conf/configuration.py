@@ -26,6 +26,7 @@ from typing import Any, Dict, List, Optional
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import Layer, OutputLayer, layer_from_dict
 from deeplearning4j_tpu.optim import updaters as _upd
+from deeplearning4j_tpu.nn.conf import preprocessors as _preproc
 
 
 @dataclasses.dataclass
@@ -123,6 +124,7 @@ class ListBuilder:
         self._backprop_type = BackpropType.Standard
         self._tbptt_fwd = 20
         self._tbptt_bwd = 20
+        self._preprocessors = {}
 
     def layer(self, *args) -> "ListBuilder":
         """layer(conf) or layer(index, conf)."""
@@ -133,6 +135,14 @@ class ListBuilder:
     def set_input_type(self, t: InputType) -> "ListBuilder":
         self._input_type = t
         return self
+
+    def input_pre_processor(self, idx: int, proc) -> "ListBuilder":
+        """Attach an explicit InputPreProcessor before layer ``idx`` (ref:
+        ListBuilder#inputPreProcessor)."""
+        self._preprocessors[int(idx)] = proc
+        return self
+
+    inputPreProcessor = input_pre_processor
 
     setInputType = set_input_type
 
@@ -167,6 +177,7 @@ class ListBuilder:
             tbptt_bwd_length=self._tbptt_bwd,
             grad_normalization=c._grad_normalization,
             grad_norm_threshold=c._grad_norm_threshold,
+            input_pre_processors=self._preprocessors,
         )
 
 
@@ -185,6 +196,7 @@ class MultiLayerConfiguration:
     tbptt_bwd_length: int = 20
     grad_normalization: Optional[str] = None
     grad_norm_threshold: float = 1.0
+    input_pre_processors: dict = dataclasses.field(default_factory=dict)
 
     def recompute_shapes(self):
         """Re-run config-time shape inference after layer edits
@@ -208,6 +220,8 @@ class MultiLayerConfiguration:
             "tbptt_bwd_length": self.tbptt_bwd_length,
             "grad_normalization": self.grad_normalization,
             "grad_norm_threshold": self.grad_norm_threshold,
+            "input_pre_processors": {str(k): v.to_dict() for k, v in
+                                     self.input_pre_processors.items()},
         }, indent=2)
 
     @staticmethod
@@ -224,4 +238,7 @@ class MultiLayerConfiguration:
             tbptt_bwd_length=d.get("tbptt_bwd_length", 20),
             grad_normalization=d.get("grad_normalization"),
             grad_norm_threshold=d.get("grad_norm_threshold", 1.0),
+            input_pre_processors={
+                int(k): _preproc.preprocessor_from_dict(v)
+                for k, v in (d.get("input_pre_processors") or {}).items()},
         )

@@ -1284,3 +1284,35 @@ class CnnLossLayer(Layer):
         if fused is not None:
             return fused(z, lbl, m)
         return _loss.get(self.loss_function)(self._act(z), lbl, m)
+
+
+@register_layer
+@dataclasses.dataclass
+class LayerNormalization(Layer):
+    """Per-feature layer norm with learned gain/bias (Keras
+    LayerNormalization / the reference's layer_norm declarable op — SURVEY
+    N3). Normalizes over the LAST axis; statistics in ≥f32."""
+    n_out: Optional[int] = None
+    eps: float = 1e-3
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_out is None:
+            self.n_out = (input_type.channels
+                          if input_type.kind in ("cnn", "cnn3d")
+                          else input_type.size)
+
+    def param_shapes(self):
+        return {"gamma": (self.n_out,), "beta": (self.n_out,)}
+
+    def init_params(self, key):
+        return {"gamma": jnp.ones((self.n_out,)),
+                "beta": jnp.zeros((self.n_out,))}
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        acc = jnp.promote_types(x.dtype, jnp.float32)
+        xf = x.astype(acc)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * lax.rsqrt(var + self.eps)
+        y = y * params["gamma"].astype(acc) + params["beta"].astype(acc)
+        return self._act(y.astype(x.dtype)), state

@@ -171,8 +171,12 @@ class MultiLayerNetwork:
         new_states = dict(states)
         new_carries = {}
         h = _maybe_unflatten_input(x, self.conf.input_type)
+        batch_n = x.shape[0]
+        preprocs = getattr(self.conf, "input_pre_processors", None) or {}
         n_layers = len(self.layers) if up_to is None else up_to
         for i, layer in enumerate(self.layers[:n_layers]):
+            if i in preprocs:   # explicit reference-API preprocessor
+                h = preprocs[i].pre_process(h, batch_size=batch_n)
             lkey = str(i)
             lp = params.get(lkey, {})
             lst = states.get(lkey)
@@ -219,6 +223,10 @@ class MultiLayerNetwork:
             up_to=len(self.layers) - 1)
         out_layer = self.layers[-1]
         lkey = str(len(self.layers) - 1)
+        preprocs = getattr(self.conf, "input_pre_processors", None) or {}
+        if (len(self.layers) - 1) in preprocs:
+            h = preprocs[len(self.layers) - 1].pre_process(
+                h, batch_size=x.shape[0])
         lrng = jax.random.fold_in(rng, len(self.layers) - 1) if rng is not None else None
         loss = out_layer.loss(params.get(lkey, {}), h, labels, mask=label_mask,
                               training=True, rng=lrng)

@@ -584,3 +584,10 @@ def _reverse(x, axis):
 @register("trapz", aliases=[])
 def _trapz(y, x=None, axis=-1):
     return jnp.trapezoid(y, x=x, axis=axis)
+
+
+# boolean reductions (TF All/Any — assert chains in imported graphs)
+register("reduce_all", lambda x, axis=None, keepdims=False:
+         jnp.all(x, axis=axis, keepdims=keepdims), aliases=["All"])
+register("reduce_any", lambda x, axis=None, keepdims=False:
+         jnp.any(x, axis=axis, keepdims=keepdims), aliases=["Any"])

@@ -1,0 +1,125 @@
+"""Mixture-of-experts with expert parallelism (SURVEY P7: ABSENT in the
+reference — net-new TPU capability).
+
+Switch-Transformer-style top-1 routing in the dense-dispatch formulation —
+the TPU-canonical shape: routing becomes three einsums over a fixed-capacity
+(tokens, experts, capacity) one-hot dispatch tensor, so shapes stay STATIC
+under jit (no data-dependent gather/scatter), and sharding the expert axis
+over the ``expert`` mesh dimension makes GSPMD insert the token all-to-alls
+over ICI. Over-capacity tokens are dropped (their output is the residual
+zero), exactly as in Switch/GShard.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import EXPERT_AXIS, axis_size
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    router_noise: float = 0.0       # jitter for load-balancing exploration
+
+
+def init_moe_params(cfg: MoEConfig, key, scale: float = 0.02):
+    kg, k1, k2 = jax.random.split(key, 3)
+    E, d, f = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "Wg": jax.random.normal(kg, (d, E)) * scale,
+        "W1": jax.random.normal(k1, (E, d, f)) * scale,
+        "b1": jnp.zeros((E, f)),
+        "W2": jax.random.normal(k2, (E, f, d)) * scale,
+        "b2": jnp.zeros((E, d)),
+    }
+
+
+def moe_param_shardings(cfg: MoEConfig, mesh: Mesh):
+    """Expert-dim sharding over the ``expert`` mesh axis (router replicated)."""
+    e = EXPERT_AXIS if EXPERT_AXIS in mesh.axis_names else None
+    return {
+        "Wg": NamedSharding(mesh, P()),
+        "W1": NamedSharding(mesh, P(e)),
+        "b1": NamedSharding(mesh, P(e)),
+        "W2": NamedSharding(mesh, P(e)),
+        "b2": NamedSharding(mesh, P(e)),
+    }
+
+
+def moe_ffn(params, x, cfg: MoEConfig, mesh: Optional[Mesh] = None,
+            rng=None):
+    """Top-1 MoE FFN over (B, T, d). Returns (y, aux) where aux carries the
+    Switch load-balancing loss and routing stats."""
+    B, T, d = x.shape
+    E = cfg.num_experts
+    G = B * T
+    xt = x.reshape(G, d)
+
+    logits = xt @ params["Wg"]                       # (G, E)
+    if rng is not None and cfg.router_noise > 0:
+        logits = logits + jax.random.uniform(
+            rng, logits.shape, minval=1.0 - cfg.router_noise,
+            maxval=1.0 + cfg.router_noise)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)          # (G,)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    C = int(np.ceil(G / E * cfg.capacity_factor))
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)       # (G, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # (G, E)
+    keep = (pos >= 0) & (pos < C)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=x.dtype)  # (G,E,C)
+    dispatch = pos_oh * keep.astype(x.dtype)[..., None]          # (G, E, C)
+    combine = dispatch * gate[:, None, None]
+
+    # token → expert buffers; sharding hint puts E on the expert axis so
+    # GSPMD routes via all-to-all over ICI
+    ei = jnp.einsum("gec,gd->ecd", dispatch, xt)                 # (E, C, d)
+    if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+        ei = lax.with_sharding_constraint(
+            ei, NamedSharding(mesh, P(EXPERT_AXIS)))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", ei, params["W1"])
+                    + params["b1"][:, None, :])
+    out_e = jnp.einsum("ecf,efd->ecd", h, params["W2"]) \
+        + params["b2"][:, None, :]
+    if mesh is not None and EXPERT_AXIS in mesh.axis_names:
+        out_e = lax.with_sharding_constraint(
+            out_e, NamedSharding(mesh, P(EXPERT_AXIS)))
+    y = jnp.einsum("gec,ecd->gd", combine, out_e)                # (G, d)
+
+    # Switch aux loss: E * Σ_e fraction_tokens_e · mean_prob_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(frac * mean_prob)
+    dropped = jnp.maximum(0.0, 1.0 - jnp.sum(dispatch) / G)
+    return y.reshape(B, T, d), {"aux_loss": aux_loss,
+                                "dropped_fraction": dropped,
+                                "expert_fraction": frac}
+
+
+def moe_reference_dense(params, x, cfg: MoEConfig):
+    """Unrouted check path: every token through its argmax expert with no
+    capacity limit (the semantics dispatch must match when nothing drops)."""
+    B, T, d = x.shape
+    xt = x.reshape(-1, d)
+    probs = jax.nn.softmax(xt @ params["Wg"], axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    W1 = params["W1"][idx]            # (G, d, f)
+    b1 = params["b1"][idx]
+    W2 = params["W2"][idx]
+    b2 = params["b2"][idx]
+    h = jax.nn.gelu(jnp.einsum("gd,gdf->gf", xt, W1) + b1)
+    y = (jnp.einsum("gf,gfd->gd", h, W2) + b2) * gate[:, None]
+    return y.reshape(B, T, d)

@@ -1,0 +1,96 @@
+"""Pipeline parallelism — GPipe micro-batch schedule over the ``stage`` mesh
+axis (SURVEY P5: ABSENT in the reference; net-new TPU capability).
+
+Design (TPU-idiomatic, no per-stage processes): the layer stack is split
+into S stages; each device along ``stage`` holds ONE stage's params
+(leading-axis sharded pytree). A ``shard_map`` program runs the classic
+GPipe schedule: at tick t, stage s processes micro-batch (t − s); between
+ticks activations hop one stage to the right via ``lax.ppermute`` over ICI.
+The whole schedule — M + S − 1 ticks — is one ``lax.fori_loop`` inside one
+jitted program, and it is DIFFERENTIABLE: jax reverse-mode through the
+ppermute ring gives the backward pipeline automatically (the hand-built
+1F1B machinery of torch-style PP collapses into autodiff).
+
+Bubble fraction is the standard (S−1)/(M+S−1) — callers pick M >> S.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import STAGE_AXIS, axis_size
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] → one tree with a leading stage axis
+    (shardable over ``stage``)."""
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def shard_stage_params(stacked, mesh: Mesh):
+    """Place the stacked tree so each stage device holds its own slice."""
+    spec = jax.tree.map(
+        lambda a: NamedSharding(mesh, P(STAGE_AXIS)), stacked)
+    return jax.device_put(stacked, spec)
+
+
+def gpipe(stage_fn: Callable, mesh: Mesh, num_stages: int = None):
+    """Build a pipelined forward: ``fn(stacked_params, x_micro) -> y_micro``.
+
+    ``stage_fn(stage_params, h) -> h`` is the per-stage computation (same
+    activation shape in/out — transformer-block-stack shaped, which is what
+    pipelining is for). ``x_micro``: (M, micro_batch, ...) micro-batches.
+    Returns (M, micro_batch, ...) outputs after all S stages.
+    """
+    S = num_stages or axis_size(mesh, STAGE_AXIS)
+
+    def local(params_slice, x):          # runs per stage device
+        # params_slice: (1, ...) leading stage slice; x: (M, mb, ...) full
+        # micro-batch queue, replicated — stage 0 reads it, others ignore
+        p = jax.tree.map(lambda a: a[0], params_slice)
+        stage_id = lax.axis_index(STAGE_AXIS)
+        M = x.shape[0]
+        n_ticks = M + S - 1
+        mb_shape = x.shape[1:]
+        out = jnp.zeros_like(x)
+
+        def tick(t, carry):
+            h, out = carry
+            # stage 0 ingests micro-batch t (if any); others use the
+            # activation handed over from the left neighbour
+            feed = x[jnp.clip(t, 0, M - 1)]
+            h_in = jnp.where(stage_id == 0, feed, h)
+            mb_idx = t - stage_id                 # micro-batch at this stage
+            active = (mb_idx >= 0) & (mb_idx < M)
+            h_out = stage_fn(p, h_in)
+            h_out = jnp.where(active, h_out, h_in)
+            # the LAST stage's finished micro-batch lands in the output slot
+            out = lax.cond(
+                active & (stage_id == S - 1),
+                lambda o: o.at[jnp.clip(mb_idx, 0, M - 1)].set(h_out),
+                lambda o: o, out)
+            # hop right: stage s → s+1 (ring; the wraparound edge is ignored
+            # because stage 0 always re-ingests from x)
+            h_next = lax.ppermute(h_out, STAGE_AXIS,
+                                  [(i, (i + 1) % S) for i in range(S)])
+            return h_next, out
+
+        h0 = jnp.zeros(mb_shape, x.dtype)
+        _, out = lax.fori_loop(0, n_ticks, tick, (h0, out))
+        # only the last stage wrote outputs; psum broadcasts them to all
+        return lax.psum(out, STAGE_AXIS)
+
+    def run(stacked_params, x_micro):
+        specs = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
+        f = shard_map(local, mesh=mesh, in_specs=(specs, P()),
+                      out_specs=P(), check_vma=False)
+        return f(stacked_params, x_micro)
+
+    return run

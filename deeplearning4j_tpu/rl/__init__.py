@@ -5,9 +5,10 @@ from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
 from deeplearning4j_tpu.rl.qlearning import (DQNPolicy, EpsGreedy,
                                              QLearningConfiguration,
                                              QLearningDiscreteDense)
-from deeplearning4j_tpu.rl.a2c import A2CDiscreteDense, A2CConfiguration
+from deeplearning4j_tpu.rl.a2c import (A2CDiscreteDense, A2CConfiguration,
+                                       A3CDiscreteDense)
 
 __all__ = ["MDP", "ObservationSpace", "DiscreteSpace", "CartPole",
            "GridWorld", "ExpReplay", "Transition", "QLearningConfiguration",
            "QLearningDiscreteDense", "EpsGreedy", "DQNPolicy",
-           "A2CDiscreteDense", "A2CConfiguration"]
+           "A2CDiscreteDense", "A2CConfiguration", "A3CDiscreteDense"]

@@ -90,12 +90,19 @@ class A2CDiscreteDense:
             updates, opt_state = self._opt.update(grads, opt_state, p)
             return optax.apply_updates(p, updates), opt_state, loss
 
+        @jax.jit
+        def apply_grads(grads, opt_state, p):
+            updates, opt_state = self._opt.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state
+
         self._train_step = train_step
+        self._loss_ref = loss_fn           # A3C workers grad this directly
+        self._apply = apply_grads          # A3C global apply (under lock)
         self._heads = jax.jit(heads)
         self._jnp = jnp
 
-    def _policy_value(self, obs):
-        logits, value = self._heads(self.params,
+    def _policy_value(self, obs, params=None):
+        logits, value = self._heads(self.params if params is None else params,
                                     self._jnp.asarray(obs[None]))
         logits = np.asarray(logits)[0]
         e = np.exp(logits - logits.max())
@@ -164,3 +171,96 @@ class A2CDiscreteDense:
                 self._jnp.asarray(np.asarray(buf_act, np.int32)),
                 self._jnp.asarray(returns))
         return episode_rewards
+
+
+class A3CDiscreteDense(A2CDiscreteDense):
+    """Asynchronous advantage actor-critic — the reference's actual A3C
+    (ref: ``rl4j.learning.async.a3c.discrete.A3CDiscreteDense`` +
+    ``AsyncGlobal``/``AsyncThread``): ``num_threads`` workers roll out
+    n-step trajectories against PRIVATE MDP instances with a snapshot of the
+    shared params, compute gradients through the shared jitted grad program
+    (jax dispatch releases the GIL, so workers overlap for real), and apply
+    them to the global params under a mutex — the reference's lock-free
+    Hogwild accumulator narrowed to update-granularity locking, preserving
+    the bounded-staleness semantics."""
+
+    def __init__(self, mdp: MDP, conf: A2CConfiguration,
+                 hidden: List[int] = (64,), num_threads: int = 2):
+        super().__init__(mdp, conf, hidden)
+        import jax
+
+        self.num_threads = num_threads
+        # grad-only program: workers grad on their snapshot; the global
+        # apply happens under the lock
+        self._grad_fn = jax.jit(jax.value_and_grad(self._loss_ref))
+
+    def train(self) -> List[float]:
+        import threading
+
+        import numpy as np
+
+        conf = self.conf
+        lock = threading.Lock()
+        episode_rewards: List[float] = []
+        step_counter = [0]
+
+        def worker(wid: int):
+            import jax.numpy as jnp
+            rng = np.random.RandomState(conf.seed + 1000 * wid)
+            mdp = self.mdp.new_instance()
+            obs = mdp.reset()
+            ep_reward, ep_steps = 0.0, 0
+            while True:
+                with lock:
+                    if step_counter[0] >= conf.max_step:
+                        return
+                    snapshot = self.params        # param snapshot (staleness
+                    #                               bounded by one rollout)
+                buf_obs, buf_act, buf_rew, buf_done = [], [], [], []
+                boot_obs = None
+                for _ in range(conf.n_step):
+                    probs, _ = self._policy_value(np.asarray(obs, np.float32), params=snapshot)
+                    action = int(rng.choice(self.n_actions, p=probs))
+                    reply = mdp.step(action)
+                    buf_obs.append(np.asarray(obs, np.float32))
+                    buf_act.append(action)
+                    buf_rew.append(reply.reward)
+                    buf_done.append(reply.done)
+                    obs = reply.observation
+                    ep_reward += reply.reward
+                    ep_steps += 1
+                    with lock:
+                        step_counter[0] += 1
+                    if reply.done or ep_steps >= conf.max_epoch_step:
+                        boot_obs = reply.observation
+                        with lock:
+                            episode_rewards.append(ep_reward)
+                        obs = mdp.reset()
+                        ep_reward, ep_steps = 0.0, 0
+                        break
+                if buf_done[-1]:
+                    R = 0.0
+                else:
+                    src = boot_obs if boot_obs is not None else obs
+                    _, R = self._policy_value(np.asarray(src, np.float32), params=snapshot)
+                returns = np.zeros(len(buf_rew), dtype=np.float32)
+                for i in reversed(range(len(buf_rew))):
+                    R = buf_rew[i] + conf.gamma * R * (1.0 - float(buf_done[i]))
+                    returns[i] = R
+                _, grads = self._grad_fn(snapshot,
+                                         jnp.asarray(np.stack(buf_obs)),
+                                         jnp.asarray(np.asarray(buf_act, np.int32)),
+                                         jnp.asarray(returns))
+                with lock:   # apply to the GLOBAL params (ref: AsyncGlobal)
+                    self.params, self._opt_state = self._apply(
+                        grads, self._opt_state, self.params)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(self.num_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return episode_rewards
+
+

@@ -1,8 +1,9 @@
 """Training UI + stats pipeline (ref: deeplearning4j-ui — SURVEY D16/5.5)."""
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.storage import (FileStatsStorage,
-                                           InMemoryStatsStorage)
+                                           InMemoryStatsStorage,
+                                           RemoteUIStatsStorageRouter)
 from deeplearning4j_tpu.ui.server import UIServer
 
 __all__ = ["StatsListener", "InMemoryStatsStorage", "FileStatsStorage",
-           "UIServer"]
+           "UIServer", "RemoteUIStatsStorageRouter"]

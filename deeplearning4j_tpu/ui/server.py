@@ -70,6 +70,7 @@ class UIServer:
         self._storages: List = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._receiver = None     # lazily created for remote-router POSTs
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -123,6 +124,33 @@ class UIServer:
                          f"<td>{s.get('stdev', 0):.3e}</td>"
                          f"<td>{ratio:.3e}</td>"
                          f"<td>{p_hist}</td><td>{u_hist}</td></tr>")
+        model_svg = ""
+        info = next((u["modelInfo"] for u in ups if "modelInfo" in u), None)
+        if info and "layers" in info:
+            boxes = ""
+            bw, bh, gap = 200, 34, 14
+            for i, l in enumerate(info["layers"]):
+                y = 8 + i * (bh + gap)
+                label = f'{l["index"]}: {l["type"]} ({l["nParams"]:,})'
+                boxes += (
+                    f'<rect x="8" y="{y}" width="{bw}" height="{bh}" '
+                    f'fill="#e8f0fe" stroke="#1f77b4"/>'
+                    f'<text x="{8 + bw / 2}" y="{y + bh / 2 + 4}" '
+                    f'font-size="11" text-anchor="middle">'
+                    f'{_html.escape(label)}</text>')
+                if i:
+                    boxes += (f'<line x1="{8 + bw / 2}" y1="{y - gap}" '
+                              f'x2="{8 + bw / 2}" y2="{y}" stroke="#555" '
+                              f'marker-end="url(#arr)"/>')
+            h_total = 16 + len(info["layers"]) * (bh + gap)
+            model_svg = (
+                f'<h3>Model graph</h3>'
+                f'<svg width="{bw + 16}" height="{h_total}" '
+                f'xmlns="http://www.w3.org/2000/svg">'
+                f'<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+                f'refX="6" refY="3" orient="auto">'
+                f'<path d="M0,0 L6,3 L0,6 z" fill="#555"/></marker></defs>'
+                f'{boxes}</svg>')
         act_rows = ""
         if ups and "activations" in ups[-1]:
             for name, s in ups[-1]["activations"].items():
@@ -152,6 +180,7 @@ class UIServer:
                "<table border=1 cellpadding=4><tr><th>layer</th>"
                "<th>mean</th><th>stdev</th><th>histogram</th>"
                f"</tr>{act_rows}</table>" if act_rows else "")
+            + model_svg
             + "</body></html>")
 
     # --------------------------------------------------------------- serve
@@ -161,6 +190,26 @@ class UIServer:
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
+
+            def do_POST(self):
+                """Receiving side of RemoteUIStatsStorageRouter (ref: the
+                Vert.x app's remote-stats endpoint)."""
+                parsed = urlparse(self.path)
+                if parsed.path != "/train/update":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                record = json.loads(self.rfile.read(n) or b"{}")
+                sid = record.pop("sessionId", "remote")
+                if ui._receiver is None:
+                    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+                    ui._receiver = InMemoryStatsStorage()
+                    ui.attach(ui._receiver)
+                ui._receiver.put_update(sid, record)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def do_GET(self):
                 parsed = urlparse(self.path)

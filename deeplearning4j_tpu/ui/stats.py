@@ -49,6 +49,26 @@ class StatsListener(TrainingListener):
         self._pushed_activations: Optional[dict] = None
         self._t0 = time.time()
 
+    def _model_info(self, model):
+        """One-time architecture snapshot (the reference UI's model-graph
+        tab data): layer index/type/params for MLN, node topology for CG."""
+        info = {}
+        if hasattr(model, "layers") and isinstance(model.layers, list):
+            info["layers"] = [
+                {"index": i, "type": type(l).__name__,
+                 "name": getattr(l, "name", None),
+                 "nParams": int(l.n_params())}
+                for i, l in enumerate(model.layers)]
+        nodes = getattr(getattr(model, "conf", None), "nodes", None)
+        if isinstance(nodes, dict):
+            info["vertices"] = [
+                {"name": name,
+                 "type": type(nd.layer or nd.vertex).__name__
+                 if (nd.layer or getattr(nd, "vertex", None)) else "input",
+                 "inputs": list(nd.inputs)}
+                for name, nd in nodes.items()]
+        return info or None
+
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.update_frequency:
             return
@@ -59,6 +79,11 @@ class StatsListener(TrainingListener):
             "timestamp": time.time(),
             "wallSeconds": time.time() - self._t0,
         }
+        if not getattr(self, "_sent_model_info", False):
+            info = self._model_info(model)
+            if info:
+                record["modelInfo"] = info
+            self._sent_model_info = True
         if self.collect_histograms and hasattr(model, "paramTable"):
             params = {}
             layers = {}

@@ -99,3 +99,45 @@ class FileStatsStorage(BaseStatsStorage):
                     if line.strip():
                         out.append(json.loads(line))
             return out
+
+
+class RemoteUIStatsStorageRouter(BaseStatsStorage):
+    """Posts stats records over HTTP to a DETACHED UI server (ref:
+    ``org.deeplearning4j.api.storage.impl.RemoteUIStatsStorageRouter`` —
+    training runs in one process, the UI in another).
+
+    Write-only from this side: ``put_update`` POSTs JSON to
+    ``<address>/train/update``; reads return what was sent this session
+    (the reference router is likewise fire-and-forget). Failures are counted,
+    retried up to ``max_retries``, and never break training."""
+
+    #: local echo kept only for debugging reads; bounded so a long run
+    #: doesn't accumulate every histogram-laden record in the trainer
+    MAX_LOCAL_RECORDS = 256
+
+    def __init__(self, address: str, max_retries: int = 3):
+        super().__init__()
+        self.address = address.rstrip("/")
+        self.max_retries = max_retries
+        self.failures = 0
+        self._sent: List[dict] = []
+
+    def _store(self, record: dict):
+        import urllib.request
+
+        self._sent.append(record)
+        if len(self._sent) > self.MAX_LOCAL_RECORDS:
+            del self._sent[: -self.MAX_LOCAL_RECORDS]
+        body = json.dumps(record).encode()
+        req = urllib.request.Request(
+            self.address + "/train/update", data=body,
+            headers={"Content-Type": "application/json"})
+        for _ in range(self.max_retries):
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                return
+            except Exception:
+                self.failures += 1
+
+    def _all(self):
+        return list(self._sent)

@@ -1,0 +1,59 @@
+"""Jit-safe runtime checks — the sanitizer story (SURVEY §5.2).
+
+The reference's correctness tooling is workspace debug modes (use-after-scope
+detection) plus OpProfiler NAN_PANIC/INF_PANIC. Under jit, purity removes the
+workspace class of bugs; what remains is (a) non-finite values — covered
+eagerly by ``profiler.OpProfiler`` panic modes and globally by
+``debug_nans`` — and (b) data-dependent invariants inside compiled programs,
+which ``jax.experimental.checkify`` functionalizes. This module packages
+both behind one surface.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+
+
+def set_debug_nans(enabled: bool = True):
+    """Global NaN tripwire inside jitted programs (ref: OpProfiler NAN_PANIC
+    applied at whole-program scope): recompiles with per-primitive checks."""
+    jax.config.update("jax_debug_nans", bool(enabled))
+
+
+def checked(fn: Callable, *, nan: bool = True, div: bool = False,
+            oob: bool = False) -> Callable:
+    """Wrap a jit-friendly function so float/index errors surface as Python
+    exceptions AFTER the compiled call (checkify functionalization):
+
+        step = checked(train_step)
+        out = step(params, batch)     # raises on NaN produced inside jit
+
+    User asserts inside ``fn`` via ``deeplearning4j_tpu.utils.sanitize.check``
+    participate too."""
+    from jax.experimental import checkify
+
+    sets = checkify.user_checks
+    if nan:
+        sets = sets | checkify.float_checks
+    if div:
+        sets = sets | checkify.div_checks
+    if oob:
+        sets = sets | checkify.index_checks
+    cfn = checkify.checkify(fn, errors=sets)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = cfn(*args, **kwargs)
+        err.throw()
+        return out
+
+    return wrapper
+
+
+def check(pred, msg: str, **fmt):
+    """Data-dependent assert usable INSIDE jitted code (ref analog: the
+    workspace debug scopes' invariant checks; functionalized by checkify)."""
+    from jax.experimental import checkify
+    checkify.check(pred, msg, **fmt)

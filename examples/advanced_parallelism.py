@@ -1,0 +1,67 @@
+"""Net-new TPU parallelism beyond the reference: pipeline (GPipe) and
+expert (Switch-MoE) parallelism, plus ring attention for long sequences.
+
+Run on a virtual mesh:
+  python examples/advanced_parallelism.py
+(on a real TPU slice the same code shards over the physical chips)
+"""
+import os
+
+import jax
+
+# default to a virtual 8-device CPU mesh; export DL4J_TPU_EXAMPLES_TPU=1 on
+# a real slice. (Don't probe jax.default_backend() here — that would
+# initialize the backend before the config can be changed.)
+if not os.environ.get("DL4J_TPU_EXAMPLES_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import (EXPERT_AXIS, SEQ_AXIS,
+                                              STAGE_AXIS, MeshSpec)
+from deeplearning4j_tpu.parallel.moe import (MoEConfig, init_moe_params,
+                                             moe_ffn, moe_param_shardings)
+from deeplearning4j_tpu.parallel.pipeline import (gpipe, shard_stage_params,
+                                                  stack_stage_params)
+from deeplearning4j_tpu.parallel.ring import ring_attention
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # ---- pipeline parallelism: 4-stage GPipe over micro-batches
+    S, d = 4, 32
+    pp_mesh = MeshSpec({STAGE_AXIS: S}).build(jax.devices()[:S])
+    stages = [{"W": jnp.asarray(rng.normal(size=(d, d)) * 0.2, jnp.float32),
+               "b": jnp.zeros((d,), jnp.float32)} for _ in range(S)]
+    stacked = shard_stage_params(stack_stage_params(stages), pp_mesh)
+    run = gpipe(lambda p, h: jnp.tanh(h @ p["W"] + p["b"]), pp_mesh)
+    x = jnp.asarray(rng.normal(size=(8, 4, d)), jnp.float32)  # 8 micro-batches
+    y = jax.jit(run)(stacked, x)
+    print(f"pipeline: {S} stages x 8 micro-batches -> {y.shape}, "
+          f"bubble = {(S - 1) / (8 + S - 1):.0%}")
+
+    # ---- expert parallelism: Switch-MoE with a sharded expert axis
+    E = 4
+    ep_mesh = MeshSpec({EXPERT_AXIS: E}).build(jax.devices()[:E])
+    cfg = MoEConfig(d_model=d, d_ff=4 * d, num_experts=E)
+    params = jax.device_put(init_moe_params(cfg, jax.random.key(0)),
+                            moe_param_shardings(cfg, ep_mesh))
+    xm = jnp.asarray(rng.normal(size=(4, 16, d)), jnp.float32)
+    ym, aux = jax.jit(lambda p, x: moe_ffn(p, x, cfg, ep_mesh))(params, xm)
+    print(f"moe: routed {xm.shape[0] * xm.shape[1]} tokens over {E} experts, "
+          f"dropped {float(aux['dropped_fraction']):.1%}, "
+          f"aux loss {float(aux['aux_loss']):.3f}")
+
+    # ---- sequence parallelism: ring attention over the seq axis
+    sp_mesh = MeshSpec({SEQ_AXIS: 8}).build(jax.devices()[:8])
+    q = jnp.asarray(rng.normal(size=(1, 256, 4, 16)), jnp.float32)
+    out = jax.jit(lambda q: ring_attention(q, q, q, sp_mesh, causal=True))(q)
+    print(f"ring attention: seq 256 sharded over 8 devices -> {out.shape}, "
+          f"per-chip score block = 32x32 instead of 256x256")
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,8 @@ SPECS = {
     "Convolution3D": (lambda: L.Convolution3D(
         kernel_size=(2, 2, 2), n_in=2, n_out=2), _x((2, 3, 3, 3, 2)), {}),
     "CnnLossLayer": (lambda: L.CnnLossLayer(), _x((2, 3, 3, 2)), {}),
+    "LayerNormalization": (lambda: L.LayerNormalization(n_out=4),
+                           _x((3, 4)), {}),
 }
 
 

@@ -159,3 +159,102 @@ def test_h5_nested_submodel_weights_do_not_collide(tmp_path):
             w.get("wrapper")
         # full paths remain addressable
         assert np.allclose(w.by_layer["wrapper"]["dense_b/kernel"], 7.0)
+
+
+def test_sequential_conv1d_causal(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((10, 3)),
+        tf.keras.layers.Conv1D(6, 3, padding="causal", activation="relu"),
+        tf.keras.layers.Conv1D(4, 3, padding="same"),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(2, activation="softmax"),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(0).rand(4, 10, 3).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_sequential_conv3d(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((4, 6, 6, 2)),
+        tf.keras.layers.Conv3D(3, 2, activation="relu", padding="same"),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(2),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(1).rand(2, 4, 6, 6, 2).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_sequential_layernorm_and_activation_layers(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((8,)),
+        tf.keras.layers.Dense(16),
+        tf.keras.layers.LayerNormalization(),
+        tf.keras.layers.LeakyReLU(),
+        tf.keras.layers.Dense(4),
+        tf.keras.layers.Softmax(),
+    ])
+    # make layernorm params non-trivial
+    m.layers[1].set_weights([np.random.RandomState(2).rand(16).astype("f4"),
+                             np.random.RandomState(3).rand(16).astype("f4")])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(4).rand(5, 8).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_sequential_timedistributed_dense(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((6, 4)),
+        tf.keras.layers.TimeDistributed(tf.keras.layers.Dense(
+            5, activation="tanh")),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(5).rand(3, 6, 4).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_sequential_bidirectional_lstm(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((6, 4)),
+        tf.keras.layers.Bidirectional(
+            tf.keras.layers.LSTM(5, return_sequences=True)),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(7).rand(4, 6, 4).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_sequential_relu6_layer(tmp_path):
+    m = tf.keras.Sequential([
+        tf.keras.Input((5,)),
+        tf.keras.layers.Dense(8),
+        tf.keras.layers.ReLU(max_value=6.0),
+        tf.keras.layers.Dense(2),
+    ])
+    m.layers[0].set_weights([
+        np.random.RandomState(8).rand(5, 8).astype("f4") * 4,
+        np.zeros(8, "f4")])
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = np.random.RandomState(9).rand(6, 5).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-5)

@@ -204,3 +204,72 @@ class TestGraphConfValidation:
              .add_layer("a", DenseLayer(n_in=4, n_out=4), "nonexistent")
              .set_outputs("a")
              .build())
+
+
+class TestExplicitPreprocessors:
+    """Explicit InputPreProcessor API (ref: conf.preprocessor.* +
+    ListBuilder#inputPreProcessor — SURVEY D1/D2)."""
+
+    def test_ff_to_cnn_and_back(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(ConvolutionLayer(kernel_size=3, n_in=1, n_out=4,
+                                        padding="same", activation="relu"))
+                .layer(DenseLayer(n_in=6 * 6 * 4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .input_pre_processor(0, FeedForwardToCnnPreProcessor(6, 6, 1))
+                .input_pre_processor(1, CnnToFeedForwardPreProcessor())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 36)).astype(np.float32)  # flat rows
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net.fit(x, y)
+        s0 = net.score()
+        for _ in range(10):
+            net.fit(x, y)
+        assert net.score() < s0
+        assert np.asarray(net.output(x)).shape == (8, 3)
+
+    def test_rnn_ff_round_trip_preprocessors(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).updater(Adam(1e-2)).list()
+                .layer(LSTM(n_in=4, n_out=6, activation="tanh"))
+                .layer(DenseLayer(n_in=6, n_out=5, activation="relu"))
+                .layer(RnnOutputLayer(n_in=5, n_out=2, activation="softmax",
+                                      loss_function="mcxent"))
+                .input_pre_processor(1, RnnToFeedForwardPreProcessor())
+                .input_pre_processor(2, FeedForwardToRnnPreProcessor())
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 7, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 7))]
+        net.fit(x, y)
+        assert np.isfinite(net.score())
+        assert np.asarray(net.output(x)).shape == (4, 7, 2)
+
+    def test_preprocessors_json_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            FeedForwardToCnnPreProcessor, preprocessor_from_dict)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Adam(1e-3)).list()
+                .layer(ConvolutionLayer(kernel_size=3, n_in=1, n_out=2,
+                                        padding="same"))
+                .layer(OutputLayer(n_in=2 * 4 * 4, n_out=2,
+                                   activation="softmax",
+                                   loss_function="mcxent"))
+                .input_pre_processor(0, FeedForwardToCnnPreProcessor(4, 4, 1))
+                .build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        p = conf2.input_pre_processors[0]
+        assert isinstance(p, FeedForwardToCnnPreProcessor)
+        assert p.input_height == 4
+        net = MultiLayerNetwork(conf2).init()
+        out = net.output(np.zeros((2, 16), np.float32))
+        assert np.asarray(out).shape == (2, 2)

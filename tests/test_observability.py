@@ -247,3 +247,104 @@ def test_stats_listener_activation_histograms():
         assert html.count("<svg") > 3     # score chart + histograms
     finally:
         server.stop()
+
+
+def test_stats_listener_model_info_and_graph_svg():
+    """Model-graph view (reference UI's architecture tab): the first stats
+    record carries modelInfo and the server renders a layer-chain SVG."""
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       UIServer)
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.setListeners(StatsListener(storage, session_id="mg"))
+    net.fit(_data(), epochs=2)
+    ups = storage.get_all_updates("mg")
+    assert "modelInfo" in ups[0] and "modelInfo" not in ups[1]
+    layers = ups[0]["modelInfo"]["layers"]
+    assert layers[0]["type"] == "DenseLayer" and layers[0]["nParams"] > 0
+
+    server = UIServer(port=0).start()
+    try:
+        server.attach(storage)
+        html = urllib.request.urlopen(
+            server.get_address() + "/?sid=mg", timeout=5).read().decode()
+        assert "Model graph" in html and "DenseLayer" in html
+    finally:
+        server.stop()
+
+
+def test_sanitize_checked_catches_nan_and_user_checks():
+    """checkify sanitizer (SURVEY 5.2): float errors and data-dependent
+    asserts inside jitted code surface as Python exceptions."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.utils import sanitize
+
+    @jax.jit
+    def bad(x):
+        return jnp.log(x)          # NaN for negative input
+
+    wrapped = sanitize.checked(bad)
+    wrapped(jnp.asarray([1.0, 2.0]))      # fine
+    import pytest
+    with pytest.raises(Exception, match="nan"):
+        wrapped(jnp.asarray([-1.0]))
+
+    def guarded(x):
+        sanitize.check(jnp.all(x > 0), "input must be positive")
+        return jnp.sqrt(x)
+
+    g = sanitize.checked(jax.jit(guarded), nan=False)
+    g(jnp.asarray([4.0]))
+    with pytest.raises(Exception, match="positive"):
+        g(jnp.asarray([-4.0]))
+
+
+def test_remote_ui_stats_router_round_trip():
+    """Detached-UI flow (ref: RemoteUIStatsStorageRouter → remote Vert.x
+    endpoint): a training process posts stats over HTTP; the standalone UI
+    server receives, stores, and renders them."""
+    from deeplearning4j_tpu.ui import RemoteUIStatsStorageRouter, UIServer
+
+    server = UIServer(port=0).start()
+    try:
+        router = RemoteUIStatsStorageRouter(server.get_address())
+        net = _net()
+        net.setListeners(__import__(
+            "deeplearning4j_tpu.ui", fromlist=["StatsListener"]
+        ).StatsListener(router, session_id="remote-sess"))
+        net.fit(_data(), epochs=2)
+        assert router.failures == 0
+        sessions = json.loads(urllib.request.urlopen(
+            server.get_address() + "/train/sessions", timeout=5).read())
+        assert "remote-sess" in sessions
+        ups = json.loads(urllib.request.urlopen(
+            server.get_address() + "/train/updates?sid=remote-sess",
+            timeout=5).read())
+        assert len(ups) == 2 and all("score" in u for u in ups)
+        html = urllib.request.urlopen(
+            server.get_address() + "/?sid=remote-sess",
+            timeout=5).read().decode()
+        assert "remote-sess" in html
+    finally:
+        server.stop()
+
+
+def test_parallel_transform_executor_matches_local():
+    """Partitioned ETL (ref: SparkTransformExecutor — SURVEY E3): forked
+    partitions produce exactly the local executor's output."""
+    from deeplearning4j_tpu.datavec import (IntWritable, LocalTransformExecutor,
+                                            Schema, Text, TransformProcess)
+    from deeplearning4j_tpu.datavec.distributed import ParallelTransformExecutor
+    from deeplearning4j_tpu.datavec.schema import ColumnMetaData, ColumnType
+
+    schema = Schema([ColumnMetaData("a", ColumnType.Integer),
+                     ColumnMetaData("tag", ColumnType.String)])
+    tp = (TransformProcess.Builder(schema)
+          .remove_columns("tag")
+          .build())
+    rows = [[IntWritable(i), Text(f"t{i}")] for i in range(37)]
+    local = LocalTransformExecutor.execute(rows, tp)
+    dist = ParallelTransformExecutor.execute(rows, tp, num_partitions=4)
+    assert dist == local and len(dist) == 37

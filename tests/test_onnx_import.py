@@ -187,3 +187,113 @@ def test_imported_model_finetunes_when_trainable():
     out = np.asarray(sd.output({"x": X}, "probs")["probs"])
     acc = (np.argmax(out, 1) == (X.sum(1) > 0)).mean()
     assert acc >= 0.8, acc
+
+
+class TestOpsetLongTail:
+    """New rule groups: normalization, resize, topk, scatter/gather-nd,
+    variadic, cumsum — each checked numerically against torch."""
+
+    def _run(self, model, feeds):
+        sd = OnnxGraphMapper.import_model(model)
+        out = sd.output(feeds)
+        return out
+
+    def test_instance_normalization(self):
+        r = R(2)
+        x = r.randn(2, 3, 4, 4).astype(F32)
+        scale = r.rand(3).astype(F32) + 0.5
+        bias = r.randn(3).astype(F32)
+        g = P.make_graph(
+            [P.make_node("InstanceNormalization", ["x", "s", "b"], ["y"],
+                         epsilon=1e-5)],
+            "in", inputs=[P.make_value_info("x", F32, (2, 3, 4, 4))],
+            outputs=[P.make_value_info("y", F32, (2, 3, 4, 4))],
+            initializers=[P.make_tensor("s", scale), P.make_tensor("b", bias)])
+        out = self._run(P.make_model(g), {"x": x})["y"]
+        expect = torch.nn.functional.instance_norm(
+            torch.from_numpy(x), weight=torch.from_numpy(scale),
+            bias=torch.from_numpy(bias)).numpy()
+        np.testing.assert_allclose(np.asarray(out), expect, atol=1e-4)
+
+    def test_layer_normalization(self):
+        r = R(3)
+        x = r.randn(2, 5, 8).astype(F32)
+        scale = r.rand(8).astype(F32) + 0.5
+        bias = r.randn(8).astype(F32)
+        g = P.make_graph(
+            [P.make_node("LayerNormalization", ["x", "s", "b"], ["y"],
+                         axis=-1, epsilon=1e-5)],
+            "ln", inputs=[P.make_value_info("x", F32, (2, 5, 8))],
+            outputs=[P.make_value_info("y", F32, (2, 5, 8))],
+            initializers=[P.make_tensor("s", scale), P.make_tensor("b", bias)])
+        out = self._run(P.make_model(g), {"x": x})["y"]
+        expect = torch.nn.functional.layer_norm(
+            torch.from_numpy(x), (8,), torch.from_numpy(scale),
+            torch.from_numpy(bias)).numpy()
+        np.testing.assert_allclose(np.asarray(out), expect, atol=1e-4)
+
+    def test_resize_nearest_sizes(self):
+        x = np.arange(16, dtype=F32).reshape(1, 1, 4, 4)
+        g = P.make_graph(
+            [P.make_node("Resize", ["x", "", "", "sizes"], ["y"],
+                         mode="nearest")],
+            "rs", inputs=[P.make_value_info("x", F32, (1, 1, 4, 4))],
+            outputs=[P.make_value_info("y", F32, (1, 1, 8, 8))],
+            initializers=[P.make_tensor(
+                "sizes", np.asarray([1, 1, 8, 8], np.int64))])
+        out = self._run(P.make_model(g), {"x": x})["y"]
+        expect = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(8, 8), mode="nearest").numpy()
+        np.testing.assert_allclose(np.asarray(out), expect, atol=1e-5)
+
+    def test_topk_and_cumsum(self):
+        x = np.asarray([[3.0, 1.0, 4.0, 1.5]], F32)
+        g = P.make_graph(
+            [P.make_node("TopK", ["x", "k"], ["vals", "idx"]),
+             P.make_node("CumSum", ["x", "ax"], ["cs"])],
+            "tk", inputs=[P.make_value_info("x", F32, (1, 4))],
+            outputs=[P.make_value_info("vals", F32, (1, 2)),
+                     P.make_value_info("idx", np.int64, (1, 2)),
+                     P.make_value_info("cs", F32, (1, 4))],
+            initializers=[P.make_tensor("k", np.asarray(2, np.int64)),
+                          P.make_tensor("ax", np.asarray(1, np.int64))])
+        out = self._run(P.make_model(g), {"x": x})
+        np.testing.assert_allclose(np.asarray(out["vals"]), [[4.0, 3.0]])
+        np.testing.assert_allclose(np.asarray(out["cs"]),
+                                   [[3.0, 4.0, 8.0, 9.5]])
+
+    def test_gather_scatter_nd_variadic_sum(self):
+        data = np.arange(6, dtype=F32).reshape(3, 2)
+        idx = np.asarray([[0], [2]], np.int64)
+        upd = np.asarray([[9.0, 9.0]], F32)
+        uidx = np.asarray([[1]], np.int64)
+        g = P.make_graph(
+            [P.make_node("GatherND", ["d", "i"], ["g"]),
+             P.make_node("ScatterND", ["d", "ui", "u"], ["s"]),
+             P.make_node("Sum", ["d", "d", "d"], ["tri"])],
+            "gs", inputs=[P.make_value_info("d", F32, (3, 2))],
+            outputs=[P.make_value_info("g", F32, (2, 2)),
+                     P.make_value_info("s", F32, (3, 2)),
+                     P.make_value_info("tri", F32, (3, 2))],
+            initializers=[P.make_tensor("i", idx), P.make_tensor("ui", uidx),
+                          P.make_tensor("u", upd)])
+        out = self._run(P.make_model(g), {"d": data})
+        np.testing.assert_allclose(np.asarray(out["g"]),
+                                   [[0, 1], [4, 5]])
+        np.testing.assert_allclose(np.asarray(out["s"]),
+                                   [[0, 1], [9, 9], [4, 5]])
+        np.testing.assert_allclose(np.asarray(out["tri"]), data * 3)
+
+    def test_reduce_l2_and_hard_sigmoid(self):
+        x = np.asarray([[3.0, 4.0], [-6.0, 8.0]], F32)
+        g = P.make_graph(
+            [P.make_node("ReduceL2", ["x"], ["l2"], axes=[1], keepdims=0),
+             P.make_node("HardSigmoid", ["x"], ["hs"], alpha=0.2, beta=0.5)],
+            "r", inputs=[P.make_value_info("x", F32, (2, 2))],
+            outputs=[P.make_value_info("l2", F32, (2,)),
+                     P.make_value_info("hs", F32, (2, 2))])
+        out = self._run(P.make_model(g), {"x": x})
+        np.testing.assert_allclose(np.asarray(out["l2"]), [5.0, 10.0],
+                                   rtol=1e-6)
+        expect = np.clip(0.2 * x + 0.5, 0, 1)
+        np.testing.assert_allclose(np.asarray(out["hs"]), expect, rtol=1e-6)

@@ -173,3 +173,21 @@ def test_grid_search_enumerates():
         train_data=_toy_iter(0), test_data=_toy_iter(1), epochs=25)
     best = LocalOptimizationRunner(conf).execute()
     assert best.score > 0.8
+
+
+def test_a3c_async_workers_learn_gridworld():
+    """True async A3C (ref: A3CDiscreteDense + AsyncGlobal/AsyncThread):
+    multiple worker threads against private MDPs, shared params updated
+    under a mutex — final greedy policy beats a random one."""
+    from deeplearning4j_tpu.rl import A2CConfiguration, A3CDiscreteDense, GridWorld
+
+    conf = A2CConfiguration(seed=7, max_step=6000, n_step=8,
+                            learning_rate=5e-3, max_epoch_step=60)
+    learner = A3CDiscreteDense(GridWorld(5), conf, hidden=[32],
+                               num_threads=3)
+    rewards = learner.train()
+    assert len(rewards) > 10
+    final = learner.play(max_steps=100)
+    # a random walk on the corridor pays -0.01 per step; the learned
+    # policy walks straight to the +1 goal
+    assert final > 0.0, final

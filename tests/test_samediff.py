@@ -317,3 +317,29 @@ class TestControlFlow:
         i0 = sd.constant(np.int32(9), name="i0")
         with pytest.raises(ValueError, match="preserve"):
             sd.while_loop(lambda s, i: i > 0, lambda s, i: i / 2.0, i0)
+
+
+class TestBitwiseAndImageNamespaces:
+    """SDBitwise / SDImage namespace parity (ref: nd4j SDBitwise, SDImage)."""
+
+    def test_bitwise_ops(self):
+        sd = SameDiff.create()
+        a = sd.constant(np.array([0b1100], np.int32), name="a")
+        b = sd.constant(np.array([0b1010], np.int32), name="b")
+        sd.bitwise.and_(a, b).rename("and")
+        sd.bitwise.xor(a, b).rename("xor")
+        sd.bitwise.left_shift(a, 1).rename("shl")
+        out = sd.output({}, ["and", "xor", "shl"])
+        assert int(out["and"][0]) == 0b1000
+        assert int(out["xor"][0]) == 0b0110
+        assert int(out["shl"][0]) == 0b11000
+
+    def test_image_ops(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (1, 4, 4, 3))
+        sd.image.resize_bilinear(x, 2, 2).rename("small")
+        sd.image.rgb_to_hsv(x).rename("hsv")
+        img = np.random.default_rng(0).random((1, 4, 4, 3)).astype(np.float32)
+        out = sd.output({"x": img}, ["small", "hsv"])
+        assert out["small"].shape == (1, 2, 2, 3)
+        assert out["hsv"].shape == (1, 4, 4, 3)

@@ -93,6 +93,8 @@ CORPUS = {
     "LogSoftmax": (lambda x: tf.nn.log_softmax(x), {"x": x34}),
     "Mean": (lambda x: tf.reduce_mean(x, axis=1, keepdims=True), {"x": x34}),
     "Sum": (lambda x: tf.reduce_sum(x, axis=[0, 1]), {"x": x34}),
+    "All": (lambda x: tf.reduce_all(x > 0, axis=1), {"x": x34}),
+    "Any": (lambda x: tf.reduce_any(x > 0.5, axis=1), {"x": x34}),
     "Max": (lambda x: tf.reduce_max(x, axis=0), {"x": x34}),
     "Min": (lambda x: tf.reduce_min(x, axis=1), {"x": x34}),
     "Prod": (lambda x: tf.reduce_prod(x, axis=1), {"x": x34}),

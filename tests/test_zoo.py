@@ -229,3 +229,21 @@ def test_nasnet_forward_and_train_step():
     assert out.shape == (2, 3)
     net.fit(x, y)
     assert np.isfinite(net.score())
+
+
+def test_zoo_pretrained_cache_round_trip(tmp_path, monkeypatch):
+    """Pretrained-weight story (D11): train → save_pretrained into the local
+    cache → init_pretrained restores the trained net with matching outputs."""
+    monkeypatch.setenv("DL4J_TPU_ZOO_CACHE", str(tmp_path))
+    m = zoo.LeNet()
+    net = m.init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 784).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.randint(0, 10, 16)]
+    net.fit(x, y)
+    path = m.save_pretrained(net, zoo.PretrainedType.MNIST)
+    assert m.pretrained_available(zoo.PretrainedType.MNIST)
+
+    restored = zoo.LeNet().init_pretrained(zoo.PretrainedType.MNIST)
+    np.testing.assert_allclose(np.asarray(restored.output(x[:4])),
+                               np.asarray(net.output(x[:4])), atol=1e-6)
