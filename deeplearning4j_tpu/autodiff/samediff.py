@@ -1028,6 +1028,37 @@ class SameDiff:
             self._pending_opt_leaves = None
         return jitted, init_state
 
+    def evaluate(self, iterator, output_name: str, evaluation=None,
+                 label_index: int = 0):
+        """Evaluate a graph output against iterator labels (ref:
+        ``SameDiff#evaluate(DataSetIterator, String, IEvaluation...)``).
+        Placeholder binding follows TrainingConfig's dataSetFeatureMapping,
+        as in the reference; ``evaluation`` defaults to classification
+        ``Evaluation``."""
+        from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+        from deeplearning4j_tpu.eval.classification import Evaluation
+
+        if self.training_config is None:
+            raise ValueError("call set_training_config first (the feature "
+                             "mapping binds iterator columns to placeholders)")
+        ev = evaluation if evaluation is not None else Evaluation()
+        tc = self.training_config
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        data = iterator
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = [data]
+        for ds in data:
+            feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                else [ds.features]
+            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                else [ds.labels]
+            ph = {name: jnp.asarray(arr) for name, arr in
+                  zip(tc.data_set_feature_mapping, feats)}
+            out = self.output(ph, [output_name])[output_name]
+            ev.eval(labs[label_index], np.asarray(out))
+        return ev
+
     def fit(self, data=None, epochs: int = 1, batch_size: int = None,
             rng_seed: int = 0):
         """Train (ref: ``SameDiff#fit``). ``data`` is a DataSet/

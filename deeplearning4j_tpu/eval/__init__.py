@@ -1,7 +1,8 @@
 """Evaluation suites (ref: org.nd4j.evaluation.*)."""
 from deeplearning4j_tpu.eval.classification import (
-    Evaluation, EvaluationBinary, EvaluationCalibration, ROC, ROCMultiClass)
+    Evaluation, EvaluationBinary, EvaluationCalibration, ROC, ROCBinary,
+    ROCMultiClass)
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation
 
 __all__ = ["Evaluation", "EvaluationBinary", "EvaluationCalibration", "ROC",
-           "ROCMultiClass", "RegressionEvaluation"]
+           "ROCBinary", "ROCMultiClass", "RegressionEvaluation"]

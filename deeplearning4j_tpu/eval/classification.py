@@ -191,6 +191,27 @@ class ROCMultiClass:
     calculateAverageAUC = average_auc
 
 
+class ROCBinary(ROCMultiClass):
+    """Per-output binary ROC for multi-label sigmoid outputs (ref:
+    org.nd4j.evaluation.classification.ROCBinary). Same per-column
+    accumulation as ROCMultiClass (one-vs-all ≡ independent binary outputs);
+    adds 1-D promotion, num_labels and per-output AUPRC."""
+
+    def eval(self, labels, predictions):
+        y, p = _np(labels), _np(predictions)
+        if y.ndim == 1:
+            y, p = y[:, None], p[:, None]
+        return super().eval(y, p)
+
+    def num_labels(self) -> int:
+        return len(self._rocs)
+
+    def calculate_auprc(self, output: int) -> float:
+        return self._rocs[output].calculate_auprc()
+
+    calculateAUCPR = calculate_auprc
+
+
 class EvaluationBinary:
     """Per-output binary metrics for multi-label sigmoid outputs
     (ref: EvaluationBinary)."""

@@ -116,7 +116,7 @@ class _H5Weights:
 
 
 # ------------------------------------------------------------ layer mapping
-def _map_layer(cls: str, cfg: dict):
+def _map_layer(cls: str, cfg: dict, build_shape=None):
     """Keras layer config dict → (our Layer | '__flatten__' | None).
 
     Returning None means "structural no-op at runtime" (InputLayer etc.).
@@ -220,10 +220,18 @@ def _map_layer(cls: str, cfg: dict):
             dilation=tuple(cfg.get("dilation_rate", (1, 1, 1))),
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "LayerNormalization":
+        # we normalize over the LAST dim; -1/[-1] always qualifies, and a
+        # resolved positive axis qualifies iff it equals rank-1 (rank from
+        # the serialized build_config, available in both Keras 2 and 3)
         axis = cfg.get("axis", -1)
-        if axis not in (-1, [-1]):
+        axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+        rank = len(build_shape) if build_shape else None
+        ok = (axes in ([-1],) or
+              (rank is not None and axes == [rank - 1]))
+        if not ok:
             raise UnsupportedKerasConfigurationException(
-                "LayerNormalization only supports axis=-1")
+                f"LayerNormalization only supports the last axis; got "
+                f"axis={axes} (input rank {rank})")
         return L.LayerNormalization(name=name, eps=cfg.get("epsilon", 1e-3))
     if cls == "LeakyReLU":
         alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
@@ -404,7 +412,8 @@ class KerasModelImport:
                  .updater(Adam(1e-3)).weight_init("xavier").list())
             mapped: List[tuple] = []   # (our layer, keras name)
             for ld in layer_dicts:
-                out = _map_layer(ld["class_name"], ld["config"])
+                out = _map_layer(ld["class_name"], ld["config"],
+                                 (ld.get("build_config") or {}).get("input_shape"))
                 if out is None:
                     continue
                 for lyr in (out if isinstance(out, list) else [out]):
@@ -487,7 +496,8 @@ class KerasModelImport:
                 elif cls in ("Maximum",):
                     g.add_vertex(name, ElementWiseVertex(op="max"), *srcs)
                 else:
-                    out = _map_layer(cls, lcfg)
+                    out = _map_layer(cls, lcfg,
+                                     (ld.get("build_config") or {}).get("input_shape"))
                     if out is None:
                         name_of[name] = srcs[0]
                         continue

@@ -9,7 +9,7 @@ score = sum of all output-layer losses (reference semantics).
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -108,9 +108,12 @@ class ComputationGraph:
 
     # --------------------------------------------------------------- forward
     def _forward(self, params, states, inputs: Sequence[jnp.ndarray], training, rng,
-                 masks=None, collect=False):
+                 masks=None, collect=False, carries=None, carry_out=None):
         """Topological trace of the DAG (ref: ComputationGraph#feedForward over
-        topologicalSortOrder). Returns ({name: activation}, new_states)."""
+        topologicalSortOrder). Returns ({name: activation}, new_states).
+        ``carries``/``carry_out``: streaming rnnTimeStep state — when
+        ``carries`` is a dict, recurrent layers run stepwise from their carry
+        and write the new carry into ``carry_out``."""
         acts: Dict[str, jnp.ndarray] = {}
         new_states = dict(states)
         from deeplearning4j_tpu.nn.multilayer import _maybe_unflatten_input
@@ -129,8 +132,20 @@ class ComputationGraph:
                 kwargs = {}
                 if mask is not None and isinstance(node.layer, _MASK_AWARE):
                     kwargs["mask"] = mask
-                h, st = node.layer.apply(params.get(name, {}), srcs[0],
-                                         training=training, rng=lrng, state=lst, **kwargs)
+                if carries is not None and isinstance(node.layer, L._RnnBase):
+                    carry0 = carries.get(name)
+                    if carry0 is None:
+                        carry0 = node.layer.initial_carry(srcs[0].shape[0])
+                    h_in = node.layer._maybe_dropout(srcs[0], training, lrng)
+                    h, carry = node.layer.run(params.get(name, {}), h_in,
+                                              carry0, mask=mask)
+                    if carry_out is not None:
+                        carry_out[name] = carry
+                    st = lst
+                else:
+                    h, st = node.layer.apply(params.get(name, {}), srcs[0],
+                                             training=training, rng=lrng,
+                                             state=lst, **kwargs)
                 if lst is not None and st is not None:
                     new_states[name] = st
                 acts[name] = h
@@ -160,8 +175,12 @@ class ComputationGraph:
                     penalty = penalty + 0.5 * l2 * jnp.sum(jnp.square(arr))
         return penalty
 
-    def _loss_fn(self, params, states, inputs, labels, masks, label_masks, rng):
-        acts, new_states = self._forward(params, states, inputs, True, rng, masks=masks)
+    def _loss_fn(self, params, states, inputs, labels, masks, label_masks, rng,
+                 carries=None):
+        carry_out = {} if carries is not None else None
+        acts, new_states = self._forward(params, states, inputs, True, rng,
+                                         masks=masks, carries=carries,
+                                         carry_out=carry_out)
         total = 0.0
         for i, out_name in enumerate(self.conf.network_outputs):
             node = self.conf.nodes[out_name]
@@ -178,14 +197,15 @@ class ComputationGraph:
             total = total + node.layer.loss(params.get(out_name, {}), src, labels[i],
                                             mask=lm, training=True, rng=lrng)
         total = total + self._regularization_penalty(params)
-        return total, new_states
+        return total, (new_states, carry_out)
 
     # ------------------------------------------------------------ train step
-    @functools.partial(jax.jit, static_argnums=(0, 9), donate_argnums=(1, 2, 3))
+    @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(1, 2, 3))
     def _train_step(self, params, opt_state, states, inputs, labels, masks, label_masks, rng,
-                    frozen=frozenset()):
-        (loss, new_states), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
-            params, states, inputs, labels, masks, label_masks, rng)
+                    carries=None, frozen=frozenset()):
+        (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(
+            params, states, inputs, labels, masks, label_masks, rng, carries)
         if frozen:
             grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in frozen else g)
                      for k, g in grads.items()}
@@ -196,7 +216,7 @@ class ComputationGraph:
             updates = {k: (jax.tree.map(jnp.zeros_like, u) if k in frozen else u)
                        for k, u in updates.items()}
         params = optax.apply_updates(params, updates)
-        return params, opt_state, new_states, loss
+        return params, opt_state, new_states, loss, new_carries
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
@@ -232,14 +252,48 @@ class ComputationGraph:
         labels = tuple(jnp.asarray(_unwrap(y)) for y in labels)
         fmasks = tuple(jnp.asarray(_unwrap(m)) for m in fmasks if m is not None) or None
         lmasks = tuple(jnp.asarray(_unwrap(m)) for m in lmasks if m is not None) or None
+        if (getattr(self.conf, "backprop_type", "standard") == "tbptt"
+                and any(x.ndim == 3 for x in inputs)):
+            self._fit_tbptt(inputs, labels, fmasks, lmasks)
+            return
         self._key, rng = jax.random.split(self._key)
-        self._params, self._opt_state, self._states, loss = self._train_step(
+        self._params, self._opt_state, self._states, loss, _ = self._train_step(
             self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng,
-            frozenset(self._frozen))
+            None, frozenset(self._frozen))
         self._score = float(loss)
         self._iteration += 1
         for lst in self._listeners:
             lst.iteration_done(self, self._iteration, self._epoch, self._score)
+
+    def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
+        """Truncated BPTT for graphs (ref: ComputationGraph#doTruncatedBPTT):
+        time-chunk every 3-D input/label, carry recurrent state across
+        chunks; gradients stop at chunk boundaries."""
+        t_total = max(x.shape[1] for x in inputs if x.ndim == 3)
+        fwd = self.conf.tbptt_fwd_length
+        carries = {}
+
+        def chunk(seq, start, end, min_ndim=3):
+            # masks are (N, T): slice them at 2-D too (min_ndim=2); static
+            # 2-D labels/inputs (N, C) stay whole
+            return tuple(a[:, start:end] if a is not None
+                         and a.ndim >= min_ndim else a for a in seq)
+
+        for start in range(0, t_total, fwd):
+            end = min(start + fwd, t_total)
+            fm = chunk(fmasks, start, end, min_ndim=2) if fmasks else None
+            lm = chunk(lmasks, start, end, min_ndim=2) if lmasks else None
+            self._key, rng = jax.random.split(self._key)
+            (self._params, self._opt_state, self._states, loss,
+             carries) = self._train_step(
+                self._params, self._opt_state, self._states,
+                chunk(inputs, start, end), chunk(labels, start, end),
+                fm, lm, rng, carries, frozenset(self._frozen))
+            self._score = float(loss)
+            self._iteration += 1
+            for lst in self._listeners:
+                lst.iteration_done(self, self._iteration, self._epoch,
+                                   self._score)
 
     # ------------------------------------------------------------- inference
     @functools.partial(jax.jit, static_argnums=(0,))
@@ -261,6 +315,41 @@ class ComputationGraph:
         return outs[0] if len(outs) == 1 else outs
 
     outputSingle = output
+
+    # ------------------------------------------------------- rnn streaming
+    def rnnTimeStep(self, *inputs):
+        """Stateful streaming inference (ref: ComputationGraph#rnnTimeStep):
+        recurrent vertices carry hidden state across calls; inputs
+        (N, T, C) or (N, C) for a single step."""
+        if not self._initialized:
+            self.init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        arrs = []
+        single = False
+        for x in inputs:
+            x = jnp.asarray(_unwrap(x))
+            if x.ndim == 2:
+                single = True
+                x = x[:, None, :]
+            arrs.append(x)
+        carries = getattr(self, "_rnn_state", None) or {}
+        carry_out: Dict[str, Any] = {}
+        acts, _ = self._forward(self._params, self._states, tuple(arrs),
+                                False, None, carries=carries,
+                                carry_out=carry_out)
+        self._rnn_state = {**carries, **carry_out}
+        outs = []
+        for n in self.conf.network_outputs:
+            h = acts[n]
+            outs.append(NDArray(h[:, -1] if single and h.ndim == 3 else h))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def rnnClearPreviousState(self):
+        self._rnn_state = {}
+
+    def rnnGetPreviousState(self, vertex_name: str):
+        return (getattr(self, "_rnn_state", None) or {}).get(vertex_name)
 
     def feedForward(self, *inputs, train: bool = False) -> Dict[str, NDArray]:
         """All vertex activations by name (ref: #feedForward returning map)."""

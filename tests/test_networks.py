@@ -273,3 +273,79 @@ class TestExplicitPreprocessors:
         net = MultiLayerNetwork(conf2).init()
         out = net.output(np.zeros((2, 16), np.float32))
         assert np.asarray(out).shape == (2, 2)
+
+
+def test_computation_graph_rnn_time_step():
+    """CG streaming inference (ref: ComputationGraph#rnnTimeStep): stepwise
+    outputs with carried state must match the full-sequence forward."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).updater(Adam(1e-2))
+            .graph_builder().add_inputs("in")
+            .set_input_types(InputType.recurrent(3, 6)))
+    conf.add_layer("lstm", LSTM(n_out=5, activation="tanh"), "in")
+    conf.add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                         loss_function="mcxent"), "lstm")
+    conf.set_outputs("out")
+    cg = ComputationGraph(conf.build()).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 6, 3)).astype(np.float32)
+    full = np.asarray(cg.output(x).buf() if hasattr(cg.output(x), "buf")
+                      else cg.output(x))
+    cg.rnnClearPreviousState()
+    steps = []
+    for t in range(6):
+        steps.append(np.asarray(cg.rnnTimeStep(x[:, t]).buf()))
+    np.testing.assert_allclose(np.stack(steps, axis=1), full, atol=1e-5)
+    assert cg.rnnGetPreviousState("lstm") is not None
+    cg.rnnClearPreviousState()
+    assert cg.rnnGetPreviousState("lstm") is None
+
+
+def test_computation_graph_tbptt_trains():
+    """CG TBPTT (ref: ComputationGraph#doTruncatedBPTT): 3 chunks per fit,
+    loss decreases, iteration counter advances per chunk."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater(Adam(1e-2))
+            .graph_builder().add_inputs("in")
+            .set_input_types(InputType.recurrent(4, 12)))
+    conf.add_layer("lstm", LSTM(n_out=6, activation="tanh"), "in")
+    conf.add_layer("out", RnnOutputLayer(n_out=4, activation="softmax",
+                                         loss_function="mcxent"), "lstm")
+    conf.set_outputs("out")
+    conf.backprop_type("tbptt").t_bptt_length(4)
+    cg = ComputationGraph(conf.build()).init()
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, 4, (4, 13))
+    x = np.eye(4, dtype=np.float32)[idx[:, :-1]]
+    y = np.eye(4, dtype=np.float32)[idx[:, 1:]]
+    cg.fit((x,), (y,))
+    assert cg._iteration == 3          # 12 steps / tbptt 4
+    s0 = cg.score()
+    for _ in range(8):
+        cg.fit((x,), (y,))
+    assert cg.score() < s0
+
+
+def test_computation_graph_tbptt_with_masks():
+    """Regression (review finding): 2-D (N,T) masks must chunk with the
+    time axis during CG TBPTT."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(6).updater(Adam(1e-2))
+            .graph_builder().add_inputs("in")
+            .set_input_types(InputType.recurrent(3, 8)))
+    conf.add_layer("lstm", LSTM(n_out=4, activation="tanh"), "in")
+    conf.add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                         loss_function="mcxent"), "lstm")
+    conf.set_outputs("out")
+    conf.backprop_type("tbptt").t_bptt_length(4)
+    cg = ComputationGraph(conf.build()).init()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(3, 8, 3)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 8))]
+    mask = np.ones((3, 8), np.float32)
+    mask[0, 5:] = 0
+    from deeplearning4j_tpu.data.dataset import DataSet
+    ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+    cg.fit([ds])
+    assert np.isfinite(cg.score())
+    assert cg._iteration == 2

@@ -343,3 +343,33 @@ class TestBitwiseAndImageNamespaces:
         out = sd.output({"x": img}, ["small", "hsv"])
         assert out["small"].shape == (1, 2, 2, 3)
         assert out["hsv"].shape == (1, 4, 4, 3)
+
+
+def test_sd_evaluate_classification():
+    """SameDiff#evaluate parity: iterator → Evaluation over a graph output."""
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 2))
+    w = sd.var("w", init=np.asarray([[4.0, -4.0], [0.0, 0.0]], np.float32))
+    probs = sd.nn.softmax(x.mmul(w)).rename("probs")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(1e-2), data_set_feature_mapping=["x"],
+        data_set_label_mapping=["label"], loss_variables=[]))
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(64, 2)).astype(np.float32)
+    Y = np.eye(2, dtype=np.float32)[(X[:, 0] > 0).astype(int)]
+    # w maps x0>0 → class 0; these labels say class 1 → accuracy ~0
+    it = ListDataSetIterator([DataSet(X[i:i + 16], Y[i:i + 16])
+                              for i in range(0, 64, 16)])
+    ev = sd.evaluate(it, "probs")
+    assert ev.accuracy() < 0.2
+    # aligned labels → near-perfect
+    Y2 = np.eye(2, dtype=np.float32)[(X[:, 0] <= 0).astype(int)]
+    it2 = ListDataSetIterator([DataSet(X, Y2)])
+    ev2 = sd.evaluate(it2, "probs")
+    assert ev2.accuracy() > 0.95

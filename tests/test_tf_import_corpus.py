@@ -138,6 +138,8 @@ CORPUS = {
     "ReverseV2": (lambda x: tf.reverse(x, axis=[1]), {"x": x34}),
     "Identity": (lambda x: tf.identity(x), {"x": x34}),
     "StopGradient": (lambda x: tf.stop_gradient(x), {"x": x34}),
+    "CheckNumerics": (lambda x: tf.debugging.check_numerics(x, "chk") + 1.0,
+                      {"x": x34}),
     "Greater": (lambda x: tf.cast(x > 1.0, tf.float32), {"x": x34}),
     "GreaterEqual": (lambda x: tf.cast(x >= 1.0, tf.float32), {"x": x34}),
     "Less": (lambda x: tf.cast(x < 1.0, tf.float32), {"x": x34}),

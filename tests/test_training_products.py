@@ -298,3 +298,26 @@ class TestSolvers:
         net.addListeners(Probe())
         Solver(net, "conjugate_gradient", max_iterations=5).optimize(x, y)
         assert len(seen) == 5 and net.getIterationCount() == 5
+
+
+def test_roc_binary_per_output():
+    """ROCBinary (ref: evaluation.classification.ROCBinary): independent
+    per-output ROC for multi-label sigmoid outputs."""
+    import numpy as np
+
+    from deeplearning4j_tpu.eval import ROCBinary
+
+    rng = np.random.default_rng(0)
+    n = 400
+    y = rng.integers(0, 2, (n, 3)).astype(np.float32)
+    # output 0: perfectly ranked; output 1: random; output 2: inverted
+    p = np.empty((n, 3), np.float32)
+    p[:, 0] = y[:, 0] * 0.5 + 0.25 + rng.random(n) * 0.1
+    p[:, 1] = rng.random(n)
+    p[:, 2] = (1 - y[:, 2]) * 0.8 + rng.random(n) * 0.1
+    roc = ROCBinary().eval(y, p)
+    assert roc.num_labels() == 3
+    assert roc.calculate_auc(0) > 0.95
+    assert 0.4 < roc.calculate_auc(1) < 0.6
+    assert roc.calculate_auc(2) < 0.1
+    assert 0.0 <= roc.average_auc() <= 1.0
