@@ -39,6 +39,22 @@ from deeplearning4j_tpu.ops import registry as op_registry
 import deeplearning4j_tpu.ops  # noqa: F401  (trigger op registrations)
 
 
+class History(list):
+    """Training history (ref: ``org.nd4j.autodiff.listeners.records.History``
+    + ``LossCurve``). Subclasses list of per-iteration losses so existing
+    ``losses[-1]`` style code keeps working."""
+
+    def loss_curve(self):
+        return list(self)
+
+    lossCurve = loss_curve
+
+    def final_loss(self) -> float:
+        return float(self[-1]) if self else float("nan")
+
+    finalTrainingLoss = final_loss
+
+
 class VariableType(enum.Enum):
     """Mirror of ``org.nd4j.autodiff.samediff.VariableType``."""
 
@@ -1131,7 +1147,7 @@ class SameDiff:
                     lst.on_epoch_end(self, self.epoch_count)
         # output()'s cache holds stale self._values copies only by reference —
         # values dict is passed per call, so no invalidation needed here.
-        return losses
+        return History(losses)
 
     # ---- serialization -------------------------------------------------
     def to_dict(self) -> dict:

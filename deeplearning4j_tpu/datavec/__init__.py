@@ -11,13 +11,15 @@ from deeplearning4j_tpu.datavec.schema import Schema
 from deeplearning4j_tpu.datavec.transform import TransformProcess
 from deeplearning4j_tpu.datavec.records import (
     CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
-    FileSplit, LineRecordReader, ListStringSplit, RecordReader)
+    FileSplit, JacksonLineRecordReader, LineRecordReader, ListStringSplit,
+    RecordReader, RegexLineRecordReader)
 from deeplearning4j_tpu.datavec.local import LocalTransformExecutor
 
 __all__ = [
     "Writable", "IntWritable", "LongWritable", "FloatWritable",
     "DoubleWritable", "BooleanWritable", "Text", "NDArrayWritable",
     "Schema", "TransformProcess", "RecordReader", "CSVRecordReader",
+    "RegexLineRecordReader", "JacksonLineRecordReader",
     "LineRecordReader", "CollectionRecordReader", "CSVSequenceRecordReader",
     "FileSplit", "ListStringSplit", "LocalTransformExecutor",
 ]

@@ -9,7 +9,8 @@ import io
 import os
 from typing import Iterable, List, Optional, Sequence
 
-from deeplearning4j_tpu.datavec.writable import (DoubleWritable, IntWritable,
+from deeplearning4j_tpu.datavec.writable import (BooleanWritable,
+                                                 DoubleWritable, IntWritable,
                                                  Text, Writable, box)
 
 
@@ -259,3 +260,79 @@ class TransformProcessRecordReader(RecordReader):
     def reset(self):
         self.reader.reset()
         self._buffer = None
+
+
+class RegexLineRecordReader(_ListBackedReader):
+    """ref: records.reader.impl.regex.RegexLineRecordReader — each line is
+    matched against a regex; capture groups become the record's columns."""
+
+    def __init__(self, regex: str, skip_num_lines: int = 0):
+        import re
+        super().__init__()
+        self.pattern = re.compile(regex)
+        self.skip = skip_num_lines
+
+    def initialize(self, split: InputSplit):
+        self._rows = []
+        for path in split.locations():
+            with open(path) as f:
+                for i, line in enumerate(f):
+                    if i < self.skip:
+                        continue
+                    line = line.rstrip("\n")
+                    if not line:
+                        continue
+                    m = self.pattern.fullmatch(line)
+                    if m is None:
+                        raise ValueError(
+                            f"line {i} of {path} does not match pattern "
+                            f"{self.pattern.pattern!r}: {line!r}")
+                    self._rows.append([_parse_field(g)
+                                       for g in m.groups()])
+        self._pos = 0
+        return self
+
+
+class JacksonLineRecordReader(_ListBackedReader):
+    """ref: records.reader.impl.jackson.JacksonLineRecordReader — one JSON
+    object per line; ``field_selection`` names the columns to extract (dotted
+    paths supported), mirroring the reference's FieldSelection."""
+
+    def __init__(self, field_selection: Sequence[str]):
+        super().__init__()
+        self.fields = list(field_selection)
+
+    def _extract(self, obj, dotted: str):
+        cur = obj
+        for part in dotted.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return None
+            cur = cur[part]
+        return cur
+
+    def initialize(self, split: InputSplit):
+        import json as _json
+        self._rows = []
+        for path in split.locations():
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = _json.loads(line)
+                    row = []
+                    for fld in self.fields:
+                        v = self._extract(obj, fld)
+                        if v is None:
+                            row.append(Text(""))
+                        elif isinstance(v, bool):
+                            row.append(BooleanWritable(v))
+                        elif isinstance(v, int):
+                            row.append(IntWritable(v))
+                        elif isinstance(v, float):
+                            row.append(DoubleWritable(v))
+                        else:
+                            row.append(Text(str(v)))
+                    self._rows.append(row)
+        self._pos = 0
+        return self

@@ -116,7 +116,7 @@ class _H5Weights:
 
 
 # ------------------------------------------------------------ layer mapping
-def _map_layer(cls: str, cfg: dict, build_shape=None):
+def _map_layer(cls: str, cfg: dict):
     """Keras layer config dict → (our Layer | '__flatten__' | None).
 
     Returning None means "structural no-op at runtime" (InputLayer etc.).
@@ -220,19 +220,18 @@ def _map_layer(cls: str, cfg: dict, build_shape=None):
             dilation=tuple(cfg.get("dilation_rate", (1, 1, 1))),
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "LayerNormalization":
-        # we normalize over the LAST dim; -1/[-1] always qualifies, and a
-        # resolved positive axis qualifies iff it equals rank-1 (rank from
-        # the serialized build_config, available in both Keras 2 and 3)
+        # we normalize over the LAST dim. Keras 3 keeps axis=-1; Keras 2
+        # (tf_keras) H5 configs carry the RESOLVED positive axis with no
+        # per-layer build_config — defer the rank check to the layer's
+        # shape-inference (LayerNormalization.set_n_in), where the input
+        # rank is known.
         axis = cfg.get("axis", -1)
         axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
-        rank = len(build_shape) if build_shape else None
-        ok = (axes in ([-1],) or
-              (rank is not None and axes == [rank - 1]))
-        if not ok:
+        if len(axes) != 1:
             raise UnsupportedKerasConfigurationException(
-                f"LayerNormalization only supports the last axis; got "
-                f"axis={axes} (input rank {rank})")
-        return L.LayerNormalization(name=name, eps=cfg.get("epsilon", 1e-3))
+                f"LayerNormalization over multiple axes {axes} unsupported")
+        return L.LayerNormalization(name=name, eps=cfg.get("epsilon", 1e-3),
+                                    axis=int(axes[0]))
     if cls == "LeakyReLU":
         alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
         return L.ActivationLayer(name=name,
@@ -412,8 +411,7 @@ class KerasModelImport:
                  .updater(Adam(1e-3)).weight_init("xavier").list())
             mapped: List[tuple] = []   # (our layer, keras name)
             for ld in layer_dicts:
-                out = _map_layer(ld["class_name"], ld["config"],
-                                 (ld.get("build_config") or {}).get("input_shape"))
+                out = _map_layer(ld["class_name"], ld["config"])
                 if out is None:
                     continue
                 for lyr in (out if isinstance(out, list) else [out]):
@@ -496,8 +494,7 @@ class KerasModelImport:
                 elif cls in ("Maximum",):
                     g.add_vertex(name, ElementWiseVertex(op="max"), *srcs)
                 else:
-                    out = _map_layer(cls, lcfg,
-                                     (ld.get("build_config") or {}).get("input_shape"))
+                    out = _map_layer(cls, lcfg)
                     if out is None:
                         name_of[name] = srcs[0]
                         continue

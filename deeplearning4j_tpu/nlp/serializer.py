@@ -11,6 +11,22 @@ from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
 
+def _model_from(words, vecs) -> Word2Vec:
+    """Assemble a lookup-only Word2Vec from (words, vectors) — shared tail
+    of the text and binary readers. Synthetic counts preserve rank order."""
+    V, D = vecs.shape
+    model = Word2Vec(layer_size=D)
+    vc = VocabCache()
+    for i, w in enumerate(words):
+        vw = VocabWord(w, count=V - i, index=i)
+        vc._words[w] = vw
+        vc._by_index.append(vw)
+    model.vocab = vc
+    model.syn0 = vecs
+    model.syn1neg = np.zeros_like(vecs)
+    return model
+
+
 class WordVectorSerializer:
     @staticmethod
     def write_word_vectors(model: Word2Vec, path: str):
@@ -39,16 +55,48 @@ class WordVectorSerializer:
                 # parse from the right: n-gram tokens may contain spaces
                 words.append(" ".join(parts[:-D]))
                 vecs[i] = [float(x) for x in parts[-D:]]
-        model = Word2Vec(layer_size=D)
-        vc = VocabCache()
-        for i, w in enumerate(words):
-            vw = VocabWord(w, count=V - i, index=i)
-            vc._words[w] = vw
-            vc._by_index.append(vw)
-        model.vocab = vc
-        model.syn0 = vecs
-        model.syn1neg = np.zeros_like(vecs)
-        return model
+        return _model_from(words, vecs)
 
     readWord2VecModel = read_word_vectors
     loadTxtVectors = read_word_vectors
+
+    # ------------------------------------------------- word2vec binary (.bin)
+    @staticmethod
+    def write_binary(model: Word2Vec, path: str):
+        """The original word2vec.c binary format (ref:
+        WordVectorSerializer#writeWordVectors binary mode): ASCII header
+        'V D\n', then per word 'word ' + D little-endian float32s + '\n'."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wb") as f:
+            V, D = model.syn0.shape
+            f.write(f"{V} {D}\n".encode())
+            for i in range(V):
+                w = model.vocab.word_at_index(i)
+                f.write(w.encode("utf-8") + b" ")
+                f.write(np.asarray(model.syn0[i], "<f4").tobytes())
+                f.write(b"\n")
+
+    writeBinary = write_binary
+
+    @staticmethod
+    def read_binary(path: str) -> Word2Vec:
+        """ref: WordVectorSerializer#loadGoogleModel(binary=true) — reads
+        GoogleNews-style .bin files."""
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            header = f.readline().split()
+            V, D = int(header[0]), int(header[1])
+            words, vecs = [], np.zeros((V, D), dtype=np.float32)
+            for i in range(V):
+                chars = bytearray()
+                while True:
+                    c = f.read(1)
+                    if not c or c == b" ":
+                        break
+                    if c != b"\n":          # some writers pad with newline
+                        chars.extend(c)
+                words.append(chars.decode("utf-8"))
+                vecs[i] = np.frombuffer(f.read(4 * D), dtype="<f4")
+        return _model_from(words, vecs)
+
+    loadGoogleModel = read_binary

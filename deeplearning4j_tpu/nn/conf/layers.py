@@ -1291,11 +1291,20 @@ class CnnLossLayer(Layer):
 class LayerNormalization(Layer):
     """Per-feature layer norm with learned gain/bias (Keras
     LayerNormalization / the reference's layer_norm declarable op — SURVEY
-    N3). Normalizes over the LAST axis; statistics in ≥f32."""
+    N3). Normalizes over the LAST axis; statistics in ≥f32. ``axis`` (-1 or
+    an explicit positive index, e.g. from a Keras-2 import where the config
+    carries the resolved axis) is validated against the input rank at
+    shape-inference time."""
     n_out: Optional[int] = None
     eps: float = 1e-3
+    axis: int = -1
 
     def set_n_in(self, input_type: InputType):
+        rank = len(input_type.batch_shape())
+        if self.axis not in (-1, rank - 1):
+            raise ValueError(
+                f"LayerNormalization normalizes the last axis; got "
+                f"axis={self.axis} for rank-{rank} input")
         if self.n_out is None:
             self.n_out = (input_type.channels
                           if input_type.kind in ("cnn", "cnn3d")

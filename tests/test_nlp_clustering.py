@@ -218,3 +218,20 @@ def test_lsh_approximate_nn():
 
     # bucket() returns candidates containing the point itself
     assert 7 in lsh.bucket(data[7])
+
+
+def test_word2vec_binary_round_trip(tmp_path):
+    """word2vec.c binary format (ref: WordVectorSerializer#loadGoogleModel):
+    write → read round trip preserves vocab order and vectors exactly."""
+    w2v = Word2Vec(layer_size=8, epochs=1, sample=0.0,
+                   iterator=CollectionSentenceIterator(CORPUS[:20]))
+    w2v.fit()
+    p = os.path.join(str(tmp_path), "vecs.bin")
+    WordVectorSerializer.write_binary(w2v, p)
+    loaded = WordVectorSerializer.loadGoogleModel(p)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+    for i in range(w2v.vocab.num_words()):
+        w = w2v.vocab.word_at_index(i)
+        assert loaded.vocab.word_at_index(i) == w
+        np.testing.assert_allclose(loaded.get_word_vector(w),
+                                   w2v.get_word_vector(w), atol=1e-7)
