@@ -591,3 +591,54 @@ register("reduce_all", lambda x, axis=None, keepdims=False:
          jnp.all(x, axis=axis, keepdims=keepdims), aliases=["All"])
 register("reduce_any", lambda x, axis=None, keepdims=False:
          jnp.any(x, axis=axis, keepdims=keepdims), aliases=["Any"])
+
+
+# ---------------------------------------------------------- spectral / signal
+register("fft", lambda x, n=None, axis=-1: jnp.fft.fft(x, n=n, axis=axis),
+         aliases=["FFT"])
+register("ifft", lambda x, n=None, axis=-1: jnp.fft.ifft(x, n=n, axis=axis),
+         aliases=["IFFT"])
+register("rfft", lambda x, n=None, axis=-1: jnp.fft.rfft(x, n=n, axis=axis),
+         aliases=["RFFT"])
+register("irfft", lambda x, n=None, axis=-1: jnp.fft.irfft(x, n=n, axis=axis),
+         aliases=["IRFFT"])
+register("fft2", lambda x: jnp.fft.fft2(x), aliases=["FFT2D"])
+register("ifft2", lambda x: jnp.fft.ifft2(x), aliases=["IFFT2D"])
+
+
+# ----------------------------------------------------------------- ctc loss
+@register("ctc_loss", aliases=["CTCLoss", "ctc_loss_v2"])
+def _ctc_loss(log_probs, labels, logit_lengths, label_lengths, blank_id=0):
+    """Connectionist temporal classification loss (ref: libnd4j ctc_loss
+    declarable op). ``log_probs`` (B, T, C) log-softmax outputs; ``labels``
+    (B, S) int32; per-example valid lengths. Uses optax's lattice
+    implementation under the hood."""
+    import optax
+
+    T = log_probs.shape[1]
+    S = labels.shape[1]
+    logit_pad = (jnp.arange(T)[None, :]
+                 >= jnp.asarray(logit_lengths)[:, None]).astype(jnp.float32)
+    label_pad = (jnp.arange(S)[None, :]
+                 >= jnp.asarray(label_lengths)[:, None]).astype(jnp.float32)
+    return optax.ctc_loss(log_probs, logit_pad, labels, label_pad,
+                          blank_id=blank_id)
+
+
+# ------------------------------------------------------------ linalg tranche
+register("pinv", jnp.linalg.pinv, aliases=["Pinv"])
+register("kron", jnp.kron, aliases=["Kron"])
+register("matrix_power", jnp.linalg.matrix_power, aliases=["MatrixPower"])
+register("matrix_rank", lambda x: jnp.linalg.matrix_rank(x),
+         aliases=["MatrixRank"])
+register("norm", lambda x, ord=None, axis=None, keepdims=False:
+         jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims),
+         aliases=["Norm"])
+register("outer", jnp.outer, aliases=["Outer"])
+register("triu", lambda x, k=0: jnp.triu(x, k=k), aliases=["Triu"])
+register("tril", lambda x, k=0: jnp.tril(x, k=k), aliases=["Tril"])
+
+
+@register("trilu", aliases=["Trilu"])
+def _trilu(x, k=0, upper=True):
+    return jnp.triu(x, k=k) if upper else jnp.tril(x, k=k)

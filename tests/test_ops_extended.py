@@ -314,3 +314,53 @@ def test_alias_coverage():
                  "RgbToHsv", "BatchMatMulV2", "HuberLoss", "LSTMLayer",
                  "UniqueWithCounts", "DynamicStitch", "InvertPermutation"]:
         assert has_op(name), name
+
+
+class TestSpectralAndLinalgTranche:
+    def test_fft_round_trip(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 16)),
+                        jnp.float32)
+        back = exec_op("ifft", exec_op("fft", x))
+        np.testing.assert_allclose(_np(back.real), _np(x), atol=1e-5)
+        r = exec_op("rfft", x)
+        assert r.shape == (4, 9)
+        back_r = exec_op("irfft", r)
+        np.testing.assert_allclose(_np(back_r), _np(x), atol=1e-5)
+
+    def test_ctc_loss_learns_alignment(self):
+        import jax
+        import optax
+
+        rng = np.random.default_rng(0)
+        B, T, C, S = 2, 8, 5, 3
+        labels = jnp.asarray(rng.integers(1, C, (B, S)), jnp.int32)
+        logit_len = jnp.asarray([T, T])
+        label_len = jnp.asarray([S, S])
+        logits = jnp.asarray(rng.normal(size=(B, T, C)) * 0.1, jnp.float32)
+
+        def loss_fn(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return jnp.mean(exec_op("ctc_loss", lp, labels, logit_len,
+                                    label_len))
+
+        l0 = float(loss_fn(logits))
+        g = jax.jit(jax.grad(loss_fn))
+        for _ in range(60):
+            logits = logits - 0.5 * g(logits)
+        assert float(loss_fn(logits)) < l0 * 0.3
+
+    def test_linalg_tranche(self):
+        a = jnp.asarray([[2.0, 0.0], [1.0, 3.0]])
+        np.testing.assert_allclose(
+            _np(exec_op("matrix_power", a, 2)), _np(a @ a), rtol=1e-6)
+        pinv = exec_op("pinv", a)
+        np.testing.assert_allclose(_np(pinv @ a), np.eye(2), atol=1e-5)
+        assert int(exec_op("matrix_rank", a)) == 2
+        k = exec_op("kron", jnp.eye(2), a)
+        assert k.shape == (4, 4)
+        np.testing.assert_allclose(
+            _np(exec_op("trilu", jnp.ones((3, 3)), upper=False)),
+            np.tril(np.ones((3, 3))))
+        np.testing.assert_allclose(
+            float(exec_op("norm", a, ord="fro")),
+            float(np.linalg.norm(np.asarray(a))), rtol=1e-6)
