@@ -336,3 +336,26 @@ class JacksonLineRecordReader(_ListBackedReader):
                     self._rows.append(row)
         self._pos = 0
         return self
+
+
+def csv_to_matrix(split: InputSplit, delimiter: str = ",",
+                  skip_num_lines: int = 0):
+    """Bulk-load numeric CSV files into one float32 matrix via the native
+    C++ parser (ref analog: the reference's ETL hot loops run native —
+    SURVEY N8/N11; ``native.csv_read_floats`` has a numpy fallback).
+
+    The row-of-Writables ``CSVRecordReader`` remains the general path for
+    typed/string columns; this is the fast path for all-numeric tables
+    feeding ``DataSet`` construction directly.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.native import csv_read_floats
+
+    locations = split.locations()
+    if not locations:
+        raise FileNotFoundError(f"csv_to_matrix: split has no locations "
+                                f"({split!r})")
+    mats = [csv_read_floats(p, delimiter=delimiter, skip_rows=skip_num_lines)
+            for p in locations]
+    return mats[0] if len(mats) == 1 else np.concatenate(mats, axis=0)

@@ -2,5 +2,7 @@
 from deeplearning4j_tpu.profiler.op_profiler import (OpProfiler,
                                                      ProfilerConfig)
 from deeplearning4j_tpu.profiler.performance import PerformanceTracker
+from deeplearning4j_tpu.profiler.xprof import DeviceProfiler, profile_step
 
-__all__ = ["OpProfiler", "ProfilerConfig", "PerformanceTracker"]
+__all__ = ["OpProfiler", "ProfilerConfig", "PerformanceTracker",
+           "DeviceProfiler", "profile_step"]

@@ -326,3 +326,31 @@ class TestNewReaders:
         with pytest.raises(ResourceError, match="checksum"):
             d.download("scheme://y", tmp_path / "bad.bin",
                        md5="0" * 32)
+
+
+def test_csv_to_matrix_native_fast_path(tmp_path):
+    """Bulk numeric CSV → matrix via the native parser matches the
+    row-of-Writables reader (and reports which path ran)."""
+    import os
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datavec import CSVRecordReader, FileSplit
+    from deeplearning4j_tpu.datavec.records import csv_to_matrix
+    from deeplearning4j_tpu.native import is_native
+
+    p = os.path.join(str(tmp_path), "nums.csv")
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(50, 6)).astype(np.float32)
+    np.savetxt(p, data, delimiter=",", fmt="%.6f")
+
+    mat = csv_to_matrix(FileSplit(p))
+    assert mat.shape == (50, 6) and mat.dtype == np.float32
+    np.testing.assert_allclose(mat, data, atol=1e-5)
+
+    rr = CSVRecordReader()
+    rr.initialize(FileSplit(p))
+    rows = np.asarray([[w.to_double() for w in row] for row in rr],
+                      dtype=np.float32)
+    np.testing.assert_allclose(mat, rows, atol=1e-5)
+    assert isinstance(is_native(), bool)     # either path is legitimate
