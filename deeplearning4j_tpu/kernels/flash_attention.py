@@ -70,7 +70,10 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_acc[...], 1e-30)
         o_ref[0] = (o_acc[...] / l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = m_acc[...] + jnp.log(l)
+        # lse is carried as (bh, q, 1): a (block_q, 1) block satisfies the
+        # Mosaic tiling rule (sublane dim % 8 == 0, lane dim == array dim),
+        # where a (1, block_q) block of a 2-D (bh, q) array would not
+        lse_ref[0] = (m_acc[...] + jnp.log(l))[:, None]
 
 
 def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -94,7 +97,7 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
                                block_k=block_k, seq_k=seq_k, n_kb=n_kb)
     out_shapes = [
         jax.ShapeDtypeStruct((bh, padded_q, d), q.dtype),
-        jax.ShapeDtypeStruct((bh, padded_q), jnp.float32),
+        jax.ShapeDtypeStruct((bh, padded_q, 1), jnp.float32),
     ]
     o, lse = pl.pallas_call(
         kernel,
@@ -106,7 +109,7 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=out_shapes,
         scratch_shapes=[
@@ -118,7 +121,7 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
-    return o[:, :seq_q], lse[:, :seq_q]
+    return o[:, :seq_q], lse[:, :seq_q, 0]
 
 
 def _bwd_blockwise(q, k, v, o, lse, do, scale, causal, block_k):

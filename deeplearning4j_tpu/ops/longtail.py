@@ -1,0 +1,610 @@
+"""Declarable-op long tail, tranche 3 — spatial/batch reshuffles, merge ops,
+unsorted segments, quantization, loss stragglers, RNN sequence runners, and
+morphology (ref: libnd4j ``ops/declarable/generic/{transforms,parity_ops,
+recurrent,quantization,loss}`` groups, SURVEY N3 — the ~500-op registry this
+library mirrors).
+
+Same conventions as ``standard.py``: arrays traced, attrs static, NHWC.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import exec_op, register
+
+# ------------------------------------------------- spatial/batch reshuffles
+
+
+@register("space_to_batch", aliases=["SpaceToBatch"])
+def space_to_batch(x, block_size=2, paddings=((0, 0), (0, 0))):
+    """NHWC (N,H,W,C) → (N·b², H/b, W/b, C) (ref: parity_ops space_to_batch;
+    TF dilated-conv building block)."""
+    return space_to_batch_nd(x, (int(block_size),) * 2, paddings)
+
+
+@register("batch_to_space", aliases=["BatchToSpace"])
+def batch_to_space(x, block_size=2, crops=((0, 0), (0, 0))):
+    return batch_to_space_nd(x, (int(block_size),) * 2, crops)
+
+
+@register("space_to_batch_nd", aliases=["SpaceToBatchND"])
+def space_to_batch_nd(x, block_shape, paddings):
+    block_shape = [int(b) for b in np.atleast_1d(block_shape)]
+    m = len(block_shape)
+    pads = [(0, 0)] + [tuple(int(v) for v in p) for p in paddings] \
+        + [(0, 0)] * (x.ndim - 1 - m)
+    x = jnp.pad(x, pads)
+    n = x.shape[0]
+    # (N, H/b1, b1, W/b2, b2, C...) → (b1, b2, N, H/b1, W/b2, C...)
+    shape = [n]
+    for i, b in enumerate(block_shape):
+        shape += [x.shape[1 + i] // b, b]
+    shape += list(x.shape[1 + m:])
+    x = x.reshape(shape)
+    perm = [2 * i + 2 for i in range(m)] + [0] \
+        + [2 * i + 1 for i in range(m)] \
+        + list(range(2 * m + 1, x.ndim))
+    x = x.transpose(perm)
+    out_shape = [n * int(np.prod(block_shape))] \
+        + [x.shape[m + 1 + i] for i in range(m)] + list(x.shape[2 * m + 1:])
+    return x.reshape(out_shape)
+
+
+@register("batch_to_space_nd", aliases=["BatchToSpaceND"])
+def batch_to_space_nd(x, block_shape, crops):
+    block_shape = [int(b) for b in np.atleast_1d(block_shape)]
+    m = len(block_shape)
+    prod_b = int(np.prod(block_shape))
+    n = x.shape[0] // prod_b
+    x = x.reshape(block_shape + [n] + list(x.shape[1:]))
+    perm = [m]
+    for i in range(m):
+        perm += [m + 1 + i, i]
+    perm += list(range(2 * m + 1, x.ndim))
+    x = x.transpose(perm)
+    shape = [n] + [x.shape[1 + 2 * i] * block_shape[i] for i in range(m)] \
+        + list(x.shape[2 * m + 1:])
+    x = x.reshape(shape)
+    idx = [slice(None)]
+    for i, (lo, hi) in enumerate(tuple(tuple(int(v) for v in c)
+                                       for c in crops)):
+        idx.append(slice(lo, x.shape[1 + i] - hi))
+    return x[tuple(idx)]
+
+
+@register("mirror_pad", aliases=["MirrorPad"])
+def mirror_pad(x, paddings, mode="REFLECT"):
+    mode = {"REFLECT": "reflect", "SYMMETRIC": "symmetric"}[str(mode).upper()]
+    pads = [tuple(int(v) for v in p) for p in np.asarray(paddings)]
+    return jnp.pad(x, pads, mode=mode)
+
+
+@register("col2im")
+def col2im(cols, kernel, out_hw, strides=(1, 1), padding="VALID"):
+    """Inverse of im2col: scatter-add (N,OH,OW,KH·KW·C) patches back to the
+    (N,H,W,C) image (ref: libnd4j col2im helper — conv backward building
+    block)."""
+    kh, kw = (int(k) for k in kernel)
+    sh, sw = (int(s) for s in strides)
+    h, w = (int(v) for v in out_hw)
+    n, oh, ow, _ = cols.shape
+    c = cols.shape[-1] // (kh * kw)
+    cols = cols.reshape(n, oh, ow, kh, kw, c)
+    if padding.upper() == "SAME":
+        ph = max((oh - 1) * sh + kh - h, 0)
+        pw = max((ow - 1) * sw + kw - w, 0)
+        pt, pl = ph // 2, pw // 2
+    else:
+        pt = pl = 0
+    pad_h = max((oh - 1) * sh + kh, h + pt)
+    pad_w = max((ow - 1) * sw + kw, w + pl)
+    # scatter-add every patch position in one batched index-add
+    oy = jnp.arange(oh) * sh
+    ox = jnp.arange(ow) * sw
+    ky = jnp.arange(kh)
+    kx = jnp.arange(kw)
+    yy = (oy[:, None] + ky[None, :]).reshape(-1)          # (OH*KH,)
+    xx = (ox[:, None] + kx[None, :]).reshape(-1)          # (OW*KW,)
+    # flatten to linear indices over (H_pad, W_pad)
+    cols_t = cols.transpose(0, 1, 3, 2, 4, 5).reshape(n, oh * kh, ow * kw, c)
+    flat = jnp.zeros((n, pad_h * pad_w, c), cols.dtype)
+    lin = (yy[:, None] * pad_w + xx[None, :]).reshape(-1)
+    flat = flat.at[:, lin].add(cols_t.reshape(n, -1, c))
+    img = flat.reshape(n, pad_h, pad_w, c)
+    return img[:, pt:pt + h, pl:pl + w]
+
+
+@register("dilation2d", aliases=["Dilation2D"])
+def dilation2d(x, w, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Morphological dilation: out = max over window of (x + w) (ref:
+    parity_ops dilation2d; TF kernel semantics)."""
+    sh, sw = (int(s) for s in strides)
+    rh, rw = (int(r) for r in rates)
+    kh, kw, c = w.shape
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    pad = padding.upper()
+    if pad == "SAME":
+        eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        oh = -(-x.shape[1] // sh)
+        ow = -(-x.shape[2] // sw)
+        ph = max((oh - 1) * sh + eff_kh - x.shape[1], 0)
+        pw = max((ow - 1) * sw + eff_kw - x.shape[2], 0)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)),
+                    constant_values=neg)
+    outs = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = x[:, i * rh: x.shape[1] - (kh - 1 - i) * rh or None: 1,
+                   j * rw: x.shape[2] - (kw - 1 - j) * rw or None: 1]
+            outs.append(sl[:, ::sh, ::sw] + w[i, j])
+    oh = min(o.shape[1] for o in outs)
+    ow = min(o.shape[2] for o in outs)
+    return jnp.max(jnp.stack([o[:, :oh, :ow] for o in outs]), axis=0)
+
+
+@register("maxpool_with_argmax", num_outputs=2, aliases=["MaxPoolWithArgmax"])
+def maxpool_with_argmax(x, kernel=(2, 2), strides=None, padding="VALID"):
+    """Returns (pooled, argmax indices) with TF's flat-index convention
+    ``((y * W) + x) * C + c`` — ref: parity_ops max_pool_with_argmax /
+    TF MaxPoolWithArgmax."""
+    kh, kw = (int(k) for k in kernel)
+    strides = strides or (kh, kw)
+    patches = exec_op("extract_image_patches", x, ksizes=(kh, kw),
+                      strides=strides, rates=(1, 1), padding=padding)
+    n, oh, ow, _ = patches.shape
+    c = x.shape[-1]
+    patches = patches.reshape(n, oh, ow, kh * kw, c)
+    pooled = jnp.max(patches, axis=3)
+    within = jnp.argmax(patches, axis=3)                  # (N,OH,OW,C)
+    sh, sw = (int(s) for s in strides)
+    oy = jnp.arange(oh)[None, :, None, None] * sh
+    ox = jnp.arange(ow)[None, None, :, None] * sw
+    ky, kx = within // kw, within % kw
+    cc = jnp.arange(c)[None, None, None, :]
+    flat = ((oy + ky) * x.shape[2] + (ox + kx)) * c + cc
+    return pooled, flat.astype(jnp.int32)
+
+
+@register("upsampling3d", aliases=["Upsampling3D"])
+def upsampling3d(x, scale=2):
+    """(N,D,H,W,C) nearest-neighbor ×scale (ref: convo/upsampling3d.cpp)."""
+    s = int(scale)
+    return jnp.repeat(jnp.repeat(jnp.repeat(x, s, axis=1), s, axis=2),
+                      s, axis=3)
+
+
+@register("deconv3d", aliases=["DeConv3D", "Conv3DTranspose"])
+def deconv3d(x, w, b=None, strides=(1, 1, 1), padding="SAME"):
+    """(N,D,H,W,C) transposed conv, weights (KD,KH,KW,Cin,Cout)."""
+    pad = padding.upper()
+    out = lax.conv_transpose(x, w, strides=tuple(int(s) for s in strides),
+                             padding=pad,
+                             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    return out + b if b is not None else out
+
+
+@register("sconv2d", aliases=["SeparableConv2D", "separable_conv2d"])
+def sconv2d(x, depth_w, point_w=None, b=None, strides=(1, 1), padding="SAME"):
+    """Separable conv: depthwise then optional 1×1 pointwise (ref:
+    convo/sconv2d.cpp)."""
+    out = exec_op("depthwise_conv2d", x, depth_w, strides=strides,
+                  padding=padding)
+    if point_w is not None:
+        out = lax.conv_general_dilated(
+            out, point_w, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + b if b is not None else out
+
+
+@register("pointwise_conv2d", aliases=["PointwiseConv2D"])
+def pointwise_conv2d(x, w, b=None):
+    out = lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return out + b if b is not None else out
+
+
+# --------------------------------------------------------------- merge ops
+register("mergeadd", lambda *xs: sum(xs[1:], xs[0]),
+         aliases=["MergeAdd", "mergesum", "accumulate_n"])
+register("mergeavg", lambda *xs: sum(xs[1:], xs[0]) / len(xs),
+         aliases=["MergeAvg"])
+register("mergemax", lambda *xs: jnp.max(jnp.stack(xs), axis=0),
+         aliases=["MergeMax"])
+register("mergemaxindex",
+         lambda *xs: jnp.argmax(jnp.stack(xs), axis=0).astype(jnp.int32),
+         aliases=["MergeMaxIndex"])
+
+
+# ------------------------------------------------------- unsorted segments
+def _unsorted(reducer, init):
+    def op(data, segment_ids, num_segments):
+        n = int(num_segments)
+        out = jnp.full((n,) + data.shape[1:], init, data.dtype)
+        return reducer(out.at[segment_ids], data)
+    return op
+
+
+register("unsorted_segment_sum",
+         lambda d, i, n: jnp.zeros((int(n),) + d.shape[1:], d.dtype)
+         .at[i].add(d), aliases=["UnsortedSegmentSum"])
+register("unsorted_segment_max",
+         _unsorted(lambda at, d: at.max(d), -jnp.inf),
+         aliases=["UnsortedSegmentMax"])
+register("unsorted_segment_min",
+         _unsorted(lambda at, d: at.min(d), jnp.inf),
+         aliases=["UnsortedSegmentMin"])
+register("unsorted_segment_prod",
+         _unsorted(lambda at, d: at.multiply(d), 1),
+         aliases=["UnsortedSegmentProd"])
+
+
+@register("unsorted_segment_mean", aliases=["UnsortedSegmentMean"])
+def unsorted_segment_mean(data, segment_ids, num_segments):
+    n = int(num_segments)
+    tot = jnp.zeros((n,) + data.shape[1:], data.dtype).at[segment_ids].add(data)
+    cnt = jnp.zeros((n,), data.dtype).at[segment_ids].add(1.0)
+    cnt = jnp.maximum(cnt, 1).reshape((n,) + (1,) * (data.ndim - 1))
+    return tot / cnt
+
+
+# ------------------------------------------------------------ quantization
+@register("fake_quant_with_min_max_args", aliases=["FakeQuantWithMinMaxArgs"])
+def fake_quant_args(x, min=-6.0, max=6.0, num_bits=8, narrow_range=False):
+    return _fake_quant(x, jnp.asarray(min, jnp.float32),
+                       jnp.asarray(max, jnp.float32), int(num_bits),
+                       bool(narrow_range))
+
+
+@register("fake_quant_with_min_max_vars",
+          aliases=["FakeQuantWithMinMaxVars",
+                   "fake_quant_with_min_max_vars_per_channel",
+                   "FakeQuantWithMinMaxVarsPerChannel"])
+def fake_quant_vars(x, minv, maxv, num_bits=8, narrow_range=False):
+    return _fake_quant(x, minv, maxv, int(num_bits), bool(narrow_range))
+
+
+def _fake_quant(x, minv, maxv, num_bits, narrow):
+    """TF fake-quant nudging semantics (ref: quantization group)."""
+    qmin = 1.0 if narrow else 0.0
+    qmax = float(2 ** num_bits - 1)
+    scale = (maxv - minv) / (qmax - qmin)
+    zp_f = qmin - minv / scale
+    nudged_zp = jnp.clip(jnp.round(zp_f), qmin, qmax)
+    nmin = (qmin - nudged_zp) * scale
+    nmax = (qmax - nudged_zp) * scale
+    xc = jnp.clip(x, nmin, nmax)
+    return jnp.round((xc - nmin) / scale) * scale + nmin
+
+
+@register("compare_and_bitpack", aliases=["CompareAndBitpack"])
+def compare_and_bitpack(x, threshold):
+    """Pack (…, 8k) boolean comparisons into uint8 bytes, MSB-first."""
+    bits = (x > threshold).astype(jnp.uint8)
+    b = bits.reshape(bits.shape[:-1] + (bits.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(b * weights, axis=-1).astype(jnp.uint8)
+
+
+# ------------------------------------------------------------------ losses
+register("l2_loss", lambda x: 0.5 * jnp.sum(jnp.square(x)),
+         aliases=["L2Loss"])
+
+
+@register("log_poisson_loss", aliases=["LogPoissonLoss"])
+def log_poisson_loss(log_input, targets, full=False):
+    loss = jnp.exp(log_input) - targets * log_input
+    if full:
+        # Stirling approximation term for the full loss
+        t = targets
+        stirling = t * jnp.log(jnp.maximum(t, 1e-12)) - t \
+            + 0.5 * jnp.log(jnp.maximum(2 * jnp.pi * t, 1e-12))
+        loss = loss + jnp.where(t > 1, stirling, jnp.zeros_like(t))
+    return loss
+
+
+@register("mean_pairwssqerr_loss", aliases=["MeanPairwsSqErrLoss"])
+def mean_pairwssqerr_loss(predictions, labels):
+    """Pairwise squared-error (ref: loss/meanPairWsSqErr.cpp — TF
+    mean_pairwise_squared_error). Matches TF's implementation-defined
+    scalar-weight behavior: per-sample term1−term2 with the denominator N
+    being the TOTAL present element count (a `_num_present` quirk), then a
+    batch SUM."""
+    d = (predictions - labels).reshape(predictions.shape[0], -1)
+    n_total = d.size
+    sum_d = jnp.sum(d, axis=-1)
+    sum_d2 = jnp.sum(d * d, axis=-1)
+    term1 = 2.0 * sum_d2 / max(n_total - 1, 1)
+    term2 = 2.0 * sum_d ** 2 / max(n_total * (n_total - 1), 1)
+    return jnp.sum(term1 - term2)
+
+
+# ------------------------------------------------------------- misc math
+register("log_sigmoid", jax.nn.log_sigmoid, aliases=["LogSigmoid"])
+register("crelu", lambda x, axis=-1: jax.nn.relu(
+    jnp.concatenate([x, -x], axis=axis)), aliases=["CRelu"])
+register("axpy", lambda x, y, a=1.0: a * x + y, aliases=["Axpy"])
+register("assign", lambda x, y: jnp.broadcast_to(y, x.shape).astype(x.dtype),
+         aliases=["Assign"])
+
+
+@register("zeta", aliases=["Zeta"])
+def zeta(x, q):
+    """Hurwitz zeta via Euler–Maclaurin (ref: parity_ops zeta.cpp)."""
+    return jax.scipy.special.zeta(x, q)
+
+
+@register("percentile", aliases=["Percentile"])
+def percentile(x, q=50.0, axis=None, interpolation="linear"):
+    return jnp.percentile(x, q, axis=axis, method=str(interpolation))
+
+
+@register("nth_element", aliases=["NthElement"])
+def nth_element(x, n, reverse=False):
+    """n-th order statistic along the last axis (ref: parity_ops
+    nth_element.cpp)."""
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., int(n)]
+
+
+@register("clip_by_global_norm", aliases=["ClipByGlobalNorm"])
+def clip_by_global_norm(*tensors, clip_norm=1.0):
+    g = jnp.sqrt(sum(jnp.sum(jnp.square(t)) for t in tensors))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g, 1e-12))
+    out = tuple(t * scale for t in tensors)
+    return out if len(out) > 1 else out[0]
+
+
+@register("clip_by_avg_norm", aliases=["ClipByAvgNorm"])
+def clip_by_avg_norm(x, clip_norm=1.0):
+    avg = jnp.linalg.norm(x.ravel()) / x.size
+    return x * jnp.minimum(1.0, clip_norm / jnp.maximum(avg, 1e-12))
+
+
+@register("choose", num_outputs=2, aliases=["Choose"])
+def choose(x, scalar=0.0, mode=0):
+    """Filter x by comparison against scalar; returns (matching values
+    compacted to the front with zero fill, count) — ref: parity_ops
+    choose.cpp  modes 0..5 = lt/gt/eq/ne/le/ge."""
+    cmps = [x < scalar, x > scalar, x == scalar, x != scalar,
+            x <= scalar, x >= scalar]
+    m = cmps[int(mode)].ravel()
+    flat = x.ravel()
+    order = jnp.argsort(~m, stable=True)
+    vals = jnp.where(jnp.sort(~m, stable=True) == 0, flat[order], 0)
+    return vals.reshape(x.shape), jnp.sum(m).astype(jnp.int32)
+
+
+# ------------------------------------------------------------------ color
+_YIQ = np.array([[0.299, 0.587, 0.114],
+                 [0.5959, -0.2746, -0.3213],
+                 [0.2115, -0.5227, 0.3112]], np.float32)
+
+
+register("rgb_to_yiq", lambda x: x @ jnp.asarray(_YIQ.T, x.dtype),
+         aliases=["RgbToYiq"])
+register("yiq_to_rgb",
+         lambda x: x @ jnp.asarray(np.linalg.inv(_YIQ).T, x.dtype),
+         aliases=["YiqToRgb"])
+
+
+# ------------------------------------------------------------------ image
+@register("draw_bounding_boxes", aliases=["DrawBoundingBoxes"])
+def draw_bounding_boxes(images, boxes, colors=None):
+    """Paint 1-px box outlines; boxes (N,B,4) normalized [y1,x1,y2,x2]
+    (ref: parity_ops draw_bounding_boxes.cpp). Vectorized mask build —
+    no per-pixel host loop."""
+    n, h, w, c = images.shape
+    nb = boxes.shape[1]
+    if colors is None:
+        colors = jnp.ones((nb, c), images.dtype)
+    ys = jnp.arange(h)[None, None, :]                      # (1,1,H)
+    xs = jnp.arange(w)[None, None, :]
+    y1 = jnp.round(boxes[..., 0] * (h - 1))[..., None]     # (N,B,1)
+    x1 = jnp.round(boxes[..., 1] * (w - 1))[..., None]
+    y2 = jnp.round(boxes[..., 2] * (h - 1))[..., None]
+    x2 = jnp.round(boxes[..., 3] * (w - 1))[..., None]
+    in_y = (ys >= y1) & (ys <= y2)                         # (N,B,H)
+    in_x = (xs >= x1) & (xs <= x2)                         # (N,B,W)
+    edge_y = (ys == y1) | (ys == y2)
+    edge_x = (xs == x1) | (xs == x2)
+    mask = (edge_y[:, :, :, None] & in_x[:, :, None, :]) \
+        | (in_y[:, :, :, None] & edge_x[:, :, None, :])    # (N,B,H,W)
+    out = images
+    for b in range(nb):
+        mb = mask[:, b, :, :, None]
+        out = jnp.where(mb, colors[b].reshape(1, 1, 1, c).astype(out.dtype),
+                        out)
+    return out
+
+
+@register("non_max_suppression_overlaps",
+          aliases=["NonMaxSuppressionWithOverlaps"])
+def nms_overlaps(overlaps, scores, max_output_size, overlap_threshold=0.5,
+                 score_threshold=-jnp.inf):
+    """NMS on a precomputed pairwise overlap matrix (ref: image ops
+    non_max_suppression_overlaps)."""
+    k = int(max_output_size)
+    overlaps = jnp.asarray(overlaps)
+    scores = jnp.asarray(scores)
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    valid0 = scores[order] > score_threshold
+
+    def body(i, state):
+        keep, sup = state
+        cand = order[i]
+        ok = valid0[i] & ~sup[i]
+        keep = keep.at[i].set(jnp.where(ok, cand, -1))
+        row = overlaps[cand][order] > overlap_threshold
+        sup = jnp.where(ok, sup | row, sup)
+        sup = sup.at[i].set(sup[i] | ~ok)
+        return keep, sup
+
+    keep, _ = lax.fori_loop(0, n, body,
+                            (jnp.full((n,), -1, jnp.int32),
+                             jnp.zeros((n,), bool)))
+    # keep is already score-descending (it follows `order`); compact the
+    # surviving entries to the front, preserving that order (TF returns the
+    # top-k survivors by score, not by box index)
+    alive = keep >= 0
+    pos = jnp.argsort(~alive, stable=True)
+    sel = jnp.where(jnp.sort(~alive, stable=True) == 0, keep[pos], -1)
+    return sel[:k].astype(jnp.int32)
+
+
+@register("random_crop", aliases=["RandomCrop"])
+def random_crop(x, size, seed=0):
+    key = jax.random.key(int(seed))
+    size = tuple(int(s) for s in size)
+    starts = []
+    for i, s in enumerate(size):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, x.shape[i] - s + 1))
+    return lax.dynamic_slice(x, starts, size)
+
+
+# -------------------------------------------------------- RNN runners
+@register("static_rnn", num_outputs=2,
+          aliases=["StaticRNN", "dynamic_rnn", "DynamicRNN"])
+def static_rnn(x, h0, c0, w, b, cell="lstm", forget_bias=0.0):
+    """Run a cell over (N,T,C) via lax.scan (ref: recurrent static_rnn.cpp /
+    dynamic_rnn.cpp — identical math on TPU; 'dynamic' time-major handling
+    is a transpose at the call site). Returns (outputs, final state).
+
+    For ``cell="gru"``, ``w``/``b`` pack the two GRU weight groups:
+    ``w = (w_rz, w_h)`` and ``b = (b_rz, b_h)`` (gru_cell's signature)."""
+    def step(carry, xt):
+        if cell == "lstm":
+            h, c = carry
+            h, c = exec_op("lstm_cell", xt, h, c, w, b,
+                           forget_bias=forget_bias)
+            return (h, c), h
+        w_rz, w_h = w
+        b_rz, b_h = b
+        h = exec_op("gru_cell", xt, carry[0], w_rz, w_h, b_rz, b_h)
+        return (h, carry[1]), h
+
+    (hN, cN), ys = lax.scan(step, (h0, c0), x.transpose(1, 0, 2))
+    return ys.transpose(1, 0, 2), (hN, cN)
+
+
+@register("static_bidirectional_rnn", num_outputs=2,
+          aliases=["StaticBidirectionalRNN", "dynamic_bidirectional_rnn",
+                   "DynamicBidirectionalRNN"])
+def static_bidirectional_rnn(x, h0f, c0f, wf, bf, h0b, c0b, wb, bb,
+                             cell="lstm", forget_bias=0.0):
+    """Forward + time-reversed backward pass, concat on features."""
+    yf, sf = static_rnn(x, h0f, c0f, wf, bf, cell=cell,
+                        forget_bias=forget_bias)
+    yb, sb = static_rnn(jnp.flip(x, axis=1), h0b, c0b, wb, bb, cell=cell,
+                        forget_bias=forget_bias)
+    return jnp.concatenate([yf, jnp.flip(yb, axis=1)], axis=-1), (sf, sb)
+
+
+@register("lstm_block", num_outputs=2, aliases=["LSTMBlock"])
+def lstm_block(x, h0, c0, w, b, forget_bias=1.0):
+    """Whole-sequence fused LSTM (ref: recurrent/lstmBlock.cpp) — same scan
+    as lstm_layer but with TF-style forget-bias default."""
+    return exec_op("lstm_layer", x, h0, c0, w, b, forget_bias=forget_bias)
+
+
+@register("sru", num_outputs=2, aliases=["SRU"])
+def sru(x, c0, w, b):
+    """Simple Recurrent Unit over (N,T,C) (ref: recurrent/sru.cpp). The
+    matmuls batch over the whole sequence (MXU-friendly); only the light
+    elementwise recurrence runs in the scan."""
+    n, t, d = x.shape
+    proj = x @ w                                           # (N,T,3D)
+    xt_, f_, r_ = jnp.split(proj, 3, axis=-1)
+    bf, br = jnp.split(b, 2)
+    f = jax.nn.sigmoid(f_ + bf)
+    r = jax.nn.sigmoid(r_ + br)
+
+    def step(c, inp):
+        xt, ft, rt, xraw = inp
+        c = ft * c + (1 - ft) * xt
+        h = rt * jnp.tanh(c) + (1 - rt) * xraw
+        return c, h
+
+    cN, hs = lax.scan(step, c0, (xt_.transpose(1, 0, 2),
+                                 f.transpose(1, 0, 2),
+                                 r.transpose(1, 0, 2),
+                                 x.transpose(1, 0, 2)))
+    return hs.transpose(1, 0, 2), cN
+
+
+@register("sru_bi", num_outputs=2, aliases=["SRUBi"])
+def sru_bi(x, c0f, wf, bf, c0b, wb, bb):
+    hf, cf = sru(x, c0f, wf, bf)
+    hb, cb = sru(jnp.flip(x, axis=1), c0b, wb, bb)
+    return jnp.concatenate([hf, jnp.flip(hb, axis=1)], axis=-1), (cf, cb)
+
+
+# ------------------------------------------------------- fused NLP steps
+@register("skipgram", aliases=["SkipGram", "sg"])
+def skipgram(syn0, syn1neg, center, context, neg, lr=0.025):
+    """Fused skip-gram negative-sampling update (ref: libnd4j sg/cbow
+    natives — the word2vec hot loop, SURVEY D15). Returns updated
+    (syn0, syn1neg). Pure-functional twin of nlp/word2vec's jitted batch
+    step, exposed as a registry op for parity."""
+    v_in = syn0[center]                                    # (B,D)
+    tgt = jnp.concatenate([context[:, None], neg], axis=1)  # (B,1+K)
+    lbl = jnp.concatenate([jnp.ones_like(context[:, None]),
+                           jnp.zeros_like(neg)], axis=1).astype(syn0.dtype)
+    v_out = syn1neg[tgt]                                   # (B,1+K,D)
+    logits = jnp.einsum("bd,bkd->bk", v_in, v_out)
+    g = (lbl - jax.nn.sigmoid(logits)) * lr                # (B,1+K)
+    d_in = jnp.einsum("bk,bkd->bd", g, v_out)
+    d_out = jnp.einsum("bk,bd->bkd", g, v_in)
+    syn0 = syn0.at[center].add(d_in)
+    syn1neg = syn1neg.at[tgt.reshape(-1)].add(
+        d_out.reshape(-1, d_out.shape[-1]))
+    return syn0, syn1neg
+
+
+@register("cbow", aliases=["CBOW"])
+def cbow(syn0, syn1neg, context_words, target, neg, lr=0.025):
+    """Fused CBOW negative-sampling update; context (B,W) averaged."""
+    v_in = jnp.mean(syn0[context_words], axis=1)           # (B,D)
+    tgt = jnp.concatenate([target[:, None], neg], axis=1)
+    lbl = jnp.concatenate([jnp.ones_like(target[:, None]),
+                           jnp.zeros_like(neg)], axis=1).astype(syn0.dtype)
+    v_out = syn1neg[tgt]
+    logits = jnp.einsum("bd,bkd->bk", v_in, v_out)
+    g = (lbl - jax.nn.sigmoid(logits)) * lr
+    d_in = jnp.einsum("bk,bkd->bd", g, v_out) / context_words.shape[1]
+    d_out = jnp.einsum("bk,bd->bkd", g, v_in)
+    syn0 = syn0.at[context_words.reshape(-1)].add(
+        jnp.repeat(d_in, context_words.shape[1], axis=0))
+    syn1neg = syn1neg.at[tgt.reshape(-1)].add(
+        d_out.reshape(-1, d_out.shape[-1]))
+    return syn0, syn1neg
+
+
+# ----------------------------------------------------- fused attention op
+@register("multi_head_dot_product_attention", num_outputs=1,
+          aliases=["MultiHeadDotProductAttentionOp"])
+def mh_attention(q, k, v, wq, wk, wv, wo, mask=None, causal=False):
+    """Projected multi-head attention as ONE registry op (ref: SameDiff
+    MultiHeadDotProductAttention, SURVEY 5.7). Inputs (N,T,D); heads from
+    wq (D, H, Dh)."""
+    def proj(x, w):
+        return jnp.einsum("ntd,dhk->nhtk", x, w)
+
+    qh, kh, vh = proj(q, wq), proj(k, wk), proj(v, wv)
+    s = jnp.einsum("nhqk,nhmk->nhqm", qh, kh) / np.sqrt(qh.shape[-1])
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        cm = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(cm, s, -1e30)
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("nhqm,nhmk->nhqk", p, vh)
+    return jnp.einsum("nhtk,hkd->ntd", o, wo)

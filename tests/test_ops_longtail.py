@@ -1,0 +1,322 @@
+"""Tranche-3 long-tail op tests (ops/longtail.py) — crosschecked against
+TensorFlow where the reference op mirrors TF semantics (the reference's own
+conformance style, SURVEY §4 TF-import corpus), else against numpy."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.registry import exec_op
+
+tf = pytest.importorskip("tensorflow")
+
+
+def rnd(*s, seed=0):
+    return np.random.default_rng(seed).normal(size=s).astype(np.float32)
+
+
+class TestSpatial:
+    def test_space_to_batch_roundtrip_vs_tf(self):
+        x = rnd(2, 4, 6, 3)
+        got = exec_op("space_to_batch", x, block_size=2,
+                      paddings=((0, 0), (0, 0)))
+        want = tf.nn.space_to_batch(x, [2, 2], [[0, 0], [0, 0]]).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+        back = exec_op("batch_to_space", got, block_size=2,
+                       crops=((0, 0), (0, 0)))
+        np.testing.assert_allclose(np.asarray(back), x, rtol=1e-6)
+
+    def test_space_to_batch_padded(self):
+        x = rnd(1, 3, 5, 2, seed=1)
+        got = exec_op("space_to_batch", x, block_size=2,
+                      paddings=((1, 0), (1, 0)))
+        want = tf.nn.space_to_batch(x, [2, 2], [[1, 0], [1, 0]]).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_mirror_pad_vs_tf(self):
+        x = rnd(2, 3, seed=2)
+        for mode in ("REFLECT", "SYMMETRIC"):
+            got = exec_op("mirror_pad", x, paddings=[[1, 1], [2, 1]],
+                          mode=mode)
+            want = tf.pad(x, [[1, 1], [2, 1]], mode=mode).numpy()
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_col2im_inverts_im2col_ones(self):
+        # im2col → col2im equals multiplying each pixel by its patch count
+        x = np.ones((1, 6, 6, 2), np.float32)
+        cols = exec_op("im2col", x, kernel=(3, 3), strides=(3, 3),
+                       padding="VALID")
+        img = exec_op("col2im", cols, kernel=(3, 3), out_hw=(6, 6),
+                      strides=(3, 3), padding="VALID")
+        np.testing.assert_allclose(np.asarray(img), x)  # disjoint patches
+
+    def test_dilation2d_vs_tf(self):
+        x = rnd(1, 6, 6, 2, seed=3)
+        w = rnd(3, 3, 2, seed=4) * 0.1
+        got = exec_op("dilation2d", x, w, strides=(1, 1), rates=(1, 1),
+                      padding="SAME")
+        want = tf.nn.dilation2d(x, w, strides=[1, 1, 1, 1],
+                                padding="SAME", data_format="NHWC",
+                                dilations=[1, 1, 1, 1]).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_maxpool_with_argmax_vs_tf(self):
+        x = rnd(2, 4, 4, 3, seed=5)
+        pooled, idx = exec_op("maxpool_with_argmax", x, kernel=(2, 2))
+        want_p, want_i = tf.nn.max_pool_with_argmax(x, 2, 2, "VALID")
+        np.testing.assert_allclose(np.asarray(pooled), want_p.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(idx),
+                                      want_i.numpy().astype(np.int32))
+
+    def test_deconv3d_shape(self):
+        x = rnd(1, 3, 3, 3, 4, seed=6)
+        w = rnd(2, 2, 2, 4, 5, seed=7) * 0.1
+        out = exec_op("deconv3d", x, w, strides=(2, 2, 2), padding="SAME")
+        assert out.shape == (1, 6, 6, 6, 5)
+
+    def test_sconv2d_matches_depthwise_plus_pointwise(self):
+        x = rnd(1, 5, 5, 3, seed=8)
+        dw = rnd(3, 3, 3, 1, seed=9) * 0.2
+        pw = rnd(1, 1, 3, 6, seed=10) * 0.2
+        got = exec_op("sconv2d", x, dw, pw)
+        want = tf.nn.separable_conv2d(x, dw, pw, strides=[1, 1, 1, 1],
+                                      padding="SAME").numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_upsampling3d(self):
+        x = rnd(1, 2, 2, 2, 3, seed=11)
+        out = exec_op("upsampling3d", x, scale=2)
+        assert out.shape == (1, 4, 4, 4, 3)
+        np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0],
+                                   np.asarray(out)[0, 1, 1, 1])
+
+
+class TestMergeSegmentsQuant:
+    def test_merge_ops(self):
+        xs = [rnd(3, 4, seed=i) for i in range(3)]
+        np.testing.assert_allclose(np.asarray(exec_op("mergeadd", *xs)),
+                                   sum(xs), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(exec_op("mergeavg", *xs)),
+                                   sum(xs) / 3, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(exec_op("mergemax", *xs)),
+                                   np.max(np.stack(xs), 0), rtol=1e-6)
+        assert exec_op("mergemaxindex", *xs).dtype == jnp.int32
+
+    @pytest.mark.parametrize("kind", ["sum", "mean", "min", "max", "prod"])
+    def test_unsorted_segments_vs_tf(self, kind):
+        data = rnd(6, 3, seed=20)
+        ids = np.array([0, 2, 0, 1, 2, 2], np.int32)
+        got = exec_op(f"unsorted_segment_{kind}", data, ids, 4)
+        tf_fn = getattr(tf.math, f"unsorted_segment_{kind}")
+        want = tf_fn(data, ids, 4).numpy()
+        # empty segments: TF fills sum/mean with 0, min/max with ±inf-like
+        # extremes; compare only non-empty rows for min/max/prod
+        rows = [0, 1, 2] if kind in ("min", "max", "prod") else range(4)
+        np.testing.assert_allclose(np.asarray(got)[list(rows)],
+                                   want[list(rows)], rtol=1e-5)
+
+    def test_fake_quant_vs_tf(self):
+        x = np.linspace(-7, 7, 23).astype(np.float32)
+        got = exec_op("fake_quant_with_min_max_args", x, min=-6.0, max=6.0)
+        want = tf.quantization.fake_quant_with_min_max_args(
+            x, min=-6.0, max=6.0).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_compare_and_bitpack(self):
+        x = np.array([[1, -1, 2, -2, 3, -3, 4, -4]], np.float32)
+        got = exec_op("compare_and_bitpack", x, 0.0)
+        want = np.packbits((x > 0.0).astype(np.uint8), axis=-1)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestLossesMath:
+    def test_l2_loss(self):
+        x = rnd(4, 5, seed=30)
+        np.testing.assert_allclose(float(exec_op("l2_loss", x)),
+                                   tf.nn.l2_loss(x).numpy(), rtol=1e-5)
+
+    def test_log_poisson_loss(self):
+        logx, t = rnd(8, seed=31), np.abs(rnd(8, seed=32))
+        got = exec_op("log_poisson_loss", logx, t)
+        want = tf.nn.log_poisson_loss(t, logx).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_mean_pairwise_sqerr_vs_tf(self):
+        p, l = rnd(4, 6, seed=33), rnd(4, 6, seed=34)
+        got = float(exec_op("mean_pairwssqerr_loss", p, l))
+        want = float(tf.compat.v1.losses.mean_pairwise_squared_error(
+            labels=l, predictions=p).numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_zeta_log_sigmoid_crelu(self):
+        np.testing.assert_allclose(float(exec_op("zeta", 2.0, 1.0)),
+                                   np.pi ** 2 / 6, rtol=1e-4)
+        x = rnd(5, seed=35)
+        np.testing.assert_allclose(np.asarray(exec_op("log_sigmoid", x)),
+                                   np.log(1 / (1 + np.exp(-x))), rtol=1e-5)
+        assert exec_op("crelu", x).shape == (10,)
+
+    def test_percentile_nth_element(self):
+        x = rnd(3, 7, seed=36)
+        np.testing.assert_allclose(
+            np.asarray(exec_op("percentile", x, q=50.0, axis=1)),
+            np.percentile(x, 50.0, axis=1), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(exec_op("nth_element", x, 2)),
+            np.sort(x, axis=-1)[:, 2], rtol=1e-6)
+
+    def test_clip_by_global_norm_vs_tf(self):
+        ts = [rnd(3, 3, seed=40), rnd(5, seed=41)]
+        got = exec_op("clip_by_global_norm", *ts, clip_norm=0.5)
+        want, _ = tf.clip_by_global_norm([tf.constant(t) for t in ts], 0.5)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), w.numpy(), rtol=1e-5)
+
+    def test_choose(self):
+        x = np.array([3.0, -1.0, 2.0, -5.0], np.float32)
+        vals, cnt = exec_op("choose", x, scalar=0.0, mode=1)  # gt
+        assert int(cnt) == 2
+        assert set(np.asarray(vals)[:2].tolist()) == {3.0, 2.0}
+
+    def test_axpy_assign(self):
+        x, y = rnd(4, seed=42), rnd(4, seed=43)
+        np.testing.assert_allclose(np.asarray(exec_op("axpy", x, y, a=2.0)),
+                                   2 * x + y, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(exec_op("assign", x, y)), y)
+
+
+class TestColorImage:
+    def test_yiq_roundtrip_vs_tf(self):
+        x = np.random.default_rng(0).uniform(size=(4, 4, 3)).astype(np.float32)
+        got = exec_op("rgb_to_yiq", x)
+        want = tf.image.rgb_to_yiq(x).numpy()
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4)
+        back = exec_op("yiq_to_rgb", got)
+        np.testing.assert_allclose(np.asarray(back), x, atol=1e-4)
+
+    def test_draw_bounding_boxes(self):
+        img = np.zeros((1, 8, 8, 3), np.float32)
+        boxes = np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)
+        out = np.asarray(exec_op("draw_bounding_boxes", img, boxes))
+        assert out[0, 0, 0].sum() > 0          # corner painted
+        assert out[0, 7, 7].sum() == 0         # outside untouched
+        assert out[0, 2, 2].sum() == 0         # interior untouched
+
+    def test_nms_overlaps(self):
+        overlaps = np.array([[1.0, 0.9, 0.0],
+                             [0.9, 1.0, 0.0],
+                             [0.0, 0.0, 1.0]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        sel = np.asarray(exec_op("non_max_suppression_overlaps",
+                                 overlaps, scores, 3, 0.5))
+        kept = [s for s in sel.tolist() if s >= 0]
+        assert kept == [0, 2]
+
+    def test_nms_overlaps_topk_by_score(self):
+        # non-overlapping boxes: truncation must keep the BEST scorer,
+        # not the lowest box index (TF semantics)
+        overlaps = np.eye(3, dtype=np.float32)
+        scores = np.array([0.1, 0.9, 0.5], np.float32)
+        sel = np.asarray(exec_op("non_max_suppression_overlaps",
+                                 overlaps, scores, 1, 0.5))
+        assert sel.tolist() == [1]
+
+    def test_random_crop(self):
+        x = rnd(8, 8, 3, seed=50)
+        out = exec_op("random_crop", x, (4, 4, 3), seed=7)
+        assert out.shape == (4, 4, 3)
+
+
+class TestRNNRunners:
+    def test_static_rnn_matches_lstm_layer(self):
+        n, t, d, h = 2, 5, 3, 4
+        x = rnd(n, t, d, seed=60)
+        w = rnd(d + h, 4 * h, seed=61) * 0.2
+        b = np.zeros(4 * h, np.float32)
+        h0 = np.zeros((n, h), np.float32)
+        c0 = np.zeros((n, h), np.float32)
+        ys, (hN, cN) = exec_op("static_rnn", x, h0, c0, w, b)
+        ys2, (h2, c2) = exec_op("lstm_layer", x, h0, c0, w, b)
+        np.testing.assert_allclose(np.asarray(ys), np.asarray(ys2),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_static_rnn_gru_cell(self):
+        n, t, d, h = 2, 4, 3, 5
+        x = rnd(n, t, d, seed=67)
+        w = (rnd(d + h, 2 * h, seed=68) * 0.2, rnd(d + h, h, seed=69) * 0.2)
+        b = (np.zeros(2 * h, np.float32), np.zeros(h, np.float32))
+        h0 = np.zeros((n, h), np.float32)
+        ys, (hN, _) = exec_op("static_rnn", x, h0, h0, w, b, cell="gru")
+        assert ys.shape == (n, t, h)
+        np.testing.assert_allclose(np.asarray(ys)[:, -1], np.asarray(hN))
+
+    def test_bidirectional_concat(self):
+        n, t, d, h = 2, 4, 3, 5
+        x = rnd(n, t, d, seed=62)
+        mk = lambda s: (np.zeros((n, h), np.float32),
+                        np.zeros((n, h), np.float32),
+                        rnd(d + h, 4 * h, seed=s) * 0.2,
+                        np.zeros(4 * h, np.float32))
+        h0f, c0f, wf, bf = mk(63)
+        h0b, c0b, wb, bb = mk(64)
+        ys, _ = exec_op("static_bidirectional_rnn", x, h0f, c0f, wf, bf,
+                        h0b, c0b, wb, bb)
+        assert ys.shape == (n, t, 2 * h)
+        # forward half equals forward-only run
+        yf, _ = exec_op("static_rnn", x, h0f, c0f, wf, bf)
+        np.testing.assert_allclose(np.asarray(ys)[..., :h], np.asarray(yf),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sru_shapes_and_grad(self):
+        n, t, d = 2, 6, 4
+        x = jnp.asarray(rnd(n, t, d, seed=65))
+        w = jnp.asarray(rnd(d, 3 * d, seed=66) * 0.3)
+        b = jnp.zeros((2 * d,))
+        c0 = jnp.zeros((n, d))
+        hs, cN = exec_op("sru", x, c0, w, b)
+        assert hs.shape == (n, t, d) and cN.shape == (n, d)
+        g = jax.grad(lambda w: exec_op("sru", x, c0, w, b)[0].sum())(w)
+        assert np.isfinite(np.asarray(g)).all()
+        hb, _ = exec_op("sru_bi", x, c0, w, b, c0, w, b)
+        assert hb.shape == (n, t, 2 * d)
+
+
+class TestFusedNLPAttention:
+    def test_skipgram_moves_embeddings(self):
+        v, d = 20, 8
+        syn0 = jnp.asarray(rnd(v, d, seed=70) * 0.1)
+        syn1 = jnp.asarray(rnd(v, d, seed=72) * 0.1)
+        center = jnp.asarray([1, 2], jnp.int32)
+        context = jnp.asarray([3, 4], jnp.int32)
+        neg = jnp.asarray([[5, 6], [7, 8]], jnp.int32)
+        s0, s1 = exec_op("skipgram", syn0, syn1, center, context, neg)
+        assert not np.allclose(np.asarray(s0)[1], np.asarray(syn0)[1])
+        assert np.allclose(np.asarray(s0)[10], np.asarray(syn0)[10])
+
+    def test_cbow_runs(self):
+        v, d = 20, 8
+        syn0 = jnp.asarray(rnd(v, d, seed=71) * 0.1)
+        syn1 = jnp.zeros((v, d))
+        ctx = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        tgt = jnp.asarray([7, 8], jnp.int32)
+        neg = jnp.asarray([[9], [10]], jnp.int32)
+        s0, s1 = exec_op("cbow", syn0, syn1, ctx, tgt, neg)
+        assert np.isfinite(np.asarray(s0)).all()
+
+    def test_mh_attention_matches_manual(self):
+        n, t, dm, h, dh = 2, 5, 8, 2, 4
+        q = jnp.asarray(rnd(n, t, dm, seed=80))
+        wq = jnp.asarray(rnd(dm, h, dh, seed=81) * 0.3)
+        wk = jnp.asarray(rnd(dm, h, dh, seed=82) * 0.3)
+        wv = jnp.asarray(rnd(dm, h, dh, seed=83) * 0.3)
+        wo = jnp.asarray(rnd(h, dh, dm, seed=84) * 0.3)
+        out = exec_op("multi_head_dot_product_attention", q, q, q,
+                      wq, wk, wv, wo, causal=True)
+        assert out.shape == (n, t, dm)
+        g = jax.grad(lambda w: exec_op(
+            "multi_head_dot_product_attention", q, q, q, w, wk, wv, wo,
+            causal=True).sum())(wq)
+        assert np.isfinite(np.asarray(g)).all()
