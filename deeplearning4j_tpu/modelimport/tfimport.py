@@ -557,6 +557,192 @@ def _map_nodes(ctx: _ImportCtx, nodes, skip=frozenset()):
             outs[0].rename(node.name)
 
 
+def _emit_v1_loop(ctx: _ImportCtx, loop):
+    """Rewrite one analyzed V1 frame into sd.while_loop (see
+    tf_v1_control_flow module docstring)."""
+    lib = ctx.library
+    lv_refs = [e.input[0] for e in loop.enters]
+    inv_refs = [e.input[0] for e in loop.inv_enters]
+    operands = [ctx.vars[_fq(r)] for r in lv_refs + inv_refs]
+    n_lv = len(loop.enters)
+
+    def cond_build(sub, *state):
+        c = _ImportCtx(sub, library=lib)
+        for m, st in zip(loop.merges, state[:n_lv]):
+            c.vars[m.name + ":0"] = st
+        for e, st in zip(loop.inv_enters, state[n_lv:]):
+            c.vars[e.name + ":0"] = st
+        _map_nodes_auto(c, loop.cond_nodes)
+        return c.vars[_fq(loop.loop_cond.input[0])]
+
+    def body_build(sub, *state):
+        c = _ImportCtx(sub, library=lib)
+        for m, sw, st in zip(loop.merges, loop.switches, state[:n_lv]):
+            c.vars[m.name + ":0"] = st
+            if sw is not None:
+                # Switch:1 (output_true) feeds the body; seed :0 too so any
+                # stray consumer resolves to the same per-iteration value
+                c.vars[sw.name + ":1"] = st
+                c.vars[sw.name + ":0"] = st
+        for e, st in zip(loop.inv_enters, state[n_lv:]):
+            c.vars[e.name + ":0"] = st
+        # _auto: a V1 tf.cond inside the body is rewritten recursively
+        _map_nodes_auto(c, loop.body_nodes)
+        outs = [c.vars[_fq(ni.input[0])] for ni in loop.next_iters]
+        outs += list(state[n_lv:])        # invariants pass through unchanged
+        return outs if len(outs) > 1 else outs[0]
+
+    res = ctx.sd.while_loop(cond_build, body_build, *operands,
+                            name=loop.frame.split("/")[-1] or "v1_while")
+    res = res if isinstance(res, tuple) else (res,)
+    for i, ex in enumerate(loop.exits):
+        if ex is not None:
+            ctx.vars[ex.name + ":0"] = res[i]
+
+
+def _emit_v1_cond(ctx: _ImportCtx, group):
+    """Rewrite one V1 tf.cond call (a CondGroup — possibly multi-output)
+    into ONE sd.if_cond; branch nodes are traced once per branch, not once
+    per output."""
+    pred = ctx.vars[_fq(group.pred_ref)]
+    operands = [ctx.vars[_fq(s.input[0])] for s in group.switches]
+
+    def make(take_refs):
+        def build(sub, *args):
+            c = _ImportCtx(sub, library=ctx.library)
+            for s, a in zip(group.switches, args):
+                c.vars[s.name + ":0"] = a
+                c.vars[s.name + ":1"] = a
+            _map_nodes_auto(c, group.branch_nodes)
+            outs = [c.vars[_fq(r)] for r in take_refs]
+            return outs if len(outs) > 1 else outs[0]
+        return build
+
+    out = ctx.sd.if_cond(pred, make(group.true_refs),
+                         make(group.false_refs), *operands,
+                         name=group.merges[0].name.replace("/", "_"))
+    outs = out if isinstance(out, tuple) else (out,)
+    for m, o in zip(group.merges, outs):
+        ctx.vars[m.name + ":0"] = o
+
+
+def _cond_ready(ctx, group):
+    return _fq(group.pred_ref) in ctx.vars and all(
+        _fq(s.input[0]) in ctx.vars for s in group.switches)
+
+
+def _map_nodes_v1(ctx: _ImportCtx, nodes, skip=frozenset()):
+    """Node walk for GraphDefs containing V1 control flow: loop frames and
+    Switch/Merge conds are emitted as functional composites at the point
+    their outer inputs are all available; their internal nodes are skipped
+    from the plain walk."""
+    from deeplearning4j_tpu.modelimport.tf_v1_control_flow import (
+        analyze_conds, analyze_loops)
+
+    try:
+        loops = analyze_loops(nodes)
+        loop_names = set().union(*(l.all_names for l in loops)) \
+            if loops else set()
+        conds = analyze_conds(nodes, loop_names)
+    except ValueError as e:
+        raise TFImportError(str(e)) from e
+    member_loop = {}
+    for l in loops:
+        for nm in l.all_names:
+            member_loop[nm] = l
+    cond_by_merge = {}
+    for c in conds:
+        for m in c.merges:
+            cond_by_merge[m.name] = c
+    cond_skip = set().union(*(c.skip_names for c in conds)) \
+        if conds else set()
+
+    # V1 tf.cond pivot plumbing (the pred Switch + switch_t/switch_f/pred_id
+    # Identities) is consumed only over CONTROL edges — sweep any leftover
+    # Switch, and any Identity chained off a swept node, whose tensor
+    # outputs have no live data consumer
+    name_set = {n.name for n in nodes}
+    data_consumers = {}
+    for n in nodes:
+        for ref in n.input:
+            if not ref.startswith("^"):
+                data_consumers.setdefault(ref.split(":")[0], set()) \
+                    .add(n.name)
+    by_name = {n.name: n for n in nodes}
+    dead = set()
+    changed = True
+    while changed:
+        changed = False
+        for n in nodes:
+            if n.name in dead or n.name in cond_skip:
+                continue
+            live = {c for c in data_consumers.get(n.name, set())
+                    if c not in dead and c not in cond_skip
+                    and c not in member_loop}
+            if live:
+                continue
+            src = n.input[0].split(":")[0].lstrip("^") if n.input else None
+            if n.op == "Switch" or (
+                    n.op == "Identity" and src in dead) or (
+                    n.op == "Identity" and src in name_set
+                    and by_name[src].op == "Switch"
+                    and n.name not in member_loop):
+                if n.name not in member_loop and n.name not in cond_by_merge:
+                    dead.add(n.name)
+                    changed = True
+    cond_skip |= dead
+
+    emitted = set()
+    plain = []
+
+    def loop_ready(l):
+        return all(_fq(e.input[0]) in ctx.vars
+                   for e in l.enters + l.inv_enters)
+
+    for node in nodes:
+        l = member_loop.get(node.name)
+        if l is not None:
+            if id(l) not in emitted:
+                # flush plain nodes mapped so far, then emit when the
+                # outer inputs are all present (topo order ⇒ by the time
+                # any Merge appears, Enter inputs were walked)
+                _map_nodes(ctx, plain, skip=skip)
+                plain = []
+                if loop_ready(l):
+                    _emit_v1_loop(ctx, l)
+                    emitted.add(id(l))
+            continue
+        c = cond_by_merge.get(node.name)
+        if c is not None:
+            if id(c) not in emitted:
+                _map_nodes(ctx, plain, skip=skip)
+                plain = []
+                if _cond_ready(ctx, c):
+                    _emit_v1_cond(ctx, c)
+                    emitted.add(id(c))
+            continue
+        if node.name in cond_skip:
+            continue
+        plain.append(node)
+    _map_nodes(ctx, plain, skip=skip)
+    missing = [l.frame for l in loops if id(l) not in emitted] \
+        + [c.merges[0].name for c in conds if id(c) not in emitted]
+    if missing:
+        raise TFImportError(f"V1 control-flow regions never became "
+                            f"emittable (inputs unmapped): {missing}")
+
+
+def _map_nodes_auto(ctx: _ImportCtx, nodes, skip=frozenset()):
+    """Plain walk, upgraded to the V1 control-flow walk when the node list
+    itself contains Switch/Merge regions (cond-in-loop recursion)."""
+    from deeplearning4j_tpu.modelimport.tf_v1_control_flow import (
+        has_v1_control_flow)
+    if has_v1_control_flow(nodes):
+        _map_nodes_v1(ctx, nodes, skip=skip)
+    else:
+        _map_nodes(ctx, nodes, skip=skip)
+
+
 def _fdef_builder(fdef, library):
     """FunctionDef → a control-flow body builder fn(sub_sd, *args)."""
     def build(sub_sd, *args):
@@ -581,7 +767,12 @@ class TFGraphMapper:
                    for f in getattr(gd, "library", ()).function} \
             if gd.HasField("library") else {}
         ctx = _ImportCtx(sd, library=library)
-        _map_nodes(ctx, gd.node, skip=set(ignore_nodes))
+        from deeplearning4j_tpu.modelimport.tf_v1_control_flow import (
+            has_v1_control_flow)
+        if has_v1_control_flow(gd.node):
+            _map_nodes_v1(ctx, gd.node, skip=set(ignore_nodes))
+        else:
+            _map_nodes(ctx, gd.node, skip=set(ignore_nodes))
         return sd
 
     importGraph = import_graph

@@ -153,19 +153,31 @@ def maxpool_with_argmax(x, kernel=(2, 2), strides=None, padding="VALID"):
     TF MaxPoolWithArgmax."""
     kh, kw = (int(k) for k in kernel)
     strides = strides or (kh, kw)
+    sh, sw = (int(s) for s in strides)
+    h, w, c = x.shape[1], x.shape[2], x.shape[-1]
+    pt = pl_ = 0
+    if padding.upper() == "SAME":
+        # explicit -inf pad (extract_image_patches zero-pads, which would
+        # beat genuine negative maxima) and index math in UNPADDED coords
+        oh_s, ow_s = -(-h // sh), -(-w // sw)
+        ph = max((oh_s - 1) * sh + kh - h, 0)
+        pw = max((ow_s - 1) * sw + kw - w, 0)
+        pt, pl_ = ph // 2, pw // 2
+        neg = (jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating)
+               else jnp.iinfo(x.dtype).min)
+        x = jnp.pad(x, ((0, 0), (pt, ph - pt), (pl_, pw - pl_), (0, 0)),
+                    constant_values=neg)
     patches = exec_op("extract_image_patches", x, ksizes=(kh, kw),
-                      strides=strides, rates=(1, 1), padding=padding)
+                      strides=strides, rates=(1, 1), padding="VALID")
     n, oh, ow, _ = patches.shape
-    c = x.shape[-1]
     patches = patches.reshape(n, oh, ow, kh * kw, c)
     pooled = jnp.max(patches, axis=3)
     within = jnp.argmax(patches, axis=3)                  # (N,OH,OW,C)
-    sh, sw = (int(s) for s in strides)
-    oy = jnp.arange(oh)[None, :, None, None] * sh
-    ox = jnp.arange(ow)[None, None, :, None] * sw
+    oy = jnp.arange(oh)[None, :, None, None] * sh - pt
+    ox = jnp.arange(ow)[None, None, :, None] * sw - pl_
     ky, kx = within // kw, within % kw
     cc = jnp.arange(c)[None, None, None, :]
-    flat = ((oy + ky) * x.shape[2] + (ox + kx)) * c + cc
+    flat = ((oy + ky) * w + (ox + kx)) * c + cc
     return pooled, flat.astype(jnp.int32)
 
 

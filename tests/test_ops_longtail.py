@@ -70,6 +70,19 @@ class TestSpatial:
         np.testing.assert_array_equal(np.asarray(idx),
                                       want_i.numpy().astype(np.int32))
 
+    def test_maxpool_with_argmax_same_negative(self):
+        # SAME padding: all-negative input must not pool the zero pad, and
+        # indices must be in unpadded coordinates (TF semantics)
+        x = -np.abs(rnd(1, 4, 4, 1, seed=51)) - 0.5
+        pooled, idx = exec_op("maxpool_with_argmax", x, kernel=(3, 3),
+                              strides=(1, 1), padding="SAME")
+        want_p, want_i = tf.nn.max_pool_with_argmax(
+            x, 3, strides=1, padding="SAME")
+        np.testing.assert_allclose(np.asarray(pooled), want_p.numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(idx),
+                                      want_i.numpy().astype(np.int32))
+
     def test_deconv3d_shape(self):
         x = rnd(1, 3, 3, 3, 4, seed=6)
         w = rnd(2, 2, 2, 4, 5, seed=7) * 0.1

@@ -1,0 +1,202 @@
+"""V1 (frame-based) control-flow import: Enter/Merge/Switch/NextIteration/
+Exit loops and Switch/Merge conds, rebuilt as functional while/cond (ref:
+AbstractSession's frame interpreter, SURVEY.md:314-317). Graphs are generated
+by real TF-v1 graph mode and outputs compared against a tf.compat.v1.Session
+— the reference's golden-conformance style."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
+
+
+def _run_tf(graph, fetches, feed):
+    with tf.compat.v1.Session(graph=graph) as s:
+        return s.run(fetches, feed)
+
+
+@pytest.fixture(autouse=True)
+def _v1_control_flow():
+    tf.compat.v1.disable_control_flow_v2()
+    yield
+    tf.compat.v1.enable_control_flow_v2()
+
+
+class TestV1While:
+    def test_counter_accumulator_loop(self):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [2, 3], name="x")
+            i0 = tf.constant(0, name="i0")
+
+            def cond(i, acc):
+                return tf.less(i, 5)
+
+            def body(i, acc):
+                return tf.add(i, 1), acc * 1.1 + 1.0
+
+            _, acc = tf.compat.v1.while_loop(cond, body, [i0, x],
+                                             name="loop")
+            out = tf.identity(acc, name="out")
+        gd = g.as_graph_def()
+        assert any(n.op == "Enter" for n in gd.node), "expected V1 frames"
+
+        xv = np.random.default_rng(0).normal(size=(2, 3)).astype(np.float32)
+        want = _run_tf(g, out, {x: xv})
+
+        sd = TFGraphMapper.import_graph(gd)
+        got = sd.output({"x": xv}, "out")["out"]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_loop_with_invariant_matmul(self):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [3, 3], name="x")
+            w = tf.constant(
+                np.random.default_rng(1).normal(size=(3, 3))
+                .astype(np.float32) * 0.3, name="w")
+            i0 = tf.constant(0)
+
+            def cond(i, h):
+                return i < 3
+
+            def body(i, h):
+                return i + 1, tf.tanh(tf.matmul(h, w))
+
+            _, h = tf.compat.v1.while_loop(cond, body, [i0, x], name="rnn")
+            out = tf.identity(h, name="out")
+        gd = g.as_graph_def()
+        xv = np.random.default_rng(2).normal(size=(3, 3)).astype(np.float32)
+        want = _run_tf(g, out, {x: xv})
+        sd = TFGraphMapper.import_graph(gd)
+        got = sd.output({"x": xv}, "out")["out"]
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_nested_frames_rejected(self):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [], name="x")
+
+            def outer_body(i, a):
+                def inner_body(j, b):
+                    return j + 1, b + 1.0
+
+                _, a2 = tf.compat.v1.while_loop(
+                    lambda j, b: j < 2, inner_body, [tf.constant(0), a])
+                return i + 1, a2
+
+            _, out = tf.compat.v1.while_loop(
+                lambda i, a: i < 2, outer_body, [tf.constant(0), x])
+            tf.identity(out, name="out")
+        gd = g.as_graph_def()
+        with pytest.raises(Exception, match="[Nn]ested"):
+            TFGraphMapper.import_graph(gd)
+
+
+class TestV1Cond:
+    def test_simple_cond(self):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [4], name="x")
+            p = tf.compat.v1.placeholder(tf.bool, [], name="p")
+            out = tf.compat.v1.cond(p, lambda: x + 1.0, lambda: x * 2.0)
+            out = tf.identity(out, name="out")
+        gd = g.as_graph_def()
+        assert any(n.op == "Switch" for n in gd.node)
+        assert not any(n.op == "Enter" for n in gd.node)
+
+        xv = np.arange(4, dtype=np.float32)
+        sd = TFGraphMapper.import_graph(gd)
+        for pv in (True, False):
+            want = _run_tf(g, out, {x: xv, p: pv})
+            got = sd.output({"x": xv, "p": np.asarray(pv)}, "out")["out"]
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_cond_const_true_branch(self):
+        # constant-only branch: connected to its Merge with only a pivot
+        # control edge — branch classification must use the pivot, not data
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [3], name="x")
+            p = tf.compat.v1.placeholder(tf.bool, [], name="p")
+            out = tf.compat.v1.cond(
+                p, lambda: tf.constant([9.0, 9.0, 9.0]), lambda: x * 2.0)
+            out = tf.identity(out, name="out")
+        gd = g.as_graph_def()
+        xv = np.arange(3, dtype=np.float32)
+        sd = TFGraphMapper.import_graph(gd)
+        for pv in (True, False):
+            want = _run_tf(g, out, {x: xv, p: pv})
+            got = sd.output({"x": xv, "p": np.asarray(pv)}, "out")["out"]
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_cond_multi_output_shared_nodes(self):
+        # two outputs sharing an intermediate — must fuse into ONE if_cond
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [4], name="x")
+            p = tf.compat.v1.placeholder(tf.bool, [], name="p")
+
+            def true_fn():
+                t = x + 1.0
+                return t, t * 2.0
+
+            def false_fn():
+                return x * 3.0, x * 4.0
+
+            a, b = tf.compat.v1.cond(p, true_fn, false_fn)
+            a = tf.identity(a, name="a")
+            b = tf.identity(b, name="b")
+        gd = g.as_graph_def()
+        xv = np.arange(4, dtype=np.float32)
+        sd = TFGraphMapper.import_graph(gd)
+        for pv in (True, False):
+            wa, wb = _run_tf(g, [a, b], {x: xv, p: pv})
+            got = sd.output({"x": xv, "p": np.asarray(pv)}, ["a", "b"])
+            np.testing.assert_allclose(np.asarray(got["a"]), wa, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(got["b"]), wb, rtol=1e-6)
+
+    def test_cond_inside_while_body(self):
+        # the common V1 shape: a conditional update inside a training loop
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [], name="x")
+
+            def body(i, a):
+                a2 = tf.compat.v1.cond(a < 10.0,
+                                       lambda: a * 2.0,
+                                       lambda: a + 1.0)
+                return i + 1, a2
+
+            _, out = tf.compat.v1.while_loop(
+                lambda i, a: i < 4, body, [tf.constant(0), x], name="lp")
+            tf.identity(out, name="out")
+        gd = g.as_graph_def()
+        sd = TFGraphMapper.import_graph(gd)
+        for xv in (1.0, 50.0):
+            want = _run_tf(g, g.get_tensor_by_name("out:0"),
+                           {x: np.float32(xv)})
+            got = sd.output({"x": np.float32(xv)}, "out")["out"]
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_cond_with_branch_compute(self):
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [2, 2], name="x")
+            p = tf.compat.v1.placeholder(tf.bool, [], name="p")
+            out = tf.compat.v1.cond(
+                p,
+                lambda: tf.nn.relu(x) + tf.reduce_sum(x),
+                lambda: tf.tanh(x) - 1.0)
+            out = tf.identity(out, name="out")
+        gd = g.as_graph_def()
+        xv = np.random.default_rng(3).normal(size=(2, 2)).astype(np.float32)
+        sd = TFGraphMapper.import_graph(gd)
+        for pv in (True, False):
+            want = _run_tf(g, out, {x: xv, p: pv})
+            got = sd.output({"x": xv, "p": np.asarray(pv)}, "out")["out"]
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                       atol=1e-6)
