@@ -204,17 +204,20 @@ def _branch_is_true(ref, by_name) -> bool:
     """Does this merge input come from the TRUE branch? Signals, in order:
     a data path to ``Switch:1`` (output_true), else a control edge to the
     ``switch_t`` pivot (an Identity of ``Switch:1``) — the only connection
-    a constant-only branch has."""
+    a constant-only branch has. Iterative (explicit stack), like every
+    other traversal here — deep unrolled branches must not hit the Python
+    recursion limit."""
     seen = set()
-
-    def walk(r):
+    stack = [ref]
+    while stack:
+        r = stack.pop()
         nm = _ref_node(r)
         if nm in seen:
-            return None
+            continue
         seen.add(nm)
         node = by_name.get(nm)
         if node is None:
-            return None
+            continue
         if node.op == "Switch":
             return r.endswith(":1")
         for cr in node.input:
@@ -226,17 +229,10 @@ def _branch_is_true(ref, by_name) -> bool:
                         return piv.input[0].endswith(":1")
         for dr in node.input:
             if not dr.startswith("^"):
-                res = walk(dr)
-                if res is not None:
-                    return res
-        return None
-
-    res = walk(ref)
-    if res is None:
-        raise ValueError(f"cannot classify V1 cond branch for merge input "
-                         f"{ref!r} (no Switch reachable by data or pivot "
-                         f"control edge)")
-    return res
+                stack.append(dr)
+    raise ValueError(f"cannot classify V1 cond branch for merge input "
+                     f"{ref!r} (no Switch reachable by data or pivot "
+                     f"control edge)")
 
 
 def analyze_conds(nodes, loop_names: set) -> List[CondGroup]:
@@ -282,23 +278,27 @@ def analyze_conds(nodes, loop_names: set) -> List[CondGroup]:
             t_ref, f_ref = n.input[1], n.input[0]
         raw.append((n, sws_a | sws_b, nodes_a | nodes_b, t_ref, f_ref))
 
-    # connected components over shared switches / shared branch nodes
-    groups: List[List[int]] = []
-    assigned = [-1] * len(raw)
-    for i, (_, sw_i, br_i, _, _) in enumerate(raw):
-        placed = -1
-        for gi, g in enumerate(groups):
-            for j in g:
-                if (sw_i & raw[j][1]) or (br_i & raw[j][2]):
-                    placed = gi
-                    break
-            if placed >= 0:
-                break
-        if placed >= 0:
-            groups[placed].append(i)
-        else:
-            groups.append([i])
-        assigned[i] = placed if placed >= 0 else len(groups) - 1
+    # connected components over shared switches / shared branch nodes —
+    # union-find, so a Merge that bridges two earlier components fuses
+    # them (first-match-append would split one tf.cond into two groups)
+    parent = list(range(len(raw)))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i in range(len(raw)):
+        for j in range(i):
+            if (raw[i][1] & raw[j][1]) or (raw[i][2] & raw[j][2]):
+                ri, rj = find(i), find(j)
+                if ri != rj:
+                    parent[ri] = rj
+    comp: Dict[int, List[int]] = {}
+    for i in range(len(raw)):
+        comp.setdefault(find(i), []).append(i)
+    groups = list(comp.values())
 
     out = []
     for g in groups:

@@ -71,6 +71,12 @@ class ComputationGraph:
             st = node.layer.init_state()
             if st:
                 self._states[name] = st
+        # strip weak types BEFORE opt init: weak-typed leaves would change
+        # signature after step 1 and retrace the jitted step (see
+        # utils.strengthen_dtypes)
+        from deeplearning4j_tpu.utils import strengthen_dtypes
+        self._params = strengthen_dtypes(self._params)
+        self._states = strengthen_dtypes(self._states)
         self._opt_state = self._opt.init(self._params)
         self._initialized = True
         return self

@@ -349,3 +349,36 @@ def test_computation_graph_tbptt_with_masks():
     cg.fit([ds])
     assert np.isfinite(cg.score())
     assert cg._iteration == 2
+
+
+def test_no_retrace_across_fit_steps():
+    """Weak-typed init leaves (e.g. jnp.full biases) change the jitted
+    step's signature after step 1 (weak->strong) and silently retrace the
+    whole-net train step on the 2nd AND 3rd calls — a full XLA recompile
+    each (~14 s on ResNet-50). init() strengthens dtypes so the first
+    trace is the only trace."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.RandomState(0)
+
+    net = zoo.LeNet().init_model()          # MultiLayerNetwork
+    x = rng.rand(4, 784).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.randint(0, 10, 4)]
+    before = MultiLayerNetwork._train_step._cache_size()
+    for _ in range(3):
+        net.fit(x, y)
+    assert MultiLayerNetwork._train_step._cache_size() - before == 1
+
+    m = zoo.SimpleCNN(num_classes=3, input_shape=(16, 16, 3))
+    gnet = m.init_model()
+    if isinstance(gnet, ComputationGraph):
+        xi = rng.rand(2, 16, 16, 3).astype("float32")
+        yi = np.eye(3, dtype="float32")[rng.randint(0, 3, 2)]
+        before = ComputationGraph._train_step._cache_size()
+        for _ in range(3):
+            gnet.fit(xi, yi)
+        assert ComputationGraph._train_step._cache_size() - before == 1

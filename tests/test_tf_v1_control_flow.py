@@ -159,6 +159,39 @@ class TestV1Cond:
             np.testing.assert_allclose(np.asarray(got["a"]), wa, rtol=1e-6)
             np.testing.assert_allclose(np.asarray(got["b"]), wb, rtol=1e-6)
 
+    def test_cond_three_outputs_bridging_merge(self):
+        # merge order a(x-only), b(y-only), c(x and y): c BRIDGES the two
+        # earlier components — grouping must union them (one if_cond)
+        g = tf.Graph()
+        with g.as_default():
+            x = tf.compat.v1.placeholder(tf.float32, [3], name="x")
+            y = tf.compat.v1.placeholder(tf.float32, [3], name="y")
+            p = tf.compat.v1.placeholder(tf.bool, [], name="p")
+
+            def true_fn():
+                fx, gy = x + 1.0, y * 2.0
+                return fx, gy, fx + gy
+
+            def false_fn():
+                fx, gy = x * 3.0, y - 1.0
+                return fx, gy, fx * gy
+
+            a, b, c = tf.compat.v1.cond(p, true_fn, false_fn)
+            a = tf.identity(a, name="a")
+            b = tf.identity(b, name="b")
+            c = tf.identity(c, name="c")
+        gd = g.as_graph_def()
+        xv = np.arange(3, dtype=np.float32)
+        yv = np.arange(3, dtype=np.float32) + 5
+        sd = TFGraphMapper.import_graph(gd)
+        for pv in (True, False):
+            wa, wb, wc = _run_tf(g, [a, b, c], {x: xv, y: yv, p: pv})
+            got = sd.output({"x": xv, "y": yv, "p": np.asarray(pv)},
+                            ["a", "b", "c"])
+            np.testing.assert_allclose(np.asarray(got["a"]), wa, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(got["b"]), wb, rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(got["c"]), wc, rtol=1e-6)
+
     def test_cond_inside_while_body(self):
         # the common V1 shape: a conditional update inside a training loop
         g = tf.Graph()
