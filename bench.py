@@ -53,9 +53,6 @@ class StepTimer:
     phases masquerade as a model difference."""
 
     def __init__(self, step, params, opt_state, toks, tgts, iters):
-        import jax
-
-        self._jax = jax
         self.step = step
         self.state = (params, opt_state)
         self.toks, self.tgts = toks, tgts

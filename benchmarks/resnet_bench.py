@@ -6,6 +6,9 @@ optimizer (SGD+momentum), same batch/dtype, and are measured INTERLEAVED
 (A,B,A,B…) with a per-window loss VALUE fetch as the sync point (bench.py's
 anti-relay-artifact rule). Prints one JSON line.
 
+Both sides sync per STEP (net.fit fetches its score scalar every batch, so
+the flax denominator fetches its loss every step too).
+
 Run: python benchmarks/resnet_bench.py [--smoke]   (--smoke: tiny CPU config)
 """
 from __future__ import annotations
@@ -71,7 +74,7 @@ def _flax_resnet50(num_classes, dtype):
     return ResNet50()
 
 
-def measure_flax(img_hw, num_classes, batch, iters, repeats, lr):
+def measure_flax(img_hw, num_classes, batch, iters, lr):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -84,7 +87,7 @@ def measure_flax(img_hw, num_classes, batch, iters, repeats, lr):
         jnp.asarray(rng.integers(0, num_classes, (batch,))), num_classes)
     variables = model.init(jax.random.key(0), x)
     params, batch_stats = variables["params"], variables["batch_stats"]
-    opt = optax.sgd(lr, momentum=0.9)
+    opt = optax.sgd(lr, momentum=0.9, nesterov=True)  # = ours (Nesterovs)
     opt_state = jax.jit(opt.init)(params)
 
     def loss_fn(p, bs, x, y):
@@ -112,14 +115,14 @@ def measure_flax(img_hw, num_classes, batch, iters, repeats, lr):
         t0 = time.perf_counter()
         for _ in range(iters):
             p, bs, s, loss = step(p, bs, s, x, y)
-        float(loss)                       # value fetch = sync
+            float(loss)   # per-STEP fetch, matching net.fit's score sync
         state = (p, bs, s)
         return batch * iters / (time.perf_counter() - t0)
 
     return window
 
 
-def measure_ours(img_hw, num_classes, batch, iters, repeats, lr):
+def measure_ours(img_hw, num_classes, batch, iters, lr):
     import numpy as np
 
     from deeplearning4j_tpu.models import zoo
@@ -170,8 +173,8 @@ def main():
     else:
         img_hw, classes, batch, iters, repeats = (224, 224), 1000, 32, 10, 3
 
-    ours = measure_ours(img_hw, classes, batch, iters, repeats, 0.1)
-    flax_w = measure_flax(img_hw, classes, batch, iters, repeats, 0.1)
+    ours = measure_ours(img_hw, classes, batch, iters, 0.1)
+    flax_w = measure_flax(img_hw, classes, batch, iters, 0.1)
 
     ours_runs, flax_runs = [], []
     for _ in range(repeats):

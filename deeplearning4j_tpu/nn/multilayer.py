@@ -152,7 +152,8 @@ class MultiLayerNetwork:
         return self._params
 
     def set_param_tree(self, tree):
-        self._params = tree
+        from deeplearning4j_tpu.utils import strengthen_dtypes
+        self._params = strengthen_dtypes(tree)   # weak leaves would retrace
 
     def state_tree(self):
         return self._states
