@@ -1,0 +1,40 @@
+"""Mixed-precision policy helpers (ref: `NeuralNetConfiguration.Builder
+#dataType` / `DataType.HALF`; TPU-first policy per BASELINE.md protocol:
+low-precision compute on the MXU, float32 master params / updater state /
+loss / running statistics)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# DataType.HALF maps to bfloat16 — the TPU half type. fp16 compute would
+# need a loss-scaling mechanism (fp16 max 65504 overflows activations and
+# its gradients underflow); bf16 shares f32's exponent range and needs
+# neither, which is why it is THE low-precision dtype on this hardware.
+_COMPUTE_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float16": jnp.bfloat16,
+    "half": jnp.bfloat16,
+}
+
+
+def _cast_float(a, dtype):
+    """Cast floating arrays; leave ints/bools (labels, indices) alone."""
+    a = jnp.asarray(a)
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        return a.astype(dtype)
+    return a
+
+
+def cast_params(tree, dtype):
+    """Cast a param pytree's floating leaves to the compute dtype."""
+    import jax
+    return jax.tree.map(lambda a: _cast_float(a, dtype), tree)
+
+
+def recast_like(ref_tree, tree):
+    """Cast ``tree``'s floating leaves back to ``ref_tree``'s dtypes —
+    keeps stored states/carries at their f32 master dtype across steps."""
+    import jax
+    return jax.tree.map(
+        lambda r, t: _cast_float(t, jnp.asarray(r).dtype), ref_tree, tree)

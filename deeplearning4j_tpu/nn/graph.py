@@ -124,16 +124,31 @@ class ComputationGraph:
         acts: Dict[str, jnp.ndarray] = {}
         new_states = dict(states)
         from deeplearning4j_tpu.nn.multilayer import _maybe_unflatten_input
+        from deeplearning4j_tpu.nn._precision import (_COMPUTE_DTYPES,
+                                                      _cast_float,
+                                                      cast_params,
+                                                      recast_like)
+        # mixed precision (see multilayer._forward): hidden nodes run in
+        # the compute dtype; output (loss-bearing) nodes and stored
+        # states/carries stay f32
+        cdtype = _COMPUTE_DTYPES.get(getattr(self.conf, "dtype", "float32"))
+        out_names = set(self.conf.network_outputs)
         in_types = list(self.conf.input_types) or [None] * len(self.conf.network_inputs)
         for name, x, it in zip(self.conf.network_inputs, inputs, in_types):
-            acts[name] = _maybe_unflatten_input(x, it)
+            h0 = _maybe_unflatten_input(x, it)
+            acts[name] = _cast_float(h0, cdtype) if cdtype is not None else h0
         mask = None
         if masks:
             mask = masks[0]
         for li, name in enumerate(self.conf.topo_order):
             node = self.conf.nodes[name]
             srcs = [acts[s] for s in node.inputs]
+            if cdtype is not None and name in out_names:
+                srcs = [_cast_float(s, jnp.float32) for s in srcs]
             if node.layer is not None:
+                lp = params.get(name, {})
+                if cdtype is not None and name not in out_names:
+                    lp = cast_params(lp, cdtype)
                 lrng = jax.random.fold_in(rng, li) if rng is not None else None
                 lst = states.get(name)
                 kwargs = {}
@@ -144,20 +159,25 @@ class ComputationGraph:
                     if carry0 is None:
                         carry0 = node.layer.initial_carry(srcs[0].shape[0])
                     h_in = node.layer._maybe_dropout(srcs[0], training, lrng)
-                    h, carry = node.layer.run(params.get(name, {}), h_in,
-                                              carry0, mask=mask)
+                    h, carry = node.layer.run(lp, h_in, carry0, mask=mask)
+                    if cdtype is not None:
+                        carry = recast_like(carry0, carry)
                     if carry_out is not None:
                         carry_out[name] = carry
                     st = lst
                 else:
-                    h, st = node.layer.apply(params.get(name, {}), srcs[0],
+                    h, st = node.layer.apply(lp, srcs[0],
                                              training=training, rng=lrng,
                                              state=lst, **kwargs)
                 if lst is not None and st is not None:
+                    if cdtype is not None:
+                        st = recast_like(lst, st)
                     new_states[name] = st
                 acts[name] = h
             else:
                 acts[name] = node.vertex.apply(srcs)
+        if cdtype is not None:
+            acts = {k: _cast_float(v, jnp.float32) for k, v in acts.items()}
         return acts, new_states
 
     def _output_layer_names(self) -> List[str]:

@@ -24,6 +24,8 @@ from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn import params as _flat
 from deeplearning4j_tpu.nn.conf.configuration import BackpropType, MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn._precision import (_COMPUTE_DTYPES, _cast_float,
+                                              cast_params, recast_like)
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -181,11 +183,24 @@ class MultiLayerNetwork:
         batch_n = x.shape[0]
         preprocs = getattr(self.conf, "input_pre_processors", None) or {}
         n_layers = len(self.layers) if up_to is None else up_to
+        # mixed precision (ref: NeuralNetConfiguration.Builder#dataType —
+        # DataType.HALF; TPU policy per BASELINE protocol: low-precision
+        # compute, f32 master params/updater/loss). Hidden layers run in the
+        # compute dtype; the FINAL layer and everything after it (softmax,
+        # loss, running stats, TBPTT carries) stays f32.
+        cdtype = _COMPUTE_DTYPES.get(getattr(self.conf, "dtype", "float32"))
+        last_idx = len(self.layers) - 1
+        if cdtype is not None:
+            h = _cast_float(h, cdtype)
         for i, layer in enumerate(self.layers[:n_layers]):
             if i in preprocs:   # explicit reference-API preprocessor
                 h = preprocs[i].pre_process(h, batch_size=batch_n)
             lkey = str(i)
             lp = params.get(lkey, {})
+            if cdtype is not None and i < last_idx:
+                lp = cast_params(lp, cdtype)
+            elif cdtype is not None:
+                h = _cast_float(h, jnp.float32)   # final layer in f32
             lst = states.get(lkey)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
             kwargs = {}
@@ -197,13 +212,24 @@ class MultiLayerNetwork:
                     carry0 = layer.initial_carry(h.shape[0])
                 h_in = layer._maybe_dropout(h, training, lrng)
                 h, carry = layer.run(lp, h_in, carry0, mask=mask)
+                if cdtype is not None:
+                    # carry dtype must stay stable across TBPTT chunks
+                    carry = recast_like(carry0, carry)
                 new_carries[lkey] = carry
             else:
                 h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)
                 if lst is not None and st is not None:
+                    if cdtype is not None:
+                        st = recast_like(lst, st)
                     new_states[lkey] = st
             if collect:
-                acts.append(h)
+                # collected activations are a public API surface
+                # (feedForward, TransferLearningHelper.featurize, stats
+                # listeners) — hand them out in f32 like the graph path
+                acts.append(_cast_float(h, jnp.float32)
+                            if cdtype is not None else h)
+        if cdtype is not None and not collect:
+            h = _cast_float(h, jnp.float32)
         return (acts if collect else h), new_states, new_carries
 
     def _regularization_penalty(self, params):

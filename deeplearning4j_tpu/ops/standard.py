@@ -275,7 +275,11 @@ def _conv_nd(x, w, strides, padding, dilation, dims, feature_group_count=1):
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dilation, dimension_numbers=num,
         feature_group_count=feature_group_count,
-        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None)
+        # no preferred_element_type: the MXU accumulates f32 internally
+        # regardless, and a f32-PET conv breaks the transpose (dW) rule
+        # under grad with bf16 inputs (mixed-dtype conv). bf16-in ->
+        # bf16-out matches the flax convention.
+        )
 
 
 def _pad_attr(padding, kernel, strides, dilation=None):
@@ -314,7 +318,11 @@ def conv2d_nchw(x, w, b=None, strides=(1, 1), padding=((0, 0), (0, 0)),
         rhs_dilation=tuple(dilation),
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=int(groups),
-        preferred_element_type=jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None)
+        # no preferred_element_type: the MXU accumulates f32 internally
+        # regardless, and a f32-PET conv breaks the transpose (dW) rule
+        # under grad with bf16 inputs (mixed-dtype conv). bf16-in ->
+        # bf16-out matches the flax convention.
+        )
     return out + b.reshape(1, -1, 1, 1) if b is not None else out
 
 

@@ -10,12 +10,18 @@ access).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("DL4J_TPU_TESTS") == "1":
+    # opt-in: run the suite against the real accelerator (backend-parametric
+    # testing, SURVEY §4 — the nd4j-native/nd4j-cuda classpath-swap analog).
+    # Only a single-device subset is expected to pass (no 8-device mesh).
+    import jax  # noqa: F401
+else:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+    import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,142 @@
+"""Mixed-precision policy (ref: NeuralNetConfiguration.Builder#dataType /
+DataType.HALF; BASELINE.md protocol "bf16 + f32 accum"): hidden compute in
+bfloat16, f32 master params / loss / running stats / carries."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.configuration import (BackpropType,
+                                                      NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam
+
+
+def _mlp_conf(dtype):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(1e-2)).data_type(dtype).list()
+            .layer(L.DenseLayer(n_out=32, activation="relu"))
+            .layer(L.BatchNormalization())
+            .layer(L.DenseLayer(n_out=16, activation="relu"))
+            .layer(L.OutputLayer(n_out=4, activation="softmax",
+                                 loss_function="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+
+
+def _data(n=32, f=12, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, f).astype("float32")
+    y = np.eye(c, dtype="float32")[rng.randint(0, c, n)]
+    return x, y
+
+
+class TestMLNMixedPrecision:
+    def test_bf16_trains_and_keeps_f32_masters(self):
+        net = MultiLayerNetwork(_mlp_conf("bfloat16")).init()
+        x, y = _data()
+        net.fit(x, y)
+        s0 = net.score()
+        for _ in range(20):
+            net.fit(x, y)
+        assert net.score() < s0
+        # master params, BN running stats, and loss all stay f32
+        for leaf in jax.tree.leaves(net._params):
+            assert leaf.dtype == jnp.float32
+        for leaf in jax.tree.leaves(net._states):
+            assert leaf.dtype == jnp.float32
+        out = net.output(x)
+        assert np.asarray(out).dtype == np.float32
+
+    def test_bf16_does_not_retrace(self):
+        net = MultiLayerNetwork(_mlp_conf("bfloat16")).init()
+        x, y = _data()
+        before = MultiLayerNetwork._train_step._cache_size()
+        for _ in range(3):
+            net.fit(x, y)
+        assert MultiLayerNetwork._train_step._cache_size() - before == 1
+
+    def test_bf16_close_to_f32(self):
+        x, y = _data(seed=3)
+        nets = {}
+        for dt in ("float32", "bfloat16"):
+            net = MultiLayerNetwork(_mlp_conf(dt)).init()
+            for _ in range(10):
+                net.fit(x, y)
+            nets[dt] = net.score()
+        # same trajectory to low precision: scores within 10% relative
+        assert abs(nets["bfloat16"] - nets["float32"]) \
+            < 0.1 * abs(nets["float32"]) + 0.05
+
+    def test_bf16_tbptt_lstm(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(1e-2)).data_type("bfloat16")
+                .list()
+                .backprop_type("tbptt").t_bptt_length(5)
+                .layer(L.LSTM(n_out=8))
+                .layer(L.RnnOutputLayer(n_out=3, activation="softmax",
+                                        loss_function="negativeloglikelihood"))
+                .set_input_type(InputType.recurrent(6))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(4, 15, 6).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, (4, 15))]
+        net.fit(x, y)
+        s0 = net.score()
+        for _ in range(10):
+            net.fit(x, y)
+        assert np.isfinite(net.score()) and net.score() < s0
+        # streaming inference stays functional in bf16 mode
+        step = net.rnnTimeStep(x[:, :1])
+        assert np.isfinite(np.asarray(step)).all()
+
+
+class TestGraphMixedPrecision:
+    def test_graph_bf16_trains(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gb = (NeuralNetConfiguration.builder()
+              .seed(5).updater(Adam(1e-2)).data_type("bfloat16")
+              .graph_builder().add_inputs("in")
+              .set_input_types(InputType.feed_forward(8)))
+        gb.add_layer("d1", L.DenseLayer(n_out=16, activation="relu"), "in")
+        gb.add_layer("d2", L.DenseLayer(n_out=16, activation="tanh"), "in")
+        from deeplearning4j_tpu.nn.graph_conf import MergeVertex
+        gb.add_vertex("merge", MergeVertex(), "d1", "d2")
+        gb.add_layer("out", L.OutputLayer(
+            n_out=3, activation="softmax",
+            loss_function="negativeloglikelihood"), "merge")
+        gb.set_outputs("out")
+        net = ComputationGraph(gb.build()).init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 8).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 16)]
+        net.fit(x, y)
+        s0 = net.score()
+        for _ in range(15):
+            net.fit(x, y)
+        assert net.score() < s0
+        for leaf in jax.tree.leaves(net._params):
+            assert leaf.dtype == jnp.float32
+
+
+def test_conv_bf16_grad_no_mixed_dtype_error():
+    """conv lowering must stay differentiable with bf16 inputs: a f32
+    preferred_element_type on the forward conv breaks the transpose (dW)
+    rule with a mixed-dtype conv error."""
+    from deeplearning4j_tpu.ops.registry import exec_op
+
+    p = {"W": jnp.ones((3, 3, 2, 4), jnp.float32) * 0.1,
+         "b": jnp.zeros((4,), jnp.float32)}
+    x = jnp.ones((2, 8, 8, 2), jnp.float32)
+
+    def f(p, x):
+        lp = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        z = exec_op("conv2d", x.astype(jnp.bfloat16), lp["W"], lp["b"])
+        z = exec_op("maxpool2d", z, kernel=(2, 2), strides=(2, 2))
+        return jnp.sum(z.astype(jnp.float32))
+
+    g = jax.grad(f)(p, x)
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(g))
