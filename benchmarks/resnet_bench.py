@@ -74,13 +74,14 @@ def _flax_resnet50(num_classes, dtype):
     return ResNet50()
 
 
-def measure_flax(img_hw, num_classes, batch, iters, lr):
+def measure_flax(img_hw, num_classes, batch, iters, lr, dtype="float32"):
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
-    model = _flax_resnet50(num_classes, jnp.float32)
+    model = _flax_resnet50(
+        num_classes, jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch,) + img_hw + (3,)), jnp.float32)
     y = jax.nn.one_hot(
@@ -122,7 +123,7 @@ def measure_flax(img_hw, num_classes, batch, iters, lr):
     return window
 
 
-def measure_ours(img_hw, num_classes, batch, iters, lr):
+def measure_ours(img_hw, num_classes, batch, iters, lr, dtype="float32"):
     import numpy as np
 
     from deeplearning4j_tpu.models import zoo
@@ -130,7 +131,8 @@ def measure_ours(img_hw, num_classes, batch, iters, lr):
 
     m = zoo.ResNet50(num_classes=num_classes,
                      input_shape=img_hw + (3,),
-                     updater=Nesterovs(lr, momentum=0.9))
+                     updater=Nesterovs(lr, momentum=0.9),
+                     data_type=dtype)
     net = m.init_model()
     rng = np.random.default_rng(0)
     x = rng.normal(size=(batch,) + img_hw + (3,)).astype(np.float32)
@@ -170,11 +172,14 @@ def main():
 
     if args.smoke or not on_tpu:
         img_hw, classes, batch, iters, repeats = (32, 32), 10, 4, 3, 2
+        dtype = "float32"
     else:
+        # bf16 compute on TPU (MXU rate); both sides use the same policy
         img_hw, classes, batch, iters, repeats = (224, 224), 1000, 32, 10, 3
+        dtype = "bfloat16"
 
-    ours = measure_ours(img_hw, classes, batch, iters, 0.1)
-    flax_w = measure_flax(img_hw, classes, batch, iters, 0.1)
+    ours = measure_ours(img_hw, classes, batch, iters, 0.1, dtype=dtype)
+    flax_w = measure_flax(img_hw, classes, batch, iters, 0.1, dtype=dtype)
 
     ours_runs, flax_runs = [], []
     for _ in range(repeats):
@@ -190,7 +195,8 @@ def main():
         "vs_baseline": round(ours_ips / flax_ips, 3),
         "flax_images_per_sec": round(flax_ips, 2),
         "platform": platform,
-        "config": {"img": list(img_hw), "classes": classes, "batch": batch},
+        "config": {"img": list(img_hw), "classes": classes, "batch": batch,
+                   "dtype": dtype},
     }))
 
 

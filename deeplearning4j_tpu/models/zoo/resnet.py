@@ -21,13 +21,16 @@ class ResNet50(ZooModel):
     input_shape = (224, 224, 3)
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(224, 224, 3), updater=None):
+                 input_shape=(224, 224, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
         # ref parity: ZooModel builders accept an updater override
         # (ResNet50.builder().updater(...)); default matches the reference
         self.updater = updater
+        # TPU extension: mixed-precision policy (nn/_precision)
+        self.data_type = data_type
 
     # ----- blocks (ref: ResNet50#convBlock / #identityBlock)
     def _conv_bn_act(self, g, name, inp, n_out, kernel, stride=(1, 1),
@@ -70,6 +73,7 @@ class ResNet50(ZooModel):
              .seed(self.seed)
              .updater(self.updater or Nesterovs(1e-1, 0.9))
              .weight_init("relu")
+             .data_type(self.data_type)
              .graph_builder()
              .add_inputs("input")
              .set_input_types(InputType.convolutional(h, w, c)))
