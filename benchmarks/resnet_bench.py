@@ -80,8 +80,10 @@ def measure_flax(img_hw, num_classes, batch, iters, lr, dtype="float32"):
     import numpy as np
     import optax
 
+    # same alias canonicalization as our side (nn/_precision)
+    from deeplearning4j_tpu.nn._precision import _COMPUTE_DTYPES
     model = _flax_resnet50(
-        num_classes, jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+        num_classes, _COMPUTE_DTYPES.get(dtype, jnp.float32))
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch,) + img_hw + (3,)), jnp.float32)
     y = jax.nn.one_hot(

@@ -87,7 +87,17 @@ class NeuralNetConfiguration:
         return self
 
     def data_type(self, dt: str):
-        self._dtype = dt
+        """ref: Builder#dataType(DataType). Normalized lowercase; unknown
+        values raise rather than silently training in f32."""
+        dt = str(dt).lower()
+        allowed = {"float32", "float", "single",          # f32 (default)
+                   "float64", "double",                   # accepted, runs f32
+                   "bfloat16", "bf16", "float16", "half"}  # bf16 compute
+        if dt not in allowed:
+            raise ValueError(f"data_type {dt!r} not supported; use one of "
+                             f"{sorted(allowed)}")
+        self._dtype = {"float": "float32", "single": "float32",
+                       "double": "float64"}.get(dt, dt)
         return self
 
     def gradient_normalization(self, kind: str, threshold: float = 1.0):
