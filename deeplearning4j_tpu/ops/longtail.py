@@ -620,3 +620,113 @@ def mh_attention(q, k, v, wq, wk, wv, wo, mask=None, causal=False):
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("nhqm,nhmk->nhqk", p, vh)
     return jnp.einsum("nhtk,hkd->ntd", o, wo)
+
+
+# ---------------------------------------------------- tranche-4 stragglers
+@register("maxout", aliases=["Maxout"])
+def maxout(x, channels=2):
+    """Maxout activation: max over groups of `channels` features (ref:
+    generic/nn/activations maxout.cpp)."""
+    c = int(channels)
+    shp = x.shape[:-1] + (x.shape[-1] // c, c)
+    return jnp.max(x.reshape(shp), axis=-1)
+
+
+register("stop_gradient", lax.stop_gradient,
+         aliases=["StopGradient", "stopgradient"])
+register("tri", lambda rows, cols=None, diag=0: jnp.tri(
+    int(rows), int(cols) if cols is not None else None, int(diag)),
+    aliases=["Tri"])
+
+
+@register("sufficient_statistics", num_outputs=3,
+          aliases=["SufficientStatistics"])
+def sufficient_statistics(x, axes):
+    """(count, mean_ss=Σx, var_ss=Σx²) over axes (ref: parity_ops
+    sufficient_statistics.cpp / tf.nn.sufficient_statistics)."""
+    axes = tuple(int(a) for a in np.atleast_1d(axes))
+    count = float(np.prod([x.shape[a] for a in axes]))
+    return (jnp.asarray(count, x.dtype), jnp.sum(x, axis=axes),
+            jnp.sum(jnp.square(x), axis=axes))
+
+
+# NOTE: no TF aliases here — standard.py's `batchnorm` owns the
+# FusedBatchNorm/V2/V3 alias family with the (x, mean, var, gamma, beta)
+# inference signature; this is the TRAINING-mode (scale, offset) form.
+@register("fused_batch_norm", num_outputs=3)
+def fused_batch_norm(x, scale, offset, mean=None, variance=None,
+                     epsilon=1e-3, is_training=True):
+    """TF training-mode FusedBatchNorm semantics: returns (y, batch_mean,
+    batch_var); NHWC. y normalizes with the BIASED batch variance, while
+    the returned batch_var is Bessel-corrected (N/(N-1)) — what TF feeds
+    the moving-variance update."""
+    if is_training or mean is None:
+        n = float(np.prod([x.shape[i] for i in (0, 1, 2)]))
+        mean = jnp.mean(x, axis=(0, 1, 2))
+        variance = jnp.var(x, axis=(0, 1, 2))
+        var_out = variance * (n / max(n - 1.0, 1.0))
+    else:
+        var_out = variance
+    inv = lax.rsqrt(variance + epsilon)
+    y = (x - mean) * inv * scale + offset
+    return y, mean, var_out
+
+
+@register("histogram", aliases=["Histogram"])
+def histogram(x, num_bins=10):
+    """Equal-width histogram over [min, max] (ref: parity_ops
+    histogram.cpp)."""
+    n = int(num_bins)
+    lo, hi = jnp.min(x), jnp.max(x)
+    width = jnp.maximum(hi - lo, 1e-12)
+    idx = jnp.clip(((x - lo) / width * n).astype(jnp.int32), 0, n - 1)
+    return jnp.zeros((n,), jnp.int64).at[idx.ravel()].add(1)
+
+
+@register("boolean_mask", num_outputs=2, aliases=["BooleanMask"])
+def boolean_mask(x, mask):
+    """Compact rows where mask is True to the front, zero-filled tail
+    (static-shape variant of tf.boolean_mask — XLA needs fixed shapes;
+    pair with the returned count). Returns (values, count)."""
+    m = jnp.ravel(mask).astype(bool)
+    flat = x.reshape((m.shape[0],) + x.shape[mask.ndim:])
+    order = jnp.argsort(~m, stable=True)
+    vals = jnp.where((jnp.sort(~m, stable=True) == 0)
+                     .reshape((-1,) + (1,) * (flat.ndim - 1)),
+                     flat[order], 0)
+    return vals, jnp.sum(m).astype(jnp.int32)
+
+
+@register("sparse_to_dense", aliases=["SparseToDense"])
+def sparse_to_dense(indices, dense_shape, values, default_value=0):
+    """COO scatter (ref: parity_ops sparse_to_dense.cpp). indices (N, R)."""
+    shape = tuple(int(s) for s in np.atleast_1d(dense_shape))
+    out = jnp.full(shape, default_value,
+                   values.dtype if hasattr(values, "dtype") else jnp.float32)
+    idx = tuple(jnp.asarray(indices)[:, i] for i in range(len(shape)))
+    return out.at[idx].set(values)
+
+
+@register("sparse_dense_matmul", aliases=["SparseTensorDenseMatMul"])
+def sparse_dense_matmul(indices, values, dense_shape, b):
+    """(sparse A in COO) @ (dense B) via scatter-free segment sum — the
+    rows of B gathered by A's column indices, scaled and summed per A-row.
+    TPU-friendly: one gather + one segment-sum, no host loop."""
+    a_rows = int(np.atleast_1d(dense_shape)[0])
+    idx = jnp.asarray(indices)
+    rows, cols = idx[:, 0], idx[:, 1]
+    contrib = values[:, None] * b[cols]                  # (nnz, N)
+    return jnp.zeros((a_rows, b.shape[1]), contrib.dtype) \
+        .at[rows].add(contrib)
+
+
+@register("log_matrix_determinant", num_outputs=2,
+          aliases=["LogMatrixDeterminant"])
+def log_matrix_determinant(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+register("reduce_sqnorm", lambda x, axis=None, keepdims=False:
+         jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims),
+         aliases=["SquaredNorm"])

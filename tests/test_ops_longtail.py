@@ -333,3 +333,70 @@ class TestFusedNLPAttention:
             "multi_head_dot_product_attention", q, q, q, w, wk, wv, wo,
             causal=True).sum())(wq)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestTranche4:
+    def test_maxout(self):
+        x = np.array([[1.0, 5.0, 2.0, 3.0]], np.float32)
+        out = exec_op("maxout", x, channels=2)
+        np.testing.assert_allclose(np.asarray(out), [[5.0, 3.0]])
+
+    def test_stop_gradient_tri_alias_integrity(self):
+        x = jnp.asarray([3.0, -2.0])
+        g = jax.grad(lambda x: exec_op("stop_gradient", x).sum())(x)
+        assert np.all(np.asarray(g) == 0)
+        assert exec_op("tri", 3).shape == (3, 3)
+        # alias families stay on their canonical owners (no clobbering)
+        from deeplearning4j_tpu.ops import registry
+        assert registry.get("FloorMod") is registry.get("mod")
+        assert registry.get("Select") is registry.get("where")
+        assert registry.get("FusedBatchNorm") is registry.get("batchnorm")
+
+    def test_sufficient_statistics_vs_tf(self):
+        x = rnd(2, 3, 4, seed=90)
+        cnt, mss, vss = exec_op("sufficient_statistics", x, [0, 1])
+        tcnt, tmss, tvss, _ = tf.nn.sufficient_statistics(x, [0, 1])
+        np.testing.assert_allclose(float(cnt), tcnt.numpy())
+        np.testing.assert_allclose(np.asarray(mss), tmss.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(vss), tvss.numpy(), rtol=1e-5)
+
+    def test_fused_batch_norm_vs_tf(self):
+        x = rnd(2, 4, 4, 3, seed=91)
+        scale = np.abs(rnd(3, seed=92)) + 0.5
+        offset = rnd(3, seed=93)
+        y, m, v = exec_op("fused_batch_norm", x, scale, offset)
+        ty, tm, tv = tf.compat.v1.nn.fused_batch_norm(x, scale, offset,
+                                                      epsilon=1e-3)
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(m), tm.numpy(), rtol=1e-5)
+        # batch_variance output is the Bessel-corrected one (TF semantics)
+        np.testing.assert_allclose(np.asarray(v), tv.numpy(), rtol=1e-4)
+
+    def test_histogram(self):
+        x = np.array([0.0, 0.1, 0.9, 1.0, 0.5], np.float32)
+        h = exec_op("histogram", x, num_bins=2)
+        assert int(h.sum()) == 5 and h.shape == (2,)
+
+    def test_boolean_mask(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        mask = np.array([True, False, True, False])
+        vals, cnt = exec_op("boolean_mask", x, mask)
+        assert int(cnt) == 2
+        np.testing.assert_allclose(np.asarray(vals)[:2], x[[0, 2]])
+
+    def test_sparse_to_dense_and_matmul(self):
+        idx = np.array([[0, 1], [2, 0]], np.int32)
+        vals = np.array([5.0, 7.0], np.float32)
+        dense = exec_op("sparse_to_dense", idx, (3, 2), vals)
+        want = np.zeros((3, 2), np.float32)
+        want[0, 1], want[2, 0] = 5.0, 7.0
+        np.testing.assert_allclose(np.asarray(dense), want)
+        b = rnd(2, 4, seed=94)
+        got = exec_op("sparse_dense_matmul", idx, vals, (3, 2), b)
+        np.testing.assert_allclose(np.asarray(got), want @ b, rtol=1e-5)
+
+    def test_log_matrix_determinant(self):
+        a = np.eye(3, dtype=np.float32) * 2.0
+        sign, logdet = exec_op("log_matrix_determinant", a)
+        np.testing.assert_allclose(float(sign), 1.0)
+        np.testing.assert_allclose(float(logdet), 3 * np.log(2.0), rtol=1e-6)
