@@ -16,6 +16,8 @@ only (no session/runtime); import fails with a clear message without it.
 """
 from __future__ import annotations
 
+import re
+
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -504,7 +506,344 @@ def _register_default_rules():
                                  *inputs, name=node.name)
 
 
+
+def _register_extended_rules():
+    """Long-tail op-type coverage (trig/special functions, scans, segments,
+    spatial reshuffles, linalg, image, quantization) — mechanical maps onto
+    registry lowerings; structural inputs constant-folded like the default
+    rules (ref: the OpMappingRegistry's several-hundred-rule table)."""
+    # tensor-only passthrough onto canonical snake_case registry names
+    def _snake(name):
+        out = re.sub(r"(?<!^)(?=[A-Z][a-z])|(?<=[a-z0-9])(?=[A-Z])", "_",
+                     name)
+        return out.lower()
+
+    for op in ["Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
+               "Asinh", "Acosh", "Atanh", "Expm1", "Log1p", "Rint",
+               "Lgamma", "Digamma", "Atan2", "Betainc", "Igamma", "Igammac",
+               "Zeta", "Polygamma", "Cross", "InvertPermutation",
+               "MatrixDeterminant", "MatrixInverse", "MatrixDiag",
+               "MatrixSetDiag"]:
+        @mapping_rule(op)
+        def _pt(ctx, node, inputs, attrs, _op=op):
+            return ctx.sd._op(_snake(_op), *inputs)
+
+    @mapping_rule("L2Loss")
+    def _l2loss(ctx, node, inputs, attrs):
+        return ctx.sd._op("l2_loss", inputs[0])
+
+    @mapping_rule("SegmentSum", "SegmentMean", "SegmentMax", "SegmentMin",
+                  "SegmentProd")
+    def _seg(ctx, node, inputs, attrs):
+        # num_segments must be static for XLA: resolvable when the ids are
+        # constant (the usual frozen-graph case)
+        ids = np.asarray(ctx.const_value(node.input[1]))
+        n = int(ids.max()) + 1
+        name = "segment_" + node.op.replace("Segment", "").lower()
+        return ctx.sd._op(name, inputs[0], inputs[1], num_segments=n)
+
+    @mapping_rule("MatrixDiagV3")
+    def _mdiag_v3(ctx, node, inputs, attrs):
+        k = int(np.asarray(ctx.const_value(node.input[1])).item())
+        if k != 0:
+            raise TFImportError("MatrixDiagV3 with k != 0 unsupported")
+        return ctx.sd._op("matrix_diag", inputs[0])
+
+    @mapping_rule("MatrixSetDiagV3")
+    def _msetdiag_v3(ctx, node, inputs, attrs):
+        if len(node.input) > 2:
+            k = int(np.asarray(ctx.const_value(node.input[2])).item())
+            if k != 0:
+                raise TFImportError("MatrixSetDiagV3 with k != 0 "
+                                    "unsupported")
+        return ctx.sd._op("matrix_set_diag", inputs[0], inputs[1])
+
+    @mapping_rule("DenseBincount")
+    def _dense_bincount(ctx, node, inputs, attrs):
+        if inputs[0].shape is not None and len(inputs[0].shape) > 1:
+            raise TFImportError("DenseBincount: only rank-1 input "
+                                "supported (TF computes per-row bincounts "
+                                "for rank-2)")
+        if attrs.get("binary_output"):
+            raise TFImportError("DenseBincount: binary_output=True "
+                                "unsupported")
+        w = np.asarray(ctx.const_value(node.input[2]))
+        if w.size:
+            raise TFImportError("DenseBincount: weights unsupported")
+        size = int(np.asarray(ctx.const_value(node.input[1])).item())
+        return ctx.sd._op("bincount", inputs[0], minlength=size,
+                          length=size)
+
+    @mapping_rule("LogMatrixDeterminant")
+    def _logdet2(ctx, node, inputs, attrs):
+        return ctx.sd._op("log_matrix_determinant", inputs[0])
+
+    @mapping_rule("ReverseSequence")
+    def _revseq(ctx, node, inputs, attrs):
+        return ctx.sd._op("reverse_sequence", inputs[0], inputs[1],
+                          seq_axis=attrs.get("seq_dim", 1),
+                          batch_axis=attrs.get("batch_dim", 0))
+
+    @mapping_rule("RGBToHSV")
+    def _rgb2hsv(ctx, node, inputs, attrs):
+        return ctx.sd._op("rgb_to_hsv", inputs[0])
+
+    @mapping_rule("HSVToRGB")
+    def _hsv2rgb(ctx, node, inputs, attrs):
+        return ctx.sd._op("hsv_to_rgb", inputs[0])
+
+    @mapping_rule("Cholesky")
+    def _chol(ctx, node, inputs, attrs):
+        return ctx.sd._op("cholesky", inputs[0])
+
+    @mapping_rule("MatrixDiagPart", "MatrixDiagPartV3")
+    def _mdiagpart(ctx, node, inputs, attrs):
+        if node.op == "MatrixDiagPartV3" and len(node.input) > 1:
+            k = int(np.asarray(ctx.const_value(node.input[1])).item())
+            if k != 0:
+                raise TFImportError("MatrixDiagPartV3 with k != 0 "
+                                    "unsupported")
+        return ctx.sd._op("matrix_diag_part", inputs[0])
+
+    @mapping_rule("ZerosLike")
+    def _zeros_like(ctx, node, inputs, attrs):
+        return ctx.sd._op("zeros_like", inputs[0])
+
+    @mapping_rule("OnesLike")
+    def _ones_like(ctx, node, inputs, attrs):
+        return ctx.sd._op("ones_like", inputs[0])
+
+    @mapping_rule("Reciprocal", "Inv")
+    def _recip(ctx, node, inputs, attrs):
+        return ctx.sd._op("reciprocal", inputs[0])
+
+    @mapping_rule("Cumsum", "Cumprod")
+    def _cumx(ctx, node, inputs, attrs):
+        axis = int(np.asarray(ctx.const_value(node.input[1])).item())
+        return ctx.sd._op(node.op.lower(), inputs[0], axis=axis,
+                          exclusive=bool(attrs.get("exclusive", False)),
+                          reverse=bool(attrs.get("reverse", False)))
+
+    @mapping_rule("TopKV2")
+    def _topk(ctx, node, inputs, attrs):
+        k = int(np.asarray(ctx.const_value(node.input[1])).item())
+        return ctx.sd._op("top_k", inputs[0], k=k)
+
+    @mapping_rule("InTopK", "InTopKV2")
+    def _intopk(ctx, node, inputs, attrs):
+        if node.op == "InTopKV2":
+            k = int(np.asarray(ctx.const_value(node.input[2])).item())
+        else:
+            k = int(attrs["k"])
+        return ctx.sd._op("in_top_k", inputs[0], inputs[1], k=k)
+
+    @mapping_rule("MirrorPad")
+    def _mirror_pad(ctx, node, inputs, attrs):
+        pads = np.asarray(ctx.const_value(node.input[1])).tolist()
+        return ctx.sd._op("mirror_pad", inputs[0], paddings=pads,
+                          mode=attrs.get("mode", "REFLECT"))
+
+    @mapping_rule("SpaceToBatchND", "BatchToSpaceND")
+    def _sb_nd(ctx, node, inputs, attrs):
+        block = np.asarray(ctx.const_value(node.input[1])).tolist()
+        aux = np.asarray(ctx.const_value(node.input[2])).tolist()
+        if node.op == "SpaceToBatchND":
+            return ctx.sd._op("space_to_batch_nd", inputs[0],
+                              block_shape=block, paddings=aux)
+        return ctx.sd._op("batch_to_space_nd", inputs[0],
+                          block_shape=block, crops=aux)
+
+    @mapping_rule("SpaceToBatch", "BatchToSpace")
+    def _sb(ctx, node, inputs, attrs):
+        aux = np.asarray(ctx.const_value(node.input[1])).tolist()
+        b = int(attrs["block_size"])
+        if node.op == "SpaceToBatch":
+            return ctx.sd._op("space_to_batch", inputs[0], block_size=b,
+                              paddings=aux)
+        return ctx.sd._op("batch_to_space", inputs[0], block_size=b,
+                          crops=aux)
+
+    @mapping_rule("SpaceToDepth", "DepthToSpace")
+    def _sd_depth(ctx, node, inputs, attrs):
+        name = ("space_to_depth" if node.op == "SpaceToDepth"
+                else "depth_to_space")
+        return ctx.sd._op(name, inputs[0],
+                          block_size=int(attrs["block_size"]))
+
+    @mapping_rule("MatrixBandPart", "BatchMatrixBandPart")
+    def _band(ctx, node, inputs, attrs):
+        lo = int(np.asarray(ctx.const_value(node.input[1])).item())
+        hi = int(np.asarray(ctx.const_value(node.input[2])).item())
+        return ctx.sd._op("matrix_band_part", inputs[0], lower=lo, upper=hi)
+
+    @mapping_rule("HistogramFixedWidth")
+    def _hfw(ctx, node, inputs, attrs):
+        nbins = int(np.asarray(ctx.const_value(node.input[2])).item())
+        return ctx.sd._op("histogram_fixed_width", inputs[0], inputs[1],
+                          nbins=nbins)
+
+    @mapping_rule("Bincount")
+    def _bincount(ctx, node, inputs, attrs):
+        if len(node.input) > 2:
+            w = np.asarray(ctx.const_value(node.input[2]))
+            if w.size:
+                raise TFImportError("Bincount: weights unsupported")
+        size = int(np.asarray(ctx.const_value(node.input[1])).item())
+        return ctx.sd._op("bincount", inputs[0], minlength=size,
+                          length=size)
+
+    @mapping_rule("ClipByValue")
+    def _clip(ctx, node, inputs, attrs):
+        lo = float(np.asarray(ctx.const_value(node.input[1])).item())
+        hi = float(np.asarray(ctx.const_value(node.input[2])).item())
+        return ctx.sd._op("clipbyvalue", inputs[0], lo=lo, hi=hi)
+
+    @mapping_rule("UnsortedSegmentSum", "UnsortedSegmentMax",
+                  "UnsortedSegmentMin", "UnsortedSegmentProd")
+    def _useg(ctx, node, inputs, attrs):
+        n = int(np.asarray(ctx.const_value(node.input[2])).item())
+        kind = node.op.replace("UnsortedSegment", "").lower()
+        return ctx.sd._op(f"unsorted_segment_{kind}", inputs[0], inputs[1],
+                          num_segments=n)
+
+    @mapping_rule("SparseToDense")
+    def _sparse_to_dense(ctx, node, inputs, attrs):
+        shape = np.asarray(ctx.const_value(node.input[1])).tolist()
+        default = float(np.asarray(ctx.const_value(node.input[3])).item())
+        return ctx.sd._op("sparse_to_dense", inputs[0], inputs[2],
+                          dense_shape=shape, default_value=default)
+
+    @mapping_rule("ResizeBilinear", "ResizeNearestNeighbor",
+                  "ResizeBicubic", "ResizeArea")
+    def _resize_rule(ctx, node, inputs, attrs):
+        if attrs.get("align_corners"):
+            raise TFImportError(f"{node.op}: align_corners=True grid "
+                                f"unsupported")
+        # ResizeArea has no half_pixel_centers attr (and our lowering is
+        # the documented linear approximation); the others must use the
+        # modern half-pixel grid
+        if node.op != "ResizeArea" and not attrs.get("half_pixel_centers",
+                                                     False):
+            raise TFImportError(
+                f"{node.op}: only the half-pixel grid is supported "
+                f"(tf.image.resize / half_pixel_centers=True); the legacy "
+                f"asymmetric grid is not")
+        size = [int(v) for v in np.asarray(ctx.const_value(node.input[1]))]
+        name = {"ResizeBilinear": "resize_bilinear",
+                "ResizeNearestNeighbor": "resize_nearest_neighbor",
+                "ResizeBicubic": "resize_bicubic",
+                "ResizeArea": "resize_area"}[node.op]
+        return ctx.sd._op(name, inputs[0], size=size)
+
+    @mapping_rule("AdjustContrastv2", "AdjustSaturation", "AdjustHue")
+    def _adjust(ctx, node, inputs, attrs):
+        factor = float(np.asarray(ctx.const_value(node.input[1])).item())
+        name = {"AdjustContrastv2": "adjust_contrast",
+                "AdjustSaturation": "adjust_saturation",
+                "AdjustHue": "adjust_hue"}[node.op]
+        kw = ("delta" if node.op == "AdjustHue" else "factor")
+        return ctx.sd._op(name, inputs[0], **{kw: factor})
+
+    @mapping_rule("CropAndResize")
+    def _crop_resize(ctx, node, inputs, attrs):
+        size = [int(v) for v in np.asarray(ctx.const_value(node.input[3]))]
+        return ctx.sd._op("crop_and_resize", inputs[0], inputs[1],
+                          inputs[2], crop_size=size)
+
+    @mapping_rule("NonMaxSuppressionV3")
+    def _nms(ctx, node, inputs, attrs):
+        mx = int(np.asarray(ctx.const_value(node.input[2])).item())
+        iou = float(np.asarray(ctx.const_value(node.input[3])).item())
+        st = float(np.asarray(ctx.const_value(node.input[4])).item())
+        return ctx.sd._op("non_max_suppression", inputs[0], inputs[1],
+                          max_output_size=mx, iou_threshold=iou,
+                          score_threshold=st)
+
+    @mapping_rule("FakeQuantWithMinMaxArgs")
+    def _fq_args(ctx, node, inputs, attrs):
+        return ctx.sd._op("fake_quant_with_min_max_args", inputs[0],
+                          min=float(attrs.get("min", -6.0)),
+                          max=float(attrs.get("max", 6.0)),
+                          num_bits=int(attrs.get("num_bits", 8)),
+                          narrow_range=bool(attrs.get("narrow_range",
+                                                      False)))
+
+    @mapping_rule("FakeQuantWithMinMaxVars")
+    def _fq_vars(ctx, node, inputs, attrs):
+        return ctx.sd._op("fake_quant_with_min_max_vars", inputs[0],
+                          inputs[1], inputs[2],
+                          num_bits=int(attrs.get("num_bits", 8)),
+                          narrow_range=bool(attrs.get("narrow_range",
+                                                      False)))
+
+    @mapping_rule("LRN")
+    def _lrn_rule(ctx, node, inputs, attrs):
+        return ctx.sd._op("lrn", inputs[0],
+                          depth_radius=int(attrs.get("depth_radius", 5)),
+                          bias=float(attrs.get("bias", 1.0)),
+                          alpha=float(attrs.get("alpha", 1.0)),
+                          beta=float(attrs.get("beta", 0.5)))
+
+    @mapping_rule("Conv2DBackpropInput")
+    def _deconv_rule(ctx, node, inputs, attrs):
+        st = attrs.get("strides", [1, 1, 1, 1])
+        # TF's op is the conv GRADIENT: lax applies the spatial flip +
+        # channel swap itself under transpose_kernel=True, taking the
+        # filter in TF's own (H, W, out, in) layout unmodified
+        return ctx.sd._op("deconv2d", inputs[2], inputs[1],
+                          strides=(int(st[1]), int(st[2])),
+                          padding=attrs.get("padding", "SAME"),
+                          transpose_kernel=True)
+
+    @mapping_rule("Conv3D")
+    def _conv3d_rule(ctx, node, inputs, attrs):
+        s = attrs.get("strides", [1, 1, 1, 1, 1])
+        return ctx.sd._op("conv3d", inputs[0], inputs[1],
+                          strides=tuple(int(v) for v in s[1:4]),
+                          padding=attrs.get("padding", "SAME"))
+
+    @mapping_rule("MaxPool3D", "AvgPool3D")
+    def _pool3d(ctx, node, inputs, attrs):
+        k = attrs.get("ksize", [1, 2, 2, 2, 1])
+        s = attrs.get("strides", [1, 2, 2, 2, 1])
+        name = "maxpool3d" if node.op == "MaxPool3D" else "avgpool3d"
+        return ctx.sd._op(name, inputs[0],
+                          kernel=tuple(int(v) for v in k[1:4]),
+                          strides=tuple(int(v) for v in s[1:4]),
+                          padding=attrs.get("padding", "VALID"))
+
+    @mapping_rule("Dilation2D")
+    def _dilation_rule(ctx, node, inputs, attrs):
+        s = attrs.get("strides", [1, 1, 1, 1])
+        r = attrs.get("rates", [1, 1, 1, 1])
+        return ctx.sd._op("dilation2d", inputs[0], inputs[1],
+                          strides=(int(s[1]), int(s[2])),
+                          rates=(int(r[1]), int(r[2])),
+                          padding=attrs.get("padding", "SAME"))
+
+    @mapping_rule("MaxPoolWithArgmax")
+    def _mpargmax(ctx, node, inputs, attrs):
+        k = attrs.get("ksize", [1, 2, 2, 1])
+        s = attrs.get("strides", [1, 2, 2, 1])
+        return ctx.sd._op("maxpool_with_argmax", inputs[0],
+                          kernel=(int(k[1]), int(k[2])),
+                          strides=(int(s[1]), int(s[2])),
+                          padding=attrs.get("padding", "VALID"))
+
+    @mapping_rule("ExtractImagePatches")
+    def _patches(ctx, node, inputs, attrs):
+        k = attrs.get("ksizes", [1, 2, 2, 1])
+        s = attrs.get("strides", [1, 1, 1, 1])
+        r = attrs.get("rates", [1, 1, 1, 1])
+        return ctx.sd._op("extract_image_patches", inputs[0],
+                          ksizes=(int(k[1]), int(k[2])),
+                          strides=(int(s[1]), int(s[2])),
+                          rates=(int(r[1]), int(r[2])),
+                          padding=attrs.get("padding", "VALID"))
+
+
 _register_default_rules()
+_register_extended_rules()
 
 
 def _fq(ref: str) -> str:

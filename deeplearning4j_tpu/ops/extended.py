@@ -161,9 +161,11 @@ register("size_at", lambda x, dim: x.shape[dim], aliases=["SizeAt"])
 register("searchsorted", lambda sorted_seq, values, side="left":
          jnp.searchsorted(sorted_seq, values, side=side),
          aliases=["SearchSorted"])
-register("bincount", lambda x, weights=None, minlength=0:
+register("bincount", lambda x, weights=None, minlength=0, length=None:
          jnp.bincount(jnp.ravel(x), weights=weights, minlength=minlength,
-                      length=None),
+                      # static `length` makes it jit-traceable (TF
+                      # Bincount/DenseBincount size attr)
+                      length=length),
          aliases=["Bincount"])
 
 
@@ -306,7 +308,10 @@ register("bitcast", lambda x, dtype: lax.bitcast_convert_type(x, dtype),
 # --------------------------------------------------------------------- image
 def _resize(x, size, method):
     n, h, w, c = x.shape
-    return jax.image.resize(x, (n, int(size[0]), int(size[1]), c), method)
+    # antialias=False: TF's ResizeBilinear/Bicubic kernels do not
+    # antialias on downscale (jax defaults to True)
+    return jax.image.resize(x, (n, int(size[0]), int(size[1]), c), method,
+                            antialias=False)
 
 
 register("resize_nearest_neighbor",

@@ -233,7 +233,7 @@ register("mergemaxindex",
 
 # ------------------------------------------------------- unsorted segments
 def _unsorted(reducer, init):
-    def op(data, segment_ids, num_segments):
+    def op(data, segment_ids, num_segments=None):
         n = int(num_segments)
         out = jnp.full((n,) + data.shape[1:], init, data.dtype)
         return reducer(out.at[segment_ids], data)
@@ -241,7 +241,8 @@ def _unsorted(reducer, init):
 
 
 register("unsorted_segment_sum",
-         lambda d, i, n: jnp.zeros((int(n),) + d.shape[1:], d.dtype)
+         lambda d, i, num_segments=None:
+         jnp.zeros((int(num_segments),) + d.shape[1:], d.dtype)
          .at[i].add(d), aliases=["UnsortedSegmentSum"])
 register("unsorted_segment_max",
          _unsorted(lambda at, d: at.max(d), -jnp.inf),
@@ -680,7 +681,7 @@ def histogram(x, num_bins=10):
     lo, hi = jnp.min(x), jnp.max(x)
     width = jnp.maximum(hi - lo, 1e-12)
     idx = jnp.clip(((x - lo) / width * n).astype(jnp.int32), 0, n - 1)
-    return jnp.zeros((n,), jnp.int64).at[idx.ravel()].add(1)
+    return jnp.zeros((n,), jnp.int32).at[idx.ravel()].add(1)
 
 
 @register("boolean_mask", num_outputs=2, aliases=["BooleanMask"])
@@ -698,8 +699,9 @@ def boolean_mask(x, mask):
 
 
 @register("sparse_to_dense", aliases=["SparseToDense"])
-def sparse_to_dense(indices, dense_shape, values, default_value=0):
-    """COO scatter (ref: parity_ops sparse_to_dense.cpp). indices (N, R)."""
+def sparse_to_dense(indices, values, dense_shape=None, default_value=0):
+    """COO scatter (ref: parity_ops sparse_to_dense.cpp). indices (N, R);
+    ``dense_shape`` is a static attr (XLA shapes are static)."""
     shape = tuple(int(s) for s in np.atleast_1d(dense_shape))
     out = jnp.full(shape, default_value,
                    values.dtype if hasattr(values, "dtype") else jnp.float32)
@@ -730,3 +732,10 @@ def log_matrix_determinant(x):
 register("reduce_sqnorm", lambda x, axis=None, keepdims=False:
          jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims),
          aliases=["SquaredNorm"])
+
+
+@register("matrix_diag_part", aliases=["MatrixDiagPartV3Op"])
+def matrix_diag_part(x):
+    """Main diagonal of the LAST two axes (TF batched semantics — plain
+    diag_part reduces axes 0,1 which is wrong for (B, M, N))."""
+    return jnp.diagonal(x, axis1=-2, axis2=-1)

@@ -378,11 +378,17 @@ def depthwise_conv2d(x, w, b=None, strides=(1, 1), padding="SAME", dilation=(1, 
 
 
 @register("deconv2d", aliases=["Conv2DTranspose", "Conv2DBackpropInput"])
-def deconv2d(x, w, b=None, strides=(1, 1), padding="SAME"):
+def deconv2d(x, w, b=None, strides=(1, 1), padding="SAME",
+             transpose_kernel=False):
+    """``transpose_kernel=True`` applies the 180-degree spatial flip +
+    in/out channel swap of a true conv GRADIENT (TF Conv2DBackpropInput
+    semantics, filter layout (H, W, out, in)); False keeps the
+    correlation form used by the Keras/ONNX ConvTranspose layers."""
     strides = (strides, strides) if isinstance(strides, int) else tuple(strides)
     pad = padding.upper() if isinstance(padding, str) else [(int(p), int(p)) for p in ((padding, padding) if isinstance(padding, int) else padding)]
     out = lax.conv_transpose(x, w, strides=strides, padding=pad,
-                             dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                             dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                             transpose_kernel=bool(transpose_kernel))
     return out + b if b is not None else out
 
 
@@ -469,7 +475,9 @@ def upsampling2d(x, size=2):
 @register("resize_bilinear", aliases=["ResizeBilinear"])
 def resize_bilinear(x, size):
     n, h, w, c = x.shape
-    return jax.image.resize(x, (n, int(size[0]), int(size[1]), c), method="bilinear")
+    # antialias=False matches TF's kernel (no filtering on downscale)
+    return jax.image.resize(x, (n, int(size[0]), int(size[1]), c),
+                            method="bilinear", antialias=False)
 
 
 @register("im2col")

@@ -387,7 +387,7 @@ class TestTranche4:
     def test_sparse_to_dense_and_matmul(self):
         idx = np.array([[0, 1], [2, 0]], np.int32)
         vals = np.array([5.0, 7.0], np.float32)
-        dense = exec_op("sparse_to_dense", idx, (3, 2), vals)
+        dense = exec_op("sparse_to_dense", idx, vals, dense_shape=(3, 2))
         want = np.zeros((3, 2), np.float32)
         want[0, 1], want[2, 0] = 5.0, 7.0
         np.testing.assert_allclose(np.asarray(dense), want)
@@ -400,3 +400,19 @@ class TestTranche4:
         sign, logdet = exec_op("log_matrix_determinant", a)
         np.testing.assert_allclose(float(sign), 1.0)
         np.testing.assert_allclose(float(logdet), 3 * np.log(2.0), rtol=1e-6)
+
+
+def test_matrix_diag_part_batched_and_deconv_gradient_semantics():
+    # batched diag over LAST two axes (TF), not axes 0,1
+    x = rnd(2, 3, 4, seed=95)
+    got = exec_op("matrix_diag_part", x)
+    want = tf.linalg.diag_part(x).numpy()
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+    # Conv2DBackpropInput = conv gradient: asymmetric kernel must match TF
+    xin = rnd(1, 4, 4, 2, seed=96)
+    w = rnd(2, 3, 3, 2, seed=97)          # (H, W, out, in) — asymmetric
+    want = tf.nn.conv2d_transpose(xin, w, [1, 8, 8, 3], [1, 2, 2, 1],
+                                  "SAME").numpy()
+    got = exec_op("deconv2d", xin, w, strides=(2, 2), padding="SAME",
+                  transpose_kernel=True)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-5)

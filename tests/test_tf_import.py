@@ -100,7 +100,8 @@ def test_batchnorm_inference_graph():
 
 
 def test_unknown_op_raises_with_rule_hint():
-    gd, _ = _graph_def(lambda x: tf.raw_ops.Atan(x=x), [("x", (2,))])
+    # BesselI0e: a real TF op with no mapping rule registered
+    gd, _ = _graph_def(lambda x: tf.raw_ops.BesselI0e(x=x), [("x", (2,))])
     with pytest.raises(TFImportError, match="mapping rule"):
         TFGraphMapper.import_graph(gd)
 

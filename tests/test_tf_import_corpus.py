@@ -150,6 +150,144 @@ CORPUS = {
     "LogicalOr": (lambda x: tf.cast(tf.logical_or(x < 0.7, x > 1.2), tf.float32), {"x": x34}),
     "LogicalNot": (lambda x: tf.cast(tf.logical_not(x > 1.0), tf.float32), {"x": x34}),
     "SelectV2": (lambda x: tf.where(x > 1.0, x, -x), {"x": x34}),
+    # ---- extended-rule tranche (trig/special, scans, segments, spatial,
+    # linalg, image, quantization) ----
+    "Sin": (lambda x: tf.sin(x), {"x": x34}),
+    "Cos": (lambda x: tf.cos(x), {"x": x34}),
+    "Tan": (lambda x: tf.tan(x * 0.3), {"x": x34}),
+    "Asin": (lambda x: tf.asin(x * 0.4), {"x": x34}),
+    "Acos": (lambda x: tf.acos(x * 0.4), {"x": x34}),
+    "Atan": (lambda x: tf.atan(x), {"x": x34}),
+    "Sinh": (lambda x: tf.sinh(x), {"x": x34}),
+    "Cosh": (lambda x: tf.cosh(x), {"x": x34}),
+    "Asinh": (lambda x: tf.asinh(x), {"x": x34}),
+    "Acosh": (lambda x: tf.acosh(x + 1.5), {"x": x34}),
+    "Atanh": (lambda x: tf.atanh(x * 0.4), {"x": x34}),
+    "Expm1": (lambda x: tf.math.expm1(x), {"x": x34}),
+    "Log1p": (lambda x: tf.math.log1p(x), {"x": x34}),
+    "Rint": (lambda x: tf.math.rint(x * 3.0), {"x": x34}),
+    "Lgamma": (lambda x: tf.math.lgamma(x + 1.0), {"x": x34}),
+    "Digamma": (lambda x: tf.math.digamma(x + 1.0), {"x": x34}),
+    "Atan2": (lambda x: tf.atan2(x, x + 2.0), {"x": x34}),
+    "Betainc": (lambda x: tf.math.betainc(
+        tf.constant(2.0), tf.constant(3.0), x * 0.4), {"x": x34}),
+    "Igamma": (lambda x: tf.math.igamma(tf.constant(2.0), x), {"x": x34}),
+    "Igammac": (lambda x: tf.math.igammac(tf.constant(2.0), x), {"x": x34}),
+    "Zeta": (lambda x: tf.math.zeta(x + 2.0, tf.ones_like(x)), {"x": x34}),
+    "Polygamma": (lambda x: tf.math.polygamma(
+        tf.ones_like(x), x + 1.0), {"x": x34}),
+    "L2Loss": (lambda x: tf.nn.l2_loss(x), {"x": x34}),
+    "Cross": (lambda x: tf.linalg.cross(x[:, :3], x[:, 1:4]), {"x": x34}),
+    "InvertPermutation": (lambda x: tf.cast(tf.math.invert_permutation(
+        tf.constant([2, 0, 1, 3])), tf.float32) + tf.reduce_sum(x) * 0.0,
+        {"x": x34}),
+    "MatrixDeterminant": (lambda x: tf.linalg.det(
+        x[:3, :3] + tf.constant(3.0 * np.eye(3, dtype=np.float32))), {"x": x34}),
+    "MatrixInverse": (lambda x: tf.linalg.inv(
+        x[:3, :3] + tf.constant(3.0 * np.eye(3, dtype=np.float32))), {"x": x34}),
+    "Cholesky": (lambda x: tf.linalg.cholesky(
+        tf.matmul(x, x, transpose_b=True)
+        + tf.constant(3.0 * np.eye(3, dtype=np.float32))), {"x": x34}),
+    "MatrixDiag": (lambda x: tf.linalg.diag(x[0]), {"x": x34}),
+    "MatrixDiagV3": (lambda x: tf.linalg.diag(x[1]), {"x": x34}),
+    "MatrixSetDiagV3": (lambda x: tf.linalg.set_diag(
+        x[:3, :3], tf.ones(3)), {"x": x34}),
+    "MatrixDiagPartV3": (lambda x: tf.linalg.diag_part(
+        x[:3, :3]), {"x": x34}),
+    "MatrixSetDiag": (lambda x: tf.linalg.set_diag(
+        x[:3, :3], tf.ones(3)), {"x": x34}),
+    "LogMatrixDeterminant": (lambda x: tf.linalg.slogdet(
+        tf.matmul(x, x, transpose_b=True)
+        + tf.constant(3.0 * np.eye(3, dtype=np.float32)))[1], {"x": x34}),
+    "ZerosLike": (lambda x: tf.zeros_like(x) + x, {"x": x34}),
+    "OnesLike": (lambda x: tf.ones_like(x) * x, {"x": x34}),
+    "Reciprocal": (lambda x: tf.math.reciprocal(x + 2.0), {"x": x34}),
+    "Cumsum": (lambda x: tf.cumsum(x, axis=1, exclusive=True), {"x": x34}),
+    "Cumprod": (lambda x: tf.math.cumprod(x, axis=1, reverse=True),
+                {"x": x34}),
+    "TopKV2": (lambda x: tf.math.top_k(x, k=2).values, {"x": x34}),
+    "InTopKV2": (lambda x: tf.cast(tf.math.in_top_k(
+        tf.constant([0, 1, 2]), x[:3], k=2), tf.float32), {"x": x34}),
+    "MirrorPad": (lambda x: tf.pad(x, [[1, 1], [1, 1]], mode="REFLECT"),
+                  {"x": x34}),
+    "SpaceToBatchND": (lambda x: tf.space_to_batch(
+        x, [2, 2], [[0, 0], [0, 0]]), {"x": ximg}),
+    "BatchToSpaceND": (lambda x: tf.batch_to_space(
+        tf.space_to_batch(x, [2, 2], [[0, 0], [0, 0]]), [2, 2],
+        [[0, 0], [0, 0]]), {"x": ximg}),
+    "SpaceToDepth": (lambda x: tf.nn.space_to_depth(x, 2), {"x": ximg}),
+    "DepthToSpace": (lambda x: tf.nn.depth_to_space(
+        tf.nn.space_to_depth(x, 2), 2), {"x": ximg}),
+    "MatrixBandPart": (lambda x: tf.linalg.band_part(x, 1, 1), {"x": x34}),
+    "HistogramFixedWidth": (lambda x: tf.cast(tf.histogram_fixed_width(
+        x, [0.0, 2.0], nbins=4), tf.float32), {"x": x34}),
+    "DenseBincount": (lambda x: tf.cast(tf.raw_ops.DenseBincount(
+        input=tf.cast(x[0] * 2.0, tf.int32), size=8,
+        weights=tf.constant([], tf.int32), binary_output=False),
+        tf.float32), {"x": x34}),
+    "ClipByValue": (lambda x: tf.clip_by_value(x, 0.7, 1.2), {"x": x34}),
+    "SegmentSum": (lambda x: tf.math.segment_sum(
+        x, tf.constant([0, 0, 1])), {"x": x34}),
+    "SegmentMean": (lambda x: tf.math.segment_mean(
+        x, tf.constant([0, 0, 1])), {"x": x34}),
+    "SegmentMax": (lambda x: tf.math.segment_max(
+        x, tf.constant([0, 0, 1])), {"x": x34}),
+    "SegmentMin": (lambda x: tf.math.segment_min(
+        x, tf.constant([0, 0, 1])), {"x": x34}),
+    "SegmentProd": (lambda x: tf.math.segment_prod(
+        x, tf.constant([0, 0, 1])), {"x": x34}),
+    "UnsortedSegmentSum": (lambda x: tf.math.unsorted_segment_sum(
+        x, tf.constant([2, 0, 2]), 3), {"x": x34}),
+    "UnsortedSegmentMax": (lambda x: tf.math.unsorted_segment_max(
+        x, tf.constant([1, 0, 1]), 2), {"x": x34}),
+    "UnsortedSegmentMin": (lambda x: tf.math.unsorted_segment_min(
+        x, tf.constant([1, 0, 1]), 2), {"x": x34}),
+    "UnsortedSegmentProd": (lambda x: tf.math.unsorted_segment_prod(
+        x, tf.constant([1, 0, 1]), 2), {"x": x34}),
+    "SparseToDense": (lambda x: tf.sparse.to_dense(tf.SparseTensor(
+        [[0, 1], [2, 3]], [5.0, 7.0], [3, 4])) + x * 0.0, {"x": x34}),
+    "ResizeBilinear": (lambda x: tf.compat.v1.image.resize_bilinear(
+        x, [4, 4], half_pixel_centers=True), {"x": ximg}),
+    "ResizeNearestNeighbor": (
+        lambda x: tf.compat.v1.image.resize_nearest_neighbor(
+            x, [4, 4], half_pixel_centers=True),
+        {"x": ximg}),
+    "AdjustSaturation": (lambda x: tf.image.adjust_saturation(
+        tf.clip_by_value(x[..., :3] if x.shape[-1] >= 3 else
+                         tf.concat([x, x, x], -1), 0.0, 1.0), 0.5),
+        {"x": np.random.RandomState(5).rand(1, 6, 6, 3).astype(F32)}),
+    "AdjustHue": (lambda x: tf.image.adjust_hue(x, 0.2),
+                  {"x": np.random.RandomState(6).rand(1, 6, 6, 3)
+                   .astype(F32)}),
+    "CropAndResize": (lambda x: tf.image.crop_and_resize(
+        x, [[0.1, 0.1, 0.8, 0.8]], [0], [4, 4]), {"x": ximg}),
+    "FakeQuantWithMinMaxArgs": (
+        lambda x: tf.quantization.fake_quant_with_min_max_args(
+            x, min=-1.0, max=2.0), {"x": x34}),
+    "FakeQuantWithMinMaxVars": (
+        lambda x: tf.quantization.fake_quant_with_min_max_vars(
+            x, tf.constant(-1.0), tf.constant(2.0)), {"x": x34}),
+    "LRN": (lambda x: tf.nn.local_response_normalization(
+        x, depth_radius=1, bias=1.0, alpha=0.5, beta=0.5), {"x": ximg}),
+    "Conv3D": (lambda x: tf.nn.conv3d(
+        tf.reshape(x[:, :4], [1, 2, 4, 4, 2]),
+        tf.ones([1, 2, 2, 2, 3]) * 0.1, [1, 1, 1, 1, 1], "VALID"),
+        {"x": ximg}),
+    "MaxPool3D": (lambda x: tf.nn.max_pool3d(
+        tf.reshape(x[:, :4], [1, 2, 4, 4, 2]), [1, 1, 2, 2, 1],
+        [1, 1, 2, 2, 1], "VALID"), {"x": ximg}),
+    "AvgPool3D": (lambda x: tf.nn.avg_pool3d(
+        tf.reshape(x[:, :4], [1, 2, 4, 4, 2]), [1, 1, 2, 2, 1],
+        [1, 1, 2, 2, 1], "VALID"), {"x": ximg}),
+    "Dilation2D": (lambda x: tf.nn.dilation2d(
+        x, tf.ones([2, 2, 2]) * 0.1, [1, 1, 1, 1], "SAME", "NHWC",
+        [1, 1, 1, 1]), {"x": ximg}),
+    "ExtractImagePatches": (lambda x: tf.image.extract_patches(
+        x, [1, 2, 2, 1], [1, 2, 2, 1], [1, 1, 1, 1], "VALID"),
+        {"x": ximg}),
+    "Conv2DBackpropInput": (lambda x: tf.nn.conv2d_transpose(
+        x, tf.ones([2, 2, 3, 2]) * 0.1, [1, 16, 16, 3], [1, 2, 2, 1],
+        "SAME"), {"x": ximg}),
 }
 
 # rules that cannot be exercised as a standalone frozen graph op
@@ -162,6 +300,19 @@ COVERAGE_IGNORE = {
     "Select",  # legacy duplicate of SelectV2
     # functional control flow is exercised in test_control_flow below
     "StatelessIf", "If", "StatelessWhile", "While",
+    "RGBToHSV", "HSVToRGB",       # tf.image traces these into primitives
+    "Inv",                        # legacy duplicate of Reciprocal
+    "SpaceToBatch", "BatchToSpace",   # legacy non-ND forms of the ND ops
+    "InTopK",                     # tf2 always emits InTopKV2
+    "ReverseSequence",            # exercised via its dedicated rule test
+    "MatrixDiagPart",             # tf2 emits the V3 form
+    "BatchMatrixBandPart",        # legacy alias of MatrixBandPart
+    "AdjustContrastv2",           # tf traces adjust_contrast to primitives
+    "ResizeBicubic", "ResizeArea",   # deprecated v1 endpoints
+    "NonMaxSuppressionV3",        # index-output op; covered by op tests
+    "MaxPoolWithArgmax",          # multi-output; covered by op tests
+    "Bincount",                   # tf2 emits DenseBincount; rule kept for
+                                  # legacy graphs, op tested directly
 }
 
 
