@@ -522,15 +522,12 @@ def _register_extended_rules():
                "Asinh", "Acosh", "Atanh", "Expm1", "Log1p", "Rint",
                "Lgamma", "Digamma", "Atan2", "Betainc", "Igamma", "Igammac",
                "Zeta", "Polygamma", "Cross", "InvertPermutation",
-               "MatrixDeterminant", "MatrixInverse", "MatrixDiag",
-               "MatrixSetDiag"]:
+               "MatrixDeterminant", "MatrixInverse",
+               "L2Loss", "Cholesky", "LogMatrixDeterminant",
+               "ZerosLike", "OnesLike", "RGBToHSV", "HSVToRGB"]:
         @mapping_rule(op)
         def _pt(ctx, node, inputs, attrs, _op=op):
             return ctx.sd._op(_snake(_op), *inputs)
-
-    @mapping_rule("L2Loss")
-    def _l2loss(ctx, node, inputs, attrs):
-        return ctx.sd._op("l2_loss", inputs[0])
 
     @mapping_rule("SegmentSum", "SegmentMean", "SegmentMax", "SegmentMin",
                   "SegmentProd")
@@ -542,12 +539,29 @@ def _register_extended_rules():
         name = "segment_" + node.op.replace("Segment", "").lower()
         return ctx.sd._op(name, inputs[0], inputs[1], num_segments=n)
 
+    @mapping_rule("MatrixDiag")
+    def _mdiag_v1(ctx, node, inputs, attrs):
+        return ctx.sd._op("matrix_diag", inputs[0])
+
     @mapping_rule("MatrixDiagV3")
     def _mdiag_v3(ctx, node, inputs, attrs):
         k = int(np.asarray(ctx.const_value(node.input[1])).item())
         if k != 0:
             raise TFImportError("MatrixDiagV3 with k != 0 unsupported")
+        rows = int(np.asarray(ctx.const_value(node.input[2])).item())
+        cols = int(np.asarray(ctx.const_value(node.input[3])).item())
+        padv = float(np.asarray(ctx.const_value(node.input[4])).item())
+        if (rows not in (-1,) or cols not in (-1,)) and rows != cols:
+            raise TFImportError("MatrixDiagV3 with explicit non-square "
+                                "num_rows/num_cols unsupported")
+        if padv != 0.0:
+            raise TFImportError("MatrixDiagV3 with padding_value != 0 "
+                                "unsupported")
         return ctx.sd._op("matrix_diag", inputs[0])
+
+    @mapping_rule("MatrixSetDiag")
+    def _msetdiag_v1(ctx, node, inputs, attrs):
+        return ctx.sd._op("matrix_set_diag", inputs[0], inputs[1])
 
     @mapping_rule("MatrixSetDiagV3")
     def _msetdiag_v3(ctx, node, inputs, attrs):
@@ -574,27 +588,11 @@ def _register_extended_rules():
         return ctx.sd._op("bincount", inputs[0], minlength=size,
                           length=size)
 
-    @mapping_rule("LogMatrixDeterminant")
-    def _logdet2(ctx, node, inputs, attrs):
-        return ctx.sd._op("log_matrix_determinant", inputs[0])
-
     @mapping_rule("ReverseSequence")
     def _revseq(ctx, node, inputs, attrs):
         return ctx.sd._op("reverse_sequence", inputs[0], inputs[1],
                           seq_axis=attrs.get("seq_dim", 1),
                           batch_axis=attrs.get("batch_dim", 0))
-
-    @mapping_rule("RGBToHSV")
-    def _rgb2hsv(ctx, node, inputs, attrs):
-        return ctx.sd._op("rgb_to_hsv", inputs[0])
-
-    @mapping_rule("HSVToRGB")
-    def _hsv2rgb(ctx, node, inputs, attrs):
-        return ctx.sd._op("hsv_to_rgb", inputs[0])
-
-    @mapping_rule("Cholesky")
-    def _chol(ctx, node, inputs, attrs):
-        return ctx.sd._op("cholesky", inputs[0])
 
     @mapping_rule("MatrixDiagPart", "MatrixDiagPartV3")
     def _mdiagpart(ctx, node, inputs, attrs):
@@ -604,14 +602,6 @@ def _register_extended_rules():
                 raise TFImportError("MatrixDiagPartV3 with k != 0 "
                                     "unsupported")
         return ctx.sd._op("matrix_diag_part", inputs[0])
-
-    @mapping_rule("ZerosLike")
-    def _zeros_like(ctx, node, inputs, attrs):
-        return ctx.sd._op("zeros_like", inputs[0])
-
-    @mapping_rule("OnesLike")
-    def _ones_like(ctx, node, inputs, attrs):
-        return ctx.sd._op("ones_like", inputs[0])
 
     @mapping_rule("Reciprocal", "Inv")
     def _recip(ctx, node, inputs, attrs):
@@ -787,13 +777,29 @@ def _register_extended_rules():
     @mapping_rule("Conv2DBackpropInput")
     def _deconv_rule(ctx, node, inputs, attrs):
         st = attrs.get("strides", [1, 1, 1, 1])
+        pad = attrs.get("padding", "SAME")
+        # lax.conv_transpose SAME always yields in*stride; TF records the
+        # true forward-input size — reject odd-size gradients we cannot
+        # reproduce rather than silently misalign the grid
+        sizes = np.asarray(ctx.const_value(node.input[0])).tolist()
+        in_shape = inputs[2].shape
+        if pad.upper() == "SAME" and in_shape is not None \
+                and None not in in_shape[1:3]:
+            want_h, want_w = int(sizes[1]), int(sizes[2])
+            got_h = int(in_shape[1]) * int(st[1])
+            got_w = int(in_shape[2]) * int(st[2])
+            if (want_h, want_w) != (got_h, got_w):
+                raise TFImportError(
+                    f"Conv2DBackpropInput: recorded input_sizes "
+                    f"({want_h}, {want_w}) != stride-inferred "
+                    f"({got_h}, {got_w}) — odd-size SAME transposes are "
+                    f"unsupported")
         # TF's op is the conv GRADIENT: lax applies the spatial flip +
         # channel swap itself under transpose_kernel=True, taking the
         # filter in TF's own (H, W, out, in) layout unmodified
         return ctx.sd._op("deconv2d", inputs[2], inputs[1],
                           strides=(int(st[1]), int(st[2])),
-                          padding=attrs.get("padding", "SAME"),
-                          transpose_kernel=True)
+                          padding=pad, transpose_kernel=True)
 
     @mapping_rule("Conv3D")
     def _conv3d_rule(ctx, node, inputs, attrs):

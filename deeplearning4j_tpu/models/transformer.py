@@ -78,6 +78,9 @@ class TransformerConfig:
     dropout: float = 0.0
     dtype: Any = jnp.float32          # bfloat16 on real TPU runs
     causal: bool = True
+    remat: bool = False               # jax.checkpoint each block: trade
+                                      # recompute FLOPs for HBM (SURVEY §7
+                                      # rematerialisation lever)
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -208,7 +211,7 @@ class TransformerLM:
         x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
         x = self._dropout(x.astype(c.dtype), rng, 0)
         x = self._constrain(x)
-        for li, blk in enumerate(params["blocks"]):
+        def block(blk, x, li):
             a = self._attn(blk["attn"], self._ln(blk["ln1"], x), self.mesh)
             x = x + self._dropout(a, rng, 2 * li + 1)
             x = self._constrain(x)
@@ -216,7 +219,14 @@ class TransformerLM:
             hdn = jax.nn.gelu(hdn)
             m = hdn @ blk["mlp"]["w_down"] + blk["mlp"]["b_down"]
             x = x + self._dropout(m, rng, 2 * li + 2)
-            x = self._constrain(x)
+            return self._constrain(x)
+
+        if c.remat:
+            # recompute each block's activations in backward instead of
+            # saving them: O(L·T·d) residuals shrink to O(T·d) per block
+            block = jax.checkpoint(block, static_argnums=(2,))
+        for li, blk in enumerate(params["blocks"]):
+            x = block(blk, x, li)
         x = self._ln(params["ln_f"], x)
         return jnp.matmul(x, params["tok_emb"].T,
                           preferred_element_type=jnp.float32)

@@ -160,6 +160,16 @@ class ListBuilder:
         self._backprop_type = t
         return self
 
+    def gradient_checkpointing(self, enabled: bool = True) -> "ListBuilder":
+        """jax.checkpoint every hidden layer during training: backward
+        recomputes activations instead of saving them — the SURVEY §7
+        rematerialisation lever (HBM for FLOPs). TPU extension; the
+        reference bounds memory with workspaces instead."""
+        self._remat = bool(enabled)
+        return self
+
+    gradientCheckpointing = gradient_checkpointing
+
     def t_bptt_length(self, fwd: int, bwd: Optional[int] = None) -> "ListBuilder":
         self._tbptt_fwd = fwd
         self._tbptt_bwd = bwd if bwd is not None else fwd
@@ -188,6 +198,7 @@ class ListBuilder:
             grad_normalization=c._grad_normalization,
             grad_norm_threshold=c._grad_norm_threshold,
             input_pre_processors=self._preprocessors,
+            remat=getattr(self, "_remat", False),
         )
 
 
@@ -207,6 +218,7 @@ class MultiLayerConfiguration:
     grad_normalization: Optional[str] = None
     grad_norm_threshold: float = 1.0
     input_pre_processors: dict = dataclasses.field(default_factory=dict)
+    remat: bool = False
 
     def recompute_shapes(self):
         """Re-run config-time shape inference after layer edits
@@ -232,6 +244,7 @@ class MultiLayerConfiguration:
             "grad_norm_threshold": self.grad_norm_threshold,
             "input_pre_processors": {str(k): v.to_dict() for k, v in
                                      self.input_pre_processors.items()},
+            "remat": self.remat,
         }, indent=2)
 
     @staticmethod
@@ -251,4 +264,5 @@ class MultiLayerConfiguration:
             input_pre_processors={
                 int(k): _preproc.preprocessor_from_dict(v)
                 for k, v in (d.get("input_pre_processors") or {}).items()},
+            remat=d.get("remat", False),
         )

@@ -165,6 +165,14 @@ class ComputationGraph:
                     if carry_out is not None:
                         carry_out[name] = carry
                     st = lst
+                elif training and getattr(self.conf, "remat", False) \
+                        and name not in out_names:
+                    def _ckpt_apply(lp_, h_, lst_, lrng_, _layer=node.layer,
+                                    _kw=kwargs):
+                        return _layer.apply(lp_, h_, training=True,
+                                            rng=lrng_, state=lst_, **_kw)
+                    h, st = jax.checkpoint(_ckpt_apply)(lp, srcs[0], lst,
+                                                        lrng)
                 else:
                     h, st = node.layer.apply(lp, srcs[0],
                                              training=training, rng=lrng,

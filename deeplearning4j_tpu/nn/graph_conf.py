@@ -272,6 +272,14 @@ class GraphBuilder:
 
     setOutputs = set_outputs
 
+    def gradient_checkpointing(self, enabled: bool = True) -> "GraphBuilder":
+        """jax.checkpoint every hidden layer node during training (see
+        ListBuilder.gradient_checkpointing)."""
+        self._remat = bool(enabled)
+        return self
+
+    gradientCheckpointing = gradient_checkpointing
+
     def backprop_type(self, t: str) -> "GraphBuilder":
         self._backprop_type = t
         return self
@@ -291,6 +299,7 @@ class GraphBuilder:
             seed=c._seed,
             updater=c._updater,
             dtype=c._dtype,
+            remat=getattr(self, "_remat", False),
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_bwd_length=self._tbptt_bwd,
@@ -313,6 +322,7 @@ class ComputationGraphConfiguration:
     seed: int = 12345
     updater: object = None
     dtype: str = "float32"
+    remat: bool = False
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     tbptt_bwd_length: int = 20
@@ -381,6 +391,7 @@ class ComputationGraphConfiguration:
             "seed": self.seed,
             "updater": self.updater.to_dict() if self.updater is not None else None,
             "dtype": self.dtype,
+            "remat": self.remat,
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_bwd_length": self.tbptt_bwd_length,
@@ -405,6 +416,7 @@ class ComputationGraphConfiguration:
             seed=d.get("seed", 12345),
             updater=_upd.Updater.from_dict(d["updater"]) if d.get("updater") else None,
             dtype=d.get("dtype", "float32"),
+            remat=d.get("remat", False),
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_bwd_length=d.get("tbptt_bwd_length", 20),

@@ -217,7 +217,17 @@ class MultiLayerNetwork:
                     carry = recast_like(carry0, carry)
                 new_carries[lkey] = carry
             else:
-                h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)
+                if training and getattr(self.conf, "remat", False) \
+                        and i < last_idx:
+                    # rematerialise: don't save this layer's activations
+                    # for backward — recompute them (HBM ↔ FLOPs trade)
+                    def _ckpt_apply(lp_, h_, lst_, lrng_, _layer=layer,
+                                    _kw=kwargs):
+                        return _layer.apply(lp_, h_, training=True,
+                                            rng=lrng_, state=lst_, **_kw)
+                    h, st = jax.checkpoint(_ckpt_apply)(lp, h, lst, lrng)
+                else:
+                    h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)
                 if lst is not None and st is not None:
                     if cdtype is not None:
                         st = recast_like(lst, st)
