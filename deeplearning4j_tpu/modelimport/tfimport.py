@@ -551,9 +551,13 @@ def _register_extended_rules():
         rows = int(np.asarray(ctx.const_value(node.input[2])).item())
         cols = int(np.asarray(ctx.const_value(node.input[3])).item())
         padv = float(np.asarray(ctx.const_value(node.input[4])).item())
-        if (rows not in (-1,) or cols not in (-1,)) and rows != cols:
-            raise TFImportError("MatrixDiagV3 with explicit non-square "
-                                "num_rows/num_cols unsupported")
+        diag_len = (inputs[0].shape[-1]
+                    if inputs[0].shape and inputs[0].shape[-1] else None)
+        for v in (rows, cols):
+            if v != -1 and (diag_len is None or v != diag_len):
+                raise TFImportError(
+                    "MatrixDiagV3 with explicit num_rows/num_cols "
+                    "different from the diagonal length unsupported")
         if padv != 0.0:
             raise TFImportError("MatrixDiagV3 with padding_value != 0 "
                                 "unsupported")
@@ -779,12 +783,16 @@ def _register_extended_rules():
         st = attrs.get("strides", [1, 1, 1, 1])
         pad = attrs.get("padding", "SAME")
         # lax.conv_transpose SAME always yields in*stride; TF records the
-        # true forward-input size — reject odd-size gradients we cannot
-        # reproduce rather than silently misalign the grid
-        sizes = np.asarray(ctx.const_value(node.input[0])).tolist()
+        # true forward-input size — when it is STATICALLY known, reject
+        # odd-size gradients we cannot reproduce rather than silently
+        # misalign the grid (dynamic input_sizes skips the validation)
+        try:
+            sizes = np.asarray(ctx.const_value(node.input[0])).tolist()
+        except TFImportError:
+            sizes = None
         in_shape = inputs[2].shape
-        if pad.upper() == "SAME" and in_shape is not None \
-                and None not in in_shape[1:3]:
+        if sizes is not None and pad.upper() == "SAME" \
+                and in_shape is not None and None not in in_shape[1:3]:
             want_h, want_w = int(sizes[1]), int(sizes[2])
             got_h = int(in_shape[1]) * int(st[1])
             got_w = int(in_shape[2]) * int(st[2])

@@ -81,6 +81,10 @@ class TransformerConfig:
     remat: bool = False               # jax.checkpoint each block: trade
                                       # recompute FLOPs for HBM (SURVEY §7
                                       # rematerialisation lever)
+    scan_layers: bool = False         # lax.scan over stacked block params:
+                                      # compile time/HLO size O(1) in depth
+                                      # instead of O(L) — the deep-model
+                                      # compile lever
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -125,6 +129,11 @@ class TransformerLM:
                 },
             }
             params["blocks"].append(blk)
+        if c.scan_layers:
+            # stacked storage: one leading L axis per leaf, scanned at
+            # apply time — identical math, O(1) compile in depth
+            params["blocks"] = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *params["blocks"])
         params = jax.tree.map(lambda a: a.astype(jnp.float32), params)
         return params
 
@@ -142,10 +151,18 @@ class TransformerLM:
                 "mlp": {"w_up": col, "b_up": P(MODEL_AXIS) if has_tp else rep,
                         "w_down": row, "b_down": rep},
             }
+        if self.config.scan_layers:
+            # stacked blocks: same per-leaf spec with a leading (layer)
+            # axis left unsharded
+            blocks_spec = jax.tree.map(lambda sp: P(*((None,) + tuple(sp))),
+                                       blk(),
+                                       is_leaf=lambda x: isinstance(x, P))
+        else:
+            blocks_spec = [blk() for _ in range(self.config.n_layers)]
         spec = {
             "tok_emb": col, "pos_emb": rep,
             "ln_f": {"g": rep, "b": rep},
-            "blocks": [blk() for _ in range(self.config.n_layers)],
+            "blocks": blocks_spec,
         }
         return jax.tree.map(lambda s: NamedSharding(mesh, s), spec,
                             is_leaf=lambda x: isinstance(x, P))
@@ -221,12 +238,25 @@ class TransformerLM:
             x = x + self._dropout(m, rng, 2 * li + 2)
             return self._constrain(x)
 
-        if c.remat:
-            # recompute each block's activations in backward instead of
-            # saving them: O(L·T·d) residuals shrink to O(T·d) per block
-            block = jax.checkpoint(block, static_argnums=(2,))
-        for li, blk in enumerate(params["blocks"]):
-            x = block(blk, x, li)
+        if c.scan_layers:
+            def scan_body(carry, blk_li):
+                x, = carry
+                blk, li = blk_li
+                body = (lambda b, x_: block(b, x_, li))
+                if c.remat:
+                    body = jax.checkpoint(body)
+                return (body(blk, x),), None
+
+            li_idx = jnp.arange(c.n_layers)
+            (x,), _ = lax.scan(scan_body, (x,),
+                               (params["blocks"], li_idx))
+        else:
+            if c.remat:
+                # recompute each block's activations in backward instead
+                # of saving them: O(L·T·d) residuals shrink to O(T·d)
+                block = jax.checkpoint(block, static_argnums=(2,))
+            for li, blk in enumerate(params["blocks"]):
+                x = block(blk, x, li)
         x = self._ln(params["ln_f"], x)
         return jnp.matmul(x, params["tok_emb"].T,
                           preferred_element_type=jnp.float32)

@@ -167,12 +167,9 @@ class ComputationGraph:
                     st = lst
                 elif training and getattr(self.conf, "remat", False) \
                         and name not in out_names:
-                    def _ckpt_apply(lp_, h_, lst_, lrng_, _layer=node.layer,
-                                    _kw=kwargs):
-                        return _layer.apply(lp_, h_, training=True,
-                                            rng=lrng_, state=lst_, **_kw)
-                    h, st = jax.checkpoint(_ckpt_apply)(lp, srcs[0], lst,
-                                                        lrng)
+                    from deeplearning4j_tpu.nn._precision import remat_apply
+                    h, st = remat_apply(node.layer, lp, srcs[0], lst, lrng,
+                                        kwargs)
                 else:
                     h, st = node.layer.apply(lp, srcs[0],
                                              training=training, rng=lrng,

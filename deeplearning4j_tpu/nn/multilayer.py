@@ -221,11 +221,8 @@ class MultiLayerNetwork:
                         and i < last_idx:
                     # rematerialise: don't save this layer's activations
                     # for backward — recompute them (HBM ↔ FLOPs trade)
-                    def _ckpt_apply(lp_, h_, lst_, lrng_, _layer=layer,
-                                    _kw=kwargs):
-                        return _layer.apply(lp_, h_, training=True,
-                                            rng=lrng_, state=lst_, **_kw)
-                    h, st = jax.checkpoint(_ckpt_apply)(lp, h, lst, lrng)
+                    from deeplearning4j_tpu.nn._precision import remat_apply
+                    h, st = remat_apply(layer, lp, h, lst, lrng, kwargs)
                 else:
                     h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)
                 if lst is not None and st is not None:

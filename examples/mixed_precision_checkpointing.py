@@ -9,13 +9,10 @@ run_name='__main__')"
 import os
 import tempfile
 
-import numpy as np
-
 
 def main():
     from deeplearning4j_tpu.data import MnistDataSetIterator
     from deeplearning4j_tpu.models import zoo
-    from deeplearning4j_tpu.optim.updaters import Adam
     from deeplearning4j_tpu.utils.orbax_ckpt import (
         ShardedCheckpointListener)
 
