@@ -548,3 +548,82 @@ def _cond_mask(buf, cond):
 
 
 _extend_ndarray()
+
+
+def _extend_ndarray_tranche2():
+    """INDArray surface, tranche 2 (ref: org.nd4j.linalg.api.ndarray.INDArray
+    ~700-method interface — the ordering/statistics/boolean long tail)."""
+    N = NDArray
+
+    # ------------------------------------------------ sorting / statistics
+    N.sort = lambda self, dim=-1, ascending=True: NDArray(
+        jnp.sort(self.buf(), axis=dim) if ascending
+        else jnp.flip(jnp.sort(self.buf(), axis=dim), axis=dim))
+    N.sortAlongDimension = N.sort
+    def _sort_with_indices(self, dim=-1, ascending=True):
+        # argsort then flip (negating wraps unsigned dtypes); values come
+        # from the same permutation so both halves always agree
+        idx = jnp.argsort(self.buf(), axis=dim)
+        if not ascending:
+            idx = jnp.flip(idx, axis=dim)
+        vals = jnp.take_along_axis(self.buf(), idx, axis=dim)
+        return NDArray(idx.astype(jnp.int32)), NDArray(vals)
+
+    N.sortWithIndices = _sort_with_indices
+    N.median = lambda self, *dims: NDArray(
+        jnp.median(self.buf(), axis=dims or None))
+    N.medianNumber = lambda self: float(jnp.median(self.buf()))
+    N.percentile = lambda self, q, *dims: NDArray(
+        jnp.percentile(self.buf(), q, axis=dims or None))
+    N.percentileNumber = lambda self, q: float(
+        jnp.percentile(self.buf(), q))
+    N.argSort = lambda self, dim=-1: NDArray(
+        jnp.argsort(self.buf(), axis=dim).astype(jnp.int32))
+
+    # ------------------------------------------------ boolean reductions
+    N.all = lambda self: bool(jnp.all(self.buf()))
+    N.any = lambda self: bool(jnp.any(self.buf()))
+    N.none = lambda self: not bool(jnp.any(self.buf()))
+    N.countNonZero = lambda self: int(jnp.count_nonzero(self.buf()))
+    N.countZero = lambda self: int(self.length()
+                                   - jnp.count_nonzero(self.buf()))
+    N.eps = lambda self, other, eps=1e-5: NDArray(
+        jnp.abs(self.buf() - jnp.asarray(_unwrap(other))) < eps)
+
+    # ------------------------------------------------ scalar accessors
+    N.getFloat = N.getDouble            # same accessor, float32 surface
+    N.getLong = N.getInt
+    N.maxIndex = lambda self: int(jnp.argmax(self.buf()))
+    N.minIndex = lambda self: int(jnp.argmin(self.buf()))
+
+    # ------------------------------------------------ structure helpers
+    N.like = lambda self: NDArray(jnp.zeros_like(self.buf()))
+    N.ulike = N.like                      # no uninitialized memory in XLA
+    N.toBoolVector = lambda self: np.asarray(self.buf(),
+                                             bool).reshape(-1)
+    N.vectorsAlongDimension = lambda self, dim: int(
+        self.length() // self.shape[dim])
+    N.tensorsAlongDimension = lambda self, *dims: int(
+        self.length() // int(np.prod([self.shape[d] for d in dims],
+                                     dtype=np.int64)))
+    N.cumsumi = lambda self, dim=0: self.assign(
+        jnp.cumsum(self.buf(), axis=dim))
+    N.cumprodi = lambda self, dim=0: self.assign(
+        jnp.cumprod(self.buf(), axis=dim))
+
+    # ------------------------------------------- reverse vector-op family
+    def _rowvec(self, v, op):
+        v = jnp.asarray(_unwrap(v)).reshape(1, -1)
+        return NDArray(op(self.buf(), v))
+
+    def _colvec(self, v, op):
+        v = jnp.asarray(_unwrap(v)).reshape(-1, 1)
+        return NDArray(op(self.buf(), v))
+
+    N.rsubRowVector = lambda self, v: _rowvec(self, v, lambda a, b: b - a)
+    N.rsubColumnVector = lambda self, v: _colvec(self, v, lambda a, b: b - a)
+    N.rdivRowVector = lambda self, v: _rowvec(self, v, lambda a, b: b / a)
+    N.rdivColumnVector = lambda self, v: _colvec(self, v, lambda a, b: b / a)
+
+
+_extend_ndarray_tranche2()

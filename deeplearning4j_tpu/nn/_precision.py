@@ -39,15 +39,3 @@ def recast_like(ref_tree, tree):
     return jax.tree.map(
         lambda r, t: _cast_float(t, jnp.asarray(r).dtype), ref_tree, tree)
 
-
-def remat_apply(layer, lp, h, lst, lrng, kwargs):
-    """jax.checkpoint a layer's training-mode apply (shared by the MLN and
-    ComputationGraph forward paths — one place for future checkpoint-policy
-    changes)."""
-    import jax
-
-    def _apply(lp_, h_, lst_, lrng_):
-        return layer.apply(lp_, h_, training=True, rng=lrng_, state=lst_,
-                           **kwargs)
-
-    return jax.checkpoint(_apply)(lp, h, lst, lrng)

@@ -1,0 +1,14 @@
+"""Shared rematerialisation (jax.checkpoint) hook for the layer-API
+runtimes (SURVEY §7 lever; one place for future checkpoint-policy
+changes)."""
+def remat_apply(layer, lp, h, lst, lrng, kwargs):
+    """jax.checkpoint a layer's training-mode apply (shared by the MLN and
+    ComputationGraph forward paths — one place for future checkpoint-policy
+    changes)."""
+    import jax
+
+    def _apply(lp_, h_, lst_, lrng_):
+        return layer.apply(lp_, h_, training=True, rng=lrng_, state=lst_,
+                           **kwargs)
+
+    return jax.checkpoint(_apply)(lp, h, lst, lrng)

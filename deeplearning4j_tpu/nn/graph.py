@@ -167,7 +167,7 @@ class ComputationGraph:
                     st = lst
                 elif training and getattr(self.conf, "remat", False) \
                         and name not in out_names:
-                    from deeplearning4j_tpu.nn._precision import remat_apply
+                    from deeplearning4j_tpu.nn._remat import remat_apply
                     h, st = remat_apply(node.layer, lp, srcs[0], lst, lrng,
                                         kwargs)
                 else:

@@ -221,7 +221,7 @@ class MultiLayerNetwork:
                         and i < last_idx:
                     # rematerialise: don't save this layer's activations
                     # for backward — recompute them (HBM ↔ FLOPs trade)
-                    from deeplearning4j_tpu.nn._precision import remat_apply
+                    from deeplearning4j_tpu.nn._remat import remat_apply
                     h, st = remat_apply(layer, lp, h, lst, lrng, kwargs)
                 else:
                     h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)

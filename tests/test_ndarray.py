@@ -327,3 +327,57 @@ class TestINDArraySurfaceLongTail:
                                    [[1, 3], [5, 7], [9, 11]])
         np.testing.assert_allclose(a.subArray((1, 1), (2, 2)).toNumpy(),
                                    [[5, 6], [9, 10]])
+
+
+class TestINDArrayTranche2:
+    """Surface tranche 2 (ref: INDArray ordering/statistics/boolean tail)."""
+
+    def _arr(self):
+        from deeplearning4j_tpu.ndarray import factory as nd
+        return nd.create([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]])
+
+    def test_sort_family(self):
+        a = self._arr()
+        np.testing.assert_allclose(a.sort().toNumpy(),
+                                   [[1, 2, 3], [4, 5, 6]])
+        np.testing.assert_allclose(a.sort(ascending=False).toNumpy(),
+                                   [[3, 2, 1], [6, 5, 4]])
+        idx, vals = a.sortWithIndices()
+        np.testing.assert_allclose(idx.toNumpy(), [[1, 2, 0], [2, 1, 0]])
+        np.testing.assert_allclose(vals.toNumpy(), [[1, 2, 3], [4, 5, 6]])
+
+    def test_median_percentile(self):
+        a = self._arr()
+        assert abs(a.medianNumber() - 3.5) < 1e-6
+        np.testing.assert_allclose(a.median(1).toNumpy(), [2.0, 5.0])
+        assert abs(a.percentileNumber(50) - 3.5) < 1e-6
+
+    def test_boolean_reductions(self):
+        a = self._arr()
+        assert a.all() and a.any() and not a.none()
+        assert a.countNonZero() == 6 and a.countZero() == 0
+        assert bool(a.eps(a).all())
+
+    def test_scalar_accessors_and_like(self):
+        a = self._arr()
+        assert a.getFloat(0, 0) == 3.0 and a.getLong(1, 2) == 4
+        assert a.maxIndex() == 3 and a.minIndex() == 1
+        assert a.like().sumNumber() == 0.0 and a.like().shape == a.shape
+
+    def test_tensor_counts_and_inplace_scans(self):
+        a = self._arr()
+        assert a.vectorsAlongDimension(1) == 2
+        assert a.tensorsAlongDimension(0, 1) == 1
+        b = self._arr()
+        b.cumsumi(1)
+        np.testing.assert_allclose(b.toNumpy(), [[3, 4, 6], [6, 11, 15]])
+
+    def test_reverse_vector_ops(self):
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = self._arr()
+        v = nd.create([10.0, 20.0, 30.0])
+        np.testing.assert_allclose(a.rsubRowVector(v).toNumpy(),
+                                   [[7, 19, 28], [4, 15, 26]])
+        c = nd.create([6.0, 12.0])
+        np.testing.assert_allclose(a.rdivColumnVector(c).toNumpy(),
+                                   [[2, 6, 3], [2, 2.4, 3]])
