@@ -577,6 +577,196 @@ def _register_onnx_rules():
         return ctx.sd._op("transpose", out, perm=[0, 3, 1, 2])
 
 
+    # ------------------------------------------------ extended tranche
+    def _rnn_fill(ctx, node, x, w, r, gates, b, states):
+        """Substitute explicit zeros for omitted optional inputs — the op's
+        positional signature must never see shifted slots."""
+        d = w.shape[0] if w.shape else None
+        hsz = r.shape[2] if r.shape else None
+        if d is None or hsz is None:
+            raise ONNXImportError(f"{node['op_type']}: W/R shapes must be "
+                                  f"static")
+        out = []
+        if b is None:
+            b = ctx.sd.constant(
+                np.zeros((d, 2 * gates * hsz), np.float32))
+        out.append(b)
+        bsz = x.shape[1] if x.shape else None
+        for st in states:
+            if st is None:
+                if bsz is None:
+                    raise ONNXImportError(
+                        f"{node['op_type']}: initial state required when "
+                        f"the batch dimension is dynamic")
+                st = ctx.sd.constant(np.zeros((d, bsz, hsz), np.float32))
+            out.append(st)
+        return tuple(out)
+
+    def _rnn_slots(ctx, node, n_slots):
+        """Positional recurrent-op inputs with ''-skipped optionals kept in
+        their slots (the generic input list drops empty names)."""
+        refs = list(node.get("input", [])) + [""] * n_slots
+        return [ctx.vars.get(r) if r else None for r in refs[:n_slots]]
+
+    @onnx_rule("LSTM")
+    def _lstm(ctx, node, inputs, attrs):
+        if attrs.get("activations"):
+            raise ONNXImportError("LSTM with custom activations "
+                                  "unsupported")
+        if attrs.get("clip"):
+            raise ONNXImportError("LSTM with clip unsupported")
+        x, w, r, b, seq_lens, h0, c0, peep = _rnn_slots(ctx, node, 8)
+        if seq_lens is not None:
+            raise ONNXImportError("LSTM with sequence_lens unsupported")
+        if peep is not None:
+            raise ONNXImportError("LSTM with peephole weights unsupported")
+        b, h0, c0 = _rnn_fill(ctx, node, x, w, r, gates=4,
+                              b=b, states=[h0, c0])
+        return ctx.sd._op("onnx_lstm", x, w, r, b, h0, c0,
+                          direction=attrs.get("direction", "forward"))
+
+    @onnx_rule("GRU")
+    def _gru(ctx, node, inputs, attrs):
+        if attrs.get("activations"):
+            raise ONNXImportError("GRU with custom activations "
+                                  "unsupported")
+        x, w, r, b, seq_lens, h0 = _rnn_slots(ctx, node, 6)
+        if seq_lens is not None:
+            raise ONNXImportError("GRU with sequence_lens unsupported")
+        b, h0 = _rnn_fill(ctx, node, x, w, r, gates=3, b=b, states=[h0])
+        return ctx.sd._op("onnx_gru", x, w, r, b, h0,
+                          direction=attrs.get("direction", "forward"),
+                          linear_before_reset=int(
+                              attrs.get("linear_before_reset", 0)))
+
+    @onnx_rule("RNN")
+    def _rnn(ctx, node, inputs, attrs):
+        if attrs.get("activations"):
+            raise ONNXImportError("RNN with custom activations "
+                                  "unsupported")
+        x, w, r, b, seq_lens, h0 = _rnn_slots(ctx, node, 6)
+        if seq_lens is not None:
+            raise ONNXImportError("RNN with sequence_lens unsupported")
+        b, h0 = _rnn_fill(ctx, node, x, w, r, gates=1, b=b, states=[h0])
+        return ctx.sd._op("onnx_rnn", x, w, r, b, h0,
+                          direction=attrs.get("direction", "forward"))
+
+    @onnx_rule("ConvTranspose")
+    def _convt(ctx, node, inputs, attrs):
+        spatial = len(attrs.get("kernel_shape", [0, 0]))
+        if spatial != 2:
+            raise ONNXImportError("only 2-D ConvTranspose supported")
+        if any(attrs.get("output_padding", [])) or attrs.get("group", 1) != 1:
+            raise ONNXImportError("ConvTranspose output_padding/groups "
+                                  "unsupported")
+        if any(v != 1 for v in attrs.get("dilations", [])):
+            raise ONNXImportError("ConvTranspose dilations unsupported")
+        if attrs.get("auto_pad") not in (None, "", "NOTSET"):
+            raise ONNXImportError("ConvTranspose auto_pad unsupported "
+                                  "(use explicit pads)")
+        if attrs.get("output_shape"):
+            raise ONNXImportError("ConvTranspose output_shape unsupported")
+        pads = attrs.get("pads", [0] * 4)
+        padding = ((pads[0], pads[2]), (pads[1], pads[3]))
+        return ctx.sd._op("deconv2d_nchw", *inputs,
+                          strides=tuple(attrs.get("strides", [1, 1])),
+                          padding=padding)
+
+    @onnx_rule("LRN")
+    def _lrn(ctx, node, inputs, attrs):
+        size = int(attrs.get("size", 5))
+        if size % 2 == 0:
+            raise ONNXImportError("LRN with even size unsupported "
+                                  "(depth_radius windows are odd)")
+        # our lrn is NHWC with depth_radius; ONNX size = full window
+        x = ctx.sd._op("Transpose", inputs[0], perm=[0, 2, 3, 1])
+        y = ctx.sd._op("lrn", x, depth_radius=(size - 1) // 2,
+                       bias=float(attrs.get("bias", 1.0)),
+                       alpha=float(attrs.get("alpha", 1e-4)) / size,
+                       beta=float(attrs.get("beta", 0.75)))
+        return ctx.sd._op("Transpose", y, perm=[0, 3, 1, 2])
+
+    @onnx_rule("GroupNormalization")
+    def _groupnorm(ctx, node, inputs, attrs):
+        return ctx.sd._op("group_norm", *inputs,
+                          num_groups=int(attrs["num_groups"]),
+                          epsilon=float(attrs.get("epsilon", 1e-5)))
+
+    @onnx_rule("ReduceLogSumExp", "ReduceSumSquare")
+    def _reduce_extra(ctx, node, inputs, attrs):
+        axes = attrs.get("axes")
+        if axes is None and len(inputs) > 1:
+            axes = [int(v) for v in np.asarray(
+                ctx.const(node["input"][1]))]
+        axes = tuple(axes) if axes else None
+        kd = bool(attrs.get("keepdims", 1))
+        name = ("reduce_logsumexp_axes" if node["op_type"] ==
+                "ReduceLogSumExp" else "reduce_sqnorm")
+        return ctx.sd._op(name, inputs[0], axis=axes, keepdims=kd)
+
+    @onnx_rule("Trilu")
+    def _trilu(ctx, node, inputs, attrs):
+        k = 0
+        if len(inputs) > 1:
+            k = int(np.asarray(ctx.const(node["input"][1])).item())
+        return ctx.sd._op("trilu", inputs[0], k=k,
+                          upper=bool(attrs.get("upper", 1)))
+
+    @onnx_rule("Hardmax")
+    def _hardmax(ctx, node, inputs, attrs):
+        return ctx.sd._op("hardmax", inputs[0],
+                          axis=int(attrs.get("axis", -1)))
+
+    @onnx_rule("GlobalMaxPool")
+    def _gmp(ctx, node, inputs, attrs):
+        return ctx.sd._op("global_maxpool_nchw", inputs[0])
+
+    @onnx_rule("IsInf")
+    def _isinf(ctx, node, inputs, attrs):
+        pos = bool(attrs.get("detect_positive", 1))
+        neg = bool(attrs.get("detect_negative", 1))
+        if pos and neg:
+            return ctx.sd._op("isinf", inputs[0])
+        inf = ctx.sd._op("isinf", inputs[0])
+        sign_ok = (ctx.sd._op("Greater", inputs[0],
+                              ctx.sd.constant(np.float32(0.0))) if pos
+                   else ctx.sd._op("Less", inputs[0],
+                                   ctx.sd.constant(np.float32(0.0))))
+        return ctx.sd._op("boolean_and", inf, sign_ok)
+
+    @onnx_rule("IsNaN")
+    def _isnan(ctx, node, inputs, attrs):
+        return ctx.sd._op("isnan", inputs[0])
+
+    @onnx_rule("Det")
+    def _det(ctx, node, inputs, attrs):
+        return ctx.sd._op("matrix_determinant", inputs[0])
+
+    @onnx_rule("ReverseSequence")
+    def _revseq_onnx(ctx, node, inputs, attrs):
+        return ctx.sd._op("reverse_sequence", inputs[0], inputs[1],
+                          seq_axis=int(attrs.get("time_axis", 0)),
+                          batch_axis=int(attrs.get("batch_axis", 1)))
+
+    @onnx_rule("ScatterElements")
+    def _scatter_el(ctx, node, inputs, attrs):
+        return ctx.sd._op("scatter_elements", *inputs,
+                          axis=int(attrs.get("axis", 0)),
+                          reduction=attrs.get("reduction", "none"))
+
+    @onnx_rule("Shrink")
+    def _shrink(ctx, node, inputs, attrs):
+        return ctx.sd._op("shrink", inputs[0],
+                          bias=float(attrs.get("bias", 0.0)),
+                          lambd=float(attrs.get("lambd", 0.5)))
+
+    @onnx_rule("Celu")
+    def _celu(ctx, node, inputs, attrs):
+        return ctx.sd._op("celu", inputs[0],
+                          alpha=float(attrs.get("alpha", 1.0)))
+
+
+
 _register_onnx_rules()
 
 

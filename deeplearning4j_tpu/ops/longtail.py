@@ -739,3 +739,200 @@ def matrix_diag_part(x):
     """Main diagonal of the LAST two axes (TF batched semantics — plain
     diag_part reduces axes 0,1 which is wrong for (B, M, N))."""
     return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+# ----------------------------------------------- ONNX-layout recurrent ops
+def _onnx_dirs(x, num_dirs, direction, run_dir):
+    """Shared forward/reverse/bidirectional dispatch: ``run_dir(di, xd)``
+    returns (y (T,B,H), *finals); outputs stack to (T,D,B,H)/(D,B,H)."""
+    dirs = ["forward"] if num_dirs == 1 else ["forward", "reverse"]
+    if direction == "reverse":
+        dirs = ["reverse"]
+    outs, finals = [], None
+    for di, kind in enumerate(dirs):
+        xd = jnp.flip(x, 0) if kind == "reverse" else x
+        res = run_dir(di, xd)
+        y, rest = res[0], res[1:]
+        if kind == "reverse":
+            y = jnp.flip(y, 0)
+        outs.append(y)
+        if finals is None:
+            finals = [[] for _ in rest]
+        for slot, v in zip(finals, rest):
+            slot.append(v)
+    return (jnp.stack(outs, 1),
+            *[jnp.stack(slot, 0) for slot in finals])
+
+
+def _onnx_lstm_dir(x, w, r, wb, rb, h0, c0):
+    """One direction. x (T,B,I); w (4H,I); r (4H,H); gate order iofc."""
+    hsz = r.shape[1]
+
+    def step(carry, xt):
+        h, c = carry
+        z = xt @ w.T + h @ r.T + wb + rb
+        i, o, f, g = (z[:, :hsz], z[:, hsz:2 * hsz],
+                      z[:, 2 * hsz:3 * hsz], z[:, 3 * hsz:])
+        i, o, f = (jax.nn.sigmoid(v) for v in (i, o, f))
+        c = f * c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hN, cN), ys = lax.scan(step, (h0, c0), x)
+    return ys, hN, cN
+
+
+@register("onnx_lstm", num_outputs=3, aliases=["OnnxLSTM"])
+def onnx_lstm(x, w, r, b=None, h0=None, c0=None, direction="forward"):
+    """ONNX LSTM semantics (ref: samediff-import-onnx LSTM mapping): x
+    (T,B,I), W (D,4H,I), R (D,4H,H), B (D,8H); gate order i,o,f,c; default
+    activations. Returns (Y (T,D,B,H), Y_h (D,B,H), Y_c)."""
+    t, bsz, _ = x.shape
+    d, four_h, hsz = w.shape[0], w.shape[1], r.shape[2]
+    if b is None:
+        b = jnp.zeros((d, 2 * four_h), x.dtype)
+    if h0 is None:
+        h0 = jnp.zeros((d, bsz, hsz), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((d, bsz, hsz), x.dtype)
+    return _onnx_dirs(x, d, direction, lambda di, xd: _onnx_lstm_dir(
+        xd, w[di], r[di], b[di, :four_h], b[di, four_h:], h0[di], c0[di]))
+
+
+@register("onnx_gru", num_outputs=2, aliases=["OnnxGRU"])
+def onnx_gru(x, w, r, b=None, h0=None, direction="forward",
+             linear_before_reset=0):
+    """ONNX GRU: gate order z,r,h; torch exports linear_before_reset=1."""
+    t, bsz, _ = x.shape
+    d, three_h, hsz = w.shape[0], w.shape[1], r.shape[2]
+    if b is None:
+        b = jnp.zeros((d, 2 * three_h), x.dtype)
+    if h0 is None:
+        h0 = jnp.zeros((d, bsz, hsz), x.dtype)
+
+    def run_dir(xd, wd, rd, wbd, rbd, h0d):
+        def step(h, xt):
+            xz = xt @ wd.T + wbd
+            hz = h @ rd.T + rbd
+            z = jax.nn.sigmoid(xz[:, :hsz] + hz[:, :hsz])
+            rr = jax.nn.sigmoid(xz[:, hsz:2 * hsz] + hz[:, hsz:2 * hsz])
+            if linear_before_reset:
+                ht = jnp.tanh(xz[:, 2 * hsz:] + rr * hz[:, 2 * hsz:])
+            else:
+                ht = jnp.tanh(xz[:, 2 * hsz:]
+                              + (rr * h) @ rd[2 * hsz:].T + rbd[2 * hsz:])
+            h = (1 - z) * ht + z * h
+            return h, h
+        return lax.scan(step, h0d, xd)
+
+    def one(di, xd):
+        hN, y = run_dir(xd, w[di], r[di], b[di, :three_h],
+                        b[di, three_h:], h0[di])
+        return y, hN
+
+    return _onnx_dirs(x, d, direction, one)
+
+
+@register("onnx_rnn", num_outputs=2, aliases=["OnnxRNN"])
+def onnx_rnn(x, w, r, b=None, h0=None, direction="forward"):
+    """ONNX vanilla RNN (tanh)."""
+    t, bsz, _ = x.shape
+    d, hsz = w.shape[0], r.shape[2]
+    if b is None:
+        b = jnp.zeros((d, 2 * hsz), x.dtype)
+    if h0 is None:
+        h0 = jnp.zeros((d, bsz, hsz), x.dtype)
+    def one(di, xd):
+        def step(h, xt, _w=w[di], _r=r[di], _wb=b[di, :hsz],
+                 _rb=b[di, hsz:]):
+            h = jnp.tanh(xt @ _w.T + h @ _r.T + _wb + _rb)
+            return h, h
+
+        hN, y = lax.scan(step, h0[di], xd)
+        return y, hN
+
+    return _onnx_dirs(x, d, direction, one)
+
+
+@register("deconv2d_nchw", aliases=["ConvTransposeNCHW"])
+def deconv2d_nchw(x, w, b=None, strides=(1, 1), padding=((0, 0), (0, 0))):
+    """NCHW transposed conv with ONNX/torch weight layout (Cin, Cout, kH,
+    kW). lax's IOHW rhs spec matches that layout directly."""
+    pad = [(int(lo), int(hi)) for lo, hi in padding]
+    # lax.conv_transpose padding refers to the FORWARD conv's padding
+    # semantics via transpose; ONNX pads shrink the output:
+    # out = (in-1)*s + k - pad_lo - pad_hi
+    kh, kw = w.shape[2], w.shape[3]
+    sh, sw = (int(s) for s in strides)
+    # torch/ONNX weight (Cin, Cout, kH, kW) = the FORWARD conv's (O, I)
+    # once transposed, so the rhs spec under transpose_kernel=True is OIHW
+    full = lax.conv_transpose(
+        x, w, (sh, sw), [(kh - 1, kh - 1), (kw - 1, kw - 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), transpose_kernel=True)
+    lo_h, hi_h = pad[0]
+    lo_w, hi_w = pad[1]
+    out = full[:, :, lo_h: full.shape[2] - hi_h or None,
+               lo_w: full.shape[3] - hi_w or None]
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+@register("scatter_elements", aliases=["ScatterElements"])
+def scatter_elements(x, indices, updates, axis=0, reduction="none"):
+    """ONNX ScatterElements / torch scatter: per-element writes along one
+    axis."""
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    axis = int(axis) % x.ndim
+    grids = list(jnp.meshgrid(*[jnp.arange(s) for s in indices.shape],
+                              indexing="ij"))
+    grids[axis] = indices
+    at = x.at[tuple(grids)]
+    if reduction == "add":
+        return at.add(updates)
+    if reduction == "mul":
+        return at.multiply(updates)
+    if reduction == "min":
+        return at.min(updates)
+    if reduction == "max":
+        return at.max(updates)
+    if reduction not in ("none", "", None):
+        raise ValueError(f"scatter_elements: unknown reduction "
+                         f"{reduction!r}")
+    return at.set(updates)
+
+
+register("trilu", lambda x, k=0, upper=True:
+         (jnp.triu(x, k) if upper else jnp.tril(x, k)), aliases=["Trilu"])
+register("hardmax", lambda x, axis=-1: jax.nn.one_hot(
+    jnp.argmax(x, axis=axis), x.shape[axis], axis=axis, dtype=x.dtype),
+    aliases=["Hardmax"])
+register("global_maxpool_nchw", lambda x: jnp.max(x, axis=(2, 3),
+                                                  keepdims=True),
+         aliases=["GlobalMaxPoolNCHW"])
+register("shrink", lambda x, bias=0.0, lambd=0.5: jnp.where(
+    x < -lambd, x + bias, jnp.where(x > lambd, x - bias,
+                                    jnp.zeros_like(x))), aliases=["Shrink"])
+register("celu", lambda x, alpha=1.0: jnp.maximum(x, 0)
+         + jnp.minimum(0, alpha * jnp.expm1(x / alpha)), aliases=["Celu"])
+
+
+@register("group_norm", aliases=["GroupNormalization", "group_normalization"])
+def group_norm(x, scale, bias, num_groups, epsilon=1e-5):
+    """NCHW group normalization (ONNX GroupNormalization)."""
+    n, c = x.shape[0], x.shape[1]
+    g = int(num_groups)
+    xg = x.reshape(n, g, c // g, *x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mu = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xn = ((xg - mu) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    return xn * scale.reshape(shape) + bias.reshape(shape)
+
+
+register("reduce_logsumexp_axes",
+         lambda x, axis=None, keepdims=False:
+         jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims),
+         aliases=["ReduceLogSumExpOp"])

@@ -297,3 +297,163 @@ class TestOpsetLongTail:
                                    rtol=1e-6)
         expect = np.clip(0.2 * x + 0.5, 0, 1)
         np.testing.assert_allclose(np.asarray(out["hs"]), expect, rtol=1e-6)
+
+
+class TestOpsetTranche2:
+    """Recurrent/deconv/normalization tranche, checked against torch."""
+
+    def _import_single(self, op_type, inputs, outputs, initializers,
+                       attrs=None, n_out=1):
+        nodes = [P.make_node(op_type, list(inputs) + [t[0] for t in
+                                                      initializers],
+                             [f"y{i}" for i in range(n_out)],
+                             **(attrs or {}))]
+        g = P.make_graph(
+            nodes=nodes, name="g",
+            inputs=[P.make_value_info(k, F32, v.shape)
+                    for k, v in inputs.items()],
+            outputs=[P.make_value_info(f"y{i}", F32, ())
+                     for i in range(n_out)],
+            initializers=[P.make_tensor(k, v) for k, v in initializers],
+        )
+        sd = OnnxGraphMapper.import_graph(P.make_model(g))
+        return sd
+
+    def test_lstm_vs_torch(self):
+        T, B, I, H = 5, 2, 3, 4
+        rng = R(0)
+        x = rng.randn(T, B, I).astype(F32)
+        tl = torch.nn.LSTM(I, H)
+        with torch.no_grad():
+            want, (hN, cN) = tl(torch.tensor(x))
+        # torch gate order i,f,g,o -> ONNX i,o,f,c
+        wih = tl.weight_ih_l0.detach().numpy()
+        whh = tl.weight_hh_l0.detach().numpy()
+        bih = tl.bias_ih_l0.detach().numpy()
+        bhh = tl.bias_hh_l0.detach().numpy()
+
+        def reorder(m):
+            i, f, g, o = np.split(m, 4, axis=0)
+            return np.concatenate([i, o, f, g], axis=0)
+
+        W = reorder(wih)[None]
+        Rm = reorder(whh)[None]
+        Bm = np.concatenate([reorder(bih), reorder(bhh)])[None]
+        sd = self._import_single(
+            "LSTM", {"x": x}, ["y0", "y1", "y2"],
+            [("W", W), ("R", Rm), ("B", Bm)], n_out=3)
+        got = sd.output({"x": x}, ["y0", "y1", "y2"])
+        np.testing.assert_allclose(np.asarray(got["y0"])[:, 0],
+                                   want.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got["y1"])[0],
+                                   hN[0].numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got["y2"])[0],
+                                   cN[0].numpy(), atol=1e-5)
+
+    def test_gru_vs_torch(self):
+        T, B, I, H = 4, 2, 3, 5
+        rng = R(1)
+        x = rng.randn(T, B, I).astype(F32)
+        tg = torch.nn.GRU(I, H)
+        with torch.no_grad():
+            want, hN = tg(torch.tensor(x))
+        # torch gate order r,z,n -> ONNX z,r,h; torch = linear_before_reset
+        wih, whh = (tg.weight_ih_l0.detach().numpy(),
+                    tg.weight_hh_l0.detach().numpy())
+        bih, bhh = (tg.bias_ih_l0.detach().numpy(),
+                    tg.bias_hh_l0.detach().numpy())
+
+        def reorder(m):
+            r, z, n = np.split(m, 3, axis=0)
+            return np.concatenate([z, r, n], axis=0)
+
+        W, Rm = reorder(wih)[None], reorder(whh)[None]
+        Bm = np.concatenate([reorder(bih), reorder(bhh)])[None]
+        sd = self._import_single(
+            "GRU", {"x": x}, ["y0", "y1"],
+            [("W", W), ("R", Rm), ("B", Bm)],
+            attrs={"linear_before_reset": 1}, n_out=2)
+        got = sd.output({"x": x}, ["y0", "y1"])
+        np.testing.assert_allclose(np.asarray(got["y0"])[:, 0],
+                                   want.numpy(), atol=1e-5)
+
+    def test_conv_transpose_vs_torch(self):
+        rng = R(2)
+        x = rng.randn(1, 3, 5, 5).astype(F32)
+        ct = torch.nn.ConvTranspose2d(3, 4, 3, stride=2, padding=1)
+        with torch.no_grad():
+            want = ct(torch.tensor(x)).numpy()
+        W = ct.weight.detach().numpy()        # (Cin, Cout, kH, kW)
+        b = ct.bias.detach().numpy()
+        sd = self._import_single(
+            "ConvTranspose", {"x": x}, ["y0"],
+            [("W", W), ("B", b)],
+            attrs={"kernel_shape": [3, 3], "strides": [2, 2],
+                   "pads": [1, 1, 1, 1]})
+        got = np.asarray(sd.output({"x": x}, "y0")["y0"])
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_group_norm_vs_torch(self):
+        rng = R(3)
+        x = rng.randn(2, 6, 4, 4).astype(F32)
+        gn = torch.nn.GroupNorm(3, 6)
+        with torch.no_grad():
+            want = gn(torch.tensor(x)).numpy()
+        sd = self._import_single(
+            "GroupNormalization", {"x": x}, ["y0"],
+            [("scale", gn.weight.detach().numpy()),
+             ("bias", gn.bias.detach().numpy())],
+            attrs={"num_groups": 3})
+        got = np.asarray(sd.output({"x": x}, "y0")["y0"])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_scatter_elements_trilu_shrink_celu(self):
+        from deeplearning4j_tpu.ops.registry import exec_op
+        x = torch.zeros(3, 4)
+        idx = torch.tensor([[0, 1], [2, 0]])
+        upd = torch.tensor([[5.0, 6.0], [7.0, 8.0]])
+        want = x.scatter(1, idx, upd).numpy()
+        got = exec_op("scatter_elements", np.zeros((3, 4), F32),
+                      idx.numpy(), upd.numpy(), axis=1)
+        np.testing.assert_allclose(np.asarray(got), want)
+        a = R(4).randn(4, 4).astype(F32)
+        np.testing.assert_allclose(np.asarray(exec_op("trilu", a, k=1)),
+                                   np.triu(a, 1))
+        v = R(5).randn(8).astype(F32)
+        np.testing.assert_allclose(
+            np.asarray(exec_op("celu", v, alpha=0.7)),
+            torch.celu(torch.tensor(v), 0.7).numpy(), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(exec_op("shrink", v, bias=0.1, lambd=0.3)),
+            torch.nn.functional.softshrink(torch.tensor(v), 0.3).numpy()
+            + np.where(np.abs(v) > 0.3, np.sign(v) * (0.3 - 0.1), 0.0),
+            atol=1e-6)
+
+    def test_lstm_skipped_optional_inputs_stay_in_slots(self):
+        # no-bias LSTM with initial state: '' optionals must not shift
+        # later inputs into wrong slots (b/seq_lens confusion)
+        T, B, I, H = 3, 2, 3, 4
+        rng = R(7)
+        x = rng.randn(T, B, I).astype(F32)
+        W = (rng.randn(1, 4 * H, I) * 0.3).astype(F32)
+        Rm = (rng.randn(1, 4 * H, H) * 0.3).astype(F32)
+        h0 = rng.randn(1, B, H).astype(F32)
+        c0 = rng.randn(1, B, H).astype(F32)
+        nodes = [P.make_node("LSTM", ["x", "W", "R", "", "", "h0", "c0"],
+                             ["y", "yh", "yc"])]
+        g = P.make_graph(
+            nodes=nodes, name="g",
+            inputs=[P.make_value_info("x", F32, (T, B, I))],
+            outputs=[P.make_value_info(n, F32, ()) for n in
+                     ("y", "yh", "yc")],
+            initializers=[P.make_tensor("W", W), P.make_tensor("R", Rm),
+                          P.make_tensor("h0", h0),
+                          P.make_tensor("c0", c0)])
+        sd = OnnxGraphMapper.import_graph(P.make_model(g))
+        got = sd.output({"x": x}, ["y", "yh"])
+        from deeplearning4j_tpu.ops.registry import exec_op
+        want_y, want_h, _ = exec_op("onnx_lstm", x, W, Rm, None, h0, c0)
+        np.testing.assert_allclose(np.asarray(got["y"]),
+                                   np.asarray(want_y), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got["yh"]),
+                                   np.asarray(want_h), atol=1e-6)
