@@ -296,17 +296,19 @@ class _Namespace:
         args = [self.sd._lift(a) for a in args]
         return self.sd._op(name, *args, **attrs)
 
-
-class SDMath(_Namespace):
-    """Ref: ``SDMath`` / ``SDBaseOps`` transform ops."""
-
     def __getattr__(self, item):
-        # generic fall-through: any registered unary/binary op by name
+        # generic fall-through on EVERY namespace: any registered op by
+        # name (the reference generates its ~200-method namespace classes
+        # with codegen, SURVEY E8; here the registry IS the codegen source)
         if op_registry.has(item):
             def call(*args, **attrs):
                 return self._op(item, *args, **attrs)
             return call
         raise AttributeError(item)
+
+
+class SDMath(_Namespace):
+    """Ref: ``SDMath`` / ``SDBaseOps`` transform ops."""
 
     def square(self, x): return self._op("square", x)
     def abs(self, x): return self._op("abs", x)
