@@ -116,6 +116,28 @@ class _H5Weights:
 
 
 # ------------------------------------------------------------ layer mapping
+# ---- custom/lambda layer registries (ref: KerasLayer.registerCustomLayer
+# and KerasLayerUtils.registerLambdaLayer) -------------------------------
+_CUSTOM_LAYERS: Dict[str, "object"] = {}
+_LAMBDA_LAYERS: Dict[str, "object"] = {}
+
+
+def register_custom_layer(class_name: str, builder):
+    """ref: ``KerasLayer.registerCustomLayer(name, clazz)``. ``builder`` is
+    ``fn(config_dict) -> Layer``; consulted for unknown class_names."""
+    _CUSTOM_LAYERS[class_name] = builder
+
+
+def register_lambda_layer(layer_name: str, fn, output_type_fn=None):
+    """ref: ``KerasLayerUtils.registerLambdaLayer``. ``fn`` is a
+    jax-traceable ``fn(x) -> y`` bound to the Keras Lambda layer's NAME
+    (lambda bodies cannot be deserialized from H5). ``output_type_fn``
+    (InputType -> InputType) must be given for shape-CHANGING lambdas so
+    downstream layers infer n_in correctly."""
+    _LAMBDA_LAYERS[layer_name] = (fn, output_type_fn)
+    L.LAMBDA_REGISTRY[layer_name] = (fn, output_type_fn)
+
+
 def _map_layer(cls: str, cfg: dict):
     """Keras layer config dict → (our Layer | '__flatten__' | None).
 
@@ -293,8 +315,20 @@ def _map_layer(cls: str, cfg: dict):
             # wrapped, as the reference's KerasLSTM does with LastTimeStep
             return L.LastTimeStep.wrap(lyr)
         return lyr
+    if cls == "Lambda":
+        entry = _LAMBDA_LAYERS.get(name)
+        if entry is None:
+            raise UnsupportedKerasConfigurationException(
+                f"Keras Lambda layer {name!r}: register its body with "
+                f"keras.register_lambda_layer({name!r}, fn) before import "
+                f"(lambda code cannot be read from H5)")
+        fn, ot = entry
+        return L.LambdaLayer(name=name, fn=fn, output_type_fn=ot)
+    if cls in _CUSTOM_LAYERS:
+        return _CUSTOM_LAYERS[cls](cfg)
     raise UnsupportedKerasConfigurationException(
-        f"Unsupported Keras layer type {cls!r}")
+        f"Unsupported Keras layer type {cls!r} (register a builder with "
+        f"keras.register_custom_layer({cls!r}, builder))")
 
 
 def _load_weights_into(layer, w: Dict[str, np.ndarray], params: dict,

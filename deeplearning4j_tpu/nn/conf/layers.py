@@ -1325,3 +1325,43 @@ class LayerNormalization(Layer):
         y = (xf - mu) * lax.rsqrt(var + self.eps)
         y = y * params["gamma"].astype(acc) + params["beta"].astype(acc)
         return self._act(y.astype(x.dtype)), state
+
+
+# name-keyed lambda registry: bodies are code and cannot be serialized;
+# JSON stores the NAME and revival looks it up here (the reference's
+# registerLambdaLayer contract applies at load time too)
+LAMBDA_REGISTRY: Dict[str, Any] = {}
+
+
+@register_layer
+@dataclasses.dataclass
+class LambdaLayer(Layer):
+    """Arbitrary jax-traceable function as a layer (ref:
+    ``SameDiffLambdaLayer`` / Keras ``Lambda`` — the importer's custom-layer
+    escape hatch). Serializes by NAME; the body must be registered in
+    ``LAMBDA_REGISTRY`` (via keras.register_lambda_layer) in the loading
+    process."""
+    fn: Any = None
+    output_type_fn: Any = None       # optional InputType -> InputType
+
+    def __post_init__(self):
+        if self.fn is None and self.name:
+            entry = LAMBDA_REGISTRY.get(self.name)
+            if entry is None:
+                raise ValueError(
+                    f"LambdaLayer {self.name!r}: body not registered — "
+                    f"call register_lambda_layer({self.name!r}, fn) "
+                    f"before loading")
+            self.fn, self.output_type_fn = entry
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return self.fn(x), state
+
+    def output_type(self, input_type):
+        if self.output_type_fn is not None:
+            return self.output_type_fn(input_type)
+        return input_type
+
+    def to_dict(self):
+        # body serializes by name only (clone/TransferLearning/save paths)
+        return {"@layer": "LambdaLayer", "name": self.name}

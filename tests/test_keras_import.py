@@ -258,3 +258,79 @@ def test_sequential_relu6_layer(tmp_path):
     expected = m.predict(x, verbose=0)
     got = np.asarray(net.output(x))
     assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_lambda_layer_and_custom_registry(tmp_path):
+    """ref: KerasLayer.registerCustomLayer / registerLambdaLayer — lambda
+    bodies re-registered in code, unknown classes routed to builders."""
+    import jax.numpy as jnp
+    import tensorflow as tf
+
+    from deeplearning4j_tpu.modelimport import keras as ki
+
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(6, activation="relu"),
+        tf.keras.layers.Lambda(lambda t: t * 2.0 + 1.0,
+                               name="double_shift"),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    path = str(tmp_path / "lam.h5")
+    m.save(path)
+
+    # un-registered lambda: clear, actionable error
+    with pytest.raises(Exception, match="register_lambda_layer"):
+        ki.KerasModelImport.importKerasSequentialModelAndWeights(path)
+
+    ki.register_lambda_layer("double_shift", lambda x: x * 2.0 + 1.0)
+    try:
+        net = ki.KerasModelImport.importKerasSequentialModelAndWeights(path)
+        x = np.random.RandomState(0).rand(5, 4).astype("float32")
+        want = m.predict(x, verbose=0)
+        got = np.asarray(net.output(x))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # name-keyed serialization: clone()/to_json round-trips revive the
+        # body from the registry
+        back = type(net.conf).from_json(net.conf.to_json())
+        assert back.layers[1].fn is not None
+    finally:
+        ki._LAMBDA_LAYERS.clear()
+        from deeplearning4j_tpu.nn.conf.layers import LAMBDA_REGISTRY
+        LAMBDA_REGISTRY.clear()
+
+
+def test_custom_layer_builder_registry(tmp_path):
+    """Unknown class_names route to registered builders (ref:
+    KerasLayer.registerCustomLayer)."""
+    import tensorflow as tf
+
+    from deeplearning4j_tpu.modelimport import keras as ki
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    class Doubler(tf.keras.layers.Layer):
+        def call(self, t):
+            return t * 2.0
+
+    m = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(6, activation="relu"),
+        Doubler(),
+        tf.keras.layers.Dense(3, activation="softmax"),
+    ])
+    path = str(tmp_path / "cust.h5")
+    m.save(path)
+
+    with pytest.raises(Exception, match="register_custom_layer"):
+        ki.KerasModelImport.importKerasSequentialModelAndWeights(path)
+
+    ki.register_custom_layer(
+        "Doubler", lambda cfg: L.LambdaLayer(name=cfg.get("name"),
+                                             fn=lambda x: x * 2.0))
+    try:
+        net = ki.KerasModelImport.importKerasSequentialModelAndWeights(path)
+        x = np.random.RandomState(1).rand(5, 4).astype("float32")
+        want = m.predict(x, verbose=0)
+        np.testing.assert_allclose(np.asarray(net.output(x)), want,
+                                   atol=1e-5)
+    finally:
+        ki._CUSTOM_LAYERS.clear()
