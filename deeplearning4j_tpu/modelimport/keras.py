@@ -119,7 +119,8 @@ class _H5Weights:
 # ---- custom/lambda layer registries (ref: KerasLayer.registerCustomLayer
 # and KerasLayerUtils.registerLambdaLayer) -------------------------------
 _CUSTOM_LAYERS: Dict[str, "object"] = {}
-_LAMBDA_LAYERS: Dict[str, "object"] = {}
+# single source of truth for lambda bodies: layers.LAMBDA_REGISTRY
+_LAMBDA_LAYERS = L.LAMBDA_REGISTRY
 
 
 def register_custom_layer(class_name: str, builder):
@@ -134,7 +135,6 @@ def register_lambda_layer(layer_name: str, fn, output_type_fn=None):
     (lambda bodies cannot be deserialized from H5). ``output_type_fn``
     (InputType -> InputType) must be given for shape-CHANGING lambdas so
     downstream layers infer n_in correctly."""
-    _LAMBDA_LAYERS[layer_name] = (fn, output_type_fn)
     L.LAMBDA_REGISTRY[layer_name] = (fn, output_type_fn)
 
 

@@ -178,3 +178,197 @@ def sort(a, axis=-1, descending=False) -> NDArray:
 
 def diag(a) -> NDArray:
     return NDArray(jnp.diag(_unwrap(a)))
+
+
+# --------------------------------------------------------------------------
+# Nd4j static surface, tranche 2 (ref: org.nd4j.linalg.factory.Nd4j ~7k
+# lines of statics — IO, structure, random-distribution, reduction tails)
+
+def readNumpy(path, dtype=None) -> NDArray:
+    """ref: Nd4j.readNumpy — .npy file → array. ``dtype`` accepts the
+    DL4J-style names every other factory API does ("float" == float32)."""
+    arr = np.load(path)
+    return NDArray(jnp.asarray(arr if dtype is None
+                               else arr.astype(_dt.resolve(dtype))))
+
+
+def writeNumpy(arr, path) -> None:
+    np.save(path, np.asarray(_unwrap(arr)))
+
+
+createFromNpyFile = readNumpy
+
+
+def saveBinary(arr, path) -> None:
+    """ref: Nd4j.saveBinary — portable single-array binary (npy format)."""
+    np.save(path, np.asarray(_unwrap(arr)))
+
+
+def readBinary(path) -> NDArray:
+    return NDArray(jnp.asarray(np.load(path)))
+
+
+def toFlattened(*arrays) -> NDArray:
+    """ref: Nd4j.toFlattened — concat everything as one flat vector."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(jnp.concatenate([jnp.ravel(_unwrap(a))
+                                    for a in arrays]))
+
+
+def expandDims(a, axis) -> NDArray:
+    return NDArray(jnp.expand_dims(_unwrap(a), axis))
+
+
+def squeeze(a, axis=None) -> NDArray:
+    return NDArray(jnp.squeeze(_unwrap(a), axis))
+
+
+def tile(a, *reps) -> NDArray:
+    reps = reps[0] if len(reps) == 1 and isinstance(reps[0],
+                                                    (list, tuple)) else reps
+    return NDArray(jnp.tile(_unwrap(a), reps))
+
+
+def repeat(a, repeats, axis=None) -> NDArray:
+    return NDArray(jnp.repeat(_unwrap(a), repeats, axis=axis))
+
+
+def reverse(a, axis=None) -> NDArray:
+    """ref: Nd4j.reverse."""
+    return NDArray(jnp.flip(_unwrap(a), axis=axis))
+
+
+flip = reverse
+
+
+def roll(a, shift, axis=None) -> NDArray:
+    return NDArray(jnp.roll(_unwrap(a), shift, axis=axis))
+
+
+def triu(a, k=0) -> NDArray:
+    return NDArray(jnp.triu(_unwrap(a), k))
+
+
+def tril(a, k=0) -> NDArray:
+    return NDArray(jnp.tril(_unwrap(a), k))
+
+
+def meshgrid(*xs, indexing="xy"):
+    return tuple(NDArray(g) for g in
+                 jnp.meshgrid(*[_unwrap(x) for x in xs],
+                              indexing=indexing))
+
+
+def split(a, parts, axis=0):
+    return [NDArray(p) for p in jnp.split(_unwrap(a), parts, axis=axis)]
+
+
+def kron(a, b) -> NDArray:
+    return NDArray(jnp.kron(_unwrap(a), _unwrap(b)))
+
+
+def dot(a, b) -> NDArray:
+    return NDArray(jnp.dot(_unwrap(a), _unwrap(b)))
+
+
+def matmul(a, b) -> NDArray:
+    return NDArray(jnp.matmul(_unwrap(a), _unwrap(b)))
+
+
+def pile(*arrays) -> NDArray:
+    """ref: Nd4j.pile — stack along a new leading axis."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=0))
+
+
+def tear(a, axis=0):
+    """ref: Nd4j.tear — unstack along an axis."""
+    buf = _unwrap(a)
+    return [NDArray(jnp.squeeze(p, axis=axis))
+            for p in jnp.split(buf, buf.shape[axis], axis=axis)]
+
+
+def argMax(a, axis=None) -> NDArray:
+    return NDArray(jnp.argmax(_unwrap(a), axis=axis).astype(jnp.int32))
+
+
+def argMin(a, axis=None) -> NDArray:
+    return NDArray(jnp.argmin(_unwrap(a), axis=axis).astype(jnp.int32))
+
+
+# random-distribution statics (ref: Nd4j.randomBernoulli etc.) — route
+# through the stateful RNG facade so setSeed governs reproducibility
+
+def randomBernoulli(p, *shape) -> NDArray:
+    return NDArray(jax.random.bernoulli(_rng.next_key(), p, tuple(shape))
+                   .astype(jnp.float32))
+
+
+def randomExponential(lam, *shape) -> NDArray:
+    return NDArray(jax.random.exponential(_rng.next_key(), tuple(shape))
+                   / lam)
+
+
+def randomGamma(alpha, *shape) -> NDArray:
+    return NDArray(jax.random.gamma(_rng.next_key(), alpha, tuple(shape)))
+
+
+def randomPoisson(lam, *shape) -> NDArray:
+    return NDArray(jax.random.poisson(_rng.next_key(), lam, tuple(shape))
+                   .astype(jnp.float32))
+
+
+def randomBinomial(n, p, *shape) -> NDArray:
+    # O(shape) memory — never materialize an (n, *shape) bernoulli tensor
+    return NDArray(jax.random.binomial(_rng.next_key(), float(n), p,
+                                       tuple(shape)).astype(jnp.float32))
+
+
+def choice(source, probs, n) -> NDArray:
+    src = _unwrap(source)
+    idx = jax.random.choice(_rng.next_key(), src.shape[0], (int(n),),
+                            p=_unwrap(probs))
+    return NDArray(jnp.take(src, idx, axis=0))
+
+
+# reduction statics (ref: Nd4j.max/min/mean/std/sum/var/norm1/norm2)
+def max(a, axis=None) -> NDArray:
+    return NDArray(jnp.max(_unwrap(a), axis=axis))
+
+
+def min(a, axis=None) -> NDArray:
+    return NDArray(jnp.min(_unwrap(a), axis=axis))
+
+
+def sum(a, axis=None) -> NDArray:
+    return NDArray(jnp.sum(_unwrap(a), axis=axis))
+
+
+def mean(a, axis=None) -> NDArray:
+    return NDArray(jnp.mean(_unwrap(a), axis=axis))
+
+
+def std(a, axis=None) -> NDArray:
+    return NDArray(jnp.std(_unwrap(a), axis=axis, ddof=1))
+
+
+def var(a, axis=None) -> NDArray:
+    return NDArray(jnp.var(_unwrap(a), axis=axis, ddof=1))
+
+
+def norm1(a, axis=None) -> NDArray:
+    return NDArray(jnp.sum(jnp.abs(_unwrap(a)), axis=axis))
+
+
+def norm2(a, axis=None) -> NDArray:
+    return NDArray(jnp.sqrt(jnp.sum(jnp.square(_unwrap(a)), axis=axis)))
+
+
+def normmax(a, axis=None) -> NDArray:
+    return NDArray(jnp.max(jnp.abs(_unwrap(a)), axis=axis))
+
+
+def prod(a, axis=None) -> NDArray:
+    return NDArray(jnp.prod(_unwrap(a), axis=axis))

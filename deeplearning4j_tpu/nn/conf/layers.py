@@ -1353,6 +1353,11 @@ class LambdaLayer(Layer):
                     f"call register_lambda_layer({self.name!r}, fn) "
                     f"before loading")
             self.fn, self.output_type_fn = entry
+        elif self.fn is not None and self.name:
+            # self-register: any LambdaLayer built with an inline body
+            # (e.g. by a custom-layer builder) can revive from JSON by name
+            LAMBDA_REGISTRY.setdefault(self.name,
+                                       (self.fn, self.output_type_fn))
 
     def apply(self, params, x, training=False, rng=None, state=None):
         return self.fn(x), state

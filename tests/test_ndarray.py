@@ -381,3 +381,68 @@ class TestINDArrayTranche2:
         c = nd.create([6.0, 12.0])
         np.testing.assert_allclose(a.rdivColumnVector(c).toNumpy(),
                                    [[2, 6, 3], [2, 2.4, 3]])
+
+
+class TestFactoryTranche2:
+    """Nd4j static surface tranche 2 (IO, structure, random, reductions)."""
+
+    def test_npy_and_binary_io(self, tmp_path):
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = nd.rand(3, 4)
+        p = str(tmp_path / "a.npy")
+        nd.writeNumpy(a, p)
+        back = nd.readNumpy(p)
+        np.testing.assert_allclose(back.toNumpy(), a.toNumpy())
+        p2 = str(tmp_path / "b.npy")
+        nd.saveBinary(a, p2)
+        np.testing.assert_allclose(nd.readBinary(p2).toNumpy(),
+                                   a.toNumpy())
+
+    def test_structure_statics(self):
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert nd.toFlattened(a, a).shape == (8,)
+        assert nd.expandDims(a, 0).shape == (1, 2, 2)
+        assert nd.tile(a, 2, 1).shape == (4, 2)
+        assert nd.repeat(a, 2, axis=1).shape == (2, 4)
+        np.testing.assert_allclose(nd.reverse(a, 0).toNumpy(),
+                                   [[3, 4], [1, 2]])
+        assert len(nd.split(a, 2, axis=0)) == 2
+        piled = nd.pile(a, a, a)
+        assert piled.shape == (3, 2, 2)
+        torn = nd.tear(piled, 0)
+        assert len(torn) == 3 and torn[0].shape == (2, 2)
+        np.testing.assert_allclose(nd.kron(nd.eye(2), a).toNumpy()[0, :2],
+                                   [1, 2])
+        assert int(nd.argMax(a).item()) == 3
+
+    def test_random_statics_reproducible(self):
+        from deeplearning4j_tpu.ndarray import factory as nd
+        nd.setSeed(99)
+        a = nd.randomBernoulli(0.5, 100)
+        b = nd.randomExponential(2.0, 1000)
+        g = nd.randomGamma(3.0, 500)
+        p = nd.randomPoisson(4.0, 500)
+        bi = nd.randomBinomial(10, 0.3, 500)
+        ch = nd.choice(nd.create([1.0, 2.0, 3.0]),
+                       nd.create([0.2, 0.3, 0.5]), 50)
+        assert 0.3 < float(a.meanNumber()) < 0.7
+        assert 0.4 < float(b.meanNumber()) < 0.6        # mean 1/lam
+        assert 2.5 < float(g.meanNumber()) < 3.5
+        assert 3.5 < float(p.meanNumber()) < 4.5
+        assert 2.5 < float(bi.meanNumber()) < 3.5       # n*p = 3
+        assert ch.shape == (50,)
+        nd.setSeed(99)
+        a2 = nd.randomBernoulli(0.5, 100)
+        np.testing.assert_allclose(a.toNumpy(), a2.toNumpy())
+
+    def test_reduction_statics(self):
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = nd.create([[1.0, -2.0], [3.0, -4.0]])
+        assert float(nd.max(a).item()) == 3.0
+        assert float(nd.norm1(a).item()) == 10.0
+        np.testing.assert_allclose(float(nd.norm2(a).item()),
+                                   np.sqrt(30.0), rtol=1e-6)
+        np.testing.assert_allclose(nd.std(a, 0).toNumpy(),
+                                   np.std(a.toNumpy(), 0, ddof=1),
+                                   rtol=1e-6)
