@@ -48,6 +48,9 @@ def layer_from_dict(d: dict) -> "Layer":
 
 
 def _revive(k, v):
+    if k == "weight_noise" and isinstance(v, dict):
+        from deeplearning4j_tpu.nn.weightnoise import noise_from_dict
+        return noise_from_dict(v)
     if isinstance(v, list):
         return tuple(v)
     return v
@@ -79,11 +82,16 @@ class Layer:
     l2: Optional[float] = None
     dropout: Optional[float] = None     # retain probability
     bias_init: float = 0.0
+    # ref: BaseLayer#weightNoise (conf.weightnoise.IWeightNoise) — applied
+    # to WEIGHTS by the forward walk at training time
+    weight_noise: Any = None
 
     # ---------------- config protocol
     def to_dict(self) -> dict:
         d = {k: v for k, v in dataclasses.asdict(self).items()
              if not k.startswith("_") and (v is not None or k in ("name",))}
+        if self.weight_noise is not None:
+            d["weight_noise"] = self.weight_noise.to_dict()
         d["@layer"] = type(self).__name__
         return d
 

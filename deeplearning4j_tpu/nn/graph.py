@@ -150,6 +150,9 @@ class ComputationGraph:
                 if cdtype is not None and name not in out_names:
                     lp = cast_params(lp, cdtype)
                 lrng = jax.random.fold_in(rng, li) if rng is not None else None
+                wn = getattr(node.layer, "weight_noise", None)
+                if wn is not None and training and lrng is not None:
+                    lp = wn.apply(lp, jax.random.fold_in(lrng, 7919))
                 lst = states.get(name)
                 kwargs = {}
                 if mask is not None and isinstance(node.layer, _MASK_AWARE):
@@ -199,7 +202,9 @@ class ComputationGraph:
             if not l1 and not l2:
                 continue
             for pname, arr in params.get(name, {}).items():
-                if pname.lower().startswith(("b", "beta", "gamma", "p")):
+                from deeplearning4j_tpu.nn.weightnoise import (
+                    is_weight_param)
+                if not is_weight_param(pname, arr):
                     continue
                 if l1:
                     penalty = penalty + l1 * jnp.sum(jnp.abs(arr))

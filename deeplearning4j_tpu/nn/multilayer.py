@@ -203,6 +203,10 @@ class MultiLayerNetwork:
                 h = _cast_float(h, jnp.float32)   # final layer in f32
             lst = states.get(lkey)
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            wn = getattr(layer, "weight_noise", None)
+            if wn is not None and training and lrng is not None:
+                # ref: IWeightNoise applies to weights at training forward
+                lp = wn.apply(lp, jax.random.fold_in(lrng, 7919))
             kwargs = {}
             if mask is not None and isinstance(layer, _MASK_AWARE):
                 kwargs["mask"] = mask
@@ -248,8 +252,9 @@ class MultiLayerNetwork:
             l2 = getattr(layer, "l2", None)
             if not l1 and not l2:
                 continue
+            from deeplearning4j_tpu.nn.weightnoise import is_weight_param
             for pname, arr in params.get(str(i), {}).items():
-                if pname.lower().startswith(("b", "beta", "gamma", "p")):
+                if not is_weight_param(pname, arr):
                     continue
                 if l1:
                     penalty = penalty + l1 * jnp.sum(jnp.abs(arr))

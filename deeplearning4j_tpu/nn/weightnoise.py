@@ -1,0 +1,80 @@
+"""Weight noise (ref: ``org.deeplearning4j.nn.conf.weightnoise.{DropConnect,
+WeightNoise}`` — IWeightNoise applied to WEIGHTS at training-forward time,
+unlike dropout which masks activations). Applied centrally by the
+MLN/ComputationGraph forward walk; biases and normalization params are left
+untouched (the reference's applyToBias=false default)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+def is_weight_param(pname: str, value) -> bool:
+    """Weight-vs-bias classification shared by weight noise and L1/L2
+    regularization: weights are the >=2-D tensors (matrices/kernels);
+    1-D params (biases, BN gamma/beta, peepholes) are not. Name-prefix
+    heuristics misfire on names like 'pW' (pointwise) or 'b_W'
+    (backward-direction weights)."""
+    return jnp.ndim(value) >= 2
+
+
+@dataclasses.dataclass
+class DropConnect:
+    """Bernoulli weight masking (Wan et al. 2013; ref: weightnoise
+    .DropConnect). ``p`` is the RETAIN probability (reference semantics);
+    kept weights are inverse-scaled so expectations match inference."""
+    p: float = 0.5
+    apply_to_bias: bool = False
+
+    def apply(self, params: dict, rng) -> dict:
+        out = {}
+        for i, (k, w) in enumerate(sorted(params.items())):
+            if self.apply_to_bias or is_weight_param(k, w):
+                sub = jax.random.fold_in(rng, i)
+                mask = jax.random.bernoulli(sub, self.p, jnp.shape(w))
+                out[k] = jnp.where(mask, w / self.p, 0.0).astype(w.dtype)
+            else:
+                out[k] = w
+        return out
+
+    def to_dict(self):
+        return {"@noise": "DropConnect", "p": self.p,
+                "apply_to_bias": self.apply_to_bias}
+
+
+@dataclasses.dataclass
+class WeightNoise:
+    """Additive (default) or multiplicative Gaussian weight noise (ref:
+    weightnoise.WeightNoise with a NormalDistribution)."""
+    std: float = 0.01
+    additive: bool = True
+    apply_to_bias: bool = False
+
+    def apply(self, params: dict, rng) -> dict:
+        out = {}
+        for i, (k, w) in enumerate(sorted(params.items())):
+            if self.apply_to_bias or is_weight_param(k, w):
+                sub = jax.random.fold_in(rng, i)
+                n = jax.random.normal(sub, jnp.shape(w), jnp.float32) \
+                    * self.std
+                out[k] = (w + n.astype(w.dtype) if self.additive
+                          else w * (1.0 + n).astype(w.dtype))
+            else:
+                out[k] = w
+        return out
+
+    def to_dict(self):
+        return {"@noise": "WeightNoise", "std": self.std,
+                "additive": self.additive,
+                "apply_to_bias": self.apply_to_bias}
+
+
+def noise_from_dict(d: Any):
+    if d is None or not isinstance(d, dict) or "@noise" not in d:
+        return d
+    d = dict(d)
+    kind = d.pop("@noise")
+    return {"DropConnect": DropConnect,
+            "WeightNoise": WeightNoise}[kind](**d)

@@ -36,6 +36,9 @@ SPECS = {
     "ActivationLayer": (lambda: L.ActivationLayer(activation="tanh"),
                         _x((3, 4)), {}),
     "DropoutLayer": (lambda: L.DropoutLayer(dropout=0.5), _x((3, 4)), {}),
+    "LambdaLayer": (lambda: L.LambdaLayer(name="gc_lambda",
+                                          fn=lambda t: jnp.tanh(t) * 2.0),
+                    _x((3, 4)), {}),
     "ConvolutionLayer": (lambda: L.ConvolutionLayer(
         kernel_size=(3, 3), n_in=2, n_out=3), _x((2, 5, 5, 2)), {}),
     "Deconvolution2D": (lambda: L.Deconvolution2D(

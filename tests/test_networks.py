@@ -396,3 +396,42 @@ def test_no_retrace_across_fit_steps():
     for _ in range(3):
         gnet.fit(xi, yi)
     assert ComputationGraph._train_step._cache_size() - before == 1
+
+
+def test_weight_noise_dropconnect():
+    """ref: conf.weightnoise.{DropConnect,WeightNoise} — weight-level noise
+    at training forward; inference is deterministic and unnoised."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.weightnoise import (DropConnect, WeightNoise,
+                                                   noise_from_dict)
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu",
+                              weight_noise=DropConnect(p=0.8)))
+            .layer(DenseLayer(n_out=16, activation="relu",
+                              weight_noise=WeightNoise(std=0.05)))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 6).astype("float32")
+    y = np.eye(3, dtype="float32")[rng.randint(0, 3, 16)]
+    net.fit(x, y)
+    s0 = net.score()
+    for _ in range(15):
+        net.fit(x, y)
+    assert np.isfinite(net.score()) and net.score() < s0
+    # inference: deterministic, no noise
+    a = np.asarray(net.output(x))
+    b = np.asarray(net.output(x))
+    np.testing.assert_allclose(a, b)
+    # JSON round-trip revives the noise objects
+    back = type(net.conf).from_json(net.conf.to_json())
+    assert isinstance(back.layers[0].weight_noise, DropConnect)
+    assert back.layers[0].weight_noise.p == 0.8
+    assert isinstance(back.layers[1].weight_noise, WeightNoise)
