@@ -3,4 +3,8 @@ from deeplearning4j_tpu.ndarray.ndarray import NDArray
 from deeplearning4j_tpu.ndarray import factory as nd
 from deeplearning4j_tpu.ndarray import dtypes
 
-__all__ = ["NDArray", "nd", "dtypes"]
+from deeplearning4j_tpu.ndarray.indexing import (BooleanIndexing,
+                                                 NDArrayIndex)
+
+__all__ = ["NDArrayIndex", "BooleanIndexing", "NDArray", "nd", "dtypes"]
+

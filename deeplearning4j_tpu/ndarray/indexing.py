@@ -1,0 +1,112 @@
+"""NDArrayIndex compatibility surface (ref:
+``org.nd4j.linalg.indexing.NDArrayIndex`` + ``indexing.BooleanIndexing``).
+
+The migrating user's first reach: ``arr.get(NDArrayIndex.interval(0, 2),
+NDArrayIndex.all())``. Index objects translate to the python slicing the
+array API already implements (copy-on-write views, scatter write-through).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Index:
+    """One INDArrayIndex: wraps the equivalent python index object."""
+
+    def __init__(self, py):
+        self.py = py
+
+    def __repr__(self):
+        return f"NDArrayIndex({self.py!r})"
+
+
+class NDArrayIndex:
+    """Static factory (ref: indexing.NDArrayIndex)."""
+
+    @staticmethod
+    def all() -> _Index:
+        return _Index(slice(None))
+
+    @staticmethod
+    def point(i: int) -> _Index:
+        return _Index(int(i))
+
+    @staticmethod
+    def interval(*args) -> _Index:
+        """Mirrors ND4J's overloads EXACTLY (argument order matters):
+        ``interval(begin, end)``, ``interval(begin, stride, end)``,
+        ``interval(begin, stride, end, inclusive)``."""
+        if len(args) == 2:
+            begin, stride, end, inclusive = args[0], 1, args[1], False
+        elif len(args) == 3:
+            begin, stride, end = args
+            inclusive = False
+        elif len(args) == 4:
+            begin, stride, end, inclusive = args
+        else:
+            raise TypeError("interval takes 2-4 arguments "
+                            "(begin[, stride], end[, inclusive])")
+        end = int(end) + (1 if inclusive else 0)
+        return _Index(slice(int(begin), end, int(stride)))
+
+    @staticmethod
+    def indices(*idx) -> _Index:
+        """Fancy index along one axis (ref: NDArrayIndex.indices)."""
+        if len(idx) == 1 and hasattr(idx[0], "__len__"):
+            idx = idx[0]
+        return _Index(jnp.asarray(np.asarray(idx, np.int32)))
+
+    @staticmethod
+    def newAxis() -> _Index:
+        return _Index(None)
+
+    new_axis = newAxis
+
+    @staticmethod
+    def empty() -> _Index:
+        return _Index(slice(0, 0))
+
+
+def resolve(idx_tuple):
+    """Translate a mixed tuple of _Index / ints / slices to python
+    indexing; passthrough when no _Index objects are present."""
+    if not isinstance(idx_tuple, tuple):
+        idx_tuple = (idx_tuple,)
+    if not any(isinstance(i, _Index) for i in idx_tuple):
+        return idx_tuple if len(idx_tuple) != 1 else idx_tuple[0]
+    out = tuple(i.py if isinstance(i, _Index) else i for i in idx_tuple)
+    return out if len(out) != 1 else out[0]
+
+
+class BooleanIndexing:
+    """ref: org.nd4j.linalg.indexing.BooleanIndexing statics."""
+
+    @staticmethod
+    def replaceWhere(arr, replacement, cond):
+        return arr.replaceWhere(replacement, cond)
+
+    @staticmethod
+    def and_(arr, cond) -> bool:
+        from deeplearning4j_tpu.ndarray.ndarray import _cond_mask
+        return bool(jnp.all(_cond_mask(arr.buf(), cond)))
+
+    @staticmethod
+    def or_(arr, cond) -> bool:
+        from deeplearning4j_tpu.ndarray.ndarray import _cond_mask
+        return bool(jnp.any(_cond_mask(arr.buf(), cond)))
+
+    @staticmethod
+    def firstIndex(arr, cond):
+        from deeplearning4j_tpu.ndarray.ndarray import _cond_mask
+        m = _cond_mask(arr.buf(), cond).ravel()
+        hit = jnp.argmax(m)
+        return int(jnp.where(m[hit], hit, -1))
+
+    @staticmethod
+    def lastIndex(arr, cond):
+        from deeplearning4j_tpu.ndarray.ndarray import _cond_mask
+        m = _cond_mask(arr.buf(), cond).ravel()
+        rev = jnp.argmax(jnp.flip(m))
+        n = m.shape[0]
+        return int(jnp.where(jnp.any(m), n - 1 - rev, -1))

@@ -156,11 +156,15 @@ class NDArray:
         self._write(self.buf().at[idx].set(_unwrap(value)))
 
     def get(self, *idx) -> "NDArray":
-        """Reference: INDArray#get(INDArrayIndex...) — returns a live view."""
-        return self.__getitem__(idx if len(idx) != 1 else idx[0])
+        """Reference: INDArray#get(INDArrayIndex...) — returns a live view.
+        Accepts NDArrayIndex objects (point/interval/all/newAxis/indices)
+        as well as plain python ints/slices."""
+        from deeplearning4j_tpu.ndarray.indexing import resolve
+        return self.__getitem__(resolve(idx))
 
     def put(self, idx, value) -> "NDArray":
-        self.__setitem__(idx, value)
+        from deeplearning4j_tpu.ndarray.indexing import resolve
+        self.__setitem__(resolve(idx), value)
         return self
 
     def getScalar(self, *idx) -> "NDArray":

@@ -446,3 +446,47 @@ class TestFactoryTranche2:
         np.testing.assert_allclose(nd.std(a, 0).toNumpy(),
                                    np.std(a.toNumpy(), 0, ddof=1),
                                    rtol=1e-6)
+
+
+class TestNDArrayIndexCompat:
+    """ref: org.nd4j.linalg.indexing.{NDArrayIndex,BooleanIndexing}."""
+
+    def test_get_with_index_objects(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = nd.create(np.arange(24.0).reshape(4, 6))
+        np.testing.assert_allclose(
+            a.get(I.interval(0, 2), I.all()).toNumpy(),
+            a.toNumpy()[0:2])
+        np.testing.assert_allclose(
+            a.get(I.point(3), I.interval(1, 4)).toNumpy(),
+            a.toNumpy()[3, 1:4])
+        # ND4J argument order: interval(begin, stride, end[, inclusive])
+        np.testing.assert_allclose(
+            a.get(I.interval(0, 2, 3, True), I.point(0)).toNumpy(),
+            a.toNumpy()[0:4:2, 0])
+        np.testing.assert_allclose(
+            a.get(I.interval(1, 2, 6), I.point(0)).toNumpy(),
+            a.toNumpy()[1:6:2, 0])
+        assert a.get(I.newAxis(), I.all(), I.all()).shape == (1, 4, 6)
+        np.testing.assert_allclose(
+            a.get(I.indices(2, 0), I.all()).toNumpy(),
+            a.toNumpy()[[2, 0]])
+
+    def test_put_with_index_objects(self):
+        from deeplearning4j_tpu.ndarray import NDArrayIndex as I
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = nd.zeros((3, 3))
+        a.put((I.point(1), I.all()), 5.0)
+        np.testing.assert_allclose(a.toNumpy()[1], 5.0)
+
+    def test_boolean_indexing_statics(self):
+        from deeplearning4j_tpu.ndarray import BooleanIndexing as B
+        from deeplearning4j_tpu.ndarray import factory as nd
+        a = nd.create([0.0, 3.0, -1.0, 3.0])
+        assert B.or_(a, ("greaterThan", 2.0))
+        assert B.and_(a, ("greaterThan", -2.0))        # every element > -2
+        assert not B.and_(a, ("greaterThan", 2.0))     # 0.0 and -1.0 fail
+        assert B.firstIndex(a, ("greaterThan", 2.0)) == 1
+        assert B.lastIndex(a, ("greaterThan", 2.0)) == 3
+        assert B.firstIndex(a, ("greaterThan", 99.0)) == -1
