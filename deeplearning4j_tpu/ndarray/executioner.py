@@ -1,0 +1,67 @@
+"""OpExecutioner facade (ref: ``org.nd4j.linalg.api.ops.executioner
+.OpExecutioner`` reached via ``Nd4j.getExecutioner()``).
+
+The reference dispatches every op across JNI through this object; here ops
+lower into XLA, so the facade is a thin eager veneer over the registry —
+kept because ``Nd4j.getExecutioner().exec(...)`` /
+``.setProfilingConfig(...)`` is a core migration surface."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.ops import registry
+
+
+class OpExecutioner:
+    """Eager op execution + profiling knobs (ref: DefaultOpExecutioner /
+    NativeOpExecutioner surface)."""
+
+    def exec(self, op_name: str, *arrays, **attrs):
+        """Run a registry op on NDArrays/arrays eagerly; NDArray out."""
+        args = [jnp.asarray(_unwrap(a)) for a in arrays]
+        out = registry.exec_op(op_name, *args, **attrs)
+        if isinstance(out, (tuple, list)):
+            return tuple(NDArray(o) for o in out)
+        return NDArray(out)
+
+    # camelCase parity
+    execAndReturn = exec
+
+    def setProfilingConfig(self, config) -> None:
+        """ref: OpExecutioner#setProfilingConfig(ProfilerConfig)."""
+        from deeplearning4j_tpu.profiler.op_profiler import OpProfiler
+        OpProfiler.get_instance().set_config(config)
+
+    set_profiling_config = setProfilingConfig
+
+    def profilingConfig(self):
+        from deeplearning4j_tpu.profiler.op_profiler import OpProfiler
+        return OpProfiler.get_instance().config
+
+    def commit(self) -> None:
+        """ref: OpExecutioner#commit — barrier until queued work lands
+        (XLA dispatch is async)."""
+        import jax
+
+        if hasattr(jax, "effects_barrier"):
+            jax.effects_barrier()
+
+    def enableDebugMode(self, flag: bool = True) -> None:
+        """ref: Environment::setDebug — here: eager per-op prints."""
+        self.enableVerboseMode(flag)
+
+    def enableVerboseMode(self, flag: bool = True) -> None:
+        from deeplearning4j_tpu.profiler.op_profiler import OpProfiler
+        prof = OpProfiler.get_instance()
+        prof.config.verbose = bool(flag)
+        prof.set_config(prof.config)
+
+
+_EXECUTIONER = OpExecutioner()
+
+
+def get_executioner() -> OpExecutioner:
+    return _EXECUTIONER

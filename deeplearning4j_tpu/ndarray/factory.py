@@ -372,3 +372,9 @@ def normmax(a, axis=None) -> NDArray:
 
 def prod(a, axis=None) -> NDArray:
     return NDArray(jnp.prod(_unwrap(a), axis=axis))
+
+
+def getExecutioner():
+    """ref: Nd4j.getExecutioner() — the op-execution facade."""
+    from deeplearning4j_tpu.ndarray.executioner import get_executioner
+    return get_executioner()

@@ -490,3 +490,22 @@ class TestNDArrayIndexCompat:
         assert B.firstIndex(a, ("greaterThan", 2.0)) == 1
         assert B.lastIndex(a, ("greaterThan", 2.0)) == 3
         assert B.firstIndex(a, ("greaterThan", 99.0)) == -1
+
+
+def test_executioner_facade():
+    """ref: Nd4j.getExecutioner().exec(op) + setProfilingConfig."""
+    from deeplearning4j_tpu.ndarray import factory as nd
+    ex = nd.getExecutioner()
+    out = ex.exec("relu", nd.create([-1.0, 2.0]))
+    np.testing.assert_allclose(out.toNumpy(), [0.0, 2.0])
+    vals, idx = ex.exec("top_k", nd.create([1.0, 9.0, 3.0]), k=2)
+    np.testing.assert_allclose(vals.toNumpy(), [9.0, 3.0])
+    from deeplearning4j_tpu.profiler.op_profiler import (OpProfiler,
+                                                          ProfilerConfig)
+    ex.setProfilingConfig(ProfilerConfig(op_timing=True))
+    try:
+        ex.exec("exp", nd.create([0.0, 1.0]))
+        assert OpProfiler.get_instance().config.op_timing
+    finally:
+        ex.setProfilingConfig(ProfilerConfig())   # never leak the hook
+    ex.commit()
