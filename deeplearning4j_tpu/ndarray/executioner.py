@@ -38,15 +38,24 @@ class OpExecutioner:
     set_profiling_config = setProfilingConfig
 
     def profilingConfig(self):
-        from deeplearning4j_tpu.profiler.op_profiler import OpProfiler
-        return OpProfiler.get_instance().config
+        """Returns a COPY: mutate it and pass back via setProfilingConfig
+        (mutating the live object would bypass hook install/uninstall)."""
+        import dataclasses as _dc
 
-    def commit(self) -> None:
-        """ref: OpExecutioner#commit — barrier until queued work lands
-        (XLA dispatch is async)."""
+        from deeplearning4j_tpu.profiler.op_profiler import OpProfiler
+        return _dc.replace(OpProfiler.get_instance().config)
+
+    def commit(self, *arrays) -> None:
+        """ref: OpExecutioner#commit — barrier until queued work lands.
+        XLA dispatch is async and has no global device fence; pass the
+        arrays you need landed (block_until_ready), no-arg form flushes
+        ordered host effects only."""
         import jax
 
-        if hasattr(jax, "effects_barrier"):
+        if arrays:
+            jax.block_until_ready([jnp.asarray(_unwrap(a))
+                                   for a in arrays])
+        elif hasattr(jax, "effects_barrier"):
             jax.effects_barrier()
 
     def enableDebugMode(self, flag: bool = True) -> None:

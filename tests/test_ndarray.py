@@ -508,4 +508,9 @@ def test_executioner_facade():
         assert OpProfiler.get_instance().config.op_timing
     finally:
         ex.setProfilingConfig(ProfilerConfig())   # never leak the hook
-    ex.commit()
+    out2 = ex.exec("exp", nd.create([0.0, 1.0]))
+    ex.commit(out2)                               # array-landing barrier
+    cfg_copy = ex.profilingConfig()
+    cfg_copy.op_timing = True                     # mutating the copy is inert
+    from deeplearning4j_tpu.profiler.op_profiler import OpProfiler
+    assert not OpProfiler.get_instance().config.op_timing
