@@ -856,6 +856,106 @@ def _register_extended_rules():
                           padding=attrs.get("padding", "VALID"))
 
 
+    # tranche 3: remaining raw-op passthroughs (registry alias == TF type)
+    @mapping_rule("Mod", "TruncateMod")
+    def _mod_trunc(ctx, node, inputs, attrs):
+        # TF's raw Mod is the C-style TRUNCATED remainder for floats
+        # (pinned by the negative-operand corpus case); FloorMod is floor
+        return ctx.sd._op("truncatemod", *inputs)
+
+    for op in ["BitwiseAnd", "BitwiseOr", "BitwiseXor", "IsNan",
+               "IsFinite", "Rank", "Size", "ListDiff",
+               "TensorScatterAdd", "TensorScatterSub", "TensorScatterUpdate",
+               "TruncateDiv", "Erfinv"]:
+        @mapping_rule(op)
+        def _pt3(ctx, node, inputs, attrs, _op=op):
+            return ctx.sd._op(_op, *inputs)
+
+    @mapping_rule("MatrixSolve")
+    def _matrix_solve(ctx, node, inputs, attrs):
+        a = inputs[0]
+        if attrs.get("adjoint"):
+            # real dtypes only (no complex in _TF_DTYPES): adjoint == T
+            a = ctx.sd._op("matrix_transpose", a)
+        return ctx.sd._op("solve", a, inputs[1])
+
+    @mapping_rule("Diag")
+    def _tf_diag(ctx, node, inputs, attrs):
+        if inputs[0].shape is not None and len(inputs[0].shape) != 1:
+            raise TFImportError(
+                "Diag: only rank-1 input supported (TF's higher-rank "
+                "(i..,j..) tensor-diag form is not)")
+        return ctx.sd._op("diag", inputs[0])
+
+    @mapping_rule("DiagPart")
+    def _tf_diag_part(ctx, node, inputs, attrs):
+        if inputs[0].shape is not None and len(inputs[0].shape) != 2:
+            raise TFImportError(
+                "DiagPart: only rank-2 input supported (TF's rank-2k "
+                "form is not)")
+        return ctx.sd._op("diag_part", inputs[0])
+
+    @mapping_rule("LeftShift")
+    def _lshift(ctx, node, inputs, attrs):
+        return ctx.sd._op("shift_bits", *inputs)
+
+    @mapping_rule("RightShift")
+    def _rshift(ctx, node, inputs, attrs):
+        return ctx.sd._op("rshift_bits", *inputs)
+
+    @mapping_rule("TopK")
+    def _topk_v1(ctx, node, inputs, attrs):
+        return ctx.sd._op("top_k", inputs[0], k=int(attrs["k"]))
+
+    @mapping_rule("BroadcastTo")
+    def _broadcast_to(ctx, node, inputs, attrs):
+        shape = [int(v) for v in np.asarray(ctx.const_value(node.input[1]))]
+        return ctx.sd._op("broadcast_to", inputs[0], shape=tuple(shape))
+
+    @mapping_rule("LinSpace")
+    def _linspace(ctx, node, inputs, attrs):
+        n = int(np.asarray(ctx.const_value(node.input[2])).item())
+        return ctx.sd._op("linspace", inputs[0], inputs[1], num=n)
+
+    @mapping_rule("ConfusionMatrix")
+    def _confusion(ctx, node, inputs, attrs):
+        # num_classes: explicit const input when given, else fold both
+        # index inputs and take the max + 1
+        try:
+            n = int(np.asarray(ctx.const_value(node.input[2])).item())
+        except (TFImportError, IndexError):
+            a = np.asarray(ctx.const_value(node.input[0]))
+            b = np.asarray(ctx.const_value(node.input[1]))
+            n = int(max(a.max(), b.max())) + 1
+        return ctx.sd._op("confusion_matrix", inputs[0], inputs[1],
+                          num_classes=n)
+
+    @mapping_rule("ScatterNd")
+    def _scatter_nd_rule(ctx, node, inputs, attrs):
+        shape = [int(v) for v in np.asarray(ctx.const_value(node.input[2]))]
+        return ctx.sd._op("scatter_nd", inputs[0], inputs[1],
+                          shape=tuple(shape))
+
+    @mapping_rule("Qr")
+    def _qr(ctx, node, inputs, attrs):
+        mode = "complete" if attrs.get("full_matrices") else "reduced"
+        return ctx.sd._op("qr", inputs[0], mode=mode)
+
+    @mapping_rule("Svd")
+    def _svd(ctx, node, inputs, attrs):
+        # ours: (u, s, vh); TF: (s, u, v) with v NOT conjugate-transposed
+        u, sdiag, vh = ctx.sd._op("svd", inputs[0],
+                                  full_matrices=bool(
+                                      attrs.get("full_matrices", 0)))
+        v = ctx.sd._op("matrix_transpose", vh)
+        return sdiag, u, v
+
+    @mapping_rule("Bitcast")
+    def _bitcast_rule(ctx, node, inputs, attrs):
+        dt = _dtype_of(int(attrs.get("type", attrs.get("T", 1))))
+        return ctx.sd._op("bitcast", inputs[0], dtype=dt)
+
+
 _register_default_rules()
 _register_extended_rules()
 

@@ -936,3 +936,7 @@ register("reduce_logsumexp_axes",
          lambda x, axis=None, keepdims=False:
          jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims),
          aliases=["ReduceLogSumExpOp"])
+
+
+register("truncatemod", lambda a, b: jnp.fmod(a, b),
+         aliases=["TruncateMod", "fmod_op"])

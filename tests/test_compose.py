@@ -48,5 +48,6 @@ def test_layer_api_all_features_compose():
     s0 = net.score()
     tr.fit(MnistDataSetIterator(32, train=True, num_examples=128))
     assert np.isfinite(net.score())
+    assert net.score() < s0            # training actually improves
     assert all(l.dtype == jnp.float32
                for l in jax.tree.leaves(net._params))

@@ -150,6 +150,50 @@ CORPUS = {
     "LogicalOr": (lambda x: tf.cast(tf.logical_or(x < 0.7, x > 1.2), tf.float32), {"x": x34}),
     "LogicalNot": (lambda x: tf.cast(tf.logical_not(x > 1.0), tf.float32), {"x": x34}),
     "SelectV2": (lambda x: tf.where(x > 1.0, x, -x), {"x": x34}),
+    "Mod": (lambda x: tf.raw_ops.Mod(x=x - 1.0, y=tf.constant(0.7)),
+            {"x": x34}),
+    "TruncateDiv": (lambda x: tf.raw_ops.TruncateDiv(
+        x=tf.cast(x * 10.0 - 5.0, tf.int32), y=tf.constant(3)),
+        {"x": x34}),
+    "BitwiseAnd": (lambda x: tf.cast(tf.bitwise.bitwise_and(
+        tf.cast(x * 100, tf.int32), 12), tf.float32), {"x": x34}),
+    "BitwiseOr": (lambda x: tf.cast(tf.bitwise.bitwise_or(
+        tf.cast(x * 100, tf.int32), 12), tf.float32), {"x": x34}),
+    "BitwiseXor": (lambda x: tf.cast(tf.bitwise.bitwise_xor(
+        tf.cast(x * 100, tf.int32), 12), tf.float32), {"x": x34}),
+    "LeftShift": (lambda x: tf.cast(tf.bitwise.left_shift(
+        tf.cast(x * 10, tf.int32), 2), tf.float32), {"x": x34}),
+    "RightShift": (lambda x: tf.cast(tf.bitwise.right_shift(
+        tf.cast(x * 100, tf.int32), 2), tf.float32), {"x": x34}),
+    "IsNan": (lambda x: tf.cast(tf.math.is_nan(tf.math.log(x - 1.0)),
+                                tf.float32), {"x": x34}),
+    "IsFinite": (lambda x: tf.cast(tf.math.is_finite(1.0 / (x - 1.0)),
+                                   tf.float32), {"x": x34}),
+    "Rank": (lambda x: tf.cast(tf.raw_ops.Rank(input=x), tf.float32)
+             + tf.reduce_sum(x) * 0.0, {"x": x34}),
+    "Size": (lambda x: tf.cast(tf.raw_ops.Size(input=x), tf.float32)
+             + tf.reduce_sum(x) * 0.0, {"x": x34}),
+    "Diag": (lambda x: tf.raw_ops.Diag(diagonal=x[0]), {"x": x34}),
+    "DiagPart": (lambda x: tf.raw_ops.DiagPart(
+        input=tf.raw_ops.Diag(diagonal=x[0])), {"x": x34}),
+    "TensorScatterUpdate": (lambda x: tf.tensor_scatter_nd_update(
+        x, [[0, 1], [2, 2]], [9.0, 8.0]), {"x": x34}),
+    "TensorScatterAdd": (lambda x: tf.tensor_scatter_nd_add(
+        x, [[0, 1], [2, 2]], [9.0, 8.0]), {"x": x34}),
+    "TensorScatterSub": (lambda x: tf.tensor_scatter_nd_sub(
+        x, [[0, 1], [2, 2]], [9.0, 8.0]), {"x": x34}),
+    "MatrixSolve": (lambda x: tf.linalg.solve(
+        tf.matmul(x[:3, :3], x[:3, :3], transpose_b=True)
+        + tf.constant(3.0 * np.eye(3, dtype=np.float32)),
+        x[:3, :2]), {"x": x34}),
+    "Erfinv": (lambda x: tf.math.erfinv(x * 0.4), {"x": x34}),
+    "BroadcastTo": (lambda x: tf.broadcast_to(x[0], [2, 4]), {"x": x34}),
+    "LinSpace": (lambda x: tf.raw_ops.LinSpace(
+        start=0.0, stop=1.0, num=5) + tf.reduce_sum(x) * 0.0, {"x": x34}),
+    "ScatterNd": (lambda x: tf.scatter_nd([[1], [3]], x[:2], [6, 4]),
+                  {"x": x34}),
+    "Bitcast": (lambda x: tf.cast(tf.bitcast(x, tf.int32), tf.float32)
+                * 1e-9, {"x": x34}),
     # ---- extended-rule tranche (trig/special, scans, segments, spatial,
     # linalg, image, quantization) ----
     "Sin": (lambda x: tf.sin(x), {"x": x34}),
@@ -313,6 +357,15 @@ COVERAGE_IGNORE = {
     "MaxPoolWithArgmax",          # multi-output; covered by op tests
     "Bincount",                   # tf2 emits DenseBincount; rule kept for
                                   # legacy graphs, op tested directly
+    "ListDiff",                   # data-dependent output shape (works only
+                                  # in constant-folded positions)
+    "Qr", "Svd",                  # sign/phase non-unique vs TF; covered by
+                                  # registry op tests instead
+    "TopK",                       # v1 form removed from modern TF exports
+                                  # (TopKV2 covered); rule kept for legacy
+    "ConfusionMatrix",            # tf.math wrapper emits Assert guard
+                                  # subgraphs; rule covered via registry op
+    "TruncateMod",                # same rule as Mod (corpus-pinned there)
 }
 
 
