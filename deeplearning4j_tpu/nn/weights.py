@@ -49,4 +49,35 @@ def init(name, key, shape, fan_in: float, fan_out: float, dtype=jnp.float32):
         raise ValueError("IDENTITY init requires square 2-D shape")
     if name in ("var_scaling_normal_fan_avg",):
         return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if name in ("var_scaling_normal_fan_in",):
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if name in ("var_scaling_normal_fan_out",):
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_out)
+    if name in ("var_scaling_uniform_fan_in",):
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name in ("var_scaling_uniform_fan_out",):
+        a = math.sqrt(3.0 / fan_out)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name in ("var_scaling_uniform_fan_avg",):
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name in ("truncated_normal", "truncatednormal"):
+        # ref: TruncatedNormalDistribution — N(0, 1/sqrt(fanIn)) clipped
+        # to two standard deviations (resampled in the reference; the
+        # truncated sampler is equivalent in distribution)
+        std = 1.0 / math.sqrt(fan_in)
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                           dtype) * std
+    if name == "orthogonal":
+        # ref: OrthogonalDistribution (gain 1): QR of a Gaussian, sign-fixed
+        rows = shape[0] if len(shape) == 2 else int(
+            math.prod(shape[:-1]))
+        cols = shape[-1]
+        big, small = max(rows, cols), min(rows, cols)
+        g = jax.random.normal(key, (big, small), jnp.float32)
+        q, r = jnp.linalg.qr(g)
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        q = q.T if rows < cols else q
+        return q.reshape(shape).astype(dtype)
     raise ValueError(f"Unknown weight init: {name!r}")

@@ -435,3 +435,24 @@ def test_weight_noise_dropconnect():
     assert isinstance(back.layers[0].weight_noise, DropConnect)
     assert back.layers[0].weight_noise.p == 0.8
     assert isinstance(back.layers[1].weight_noise, WeightNoise)
+
+
+def test_weight_init_tranche2():
+    """orthogonal / truncated_normal / var_scaling family (ref:
+    WeightInit.DISTRIBUTION + VAR_SCALING_* enum members)."""
+    import jax as _jax
+
+    from deeplearning4j_tpu.nn import weights as W
+
+    k = _jax.random.key(0)
+    q = W.init("orthogonal", k, (6, 4), 6, 4)
+    np.testing.assert_allclose(np.asarray(q.T @ q), np.eye(4), atol=1e-5)
+    q2 = W.init("orthogonal", k, (4, 6), 4, 6)
+    np.testing.assert_allclose(np.asarray(q2 @ q2.T), np.eye(4), atol=1e-5)
+    t = W.init("truncated_normal", k, (2000,), 100.0, 100.0)
+    assert float(np.abs(np.asarray(t)).max()) <= 2.0 / 10.0 + 1e-6
+    for nm in ("var_scaling_normal_fan_in", "var_scaling_uniform_fan_avg",
+               "var_scaling_normal_fan_out", "var_scaling_uniform_fan_in",
+               "var_scaling_uniform_fan_out"):
+        out = W.init(nm, k, (50, 50), 50, 50)
+        assert np.isfinite(np.asarray(out)).all()
