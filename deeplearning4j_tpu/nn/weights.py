@@ -47,12 +47,20 @@ def init(name, key, shape, fan_in: float, fan_out: float, dtype=jnp.float32):
         if len(shape) == 2 and shape[0] == shape[1]:
             return jnp.eye(shape[0], dtype=dtype)
         raise ValueError("IDENTITY init requires square 2-D shape")
+    # ref: WeightInitVarScalingNormal* draw from a TruncatedNormal
+    # clipped at two standard deviations, not a plain Gaussian
     if name in ("var_scaling_normal_fan_avg",):
-        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                           dtype) * std
     if name in ("var_scaling_normal_fan_in",):
-        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+        std = math.sqrt(1.0 / fan_in)
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                           dtype) * std
     if name in ("var_scaling_normal_fan_out",):
-        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_out)
+        std = math.sqrt(1.0 / fan_out)
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                           dtype) * std
     if name in ("var_scaling_uniform_fan_in",):
         a = math.sqrt(3.0 / fan_in)
         return jax.random.uniform(key, shape, dtype, -a, a)

@@ -451,8 +451,22 @@ def test_weight_init_tranche2():
     np.testing.assert_allclose(np.asarray(q2 @ q2.T), np.eye(4), atol=1e-5)
     t = W.init("truncated_normal", k, (2000,), 100.0, 100.0)
     assert float(np.abs(np.asarray(t)).max()) <= 2.0 / 10.0 + 1e-6
-    for nm in ("var_scaling_normal_fan_in", "var_scaling_uniform_fan_avg",
-               "var_scaling_normal_fan_out", "var_scaling_uniform_fan_in",
-               "var_scaling_uniform_fan_out"):
-        out = W.init(nm, k, (50, 50), 50, 50)
-        assert np.isfinite(np.asarray(out)).all()
+    # scale checks with asymmetric fans so swapped fan_in/fan_out fails
+    fi, fo = 400.0, 100.0
+    big = (400, 400)
+    trunc_std = 0.8796     # std of N(0,1) truncated at ±2
+    for nm, target in [
+            ("var_scaling_normal_fan_in", trunc_std / np.sqrt(fi)),
+            ("var_scaling_normal_fan_out", trunc_std / np.sqrt(fo)),
+            ("var_scaling_normal_fan_avg",
+             trunc_std * np.sqrt(2.0 / (fi + fo))),
+            ("var_scaling_uniform_fan_in", np.sqrt(3.0 / fi) / np.sqrt(3)),
+            ("var_scaling_uniform_fan_out", np.sqrt(3.0 / fo) / np.sqrt(3)),
+            ("var_scaling_uniform_fan_avg",
+             np.sqrt(6.0 / (fi + fo)) / np.sqrt(3))]:
+        out = np.asarray(W.init(nm, k, big, fi, fo))
+        assert abs(out.std() - target) < 0.1 * target, (nm, out.std(),
+                                                        target)
+    # truncation: normal variants never exceed two std of the base scale
+    t2 = np.asarray(W.init("var_scaling_normal_fan_in", k, big, fi, fo))
+    assert np.abs(t2).max() <= 2.0 / np.sqrt(fi) + 1e-6
