@@ -24,15 +24,28 @@ def _np(x):
 class Evaluation:
     """Multi-class classification metrics (ref: Evaluation)."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels_names=None):
+    def __init__(self, num_classes: Optional[int] = None, labels_names=None,
+                 top_n: int = 1):
         self.num_classes = num_classes
         self.labels_names = labels_names
         self._cm: Optional[np.ndarray] = None
+        # ref: Evaluation(int topN) — top-N accuracy alongside top-1
+        self.top_n = int(top_n)
+        self._topn_hits = 0
+        self._topn_total = 0
 
     def _ensure(self, n):
         if self._cm is None:
             self.num_classes = self.num_classes or n
             self._cm = np.zeros((self.num_classes, self.num_classes), dtype=np.int64)
+
+    def topNAccuracy(self) -> float:
+        """ref: Evaluation#topNAccuracy (0.0 when top_n == 1 unused)."""
+        if self._topn_total == 0:
+            return 0.0
+        return self._topn_hits / self._topn_total
+
+    top_n_accuracy = topNAccuracy
 
     def eval(self, labels, predictions, mask=None):
         """labels: one-hot or int; predictions: probabilities or int classes.
@@ -46,6 +59,14 @@ class Evaluation:
             m = m.reshape(n * t) if m is not None else None
         y_idx = y.argmax(-1) if y.ndim > 1 and y.shape[-1] > 1 else y.astype(int).ravel()
         p_idx = p.argmax(-1) if p.ndim > 1 and p.shape[-1] > 1 else p.astype(int).ravel()
+        if self.top_n > 1 and p.ndim > 1 and p.shape[-1] > 1:
+            kn = min(self.top_n, p.shape[-1])
+            topk = np.argpartition(-p, kn - 1, axis=-1)[:, :kn]
+            hits = (topk == y_idx[:, None]).any(axis=1)
+            if m is not None:
+                hits = hits[m.astype(bool)]
+            self._topn_hits += int(hits.sum())
+            self._topn_total += int(hits.shape[0])
         n_cls = max(y.shape[-1] if y.ndim > 1 else y_idx.max() + 1,
                     p.shape[-1] if p.ndim > 1 else p_idx.max() + 1)
         self._ensure(int(n_cls))

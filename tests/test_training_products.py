@@ -321,3 +321,21 @@ def test_roc_binary_per_output():
     assert 0.4 < roc.calculate_auc(1) < 0.6
     assert roc.calculate_auc(2) < 0.1
     assert 0.0 <= roc.average_auc() <= 1.0
+
+
+def test_evaluation_top_n_accuracy():
+    """ref: Evaluation(int topN) — top-N counts a hit when the label is
+    anywhere in the N highest-probability classes."""
+    from deeplearning4j_tpu.eval import Evaluation
+
+    ev = Evaluation(top_n=2)
+    labels = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+    preds = np.asarray([
+        [0.9, 0.05, 0.03, 0.02],   # top1 hit
+        [0.5, 0.4, 0.05, 0.05],    # top2 hit (label 1 is 2nd)
+        [0.5, 0.4, 0.05, 0.05],    # miss even at top2
+        [0.1, 0.2, 0.3, 0.4],      # top1 hit
+    ], np.float32)
+    ev.eval(labels, preds)
+    assert ev.accuracy() == 0.5
+    assert ev.topNAccuracy() == 0.75
