@@ -59,12 +59,17 @@ class Evaluation:
             m = m.reshape(n * t) if m is not None else None
         y_idx = y.argmax(-1) if y.ndim > 1 and y.shape[-1] > 1 else y.astype(int).ravel()
         p_idx = p.argmax(-1) if p.ndim > 1 and p.shape[-1] > 1 else p.astype(int).ravel()
-        if self.top_n > 1 and p.ndim > 1 and p.shape[-1] > 1:
-            kn = min(self.top_n, p.shape[-1])
-            topk = np.argpartition(-p, kn - 1, axis=-1)[:, :kn]
-            hits = (topk == y_idx[:, None]).any(axis=1)
+        if self.top_n > 1:
+            if p.ndim > 1 and p.shape[-1] > 1:
+                kn = min(self.top_n, p.shape[-1])
+                topk = np.argpartition(-p, kn - 1, axis=-1)[:, :kn]
+                hits = (topk == y_idx[:, None]).any(axis=1)
+            else:
+                # integer-class predictions carry no ranking: top-N
+                # degrades to top-1 so the denominator tracks accuracy's
+                hits = (p_idx == y_idx)
             if m is not None:
-                hits = hits[m.astype(bool)]
+                hits = hits[m.astype(bool).ravel()]
             self._topn_hits += int(hits.sum())
             self._topn_total += int(hits.shape[0])
         n_cls = max(y.shape[-1] if y.ndim > 1 else y_idx.max() + 1,
@@ -127,6 +132,8 @@ class Evaluation:
             "========================Evaluation Metrics========================",
             f" # of classes: {self.num_classes}",
             f" Accuracy:  {self.accuracy():.4f}",
+            *([f" Top {self.top_n} Accuracy: {self.topNAccuracy():.4f}"]
+              if self.top_n > 1 else []),
             f" Precision: {self.precision():.4f}",
             f" Recall:    {self.recall():.4f}",
             f" F1 Score:  {self.f1():.4f}",

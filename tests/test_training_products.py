@@ -339,3 +339,12 @@ def test_evaluation_top_n_accuracy():
     ev.eval(labels, preds)
     assert ev.accuracy() == 0.5
     assert ev.topNAccuracy() == 0.75
+    assert "Top 2 Accuracy: 0.7500" in ev.stats()
+    # column-vector masks accepted like the confusion-matrix path
+    ev2 = Evaluation(top_n=2)
+    ev2.eval(labels, preds, mask=np.ones((4, 1), np.float32))
+    assert ev2.topNAccuracy() == 0.75
+    # integer-class predictions degrade to top-1 with a matching denominator
+    ev3 = Evaluation(top_n=3)
+    ev3.eval(np.asarray([0, 1]), np.asarray([0, 0]))
+    assert ev3.topNAccuracy() == 0.5
