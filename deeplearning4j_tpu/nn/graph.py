@@ -426,6 +426,28 @@ class ComputationGraph:
             ev.eval(labels, out, mask=getattr(ds, "labels_mask", None))
         return ev
 
+    def evaluateROC(self, iterator, threshold_steps: int = 0):
+        """ref: ComputationGraph#evaluateROC (binary single-output)."""
+        # threshold_steps accepted for reference-signature parity; the
+        # ROC implementation is exact-threshold (no binning needed)
+        from deeplearning4j_tpu.eval.classification import ROC
+        roc = ROC()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            roc.eval(ds.labels, self.output(ds.features))
+        return roc
+
+    def evaluateROCMultiClass(self, iterator, threshold_steps: int = 0):
+        """ref: ComputationGraph#evaluateROCMultiClass."""
+        from deeplearning4j_tpu.eval.classification import ROCMultiClass
+        roc = ROCMultiClass()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            roc.eval(ds.labels, self.output(ds.features))
+        return roc
+
     # ------------------------------------------------------------ persistence
     def save(self, path, save_updater: bool = True):
         from deeplearning4j_tpu.utils.serialization import ModelSerializer

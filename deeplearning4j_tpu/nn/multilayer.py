@@ -504,6 +504,29 @@ class MultiLayerNetwork:
             ev.eval(ds.labels, out, mask=getattr(ds, "labels_mask", None))
         return ev
 
+
+    def evaluateROC(self, iterator, threshold_steps: int = 0):
+        """ref: MultiLayerNetwork#evaluateROC (binary outputs)."""
+        # threshold_steps accepted for reference-signature parity; the
+        # ROC implementation is exact-threshold (no binning needed)
+        from deeplearning4j_tpu.eval.classification import ROC
+        roc = ROC()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            roc.eval(ds.labels, self.output(ds.features))
+        return roc
+
+    def evaluateROCMultiClass(self, iterator, threshold_steps: int = 0):
+        """ref: #evaluateROCMultiClass."""
+        from deeplearning4j_tpu.eval.classification import ROCMultiClass
+        roc = ROCMultiClass()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            roc.eval(ds.labels, self.output(ds.features))
+        return roc
+
     def evaluateRegression(self, iterator):
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
         ev = RegressionEvaluation()

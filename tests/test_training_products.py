@@ -348,3 +348,33 @@ def test_evaluation_top_n_accuracy():
     ev3 = Evaluation(top_n=3)
     ev3.eval(np.asarray([0, 1]), np.asarray([0, 0]))
     assert ev3.topNAccuracy() == 0.5
+
+
+def test_evaluate_roc_convenience_methods():
+    """ref: MultiLayerNetwork#evaluateROC / #evaluateROCMultiClass."""
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.configuration import (
+        NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss_function="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 4).astype("float32")
+    y = np.eye(2, dtype="float32")[(x.sum(1) > 2.0).astype(int)]
+    for _ in range(20):
+        net.fit(x, y)
+    it = [DataSet(x, y)]
+    roc = net.evaluateROC(it, threshold_steps=30)
+    assert 0.5 < roc.calculateAUC() <= 1.0
+    rocm = net.evaluateROCMultiClass(it)
+    assert 0.5 < rocm.calculateAUC(1) <= 1.0
