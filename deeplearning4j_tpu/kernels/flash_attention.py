@@ -45,26 +45,42 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_acc[...] = jnp.full_like(m_acc, _NEG_INF)
         l_acc[...] = jnp.zeros_like(l_acc)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    q = q_ref[0]                                      # (bq, d) compute dtype
     bq = q.shape[0]
     q_start = pl.program_id(1) * bq
-    k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-    v = v_ref[0].astype(jnp.float32)
-    s = q @ k.T                                       # (bq, bk)
-    k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    mask = k_idx < seq_k                              # ragged tail block
-    if causal:
-        q_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        mask = mask & (q_idx >= k_idx)
-    s = jnp.where(mask, s, _NEG_INF)
+    def _update():
+        k = k_ref[0]                                  # (bk, d)
+        v = v_ref[0]
+        # MXU-native: low-precision operands, f32 accumulation — an f32×f32
+        # matmul here runs at a fraction of bf16 MXU rate (the round-2 perf
+        # regression found by device-side op profiling)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk) f32
+        k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_idx < seq_k                          # ragged tail block
+        if causal:
+            q_idx = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (q_idx >= k_idx)
+        s = jnp.where(mask, s, _NEG_INF)
 
-    m = m_acc[...]
-    m_new = jnp.maximum(m, jnp.max(s, axis=1))
-    p = jnp.exp(s - m_new[:, None])
-    alpha = jnp.exp(m - m_new)
-    l_acc[...] = l_acc[...] * alpha + jnp.sum(p, axis=1)
-    o_acc[...] = o_acc[...] * alpha[:, None] + p @ v
-    m_acc[...] = m_new
+        m = m_acc[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_acc[...] = l_acc[...] * alpha + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_acc[...] = o_acc[...] * alpha[:, None] + pv
+        m_acc[...] = m_new
+
+    if causal:
+        # a k-block strictly past this q-block's last row contributes
+        # nothing — skip its matmuls entirely (halves MXU work)
+        pl.when(kb * block_k <= q_start + bq - 1)(_update)
+    else:
+        _update()
 
     @pl.when(kb == n_kb - 1)
     def _finalize():
@@ -140,28 +156,33 @@ def _bwd_blockwise(q, k, v, o, lse, do, scale, causal, block_k):
     kb = kp.reshape(bh, n_kb, block_k, d)
     vb = vp.reshape(bh, n_kb, block_k, d)
 
-    qf = q.astype(jnp.float32) * scale
-    dof = do.astype(jnp.float32)
-    D = jnp.sum(dof * o.astype(jnp.float32), axis=-1)      # (bh, seq_q)
+    # every matmul below: low-precision operands + f32 accumulation
+    # (preferred_element_type) — f32×f32 operands would fall off the fast
+    # MXU path, which device-side op profiling showed dominating step time
+    D = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     q_idx = jnp.arange(seq_q)
 
     def body(dq, blk):
         kblk, vblk, kb_i = blk                              # (bh, bk, d)
-        s = jnp.einsum("bqd,bkd->bqk", qf, kblk.astype(jnp.float32))
+        s = jnp.einsum("bqd,bkd->bqk", q, kblk,
+                       preferred_element_type=jnp.float32) * scale
         k_idx = kb_i * block_k + jnp.arange(block_k)
         valid = k_idx < seq_k
         mask = valid[None, :]
         if causal:
             mask = mask & (q_idx[:, None] >= k_idx[None, :])
         s = jnp.where(mask[None], s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])                     # (bh, q, bk)
-        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-        dp = jnp.einsum("bqd,bkd->bqk", dof, vblk.astype(jnp.float32))
-        ds = p * (dp - D[..., None])
-        dq = dq + scale * jnp.einsum("bqk,bkd->bqd", ds,
-                                     kblk.astype(jnp.float32))
-        # d s/d k = scale·q = qf, so dk uses the pre-scaled q directly
-        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        p = jnp.exp(s - lse[..., None])                     # (bh, q, bk) f32
+        pl_ = p.astype(q.dtype)
+        dv = jnp.einsum("bqk,bqd->bkd", pl_, do,
+                        preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqd,bkd->bqk", do, vblk,
+                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - D[..., None])).astype(q.dtype)
+        dq = dq + scale * jnp.einsum("bqk,bkd->bqd", ds, kblk,
+                                     preferred_element_type=jnp.float32)
+        dk = scale * jnp.einsum("bqk,bqd->bkd", ds, q,
+                                preferred_element_type=jnp.float32)
         return dq, (dk, dv)
 
     dq0 = jnp.zeros((bh, seq_q, d), jnp.float32)

@@ -31,32 +31,49 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from deeplearning4j_tpu.parallel.ring import ring_attention, _plain_attention
 
-# attention backend override: None = auto (flash kernel on TPU, XLA attention
-# elsewhere — interpret-mode pallas is slow on CPU); True/False forces it
+# attention backend override: None = auto (flash kernel on TPU for long
+# sequences, XLA attention elsewhere — interpret-mode pallas is slow on CPU);
+# True/False forces it
 FLASH_ATTENTION: Optional[bool] = None
+
+# auto-policy crossover: below this sequence length the XLA attention's
+# (T, T) materialization is cheap enough that it beats the Pallas kernel on
+# device-measured step time (v5e, d_head=64); at/above it the scores tensor
+# is HBM-traffic- and memory-bound and flash wins
+FLASH_MIN_SEQ = 1024
 
 
 _FLASH_LOWERS: Optional[bool] = None
+_FLASH_PROBE_ERROR: Optional[str] = None
 
 
 def _flash_lowers() -> bool:
     """One-time capability probe: does the Pallas kernel actually compile and
-    run on this backend? Cached for the process lifetime."""
-    global _FLASH_LOWERS
+    run on this backend? Cached for the process lifetime. A failure is LOGGED
+    and kept in ``_FLASH_PROBE_ERROR`` (surfaced by bench.py) — a silent
+    downgrade to XLA attention would otherwise only show up as a perf drop."""
+    global _FLASH_LOWERS, _FLASH_PROBE_ERROR
     if _FLASH_LOWERS is None:
         try:
             from deeplearning4j_tpu.kernels import flash_attention
             x = jnp.ones((1, 1, 128, 64), jnp.bfloat16)
             jax.block_until_ready(flash_attention(x, x, x, causal=True))
             _FLASH_LOWERS = True
-        except Exception:
+        except Exception as e:
             _FLASH_LOWERS = False
+            _FLASH_PROBE_ERROR = f"{type(e).__name__}: {e}"
+            import logging
+            logging.getLogger(__name__).warning(
+                "Pallas flash-attention probe failed — falling back to XLA "
+                "attention: %s", _FLASH_PROBE_ERROR)
     return _FLASH_LOWERS
 
 
-def _use_flash_attention() -> bool:
+def _use_flash_attention(seq_len: Optional[int] = None) -> bool:
     if FLASH_ATTENTION is not None:
         return FLASH_ATTENTION
+    if seq_len is not None and seq_len < FLASH_MIN_SEQ:
+        return False
     backend = jax.default_backend()
     if backend == "tpu":
         return True
@@ -186,7 +203,7 @@ class TransformerLM:
         v = (x @ p["wv"]).reshape(b, t, h, hd)
         if mesh is not None and SEQ_AXIS in mesh.axis_names:
             o = ring_attention(q, k, v, mesh, causal=c.causal)
-        elif _use_flash_attention():
+        elif _use_flash_attention(t):
             # Pallas flash kernel: O(T·d) memory (ref of N4's platform
             # override hook — kernel swapped in when the platform supports it)
             from deeplearning4j_tpu.kernels import flash_attention

@@ -47,7 +47,10 @@ def _block_attn_update(q, k, v, m, l, o, q_start, k_start, causal, scale):
     corr = jnp.exp(jnp.where(jnp.isneginf(m), m_safe * 0 - jnp.inf, m - m_safe))
     corr = jnp.where(jnp.isneginf(m), 0.0, corr)
     l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # bf16 operands + f32 accumulation (preferred_element_type) — an
+    # f32×f32 matmul would fall off the fast MXU path
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -60,7 +63,6 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     b, tq, h, d = q.shape
     tk = k.shape[1]
     scale = 1.0 / np.sqrt(d)
-    qf = q.astype(jnp.float32)
 
     m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, tq), jnp.float32)
@@ -77,7 +79,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
         # after i rotations, this device holds the block that started at
         # ring position (my_idx - i) mod P
         blk_idx = jnp.mod(my_idx - i, p_size)
-        m, l, o = _block_attn_update(qf, k_blk, v_blk, m, l, o,
+        m, l, o = _block_attn_update(q, k_blk, v_blk, m, l, o,
                                      my_idx * tq, blk_idx * tk, causal, scale)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -111,13 +113,23 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = SEQ_AXIS,
 
 
 def _plain_attention(q, k, v, causal: bool = False):
-    """Single-shard reference attention (the crosscheck baseline)."""
+    """Single-shard XLA attention (the flash-kernel crosscheck baseline).
+
+    The (B,H,T,T) score/probability tensors stay in the compute dtype —
+    in bf16 they cost half the HBM traffic of f32 and both matmuls ride the
+    fast MXU path (accumulation is f32 inside the MXU regardless). exp/sum
+    run in f32 on the fly (XLA fuses; nothing f32 materializes). Full-f32
+    softmax accuracy is the flash kernel's job (online f32 accumulation).
+    """
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / float(np.sqrt(d))
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
-        s = jnp.where(mask[None, None], s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(p.dtype)).astype(q.dtype)
+        # finite sentinel: -inf arithmetic in low precision breeds NaNs on
+        # the (impossible-here, but ragged-block) fully-masked rows
+        s = jnp.where(mask[None, None], s, jnp.asarray(-1e30, s.dtype))
+    m = jax.lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp((s - m).astype(jnp.float32))
+    p = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
