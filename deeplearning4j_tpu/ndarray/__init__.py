@@ -1,10 +1,13 @@
 """Eager tensor API: ``NDArray`` + the ``nd`` factory (ref: INDArray / Nd4j)."""
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
+from deeplearning4j_tpu.ndarray import surface as _surface  # noqa: F401 — tranche-3 methods
 from deeplearning4j_tpu.ndarray import factory as nd
+from deeplearning4j_tpu.ndarray.factory import Nd4j
 from deeplearning4j_tpu.ndarray import dtypes
 
 from deeplearning4j_tpu.ndarray.indexing import (BooleanIndexing,
                                                  NDArrayIndex)
 
-__all__ = ["NDArrayIndex", "BooleanIndexing", "NDArray", "nd", "dtypes"]
+__all__ = ["NDArrayIndex", "BooleanIndexing", "NDArray", "nd", "Nd4j",
+           "dtypes"]
 

@@ -378,3 +378,635 @@ def getExecutioner():
     """ref: Nd4j.getExecutioner() — the op-execution facade."""
     from deeplearning4j_tpu.ndarray.executioner import get_executioner
     return get_executioner()
+
+
+# --------------------------------------------------------------------------
+# Nd4j static surface, tranche 3 (ref: org.nd4j.linalg.factory.Nd4j — the
+# creation-overload, linalg, accumulation, serialization and env tails)
+
+def createFromArray(*values, dtype=None) -> NDArray:
+    """ref: Nd4j.createFromArray(...) — varargs scalars or nested lists."""
+    if len(values) == 1 and isinstance(values[0], (list, tuple, np.ndarray)):
+        values = values[0]
+    return create(np.asarray(values), dtype)
+
+
+def fromNumpy(arr) -> NDArray:
+    """ref: Nd4j.createFromNpyPointer analog — zero-copy numpy ingest."""
+    return NDArray(jnp.asarray(arr))
+
+
+def createUninitialized(*shape, dtype=None) -> NDArray:
+    """ref: Nd4j.createUninitialized — XLA has no uninitialized memory;
+    zeros (the reference's contract is 'contents undefined', zeros satisfy)."""
+    return zeros(*shape, dtype=dtype)
+
+
+createUninitializedDetached = createUninitialized
+
+
+def trueScalar(value) -> NDArray:
+    """ref: Nd4j.trueScalar (rank-0)."""
+    return NDArray(jnp.asarray(value))
+
+
+def trueVector(values) -> NDArray:
+    return NDArray(jnp.asarray(values).reshape(-1))
+
+
+emptyLike = zerosLike
+
+
+def rot90(a, k: int = 1) -> NDArray:
+    """ref: Nd4j.rot90."""
+    return NDArray(jnp.rot90(_unwrap(a), k))
+
+
+def flipud(a) -> NDArray:
+    return NDArray(jnp.flipud(_unwrap(a)))
+
+
+def fliplr(a) -> NDArray:
+    return NDArray(jnp.fliplr(_unwrap(a)))
+
+
+def gemm(a, b, transpose_a=False, transpose_b=False, alpha=1.0, beta=0.0,
+         c=None) -> NDArray:
+    """ref: Nd4j.gemm — C = alpha·op(A)·op(B) + beta·C. bf16 operands ride
+    the MXU with f32 accumulation."""
+    A = _unwrap(a).T if transpose_a else _unwrap(a)
+    B = _unwrap(b).T if transpose_b else _unwrap(b)
+    prefer = jnp.float32 if A.dtype in (jnp.bfloat16, jnp.float16) else None
+    out = alpha * jnp.matmul(A, B, preferred_element_type=prefer)
+    if c is not None and beta != 0.0:
+        out = out + beta * _unwrap(c)
+    if isinstance(c, NDArray):
+        return c._write(out.astype(c.dtype))
+    return NDArray(out)
+
+
+def tensorMmul(a, b, axes) -> NDArray:
+    """ref: Nd4j.tensorMmul."""
+    return NDArray(jnp.tensordot(_unwrap(a), _unwrap(b), axes=axes))
+
+
+def outer(a, b) -> NDArray:
+    return NDArray(jnp.outer(_unwrap(a), _unwrap(b)))
+
+
+def accumulate(*arrays) -> NDArray:
+    """ref: Nd4j.accumulate — elementwise sum of N same-shape arrays."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    out = _unwrap(arrays[0])
+    for a in arrays[1:]:
+        out = out + _unwrap(a)
+    return NDArray(out)
+
+
+def average(*arrays) -> NDArray:
+    """ref: Nd4j.averageAndPropagate family — mean of N arrays."""
+    if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+        arrays = arrays[0]
+    return NDArray(accumulate(list(arrays)).buf() / len(arrays))
+
+
+averageAndPropagate = average
+
+
+def appendBias(*vectors) -> NDArray:
+    """ref: Nd4j.appendBias — concat column vectors and append a 1.0 bias."""
+    if len(vectors) == 1 and isinstance(vectors[0], (list, tuple)):
+        vectors = vectors[0]
+    flat = jnp.concatenate([jnp.ravel(_unwrap(v)) for v in vectors])
+    return NDArray(jnp.concatenate([flat, jnp.ones((1,), flat.dtype)])
+                   .reshape(-1, 1))
+
+
+def bilinearProducts(curr, in_):
+    """ref: Nd4j.bilinearProducts — d-vector of x^T·T[d]·y slices."""
+    T = _unwrap(curr)          # (d, n, n)
+    x = _unwrap(in_).reshape(-1)
+    return NDArray(jnp.einsum("dij,i,j->d", T, x, x))
+
+
+def isMax(a, axis=None) -> NDArray:
+    """ref: Nd4j.getExecutioner IsMax op — one-hot of the argmax."""
+    buf = _unwrap(a)
+    if axis is None:
+        flat = buf.ravel()
+        return NDArray((jnp.arange(flat.size) == jnp.argmax(flat))
+                       .reshape(buf.shape).astype(buf.dtype))
+    idx = jnp.argmax(buf, axis=axis, keepdims=True)
+    iota = jax.lax.broadcasted_iota(jnp.int32, buf.shape, axis)
+    return NDArray((iota == idx).astype(buf.dtype))
+
+
+def scatterUpdate(op: str, array, indices, updates, axis=0) -> NDArray:
+    """ref: Nd4j.scatterUpdate — in-place indexed update (add/sub/mul/assign)."""
+    buf = _unwrap(array)
+    idx = jnp.asarray(_unwrap(indices))
+    upd = jnp.asarray(_unwrap(updates), buf.dtype)
+    at = buf.at[idx] if axis == 0 else buf.at[(slice(None),) * axis + (idx,)]
+    out = {"add": at.add, "sub": lambda u: at.add(-u), "mul": at.multiply,
+           "assign": at.set}[op](upd)
+    if isinstance(array, NDArray):
+        return array._write(out)
+    return NDArray(out)
+
+
+def sortRows(a, column: int = 0, ascending=True) -> NDArray:
+    """ref: Nd4j.sortRows — reorder rows by one column's values."""
+    buf = _unwrap(a)
+    order = jnp.argsort(buf[:, column])
+    if not ascending:
+        order = jnp.flip(order)
+    return NDArray(buf[order])
+
+
+def sortColumns(a, row: int = 0, ascending=True) -> NDArray:
+    buf = _unwrap(a)
+    order = jnp.argsort(buf[row, :])
+    if not ascending:
+        order = jnp.flip(order)
+    return NDArray(buf[:, order])
+
+
+def sortWithIndices(a, dim=-1, ascending=True):
+    """ref: Nd4j.sortWithIndices — (indices, sorted) pair."""
+    buf = _unwrap(a)
+    idx = jnp.argsort(buf, axis=dim)
+    if not ascending:
+        idx = jnp.flip(idx, axis=dim)
+    return (NDArray(idx.astype(jnp.int32)),
+            NDArray(jnp.take_along_axis(buf, idx, axis=dim)))
+
+
+def stripOnes(a) -> NDArray:
+    """ref: Nd4j.stripOnes — squeeze all size-1 dims."""
+    return NDArray(jnp.squeeze(_unwrap(a)))
+
+
+def clearNans(a) -> NDArray:
+    """ref: Nd4j.clearNans — in-place NaN→0."""
+    buf = _unwrap(a)
+    out = jnp.where(jnp.isnan(buf), jnp.zeros((), buf.dtype), buf)
+    if isinstance(a, NDArray):
+        return a._write(out)
+    return NDArray(out)
+
+
+def cumsum(a, axis=None) -> NDArray:
+    return NDArray(jnp.cumsum(_unwrap(a), axis=axis))
+
+
+def cumprod(a, axis=None) -> NDArray:
+    return NDArray(jnp.cumprod(_unwrap(a), axis=axis))
+
+
+def exec_(op, *args, **kwargs):
+    """ref: Nd4j.exec(Op/CustomOp) — run a registry op eagerly by name."""
+    from deeplearning4j_tpu.ops.registry import exec_op
+    return exec_op(op, *args, **kwargs)
+
+
+def dataType():
+    """ref: Nd4j.dataType() — the default floating point type."""
+    return _default_dtype
+
+
+setDefaultDataTypes = setDefaultDataType
+
+
+def sizeOfDataType(dtype=None) -> int:
+    """ref: Nd4j.sizeOfDataType — bytes per element."""
+    return jnp.dtype(_dt.resolve(dtype) if dtype is not None
+                     else _default_dtype).itemsize
+
+
+def getBackend() -> str:
+    return backend()
+
+
+def getStrides(shape, order="c"):
+    """ref: Nd4j.getStrides — row/col-major element strides for a shape."""
+    shape = tuple(shape)
+    if order == "f":
+        out, acc = [], 1
+        for s in shape:
+            out.append(acc)
+            acc *= s
+        return tuple(out)
+    out, acc = [], 1
+    for s in reversed(shape):
+        out.append(acc)
+        acc *= s
+    return tuple(reversed(out))
+
+
+def checkShapeValues(shape) -> None:
+    """ref: Nd4j.checkShapeValues — reject negatives/overflow."""
+    for s in shape:
+        if int(s) < 0:
+            raise ValueError(f"negative dimension in shape {tuple(shape)}")
+
+
+def toByteArray(arr) -> bytes:
+    """ref: Nd4j.toByteArray — portable npy bytes."""
+    import io
+    bio = io.BytesIO()
+    np.save(bio, np.asarray(_unwrap(arr)))
+    return bio.getvalue()
+
+
+def fromByteArray(data: bytes) -> NDArray:
+    import io
+    return NDArray(jnp.asarray(np.load(io.BytesIO(data))))
+
+
+def toNpyByteArray(arr) -> bytes:
+    return toByteArray(arr)
+
+
+createNpyFromByteArray = fromByteArray
+
+
+def writeTxt(arr, path, sep=",") -> None:
+    """ref: Nd4j.writeTxt."""
+    a = np.asarray(_unwrap(arr))
+    header = f"shape={a.shape}"
+    rows = a.shape[0] if a.ndim > 1 else 1
+    np.savetxt(path, a.reshape(rows, -1), delimiter=sep, header=header)
+
+
+def readTxt(path, sep=",") -> NDArray:
+    """ref: Nd4j.readTxt — reads writeTxt output (shape in header)."""
+    with open(path) as f:
+        first = f.readline()
+    data = np.loadtxt(path, delimiter=sep)
+    if first.startswith("# shape="):
+        shape = tuple(int(x) for x in
+                      first.strip()[len("# shape=("):-1].split(",") if x.strip())
+        data = data.reshape(shape)
+    return NDArray(jnp.asarray(data))
+
+
+def write(arr, path) -> None:
+    """ref: Nd4j.write(INDArray, DataOutputStream) — binary single array."""
+    saveBinary(arr, path)
+
+
+def read(path) -> NDArray:
+    return readBinary(path)
+
+
+def getAffinityManager():
+    """ref: Nd4j.getAffinityManager — device placement facade. XLA/PJRT owns
+    placement; exposes the current device list."""
+    class _Affinity:
+        def getNumberOfDevices(self):
+            return len(jax.devices())
+
+        def getDeviceForCurrentThread(self):
+            return 0
+    return _Affinity()
+
+
+def getMemoryManager():
+    """ref: Nd4j.getMemoryManager — PJRT owns memory; live-buffer stats."""
+    class _Mem:
+        def getCurrentWorkspace(self):
+            return None
+
+        def allocatedMemory(self, device=0):
+            try:
+                stats = jax.local_devices()[device].memory_stats()
+                return int(stats.get("bytes_in_use", 0)) if stats else 0
+            except Exception:
+                return 0
+    return _Mem()
+
+
+def create_shaped(*args, dtype=None, order="c") -> NDArray:
+    """ref: Nd4j.create(int...)/(double[])/(data, shape, order) — the
+    creation mega-overload. Dispatch mirrors the reference's: int varargs /
+    an int list = shape (Java ``create(int[])`` allocates); a float list,
+    nested list, or numpy array = data; data + shape tuple = reshape."""
+    if args and isinstance(args[0], (list, np.ndarray)):
+        data = np.asarray(args[0])
+        if len(args) >= 2 and isinstance(args[1], (tuple, list)):
+            shape = tuple(args[1])
+            buf = create(data.ravel(), dtype).buf()
+            arr = buf.reshape(shape[::-1]).T if order == "f" \
+                else buf.reshape(shape)
+            return NDArray(arr)
+        if data.ndim > 1 or not np.issubdtype(data.dtype, np.integer) \
+                or isinstance(args[0], np.ndarray):
+            return create(data, dtype)
+        # flat python int list = shape, matching Java create(int[])
+        return zeros(*data.tolist(), dtype=dtype)
+    return zeros(*args, dtype=dtype)
+
+
+class Nd4j:
+    """The reference-spelled static facade: ``Nd4j.zeros(...)`` etc.
+
+    ref: org.nd4j.linalg.factory.Nd4j (~7k-line static factory). Every
+    module-level factory function is exposed as a static; the class exists
+    so reference code translates 1:1 (``Nd4j.create`` → ``Nd4j.create``).
+    Populated at import time from this module's public functions.
+    """
+    pass
+
+
+def _populate_nd4j_facade():
+    import sys
+    mod = sys.modules[__name__]
+    skip = {"NDArray", "Nd4j"}
+    for name in dir(mod):
+        if name.startswith("_") or name in skip:
+            continue
+        obj = getattr(mod, name)
+        if callable(obj) and getattr(obj, "__module__", "").endswith(
+                ("factory", "random")):
+            setattr(Nd4j, name, staticmethod(obj))
+    # reference-spelled aliases
+    Nd4j.create = staticmethod(create_shaped)
+    Nd4j.createFromData = staticmethod(create)
+    Nd4j.exec_ = staticmethod(exec_)
+    setattr(Nd4j, "exec", staticmethod(exec_))  # valid since py3 — 1:1 spelling
+    Nd4j.getRandomFactory = staticmethod(getRandom)
+    Nd4j.defaultFloatingPointType = staticmethod(defaultFloatingPointType)
+
+
+_populate_nd4j_facade()
+
+
+# --------------------------------------------------------------------------
+# BLAS/LAPACK facade (ref: Nd4j.getBlasWrapper() →
+# org.nd4j.linalg.factory.BlasWrapper + .lapack()). On TPU these lower to
+# XLA's linalg lowerings (QR/SVD/Cholesky run on device); the facade keeps
+# the reference's call shape.
+
+class _Lapack:
+    """ref: org.nd4j.linalg.api.blas.Lapack."""
+
+    def gesvd(self, a):
+        u, s, vt = jnp.linalg.svd(_unwrap(a), full_matrices=False)
+        return NDArray(u), NDArray(s), NDArray(vt)
+
+    def potrf(self, a, lower=True):
+        c = jnp.linalg.cholesky(_unwrap(a))
+        return NDArray(c if lower else c.T)
+
+    def getrf(self, a):
+        import jax.scipy.linalg as jsl
+        lu, piv = jsl.lu_factor(_unwrap(a))
+        return NDArray(lu), NDArray(piv)
+
+    def syev(self, a):
+        w, v = jnp.linalg.eigh(_unwrap(a))
+        return NDArray(w), NDArray(v)
+
+    def geqrf(self, a):
+        q, r = jnp.linalg.qr(_unwrap(a))
+        return NDArray(q), NDArray(r)
+
+
+class _BlasWrapper:
+    """ref: org.nd4j.linalg.factory.BlasWrapper (level1/2/3 + lapack)."""
+
+    def lapack(self):
+        return _Lapack()
+
+    def dot(self, x, y):
+        return float(jnp.vdot(_unwrap(x), _unwrap(y)))
+
+    def nrm2(self, x):
+        return float(jnp.linalg.norm(jnp.ravel(_unwrap(x))))
+
+    def asum(self, x):
+        return float(jnp.sum(jnp.abs(_unwrap(x))))
+
+    def iamax(self, x):
+        return int(jnp.argmax(jnp.abs(jnp.ravel(_unwrap(x)))))
+
+    def scal(self, alpha, x):
+        if isinstance(x, NDArray):
+            return x._write(alpha * x.buf())
+        return NDArray(alpha * _unwrap(x))
+
+    def axpy(self, alpha, x, y):
+        out = alpha * _unwrap(x) + _unwrap(y)
+        if isinstance(y, NDArray):
+            return y._write(out)
+        return NDArray(out)
+
+    def gemv(self, alpha, a, x, beta=0.0, y=None):
+        out = alpha * (_unwrap(a) @ jnp.ravel(_unwrap(x)))
+        if y is not None:
+            out = out + beta * jnp.ravel(_unwrap(y))
+        return NDArray(out)
+
+    def gemm(self, a, b, transpose_a=False, transpose_b=False,
+             alpha=1.0, beta=0.0, c=None):
+        return gemm(a, b, transpose_a, transpose_b, alpha, beta, c)
+
+    def ger(self, alpha, x, y, a=None):
+        out = alpha * jnp.outer(jnp.ravel(_unwrap(x)), jnp.ravel(_unwrap(y)))
+        if a is not None:
+            out = out + _unwrap(a)
+        return NDArray(out)
+
+
+def getBlasWrapper() -> _BlasWrapper:
+    return _BlasWrapper()
+
+
+# linalg statics (ref: Lapack entry points surfaced on Nd4j in examples)
+def svd(a):
+    return getBlasWrapper().lapack().gesvd(a)
+
+
+def cholesky(a) -> NDArray:
+    return getBlasWrapper().lapack().potrf(a)
+
+
+def qr(a):
+    return getBlasWrapper().lapack().geqrf(a)
+
+
+def lu(a):
+    return getBlasWrapper().lapack().getrf(a)
+
+
+def eig(a):
+    return getBlasWrapper().lapack().syev(a)
+
+
+def solve(a, b) -> NDArray:
+    return NDArray(jnp.linalg.solve(_unwrap(a), _unwrap(b)))
+
+
+def lstsq(a, b) -> NDArray:
+    sol, *_ = jnp.linalg.lstsq(_unwrap(a), _unwrap(b))
+    return NDArray(sol)
+
+
+def inv(a) -> NDArray:
+    return NDArray(jnp.linalg.inv(_unwrap(a)))
+
+
+def pinv(a) -> NDArray:
+    return NDArray(jnp.linalg.pinv(_unwrap(a)))
+
+
+def det(a) -> float:
+    return float(jnp.linalg.det(_unwrap(a)))
+
+
+def matrixRank(a) -> int:
+    return int(jnp.linalg.matrix_rank(_unwrap(a)))
+
+
+# remaining creation/structure statics
+def randUniform(low, high, *shape) -> NDArray:
+    """ref: Nd4j.rand(shape, min, max, rng)."""
+    key = _rng.next_key()
+    return NDArray(jax.random.uniform(key, _shape(shape), _default_dtype,
+                                      low, high))
+
+
+def specialConcat(dim, *arrays) -> NDArray:
+    """ref: Nd4j.specialConcat — same contract as concat."""
+    return concat(dim, *arrays)
+
+
+def rollAxis(a, axis, start=0) -> NDArray:
+    """ref: Nd4j.rollAxis."""
+    return NDArray(jnp.moveaxis(_unwrap(a), axis, start))
+
+
+def shape(a):
+    """ref: Nd4j.shape(INDArray)."""
+    return tuple(_unwrap(a).shape)
+
+
+def order() -> str:
+    """ref: Nd4j.order() — logical ordering (XLA owns physical layout)."""
+    return "c"
+
+
+def factory():
+    """ref: Nd4j.factory() — the NDArrayFactory; here the module itself."""
+    import sys
+    return sys.modules[__name__]
+
+
+def createFromNpzFile(path):
+    """ref: Nd4j.createFromNpzFile — dict of name → array."""
+    data = np.load(path)
+    return {k: NDArray(jnp.asarray(data[k])) for k in data.files}
+
+
+def writeAsNumpy(arr, path) -> None:
+    """ref: Nd4j.writeAsNumpy."""
+    writeNumpy(arr, path)
+
+
+def getCompressor():
+    """ref: Nd4j.getCompressor() → BasicNDArrayCompressor. TPU story: PJRT
+    buffers are never compressed in-memory; this facade provides the
+    at-rest codec (gzip over npy bytes) the reference uses for transport."""
+    import gzip
+
+    class _Compressor:
+        def compress(self, arr) -> bytes:
+            return gzip.compress(toByteArray(arr))
+
+        def decompress(self, data: bytes) -> NDArray:
+            return fromByteArray(gzip.decompress(data))
+
+        def setDefaultCompression(self, algo: str):
+            return self
+    return _Compressor()
+
+
+def zeros_like(a) -> NDArray:
+    return zerosLike(a)
+
+
+def ones_like(a) -> NDArray:
+    return onesLike(a)
+
+
+def vander(x, n=None) -> NDArray:
+    """ref: Nd4j.vander — Vandermonde matrix."""
+    return NDArray(jnp.vander(jnp.ravel(_unwrap(x)), n))
+
+
+def tri(n, m=None, k=0) -> NDArray:
+    return NDArray(jnp.tri(n, m, k, dtype=_default_dtype))
+
+
+def logspace(start, stop, num, base=10.0) -> NDArray:
+    return NDArray(jnp.logspace(start, stop, num, base=base,
+                                dtype=_default_dtype))
+
+
+def histogram(a, bins=10):
+    h, edges = jnp.histogram(jnp.ravel(_unwrap(a)), bins=bins)
+    return NDArray(h), NDArray(edges)
+
+
+def unique(a) -> NDArray:
+    return NDArray(jnp.unique(_unwrap(a)))
+
+
+def nonzero(a) -> NDArray:
+    """Coordinates of nonzero elements, (n, rank) — Nd4j.where analog."""
+    return NDArray(jnp.stack(jnp.nonzero(_unwrap(a)), axis=-1))
+
+
+# re-populate the facade with everything defined after the first pass
+_populate_nd4j_facade()
+
+
+def getEnvironment():
+    """ref: Nd4j.getEnvironment() → org.nd4j.linalg.factory.Environment —
+    runtime introspection knobs (the debug/verbose toggles map to jax's)."""
+    class _Env:
+        def isCPU(self):
+            return jax.default_backend() == "cpu"
+
+        def isTPU(self):
+            return jax.default_backend() in ("tpu", "axon")
+
+        def isDebug(self):
+            return bool(jax.config.jax_debug_nans)
+
+        def setDebug(self, v: bool):
+            jax.config.update("jax_debug_nans", bool(v))
+
+        def isVerbose(self):
+            return jax.config.jax_log_compiles
+
+        def setVerbose(self, v: bool):
+            jax.config.update("jax_log_compiles", bool(v))
+
+        def maxThreads(self):
+            import os as _os
+            return _os.cpu_count()
+    return _Env()
+
+
+def version() -> str:
+    """ref: nd4j-common VersionCheck / Nd4j version info."""
+    try:
+        import importlib.metadata as md
+        return md.version("deeplearning4j-tpu")
+    except Exception:
+        return "0.0.0-dev"
+
+
+_populate_nd4j_facade()

@@ -149,3 +149,100 @@ def unitVec(x):
 def normalizeZeroMeanAndUnitVariance(x):
     b = _unwrap(x)
     return NDArray((b - jnp.mean(b, axis=0)) / (jnp.std(b, axis=0) + 1e-12))
+
+
+def stabilize(x, k=1.0):
+    """ref: Transforms.stabilize — clamp to the numerically safe exp range."""
+    b = _unwrap(x)
+    lim = 80.0 / k
+    return NDArray(jnp.clip(b, -lim, lim))
+
+
+def andOp(a, b):
+    return NDArray(jnp.asarray(_unwrap(a)).astype(bool)
+                   & jnp.asarray(_unwrap(b)).astype(bool))
+
+
+def orOp(a, b):
+    return NDArray(jnp.asarray(_unwrap(a)).astype(bool)
+                   | jnp.asarray(_unwrap(b)).astype(bool))
+
+
+def xorOp(a, b):
+    return NDArray(jnp.asarray(_unwrap(a)).astype(bool)
+                   ^ jnp.asarray(_unwrap(b)).astype(bool))
+
+
+def notOp(a):
+    return NDArray(~jnp.asarray(_unwrap(a)).astype(bool))
+
+
+def greaterThanOrEqual(a, b):
+    return NDArray(jnp.greater_equal(_unwrap(a), _unwrap(b)))
+
+
+def lessThanOrEqual(a, b):
+    return NDArray(jnp.less_equal(_unwrap(a), _unwrap(b)))
+
+
+def allEuclideanDistances(a, b, dim=1):
+    """ref: Transforms.allEuclideanDistances — pairwise row distances."""
+    A, B = _unwrap(a), _unwrap(b)
+    if dim == 0:
+        A, B = A.T, B.T
+    d2 = (jnp.sum(A * A, 1)[:, None] - 2.0 * A @ B.T
+          + jnp.sum(B * B, 1)[None, :])
+    return NDArray(jnp.sqrt(jnp.maximum(d2, 0.0)))
+
+
+def allManhattanDistances(a, b, dim=1):
+    A, B = _unwrap(a), _unwrap(b)
+    if dim == 0:
+        A, B = A.T, B.T
+    return NDArray(jnp.sum(jnp.abs(A[:, None, :] - B[None, :, :]), axis=-1))
+
+
+def allCosineSimilarities(a, b, dim=1):
+    A, B = _unwrap(a), _unwrap(b)
+    if dim == 0:
+        A, B = A.T, B.T
+    An = A / (jnp.linalg.norm(A, axis=1, keepdims=True) + 1e-12)
+    Bn = B / (jnp.linalg.norm(B, axis=1, keepdims=True) + 1e-12)
+    return NDArray(An @ Bn.T)
+
+
+def cross(a, b):
+    return NDArray(jnp.cross(_unwrap(a), _unwrap(b)))
+
+
+def dot(a, b):
+    return NDArray(jnp.dot(_unwrap(a), _unwrap(b)))
+
+
+def reverse(x, *dims):
+    return NDArray(jnp.flip(_unwrap(x), axis=dims or None))
+
+
+class Transforms:
+    """Reference-spelled static facade (ref: org.nd4j.linalg.ops.transforms
+    .Transforms). All module functions as statics, incl. python-keyword-safe
+    names (``Transforms.and_`` for Java's ``Transforms.and``)."""
+    pass
+
+
+def _populate_transforms_facade():
+    import sys
+    mod = sys.modules[__name__]
+    for name in dir(mod):
+        if name.startswith("_") or name == "Transforms":
+            continue
+        obj = getattr(mod, name)
+        if callable(obj) and getattr(obj, "__module__", "") == __name__:
+            setattr(Transforms, name, staticmethod(obj))
+    Transforms.and_ = staticmethod(andOp)
+    Transforms.or_ = staticmethod(orOp)
+    Transforms.xor_ = staticmethod(xorOp)
+    Transforms.not_ = staticmethod(notOp)
+
+
+_populate_transforms_facade()

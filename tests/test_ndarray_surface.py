@@ -1,0 +1,298 @@
+"""Tranche-3 INDArray/Nd4j surface tests (ref: nd4j-api INDArray interface +
+Nd4j factory, exercised family by family — the backend-parametric array-test
+pattern of nd4j-tests, SURVEY §4)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ndarray import NDArray, Nd4j, nd
+from deeplearning4j_tpu.ops.transforms import Transforms
+
+
+@pytest.fixture
+def a():
+    return nd.create(np.arange(6.0).reshape(2, 3))
+
+
+class TestResultArgBinops:
+    def test_add_into_result(self, a):
+        r = nd.zeros(2, 3)
+        out = a.add(10.0, r)
+        assert out is r
+        np.testing.assert_allclose(r.toNumpy(), a.toNumpy() + 10.0)
+
+    def test_sub_mul_div_rsub_rdiv_result(self, a):
+        b = nd.ones(2, 3)
+        for name, expect in [("sub", a.toNumpy() - 1), ("mul", a.toNumpy()),
+                             ("div", a.toNumpy()),
+                             ("rsub", 1 - a.toNumpy())]:
+            r = nd.zeros(2, 3)
+            getattr(a, name)(b, r)
+            np.testing.assert_allclose(r.toNumpy(), expect)
+
+    def test_mmul_result_and_transpose(self, a):
+        r = nd.zeros(2, 2)
+        a.mmul(a, r, transpose="b")
+        np.testing.assert_allclose(r.toNumpy(), a.toNumpy() @ a.toNumpy().T)
+
+    def test_operators_still_allocate(self, a):
+        out = a + 1.0
+        assert isinstance(out, NDArray)
+        np.testing.assert_allclose(out.toNumpy(), a.toNumpy() + 1.0)
+
+
+class TestComparisonIVariants:
+    def test_lti_writes_in_place(self, a):
+        a.lti(3.0)
+        np.testing.assert_allclose(a.toNumpy(),
+                                   (np.arange(6.0) < 3).reshape(2, 3))
+
+    def test_gtei(self, a):
+        a.gtei(4.0)
+        np.testing.assert_allclose(a.toNumpy(),
+                                   (np.arange(6.0) >= 4).reshape(2, 3))
+
+
+class TestBooleanOps:
+    def test_and_or_xor_not(self):
+        x = nd.create(np.array([1.0, 0.0, 1.0]))
+        y = nd.create(np.array([1.0, 1.0, 0.0]))
+        assert x.and_(y).toNumpy().tolist() == [True, False, False]
+        assert x.or_(y).toNumpy().tolist() == [True, True, True]
+        assert x.xor_(y).toNumpy().tolist() == [False, True, True]
+        assert x.not_().toNumpy().tolist() == [False, True, False]
+
+    def test_dunder_forms(self):
+        x = nd.create(np.array([True, False]))
+        y = nd.create(np.array([True, True]))
+        assert (x & y).toNumpy().tolist() == [True, False]
+        assert (~x).toNumpy().tolist() == [False, True]
+
+
+class TestConditionFamily:
+    def test_match_equality_and_named(self, a):
+        assert a.match(3.0).toNumpy().sum() == 1
+        assert a.match(2.0, "greaterthan").toNumpy().sum() == 3
+
+    def test_scan_counts(self, a):
+        assert a.scan(("greaterthan", 2.0)) == 3
+        assert a.scan_(("lessthan", 1.0)) == 1
+
+    def test_putWhere_and_mask(self, a):
+        out = a.putWhere(("greaterthan", 3.0), 0.0)
+        assert out.toNumpy().max() == 3.0
+        m = np.zeros((2, 3)); m[0, 0] = 1
+        out2 = a.putWhereWithMask(m, -1.0)
+        assert out2.toNumpy()[0, 0] == -1.0
+
+    def test_assignIf_in_place(self, a):
+        a.assignIf(99.0, ("greaterthan", 4.0))
+        assert a.toNumpy()[1, 2] == 99.0
+        assert a.toNumpy()[0, 0] == 0.0
+
+
+class TestOrderAware:
+    def test_ravel_f_order(self, a):
+        np.testing.assert_allclose(a.ravel("f").toNumpy(),
+                                   a.toNumpy().ravel(order="F"))
+
+    def test_reshape_f_order(self, a):
+        np.testing.assert_allclose(
+            a.reshape(3, 2, order="f").toNumpy(),
+            a.toNumpy().reshape(3, 2, order="F"))
+
+    def test_reshape_char_first_form(self, a):
+        np.testing.assert_allclose(
+            a.reshape("f", 3, 2).toNumpy(),
+            a.toNumpy().reshape(3, 2, order="F"))
+
+    def test_dup_preserves_values(self, a):
+        np.testing.assert_allclose(a.dup("f").toNumpy(), a.toNumpy())
+
+
+class TestSliceFamily:
+    def test_slices_and_putSlice(self, a):
+        assert a.slices() == 2
+        a.putSlice(0, np.array([9.0, 9.0, 9.0]))
+        assert a.toNumpy()[0].tolist() == [9.0, 9.0, 9.0]
+
+    def test_vectorAlongDimension(self, a):
+        v = a.vectorAlongDimension(0, 1)
+        np.testing.assert_allclose(v.toNumpy(), [0.0, 1.0, 2.0])
+
+    def test_dimShuffle(self, a):
+        out = a.dimShuffle(["x", 1, 0])
+        assert out.shape == (1, 3, 2)
+        np.testing.assert_allclose(out.toNumpy()[0], a.toNumpy().T)
+
+
+class TestEntropyFamily:
+    def test_entropy_matches_numpy(self):
+        p = nd.create(np.array([0.5, 0.5]))
+        assert abs(float(p.entropy().toNumpy()) - np.log(2)) < 1e-6
+        assert abs(p.shannonEntropyNumber() - 1.0) < 1e-6
+
+    def test_entropy_along_dims(self):
+        p = nd.create(np.array([[0.5, 0.5], [1.0, 0.0]]))
+        e = p.entropy(1).toNumpy()
+        assert abs(e[0] - np.log(2)) < 1e-6 and abs(e[1]) < 1e-6
+
+
+class TestInPlaceShape:
+    def test_transposei(self, a):
+        a.transposei()
+        assert a.shape == (3, 2)
+
+    def test_permutei_view_raises(self, a):
+        v = a[0]
+        with pytest.raises(ValueError):
+            v.transposei()
+
+
+class TestMiscLongTail:
+    def test_element_and_data(self, a):
+        assert nd.scalar(5.0).element() == 5.0
+        assert a.data().shape == (6,)
+
+    def test_convert_family(self, a):
+        assert a.convertToFloats().dtype == np.float32
+        assert a.convertToHalfs().dtype == np.float16
+
+    def test_equalShapes(self, a):
+        assert a.equalShapes(nd.zeros(2, 3))
+        assert not a.equalShapes(nd.zeros(3, 2))
+
+    def test_puti_vectors(self, a):
+        a.putiRowVector(np.array([7.0, 8.0, 9.0]))
+        np.testing.assert_allclose(a.toNumpy()[1], [7.0, 8.0, 9.0])
+
+    def test_getRow_dup_detaches(self, a):
+        r = a.getRow(0, dup=True)
+        r.addi(100.0)
+        assert a.toNumpy()[0, 0] == 0.0
+
+    def test_getRow_view_writes_through(self, a):
+        r = a.getRow(0)
+        r.addi(100.0)
+        assert a.toNumpy()[0, 0] == 100.0
+
+    def test_repmat(self, a):
+        assert a.repmat(2, 2).shape == (4, 6)
+
+    def test_layout_divergence_raises(self, a):
+        with pytest.raises(NotImplementedError):
+            a.setOrder("f")
+
+
+class TestNd4jFacade:
+    def test_spelling_parity(self):
+        out = Nd4j.zeros(2, 2)
+        assert out.shape == (2, 2)
+        assert Nd4j.createFromArray(1.0, 2.0, 3.0).shape == (3,)
+
+    def test_create_mega_overload(self):
+        assert Nd4j.create(2, 3).shape == (2, 3)
+        d = Nd4j.create([1.0, 2.0, 3.0, 4.0], (2, 2))
+        assert d.shape == (2, 2)
+
+    def test_gemm_alpha_beta(self):
+        a = nd.create(np.eye(2))
+        c = nd.ones(2, 2)
+        out = Nd4j.gemm(a, a, alpha=2.0, beta=3.0, c=c)
+        np.testing.assert_allclose(out.toNumpy(), 2 * np.eye(2) + 3)
+
+    def test_isMax(self):
+        out = Nd4j.isMax(nd.create(np.array([[1.0, 3.0], [2.0, 0.0]])), axis=1)
+        np.testing.assert_allclose(out.toNumpy(), [[0, 1], [1, 0]])
+
+    def test_scatterUpdate(self):
+        arr = nd.zeros(4, 2)
+        Nd4j.scatterUpdate("add", arr, [1, 3], np.ones((2, 2)))
+        assert arr.toNumpy().sum() == 4.0
+
+    def test_sortRows(self):
+        m = nd.create(np.array([[3.0, 0.0], [1.0, 1.0], [2.0, 2.0]]))
+        out = Nd4j.sortRows(m, column=0)
+        np.testing.assert_allclose(out.toNumpy()[:, 0], [1.0, 2.0, 3.0])
+
+    def test_accumulate_average(self):
+        xs = [nd.ones(2, 2), nd.ones(2, 2), nd.ones(2, 2)]
+        assert Nd4j.accumulate(xs).toNumpy().sum() == 12.0
+        assert Nd4j.average(xs).toNumpy().sum() == 4.0
+
+    def test_byte_roundtrip(self):
+        a = nd.create(np.arange(4.0))
+        b = Nd4j.fromByteArray(Nd4j.toByteArray(a))
+        np.testing.assert_allclose(a.toNumpy(), b.toNumpy())
+
+    def test_txt_roundtrip(self, tmp_path):
+        a = nd.create(np.arange(6.0).reshape(2, 3))
+        p = str(tmp_path / "arr.txt")
+        Nd4j.writeTxt(a, p)
+        b = Nd4j.readTxt(p)
+        np.testing.assert_allclose(a.toNumpy(), b.toNumpy())
+
+    def test_compressor_roundtrip(self):
+        a = nd.create(np.arange(100.0))
+        comp = Nd4j.getCompressor()
+        blob = comp.compress(a)
+        np.testing.assert_allclose(comp.decompress(blob).toNumpy(),
+                                   a.toNumpy())
+
+    def test_environment(self):
+        env = Nd4j.getEnvironment()
+        assert env.isCPU() or env.isTPU()
+
+    def test_strides_and_shape_check(self):
+        assert Nd4j.getStrides((2, 3, 4)) == (12, 4, 1)
+        assert Nd4j.getStrides((2, 3, 4), "f") == (1, 2, 6)
+        with pytest.raises(ValueError):
+            Nd4j.checkShapeValues((2, -1))
+
+
+class TestLinalgFacade:
+    def test_svd_reconstructs(self):
+        m = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+        u, s, vt = Nd4j.svd(nd.create(m))
+        rec = u.toNumpy() @ np.diag(s.toNumpy()) @ vt.toNumpy()
+        np.testing.assert_allclose(rec, m, atol=1e-4)
+
+    def test_cholesky_solve_det(self):
+        spd = np.array([[4.0, 2.0], [2.0, 3.0]], np.float32)
+        c = Nd4j.cholesky(nd.create(spd)).toNumpy()
+        np.testing.assert_allclose(c @ c.T, spd, atol=1e-5)
+        x = Nd4j.solve(nd.create(spd), nd.create(np.array([1.0, 0.0])))
+        np.testing.assert_allclose(spd @ x.toNumpy(), [1.0, 0.0], atol=1e-5)
+        assert abs(Nd4j.det(nd.create(spd)) - 8.0) < 1e-4
+
+    def test_blas_wrapper_level1(self):
+        w = Nd4j.getBlasWrapper()
+        x = nd.create(np.array([3.0, -4.0]))
+        assert abs(w.nrm2(x) - 5.0) < 1e-6
+        assert abs(w.asum(x) - 7.0) < 1e-6
+        assert w.iamax(x) == 1
+        y = nd.create(np.array([1.0, 1.0]))
+        w.axpy(2.0, x, y)   # y ← 2x + y in place
+        np.testing.assert_allclose(y.toNumpy(), [7.0, -7.0])
+
+    def test_lapack_syev(self):
+        spd = nd.create(np.array([[2.0, 0.0], [0.0, 1.0]], np.float32))
+        w_, v = Nd4j.getBlasWrapper().lapack().syev(spd)
+        np.testing.assert_allclose(sorted(w_.toNumpy()), [1.0, 2.0],
+                                   atol=1e-5)
+
+
+class TestTransformsFacade:
+    def test_static_spelling(self):
+        x = nd.create(np.array([-1.0, 0.0, 1.0]))
+        np.testing.assert_allclose(Transforms.relu(x).toNumpy(), [0, 0, 1])
+        np.testing.assert_allclose(Transforms.not_(
+            nd.create(np.array([1.0, 0.0]))).toNumpy(), [False, True])
+
+    def test_all_distances(self):
+        a = nd.create(np.array([[0.0, 0.0], [1.0, 0.0]]))
+        d = Transforms.allEuclideanDistances(a, a)
+        np.testing.assert_allclose(d.toNumpy(), [[0, 1], [1, 0]], atol=1e-6)
+
+    def test_stabilize(self):
+        out = Transforms.stabilize(nd.create(np.array([1e6, -1e6])))
+        assert out.toNumpy().max() <= 80.0
