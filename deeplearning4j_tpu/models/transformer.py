@@ -28,7 +28,11 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS,
+                                              MODEL_AXIS, SEQ_AXIS,
+                                              STAGE_AXIS)
+from deeplearning4j_tpu.parallel.moe import (MoEConfig, init_moe_params,
+                                             moe_ffn, moe_param_specs)
 from deeplearning4j_tpu.parallel.ring import ring_attention, _plain_attention
 
 # attention backend override: None = auto (flash kernel on TPU for long
@@ -102,11 +106,35 @@ class TransformerConfig:
                                       # compile time/HLO size O(1) in depth
                                       # instead of O(L) — the deep-model
                                       # compile lever
+    pipeline_stages: int = 0          # >1: GPipe the block stack over the
+                                      # ``stage`` mesh axis (parallel/pipeline)
+    microbatches: int = 0             # GPipe micro-batch count (0 = 2·stages)
+    moe: Optional["MoEConfig"] = None  # replace the dense FFN with a
+                                      # Switch-MoE FFN (parallel/moe); expert
+                                      # axis shards over ``expert`` when the
+                                      # mesh has one
+    moe_aux_weight: float = 0.01      # Switch load-balance aux-loss weight
 
     def __post_init__(self):
         if self.d_ff is None:
             self.d_ff = 4 * self.d_model
         assert self.d_model % self.n_heads == 0
+        if self.moe is not None:
+            import dataclasses as _dc
+            self.moe = _dc.replace(
+                self.moe,
+                d_model=self.moe.d_model or self.d_model,
+                d_ff=self.moe.d_ff or self.d_ff)
+        if self.pipeline_stages > 1:
+            assert self.n_layers % self.pipeline_stages == 0, \
+                "n_layers must divide into pipeline_stages"
+            assert not self.scan_layers, \
+                "pipeline_stages and scan_layers are mutually exclusive"
+            assert self.moe is None, \
+                "pipeline_stages + moe is not supported yet (the MoE aux " \
+                "loss cannot cross the pipeline's shard_map boundary)"
+            if not self.microbatches:
+                self.microbatches = 2 * self.pipeline_stages
 
 
 class TransformerLM:
@@ -138,19 +166,33 @@ class TransformerLM:
                     "wv": jax.random.normal(kk[2], (c.d_model, c.d_model)) * scale,
                     "wo": jax.random.normal(kk[3], (c.d_model, c.d_model)) * scale,
                 },
-                "mlp": {
+            }
+            if c.moe is not None:
+                blk["moe"] = init_moe_params(c.moe, kk[4], scale=scale)
+            else:
+                blk["mlp"] = {
                     "w_up": jax.random.normal(kk[4], (c.d_model, c.d_ff)) * scale,
                     "b_up": jnp.zeros((c.d_ff,)),
                     "w_down": jax.random.normal(kk[5], (c.d_ff, c.d_model)) * scale,
                     "b_down": jnp.zeros((c.d_model,)),
-                },
-            }
+                }
             params["blocks"].append(blk)
         if c.scan_layers:
             # stacked storage: one leading L axis per leaf, scanned at
             # apply time — identical math, O(1) compile in depth
             params["blocks"] = jax.tree.map(
                 lambda *xs: jnp.stack(xs), *params["blocks"])
+        elif c.pipeline_stages > 1:
+            # (S, L/S, ...) leaves: leading stage axis shards over ``stage``,
+            # second axis is the static per-stage layer loop
+            S = c.pipeline_stages
+            lps = c.n_layers // S
+            stages = [
+                jax.tree.map(lambda *xs: jnp.stack(xs),
+                             *params["blocks"][s * lps:(s + 1) * lps])
+                for s in range(S)]
+            params["blocks"] = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *stages)
         params = jax.tree.map(lambda a: a.astype(jnp.float32), params)
         return params
 
@@ -161,19 +203,36 @@ class TransformerLM:
         row = P(MODEL_AXIS, None) if has_tp else P()
         rep = P()
 
+        has_ep = EXPERT_AXIS in mesh.axis_names
+
         def blk():
-            return {
+            d = {
                 "ln1": {"g": rep, "b": rep}, "ln2": {"g": rep, "b": rep},
                 "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
-                "mlp": {"w_up": col, "b_up": P(MODEL_AXIS) if has_tp else rep,
-                        "w_down": row, "b_down": rep},
             }
+            if self.config.moe is not None:
+                d["moe"] = moe_param_specs(EXPERT_AXIS if has_ep else None)
+            else:
+                d["mlp"] = {"w_up": col,
+                            "b_up": P(MODEL_AXIS) if has_tp else rep,
+                            "w_down": row, "b_down": rep}
+            return d
+
+        def _prepend(spec_tree, *lead):
+            return jax.tree.map(lambda sp: P(*(lead + tuple(sp))), spec_tree,
+                                is_leaf=lambda x: isinstance(x, P))
+
         if self.config.scan_layers:
             # stacked blocks: same per-leaf spec with a leading (layer)
             # axis left unsharded
-            blocks_spec = jax.tree.map(lambda sp: P(*((None,) + tuple(sp))),
-                                       blk(),
-                                       is_leaf=lambda x: isinstance(x, P))
+            blocks_spec = _prepend(blk(), None)
+        elif self.config.pipeline_stages > 1:
+            # (S, L/S, ...): stage axis sharded, per-stage layer axis not;
+            # per-leaf TP specs are dropped inside the pipeline (shard_map
+            # owns the stage body — TP×PP composition is future work)
+            blocks_spec = jax.tree.map(
+                lambda sp: P(STAGE_AXIS, None), blk(),
+                is_leaf=lambda x: isinstance(x, P))
         else:
             blocks_spec = [blk() for _ in range(self.config.n_layers)]
         spec = {
@@ -231,9 +290,71 @@ class TransformerLM:
         mask = jax.random.bernoulli(jax.random.fold_in(rng, i), keep, x.shape)
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
-    def apply(self, params, tokens, rng=None):
+    def _block_math(self, blk, x, rng, li, mesh):
+        """One transformer block. ``mesh=None`` inside the pipeline body
+        (sharding constraints/collectives are owned by shard_map there).
+        Returns (x, moe_aux_loss) — aux is 0.0 for the dense FFN."""
+        c = self.config
+        a = self._attn(blk["attn"], self._ln(blk["ln1"], x), mesh)
+        x = x + self._dropout(a, rng, 2 * li + 1)
+        if mesh is not None:
+            x = self._constrain(x)
+        h = self._ln(blk["ln2"], x)
+        aux = jnp.zeros((), jnp.float32)
+        if c.moe is not None:
+            y, stats = moe_ffn(blk["moe"], h, c.moe, mesh)
+            aux = stats["aux_loss"].astype(jnp.float32)
+        else:
+            hdn = jax.nn.gelu(h @ blk["mlp"]["w_up"] + blk["mlp"]["b_up"])
+            y = hdn @ blk["mlp"]["w_down"] + blk["mlp"]["b_down"]
+        x = x + self._dropout(y, rng, 2 * li + 2)
+        if mesh is not None:
+            x = self._constrain(x)
+        return x, aux
+
+    def _apply_pipelined(self, params, x, rng):
+        """GPipe the block stack over the ``stage`` mesh axis (micro-batch
+        gradient accumulation comes from differentiating the schedule)."""
+        from deeplearning4j_tpu.parallel.pipeline import gpipe
+        c = self.config
+        S, M = c.pipeline_stages, c.microbatches
+        B, t, d = x.shape
+        assert B % M == 0, f"batch {B} must divide into {M} microbatches"
+        lps = c.n_layers // S
+
+        def stage_fn(p_stage, h, mb_idx):
+            stage = lax.axis_index(STAGE_AXIS)
+            # per-micro-batch dropout keys — without the mb fold every
+            # micro-batch would share one mask per layer
+            rng_mb = None if rng is None else jax.random.fold_in(rng, mb_idx)
+            for i in range(lps):
+                blk = jax.tree.map(lambda a: a[i], p_stage)
+                body = (lambda b, h_, li: self._block_math(
+                    b, h_, rng_mb, li, mesh=None)[0])
+                if c.remat:
+                    body = jax.checkpoint(body)
+                h = body(blk, h, stage * lps + i)
+            return h
+
+        dp_ok = (DATA_AXIS in self.mesh.axis_names
+                 and (B // M) % self.mesh.shape[DATA_AXIS] == 0)
+        if DATA_AXIS in self.mesh.axis_names and not dp_ok \
+                and self.mesh.shape[DATA_AXIS] > 1:
+            import logging
+            logging.getLogger(__name__).warning(
+                "pipeline micro-batch size %d is not divisible by the "
+                "data axis (%d) — activations will REPLICATE over data "
+                "and data parallelism contributes no throughput",
+                B // M, self.mesh.shape[DATA_AXIS])
+        batch_ax = DATA_AXIS if dp_ok else None
+        run = gpipe(stage_fn, self.mesh, S, batch_axis=batch_ax)
+        y = run(params["blocks"], x.reshape(M, B // M, t, d))
+        return y.reshape(B, t, d)
+
+    def apply(self, params, tokens, rng=None, return_aux=False):
         """tokens (B, T) int32 → logits (B, T, V). ``rng`` enables dropout
-        (training mode); None = inference."""
+        (training mode); None = inference. ``return_aux``: also return the
+        dict of auxiliary losses/stats (MoE load-balancing)."""
         c = self.config
         t = tokens.shape[1]
         if c.dtype != jnp.float32:
@@ -245,53 +366,90 @@ class TransformerLM:
         x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
         x = self._dropout(x.astype(c.dtype), rng, 0)
         x = self._constrain(x)
-        def block(blk, x, li):
-            a = self._attn(blk["attn"], self._ln(blk["ln1"], x), self.mesh)
-            x = x + self._dropout(a, rng, 2 * li + 1)
-            x = self._constrain(x)
-            hdn = self._ln(blk["ln2"], x) @ blk["mlp"]["w_up"] + blk["mlp"]["b_up"]
-            hdn = jax.nn.gelu(hdn)
-            m = hdn @ blk["mlp"]["w_down"] + blk["mlp"]["b_down"]
-            x = x + self._dropout(m, rng, 2 * li + 2)
-            return self._constrain(x)
+        aux_total = jnp.zeros((), jnp.float32)
 
-        if c.scan_layers:
+        if (c.pipeline_stages > 1 and self.mesh is not None
+                and STAGE_AXIS in self.mesh.axis_names):
+            x = self._apply_pipelined(params, x, rng)
+        elif c.scan_layers:
             def scan_body(carry, blk_li):
-                x, = carry
+                x, aux = carry
                 blk, li = blk_li
-                body = (lambda b, x_: block(b, x_, li))
+                body = (lambda b, x_: self._block_math(
+                    b, x_, rng, li, self.mesh))
                 if c.remat:
                     body = jax.checkpoint(body)
-                return (body(blk, x),), None
+                x, a = body(blk, x)
+                return (x, aux + a), None
 
             li_idx = jnp.arange(c.n_layers)
-            (x,), _ = lax.scan(scan_body, (x,),
-                               (params["blocks"], li_idx))
+            (x, aux_total), _ = lax.scan(scan_body, (x, aux_total),
+                                         (params["blocks"], li_idx))
         else:
+            blocks = params["blocks"]
+            if c.pipeline_stages > 1:
+                # stage-stacked params but no stage mesh (single-device
+                # eval/inference of a pipeline-trained model): unstack and
+                # run the stack sequentially — same math, no pipeline
+                S = c.pipeline_stages
+                lps = c.n_layers // S
+                blocks = [jax.tree.map(lambda a, s=s, i=i: a[s][i],
+                                       params["blocks"])
+                          for s in range(S) for i in range(lps)]
             if c.remat:
                 # recompute each block's activations in backward instead
                 # of saving them: O(L·T·d) residuals shrink to O(T·d)
-                block = jax.checkpoint(block, static_argnums=(2,))
-            for li, blk in enumerate(params["blocks"]):
-                x = block(blk, x, li)
+                body = jax.checkpoint(
+                    lambda b, x_, li: self._block_math(
+                        b, x_, rng, li, self.mesh),
+                    static_argnums=(2,))
+                for li, blk in enumerate(blocks):
+                    x, a = body(blk, x, li)
+                    aux_total = aux_total + a
+            else:
+                for li, blk in enumerate(blocks):
+                    x, a = self._block_math(blk, x, rng, li, self.mesh)
+                    aux_total = aux_total + a
         x = self._ln(params["ln_f"], x)
-        return jnp.matmul(x, params["tok_emb"].T,
-                          preferred_element_type=jnp.float32)
+        logits = jnp.matmul(x, params["tok_emb"].T,
+                            preferred_element_type=jnp.float32)
+        if return_aux:
+            return logits, {"moe_aux_loss": aux_total}
+        return logits
 
     # ------------------------------------------------------------------- loss
-    def loss_fn(self, params, tokens, targets, rng=None):
-        logits = self.apply(params, tokens, rng=rng)
+    def loss_fn(self, params, tokens, targets, rng=None, with_aux=False):
+        logits, aux = self.apply(params, tokens, rng=rng, return_aux=True)
         # fused cross-entropy: logsumexp − correct-logit avoids materializing
         # the full (B, T, V) log-softmax in forward AND backward — ~35%
         # step-time win at V=8192 (HBM-traffic bound, the usual TPU limiter)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         correct = jnp.take_along_axis(logits, targets[..., None],
                                       axis=-1)[..., 0]
-        return jnp.mean(lse - correct)
+        lm_loss = jnp.mean(lse - correct)
+        loss = lm_loss
+        if self.config.moe is not None:
+            loss = loss + self.config.moe_aux_weight * aux["moe_aux_loss"]
+        if with_aux:
+            return loss, {"lm_loss": lm_loss, **aux}
+        return loss
 
-    def make_train_step(self, optimizer):
+    def make_train_step(self, optimizer, return_metrics: bool = False):
         """One whole-graph jitted step (fwd+bwd+allreduce+update). Pass
-        ``rng`` to enable dropout."""
+        ``rng`` to enable dropout. With ``return_metrics`` the step returns
+        (params, opt_state, metrics-dict) where metrics carries the LM loss
+        and the MoE aux loss separately (the training-history surface)."""
+        if return_metrics:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step_m(params, opt_state, tokens, targets, rng=None):
+                (loss, aux), grads = jax.value_and_grad(
+                    self.loss_fn, has_aux=True)(
+                    params, tokens, targets, rng, with_aux=True)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, {"loss": loss, **aux}
+            return step_m
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(params, opt_state, tokens, targets, rng=None):
             loss, grads = jax.value_and_grad(self.loss_fn)(

@@ -25,14 +25,23 @@ from deeplearning4j_tpu.parallel.mesh import EXPERT_AXIS, axis_size
 
 @dataclasses.dataclass
 class MoEConfig:
-    d_model: int
-    d_ff: int
-    num_experts: int
+    d_model: Optional[int] = None   # None: filled in from the host model's
+    d_ff: Optional[int] = None      # config (TransformerConfig.moe path)
+    num_experts: int = 8
     capacity_factor: float = 1.25
     router_noise: float = 0.0       # jitter for load-balancing exploration
 
 
+def _check_resolved(cfg: MoEConfig):
+    if not cfg.d_model or not cfg.d_ff:
+        raise ValueError(
+            "MoEConfig.d_model/d_ff are unset — pass them explicitly, or "
+            "hand the config to TransformerConfig(moe=...) which fills them "
+            "from the host model")
+
+
 def init_moe_params(cfg: MoEConfig, key, scale: float = 0.02):
+    _check_resolved(cfg)
     kg, k1, k2 = jax.random.split(key, 3)
     E, d, f = cfg.num_experts, cfg.d_model, cfg.d_ff
     return {
@@ -44,22 +53,26 @@ def init_moe_params(cfg: MoEConfig, key, scale: float = 0.02):
     }
 
 
+def moe_param_specs(expert_axis=None):
+    """PartitionSpec tree for the MoE param leaves — the single source of the
+    expert-sharding layout (router replicated, expert dim sharded)."""
+    e = expert_axis
+    return {"Wg": P(), "W1": P(e), "b1": P(e), "W2": P(e), "b2": P(e)}
+
+
 def moe_param_shardings(cfg: MoEConfig, mesh: Mesh):
     """Expert-dim sharding over the ``expert`` mesh axis (router replicated)."""
     e = EXPERT_AXIS if EXPERT_AXIS in mesh.axis_names else None
-    return {
-        "Wg": NamedSharding(mesh, P()),
-        "W1": NamedSharding(mesh, P(e)),
-        "b1": NamedSharding(mesh, P(e)),
-        "W2": NamedSharding(mesh, P(e)),
-        "b2": NamedSharding(mesh, P(e)),
-    }
+    return jax.tree.map(lambda sp: NamedSharding(mesh, sp),
+                        moe_param_specs(e),
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def moe_ffn(params, x, cfg: MoEConfig, mesh: Optional[Mesh] = None,
             rng=None):
     """Top-1 MoE FFN over (B, T, d). Returns (y, aux) where aux carries the
     Switch load-balancing loss and routing stats."""
+    _check_resolved(cfg)
     B, T, d = x.shape
     E = cfg.num_experts
     G = B * T

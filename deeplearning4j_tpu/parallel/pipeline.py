@@ -6,17 +6,34 @@ into S stages; each device along ``stage`` holds ONE stage's params
 (leading-axis sharded pytree). A ``shard_map`` program runs the classic
 GPipe schedule: at tick t, stage s processes micro-batch (t − s); between
 ticks activations hop one stage to the right via ``lax.ppermute`` over ICI.
-The whole schedule — M + S − 1 ticks — is one ``lax.fori_loop`` inside one
-jitted program, and it is DIFFERENTIABLE: jax reverse-mode through the
-ppermute ring gives the backward pipeline automatically (the hand-built
-1F1B machinery of torch-style PP collapses into autodiff).
+The whole schedule — one tick per micro-batch plus the (S−1)-tick bubble —
+is nested ``lax.fori_loop``s inside one jitted program, and it is
+DIFFERENTIABLE: jax reverse-mode through the ppermute ring gives the
+backward pipeline (and with it micro-batch gradient accumulation) for free —
+the hand-built 1F1B machinery of torch-style PP collapses into autodiff.
 
-Bubble fraction is the standard (S−1)/(M+S−1) — callers pick M >> S.
+Memory is O(M/S) micro-batches per device (M = micro-batch count), not the
+round-2 O(M)-replicated queue:
+
+- **input**: the queue is block-sharded over ``stage`` — stage s holds
+  micro-batches [s·Q, (s+1)·Q) where Q = M/S. Stage 0 consumes its resident
+  slab one micro-batch per tick; every Q ticks the slabs rotate one stage
+  down (s → s−1), so the block stage 0 needs next is always arriving.
+  Amortized rotation traffic: one micro-batch per tick — the same order as
+  the activation hop itself.
+- **output**: finished micro-batches ride a systolic channel DOWN the ring
+  (stage S−1 → 0, opposite to activations): every tick each stage forwards
+  its channel slot and the last stage inserts the micro-batch it just
+  finished; each stage copies out the passing micro-batches it owns
+  (block-layout home: stage s keeps finished [s·Q, (s+1)·Q)). The last
+  arrival lands exactly on the final tick — no extra ticks needed.
+
+Bubble fraction stays the standard (S−1)/(M+S−1) — callers pick M >> S.
 """
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,56 +58,92 @@ def shard_stage_params(stacked, mesh: Mesh):
     return jax.device_put(stacked, spec)
 
 
-def gpipe(stage_fn: Callable, mesh: Mesh, num_stages: int = None):
+def gpipe(stage_fn: Callable, mesh: Mesh, num_stages: Optional[int] = None,
+          batch_axis: Optional[str] = None):
     """Build a pipelined forward: ``fn(stacked_params, x_micro) -> y_micro``.
 
     ``stage_fn(stage_params, h) -> h`` is the per-stage computation (same
     activation shape in/out — transformer-block-stack shaped, which is what
     pipelining is for). ``x_micro``: (M, micro_batch, ...) micro-batches.
     Returns (M, micro_batch, ...) outputs after all S stages.
+
+    ``batch_axis``: optionally shard the micro-batch dim of activations over
+    a second mesh axis (PP × DP composition); params stay replicated over it.
+
+    ``stage_fn`` may also accept a third argument — the (traced) micro-batch
+    index — e.g. to derive per-micro-batch dropout keys.
     """
     S = num_stages or axis_size(mesh, STAGE_AXIS)
+    import inspect
+    takes_mb = len(inspect.signature(stage_fn).parameters) >= 3
 
-    def local(params_slice, x):          # runs per stage device
-        # params_slice: (1, ...) leading stage slice; x: (M, mb, ...) full
-        # micro-batch queue, replicated — stage 0 reads it, others ignore
+    def local(params_slice, x_slab):     # runs per stage device
+        # params_slice: (1, ...) leading stage slice; x_slab: (Q, mb, ...) —
+        # this stage's block of the micro-batch queue (NOT the full queue)
         p = jax.tree.map(lambda a: a[0], params_slice)
         stage_id = lax.axis_index(STAGE_AXIS)
-        M = x.shape[0]
-        n_ticks = M + S - 1
-        mb_shape = x.shape[1:]
-        out = jnp.zeros_like(x)
+        Q = x_slab.shape[0]
+        M = Q * S                        # padded micro-batch count
+        mb_shape = x_slab.shape[1:]
+        n_phases = S + int(np.ceil((S - 1) / Q))   # covers M + S - 1 ticks
+
+        down = [(i, (i - 1) % S) for i in range(S)]
+        up = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(t, carry):
-            h, out = carry
-            # stage 0 ingests micro-batch t (if any); others use the
-            # activation handed over from the left neighbour
-            feed = x[jnp.clip(t, 0, M - 1)]
+            slab, h, chan, out = carry
+            # stage 0 ingests micro-batch t from its resident slab; others
+            # use the activation handed over from the left neighbour
+            feed = lax.dynamic_index_in_dim(slab, jnp.mod(t, Q), 0,
+                                            keepdims=False)
             h_in = jnp.where(stage_id == 0, feed, h)
             mb_idx = t - stage_id                 # micro-batch at this stage
             active = (mb_idx >= 0) & (mb_idx < M)
-            h_out = stage_fn(p, h_in)
+            h_out = (stage_fn(p, h_in, jnp.clip(mb_idx, 0)) if takes_mb
+                     else stage_fn(p, h_in))
             h_out = jnp.where(active, h_out, h_in)
-            # the LAST stage's finished micro-batch lands in the output slot
-            out = lax.cond(
-                active & (stage_id == S - 1),
-                lambda o: o.at[jnp.clip(mb_idx, 0, M - 1)].set(h_out),
-                lambda o: o, out)
-            # hop right: stage s → s+1 (ring; the wraparound edge is ignored
-            # because stage 0 always re-ingests from x)
-            h_next = lax.ppermute(h_out, STAGE_AXIS,
-                                  [(i, (i + 1) % S) for i in range(S)])
-            return h_next, out
+            # ---- output channel: shift down, last stage inserts its result
+            chan = lax.ppermute(chan, STAGE_AXIS, down)
+            chan = jnp.where(stage_id == S - 1, h_out, chan)
+            # the micro-batch in this stage's channel slot right now
+            m = t - 2 * (S - 1) + stage_id
+            own = (m >= 0) & (m < M) & (m // Q == stage_id)
+            idx = jnp.mod(jnp.clip(m, 0), Q)
+            out = jnp.where(own, out.at[idx].set(chan), out)
+            # ---- activation hop right (the pipeline edge itself)
+            h = lax.ppermute(h_out, STAGE_AXIS, up)
+            return slab, h, chan, out
 
-        h0 = jnp.zeros(mb_shape, x.dtype)
-        _, out = lax.fori_loop(0, n_ticks, tick, (h0, out))
-        # only the last stage wrote outputs; psum broadcasts them to all
-        return lax.psum(out, STAGE_AXIS)
+        def phase(ph, carry):
+            def inner(i, c):
+                return tick(ph * Q + i, c)
+            slab, h, chan, out = lax.fori_loop(0, Q, inner, carry)
+            # stage 0 finished block ph; bring the next block down one stage
+            slab = lax.ppermute(slab, STAGE_AXIS, down)
+            return slab, h, chan, out
+
+        h0 = jnp.zeros(mb_shape, x_slab.dtype)
+        chan0 = jnp.zeros(mb_shape, x_slab.dtype)
+        out0 = jnp.zeros_like(x_slab)
+        _, _, _, out = lax.fori_loop(0, n_phases, phase,
+                                     (x_slab, h0, chan0, out0))
+        return out
 
     def run(stacked_params, x_micro):
-        specs = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
-        f = shard_map(local, mesh=mesh, in_specs=(specs, P()),
-                      out_specs=P(), check_vma=False)
-        return f(stacked_params, x_micro)
+        M = x_micro.shape[0]
+        Q = -(-M // S)                   # ceil: pad the queue to S·Q
+        pad = S * Q - M
+        if pad:
+            x_micro = jnp.concatenate(
+                [x_micro, jnp.zeros((pad,) + x_micro.shape[1:],
+                                    x_micro.dtype)], axis=0)
+        pspecs = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
+        act_spec = P(*([STAGE_AXIS, batch_axis]
+                       + [None] * (x_micro.ndim - 2))) \
+            if batch_axis else P(STAGE_AXIS)
+        f = shard_map(local, mesh=mesh, in_specs=(pspecs, act_spec),
+                      out_specs=act_spec, check_vma=False)
+        out = f(stacked_params, x_micro)
+        return out[:M] if pad else out
 
     return run

