@@ -120,8 +120,16 @@ class ModelSerializer:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         with zipfile.ZipFile(path, "r") as zf:
-            conf = MultiLayerConfiguration.from_json(zf.read("configuration.json").decode())
-            return ModelSerializer._restore_into(MultiLayerNetwork(conf), zf, load_updater)
+            # "coefficients.bin" = an actual reference-written DL4J artifact
+            # (Jackson JSON + Nd4j.write binary) → the compat reader
+            is_dl4j_artifact = "coefficients.bin" in zf.namelist()
+            if not is_dl4j_artifact:
+                conf = MultiLayerConfiguration.from_json(
+                    zf.read("configuration.json").decode())
+                return ModelSerializer._restore_into(
+                    MultiLayerNetwork(conf), zf, load_updater)
+        from deeplearning4j_tpu.modelimport import dl4j_zip
+        return dl4j_zip.restore_multi_layer_network(path)
 
     restoreMultiLayerNetwork = restore_multi_layer_network
 
