@@ -34,10 +34,17 @@ class ShardedTrainer:
 
     def __init__(self, net, mesh_spec: Optional[MeshSpec] = None, devices=None,
                  tensor_parallel: bool = False,
-                 shard_optimizer_state: bool = False):
+                 shard_optimizer_state: bool = False,
+                 preemption_handler=None, checkpoint_dir: Optional[str] = None):
         self.net = net
         self.mesh = (mesh_spec or MeshSpec.data_parallel()).build(devices)
         self.tensor_parallel = tensor_parallel
+        # preemption safety (SURVEY §5.3): when a handler is given (or one is
+        # installed process-wide), fit() checks the latch at every batch
+        # boundary, writes a final checkpoint into ``checkpoint_dir`` and
+        # raises TrainingPreempted — the pod-reclaim path, first-class
+        self.preemption_handler = preemption_handler
+        self.checkpoint_dir = checkpoint_dir
         # ZeRO-style cross-replica weight-update sharding (Xu et al. 2020,
         # arXiv:2004.13336 — the XLA weight-update-sharding recipe): optimizer
         # moments shard over the data axis while params stay replicated; XLA
@@ -124,6 +131,34 @@ class ShardedTrainer:
         return jax.device_put(x, NamedSharding(self.mesh, spec))
 
     # ------------------------------------------------------------------ train
+    def _active_preemption_handler(self):
+        if self.preemption_handler is not None:
+            return self.preemption_handler
+        from deeplearning4j_tpu.utils.preemption import PreemptionHandler
+        return PreemptionHandler._installed
+
+    def _check_preemption(self):
+        """Batch-boundary preemption latch check: checkpoint + unwind.
+        Runs between jitted steps so no donated buffer is mid-flight."""
+        handler = self._active_preemption_handler()
+        if handler is None or not handler.preempted:
+            return
+        from deeplearning4j_tpu.utils.preemption import (
+            PreemptionSafeListener, TrainingPreempted)
+        path = None
+        if self.checkpoint_dir is not None:
+            import os
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            # same filename contract as PreemptionSafeListener so
+            # resume_or_new discovers trainer-written checkpoints too
+            path = os.path.join(
+                self.checkpoint_dir,
+                PreemptionSafeListener.FINAL_NAME.format(
+                    model=type(self.net).__name__))
+            self.net.save(path)
+        raise TrainingPreempted(path or "<no checkpoint_dir configured>",
+                                self.net._iteration)
+
     def fit(self, data, labels=None, epochs: int = 1):
         """Same surface as the wrapped net's fit; batches are sharded over the
         ``data`` axis before entering the jitted step."""
@@ -132,11 +167,13 @@ class ShardedTrainer:
         net = self.net
         if labels is not None:
             self._fit_batch(data, labels)
+            self._check_preemption()
             return self
         if hasattr(data, "features"):
             self._fit_batch(data.features, data.labels,
                             self._ds_mask(data, "features"),
                             self._ds_mask(data, "labels"))
+            self._check_preemption()
             return self
         for _ in range(epochs):
             for lst in net._listeners:
@@ -147,6 +184,7 @@ class ShardedTrainer:
                 self._fit_batch(ds.features, ds.labels,
                                 self._ds_mask(ds, "features"),
                                 self._ds_mask(ds, "labels"))
+                self._check_preemption()
             for lst in net._listeners:
                 lst.on_epoch_end(net, net._epoch)
             net._epoch += 1

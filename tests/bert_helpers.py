@@ -1,0 +1,41 @@
+"""Shared helpers for the BERT TF-import tests (mini + full-size): the
+constant-promotion heuristic and the classifier-head attach live in ONE
+place so the two scales cannot drift."""
+import numpy as np
+
+
+def promote_weight_constants(sd, min_size: int) -> int:
+    """Promote every float constant bigger than ``min_size`` elements to a
+    trainable variable (the imported BERT encoder weights). Returns count."""
+    n = 0
+    for name, var in list(sd._vars.items()):
+        if (var.var_type.value == "CONSTANT" and var.shape
+                and np.issubdtype(np.dtype(var.dtype or np.float32),
+                                  np.floating)
+                and int(np.prod(var.shape)) > min_size):
+            var.convert_to_variable()
+            n += 1
+    return n
+
+
+def attach_classifier_head(sd, gd, hidden_size: int, n_classes: int = 2,
+                           lr: float = 5e-3):
+    """[CLS]-position linear head + softmax-CE loss + TrainingConfig
+    (the fine-tune half of BASELINE config[3])."""
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    out_name = [n.name for n in gd.node if n.op == "Identity"][-1]
+    hidden = sd._vars[out_name]                      # (B, T, H)
+    cls = hidden[:, 0]                               # [CLS] position → (B, H)
+    w = sd.var("head_w", init=np.zeros((hidden_size, n_classes), np.float32))
+    b = sd.var("head_b", init=np.zeros((n_classes,), np.float32))
+    logits = cls.mmul(w) + b
+    lab = sd.placeholder("label", (None, n_classes))
+    sd.loss.softmax_cross_entropy(lab, logits).rename("loss")
+    sd.set_training_config(TrainingConfig(
+        updater=Adam(lr),
+        data_set_feature_mapping=["input_ids", "attention_mask"],
+        data_set_label_mapping=["label"],
+        loss_variables=["loss"]))
+    return sd

@@ -1,0 +1,87 @@
+"""Worker for the elastic/fault-injection multihost test (VERDICT r2 #7).
+
+Each process joins a jax.distributed 2-process mesh, trains a deterministic
+schedule through ``ShardedTrainer``, and checkpoints (step, flat params,
+updater state) after EVERY step into a shared directory. ``--die-at K``
+makes process 1 SIGKILL itself mid-run after step K's checkpoint — the
+fault-injection arm. A relaunch with the same checkpoint dir resumes from
+the newest complete checkpoint and finishes the schedule; because the data
+schedule is keyed by step index, an interrupted-then-resumed run must land
+on EXACTLY the same params as an uninterrupted one.
+
+Ref: SURVEY §5.3 — the reference's only fault tolerance is Spark task retry
+plus checkpoint/restart; this exercises the checkpoint/restart contract
+across a real process boundary with a hard kill (no graceful signal).
+"""
+import os
+import signal
+import sys
+
+import numpy as np
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = sys.argv[3]
+    ckpt_dir = sys.argv[4]
+    out_path = sys.argv[5]
+    total_steps = int(sys.argv[6])
+    die_at = int(sys.argv[7]) if len(sys.argv) > 7 else -1
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from deeplearning4j_tpu.parallel.master import DistributedConfig
+
+    DistributedConfig(coordinator_address=f"127.0.0.1:{port}",
+                      num_processes=nprocs, process_id=proc_id).initialize()
+
+    from deeplearning4j_tpu.parallel import MeshSpec
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+    from tests.multihost_worker import build_net, global_data
+
+    net = build_net()
+    trainer = ShardedTrainer(net, MeshSpec.data_parallel())
+
+    # ---- resume: newest complete checkpoint in the shared dir ----
+    def ckpt_path(step):
+        return os.path.join(ckpt_dir, f"step_{step:04d}.zip")
+
+    start = 0
+    done = sorted(int(n[5:9]) for n in os.listdir(ckpt_dir)
+                  if n.startswith("step_") and n.endswith(".zip"))
+    if done:
+        start = done[-1] + 1
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork.load(ckpt_path(done[-1]), load_updater=True)
+        trainer = ShardedTrainer(net, MeshSpec.data_parallel())
+        print(f"proc{proc_id}: resumed from step {done[-1]}")
+
+    half = 16 // nprocs
+    for step in range(start, total_steps):
+        x, y = global_data(step)
+        lo, hi = proc_id * half, (proc_id + 1) * half
+        trainer.fit(x[lo:hi], y[lo:hi])
+        if proc_id == 0:
+            # rank-0 persists (replicated params are identical on all ranks);
+            # write-then-rename so a kill never leaves a torn zip behind
+            tmp = ckpt_path(step) + ".tmp"
+            net.save(tmp)
+            os.replace(tmp, ckpt_path(step))
+        if step == die_at and proc_id == 1:
+            print(f"proc1: SIGKILL at step {step}", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    if proc_id == 0:
+        np.save(out_path, np.asarray(net.params().buf()))
+    print(f"proc{proc_id} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

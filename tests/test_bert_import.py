@@ -71,32 +71,12 @@ def test_bert_fine_tunes_through_sd_fit(bert_frozen):
     _, gd = bert_frozen
     sd = TFGraphMapper.import_graph(gd)
 
-    # promote every float weight constant to trainable (BERT encoder params)
-    n_promoted = 0
-    for name, var in list(sd._vars.items()):
-        if (var.var_type.value == "CONSTANT" and var.shape
-                and np.issubdtype(np.dtype(var.dtype or np.float32),
-                                  np.floating)
-                and int(np.prod(var.shape)) > 32):
-            var.var_type = type(var.var_type).VARIABLE
-            n_promoted += 1
+    from tests.bert_helpers import (attach_classifier_head,
+                                    promote_weight_constants)
+
+    n_promoted = promote_weight_constants(sd, min_size=32)
     assert n_promoted > 10           # embeddings + per-layer qkv/ffn/ln
-
-    # classification head over the [CLS]-position hidden state
-    out_name = [n.name for n in gd.node if n.op == "Identity"][-1]
-    hidden = sd._vars[out_name]                      # (B, T, H)
-    cls = hidden[:, 0]                               # [CLS] position → (B, H)
-    w = sd.var("head_w", init=np.zeros((32, 2), np.float32))
-    b = sd.var("head_b", init=np.zeros((2,), np.float32))
-    logits = cls.mmul(w) + b
-    lab = sd.placeholder("label", (None, 2))
-    sd.loss.softmax_cross_entropy(lab, logits).rename("loss")
-
-    sd.set_training_config(TrainingConfig(
-        updater=Adam(5e-3),
-        data_set_feature_mapping=["input_ids", "attention_mask"],
-        data_set_label_mapping=["label"],
-        loss_variables=["loss"]))
+    attach_classifier_head(sd, gd, hidden_size=32, lr=5e-3)
 
     # batch matches the frozen graph (freezing bakes batch-shaped constants
     # like the extended-attention-mask Fill dims — reference BERT fine-tune

@@ -37,15 +37,18 @@ def _clean_env():
     return env
 
 
-def test_two_process_training_matches_single_process(tmp_path):
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_n_process_training_matches_single_process(tmp_path, nprocs):
+    """nprocs x 2 virtual devices = one DCN mesh; parity vs a single process
+    with the same global device count (VERDICT r2 #7: 2- AND 4-process)."""
     port = _free_port()
-    out2 = str(tmp_path / "params_2proc.npy")
+    out_n = str(tmp_path / f"params_{nprocs}proc.npy")
     env = _clean_env()
 
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(i), "2", str(port), out2],
+        [sys.executable, WORKER, str(i), str(nprocs), str(port), out_n],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
-        for i in range(2)]
+        for i in range(nprocs)]
     outs = []
     for p in procs:
         try:
@@ -53,20 +56,22 @@ def test_two_process_training_matches_single_process(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("multihost worker timed out")
+            pytest.fail(f"{nprocs}-process multihost worker timed out")
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
 
-    # single-process reference on 4 virtual devices, same global batches
+    # single-process reference on the same global device count + batches
+    ndev = 2 * nprocs
+    ref_out = str(tmp_path / "params_1proc.npy")
     single = subprocess.run(
         [sys.executable, "-c", f"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={ndev}"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+jax.config.update("jax_num_cpu_devices", {ndev})
 import numpy as np
 import sys
 sys.path.insert(0, {REPO!r})
@@ -79,11 +84,80 @@ tr = ShardedTrainer(net, MeshSpec.data_parallel())
 for step in range(5):
     x, y = global_data(step)
     tr.fit(x, y)
-np.save({str(tmp_path / 'params_1proc.npy')!r}, np.asarray(net.params().buf()))
+np.save({ref_out!r}, np.asarray(net.params().buf()))
 """],
         capture_output=True, text=True, env=env, timeout=420)
     assert single.returncode == 0, single.stderr[-4000:]
 
-    p2 = np.load(out2)
-    p1 = np.load(str(tmp_path / "params_1proc.npy"))
-    np.testing.assert_allclose(p2, p1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.load(out_n), np.load(ref_out),
+                               rtol=1e-5, atol=1e-6)
+
+
+ELASTIC = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _run_elastic(nsteps, port, ckpt_dir, out, die_at=-1, timeout=420,
+                 expect_kill=False):
+    env = _clean_env()
+    procs = [subprocess.Popen(
+        [sys.executable, ELASTIC, str(i), "2", str(port), ckpt_dir, out,
+         str(nsteps), str(die_at)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for i in range(2)]
+    try:
+        if not expect_kill:
+            outs = []
+            for p in procs:
+                try:
+                    o, _ = p.communicate(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    pytest.fail("elastic worker timed out")
+                outs.append(o)
+            for i, (p, o) in enumerate(zip(procs, outs)):
+                assert p.returncode == 0, f"elastic worker {i}:\n{o[-4000:]}"
+            return outs
+        # fault arm: worker 1 SIGKILLs itself; worker 0 then hangs in the
+        # next collective and is reaped below (the Spark-analog "job fails,
+        # restart from checkpoint" path)
+        try:
+            o1, _ = procs[1].communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            pytest.fail("fault-arm worker 1 neither died nor finished")
+        assert procs[1].returncode == -9, \
+            f"worker1 expected SIGKILL, rc={procs[1].returncode}:\n{o1[-2000:]}"
+        return None
+    finally:
+        # never leak a worker blocked in a cross-process collective
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+
+
+def test_sigkill_mid_run_then_resume_matches_uninterrupted(tmp_path):
+    """Fault injection: SIGKILL one worker mid-run, restart BOTH ranks from
+    the newest checkpoint, finish — final params must equal an
+    uninterrupted run's (deterministic step-keyed data schedule)."""
+    nsteps = 6
+
+    # uninterrupted reference run
+    ref_dir = str(tmp_path / "ckpt_ref")
+    os.makedirs(ref_dir)
+    ref_out = str(tmp_path / "ref.npy")
+    _run_elastic(nsteps, _free_port(), ref_dir, ref_out)
+
+    # fault run: worker1 dies after step 2's checkpoint
+    dir2 = str(tmp_path / "ckpt_fault")
+    os.makedirs(dir2)
+    out2 = str(tmp_path / "fault.npy")
+    _run_elastic(nsteps, _free_port(), dir2, out2, die_at=2,
+                 expect_kill=True)
+    ckpts = [n for n in os.listdir(dir2) if n.endswith(".zip")]
+    assert ckpts, "no checkpoint survived the kill"
+    assert not os.path.exists(out2), "fault run must not have finished"
+
+    # restart both ranks on a fresh coordinator; resume from checkpoint
+    _run_elastic(nsteps, _free_port(), dir2, out2)
+
+    np.testing.assert_allclose(np.load(out2), np.load(ref_out),
+                               rtol=1e-5, atol=1e-6)
