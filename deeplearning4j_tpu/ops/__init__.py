@@ -3,6 +3,8 @@ from deeplearning4j_tpu.ops import registry
 from deeplearning4j_tpu.ops import standard  # noqa: F401 — populates registry
 from deeplearning4j_tpu.ops import extended  # noqa: F401 — long-tail ops
 from deeplearning4j_tpu.ops import longtail  # noqa: F401 — tranche 3
+from deeplearning4j_tpu.ops import tranche4  # noqa: F401 — tranche 4
 from deeplearning4j_tpu.ops import transforms
 
-__all__ = ["registry", "standard", "extended", "longtail", "transforms"]
+__all__ = ["registry", "standard", "extended", "longtail", "tranche4",
+           "transforms"]

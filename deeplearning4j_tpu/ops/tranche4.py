@@ -1,0 +1,523 @@
+"""Declarable-op registry, tranche 4 — closing the named tail to ≥470 ops
+(VERDICT r2 #6). Groups (ref: libnd4j ``ops/declarable/headers/*.h``):
+
+- morphology completion (``erosion2d`` pairs the existing ``dilation2d``)
+- quantization/compression (``quantize``/``dequantize``/``bucketize``,
+  ``encode_bitmap``/``decode_bitmap``)
+- the updater-op family (``headers/updaters.h`` — 9 ops)
+- explicit backward ("_bp") declarable ops for conv/pool/norm/bias — in the
+  reference these are hand-written kernels; here each is jax.vjp over the
+  registered forward (same contract, autodiff body), crosschecked vs
+  jax.grad in tests
+- legacy derivative transforms (``*_derivative`` — elementwise grads)
+- index-reduce family (``first_index``/``last_index``/``iamax``/``iamin``,
+  ``match_condition``)
+- Barnes-Hut t-SNE helper ops (``headers/datatypes.h``/tsne group)
+- stragglers: ``select``, ``check_numerics``, ``zeros_as``/``ones_as``,
+  ``random_multinomial``, ``eig``, ``broadcast_dynamic_shape``,
+  ``broadcastgradientargs``, ``knn_mindistance``, ``hashcode``, ``Assert``
+
+Conventions: arrays traced, attrs static, NHWC (as standard.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import _REGISTRY, exec_op, register
+
+# ------------------------------------------------------------ named aliases
+# reference spelling variants of already-registered ops
+_REGISTRY["max_pool_with_argmax"] = _REGISTRY["maxpool_with_argmax"]
+_REGISTRY["softmax_cross_entropy_loss"] = _REGISTRY["softmax_cross_entropy"]
+_REGISTRY["sigmoid_cross_entropy_loss"] = _REGISTRY["sigmoid_cross_entropy"]
+_REGISTRY["batch_matmul"] = _REGISTRY["batched_gemm"]
+
+
+# --------------------------------------------------------------- morphology
+@register("erosion2d", aliases=["Erosion2D"])
+def erosion2d(x, w, strides=(1, 1), rates=(1, 1), padding="SAME"):
+    """Morphological erosion: min over window of (x − w) — the dual of
+    dilation2d (ref: parity_ops erosion2d; TF kernel semantics:
+    erosion2d(x, k) = −dilation2d(−x, reverse(k)))."""
+    wr = jnp.flip(w, axis=(0, 1))
+    return -exec_op("dilation2d", -x, wr, strides=strides, rates=rates,
+                    padding=padding)
+
+
+# ------------------------------------------------------------- quantization
+@register("quantize", aliases=["Quantize", "quantize_v2"])
+def quantize(x, min_range, max_range, num_bits=8, narrow_range=False):
+    """Uniform affine quantize to ints (ref: quantization group /
+    TF QuantizeV2 MIN_COMBINED). Returns int32 codes."""
+    lo = jnp.asarray(min_range, jnp.float32)
+    hi = jnp.asarray(max_range, jnp.float32)
+    qmin = 1 if narrow_range else 0
+    qmax = (1 << int(num_bits)) - 1
+    scale = (hi - lo) / (qmax - qmin)
+    q = jnp.round((x.astype(jnp.float32) - lo) / scale) + qmin
+    return jnp.clip(q, qmin, qmax).astype(jnp.int32)
+
+
+@register("dequantize", aliases=["Dequantize"])
+def dequantize(q, min_range, max_range, num_bits=8, narrow_range=False):
+    lo = jnp.asarray(min_range, jnp.float32)
+    hi = jnp.asarray(max_range, jnp.float32)
+    qmin = 1 if narrow_range else 0
+    qmax = (1 << int(num_bits)) - 1
+    scale = (hi - lo) / (qmax - qmin)
+    return (q.astype(jnp.float32) - qmin) * scale + lo
+
+
+@register("bucketize", aliases=["Bucketize"])
+def bucketize(x, boundaries):
+    """Index of the bucket each value falls into (ref: parity_ops bucketize;
+    TF Bucketize — boundaries sorted ascending, output in [0, len])."""
+    b = jnp.asarray(boundaries, jnp.float32).reshape(-1)
+    return jnp.searchsorted(b, x.astype(jnp.float32), side="right") \
+        .astype(jnp.int32)
+
+
+@register("encode_bitmap", num_outputs=2, aliases=["EncodeBitmap"])
+def encode_bitmap(x, threshold=1e-3):
+    """Sign-flag codec (ref: compression encode_bitmap — the Strom-2015
+    sibling of threshold encoding). TPU-native formulation: a dense int8
+    flag tensor {-1, 0, +1} instead of the reference's packed 2-bit words
+    (bit packing is a CPU-memory trick; dense flags vectorize on the VPU).
+    Returns (flags, residual)."""
+    t = jnp.asarray(threshold, x.dtype)
+    flags = (jnp.where(x >= t, 1, 0)
+             + jnp.where(x <= -t, -1, 0)).astype(jnp.int8)
+    residual = x - flags.astype(x.dtype) * t
+    return flags, residual
+
+
+@register("decode_bitmap", aliases=["DecodeBitmap"])
+def decode_bitmap(flags, threshold=1e-3, dtype=jnp.float32):
+    return flags.astype(dtype) * jnp.asarray(threshold, dtype)
+
+
+# ------------------------------------------------------------- updater ops
+# ref: ops/declarable/headers/updaters.h — each op maps (gradient, state…)
+# → (update, new state…); the Java updaters (J9) call these natively
+@register("sgd_updater")
+def sgd_updater(grad, lr=0.01):
+    return grad * lr
+
+
+@register("nesterovs_updater", num_outputs=2)
+def nesterovs_updater(grad, v, lr=0.01, momentum=0.9):
+    v_new = momentum * v - lr * grad
+    update = -(momentum * v_new - lr * grad)
+    return update, v_new
+
+
+@register("adam_updater", num_outputs=3)
+def adam_updater(grad, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+                 iteration=0):
+    t = iteration + 1
+    m_new = beta1 * m + (1 - beta1) * grad
+    v_new = beta2 * v + (1 - beta2) * grad * grad
+    m_hat = m_new / (1 - beta1 ** t)
+    v_hat = v_new / (1 - beta2 ** t)
+    return lr * m_hat / (jnp.sqrt(v_hat) + eps), m_new, v_new
+
+
+@register("ada_max_updater", num_outputs=3)
+def ada_max_updater(grad, m, u, lr=2e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+                    iteration=0):
+    t = iteration + 1
+    m_new = beta1 * m + (1 - beta1) * grad
+    u_new = jnp.maximum(beta2 * u, jnp.abs(grad))
+    return lr * m_new / ((1 - beta1 ** t) * (u_new + eps)), m_new, u_new
+
+
+@register("nadam_updater", num_outputs=3)
+def nadam_updater(grad, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+                  iteration=0):
+    t = iteration + 1
+    m_new = beta1 * m + (1 - beta1) * grad
+    v_new = beta2 * v + (1 - beta2) * grad * grad
+    m_hat = m_new / (1 - beta1 ** t)
+    v_hat = v_new / (1 - beta2 ** t)
+    nud = beta1 * m_hat + (1 - beta1) * grad / (1 - beta1 ** t)
+    return lr * nud / (jnp.sqrt(v_hat) + eps), m_new, v_new
+
+
+@register("ams_grad_updater", num_outputs=4)
+def ams_grad_updater(grad, m, v, vhat, lr=1e-3, beta1=0.9, beta2=0.999,
+                     eps=1e-8, iteration=0):
+    t = iteration + 1
+    m_new = beta1 * m + (1 - beta1) * grad
+    v_new = beta2 * v + (1 - beta2) * grad * grad
+    vhat_new = jnp.maximum(vhat, v_new)
+    m_c = m_new / (1 - beta1 ** t)
+    v_c = vhat_new / (1 - beta2 ** t)
+    return lr * m_c / (jnp.sqrt(v_c) + eps), m_new, v_new, vhat_new
+
+
+@register("ada_grad_updater", num_outputs=2)
+def ada_grad_updater(grad, h, lr=0.01, eps=1e-8):
+    h_new = h + grad * grad
+    return lr * grad / (jnp.sqrt(h_new) + eps), h_new
+
+
+@register("ada_delta_updater", num_outputs=3)
+def ada_delta_updater(grad, msg, msdx, rho=0.95, eps=1e-6):
+    msg_new = rho * msg + (1 - rho) * grad * grad
+    update = grad * jnp.sqrt(msdx + eps) / jnp.sqrt(msg_new + eps)
+    msdx_new = rho * msdx + (1 - rho) * update * update
+    return update, msg_new, msdx_new
+
+
+@register("rms_prop_updater", num_outputs=2)
+def rms_prop_updater(grad, g2, lr=1e-3, decay=0.95, eps=1e-8):
+    g2_new = decay * g2 + (1 - decay) * grad * grad
+    return lr * grad / (jnp.sqrt(g2_new) + eps), g2_new
+
+
+# ----------------------------------------------------------- backward (_bp)
+# the reference registers explicit *_bp declarable ops with hand-written
+# kernels; here each is the vjp of the registered forward — same contract
+def _register_bp(name, fwd_name, n_in, **fixed):
+    def bp(*args, **attrs):
+        xs, g = args[:n_in], args[n_in]
+        f = lambda *inner: exec_op(fwd_name, *inner, **{**fixed, **attrs})
+        _, vjp = jax.vjp(f, *xs)
+        grads = vjp(g.astype(jnp.result_type(xs[0])))
+        return grads if len(grads) > 1 else grads[0]
+    bp.__name__ = name
+    bp.__doc__ = (f"Backward of {fwd_name} (ref: declarable {name} — "
+                  "hand-written kernel upstream; jax.vjp body here). "
+                  f"Args: {n_in} forward inputs + upstream gradient.")
+    register(name, bp, num_outputs=n_in)
+    return bp
+
+
+_register_bp("conv1d_bp", "conv1d", 2)
+_register_bp("conv2d_bp", "conv2d", 2)
+_register_bp("conv3d_bp", "conv3d", 2)
+_register_bp("deconv2d_bp", "deconv2d", 2)
+_register_bp("depthwise_conv2d_bp", "depthwise_conv2d", 2)
+_register_bp("maxpool2d_bp", "maxpool2d", 1)
+_register_bp("avgpool2d_bp", "avgpool2d", 1)
+_register_bp("maxpool3d_bp", "maxpool3d", 1)
+_register_bp("avgpool3d_bp", "avgpool3d", 1)
+_register_bp("pnormpool2d_bp", "pnormpool2d", 1)
+_register_bp("upsampling2d_bp", "upsampling2d", 1)
+_register_bp("upsampling3d_bp", "upsampling3d", 1)
+_register_bp("lrn_bp", "lrn", 1)
+_register_bp("layer_norm_bp", "layer_norm", 3)
+_register_bp("im2col_bp", "im2col", 1)
+
+
+@register("biasadd_bp", num_outputs=2, aliases=["BiasAddGrad"])
+def biasadd_bp(x, bias, grad):
+    """Backward of bias_add: (dx, db) (ref: broadcastable biasadd_bp)."""
+    return grad, jnp.sum(grad, axis=tuple(range(grad.ndim - 1)))
+
+
+@register("batchnorm_bp", num_outputs=3)
+def batchnorm_bp(x, mean, var, gamma, beta, grad, epsilon=1e-5):
+    """Backward of batchnorm wrt (x, gamma, beta) given fixed statistics
+    (ref: declarable batchnorm_bp)."""
+    f = lambda x_, g_, b_: exec_op("batchnorm", x_, mean, var, g_, b_,
+                                   epsilon=epsilon)
+    _, vjp = jax.vjp(f, x, gamma, beta)
+    return vjp(grad.astype(x.dtype))
+
+
+@register("dropout_bp")
+def dropout_bp(mask, grad, p=0.5):
+    """Backward of dropout given the forward's keep mask."""
+    return grad * mask / jnp.asarray(p, grad.dtype)
+
+
+# -------------------------------------------------- legacy derivative ops
+# ref: the legacy TransformStrict derivative family (SigmoidDerivative etc.)
+# — sigmoid_derivative/tanh_derivative precedents already registered
+def _register_derivative(name, act_name):
+    def deriv(x):
+        f = lambda v: exec_op(act_name, v)
+        return jax.grad(lambda v: f(v).sum())(x)
+    deriv.__name__ = name
+    deriv.__doc__ = (f"d({act_name})/dx, elementwise (ref: legacy "
+                     f"{name} transform op).")
+    register(name, deriv)
+    return deriv
+
+
+for _act in ("cube", "elu", "selu", "softsign", "softplus", "hard_sigmoid",
+             "hard_tanh", "rationaltanh", "rectifiedtanh", "leakyrelu",
+             "relu", "relu6", "swish", "mish", "gelu"):
+    _register_derivative(_act.replace("hard_", "hard") + "_derivative", _act)
+
+
+# ------------------------------------------------------ index-reduce family
+def _cond_fn(condition, value):
+    ops = {"gt": jnp.greater, "gte": jnp.greater_equal, "lt": jnp.less,
+           "lte": jnp.less_equal, "eq": jnp.equal, "neq": jnp.not_equal,
+           "abs_gt": lambda a, v: jnp.abs(a) > v,
+           "abs_lt": lambda a, v: jnp.abs(a) < v}
+    return ops[condition]
+
+
+@register("first_index")
+def first_index(x, condition="gt", value=0.0):
+    """Index of the FIRST element matching (ref: indexreduce FirstIndex);
+    -1 when none match."""
+    mask = _cond_fn(condition, value)(x.reshape(-1), value)
+    idx = jnp.argmax(mask)
+    return jnp.where(jnp.any(mask), idx, -1).astype(jnp.int64)
+
+
+@register("last_index")
+def last_index(x, condition="gt", value=0.0):
+    flat = x.reshape(-1)
+    mask = _cond_fn(condition, value)(flat, value)
+    rev_idx = jnp.argmax(jnp.flip(mask))
+    idx = flat.shape[0] - 1 - rev_idx
+    return jnp.where(jnp.any(mask), idx, -1).astype(jnp.int64)
+
+
+@register("iamax", aliases=["IMax"])
+def iamax(x, axis=None):
+    """Index of max |value| (ref: legacy indexreduce IMax / BLAS iamax)."""
+    return jnp.argmax(jnp.abs(x), axis=axis).astype(jnp.int64)
+
+
+@register("iamin", aliases=["IMin"])
+def iamin(x, axis=None):
+    return jnp.argmin(jnp.abs(x), axis=axis).astype(jnp.int64)
+
+
+@register("match_condition", aliases=["MatchCondition"])
+def match_condition(x, condition="gt", value=0.0):
+    """COUNT of matching elements (ref: reduce MatchCondition)."""
+    return jnp.sum(_cond_fn(condition, value)(x, value)).astype(jnp.int64)
+
+
+@register("match_condition_transform", aliases=["MatchConditionTransform"])
+def match_condition_transform(x, condition="gt", value=0.0):
+    """Boolean mask of matching elements."""
+    return _cond_fn(condition, value)(x, value)
+
+
+# ------------------------------------------------------ Barnes-Hut t-SNE
+@register("barnes_gains")
+def barnes_gains(gains, gradient, y_incs):
+    """t-SNE adaptive per-dim gains (ref: datatypes barnes_gains): gain+0.2
+    where grad and velocity disagree in sign, gain·0.8 where they agree,
+    floored at 0.01."""
+    agree = jnp.sign(gradient) == jnp.sign(y_incs)
+    return jnp.maximum(jnp.where(agree, gains * 0.8, gains + 0.2), 0.01)
+
+
+@register("barnes_symmetrized")
+def barnes_symmetrized(rows, cols, vals, n):
+    """Symmetrize the sparse affinity matrix: P ← (P + Pᵀ)/2 (ref:
+    barnes_symmetrized over COO buffers). TPU-native formulation: dense
+    (N, N) scatter — the reference's sparse row-walk is a CPU-memory
+    optimization; XLA scatters vectorize and N is embedding-sized here."""
+    n = int(n)
+    P = jnp.zeros((n, n), vals.dtype).at[rows.reshape(-1),
+                                         cols.reshape(-1)].add(
+        vals.reshape(-1))
+    return (P + P.T) / 2.0
+
+
+@register("barnes_edge_forces")
+def barnes_edge_forces(rows, cols, vals, n, y):
+    """Attractive forces F_i = Σ_j p_ij (y_i − y_j)/(1+‖y_i−y_j‖²) (ref:
+    barnes_edge_forces). Dense formulation over the symmetrized P."""
+    P = jnp.zeros((int(n), int(n)), vals.dtype).at[
+        rows.reshape(-1), cols.reshape(-1)].set(vals.reshape(-1))
+    diff = y[:, None, :] - y[None, :, :]
+    w = 1.0 / (1.0 + jnp.sum(diff * diff, axis=-1))
+    return jnp.sum((P * w)[..., None] * diff, axis=1)
+
+
+@register("cell_contains")
+def cell_contains(corner, width, point):
+    """Does the quad-tree cell contain the point (ref: cell_contains)."""
+    c = corner.reshape(-1)
+    w = width.reshape(-1)
+    p = point.reshape(-1)
+    return jnp.all((p >= c - w) & (p <= c + w))
+
+
+# --------------------------------------------------------------- stragglers
+@register("select", aliases=["Select"])
+def select(cond, x, y):
+    """Ternary select (ref: parity_ops select / TF Select)."""
+    return jnp.where(cond.astype(bool), x, y)
+
+
+@register("check_numerics", aliases=["CheckNumerics"])
+def check_numerics(x, message="CheckNumerics failed"):
+    """Pass-through that errors on NaN/Inf (ref: parity_ops check_numerics).
+    Under jit uses checkify-style debug callback semantics via
+    jax.debug; eagerly raises."""
+    import jax.core
+    if isinstance(x, jax.core.Tracer):
+        from jax.experimental import checkify
+        checkify.check(jnp.all(jnp.isfinite(x)), message)
+        return x
+    if not bool(jnp.all(jnp.isfinite(x))):
+        raise FloatingPointError(message)
+    return x
+
+
+@register("is_numeric_tensor", aliases=["IsNumericTensor"])
+def is_numeric_tensor(x):
+    return jnp.asarray(jnp.issubdtype(x.dtype, jnp.number))
+
+
+@register("assert_op", aliases=["Assert"])
+def assert_op(cond, *data):
+    """ref: parity_ops Assert — eager check; no-op pass-through of cond."""
+    import jax.core
+    if not isinstance(cond, jax.core.Tracer) and not bool(jnp.all(cond)):
+        raise AssertionError(f"Assert failed: {[np.asarray(d) for d in data]}")
+    return cond
+
+
+@register("zeros_as", aliases=["zerosAs"])
+def zeros_as(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_as", aliases=["onesAs"])
+def ones_as(x):
+    return jnp.ones_like(x)
+
+
+@register("random_multinomial", aliases=["RandomMultinomial"])
+def random_multinomial(logits, num_samples=1, seed=None):
+    """Categorical sampling rows → (N, num_samples) int (ref: random ops
+    random_multinomial)."""
+    from deeplearning4j_tpu.ndarray import random as _rng
+    key = jax.random.key(seed) if seed is not None else _rng.next_key()
+    return jax.random.categorical(
+        key, logits, axis=-1,
+        shape=(int(num_samples),) + logits.shape[:-1]).T.astype(jnp.int64)
+
+
+@register("eig", num_outputs=2)
+def eig(x):
+    """General (non-symmetric) eigendecomposition (ref: helpers eig).
+    CPU-only lowering — like the reference's LAPACK-backed path; TPU callers
+    use self_adjoint_eig for symmetric matrices."""
+    w, v = jnp.linalg.eig(x)
+    return w, v
+
+
+@register("broadcast_dynamic_shape", aliases=["BroadcastDynamicShape"])
+def broadcast_dynamic_shape(s1, s2):
+    """Broadcasted result shape of two shape vectors (ref: parity_ops
+    broadcast_dynamic_shape)."""
+    a = tuple(int(v) for v in np.asarray(s1).reshape(-1))
+    b = tuple(int(v) for v in np.asarray(s2).reshape(-1))
+    return jnp.asarray(np.broadcast_shapes(a, b), jnp.int64)
+
+
+@register("broadcastgradientargs", num_outputs=2,
+          aliases=["BroadcastGradientArgs"])
+def broadcastgradientargs(s1, s2):
+    """Axes each operand was broadcast over — the reduction axes for its
+    gradient (ref: parity_ops broadcastgradientargs / TF internal)."""
+    a = tuple(int(v) for v in np.asarray(s1).reshape(-1))
+    b = tuple(int(v) for v in np.asarray(s2).reshape(-1))
+    out = np.broadcast_shapes(a, b)
+    ndim = len(out)
+    ap = (1,) * (ndim - len(a)) + a
+    bp = (1,) * (ndim - len(b)) + b
+    ra = [i for i in range(ndim) if ap[i] == 1 and out[i] != 1]
+    rb = [i for i in range(ndim) if bp[i] == 1 and out[i] != 1]
+    return (jnp.asarray(ra, jnp.int64), jnp.asarray(rb, jnp.int64))
+
+
+@register("knn_mindistance")
+def knn_mindistance(point, low, high):
+    """Min distance from a point to an axis-aligned box (ref: helpers
+    knn_mindistance — the VPTree/KDTree pruning bound)."""
+    p = point.reshape(-1)
+    clamped = jnp.clip(p, low.reshape(-1), high.reshape(-1))
+    return jnp.sqrt(jnp.sum((p - clamped) ** 2))
+
+
+@register("hashcode", aliases=["HashCode"])
+def hashcode(x):
+    """Deterministic int64 content hash (ref: parity_ops hashcode). The
+    constant mirrors the reference's 31-based polynomial scheme over the
+    raw buffer; values are NOT JVM-equal (dtype widths differ), determinism
+    and sensitivity are the contract."""
+    flat = jnp.asarray(x).reshape(-1)
+    bits = lax.bitcast_convert_type(
+        flat.astype(jnp.float32), jnp.int32).astype(jnp.int64)
+    powers = lax.associative_scan(
+        jnp.multiply, jnp.full(bits.shape, np.int64(31)))
+    return jnp.sum(bits * powers).astype(jnp.int64)
+
+
+@register("lstm_block_cell", num_outputs=7, aliases=["LSTMBlockCell"])
+def lstm_block_cell(x, h_prev, c_prev, w, b, forget_bias=1.0):
+    """Single fused LSTM cell step returning TF LSTMBlockCell's 7 outputs
+    (i, cs, f, o, ci, co, h) where ci = tanh(pre-gate), co = tanh(cs)
+    (ref: recurrent lstmBlockCell)."""
+    zcat = jnp.concatenate([x, h_prev], axis=-1) @ w + b
+    i, ci, f, o = jnp.split(zcat, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    o = jax.nn.sigmoid(o)
+    ci = jnp.tanh(ci)
+    cs = f * c_prev + i * ci
+    co = jnp.tanh(cs)
+    h = o * co
+    return i, cs, f, o, ci, co, h
+
+
+@register("image_resize", aliases=["ImageResize"])
+def image_resize(x, size, method="bilinear", antialias=False):
+    """Generic dispatcher over the resize family (ref: parity_ops
+    image_resize — method enum selects the kernel). 'area' does exact
+    box-filter averaging for integer downscale factors (TF semantics) and
+    antialiased linear otherwise (the standard continuous approximation)."""
+    h, w = (int(s) for s in np.asarray(size).reshape(-1))
+    out_shape = x.shape[:-3] + (h, w, x.shape[-1])
+    m = str(method).lower()
+    if m == "area":
+        ih, iw = x.shape[-3], x.shape[-2]
+        if ih % h == 0 and iw % w == 0:
+            fh, fw = ih // h, iw // w
+            xr = x.reshape(x.shape[:-3] + (h, fh, w, fw, x.shape[-1]))
+            return jnp.mean(xr, axis=(-4, -2)).astype(x.dtype)
+        return jax.image.resize(x, out_shape, method="linear",
+                                antialias=True).astype(x.dtype)
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[m]
+    return jax.image.resize(x, out_shape, method=method,
+                            antialias=bool(antialias)).astype(x.dtype)
+
+
+_register_bp("softmax_bp", "softmax", 1)
+_register_bp("log_softmax_bp", "log_softmax", 1)
+_register_bp("prelu_bp", "prelu", 2)
+_register_bp("tanh_bp", "tanh", 1)
+_register_bp("sigmoid_bp", "sigmoid", 1)
+
+
+@register("dynamic_bidirectional_rnn", num_outputs=4,
+          aliases=["DynamicBidirectionalRNN"])
+def dynamic_bidirectional_rnn(x, h0f, c0f, wf, bf, h0b, c0b, wb, bb,
+                              cell="lstm", forget_bias=0.0):
+    """Forward + time-reversed backward cell pass (ref: recurrent
+    dynamic_bidirectional_rnn — same math as static_bidirectional_rnn, the
+    'dynamic' time-major handling being a call-site transpose on TPU)."""
+    yf, sf = exec_op("static_rnn", x, h0f, c0f, wf, bf, cell=cell,
+                     forget_bias=forget_bias)
+    yb, sb = exec_op("static_rnn", jnp.flip(x, axis=1), h0b, c0b, wb, bb,
+                     cell=cell, forget_bias=forget_bias)
+    return yf, jnp.flip(yb, axis=1), sf, sb

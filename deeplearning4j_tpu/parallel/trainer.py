@@ -146,7 +146,9 @@ class ShardedTrainer:
         from deeplearning4j_tpu.utils.preemption import (
             PreemptionSafeListener, TrainingPreempted)
         path = None
-        if self.checkpoint_dir is not None:
+        # rank 0 persists (params are replicated/identical across ranks);
+        # every rank still unwinds via the raise below
+        if self.checkpoint_dir is not None and jax.process_index() == 0:
             import os
             os.makedirs(self.checkpoint_dir, exist_ok=True)
             # same filename contract as PreemptionSafeListener so
@@ -155,7 +157,10 @@ class ShardedTrainer:
                 self.checkpoint_dir,
                 PreemptionSafeListener.FINAL_NAME.format(
                     model=type(self.net).__name__))
-            self.net.save(path)
+            # write-then-rename: a hard kill after the grace window must
+            # never leave a torn zip for resume_or_new to trust
+            self.net.save(path + ".tmp")
+            os.replace(path + ".tmp", path)
         raise TrainingPreempted(path or "<no checkpoint_dir configured>",
                                 self.net._iteration)
 

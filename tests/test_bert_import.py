@@ -64,9 +64,7 @@ def test_bert_imports_with_numerical_parity(bert_frozen):
 def test_bert_fine_tunes_through_sd_fit(bert_frozen):
     """Import → promote weights to variables → attach classifier head →
     sd.fit decreases the loss (the fine-tune half of BASELINE config[3])."""
-    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
     from deeplearning4j_tpu.data.dataset import MultiDataSet
-    from deeplearning4j_tpu.optim.updaters import Adam
 
     _, gd = bert_frozen
     sd = TFGraphMapper.import_graph(gd)
