@@ -241,29 +241,53 @@ def main():
     # transformer.FLASH_MIN_SEQ). Override via BENCH_FLASH=0/1 for A/B runs.
     if os.environ.get("BENCH_FLASH"):
         transformer_mod.FLASH_ATTENTION = os.environ["BENCH_FLASH"] == "1"
-    cfg = TransformerConfig(
-        vocab_size=32768 if on_tpu else 1024,
-        n_layers=12 if on_tpu else 2,
-        n_heads=16 if on_tpu else 4,
-        d_model=1024 if on_tpu else 128,
-        max_len=1024 if on_tpu else 128,
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-    )
-    batch = 8 if on_tpu else 4
-    model = TransformerLM(cfg, mesh=None)
-    params = model.init_params(jax.random.key(0))
-    opt = optax.adamw(3e-4)
-    opt_state = jax.jit(opt.init)(params)
-    step = model.make_train_step(opt)
 
-    rng = np.random.default_rng(0)
-    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, cfg.max_len)),
-                       jnp.int32)
-    tgts = jnp.roll(toks, -1, axis=1)
+    def build_cfg(remat):
+        return TransformerConfig(
+            vocab_size=32768 if on_tpu else 1024,
+            n_layers=12 if on_tpu else 2,
+            n_heads=16 if on_tpu else 4,
+            d_model=1024 if on_tpu else 128,
+            max_len=1024 if on_tpu else 128,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            remat=remat,
+        )
 
     iters = 10 if on_tpu else 5
     repeats = 3
-    ours = StepTimer(step, params, opt_state, toks, tgts, iters)
+    rng = np.random.default_rng(0)
+
+    # OOM ladder: full batch → remat (recompute activations) → half batch.
+    # HBM is 16 GB on v5e; the warmup step is where RESOURCE_EXHAUSTED
+    # surfaces, so each rung is attempted through it
+    ladder = ([(8, False), (8, True), (4, True)] if on_tpu else [(4, False)])
+    last_err = None
+    for batch, remat in ladder:
+        cfg = build_cfg(remat)
+        model = TransformerLM(cfg, mesh=None)
+        params = model.init_params(jax.random.key(0))
+        opt = optax.adamw(3e-4)
+        opt_state = jax.jit(opt.init)(params)
+        step = model.make_train_step(opt)
+        toks = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, cfg.max_len)), jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        try:
+            ours = StepTimer(step, params, opt_state, toks, tgts, iters)
+            break
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" \
+                    not in str(e):
+                raise
+            # keep only the text: the exception's traceback frames would pin
+            # the failed rung's param/opt-state device buffers and defeat
+            # the retry
+            last_err = str(e)[:500]
+            print(f"[bench] batch={batch} remat={remat} OOM — stepping "
+                  f"down the ladder", file=sys.stderr)
+            del e, params, opt_state, step
+    else:
+        raise RuntimeError(f"all bench configs OOMed: {last_err}")
 
     # --- plain-Flax denominator on the same chip, measured INTERLEAVED ---
     flax_timer = None
@@ -322,7 +346,7 @@ def main():
         "flax_tokens_per_sec": round(flax_reported, 1) if flax_reported else None,
         "n_params": n_params,
         "config": {"layers": cfg.n_layers, "d_model": cfg.d_model,
-                   "seq": cfg.max_len, "batch": batch,
+                   "seq": cfg.max_len, "batch": batch, "remat": cfg.remat,
                    "dtype": str(getattr(cfg.dtype, "__name__", cfg.dtype))},
         "flash_attention": transformer_mod._use_flash_attention(cfg.max_len),
         "flash_probe_error": transformer_mod._FLASH_PROBE_ERROR,

@@ -1,0 +1,46 @@
+"""Device-timed flash-vs-XLA attention crossover sweep (run on a live
+TPU window; feeds FLASH_MIN_SEQ in models/transformer.py and the
+benchmarks/RESULTS.md table)."""
+import sys, tempfile
+import jax, jax.numpy as jnp, numpy as np
+
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from device_timing import measure_device_step
+from deeplearning4j_tpu.kernels.flash_attention import flash_attention
+from deeplearning4j_tpu.parallel.ring import _plain_attention
+
+D = 64
+
+def time_fn(f, args, tag):
+    try:
+        g = jax.jit(jax.value_and_grad(lambda *a: f(*a).astype(jnp.float32).sum()))
+        out = g(*args); jax.block_until_ready(out)
+        def window():
+            r = None
+            for _ in range(6):
+                r = g(*args)
+            float(r[0])
+        r = measure_device_step(window, "jit_", logdir=tempfile.mkdtemp(prefix="ft_"))
+        ms = r["median_s"] * 1e3 if r else float("nan")
+        print(f"{tag}: {ms:.3f} ms", flush=True)
+    except Exception as e:
+        print(f"{tag}: FAIL {type(e).__name__}", flush=True)
+
+import itertools
+cases = [(8, 512), (8, 2048), (2, 8192)]
+for B, T in cases:
+    H = 8
+    q = jax.random.normal(jax.random.key(0), (B, H, T, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.key(1), (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.key(2), (B, H, T, D), jnp.bfloat16)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    time_fn(lambda a, b, c: _plain_attention(a, b, c, causal=True),
+            (qt, kt, vt), f"B={B} T={T} XLA")
+    for bq, bk in [(128, 128), (256, 512), (512, 512), (512, 1024)]:
+        if bq > T or bk > T: continue
+        time_fn(lambda a, b, c, bq=bq, bk=bk: flash_attention(
+            a, b, c, causal=True, block_q=bq, block_k=bk),
+            (q, k, v), f"B={B} T={T} flash bq={bq} bk={bk}")
+    print(flush=True)

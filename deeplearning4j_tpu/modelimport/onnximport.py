@@ -821,3 +821,107 @@ class OnnxGraphMapper:
 
     importModel = import_model
     import_graph = import_model
+
+
+# --------------------------------------------------------------------------
+# rule tranche 2 (round 3): the remaining common-opset tail
+def _register_onnx_rules_t2():
+    @onnx_rule("Size")
+    def _size(ctx, node, inputs, attrs):
+        return ctx.sd._op("size", inputs[0])
+
+    @onnx_rule("EyeLike")
+    def _eyelike(ctx, node, inputs, attrs):
+        if int(attrs.get("k", 0)) != 0:
+            raise ONNXImportError("EyeLike with k != 0 unsupported")
+        x = inputs[0]
+        # ONNX contract: dtype attr wins, else the INPUT's dtype
+        dt = (op_.onnx_dtype(attrs["dtype"]).name if "dtype" in attrs
+              else str(x.dtype))
+        e = ctx.sd._op("eye", n=int(x.shape[-2]), m=int(x.shape[-1]))
+        return ctx.sd._op("Cast", e, dtype=dt)
+
+    @onnx_rule("GatherElements")
+    def _gather_elements(ctx, node, inputs, attrs):
+        # take_along_axis semantics — the registry's scatter_elements dual
+        return ctx.sd._op("gather_elements", *inputs,
+                          axis=int(attrs.get("axis", 0)))
+
+    @onnx_rule("ReduceLogSum")
+    def _reduce_log_sum(ctx, node, inputs, attrs):
+        axes = attrs.get("axes")
+        s = ctx.sd._op("reduce_sum", inputs[0],
+                       axis=tuple(axes) if axes else None,
+                       keepdims=bool(attrs.get("keepdims", 1)))
+        return ctx.sd._op("log", s)
+
+    @onnx_rule("NonMaxSuppression")
+    def _nms(ctx, node, inputs, attrs):
+        boxes, scores = inputs[0], inputs[1]
+        max_out = int(np.asarray(ctx.const(node["input"][2], 0)).reshape(()))\
+            if len(node.get("input", [])) > 2 and node["input"][2] else 0
+        iou_t = float(np.asarray(ctx.const(node["input"][3], 0.5))
+                      .reshape(())) if len(node.get("input", [])) > 3 \
+            and node["input"][3] else 0.5
+        score_t = float(np.asarray(ctx.const(node["input"][4], -np.inf))
+                        .reshape(())) if len(node.get("input", [])) > 4 \
+            and node["input"][4] else float("-inf")
+        # single batch + single class only (the registry op's contract);
+        # the batched/multi-class loop is a loud error, not a shape crash
+        if len(boxes.shape) == 3 and boxes.shape[0] not in (1, None):
+            raise ONNXImportError(
+                "batched NonMaxSuppression (num_batches > 1) unsupported")
+        if len(scores.shape) == 3 and scores.shape[1] not in (1, None):
+            raise ONNXImportError(
+                "multi-class NonMaxSuppression (num_classes > 1) unsupported")
+        b2 = ctx.sd._op("Reshape", boxes, shape=(-1, 4))
+        s2 = ctx.sd._op("Reshape", scores, shape=(-1,))
+        n_boxes = int(s2.shape[0]) if s2.shape and s2.shape[0] else 1
+        idx = ctx.sd._op("non_max_suppression", b2, s2,
+                         max_output_size=max_out or n_boxes,
+                         iou_threshold=iou_t, score_threshold=score_t)
+        # ONNX layout: (num_selected, 3) rows of [batch, class, box_idx].
+        # Whole-graph jit needs STATIC shapes, so num_selected is the padded
+        # max_output_size with -1 rows for unselected slots (documented
+        # divergence; the reference's dynamic row count cannot exist here)
+        zeros = ctx.sd._op("zeros_as", idx)
+        return ctx.sd._op("stack", zeros, zeros, idx, axis=1)
+
+    @onnx_rule("NonZero")
+    def _nonzero(ctx, node, inputs, attrs):
+        # data-dependent output SHAPE cannot exist under whole-graph jit
+        # (the executor emits ONE compiled program; SURVEY §3.3 north star).
+        # A specific error beats the generic no-rule hint.
+        raise ONNXImportError(
+            "NonZero has a data-dependent output shape, which the "
+            "whole-graph-jit executor cannot represent; replace it with a "
+            "mask (Equal/Where) or precompute indices host-side "
+            "(ops.registry 'nonzero_coords' works eagerly)")
+
+    @onnx_rule("CastLike")
+    def _castlike(ctx, node, inputs, attrs):
+        return ctx.sd._op("cast", inputs[0],
+                          dtype=str(inputs[1].dtype))
+
+    @onnx_rule("Shrink")
+    def _shrink(ctx, node, inputs, attrs):
+        return ctx.sd._op("shrink", inputs[0],
+                          lambd=float(attrs.get("lambd", 0.5)),
+                          bias=float(attrs.get("bias", 0.0)))
+
+    @onnx_rule("Bernoulli")
+    def _bernoulli(ctx, node, inputs, attrs):
+        # per-element probabilities (the input IS the p tensor)
+        return ctx.sd._op("bernoulli_sample", inputs[0],
+                          seed=(int(attrs["seed"])
+                                if attrs.get("seed") is not None else None))
+
+    @onnx_rule("Multinomial")
+    def _multinomial(ctx, node, inputs, attrs):
+        seed = attrs.get("seed")
+        return ctx.sd._op("random_multinomial", inputs[0],
+                          num_samples=int(attrs.get("sample_size", 1)),
+                          seed=int(seed) if seed is not None else None)
+
+
+_register_onnx_rules_t2()

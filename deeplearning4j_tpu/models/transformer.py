@@ -42,9 +42,11 @@ FLASH_ATTENTION: Optional[bool] = None
 
 # auto-policy crossover: below this sequence length the XLA attention's
 # (T, T) materialization is cheap enough that it beats the Pallas kernel on
-# device-measured step time (v5e, d_head=64); at/above it the scores tensor
-# is HBM-traffic- and memory-bound and flash wins
-FLASH_MIN_SEQ = 1024
+# device-measured step time (v5e, d_head=64: flash lost at T=512 even after
+# the bf16 rewrite); at/above it the O(T²) scores tensor dominates HBM and
+# flash wins on memory regardless. Conservative until the device-timed
+# crossover sweep (benchmarks/flash_crossover.py) runs on hardware.
+FLASH_MIN_SEQ = 2048
 
 
 _FLASH_LOWERS: Optional[bool] = None

@@ -256,7 +256,7 @@ for _act in ("cube", "elu", "selu", "softsign", "softplus", "hard_sigmoid",
 
 
 # ------------------------------------------------------ index-reduce family
-def _cond_fn(condition, value):
+def _cond_fn(condition):
     ops = {"gt": jnp.greater, "gte": jnp.greater_equal, "lt": jnp.less,
            "lte": jnp.less_equal, "eq": jnp.equal, "neq": jnp.not_equal,
            "abs_gt": lambda a, v: jnp.abs(a) > v,
@@ -268,7 +268,7 @@ def _cond_fn(condition, value):
 def first_index(x, condition="gt", value=0.0):
     """Index of the FIRST element matching (ref: indexreduce FirstIndex);
     -1 when none match."""
-    mask = _cond_fn(condition, value)(x.reshape(-1), value)
+    mask = _cond_fn(condition)(x.reshape(-1), value)
     idx = jnp.argmax(mask)
     return jnp.where(jnp.any(mask), idx, -1).astype(jnp.int64)
 
@@ -276,7 +276,7 @@ def first_index(x, condition="gt", value=0.0):
 @register("last_index")
 def last_index(x, condition="gt", value=0.0):
     flat = x.reshape(-1)
-    mask = _cond_fn(condition, value)(flat, value)
+    mask = _cond_fn(condition)(flat, value)
     rev_idx = jnp.argmax(jnp.flip(mask))
     idx = flat.shape[0] - 1 - rev_idx
     return jnp.where(jnp.any(mask), idx, -1).astype(jnp.int64)
@@ -296,13 +296,13 @@ def iamin(x, axis=None):
 @register("match_condition", aliases=["MatchCondition"])
 def match_condition(x, condition="gt", value=0.0):
     """COUNT of matching elements (ref: reduce MatchCondition)."""
-    return jnp.sum(_cond_fn(condition, value)(x, value)).astype(jnp.int64)
+    return jnp.sum(_cond_fn(condition)(x, value)).astype(jnp.int64)
 
 
 @register("match_condition_transform", aliases=["MatchConditionTransform"])
 def match_condition_transform(x, condition="gt", value=0.0):
     """Boolean mask of matching elements."""
-    return _cond_fn(condition, value)(x, value)
+    return _cond_fn(condition)(x, value)
 
 
 # ------------------------------------------------------ Barnes-Hut t-SNE
@@ -358,12 +358,16 @@ def select(cond, x, y):
 @register("check_numerics", aliases=["CheckNumerics"])
 def check_numerics(x, message="CheckNumerics failed"):
     """Pass-through that errors on NaN/Inf (ref: parity_ops check_numerics).
-    Under jit uses checkify-style debug callback semantics via
-    jax.debug; eagerly raises."""
+    Eager: raises immediately. Traced: a host debug callback raises when the
+    value materializes (a bare checkify.check cannot lower outside a
+    checkify.checkify wrapper, so callers wanting functional errors should
+    wrap with utils.sanitize's checkify packaging instead)."""
     import jax.core
     if isinstance(x, jax.core.Tracer):
-        from jax.experimental import checkify
-        checkify.check(jnp.all(jnp.isfinite(x)), message)
+        def _host_check(v, _msg=message):
+            if not np.isfinite(v).all():
+                raise FloatingPointError(_msg)
+        jax.debug.callback(_host_check, x)
         return x
     if not bool(jnp.all(jnp.isfinite(x))):
         raise FloatingPointError(message)
@@ -521,3 +525,28 @@ def dynamic_bidirectional_rnn(x, h0f, c0f, wf, bf, h0b, c0b, wb, bb,
     yb, sb = exec_op("static_rnn", jnp.flip(x, axis=1), h0b, c0b, wb, bb,
                      cell=cell, forget_bias=forget_bias)
     return yf, jnp.flip(yb, axis=1), sf, sb
+
+
+@register("gather_elements", aliases=["GatherElements"])
+def gather_elements(x, indices, axis=0):
+    """take_along_axis — the dual of scatter_elements (ref: parity_ops
+    gather semantics / ONNX GatherElements)."""
+    return jnp.take_along_axis(x, indices.astype(jnp.int32), axis=int(axis))
+
+
+@register("nonzero_coords", aliases=["NonZero"])
+def nonzero_coords(x):
+    """(rank, n) coordinates of nonzero elements (ONNX NonZero layout).
+    Data-dependent output shape — eager-only, like the reference's
+    dynamic-shape ops; jnp.nonzero itself rejects tracing."""
+    return jnp.stack(jnp.nonzero(x), axis=0).astype(jnp.int64)
+
+
+@register("bernoulli_sample", aliases=["Bernoulli"])
+def bernoulli_sample(p, seed=None):
+    """Per-element Bernoulli draws: the input IS the probability tensor
+    (ONNX Bernoulli contract — distinct from random_bernoulli's
+    (key, shape, scalar-p) signature)."""
+    from deeplearning4j_tpu.ndarray import random as _rng
+    key = jax.random.key(int(seed)) if seed is not None else _rng.next_key()
+    return jax.random.bernoulli(key, p).astype(p.dtype)

@@ -146,21 +146,21 @@ class ShardedTrainer:
         from deeplearning4j_tpu.utils.preemption import (
             PreemptionSafeListener, TrainingPreempted)
         path = None
-        # rank 0 persists (params are replicated/identical across ranks);
-        # every rank still unwinds via the raise below
-        if self.checkpoint_dir is not None and jax.process_index() == 0:
+        if self.checkpoint_dir is not None:
             import os
-            os.makedirs(self.checkpoint_dir, exist_ok=True)
-            # same filename contract as PreemptionSafeListener so
-            # resume_or_new discovers trainer-written checkpoints too
+            # the filename contract of PreemptionSafeListener so
+            # resume_or_new discovers trainer-written checkpoints; every
+            # rank reports the same path (shared storage), rank 0 writes it
             path = os.path.join(
                 self.checkpoint_dir,
                 PreemptionSafeListener.FINAL_NAME.format(
                     model=type(self.net).__name__))
-            # write-then-rename: a hard kill after the grace window must
-            # never leave a torn zip for resume_or_new to trust
-            self.net.save(path + ".tmp")
-            os.replace(path + ".tmp", path)
+            if jax.process_index() == 0:
+                os.makedirs(self.checkpoint_dir, exist_ok=True)
+                # write-then-rename: a hard kill after the grace window
+                # must never leave a torn zip for resume_or_new to trust
+                self.net.save(path + ".tmp")
+                os.replace(path + ".tmp", path)
         raise TrainingPreempted(path or "<no checkpoint_dir configured>",
                                 self.net._iteration)
 
