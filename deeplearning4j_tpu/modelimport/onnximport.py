@@ -850,6 +850,11 @@ def _register_onnx_rules_t2():
     @onnx_rule("ReduceLogSum")
     def _reduce_log_sum(ctx, node, inputs, attrs):
         axes = attrs.get("axes")
+        if axes is None and len(node.get("input", [])) > 1 \
+                and node["input"][1]:
+            # opset >= 18: axes arrive as the second INPUT
+            axes = [int(a) for a in
+                    np.asarray(ctx.const(node["input"][1])).reshape(-1)]
         s = ctx.sd._op("reduce_sum", inputs[0],
                        axis=tuple(axes) if axes else None,
                        keepdims=bool(attrs.get("keepdims", 1)))
@@ -858,6 +863,10 @@ def _register_onnx_rules_t2():
     @onnx_rule("NonMaxSuppression")
     def _nms(ctx, node, inputs, attrs):
         boxes, scores = inputs[0], inputs[1]
+        if int(attrs.get("center_point_box", 0)) != 0:
+            raise ONNXImportError(
+                "NonMaxSuppression center_point_box=1 (center/width format) "
+                "unsupported — convert boxes to corner coords first")
         max_out = int(np.asarray(ctx.const(node["input"][2], 0)).reshape(()))\
             if len(node.get("input", [])) > 2 and node["input"][2] else 0
         iou_t = float(np.asarray(ctx.const(node["input"][3], 0.5))
@@ -876,9 +885,9 @@ def _register_onnx_rules_t2():
                 "multi-class NonMaxSuppression (num_classes > 1) unsupported")
         b2 = ctx.sd._op("Reshape", boxes, shape=(-1, 4))
         s2 = ctx.sd._op("Reshape", scores, shape=(-1,))
-        n_boxes = int(s2.shape[0]) if s2.shape and s2.shape[0] else 1
+        # ONNX default max_output_boxes_per_class IS 0 = select nothing
         idx = ctx.sd._op("non_max_suppression", b2, s2,
-                         max_output_size=max_out or n_boxes,
+                         max_output_size=max_out,
                          iou_threshold=iou_t, score_threshold=score_t)
         # ONNX layout: (num_selected, 3) rows of [batch, class, box_idx].
         # Whole-graph jit needs STATIC shapes, so num_selected is the padded
@@ -912,16 +921,24 @@ def _register_onnx_rules_t2():
     @onnx_rule("Bernoulli")
     def _bernoulli(ctx, node, inputs, attrs):
         # per-element probabilities (the input IS the p tensor)
-        return ctx.sd._op("bernoulli_sample", inputs[0],
-                          seed=(int(attrs["seed"])
-                                if attrs.get("seed") is not None else None))
+        out = ctx.sd._op("bernoulli_sample", inputs[0],
+                         seed=(int(attrs["seed"])
+                               if attrs.get("seed") is not None else None))
+        if "dtype" in attrs:
+            out = ctx.sd._op("Cast", out,
+                             dtype=op_.onnx_dtype(attrs["dtype"]).name)
+        return out
 
     @onnx_rule("Multinomial")
     def _multinomial(ctx, node, inputs, attrs):
         seed = attrs.get("seed")
-        return ctx.sd._op("random_multinomial", inputs[0],
-                          num_samples=int(attrs.get("sample_size", 1)),
-                          seed=int(seed) if seed is not None else None)
+        out = ctx.sd._op("random_multinomial", inputs[0],
+                         num_samples=int(attrs.get("sample_size", 1)),
+                         seed=int(seed) if seed is not None else None)
+        # spec default output dtype is int32; dtype attr overrides
+        dt = (op_.onnx_dtype(attrs["dtype"]).name if "dtype" in attrs
+              else "int32")
+        return ctx.sd._op("Cast", out, dtype=dt)
 
 
 _register_onnx_rules_t2()

@@ -349,10 +349,9 @@ def cell_contains(corner, width, point):
 
 
 # --------------------------------------------------------------- stragglers
-@register("select", aliases=["Select"])
-def select(cond, x, y):
-    """Ternary select (ref: parity_ops select / TF Select)."""
-    return jnp.where(cond.astype(bool), x, y)
+# ternary select: the registry's "where" op already owns Select/SelectV2 —
+# expose the libnd4j lowercase spelling on the same OpDef (no clobbering)
+_REGISTRY["select"] = _REGISTRY["where"]
 
 
 @register("check_numerics", aliases=["CheckNumerics"])

@@ -161,6 +161,11 @@ class ShardedTrainer:
                 # must never leave a torn zip for resume_or_new to trust
                 self.net.save(path + ".tmp")
                 os.replace(path + ".tmp", path)
+            else:
+                # no cross-rank barrier here (a single-rank latch would
+                # deadlock one); mark the path as possibly in flight so a
+                # supervisor never mistakes it for a ready checkpoint
+                path = f"<rank 0 writes {path}>"
         raise TrainingPreempted(path or "<no checkpoint_dir configured>",
                                 self.net._iteration)
 

@@ -468,8 +468,7 @@ class TestRuleTranche2:
                          inputs=list(inputs), outputs=list(outputs),
                          initializers=list(initializers))
         sd = OnnxGraphMapper.import_model(P.parse_model(P.make_model(g)))
-        res = sd.output(feed)
-        return res if not isinstance(res, dict) else res
+        return sd.output(feed)
 
     def test_eyelike_and_size(self):
         x = R(1).randn(3, 3).astype(F32)
