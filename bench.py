@@ -251,6 +251,7 @@ def main():
             max_len=1024 if on_tpu else 128,
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
             remat=remat,
+            fused_qkv=True,
         )
 
     iters = 10 if on_tpu else 5

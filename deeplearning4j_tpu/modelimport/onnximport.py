@@ -99,6 +99,20 @@ def _pads(attrs, spatial: int):
 
 
 # -------------------------------------------------------------------- rules
+def _axes_arg(ctx, node, attrs):
+    """ONNX axes: attribute (opset < 18) or second input (opset >= 18).
+    Returns (axes_list_or_None, is_empty) — empty axes pair with the
+    noop_with_empty_axes=1 attr to mean "no reduction" per spec."""
+    axes = attrs.get("axes")
+    if axes is None and len(node.get("input", [])) > 1 and node["input"][1]:
+        axes = [int(a) for a in
+                np.asarray(ctx.const(node["input"][1])).reshape(-1)]
+    if axes is None:
+        return None, False
+    axes = list(axes)
+    return axes, len(axes) == 0
+
+
 def _register_onnx_rules():
     def passthru(onnx_op, reg_op):
         @onnx_rule(onnx_op)
@@ -251,16 +265,12 @@ def _register_onnx_rules():
 
     @onnx_rule("Squeeze")
     def _squeeze(ctx, node, inputs, attrs):
-        axes = attrs.get("axes")
-        if axes is None and len(node["input"]) > 1:
-            axes = [int(a) for a in ctx.const(node["input"][1])]
+        axes, _ = _axes_arg(ctx, node, attrs)
         return ctx.sd._op("Squeeze", inputs[0], axis=axes)
 
     @onnx_rule("Unsqueeze")
     def _unsqueeze(ctx, node, inputs, attrs):
-        axes = attrs.get("axes")
-        if axes is None and len(node["input"]) > 1:
-            axes = [int(a) for a in ctx.const(node["input"][1])]
+        axes, _ = _axes_arg(ctx, node, attrs)
         out = inputs[0]
         for a in sorted(axes):
             out = ctx.sd._op("ExpandDims", out, axis=int(a))
@@ -317,9 +327,9 @@ def _register_onnx_rules():
     def _reduce(ctx, node, inputs, attrs):
         reg = {"ReduceMean": "Mean", "ReduceSum": "Sum", "ReduceMax": "Max",
                "ReduceMin": "Min", "ReduceProd": "Prod"}[node["op_type"]]
-        axes = attrs.get("axes")
-        if axes is None and len(node["input"]) > 1 and node["input"][1]:
-            axes = [int(a) for a in ctx.const(node["input"][1])]
+        axes, empty = _axes_arg(ctx, node, attrs)
+        if empty and attrs.get("noop_with_empty_axes"):
+            return ctx.sd._op("Identity", inputs[0])
         return ctx.sd._op(reg, inputs[0],
                           axis=tuple(axes) if axes else None,
                           keepdims=bool(attrs.get("keepdims", 1)))
@@ -694,10 +704,9 @@ def _register_onnx_rules():
 
     @onnx_rule("ReduceLogSumExp", "ReduceSumSquare")
     def _reduce_extra(ctx, node, inputs, attrs):
-        axes = attrs.get("axes")
-        if axes is None and len(inputs) > 1:
-            axes = [int(v) for v in np.asarray(
-                ctx.const(node["input"][1]))]
+        axes, empty = _axes_arg(ctx, node, attrs)
+        if empty and attrs.get("noop_with_empty_axes"):
+            return ctx.sd._op("Identity", inputs[0])
         axes = tuple(axes) if axes else None
         kd = bool(attrs.get("keepdims", 1))
         name = ("reduce_logsumexp_axes" if node["op_type"] ==
@@ -849,12 +858,9 @@ def _register_onnx_rules_t2():
 
     @onnx_rule("ReduceLogSum")
     def _reduce_log_sum(ctx, node, inputs, attrs):
-        axes = attrs.get("axes")
-        if axes is None and len(node.get("input", [])) > 1 \
-                and node["input"][1]:
-            # opset >= 18: axes arrive as the second INPUT
-            axes = [int(a) for a in
-                    np.asarray(ctx.const(node["input"][1])).reshape(-1)]
+        axes, empty = _axes_arg(ctx, node, attrs)
+        if empty and attrs.get("noop_with_empty_axes"):
+            return ctx.sd._op("log", inputs[0])
         s = ctx.sd._op("reduce_sum", inputs[0],
                        axis=tuple(axes) if axes else None,
                        keepdims=bool(attrs.get("keepdims", 1)))

@@ -116,6 +116,9 @@ class TransformerConfig:
                                       # axis shards over ``expert`` when the
                                       # mesh has one
     moe_aux_weight: float = 0.01      # Switch load-balance aux-loss weight
+    fused_qkv: bool = False           # one (d, 3d) projection matmul per
+                                      # block instead of three (d, d): fewer,
+                                      # larger MXU ops + one HBM read of x
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -162,12 +165,16 @@ class TransformerLM:
             blk = {
                 "ln1": {"g": jnp.ones((c.d_model,)), "b": jnp.zeros((c.d_model,))},
                 "ln2": {"g": jnp.ones((c.d_model,)), "b": jnp.zeros((c.d_model,))},
-                "attn": {
+                "attn": ({
+                    "wqkv": jax.random.normal(
+                        kk[0], (c.d_model, 3 * c.d_model)) * scale,
+                    "wo": jax.random.normal(kk[3], (c.d_model, c.d_model)) * scale,
+                } if c.fused_qkv else {
                     "wq": jax.random.normal(kk[0], (c.d_model, c.d_model)) * scale,
                     "wk": jax.random.normal(kk[1], (c.d_model, c.d_model)) * scale,
                     "wv": jax.random.normal(kk[2], (c.d_model, c.d_model)) * scale,
                     "wo": jax.random.normal(kk[3], (c.d_model, c.d_model)) * scale,
-                },
+                }),
             }
             if c.moe is not None:
                 blk["moe"] = init_moe_params(c.moe, kk[4], scale=scale)
@@ -210,7 +217,8 @@ class TransformerLM:
         def blk():
             d = {
                 "ln1": {"g": rep, "b": rep}, "ln2": {"g": rep, "b": rep},
-                "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+                "attn": ({"wqkv": col, "wo": row} if self.config.fused_qkv
+                         else {"wq": col, "wk": col, "wv": col, "wo": row}),
             }
             if self.config.moe is not None:
                 d["moe"] = moe_param_specs(EXPERT_AXIS if has_ep else None)
@@ -259,9 +267,16 @@ class TransformerLM:
         c = self.config
         b, t, _ = x.shape
         h, hd = c.n_heads, c.d_model // c.n_heads
-        q = (x @ p["wq"]).reshape(b, t, h, hd)
-        k = (x @ p["wk"]).reshape(b, t, h, hd)
-        v = (x @ p["wv"]).reshape(b, t, h, hd)
+        if "wqkv" in p:
+            qkv = x @ p["wqkv"]                       # one MXU op, one x read
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, t, h, hd)
+            k = k.reshape(b, t, h, hd)
+            v = v.reshape(b, t, h, hd)
+        else:
+            q = (x @ p["wq"]).reshape(b, t, h, hd)
+            k = (x @ p["wk"]).reshape(b, t, h, hd)
+            v = (x @ p["wv"]).reshape(b, t, h, hd)
         if mesh is not None and SEQ_AXIS in mesh.axis_names:
             o = ring_attention(q, k, v, mesh, causal=c.causal)
         elif _use_flash_attention(t):

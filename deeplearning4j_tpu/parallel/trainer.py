@@ -161,13 +161,12 @@ class ShardedTrainer:
                 # must never leave a torn zip for resume_or_new to trust
                 self.net.save(path + ".tmp")
                 os.replace(path + ".tmp", path)
-            else:
-                # no cross-rank barrier here (a single-rank latch would
-                # deadlock one); mark the path as possibly in flight so a
-                # supervisor never mistakes it for a ready checkpoint
-                path = f"<rank 0 writes {path}>"
+        # no cross-rank barrier (a single-rank latch would deadlock one);
+        # non-zero ranks keep the REAL path but flag it possibly in flight
         raise TrainingPreempted(path or "<no checkpoint_dir configured>",
-                                self.net._iteration)
+                                self.net._iteration,
+                                checkpoint_ready=(path is not None
+                                                  and jax.process_index() == 0))
 
     def fit(self, data, labels=None, epochs: int = 1):
         """Same surface as the wrapped net's fit; batches are sharded over the

@@ -33,13 +33,19 @@ from deeplearning4j_tpu.optim.listeners import TrainingListener
 
 class TrainingPreempted(Exception):
     """Raised at the step boundary after a preemption signal; carries the
-    checkpoint path written before unwinding."""
+    checkpoint path written before unwinding. ``checkpoint_ready`` is False
+    on multi-host ranks that did not write the file themselves (rank 0
+    writes; the write may still be in flight when other ranks unwind)."""
 
-    def __init__(self, checkpoint_path: str, iteration: int):
+    def __init__(self, checkpoint_path: str, iteration: int,
+                 checkpoint_ready: bool = True):
+        state = ("state saved to" if checkpoint_ready
+                 else "state being saved by rank 0 to")
         super().__init__(f"training preempted at iteration {iteration}; "
-                         f"state saved to {checkpoint_path}")
+                         f"{state} {checkpoint_path}")
         self.checkpoint_path = checkpoint_path
         self.iteration = iteration
+        self.checkpoint_ready = checkpoint_ready
 
 
 class PreemptionHandler:
