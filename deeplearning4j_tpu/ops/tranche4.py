@@ -28,6 +28,11 @@ from jax import lax
 
 from deeplearning4j_tpu.ops.registry import _REGISTRY, exec_op, register
 
+# widest int the mode supports: int64 in x64 mode, int32 otherwise (keeps
+# index/hash ops from tripping jax's truncation warning in x32 mode)
+def _widest_int():
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
 # ------------------------------------------------------------ named aliases
 # reference spelling variants of already-registered ops
 _REGISTRY["max_pool_with_argmax"] = _REGISTRY["maxpool_with_argmax"]
@@ -270,7 +275,7 @@ def first_index(x, condition="gt", value=0.0):
     -1 when none match."""
     mask = _cond_fn(condition)(x.reshape(-1), value)
     idx = jnp.argmax(mask)
-    return jnp.where(jnp.any(mask), idx, -1).astype(jnp.int64)
+    return jnp.where(jnp.any(mask), idx, -1).astype(_widest_int())
 
 
 @register("last_index")
@@ -279,24 +284,24 @@ def last_index(x, condition="gt", value=0.0):
     mask = _cond_fn(condition)(flat, value)
     rev_idx = jnp.argmax(jnp.flip(mask))
     idx = flat.shape[0] - 1 - rev_idx
-    return jnp.where(jnp.any(mask), idx, -1).astype(jnp.int64)
+    return jnp.where(jnp.any(mask), idx, -1).astype(_widest_int())
 
 
 @register("iamax", aliases=["IMax"])
 def iamax(x, axis=None):
     """Index of max |value| (ref: legacy indexreduce IMax / BLAS iamax)."""
-    return jnp.argmax(jnp.abs(x), axis=axis).astype(jnp.int64)
+    return jnp.argmax(jnp.abs(x), axis=axis).astype(_widest_int())
 
 
 @register("iamin", aliases=["IMin"])
 def iamin(x, axis=None):
-    return jnp.argmin(jnp.abs(x), axis=axis).astype(jnp.int64)
+    return jnp.argmin(jnp.abs(x), axis=axis).astype(_widest_int())
 
 
 @register("match_condition", aliases=["MatchCondition"])
 def match_condition(x, condition="gt", value=0.0):
     """COUNT of matching elements (ref: reduce MatchCondition)."""
-    return jnp.sum(_cond_fn(condition)(x, value)).astype(jnp.int64)
+    return jnp.sum(_cond_fn(condition)(x, value)).astype(_widest_int())
 
 
 @register("match_condition_transform", aliases=["MatchConditionTransform"])
@@ -405,7 +410,7 @@ def random_multinomial(logits, num_samples=1, seed=None):
     key = jax.random.key(seed) if seed is not None else _rng.next_key()
     return jax.random.categorical(
         key, logits, axis=-1,
-        shape=(int(num_samples),) + logits.shape[:-1]).T.astype(jnp.int64)
+        shape=(int(num_samples),) + logits.shape[:-1]).T.astype(_widest_int())
 
 
 @register("eig", num_outputs=2)
@@ -423,7 +428,7 @@ def broadcast_dynamic_shape(s1, s2):
     broadcast_dynamic_shape)."""
     a = tuple(int(v) for v in np.asarray(s1).reshape(-1))
     b = tuple(int(v) for v in np.asarray(s2).reshape(-1))
-    return jnp.asarray(np.broadcast_shapes(a, b), jnp.int64)
+    return jnp.asarray(np.broadcast_shapes(a, b), _widest_int())
 
 
 @register("broadcastgradientargs", num_outputs=2,
@@ -439,7 +444,7 @@ def broadcastgradientargs(s1, s2):
     bp = (1,) * (ndim - len(b)) + b
     ra = [i for i in range(ndim) if ap[i] == 1 and out[i] != 1]
     rb = [i for i in range(ndim) if bp[i] == 1 and out[i] != 1]
-    return (jnp.asarray(ra, jnp.int64), jnp.asarray(rb, jnp.int64))
+    return (jnp.asarray(ra, _widest_int()), jnp.asarray(rb, _widest_int()))
 
 
 @register("knn_mindistance")
@@ -459,10 +464,10 @@ def hashcode(x):
     and sensitivity are the contract."""
     flat = jnp.asarray(x).reshape(-1)
     bits = lax.bitcast_convert_type(
-        flat.astype(jnp.float32), jnp.int32).astype(jnp.int64)
+        flat.astype(jnp.float32), jnp.int32).astype(_widest_int())
     powers = lax.associative_scan(
-        jnp.multiply, jnp.full(bits.shape, np.int64(31)))
-    return jnp.sum(bits * powers).astype(jnp.int64)
+        jnp.multiply, jnp.full(bits.shape, 31, bits.dtype))
+    return jnp.sum(bits * powers).astype(_widest_int())
 
 
 @register("lstm_block_cell", num_outputs=7, aliases=["LSTMBlockCell"])
@@ -538,7 +543,7 @@ def nonzero_coords(x):
     """(rank, n) coordinates of nonzero elements (ONNX NonZero layout).
     Data-dependent output shape — eager-only, like the reference's
     dynamic-shape ops; jnp.nonzero itself rejects tracing."""
-    return jnp.stack(jnp.nonzero(x), axis=0).astype(jnp.int64)
+    return jnp.stack(jnp.nonzero(x), axis=0).astype(_widest_int())
 
 
 @register("bernoulli_sample", aliases=["Bernoulli"])

@@ -63,5 +63,52 @@ def main():
           f"per-chip score block = 32x32 instead of 256x256")
 
 
+def flagship_product_integration():
+    """Round 3: pp and ep as PRODUCT features — TransformerConfig flags,
+    not library plumbing (VERDICT r2 #4)."""
+    import optax
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS,
+                                                  STAGE_AXIS, MeshSpec)
+
+    # --- pipeline-parallel flagship: 4 stages x 2-way data parallel
+    mesh = MeshSpec({STAGE_AXIS: 4, DATA_AXIS: 2}).build(jax.devices()[:8])
+    cfg = TransformerConfig(vocab_size=256, n_layers=4, n_heads=4,
+                            d_model=64, max_len=32,
+                            pipeline_stages=4, microbatches=4,
+                            fused_qkv=True)
+    model = TransformerLM(cfg, mesh)
+    params = jax.device_put(model.init_params(jax.random.key(0)),
+                            model.param_shardings(mesh))
+    opt = optax.adamw(1e-3)
+    state = jax.jit(opt.init)(params)
+    step = model.make_train_step(opt)
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 256, (8, 32)),
+                       jnp.int32)
+    params, state, loss = step(params, state, toks,
+                               jnp.roll(toks, -1, axis=1))
+    print(f"flagship pp=4 x dp=2: loss {float(loss):.3f}")
+
+    # --- MoE flagship: Switch FFN, experts sharded, aux loss in metrics
+    ep_mesh = MeshSpec({EXPERT_AXIS: 4}).build(jax.devices()[:4])
+    from deeplearning4j_tpu.parallel.moe import MoEConfig
+    cfg_e = TransformerConfig(vocab_size=256, n_layers=2, n_heads=4,
+                              d_model=64, max_len=32,
+                              moe=MoEConfig(num_experts=4,
+                                            capacity_factor=2.0))
+    m_e = TransformerLM(cfg_e, ep_mesh)
+    p_e = jax.device_put(m_e.init_params(jax.random.key(1)),
+                         m_e.param_shardings(ep_mesh))
+    s_e = jax.jit(opt.init)(p_e)
+    step_e = m_e.make_train_step(opt, return_metrics=True)
+    p_e, s_e, metrics = step_e(p_e, s_e, toks[:4],
+                               jnp.roll(toks[:4], -1, axis=1))
+    print(f"flagship moe ep=4: loss {float(metrics['loss']):.3f} "
+          f"aux {float(metrics['moe_aux_loss']):.3f}")
+
+
 if __name__ == "__main__":
     main()
+    flagship_product_integration()
