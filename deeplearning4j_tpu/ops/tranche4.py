@@ -458,7 +458,8 @@ def knn_mindistance(point, low, high):
 
 @register("hashcode", aliases=["HashCode"])
 def hashcode(x):
-    """Deterministic int64 content hash (ref: parity_ops hashcode). The
+    """Deterministic content hash in the widest mode-supported int —
+    int64 under x64, int32 otherwise (ref: parity_ops hashcode). The
     constant mirrors the reference's 31-based polynomial scheme over the
     raw buffer; values are NOT JVM-equal (dtype widths differ), determinism
     and sensitivity are the contract."""

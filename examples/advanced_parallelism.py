@@ -93,7 +93,6 @@ def flagship_product_integration():
 
     # --- MoE flagship: Switch FFN, experts sharded, aux loss in metrics
     ep_mesh = MeshSpec({EXPERT_AXIS: 4}).build(jax.devices()[:4])
-    from deeplearning4j_tpu.parallel.moe import MoEConfig
     cfg_e = TransformerConfig(vocab_size=256, n_layers=2, n_heads=4,
                               d_model=64, max_len=32,
                               moe=MoEConfig(num_experts=4,
