@@ -252,6 +252,7 @@ def main():
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
             remat=remat,
             fused_qkv=True,
+            ce_chunks=8 if on_tpu else 0,   # V=32768 streams as 8x4096
         )
 
     iters = 10 if on_tpu else 5

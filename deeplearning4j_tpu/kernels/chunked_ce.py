@@ -1,0 +1,122 @@
+"""Chunked LM cross-entropy: logsumexp streamed over vocab chunks.
+
+At bench scale (B=8, T=1024, V=32768) the (B, T, V) f32 logits tensor is
+~1.07 GB; the standard loss materializes it in forward AND re-reads it in
+backward — often the single largest HBM-traffic item in an LM step (HBM
+bandwidth is the usual TPU limiter, SURVEY §7 design stance). This
+formulation never builds it:
+
+- forward: ``lax.scan`` over vocab chunks; each chunk's logits
+  ``x @ E_c^T`` live only as a (B, T, C) block feeding an online
+  (running-max, running-sumexp) accumulation — the flash-attention
+  recurrence applied to the vocab axis — plus a masked gather of the
+  correct-class logit.
+- backward (custom_vjp): d logits = softmax − onehot is recomputed
+  chunk-by-chunk from the saved (B, T) logsumexp, producing dx and dE
+  without any (B, T, V) residual.
+
+Peak extra memory: O(B·T·C) for one chunk. The matmuls stay MXU-native
+(bf16 operands, f32 accumulation via preferred_element_type).
+
+Reference role: the fused analog of the reference's per-op
+softmax-cross-entropy chain (`LossMCXENT` over a full logits INDArray).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_logits(x, emb_c):
+    """(B, T, D) @ (C, D)^T → (B, T, C) f32 — bf16 operands, f32 accum."""
+    return jax.lax.dot_general(
+        x, emb_c, (((2,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _forward_pieces(x, emb, targets, n_chunks):
+    V = emb.shape[0]
+    C = V // n_chunks
+    chunks = emb.reshape(n_chunks, C, emb.shape[1])
+
+    def body(carry, blk):
+        m, l, correct = carry
+        emb_c, c_start = blk
+        logits = _chunk_logits(x, emb_c)                     # (B, T, C)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        l = l * jnp.exp(m - m_new) \
+            + jnp.sum(jnp.exp(logits - m_new[..., None]), axis=-1)
+        # correct-class logit if the target falls in this chunk
+        local = targets - c_start
+        in_chunk = (local >= 0) & (local < C)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, C - 1)[..., None], axis=-1)[..., 0]
+        correct = correct + jnp.where(in_chunk, picked, 0.0)
+        return (m_new, l, correct), None
+
+    B, T = targets.shape
+    m0 = jnp.full((B, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, T), jnp.float32)
+    c0 = jnp.zeros((B, T), jnp.float32)
+    starts = jnp.arange(n_chunks) * C
+    (m, l, correct), _ = lax.scan(body, (m0, l0, c0), (chunks, starts))
+    lse = m + jnp.log(l)
+    return lse, correct
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(x, emb, targets, n_chunks):
+    """Mean token cross-entropy of ``x @ emb.T`` logits against ``targets``
+    without materializing the logits. x: (B, T, D) compute dtype;
+    emb: (V, D); targets: (B, T) int. V must divide by ``n_chunks``."""
+    lse, correct = _forward_pieces(x, emb, targets, n_chunks)
+    return jnp.mean(lse - correct)
+
+
+def _fwd(x, emb, targets, n_chunks):
+    lse, correct = _forward_pieces(x, emb, targets, n_chunks)
+    return jnp.mean(lse - correct), (x, emb, targets, lse)
+
+
+def _bwd(n_chunks, res, g):
+    x, emb, targets, lse = res
+    B, T = targets.shape
+    V, D = emb.shape
+    C = V // n_chunks
+    chunks = emb.reshape(n_chunks, C, D)
+    scale = (g / (B * T)).astype(jnp.float32)
+
+    def body(dx, blk):
+        emb_c, c_start = blk
+        logits = _chunk_logits(x, emb_c)                     # (B, T, C)
+        p = jnp.exp(logits - lse[..., None])                 # softmax chunk
+        local = targets - c_start
+        in_chunk = (local >= 0) & (local < C)
+        onehot = (jax.nn.one_hot(jnp.clip(local, 0, C - 1), C,
+                                 dtype=jnp.float32)
+                  * in_chunk[..., None])
+        dlog = (p - onehot) * scale                          # (B, T, C) f32
+        dlog_l = dlog.astype(x.dtype)
+        # dx contribution: (B,T,C) @ (C,D); accumulate in f32
+        dx = dx + jax.lax.dot_general(
+            dlog_l, emb_c, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dE chunk: (C, B*T) @ (B*T, D)
+        de_c = jax.lax.dot_general(
+            dlog_l.reshape(B * T, C), x.reshape(B * T, x.shape[-1]),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dx, de_c
+
+    dx0 = jnp.zeros(x.shape[:2] + (D,), jnp.float32)
+    starts = jnp.arange(n_chunks) * C
+    dx, de = lax.scan(body, dx0, (chunks, starts))
+    return (dx.astype(x.dtype), de.reshape(V, D).astype(emb.dtype),
+            None)
+
+
+chunked_softmax_xent.defvjp(_fwd, _bwd)

@@ -119,6 +119,10 @@ class TransformerConfig:
     fused_qkv: bool = False           # one (d, 3d) projection matmul per
                                       # block instead of three (d, d): fewer,
                                       # larger MXU ops + one HBM read of x
+    ce_chunks: int = 0                # >0: stream the LM cross-entropy over
+                                      # vocab chunks (kernels/chunked_ce) —
+                                      # the (B,T,V) logits tensor never
+                                      # materializes in fwd OR bwd
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -140,6 +144,11 @@ class TransformerConfig:
                 "loss cannot cross the pipeline's shard_map boundary)"
             if not self.microbatches:
                 self.microbatches = 2 * self.pipeline_stages
+        if self.ce_chunks:
+            assert self.ce_chunks > 1, "ce_chunks must be >= 2 (1 = off)"
+            assert self.vocab_size % self.ce_chunks == 0, \
+                f"vocab_size {self.vocab_size} must divide into " \
+                f"ce_chunks {self.ce_chunks}"
 
 
 class TransformerLM:
@@ -368,10 +377,10 @@ class TransformerLM:
         y = run(params["blocks"], x.reshape(M, B // M, t, d))
         return y.reshape(B, t, d)
 
-    def apply(self, params, tokens, rng=None, return_aux=False):
-        """tokens (B, T) int32 → logits (B, T, V). ``rng`` enables dropout
-        (training mode); None = inference. ``return_aux``: also return the
-        dict of auxiliary losses/stats (MoE load-balancing)."""
+    def _apply_trunk(self, params, tokens, rng):
+        """Everything up to (and incl.) the final layernorm. Returns
+        (hidden (B,T,D), casted tok_emb, aux dict) — the chunked-CE loss
+        consumes the trunk directly so logits never materialize."""
         c = self.config
         t = tokens.shape[1]
         if c.dtype != jnp.float32:
@@ -428,22 +437,37 @@ class TransformerLM:
                     x, a = self._block_math(blk, x, rng, li, self.mesh)
                     aux_total = aux_total + a
         x = self._ln(params["ln_f"], x)
-        logits = jnp.matmul(x, params["tok_emb"].T,
-                            preferred_element_type=jnp.float32)
+        return x, params["tok_emb"], {"moe_aux_loss": aux_total}
+
+    def apply(self, params, tokens, rng=None, return_aux=False):
+        """tokens (B, T) int32 → logits (B, T, V). ``rng`` enables dropout
+        (training mode); None = inference. ``return_aux``: also return the
+        dict of auxiliary losses/stats (MoE load-balancing)."""
+        x, emb, aux = self._apply_trunk(params, tokens, rng)
+        logits = jnp.matmul(x, emb.T, preferred_element_type=jnp.float32)
         if return_aux:
-            return logits, {"moe_aux_loss": aux_total}
+            return logits, aux
         return logits
 
     # ------------------------------------------------------------------- loss
     def loss_fn(self, params, tokens, targets, rng=None, with_aux=False):
-        logits, aux = self.apply(params, tokens, rng=rng, return_aux=True)
-        # fused cross-entropy: logsumexp − correct-logit avoids materializing
-        # the full (B, T, V) log-softmax in forward AND backward — ~35%
-        # step-time win at V=8192 (HBM-traffic bound, the usual TPU limiter)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        correct = jnp.take_along_axis(logits, targets[..., None],
-                                      axis=-1)[..., 0]
-        lm_loss = jnp.mean(lse - correct)
+        c = self.config
+        if c.ce_chunks:          # validated divisible in __post_init__
+            # streamed CE: the (B,T,V) logits tensor never materializes
+            # (kernels/chunked_ce — online logsumexp over vocab chunks)
+            from deeplearning4j_tpu.kernels.chunked_ce import (
+                chunked_softmax_xent)
+            x, emb, aux = self._apply_trunk(params, tokens, rng)
+            lm_loss = chunked_softmax_xent(x, emb, targets, c.ce_chunks)
+        else:
+            logits, aux = self.apply(params, tokens, rng=rng, return_aux=True)
+            # fused cross-entropy: logsumexp − correct-logit avoids
+            # materializing the (B, T, V) log-softmax in forward AND
+            # backward — ~35% step-time win at V=8192 (HBM-traffic bound)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            correct = jnp.take_along_axis(logits, targets[..., None],
+                                          axis=-1)[..., 0]
+            lm_loss = jnp.mean(lse - correct)
         loss = lm_loss
         if self.config.moe is not None:
             loss = loss + self.config.moe_aux_weight * aux["moe_aux_loss"]
