@@ -208,6 +208,104 @@ class SDVariable:
                 f"shape={self.shape})")
 
 
+def _counted_trip(c_sd, b_sd, loop_vars):
+    """Detect the counted-while pattern and return its static trip count,
+    or None. Pattern (what TF emits for ``i < T`` loops):
+
+    - cond output = Cmp(arg_k, K) (or Cmp(K, arg_k)) through Identity/
+      Squeeze wrappers, K a scalar constant in the cond graph;
+    - body output k = arg_k ± step, step a scalar constant;
+    - the k-th loop var's INITIAL value is a scalar constant.
+    """
+    def _resolve(sd, name, depth=8):
+        """Follow Identity/Squeeze chains to the producing op or leaf."""
+        for _ in range(depth):
+            prod = sd._producer.get(name)
+            if prod is None:
+                return name, None
+            if prod.op_name in ("Identity", "identity", "Squeeze", "squeeze"):
+                name = prod.inputs[0]
+                continue
+            return name, prod
+        return name, None
+
+    def _scalar_const(sd, name):
+        name, prod = _resolve(sd, name)
+        v = sd._values.get(name)
+        if v is not None and np.asarray(v).size == 1:
+            return float(np.asarray(v).reshape(()))
+        return None
+
+    def _arg_index(sd, name):
+        name, prod = _resolve(sd, name)
+        if prod is None and name.startswith("arg"):
+            try:
+                return int(name[3:].split(":")[0])
+            except ValueError:
+                return None
+        return None
+
+    try:
+        _, cmp_op = _resolve(c_sd, c_sd._branch_outputs[0])
+        if cmp_op is None:
+            return None
+        cmps = {"Less": "<", "less": "<", "LessEqual": "<=",
+                "less_equal": "<=", "Greater": ">", "greater": ">",
+                "GreaterEqual": ">=", "greater_equal": ">="}
+        sym = cmps.get(cmp_op.op_name)
+        if sym is None or len(cmp_op.inputs) != 2:
+            return None
+        a_idx = _arg_index(c_sd, cmp_op.inputs[0])
+        b_idx = _arg_index(c_sd, cmp_op.inputs[1])
+        if a_idx is not None and b_idx is None:
+            k, bound = a_idx, _scalar_const(c_sd, cmp_op.inputs[1])
+            flipped = False
+        elif b_idx is not None and a_idx is None:
+            k, bound = b_idx, _scalar_const(c_sd, cmp_op.inputs[0])
+            flipped = True
+        else:
+            return None
+        if bound is None:
+            return None
+        # body update of the counter: arg_k ± const step
+        _, upd = _resolve(b_sd, b_sd._branch_outputs[k])
+        if upd is None or upd.op_name not in ("Add", "add", "AddV2",
+                                              "Sub", "sub"):
+            return None
+        u_args = [_arg_index(b_sd, i) for i in upd.inputs]
+        if u_args[0] == k:
+            step = _scalar_const(b_sd, upd.inputs[1])
+        elif len(u_args) > 1 and u_args[1] == k \
+                and upd.op_name in ("Add", "add", "AddV2"):
+            step = _scalar_const(b_sd, upd.inputs[0])
+        else:
+            return None
+        if step is None or step == 0:
+            return None
+        if upd.op_name in ("Sub", "sub"):
+            step = -step
+        init_v = loop_vars[k]
+        raw = init_v.sd._values.get(init_v.name)
+        if init_v.var_type != VariableType.CONSTANT or raw is None \
+                or np.asarray(raw).size != 1:
+            return None
+        init = float(np.asarray(raw).reshape(()))
+        # normalize to "counter strictly approaches bound"
+        if flipped:                      # Cmp(K, arg_k) — mirror it
+            sym = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[sym]
+        if sym in ("<", "<=") and step > 0:
+            span = bound - init + (1 if sym == "<=" else 0)
+            trip = int(np.ceil(span / step))
+        elif sym in (">", ">=") and step < 0:
+            span = init - bound + (1 if sym == ">=" else 0)
+            trip = int(np.ceil(span / -step))
+        else:
+            return None                  # diverging loop — leave dynamic
+        return max(0, trip)
+    except Exception:                    # detection must never break import
+        return None
+
+
 class OpNode:
     """One node of the op graph (ref: ``samediff.internal.SameDiffOp``)."""
 
@@ -706,7 +804,8 @@ class SameDiff:
         sub._branch_outputs = [o.name for o in outs]
         return sub, outs
 
-    def _cf_node(self, op_name, name, inputs, subgraphs, out_templates):
+    def _cf_node(self, op_name, name, inputs, subgraphs, out_templates,
+                 attrs=None):
         """Register a control-flow OpNode whose output shapes/dtypes come
         from the branch's traced outputs."""
         node_name = self._unique(name or op_name.strip("_"))
@@ -714,7 +813,7 @@ class SameDiff:
         out_names = ([node_name] if n_out == 1
                      else [f"{node_name}#{i}" for i in range(n_out)])
         node = OpNode(node_name, op_name, [v.name for v in inputs],
-                      out_names, {}, subgraphs=subgraphs)
+                      out_names, attrs or {}, subgraphs=subgraphs)
         self._ops.append(node)
         outs = []
         for on, tmpl in zip(out_names, out_templates):
@@ -755,9 +854,13 @@ class SameDiff:
 
         ``cond_body(sub_sd, *state) -> scalar bool``;
         ``loop_body(sub_sd, *state) -> new state`` (same shapes/dtypes).
-        Lowers to ``lax.while_loop`` — forward-only (XLA while is not
-        reverse-differentiable; use a scan-style unrolled body for training,
-        same restriction as the reference's TF-imported while graphs).
+        Counted loops (``i < K; i += step`` with constant init/bound/step —
+        what TF emits for static-length sequence loops) are DETECTED and
+        lowered to ``lax.scan``, which is reverse-differentiable: imported
+        control flow in the training hot path gets gradients. Genuinely
+        data-dependent loops lower to ``lax.while_loop`` and stay
+        forward-only (XLA while has no reverse mode — the reference's
+        TF-imported while graphs share the restriction).
         """
         loop_vars = [self._lift(v) for v in loop_vars]
         c_sd, c_outs = self._build_body(cond_body, loop_vars)
@@ -776,8 +879,16 @@ class SameDiff:
                 f"while_loop body must preserve loop-var shapes/dtypes; "
                 f"mismatches (var, init shape/dtype, body shape/dtype): "
                 f"{mismatched}")
+        # counted-loop detection: `for i in range(k, C)` shapes (the form
+        # every TF while_loop over a static sequence length takes). When the
+        # trip count is provably static, the executor lowers to lax.scan —
+        # which IS reverse-differentiable — so imported control flow in the
+        # training hot path gets gradients (lax.while_loop cannot)
+        trip = _counted_trip(c_sd, b_sd, loop_vars)
         return self._cf_node("__while__", name, loop_vars,
-                             {"cond": c_sd, "body": b_sd}, b_outs)
+                             {"cond": c_sd, "body": b_sd}, b_outs,
+                             attrs=({"trip_count": int(trip)}
+                                    if trip is not None else None))
 
     whileLoop = while_loop
 
@@ -894,7 +1005,6 @@ class SameDiff:
                     if len(op.outputs) == 1 and isinstance(res, tuple):
                         res = res[0]
                 elif op.op_name == "__while__":
-                    c_fn = op.subgraphs["cond"]._branch_fn()
                     b_fn = op.subgraphs["body"]._branch_fn()
                     key = jax.random.fold_in(base_key, 1 + op_idx)
 
@@ -905,9 +1015,19 @@ class SameDiff:
                         return tuple(jnp.asarray(x).astype(s.dtype)
                                      for x, s in zip(r, st))
 
-                    res = jax.lax.while_loop(
-                        lambda st: jnp.squeeze(c_fn(*st)).astype(bool),
-                        _body, tuple(args))
+                    trip = op.attrs.get("trip_count")
+                    if trip is not None:
+                        # counted loop: lax.scan is reverse-differentiable,
+                        # so TF-imported control flow in the hot path TRAINS
+                        def _scan_body(st, _x, _b=_body):
+                            return _b(st), None
+                        res, _ = jax.lax.scan(_scan_body, tuple(args),
+                                              None, length=trip)
+                    else:
+                        c_fn = op.subgraphs["cond"]._branch_fn()
+                        res = jax.lax.while_loop(
+                            lambda st: jnp.squeeze(c_fn(*st)).astype(bool),
+                            _body, tuple(args))
                     if len(op.outputs) == 1:
                         res = res[0]
                 elif op.fn is not None:

@@ -119,6 +119,7 @@ class _ImportCtx:
         self.sd = sd
         self.vars: Dict[str, SDVariable] = {}     # tf tensor name -> SDVariable
         self.consts: Dict[str, np.ndarray] = {}   # tf node name -> numpy
+        self.node_defs: Dict[str, object] = {}    # tf node name -> NodeDef
         self.library: Dict[str, object] = library or {}  # FunctionDefs by name
 
     def const_value(self, ref: str) -> np.ndarray:
@@ -313,10 +314,45 @@ def _register_default_rules():
         return ctx.sd._op("batchnorm", x, mean, var, scale, offset,
                           epsilon=attrs.get("epsilon", 1e-3))
 
+    def _dynamic_ss(ctx, node, inputs, attrs):
+        """StridedSlice whose begin/end carry runtime values — the loop-body
+        ``x[:, i, :]`` pattern (begin depends on a While loop variable).
+        Supported form: every dynamically-indexed axis is a SHRINK axis
+        (size-1 select, lowered to a gather on that axis); other axes must
+        be fully masked (untouched). Anything else refuses loudly."""
+        bm = attrs.get("begin_mask", 0)
+        em = attrs.get("end_mask", 0)
+        sm = attrs.get("shrink_axis_mask", 0)
+        if attrs.get("new_axis_mask", 0) or attrs.get("ellipsis_mask", 0):
+            raise TFImportError(
+                "dynamic StridedSlice with new_axis/ellipsis unsupported")
+        strides = [int(v) for v in ctx.const_value(node.input[3])]
+        if any(s != 1 for s in strides):
+            raise TFImportError("dynamic StridedSlice needs unit strides")
+        nspec = len(strides)
+        out = inputs[0]
+        # gather from the HIGHEST axis down so earlier axis ids stay valid
+        for a in reversed(range(nspec)):
+            if (sm >> a) & 1:
+                idx = ctx.sd._op("gather", inputs[1],
+                                 ctx.sd.constant(np.asarray(a, np.int32)),
+                                 axis=0)
+                out = ctx.sd._op("gather", out, idx, axis=a)
+            elif (bm >> a) & 1 and (em >> a) & 1:
+                continue                       # full slice on this axis
+            else:
+                raise TFImportError(
+                    "dynamic StridedSlice: non-shrink, non-full axis "
+                    f"{a} unsupported (use masks or constant bounds)")
+        return out
+
     @mapping_rule("StridedSlice")
     def _ss(ctx, node, inputs, attrs):
-        begin = [int(v) for v in ctx.const_value(node.input[1])]
-        end = [int(v) for v in ctx.const_value(node.input[2])]
+        try:
+            begin = [int(v) for v in ctx.const_value(node.input[1])]
+            end = [int(v) for v in ctx.const_value(node.input[2])]
+        except TFImportError:
+            return _dynamic_ss(ctx, node, inputs, attrs)
         strides = [int(v) for v in ctx.const_value(node.input[3])]
         bm = attrs.get("begin_mask", 0)
         em = attrs.get("end_mask", 0)
@@ -443,7 +479,24 @@ def _register_default_rules():
 
     @mapping_rule("Fill")
     def _fill(ctx, node, inputs, attrs):
-        dims = [int(v) for v in ctx.const_value(node.input[0])]
+        try:
+            dims = [int(v) for v in ctx.const_value(node.input[0])]
+        except TFImportError:
+            # runtime-derived dims — tf.zeros((tf.shape(x)[0], D)) et al.
+            # Pattern-fold Pack(Shape(v)[i], const, …): tensor shapes are
+            # STATIC under the whole-graph jit, so each Shape slice becomes
+            # a template entry resolved from the ref tensor's shape at
+            # trace time (fill_template); unfoldable dims raise loudly
+            tpl = _shape_template(ctx, node.input[0])
+            if tpl is not None:
+                refs = [v for v in tpl if not isinstance(v, int)]
+                template = tuple(("shape", sum(1 for p in tpl[:i]
+                                               if not isinstance(p, int)),
+                                  v[1]) if not isinstance(v, int) else v
+                                 for i, v in enumerate(tpl))
+                return ctx.sd._op("fill_template", inputs[1],
+                                  *[r[0] for r in refs], template=template)
+            return ctx.sd._op("fill_dynamic", inputs[0], inputs[1])
         try:
             val = ctx.const_value(node.input[1])
             return ctx.sd.constant(np.full(dims, val), name=node.name)
@@ -960,6 +1013,36 @@ _register_default_rules()
 _register_extended_rules()
 
 
+def _shape_template(ctx, dims_ref):
+    """Fold a Pack of [Shape(v)[i] | const] elements into a template list
+    of ints and (SDVariable, axis) pairs; None when the pattern differs."""
+    pack = ctx.node_defs.get(dims_ref.split(":")[0])
+    if pack is None or pack.op not in ("Pack", "pack"):
+        return None
+    out = []
+    for inp in pack.input:
+        try:
+            out.append(int(np.asarray(ctx.const_value(inp)).reshape(())))
+            continue
+        except TFImportError:
+            pass
+        ss = ctx.node_defs.get(inp.split(":")[0])
+        if ss is None or ss.op != "StridedSlice":
+            return None
+        shp = ctx.node_defs.get(ss.input[0].split(":")[0])
+        if shp is None or shp.op not in ("Shape", "ShapeN"):
+            return None
+        try:
+            axis = int(np.asarray(ctx.const_value(ss.input[1])).reshape(-1)[0])
+        except TFImportError:
+            return None
+        ref_var = ctx.vars.get(_fq(shp.input[0]))
+        if ref_var is None:
+            return None
+        out.append((ref_var, axis))
+    return out
+
+
 def _fq(ref: str) -> str:
     """Normalize a tensor ref to 'node:index'. GraphDef refs are 'node' or
     'node:i'; FunctionDef refs are 'arg', 'node:out_name:i'."""
@@ -972,6 +1055,7 @@ def _fq(ref: str) -> str:
 def _map_nodes(ctx: _ImportCtx, nodes, skip=frozenset()):
     """Shared per-node rule walk for GraphDef.node and FunctionDef.node_def."""
     for node in nodes:
+        ctx.node_defs[node.name] = node
         if node.name in skip or node.op == "NoOp":
             continue
         if node.op == "Assert":

@@ -555,3 +555,25 @@ def bernoulli_sample(p, seed=None):
     from deeplearning4j_tpu.ndarray import random as _rng
     key = jax.random.key(int(seed)) if seed is not None else _rng.next_key()
     return jax.random.bernoulli(key, p).astype(p.dtype)
+
+
+@register("fill_dynamic")
+def fill_dynamic(dims, value):
+    """Fill whose dims arrive as a TENSOR (TF Fill with runtime-derived
+    dims, e.g. tf.zeros((tf.shape(x)[0], D))). Shapes are static under the
+    whole-graph jit, so the structural Shape→Pack chain is CONCRETE at
+    trace time; a genuinely data-dependent dims tensor raises jax's
+    concretization error (loud, by design)."""
+    shape = tuple(int(d) for d in np.asarray(dims))
+    return jnp.full(shape, value)
+
+
+@register("fill_template")
+def fill_template(value, *refs, template):
+    """Fill whose dims template mixes static ints with ("shape", ref_idx,
+    axis) entries resolved from the reference tensors' STATIC shapes at
+    trace time — the lowering of TF's Fill(Pack(Shape(x)[i], const…), v)
+    (tf.zeros((tf.shape(x)[0], D)) and friends) under whole-graph jit."""
+    shape = tuple(refs[e[1]].shape[e[2]] if isinstance(e, (tuple, list))
+                  else int(e) for e in template)
+    return jnp.full(shape, value)
