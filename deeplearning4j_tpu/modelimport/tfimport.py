@@ -1293,6 +1293,60 @@ def _fdef_builder(fdef, library):
     return build
 
 
+_FUNC_WRAPPER = re.compile(r"(^|.*/)Func/.*/(input|output)/_\d+$")
+
+
+def _elide_func_wrappers(nodes):
+    """Drop the pass-through Identity nodes TF's function INLINER inserts
+    (``Func/<scope>/input/_k`` / ``output/_k``) when control flow is lowered
+    (``lower_control_flow=True``), rewiring consumers to the wrapped tensor.
+    The V1 frame analyzer partitions nodes by Enter/Exit frames; these
+    wrappers sit OUTSIDE the frames while referencing tensors inside them,
+    which otherwise breaks the partition (round-3 finding)."""
+    # only single-input wrappers are pure pass-throughs; one carrying
+    # control deps (stateful-op ordering) is kept — dropping it would lose
+    # execution-ordering edges
+    subst = {n.name: n.input[0] for n in nodes
+             if n.op == "Identity" and _FUNC_WRAPPER.match(n.name)
+             and len(n.input) == 1}
+    if not subst:
+        return nodes
+
+    def resolve(ref):
+        ctrl = ref.startswith("^")
+        base = ref.lstrip("^").split(":")[0]
+        suffix = None
+        seen = set()
+        while base in subst:
+            if base in seen:
+                raise TFImportError(
+                    f"cyclic Func-wrapper chain at {base!r}")
+            seen.add(base)
+            nxt = subst[base]
+            base = nxt.lstrip("^").split(":")[0]
+            suffix = nxt.split(":", 1)[1] if ":" in nxt else None
+        if not seen:
+            return ref
+        if ctrl:
+            return "^" + base
+        return base + (":" + suffix if suffix else "")
+
+    out = []
+    for n in nodes:
+        if n.name in subst:
+            continue
+        new_inputs = [resolve(ref) for ref in n.input]
+        if new_inputs != list(n.input):
+            # copy before rewiring: the caller's GraphDef stays untouched
+            copied = type(n)()
+            copied.CopyFrom(n)
+            del copied.input[:]
+            copied.input.extend(new_inputs)
+            n = copied
+        out.append(n)
+    return out
+
+
 class TFGraphMapper:
     """ref: TFGraphMapper#importGraph — GraphDef → SameDiff."""
 
@@ -1306,10 +1360,11 @@ class TFGraphMapper:
         ctx = _ImportCtx(sd, library=library)
         from deeplearning4j_tpu.modelimport.tf_v1_control_flow import (
             has_v1_control_flow)
-        if has_v1_control_flow(gd.node):
-            _map_nodes_v1(ctx, gd.node, skip=set(ignore_nodes))
+        nodes = _elide_func_wrappers(list(gd.node))
+        if has_v1_control_flow(nodes):
+            _map_nodes_v1(ctx, nodes, skip=set(ignore_nodes))
         else:
-            _map_nodes(ctx, gd.node, skip=set(ignore_nodes))
+            _map_nodes(ctx, nodes, skip=set(ignore_nodes))
         return sd
 
     importGraph = import_graph

@@ -107,3 +107,43 @@ def test_dynamic_while_without_counter_stays_forward_only():
     assert wops[0].attrs.get("trip_count") is None
     res = sd.output({"x": np.float32(3.0)}, [out.name])
     assert float(res[out.name]) == 192.0
+
+
+def test_lowered_control_flow_with_func_wrappers():
+    """DEFAULT freezing (lower_control_flow=True) produces V1 frames plus
+    the inliner's Func/*/input|output/_N pass-through Identities that sit
+    outside the frames; the elision pre-pass rewires them so the V1 frame
+    rewriter sees a clean partition."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    w = tf.Variable(tf.random.normal((2 * D, D), stddev=0.1, seed=2))
+    b = tf.Variable(tf.zeros((D,)))
+
+    @tf.function
+    def f(x):
+        h0 = tf.zeros((tf.shape(x)[0], D))
+        i0 = tf.constant(0)
+
+        def cond(i, h):
+            return i < T
+
+        def body(i, h):
+            return i + 1, tf.tanh(tf.concat([x[:, i, :], h], 1) @ w + b)
+
+        _, hT = tf.while_loop(cond, body, [i0, h0])
+        return hT
+
+    frozen = convert_variables_to_constants_v2(
+        f.get_concrete_function(
+            tf.TensorSpec((None, T, D), tf.float32, name="x")))
+    gd = frozen.graph.as_graph_def()
+    assert any(n.op == "Enter" for n in gd.node)      # really lowered
+    sd = TFGraphMapper.import_graph(gd)
+    x = np.random.default_rng(3).normal(size=(B, T, D)).astype(np.float32)
+    tf_out = f(tf.constant(x)).numpy()
+    res = sd.output({"x": x})
+    outs = [np.asarray(v) for v in (res.values() if isinstance(res, dict)
+                                    else [res])
+            if getattr(v, "shape", None) == tf_out.shape]
+    assert min(float(np.abs(o - tf_out).max()) for o in outs) < 1e-4
