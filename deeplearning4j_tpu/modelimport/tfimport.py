@@ -1303,12 +1303,14 @@ def _elide_func_wrappers(nodes):
     The V1 frame analyzer partitions nodes by Enter/Exit frames; these
     wrappers sit OUTSIDE the frames while referencing tensors inside them,
     which otherwise breaks the partition (round-3 finding)."""
-    # only single-input wrappers are pure pass-throughs; one carrying
-    # control deps (stateful-op ordering) is kept — dropping it would lose
-    # execution-ordering edges
+    # a wrapper is a pass-through when its one DATA input is first and any
+    # remaining inputs are control edges — which this importer drops
+    # globally by design (functional executor; ordering comes from the
+    # topo walk, see the control-edge skip in _map_nodes)
     subst = {n.name: n.input[0] for n in nodes
              if n.op == "Identity" and _FUNC_WRAPPER.match(n.name)
-             and len(n.input) == 1}
+             and n.input and not n.input[0].startswith("^")
+             and all(r.startswith("^") for r in n.input[1:])}
     if not subst:
         return nodes
 
