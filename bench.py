@@ -201,14 +201,17 @@ def flax_baseline_timer(cfg, batch, iters):
     return StepTimer(flax_step, params, opt_state, toks, tgts, iters)
 
 
+def resolve_platform(force_cpu: bool = False):
+    """Shared probe-or-skip: BENCH_CPU=1 (or force_cpu) skips the probe —
+    the sitecustomize in this container re-sets JAX_PLATFORMS=axon at
+    interpreter startup, so the env-var route alone can't force CPU."""
+    if force_cpu or os.environ.get("BENCH_CPU") == "1":
+        return "cpu", None
+    return probe_accelerator()
+
+
 def main():
-    if os.environ.get("BENCH_CPU") == "1":
-        # local smoke-test escape hatch: the sitecustomize in this container
-        # re-sets JAX_PLATFORMS=axon at interpreter startup, so the env-var
-        # route can't force CPU — skip the probe explicitly instead
-        platform, err = "cpu", None
-    else:
-        platform, err = probe_accelerator()
+    platform, err = resolve_platform()
     tpu_error = None
     if platform is None or platform == "cpu":
         if err:
