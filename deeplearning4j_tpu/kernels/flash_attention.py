@@ -23,8 +23,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 256x512 tiles: ~4x fewer grid cells and larger MXU matmuls than the
+# round-2 128x128 defaults (measured slow on v5e); the device-timed sweep
+# in benchmarks/flash_crossover.py refines these per (d_head, T)
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
