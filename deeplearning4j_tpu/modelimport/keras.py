@@ -147,10 +147,12 @@ def _map_layer(cls: str, cfg: dict):
     use_bias = cfg.get("use_bias", True)
     name = cfg.get("name")
 
-    if cls in ("InputLayer", "Flatten"):
-        # Flatten is implicit in DenseLayer's CNN→FF handling (ref:
-        # KerasFlatten → preprocessor); nothing to instantiate.
+    if cls == "InputLayer":
         return None
+    if cls == "Flatten":
+        # explicit row-major flatten (ref: KerasFlatten → preprocessor);
+        # NHWC order matches Keras so Dense kernels line up
+        return L.FlattenLayer(name=name)
     if cls == "Dense":
         return L.DenseLayer(name=name, n_out=cfg["units"], activation=act,
                             has_bias=use_bias)
@@ -159,6 +161,16 @@ def _map_layer(cls: str, cfg: dict):
         return L.DropoutLayer(name=name, dropout=1.0 - cfg["rate"])
     if cls == "Activation":
         return L.ActivationLayer(name=name, activation=act)
+    if cls == "Reshape":
+        return L.ReshapeLayer(name=name,
+                              target_shape=tuple(cfg["target_shape"]))
+    if cls == "Permute":
+        return L.PermuteLayer(name=name, dims=tuple(cfg["dims"]))
+    if cls == "RepeatVector":
+        return L.RepeatVectorLayer(name=name, n=int(cfg["n"]))
+    if cls in ("SpatialDropout2D", "SpatialDropout1D"):
+        # channel-wise dropout (ref: KerasSpatialDropout → SpatialDropout)
+        return L.SpatialDropoutLayer(name=name, dropout=1.0 - cfg["rate"])
     if cls == "Conv2D" or cls == "Convolution2D":
         return L.ConvolutionLayer(
             name=name, n_out=cfg["filters"],

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deeplearning4j_tpu.nn import activations as _act
@@ -237,6 +238,122 @@ class ActivationLayer(Layer):
 class DropoutLayer(Layer):
     def apply(self, params, x, training=False, rng=None, state=None):
         return self._maybe_dropout(x, training, rng), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SpatialDropoutLayer(Layer):
+    """Channel-wise dropout: whole feature maps drop together (ref:
+    conf.dropout.SpatialDropout / KerasSpatialDropout). ``dropout`` is the
+    RETAIN probability, matching the base-layer convention."""
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        if not training or rng is None or self.dropout is None \
+                or self.dropout >= 1.0:
+            return x, state
+        keep = self.dropout
+        # mask one value per (example, channel); broadcast over space/time
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state
+
+
+@register_layer
+@dataclasses.dataclass
+class FlattenLayer(Layer):
+    """(N, ...) → (N, ∏dims) row-major (ref: KerasFlatten; NHWC order
+    matches Keras so following Dense kernels line up element-for-element)."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.array_elements())
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ReshapeLayer(Layer):
+    """Row-wise reshape to ``target_shape`` (ref: Keras-import
+    KerasReshape → ReshapePreprocessor — here a first-class layer; the
+    batch dim is untouched)."""
+    target_shape: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        self.target_shape = tuple(int(s) for s in self.target_shape)
+
+    def _resolved(self, total: Optional[int]) -> Tuple[int, ...]:
+        t = self.target_shape
+        if -1 not in t:
+            return t
+        if t.count(-1) > 1:
+            raise ValueError(f"reshape target {t} has multiple -1 dims")
+        if not total:
+            raise ValueError(
+                f"reshape target {t} needs a known input size to resolve -1")
+        known = int(np.prod([d for d in t if d != -1]))
+        return tuple(total // known if d == -1 else d for d in t)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = self._resolved(input_type.array_elements())
+        self.target_shape = t            # pin for apply()
+        if len(t) == 1:
+            return InputType.feed_forward(t[0])
+        if len(t) == 2:
+            return InputType.recurrent(t[1], t[0])
+        if len(t) == 3:
+            return InputType.convolutional(t[0], t[1], t[2])
+        raise ValueError(f"unsupported reshape target {t}")
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        shape = self._resolved(int(np.prod(x.shape[1:])))
+        return x.reshape((x.shape[0],) + shape), state
+
+
+@register_layer
+@dataclasses.dataclass
+class PermuteLayer(Layer):
+    """Permute non-batch dims, 1-indexed like Keras (ref: Keras-import
+    KerasPermute → PermutePreprocessor)."""
+    dims: Tuple[int, ...] = ()
+
+    def __post_init__(self):
+        self.dims = tuple(int(d) for d in self.dims)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn" and self.dims == (2, 1):
+            tsl = input_type.timeseries_length
+            if tsl is None or tsl < 0:
+                raise ValueError(
+                    "Permute((2,1)) on variable-length recurrent input: the "
+                    "permuted feature size would be the (unknown) sequence "
+                    "length — fix the input length")
+            return InputType.recurrent(tsl, input_type.size)
+        if input_type.kind == "cnn" and len(self.dims) == 3:
+            hwc = (input_type.height, input_type.width, input_type.channels)
+            p = tuple(hwc[d - 1] for d in self.dims)
+            return InputType.convolutional(*p)
+        raise ValueError(
+            f"Permute dims {self.dims} unsupported for input kind "
+            f"{input_type.kind!r}")
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        perm = (0,) + tuple(d for d in self.dims)
+        return jnp.transpose(x, perm), state
+
+
+@register_layer
+@dataclasses.dataclass
+class RepeatVectorLayer(Layer):
+    """(N, C) → (N, n, C) (ref: Keras-import KerasRepeatVector /
+    conf.layers.misc.RepeatVector)."""
+    n: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.size, self.n)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        return jnp.repeat(x[:, None, :], int(self.n), axis=1), state
 
 
 # ------------------------------------------------------------------- conv2d

@@ -334,3 +334,55 @@ def test_custom_layer_builder_registry(tmp_path):
                                    atol=1e-5)
     finally:
         ki._CUSTOM_LAYERS.clear()
+
+
+class TestStructuralLayers:
+    """Round-3 additions: Reshape/Permute/RepeatVector (ref: KerasReshape/
+    KerasPermute/KerasRepeatVector) — imported nets must match live Keras."""
+
+    def _roundtrip(self, model, x, tmp_path):
+        import os
+        import numpy as np
+        from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+        p = os.path.join(str(tmp_path), "m.h5")
+        model.save(p)
+        net = KerasModelImport.importKerasSequentialModelAndWeights(p)
+        ref = model.predict(x, verbose=0)
+        got = net.output(x).toNumpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+        return net
+
+    def test_reshape_then_dense(self, tmp_path):
+        import numpy as np
+        keras = pytest.importorskip("tensorflow").keras
+        m = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Reshape((3, 4)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(5, activation="relu"),
+        ])
+        x = np.random.default_rng(0).normal(size=(2, 12)).astype(np.float32)
+        self._roundtrip(m, x, tmp_path)
+
+    def test_repeat_vector_into_lstm(self, tmp_path):
+        import numpy as np
+        keras = pytest.importorskip("tensorflow").keras
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.RepeatVector(4),
+            keras.layers.LSTM(3),
+        ])
+        x = np.random.default_rng(1).normal(size=(2, 6)).astype(np.float32)
+        self._roundtrip(m, x, tmp_path)
+
+    def test_permute_on_sequence(self, tmp_path):
+        import numpy as np
+        keras = pytest.importorskip("tensorflow").keras
+        m = keras.Sequential([
+            keras.layers.Input((4, 6)),
+            keras.layers.Permute((2, 1)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3),
+        ])
+        x = np.random.default_rng(2).normal(size=(2, 4, 6)).astype(np.float32)
+        self._roundtrip(m, x, tmp_path)
