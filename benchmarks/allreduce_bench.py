@@ -1,0 +1,112 @@
+"""Gradient-sync allreduce bandwidth (BASELINE.md row 3: "measure XLA
+collective over ICI; record GB/s vs theoretical").
+
+The reference's gradient-sharing transport (Aeron UDP mesh + threshold
+codec, SURVEY P3/J13) is replaced by GSPMD-emitted dense allreduce; this
+microbench measures that path directly: a psum over the ``data`` axis of a
+parameter-sized f32 buffer, device-timed (XPlane) when possible.
+
+On a real multi-chip slice the number is ICI bandwidth; on the virtual CPU
+mesh it validates the harness (numbers are host-memory-bound and labeled as
+such). Algorithmic bytes for a ring allreduce: 2·(n-1)/n · size per chip.
+
+Run: python benchmarks/allreduce_bench.py [--devices N] [--mb SIZE_MB]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench import resolve_platform  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=0,
+                    help="virtual device count when not on TPU (default 8)")
+    ap.add_argument("--mb", type=float, default=64.0,
+                    help="buffer size in MiB (default 64 ≈ a 16M-param f32 "
+                         "gradient shard)")
+    args = ap.parse_args()
+
+    platform, err = resolve_platform()
+    if platform is None or platform == "cpu":
+        if err:
+            print(f"[allreduce] accelerator unavailable: {err}",
+                  file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if platform is None or platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices or 8)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = len(devs)
+    platform = devs[0].platform
+    if n < 2:
+        print(json.dumps({
+            "metric": "allreduce_busbw_gbps", "value": None,
+            "unit": "GB/s", "vs_baseline": None, "platform": platform,
+            "note": f"single {platform} device — allreduce needs >=2; run "
+                    f"on a slice or with virtual devices"}))
+        return
+
+    mesh = Mesh(np.array(devs), ("data",))
+    elems = int(args.mb * (1 << 20) // 4)
+    x = jax.device_put(
+        jnp.arange(elems * n, dtype=jnp.float32).reshape(n, elems),
+        NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def allreduce(x):
+        f = shard_map(lambda s: jax.lax.psum(s, "data"), mesh=mesh,
+                      in_specs=P("data", None), out_specs=P("data", None))
+        return f(x.reshape(n, 1, elems)).reshape(n, elems)
+
+    out = allreduce(x)
+    jax.block_until_ready(out)           # warm/compile
+
+    iters, runs = 5, []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = allreduce(out)
+        float(out[0, 0])                 # value fetch = sync
+        runs.append((time.perf_counter() - t0) / iters)
+    sec = statistics.median(runs)
+
+    size = elems * 4
+    # ring-allreduce bus bandwidth convention: 2(n-1)/n · size / time
+    busbw = 2 * (n - 1) / n * size / sec / 1e9
+    out_json = {
+        "metric": "allreduce_busbw_gbps",
+        "value": round(busbw, 2),
+        "unit": "GB/s",
+        "vs_baseline": None,             # v5e ICI theoretical filled on HW
+        "platform": platform,
+        "devices": n,
+        "buffer_mb": args.mb,
+        "sec_per_allreduce": round(sec, 6),
+        "note": ("host-memory-bound virtual mesh (harness validation)"
+                 if platform == "cpu" else
+                 "ICI path; compare to v5e 1.6 TB/s ICI per chip"),
+    }
+    print(json.dumps(out_json))
+
+
+if __name__ == "__main__":
+    main()
