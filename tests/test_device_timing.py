@@ -1,0 +1,109 @@
+"""Unit tests for the XPlane device-timing parser (benchmarks/device_timing)
+— the round-3 measurement spine. Uses hand-built XSpace protos so the parse
+contract (device planes only, module-line filtering, fingerprint stripping,
+ps→s conversion) is pinned without TPU hardware."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+xplane_pb2 = pytest.importorskip(
+    "tensorflow.tsl.profiler.protobuf.xplane_pb2")
+
+_BENCH_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks")
+sys.path.insert(0, _BENCH_DIR)
+try:
+    import device_timing  # noqa: E402
+finally:
+    # scoped import: don't leave benchmarks/ shadowing generic module names
+    # for every later test in the session
+    sys.path.remove(_BENCH_DIR)
+
+
+def _space(tmp_path, planes):
+    """planes: [(plane_name, line_name, [(event_name, duration_ps)])]."""
+    sp = xplane_pb2.XSpace()
+    for plane_name, line_name, events in planes:
+        plane = sp.planes.add()
+        plane.name = plane_name
+        next_id = 1
+        line = plane.lines.add()
+        line.name = line_name
+        for ev_name, dur in events:
+            md = plane.event_metadata[next_id]
+            md.id = next_id
+            md.name = ev_name
+            ev = line.events.add()
+            ev.metadata_id = next_id
+            ev.duration_ps = dur
+            next_id += 1
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    (d / "host.xplane.pb").write_bytes(sp.SerializeToString())
+    return str(tmp_path)
+
+
+def test_module_times_reads_device_plane_only(tmp_path):
+    logdir = _space(tmp_path, [
+        ("/device:TPU:0", "XLA Modules",
+         [("jit_step(123456)", 5_000_000), ("jit_step(123456)", 7_000_000)]),
+        # host plane carries dispatch time — must be IGNORED
+        ("/host:CPU", "XLA Modules", [("jit_step(123456)", 99_000_000_000)]),
+    ])
+    times = device_timing.module_times(logdir)
+    assert list(times) == ["jit_step"]           # fingerprint stripped
+    np.testing.assert_allclose(times["jit_step"], [5e-6, 7e-6])
+
+
+def test_measure_device_step_matches_prefix_and_median(tmp_path):
+    """Drives measure_device_step itself: the pre-seeded synthetic device
+    plane survives the (host-only, CPU) profiler trace in the same logdir,
+    so matching, median selection and the explicit-logdir no-cleanup path
+    all execute for real."""
+    logdir = _space(tmp_path, [
+        ("/device:TPU:0", "XLA Modules",
+         [("jit_train(9)", 2_000_000), ("jit_train(9)", 4_000_000),
+          ("jit_train(9)", 10_000_000), ("jit_OTHER(1)", 1)]),
+    ])
+    r = device_timing.measure_device_step(lambda: None, "jit_train",
+                                          logdir=logdir)
+    assert r is not None and r["module"] == "jit_train"
+    assert r["n"] == 3
+    assert r["median_s"] == pytest.approx(4e-6)
+    assert r["min_s"] == pytest.approx(2e-6)
+    assert r["logdir"] == logdir               # explicit dir: kept, reported
+    assert os.path.isdir(logdir)               # and not cleaned up
+
+    # no match for a different prefix
+    assert device_timing.measure_device_step(
+        lambda: None, "jit_absent", logdir=logdir) is None
+
+
+def test_custom_pseudo_planes_skipped(tmp_path):
+    logdir = _space(tmp_path, [
+        ("/device:CUSTOM:Megascale Trace", "XLA Modules",
+         [("jit_step(1)", 1_000_000)]),
+        ("/device:TPU:0", "XLA Modules", [("jit_step(1)", 3_000_000)]),
+    ])
+    times = device_timing.module_times(logdir)
+    np.testing.assert_allclose(times["jit_step"], [3e-6])
+
+
+def test_op_times_aggregation(tmp_path):
+    logdir = _space(tmp_path, [
+        ("/device:TPU:0", "XLA Ops",
+         [("fusion.1", 2_000_000), ("fusion.1", 3_000_000),
+          ("copy.2", 1_000_000)]),
+    ])
+    rows = device_timing.op_times(logdir)
+    assert rows[0][0] == "fusion.1"
+    assert rows[0][1] == pytest.approx(5e-6)
+    assert rows[0][2] == 2
+
+
+def test_empty_trace_returns_empty(tmp_path):
+    (tmp_path / "plugins").mkdir()
+    assert device_timing.module_times(str(tmp_path)) == {}
