@@ -55,6 +55,13 @@ SPECS = {
         _x((2, 4, 4, 2)), {}),
     "Upsampling2D": (lambda: L.Upsampling2D(size=(2, 2)),
                      _x((2, 3, 3, 2)), {}),
+    "FlattenLayer": (lambda: L.FlattenLayer(), _x((2, 3, 4)), {}),
+    "ReshapeLayer": (lambda: L.ReshapeLayer(target_shape=(2, 6)),
+                     _x((3, 12)), {}),
+    "PermuteLayer": (lambda: L.PermuteLayer(dims=(2, 1)), _x((2, 3, 4)), {}),
+    "RepeatVectorLayer": (lambda: L.RepeatVectorLayer(n=3), _x((2, 5)), {}),
+    "SpatialDropoutLayer": (lambda: L.SpatialDropoutLayer(dropout=0.5),
+                            _x((2, 4, 4, 2)), {}),
     "ZeroPaddingLayer": (lambda: L.ZeroPaddingLayer(padding=(1, 1)),
                          _x((2, 3, 3, 2)), {}),
     "Cropping2D": (lambda: L.Cropping2D(cropping=(1, 1)),
