@@ -1,0 +1,25 @@
+#!/bin/bash
+# Tunnel watcher: polls the axon TPU tunnel; on the first live window it
+# runs the queued hardware measurements and writes results into the repo
+# (BENCH_r03_live.json + benchmarks/ logs). Safe to leave running — exits
+# after one successful capture or when the kill file appears.
+cd /root/repo
+LOG=benchmarks/tunnel_watcher.log
+echo "[watcher] started $(date -u +%H:%M:%S)" >> "$LOG"
+while true; do
+  [ -f /tmp/stop_tunnel_watcher ] && { echo "[watcher] stopped" >> "$LOG"; exit 0; }
+  if timeout 75 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" 2>/dev/null; then
+    echo "[watcher] TUNNEL LIVE $(date -u +%H:%M:%S) — capturing" >> "$LOG"
+    timeout 1500 python bench.py > BENCH_r03_live.json 2>> "$LOG" \
+      && echo "[watcher] bench.py done: $(cat BENCH_r03_live.json)" >> "$LOG"
+    timeout 900 python benchmarks/flash_crossover.py \
+      > benchmarks/flash_crossover_live.txt 2>> "$LOG" \
+      && echo "[watcher] crossover done" >> "$LOG"
+    timeout 900 python benchmarks/ring_attention_bench.py --tpu \
+      > benchmarks/ring_live.txt 2>> "$LOG" \
+      && echo "[watcher] ring done" >> "$LOG"
+    echo "[watcher] capture complete $(date -u +%H:%M:%S)" >> "$LOG"
+    exit 0
+  fi
+  sleep 180
+done
