@@ -210,20 +210,24 @@ def resolve_platform(force_cpu: bool = False):
     return probe_accelerator()
 
 
-def main():
-    platform, err = resolve_platform()
-    tpu_error = None
-    if platform is None or platform == "cpu":
-        if err:
-            tpu_error = err
-            print(f"[bench] ACCELERATOR INIT FAILED — falling back to CPU.\n"
-                  f"[bench] cause: {err}", file=sys.stderr)
-        # force CPU before importing jax so the hanging plugin is never touched
-        os.environ["JAX_PLATFORMS"] = "cpu"
+def measure(rung: str, force_cpu: bool = False) -> dict:
+    """One full measurement at a given size rung ("small" | "large" | "cpu").
+
+    Runs in the CURRENT process: callers that want wedge-protection against a
+    dying tunnel run this via a ``--worker`` subprocess with a hard timeout
+    (a remote-PJRT RPC that loses its transport can block forever and cannot
+    be interrupted in-process — round-3 lesson: a 20-minute window died
+    during one warmup and took the whole bench with it)."""
+    t_start = time.perf_counter()
+
+    def phase(msg):
+        print(f"[bench:{rung}] t+{time.perf_counter() - t_start:5.1f}s {msg}",
+              file=sys.stderr, flush=True)
 
     import jax
 
-    if platform is None or platform == "cpu":
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
@@ -236,26 +240,37 @@ def main():
     devices = jax.devices()
     platform = devices[0].platform
     on_tpu = platform != "cpu"
-    print(f"[bench] platform={platform} devices={len(devices)}",
-          file=sys.stderr)
+    phase(f"platform={platform} devices={len(devices)}")
+    if rung != "cpu" and not on_tpu:
+        # the tunnel dropped between the parent's probe and this worker's
+        # init — a CPU-smoke number must never masquerade as a TPU phase
+        raise RuntimeError(f"worker rung {rung!r} came up on platform="
+                           f"{platform}; refusing to measure")
 
-    # TPU: ~190M params so the MXU (not HBM) sets the ceiling; the attention
-    # backend is the measured auto policy (XLA attention at seq 1024, see
+    # The attention backend is the measured auto policy (XLA attention below
     # transformer.FLASH_MIN_SEQ). Override via BENCH_FLASH=0/1 for A/B runs.
     if os.environ.get("BENCH_FLASH"):
         transformer_mod.FLASH_ATTENTION = os.environ["BENCH_FLASH"] == "1"
 
     def build_cfg(remat):
+        if not on_tpu:                       # CPU smoke (driver fallback)
+            return TransformerConfig(
+                vocab_size=1024, n_layers=2, n_heads=4, d_model=128,
+                max_len=128, dtype=jnp.float32, remat=remat, fused_qkv=True,
+                ce_chunks=0)
+        if rung == "small":
+            # the round-2 proven-on-hardware shape: compiles in tens of
+            # seconds through the tunnel — banks a device-timed number
+            # early in a window before the large config is attempted
+            return TransformerConfig(
+                vocab_size=16384, n_layers=4, n_heads=8, d_model=512,
+                max_len=512, dtype=jnp.bfloat16, remat=remat, fused_qkv=True,
+                ce_chunks=4)
+        # "large": ~190M params so the MXU (not HBM) sets the ceiling
         return TransformerConfig(
-            vocab_size=32768 if on_tpu else 1024,
-            n_layers=12 if on_tpu else 2,
-            n_heads=16 if on_tpu else 4,
-            d_model=1024 if on_tpu else 128,
-            max_len=1024 if on_tpu else 128,
-            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-            remat=remat,
-            fused_qkv=True,
-            ce_chunks=8 if on_tpu else 0,   # V=32768 streams as 8x4096
+            vocab_size=32768, n_layers=12, n_heads=16, d_model=1024,
+            max_len=1024, dtype=jnp.bfloat16, remat=remat, fused_qkv=True,
+            ce_chunks=8,                     # V=32768 streams as 8x4096
         )
 
     iters = 10 if on_tpu else 5
@@ -265,7 +280,12 @@ def main():
     # OOM ladder: full batch → remat (recompute activations) → half batch.
     # HBM is 16 GB on v5e; the warmup step is where RESOURCE_EXHAUSTED
     # surfaces, so each rung is attempted through it
-    ladder = ([(8, False), (8, True), (4, True)] if on_tpu else [(4, False)])
+    if not on_tpu:
+        ladder = [(4, False)]
+    elif rung == "small":
+        ladder = [(32, False), (16, False)]
+    else:
+        ladder = [(8, False), (8, True), (4, True)]
     last_err = None
     for batch, remat in ladder:
         cfg = build_cfg(remat)
@@ -278,7 +298,9 @@ def main():
             rng.integers(0, cfg.vocab_size, (batch, cfg.max_len)), jnp.int32)
         tgts = jnp.roll(toks, -1, axis=1)
         try:
+            phase(f"warmup (compile) batch={batch} remat={remat}")
             ours = StepTimer(step, params, opt_state, toks, tgts, iters)
+            phase("warmup done")
             break
         except Exception as e:
             if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" \
@@ -297,19 +319,23 @@ def main():
     # --- plain-Flax denominator on the same chip, measured INTERLEAVED ---
     flax_timer = None
     try:
+        phase("flax denominator warmup (compile)")
         flax_timer = flax_baseline_timer(cfg, batch, iters)
     except Exception as e:  # measured best-effort; failure is reported, not hidden
         print(f"[bench] flax baseline failed: {e!r}", file=sys.stderr)
 
-    for _ in range(repeats):
+    for i in range(repeats):
+        phase(f"timed window {i + 1}/{repeats}")
         ours.run_window()
         if flax_timer is not None:
             flax_timer.run_window()
     # device-timed windows (the headline number on TPU)
     if on_tpu:
+        phase("traced windows (device timing)")
         ours.run_traced_window("jit_step")
         if flax_timer is not None:
             flax_timer.run_traced_window("jit_flax_step")
+    phase("measurement done")
 
     host_tps = ours.host_tokens_per_sec()
     dev_tps = ours.device_tokens_per_sec()
@@ -363,6 +389,75 @@ def main():
               "trustworthy on this transport; treat value/mfu as an upper "
               "bound and vs_baseline (same-method ratio) as the meaningful "
               "number", file=sys.stderr)
+    return out
+
+
+WORKER_MARK = "WORKER_JSON:"
+WORKER_BUDGET_S = {"small": 420, "large": 900}
+
+
+def run_worker_phase(rung: str):
+    """Run ``measure(rung)`` in a subprocess with a hard timeout, so a
+    tunnel that dies mid-phase (hanging remote-PJRT RPC) costs one phase,
+    not the whole bench. Returns (result_dict | None, error | None)."""
+    try:
+        # stderr inherits the parent's so the worker's phase() progress
+        # markers stream LIVE into the watcher log while a phase hangs
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", rung],
+            stdout=subprocess.PIPE, stderr=None, text=True,
+            timeout=WORKER_BUDGET_S[rung])
+    except subprocess.TimeoutExpired:
+        print(f"[bench] {rung} phase timed out after "
+              f"{WORKER_BUDGET_S[rung]}s", file=sys.stderr)
+        return None, f"{rung} phase timed out after {WORKER_BUDGET_S[rung]}s"
+    for line in (r.stdout or "").splitlines():
+        if line.startswith(WORKER_MARK):
+            return json.loads(line[len(WORKER_MARK):]), None
+    return None, (f"{rung} phase rc={r.returncode}: "
+                  f"{(r.stdout or '').strip()[-800:]}")
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        out = measure(sys.argv[2])
+        print(WORKER_MARK + json.dumps(out), flush=True)
+        return
+
+    platform, err = resolve_platform()
+    if platform is not None and platform != "cpu":
+        # TPU path: small first (banks a device-timed number inside a short
+        # tunnel window), then the ~190M-param headline config; each phase
+        # wedge-proof behind its own subprocess timeout
+        phases, errors = {}, {}
+        for rung in ("small", "large"):
+            res, perr = run_worker_phase(rung)
+            if res is not None:
+                phases[rung] = res
+            else:
+                errors[rung] = perr
+        best = phases.get("large") or phases.get("small")
+        if best is not None:
+            best["phases"] = {
+                k: {kk: v[kk] for kk in ("value", "vs_baseline", "mfu",
+                                         "device_step_ms", "timing_source",
+                                         "n_params", "platform",
+                                         "timing_suspect")
+                    if kk in v}
+                for k, v in phases.items()}
+            if errors:
+                best["phase_errors"] = errors
+            print(json.dumps(best))
+            return
+        err = "; ".join(f"{k}: {v}" for k, v in errors.items()) or err
+
+    # CPU fallback — loud, with the cause in the JSON
+    tpu_error = None
+    if err:
+        tpu_error = err
+        print(f"[bench] ACCELERATOR RUN FAILED — falling back to CPU.\n"
+              f"[bench] cause: {err}", file=sys.stderr)
+    out = measure("cpu", force_cpu=True)
     if tpu_error:
         out["tpu_init_error"] = tpu_error[:500]
     print(json.dumps(out))

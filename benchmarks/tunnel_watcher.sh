@@ -10,12 +10,21 @@ while true; do
   [ -f /tmp/stop_tunnel_watcher ] && { echo "[watcher] stopped" >> "$LOG"; exit 0; }
   if timeout 75 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" 2>/dev/null; then
     echo "[watcher] TUNNEL LIVE $(date -u +%H:%M:%S) — capturing" >> "$LOG"
-    timeout 1500 python bench.py > BENCH_r03_live.json 2>> "$LOG" \
+    # bench.py runs its TPU phases in its own timeout-wrapped subprocesses
+    # (small config first to bank a number inside a short window)
+    timeout 1700 python bench.py > BENCH_r03_live.json 2>> "$LOG" \
       && echo "[watcher] bench.py done: $(cat BENCH_r03_live.json)" >> "$LOG"
-    timeout 900 python benchmarks/flash_crossover.py \
+    # a real capture is a non-empty JSON whose platform is not cpu; an
+    # empty file (outer-timeout kill) or CPU fallback must keep watching
+    if ! grep -q '"platform": "tpu"\|"platform": "axon"' BENCH_r03_live.json 2>/dev/null; then
+      echo "[watcher] no TPU capture (window closed?) — resuming watch" >> "$LOG"
+      sleep 180
+      continue
+    fi
+    timeout 600 python benchmarks/flash_crossover.py \
       > benchmarks/flash_crossover_live.txt 2>> "$LOG" \
       && echo "[watcher] crossover done" >> "$LOG"
-    timeout 900 python benchmarks/ring_attention_bench.py --tpu \
+    timeout 600 python benchmarks/ring_attention_bench.py --tpu \
       > benchmarks/ring_live.txt 2>> "$LOG" \
       && echo "[watcher] ring done" >> "$LOG"
     echo "[watcher] capture complete $(date -u +%H:%M:%S)" >> "$LOG"
