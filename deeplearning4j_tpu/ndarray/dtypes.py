@@ -42,6 +42,25 @@ _NAME_TO_DTYPE = {
 
 FLOATING_DTYPES = (jnp.float64, jnp.float32, jnp.float16, jnp.bfloat16)
 
+# stable ordinals for the packed shape-info descriptor
+# (ref: DataType enum ordinal slot in the nd4j shape-info buffer; the
+# numbering here is this framework's own stable table, not Java's)
+_ORDINALS = {
+    np.dtype(np.float64): 1, np.dtype(np.float32): 2,
+    np.dtype(np.float16): 3, np.dtype(jnp.bfloat16): 4,
+    np.dtype(np.int64): 5, np.dtype(np.int32): 6,
+    np.dtype(np.int16): 7, np.dtype(np.int8): 8,
+    np.dtype(np.uint8): 9, np.dtype(np.uint16): 10,
+    np.dtype(np.uint32): 11, np.dtype(np.uint64): 12,
+    np.dtype(np.bool_): 13,
+}
+
+
+def type_ordinal(dtype) -> int:
+    """Ordinal for ``dtype`` in shape-info descriptors; distinct dtypes get
+    distinct ordinals so descriptor comparison implies dtype equality."""
+    return _ORDINALS[np.dtype(dtype)]
+
 
 def resolve(dtype) -> jnp.dtype:
     """Accept a string name, numpy/jnp dtype, or python type; return jnp dtype."""

@@ -1,0 +1,262 @@
+"""INDArray surface, tranche 4 — the remaining reference name-tail.
+
+Reference: ``org.nd4j.linalg.api.ndarray.INDArray`` / ``BaseNDArray``
+(nd4j-api). Tranches 1-3 (ndarray.py, surface.py) covered the working core;
+this tranche closes the last distinct-name families:
+
+- shape-info/layout descriptors (``shapeInfo``/``shapeInfoDataBuffer``/
+  stride accessors) — ND4J exposes its packed shape-info buffer; here the
+  equivalent descriptor is synthesized from the jax array's logical shape
+  (XLA owns physical layout on TPU, SURVEY N1 divergence)
+- the deprecated-era linear-view accessors (``linearView``/``majorStride``…)
+  that the ~700-signature count includes
+- unsafe flat-offset accessors (``putScalarUnsafe``/``getDoubleUnsafe``)
+- the sparse-protocol surface on dense arrays (``toDense``/``nnz``/
+  ``getVectorCoordinates``; format-specific accessors raise, exactly as
+  ``BaseNDArray`` throws for dense inputs)
+- explicit ``*AlongDimension`` reduction entry points and the remaining
+  Number accessors
+- list/compat helpers (``sliceVectors``, ``checkDimensions``,
+  ``javaTensorAlongDimension``, the deprecated ``tensorssAlongDimension``
+  spelling, ``leverageOrDetach``)
+
+Signature-level coverage accounting lives in ``ndarray/parity.py``; tests in
+tests/test_ndarray_surface.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+
+
+def extend_tranche4():
+    N = NDArray
+
+    # ------------------------------------------------- shape-info family
+    def shapeInfo(self):
+        """ref: INDArray#shapeInfo — human-readable shape descriptor."""
+        return (f"Rank: {self.rank()}, Offset: 0, Order: c, "
+                f"shape: {list(self.shape)}, stride: {list(self.stride())}")
+
+    def shapeInfoDataBuffer(self):
+        """ref: INDArray#shapeInfoDataBuffer — the packed shape-info vector
+        [rank, shape..., stride..., dtypeOrdinal, elementWiseStride,
+        orderChar]. Synthesized: XLA owns the physical layout."""
+        from deeplearning4j_tpu.ndarray import dtypes as _dt
+        return np.asarray([self.rank(), *self.shape, *self.stride(),
+                           _dt.type_ordinal(self.dtype),
+                           self.elementWiseStride(), ord("c")], np.int64)
+
+    N.shapeInfo = shapeInfo
+    N.shapeInfoDataBuffer = shapeInfoDataBuffer
+    N.shapeInfoJava = lambda self: [int(v) for v in
+                                    self.shapeInfoDataBuffer()]
+    N.jvmShapeInfo = lambda self: tuple(self.shapeInfoJava())
+    N.getTrailingOnes = lambda self: next(
+        (i for i, s in enumerate(reversed(self.shape)) if s != 1),
+        len(self.shape))
+    N.getLeadingOnes = lambda self: next(
+        (i for i, s in enumerate(self.shape) if s != 1), len(self.shape))
+    N.underlyingRank = lambda self: self.rank()
+    N.originalOffset = lambda self: 0
+
+    # deprecated-era stride accessors (row-major logical strides)
+    N.majorStride = lambda self: self.stride()[0] if self.rank() else 1
+    N.secondaryStride = lambda self: (self.stride()[1] if self.rank() > 1
+                                      else 1)
+    N.innerMostStride = lambda self: self.stride()[-1] if self.rank() else 1
+    # ref: #linearView / #linearViewColumnOrder / #resetLinearView — the
+    # pre-2016 flat-view API the signature count still carries
+    N.linearView = lambda self: self.ravel()
+    N.linearViewColumnOrder = lambda self: self.ravel("f")
+    N.resetLinearView = lambda self: self
+    N.isView = N.is_view                       # reference spelling
+    N.isWrapAround = lambda self: False
+
+    # ---------------------------------------------- compression bookkeeping
+    # ref: #markAsCompressed(boolean) — compression here is codec-level
+    # (kernels/threshold.py), not a buffer state; accepted as a no-op
+    N.markAsCompressed = lambda self, flag=True: self
+
+    # -------------------------------------------------- unsafe accessors
+    # ref: #putScalarUnsafe(long offset, double) / #getDoubleUnsafe(long)
+    def putScalarUnsafe(self, offset, value):
+        flat = self.buf().reshape(-1).at[int(offset)].set(value)
+        return self._write(flat.reshape(self.shape))
+
+    N.putScalarUnsafe = putScalarUnsafe
+    N.getDoubleUnsafe = lambda self, offset: float(
+        self.buf().reshape(-1)[int(offset)])
+
+    # ------------------------------------------------ sparse protocol
+    # ref: BaseNDArray#toDense (identity for dense), #nnz,
+    # #getVectorCoordinates; format-specific accessors throw for dense
+    # arrays in the reference too
+    N.toDense = lambda self: self
+    N.nnz = lambda self: int(jnp.sum(self.buf() != 0))
+
+    def getVectorCoordinates(self):
+        flat = np.asarray(self.buf()).reshape(-1)
+        return NDArray(jnp.asarray(np.nonzero(flat)[0].astype(np.int64)))
+
+    N.getVectorCoordinates = getVectorCoordinates
+
+    def _dense_only(self, *a, **k):
+        raise NotImplementedError(
+            "not a sparse ndarray (ref: BaseNDArray throws "
+            "UnsupportedOperationException for dense inputs)")
+
+    N.sparseInfoDataBuffer = _dense_only
+
+    # ----------------------------------- along-dimension reduction family
+    # ref: #max(int...)/#min/#prod/#std/#var/#norm1/#norm2/#normmax with
+    # dimensions — explicit *AlongDimension entry points (the result-array
+    # overloads collapse onto these; see parity.py)
+    def _along(fn):
+        def f(self, *dims):
+            return NDArray(jnp.asarray(fn(self.buf(), dims or None)))
+        return f
+
+    N.maxAlongDimension = _along(lambda a, ax: jnp.max(a, axis=ax))
+    N.minAlongDimension = _along(lambda a, ax: jnp.min(a, axis=ax))
+    N.prodAlongDimension = _along(lambda a, ax: jnp.prod(a, axis=ax))
+    N.stdAlongDimension = _along(lambda a, ax: jnp.std(a, axis=ax, ddof=1))
+    N.varAlongDimension = _along(lambda a, ax: jnp.var(a, axis=ax, ddof=1))
+    N.norm1AlongDimension = _along(
+        lambda a, ax: jnp.sum(jnp.abs(a), axis=ax))
+    N.norm2AlongDimension = _along(
+        lambda a, ax: jnp.sqrt(jnp.sum(jnp.square(a), axis=ax)))
+    N.normmaxAlongDimension = _along(
+        lambda a, ax: jnp.max(jnp.abs(a), axis=ax))
+    N.cumsumAlongDimension = lambda self, dim: NDArray(
+        jnp.cumsum(self.buf(), axis=dim))
+    N.norm2NumberAlong = lambda self, *dims: NDArray(jnp.asarray(
+        jnp.sqrt(jnp.sum(jnp.square(self.buf()), axis=dims or None))))
+    N.normmaxNumberAlong = lambda self, *dims: NDArray(jnp.asarray(
+        jnp.max(jnp.abs(self.buf()), axis=dims or None)))
+    N.asumNumber = lambda self: float(jnp.sum(jnp.abs(self.buf())))
+
+    # ------------------------------------------------------ compat helpers
+    N.javaTensorAlongDimension = lambda self, i, *dims: \
+        self.tensorAlongDimension(i, *dims)
+    # the deprecated double-s spelling the reference kept for binary compat
+    N.tensorssAlongDimension = lambda self, *dims: \
+        self.tensorsAlongDimension(*dims)
+
+    def sliceVectors(self, out=None):
+        """ref: #sliceVectors(List<INDArray>) — appends this array's row
+        vectors to ``out`` (returned; created when omitted). Rows are
+        write-through views, as in the reference."""
+        if out is None:
+            out = []
+        if self.rank() <= 1:
+            out.append(self)
+        else:
+            for i in range(self.shape[0]):
+                out.append(self[i])
+        return out
+
+    N.sliceVectors = sliceVectors
+
+    def checkDimensions(self, other):
+        """ref: #checkDimensions(INDArray) — assert shape compatibility."""
+        o = _unwrap(other)
+        if tuple(o.shape) != self.shape:
+            raise ValueError(
+                f"shape mismatch: {self.shape} vs {tuple(o.shape)}")
+        return self
+
+    N.checkDimensions = checkDimensions
+    # ref: #leverageOrDetach(String) — no workspaces (SURVEY J5 yes-D)
+    N.leverageOrDetach = lambda self, ws_id=None: self
+
+    def getString(self, i):
+        """ref: #getString(long) — utf8 arrays only; numeric arrays throw,
+        matching the reference."""
+        a = np.asarray(self.buf())
+        if a.dtype.kind not in ("U", "S"):
+            raise TypeError("getString is defined for utf8 arrays only "
+                            f"(dtype={a.dtype})")
+        return str(a.reshape(-1)[int(i)])
+
+    N.getString = getString
+
+    # sum/mean: widen with the #sum(INDArray result, int... dim) overload
+    # (result written in place and returned)
+    def _result_reduce(base):
+        def f(self, *args, **kw):
+            if args and isinstance(args[0], NDArray):
+                result, *dims = args
+                out = base(self, tuple(dims) or None, **kw)
+                return result._write(out.buf().astype(result.dtype))
+            return base(self, *args, **kw)
+        return f
+
+    N.sum = _result_reduce(N.sum)
+    N.mean = _result_reduce(N.mean)
+
+    # scalar accessors: the reference's single-``long`` overloads index
+    # LINEARLY on multi-dim arrays (#getDouble(long) walks the flattened
+    # buffer); the multi-index overloads index by coordinate. Widen the
+    # existing coordinate accessors with the linear form.
+    def _linear_get(cast):
+        def f(self, *idx):
+            b = self.buf()
+            if not idx:
+                return cast(b)
+            if len(idx) == 1 and not isinstance(idx[0], (tuple, list)) \
+                    and b.ndim > 1:
+                return cast(b.reshape(-1)[int(idx[0])])
+            if len(idx) == 1 and isinstance(idx[0], (tuple, list)):
+                idx = tuple(idx[0])
+            return cast(b[tuple(int(i) for i in idx)])
+        return f
+
+    N.getDouble = _linear_get(float)
+    N.getFloat = _linear_get(float)
+    N.getInt = _linear_get(int)
+    N.getLong = _linear_get(int)
+    N.getNumber = _linear_get(float)
+
+    # putScalar: accept the (long, double) linear form, the (long[], v)
+    # coordinate form, and the flattened (i, j, ..., v) varargs overloads
+    def putScalar(self, *args):
+        *idx, value = args
+        if len(idx) == 1 and isinstance(idx[0], (tuple, list, np.ndarray)):
+            idx = tuple(int(i) for i in idx[0])
+        else:
+            idx = tuple(int(i) for i in idx)
+        b = self.buf()
+        if len(idx) == 1 and b.ndim > 1:     # linear overload
+            flat = b.reshape(-1).at[idx[0]].set(value)
+            return self._write(flat.reshape(self.shape))
+        return self._write(b.at[idx].set(value))
+
+    N.putScalar = putScalar
+
+    # stride(): widen the existing no-arg form with the #stride(int dim)
+    # overload from the reference
+    _stride_all = N.stride
+
+    def stride(self, dim=None):
+        s = _stride_all(self)
+        return s if dim is None else s[dim]
+
+    N.stride = stride
+
+    # broadcast(): widen with the #broadcast(INDArray result) overload
+    _broadcast_shape = N.broadcast
+
+    def broadcast(self, *arg):
+        if len(arg) == 1 and isinstance(arg[0], NDArray):
+            result = arg[0]
+            return result._write(jnp.broadcast_to(
+                self.buf(), result.shape).astype(result.dtype))
+        return _broadcast_shape(self, *arg)
+
+    N.broadcast = broadcast
+
+
+extend_tranche4()

@@ -296,3 +296,183 @@ class TestTransformsFacade:
     def test_stabilize(self):
         out = Transforms.stabilize(nd.create(np.array([1e6, -1e6])))
         assert out.toNumpy().max() <= 80.0
+
+
+class TestTranche4ShapeInfo:
+    def test_shape_info_family(self, a):
+        s = a.shapeInfo()
+        assert "Rank: 2" in s and "[2, 3]" in s
+        buf = a.shapeInfoDataBuffer()
+        assert buf[0] == 2 and list(buf[1:3]) == [2, 3]
+        assert a.shapeInfoJava() == [int(v) for v in buf]
+        assert a.jvmShapeInfo() == tuple(a.shapeInfoJava())
+
+    def test_leading_trailing_ones(self):
+        x = nd.create(np.zeros((1, 1, 4, 2, 1)))
+        assert x.getLeadingOnes() == 2
+        assert x.getTrailingOnes() == 1
+        assert nd.create(np.zeros((3, 4))).getLeadingOnes() == 0
+
+    def test_stride_accessors(self, a):
+        assert a.stride() == (3, 1)
+        assert a.stride(0) == 3 and a.stride(1) == 1
+        assert a.majorStride() == 3
+        assert a.secondaryStride() == 1
+        assert a.innerMostStride() == 1
+        assert a.underlyingRank() == 2
+        assert a.originalOffset() == 0
+
+    def test_linear_view(self, a):
+        np.testing.assert_allclose(a.linearView().toNumpy(),
+                                   a.toNumpy().reshape(-1))
+        np.testing.assert_allclose(a.linearViewColumnOrder().toNumpy(),
+                                   a.toNumpy().reshape(-1, order="F"))
+        assert a.resetLinearView() is a
+        assert not a.isView()
+        assert not a.isWrapAround()
+
+
+class TestTranche4Accessors:
+    def test_linear_scalar_get(self, a):
+        # reference semantics: single-long accessors walk the FLAT buffer
+        assert a.getDouble(4) == 4.0
+        assert a.getDouble(1, 1) == 4.0
+        assert a.getFloat(5) == 5.0
+        assert a.getInt(1, 2) == 5
+        assert a.getLong(0) == 0
+        assert a.getNumber(3) == 3.0
+
+    def test_linear_put_scalar(self, a):
+        a.putScalar(4, 99.0)                  # linear overload
+        assert a.toNumpy()[1, 1] == 99.0
+        a.putScalar(0, 2, 7.0)                # (row, col, value) varargs
+        assert a.toNumpy()[0, 2] == 7.0
+        a.putScalar((1, 0), 5.0)              # coordinate-array overload
+        assert a.toNumpy()[1, 0] == 5.0
+
+    def test_unsafe_accessors(self, a):
+        a.putScalarUnsafe(5, -1.0)
+        assert a.getDoubleUnsafe(5) == -1.0
+        assert a.toNumpy()[1, 2] == -1.0
+
+    def test_get_string_raises_for_numeric(self, a):
+        with pytest.raises(TypeError):
+            a.getString(0)
+
+
+class TestTranche4SparseProtocol:
+    def test_dense_backed_sparse_surface(self):
+        x = nd.create(np.array([[0.0, 2.0], [3.0, 0.0]]))
+        assert x.toDense() is x
+        assert x.nnz() == 2
+        np.testing.assert_array_equal(x.getVectorCoordinates().toNumpy(),
+                                      [1, 2])
+        with pytest.raises(NotImplementedError):
+            x.sparseInfoDataBuffer()
+        assert x.markAsCompressed() is x
+
+
+class TestTranche4AlongDimension:
+    def test_reduction_family(self, a):
+        x = a.toNumpy()
+        np.testing.assert_allclose(a.maxAlongDimension(0).toNumpy(),
+                                   x.max(0))
+        np.testing.assert_allclose(a.minAlongDimension(1).toNumpy(),
+                                   x.min(1))
+        np.testing.assert_allclose(a.prodAlongDimension(0).toNumpy(),
+                                   x.prod(0))
+        np.testing.assert_allclose(a.stdAlongDimension(0).toNumpy(),
+                                   x.std(0, ddof=1))
+        np.testing.assert_allclose(a.varAlongDimension(1).toNumpy(),
+                                   x.var(1, ddof=1))
+        np.testing.assert_allclose(a.norm1AlongDimension(0).toNumpy(),
+                                   np.abs(x).sum(0))
+        np.testing.assert_allclose(a.norm2AlongDimension(1).toNumpy(),
+                                   np.sqrt((x ** 2).sum(1)), rtol=1e-6)
+        np.testing.assert_allclose(a.normmaxAlongDimension(0).toNumpy(),
+                                   np.abs(x).max(0))
+        np.testing.assert_allclose(a.cumsumAlongDimension(1).toNumpy(),
+                                   x.cumsum(1))
+        np.testing.assert_allclose(a.norm2NumberAlong(0).toNumpy(),
+                                   np.sqrt((x ** 2).sum(0)), rtol=1e-6)
+        assert a.asumNumber() == np.abs(x).sum()
+
+
+class TestTranche4Compat:
+    def test_tensor_aliases(self, a):
+        np.testing.assert_allclose(
+            a.javaTensorAlongDimension(0, 1).toNumpy(),
+            a.tensorAlongDimension(0, 1).toNumpy())
+        assert a.tensorssAlongDimension(1) == a.tensorsAlongDimension(1)
+
+    def test_slice_vectors(self, a):
+        out = []
+        ret = a.sliceVectors(out)
+        assert ret is out and len(out) == 2
+        np.testing.assert_allclose(out[1].toNumpy(), a.toNumpy()[1])
+
+    def test_check_dimensions(self, a):
+        assert a.checkDimensions(nd.zeros(2, 3)) is a
+        with pytest.raises(ValueError):
+            a.checkDimensions(nd.zeros(3, 2))
+        assert a.leverageOrDetach("ws") is a
+
+    def test_broadcast_result_overload(self):
+        v = nd.create(np.array([1.0, 2.0, 3.0]))
+        r = nd.zeros(2, 3)
+        out = v.broadcast(r)
+        assert out is r
+        np.testing.assert_allclose(r.toNumpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+class TestSignatureParity:
+    def test_manifest_fully_mapped_and_counts(self):
+        from deeplearning4j_tpu.ndarray import parity
+        covered, total, missing = parity.coverage(strict=True)
+        assert missing == []
+        assert covered == total
+        # round-3 breadth gate (VERDICT r2 item 2): >=400 reference
+        # signatures covered, >=280 distinct method names
+        assert covered >= 400, covered
+        assert parity.distinct_method_count() >= 280
+        # no duplicate signature rows padding the count
+        seen = set()
+        for fam, entries in parity.SIGNATURES.items():
+            for sig, _py in entries:
+                assert (fam, sig) not in seen
+                seen.add((fam, sig))
+
+
+class TestOverloadSpotChecks:
+    """One live call per multi-overload manifest row family, so 'covered'
+    means callable-with-those-arguments, not just name-exists."""
+
+    def test_result_arg_reductions(self, a):
+        r = nd.zeros(3)
+        out = a.sum(r, 0)
+        assert out is r
+        np.testing.assert_allclose(r.toNumpy(), a.toNumpy().sum(0))
+        r2 = nd.zeros(2)
+        np.testing.assert_allclose(a.mean(r2, 1).toNumpy(),
+                                   a.toNumpy().mean(1))
+
+    def test_order_char_overloads(self, a):
+        np.testing.assert_allclose(a.dup("f").toNumpy(), a.toNumpy())
+        np.testing.assert_allclose(a.ravel("f").toNumpy(),
+                                   a.toNumpy().ravel(order="F"))
+        np.testing.assert_allclose(a.reshape("c", 3, 2).toNumpy(),
+                                   a.toNumpy().reshape(3, 2))
+
+    def test_row_col_dup_flag(self, a):
+        row = a.getRow(1, True)          # detached copy
+        row.putScalar(0, 99.0)
+        assert a.toNumpy()[1, 0] != 99.0
+
+    def test_percentile_with_dims(self, a):
+        np.testing.assert_allclose(
+            a.percentile(50.0, 0).toNumpy(),
+            np.percentile(a.toNumpy(), 50.0, axis=0))
+
+    def test_reduction_keepdims_overload(self, a):
+        assert a.sum(0, True).shape == (1, 3)
+        assert a.max(1, True).shape == (2, 1)
