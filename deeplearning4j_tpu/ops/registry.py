@@ -52,6 +52,19 @@ def register(name: str, fn: Callable = None, *, num_outputs: int = 1, aliases: S
     return do_register
 
 
+def alias(existing: str, *spellings: str):
+    """Bind alternate spellings to an existing OpDef. Raises on collision
+    with a DIFFERENT op — silent clobbering is how alias bugs start."""
+    op = get(existing)
+    for s in spellings:
+        bound = _REGISTRY.get(s)
+        if bound is not None and bound is not op:
+            raise ValueError(
+                f"alias {s!r} already bound to op {bound.name!r}; "
+                f"refusing to rebind to {op.name!r}")
+        _REGISTRY[s] = op
+
+
 def register_platform(name: str, fn: Callable):
     """Attach an accelerated override (Pallas kernel) to an existing op."""
     _REGISTRY[name].platform_fn = fn

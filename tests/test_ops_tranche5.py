@@ -1,0 +1,305 @@
+"""Tranche-5 op tests — one behavioral case per family (ref: libnd4j
+declarable/legacy inventories; the per-op unit pattern of SURVEY §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.registry import exec_op, has
+
+
+class TestLegacyCasts:
+    def test_cast_family(self):
+        x = jnp.asarray([1.5, 2.5])
+        wide_i = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        assert exec_op("to_float32", x).dtype == jnp.float32
+        assert exec_op("to_int32", x).dtype == jnp.int32
+        assert exec_op("to_int64", x).dtype == wide_i
+        assert exec_op("to_uint32", jnp.asarray([1, 2])).dtype == jnp.uint32
+        assert exec_op("to_float16", x).dtype == jnp.float16
+
+
+class TestLegacyRandom:
+    def test_shapes_and_state(self):
+        exec_op("set_seed", 42)
+        a = exec_op("normal", (3, 2), 1.0, 0.5)
+        assert a.shape == (3, 2)
+        u = exec_op("uniform", (100,), 2.0, 3.0)
+        assert float(u.min()) >= 2.0 and float(u.max()) <= 3.0
+        t = exec_op("truncatednormal", (200,), 0.0, 1.0)
+        assert float(jnp.abs(t).max()) <= 2.0 + 1e-6
+        ln = exec_op("lognormal", (50,))
+        assert float(ln.min()) > 0.0
+        b = exec_op("binomial", (50,), 10, 0.5)
+        assert 0 <= float(b.min()) and float(b.max()) <= 10
+        e = exec_op("exponential_distribution", (50,), 2.0)
+        assert float(e.min()) >= 0.0
+        assert int(exec_op("get_seed")) == 42
+
+    def test_seeded_reproducible(self):
+        a = exec_op("normal", (4,), seed=7)
+        b = exec_op("normal", (4,), seed=7)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestReduce3Distances:
+    def setup_method(self, _m):
+        self.x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        self.y = jnp.asarray([[1.0, 0.0], [0.0, 4.0]])
+
+    def test_euclidean_manhattan(self):
+        np.testing.assert_allclose(
+            float(exec_op("euclidean", self.x, self.y)),
+            np.sqrt(4.0 + 9.0))
+        np.testing.assert_allclose(
+            float(exec_op("manhattan", self.x, self.y)), 5.0)
+        np.testing.assert_allclose(
+            np.asarray(exec_op("manhattan", self.x, self.y, 1)), [2.0, 3.0])
+
+    def test_cosine_jaccard_hamming(self):
+        v1 = jnp.asarray([1.0, 0.0]); v2 = jnp.asarray([1.0, 0.0])
+        assert float(exec_op("cosinesim", v1, v2)) == pytest.approx(1.0)
+        assert float(exec_op("cosinedistance", v1, v2)) == pytest.approx(0.0)
+        assert float(exec_op("hammingdistance", self.x, self.y)) == 2.0
+        j = float(exec_op("jaccarddistance",
+                          jnp.asarray([1.0, 1.0]), jnp.asarray([1.0, 0.0])))
+        assert j == pytest.approx(0.5)
+
+
+class TestLinalgTail:
+    def test_cholesky_solve(self):
+        a = jnp.asarray([[4.0, 2.0], [2.0, 3.0]])
+        b = jnp.asarray([1.0, 2.0])
+        chol = jnp.linalg.cholesky(a)
+        x = exec_op("cholesky_solve", chol, b)
+        np.testing.assert_allclose(np.asarray(a @ x), np.asarray(b),
+                                   atol=1e-5)
+
+    def test_sqrtm(self):
+        a = jnp.asarray([[4.0, 0.0], [0.0, 9.0]])
+        np.testing.assert_allclose(np.asarray(exec_op("sqrtm", a)),
+                                   [[2, 0], [0, 3]], atol=1e-5)
+
+    def test_gemm_gemv_dot(self):
+        a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        c = jnp.ones((2, 2))
+        out = exec_op("gemm", a, a, c, alpha=2.0, beta=1.0, transB=True)
+        np.testing.assert_allclose(
+            np.asarray(out), 2 * np.asarray(a) @ np.asarray(a).T + 1)
+        v = jnp.asarray([1.0, 1.0])
+        np.testing.assert_allclose(np.asarray(exec_op("gemv", a, v)),
+                                   [3.0, 7.0])
+        assert float(exec_op("dot_product", v, v)) == 2.0
+
+
+class TestArithmeticSpellings:
+    def test_mod_div_family(self):
+        x, y = jnp.asarray([7.0, -7.0]), jnp.asarray([3.0, 3.0])
+        np.testing.assert_allclose(np.asarray(exec_op("floormod", x, y)),
+                                   [1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(exec_op("remainder", x, y)),
+                                   [1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(exec_op("realdiv", x, y)),
+                                   np.asarray(x) / 3.0)
+        np.testing.assert_allclose(np.asarray(exec_op("truncatediv", x, y)),
+                                   [2.0, -2.0])
+        np.testing.assert_allclose(
+            np.asarray(exec_op("reversemod", jnp.asarray([3.0]),
+                               jnp.asarray([7.0]))), [1.0])
+
+    def test_pairwise_assign_setscalar(self):
+        x, y = jnp.asarray([1.0, 5.0]), jnp.asarray([3.0, 2.0])
+        np.testing.assert_allclose(np.asarray(exec_op("max_pairwise", x, y)),
+                                   [3.0, 5.0])
+        np.testing.assert_allclose(np.asarray(exec_op("min_pairwise", x, y)),
+                                   [1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(exec_op("assign_add", x, y)),
+                                   [4.0, 7.0])
+        np.testing.assert_allclose(np.asarray(exec_op("assign_sub", x, y)),
+                                   [-2.0, 3.0])
+        np.testing.assert_allclose(np.asarray(exec_op("set_scalar", x, 9.0)),
+                                   [9.0, 9.0])
+        np.testing.assert_allclose(
+            np.asarray(exec_op("compare_and_set", x, 1.0, 0.0)), [0.0, 5.0])
+
+    def test_bits(self):
+        assert int(exec_op("popcount", jnp.asarray(7))) == 3
+        out = exec_op("cyclic_rshift_bits", jnp.asarray(2, jnp.int32), 1)
+        assert int(out) == 1
+
+
+class TestActivationTail:
+    def test_hard_swish_and_derivatives(self):
+        x = jnp.asarray([-4.0, 0.0, 4.0])
+        np.testing.assert_allclose(np.asarray(exec_op("hard_swish", x)),
+                                   [0.0, 0.0, 4.0], atol=1e-6)
+        t = jnp.asarray([0.3])
+        np.testing.assert_allclose(
+            np.asarray(exec_op("tanhderivative", t)),
+            np.asarray(1 - jnp.tanh(t) ** 2), rtol=1e-6)
+        s = exec_op("softmaxderivative", jnp.asarray([1.0, 2.0]))
+        sm = jax.nn.softmax(jnp.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(s), np.asarray(sm * (1 - sm)),
+                                   rtol=1e-6)
+
+    def test_alpha_dropout_moments(self):
+        x = jax.random.normal(jax.random.key(0), (20000,))
+        y = exec_op("alpha_dropout", x, p=0.3, seed=1)
+        assert abs(float(y.mean())) < 0.1
+        assert abs(float(y.std()) - 1.0) < 0.15
+        np.testing.assert_array_equal(
+            np.asarray(exec_op("alpha_dropout", x, p=0.3, training=False)),
+            np.asarray(x))
+
+
+class TestLossTail:
+    def test_softmax_ce_with_logits(self):
+        logits = jnp.asarray([[2.0, 1.0, 0.0]])
+        labels = jnp.asarray([[1.0, 0.0, 0.0]])
+        expect = -jax.nn.log_softmax(logits)[0, 0]
+        np.testing.assert_allclose(
+            np.asarray(exec_op("softmax_cross_entropy_with_logits",
+                               logits, labels)), [float(expect)], rtol=1e-6)
+
+    def test_log_poisson(self):
+        lp = exec_op("log_poisson_loss", jnp.asarray([0.5]),
+                     jnp.asarray([2.0]))
+        np.testing.assert_allclose(np.asarray(lp), [np.exp(0.5) - 2 * 0.5],
+                                   rtol=1e-6)
+
+    def test_ctc_loss_grad_matches_autodiff(self):
+        B, T, C, S = 2, 5, 4, 2
+        logp = jax.nn.log_softmax(
+            jax.random.normal(jax.random.key(0), (B, T, C)))
+        labels = jnp.asarray([[1, 2], [2, 3]], jnp.int32)
+        lt = jnp.asarray([T, T]); st = jnp.asarray([S, S])
+        g = exec_op("ctc_loss_grad", logp, labels, lt, st)
+        g2 = jax.grad(lambda lp: jnp.sum(exec_op(
+            "ctc_loss", lp, labels, lt, st)))(logp)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2), atol=1e-6)
+
+
+class TestCtcDecoders:
+    def test_greedy(self):
+        # sequence: a a blank b -> "ab"
+        lp = jnp.log(jnp.asarray(
+            [[[0.1, 0.8, 0.1], [0.1, 0.8, 0.1],
+              [0.8, 0.1, 0.1], [0.1, 0.1, 0.8]]]))
+        dec, score = exec_op("ctc_greedy_decoder", lp, blank_id=0)
+        assert list(np.asarray(dec)[0][:2]) == [1, 2]
+        assert np.asarray(dec)[0][2] == -1
+
+    def test_beam_matches_greedy_on_peaky(self):
+        lp = jnp.log(jnp.asarray(
+            [[[0.05, 0.9, 0.05], [0.9, 0.05, 0.05], [0.05, 0.05, 0.9]]]))
+        dec = exec_op("ctc_beam", lp, beam_width=3, blank_id=0)
+        assert list(np.asarray(dec)[0][:2]) == [1, 2]
+
+
+class TestAttentionV2AndBp:
+    def test_dpa_v2_causal(self):
+        q = jax.random.normal(jax.random.key(0), (1, 2, 3, 4))
+        out = exec_op("dot_product_attention_v2", q, q, q, causal=True)
+        assert out.shape == q.shape
+        # first position attends only to itself under causal masking
+        np.testing.assert_allclose(np.asarray(out[:, :, 0]),
+                                   np.asarray(q[:, :, 0]), atol=1e-5)
+
+    def test_mhdpa_bp_matches_vjp(self):
+        N, T, D, H, Dh = 2, 3, 4, 2, 2
+        ks = jax.random.split(jax.random.key(1), 8)
+        q = jax.random.normal(ks[0], (N, T, D))
+        wq = jax.random.normal(ks[1], (D, H, Dh))
+        wk = jax.random.normal(ks[2], (D, H, Dh))
+        wv = jax.random.normal(ks[3], (D, H, Dh))
+        wo = jax.random.normal(ks[4], (H, Dh, D))
+        dout = jax.random.normal(ks[5], (N, T, D))
+        grads = exec_op("multi_head_dot_product_attention_bp",
+                        q, q, q, wq, wk, wv, wo, dout)
+        assert len(grads) == 7
+        assert grads[0].shape == q.shape and grads[3].shape == wq.shape
+
+    def test_standardize_bp(self):
+        x = jax.random.normal(jax.random.key(2), (3, 5))
+        d = jnp.ones_like(x)
+        g = exec_op("standardize_bp", x, d)
+        g2 = jax.grad(lambda t: jnp.sum(exec_op("standardize", t)))(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2), atol=1e-5)
+
+
+class TestStructuralTail:
+    def test_parallel_stack_tear_shapes_of(self):
+        a, b = jnp.zeros((2, 3)), jnp.ones((2, 3))
+        st = exec_op("parallel_stack", a, b)
+        assert st.shape == (2, 2, 3)
+        parts = exec_op("tear", st, 1, 2)
+        assert len(parts) == 2 and parts[0].shape == (2, 3)
+        shp = exec_op("shapes_of", a, st)
+        assert list(np.asarray(shp[1])) == [2, 2, 3]
+
+    def test_where_np_forms(self):
+        c = jnp.asarray([True, False, True])
+        np.testing.assert_allclose(
+            np.asarray(exec_op("where_np", c, jnp.asarray([1.0, 1.0, 1.0]),
+                               jnp.asarray([2.0, 2.0, 2.0]))), [1, 2, 1])
+        idx = exec_op("where_np", c)
+        assert list(np.asarray(idx).reshape(-1)) == [0, 2]
+
+    def test_flatten2d_order_matchcondition(self):
+        x = jnp.arange(24.0).reshape(2, 3, 4)
+        assert exec_op("flatten_2d", x, 2).shape == (6, 4)
+        assert exec_op("order", x).shape == x.shape
+        assert int(exec_op("matchcondition", x, condition="gt",
+                           value=0.0)) == 23
+
+    def test_logentropy_biasadd_grs(self):
+        p = jnp.asarray([0.5, 0.5])
+        np.testing.assert_allclose(
+            float(exec_op("logentropy", p)),
+            np.log(-2 * 0.5 * np.log(0.5)), rtol=1e-5)
+        x = jnp.zeros((1, 2, 2, 3))
+        out = exec_op("biasadd", x, jnp.asarray([1.0, 2.0, 3.0]))
+        np.testing.assert_allclose(np.asarray(out[0, 0, 0]), [1, 2, 3])
+        xc = jnp.zeros((1, 3, 2, 2))
+        outc = exec_op("biasadd", xc, jnp.asarray([1.0, 2.0, 3.0]),
+                       data_format="NCHW")
+        np.testing.assert_allclose(np.asarray(outc[0, :, 0, 0]), [1, 2, 3])
+        g = exec_op("grs_to_rgb", jnp.ones((2, 2, 1)))
+        assert g.shape == (2, 2, 3)
+
+    def test_sparse_and_string_compat(self):
+        dense = exec_op("compat_sparse_to_dense",
+                        jnp.asarray([[0, 1], [1, 0]]), jnp.asarray([2, 2]),
+                        jnp.asarray([5.0, 6.0]))
+        np.testing.assert_allclose(np.asarray(dense), [[0, 5], [6, 0]])
+        idx, vals = exec_op("compat_string_split",
+                            np.asarray(["a b", "c"]))
+        assert list(vals) == ["a", "b", "c"]
+        assert idx.shape == (3, 2)
+
+    def test_debug_and_gd(self):
+        x = jnp.asarray([1.0, 2.0])
+        assert exec_op("expose", x) is x
+        out = exec_op("apply_gradient_descent", x, jnp.asarray([1.0, 1.0]),
+                      lr=0.5)
+        np.testing.assert_allclose(np.asarray(out), [0.5, 1.5])
+        np.testing.assert_allclose(
+            np.asarray(exec_op("reduce_norm_max",
+                               jnp.asarray([[-3.0, 2.0]]), 1)), [3.0])
+
+
+class TestAliases:
+    def test_reference_spellings_resolve(self):
+        for name in ["conv3dnew", "avgpool3dnew", "maxpool3dnew",
+                     "deconv2d_tf", "hardswish", "hardtanh", "hardsigmoid",
+                     "clip_by_norm", "clipbyavgnorm", "clipbyglobalnorm",
+                     "gruCell", "lstmCell", "sruCell", "lstmBlock",
+                     "sigm_cross_entropy", "bidirectional", "attention",
+                     "batch_norm", "nms_v3", "non_max_suppression_v3",
+                     "is_nan", "is_inf", "is_finite", "cropandresize",
+                     "assert", "norm_max", "bitcount", "countBits"]:
+            assert has(name), name
+
+    def test_registry_size_gate(self):
+        from deeplearning4j_tpu.ops import registry
+        assert len(registry.names()) >= 500
