@@ -13,10 +13,11 @@ from deeplearning4j_tpu.models.zoo.darknet import Darknet19, TinyYOLO, YOLO2
 from deeplearning4j_tpu.models.zoo.unet import UNet
 from deeplearning4j_tpu.models.zoo.xception import Xception
 from deeplearning4j_tpu.models.zoo.inception import InceptionResNetV1, NASNet
+from deeplearning4j_tpu.models.zoo.facenet import FaceNetNN4Small2
 
 __all__ = [
     "ZooModel", "PretrainedType", "LeNet", "SimpleCNN", "AlexNet",
     "TextGenerationLSTM", "VGG16", "VGG19", "ResNet50", "SqueezeNet",
     "Darknet19", "TinyYOLO", "YOLO2", "UNet", "Xception",
-    "InceptionResNetV1", "NASNet",
+    "InceptionResNetV1", "NASNet", "FaceNetNN4Small2",
 ]

@@ -212,6 +212,59 @@ class OutputLayer(DenseLayer):
 
 @register_layer
 @dataclasses.dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax head + center loss (ref: conf.layers.CenterLossOutputLayer,
+    layers.training.CenterLossOutputLayer): each example's FEATURE vector is
+    pulled toward its class center, ``lambda``-weighted; centers (one per
+    class, in feature space) move toward the features at rate ``alpha``.
+
+    Divergence note: the reference updates centers by a dedicated EMA inside
+    backprop; here centers are parameters driven by a stop-gradient-split
+    loss — the ``alpha`` term's gradient wrt the centers is
+    ``alpha * (c_y - f)``, so the optimizer step moves centers toward
+    features at ``lr * alpha`` (alpha composes with the learning rate).
+    ``gradient_check=True`` (the reference's FD-validation flag) keeps BOTH
+    the lambda and alpha terms but without the stop-gradients, so finite
+    differences validate every pathway of the training loss."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+    gradient_check: bool = False
+
+    def param_shapes(self):
+        shapes = super().param_shapes()
+        shapes["centers"] = (self.n_out, self.n_in)
+        return shapes
+
+    def init_params(self, key):
+        p = super().init_params(key)
+        p["centers"] = jnp.zeros((self.n_out, self.n_in))
+        return p
+
+    def loss(self, params, x, labels, mask=None, training=False, rng=None,
+             state=None):
+        x = self._maybe_dropout(x, training, rng)
+        if x.ndim >= 4 or (x.ndim == 3 and x.shape[-1] != self.n_in):
+            x = x.reshape(x.shape[0], -1)
+        head = {k: v for k, v in params.items() if k != "centers"}
+        ce = OutputLayer.loss(self, head, x, labels, mask=mask)
+        # class centers of each example: exact gather for one-hot labels
+        cy = jnp.asarray(labels) @ params["centers"]          # (N, n_in)
+        w = jnp.ones((x.shape[0],), x.dtype) if mask is None \
+            else jnp.asarray(mask).reshape(-1).astype(x.dtype)
+
+        def sq(a, b):
+            return jnp.sum(w * jnp.sum(jnp.square(a - b), axis=-1)) \
+                / jnp.maximum(jnp.sum(w), 1.0)
+
+        if self.gradient_check:
+            return ce + 0.5 * (self.lambda_ + self.alpha) * sq(x, cy)
+        pull = 0.5 * self.lambda_ * sq(x, jax.lax.stop_gradient(cy))
+        update = 0.5 * self.alpha * sq(jax.lax.stop_gradient(x), cy)
+        return ce + pull + update
+
+
+@register_layer
+@dataclasses.dataclass
 class LossLayer(Layer):
     """Loss without params (ref: conf.layers.LossLayer)."""
     loss_function: str = "mse"

@@ -16,8 +16,10 @@ def is_weight_param(pname: str, value) -> bool:
     regularization: weights are the >=2-D tensors (matrices/kernels);
     1-D params (biases, BN gamma/beta, peepholes) are not. Name-prefix
     heuristics misfire on names like 'pW' (pointwise) or 'b_W'
-    (backward-direction weights)."""
-    return jnp.ndim(value) >= 2
+    (backward-direction weights). Class centers (CenterLossOutputLayer)
+    are 2-D but are statistics, not weights — the reference never
+    regularizes or perturbs them."""
+    return jnp.ndim(value) >= 2 and pname != "centers"
 
 
 @dataclasses.dataclass

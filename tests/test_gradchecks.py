@@ -32,6 +32,8 @@ def _x(shape, seed=0, scale=1.0):
 SPECS = {
     "DenseLayer": (lambda: L.DenseLayer(n_in=4, n_out=3), _x((3, 4)), {}),
     "OutputLayer": (lambda: L.OutputLayer(n_in=4, n_out=3), _x((3, 4)), {}),
+    "CenterLossOutputLayer": (lambda: L.CenterLossOutputLayer(
+        n_in=4, n_out=3), _x((3, 4)), {}),
     "LossLayer": (lambda: L.LossLayer(), _x((3, 4)), {}),
     "ActivationLayer": (lambda: L.ActivationLayer(activation="tanh"),
                         _x((3, 4)), {}),
@@ -143,6 +145,28 @@ def _check(layer, x, opts):
 def test_layer_gradcheck(name):
     factory, x, opts = SPECS[name]
     _check(factory(), x, opts)
+
+
+def test_center_loss_gradcheck():
+    """CenterLossOutputLayer with gradient_check=True (the reference's FD
+    flag): d(loss)/d(W,b,centers,x) must all match finite differences."""
+    lyr = L.CenterLossOutputLayer(n_in=4, n_out=3, activation="softmax",
+                                  loss_function="mcxent",
+                                  alpha=0.1, lambda_=0.05,
+                                  gradient_check=True)
+    lyr.apply_global_defaults({"activation": "softmax",
+                               "weight_init": "xavier"})
+    params = lyr.init_params(jax.random.key(0))
+    params["centers"] = jnp.asarray(R(5).randn(3, 4).astype(F32))
+    x = _x((6, 4))
+    labels = np.eye(3, dtype="float32")[R(6).randint(0, 3, 6)]
+
+    def fn(tree):
+        return jnp.asarray(lyr.loss(tree["p"], tree["x"],
+                                    jnp.asarray(labels)))
+
+    assert grad_check(fn, {"p": params, "x": jnp.asarray(x)},
+                      subset=10, max_rel_error=2e-3)
 
 
 def test_yolo2_loss_gradcheck():

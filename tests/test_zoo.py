@@ -247,3 +247,31 @@ def test_zoo_pretrained_cache_round_trip(tmp_path, monkeypatch):
     restored = zoo.LeNet().init_pretrained(zoo.PretrainedType.MNIST)
     np.testing.assert_allclose(np.asarray(restored.output(x[:4])),
                                np.asarray(net.output(x[:4])), atol=1e-6)
+
+
+def test_facenet_nn4_small2_forward_and_center_loss_train():
+    """FaceNetNN4Small2 (the last reference zoo architecture): NN4 inception
+    modules, L2-normalised 128-d embedding, CenterLossOutputLayer head.
+    Training must decrease the loss AND move the class centers off zero."""
+    m = zoo.FaceNetNN4Small2(num_classes=4, input_shape=(32, 32, 3),
+                             width_mult=0.15, embedding_size=16)
+    net = m.init_model()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 32, 32, 3).astype("float32")
+    y = np.eye(4, dtype="float32")[rng.randint(0, 4, 8)]
+    out = np.asarray(net.output(x))
+    assert out.shape == (8, 4)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-4)
+    emb = np.asarray(net.feedForward(x)["embeddings"])
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-4)
+    net.fit(x, y)
+    s0 = net.score()
+    for _ in range(8):
+        net.fit(x, y)
+    assert net.score() < s0
+    centers = np.asarray(net._params["out"]["centers"])
+    assert np.abs(centers).max() > 0.0
+    # centers are statistics, not weights: L1/L2 + weight noise skip them
+    from deeplearning4j_tpu.nn.weightnoise import is_weight_param
+    assert not is_weight_param("centers", centers)
+    assert is_weight_param("W", np.zeros((3, 3)))
