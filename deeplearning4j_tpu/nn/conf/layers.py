@@ -229,6 +229,8 @@ class CenterLossOutputLayer(OutputLayer):
     alpha: float = 0.05
     lambda_: float = 2e-4
     gradient_check: bool = False
+    #: centers are statistics, not weights: excluded from L1/L2 + noise
+    non_weight_params = ("centers",)
 
     def param_shapes(self):
         shapes = super().param_shapes()

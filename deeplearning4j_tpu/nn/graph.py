@@ -152,7 +152,8 @@ class ComputationGraph:
                 lrng = jax.random.fold_in(rng, li) if rng is not None else None
                 wn = getattr(node.layer, "weight_noise", None)
                 if wn is not None and training and lrng is not None:
-                    lp = wn.apply(lp, jax.random.fold_in(lrng, 7919))
+                    lp = wn.apply(lp, jax.random.fold_in(lrng, 7919),
+                                  layer=node.layer)
                 lst = states.get(name)
                 kwargs = {}
                 if mask is not None and isinstance(node.layer, _MASK_AWARE):
@@ -204,7 +205,7 @@ class ComputationGraph:
             for pname, arr in params.get(name, {}).items():
                 from deeplearning4j_tpu.nn.weightnoise import (
                     is_weight_param)
-                if not is_weight_param(pname, arr):
+                if not is_weight_param(pname, arr, node.layer):
                     continue
                 if l1:
                     penalty = penalty + l1 * jnp.sum(jnp.abs(arr))

@@ -206,7 +206,8 @@ class MultiLayerNetwork:
             wn = getattr(layer, "weight_noise", None)
             if wn is not None and training and lrng is not None:
                 # ref: IWeightNoise applies to weights at training forward
-                lp = wn.apply(lp, jax.random.fold_in(lrng, 7919))
+                lp = wn.apply(lp, jax.random.fold_in(lrng, 7919),
+                              layer=layer)
             kwargs = {}
             if mask is not None and isinstance(layer, _MASK_AWARE):
                 kwargs["mask"] = mask
@@ -254,7 +255,7 @@ class MultiLayerNetwork:
                 continue
             from deeplearning4j_tpu.nn.weightnoise import is_weight_param
             for pname, arr in params.get(str(i), {}).items():
-                if not is_weight_param(pname, arr):
+                if not is_weight_param(pname, arr, layer):
                     continue
                 if l1:
                     penalty = penalty + l1 * jnp.sum(jnp.abs(arr))

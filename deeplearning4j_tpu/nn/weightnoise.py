@@ -11,15 +11,18 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-def is_weight_param(pname: str, value) -> bool:
+def is_weight_param(pname: str, value, layer=None) -> bool:
     """Weight-vs-bias classification shared by weight noise and L1/L2
     regularization: weights are the >=2-D tensors (matrices/kernels);
     1-D params (biases, BN gamma/beta, peepholes) are not. Name-prefix
     heuristics misfire on names like 'pW' (pointwise) or 'b_W'
-    (backward-direction weights). Class centers (CenterLossOutputLayer)
-    are 2-D but are statistics, not weights — the reference never
-    regularizes or perturbs them."""
-    return jnp.ndim(value) >= 2 and pname != "centers"
+    (backward-direction weights), so shape is the rule — a layer whose 2-D
+    params are statistics rather than weights (CenterLossOutputLayer's
+    centers) declares them in ``non_weight_params``, keeping the knowledge
+    on the layer."""
+    if pname in getattr(layer, "non_weight_params", ()):
+        return False
+    return jnp.ndim(value) >= 2
 
 
 @dataclasses.dataclass
@@ -30,10 +33,12 @@ class DropConnect:
     p: float = 0.5
     apply_to_bias: bool = False
 
-    def apply(self, params: dict, rng) -> dict:
+    def apply(self, params: dict, rng, layer=None) -> dict:
         out = {}
         for i, (k, w) in enumerate(sorted(params.items())):
-            if self.apply_to_bias or is_weight_param(k, w):
+            if k in getattr(layer, "non_weight_params", ()):
+                out[k] = w
+            elif self.apply_to_bias or is_weight_param(k, w, layer):
                 sub = jax.random.fold_in(rng, i)
                 mask = jax.random.bernoulli(sub, self.p, jnp.shape(w))
                 out[k] = jnp.where(mask, w / self.p, 0.0).astype(w.dtype)
@@ -54,10 +59,12 @@ class WeightNoise:
     additive: bool = True
     apply_to_bias: bool = False
 
-    def apply(self, params: dict, rng) -> dict:
+    def apply(self, params: dict, rng, layer=None) -> dict:
         out = {}
         for i, (k, w) in enumerate(sorted(params.items())):
-            if self.apply_to_bias or is_weight_param(k, w):
+            if k in getattr(layer, "non_weight_params", ()):
+                out[k] = w
+            elif self.apply_to_bias or is_weight_param(k, w, layer):
                 sub = jax.random.fold_in(rng, i)
                 n = jax.random.normal(sub, jnp.shape(w), jnp.float32) \
                     * self.std

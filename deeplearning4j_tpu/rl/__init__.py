@@ -2,7 +2,8 @@
 from deeplearning4j_tpu.rl.mdp import (CartPole, DiscreteSpace, GridWorld,
                                        MDP, ObservationSpace)
 from deeplearning4j_tpu.rl.replay import ExpReplay, Transition
-from deeplearning4j_tpu.rl.qlearning import (DQNPolicy, EpsGreedy,
+from deeplearning4j_tpu.rl.qlearning import (AsyncNStepQLearningDiscreteDense,
+                                             DQNPolicy, EpsGreedy,
                                              QLearningConfiguration,
                                              QLearningDiscreteDense)
 from deeplearning4j_tpu.rl.a2c import (A2CDiscreteDense, A2CConfiguration,
@@ -11,4 +12,4 @@ from deeplearning4j_tpu.rl.a2c import (A2CDiscreteDense, A2CConfiguration,
 __all__ = ["MDP", "ObservationSpace", "DiscreteSpace", "CartPole",
            "GridWorld", "ExpReplay", "Transition", "QLearningConfiguration",
            "QLearningDiscreteDense", "EpsGreedy", "DQNPolicy",
-           "A2CDiscreteDense", "A2CConfiguration", "A3CDiscreteDense"]
+           "A2CDiscreteDense", "A2CConfiguration", "A3CDiscreteDense", "AsyncNStepQLearningDiscreteDense"]

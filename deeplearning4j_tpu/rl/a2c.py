@@ -195,72 +195,37 @@ class A3CDiscreteDense(A2CDiscreteDense):
         self._grad_fn = jax.jit(jax.value_and_grad(self._loss_ref))
 
     def train(self) -> List[float]:
-        import threading
-
+        import jax.numpy as jnp
         import numpy as np
 
+        from deeplearning4j_tpu.rl.async_loop import async_nstep_train
+
         conf = self.conf
-        lock = threading.Lock()
-        episode_rewards: List[float] = []
-        step_counter = [0]
 
-        def worker(wid: int):
-            import jax.numpy as jnp
-            rng = np.random.RandomState(conf.seed + 1000 * wid)
-            mdp = self.mdp.new_instance()
-            obs = mdp.reset()
-            ep_reward, ep_steps = 0.0, 0
-            while True:
-                with lock:
-                    if step_counter[0] >= conf.max_step:
-                        return
-                    snapshot = self.params        # param snapshot (staleness
-                    #                               bounded by one rollout)
-                buf_obs, buf_act, buf_rew, buf_done = [], [], [], []
-                boot_obs = None
-                for _ in range(conf.n_step):
-                    probs, _ = self._policy_value(np.asarray(obs, np.float32), params=snapshot)
-                    action = int(rng.choice(self.n_actions, p=probs))
-                    reply = mdp.step(action)
-                    buf_obs.append(np.asarray(obs, np.float32))
-                    buf_act.append(action)
-                    buf_rew.append(reply.reward)
-                    buf_done.append(reply.done)
-                    obs = reply.observation
-                    ep_reward += reply.reward
-                    ep_steps += 1
-                    with lock:
-                        step_counter[0] += 1
-                    if reply.done or ep_steps >= conf.max_epoch_step:
-                        boot_obs = reply.observation
-                        with lock:
-                            episode_rewards.append(ep_reward)
-                        obs = mdp.reset()
-                        ep_reward, ep_steps = 0.0, 0
-                        break
-                if buf_done[-1]:
-                    R = 0.0
-                else:
-                    src = boot_obs if boot_obs is not None else obs
-                    _, R = self._policy_value(np.asarray(src, np.float32), params=snapshot)
-                returns = np.zeros(len(buf_rew), dtype=np.float32)
-                for i in reversed(range(len(buf_rew))):
-                    R = buf_rew[i] + conf.gamma * R * (1.0 - float(buf_done[i]))
-                    returns[i] = R
-                _, grads = self._grad_fn(snapshot,
-                                         jnp.asarray(np.stack(buf_obs)),
-                                         jnp.asarray(np.asarray(buf_act, np.int32)),
-                                         jnp.asarray(returns))
-                with lock:   # apply to the GLOBAL params (ref: AsyncGlobal)
-                    self.params, self._opt_state = self._apply(
-                        grads, self._opt_state, self.params)
+        def select_action(snapshot, obs, rng):
+            probs, _ = self._policy_value(obs, params=snapshot)
+            return int(rng.choice(self.n_actions, p=probs))
 
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-                   for i in range(self.num_threads)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        return episode_rewards
+        def bootstrap_value(snapshot, obs):
+            _, v = self._policy_value(obs, params=snapshot)
+            return v
+
+        def compute_update(snapshot, obs, actions, returns):
+            _, grads = self._grad_fn(snapshot, jnp.asarray(obs),
+                                     jnp.asarray(actions),
+                                     jnp.asarray(returns))
+            return grads
+
+        def apply_update(grads):   # under the lock (ref: AsyncGlobal)
+            self.params, self._opt_state = self._apply(
+                grads, self._opt_state, self.params)
+
+        return async_nstep_train(
+            mdp=self.mdp, num_threads=self.num_threads, n_step=conf.n_step,
+            gamma=conf.gamma, max_step=conf.max_step,
+            max_epoch_step=conf.max_epoch_step, seed=conf.seed,
+            snapshot=lambda: self.params, select_action=select_action,
+            bootstrap_value=bootstrap_value, compute_update=compute_update,
+            apply_update=apply_update)
 
 

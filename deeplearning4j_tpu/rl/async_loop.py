@@ -1,0 +1,102 @@
+"""Shared async n-step worker scaffold (ref: rl4j.learning.async.
+{AsyncLearning,AsyncThread,AsyncGlobal} — the common machinery under both
+A3CDiscrete and AsyncNStepQLearningDiscrete).
+
+``num_threads`` workers each roll n-step segments against a PRIVATE MDP
+instance using a snapshot of the shared state, compute an update OUTSIDE
+the lock (jax dispatch releases the GIL, so workers overlap for real), and
+apply it to the global state under the mutex — the reference's Hogwild
+accumulator narrowed to update-granularity locking. Episode truncation at
+``max_epoch_step`` bootstraps from the TRUNCATED episode's successor state
+(``boot_obs``), never the post-reset observation.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+def async_nstep_train(*, mdp, num_threads: int, n_step: int, gamma: float,
+                      max_step: int, max_epoch_step: int, seed: int = 0,
+                      reward_factor: float = 1.0,
+                      snapshot: Callable[[], object],
+                      select_action: Callable[[object, np.ndarray,
+                                               np.random.RandomState], int],
+                      bootstrap_value: Callable[[object, np.ndarray], float],
+                      compute_update: Callable[[object, np.ndarray,
+                                                np.ndarray, np.ndarray],
+                                               object],
+                      apply_update: Callable[[object], None],
+                      on_global_step: Optional[Callable[[int], None]] = None,
+                      on_episode=None) -> List[float]:
+    """Run the async worker pool; returns per-episode rewards.
+
+    Lock discipline: ``snapshot``/``apply_update``/``on_global_step``/
+    ``on_episode`` run UNDER the global lock; ``select_action``/
+    ``bootstrap_value``/``compute_update`` run outside it.
+    """
+    lock = threading.Lock()
+    episode_rewards: List[float] = []
+    step_counter = [0]
+
+    def worker(wid: int):
+        rng = np.random.RandomState(seed + 1000 * wid)
+        env = mdp.new_instance()
+        obs = env.reset()
+        ep_reward, ep_steps = 0.0, 0
+        while True:
+            with lock:
+                if step_counter[0] >= max_step:
+                    return
+                snap = snapshot()
+            buf_obs, buf_act, buf_rew, buf_done = [], [], [], []
+            boot_obs = None
+            for _ in range(n_step):
+                o = np.asarray(obs, np.float32)
+                action = select_action(snap, o, rng)
+                reply = env.step(action)
+                buf_obs.append(o)
+                buf_act.append(action)
+                buf_rew.append(reply.reward * reward_factor)
+                buf_done.append(reply.done)
+                obs = reply.observation
+                ep_reward += reply.reward
+                ep_steps += 1
+                with lock:
+                    step_counter[0] += 1
+                    if on_global_step is not None:
+                        on_global_step(step_counter[0])
+                if reply.done or ep_steps >= max_epoch_step:
+                    # bootstrap source for a TRUNCATED (non-done) episode is
+                    # its actual successor state, saved before the reset
+                    boot_obs = reply.observation
+                    with lock:
+                        episode_rewards.append(ep_reward)
+                        if on_episode is not None:
+                            on_episode(len(episode_rewards), ep_reward)
+                    obs = env.reset()
+                    ep_reward, ep_steps = 0.0, 0
+                    break
+            if buf_done[-1]:
+                R = 0.0
+            else:
+                src = boot_obs if boot_obs is not None else obs
+                R = float(bootstrap_value(snap, np.asarray(src, np.float32)))
+            returns = np.zeros(len(buf_rew), dtype=np.float32)
+            for i in reversed(range(len(buf_rew))):
+                R = buf_rew[i] + gamma * R * (1.0 - float(buf_done[i]))
+                returns[i] = R
+            update = compute_update(snap, np.stack(buf_obs),
+                                    np.asarray(buf_act, np.int32), returns)
+            with lock:
+                apply_update(update)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(num_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return episode_rewards

@@ -177,6 +177,8 @@ class QLearningDiscreteDense:
 
         self._train_step = train_step
         self._q_fn = jax.jit(q_fn)
+        self._q_raw = q_fn          # untraced form (async n-step subclass)
+        self._loss_raw = loss_fn
         self._jnp = jnp
 
     # ------------------------------------------------------------------ api
@@ -226,3 +228,98 @@ class QLearningDiscreteDense:
             if on_episode is not None:
                 on_episode(len(episode_rewards), ep_reward)
         return episode_rewards
+
+
+class AsyncNStepQLearningDiscreteDense(QLearningDiscreteDense):
+    """Asynchronous n-step Q-learning (ref:
+    ``rl4j.learning.async.nstep.discrete.AsyncNStepQLearningDiscreteDense``
+    + ``AsyncNStepQLearningThreadDiscrete``): ``num_threads`` workers roll
+    n-step segments against PRIVATE MDP instances with eps-greedy over a
+    snapshot of the shared net, build n-step targets bootstrapped from the
+    shared TARGET net, and apply gradients to the global params under a
+    mutex (the A3C AsyncGlobal pattern, Q-flavoured). The target net syncs
+    from the global every ``target_dqn_update_freq`` global steps. No
+    replay buffer — parallel decorrelation replaces it, as in the
+    reference."""
+
+    def __init__(self, mdp: MDP, conf: QLearningConfiguration,
+                 hidden: List[int] = (64, 64), dueling: bool = False,
+                 n_step: int = 5, num_threads: int = 2):
+        super().__init__(mdp, conf, hidden, dueling)
+        import jax
+        import jax.numpy as jnp
+
+        self.n_step = n_step
+        self.num_threads = num_threads
+        q_fn, clamp = self._q_raw, conf.error_clamp
+
+        def nstep_loss(p, obs, actions, returns):
+            q = q_fn(p, obs)
+            td = q[jnp.arange(q.shape[0]), actions] - returns
+            if clamp:
+                a = jnp.abs(td)
+                return jnp.mean(jnp.where(a <= clamp, 0.5 * td * td,
+                                          clamp * (a - 0.5 * clamp)))
+            return jnp.mean(td * td)
+
+        self._nstep_grad = jax.jit(jax.value_and_grad(nstep_loss))
+
+        import optax
+
+        def apply_grads(grads, opt_state, p):
+            updates, opt_state = self._opt.update(grads, opt_state, p)
+            return optax.apply_updates(p, updates), opt_state
+
+        self._apply_grads = jax.jit(apply_grads)
+
+    def train(self, on_episode=None) -> List[float]:
+        import jax
+
+        from deeplearning4j_tpu.rl.async_loop import async_nstep_train
+
+        conf = self.conf
+        jnp = self._jnp
+        # per-worker eps schedules (ref: per-thread EpsGreedy), keyed by a
+        # thread-local since select_action only receives (snap, obs, rng)
+        eps_by_rng: dict = {}
+
+        def select_action(snapshot, obs, rng):
+            eps = eps_by_rng.setdefault(id(rng), EpsGreedy(conf, rng))
+            params, _target = snapshot
+            return eps.next_action_lazy(
+                self.n_actions,
+                lambda: np.asarray(self._q_fn(
+                    params, jnp.asarray(obs[None])))[0])
+
+        def bootstrap_value(snapshot, obs):
+            # n-step targets bootstrap from the TARGET net (ref:
+            # AsyncNStepQLearningThreadDiscrete)
+            _params, target = snapshot
+            return float(np.max(np.asarray(self._q_fn(
+                target, jnp.asarray(obs[None])))[0]))
+
+        def compute_update(snapshot, obs, actions, returns):
+            params, _target = snapshot
+            _, grads = self._nstep_grad(params, jnp.asarray(obs),
+                                        jnp.asarray(actions),
+                                        jnp.asarray(returns))
+            return grads
+
+        def apply_update(grads):
+            self.params, self._opt_state = self._apply_grads(
+                grads, self._opt_state, self.params)
+
+        def on_global_step(step):
+            # target sync on the GLOBAL step clock (ref: AsyncGlobal)
+            if step % conf.target_dqn_update_freq == 0:
+                self.target_params = jax.tree.map(jnp.array, self.params)
+
+        return async_nstep_train(
+            mdp=self.mdp, num_threads=self.num_threads, n_step=self.n_step,
+            gamma=conf.gamma, max_step=conf.max_step,
+            max_epoch_step=conf.max_epoch_step, seed=conf.seed,
+            reward_factor=conf.reward_factor,
+            snapshot=lambda: (self.params, self.target_params),
+            select_action=select_action, bootstrap_value=bootstrap_value,
+            compute_update=compute_update, apply_update=apply_update,
+            on_global_step=on_global_step, on_episode=on_episode)

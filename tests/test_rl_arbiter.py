@@ -191,3 +191,24 @@ def test_a3c_async_workers_learn_gridworld():
     # a random walk on the corridor pays -0.01 per step; the learned
     # policy walks straight to the +1 goal
     assert final > 0.0, final
+
+
+def test_async_nstep_qlearning_learns_gridworld():
+    """AsyncNStepQLearningDiscreteDense (ref: the async n-step Q family):
+    worker threads roll n-step segments eps-greedily, bootstrap targets
+    from the shared target net, apply grads under a mutex — the greedy
+    policy must walk the corridor to the goal."""
+    from deeplearning4j_tpu.rl import (AsyncNStepQLearningDiscreteDense,
+                                       GridWorld)
+
+    conf = QLearningConfiguration(seed=11, max_step=8000,
+                                  epsilon_nb_step=4000,
+                                  target_dqn_update_freq=200, gamma=0.95,
+                                  learning_rate=5e-3, max_epoch_step=60)
+    learner = AsyncNStepQLearningDiscreteDense(GridWorld(6), conf,
+                                               hidden=[32], n_step=6,
+                                               num_threads=3)
+    rewards = learner.train()
+    assert len(rewards) > 10
+    reward = learner.get_policy().play(GridWorld(6), max_steps=30)
+    assert reward > 0.8, reward

@@ -271,7 +271,11 @@ def test_facenet_nn4_small2_forward_and_center_loss_train():
     assert net.score() < s0
     centers = np.asarray(net._params["out"]["centers"])
     assert np.abs(centers).max() > 0.0
-    # centers are statistics, not weights: L1/L2 + weight noise skip them
+    # centers are statistics, not weights (declared by the layer):
+    # L1/L2 + weight noise skip them
+    from deeplearning4j_tpu.nn.conf.layers import CenterLossOutputLayer
     from deeplearning4j_tpu.nn.weightnoise import is_weight_param
-    assert not is_weight_param("centers", centers)
-    assert is_weight_param("W", np.zeros((3, 3)))
+    lyr = CenterLossOutputLayer(n_in=4, n_out=3)
+    assert not is_weight_param("centers", centers, lyr)
+    assert is_weight_param("W", np.zeros((3, 3)), lyr)
+    assert is_weight_param("centers", centers)  # shape rule without a layer
