@@ -304,15 +304,198 @@ KNOWN_GAPS: List[str] = [
 ]
 
 
-def coverage(cls=None, strict: bool = True):
-    """Machine-check the manifest against the live NDArray class.
+def _nd4j_sigs() -> Dict[str, List[Entry]]:
+    """``Nd4j`` factory statics (ref: org.nd4j.linalg.factory.Nd4j, ~7k
+    lines). Same counting rule as the INDArray manifest: one row per Java
+    overload signature, mapped to the python static that covers it."""
+    fam: Dict[str, List[Entry]] = {}
+
+    fam["create"] = (
+        [(f"create({a})", "create") for a in
+         ("int...", "long...", "float[]", "double[]", "float[][]",
+          "double[][]", "float[], int[]", "double[], int[]",
+          "float[], int[], char", "double[], long[], char",
+          "float[], long[], long[], char, DataType",
+          "DataType, long...", "List<INDArray>, int[]")]
+        + [("createFromArray(float...)", "createFromArray"),
+           ("createFromArray(double...)", "createFromArray"),
+           ("createFromArray(int...)", "createFromArray"),
+           ("createUninitialized(long...)", "createUninitialized"),
+           ("createUninitialized(DataType, long...)", "createUninitialized"),
+           ("createUninitializedDetached(DataType, char, long...)",
+            "createUninitializedDetached"),
+           ("empty()", "empty"), ("empty(DataType)", "empty"),
+           ("emptyLike(INDArray)", "emptyLike"),
+           ("scalar(double)", "scalar"), ("scalar(float)", "scalar"),
+           ("scalar(int)", "scalar"), ("scalar(DataType, Number)", "scalar"),
+           ("trueScalar(Number)", "trueScalar"),
+           ("trueVector(double[])", "trueVector"),
+           ("valueArrayOf(long[], double)", "valueArrayOf"),
+           ("valueArrayOf(long, long, double)", "valueArrayOf"),
+           ("full(long[], Number)", "full")])
+    fam["zeros_ones"] = (
+        [(f"zeros({a})", "zeros") for a in
+         ("int...", "long...", "DataType, long...", "int, int")]
+        + [(f"ones({a})", "ones") for a in
+           ("int...", "long...", "DataType, long...", "int, int")]
+        + [("zerosLike(INDArray)", "zerosLike"),
+           ("onesLike(INDArray)", "onesLike")])
+    fam["ranges"] = [
+        ("linspace(long, long, long)", "linspace"),
+        ("linspace(DataType, long, long, long)", "linspace"),
+        ("linspace(double, double, long, DataType)", "linspace"),
+        ("logspace(double, double, long)", "logspace"),
+        ("arange(double)", "arange"), ("arange(double, double)", "arange"),
+        ("eye(long)", "eye"), ("meshgrid(INDArray...)", "meshgrid"),
+        ("vander(INDArray)", "vander"), ("tri(int, int, int)", "tri"),
+        ("triu(INDArray, int)", "triu"), ("tril(INDArray, int)", "tril"),
+        ("diag(INDArray)", "diag"), ("diag(INDArray, int)", "diag")]
+    fam["random_factory"] = [
+        ("rand(int, int)", "rand"), ("rand(int...)", "rand"),
+        ("rand(long...)", "rand"), ("rand(DataType, long...)", "rand"),
+        ("rand(char, long...)", "rand"),
+        ("randn(int, int)", "randn"), ("randn(int...)", "randn"),
+        ("randn(long...)", "randn"), ("randn(DataType, long...)", "randn"),
+        ("randint(int, long...)", "randint"),
+        ("randUniform(double, double, long...)", "randUniform"),
+        ("randomBernoulli(double, long...)", "randomBernoulli"),
+        ("randomBernoulli(double, INDArray)", "randomBernoulli"),
+        ("randomBinomial(int, double, long...)", "randomBinomial"),
+        ("randomExponential(double, long...)", "randomExponential"),
+        ("randomGamma(double, double, long...)", "randomGamma"),
+        ("randomPoisson(double, long...)", "randomPoisson"),
+        ("choice(INDArray, INDArray, int)", "choice"),
+        ("shuffle(INDArray, int...)", "shuffle"),
+        ("getRandom()", "getRandom"),
+        ("getRandomFactory()", "getRandomFactory")]
+    fam["combine_split"] = [
+        ("concat(int, INDArray...)", "concat"),
+        ("specialConcat(int, INDArray...)", "specialConcat"),
+        ("hstack(INDArray...)", "hstack"), ("vstack(INDArray...)", "vstack"),
+        ("stack(int, INDArray...)", "stack"),
+        ("pile(INDArray...)", "pile"), ("tear(INDArray, int...)", "tear"),
+        ("split(INDArray, int, int)", "split"),
+        ("repeat(INDArray, int)", "repeat"),
+        ("tile(INDArray, int...)", "tile"),
+        ("pad(INDArray, int[][])", "pad"),
+        ("pad(INDArray, int[][], Nd4j.PadMode)", "pad"),
+        ("append(INDArray, int, double, int)", "pad"),   # value-pad along axis
+        ("appendBias(INDArray...)", "appendBias"),
+        ("expandDims(INDArray, int)", "expandDims"),
+        ("squeeze(INDArray, int)", "squeeze"),
+        ("stripOnes(INDArray)", "stripOnes")]
+    fam["structure"] = [
+        ("reverse(INDArray)", "reverse"), ("flip(INDArray, int...)", "flip"),
+        ("fliplr(INDArray)", "fliplr"), ("flipud(INDArray)", "flipud"),
+        ("rot90(INDArray)", "rot90"), ("roll(INDArray, int)", "roll"),
+        ("roll(INDArray, int, int...)", "roll"),
+        ("rollAxis(INDArray, int)", "rollAxis"),
+        ("rollAxis(INDArray, int, int)", "rollAxis"),
+        ("where(INDArray, INDArray, INDArray)", "where"),
+        ("gather(INDArray, INDArray, int)", "gather"),
+        ("scatterUpdate(...)", "scatterUpdate"),
+        ("isMax(INDArray)", "isMax"), ("isMax(INDArray, int...)", "isMax"),
+        ("sort(INDArray, boolean)", "sort"),
+        ("sort(INDArray, int, boolean)", "sort"),
+        ("sortRows(INDArray, int, boolean)", "sortRows"),
+        ("sortColumns(INDArray, int, boolean)", "sortColumns"),
+        ("sortWithIndices(INDArray, int, boolean)", "sortWithIndices"),
+        ("shape(INDArray)", "shape"), ("getStrides(long[])", "getStrides"),
+        ("getStrides(long[], char)", "getStrides"),
+        ("checkShapeValues(long[])", "checkShapeValues"),
+        ("toFlattened(INDArray...)", "toFlattened"),
+        ("toFlattened(char, INDArray...)", "toFlattened"),
+        ("unique(INDArray)", "unique"), ("nonzero(INDArray)", "nonzero"),
+        ("histogram(INDArray, int)", "histogram")]
+    fam["linalg_statics"] = [
+        ("gemm(INDArray, INDArray, boolean, boolean)", "gemm"),
+        ("gemm(INDArray, INDArray, INDArray, boolean, boolean, double, "
+         "double)", "gemm"),
+        ("matmul(INDArray, INDArray)", "matmul"),
+        ("matmul(INDArray, INDArray, INDArray)", "matmul"),
+        ("matmul(INDArray, INDArray, boolean, boolean, boolean)", "matmul"),
+        ("dot(INDArray, INDArray)", "dot"),
+        ("tensorMmul(INDArray, INDArray, int[][])", "tensorMmul"),
+        ("kron(INDArray, INDArray)", "kron"),
+        ("outer(INDArray, INDArray)", "outer"),
+        ("cholesky(INDArray)", "cholesky"), ("qr(INDArray)", "qr"),
+        ("svd(INDArray)", "svd"), ("lu(INDArray)", "lu"),
+        ("eig(INDArray)", "eig"), ("lstsq(INDArray, INDArray)", "lstsq"),
+        ("solve(INDArray, INDArray)", "solve"), ("inv(INDArray)", "inv"),
+        ("pinv(INDArray)", "pinv"), ("det(INDArray)", "det"),
+        ("matrixRank(INDArray)", "matrixRank"),
+        ("getBlasWrapper()", "getBlasWrapper")]
+    fam["reduction_statics"] = [
+        (f"{op}(INDArray{d})", op) for op in
+        ("max", "min", "mean", "sum", "prod", "std", "var", "norm1",
+         "norm2", "normmax", "cumsum", "cumprod", "argMax", "argMin")
+        for d in ("", ", int...")]
+    fam["reduction_statics"] += [
+        ("average(INDArray[])", "average"),
+        ("averageAndPropagate(INDArray[])", "averageAndPropagate"),
+        ("accumulate(INDArray...)", "accumulate"),
+        ("accumulate(INDArray, Collection<INDArray>)", "accumulate"),
+        ("bilinearProducts(INDArray, INDArray)", "bilinearProducts"),
+        ("clearNans(INDArray)", "clearNans")]
+    fam["io_statics"] = [
+        ("read(DataInputStream)", "read"),
+        ("readBinary(File)", "readBinary"),
+        ("readNumpy(String)", "readNumpy"),
+        ("readNumpy(String, String)", "readNumpy"),
+        ("readTxt(String)", "readTxt"),
+        ("write(INDArray, DataOutputStream)", "write"),
+        ("writeTxt(INDArray, String)", "writeTxt"),
+        ("writeAsNumpy(INDArray, File)", "writeAsNumpy"),
+        ("writeNumpy(INDArray, String)", "writeNumpy"),
+        ("saveBinary(INDArray, File)", "saveBinary"),
+        ("fromByteArray(byte[])", "fromByteArray"),
+        ("toByteArray(INDArray)", "toByteArray"),
+        ("fromNumpy(numpy)", "fromNumpy"),
+        ("createFromNpyFile(File)", "createFromNpyFile"),
+        ("createFromNpzFile(File)", "createFromNpzFile"),
+        ("createNpyFromByteArray(byte[])", "createNpyFromByteArray"),
+        ("toNpyByteArray(INDArray)", "toNpyByteArray"),
+        ("createFromData(DataBuffer, long...)", "createFromData")]
+    fam["env_statics"] = [
+        ("dataType()", "dataType"),
+        ("setDefaultDataType(DataType)", "setDefaultDataType"),
+        ("setDefaultDataTypes(DataType, DataType)", "setDefaultDataTypes"),
+        ("defaultFloatingPointType()", "defaultFloatingPointType"),
+        ("getExecutioner()", "getExecutioner"),
+        ("getBackend()", "getBackend"), ("backend()", "backend"),
+        ("getEnvironment()", "getEnvironment"),
+        ("getMemoryManager()", "getMemoryManager"),
+        ("getAffinityManager()", "getAffinityManager"),
+        ("getCompressor()", "getCompressor"),
+        ("factory()", "factory"), ("order()", "order"),
+        ("sizeOfDataType(DataType)", "sizeOfDataType"),
+        ("exec(Op)", "exec_"), ("exec(CustomOp)", "exec_"),
+        ("setSeed(long)", "setSeed"), ("version()", "version")]
+    return fam
+
+
+ND4J_SIGNATURES: Dict[str, List[Entry]] = _nd4j_sigs()
+
+
+def nd4j_coverage(strict: bool = True):
+    """Machine-check the Nd4j manifest against the live factory class
+    (same callable-or-property rule as the INDArray check)."""
+    from deeplearning4j_tpu.ndarray.factory import Nd4j
+    return coverage(cls=Nd4j, strict=strict, manifest=ND4J_SIGNATURES)
+
+
+def coverage(cls=None, strict: bool = True, manifest=None):
+    """Machine-check a manifest against a live class (default: the
+    INDArray manifest against NDArray).
 
     Returns (covered:int, total:int, missing:[(family, sig, py)]).
     """
     if cls is None:
         from deeplearning4j_tpu.ndarray.ndarray import NDArray as cls
+    if manifest is None:
+        manifest = SIGNATURES
     covered, total, missing = 0, 0, []
-    for family, entries in SIGNATURES.items():
+    for family, entries in manifest.items():
         for sig, py in entries:
             total += 1
             attr = getattr(cls, py, None)
