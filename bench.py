@@ -251,8 +251,19 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
     # transformer.FLASH_MIN_SEQ). Override via BENCH_FLASH=0/1 for A/B runs.
     if os.environ.get("BENCH_FLASH"):
         transformer_mod.FLASH_ATTENTION = os.environ["BENCH_FLASH"] == "1"
+    # Live-window A/B knobs (never set by the driver): pin the CE chunking,
+    # the batch ladder, or skip the flax denominator to halve a probe's cost.
+    ce_override = (int(os.environ["BENCH_CE_CHUNKS"])
+                   if os.environ.get("BENCH_CE_CHUNKS") else None)
+    if ce_override is not None and ce_override <= 1:
+        ce_override = 0                      # 0 and 1 both mean "unchunked"
+    batch_override = (int(os.environ["BENCH_BATCH"])
+                      if os.environ.get("BENCH_BATCH") else None)
+    skip_flax = os.environ.get("BENCH_SKIP_FLAX") == "1"
 
-    def build_cfg(remat):
+    def build_cfg(remat, ce_chunks):
+        if ce_override is not None:
+            ce_chunks = ce_override
         if not on_tpu:                       # CPU smoke (driver fallback)
             return TransformerConfig(
                 vocab_size=1024, n_layers=2, n_heads=4, d_model=128,
@@ -265,30 +276,42 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
             return TransformerConfig(
                 vocab_size=16384, n_layers=4, n_heads=8, d_model=512,
                 max_len=512, dtype=jnp.bfloat16, remat=remat, fused_qkv=True,
-                ce_chunks=4)
+                ce_chunks=ce_chunks)
         # "large": ~190M params so the MXU (not HBM) sets the ceiling
         return TransformerConfig(
             vocab_size=32768, n_layers=12, n_heads=16, d_model=1024,
             max_len=1024, dtype=jnp.bfloat16, remat=remat, fused_qkv=True,
-            ce_chunks=8,                     # V=32768 streams as 8x4096
-        )
+            ce_chunks=ce_chunks)
 
     iters = 10 if on_tpu else 5
     repeats = 3
     rng = np.random.default_rng(0)
 
-    # OOM ladder: full batch → remat (recompute activations) → half batch.
+    # OOM ladder: unchunked CE first (measured 2.7% faster on-device at the
+    # large config, 2026-07-31 window), then chunked CE (streams the
+    # (B,T,V) logits — the memory saver), then remat, then half batch.
     # HBM is 16 GB on v5e; the warmup step is where RESOURCE_EXHAUSTED
     # surfaces, so each rung is attempted through it
     if not on_tpu:
-        ladder = [(4, False)]
+        ladder = [(4, False, 0)]
     elif rung == "small":
-        ladder = [(32, False), (16, False)]
+        ladder = [(32, False, 0), (32, False, 4), (16, False, 4)]
     else:
-        ladder = [(8, False), (8, True), (4, True)]
+        ladder = [(8, False, 0), (8, False, 8), (8, True, 8), (4, True, 8)]
+    if batch_override is not None:
+        ladder = [(batch_override, False, 0)]
+    if ce_override is not None:
+        # the override collapses the ce dimension — drop rungs that become
+        # duplicates so an OOM is never retried on an identical config
+        seen, deduped = set(), []
+        for b, r, _ in ladder:
+            if (b, r) not in seen:
+                seen.add((b, r))
+                deduped.append((b, r, ce_override))
+        ladder = deduped
     last_err = None
-    for batch, remat in ladder:
-        cfg = build_cfg(remat)
+    for batch, remat, ce_chunks in ladder:
+        cfg = build_cfg(remat, ce_chunks)
         model = TransformerLM(cfg, mesh=None)
         params = model.init_params(jax.random.key(0))
         opt = optax.adamw(3e-4)
@@ -319,6 +342,8 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
     # --- plain-Flax denominator on the same chip, measured INTERLEAVED ---
     flax_timer = None
     try:
+        if skip_flax:
+            raise RuntimeError("BENCH_SKIP_FLAX=1 (A/B probe)")
         phase("flax denominator warmup (compile)")
         flax_timer = flax_baseline_timer(cfg, batch, iters)
     except Exception as e:  # measured best-effort; failure is reported, not hidden

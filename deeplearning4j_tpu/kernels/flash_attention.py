@@ -26,8 +26,8 @@ from jax.experimental.pallas import tpu as pltpu
 # 256x512 tiles: ~4x fewer grid cells and larger MXU matmuls than the
 # round-2 128x128 defaults (measured slow on v5e); the device-timed sweep
 # in benchmarks/flash_crossover.py refines these per (d_head, T)
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 

@@ -42,11 +42,12 @@ FLASH_ATTENTION: Optional[bool] = None
 
 # auto-policy crossover: below this sequence length the XLA attention's
 # (T, T) materialization is cheap enough that it beats the Pallas kernel on
-# device-measured step time (v5e, d_head=64: flash lost at T=512 even after
-# the bf16 rewrite); at/above it the O(T²) scores tensor dominates HBM and
-# flash wins on memory regardless. Conservative until the device-timed
-# crossover sweep (benchmarks/flash_crossover.py) runs on hardware.
-FLASH_MIN_SEQ = 2048
+# device-measured step time; at/above it the Pallas kernel wins outright.
+# Hardware-measured crossover (v5e, 2026-07-31, fwd+grad, D=64, causal,
+# benchmarks/flash_crossover.py): XLA 2.7x faster at T=512, dead heat at
+# T=2048 (XLA 4.90 ms vs flash 5.01 ms), flash 1.71x faster at T=8192
+# (17.2 ms vs 29.4 ms) with bq=512/bk=1024 tiles.
+FLASH_MIN_SEQ = 4096
 
 
 _FLASH_LOWERS: Optional[bool] = None
