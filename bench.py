@@ -299,7 +299,10 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
     else:
         ladder = [(8, False, 0), (8, False, 8), (8, True, 8), (4, True, 8)]
     if batch_override is not None:
-        ladder = [(batch_override, False, 0)]
+        # batch-only probe: keep the rung's CE progression so the override
+        # changes ONE variable and retains the chunked-CE OOM fallback
+        ce_rungs = sorted({ce for _, _, ce in ladder})
+        ladder = [(batch_override, False, ce) for ce in ce_rungs]
     if ce_override is not None:
         # the override collapses the ce dimension — drop rungs that become
         # duplicates so an OOM is never retried on an identical config

@@ -23,9 +23,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 256x512 tiles: ~4x fewer grid cells and larger MXU matmuls than the
-# round-2 128x128 defaults (measured slow on v5e); the device-timed sweep
-# in benchmarks/flash_crossover.py refines these per (d_head, T)
+# 512x1024 tiles: hardware-measured best on v5e (2026-07-31 crossover
+# sweep, benchmarks/flash_crossover.py — beat 256/512 at every T probed,
+# 17.2 ms vs 19.8 ms at T=8192); clamped to seq_len below, so short
+# sequences degrade gracefully
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30

@@ -2,6 +2,7 @@
 from deeplearning4j_tpu.ndarray.ndarray import NDArray
 from deeplearning4j_tpu.ndarray import surface as _surface  # noqa: F401 — tranche-3 methods
 from deeplearning4j_tpu.ndarray import surface4 as _surface4  # noqa: F401 — tranche-4 methods
+from deeplearning4j_tpu.ndarray import surface5 as _surface5  # noqa: F401 — tranche-5 methods
 from deeplearning4j_tpu.ndarray import factory as nd
 from deeplearning4j_tpu.ndarray.factory import Nd4j
 from deeplearning4j_tpu.ndarray import dtypes

@@ -1010,3 +1010,114 @@ def version() -> str:
 
 
 _populate_nd4j_facade()
+
+
+# --------------------------------------------------------------------------
+# Tranche-6 statics: the probed remaining Nd4j surface
+# (ref: org.nd4j.linalg.factory.Nd4j, SURVEY.md:95-100 J1)
+
+def getDataType():
+    """ref: Nd4j.dataType()/getDataType — the global default dtype."""
+    return _default_dtype
+
+
+def setDataType(dtype):
+    """ref: Nd4j.setDataType(DataType) — alias of setDefaultDataType."""
+    setDefaultDataType(dtype)
+
+
+def typeConversion(arr, dtype):
+    """ref: Nd4j.typeConversion(INDArray, DataTypeEx) — dtype cast through
+    the executioner; on TPU a pure `convert_element_type`."""
+    a = arr if isinstance(arr, NDArray) else NDArray(arr)
+    return a.castTo(dtype)
+
+
+def batchMmul(matrices_a, matrices_b, transpose_a: bool = False,
+              transpose_b: bool = False):
+    """ref: Nd4j.batchMmul(INDArray[], INDArray[]) — N independent GEMMs.
+
+    TPU-first divergence: the reference loops gemm over the array pairs
+    (libnd4j batched_gemm); here the pairs are STACKED into a single
+    (N, m, k) x (N, k, n) `jnp.matmul` so XLA tiles ONE batched MXU
+    computation instead of N kernel launches."""
+    As = jnp.stack([(_m.buf() if isinstance(_m, NDArray)
+                     else jnp.asarray(_m)) for _m in matrices_a])
+    Bs = jnp.stack([(_m.buf() if isinstance(_m, NDArray)
+                     else jnp.asarray(_m)) for _m in matrices_b])
+    if transpose_a:
+        As = jnp.swapaxes(As, -1, -2)
+    if transpose_b:
+        Bs = jnp.swapaxes(Bs, -1, -2)
+    out = jnp.matmul(As, Bs)
+    return [NDArray(out[i]) for i in range(out.shape[0])]
+
+
+def createBuffer(data_or_length, dtype=None):
+    """ref: Nd4j.createBuffer(...) — DataBuffer creation. PJRT owns device
+    storage on TPU (SURVEY N7 yes-D), so the "buffer" equivalent is the
+    flat host-side array that backs an NDArray: int/long → zero-filled
+    flat buffer of that length; array-like → its flat copy."""
+    dt = _dt.resolve(dtype) if dtype is not None else _default_dtype
+    if isinstance(data_or_length, (int, np.integer)):
+        return NDArray(jnp.zeros((int(data_or_length),), dt))
+    flat = jnp.asarray(
+        data_or_length.toNumpy() if isinstance(data_or_length, NDArray)
+        else data_or_length).reshape(-1)
+    return NDArray(flat.astype(dt) if dtype is not None else flat)
+
+
+def createArrayFromShapeBuffer(buffer, shape_info):
+    """ref: Nd4j.createArrayFromShapeBuffer(DataBuffer, DataBuffer/long[])
+    — reassemble an array from a flat buffer + shape descriptor. The TPU
+    shape descriptor is the logical shape tuple (XLA owns strides)."""
+    flat = (buffer.buf() if isinstance(buffer, NDArray)
+            else jnp.asarray(buffer)).reshape(-1)
+    shape = tuple(int(s) for s in
+                  (shape_info.toNumpy().astype(int)
+                   if isinstance(shape_info, NDArray) else shape_info))
+    return NDArray(flat.reshape(shape))
+
+
+def versionCheck():
+    """ref: nd4j-common org.nd4j.versioncheck.VersionCheck — asserts the
+    classpath backend/api versions agree. One wheel here: always
+    consistent; returns the version string it validated."""
+    return version()
+
+
+class _DeallocatorService:
+    """ref: Nd4j.getDeallocatorService() — JVM-side reference-queue
+    deallocator for off-heap buffers. PJRT owns buffer lifetime on TPU
+    (SURVEY N7), so the service reports zero queued deallocations."""
+
+    def pendingDeallocations(self):
+        return 0
+
+    def deallocate(self, _array=None):  # buffers are GC/PJRT-managed
+        return True
+
+
+_deallocator_service = _DeallocatorService()
+
+
+def getDeallocatorService():
+    return _deallocator_service
+
+
+class _ShapeInfoProvider:
+    """ref: Nd4j.getShapeInfoProvider() → ShapeInfoProvider — builds the
+    packed shape-info descriptor. Here the descriptor is (shape, order)."""
+
+    def createShapeInformation(self, shape, order="c"):
+        return (tuple(int(s) for s in shape), order)
+
+
+_shape_info_provider = _ShapeInfoProvider()
+
+
+def getShapeInfoProvider():
+    return _shape_info_provider
+
+
+_populate_nd4j_facade()

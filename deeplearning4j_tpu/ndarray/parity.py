@@ -288,6 +288,15 @@ def _sigs() -> Dict[str, List[Entry]]:
         ("nnz()", "nnz"),
         ("getVectorCoordinates()", "getVectorCoordinates"),
         ("sparseInfoDataBuffer()", "sparseInfoDataBuffer")]
+
+    # --------------------------------------- tranche 5 (surface5.py)
+    fam["condition_serial"] = [
+        ("cond(Condition)", "cond"), ("condi(Condition)", "condi"),
+        ("toFlatArray(FlatBufferBuilder)", "toFlatArray"),
+        ("isInScope()", "isInScope"),
+        ("setShape(long...)", "setShape"),
+        ("setStride(long...)", "setStride"),
+        ("setData(DataBuffer)", "setData")]
     return fam
 
 
@@ -471,6 +480,22 @@ def _nd4j_sigs() -> Dict[str, List[Entry]]:
         ("sizeOfDataType(DataType)", "sizeOfDataType"),
         ("exec(Op)", "exec_"), ("exec(CustomOp)", "exec_"),
         ("setSeed(long)", "setSeed"), ("version()", "version")]
+    # ------------------------------------------ tranche 6 (probed tail)
+    fam["buffers_runtime"] = [
+        ("getDataType()", "getDataType"),
+        ("setDataType(DataType)", "setDataType"),
+        ("typeConversion(INDArray, DataTypeEx)", "typeConversion"),
+        ("batchMmul(INDArray[], INDArray[])", "batchMmul"),
+        ("batchMmul(INDArray[], INDArray[], boolean, boolean)",
+         "batchMmul"),
+        ("createBuffer(long)", "createBuffer"),
+        ("createBuffer(float[])", "createBuffer"),
+        ("createBuffer(double[], DataType)", "createBuffer"),
+        ("createArrayFromShapeBuffer(DataBuffer, DataBuffer)",
+         "createArrayFromShapeBuffer"),
+        ("versionCheck()", "versionCheck"),
+        ("getDeallocatorService()", "getDeallocatorService"),
+        ("getShapeInfoProvider()", "getShapeInfoProvider")]
     return fam
 
 

@@ -1,0 +1,105 @@
+"""INDArray surface, tranche 5 — closing the last probed name gaps.
+
+Reference: ``org.nd4j.linalg.api.ndarray.INDArray`` / ``BaseNDArray``
+(nd4j-api, SURVEY.md:95-100 J1/N1):
+
+- ``cond(Condition)`` / ``condi(Condition)`` — element-wise condition to a
+  0/1 array (BaseNDArray#cond applies the Condition op; the i-variant
+  mutates). Here both evaluate the mask with XLA compare ops; ``condi``
+  write-through-assigns into the view like every other i-variant.
+- ``toFlatArray(FlatBufferBuilder)`` — the reference serializes into the
+  libnd4j FlatBuffers ``FlatArray`` table (N6 schema). The TPU build's
+  graph persistence is zip(graph.json + npz) (autodiff/samediff.py
+  divergence note), so the equivalent portable flat encoding is the npy
+  byte payload + dtype/shape header returned as ``bytes``.
+- ``isInScope()`` — workspace-scope check (J5). Workspaces are subsumed by
+  donated jitted buffers; every live NDArray is by construction in scope.
+- ``setShape``/``setStride``/``setData`` — the deprecated in-place layout
+  mutators of BaseNDArray. Strides are XLA-owned here (SURVEY N1
+  divergence): ``setShape`` reshapes through the view write path,
+  ``setStride`` validates-and-ignores (physical layout is the compiler's),
+  ``setData`` replaces the buffer contents.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _cond_mask, _unwrap
+
+
+def extend_tranche5():
+    N = NDArray
+
+    def cond(self, condition):
+        """ref: INDArray#cond(Condition) — 1.0 where the condition holds."""
+        return NDArray(_cond_mask(self.buf(), condition)
+                       .astype(self.buf().dtype))
+
+    def condi(self, condition):
+        """ref: INDArray#condi(Condition) — in-place form of #cond."""
+        return self._write(_cond_mask(self.buf(), condition)
+                           .astype(self.buf().dtype))
+
+    N.cond = cond
+    N.condi = condi
+
+    def toFlatArray(self):
+        """ref: BaseNDArray#toFlatArray(FlatBufferBuilder) → the serialized
+        FlatArray payload. Portable flat encoding here = npy bytes (dtype +
+        shape header + row-major data), round-tripped by Nd4j.fromByteArray /
+        numpy.load. Delegates to the maintained codec (factory.toByteArray)."""
+        from deeplearning4j_tpu.ndarray.factory import toByteArray
+        return toByteArray(self)
+
+    N.toFlatArray = toFlatArray
+
+    def isInScope(self):
+        """ref: INDArray#isInScope() — workspace scope check (J5). PJRT
+        buffers have no scoped arena; a live array is always in scope."""
+        return True
+
+    N.isInScope = isInScope
+
+    def setShape(self, *shape):
+        """ref: BaseNDArray#setShape(long...) (deprecated mutator) —
+        in-place relayout; lowers to a write-through reshape. Refused on
+        views: the write-through path scatters into the parent's index
+        slot, whose shape must match (reshape the dup instead)."""
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        if self._base is not None and shape != self.shape:
+            raise ValueError(
+                "setShape on a view is unsupported: the view writes through "
+                "to a fixed-shape slot of its parent; use dup().reshape()")
+        return self._write(jnp.reshape(self.buf(), shape))
+
+    def setStride(self, *stride):
+        """ref: BaseNDArray#setStride(long...) (deprecated) — physical
+        strides are XLA-owned on TPU; the call validates rank and is
+        otherwise a no-op (documented N1 divergence)."""
+        if len(stride) == 1 and isinstance(stride[0], (tuple, list)):
+            stride = tuple(stride[0])
+        if len(stride) != len(self.shape):
+            raise ValueError(
+                f"stride rank {len(stride)} != array rank {len(self.shape)}")
+        return self
+
+    def setData(self, data):
+        """ref: BaseNDArray#setData(DataBuffer) (deprecated) — replace the
+        backing contents, preserving this array's shape."""
+        flat = jnp.asarray(_unwrap(data)).reshape(-1)
+        if flat.size != int(np.prod(self.shape)):
+            raise ValueError(
+                f"data length {flat.size} != array length "
+                f"{int(np.prod(self.shape))}")
+        return self._write(flat.astype(self.buf().dtype)
+                           .reshape(self.shape))
+
+    N.setShape = setShape
+    N.setStride = setStride
+    N.setData = setData
+
+
+extend_tranche5()
