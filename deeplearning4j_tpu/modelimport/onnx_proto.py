@@ -224,6 +224,8 @@ def _attr(name: str, v) -> bytes:
         out += _field(4, 2, v.encode()) + _i(20, 3)
     elif isinstance(v, np.ndarray):
         out += _field(5, 2, make_tensor("", v)) + _i(20, 4)
+    elif isinstance(v, bytes):              # serialized GraphProto (If/Loop)
+        out += _field(6, 2, v) + _i(20, 5)
     elif isinstance(v, (list, tuple)) and all(isinstance(x, int) for x in v):
         out += b"".join(_i(8, x) for x in v) + _i(20, 7)
     elif isinstance(v, (list, tuple)):

@@ -78,6 +78,8 @@ def _attrs(node: dict) -> dict:
             out[a["name"]] = a.get("s", b"").decode()
         elif t == 4:
             out[a["name"]] = op_.tensor_to_np(a["t"])
+        elif t == 5:                       # GRAPH — subgraph (If/Loop/Scan)
+            out[a["name"]] = a.get("g")
         elif t == 6:
             out[a["name"]] = a.get("floats", [])
         elif t == 7:
@@ -779,6 +781,72 @@ def _register_onnx_rules():
 _register_onnx_rules()
 
 
+def _walk_nodes(ctx: "_Ctx", graph: dict):
+    """Map every node of ``graph`` through the rule registry into
+    ``ctx.sd`` — shared by the top-level import and subgraph (If/Loop)
+    body builders."""
+    sd = ctx.sd
+    for node in graph.get("node", []):
+        rule = _ONNX_RULES.get(node.get("op_type"))
+        if rule is None:
+            raise ONNXImportError(
+                f"No mapping rule for ONNX op {node.get('op_type')!r} "
+                f"(node {node.get('name')!r}); register one with "
+                f"@onnximport.onnx_rule({node.get('op_type')!r})")
+        inputs = [ctx.vars[r] for r in node.get("input", []) if r]
+        attrs = _attrs(node)
+        out = rule(ctx, node, inputs, attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for tensor_name, o in zip(node["output"], outs):
+            ctx.vars[tensor_name] = o
+            if o.name != tensor_name and tensor_name not in sd._vars:
+                o.rename(tensor_name)
+
+
+def _subgraph_captures(graph: dict, outer_ctx: "_Ctx") -> List[str]:
+    """Outer-scope tensor names referenced by ``graph``'s nodes (ONNX
+    subgraphs capture implicitly), in first-use order."""
+    needed: List[str] = []
+
+    def walk(g, local):
+        local = set(local)
+        local |= {i["name"] for i in g.get("initializer", [])}
+        local |= {vi["name"] for vi in g.get("input", [])}
+        for node in g.get("node", []):
+            for r in node.get("input", []):
+                if r and r not in local and r in outer_ctx.vars \
+                        and r not in needed:
+                    needed.append(r)
+            for a in node.get("attribute", []):
+                if a.get("type") == 5 and a.get("g"):
+                    walk(a["g"], local)
+            local |= set(node.get("output", []))
+
+    walk(graph, set())
+    return needed
+
+
+def _subgraph_body(outer_ctx: "_Ctx", graph: dict, seed_names: List[str]):
+    """Build an ``fn(sub_sd, *args)`` body that maps ``graph`` with
+    ``seed_names[i]`` bound to ``args[i]`` and returns the graph outputs."""
+
+    def body(sub_sd, *args):
+        ctx2 = _Ctx(sub_sd)
+        ctx2.consts.update(outer_ctx.consts)
+        for nm, a in zip(seed_names, args):
+            ctx2.vars[nm] = a
+        for init in graph.get("initializer", []):
+            arr = op_.tensor_to_np(init)
+            ctx2.consts[init["name"]] = arr
+            ctx2.vars[init["name"]] = sub_sd.constant(arr,
+                                                      name=init["name"])
+        _walk_nodes(ctx2, graph)
+        outs = [ctx2.vars[o["name"]] for o in graph.get("output", [])]
+        return outs if len(outs) != 1 else outs[0]
+
+    return body
+
+
 class OnnxGraphMapper:
     """ref: OnnxFrameworkImporter#runImport — ONNX ModelProto → SameDiff."""
 
@@ -811,21 +879,7 @@ class OnnxGraphMapper:
             dt = op_.onnx_dtype(tt.get("elem_type", 1))
             ctx.vars[vi["name"]] = sd.placeholder(vi["name"],
                                                   tuple(dims) or None, dt)
-        for node in graph.get("node", []):
-            rule = _ONNX_RULES.get(node.get("op_type"))
-            if rule is None:
-                raise ONNXImportError(
-                    f"No mapping rule for ONNX op {node.get('op_type')!r} "
-                    f"(node {node.get('name')!r}); register one with "
-                    f"@onnximport.onnx_rule({node.get('op_type')!r})")
-            inputs = [ctx.vars[r] for r in node.get("input", []) if r]
-            attrs = _attrs(node)
-            out = rule(ctx, node, inputs, attrs)
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            for tensor_name, o in zip(node["output"], outs):
-                ctx.vars[tensor_name] = o
-                if o.name != tensor_name and tensor_name not in sd._vars:
-                    o.rename(tensor_name)
+        _walk_nodes(ctx, graph)
         return sd
 
     importModel = import_model
@@ -948,3 +1002,345 @@ def _register_onnx_rules_t2():
 
 
 _register_onnx_rules_t2()
+
+
+# --------------------------------------------------------------------------
+# rule tranche 3 (round 3, continued): control flow, quantized ops, image
+# sampling, Lp family, random generators, and loud informative errors for
+# the dynamic-shape / sequence-typed remainder
+def _register_onnx_rules_t3():
+    @onnx_rule("Upsample")
+    def _upsample(ctx, node, inputs, attrs):
+        # deprecated opset-9 alias of Resize: scales via input 1 (or the
+        # even older 'scales' attr)
+        mode = attrs.get("mode", "nearest")
+        ins = node["input"]
+        if len(ins) > 1 and ins[1]:
+            scales = [float(v) for v in ctx.const(ins[1])]
+        elif attrs.get("scales"):
+            scales = [float(v) for v in attrs["scales"]]
+        else:
+            raise ONNXImportError("Upsample needs scales")
+        shape = inputs[0].shape
+        out_h = int(shape[2] * scales[2])
+        out_w = int(shape[3] * scales[3])
+        op = {"nearest": "resize_nearest_neighbor",
+              "linear": "resize_bilinear"}.get(mode, "resize_bilinear")
+        nhwc = ctx.sd._op("transpose", inputs[0], perm=[0, 2, 3, 1])
+        out = ctx.sd._op(op, nhwc, size=(out_h, out_w))
+        return ctx.sd._op("transpose", out, perm=[0, 3, 1, 2])
+
+    @onnx_rule("Scatter")
+    def _scatter_deprecated(ctx, node, inputs, attrs):
+        # opset-9 deprecated alias of ScatterElements
+        return ctx.sd._op("scatter_elements", *inputs,
+                          axis=int(attrs.get("axis", 0)))
+
+    @onnx_rule("LpNormalization")
+    def _lp_norm(ctx, node, inputs, attrs):
+        axis = int(attrs.get("axis", -1))
+        p = int(attrs.get("p", 2))
+        x = inputs[0]
+        if p == 2:
+            n = ctx.sd._op("reduce_norm2", x, axis=(axis,), keepdims=True)
+        else:                              # p == 1
+            n = ctx.sd._op("reduce_sum", ctx.sd._op("abs", x),
+                           axis=(axis,), keepdims=True)
+        return ctx.sd._op("div", x, n)
+
+    @onnx_rule("LpPool")
+    def _lp_pool(ctx, node, inputs, attrs):
+        k = attrs["kernel_shape"]
+        return ctx.sd._op("lp_pool2d_nchw", inputs[0], kernel=tuple(k),
+                          strides=tuple(attrs.get("strides", [1] * len(k))),
+                          padding=_pads(attrs, len(k)),
+                          p=float(attrs.get("p", 2)))
+
+    @onnx_rule("GlobalLpPool")
+    def _global_lp_pool(ctx, node, inputs, attrs):
+        h, w = inputs[0].shape[2], inputs[0].shape[3]
+        return ctx.sd._op("lp_pool2d_nchw", inputs[0], kernel=(int(h),
+                                                               int(w)),
+                          p=float(attrs.get("p", 2)))
+
+    @onnx_rule("MeanVarianceNormalization")
+    def _mvn(ctx, node, inputs, attrs):
+        axes = tuple(attrs.get("axes", [0, 2, 3]))
+        x = inputs[0]
+        mean = ctx.sd._op("reduce_mean", x, axis=axes, keepdims=True)
+        centered = ctx.sd._op("subtract", x, mean)
+        var = ctx.sd._op("reduce_mean", ctx.sd._op("square", centered),
+                         axis=axes, keepdims=True)
+        return ctx.sd._op("div", centered, ctx.sd._op("sqrt", var))
+
+    @onnx_rule("SoftmaxCrossEntropyLoss")
+    def _sce_loss(ctx, node, inputs, attrs):
+        if attrs.get("ignore_index") is not None:
+            raise ONNXImportError(
+                "SoftmaxCrossEntropyLoss ignore_index unsupported")
+        scores, labels = inputs[0], inputs[1]
+        weights = inputs[2] if len(inputs) > 2 else None
+        logp = ctx.sd._op("log_softmax", scores, axis=1)
+        oh = ctx.sd._op("one_hot", labels, depth=int(scores.shape[1]),
+                        axis=1)
+        nll = ctx.sd._op("neg", ctx.sd._op(
+            "reduce_sum", ctx.sd._op("multiply", logp, oh), axis=(1,)))
+        if weights is not None:
+            w_per = ctx.sd._op("gather", weights, labels, axis=0)
+            nll = ctx.sd._op("multiply", nll, w_per)
+        red = attrs.get("reduction", "mean")
+        if red == "none":
+            loss = nll
+        elif red == "sum":
+            loss = ctx.sd._op("reduce_sum", nll)
+        elif weights is not None:
+            # spec: weighted mean divides by the SUM OF WEIGHTS
+            loss = ctx.sd._op("div", ctx.sd._op("reduce_sum", nll),
+                              ctx.sd._op("reduce_sum", w_per))
+        else:
+            loss = ctx.sd._op("reduce_mean", nll)
+        return [loss, logp]
+
+    @onnx_rule("QuantizeLinear")
+    def _quantize_linear(ctx, node, inputs, attrs):
+        x = inputs[0]
+        scale = np.asarray(ctx.const(node["input"][1]))
+        ins = node.get("input", [])
+        zp = (np.asarray(ctx.const(ins[2]))
+              if len(ins) > 2 and ins[2] else np.zeros((), np.uint8))
+        axis = int(attrs.get("axis", 1))
+        qdt = zp.dtype
+        lo, hi = np.iinfo(qdt).min, np.iinfo(qdt).max
+        if scale.ndim == 1:                # per-axis: broadcast along axis
+            bshape = [1] * len(x.shape)
+            bshape[axis] = scale.shape[0]
+            scale = scale.reshape(bshape)
+            zp = zp.reshape(bshape) if zp.ndim == 1 else zp
+        scaled = ctx.sd._op("div", x,
+                            ctx.sd.constant(scale.astype(np.float32)))
+        rounded = ctx.sd._op("rint", scaled)   # round half-to-even (spec)
+        shifted = ctx.sd._op("add", rounded, ctx.sd.constant(
+            zp.astype(np.float32)))
+        clipped = ctx.sd._op("clip_by_value", shifted, clip_value_min=lo,
+                             clip_value_max=hi)
+        return ctx.sd._op("Cast", clipped, dtype=np.dtype(qdt).name)
+
+    @onnx_rule("DequantizeLinear")
+    def _dequantize_linear(ctx, node, inputs, attrs):
+        x = inputs[0]
+        scale = np.asarray(ctx.const(node["input"][1]))
+        ins = node.get("input", [])
+        zp = (np.asarray(ctx.const(ins[2]))
+              if len(ins) > 2 and ins[2] else np.zeros((), np.int32))
+        axis = int(attrs.get("axis", 1))
+        if scale.ndim == 1:
+            bshape = [1] * len(x.shape)
+            bshape[axis] = scale.shape[0]
+            scale = scale.reshape(bshape)
+            zp = zp.reshape(bshape) if zp.ndim == 1 else zp
+        xf = ctx.sd._op("Cast", x, dtype="float32")
+        centered = ctx.sd._op("subtract", xf, ctx.sd.constant(
+            zp.astype(np.float32)))
+        return ctx.sd._op("multiply", centered, ctx.sd.constant(
+            scale.astype(np.float32)))
+
+    @onnx_rule("MatMulInteger")
+    def _matmul_integer(ctx, node, inputs, attrs):
+        a, b = inputs[0], inputs[1]
+        ins = node.get("input", [])
+        ai = ctx.sd._op("Cast", a, dtype="int32")
+        bi = ctx.sd._op("Cast", b, dtype="int32")
+        if len(ins) > 2 and ins[2]:
+            ai = ctx.sd._op("subtract", ai, ctx.sd._op(
+                "Cast", ctx.vars[ins[2]], dtype="int32"))
+        if len(ins) > 3 and ins[3]:
+            bi = ctx.sd._op("subtract", bi, ctx.sd._op(
+                "Cast", ctx.vars[ins[3]], dtype="int32"))
+        return ctx.sd._op("matmul", ai, bi)
+
+    @onnx_rule("ConvInteger")
+    def _conv_integer(ctx, node, inputs, attrs):
+        ins = node.get("input", [])
+        x_zp = (ctx.vars[ins[2]] if len(ins) > 2 and ins[2]
+                else ctx.sd.constant(np.zeros((), np.int32)))
+        w_zp = (ctx.vars[ins[3]] if len(ins) > 3 and ins[3]
+                else ctx.sd.constant(np.zeros((), np.int32)))
+        k = attrs.get("kernel_shape", [1, 1])
+        return ctx.sd._op(
+            "conv_integer", inputs[0], inputs[1], x_zp, w_zp,
+            strides=tuple(attrs.get("strides", [1] * len(k))),
+            padding=_pads(attrs, len(k)),
+            dilations=tuple(attrs.get("dilations", [1] * len(k))))
+
+    @onnx_rule("GridSample")
+    def _grid_sample(ctx, node, inputs, attrs):
+        return ctx.sd._op(
+            "grid_sample", inputs[0], inputs[1],
+            mode={"linear": "bilinear"}.get(attrs.get("mode", "bilinear"),
+                                            attrs.get("mode", "bilinear")),
+            padding_mode=attrs.get("padding_mode", "zeros"),
+            align_corners=bool(attrs.get("align_corners", 0)))
+
+    @onnx_rule("MaxUnpool")
+    def _max_unpool(ctx, node, inputs, attrs):
+        x, indices = inputs[0], inputs[1]
+        ins = node.get("input", [])
+        if len(ins) > 2 and ins[2]:
+            out_shape = [int(v) for v in ctx.const(ins[2])]
+        else:
+            k = attrs["kernel_shape"]
+            st = attrs.get("strides", [1] * len(k))   # ONNX default: 1s
+            pads = attrs.get("pads", [0] * (2 * len(k)))
+            n, c, ph, pw = x.shape
+            # spec: out = (in - 1)*stride + kernel - pad_begin - pad_end
+            out_shape = [int(n), int(c),
+                         (int(ph) - 1) * st[0] + k[0] - pads[0]
+                         - pads[len(k)],
+                         (int(pw) - 1) * st[1] + k[1] - pads[1]
+                         - pads[len(k) + 1]]
+        spatial = int(np.prod(out_shape[2:]))
+        # ONNX MaxPool indices are flat over the WHOLE NCHW tensor;
+        # max_unpool wants per-(N,C) spatial offsets — mod folds them
+        local = ctx.sd._op("mod", indices, ctx.sd.constant(
+            np.asarray(spatial, np.int64)))
+        return ctx.sd._op("max_unpool", x, local,
+                          output_shape=tuple(out_shape))
+
+    @onnx_rule("Compress")
+    def _compress(ctx, node, inputs, attrs):
+        cond = np.asarray(ctx.const(node["input"][1])).astype(bool)
+        idx = np.nonzero(cond)[0].astype(np.int64)
+        axis = attrs.get("axis")
+        gather_idx = ctx.sd.constant(idx)
+        if axis is None:
+            flat = ctx.sd._op("Reshape", inputs[0], shape=(-1,))
+            return ctx.sd._op("gather", flat, gather_idx, axis=0)
+        return ctx.sd._op("gather", inputs[0], gather_idx,
+                          axis=int(axis))
+
+    @onnx_rule("RandomNormal", "RandomNormalLike")
+    def _random_normal(ctx, node, inputs, attrs):
+        if node["op_type"].endswith("Like"):
+            shape = tuple(int(s) for s in inputs[0].shape)
+            default_dt = str(inputs[0].dtype)     # spec: inherit input dtype
+        else:
+            shape = tuple(int(s) for s in attrs["shape"])
+            default_dt = "float32"
+        dt = (op_.onnx_dtype(attrs["dtype"]).name if "dtype" in attrs
+              else default_dt)
+        seed = attrs.get("seed")
+        out = ctx.sd._op("random_normal_gen", shape=shape,
+                         mean=float(attrs.get("mean", 0.0)),
+                         scale=float(attrs.get("scale", 1.0)),
+                         seed=int(seed) if seed is not None else None)
+        return ctx.sd._op("Cast", out, dtype=dt)
+
+    @onnx_rule("RandomUniform", "RandomUniformLike")
+    def _random_uniform(ctx, node, inputs, attrs):
+        if node["op_type"].endswith("Like"):
+            shape = tuple(int(s) for s in inputs[0].shape)
+            default_dt = str(inputs[0].dtype)
+        else:
+            shape = tuple(int(s) for s in attrs["shape"])
+            default_dt = "float32"
+        dt = (op_.onnx_dtype(attrs["dtype"]).name if "dtype" in attrs
+              else default_dt)
+        seed = attrs.get("seed")
+        out = ctx.sd._op("random_uniform_gen", shape=shape,
+                         low=float(attrs.get("low", 0.0)),
+                         high=float(attrs.get("high", 1.0)),
+                         seed=int(seed) if seed is not None else None)
+        return ctx.sd._op("Cast", out, dtype=dt)
+
+    @onnx_rule("If")
+    def _if(ctx, node, inputs, attrs):
+        then_g, else_g = attrs["then_branch"], attrs["else_branch"]
+        if then_g is None or else_g is None:
+            raise ONNXImportError("If: missing branch subgraph")
+        caps = _subgraph_captures(then_g, ctx)
+        for nm in _subgraph_captures(else_g, ctx):
+            if nm not in caps:
+                caps.append(nm)
+        operands = [ctx.vars[nm] for nm in caps]
+        return ctx.sd.if_cond(inputs[0],
+                              _subgraph_body(ctx, then_g, caps),
+                              _subgraph_body(ctx, else_g, caps),
+                              *operands)
+
+    @onnx_rule("Loop")
+    def _loop(ctx, node, inputs, attrs):
+        body_g = attrs["body"]
+        ins = node.get("input", [])
+        b_inputs = [vi["name"] for vi in body_g.get("input", [])]
+        n_carried = len(b_inputs) - 2
+        n_body_out = len(body_g.get("output", []))
+        if n_body_out > 1 + n_carried:
+            raise ONNXImportError(
+                "Loop with scan outputs unsupported — hoist the "
+                "accumulation into a loop-carried tensor of static length")
+        m_name = ins[0] if len(ins) > 0 else ""
+        cond_name = ins[1] if len(ins) > 1 else ""
+        trip_max = (int(np.asarray(ctx.const(m_name)).reshape(()))
+                    if m_name else None)
+        carried = [ctx.vars[r] for r in ins[2:]]
+        caps = _subgraph_captures(body_g, ctx)
+        cap_vars = [ctx.vars[nm] for nm in caps]
+        i0 = ctx.sd.constant(np.asarray(0, np.int64))
+        c0 = (ctx.vars[cond_name] if cond_name
+              else ctx.sd.constant(np.asarray(True)))
+        n_car = len(carried)
+
+        def cond_body(sub_sd, i, c, *rest):
+            out = c
+            if trip_max is not None:
+                lim = sub_sd.constant(np.asarray(trip_max, np.int64))
+                out = sub_sd._op("boolean_and",
+                                 sub_sd._op("Cast", c, dtype="bool"),
+                                 sub_sd._op("less", i, lim))
+            return sub_sd._op("Cast", out, dtype="bool")
+
+        def loop_body(sub_sd, i, c, *rest):
+            vs, cvs = rest[:n_car], rest[n_car:]
+            seeds = ([b_inputs[0], b_inputs[1]] + list(b_inputs[2:])
+                     + list(caps))
+            body = _subgraph_body(ctx, body_g, seeds)
+            outs = body(sub_sd, i, c, *vs, *cvs)
+            outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            one = sub_sd.constant(np.asarray(1, np.int64))
+            return [sub_sd._op("add", i, one), outs[0], *outs[1:],
+                    *cvs]
+
+        final = ctx.sd.while_loop(cond_body, loop_body,
+                                  i0, c0, *carried, *cap_vars)
+        final = list(final) if isinstance(final, (list, tuple)) else [final]
+        return final[2:2 + n_car]
+
+    for seq_op in ("Scan", "RoiAlign", "MaxRoiPool"):
+        @onnx_rule(seq_op)
+        def _heavy_unsupported(ctx, node, inputs, attrs,
+                               _op_name=seq_op):
+            raise ONNXImportError(
+                f"{_op_name} unsupported in this build — Scan: express as "
+                f"Loop with carried accumulators; RoiAlign/MaxRoiPool: "
+                f"use crop_and_resize + pooling (ops registry) host-side")
+
+    @onnx_rule("Unique")
+    def _unique(ctx, node, inputs, attrs):
+        raise ONNXImportError(
+            "Unique has a data-dependent output shape, which the "
+            "whole-graph-jit executor cannot represent; the eager registry "
+            "op 'unique' covers host-side use")
+
+    for seq_op in ("SequenceAt", "SequenceConstruct", "SequenceEmpty",
+                   "SequenceErase", "SequenceInsert", "SequenceLength",
+                   "SplitToSequence", "ConcatFromSequence",
+                   "StringNormalizer", "TfIdfVectorizer"):
+        @onnx_rule(seq_op)
+        def _seq_unsupported(ctx, node, inputs, attrs, _op_name=seq_op):
+            raise ONNXImportError(
+                f"{_op_name}: sequence/string-typed ONNX values are outside "
+                f"the dense-tensor model (the reference importer shares "
+                f"this gap); restructure with dense tensors")
+
+
+_register_onnx_rules_t3()

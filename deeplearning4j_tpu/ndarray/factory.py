@@ -739,7 +739,6 @@ def _populate_nd4j_facade():
     Nd4j.defaultFloatingPointType = staticmethod(defaultFloatingPointType)
 
 
-_populate_nd4j_facade()
 
 
 # --------------------------------------------------------------------------
@@ -969,7 +968,6 @@ def nonzero(a) -> NDArray:
 
 
 # re-populate the facade with everything defined after the first pass
-_populate_nd4j_facade()
 
 
 def getEnvironment():
@@ -1009,7 +1007,6 @@ def version() -> str:
         return "0.0.0-dev"
 
 
-_populate_nd4j_facade()
 
 
 # --------------------------------------------------------------------------

@@ -5,7 +5,8 @@ from deeplearning4j_tpu.ops import extended  # noqa: F401 — long-tail ops
 from deeplearning4j_tpu.ops import longtail  # noqa: F401 — tranche 3
 from deeplearning4j_tpu.ops import tranche4  # noqa: F401 — tranche 4
 from deeplearning4j_tpu.ops import tranche5  # noqa: F401 — tranche 5
+from deeplearning4j_tpu.ops import tranche6  # noqa: F401 — tranche 6
 from deeplearning4j_tpu.ops import transforms
 
-__all__ = ["registry", "standard", "extended", "longtail", "tranche4", "tranche5",
-           "transforms"]
+__all__ = ["registry", "standard", "extended", "longtail", "tranche4",
+           "tranche5", "tranche6", "transforms"]

@@ -1,0 +1,160 @@
+"""Op-registry tranche 6 — image-sampling / integer-conv / unpool ops.
+
+Added for ONNX importer parity (reference: samediff-import-onnx mapping
+registry, SURVEY J8; libnd4j has no GridSample/ConvInteger — these are
+net-new TPU-first lowerings):
+
+- ``grid_sample``: ONNX GridSample / torch ``F.grid_sample`` semantics —
+  bilinear or nearest sampling of an NCHW input at normalized grid
+  coordinates, zeros or border padding, align_corners both ways. Pure
+  gather+lerp: vectorized, MXU-free but VPU-friendly, fully jittable.
+- ``max_unpool``: ONNX MaxUnpool — scatter pooled values back to their
+  argmax flat indices (the dual of ``maxpool_with_argmax``).
+- ``conv_integer``: ONNX ConvInteger — int8/uint8 conv with zero-point
+  subtraction, exact int32 accumulation (XLA integer conv).
+- ``lp_pool2d_nchw``: ONNX LpPool — (sum |x|^p over window)^(1/p); built
+  on the average-pool window machinery so padding semantics match.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.ops.registry import exec_op, register
+
+
+def _unnormalize(coord, size, align_corners):
+    # ONNX/torch: align_corners=True maps [-1,1] -> [0, size-1];
+    # False maps [-1,1] -> [-0.5, size-0.5]
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+@register("grid_sample")
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = False):
+    """x: (N, C, H, W); grid: (N, Ho, Wo, 2) with (x, y) in [-1, 1].
+    Returns (N, C, Ho, Wo)."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    n, c, h, w = x.shape
+    gx = _unnormalize(grid[..., 0].astype(jnp.float32), w, align_corners)
+    gy = _unnormalize(grid[..., 1].astype(jnp.float32), h, align_corners)
+
+    def sample_at(ix, iy):
+        """Gather x[n, :, iy, ix] with out-of-bounds handling."""
+        if padding_mode == "border":
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            valid = jnp.ones_like(ix, jnp.bool_)
+        else:                               # zeros
+            valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+        flat = x.reshape(n, c, h * w)
+        idx = (iyc * w + ixc).reshape(n, -1)            # (N, Ho*Wo)
+        g = jnp.take_along_axis(flat, idx[:, None, :], axis=2)
+        g = g.reshape(n, c, *ix.shape[1:])
+        return jnp.where(valid[:, None], g, jnp.zeros_like(g))
+
+    if mode == "nearest":
+        # torch rounds half away from0? — it uses round-half-to-even via
+        # float rounding; jnp.round (banker's) matches torch here
+        out = sample_at(jnp.round(gx).astype(jnp.int32),
+                        jnp.round(gy).astype(jnp.int32))
+        return out.astype(x.dtype)
+    if mode != "bilinear":
+        raise NotImplementedError(f"grid_sample mode {mode!r}")
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = (gx - x0)[:, None]
+    wy = (gy - y0)[:, None]
+    x0i = x0.astype(jnp.int32)
+    y0i = y0.astype(jnp.int32)
+    v00 = sample_at(x0i, y0i)
+    v01 = sample_at(x0i + 1, y0i)
+    v10 = sample_at(x0i, y0i + 1)
+    v11 = sample_at(x0i + 1, y0i + 1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return (top * (1 - wy) + bot * wy).astype(x.dtype)
+
+
+@register("max_unpool")
+def max_unpool(pooled, indices, output_shape):
+    """ONNX MaxUnpool: scatter ``pooled`` values to flat position
+    ``indices`` (per N,C slice — the maxpool_with_argmax convention) in a
+    zeros tensor of ``output_shape`` (N, C, H, W)."""
+    pooled = jnp.asarray(pooled)
+    indices = jnp.asarray(indices).astype(jnp.int32)
+    n, c = int(output_shape[0]), int(output_shape[1])
+    spatial = int(np.prod(output_shape[2:]))
+    flat_idx = indices.reshape(n, c, -1)
+    flat_val = pooled.reshape(n, c, -1)
+    zeros = jnp.zeros((n, c, spatial), pooled.dtype)
+    out = jax_vmap_scatter(zeros, flat_idx, flat_val)
+    return out.reshape(tuple(int(s) for s in output_shape))
+
+
+def jax_vmap_scatter(zeros, idx, val):
+    import jax
+
+    def one(z, i, v):
+        return z.at[i].set(v)
+
+    return jax.vmap(jax.vmap(one))(zeros, idx, val)
+
+
+@register("conv_integer")
+def conv_integer(x, w, x_zero_point=0, w_zero_point=0,
+                 strides=(1, 1), padding=((0, 0), (0, 0)),
+                 dilations=(1, 1)):
+    """ONNX ConvInteger: (x - x_zp) * (w - w_zp) convolution with exact
+    int32 accumulation. x: (N, C, H, W) int8/uint8; w: (M, C, kH, kW)."""
+    xi = jnp.asarray(x).astype(jnp.int32) - jnp.asarray(
+        x_zero_point).astype(jnp.int32)
+    wi = jnp.asarray(w).astype(jnp.int32) - jnp.asarray(
+        w_zero_point).astype(jnp.int32)
+    return lax.conv_general_dilated(
+        xi, wi, tuple(strides), tuple(tuple(p) for p in padding),
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+@register("lp_pool2d_nchw")
+def lp_pool2d_nchw(x, kernel, strides=None, padding=((0, 0), (0, 0)),
+                   p: float = 2.0):
+    """ONNX LpPool (NCHW): (sum_window |x|^p)^(1/p). Sum (not average) per
+    the ONNX spec; padded positions contribute zero."""
+    x = jnp.asarray(x)
+    strides = tuple(strides) if strides else tuple(kernel)
+    powed = jnp.abs(x.astype(jnp.float32)) ** p
+    summed = lax.reduce_window(
+        powed, 0.0, lax.add, (1, 1) + tuple(kernel), (1, 1) + strides,
+        ((0, 0), (0, 0)) + tuple(tuple(pp) for pp in padding))
+    return (summed ** (1.0 / p)).astype(x.dtype)
+
+
+@register("random_normal_gen")
+def random_normal_gen(shape, mean=0.0, scale=1.0, dtype=jnp.float32,
+                      seed=None):
+    """ONNX RandomNormal(Like) generator — attr-shaped, optionally seeded
+    (the key convention of bernoulli_sample)."""
+    import jax
+    from deeplearning4j_tpu.ndarray import random as _rng
+    key = jax.random.key(int(seed)) if seed is not None else _rng.next_key()
+    shape = tuple(int(s) for s in shape)
+    return mean + scale * jax.random.normal(key, shape, dtype)
+
+
+@register("random_uniform_gen")
+def random_uniform_gen(shape, low=0.0, high=1.0, dtype=jnp.float32,
+                       seed=None):
+    """ONNX RandomUniform(Like) generator."""
+    import jax
+    from deeplearning4j_tpu.ndarray import random as _rng
+    key = jax.random.key(int(seed)) if seed is not None else _rng.next_key()
+    shape = tuple(int(s) for s in shape)
+    return jax.random.uniform(key, shape, dtype, low, high)

@@ -302,4 +302,4 @@ class TestAliases:
 
     def test_registry_size_gate(self):
         from deeplearning4j_tpu.ops import registry
-        assert len(registry.names()) >= 500
+        assert len(registry.names()) >= 540
