@@ -847,6 +847,15 @@ def _subgraph_body(outer_ctx: "_Ctx", graph: dict, seed_names: List[str]):
     return body
 
 
+class _FakeVar:
+    """Shape/dtype template standing in for an SDVariable when pre-tracing
+    a subgraph against element (sliced) shapes."""
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
 class OnnxGraphMapper:
     """ref: OnnxFrameworkImporter#runImport — ONNX ModelProto → SameDiff."""
 
@@ -1267,6 +1276,16 @@ def _register_onnx_rules_t3():
                               _subgraph_body(ctx, else_g, caps),
                               *operands)
 
+    def _pretrace_outputs(ctx, graph, seed_names, arg_templates):
+        """Trace a subgraph against placeholder templates to learn its
+        output shapes/dtypes without touching the real graph."""
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff as _SD
+        tmp = _SD.create()
+        args = [tmp.placeholder(f"__t{i}", v.shape, v.dtype)
+                for i, v in enumerate(arg_templates)]
+        outs = _subgraph_body(ctx, graph, seed_names)(tmp, *args)
+        return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
     @onnx_rule("Loop")
     def _loop(ctx, node, inputs, attrs):
         body_g = attrs["body"]
@@ -1274,14 +1293,16 @@ def _register_onnx_rules_t3():
         b_inputs = [vi["name"] for vi in body_g.get("input", [])]
         n_carried = len(b_inputs) - 2
         n_body_out = len(body_g.get("output", []))
-        if n_body_out > 1 + n_carried:
-            raise ONNXImportError(
-                "Loop with scan outputs unsupported — hoist the "
-                "accumulation into a loop-carried tensor of static length")
+        n_scan = n_body_out - 1 - n_carried
         m_name = ins[0] if len(ins) > 0 else ""
         cond_name = ins[1] if len(ins) > 1 else ""
         trip_max = (int(np.asarray(ctx.const(m_name)).reshape(()))
                     if m_name else None)
+        if n_scan > 0 and trip_max is None:
+            raise ONNXImportError(
+                "Loop scan outputs need a static trip count (constant M "
+                "input): the whole-graph-jit executor preallocates the "
+                "stacked output, so its length must be known at trace time")
         carried = [ctx.vars[r] for r in ins[2:]]
         caps = _subgraph_captures(body_g, ctx)
         cap_vars = [ctx.vars[nm] for nm in caps]
@@ -1289,6 +1310,22 @@ def _register_onnx_rules_t3():
         c0 = (ctx.vars[cond_name] if cond_name
               else ctx.sd.constant(np.asarray(True)))
         n_car = len(carried)
+        seeds = ([b_inputs[0], b_inputs[1]] + list(b_inputs[2:])
+                 + list(caps))
+
+        accs = []
+        if n_scan > 0:
+            # scan accumulators: (M, *elem) zeros, rows written at index i.
+            # If the body's cond_out goes false before M trips (dynamic
+            # early exit), the remaining rows stay zero — a documented
+            # divergence from ONNX's true-length scan output, which cannot
+            # exist under static shapes
+            tmpl = _pretrace_outputs(ctx, body_g, seeds,
+                                     [i0, c0, *carried, *cap_vars])
+            for t in tmpl[1 + n_car:]:
+                accs.append(ctx.sd.constant(np.zeros(
+                    (trip_max,) + tuple(int(d) for d in (t.shape or ())),
+                    np.dtype(t.dtype))))
 
         def cond_body(sub_sd, i, c, *rest):
             out = c
@@ -1300,28 +1337,96 @@ def _register_onnx_rules_t3():
             return sub_sd._op("Cast", out, dtype="bool")
 
         def loop_body(sub_sd, i, c, *rest):
-            vs, cvs = rest[:n_car], rest[n_car:]
-            seeds = ([b_inputs[0], b_inputs[1]] + list(b_inputs[2:])
-                     + list(caps))
+            vs = rest[:n_car]
+            acc_vs = rest[n_car:n_car + n_scan]
+            cvs = rest[n_car + n_scan:]
             body = _subgraph_body(ctx, body_g, seeds)
             outs = body(sub_sd, i, c, *vs, *cvs)
             outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            new_accs = []
+            row = sub_sd._op("Reshape", i, shape=(1,))
+            for acc, step in zip(acc_vs, outs[1 + n_car:]):
+                new_accs.append(sub_sd._op(
+                    "scatter_update", acc, row,
+                    sub_sd._op("expand_dims", step, axis=0)))
             one = sub_sd.constant(np.asarray(1, np.int64))
-            return [sub_sd._op("add", i, one), outs[0], *outs[1:],
-                    *cvs]
+            return [sub_sd._op("add", i, one), outs[0],
+                    *outs[1:1 + n_car], *new_accs, *cvs]
 
         final = ctx.sd.while_loop(cond_body, loop_body,
-                                  i0, c0, *carried, *cap_vars)
+                                  i0, c0, *carried, *accs, *cap_vars)
         final = list(final) if isinstance(final, (list, tuple)) else [final]
-        return final[2:2 + n_car]
+        return final[2:2 + n_car + n_scan]
 
-    for seq_op in ("Scan", "RoiAlign", "MaxRoiPool"):
+    @onnx_rule("Scan")
+    def _scan(ctx, node, inputs, attrs):
+        body_g = attrs["body"]
+        m = int(attrs["num_scan_inputs"])
+        if attrs.get("scan_input_axes") or attrs.get("scan_input_directions") \
+                or attrs.get("scan_output_axes") \
+                or attrs.get("scan_output_directions"):
+            raise ONNXImportError(
+                "Scan with non-default axes/directions unsupported "
+                "(transpose/reverse the scan tensors around the node)")
+        ins = node.get("input", [])
+        b_inputs = [vi["name"] for vi in body_g.get("input", [])]
+        n_state = len(b_inputs) - m
+        states = [ctx.vars[r] for r in ins[:n_state]]
+        scans = [ctx.vars[r] for r in ins[n_state:]]
+        trips = {int(v.shape[0]) for v in scans if v.shape}
+        if len(trips) != 1:
+            raise ONNXImportError(
+                f"Scan inputs must share one static leading length, "
+                f"got {sorted(trips)}")
+        trip = trips.pop()
+        n_body_out = len(body_g.get("output", []))
+        n_scan_out = n_body_out - n_state
+        caps = _subgraph_captures(body_g, ctx)
+        cap_vars = [ctx.vars[nm] for nm in caps]
+        seeds = list(b_inputs) + list(caps)
+        elem_tmpl = [_FakeVar(tuple((v.shape or ())[1:]), v.dtype)
+                     for v in scans]
+        tmpl = _pretrace_outputs(ctx, body_g, seeds,
+                                 [*states, *elem_tmpl, *cap_vars])
+        accs = [ctx.sd.constant(np.zeros(
+                    (trip,) + tuple(int(d) for d in (t.shape or ())),
+                    np.dtype(t.dtype)))
+                for t in tmpl[n_state:]]
+        i0 = ctx.sd.constant(np.asarray(0, np.int64))
+        n_st, n_sc = len(states), len(scans)
+
+        def cond_body(sub_sd, i, *rest):
+            lim = sub_sd.constant(np.asarray(trip, np.int64))
+            return sub_sd._op("less", i, lim)
+
+        def loop_body(sub_sd, i, *rest):
+            sts = rest[:n_st]
+            acc_vs = rest[n_st:n_st + n_scan_out]
+            sc_ins = rest[n_st + n_scan_out:n_st + n_scan_out + n_sc]
+            cvs = rest[n_st + n_scan_out + n_sc:]
+            elems = [sub_sd._op("gather", sv, i, axis=0) for sv in sc_ins]
+            body = _subgraph_body(ctx, body_g, seeds)
+            outs = body(sub_sd, *sts, *elems, *cvs)
+            outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+            row = sub_sd._op("Reshape", i, shape=(1,))
+            new_accs = [sub_sd._op("scatter_update", acc, row,
+                                   sub_sd._op("expand_dims", step, axis=0))
+                        for acc, step in zip(acc_vs, outs[n_st:])]
+            one = sub_sd.constant(np.asarray(1, np.int64))
+            return [sub_sd._op("add", i, one), *outs[:n_st], *new_accs,
+                    *sc_ins, *cvs]
+
+        final = ctx.sd.while_loop(cond_body, loop_body,
+                                  i0, *states, *accs, *scans, *cap_vars)
+        final = list(final) if isinstance(final, (list, tuple)) else [final]
+        return final[1:1 + n_st + n_scan_out]
+
+    for seq_op in ("RoiAlign", "MaxRoiPool"):
         @onnx_rule(seq_op)
         def _heavy_unsupported(ctx, node, inputs, attrs,
                                _op_name=seq_op):
             raise ONNXImportError(
-                f"{_op_name} unsupported in this build — Scan: express as "
-                f"Loop with carried accumulators; RoiAlign/MaxRoiPool: "
+                f"{_op_name} unsupported in this build — "
                 f"use crop_and_resize + pooling (ops registry) host-side")
 
     @onnx_rule("Unique")

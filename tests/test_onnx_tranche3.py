@@ -264,7 +264,7 @@ class TestMiscT3:
 
     def test_unique_and_sequence_raise_loudly(self):
         for op, n_in in [("Unique", 1), ("SequenceLength", 1),
-                         ("Scan", 1)]:
+                         ("RoiAlign", 1)]:
             g = P.make_graph(
                 [P.make_node(op, ["x"], ["y"])], "g",
                 [P.make_value_info("x", F32, (3,))],
@@ -322,7 +322,58 @@ class TestControlFlow:
         (vf,) = _run(P.make_model(g), {"x": x, "v0": v0}, ["vf"])
         np.testing.assert_allclose(vf, v0 + 4 * x)
 
-    def test_loop_scan_outputs_raise(self):
+    def test_loop_scan_outputs_stacked(self):
+        # body: v = v * 2 ; scan output collects each step's v
+        body = P.make_graph(
+            [P.make_node("Identity", ["cond_in"], ["cond_out"]),
+             P.make_node("Mul", ["v_in", "two"], ["v_out"]),
+             P.make_node("Identity", ["v_out"], ["scan0"])],
+            "body",
+            [P.make_value_info("iter", np.int64, ()),
+             P.make_value_info("cond_in", np.bool_, ()),
+             P.make_value_info("v_in", F32, (2,))],
+            [P.make_value_info("cond_out", np.bool_, ()),
+             P.make_value_info("v_out", F32, (2,)),
+             P.make_value_info("scan0", F32, (2,))],
+            initializers=[P.make_tensor("two", np.asarray(2.0, F32))])
+        g = P.make_graph(
+            [P.make_node("Loop", ["M", "", "v0"], ["vf", "sc"],
+                         body=body)],
+            "g", [P.make_value_info("v0", F32, (2,))],
+            [P.make_value_info("vf", F32, (2,)),
+             P.make_value_info("sc", F32, (3, 2))],
+            initializers=[P.make_tensor("M", np.asarray(3, np.int64))])
+        v0 = np.array([1.0, 0.5], F32)
+        vf, sc = _run(P.make_model(g), {"v0": v0}, ["vf", "sc"])
+        np.testing.assert_allclose(vf, v0 * 8)
+        np.testing.assert_allclose(sc, np.stack([v0 * 2, v0 * 4, v0 * 8]))
+
+    def test_scan_cumulative_sum(self):
+        # classic Scan: state = state + elem; scan out each new state
+        body = P.make_graph(
+            [P.make_node("Add", ["s_in", "elem"], ["s_out"]),
+             P.make_node("Identity", ["s_out"], ["o"])],
+            "body",
+            [P.make_value_info("s_in", F32, (3,)),
+             P.make_value_info("elem", F32, (3,))],
+            [P.make_value_info("s_out", F32, (3,)),
+             P.make_value_info("o", F32, (3,))])
+        g = P.make_graph(
+            [P.make_node("Scan", ["s0", "xs"], ["sf", "ys"], body=body,
+                         num_scan_inputs=1)],
+            "g", [P.make_value_info("s0", F32, (3,)),
+                  P.make_value_info("xs", F32, (5, 3))],
+            [P.make_value_info("sf", F32, (3,)),
+             P.make_value_info("ys", F32, (5, 3))])
+        rng = np.random.RandomState(11)
+        s0 = rng.randn(3).astype(F32)
+        xs = rng.randn(5, 3).astype(F32)
+        sf, ys = _run(P.make_model(g), {"s0": s0, "xs": xs}, ["sf", "ys"])
+        ref = s0 + np.cumsum(xs, axis=0)
+        np.testing.assert_allclose(ys, ref, rtol=1e-5)
+        np.testing.assert_allclose(sf, ref[-1], rtol=1e-5)
+
+    def test_loop_scan_outputs_dynamic_trip_raise(self):
         body = P.make_graph(
             [P.make_node("Identity", ["cond_in"], ["cond_out"]),
              P.make_node("Identity", ["v_in"], ["v_out"]),
@@ -335,10 +386,10 @@ class TestControlFlow:
              P.make_value_info("v_out", F32, (2,)),
              P.make_value_info("scan0", F32, (2,))])
         g = P.make_graph(
-            [P.make_node("Loop", ["M", "", "v0"], ["vf", "sc"],
+            [P.make_node("Loop", ["", "c0", "v0"], ["vf", "sc"],
                          body=body)],
-            "g", [P.make_value_info("v0", F32, (2,))],
-            [P.make_value_info("vf", F32, (2,))],
-            initializers=[P.make_tensor("M", np.asarray(2, np.int64))])
+            "g", [P.make_value_info("v0", F32, (2,)),
+                  P.make_value_info("c0", np.bool_, ())],
+            [P.make_value_info("vf", F32, (2,))])
         with pytest.raises(ONNXImportError):
             OnnxGraphMapper.import_model(P.make_model(g))
