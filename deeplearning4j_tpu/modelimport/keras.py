@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf import layers as L
@@ -229,6 +230,96 @@ def _map_layer(cls: str, cfg: dict):
         return L.Cropping2D(name=name, cropping=crops)
     if cls == "UpSampling2D":
         return L.Upsampling2D(name=name, size=_pair(cfg.get("size", 2)))
+    # ---- tranche-2 layer mappings (ref KerasDepthwiseConvolution2D,
+    # KerasPReLU, KerasThresholdedReLU, KerasMasking, KerasLocallyConnected,
+    # the 1D/3D structural family — deeplearning4j-modelimport layers.*)
+    if cls == "DepthwiseConv2D":
+        return L.DepthwiseConvolution2D(
+            name=name, kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            padding=_padding(cfg), activation=act, has_bias=use_bias)
+    if cls == "PReLU":
+        shared = cfg.get("shared_axes")
+        if shared:
+            raise UnsupportedKerasConfigurationException(
+                "PReLU shared_axes unsupported — full-shape alpha only")
+        return L.PReLULayer(name=name)
+    if cls == "ThresholdedReLU":
+        theta = float(cfg.get("theta", 1.0))
+        return L.LambdaLayer(
+            name=name or "thresholded_relu",
+            fn=lambda x, _t=theta: jnp.where(x > _t, x, 0.0))
+    if cls == "Masking":
+        # fused with the FOLLOWING layer by the sequential walk (Keras
+        # masking semantics = derive mask from mask_value rows and hand it
+        # to the next recurrent layer) — MaskZeroLayer carries both steps
+        return ("__masking__", float(cfg.get("mask_value", 0.0)), name)
+    if cls in ("LocallyConnected2D", "LocallyConnected1D"):
+        if _padding(cfg) not in (0, (0, 0), "valid", "VALID"):
+            raise UnsupportedKerasConfigurationException(
+                f"{cls}: only 'valid' padding")
+        if cls == "LocallyConnected2D":
+            return L.LocallyConnected2D(
+                name=name, n_out=cfg["filters"],
+                kernel_size=_pair(cfg["kernel_size"]),
+                stride=_pair(cfg.get("strides", 1)),
+                activation=act, has_bias=use_bias)
+        return L.LocallyConnected1D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=int(cfg["kernel_size"][0]
+                            if isinstance(cfg["kernel_size"],
+                                          (list, tuple))
+                            else cfg["kernel_size"]),
+            stride=int(cfg.get("strides", [1])[0]
+                       if isinstance(cfg.get("strides", 1), (list, tuple))
+                       else cfg.get("strides", 1)),
+            activation=act, has_bias=use_bias)
+    if cls == "Cropping1D":
+        return L.Cropping1D(name=name, cropping=_pair(
+            cfg.get("cropping", 1)))
+    if cls == "ZeroPadding1D":
+        return L.ZeroPadding1DLayer(name=name, padding=_pair(
+            cfg.get("padding", 1)))
+    if cls == "UpSampling1D":
+        return L.Upsampling1D(name=name, size=int(cfg.get("size", 2)))
+    if cls == "Cropping3D":
+        c = cfg.get("cropping", 0)
+        if isinstance(c, int):
+            crops = (c,) * 6
+        else:
+            crops = tuple(int(v) for pair in c for v in _pair(pair))
+        return L.Cropping3D(name=name, cropping=crops)
+    if cls == "ZeroPadding3D":
+        p = cfg.get("padding", 1)
+        if isinstance(p, int):
+            pads = (p,) * 6
+        else:
+            pads = tuple(int(v) for pair in p for v in _pair(pair))
+        return L.ZeroPadding3DLayer(name=name, padding=pads)
+    if cls == "UpSampling3D":
+        s = cfg.get("size", 2)
+        return L.Upsampling3D(name=name, size=(s,) * 3
+                              if isinstance(s, int) else tuple(s))
+    if cls in ("MaxPooling1D", "AveragePooling1D"):
+        pool = "max" if cls.startswith("Max") else "avg"
+        ps = cfg.get("pool_size", 2)
+        ps = int(ps[0] if isinstance(ps, (list, tuple)) else ps)
+        st = cfg.get("strides") or ps
+        st = int(st[0] if isinstance(st, (list, tuple)) else st)
+        return L.Subsampling1DLayer(name=name, pooling_type=pool,
+                                    kernel_size=ps, stride=st,
+                                    padding=_padding(cfg))
+    if cls in ("MaxPooling3D", "AveragePooling3D"):
+        pool = "max" if cls.startswith("Max") else "avg"
+        ps = cfg.get("pool_size", 2)
+        ps = (ps,) * 3 if isinstance(ps, int) else tuple(ps)
+        st = cfg.get("strides") or ps
+        st = (st,) * 3 if isinstance(st, int) else tuple(st)
+        return L.Subsampling3DLayer(name=name, pooling_type=pool,
+                                    kernel_size=ps, stride=st,
+                                    padding=_padding(cfg))
     if cls == "Embedding":
         return L.EmbeddingSequenceLayer(name=name, n_in=cfg["input_dim"],
                                         n_out=cfg["output_dim"])
@@ -352,13 +443,32 @@ def _load_weights_into(layer, w: Dict[str, np.ndarray], params: dict,
     def put(our, theirs):
         if theirs in w:
             params.setdefault(lkey, {})[our] = jnp.asarray(w[theirs])
-    if isinstance(layer, L.LastTimeStep):
+    while isinstance(layer, (L.LastTimeStep, L.MaskZeroLayer)):
         layer._materialize()
         layer = layer._inner_layer   # params live under the wrapper's key
     if isinstance(layer, L.SeparableConvolution2D):
         put("dW", "depthwise_kernel")
         put("pW", "pointwise_kernel")
         put("b", "bias")
+    elif isinstance(layer, L.DepthwiseConvolution2D):
+        # Keras 2 names it depthwise_kernel; Keras 3 plain kernel
+        put("dW", "depthwise_kernel")
+        put("dW", "kernel")
+        put("b", "bias")
+    elif isinstance(layer, L.PReLULayer):
+        put("alpha", "alpha")
+    elif isinstance(layer, (L.LocallyConnected2D, L.LocallyConnected1D)):
+        # Keras LC kernel: (positions, kh*kw*in, filters), feature axis in
+        # (*k, C) order — exactly the layer's internal patch layout, so a
+        # pure reshape onto the position grid suffices; bias is
+        # per-position in both
+        for pname in ("kernel", "bias"):
+            arr = w.get(pname)
+            if arr is not None:
+                our = "W" if pname == "kernel" else "b"
+                tgt = layer.param_shapes()[our]
+                params.setdefault(lkey, {})[our] = jnp.asarray(
+                    np.reshape(np.asarray(arr), tgt))
     elif isinstance(layer, L.BatchNormalization):
         put("gamma", "gamma")
         put("beta", "beta")
@@ -456,12 +566,24 @@ class KerasModelImport:
             b = (NeuralNetConfiguration.builder()
                  .updater(Adam(1e-3)).weight_init("xavier").list())
             mapped: List[tuple] = []   # (our layer, keras name)
+            pending_mask = None        # (mask_value, name) from Masking
             for ld in layer_dicts:
                 out = _map_layer(ld["class_name"], ld["config"])
                 if out is None:
                     continue
+                if isinstance(out, tuple) and out[0] == "__masking__":
+                    pending_mask = (out[1], out[2])
+                    continue
                 for lyr in (out if isinstance(out, list) else [out]):
+                    if pending_mask is not None:
+                        mv, mname = pending_mask
+                        pending_mask = None
+                        lyr = L.MaskZeroLayer.wrap(lyr, mask_value=mv)
+                        lyr.name = mname
                     mapped.append((lyr, ld["config"].get("name")))
+            if pending_mask is not None:
+                raise UnsupportedKerasConfigurationException(
+                    "Masking as the FINAL layer has nothing to mask")
             # Keras graphs carry no loss head; make the net trainable by
             # promoting the final Dense to an OutputLayer with a loss
             # inferred from its activation (ref: KerasLoss mapping)
@@ -544,6 +666,10 @@ class KerasModelImport:
                     if out is None:
                         name_of[name] = srcs[0]
                         continue
+                    if isinstance(out, tuple) and out[0] == "__masking__":
+                        raise UnsupportedKerasConfigurationException(
+                            "Masking in functional graphs unsupported — "
+                            "wrap the consumer in MaskZeroLayer instead")
                     lyrs = out if isinstance(out, list) else [out]
                     prev = srcs
                     for j, lyr in enumerate(lyrs):

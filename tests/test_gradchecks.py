@@ -110,6 +110,45 @@ SPECS = {
     "CnnLossLayer": (lambda: L.CnnLossLayer(), _x((2, 3, 3, 2)), {}),
     "LayerNormalization": (lambda: L.LayerNormalization(n_out=4),
                            _x((3, 4)), {}),
+    # ---- tranche 2 (reference D3 completion, nn/conf/layers2.py)
+    "DepthwiseConvolution2D": (lambda: L.DepthwiseConvolution2D(
+        kernel_size=(3, 3), n_in=2, depth_multiplier=2),
+        _x((2, 5, 5, 2)), {}),
+    "PReLULayer": (lambda: L.PReLULayer(n_in=4, alpha_init=0.2),
+                   _x((3, 4)), {}),
+    "LocallyConnected2D": (lambda: L.LocallyConnected2D(
+        kernel_size=(2, 2), n_in=2, n_out=3, input_size=(4, 4)),
+        _x((2, 4, 4, 2)), {}),
+    "LocallyConnected1D": (lambda: L.LocallyConnected1D(
+        kernel_size=2, n_in=3, n_out=4, input_size=5), _x((2, 5, 3)), {}),
+    "Cropping1D": (lambda: L.Cropping1D(cropping=(1, 1)),
+                   _x((2, 5, 3)), {}),
+    "Cropping3D": (lambda: L.Cropping3D(cropping=(1, 0, 1, 0, 0, 1)),
+                   _x((2, 4, 4, 4, 2)), {}),
+    "ZeroPadding1DLayer": (lambda: L.ZeroPadding1DLayer(padding=(1, 2)),
+                           _x((2, 4, 3)), {}),
+    "ZeroPadding3DLayer": (lambda: L.ZeroPadding3DLayer(
+        padding=(1, 1, 0, 0, 1, 0)), _x((2, 3, 3, 3, 2)), {}),
+    "Upsampling1D": (lambda: L.Upsampling1D(size=2), _x((2, 4, 3)), {}),
+    "Upsampling3D": (lambda: L.Upsampling3D(size=(2, 1, 2)),
+                     _x((2, 3, 3, 3, 2)), {}),
+    "Subsampling1DLayer": (lambda: L.Subsampling1DLayer(
+        pooling_type="avg", kernel_size=2, stride=2), _x((2, 6, 3)), {}),
+    "Subsampling3DLayer": (lambda: L.Subsampling3DLayer(
+        pooling_type="avg"), _x((2, 4, 4, 4, 2)), {}),
+    "MaskLayer": (lambda: L.MaskLayer(), _x((2, 5, 3)),
+                  {"mask": np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
+                                    F32)}),
+    "MaskZeroLayer": (lambda: L.MaskZeroLayer.wrap(
+        L.LSTM(n_in=3, n_out=4)), _x((2, 5, 3)), {}),
+    # FrozenLayer's params receive ZERO gradient by design — grad_check
+    # validates the zero-grad contract via the identity-on-inputs path of
+    # FrozenLayerWithBackprop (params frozen, input grads flow)
+    "FrozenLayerWithBackprop": (lambda: L.FrozenLayerWithBackprop.wrap(
+        L.ActivationLayer(activation="tanh")), _x((3, 4)), {}),
+    "FrozenLayer": (lambda: L.FrozenLayer.wrap(
+        L.ActivationLayer(activation="tanh")), _x((3, 4)),
+        {"zero_input_grads": True}),
 }
 
 
@@ -132,6 +171,14 @@ def _check(layer, x, opts):
         # tanh bounds the output so FD stays in a well-scaled regime
         return jnp.sum(jnp.tanh(out))
 
+    if opts.get("zero_input_grads"):
+        # freeze contract: ANALYTIC grads wrt params and inputs are exactly
+        # zero (values still flow forward, so FD comparison is meaningless)
+        g = jax.grad(lambda t: run(t["params"], t["x"]))(
+            {"params": params, "x": jnp.asarray(x)})
+        assert all(float(jnp.abs(leaf).max()) == 0.0
+                   for leaf in jax.tree.leaves(g))
+        return
     if int_input:
         fn = lambda p: run(p, jnp.asarray(x))
         tree = params

@@ -386,3 +386,71 @@ class TestStructuralLayers:
         ])
         x = np.random.default_rng(2).normal(size=(2, 4, 6)).astype(np.float32)
         self._roundtrip(m, x, tmp_path)
+
+
+def test_sequential_tranche2_layers(tmp_path):
+    """DepthwiseConv2D + PReLU + pooling-1D family import at numerical
+    parity (ref: KerasDepthwiseConvolution2D / KerasPReLU mappings)."""
+    m = tf.keras.Sequential([
+        tf.keras.Input((10, 10, 3)),
+        tf.keras.layers.DepthwiseConv2D(3, depth_multiplier=2,
+                                        padding="valid"),
+        tf.keras.layers.PReLU(),
+        tf.keras.layers.MaxPooling2D(2),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(4, activation="softmax"),
+    ])
+    rng = np.random.RandomState(3)
+    # non-zero alphas so PReLU actually bites
+    weights = m.get_weights()
+    for i, w in enumerate(weights):
+        if w.shape == (8, 8, 6):           # the PReLU alpha
+            weights[i] = rng.uniform(0.1, 0.4, w.shape).astype("f4")
+    m.set_weights(weights)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = rng.randn(3, 10, 10, 3).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+def test_sequential_1d_structural(tmp_path):
+    """Cropping1D/ZeroPadding1D/UpSampling1D/AveragePooling1D chain."""
+    m = tf.keras.Sequential([
+        tf.keras.Input((8, 3)),
+        tf.keras.layers.ZeroPadding1D(1),
+        tf.keras.layers.Conv1D(4, 3, activation="tanh"),
+        tf.keras.layers.UpSampling1D(2),
+        tf.keras.layers.AveragePooling1D(2),
+        tf.keras.layers.Cropping1D(1),
+        tf.keras.layers.GlobalAveragePooling1D(),
+        tf.keras.layers.Dense(2),
+    ])
+    rng = np.random.RandomState(4)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    x = rng.randn(3, 8, 3).astype("f4")
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+def test_masking_lstm_parity(tmp_path):
+    """Keras Masking(0.0) -> LSTM on padded sequences: the sequential walk
+    fuses Masking into MaskZeroLayer and matches Keras step-skipping."""
+    m = tf.keras.Sequential([
+        tf.keras.Input((6, 3)),
+        tf.keras.layers.Masking(mask_value=0.0),
+        tf.keras.layers.LSTM(4),
+        tf.keras.layers.Dense(2),
+    ])
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 6, 3).astype("f4")
+    x[0, 4:] = 0.0                        # padded tail
+    x[2, 2:] = 0.0
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        _save(m, tmp_path))
+    expected = m.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()

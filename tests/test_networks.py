@@ -470,3 +470,74 @@ def test_weight_init_tranche2():
     # truncation: normal variants never exceed two std of the base scale
     t2 = np.asarray(W.init("var_scaling_normal_fan_in", k, big, fi, fo))
     assert np.abs(t2).max() <= 2.0 / np.sqrt(fi) + 1e-6
+
+
+def test_tranche2_layer_json_round_trip():
+    """Every tranche-2 layer class survives to_dict -> layer_from_dict
+    (the MultiLayerConfiguration JSON path)."""
+    from deeplearning4j_tpu.nn.conf.layers import (
+        Cropping1D, Cropping3D, DepthwiseConvolution2D, FrozenLayer,
+        FrozenLayerWithBackprop, LocallyConnected1D, LocallyConnected2D,
+        MaskLayer, MaskZeroLayer, PReLULayer, Subsampling1DLayer,
+        Subsampling3DLayer, Upsampling1D, Upsampling3D,
+        ZeroPadding1DLayer, ZeroPadding3DLayer, LSTM, DenseLayer,
+        layer_from_dict)
+    layers = [
+        DepthwiseConvolution2D(kernel_size=(3, 3), n_in=2,
+                               depth_multiplier=2),
+        PReLULayer(n_in=4, alpha_init=0.1),
+        LocallyConnected2D(kernel_size=(2, 2), n_in=2, n_out=3,
+                           input_size=(4, 4)),
+        LocallyConnected1D(kernel_size=2, n_in=3, n_out=4, input_size=5),
+        Cropping1D(cropping=(1, 1)), Cropping3D(cropping=(1,) * 6),
+        ZeroPadding1DLayer(padding=(1, 2)),
+        ZeroPadding3DLayer(padding=(1, 0, 1, 0, 1, 0)),
+        Upsampling1D(size=2), Upsampling3D(size=(2, 1, 2)),
+        Subsampling1DLayer(kernel_size=2, stride=2),
+        Subsampling3DLayer(pooling_type="avg"),
+        MaskLayer(),
+        MaskZeroLayer.wrap(LSTM(n_in=3, n_out=4), mask_value=0.0),
+        FrozenLayer.wrap(DenseLayer(n_in=4, n_out=3)),
+        FrozenLayerWithBackprop.wrap(DenseLayer(n_in=4, n_out=3)),
+    ]
+    for lyr in layers:
+        d = lyr.to_dict()
+        back = layer_from_dict(d)
+        assert type(back) is type(lyr), type(back)
+        assert back.to_dict() == d, type(lyr)
+
+
+def test_frozen_layer_blocks_training():
+    """A FrozenLayerWithBackprop inside an MLN: frozen params are
+    bit-identical after fit, upstream params move."""
+    import jax
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   FrozenLayerWithBackprop,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optim.updaters import Sgd
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).updater(Sgd(0.5)).list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(FrozenLayerWithBackprop.wrap(
+                DenseLayer(n_in=6, n_out=5, activation="tanh")))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss_function="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+    p_before = [np.asarray(v) for v in
+                jax.tree.leaves(net.param_tree()["1"])]
+    d0 = [np.asarray(v) for v in jax.tree.leaves(net.param_tree()["0"])]
+    for _ in range(5):
+        net.fit(x, y)
+    p_after = [np.asarray(v) for v in
+               jax.tree.leaves(net.param_tree()["1"])]
+    d1 = [np.asarray(v) for v in jax.tree.leaves(net.param_tree()["0"])]
+    assert all(np.array_equal(a, b) for a, b in zip(p_before, p_after))
+    assert any(not np.array_equal(a, b) for a, b in zip(d0, d1))
