@@ -474,10 +474,32 @@ def _layer_to_json(layer, li: int) -> dict:
         out["forgetGateBiasInit"] = getattr(layer, "forget_gate_bias_init",
                                             1.0)
     if kind == "DropoutLayer":
-        out["iDropout"] = {
-            "@class": "org.deeplearning4j.nn.conf.dropout.Dropout",
-            "p": float(getattr(layer, "dropout", 0.5) or 0.5)}
+        out["iDropout"] = _idropout_to_json(
+            getattr(layer, "dropout", 0.5))
     return out
+
+
+def _idropout_to_json(d) -> dict:
+    """Our dropout field (float retain-prob or IDropout object) → the
+    reference's Jackson conf.dropout classes."""
+    from deeplearning4j_tpu.nn.conf.dropout import (AlphaDropout, Dropout,
+                                                    GaussianDropout,
+                                                    GaussianNoise, IDropout)
+    base = "org.deeplearning4j.nn.conf.dropout."
+    if isinstance(d, IDropout):
+        if isinstance(d, Dropout):
+            return {"@class": base + "Dropout", "p": float(d.p)}
+        if isinstance(d, GaussianDropout):
+            return {"@class": base + "GaussianDropout",
+                    "rate": float(d.rate)}
+        if isinstance(d, GaussianNoise):
+            return {"@class": base + "GaussianNoise",
+                    "stddev": float(d.stddev)}
+        if isinstance(d, AlphaDropout):
+            return {"@class": base + "AlphaDropout", "p": float(d.p)}
+        raise ValueError(
+            f"no DL4J-zip mapping for dropout scheme {type(d).__name__}")
+    return {"@class": base + "Dropout", "p": float(d or 0.5)}
 
 
 def _input_type_to_json(it) -> Optional[dict]:

@@ -160,6 +160,17 @@ def _map_layer(cls: str, cfg: dict):
     if cls == "Dropout":
         # Keras rate = drop prob; our dropout field = retain prob (ref parity)
         return L.DropoutLayer(name=name, dropout=1.0 - cfg["rate"])
+    if cls in ("GaussianNoise", "GaussianDropout", "AlphaDropout"):
+        from deeplearning4j_tpu.nn.conf.dropout import (AlphaDropout,
+                                                        GaussianDropout,
+                                                        GaussianNoise)
+        obj = {"GaussianNoise": lambda: GaussianNoise(
+                   float(cfg.get("stddev", 0.1))),
+               "GaussianDropout": lambda: GaussianDropout(
+                   float(cfg.get("rate", 0.5))),
+               "AlphaDropout": lambda: AlphaDropout(
+                   1.0 - float(cfg.get("rate", 0.05)))}[cls]()
+        return L.DropoutLayer(name=name, dropout=obj)
     if cls == "Activation":
         return L.ActivationLayer(name=name, activation=act)
     if cls == "Reshape":

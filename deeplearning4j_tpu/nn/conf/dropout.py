@@ -1,0 +1,108 @@
+"""IDropout family — the reference's pluggable dropout schemes.
+
+Reference: ``org.deeplearning4j.nn.conf.dropout.{IDropout, Dropout,
+GaussianDropout, GaussianNoise, AlphaDropout}`` (SURVEY D3). Any layer's
+``dropout=`` field accepts a plain float (retain probability — the
+reference's ``Dropout(double)`` convention carried since round 1) OR one of
+these objects; ``Layer._maybe_dropout`` dispatches.
+
+All schemes are train-only multiplicative/additive noise, lowered to
+stateless ``jax.random`` draws keyed per step — no RNG state objects to
+carry (the reference threads a per-op RNG; under jit the key IS the
+state).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+_DROPOUT_TYPES = {}
+
+
+def register_dropout(cls):
+    _DROPOUT_TYPES[cls.__name__] = cls
+    return cls
+
+
+class IDropout:
+    """Protocol: ``apply(x, key, training) -> x`` + dict round-trip."""
+
+    def apply(self, x, key, training):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@dropout"] = type(self).__name__
+        return d
+
+
+def dropout_from_dict(d: dict) -> IDropout:
+    d = dict(d)
+    cls = _DROPOUT_TYPES[d.pop("@dropout")]
+    return cls(**d)
+
+
+@register_dropout
+@dataclasses.dataclass
+class Dropout(IDropout):
+    """ref: conf.dropout.Dropout — inverted dropout at retain
+    probability ``p`` (the reference's activation-retain convention)."""
+    p: float = 0.5
+
+    def apply(self, x, key, training):
+        if not training or self.p >= 1.0:
+            return x
+        from deeplearning4j_tpu.ops.registry import exec_op
+        return exec_op("dropout_inverted", x, key, p=self.p)
+
+
+@register_dropout
+@dataclasses.dataclass
+class GaussianDropout(IDropout):
+    """ref: conf.dropout.GaussianDropout — multiplicative N(1, sqrt(
+    rate/(1-rate))) noise (Srivastava et al. §10)."""
+    rate: float = 0.5
+
+    def apply(self, x, key, training):
+        if not training or self.rate <= 0.0:
+            return x
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(key, x.shape, x.dtype)
+        return x * noise
+
+
+@register_dropout
+@dataclasses.dataclass
+class GaussianNoise(IDropout):
+    """ref: conf.dropout.GaussianNoise — additive N(0, stddev) noise."""
+    stddev: float = 0.1
+
+    def apply(self, x, key, training):
+        if not training or self.stddev <= 0.0:
+            return x
+        return x + self.stddev * jax.random.normal(key, x.shape, x.dtype)
+
+
+@register_dropout
+@dataclasses.dataclass
+class AlphaDropout(IDropout):
+    """ref: conf.dropout.AlphaDropout — SELU-preserving dropout (Klambauer
+    et al.): masked units take alpha' and an affine (a, b) correction keeps
+    zero mean / unit variance."""
+    p: float = 0.95                       # retain probability
+
+    # fixed-point constants of the SELU nonlinearity
+    _ALPHA_PRIME = -1.7580993408473766
+
+    def apply(self, x, key, training):
+        if not training or self.p >= 1.0:
+            return x
+        q = self.p
+        ap = self._ALPHA_PRIME
+        a = (q + ap * ap * q * (1 - q)) ** -0.5
+        b = -a * ap * (1 - q)
+        keep = jax.random.bernoulli(key, q, x.shape)
+        return (a * jnp.where(keep, x, ap) + b).astype(x.dtype)

@@ -52,6 +52,9 @@ def _revive(k, v):
     if k == "weight_noise" and isinstance(v, dict):
         from deeplearning4j_tpu.nn.weightnoise import noise_from_dict
         return noise_from_dict(v)
+    if k == "dropout" and isinstance(v, dict):
+        from deeplearning4j_tpu.nn.conf.dropout import dropout_from_dict
+        return dropout_from_dict(v)
     if isinstance(v, list):
         return tuple(v)
     return v
@@ -93,6 +96,9 @@ class Layer:
              if not k.startswith("_") and (v is not None or k in ("name",))}
         if self.weight_noise is not None:
             d["weight_noise"] = self.weight_noise.to_dict()
+        from deeplearning4j_tpu.nn.conf.dropout import IDropout
+        if isinstance(self.dropout, IDropout):
+            d["dropout"] = self.dropout.to_dict()
         d["@layer"] = type(self).__name__
         return d
 
@@ -136,8 +142,14 @@ class Layer:
         raise NotImplementedError
 
     def _maybe_dropout(self, x, training, rng):
-        """Input dropout, reference retain-prob semantics."""
-        if training and self.dropout is not None and self.dropout < 1.0 and rng is not None:
+        """Input dropout: float = reference retain-prob semantics;
+        IDropout object = pluggable scheme (conf.dropout family)."""
+        if not training or self.dropout is None or rng is None:
+            return x
+        from deeplearning4j_tpu.nn.conf.dropout import IDropout
+        if isinstance(self.dropout, IDropout):
+            return self.dropout.apply(x, rng, training)
+        if self.dropout < 1.0:
             return exec_op("dropout_inverted", x, rng, p=self.dropout)
         return x
 
@@ -303,6 +315,12 @@ class SpatialDropoutLayer(Layer):
     RETAIN probability, matching the base-layer convention."""
 
     def apply(self, params, x, training=False, rng=None, state=None):
+        from deeplearning4j_tpu.nn.conf.dropout import IDropout
+        if isinstance(self.dropout, IDropout):
+            raise ValueError(
+                "SpatialDropoutLayer defines its own channel-wise scheme; "
+                "IDropout objects are not composable here — use a plain "
+                "retain probability")
         if not training or rng is None or self.dropout is None \
                 or self.dropout >= 1.0:
             return x, state
@@ -1555,7 +1573,8 @@ class LambdaLayer(Layer):
 # layer tranche 2 (reference D3 completion) re-exported into this namespace
 # so user code and the gradcheck coverage gate see one flat `layers` module
 from deeplearning4j_tpu.nn.conf.layers2 import (  # noqa: E402,F401
-    Cropping1D, Cropping3D, DepthwiseConvolution2D, FrozenLayer,
+    CapsuleLayer, CapsuleStrengthLayer, Cropping1D, Cropping3D,
+    DepthwiseConvolution2D, FrozenLayer, PrimaryCapsules,
     FrozenLayerWithBackprop, LocallyConnected1D, LocallyConnected2D,
     MaskLayer, MaskZeroLayer, PReLULayer, Subsampling1DLayer,
     Subsampling3DLayer, Upsampling1D, Upsampling3D, ZeroPadding1DLayer,

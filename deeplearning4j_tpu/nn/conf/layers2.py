@@ -593,3 +593,149 @@ class FrozenLayerWithBackprop(_FrozenBase):
         params = jax.tree.map(lax.stop_gradient, params)
         return self._inner_layer.apply(params, x, training=training,
                                        rng=rng, state=state)
+
+
+# ----------------------------------------------------------- capsnet trio
+def _squash(s, axis=-1, eps=1e-8):
+    """v = |s|^2/(1+|s|^2) * s/|s| (Sabour et al., the reference's
+    CapsuleUtils.squash)."""
+    sq = jnp.sum(s * s, axis=axis, keepdims=True)
+    return (sq / (1.0 + sq)) * s / jnp.sqrt(sq + eps)
+
+
+@register_layer
+@dataclasses.dataclass
+class PrimaryCapsules(Layer):
+    """ref: conf.layers.PrimaryCapsules — conv into ``channels`` capsule
+    maps of ``capsule_dimensions`` each, flattened to (N, caps, capDim)
+    and squashed. Input (N, H, W, C)."""
+    capsule_dimensions: int = 8
+    channels: int = 8
+    kernel_size: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+    n_in: Optional[int] = None
+    input_size: Optional[Tuple[int, int]] = None
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.channels
+        if self.input_size is None:
+            self.input_size = (input_type.height, input_type.width)
+
+    def _out_hw(self):
+        h, w = self.input_size
+        return (conv_out_size(h, self.kernel_size[0], self.stride[0], 0,
+                              1, False),
+                conv_out_size(w, self.kernel_size[1], self.stride[1], 0,
+                              1, False))
+
+    def n_capsules(self):
+        oh, ow = self._out_hw()
+        return self.channels * oh * ow
+
+    def output_type(self, input_type: InputType) -> InputType:
+        # capsule tensor rides the (N, T, C) convention: T = capsules,
+        # C = capsule dimension
+        return InputType.recurrent(self.capsule_dimensions,
+                                   self.n_capsules())
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        cout = self.channels * self.capsule_dimensions
+        shapes = {"W": (kh, kw, self.n_in, cout)}
+        if self.has_bias:
+            shapes["b"] = (cout,)
+        return shapes
+
+    def init_params(self, key):
+        kh, kw = self.kernel_size
+        cout = self.channels * self.capsule_dimensions
+        p = {"W": _winit.init(self.weight_init, key,
+                              (kh, kw, self.n_in, cout),
+                              kh * kw * self.n_in, kh * kw * cout)}
+        if self.has_bias:
+            p["b"] = jnp.full((cout,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        z = exec_op("conv2d", x, params["W"], params.get("b"),
+                    strides=self.stride, padding="VALID")
+        n = z.shape[0]
+        caps = z.reshape(n, -1, self.capsule_dimensions)
+        return _squash(caps), state
+
+
+@register_layer
+@dataclasses.dataclass
+class CapsuleLayer(Layer):
+    """ref: conf.layers.CapsuleLayer — capsules with dynamic routing
+    (Sabour et al. 2017). Input (N, inCaps, inDim) → (N, capsules,
+    capsule_dimensions).
+
+    TPU-first: the per-pair prediction u_hat is ONE einsum over a
+    (inCaps, capsules, outDim, inDim) weight; the ``routings`` softmax
+    iterations unroll statically (default 3) inside the jitted step."""
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+    input_capsules: Optional[int] = None
+    input_capsule_dimensions: Optional[int] = None
+
+    def set_n_in(self, input_type: InputType):
+        if self.input_capsules is None:
+            self.input_capsules = input_type.timeseries_length
+        if self.input_capsule_dimensions is None:
+            self.input_capsule_dimensions = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.capsule_dimensions, self.capsules)
+
+    def param_shapes(self):
+        return {"W": (self.input_capsules, self.capsules,
+                      self.capsule_dimensions,
+                      self.input_capsule_dimensions)}
+
+    def init_params(self, key):
+        fan_in = self.input_capsule_dimensions
+        return {"W": _winit.init(self.weight_init, key,
+                                 (self.input_capsules, self.capsules,
+                                  self.capsule_dimensions,
+                                  self.input_capsule_dimensions),
+                                 fan_in, self.capsule_dimensions)}
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        # u_hat[n,i,j,d] = W[i,j,d,e] @ x[n,i,e]
+        u_hat = jnp.einsum("ijde,nie->nijd", params["W"], x)
+        b = jnp.zeros(u_hat.shape[:3], u_hat.dtype)       # (N, i, j)
+        v = None
+        # gradients flow through ALL routing iterations (the reference
+        # backprops the full routing; FD-gradchecked)
+        for r in range(self.routings):
+            c = jax.nn.softmax(b, axis=2)      # couple over OUT capsules
+            s = jnp.einsum("nij,nijd->njd", c, u_hat)
+            v = _squash(s)
+            if r < self.routings - 1:
+                b = b + jnp.einsum("nijd,njd->nij", u_hat, v)
+        return v, state
+
+
+@register_layer
+@dataclasses.dataclass
+class CapsuleStrengthLayer(Layer):
+    """ref: conf.layers.CapsuleStrengthLayer — per-capsule L2 norm:
+    (N, caps, capDim) → (N, caps), the class-probability head of a
+    capsnet."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.timeseries_length)
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-12), state

@@ -149,6 +149,15 @@ SPECS = {
     "FrozenLayer": (lambda: L.FrozenLayer.wrap(
         L.ActivationLayer(activation="tanh")), _x((3, 4)),
         {"zero_input_grads": True}),
+    # ---- capsnet trio
+    "PrimaryCapsules": (lambda: L.PrimaryCapsules(
+        capsule_dimensions=4, channels=2, kernel_size=(3, 3),
+        stride=(2, 2), n_in=2, input_size=(7, 7)), _x((2, 7, 7, 2)), {}),
+    "CapsuleLayer": (lambda: L.CapsuleLayer(
+        capsules=3, capsule_dimensions=4, routings=2, input_capsules=5,
+        input_capsule_dimensions=4), _x((2, 5, 4), scale=0.5), {}),
+    "CapsuleStrengthLayer": (lambda: L.CapsuleStrengthLayer(),
+                             _x((2, 5, 4)), {}),
 }
 
 

@@ -541,3 +541,81 @@ def test_frozen_layer_blocks_training():
     d1 = [np.asarray(v) for v in jax.tree.leaves(net.param_tree()["0"])]
     assert all(np.array_equal(a, b) for a, b in zip(p_before, p_after))
     assert any(not np.array_equal(a, b) for a, b in zip(d0, d1))
+
+
+def test_dropout_family():
+    """conf.dropout family: statistical contracts + JSON roundtrip through
+    a layer config (ref: org.deeplearning4j.nn.conf.dropout.*)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.conf.dropout import (AlphaDropout, Dropout,
+                                                    GaussianDropout,
+                                                    GaussianNoise,
+                                                    dropout_from_dict)
+    rng = np.random.RandomState(0)
+    key = jax.random.key(3)
+    x = jnp.asarray(rng.randn(4000, 16).astype(np.float32))
+    # inverted dropout keeps the expectation
+    y = Dropout(0.7).apply(x, key, True)
+    assert abs(float(y.mean()) - float(x.mean())) < 0.02
+    assert float((y == 0).mean()) > 0.2
+    # gaussian dropout: multiplicative, mean-preserving
+    y = GaussianDropout(0.4).apply(x, key, True)
+    assert abs(float(y.mean()) - float(x.mean())) < 0.02
+    # gaussian noise: additive stddev
+    y = GaussianNoise(0.5).apply(jnp.zeros_like(x), key, True)
+    assert abs(float(y.std()) - 0.5) < 0.02
+    # alpha dropout preserves mean AND variance of standardized input
+    y = AlphaDropout(0.9).apply(x, key, True)
+    assert abs(float(y.mean()) - float(x.mean())) < 0.05
+    assert abs(float(y.std()) - float(x.std())) < 0.1
+    # eval mode = identity for all
+    for obj in (Dropout(0.5), GaussianDropout(0.5), GaussianNoise(0.5),
+                AlphaDropout(0.8)):
+        assert bool((obj.apply(x, key, False) == x).all())
+        assert dropout_from_dict(obj.to_dict()) == obj
+    # layer-config JSON roundtrip with an object-valued dropout
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                   layer_from_dict)
+    lyr = DenseLayer(n_in=4, n_out=3, dropout=GaussianDropout(0.3))
+    back = layer_from_dict(lyr.to_dict())
+    assert isinstance(back.dropout, GaussianDropout)
+    assert back.dropout.rate == 0.3
+
+
+def test_capsnet_trains():
+    """PrimaryCapsules -> CapsuleLayer (dynamic routing) ->
+    CapsuleStrengthLayer trains end-to-end (ref: the capsnet trio,
+    conf.layers.CapsuleLayer family)."""
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (CapsuleLayer,
+                                                   CapsuleStrengthLayer,
+                                                   ConvolutionLayer,
+                                                   LossLayer,
+                                                   PrimaryCapsules)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optim.updaters import Adam
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).updater(Adam(5e-3)).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(PrimaryCapsules(capsule_dimensions=4, channels=2,
+                                   kernel_size=(3, 3), stride=(2, 2)))
+            .layer(CapsuleLayer(capsules=2, capsule_dimensions=6,
+                                routings=2))
+            .layer(CapsuleStrengthLayer())
+            .layer(LossLayer(loss_function="mse"))
+            .set_input_type(InputType.convolutional(10, 10, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(1)
+    x = rng.rand(16, 10, 10, 1).astype(np.float32)
+    y = np.zeros((16, 2), np.float32)
+    y[np.arange(16), (x.mean(axis=(1, 2, 3)) > 0.5).astype(int)] = 0.9
+    s0 = None
+    for i in range(20):
+        net.fit(x, y)
+        if i == 0:
+            s0 = net.score()
+    assert net.score() < s0, (s0, net.score())
