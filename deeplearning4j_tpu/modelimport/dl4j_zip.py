@@ -207,9 +207,23 @@ def _layer_from_json(lj: dict):
         return L.ActivationLayer(activation=act, name=lj.get("layerName"))
     if cls == "DropoutLayer":
         p = lj.get("iDropout", {})
+        if not isinstance(p, dict):
+            return L.DropoutLayer(dropout=0.5, name=lj.get("layerName"))
+        scheme = str(p.get("@class", "")).rsplit(".", 1)[-1]
+        if scheme in ("GaussianDropout", "GaussianNoise", "AlphaDropout"):
+            from deeplearning4j_tpu.nn.conf.dropout import (AlphaDropout,
+                                                            GaussianDropout,
+                                                            GaussianNoise)
+            obj = {"GaussianDropout": lambda: GaussianDropout(
+                       float(p.get("rate", 0.5))),
+                   "GaussianNoise": lambda: GaussianNoise(
+                       float(p.get("stddev", 0.1))),
+                   "AlphaDropout": lambda: AlphaDropout(
+                       float(p.get("p", 0.95)))}[scheme]()
+            return L.DropoutLayer(dropout=obj, name=lj.get("layerName"))
         # DL4J Dropout(p) and our Layer.dropout are BOTH retain probability
-        keep = p.get("p", 0.5) if isinstance(p, dict) else 0.5
-        return L.DropoutLayer(dropout=float(keep), name=lj.get("layerName"))
+        return L.DropoutLayer(dropout=float(p.get("p", 0.5)),
+                              name=lj.get("layerName"))
     raise ValueError(
         f"DL4J layer class {cls!r} is outside the supported compat subset "
         "(Dense/Conv/Subsampling/BatchNorm/LSTM/Output/RnnOutput/Embedding/"

@@ -320,3 +320,39 @@ class TestReviewFixes:
         x = np.random.default_rng(5).normal(size=(2, 6, 3)).astype(np.float32)
         np.testing.assert_allclose(net.output(x).toNumpy(),
                                    net2.output(x).toNumpy(), atol=1e-5)
+
+
+def test_idropout_schemes_round_trip(tmp_path):
+    """GaussianNoise/GaussianDropout/AlphaDropout survive the DL4J-zip
+    round trip as themselves (not silently degraded to plain Dropout)."""
+    import os
+
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.dropout import (AlphaDropout,
+                                                    GaussianDropout,
+                                                    GaussianNoise)
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, DropoutLayer,
+                                                   OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.utils.serialization import ModelSerializer
+    for obj in (GaussianNoise(0.25), GaussianDropout(0.4),
+                AlphaDropout(0.9)):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(1e-3)).list()
+                .layer(DenseLayer(n_out=5, activation="tanh"))
+                .layer(DropoutLayer(dropout=obj))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss_function="negativeloglikelihood"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        p = os.path.join(str(tmp_path), f"{type(obj).__name__}.zip")
+        from deeplearning4j_tpu.modelimport.dl4j_zip import (
+            restore_multi_layer_network, write_model)
+        write_model(net, p)
+        net2 = restore_multi_layer_network(p)
+        back = net2.conf.layers[1].dropout
+        assert type(back) is type(obj), (type(back), type(obj))
+        assert back == obj
