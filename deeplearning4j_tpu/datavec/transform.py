@@ -448,6 +448,171 @@ class TransformProcess:
                 return new_schema, out
             return self._add("reduce", fn)
 
+        # --- column structure (ref: transform.column.* /
+        # DuplicateColumnsTransform / AddConstantColumnTransform)
+        def add_constant_column(self, name, column_type: str, value):
+            def fn(schema, rows):
+                ns = Schema(schema.columns
+                            + [ColumnMetaData(name, column_type)])
+                if rows is None:
+                    return ns, None
+                return ns, [r + [box(value)] for r in rows]
+            return self._add("add_constant_column", fn)
+
+        def duplicate_column(self, src: str, new_name: str):
+            def fn(schema, rows):
+                i = schema.get_index_of_column(src)
+                meta = schema.columns[i]
+                ns = Schema(schema.columns
+                            + [ColumnMetaData(new_name, meta.column_type)])
+                if rows is None:
+                    return ns, None
+                return ns, [r + [r[i]] for r in rows]
+            return self._add("duplicate_column", fn)
+
+        # --- string transforms (ref: transform.string.*)
+        def _string_op(self, label, name, op):
+            def fn(schema, rows):
+                i = schema.get_index_of_column(name)
+                if rows is None:
+                    return schema, None
+                out = []
+                for r in rows:
+                    r = list(r)
+                    r[i] = box(op(str(unbox(r[i]))))
+                    out.append(r)
+                return schema, out
+            return self._add(label, fn)
+
+        def append_string_column_transform(self, name, to_append: str):
+            return self._string_op("append_string", name,
+                                   lambda v: v + to_append)
+
+        def change_case_transform(self, name, case: str = "lower"):
+            return self._string_op(
+                "change_case", name,
+                (str.lower if case.lower() == "lower" else str.upper))
+
+        def replace_string_transform(self, name, mapping: dict):
+            """Regex → replacement map, applied in insertion order
+            (ref: ReplaceStringTransform)."""
+            import re
+
+            def op(v):
+                for pat, rep in mapping.items():
+                    v = re.sub(pat, rep, v)
+                return v
+            return self._string_op("replace_string", name, op)
+
+        def string_map_transform(self, name, mapping: dict):
+            """Exact-match relabeling (ref: StringMapTransform)."""
+            return self._string_op("string_map", name,
+                                   lambda v: mapping.get(v, v))
+
+        def concat_string_columns(self, new_name, delimiter, *names):
+            def fn(schema, rows):
+                idx = [schema.get_index_of_column(n) for n in names]
+                ns = Schema(schema.columns
+                            + [ColumnMetaData(new_name, ColumnType.String)])
+                if rows is None:
+                    return ns, None
+                return ns, [r + [box(delimiter.join(str(unbox(r[i]))
+                                                    for i in idx))]
+                            for r in rows]
+            return self._add("concat_string_columns", fn)
+
+        # --- time transforms (ref: transform.time.StringToTimeTransform /
+        # DeriveColumnsFromTimeTransform)
+        def string_to_time_transform(self, name,
+                                     fmt: str = "%Y-%m-%d %H:%M:%S"):
+            import datetime as _dt
+
+            def fn(schema, rows):
+                i = schema.get_index_of_column(name)
+                ns = Schema(list(schema.columns))
+                ns.columns[i] = ColumnMetaData(name, ColumnType.Time)
+                if rows is None:
+                    return ns, None
+                out = []
+                for r in rows:
+                    r = list(r)
+                    t = _dt.datetime.strptime(str(unbox(r[i])), fmt)
+                    r[i] = box(int(t.replace(
+                        tzinfo=_dt.timezone.utc).timestamp() * 1000))
+                    out.append(r)
+                return ns, out
+            return self._add("string_to_time", fn)
+
+        def derive_columns_from_time(self, source: str, *fields):
+            """fields ⊆ {year, month, day, hour, minute, second,
+            day_of_week} → new integer columns named source_<field>."""
+            import datetime as _dt
+
+            def fn(schema, rows):
+                i = schema.get_index_of_column(source)
+                ns = Schema(schema.columns
+                            + [ColumnMetaData(f"{source}_{f}", ColumnType.Integer)
+                               for f in fields])
+                if rows is None:
+                    return ns, None
+                out = []
+                for r in rows:
+                    t = _dt.datetime.fromtimestamp(
+                        unbox(r[i]) / 1000.0, _dt.timezone.utc)
+                    vals = {"year": t.year, "month": t.month, "day": t.day,
+                            "hour": t.hour, "minute": t.minute,
+                            "second": t.second,
+                            "day_of_week": t.weekday()}
+                    out.append(list(r) + [box(vals[f]) for f in fields])
+                return ns, out
+            return self._add("derive_columns_from_time", fn)
+
+        # --- column-vs-column math (ref: DoubleColumnsMathOpTransform).
+        # Folds MathOp._FNS pairwise left-to-right (the scalar-only ops
+        # ScalarMin/ScalarMax double as pairwise Min/Max); division follows
+        # Java double semantics (inf/nan, never a crash)
+        def double_columns_math_op(self, new_name, op: str, *names):
+            key = {"Max": "ScalarMax", "Min": "ScalarMin"}.get(op, op)
+            pair = MathOp._FNS.get(key)
+            if pair is None:
+                raise ValueError(f"unknown op {op!r}; have "
+                                 f"{sorted(MathOp._FNS)} + Max/Min")
+
+            def fold(vals):
+                import math
+                acc = vals[0]
+                for x in vals[1:]:
+                    try:
+                        acc = pair(acc, x)
+                    except ZeroDivisionError:
+                        # Java double semantics: x % 0 = NaN; x / 0 = ±inf
+                        # (0/0 = NaN)
+                        acc = (math.nan if key == "Modulus" or acc == 0
+                               else math.copysign(math.inf, acc))
+                return acc
+
+            def fn(schema, rows):
+                idx = [schema.get_index_of_column(n) for n in names]
+                ns = Schema(schema.columns
+                            + [ColumnMetaData(new_name, ColumnType.Double)])
+                if rows is None:
+                    return ns, None
+                return ns, [list(r) + [box(float(fold(
+                    [r[i].to_double() for i in idx])))] for r in rows]
+            return self._add("double_columns_math_op", fn)
+
+        doubleColumnsMathOp = double_columns_math_op
+
+        addConstantColumn = add_constant_column
+        duplicateColumn = duplicate_column
+        appendStringColumnTransform = append_string_column_transform
+        changeCaseTransform = change_case_transform
+        replaceStringTransform = replace_string_transform
+        stringMapTransform = string_map_transform
+        concatStringColumns = concat_string_columns
+        stringToTimeTransform = string_to_time_transform
+        deriveColumnsFromTime = derive_columns_from_time
+
         # --- custom escape hatch
         def transform(self, name, fn):
             """Custom step: fn(schema, rows) -> (schema, rows)."""

@@ -354,3 +354,51 @@ def test_csv_to_matrix_native_fast_path(tmp_path):
                       dtype=np.float32)
     np.testing.assert_allclose(mat, rows, atol=1e-5)
     assert isinstance(is_native(), bool)     # either path is legitimate
+
+
+def test_transform_tranche2_string_time_math():
+    """String/time/column-math transform families (ref: transform.string.*,
+    transform.time.*, DoubleColumnsMathOpTransform,
+    AddConstantColumnTransform, DuplicateColumnsTransform)."""
+    from deeplearning4j_tpu.datavec.schema import Schema
+    from deeplearning4j_tpu.datavec.transform import TransformProcess
+    from deeplearning4j_tpu.datavec.writable import box, unbox
+
+    schema = (Schema.Builder()
+              .add_column_string("name")
+              .add_column_double("a", "b")
+              .add_column_string("ts")
+              .build())
+    tp = (TransformProcess.Builder(schema)
+          .append_string_column_transform("name", "_x")
+          .change_case_transform("name", "upper")
+          .string_map_transform("name", {"ALICE_X": "A"})
+          .replace_string_transform("name", {"^BOB.*": "B"})
+          .double_columns_math_op("sum_ab", "Add", "a", "b")
+          .double_columns_math_op("ratio", "Divide", "a", "b")
+          .duplicate_column("a", "a2")
+          .add_constant_column("k", "Double", 7.0)
+          .concat_string_columns("joined", "-", "name", "k")
+          .string_to_time_transform("ts", "%Y-%m-%d %H:%M:%S")
+          .derive_columns_from_time("ts", "year", "hour", "day_of_week")
+          .build())
+    rows = [[box("alice"), box(1.5), box(2.5),
+             box("2023-07-04 13:45:00")],
+            [box("bob"), box(3.0), box(4.0),
+             box("2024-01-01 00:30:00")]]
+    out = tp.execute(rows)
+    names = tp.get_final_schema().get_column_names()
+    assert names == ["name", "a", "b", "ts", "sum_ab", "ratio", "a2", "k",
+                     "joined", "ts_year", "ts_hour", "ts_day_of_week"]
+    r0, r1 = out
+    assert unbox(r0[0]) == "A" and unbox(r1[0]) == "B"
+    assert unbox(r0[names.index("sum_ab")]) == 4.0
+    assert abs(unbox(r0[names.index("ratio")]) - 0.6) < 1e-9
+    assert unbox(r0[names.index("a2")]) == 1.5
+    assert unbox(r0[names.index("joined")]) == "A-7.0"
+    assert unbox(r0[names.index("ts_year")]) == 2023
+    assert unbox(r0[names.index("ts_hour")]) == 13
+    assert unbox(r0[names.index("ts_day_of_week")]) == 1   # Tuesday
+    assert unbox(r1[names.index("ts_year")]) == 2024
+    # schema-only path (get_final_schema) matched execute's schema already
+    # — exercised implicitly above
