@@ -1388,3 +1388,131 @@ def _as_graph_def(graph_def):
     else:
         gd.ParseFromString(graph_def)
     return gd
+
+
+def _register_tail_rules():
+    """Round-3 long-tail sweep: the last common-GraphDef ops a probe of
+    ~140 frequently-exported op types found missing."""
+
+    @mapping_rule("AddN")
+    def _addn(ctx, node, inputs, attrs):
+        acc = inputs[0]
+        for x in inputs[1:]:
+            acc = ctx.sd._op("Add", acc, x)
+        return acc
+
+    @mapping_rule("Div")
+    def _div(ctx, node, inputs, attrs):
+        # TF Div: plain division on floats (x/0 = ±inf), truncation toward
+        # zero on integers — pick by operand dtype
+        import numpy as np
+        if np.issubdtype(np.dtype(inputs[0].dtype), np.integer):
+            return ctx.sd._op("truncatediv", *inputs)
+        return ctx.sd._op("RealDiv", *inputs)
+
+    @mapping_rule("DivNoNan")
+    def _div_no_nan(ctx, node, inputs, attrs):
+        return ctx.sd._op("divide_no_nan", *inputs)
+
+    @mapping_rule("IdentityN")
+    def _identity_n(ctx, node, inputs, attrs):
+        if len(inputs) == 1:
+            # single output rides the normal rename path — emit a real op
+            # so renaming cannot strip the PRODUCER's (or a placeholder's)
+            # name
+            return ctx.sd._op("Identity", inputs[0])
+        # multi-output: alias the inputs directly (consumed as node:i refs,
+        # never renamed); creating named Identity ops here could steal the
+        # bare name "Identity" from a later graph-output node
+        return list(inputs)
+
+    @mapping_rule("Invert")
+    def _invert(ctx, node, inputs, attrs):
+        return ctx.sd._op("bitwise_not", inputs[0])
+
+    @mapping_rule("RandomStandardNormal", "RandomUniform")
+    def _tf_random(ctx, node, inputs, attrs):
+        import numpy as np
+        dims = ctx.const_value(node.input[0])   # raises if not foldable
+        shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
+        seed = int(attrs.get("seed", 0)) or int(attrs.get("seed2", 0))
+        if not seed:
+            # one compiled program = one baked key: an unseeded TF random
+            # draws FRESH values per session.run, but here the draw is
+            # fixed at import time. Make that loud, and derive a
+            # per-import seed so separate imports at least differ.
+            import warnings
+            from deeplearning4j_tpu.ndarray import random as _rng
+            import jax as _jax
+            seed = int(_jax.random.randint(_rng.next_key(), (), 0,
+                                           2 ** 31 - 1))
+            warnings.warn(
+                f"{node.op} {node.name!r} has no seed: under whole-graph "
+                f"jit the draw is fixed per import (TF would redraw per "
+                f"run); set the seed attr for reproducibility",
+                stacklevel=2)
+        op = ("random_normal_gen" if node.op == "RandomStandardNormal"
+              else "random_uniform_gen")
+        out = ctx.sd._op(op, shape=shape, seed=seed)
+        dt = _dtype_of(int(attrs.get("dtype", 1)))
+        if str(dt) != "float32":
+            out = ctx.sd._op("Cast", out, dtype=dt)
+        return out
+
+    @mapping_rule("DynamicStitch", "ParallelDynamicStitch")
+    def _dynamic_stitch(ctx, node, inputs, attrs):
+        # TF contract: merged.shape[0] = max(indices) + 1, duplicates
+        # resolved last-wins. A static output shape therefore needs the
+        # indices to be constant-foldable (they are in the partition/
+        # stitch patterns TF emits); the merge then compiles to ONE gather
+        # with a host-computed source plan — no scatter ordering hazards.
+        import numpy as np
+        n = int(attrs.get("N", len(inputs) // 2))
+        data = inputs[n:]
+        try:
+            idx_vals = [np.asarray(ctx.const_value(r)).reshape(-1)
+                        for r in node.input[:n]]
+        except TFImportError:
+            raise TFImportError(
+                f"{node.op} {node.name!r}: indices must be "
+                "constant-foldable — the output row count max(indices)+1 "
+                "must be static under the whole-graph jit")
+        first = data[0]
+        elem = tuple(first.shape[1:]) if first.shape else ()
+        flat_data = data[0] if n == 1 else ctx.sd._op(
+            "concat", *[ctx.sd._op("Reshape", d, shape=(-1,) + elem)
+                        for d in data], axis=0)
+        all_idx = np.concatenate(idx_vals)
+        rows = int(all_idx.max()) + 1 if all_idx.size else 0
+        src = np.zeros(rows, np.int64)
+        for flat_pos, out_row in enumerate(all_idx):   # last write wins
+            src[int(out_row)] = flat_pos
+        return ctx.sd._op("gather", flat_data,
+                          ctx.sd.constant(src), axis=0)
+
+    @mapping_rule("DynamicPartition")
+    def _dynamic_partition(ctx, node, inputs, attrs):
+        raise TFImportError(
+            "DynamicPartition has data-dependent output shapes, which the "
+            "whole-graph-jit executor cannot represent; restructure with "
+            "masks/Where-free selects (the eager registry op "
+            "'dynamic_partition' covers host-side use)")
+
+    @mapping_rule("Where")
+    def _where_tf(ctx, node, inputs, attrs):
+        raise TFImportError(
+            "TF Where (coordinate list) has a data-dependent output shape; "
+            "under whole-graph jit use Select/SelectV2 masks instead "
+            "(eager: ops registry 'nonzero_coords')")
+
+    @mapping_rule("TensorListFromTensor", "TensorListStack",
+                  "TensorListReserve", "TensorListGetItem",
+                  "TensorListSetItem")
+    def _tensor_list(ctx, node, inputs, attrs, _op=None):
+        raise TFImportError(
+            f"{node.op}: TensorList (TensorArray v2) graphs import only "
+            "through the counted-While lowering (lax.scan); lists outside "
+            "a While body are unsupported")
+
+
+_register_tail_rules()

@@ -171,3 +171,34 @@ def test_strided_slice_negative_stride_and_shrink():
         return x[:, -1]
 
     _check(shrink_col, {"x": x})
+
+
+def test_tail_random_and_stitch_rules():
+    """RandomStandardNormal/RandomUniform import with static shapes and
+    plausible moments; DynamicStitch interleaves exactly (corpus pins the
+    value case; exercised here against live TF for a permuted pattern)."""
+    g = tf.Graph()
+    with g.as_default():
+        tf.raw_ops.RandomStandardNormal(shape=tf.constant([64, 8]),
+                                        dtype=tf.float32, seed=5, name="rn")
+        tf.raw_ops.RandomUniform(shape=tf.constant([64, 8]),
+                                 dtype=tf.float32, seed=9, name="ru")
+    sd = TFGraphMapper.import_graph(g.as_graph_def())
+    rn = np.asarray(sd.output({}, ["rn"])["rn"])
+    ru = np.asarray(sd.output({}, ["ru"])["ru"])
+    assert rn.shape == (64, 8) and ru.shape == (64, 8)
+    assert abs(float(rn.std()) - 1.0) < 0.15
+    assert float(ru.min()) >= 0.0 and float(ru.max()) < 1.0
+
+    g2 = tf.Graph()
+    with g2.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, (6, 3), name="x")
+        tf.raw_ops.DynamicStitch(
+            indices=[tf.constant([5, 1, 3]), tf.constant([0, 2, 4])],
+            data=[x[:3], x[3:]], name="ds")
+    xv = np.random.RandomState(3).randn(6, 3).astype(np.float32)
+    with tf.compat.v1.Session(graph=g2) as s:
+        ref = s.run("ds:0", {"x:0": xv})
+    sd2 = TFGraphMapper.import_graph(g2.as_graph_def())
+    got = np.asarray(sd2.output({"x": xv}, ["ds"])["ds"])
+    np.testing.assert_allclose(got, ref, atol=1e-6)

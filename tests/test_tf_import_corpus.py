@@ -152,6 +152,28 @@ CORPUS = {
     "SelectV2": (lambda x: tf.where(x > 1.0, x, -x), {"x": x34}),
     "Mod": (lambda x: tf.raw_ops.Mod(x=x - 1.0, y=tf.constant(0.7)),
             {"x": x34}),
+    "AddN": (lambda x: tf.raw_ops.AddN(inputs=[x, x * 2.0, x + 1.0]),
+             {"x": x34}),
+    "Div": (lambda x: tf.raw_ops.Div(x=x, y=x + 0.5), {"x": x34}),
+    "DivInt": (lambda x: tf.cast(tf.raw_ops.Div(
+        x=tf.cast(x * 10 - 5, tf.int32), y=tf.constant(3)), tf.float32),
+        {"x": x34}),
+    "DivNoNan": (lambda x: tf.raw_ops.DivNoNan(
+        x=x, y=tf.concat([tf.zeros((3, 1)), x[:, 1:]], axis=1)),
+        {"x": x34}),
+    "IdentityN": (lambda x: tf.raw_ops.IdentityN(
+        input=[x, x * 2.0])[0] + 1.0, {"x": x34}),
+    "Invert": (lambda x: tf.cast(tf.raw_ops.Invert(
+        x=tf.cast(x * 50, tf.int32)), tf.float32), {"x": x34}),
+    "DynamicStitch": (lambda x: tf.raw_ops.DynamicStitch(
+        indices=[tf.constant([0, 2]), tf.constant([1, 3])],
+        data=[x[:2] * 2.0, x[2:4]]),
+        {"x": R(7).rand(4, 4).astype(F32)}),
+    "DynamicStitchDup": (lambda x: tf.raw_ops.DynamicStitch(
+        # duplicate index 1 (last wins) + max(indices)+1 = 3 rows from 4
+        indices=[tf.constant([0, 1]), tf.constant([1, 2])],
+        data=[x[:2], x[2:4] * 3.0]),
+        {"x": R(8).rand(4, 4).astype(F32)}),
     "TruncateDiv": (lambda x: tf.raw_ops.TruncateDiv(
         x=tf.cast(x * 10.0 - 5.0, tf.int32), y=tf.constant(3)),
         {"x": x34}),
@@ -366,6 +388,17 @@ COVERAGE_IGNORE = {
     "ConfusionMatrix",            # tf.math wrapper emits Assert guard
                                   # subgraphs; rule covered via registry op
     "TruncateMod",                # same rule as Mod (corpus-pinned there)
+    # tail rules that cannot be value-pinned by the corpus harness:
+    "RandomStandardNormal",       # nondeterministic (shape/seed tested in
+    "RandomUniform",              #   tests/test_tf_import.py tail test)
+    "ParallelDynamicStitch",      # same rule as DynamicStitch
+    "DynamicPartition",           # actionable-error rule (dynamic shape)
+    "Where",                      # actionable-error rule (dynamic shape)
+    "TensorListFromTensor",       # actionable-error rules (lists outside
+    "TensorListStack",            #   a counted While body)
+    "TensorListReserve",
+    "TensorListGetItem",
+    "TensorListSetItem",
 }
 
 
