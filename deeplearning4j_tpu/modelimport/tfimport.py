@@ -1396,6 +1396,8 @@ def _register_tail_rules():
 
     @mapping_rule("AddN")
     def _addn(ctx, node, inputs, attrs):
+        if len(inputs) == 1:               # N=1 (grappler/gradient forms):
+            return ctx.sd._op("Identity", inputs[0])   # rename-safe
         acc = inputs[0]
         for x in inputs[1:]:
             acc = ctx.sd._op("Add", acc, x)
@@ -1435,7 +1437,11 @@ def _register_tail_rules():
         import numpy as np
         dims = ctx.const_value(node.input[0])   # raises if not foldable
         shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
-        seed = int(attrs.get("seed", 0)) or int(attrs.get("seed2", 0))
+        s1 = int(attrs.get("seed", 0))
+        s2 = int(attrs.get("seed2", 0))
+        # TF draws from the PAIR (graph seed, per-op seed): mix both so
+        # ops sharing a graph-level seed still differ
+        seed = (hash((s1, s2)) & 0x7FFFFFFF) if (s1 or s2) else 0
         if not seed:
             # one compiled program = one baked key: an unseeded TF random
             # draws FRESH values per session.run, but here the draw is
@@ -1477,11 +1483,16 @@ def _register_tail_rules():
                 f"{node.op} {node.name!r}: indices must be "
                 "constant-foldable — the output row count max(indices)+1 "
                 "must be static under the whole-graph jit")
-        first = data[0]
-        elem = tuple(first.shape[1:]) if first.shape else ()
-        flat_data = data[0] if n == 1 else ctx.sd._op(
+        # element shape = data rank minus the indices rank (indices may
+        # be scalar, 1-D, or higher — TF flattens index-major)
+        idx_raw = [np.asarray(ctx.const_value(r))
+                   for r in node.input[:n]]
+        elem = tuple(int(d) for d in
+                     (data[0].shape or ())[idx_raw[0].ndim:])
+        flat_data = ctx.sd._op(
             "concat", *[ctx.sd._op("Reshape", d, shape=(-1,) + elem)
-                        for d in data], axis=0)
+                        for d in data], axis=0) if n > 1 else \
+            ctx.sd._op("Reshape", data[0], shape=(-1,) + elem)
         all_idx = np.concatenate(idx_vals)
         rows = int(all_idx.max()) + 1 if all_idx.size else 0
         src = np.zeros(rows, np.int64)
@@ -1510,9 +1521,10 @@ def _register_tail_rules():
                   "TensorListSetItem")
     def _tensor_list(ctx, node, inputs, attrs, _op=None):
         raise TFImportError(
-            f"{node.op}: TensorList (TensorArray v2) graphs import only "
-            "through the counted-While lowering (lax.scan); lists outside "
-            "a While body are unsupported")
+            f"{node.op}: TensorList (TensorArray v2) ops are unsupported "
+            "— restructure the loop to accumulate into a fixed-shape "
+            "tensor (e.g. tensor_scatter_nd_update at the loop index), "
+            "which the counted-While lowering trains through")
 
 
 _register_tail_rules()

@@ -201,6 +201,103 @@ class PoolHelperVertex(GraphVertex):
         return inputs[0][:, 1:, 1:, :]
 
 
+@register_vertex
+@dataclasses.dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs, per example
+    (ref: vertex.impl.L2Vertex — used by siamese/triplet setups)."""
+    eps: float = 1e-8
+
+    def apply(self, inputs):
+        a, b = inputs[0], inputs[1]
+        diff = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(diff * diff, axis=1, keepdims=True)
+                        + self.eps)
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclasses.dataclass
+class LastTimeStepVertex(GraphVertex):
+    """(N, T, C) → (N, C) at the final timestep (ref:
+    vertex.impl.rnn.LastTimeStepVertex; mask-aware selection lives in the
+    LastTimeStep layer wrapper — this vertex takes the final step)."""
+
+    def apply(self, inputs):
+        return inputs[0][:, -1]
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+
+@register_vertex
+@dataclasses.dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """(N, C) → (N, T, C), T taken from a reference time-series input
+    (ref: vertex.impl.rnn.DuplicateToTimeSeriesVertex — seq2seq decoders
+    broadcasting an encoder summary over time). Inputs: [vector, series]."""
+
+    def apply(self, inputs):
+        vec, series = inputs[0], inputs[1]
+        return jnp.broadcast_to(vec[:, None, :],
+                                (vec.shape[0], series.shape[1],
+                                 vec.shape[-1]))
+
+    def output_type(self, input_types):
+        return InputType.recurrent(input_types[0].size,
+                                   input_types[1].timeseries_length)
+
+
+@register_vertex
+@dataclasses.dataclass
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Reverse the time axis of (N, T, C) (ref:
+    vertex.impl.rnn.ReverseTimeSeriesVertex — the manual-bidirectional
+    building block)."""
+
+    def apply(self, inputs):
+        return inputs[0][:, ::-1]
+
+
+@register_vertex
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wrap an InputPreProcessor as a standalone vertex
+    (ref: vertex.impl.PreprocessorVertex)."""
+    preprocessor: Optional[dict] = None
+    _pp: "object" = dataclasses.field(default=None, repr=False,
+                                      compare=False)
+
+    @staticmethod
+    def wrap(pp) -> "PreprocessorVertex":
+        v = PreprocessorVertex(preprocessor=pp.to_dict())
+        v._materialize()
+        return v
+
+    def _materialize(self):
+        if self._pp is None and self.preprocessor is not None:
+            from deeplearning4j_tpu.nn.conf.preprocessors import (
+                preprocessor_from_dict)
+            self._pp = preprocessor_from_dict(self.preprocessor)
+
+    def to_dict(self) -> dict:
+        # the materialized _pp object must never leak into JSON
+        return {"@vertex": type(self).__name__,
+                "preprocessor": self.preprocessor}
+
+    def apply(self, inputs):
+        self._materialize()
+        return self._pp.pre_process(inputs[0])
+
+    def output_type(self, input_types):
+        self._materialize()
+        if hasattr(self._pp, "output_type"):
+            return self._pp.output_type(input_types[0])
+        return input_types[0]
+
+
 class LambdaVertex(GraphVertex):
     """User-defined vertex fn (ref: SameDiffLambdaVertex). Not JSON-serializable."""
 

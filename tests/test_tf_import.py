@@ -202,3 +202,32 @@ def test_tail_random_and_stitch_rules():
     sd2 = TFGraphMapper.import_graph(g2.as_graph_def())
     got = np.asarray(sd2.output({"x": xv}, ["ds"])["ds"])
     np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_tail_rule_edge_cases():
+    """Scalar-indices DynamicStitch, N=1 AddN (rename hazard), and the
+    (seed, seed2) pair both differentiating draws."""
+    g = tf.Graph()
+    with g.as_default():
+        a = tf.compat.v1.placeholder(tf.float32, (4,), name="a")
+        b = tf.compat.v1.placeholder(tf.float32, (4,), name="b")
+        tf.raw_ops.DynamicStitch(indices=[tf.constant(0), tf.constant(1)],
+                                 data=[a, b], name="ds_scalar")
+        tf.raw_ops.AddN(inputs=[a], name="addn1")
+        tf.raw_ops.RandomStandardNormal(shape=tf.constant([8]),
+                                        dtype=tf.float32, seed=7, seed2=11,
+                                        name="r1")
+        tf.raw_ops.RandomStandardNormal(shape=tf.constant([8]),
+                                        dtype=tf.float32, seed=7, seed2=42,
+                                        name="r2")
+    av = np.arange(4, dtype=np.float32)
+    bv = av + 10
+    with tf.compat.v1.Session(graph=g) as s:
+        ref = s.run(["ds_scalar:0", "addn1:0"], {"a:0": av, "b:0": bv})
+    sd = TFGraphMapper.import_graph(g.as_graph_def())
+    out = sd.output({"a": av, "b": bv},
+                    ["ds_scalar", "addn1", "r1", "r2"])
+    np.testing.assert_allclose(np.asarray(out["ds_scalar"]), ref[0])
+    np.testing.assert_allclose(np.asarray(out["addn1"]), ref[1])
+    # sharing seed but not seed2 must NOT correlate the draws
+    assert not np.allclose(np.asarray(out["r1"]), np.asarray(out["r2"]))
