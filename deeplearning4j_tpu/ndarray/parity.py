@@ -294,6 +294,7 @@ def _sigs() -> Dict[str, List[Entry]]:
         ("cond(Condition)", "cond"), ("condi(Condition)", "condi"),
         ("toFlatArray(FlatBufferBuilder)", "toFlatArray"),
         ("isInScope()", "isInScope"),
+        ("epsi(INDArray)", "epsi"), ("epsi(Number)", "epsi"),
         ("setShape(long...)", "setShape"),
         ("setStride(long...)", "setStride"),
         ("setData(DataBuffer)", "setData")]

@@ -44,6 +44,14 @@ def extend_tranche5():
     N.cond = cond
     N.condi = condi
 
+    def epsi(self, other, eps=1e-5):
+        """ref: INDArray#epsi — in-place epsilon-equality (result written
+        through as 0/1 in this array's dtype)."""
+        mask = jnp.abs(self.buf() - jnp.asarray(_unwrap(other))) < eps
+        return self._write(mask.astype(self.buf().dtype))
+
+    N.epsi = epsi
+
     def toFlatArray(self):
         """ref: BaseNDArray#toFlatArray(FlatBufferBuilder) → the serialized
         FlatArray payload. Portable flat encoding here = npy bytes (dtype +

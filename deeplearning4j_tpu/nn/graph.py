@@ -9,6 +9,7 @@ score = sum of all output-layer losses (reference semantics).
 from __future__ import annotations
 
 import functools
+import inspect as _inspect
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -184,7 +185,11 @@ class ComputationGraph:
                     new_states[name] = st
                 acts[name] = h
             else:
-                acts[name] = node.vertex.apply(srcs)
+                vkw = {}
+                if mask is not None and "mask" in _inspect.signature(
+                        node.vertex.apply).parameters:
+                    vkw["mask"] = mask
+                acts[name] = node.vertex.apply(srcs, **vkw)
         if cdtype is not None:
             acts = {k: _cast_float(v, jnp.float32) for k, v in acts.items()}
         return acts, new_states

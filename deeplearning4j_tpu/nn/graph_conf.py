@@ -221,12 +221,16 @@ class L2Vertex(GraphVertex):
 @register_vertex
 @dataclasses.dataclass
 class LastTimeStepVertex(GraphVertex):
-    """(N, T, C) → (N, C) at the final timestep (ref:
-    vertex.impl.rnn.LastTimeStepVertex; mask-aware selection lives in the
-    LastTimeStep layer wrapper — this vertex takes the final step)."""
+    """(N, T, C) → (N, C) at the final UNMASKED timestep (ref:
+    vertex.impl.rnn.LastTimeStepVertex). With no mask: the final step."""
 
-    def apply(self, inputs):
-        return inputs[0][:, -1]
+    def apply(self, inputs, mask=None):
+        x = inputs[0]
+        if mask is None:
+            return x[:, -1]
+        last = jnp.maximum(jnp.sum(jnp.asarray(mask), axis=1)
+                           .astype(jnp.int32) - 1, 0)
+        return x[jnp.arange(x.shape[0]), last]
 
     def output_type(self, input_types):
         return InputType.feed_forward(input_types[0].size)

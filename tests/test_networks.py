@@ -683,3 +683,20 @@ def test_preprocessor_vertex():
     from deeplearning4j_tpu.nn.graph_conf import vertex_from_dict
     v2 = vertex_from_dict(v.to_dict())
     assert isinstance(v2, PreprocessorVertex)
+
+
+def test_last_time_step_vertex_masked():
+    """LastTimeStepVertex selects each example's last UNMASKED step when
+    the graph is fed a sequence mask (ref parity: the reference vertex is
+    mask-aware)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.graph_conf import LastTimeStepVertex
+    x = jnp.asarray(np.arange(2 * 4 * 3, dtype=np.float32)
+                    .reshape(2, 4, 3))
+    mask = jnp.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    out = LastTimeStepVertex().apply([x], mask=mask)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x[0, 1]))
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(x[1, 3]))
+    # unmasked: plain last step
+    out2 = LastTimeStepVertex().apply([x])
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(x[:, -1]))
