@@ -393,3 +393,33 @@ class TestControlFlow:
             [P.make_value_info("vf", F32, (2,))])
         with pytest.raises(ONNXImportError):
             OnnxGraphMapper.import_model(P.make_model(g))
+
+
+def test_imported_loop_survives_save_load(tmp_path):
+    """A Loop-bearing imported model round-trips through SameDiff
+    save/load (control-flow subgraphs serialize with the graph)."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+    body = P.make_graph(
+        [P.make_node("Identity", ["cond_in"], ["cond_out"]),
+         P.make_node("Mul", ["v_in", "two"], ["v_out"])],
+        "body",
+        [P.make_value_info("iter", np.int64, ()),
+         P.make_value_info("cond_in", np.bool_, ()),
+         P.make_value_info("v_in", F32, (2,))],
+        [P.make_value_info("cond_out", np.bool_, ()),
+         P.make_value_info("v_out", F32, (2,))],
+        initializers=[P.make_tensor("two", np.asarray(2.0, F32))])
+    g = P.make_graph(
+        [P.make_node("Loop", ["M", "", "v0"], ["vf"], body=body)],
+        "g", [P.make_value_info("v0", F32, (2,))],
+        [P.make_value_info("vf", F32, (2,))],
+        initializers=[P.make_tensor("M", np.asarray(3, np.int64))])
+    sd = OnnxGraphMapper.import_model(P.make_model(g))
+    v0 = np.array([1.0, 0.5], F32)
+    out1 = np.asarray(sd.output({"v0": v0}, ["vf"])["vf"])
+    np.testing.assert_allclose(out1, v0 * 8)
+    p = str(tmp_path / "loop.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    out2 = np.asarray(sd2.output({"v0": v0}, ["vf"])["vf"])
+    np.testing.assert_allclose(out1, out2)
