@@ -21,9 +21,120 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
+import subprocess
 import sys
 import time
+
+
+def _memory_worker(kind: str, T: int, P: int, heads: int, dim: int):
+    """Measure peak device memory of ONE attention variant at global length
+    ``T`` (VERDICT r3 #9: turn the "(T/P)^2 per chip" claim into telemetry).
+
+    ``ring_chip`` runs exactly one ring participant's workload on the local
+    device: resident q shard (T/P), one in-flight K/V block (T/P), and the
+    online-softmax accumulators, looping P block-update steps (the ppermute
+    is replaced by identity — same memory profile, no second chip needed).
+    ``plain`` materialises the full (B, H, T, T) score matrix. Each variant
+    runs in its own subprocess because peak_bytes_in_use is monotonic.
+    Prints one JSON line."""
+    import jax
+
+    if os.environ.get("DL4J_RING_MEM_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from deeplearning4j_tpu.parallel.ring import (_block_attn_update,
+                                                  _plain_attention)
+
+    dev = jax.devices()[0]
+    dtype = jnp.bfloat16 if dev.platform != "cpu" else jnp.float32
+    rng = np.random.default_rng(0)
+    out = {"kind": kind, "seq": T, "devices": P, "heads": heads, "dim": dim,
+           "platform": dev.platform, "dtype": str(dtype.__name__)}
+    try:
+        if kind == "ring_chip":
+            tl = T // P
+            q = jnp.asarray(rng.normal(size=(1, tl, heads, dim)), dtype)
+            k = jnp.asarray(rng.normal(size=(1, tl, heads, dim)), dtype)
+            v = jnp.asarray(rng.normal(size=(1, tl, heads, dim)), dtype)
+            scale = 1.0 / np.sqrt(dim)
+
+            def local(q, k, v):
+                m0 = jnp.full((1, heads, tl), -jnp.inf, jnp.float32)
+                l0 = jnp.zeros((1, heads, tl), jnp.float32)
+                o0 = jnp.zeros((1, tl, heads, dim), jnp.float32)
+
+                def body(i, carry):
+                    k_blk, v_blk, m, l, o = carry
+                    m, l, o = _block_attn_update(
+                        q, k_blk, v_blk, m, l, o, 0, i * tl, False, scale)
+                    return k_blk, v_blk, m, l, o
+
+                _, _, m, l, o = lax.fori_loop(0, P, body, (k, v, m0, l0, o0))
+                return (o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+                        ).astype(q.dtype)
+
+            r = jax.block_until_ready(jax.jit(local)(q, k, v))
+        else:
+            q = jnp.asarray(rng.normal(size=(1, T, heads, dim)), dtype)
+            k = jnp.asarray(rng.normal(size=(1, T, heads, dim)), dtype)
+            v = jnp.asarray(rng.normal(size=(1, T, heads, dim)), dtype)
+            r = jax.block_until_ready(jax.jit(
+                lambda a, b, c: _plain_attention(a, b, c, causal=False)
+            )(q, k, v))
+        del r
+        out["ok"] = True
+    except Exception as e:
+        msg = str(e)
+        out["ok"] = False
+        out["oom"] = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                      or "out of memory" in msg)
+        out["error"] = msg[:300]
+    stats = dev.memory_stats() or {}
+    out["peak_bytes_in_use"] = stats.get("peak_bytes_in_use")
+    out["peak_mib"] = (round(stats["peak_bytes_in_use"] / 2**20, 1)
+                       if stats.get("peak_bytes_in_use") else None)
+    print(json.dumps(out), flush=True)
+
+
+def run_memory_sweep(args):
+    """Per-chip HBM telemetry: ring participant vs plain at each T, each in
+    a fresh subprocess (monotonic peak counter; OOM must not kill the sweep).
+    """
+    for T in args.seqs:
+        for kind in ("ring_chip", "plain"):
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--memory-worker", kind, str(T), str(args.devices),
+                   str(args.heads), str(args.dim)]
+            env = dict(os.environ)
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            # APPEND, never replace: the axon sitecustomize dir must stay
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            if not args.tpu:
+                # same convention as the timing matrix: CPU unless --tpu
+                env["DL4J_RING_MEM_FORCE_CPU"] = "1"
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=600, env=env)
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"kind": kind, "seq": T, "ok": False,
+                                  "error": "timeout 600s"}))
+                continue
+            line = [ln for ln in (r.stdout or "").splitlines()
+                    if ln.startswith("{")]
+            if line:
+                print(line[-1], flush=True)
+            else:
+                # a hard OOM can kill the process before the JSON prints —
+                # that IS the boundary measurement; record it
+                print(json.dumps({
+                    "kind": kind, "seq": T, "ok": False,
+                    "oom_process_killed": True, "rc": r.returncode,
+                    "stderr_tail": (r.stderr or "")[-300:]}), flush=True)
 
 
 def main():
@@ -37,7 +148,21 @@ def main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--memory", action="store_true",
+                    help="per-chip peak-HBM sweep (ring participant vs "
+                         "plain) instead of the timing matrix")
+    ap.add_argument("--memory-worker", nargs=5, metavar=("KIND", "T", "P",
+                                                         "HEADS", "DIM"),
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.memory_worker:
+        kind, T, P, heads, dim = args.memory_worker
+        _memory_worker(kind, int(T), int(P), int(heads), int(dim))
+        return
+    if args.memory:
+        run_memory_sweep(args)
+        return
 
     if not args.tpu:
         import jax

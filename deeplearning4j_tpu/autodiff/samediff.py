@@ -732,15 +732,39 @@ class SameDiff:
                       attrs)
         self._ops.append(node)
         # shape inference via eval_shape over abstract inputs
+        dtype_only = False
         try:
             in_avals = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in inputs]
             out_aval = jax.eval_shape(lambda *xs: opdef.fn(*xs, **attrs), *in_avals)
         except Exception:
             out_aval = None
+            # dtype-only retry: dims unknown (None) block eval_shape, but
+            # the output DTYPE is still inferable by substituting a dummy
+            # extent — without this, any op downstream of a dynamic-dim
+            # placeholder silently defaulted to float32 (e.g. a bool loop
+            # condition became f32 and failed while_loop's type check)
+            if inputs and all(v.shape is not None for v in inputs):
+                try:
+                    in_avals = [jax.ShapeDtypeStruct(
+                        tuple(2 if d is None else int(d) for d in v.shape),
+                        v.dtype) for v in inputs]
+                    out_aval = jax.eval_shape(
+                        lambda *xs: opdef.fn(*xs, **attrs), *in_avals)
+                    dtype_only = True
+                except Exception:
+                    out_aval = None
         outs = []
         for i, on in enumerate(out_names):
             if out_aval is None:
                 shape, dtype = None, jnp.float32
+            elif dtype_only:
+                # extents from the dummy pass are NOT trustworthy, but the
+                # RANK is — keep (None,)*rank so the next consumer's retry
+                # gate (`shape is not None`) still fires and dtype keeps
+                # flowing through chained ops
+                aval = out_aval if n_out == 1 else out_aval[i]
+                shape = (None,) * len(aval.shape)
+                dtype = aval.dtype
             elif n_out == 1:
                 shape, dtype = out_aval.shape, out_aval.dtype
             else:
@@ -1238,21 +1262,25 @@ class SameDiff:
             data = list(data)
 
         trainable = self.trainable_names()
+        # rebuild when the graph (trainable set / loss set) or the training
+        # config changes; batch-shape changes hit jax.jit's own signature
+        # cache and must NOT reset optimizer state. The signature is hashed
+        # once per fit() call, not per batch — the graph cannot change
+        # mid-loop, and json.dumps of the config per step is measurable
+        # host overhead on large imported graphs (BERT-base: ~600 values)
+        sig = (tuple(trainable), tuple(self._loss_variables),
+               json.dumps(tc.to_dict(), sort_keys=True, default=str))
+        if self._train_step is None or self._train_sig != sig:
+            self._train_step, self._opt_state = self._build_train_step(sig)
+            self._train_sig = sig
+        train_set = set(trainable)
+        fixed_vals = {n: v for n, v in self._values.items()
+                      if n not in train_set}
         for epoch in range(epochs):
             if epoch > 0 and hasattr(data, "reset"):
                 data.reset()
             for ph in batches():
-                # rebuild when the graph (trainable set / loss set) or the
-                # training config changes; batch-shape changes hit jax.jit's
-                # own signature cache and must NOT reset optimizer state
-                sig = (tuple(trainable), tuple(self._loss_variables),
-                       json.dumps(tc.to_dict(), sort_keys=True, default=str))
-                if self._train_step is None or self._train_sig != sig:
-                    self._train_step, self._opt_state = self._build_train_step(sig)
-                    self._train_sig = sig
                 train_vals = {n: self._values[n] for n in trainable}
-                fixed_vals = {n: v for n, v in self._values.items()
-                              if n not in train_vals}
                 train_vals, self._opt_state, loss = self._train_step(
                     train_vals, fixed_vals, self._opt_state, ph,
                     rng_seed + self.iteration_count)

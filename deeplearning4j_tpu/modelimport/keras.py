@@ -271,6 +271,15 @@ def _map_layer(cls: str, cfg: dict):
         if _padding(cfg) not in (0, (0, 0), "valid", "VALID"):
             raise UnsupportedKerasConfigurationException(
                 f"{cls}: only 'valid' padding")
+        if int(cfg.get("implementation", 1)) != 1:
+            # implementation=2/3 store the kernel in a permuted axis order
+            # with the same element count — a silent np.reshape onto our
+            # (positions, kh*kw*in, filters) layout would load permuted
+            # weights and produce wrong outputs
+            raise UnsupportedKerasConfigurationException(
+                f"{cls}: only implementation=1 kernels are importable "
+                f"(got implementation={cfg.get('implementation')}; "
+                f"re-save the model with implementation=1)")
         if cls == "LocallyConnected2D":
             return L.LocallyConnected2D(
                 name=name, n_out=cfg["filters"],

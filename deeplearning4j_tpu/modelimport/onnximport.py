@@ -883,11 +883,17 @@ class OnnxGraphMapper:
             if vi["name"] in ctx.vars:
                 continue                   # initializer re-listed as input
             tt = (vi.get("type") or {}).get("tensor_type") or {}
-            dims = [(int(d["dim_value"]) if "dim_value" in d else None)
-                    for d in (tt.get("shape") or {}).get("dim", [])]
+            shape_msg = tt.get("shape")
+            if shape_msg is None:
+                shape = None               # no shape field: truly unknown
+            else:
+                # empty dim list = a SCALAR (shape ()), not unknown —
+                # collapsing () to None loses the rank and downstream
+                # dtype inference (eval_shape can't run on shape None)
+                shape = tuple(int(d["dim_value"]) if "dim_value" in d
+                              else None for d in shape_msg.get("dim", []))
             dt = op_.onnx_dtype(tt.get("elem_type", 1))
-            ctx.vars[vi["name"]] = sd.placeholder(vi["name"],
-                                                  tuple(dims) or None, dt)
+            ctx.vars[vi["name"]] = sd.placeholder(vi["name"], shape, dt)
         _walk_nodes(ctx, graph)
         return sd
 
@@ -1031,6 +1037,11 @@ def _register_onnx_rules_t3():
         else:
             raise ONNXImportError("Upsample needs scales")
         shape = inputs[0].shape
+        if len(shape) != 4 or len(scales) != 4:
+            raise ONNXImportError(
+                f"Upsample: only 4-D NCHW is supported (got rank "
+                f"{len(shape)} input, {len(scales)} scales); use Resize "
+                f"for other ranks")
         out_h = int(shape[2] * scales[2])
         out_w = int(shape[3] * scales[3])
         op = {"nearest": "resize_nearest_neighbor",
@@ -1286,6 +1297,35 @@ def _register_onnx_rules_t3():
         outs = _subgraph_body(ctx, graph, seed_names)(tmp, *args)
         return list(outs) if isinstance(outs, (list, tuple)) else [outs]
 
+    def _loop_cond_statically_true(ctx, body_g, cond_name):
+        """True when the Loop can provably never exit early: the initial
+        cond is a constant True AND the body's cond_out is the cond input
+        (or a constant True) threaded through Identity nodes — the pattern
+        for-loop exporters emit."""
+        init = ctx.consts.get(cond_name)
+        if init is None or not bool(np.asarray(init).reshape(())):
+            return False
+        b_inputs = [vi["name"] for vi in body_g.get("input", [])]
+        cond_in = b_inputs[1] if len(b_inputs) > 1 else None
+        outs = body_g.get("output", [])
+        if not outs:
+            return False
+        src = outs[0]["name"]
+        producers = {o: n for n in body_g.get("node", [])
+                     for o in n.get("output", [])}
+        for _ in range(64):                # follow the Identity chain
+            if src == cond_in:
+                return True
+            for init_t in body_g.get("initializer", []):
+                if init_t["name"] == src:
+                    return bool(np.asarray(
+                        op_.tensor_to_np(init_t)).reshape(()))
+            n = producers.get(src)
+            if n is None or n.get("op_type") not in ("Identity", "Cast"):
+                return False
+            src = n["input"][0]
+        return False
+
     @onnx_rule("Loop")
     def _loop(ctx, node, inputs, attrs):
         body_g = attrs["body"]
@@ -1319,7 +1359,22 @@ def _register_onnx_rules_t3():
             # If the body's cond_out goes false before M trips (dynamic
             # early exit), the remaining rows stay zero — a documented
             # divergence from ONNX's true-length scan output, which cannot
-            # exist under static shapes
+            # exist under static shapes. Surfaced at import time (not just
+            # here): consumers that rely on the true-length semantics must
+            # mask the tail rows themselves. NOT warned for the ubiquitous
+            # for-loop export pattern (constant-true cond threaded through
+            # unchanged) where early exit is statically impossible.
+            if cond_name and not _loop_cond_statically_true(
+                    ctx, body_g, cond_name):
+                import warnings
+
+                warnings.warn(
+                    f"ONNX Loop {node.get('name') or ''!r}: scan outputs "
+                    f"are padded to the static trip count M={trip_max}; on "
+                    f"dynamic early exit the tail rows are ZEROS, not "
+                    f"truncated as ONNX specifies. Mask them using the "
+                    f"final iteration count if your consumer depends on "
+                    f"true-length scan outputs.", stacklevel=2)
             tmpl = _pretrace_outputs(ctx, body_g, seeds,
                                      [i0, c0, *carried, *cap_vars])
             for t in tmpl[1 + n_car:]:

@@ -54,6 +54,12 @@ class DepthwiseConvolution2D(_ConvBase):
         super().set_n_in(input_type)
         if self.n_out is None:
             self.n_out = self.n_in * self.depth_multiplier
+        elif self.n_out != self.n_in * self.depth_multiplier:
+            raise ValueError(
+                f"DepthwiseConvolution2D: nOut={self.n_out} inconsistent "
+                f"with nIn*depthMultiplier="
+                f"{self.n_in * self.depth_multiplier} (depthwise output "
+                f"channels are structural, not configurable)")
 
     def output_type(self, input_type: InputType) -> InputType:
         h, w = self._spatial_out(input_type)

@@ -228,8 +228,12 @@ class LastTimeStepVertex(GraphVertex):
         x = inputs[0]
         if mask is None:
             return x[:, -1]
-        last = jnp.maximum(jnp.sum(jnp.asarray(mask), axis=1)
-                           .astype(jnp.int32) - 1, 0)
+        # last index where mask==1 (NOT sum-1: masks with interior gaps,
+        # e.g. [1,0,1,0], must pick index 2 like the reference does)
+        m = jnp.asarray(mask)
+        T = x.shape[1]
+        last = T - 1 - jnp.argmax(m[:, ::-1] > 0, axis=1).astype(jnp.int32)
+        last = jnp.where(jnp.sum(m, axis=1) > 0, last, 0)  # all-zero rows
         return x[jnp.arange(x.shape[0]), last]
 
     def output_type(self, input_types):

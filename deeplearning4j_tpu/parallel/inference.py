@@ -13,6 +13,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from typing import List, Optional
 
 import numpy as np
@@ -35,7 +36,14 @@ class _Request:
 
 class ParallelInference:
     """ref API: ParallelInference.Builder(model).inferenceMode(...)
-    .batchLimit(n).queueLimit(n).build(); output(x)."""
+    .batchLimit(n).queueLimit(n).build(); output(x).
+
+    Instances own a serve thread (BATCHED mode); call :meth:`shutdown` (or
+    use as a context manager) when done. :meth:`shutdown_all` stops every
+    live instance — the test harness's safety net against leaked serve
+    threads keeping the process's jit caches and buffers alive."""
+
+    _live = weakref.WeakSet()
 
     def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
@@ -66,6 +74,20 @@ class ParallelInference:
             self._worker = threading.Thread(target=self._serve_loop,
                                             daemon=True)
             self._worker.start()
+        ParallelInference._live.add(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    @classmethod
+    def shutdown_all(cls):
+        """Stop every live instance's serve thread (test-harness teardown)."""
+        for pi in list(cls._live):
+            pi.shutdown()
 
     class Builder:
         def __init__(self, model):

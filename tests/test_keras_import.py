@@ -31,6 +31,21 @@ def test_sequential_dense(tmp_path):
     assert np.allclose(got, expected, atol=1e-5)
 
 
+def test_locally_connected_implementation_2_rejected():
+    """implementation=2/3 kernels are stored in a permuted axis order with
+    the same element count — a silent reshape would load permuted weights
+    (ADVICE r3 medium). The importer must refuse loudly."""
+    from deeplearning4j_tpu.modelimport.keras import (
+        UnsupportedKerasConfigurationException, _map_layer)
+    cfg = {"filters": 4, "kernel_size": [2, 2], "padding": "valid",
+           "implementation": 2}
+    with pytest.raises(UnsupportedKerasConfigurationException,
+                       match="implementation"):
+        _map_layer("LocallyConnected2D", cfg)
+    cfg["implementation"] = 1
+    assert _map_layer("LocallyConnected2D", cfg) is not None
+
+
 def test_sequential_cnn_with_bn(tmp_path):
     m = tf.keras.Sequential([
         tf.keras.Input((12, 12, 3)),

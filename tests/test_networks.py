@@ -700,3 +700,24 @@ def test_last_time_step_vertex_masked():
     # unmasked: plain last step
     out2 = LastTimeStepVertex().apply([x])
     np.testing.assert_array_equal(np.asarray(out2), np.asarray(x[:, -1]))
+    # interior-gap mask [1,0,1,0]: the last index where mask==1 is 2 —
+    # NOT sum(mask)-1 == 1 (the reference scans for the last set index);
+    # all-zero rows fall back to index 0
+    gap = jnp.asarray([[1, 0, 1, 0], [0, 0, 0, 0]], jnp.float32)
+    out3 = LastTimeStepVertex().apply([x], mask=gap)
+    np.testing.assert_array_equal(np.asarray(out3[0]), np.asarray(x[0, 2]))
+    np.testing.assert_array_equal(np.asarray(out3[1]), np.asarray(x[1, 0]))
+
+
+def test_depthwise_conv_rejects_inconsistent_n_out():
+    """An explicit nOut != nIn*depthMultiplier must raise, not silently
+    report a different output type than the conv actually produces."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers2 import DepthwiseConvolution2D
+    lyr = DepthwiseConvolution2D(kernel_size=(3, 3), depth_multiplier=2,
+                                 n_out=5)
+    with pytest.raises(ValueError, match="depthMultiplier"):
+        lyr.set_n_in(InputType.convolutional(8, 8, 2))
+    ok = DepthwiseConvolution2D(kernel_size=(3, 3), depth_multiplier=2)
+    ok.set_n_in(InputType.convolutional(8, 8, 2))
+    assert ok.n_out == 4

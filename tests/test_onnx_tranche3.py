@@ -199,6 +199,17 @@ class TestMiscT3:
                              mode="nearest").numpy()
         np.testing.assert_allclose(y, ref)
 
+    def test_upsample_non_4d_raises_informative(self):
+        x = np.arange(8, dtype=F32).reshape(1, 2, 4)     # 3-D NCW
+        scales = np.asarray([1, 1, 2], F32)
+        g = P.make_graph(
+            [P.make_node("Upsample", ["x", "s"], ["y"], mode="nearest")],
+            "g", [P.make_value_info("x", F32, x.shape)],
+            [P.make_value_info("y", F32, (1, 2, 8))],
+            initializers=[P.make_tensor("s", scales)])
+        with pytest.raises(ONNXImportError, match="4-D NCHW"):
+            _run(P.make_model(g), {"x": x}, ["y"])
+
     def test_scatter_deprecated_alias(self):
         x = np.zeros((3, 3), F32)
         idx = np.array([[0, 1, 2]], np.int64)
@@ -347,6 +358,33 @@ class TestControlFlow:
         vf, sc = _run(P.make_model(g), {"v0": v0}, ["vf", "sc"])
         np.testing.assert_allclose(vf, v0 * 8)
         np.testing.assert_allclose(sc, np.stack([v0 * 2, v0 * 4, v0 * 8]))
+
+    def test_loop_dynamic_cond_scan_warns_about_zero_tail(self):
+        """M + dynamic cond + scan outputs: imports, but warns that on
+        early exit the tail rows are zeros (ADVICE r3: the divergence must
+        surface at runtime, not live only in a code comment)."""
+        body = P.make_graph(
+            [P.make_node("Identity", ["cond_in"], ["cond_out"]),
+             P.make_node("Mul", ["v_in", "two"], ["v_out"]),
+             P.make_node("Identity", ["v_out"], ["scan0"])],
+            "body",
+            [P.make_value_info("iter", np.int64, ()),
+             P.make_value_info("cond_in", np.bool_, ()),
+             P.make_value_info("v_in", F32, (2,))],
+            [P.make_value_info("cond_out", np.bool_, ()),
+             P.make_value_info("v_out", F32, (2,)),
+             P.make_value_info("scan0", F32, (2,))],
+            initializers=[P.make_tensor("two", np.asarray(2.0, F32))])
+        g = P.make_graph(
+            [P.make_node("Loop", ["M", "c0", "v0"], ["vf", "sc"],
+                         body=body)],
+            "g", [P.make_value_info("v0", F32, (2,)),
+                  P.make_value_info("c0", np.bool_, ())],
+            [P.make_value_info("vf", F32, (2,)),
+             P.make_value_info("sc", F32, (3, 2))],
+            initializers=[P.make_tensor("M", np.asarray(3, np.int64))])
+        with pytest.warns(UserWarning, match="tail rows are ZEROS"):
+            OnnxGraphMapper.import_model(P.make_model(g))
 
     def test_scan_cumulative_sum(self):
         # classic Scan: state = state + elem; scan out each new state

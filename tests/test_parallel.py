@@ -108,9 +108,9 @@ class TestFacades:
 
     def test_parallel_inference_pads_ragged_batch(self):
         net = MultiLayerNetwork(_mlp_conf()).init()
-        pi = ParallelInference(net, workers=8)
-        x, _ = _data(13)  # not divisible by 8
-        out = pi.output(x)
+        with ParallelInference(net, workers=8) as pi:
+            x, _ = _data(13)  # not divisible by 8
+            out = pi.output(x)
         assert out.shape[0] == 13
 
 

@@ -271,6 +271,19 @@ class TestControlFlow:
         assert np.allclose(pos, [2., 4., 6.])
         assert np.allclose(neg, [-2., -3., -4.])
 
+    def test_dynamic_dim_placeholder_keeps_dtype_through_chain(self):
+        """Ops downstream of a dynamic-dim placeholder must infer their
+        DTYPE (and rank) even though extents are unknown — a bool loop
+        condition built from chained ops used to silently default to f32
+        and fail while_loop's type check (round-4 Loop-import bug)."""
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (None, 4), np.float32)
+        a = sd._op("less", x, sd.constant(np.float32(0.0)))
+        b = sd._op("boolean_and", a, a)          # one op DEEPER than x
+        assert np.dtype(a.dtype) == np.bool_
+        assert np.dtype(b.dtype) == np.bool_
+        assert len(b.shape) == 2 and b.shape[0] is None
+
     def test_if_cond_shape_mismatch_raises(self):
         sd = SameDiff.create()
         x = sd.placeholder("x", (3,), np.float32)
