@@ -235,9 +235,22 @@ register("mergemaxindex",
 def _unsorted(reducer, init):
     def op(data, segment_ids, num_segments=None):
         n = int(num_segments)
-        out = jnp.full((n,) + data.shape[1:], init, data.dtype)
+        ini = init(np.dtype(data.dtype)) if callable(init) else init
+        out = jnp.full((n,) + data.shape[1:], ini, data.dtype)
         return reducer(out.at[segment_ids], data)
     return op
+
+
+def _dtype_min(dt):
+    # TF fills EMPTY segments of unsorted_segment_max with dtype.min
+    # (finite -3.4e38 for f32), NOT -inf — verified divergence, r3 verdict
+    return np.finfo(dt).min if np.issubdtype(dt, np.floating) \
+        else np.iinfo(dt).min
+
+
+def _dtype_max(dt):
+    return np.finfo(dt).max if np.issubdtype(dt, np.floating) \
+        else np.iinfo(dt).max
 
 
 register("unsorted_segment_sum",
@@ -245,10 +258,10 @@ register("unsorted_segment_sum",
          jnp.zeros((int(num_segments),) + d.shape[1:], d.dtype)
          .at[i].add(d), aliases=["UnsortedSegmentSum"])
 register("unsorted_segment_max",
-         _unsorted(lambda at, d: at.max(d), -jnp.inf),
+         _unsorted(lambda at, d: at.max(d), _dtype_min),
          aliases=["UnsortedSegmentMax"])
 register("unsorted_segment_min",
-         _unsorted(lambda at, d: at.min(d), jnp.inf),
+         _unsorted(lambda at, d: at.min(d), _dtype_max),
          aliases=["UnsortedSegmentMin"])
 register("unsorted_segment_prod",
          _unsorted(lambda at, d: at.multiply(d), 1),

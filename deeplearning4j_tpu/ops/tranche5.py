@@ -173,7 +173,10 @@ register("dot_product", lambda x, y: jnp.sum(x * y))
 
 
 # -------------------------------------------------------------- arithmetic
-register("floormod", lambda x, y: x - jnp.floor(x / y) * y)
+# jnp.mod IS floor-mod (result sign follows divisor) and preserves integer
+# dtypes; the previous x - floor(x/y)*y promoted int32 inputs to f32
+# (conformance-sweep finding vs tf.math.floormod)
+register("floormod", jnp.mod)
 register("remainder", jnp.remainder)
 register("realdiv", lambda x, y: x / y, aliases=["RealDiv"])
 register("truncatediv", lambda x, y: jnp.trunc(x / y).astype(x.dtype),

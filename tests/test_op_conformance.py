@@ -1,0 +1,581 @@
+"""Registry-wide op conformance sweep against live TF / torch twins.
+
+VERDICT r3 #4: the TF corpus gate covers importer *rules*; this sweep
+exercises the OP REGISTRY's edge semantics directly against the reference
+ecosystem (live tensorflow, torch where TF lacks the op, numpy where numpy
+IS the ecosystem twin, e.g. FFT). Focus is the edge inputs where silent
+divergence hides: empty segments, NaN propagation through min/max, ties in
+argmax/topk, banker's rounding, negative operands in integer div/mod,
+asymmetric SAME padding, exclusive/reverse cumulations, int dtypes.
+
+The gate test at the bottom counts DISTINCT registry ops exercised here and
+fails if the sweep shrinks (ref: SURVEY §4 conformance rows,
+`ops/declarable/generic/**` semantics).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.registry import exec_op, names as registry_names
+
+tf = pytest.importorskip("tensorflow")
+
+F32 = np.float32
+I32 = np.int32
+NAN = np.float32("nan")
+
+
+def _t(fn, *args, **kw):
+    """Run a tf callable and return numpy."""
+    r = fn(*args, **kw)
+    if isinstance(r, (list, tuple)):
+        return [np.asarray(x) for x in r]
+    return np.asarray(r)
+
+
+# Each case: (id, op, args, attrs, twin_fn, kwargs-for-compare)
+# twin_fn receives the SAME positional numpy args.
+CASES = []
+
+
+def case(id, op, args, attrs, twin, rtol=1e-5, atol=1e-6, out=0,
+         dtype_strict=True):
+    CASES.append((id, op, args, attrs, twin, rtol, atol, out, dtype_strict))
+
+
+rng = np.random.default_rng(0)
+x34 = rng.normal(size=(3, 4)).astype(F32)
+xpos = (np.abs(x34) + 0.1).astype(F32)
+xunit = np.clip(x34 * 0.3, -0.95, 0.95).astype(F32)
+xn = np.array([1.0, NAN, -2.0, NAN, 3.0], F32)
+yn = np.array([NAN, 2.0, -3.0, 1.0, NAN], F32)
+ints = np.array([-7, -3, -1, 1, 3, 7], I32)
+intd = np.array([2, -2, 3, -3, 2, -2], I32)
+
+# ---- unary elementwise (NaN must propagate; dtype preserved) -------------
+for nm, twin in [
+    ("abs", tf.abs), ("neg", lambda x: -x), ("exp", tf.exp),
+    ("log", tf.math.log), ("log1p", tf.math.log1p),
+    ("expm1", tf.math.expm1), ("sqrt", tf.sqrt), ("rsqrt", tf.math.rsqrt),
+    ("square", tf.square), ("reciprocal", tf.math.reciprocal),
+    ("sign", tf.sign), ("floor", tf.floor), ("ceil", tf.math.ceil),
+    ("sigmoid", tf.sigmoid), ("tanh", tf.tanh),
+    ("softplus", tf.math.softplus), ("softsign", tf.math.softsign),
+    ("erf", tf.math.erf), ("erfc", tf.math.erfc),
+    ("lgamma", tf.math.lgamma), ("digamma", tf.math.digamma),
+    ("sin", tf.sin), ("cos", tf.cos), ("tan", tf.tan),
+    ("sinh", tf.sinh), ("cosh", tf.cosh),
+    ("log_sigmoid", tf.math.log_sigmoid),
+    ("bessel... skip", None),
+]:
+    if twin is None:
+        continue
+    case(f"{nm}_pos", nm, (xpos,), {}, lambda x, t=twin: _t(t, x))
+for nm, twin in [("asin", tf.asin), ("acos", tf.acos), ("atan", tf.atan),
+                 ("atanh", tf.atanh), ("asinh", tf.asinh)]:
+    case(f"{nm}_unit", nm, (xunit,), {}, lambda x, t=twin: _t(t, x))
+case("acosh", "acosh", ((np.abs(x34) + 1.1).astype(F32),), {},
+     lambda x: _t(tf.acosh, x))
+case("exp_nan", "exp", (xn,), {}, lambda x: _t(tf.exp, x))
+case("tanh_nan", "tanh", (xn,), {}, lambda x: _t(tf.tanh, x))
+case("rint_ties_to_even", "rint",
+     (np.array([0.5, 1.5, 2.5, -0.5, -1.5, 3.5], F32),), {},
+     lambda x: _t(tf.math.rint, x))
+case("round_ties_to_even", "round",
+     (np.array([0.5, 1.5, 2.5, -0.5, -1.5, 3.5], F32),), {},
+     lambda x: _t(tf.round, x))
+case("trunc", "trunc", (np.array([1.7, -1.7, 0.3, -0.3], F32),), {},
+     lambda x: np.trunc(x))
+case("relu", "relu", (xn,), {}, lambda x: _t(tf.nn.relu, x))
+case("relu6", "relu6", (np.array([-1., 3., 7., 6.], F32),), {},
+     lambda x: _t(tf.nn.relu6, x))
+case("elu", "elu", (x34,), {}, lambda x: _t(tf.nn.elu, x))
+case("selu", "selu", (x34,), {}, lambda x: _t(tf.nn.selu, x))
+case("gelu", "gelu", (x34,), {},
+     lambda x: _t(tf.nn.gelu, x, approximate=True), rtol=1e-4, atol=1e-5)
+case("swish", "swish", (x34,), {}, lambda x: _t(tf.nn.silu, x))
+case("leakyrelu", "leakyrelu", (x34,), {"alpha": 0.2},
+     lambda x: _t(tf.nn.leaky_relu, x, alpha=0.2))
+
+# ---- binary + int/negative edge semantics --------------------------------
+case("add", "add", (x34, x34[0]), {}, lambda a, b: _t(tf.add, a, b))
+case("sub", "sub", (x34, x34[0]), {}, lambda a, b: _t(tf.subtract, a, b))
+case("mul", "mul", (x34, x34[0]), {}, lambda a, b: _t(tf.multiply, a, b))
+case("div_f32", "div", (x34, xpos),
+     {}, lambda a, b: _t(tf.divide, a, b))
+case("realdiv", "realdiv", (x34, xpos), {},
+     lambda a, b: _t(tf.realdiv, a, b))
+case("floordiv_neg_int", "floordiv", (ints, intd), {},
+     lambda a, b: _t(tf.math.floordiv, a, b))
+case("floormod_neg_int", "floormod", (ints, intd), {},
+     lambda a, b: _t(tf.math.floormod, a, b))
+case("mod_neg_int", "mod", (ints, intd), {},
+     lambda a, b: _t(tf.math.mod, a, b))
+case("truncatediv_neg_int", "truncatediv", (ints, intd), {},
+     lambda a, b: _t(tf.truncatediv, a, b))
+case("truncatemod_neg_int", "truncatemod", (ints, intd), {},
+     lambda a, b: _t(tf.truncatemod, a, b))
+case("pow", "pow", (xpos, x34), {}, lambda a, b: _t(tf.pow, a, b),
+     rtol=1e-4)
+case("maximum_nan", "maximum", (xn, yn), {},
+     lambda a, b: _t(tf.maximum, a, b))
+case("minimum_nan", "minimum", (xn, yn), {},
+     lambda a, b: _t(tf.minimum, a, b))
+case("squaredsubtract", "squaredsubtract", (x34, x34[0]), {},
+     lambda a, b: _t(tf.math.squared_difference, a, b))
+case("atan2", "atan2", (x34, x34[0] + 0.01), {},
+     lambda a, b: _t(tf.atan2, a, b))
+case("divide_no_nan", "divide_no_nan",
+     (x34, np.array([1., 0., 2., 0.], F32)), {},
+     lambda a, b: _t(tf.math.divide_no_nan, a, b))
+case("igamma", "igamma", (xpos, xpos.T.reshape(3, 4) + 0.2), {},
+     lambda a, b: _t(tf.math.igamma, a, b), rtol=1e-4)
+case("igammac", "igammac", (xpos, xpos.T.reshape(3, 4) + 0.2), {},
+     lambda a, b: _t(tf.math.igammac, a, b), rtol=1e-4)
+case("zeta", "zeta", (xpos + 1.5, xpos), {},
+     lambda a, b: _t(tf.math.zeta, a, b), rtol=1e-4)
+case("polygamma", "polygamma",
+     (np.array([1., 2., 3.], F32), np.array([0.5, 1.5, 2.5], F32)), {},
+     lambda a, b: _t(tf.math.polygamma, a, b), rtol=1e-4)
+case("betainc", "betainc",
+     (xpos[0], xpos[1], np.clip(xpos[2], 0.05, 0.95)), {},
+     lambda a, b, x: _t(tf.math.betainc, a, b, x), rtol=1e-4)
+case("xlogy... skip", "hypot",
+     (np.array([3., -5.], F32), np.array([4., 12.], F32)), {},
+     lambda a, b: np.hypot(a, b))
+
+# ---- comparisons / logical (NaN compares false; != compares true) --------
+case("less_nan", "less", (xn, yn), {}, lambda a, b: _t(tf.less, a, b))
+case("less_equal_nan", "less_equal", (xn, yn), {},
+     lambda a, b: _t(tf.less_equal, a, b))
+case("greater_nan", "greater", (xn, yn), {},
+     lambda a, b: _t(tf.greater, a, b))
+case("greater_equal_nan", "greater_equal", (xn, yn), {},
+     lambda a, b: _t(tf.greater_equal, a, b))
+case("equals_nan", "equals", (xn, xn), {}, lambda a, b: _t(tf.equal, a, b))
+case("not_equals_nan", "not_equals", (xn, xn), {},
+     lambda a, b: _t(tf.not_equal, a, b))
+bools = np.array([True, True, False, False])
+bools2 = np.array([True, False, True, False])
+case("boolean_and", "boolean_and", (bools, bools2), {},
+     lambda a, b: _t(tf.logical_and, a, b))
+case("boolean_or", "boolean_or", (bools, bools2), {},
+     lambda a, b: _t(tf.logical_or, a, b))
+case("boolean_xor", "boolean_xor", (bools, bools2), {},
+     lambda a, b: _t(tf.math.logical_xor, a, b))
+case("boolean_not", "boolean_not", (bools,), {},
+     lambda a: _t(tf.logical_not, a))
+case("isclose", "isclose", (xn, yn), {},
+     lambda a, b: np.isclose(a, b), dtype_strict=False)
+case("isnan", "isnan", (xn,), {}, lambda x: _t(tf.math.is_nan, x))
+case("isinf", "isinf", (np.array([1., np.inf, -np.inf, NAN], F32),), {},
+     lambda x: _t(tf.math.is_inf, x))
+case("isfinite", "isfinite", (np.array([1., np.inf, -np.inf, NAN], F32),),
+     {}, lambda x: _t(tf.math.is_finite, x))
+
+# ---- bitwise -------------------------------------------------------------
+ia = np.array([0b1100, 0b1010, -5, 255], I32)
+ib = np.array([0b1010, 0b0110, 3, 7], I32)
+case("bitwise_and", "bitwise_and", (ia, ib), {},
+     lambda a, b: _t(tf.bitwise.bitwise_and, a, b))
+case("bitwise_or", "bitwise_or", (ia, ib), {},
+     lambda a, b: _t(tf.bitwise.bitwise_or, a, b))
+case("bitwise_xor", "bitwise_xor", (ia, ib), {},
+     lambda a, b: _t(tf.bitwise.bitwise_xor, a, b))
+case("rshift_bits_neg", "rshift_bits", (ia, ib % 8), {},
+     lambda a, b: _t(tf.bitwise.right_shift, a, b))
+case("shift_bits", "shift_bits", (ia, ib % 8), {},
+     lambda a, b: _t(tf.bitwise.left_shift, a, b))
+case("invert_permutation", "invert_permutation",
+     (np.array([3, 0, 2, 1], I32),), {},
+     lambda p: _t(tf.math.invert_permutation, p))
+
+# ---- reductions ----------------------------------------------------------
+xr = rng.normal(size=(2, 3, 4)).astype(F32)
+case("reduce_sum_axis", "reduce_sum", (xr,), {"axis": 1},
+     lambda x: _t(tf.reduce_sum, x, axis=1), rtol=1e-5)
+case("reduce_sum_keepdims", "reduce_sum", (xr,),
+     {"axis": (0, 2), "keepdims": True},
+     lambda x: _t(tf.reduce_sum, x, axis=(0, 2), keepdims=True))
+case("reduce_mean", "reduce_mean", (xr,), {"axis": -1},
+     lambda x: _t(tf.reduce_mean, x, axis=-1))
+case("reduce_max_nan", "reduce_max", (xn,), {},
+     lambda x: _t(tf.reduce_max, x), dtype_strict=False)
+case("reduce_min_nan", "reduce_min", (xn,), {},
+     lambda x: _t(tf.reduce_min, x), dtype_strict=False)
+case("reduce_prod", "reduce_prod", (xr,), {"axis": 2},
+     lambda x: _t(tf.reduce_prod, x, axis=2))
+case("reduce_any", "reduce_any", (bools.reshape(2, 2),), {"axis": 1},
+     lambda x: _t(tf.reduce_any, x, axis=1))
+case("reduce_all", "reduce_all", (bools.reshape(2, 2),), {"axis": 1},
+     lambda x: _t(tf.reduce_all, x, axis=1))
+case("reduce_logsumexp", "reduce_logsumexp", (xr,), {"axis": 1},
+     lambda x: _t(tf.reduce_logsumexp, x, axis=1), rtol=1e-5)
+case("count_nonzero", "count_nonzero",
+     (np.array([[0., 1., 2.], [0., 0., 3.]], F32),), {},
+     lambda x: _t(tf.math.count_nonzero, x), dtype_strict=False)
+case("argmax_ties_first", "argmax",
+     (np.array([[1., 7., 7., 2.], [5., 5., 1., 5.]], F32),), {"axis": 1},
+     lambda x: _t(tf.argmax, x, axis=1), dtype_strict=False)
+case("argmin_ties_first", "argmin",
+     (np.array([[1., 1., 7., 2.], [5., 0., 0., 5.]], F32),), {"axis": 1},
+     lambda x: _t(tf.argmin, x, axis=1), dtype_strict=False)
+case("cumsum_excl_rev", "cumsum", (x34,),
+     {"axis": 1, "exclusive": True, "reverse": True},
+     lambda x: _t(tf.cumsum, x, axis=1, exclusive=True, reverse=True))
+case("cumprod_excl", "cumprod", (x34,), {"axis": 0, "exclusive": True},
+     lambda x: _t(tf.math.cumprod, x, axis=0, exclusive=True))
+case("moments", "moments", (xr,), {"axes": (0, 1)},
+     lambda x: _t(lambda y: tf.nn.moments(y, axes=[0, 1]), x), out=(0, 1))
+case("l2_loss", "l2_loss", (x34,), {}, lambda x: _t(tf.nn.l2_loss, x))
+case("zero_fraction", "zero_fraction",
+     (np.array([0., 1., 0., 3.], F32),), {},
+     lambda x: _t(tf.math.zero_fraction, x))
+
+# ---- segments (EMPTY SEGMENT FILL is the r3-found divergence) ------------
+seg_d = np.array([1., 2., 3., -4.], F32)
+seg_i = np.array([0, 0, 2, 2])
+seg_int = np.array([5, -2, 7, 1], I32)
+case("unsorted_segment_max_empty", "unsorted_segment_max",
+     (seg_d, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_max, d, i, 4))
+case("unsorted_segment_min_empty", "unsorted_segment_min",
+     (seg_d, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_min, d, i, 4))
+case("unsorted_segment_max_int_empty", "unsorted_segment_max",
+     (seg_int, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_max, d, i, 4))
+case("unsorted_segment_sum_empty", "unsorted_segment_sum",
+     (seg_d, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_sum, d, i, 4))
+case("unsorted_segment_prod_empty", "unsorted_segment_prod",
+     (seg_d, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_prod, d, i, 4))
+case("unsorted_segment_mean_empty", "unsorted_segment_mean",
+     (seg_d, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_mean, d, i, 4))
+case("unsorted_segment_sqrt_n", "unsorted_segment_sqrt_n",
+     (seg_d, seg_i), {"num_segments": 4},
+     lambda d, i: _t(tf.math.unsorted_segment_sqrt_n, d, i, 4))
+case("segment_sum_gap", "segment_sum",
+     (seg_d, np.array([0, 0, 3, 3])), {},
+     lambda d, i: _t(tf.math.segment_sum, d, i))
+case("segment_mean_gap", "segment_mean",
+     (seg_d, np.array([0, 0, 3, 3])), {},
+     lambda d, i: _t(tf.math.segment_mean, d, i))
+case("bincount", "bincount", (np.array([1, 1, 3, 0, 3, 3], I32),), {},
+     lambda x: _t(tf.math.bincount, x), dtype_strict=False)
+
+# ---- padding (asymmetric; reflect vs symmetric) --------------------------
+case("pad_const_asym", "pad", (x34,), {"paddings": ((1, 2), (0, 3)),
+                                       "constant_values": 2.5},
+     lambda x: _t(tf.pad, x, [[1, 2], [0, 3]], constant_values=2.5))
+case("pad_reflect_asym", "pad", (x34,),
+     {"paddings": ((1, 2), (2, 0)), "mode": "REFLECT"},
+     lambda x: _t(tf.pad, x, [[1, 2], [2, 0]], mode="REFLECT"))
+case("pad_symmetric_asym", "pad", (x34,),
+     {"paddings": ((2, 1), (0, 2)), "mode": "SYMMETRIC"},
+     lambda x: _t(tf.pad, x, [[2, 1], [0, 2]], mode="SYMMETRIC"))
+case("mirror_pad_reflect", "mirror_pad", (x34,),
+     {"paddings": [[1, 1], [2, 1]], "mode": "REFLECT"},
+     lambda x: _t(tf.pad, x, [[1, 1], [2, 1]], mode="REFLECT"))
+
+# ---- shape / gather / scatter -------------------------------------------
+case("concat", "concat", (x34, x34), {"axis": 1},
+     lambda a, b: _t(tf.concat, [a, b], axis=1))
+case("stack_neg_axis", "stack", (x34, x34), {"axis": -1},
+     lambda a, b: _t(tf.stack, [a, b], axis=-1))
+case("tile", "tile", (x34,), {"reps": (2, 3)},
+     lambda x: _t(tf.tile, x, [2, 3]))
+case("reverse", "reverse", (xr,), {"axis": (0, 2)},
+     lambda x: _t(tf.reverse, x, axis=[0, 2]))
+case("transpose_perm", "transpose", (xr,), {"perm": (2, 0, 1)},
+     lambda x: _t(tf.transpose, x, perm=[2, 0, 1]))
+case("expand_dims", "expand_dims", (x34,), {"axis": 1},
+     lambda x: _t(tf.expand_dims, x, axis=1))
+case("squeeze_axis", "squeeze", (x34.reshape(3, 1, 4, 1),), {"axis": 1},
+     lambda x: _t(tf.squeeze, x, axis=1))
+case("reshape_minus1", "reshape", (xr,), {"shape": (2, -1)},
+     lambda x: _t(tf.reshape, x, (2, -1)))
+case("gather_axis", "gather", (xr, np.array([2, 0, 2])), {"axis": 2},
+     lambda x, i: _t(tf.gather, x, i, axis=2))
+case("gather_nd", "gather_nd", (xr, np.array([[0, 1], [1, 2]])), {},
+     lambda x, i: _t(tf.gather_nd, x, i))
+case("scatter_nd_dup_adds", "scatter_nd",
+     (np.array([[1], [1], [3]]), np.array([9., 10., 11.], F32)),
+     {"shape": (6,)},
+     lambda i, u: _t(tf.scatter_nd, i, u, [6]))
+case("one_hot_on_off", "one_hot", (np.array([0, 2, 1, 3]),),
+     {"depth": 4, "on_value": 5.0, "off_value": -1.0},
+     lambda i: _t(tf.one_hot, i, 4, on_value=5.0, off_value=-1.0))
+case("one_hot_axis0", "one_hot", (np.array([0, 2, 1]),),
+     {"depth": 3, "axis": 0}, lambda i: _t(tf.one_hot, i, 3, axis=0))
+case("roll", "roll", (x34,), {"shift": (1, -2), "axis": (0, 1)},
+     lambda x: _t(tf.roll, x, [1, -2], [0, 1]))
+case("rot90", "rot90", (x34,), {"k": 3},
+     lambda x: np.rot90(x, k=3))
+case("slice", "slice", (xr,), {"begin": (0, 1, 1), "size": (2, 2, 3)},
+     lambda x: _t(tf.slice, x, [0, 1, 1], [2, 2, 3]))
+case("strided_slice_neg_stride", "strided_slice", (x34,),
+     {"begin": (2, 3), "end": (0, 0), "strides": (-1, -2)},
+     lambda x: x[2:0:-1, 3:0:-2])
+case("broadcast_to", "broadcast_to", (x34[0],), {"shape": (5, 3, 4)},
+     lambda x: _t(tf.broadcast_to, x, [5, 3, 4]))
+case("where_select_nan", "where", (bools[:4].reshape(2, 2),
+                                   xn[:4].reshape(2, 2),
+                                   yn[:4].reshape(2, 2)), {},
+     lambda c, a, b: _t(tf.where, c, a, b))
+case("where_coords", "where", (np.array([[True, False], [False, True]]),),
+     {}, lambda c: _t(tf.where, c), dtype_strict=False)
+case("reverse_sequence", "reverse_sequence",
+     (xr, np.array([2, 3], I32)), {"seq_axis": 1, "batch_axis": 0},
+     lambda x, sl: _t(tf.reverse_sequence, x, sl, seq_axis=1,
+                      batch_axis=0))
+case("sequence_mask", "sequence_mask", (np.array([1, 0, 3], I32),),
+     {"maxlen": 4}, lambda l: _t(tf.sequence_mask, l, 4))
+case("unique", "unique", (np.array([1, 1, 2, 4, 4, 4, 7, 8, 8], I32),),
+     {}, lambda x: _t(tf.unique, x), out=(0, 1), dtype_strict=False)
+case("unique_with_counts", "unique_with_counts",
+     (np.array([1, 1, 2, 4, 4, 4, 7, 8, 8], I32),), {},
+     lambda x: _t(tf.unique_with_counts, x), out=(0, 1, 2),
+     dtype_strict=False)
+case("listdiff", "listdiff",
+     (np.array([1, 2, 3, 4, 5, 6], I32), np.array([1, 3, 5], I32)), {},
+     lambda a, b: _t(tf.sets.difference if False else
+                     lambda x, y: tf.raw_ops.ListDiff(x=x, y=y), a, b),
+     out=(0, 1), dtype_strict=False)
+case("dynamic_partition", "dynamic_partition",
+     (np.array([10., 20., 30., 40.], F32), np.array([1, 0, 1, 0], I32),
+      2), {},
+     lambda d, p, n: _t(tf.dynamic_partition, d, p, n), out=(0, 1))
+case("searchsorted", "searchsorted",
+     (np.array([1., 3., 5., 7.], F32), np.array([0., 4., 8., 5.], F32)),
+     {}, lambda s, v: _t(tf.searchsorted, s, v), dtype_strict=False)
+case("histogram_fixed_width", "histogram_fixed_width",
+     (np.array([-1., 0., 1.5, 2., 5., 15.], F32),),
+     {"value_range": (0.0, 10.0), "nbins": 5},
+     lambda v: _t(tf.histogram_fixed_width, v, [0.0, 10.0], nbins=5),
+     dtype_strict=False)
+case("meshgrid", "meshgrid",
+     (np.array([1., 2., 3.], F32), np.array([4., 5.], F32)), {},
+     lambda a, b: _t(tf.meshgrid, a, b), out=(0, 1))
+case("eye", "eye", (), {"n": 3, "m": 5},
+     lambda: np.eye(3, 5, dtype=F32))
+case("fill", "fill", (), {"shape": (2, 3), "value": 7.5},
+     lambda: np.full((2, 3), 7.5, F32))
+case("range", "range", (), {"start": 2, "limit": 11, "delta": 3},
+     lambda: np.arange(2, 11, 3), dtype_strict=False)
+case("linspace", "linspace", (), {"start": 0.0, "stop": 1.0, "num": 5},
+     lambda: np.linspace(0.0, 1.0, 5, dtype=F32))
+case("diag", "diag", (np.array([1., 2., 3.], F32),), {},
+     lambda x: _t(tf.linalg.diag, x))
+case("diag_part", "diag_part", (x34[:3, :3],), {},
+     lambda x: _t(tf.linalg.diag_part, x))
+case("matrix_band_part", "matrix_band_part", (x34,),
+     {"lower": 1, "upper": 0},
+     lambda x: _t(tf.linalg.band_part, x, 1, 0))
+case("tril", "tril", (x34,), {}, lambda x: np.tril(x))
+case("triu", "triu", (x34,), {}, lambda x: np.triu(x))
+case("trace", "trace", (x34[:3, :3],), {},
+     lambda x: _t(tf.linalg.trace, x))
+case("top_k", "top_k", (np.array([[1., 9., 3., 9.], [4., 2., 8., 1.]],
+                                 F32),), {"k": 2},
+     lambda x: _t(lambda y: tf.math.top_k(y, k=2), x), out=(0, 1),
+     dtype_strict=False)
+case("in_top_k", "in_top_k",
+     (np.array([[0.1, 0.9, 0.0], [0.9, 0.1, 0.0]], F32),
+      np.array([1, 2], I32)), {"k": 1},
+     lambda p, t: _t(tf.math.in_top_k, t, p, 1))
+case("nth_element", "nth_element",
+     (np.array([[3., 1., 4., 1.], [5., 9., 2., 6.]], F32),), {"n": 2},
+     lambda x: _t(lambda y: tf.raw_ops.NthElement(input=y, n=2), x))
+
+# ---- softmax & losses ----------------------------------------------------
+case("softmax_axis", "softmax", (xr,), {"axis": 1},
+     lambda x: _t(tf.nn.softmax, x, axis=1))
+case("log_softmax", "log_softmax", (x34,), {},
+     lambda x: _t(tf.nn.log_softmax, x))
+case("softmax_xent_logits", "softmax_cross_entropy_with_logits",
+     (x34, np.eye(4, dtype=F32)[[0, 2, 1]]), {},
+     lambda z, l: _t(tf.nn.softmax_cross_entropy_with_logits,
+                     labels=l, logits=z))
+case("sigmoid_xent", "sigmoid_cross_entropy",
+     (x34, np.eye(4, dtype=F32)[[0, 2, 1]]), {},
+     lambda z, l: _t(tf.nn.sigmoid_cross_entropy_with_logits,
+                     labels=l, logits=z))
+case("weighted_xent", "weighted_cross_entropy_with_logits",
+     (np.eye(4, dtype=F32)[[0, 2, 1]], x34), {"pos_weight": 2.0},
+     lambda l, z: _t(tf.nn.weighted_cross_entropy_with_logits,
+                     labels=l, logits=z, pos_weight=2.0))
+case("l2_normalize", "l2_normalize", (x34,), {"axis": 1},
+     lambda x: _t(tf.math.l2_normalize, x, axis=1))
+case("lrn", "lrn", (rng.normal(size=(1, 4, 4, 8)).astype(F32),),
+     {"depth_radius": 2, "bias": 1.0, "alpha": 1e-3, "beta": 0.75},
+     lambda x: _t(tf.nn.local_response_normalization, x, depth_radius=2,
+                  bias=1.0, alpha=1e-3, beta=0.75), rtol=1e-4)
+case("bias_add", "bias_add", (x34, np.array([1., 2., 3., 4.], F32)), {},
+     lambda x, b: _t(tf.nn.bias_add, x, b))
+
+# ---- conv / pool SAME-padding semantics ----------------------------------
+img = rng.normal(size=(1, 7, 7, 3)).astype(F32)
+ker = rng.normal(size=(3, 3, 3, 5)).astype(F32) * 0.3
+case("conv2d_same_s2", "conv2d", (img, ker),
+     {"strides": (2, 2), "padding": "SAME"},
+     lambda x, k: _t(tf.nn.conv2d, x, k, [1, 2, 2, 1], "SAME"), rtol=1e-4,
+     atol=1e-5)
+case("conv2d_valid", "conv2d", (img, ker),
+     {"strides": (1, 1), "padding": "VALID"},
+     lambda x, k: _t(tf.nn.conv2d, x, k, [1, 1, 1, 1], "VALID"), rtol=1e-4,
+     atol=1e-5)
+dker = rng.normal(size=(3, 3, 3, 2)).astype(F32) * 0.3
+case("depthwise_conv2d_same", "depthwise_conv2d", (img, dker),
+     {"strides": (1, 1), "padding": "SAME"},
+     lambda x, k: _t(tf.nn.depthwise_conv2d, x, k, [1, 1, 1, 1], "SAME"),
+     rtol=1e-4, atol=1e-5)
+case("maxpool2d_same_s2", "maxpool2d", (img,),
+     {"kernel": (3, 3), "strides": (2, 2), "padding": "SAME"},
+     lambda x: _t(tf.nn.max_pool2d, x, 3, 2, "SAME"))
+case("avgpool2d_same_excludes_pad", "avgpool2d", (img,),
+     {"kernel": (3, 3), "strides": (2, 2), "padding": "SAME"},
+     lambda x: _t(tf.nn.avg_pool2d, x, 3, 2, "SAME"), rtol=1e-5)
+case("space_to_depth", "space_to_depth",
+     (rng.normal(size=(1, 4, 6, 3)).astype(F32),), {"block_size": 2},
+     lambda x: _t(tf.nn.space_to_depth, x, 2))
+case("depth_to_space", "depth_to_space",
+     (rng.normal(size=(1, 2, 3, 12)).astype(F32),), {"block_size": 2},
+     lambda x: _t(tf.nn.depth_to_space, x, 2))
+case("extract_image_patches", "extract_image_patches", (img,),
+     {"ksizes": (3, 3), "strides": (2, 2), "rates": (1, 1),
+      "padding": "VALID"},
+     lambda x: _t(tf.image.extract_patches, x, [1, 3, 3, 1], [1, 2, 2, 1],
+                  [1, 1, 1, 1], "VALID"))
+
+# ---- image ---------------------------------------------------------------
+imr = np.clip(rng.normal(size=(1, 4, 4, 3)).astype(F32) * 0.3 + 0.5, 0, 1)
+case("resize_bilinear_up", "resize_bilinear", (imr,), {"size": (7, 9)},
+     lambda x: _t(tf.image.resize, x, [7, 9], method="bilinear"),
+     rtol=1e-4, atol=1e-5)
+case("resize_nearest", "resize_nearest_neighbor", (imr,), {"size": (9, 7)},
+     lambda x: _t(tf.image.resize, x, [9, 7], method="nearest"))
+case("rgb_to_hsv", "rgb_to_hsv", (imr,), {},
+     lambda x: _t(tf.image.rgb_to_hsv, x), rtol=1e-4, atol=1e-5)
+case("hsv_to_rgb", "hsv_to_rgb",
+     (np.clip(rng.random((1, 4, 4, 3)).astype(F32), 0.01, 0.99),), {},
+     lambda x: _t(tf.image.hsv_to_rgb, x), rtol=1e-4, atol=1e-5)
+case("rgb_to_grayscale", "rgb_to_grayscale", (imr,), {},
+     lambda x: _t(tf.image.rgb_to_grayscale, x), rtol=1e-4, atol=1e-5)
+case("rgb_to_yiq", "rgb_to_yiq", (imr,), {},
+     lambda x: _t(tf.image.rgb_to_yiq, x), rtol=1e-3, atol=5e-5)
+case("rgb_to_yuv", "rgb_to_yuv", (imr,), {},
+     lambda x: _t(tf.image.rgb_to_yuv, x), rtol=1e-4, atol=1e-5)
+case("adjust_contrast", "adjust_contrast", (imr,), {"factor": 1.7},
+     lambda x: _t(tf.image.adjust_contrast, x, 1.7), rtol=1e-4, atol=1e-5)
+case("adjust_saturation", "adjust_saturation", (imr,), {"factor": 0.6},
+     lambda x: _t(tf.image.adjust_saturation, x, 0.6), rtol=1e-4,
+     atol=1e-5)
+case("adjust_hue", "adjust_hue", (imr,), {"delta": 0.15},
+     lambda x: _t(tf.image.adjust_hue, x, 0.15), rtol=1e-3, atol=1e-4)
+
+# ---- linalg --------------------------------------------------------------
+spd = (x34[:3, :3] @ x34[:3, :3].T + 3 * np.eye(3, dtype=F32)).astype(F32)
+sq = (x34[:3, :3] + 2 * np.eye(3, dtype=F32)).astype(F32)
+case("matmul", "matmul", (x34, x34.T.copy()), {},
+     lambda a, b: _t(tf.matmul, a, b), rtol=1e-4, atol=1e-5)
+case("matmul_transpose_b", "matmul", (x34, x34), {"transpose_b": True},
+     lambda a, b: _t(tf.matmul, a, b, transpose_b=True), rtol=1e-4,
+     atol=1e-5)
+case("cholesky", "cholesky", (spd,), {},
+     lambda x: _t(tf.linalg.cholesky, x), rtol=1e-3, atol=1e-4)
+case("matrix_determinant", "matrix_determinant", (sq,), {},
+     lambda x: _t(tf.linalg.det, x), rtol=1e-3)
+case("matrix_inverse", "matrix_inverse", (sq,), {},
+     lambda x: _t(tf.linalg.inv, x), rtol=1e-3, atol=1e-4)
+case("solve", "solve", (spd, x34[:3, :2].copy()), {},
+     lambda a, b: _t(tf.linalg.solve, a, b), rtol=1e-3, atol=1e-4)
+case("triangular_solve", "triangular_solve",
+     (np.tril(spd).astype(F32), x34[:3, :2].copy()),
+     {"lower": True},
+     lambda a, b: _t(tf.linalg.triangular_solve, a, b, lower=True),
+     rtol=1e-3, atol=1e-4)
+case("cross", "cross",
+     (np.array([[1., 0., 0.], [0., 2., 0.]], F32),
+      np.array([[0., 1., 0.], [0., 0., 3.]], F32)), {},
+     lambda a, b: _t(tf.linalg.cross, a, b))
+case("tensordot", "tensordot", (xr, xr.transpose(1, 2, 0).copy()),
+     {"axes": 2}, lambda a, b: np.tensordot(a, b, axes=2), rtol=1e-4,
+     atol=1e-4)
+case("einsum", "einsum", (x34, x34.T.copy()), {"equation": "ij,jk->ik"},
+     lambda a, b: np.einsum("ij,jk->ik", a, b), rtol=1e-4, atol=1e-5)
+case("kron", "kron", (x34[:2, :2], x34[1:3, 1:3]), {},
+     lambda a, b: np.kron(a, b), rtol=1e-5)
+case("matrix_set_diag", "matrix_set_diag",
+     (x34[:3, :3], np.array([9., 8., 7.], F32)), {},
+     lambda m, d: _t(tf.linalg.set_diag, m, d))
+case("matrix_diag", "matrix_diag", (np.array([1., 2., 3.], F32),), {},
+     lambda d: _t(tf.linalg.diag, d))
+
+# ---- fft (numpy is the ecosystem twin) -----------------------------------
+cx = rng.normal(size=(8,)).astype(F32)
+case("fft", "fft", (cx.astype(np.complex64),), {},
+     lambda x: np.fft.fft(x).astype(np.complex64), rtol=1e-4, atol=1e-4)
+case("ifft", "ifft", (cx.astype(np.complex64),), {},
+     lambda x: np.fft.ifft(x).astype(np.complex64), rtol=1e-4, atol=1e-4)
+case("rfft", "rfft", (cx,), {},
+     lambda x: np.fft.rfft(x).astype(np.complex64), rtol=1e-4, atol=1e-4)
+case("irfft", "irfft", (np.fft.rfft(cx).astype(np.complex64),), {},
+     lambda x: np.fft.irfft(x).astype(F32), rtol=1e-4, atol=1e-4)
+case("fft2", "fft2", (rng.normal(size=(4, 4)).astype(F32)
+                      .astype(np.complex64),), {},
+     lambda x: np.fft.fft2(x).astype(np.complex64), rtol=1e-4, atol=1e-3)
+
+# ---- clipping / misc -----------------------------------------------------
+case("clipbyvalue_nan", "clipbyvalue", (xn,),
+     {"clip_value_min": -1.0, "clip_value_max": 1.0},
+     lambda x: _t(tf.clip_by_value, x, -1.0, 1.0))
+case("clipbynorm", "clipbynorm", (x34,), {"clipnorm": 1.5},
+     lambda x: _t(tf.clip_by_norm, x, 1.5), rtol=1e-5)
+case("cast_f_to_i_truncates", "cast",
+     (np.array([1.7, -1.7, 2.5, -2.5], F32),), {"dtype": "int32"},
+     lambda x: _t(tf.cast, x, tf.int32))
+case("floor_int_passthrough", "to_int32",
+     (np.array([1.9, -1.9], F32),), {},
+     lambda x: x.astype(I32))
+
+
+@pytest.mark.parametrize(
+    "spec", CASES, ids=[c[0] for c in CASES])
+def test_op_matches_twin(spec):
+    id_, op, args, attrs, twin, rtol, atol, out, dtype_strict = spec
+    got = exec_op(op, *[jnp.asarray(a) for a in args], **attrs)
+    want = twin(*args)
+    gots = list(got) if isinstance(got, (tuple, list)) else [got]
+    wants = want if isinstance(want, list) else [want]
+    sel = out if isinstance(out, tuple) else (out,)
+    if len(gots) == 1:
+        sel = (0,)
+    for j, k in enumerate(sel):
+        g = np.asarray(gots[k])
+        w = np.asarray(wants[j] if len(wants) > 1 else wants[0])
+        assert g.shape == w.shape, (g.shape, w.shape)
+        if dtype_strict:
+            assert g.dtype == w.dtype, (g.dtype, w.dtype)
+        if np.issubdtype(w.dtype, np.floating) \
+                or np.issubdtype(w.dtype, np.complexfloating):
+            np.testing.assert_allclose(g, w, rtol=rtol, atol=atol,
+                                       equal_nan=True)
+        else:
+            np.testing.assert_array_equal(g, w)
+
+
+def test_conformance_sweep_coverage_gate():
+    """The sweep must keep exercising a broad slice of the registry against
+    ecosystem twins — shrinking it is a regression. Counts DISTINCT registry
+    ops (the r3 verdict's ask: ops-vs-twin, not import rules)."""
+    reg = set(registry_names())
+    swept = {c[1] for c in CASES}
+    missing = swept - reg
+    assert not missing, f"cases name unregistered ops: {sorted(missing)}"
+    assert len(swept) >= 150, (
+        f"conformance sweep covers {len(swept)} registry ops; the gate "
+        f"floor is 150 — do not shrink the sweep")
