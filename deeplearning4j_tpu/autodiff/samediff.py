@@ -894,10 +894,22 @@ class SameDiff:
         if len(b_outs) != len(loop_vars):
             raise ValueError("while_loop body must return one var per "
                              "loop var")
+        def compatible(a, b):
+            # None = unknown rank, None dim = unknown extent — either is
+            # compatible with anything (the dtype-only inference pass emits
+            # (None,)*rank shapes; only CONCRETE disagreements are errors)
+            if a is None or b is None:
+                return True
+            if len(a) != len(b):
+                return False
+            return all(da is None or db is None or da == db
+                       for da, db in zip(a, b))
+
         mismatched = [
             (v.name, v.shape, np.dtype(v.dtype), o.shape, np.dtype(o.dtype))
             for v, o in zip(loop_vars, b_outs)
-            if (v.shape, np.dtype(v.dtype)) != (o.shape, np.dtype(o.dtype))]
+            if np.dtype(v.dtype) != np.dtype(o.dtype)
+            or not compatible(v.shape, o.shape)]
         if mismatched:
             raise ValueError(
                 f"while_loop body must preserve loop-var shapes/dtypes; "

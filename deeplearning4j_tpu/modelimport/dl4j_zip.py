@@ -401,34 +401,60 @@ def _layer_param_plan(layer, params):
     return plan
 
 
+def _flat_unpack_layer(model, key, layer, flat, idx, where: str) -> int:
+    """Consume one layer's DL4J flat-vector chunk into
+    ``model._params[key]`` / ``model._states[key]``. Returns the new idx.
+    Shared by the MLN and CG walks — the packing rules must never fork."""
+    import jax.numpy as jnp
+
+    params = model._params.get(key, {})
+    for pname, numel, unpack, _ in _layer_param_plan(layer, params):
+        chunk = flat[idx:idx + numel]
+        if chunk.size != numel:
+            raise ValueError(
+                f"coefficients.bin exhausted at {where} ({pname}): "
+                f"need {numel}, have {chunk.size}")
+        idx += numel
+        val = unpack(chunk)
+        if pname.startswith("__multi_"):
+            for sub, arr in val.items():
+                model._params[key][sub] = jnp.asarray(
+                    np.asarray(arr, np.float32))
+        elif pname.startswith("__state_"):
+            sname = pname[len("__state_"):]
+            model._states.setdefault(key, {})
+            model._states[key][sname] = jnp.asarray(val)
+        else:
+            model._params[key][pname] = jnp.asarray(
+                np.asarray(val, np.float32))
+    return idx
+
+
+def _flat_pack_layer(model, key, layer) -> list:
+    """One layer's params (+state rows) as DL4J-ordered flat chunks."""
+    params = model._params.get(key, {})
+    state = model._states.get(key, {}) if hasattr(model, "_states") else {}
+    chunks = []
+    for pname, numel, _, pack in _layer_param_plan(layer, params):
+        if pname.startswith("__multi_"):
+            src = {sub: np.asarray(params[sub])
+                   for sub in pname[len("__multi_"):].split("+")}
+        elif pname.startswith("__state_"):
+            src = state.get(pname[len("__state_"):],
+                            np.zeros(numel, np.float32))
+        else:
+            src = np.asarray(params[pname])
+        chunks.append(np.asarray(pack(src), np.float32))
+    return chunks
+
+
 def params_from_flat(net, flat: np.ndarray):
     """Distribute a DL4J flat coefficient vector into the net's params/state
     (in place). Returns the number of consumed elements."""
-    import jax.numpy as jnp
-
     idx = 0
     for li, layer in enumerate(net.conf.layers):
-        lkey = str(li)
-        params = net._params.get(lkey, {})
-        for pname, numel, unpack, _ in _layer_param_plan(layer, params):
-            chunk = flat[idx:idx + numel]
-            if chunk.size != numel:
-                raise ValueError(
-                    f"coefficients.bin exhausted at layer {li} ({pname}): "
-                    f"need {numel}, have {chunk.size}")
-            idx += numel
-            val = unpack(chunk)
-            if pname.startswith("__multi_"):
-                for sub, arr in val.items():
-                    net._params[lkey][sub] = jnp.asarray(
-                        np.asarray(arr, np.float32))
-            elif pname.startswith("__state_"):
-                sname = pname[len("__state_"):]
-                net._states.setdefault(lkey, {})
-                net._states[lkey][sname] = jnp.asarray(val)
-            else:
-                net._params[lkey][pname] = jnp.asarray(
-                    np.asarray(val, np.float32))
+        idx = _flat_unpack_layer(net, str(li), layer, flat, idx,
+                                 f"layer {li}")
     return idx
 
 
@@ -436,19 +462,7 @@ def params_to_flat(net) -> np.ndarray:
     """The net's params (+BN stats) as a DL4J-ordered flat vector."""
     chunks = []
     for li, layer in enumerate(net.conf.layers):
-        lkey = str(li)
-        params = net._params.get(lkey, {})
-        state = net._states.get(lkey, {}) if hasattr(net, "_states") else {}
-        for pname, numel, _, pack in _layer_param_plan(layer, params):
-            if pname.startswith("__multi_"):
-                src = {sub: np.asarray(params[sub])
-                       for sub in pname[len("__multi_"):].split("+")}
-            elif pname.startswith("__state_"):
-                sname = pname[len("__state_"):]
-                src = state.get(sname, np.zeros(numel, np.float32))
-            else:
-                src = np.asarray(params[pname])
-            chunks.append(np.asarray(pack(src), np.float32))
+        chunks.extend(_flat_pack_layer(net, str(li), layer))
     return (np.concatenate(chunks) if chunks
             else np.zeros((0,), np.float32))
 
@@ -602,9 +616,293 @@ def restore_multi_layer_network(path):
 
 def write_model(net, path):
     """Write OUR net as a reference-schema DL4J zip (configuration.json +
-    coefficients.bin) that ``restore_multi_layer_network`` — and, per the
-    documented format, the reference's ModelSerializer — can read."""
+    coefficients.bin) that ``restore_multi_layer_network`` /
+    ``restore_computation_graph`` — and, per the documented format, the
+    reference's ModelSerializer — can read. Dispatches on net type like
+    ``ModelSerializer.writeModel`` does."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    is_cg = isinstance(net, ComputationGraph)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", config_to_dl4j_json(net.conf))
+        zf.writestr("configuration.json",
+                    cg_config_to_dl4j_json(net.conf) if is_cg
+                    else config_to_dl4j_json(net.conf))
         zf.writestr("coefficients.bin",
-                    write_nd4j_array(params_to_flat(net)))
+                    write_nd4j_array(cg_params_to_flat(net) if is_cg
+                                     else params_to_flat(net)))
+
+
+# ----------------------------------------------- ComputationGraph surface
+#
+# ref: ModelSerializer#restoreComputationGraph — the zip layout is identical
+# to the MLN one, but configuration.json is a Jackson
+# ComputationGraphConfiguration: networkInputs/networkOutputs, a
+# ``vertices`` map of polymorphic @class graph-vertex entries (LayerVertex
+# wraps a full NeuralNetConfiguration under "layerConf"), and a
+# ``vertexInputs`` map. The flat coefficient vector concatenates the LAYER
+# vertices' params in topological order (ComputationGraph#params walks
+# topologicalSortOrder); the per-layer packing reuses the MLN plans above.
+
+_VERTEX_PKG = "org.deeplearning4j.nn.conf.graph."
+
+# our ElementWiseVertex op spellings → the reference's Op enum constants
+_EW_OP_TO_DL4J = {"add": "Add", "subtract": "Subtract", "sub": "Subtract",
+                  "product": "Product", "prod": "Product", "mul": "Product",
+                  "average": "Average", "avg": "Average", "max": "Max"}
+_EW_OP_FROM_DL4J = {"Add": "add", "Subtract": "subtract",
+                    "Product": "product", "Average": "average", "Max": "max"}
+
+
+def _vertex_to_json(v) -> dict:
+    from deeplearning4j_tpu.nn import graph_conf as G
+
+    kind = type(v).__name__
+    rnn = kind in ("LastTimeStepVertex", "DuplicateToTimeSeriesVertex",
+                   "ReverseTimeSeriesVertex")
+    out = {"@class": _VERTEX_PKG + ("rnn." if rnn else "") + kind}
+    if isinstance(v, G.ElementWiseVertex):
+        op = _EW_OP_TO_DL4J.get(v.op.lower())
+        if op is None:
+            raise ValueError(f"ElementWiseVertex op {v.op!r} has no DL4J "
+                             f"Op enum constant")
+        out["op"] = op
+    elif isinstance(v, G.SubsetVertex):
+        out["from"] = int(v.from_idx)
+        out["to"] = int(v.to_idx)
+    elif isinstance(v, G.ScaleVertex):
+        out["scaleFactor"] = float(v.scale)
+    elif isinstance(v, G.ShiftVertex):
+        out["shiftValue"] = float(v.shift)
+    elif isinstance(v, G.UnstackVertex):
+        out["from"] = int(v.from_idx)
+        out["stackSize"] = int(v.stack_size)
+    elif isinstance(v, G.L2NormalizeVertex):
+        out["eps"] = float(v.eps)
+    elif isinstance(v, G.ReshapeVertex):
+        # reference newShape INCLUDES the minibatch dim (-1); ours is
+        # non-batch dims only
+        out["newShape"] = [-1] + [int(s) for s in v.shape]
+    elif isinstance(v, (G.MergeVertex, G.StackVertex, G.LastTimeStepVertex,
+                        G.DuplicateToTimeSeriesVertex,
+                        G.ReverseTimeSeriesVertex)):
+        pass
+    else:
+        raise ValueError(
+            f"vertex {kind!r} has no DL4J-zip JSON mapping (LambdaVertex "
+            f"and Preprocessor/Pool-helper vertices are outside the compat "
+            f"subset)")
+    return out
+
+
+def _vertex_from_json(vj: dict):
+    from deeplearning4j_tpu.nn import graph_conf as G
+
+    cls = vj.get("@class", "").rsplit(".", 1)[-1]
+    if cls == "MergeVertex":
+        return G.MergeVertex()
+    if cls == "ElementWiseVertex":
+        op = _EW_OP_FROM_DL4J.get(str(vj.get("op", "Add")))
+        if op is None:
+            raise ValueError(f"unknown ElementWiseVertex op "
+                             f"{vj.get('op')!r}")
+        return G.ElementWiseVertex(op=op)
+    if cls == "SubsetVertex":
+        return G.SubsetVertex(from_idx=int(vj["from"]), to_idx=int(vj["to"]))
+    if cls == "ScaleVertex":
+        return G.ScaleVertex(scale=float(vj.get("scaleFactor", 1.0)))
+    if cls == "ShiftVertex":
+        return G.ShiftVertex(shift=float(vj.get("shiftValue", 0.0)))
+    if cls == "StackVertex":
+        return G.StackVertex()
+    if cls == "UnstackVertex":
+        return G.UnstackVertex(from_idx=int(vj.get("from", 0)),
+                               stack_size=int(vj.get("stackSize", 1)))
+    if cls == "L2NormalizeVertex":
+        return G.L2NormalizeVertex(eps=float(vj.get("eps", 1e-8)))
+    if cls == "ReshapeVertex":
+        # reference newShape includes the minibatch dim; strip it for our
+        # non-batch-dims-only vertex (a concrete leading extent cannot be
+        # honored batch-independently — refuse rather than mis-shape)
+        ns = [int(s) for s in vj.get("newShape", ())]
+        if ns and ns[0] not in (-1, 0):
+            raise ValueError(
+                f"ReshapeVertex newShape {ns} pins the minibatch dim to "
+                f"{ns[0]}; only batch-preserving (-1 leading) reshapes are "
+                f"supported")
+        return G.ReshapeVertex(shape=tuple(ns[1:]))
+    if cls == "LastTimeStepVertex":
+        return G.LastTimeStepVertex()
+    if cls == "DuplicateToTimeSeriesVertex":
+        return G.DuplicateToTimeSeriesVertex()
+    if cls == "ReverseTimeSeriesVertex":
+        return G.ReverseTimeSeriesVertex()
+    raise ValueError(
+        f"DL4J graph vertex class {cls!r} is outside the supported compat "
+        f"subset (see _vertex_from_json for the implemented set)")
+
+
+def cg_config_to_dl4j_json(conf) -> str:
+    """Our ComputationGraphConfiguration → Jackson CG-configuration JSON."""
+    upd = getattr(conf, "updater", None)
+    iupdater = None
+    if upd is not None:
+        iupdater = {"@class": "org.nd4j.linalg.learning.config."
+                    + type(upd).__name__,
+                    "learningRate": float(getattr(upd, "learning_rate",
+                                                  getattr(upd, "lr", 1e-3)))}
+    from deeplearning4j_tpu.nn import graph_conf as G
+
+    vertices, vertex_inputs = {}, {}
+    for li, name in enumerate(conf.topo_order):
+        node = conf.nodes[name]
+        vertex_inputs[name] = list(node.inputs)
+        if node.layer is not None:
+            lj = _layer_to_json(node.layer, li)
+            lj["layerName"] = name
+            if iupdater is not None:
+                lj["iUpdater"] = iupdater
+            vertices[name] = {
+                "@class": _VERTEX_PKG + "LayerVertex",
+                "layerConf": {"layer": lj, "seed": conf.seed or 0,
+                              "dataType": "FLOAT"}}
+        else:
+            vj = _vertex_to_json(node.vertex)
+            if isinstance(node.vertex, G.DuplicateToTimeSeriesVertex):
+                # reference shape: ONE graph input (the vector); the
+                # time-series reference rides the 'inputName' field
+                if len(node.inputs) != 2:
+                    raise ValueError(
+                        f"DuplicateToTimeSeriesVertex {name!r} needs "
+                        f"[vector, series] inputs, got {node.inputs}")
+                vertex_inputs[name] = [node.inputs[0]]
+                vj["inputName"] = node.inputs[1]
+            vertices[name] = vj
+    out = {"networkInputs": list(conf.network_inputs),
+           "networkOutputs": list(conf.network_outputs),
+           "vertices": vertices,
+           "vertexInputs": vertex_inputs,
+           "backpropType": ("TruncatedBPTT"
+                            if "runcated" in str(conf.backprop_type)
+                            else "Standard")}
+    if conf.backprop_type and "runcated" in str(conf.backprop_type):
+        out["tbpttFwdLength"] = int(conf.tbptt_fwd_length)
+        out["tbpttBackLength"] = int(conf.tbptt_bwd_length)
+    its = [_input_type_to_json(it) for it in (conf.input_types or [])]
+    if any(its):
+        out["networkInputTypes"] = its
+    return json.dumps(out, indent=2)
+
+
+def cg_config_from_dl4j_json(text: str):
+    """Jackson ComputationGraphConfiguration JSON → our CG configuration
+    (via the GraphBuilder DSL, which recomputes topo order and shapes)."""
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+
+    j = json.loads(text)
+    if "vertices" not in j:
+        raise ValueError("not a ComputationGraph configuration "
+                         "(no 'vertices' key — use "
+                         "restore_multi_layer_network for MLN zips)")
+    vertices = j["vertices"]
+    vertex_inputs = j.get("vertexInputs", {})
+    layer_confs = [{"layer": vj.get("layerConf", {}).get("layer", {})}
+                   for vj in vertices.values()
+                   if vj.get("@class", "").endswith("LayerVertex")]
+    builder = NeuralNetConfiguration.builder()
+    seed = None
+    for vj in vertices.values():
+        lc = vj.get("layerConf")
+        if lc and lc.get("seed") is not None:
+            seed = int(lc["seed"])
+            break
+    if seed is not None:
+        builder.seed(seed)
+    gb = (builder.updater(_updater_from_json(layer_confs))
+          .graph_builder()
+          .add_inputs(*j.get("networkInputs", [])))
+    for name, vj in vertices.items():
+        inputs = list(vertex_inputs.get(name, []))
+        if vj.get("@class", "").endswith("LayerVertex"):
+            layer = _layer_from_json(vj.get("layerConf", {}).get("layer", {}))
+            gb.add_layer(name, layer, *inputs)
+        else:
+            v = _vertex_from_json(vj)
+            if vj.get("@class", "").endswith(
+                    "DuplicateToTimeSeriesVertex"):
+                # the reference names its series reference via 'inputName';
+                # our vertex takes it as a second graph input
+                ref_name = vj.get("inputName")
+                if not ref_name:
+                    raise ValueError(
+                        f"DuplicateToTimeSeriesVertex {name!r} is missing "
+                        f"the required 'inputName' field")
+                inputs.append(ref_name)
+            gb.add_vertex(name, v, *inputs)
+    gb.set_outputs(*j.get("networkOutputs", []))
+    its = [_input_type_from_json(it)
+           for it in j.get("networkInputTypes", j.get("inputTypes", []))]
+    if its and all(it is not None for it in its):
+        gb.set_input_types(*its)
+    if j.get("backpropType") == "TruncatedBPTT":
+        gb.backprop_type("truncated_bptt")
+        gb.t_bptt_length(int(j.get("tbpttFwdLength", 20)),
+                         int(j.get("tbpttBackLength", 20)))
+    return gb.build()
+
+
+def _cg_layer_nodes(conf):
+    """Layer vertices in topological order — the reference's flat-vector
+    walk (ComputationGraph#params over topologicalSortOrder)."""
+    return [(name, conf.nodes[name].layer) for name in conf.topo_order
+            if conf.nodes[name].layer is not None]
+
+
+def cg_params_from_flat(g, flat: np.ndarray) -> int:
+    """Distribute a DL4J CG flat coefficient vector into the graph's
+    params/state (in place). Returns consumed element count."""
+    idx = 0
+    for name, layer in _cg_layer_nodes(g.conf):
+        idx = _flat_unpack_layer(g, name, layer, flat, idx,
+                                 f"vertex {name!r}")
+    return idx
+
+
+def cg_params_to_flat(g) -> np.ndarray:
+    """The graph's params (+BN stats) as a DL4J-ordered flat vector."""
+    chunks = []
+    for name, layer in _cg_layer_nodes(g.conf):
+        chunks.extend(_flat_pack_layer(g, name, layer))
+    return (np.concatenate(chunks) if chunks
+            else np.zeros((0,), np.float32))
+
+
+def restore_computation_graph(path):
+    """ref: ModelSerializer#restoreComputationGraph over a REAL DL4J zip
+    (configuration.json with a vertices map + coefficients.bin)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+        if "configuration.json" not in names:
+            raise ValueError("not a DL4J model zip: no configuration.json")
+        conf = cg_config_from_dl4j_json(
+            zf.read("configuration.json").decode("utf-8"))
+        g = ComputationGraph(conf)
+        g.init()
+        if "coefficients.bin" in names:
+            flat = read_nd4j_array(zf.read("coefficients.bin")).ravel()
+            used = cg_params_from_flat(g, flat.astype(np.float32))
+            if used != flat.size:
+                raise ValueError(
+                    f"coefficients.bin has {flat.size} values but the "
+                    f"architecture consumes {used} — vertex plan mismatch")
+        if "updaterState.bin" in names:
+            import logging
+            logging.getLogger(__name__).warning(
+                "updaterState.bin present but not restored — optimizer "
+                "moments start fresh (config updater/lr ARE restored)")
+        if "normalizer.bin" in names:
+            raise ValueError(
+                "normalizer.bin (Java NormalizerSerializer format) is not "
+                "supported — strip it or re-fit a normalizer")
+    return g

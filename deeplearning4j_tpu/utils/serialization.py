@@ -139,16 +139,35 @@ class ModelSerializer:
         from deeplearning4j_tpu.nn.graph_conf import ComputationGraphConfiguration
 
         with zipfile.ZipFile(path, "r") as zf:
-            conf = ComputationGraphConfiguration.from_json(zf.read("configuration.json").decode())
-            return ModelSerializer._restore_into(ComputationGraph(conf), zf, load_updater)
+            # "coefficients.bin" = an actual reference-written DL4J artifact
+            # (Jackson CG JSON + Nd4j.write binary) → the compat reader
+            is_dl4j_artifact = "coefficients.bin" in zf.namelist()
+            if not is_dl4j_artifact:
+                conf = ComputationGraphConfiguration.from_json(
+                    zf.read("configuration.json").decode())
+                return ModelSerializer._restore_into(
+                    ComputationGraph(conf), zf, load_updater)
+        from deeplearning4j_tpu.modelimport import dl4j_zip
+        return dl4j_zip.restore_computation_graph(path)
 
     restoreComputationGraph = restore_computation_graph
 
     @staticmethod
     def restore(path, load_updater: bool = True):
-        """Dispatch on the stored model_type (meta.json)."""
+        """Dispatch on the stored model_type (meta.json); reference-written
+        DL4J artifacts carry no meta.json, so for those the CG-vs-MLN split
+        is sniffed from the configuration JSON ('vertices' map = CG)."""
         with zipfile.ZipFile(path, "r") as zf:
-            meta = json.loads(zf.read("meta.json")) if "meta.json" in zf.namelist() else {}
+            names = zf.namelist()
+            meta = json.loads(zf.read("meta.json")) if "meta.json" in names \
+                else {}
+            if not meta and "configuration.json" in names:
+                try:
+                    cj = json.loads(zf.read("configuration.json"))
+                    if "vertices" in cj:
+                        meta = {"model_type": "ComputationGraph"}
+                except Exception:
+                    pass
         if meta.get("model_type") == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path, load_updater)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)

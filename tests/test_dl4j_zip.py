@@ -356,3 +356,251 @@ def test_idropout_schemes_round_trip(tmp_path):
         back = net2.conf.layers[1].dropout
         assert type(back) is type(obj), (type(back), type(obj))
         assert back == obj
+
+
+class TestComputationGraphZip:
+    """ref: ModelSerializer#restoreComputationGraph (VERDICT r3 #5) — the
+    CG zip layout: Jackson ComputationGraphConfiguration JSON (vertices /
+    vertexInputs maps, LayerVertex wrapping layerConf) + the same flat
+    Nd4j.write coefficients binary, layer vertices walked in topo order."""
+
+    def _two_branch_graph(self):
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph_conf import (ElementWiseVertex,
+                                                      MergeVertex,
+                                                      ScaleVertex)
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        gconf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+                 .graph_builder()
+                 .add_inputs("in")
+                 .add_layer("a", DenseLayer(n_out=6, activation="relu"),
+                            "in")
+                 .add_layer("b", DenseLayer(n_out=6, activation="tanh"),
+                            "in")
+                 .add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+                 .add_vertex("scaled", ScaleVertex(scale=0.5), "sum")
+                 .add_vertex("merged", MergeVertex(), "sum", "scaled")
+                 .add_layer("out", OutputLayer(
+                     n_out=3, activation="softmax",
+                     loss_function="negativeloglikelihood"), "merged")
+                 .set_outputs("out")
+                 .set_input_types(InputType.feed_forward(5))
+                 .build())
+        return ComputationGraph(gconf).init()
+
+    def test_cg_roundtrip_finetune_resave_parity(self, tmp_path):
+        """The full VERDICT done-criterion: write → restore → fine-tune →
+        re-save → re-restore, output parity at each hop."""
+        import os
+
+        g = self._two_branch_graph()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+
+        p1 = os.path.join(str(tmp_path), "cg.zip")
+        D.write_model(g, p1)
+        g2 = D.restore_computation_graph(p1)
+        np.testing.assert_allclose(np.asarray(g.output(x)),
+                                   np.asarray(g2.output(x)), atol=1e-5)
+        # restored graph fine-tunes
+        g2.fit(x, y)
+        s0 = g2.score()
+        for _ in range(5):
+            g2.fit(x, y)
+        assert g2.score() < s0
+        # re-save the fine-tuned graph and re-restore: parity again
+        p2 = os.path.join(str(tmp_path), "cg2.zip")
+        D.write_model(g2, p2)
+        g3 = D.restore_computation_graph(p2)
+        np.testing.assert_allclose(np.asarray(g2.output(x)),
+                                   np.asarray(g3.output(x)), atol=1e-5)
+
+    def test_cg_vertex_field_mappings_roundtrip(self, tmp_path):
+        """Non-layer vertices keep their config fields through the Jackson
+        spelling (from/to, scaleFactor, shiftValue, stackSize, newShape)."""
+        from deeplearning4j_tpu.nn import graph_conf as G
+
+        for v in (G.ElementWiseVertex(op="product"),
+                  G.SubsetVertex(from_idx=1, to_idx=3),
+                  G.ScaleVertex(scale=2.5), G.ShiftVertex(shift=-1.0),
+                  G.UnstackVertex(from_idx=1, stack_size=2),
+                  G.L2NormalizeVertex(eps=1e-6),
+                  G.ReshapeVertex(shape=(2, 3)), G.MergeVertex(),
+                  G.StackVertex(), G.LastTimeStepVertex(),
+                  G.DuplicateToTimeSeriesVertex(),
+                  G.ReverseTimeSeriesVertex()):
+            back = D._vertex_from_json(D._vertex_to_json(v))
+            assert back == v, (v, back)
+
+    def test_reference_style_cg_fixture_restores(self, tmp_path):
+        """A hand-built Jackson-style CG artifact (the byte/JSON layout a
+        JVM DL4J writes) restores into a working, trainable graph with the
+        fixture's exact weights."""
+        import os
+
+        conf = {
+            "networkInputs": ["in"],
+            "networkOutputs": ["out"],
+            "backpropType": "Standard",
+            "vertices": {
+                "d0": {"@class":
+                       "org.deeplearning4j.nn.conf.graph.LayerVertex",
+                       "layerConf": {"seed": 11, "layer": {
+                           "@class": "org.deeplearning4j.nn.conf.layers"
+                                     ".DenseLayer",
+                           "activationFn": {
+                               "@class": "org.nd4j.linalg.activations.impl"
+                                         ".ActivationReLU"},
+                           "iUpdater": {
+                               "@class": "org.nd4j.linalg.learning.config"
+                                         ".Adam",
+                               "learningRate": 0.01},
+                           "nin": 3, "nout": 4, "layerName": "d0"}}},
+                "ew": {"@class": "org.deeplearning4j.nn.conf.graph"
+                                 ".ElementWiseVertex", "op": "Max"},
+                "out": {"@class":
+                        "org.deeplearning4j.nn.conf.graph.LayerVertex",
+                        "layerConf": {"seed": 11, "layer": {
+                            "@class": "org.deeplearning4j.nn.conf.layers"
+                                      ".OutputLayer",
+                            "activationFn": {
+                                "@class": "org.nd4j.linalg.activations.impl"
+                                          ".ActivationSoftmax"},
+                            "lossFn": {
+                                "@class": "org.nd4j.linalg.lossfunctions"
+                                          ".impl.LossNegativeLogLikelihood"},
+                            "nin": 4, "nout": 2, "layerName": "out"}}},
+            },
+            "vertexInputs": {"d0": ["in"], "ew": ["d0", "d0"],
+                             "out": ["ew"]},
+            "networkInputTypes": [
+                {"@class": "org.deeplearning4j.nn.conf.inputs"
+                           ".InputType$InputTypeFeedForward", "size": 3}],
+        }
+        # flat vector: d0 W(3x4 col-major)+b(4), out W(4x2)+b(2)
+        w0 = np.arange(12, dtype=np.float32).reshape(3, 4) * 0.1
+        b0 = np.full((4,), 0.5, np.float32)
+        w1 = np.arange(8, dtype=np.float32).reshape(4, 2) * -0.05
+        b1 = np.zeros((2,), np.float32)
+        flat = np.concatenate([w0.ravel(order="F"), b0,
+                               w1.ravel(order="F"), b1])
+        p = os.path.join(str(tmp_path), "ref_cg.zip")
+        with zipfile.ZipFile(p, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            zf.writestr("coefficients.bin", _java_nd4j_vector(flat))
+
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        g = ModelSerializer.restore_computation_graph(p)
+        # the exact fixture weights landed where the plan says
+        np.testing.assert_allclose(np.asarray(g._params["d0"]["W"]), w0,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(g._params["out"]["W"]), w1,
+                                   atol=1e-6)
+        # forward equals the hand-computed reference path
+        x = np.array([[1.0, -1.0, 0.5]], np.float32)
+        h = np.maximum(x @ w0 + b0, 0.0)
+        m = np.maximum(h, h)                      # ElementWise Max, twice d0
+        logits = m @ w1 + b1
+        want = np.exp(logits) / np.exp(logits).sum()
+        np.testing.assert_allclose(np.asarray(g.output(x)), want, atol=1e-5)
+        # and it fine-tunes
+        y = np.eye(2, dtype=np.float32)[[1]]
+        g.fit(x, y)
+        s0 = g.score()
+        for _ in range(5):
+            g.fit(x, y)
+        assert g.score() < s0
+
+    def test_cg_seq2seq_duplicate_vertex_inputname_mapping(self, tmp_path):
+        """DuplicateToTimeSeriesVertex: the reference stores ONE graph
+        input + an 'inputName' series reference; ours takes [vector,
+        series]. The mapping must survive a write→restore→parity hop."""
+        import os
+
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer, LSTM,
+                                                       RnnOutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph_conf import (
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex, MergeVertex)
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        gconf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                 .graph_builder()
+                 .add_inputs("seq")
+                 .add_layer("enc", LSTM(n_out=6), "seq")
+                 .add_vertex("last", LastTimeStepVertex(), "enc")
+                 .add_layer("summary", DenseLayer(n_out=5,
+                                                  activation="tanh"), "last")
+                 .add_vertex("dup", DuplicateToTimeSeriesVertex(),
+                             "summary", "seq")
+                 .add_vertex("cat", MergeVertex(), "enc", "dup")
+                 .add_layer("out", RnnOutputLayer(
+                     n_out=2, activation="identity", loss_function="mse"),
+                     "cat")
+                 .set_outputs("out")
+                 .set_input_types(InputType.recurrent(3))
+                 .build())
+        g = ComputationGraph(gconf).init()
+        p = os.path.join(str(tmp_path), "seq2seq.zip")
+        D.write_model(g, p)
+        # the written JSON uses the reference's shape: single graph input
+        # plus inputName
+        with zipfile.ZipFile(p) as zf:
+            cj = json.loads(zf.read("configuration.json"))
+        assert cj["vertexInputs"]["dup"] == ["summary"]
+        assert cj["vertices"]["dup"]["inputName"] == "seq"
+        g2 = D.restore_computation_graph(p)
+        x = np.random.default_rng(2).normal(size=(4, 7, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(g.output(x)),
+                                   np.asarray(g2.output(x)), atol=1e-5)
+
+    def test_restore_dispatch_sniffs_cg_artifact(self, tmp_path):
+        """ModelSerializer.restore() must route a reference-written CG zip
+        (no meta.json) to the CG compat reader, not the MLN one."""
+        import os
+
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        g = self._two_branch_graph()
+        p = os.path.join(str(tmp_path), "cg_sniff.zip")
+        D.write_model(g, p)
+        back = ModelSerializer.restore(p)
+        assert isinstance(back, ComputationGraph)
+        x = np.random.default_rng(3).normal(size=(2, 5)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(g.output(x)),
+                                   np.asarray(back.output(x)), atol=1e-5)
+
+    def test_reshape_vertex_batch_dim_convention(self):
+        """Reference newShape carries the minibatch dim; ours is non-batch
+        only. Write adds the -1; read strips it; a pinned batch refuses."""
+        from deeplearning4j_tpu.nn import graph_conf as G
+
+        j = D._vertex_to_json(G.ReshapeVertex(shape=(2, 3)))
+        assert j["newShape"] == [-1, 2, 3]
+        back = D._vertex_from_json(j)
+        assert back.shape == (2, 3)
+        with pytest.raises(ValueError, match="minibatch"):
+            D._vertex_from_json({"@class": D._VERTEX_PKG + "ReshapeVertex",
+                                 "newShape": [4, 2, 3]})
+
+    def test_elementwise_op_enum_spellings(self):
+        """Alias spellings canonicalize to real DL4J Op enum constants."""
+        from deeplearning4j_tpu.nn import graph_conf as G
+
+        for ours, theirs in (("avg", "Average"), ("sub", "Subtract"),
+                             ("mul", "Product"), ("max", "Max"),
+                             ("add", "Add")):
+            j = D._vertex_to_json(G.ElementWiseVertex(op=ours))
+            assert j["op"] == theirs, (ours, j)
+            assert D._vertex_from_json(j).op in (
+                "add", "subtract", "product", "average", "max")
