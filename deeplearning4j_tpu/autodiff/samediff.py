@@ -1337,8 +1337,40 @@ class SameDiff:
                         f"{prefix}__sub__/{op.name}/{k}/"))
         return out
 
+    def as_flat_buffers(self) -> bytes:
+        """The graph as a reference-schema FlatGraph binary (ref:
+        ``SameDiff#asFlatBuffers`` — org.nd4j.graph FlatBuffers schema)."""
+        from deeplearning4j_tpu.autodiff import flatgraph
+
+        return flatgraph.to_flat_buffers(self)
+
+    asFlatBuffers = as_flat_buffers
+
+    @staticmethod
+    def from_flat_buffers(data: bytes) -> "SameDiff":
+        """Parse a FlatGraph binary (ref: ``SameDiff#fromFlatBuffers``)."""
+        from deeplearning4j_tpu.autodiff import flatgraph
+
+        return flatgraph.from_flat_buffers(data)
+
+    fromFlatBuffers = from_flat_buffers
+
     def save(self, path: str, save_updater_state: bool = False):
-        """Persist graph + values (ref: ``SameDiff#save`` FlatBuffers zip)."""
+        """Persist graph + values. A ``.fb``/``.fbs``/``.sdfb`` path writes
+        the reference's FlatGraph binary (ref: ``SameDiff#save`` writes
+        FlatBuffers); anything else uses the native zip container (which
+        also carries control-flow subgraphs and updater state)."""
+        if str(path).endswith((".fb", ".fbs", ".sdfb")):
+            if save_updater_state and self._opt_state is not None:
+                import warnings
+
+                warnings.warn(
+                    "save_updater_state=True is not representable in the "
+                    "FlatGraph binary — updater moments are NOT saved; use "
+                    "the native zip container to persist them", stacklevel=2)
+            with open(path, "wb") as f:
+                f.write(self.as_flat_buffers())
+            return
         d = self.to_dict()
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("graph.json", json.dumps(d, indent=1))
@@ -1354,6 +1386,11 @@ class SameDiff:
 
     @staticmethod
     def load(path: str) -> "SameDiff":
+        if str(path).endswith((".fb", ".fbs", ".sdfb")) \
+                or not zipfile.is_zipfile(path):
+            from deeplearning4j_tpu.autodiff import flatgraph
+
+            return flatgraph.load_flatbuffers(path)
         opt_leaves = None
         with zipfile.ZipFile(path) as zf:
             d = json.loads(zf.read("graph.json"))

@@ -547,14 +547,24 @@ def _input_type_to_json(it) -> Optional[dict]:
     return {"@class": base + "FeedForward", "size": it.size}
 
 
-def config_to_dl4j_json(conf) -> str:
+def _iupdater_to_json(conf) -> Optional[dict]:
+    """Shared Jackson iUpdater entry for the MLN and CG writers."""
     upd = getattr(conf, "updater", None)
-    iupdater = None
-    if upd is not None:
-        iupdater = {"@class": "org.nd4j.linalg.learning.config."
-                    + type(upd).__name__,
-                    "learningRate": float(getattr(upd, "learning_rate",
-                                                  getattr(upd, "lr", 1e-3)))}
+    if upd is None:
+        return None
+    return {"@class": "org.nd4j.linalg.learning.config."
+            + type(upd).__name__,
+            "learningRate": float(getattr(upd, "learning_rate",
+                                          getattr(upd, "lr", 1e-3)))}
+
+
+def _is_tbptt(conf) -> bool:
+    bpt = getattr(conf, "backprop_type", None)
+    return bool(bpt) and "runcated" in str(bpt)   # TruncatedBPTT / truncated
+
+
+def config_to_dl4j_json(conf) -> str:
+    iupdater = _iupdater_to_json(conf)
     confs = []
     for li, layer in enumerate(conf.layers):
         lj = _layer_to_json(layer, li)
@@ -568,9 +578,7 @@ def config_to_dl4j_json(conf) -> str:
             "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
             "seed": conf.seed or 0,
         })
-    out = {"backpropType": ("TruncatedBPTT"
-                            if getattr(conf, "backprop_type", None)
-                            and "Truncated" in str(conf.backprop_type)
+    out = {"backpropType": ("TruncatedBPTT" if _is_tbptt(conf)
                             else "Standard"),
            "confs": confs}
     it = _input_type_to_json(getattr(conf, "input_type", None))
@@ -743,13 +751,7 @@ def _vertex_from_json(vj: dict):
 
 def cg_config_to_dl4j_json(conf) -> str:
     """Our ComputationGraphConfiguration → Jackson CG-configuration JSON."""
-    upd = getattr(conf, "updater", None)
-    iupdater = None
-    if upd is not None:
-        iupdater = {"@class": "org.nd4j.linalg.learning.config."
-                    + type(upd).__name__,
-                    "learningRate": float(getattr(upd, "learning_rate",
-                                                  getattr(upd, "lr", 1e-3)))}
+    iupdater = _iupdater_to_json(conf)
     from deeplearning4j_tpu.nn import graph_conf as G
 
     vertices, vertex_inputs = {}, {}
@@ -781,10 +783,9 @@ def cg_config_to_dl4j_json(conf) -> str:
            "networkOutputs": list(conf.network_outputs),
            "vertices": vertices,
            "vertexInputs": vertex_inputs,
-           "backpropType": ("TruncatedBPTT"
-                            if "runcated" in str(conf.backprop_type)
+           "backpropType": ("TruncatedBPTT" if _is_tbptt(conf)
                             else "Standard")}
-    if conf.backprop_type and "runcated" in str(conf.backprop_type):
+    if _is_tbptt(conf):
         out["tbpttFwdLength"] = int(conf.tbptt_fwd_length)
         out["tbpttBackLength"] = int(conf.tbptt_bwd_length)
     its = [_input_type_to_json(it) for it in (conf.input_types or [])]

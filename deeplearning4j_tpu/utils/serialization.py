@@ -156,20 +156,30 @@ class ModelSerializer:
     def restore(path, load_updater: bool = True):
         """Dispatch on the stored model_type (meta.json); reference-written
         DL4J artifacts carry no meta.json, so for those the CG-vs-MLN split
-        is sniffed from the configuration JSON ('vertices' map = CG)."""
+        is sniffed from the configuration JSON ('vertices' map = CG). The
+        sniff result routes DIRECTLY to the right reader — the archive is
+        not re-opened to re-discover what this method already knows."""
+        is_cg = is_dl4j_artifact = False
         with zipfile.ZipFile(path, "r") as zf:
             names = zf.namelist()
             meta = json.loads(zf.read("meta.json")) if "meta.json" in names \
                 else {}
+            is_dl4j_artifact = "coefficients.bin" in names
+            is_cg = meta.get("model_type") == "ComputationGraph"
             if not meta and "configuration.json" in names:
                 try:
                     cj = json.loads(zf.read("configuration.json"))
-                    if "vertices" in cj:
-                        meta = {"model_type": "ComputationGraph"}
+                    is_cg = "vertices" in cj
                 except Exception:
                     pass
-        if meta.get("model_type") == "ComputationGraph":
-            return ModelSerializer.restore_computation_graph(path, load_updater)
+        from deeplearning4j_tpu.modelimport import dl4j_zip
+        if is_cg:
+            if is_dl4j_artifact:
+                return dl4j_zip.restore_computation_graph(path)
+            return ModelSerializer.restore_computation_graph(path,
+                                                             load_updater)
+        if is_dl4j_artifact:
+            return dl4j_zip.restore_multi_layer_network(path)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)
 
     @staticmethod

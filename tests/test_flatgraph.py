@@ -1,0 +1,189 @@
+"""SameDiff FlatBuffers artifact tests (VERDICT r3 #6; ref:
+``SameDiff#asFlatBuffers``/``fromFlatBuffers``, ``graph/scheme/*.fbs``).
+
+Covers: binary round-trip fidelity (graph, values, attrs incl. nested
+tuples and ndarrays, loss variables, training config), execution parity
+after the hop, a TF-imported-BERT fine-tune through the fb path, schema
+shape checks a foreign reader would rely on, and loud refusal for
+control-flow graphs."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.autodiff import flatgraph
+
+
+def _linear_sd():
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (None, 3), np.float32)
+    w = sd.var("w", init=np.arange(6, dtype=np.float32).reshape(3, 2) * 0.1)
+    b = sd.var("b", init=np.zeros(2, np.float32))
+    (x.mmul(w) + b).rename("y")
+    return sd
+
+
+class TestRoundTrip:
+    def test_linear_exec_parity(self):
+        sd = _linear_sd()
+        data = sd.as_flat_buffers()
+        assert isinstance(data, bytes) and len(data) > 100
+        sd2 = SameDiff.from_flat_buffers(data)
+        x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+        a = sd.output({"x": x}, ["y"])["y"]
+        b = sd2.output({"x": x}, ["y"])["y"]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_attr_kinds_survive(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (2, 3, 4, 1), np.float32)
+        # nested-tuple attr (paddings), str attr (mode), scalar attrs
+        sd._op("pad", x, paddings=((0, 0), (1, 2), (0, 1), (0, 0)),
+               mode="CONSTANT", constant_values=1.5).rename("p")
+        sd._op("cumsum", sd._vars["p"], axis=1, exclusive=True,
+               reverse=False).rename("c")
+        sd2 = SameDiff.from_flat_buffers(sd.as_flat_buffers())
+        ops = {o.op_name: o for o in sd2._ops}
+        assert ops["pad"].attrs["paddings"] == ((0, 0), (1, 2), (0, 1),
+                                                (0, 0))
+        assert ops["pad"].attrs["mode"] == "CONSTANT"
+        assert ops["pad"].attrs["constant_values"] == 1.5
+        assert ops["cumsum"].attrs == {"axis": 1, "exclusive": True,
+                                       "reverse": False}
+        x_np = np.random.default_rng(1).normal(size=(2, 3, 4, 1)) \
+            .astype(np.float32)
+        a = sd.output({"x": x_np}, ["c"])["c"]
+        b = sd2.output({"x": x_np}, ["c"])["c"]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_training_state_survives_and_fine_tunes(self, tmp_path):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        sd = _linear_sd()
+        lab = sd.placeholder("label", (None, 2), np.float32)
+        sd.loss.mse(lab, sd._vars["y"]).rename("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.05), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["label"], loss_variables=["loss"]))
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(32, 3)).astype(np.float32)
+        W = np.array([[1.0, -1.0], [0.5, 2.0], [-0.3, 0.7]], np.float32)
+        Y = X @ W
+        sd.fit([DataSet(X, Y)] * 10, epochs=2)
+
+        p = str(tmp_path / "model.fb")
+        sd.save(p)                          # extension routes to FlatGraph
+        sd2 = SameDiff.load(p)
+        # values and loss/training config survived
+        np.testing.assert_allclose(np.asarray(sd2._values["w"]),
+                                   np.asarray(sd._values["w"]), atol=1e-7)
+        assert sd2._loss_variables == ["loss"]
+        assert sd2.training_config is not None
+        # fine-tuning continues from the restored point
+        h = sd2.fit([DataSet(X, Y)] * 20, epochs=3)
+        assert h[-1] < h[0] or h[0] < 1e-3
+
+    def test_control_flow_refuses_loudly(self):
+        sd = SameDiff.create()
+        i0 = sd.constant(np.int32(0), name="i0")
+        sd.while_loop(lambda s, i: s._op("less", i, s.constant(np.int32(3))),
+                      lambda s, i: s._op("add", i, s.constant(np.int32(1))),
+                      i0)
+        with pytest.raises(ValueError, match="control-flow"):
+            sd.as_flat_buffers()
+
+
+class TestSchemaShape:
+    """What a FOREIGN FlatBuffers reader (the reference) would rely on:
+    root FlatGraph offsets resolve, vectors have the right arity, and the
+    FlatArray payload decodes with shape*itemsize == len(buffer)."""
+
+    def test_flatgraph_tables_resolve(self):
+        sd = _linear_sd()
+        data = sd.as_flat_buffers()
+        import flatbuffers
+        from flatbuffers import number_types as NT
+
+        buf = bytearray(data)
+        root = flatbuffers.encode.Get(NT.UOffsetTFlags.packer_type, buf, 0)
+        g = flatgraph._Tab(buf, root)
+        vars_ = g.table_vec(flatgraph._FG["variables"])
+        nodes = g.table_vec(flatgraph._FG["nodes"])
+        assert len(vars_) == len(sd._vars)
+        assert len(nodes) == len(sd._ops)
+        names = {v.string(flatgraph._FV["name"]) for v in vars_}
+        assert {"x", "w", "b", "y"} <= names
+        # placeholder listed; w carries an ndarray whose bytes match shape
+        assert g.string_vec(flatgraph._FG["placeholders"]) == ["x"]
+        for v in vars_:
+            if v.string(flatgraph._FV["name"]) == "w":
+                nd = v.table(flatgraph._FV["ndarray"])
+                arr = flatgraph._read_flat_array(nd)
+                assert arr.shape == (3, 2)
+                np.testing.assert_allclose(
+                    arr, np.arange(6, dtype=np.float32).reshape(3, 2) * 0.1)
+        for n in nodes:
+            assert n.string(flatgraph._FN["opName"])
+            assert n.i8(flatgraph._FN["opType"]) == flatgraph._OP_TYPE_CUSTOM
+
+    def test_dtype_codes_are_reference_values(self):
+        # org.nd4j.graph.DType constants the binary must carry
+        assert flatgraph._NP_TO_DTYPE[np.dtype(np.float32)] == 5
+        assert flatgraph._NP_TO_DTYPE[np.dtype(np.float64)] == 6
+        assert flatgraph._NP_TO_DTYPE[np.dtype(np.int32)] == 9
+        assert flatgraph._NP_TO_DTYPE[np.dtype(np.int64)] == 10
+        assert flatgraph._NP_TO_DTYPE[np.dtype(np.bool_)] == 1
+
+
+@pytest.mark.slow
+def test_imported_bert_mini_survives_fb_save_load(tmp_path):
+    """The VERDICT done-criterion: a TF-imported BERT fine-tunes through
+    the fb path. Mini-scale (2L/h32) so it runs in CI time; the import
+    pipeline is identical to the full-size model's."""
+    tf = pytest.importorskip("tensorflow")
+    transformers = pytest.importorskip("transformers")
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    from transformers import BertConfig, TFBertModel
+
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
+    from tests.bert_helpers import (attach_classifier_head,
+                                    promote_weight_constants)
+
+    cfg = BertConfig(num_hidden_layers=2, hidden_size=32,
+                     num_attention_heads=2, intermediate_size=64,
+                     vocab_size=200, hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    model = TFBertModel(cfg)
+
+    @tf.function
+    def f(input_ids, attention_mask):
+        return model(input_ids=input_ids,
+                     attention_mask=attention_mask).last_hidden_state
+
+    frozen = convert_variables_to_constants_v2(f.get_concrete_function(
+        tf.TensorSpec((2, 8), tf.int32, name="input_ids"),
+        tf.TensorSpec((2, 8), tf.int32, name="attention_mask")))
+    gd = frozen.graph.as_graph_def()
+    sd = TFGraphMapper.import_graph(gd)
+    promote_weight_constants(sd, min_size=64)
+    attach_classifier_head(sd, gd, hidden_size=32, lr=5e-3)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 200, (2, 8)).astype(np.int32)
+    mask = np.ones((2, 8), np.int32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 2)]
+    feed = {"input_ids": ids, "attention_mask": mask, "label": y}
+    ref_loss = float(np.asarray(sd.output(feed, ["loss"])["loss"]))
+
+    p = str(tmp_path / "bert_mini.fb")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    got_loss = float(np.asarray(sd2.output(feed, ["loss"])["loss"]))
+    assert abs(ref_loss - got_loss) < 1e-5, (ref_loss, got_loss)
+
+    losses = sd2.fit([MultiDataSet([ids, mask], [y])] * 3, epochs=1)
+    assert all(np.isfinite(losses))
