@@ -391,6 +391,11 @@ class _Tab:
             return None
         return _Tab(self.t.Bytes, self.t.Indirect(o + self.t.Pos))
 
+    def has(self, slot) -> bool:
+        """Field PRESENCE via the vtable — a present-but-empty vector (a
+        scalar's shape) is distinct from an absent field."""
+        return bool(self._o(slot))
+
     def vec_len(self, slot) -> int:
         o = self._o(slot)
         return self.t.VectorLen(o) if o else 0
@@ -473,8 +478,9 @@ def _property_value(tab: _Tab, meta: dict):
             return flat
         return tuple(flat) if meta.get("tuple") else flat
     # no meta (foreign artifact): best-effort by which vector is populated
+    ints32 = tab.scalar_vec(_FP["i"], np.int32)
     for seq, conv in ((bools, lambda x: bool(x)), (longs, int),
-                      (dbls, float)):
+                      (ints32, int), (dbls, float)):
         if len(seq):
             vals = [conv(x) for x in seq]
             return vals[0] if len(vals) == 1 else vals
@@ -505,9 +511,8 @@ def from_flat_buffers(data: bytes):
         name = vt.string(_FV["name"])
         code = vt.i8(_FV["dtype"])
         dt = _DTYPE_TO_NP.get(int(code), np.dtype("f4"))
-        shape_vec = vt.scalar_vec(_FV["shape"], np.int64)
-        shape = tuple(int(s) for s in shape_vec) \
-            if vt.vec_len(_FV["shape"]) or len(shape_vec) else None
+        shape = tuple(int(s) for s in vt.scalar_vec(_FV["shape"], np.int64)) \
+            if vt.has(_FV["shape"]) else None   # () scalar != absent
         vtype = VariableType(_VARTYPE_TO_OURS.get(
             int(vt.i8(_FV["variabletype"])), "ARRAY"))
         v = SDVariable(sd, name, vtype, shape, dt)
@@ -569,6 +574,16 @@ def from_flat_buffers(data: bytes):
     if tc:
         sd.training_config = TrainingConfig.from_dict(
             _unjsonable(json.loads(tc)))
+    # name counters: future _unique names must not collide with loaded ones
+    # (same guard as SameDiff._restore for the zip path)
+    for n in sd._vars:
+        base = n.split(":")[0].split("#")[0]
+        cur = sd._name_counter.get(base, 0)
+        try:
+            suffix = int(n.split(":")[1]) if ":" in n else 0
+        except ValueError:
+            suffix = 0
+        sd._name_counter[base] = max(cur, suffix)
     return sd
 
 

@@ -651,11 +651,49 @@ class SameDiff:
         self.random = SDRandom(self)
         self.bitwise = SDBitwise(self)
         self.image = SDImage(self)
+        self.mesh = None               # set_mesh: data-parallel training
 
     # ---- creation -----------------------------------------------------
     @staticmethod
     def create() -> "SameDiff":
         return SameDiff()
+
+    # ---- multi-device -------------------------------------------------
+    def set_mesh(self, mesh) -> "SameDiff":
+        """Train data-parallel over a ``jax.sharding.Mesh`` with a 'data'
+        axis: ``fit`` shards each feed batch over the axis and replicates
+        variables; GSPMD inserts the gradient allreduce. The analog of
+        wrapping a net in ShardedTrainer (SURVEY P3/P9) for the SameDiff
+        surface — one compiled program, no per-replica copies."""
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+        if mesh is not None and DATA_AXIS not in mesh.axis_names:
+            raise ValueError(f"mesh has no {DATA_AXIS!r} axis: "
+                             f"{mesh.axis_names}")
+        self.mesh = mesh
+        self._train_step = None        # re-placement on next fit
+        return self
+
+    def _shard_feed(self, ph: Dict[str, Any]) -> Dict[str, Any]:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+        dp = self.mesh.shape[DATA_AXIS]
+        out = {}
+        for k, v in ph.items():
+            if v.ndim >= 1 and v.shape[0] % dp == 0:
+                out[k] = jax.device_put(
+                    v, NamedSharding(self.mesh, P(DATA_AXIS)))
+            else:   # indivisible or scalar: replicate
+                out[k] = jax.device_put(v, NamedSharding(self.mesh, P()))
+        return out
+
+    def _replicate_values(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        self._values = {k: jax.device_put(v, rep)
+                        for k, v in self._values.items()}
 
     def _unique(self, base: str) -> str:
         if base not in self._vars and base not in self._name_counter:
@@ -1283,7 +1321,23 @@ class SameDiff:
         sig = (tuple(trainable), tuple(self._loss_variables),
                json.dumps(tc.to_dict(), sort_keys=True, default=str))
         if self._train_step is None or self._train_sig != sig:
-            self._train_step, self._opt_state = self._build_train_step(sig)
+            # a placement-only rebuild (set_mesh with unchanged graph sig)
+            # must NOT reset accumulated optimizer moments — only re-home
+            # them onto the mesh alongside the values
+            keep_state = (self._train_sig == sig
+                          and self._opt_state is not None
+                          and self._pending_opt_leaves is None)
+            if self.mesh is not None:
+                self._replicate_values()
+            self._train_step, fresh_state = self._build_train_step(sig)
+            if keep_state:
+                if self.mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    self._opt_state = jax.device_put(
+                        self._opt_state,
+                        NamedSharding(self.mesh, PartitionSpec()))
+            else:
+                self._opt_state = fresh_state
             self._train_sig = sig
         train_set = set(trainable)
         fixed_vals = {n: v for n, v in self._values.items()
@@ -1292,6 +1346,8 @@ class SameDiff:
             if epoch > 0 and hasattr(data, "reset"):
                 data.reset()
             for ph in batches():
+                if self.mesh is not None:
+                    ph = self._shard_feed(ph)
                 train_vals = {n: self._values[n] for n in trainable}
                 train_vals, self._opt_state, loss = self._train_step(
                     train_vals, fixed_vals, self._opt_state, ph,
@@ -1386,11 +1442,23 @@ class SameDiff:
 
     @staticmethod
     def load(path: str) -> "SameDiff":
-        if str(path).endswith((".fb", ".fbs", ".sdfb")) \
-                or not zipfile.is_zipfile(path):
+        if str(path).endswith((".fb", ".fbs", ".sdfb")):
             from deeplearning4j_tpu.autodiff import flatgraph
 
             return flatgraph.load_flatbuffers(path)
+        if not zipfile.is_zipfile(path):
+            # unrecognized extension + not a zip: attempt the FlatGraph
+            # binary, but convert parser noise into a diagnosable error
+            # (a truncated native zip must not surface as a struct error)
+            from deeplearning4j_tpu.autodiff import flatgraph
+
+            try:
+                return flatgraph.load_flatbuffers(path)
+            except Exception as e:
+                raise ValueError(
+                    f"{path!r} is neither a SameDiff zip (corrupt or "
+                    f"truncated?) nor a readable FlatGraph binary: "
+                    f"{e!r}") from e
         opt_leaves = None
         with zipfile.ZipFile(path) as zf:
             d = json.loads(zf.read("graph.json"))

@@ -85,6 +85,24 @@ class TestRoundTrip:
         h = sd2.fit([DataSet(X, Y)] * 20, epochs=3)
         assert h[-1] < h[0] or h[0] < 1e-3
 
+    def test_scalar_shape_and_name_counter_survive(self):
+        """A rank-0 var keeps shape () (not None) through the hop, and
+        extending a loaded graph cannot collide with loaded names."""
+        sd = _linear_sd()
+        sd.var("scale", init=np.float32(2.0))
+        sd2 = SameDiff.from_flat_buffers(sd.as_flat_buffers())
+        assert sd2._vars["scale"].shape == ()
+        before = set(sd2._vars)
+        v = sd2._op("add", sd2._vars["y"], sd2._vars["scale"])
+        assert v.name not in before
+
+    def test_load_diagnosable_on_garbage_file(self, tmp_path):
+        p = str(tmp_path / "junk.model")
+        with open(p, "wb") as f:
+            f.write(b"definitely not a graph")
+        with pytest.raises(ValueError, match="neither a SameDiff zip"):
+            SameDiff.load(p)
+
     def test_control_flow_refuses_loudly(self):
         sd = SameDiff.create()
         i0 = sd.constant(np.int32(0), name="i0")
