@@ -574,16 +574,7 @@ def from_flat_buffers(data: bytes):
     if tc:
         sd.training_config = TrainingConfig.from_dict(
             _unjsonable(json.loads(tc)))
-    # name counters: future _unique names must not collide with loaded ones
-    # (same guard as SameDiff._restore for the zip path)
-    for n in sd._vars:
-        base = n.split(":")[0].split("#")[0]
-        cur = sd._name_counter.get(base, 0)
-        try:
-            suffix = int(n.split(":")[1]) if ":" in n else 0
-        except ValueError:
-            suffix = 0
-        sd._name_counter[base] = max(cur, suffix)
+    sd._reseed_name_counters()
     return sd
 
 

@@ -1503,13 +1503,17 @@ class SameDiff:
         sd._loss_variables = d.get("lossVariables", [])
         if d.get("trainingConfig"):
             sd.training_config = TrainingConfig.from_dict(d["trainingConfig"])
-        # name counters: make future names unique past loaded ones
-        for n in sd._vars:
+        sd._reseed_name_counters()
+        return sd
+
+    def _reseed_name_counters(self):
+        """Make future ``_unique`` names skip past every loaded name —
+        shared by the zip and FlatBuffers load paths."""
+        for n in self._vars:
             base = n.split(":")[0].split("#")[0]
-            cur = sd._name_counter.get(base, 0)
+            cur = self._name_counter.get(base, 0)
             try:
                 suffix = int(n.split(":")[1]) if ":" in n else 0
             except ValueError:
                 suffix = 0
-            sd._name_counter[base] = max(cur, suffix)
-        return sd
+            self._name_counter[base] = max(cur, suffix)

@@ -203,6 +203,34 @@ def _map_layer(cls: str, cfg: dict):
             stride=_pair(cfg.get("strides", 1)),
             depth_multiplier=cfg.get("depth_multiplier", 1),
             padding=_padding(cfg), activation=act, has_bias=use_bias)
+    if cls == "SeparableConv1D":
+        # __post_init__ normalizes list/tuple kernel/stride/dilation to int
+        return L.SeparableConvolution1D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=cfg["kernel_size"],
+            stride=cfg.get("strides", 1),
+            dilation=cfg.get("dilation_rate", 1),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            padding=_padding(cfg), activation=act, has_bias=use_bias)
+    if cls == "Conv3DTranspose":
+        return L.Deconvolution3D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=tuple(cfg["kernel_size"]),
+            stride=tuple(cfg.get("strides", (1, 1, 1))),
+            padding=_padding(cfg), activation=act, has_bias=use_bias)
+    if cls == "ConvLSTM2D":
+        if cfg.get("go_backwards") or cfg.get("stateful"):
+            raise UnsupportedKerasConfigurationException(
+                "ConvLSTM2D: go_backwards/stateful unsupported")
+        return L.ConvLSTM2D(
+            name=name, n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=cfg.get("padding", "valid"),   # string: same|valid
+            activation=_map_activation(cfg.get("activation", "tanh")),
+            recurrent_activation=cfg.get("recurrent_activation", "sigmoid"),
+            return_sequences=bool(cfg.get("return_sequences", False)),
+            has_bias=use_bias)
     if cls in ("MaxPooling2D", "MaxPool2D"):
         return L.SubsamplingLayer(
             name=name, pooling_type="max",
@@ -470,6 +498,54 @@ def _load_weights_into(layer, w: Dict[str, np.ndarray], params: dict,
         put("dW", "depthwise_kernel")
         put("pW", "pointwise_kernel")
         put("b", "bias")
+    elif isinstance(layer, L.SeparableConvolution1D):
+        put("dW", "depthwise_kernel")
+        if "pointwise_kernel" in w:          # keras (1, C*dm, F) → (C*dm, F)
+            params.setdefault(lkey, {})["pW"] = jnp.asarray(
+                np.asarray(w["pointwise_kernel"])[0])
+        put("b", "bias")
+    elif isinstance(layer, (L.Deconvolution2D, L.Deconvolution3D)):
+        # keras Conv{2,3}DTranspose kernel is (*k, OUT, IN); ours (*k, IN, OUT)
+        if "kernel" in w:
+            kk = np.asarray(w["kernel"])
+            perm = tuple(range(kk.ndim - 2)) + (kk.ndim - 1, kk.ndim - 2)
+            params.setdefault(lkey, {})["W"] = jnp.asarray(
+                kk.transpose(perm))
+        put("b", "bias")
+    elif isinstance(layer, L.ConvLSTM2D):
+        put("W", "kernel")
+        put("RW", "recurrent_kernel")
+        put("b", "bias")
+    elif isinstance(layer, L.SelfAttentionLayer):
+        # keras MultiHeadAttention sublayer paths: query/key/value einsum
+        # kernels (C, H, dh) + biases (H, dh); attention_output kernel
+        # (H, dh, C_out) + bias (C_out,)
+        hs = layer.n_heads * layer.head_size
+
+        def find(path_suffix):
+            for k, v in w.items():
+                if k.endswith(path_suffix):
+                    return np.asarray(v)
+            return None
+
+        for ours, theirs in (("Wq", "query/kernel"), ("Wk", "key/kernel"),
+                             ("Wv", "value/kernel")):
+            arr = find(theirs)
+            if arr is not None:
+                params.setdefault(lkey, {})[ours] = jnp.asarray(
+                    arr.reshape(layer.n_in, hs))
+        arr = find("attention_output/kernel")
+        if arr is not None:
+            params.setdefault(lkey, {})["Wo"] = jnp.asarray(
+                arr.reshape(hs, layer.n_out))
+        if layer.qkv_bias:
+            for ours, theirs in (("bq", "query/bias"), ("bk", "key/bias"),
+                                 ("bv", "value/bias"),
+                                 ("bo", "attention_output/bias")):
+                arr = find(theirs)
+                if arr is not None:
+                    params.setdefault(lkey, {})[ours] = jnp.asarray(
+                        arr.reshape(-1))
     elif isinstance(layer, L.DepthwiseConvolution2D):
         # Keras 2 names it depthwise_kernel; Keras 3 plain kernel
         put("dW", "depthwise_kernel")
@@ -627,7 +703,8 @@ class KerasModelImport:
             for i, (lyr, kname) in enumerate(mapped):
                 _load_weights_into(
                     lyr, weights.get(kname, allow_ambiguous_leaves=isinstance(
-                        lyr, L.Bidirectional)), net._params,
+                        lyr, (L.Bidirectional, L.SelfAttentionLayer))),
+                    net._params,
                                    net._states, str(i))
             net._opt_state = net._opt.init(net._params)
             return net
@@ -661,7 +738,9 @@ class KerasModelImport:
                     name_of[name] = name
                     shape = lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
                     dims = list(shape[1:]) if shape else []
-                    if len(dims) == 3:
+                    if len(dims) == 4:
+                        input_types.append(InputType.convolutional3d(*dims))
+                    elif len(dims) == 3:
                         input_types.append(InputType.convolutional(*dims))
                     elif len(dims) == 2:
                         input_types.append(InputType.recurrent(dims[1], dims[0]))
@@ -681,6 +760,55 @@ class KerasModelImport:
                     g.add_vertex(name, ElementWiseVertex(op="avg"), *srcs)
                 elif cls in ("Maximum",):
                     g.add_vertex(name, ElementWiseVertex(op="max"), *srcs)
+                elif cls in ("Minimum",):
+                    g.add_vertex(name, ElementWiseVertex(op="min"), *srcs)
+                elif cls == "Dot":
+                    from deeplearning4j_tpu.nn.graph_conf import DotVertex
+                    ax = lcfg.get("axes", -1)
+                    g.add_vertex(name, DotVertex(
+                        axes=tuple(ax) if isinstance(ax, list) else ax,
+                        normalize=bool(lcfg.get("normalize", False))), *srcs)
+                elif cls in ("Attention", "AdditiveAttention"):
+                    from deeplearning4j_tpu.nn.graph_conf import (
+                        AdditiveAttentionVertex, DotProductAttentionVertex)
+                    if lcfg.get("use_scale") and cls == "Attention":
+                        raise UnsupportedKerasConfigurationException(
+                            "Attention(use_scale=True) carries a learned "
+                            "scale — re-save with use_scale=False")
+                    if cls == "AdditiveAttention" \
+                            and lcfg.get("use_scale", True):
+                        raise UnsupportedKerasConfigurationException(
+                            "AdditiveAttention(use_scale=True) carries a "
+                            "learned scale vector — re-save with "
+                            "use_scale=False")
+                    if lcfg.get("score_mode", "dot") not in ("dot",):
+                        raise UnsupportedKerasConfigurationException(
+                            f"Attention score_mode "
+                            f"{lcfg.get('score_mode')!r} unsupported")
+                    vcls = (DotProductAttentionVertex if cls == "Attention"
+                            else AdditiveAttentionVertex)
+                    g.add_vertex(name, vcls(
+                        causal=bool(lcfg.get("causal", False))), *srcs)
+                elif cls == "MultiHeadAttention":
+                    if len(set(srcs)) != 1:
+                        raise UnsupportedKerasConfigurationException(
+                            "MultiHeadAttention: only the self-attention "
+                            "form (query is value is key) is importable")
+                    if lcfg.get("value_dim") not in (None,
+                                                     lcfg.get("key_dim")):
+                        raise UnsupportedKerasConfigurationException(
+                            "MultiHeadAttention: value_dim != key_dim "
+                            "unsupported")
+                    if lcfg.get("output_shape"):
+                        raise UnsupportedKerasConfigurationException(
+                            "MultiHeadAttention: custom output_shape "
+                            "unsupported")
+                    lyr = L.SelfAttentionLayer(
+                        name=name, n_heads=int(lcfg["num_heads"]),
+                        head_size=int(lcfg["key_dim"]),
+                        qkv_bias=bool(lcfg.get("use_bias", True)))
+                    g.add_layer(name, lyr, srcs[0])
+                    mapped[name] = lyr
                 else:
                     out = _map_layer(cls, lcfg)
                     if out is None:
@@ -711,7 +839,8 @@ class KerasModelImport:
             for kname, lyr in mapped.items():
                 _load_weights_into(
                     lyr, weights.get(kname, allow_ambiguous_leaves=isinstance(
-                        lyr, L.Bidirectional)), net._params,
+                        lyr, (L.Bidirectional, L.SelfAttentionLayer))),
+                    net._params,
                                    net._states, kname)
             net._opt_state = net._opt.init(net._params)
             return net

@@ -532,8 +532,14 @@ class Deconvolution2D(_ConvBase):
     def apply(self, params, x, training=False, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
         pad = self.padding.upper() if isinstance(self.padding, str) else [(p, p) for p in self.padding]
-        z = lax.conv_transpose(x, params["W"], strides=self.stride, padding=pad,
-                               dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # transpose_kernel=True = the TRUE transposed conv (gradient of the
+        # forward conv — reference Deconvolution2D / tf conv2d_transpose
+        # semantics, numerically verified vs tf.nn.conv2d_transpose); the
+        # flag wants the kernel as (kh, kw, O, I)
+        z = lax.conv_transpose(x, params["W"].transpose(0, 1, 3, 2),
+                               strides=self.stride, padding=pad,
+                               dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                               transpose_kernel=True)
         if self.has_bias:
             z = z + params["b"]
         return self._act(z), state
@@ -1167,6 +1173,9 @@ class SelfAttentionLayer(Layer):
     n_heads: int = 1
     head_size: Optional[int] = None
     project_input: bool = True
+    # Keras MultiHeadAttention imports carry projection biases (use_bias
+    # defaults True there); the reference layer has none, so default False
+    qkv_bias: bool = False
 
     def set_n_in(self, input_type: InputType):
         if self.n_in is None:
@@ -1184,27 +1193,41 @@ class SelfAttentionLayer(Layer):
         if not self.project_input:
             return {}
         hs = self.n_heads * self.head_size
-        return {"Wq": (self.n_in, hs), "Wk": (self.n_in, hs),
-                "Wv": (self.n_in, hs), "Wo": (hs, self.n_out)}
+        shapes = {"Wq": (self.n_in, hs), "Wk": (self.n_in, hs),
+                  "Wv": (self.n_in, hs), "Wo": (hs, self.n_out)}
+        if self.qkv_bias:
+            shapes.update({"bq": (hs,), "bk": (hs,), "bv": (hs,),
+                           "bo": (self.n_out,)})
+        return shapes
 
     def init_params(self, key):
         if not self.project_input:
             return {}
         ks = jax.random.split(key, 4)
         hs = self.n_heads * self.head_size
-        return {
+        p = {
             "Wq": _winit.init(self.weight_init, ks[0], (self.n_in, hs), self.n_in, hs),
             "Wk": _winit.init(self.weight_init, ks[1], (self.n_in, hs), self.n_in, hs),
             "Wv": _winit.init(self.weight_init, ks[2], (self.n_in, hs), self.n_in, hs),
             "Wo": _winit.init(self.weight_init, ks[3], (hs, self.n_out), hs, self.n_out),
         }
+        if self.qkv_bias:
+            p.update({"bq": jnp.zeros((hs,)), "bk": jnp.zeros((hs,)),
+                      "bv": jnp.zeros((hs,)), "bo": jnp.zeros((self.n_out,))})
+        return p
 
     def apply(self, params, x, training=False, rng=None, state=None, mask=None):
         n, t, _ = x.shape
         if self.project_input:
-            q = (x @ params["Wq"]).reshape(n, t, self.n_heads, self.head_size).transpose(0, 2, 1, 3)
-            k = (x @ params["Wk"]).reshape(n, t, self.n_heads, self.head_size).transpose(0, 2, 1, 3)
-            v = (x @ params["Wv"]).reshape(n, t, self.n_heads, self.head_size).transpose(0, 2, 1, 3)
+            def proj(w, b):
+                z = x @ params[w]
+                if self.qkv_bias:
+                    z = z + params[b]
+                return z.reshape(n, t, self.n_heads,
+                                 self.head_size).transpose(0, 2, 1, 3)
+            q = proj("Wq", "bq")
+            k = proj("Wk", "bk")
+            v = proj("Wv", "bv")
         else:
             q = k = v = x[:, None]  # single head
         attn_mask = None
@@ -1214,6 +1237,8 @@ class SelfAttentionLayer(Layer):
         out = out.transpose(0, 2, 1, 3).reshape(n, t, -1)
         if self.project_input:
             out = out @ params["Wo"]
+            if self.qkv_bias:
+                out = out + params["bo"]
         return self._act(out), state
 
 
@@ -1573,9 +1598,9 @@ class LambdaLayer(Layer):
 # layer tranche 2 (reference D3 completion) re-exported into this namespace
 # so user code and the gradcheck coverage gate see one flat `layers` module
 from deeplearning4j_tpu.nn.conf.layers2 import (  # noqa: E402,F401
-    CapsuleLayer, CapsuleStrengthLayer, Cropping1D, Cropping3D,
-    DepthwiseConvolution2D, FrozenLayer, PrimaryCapsules,
+    CapsuleLayer, CapsuleStrengthLayer, ConvLSTM2D, Cropping1D, Cropping3D,
+    Deconvolution3D, DepthwiseConvolution2D, FrozenLayer, PrimaryCapsules,
     FrozenLayerWithBackprop, LocallyConnected1D, LocallyConnected2D,
-    MaskLayer, MaskZeroLayer, PReLULayer, Subsampling1DLayer,
-    Subsampling3DLayer, Upsampling1D, Upsampling3D, ZeroPadding1DLayer,
-    ZeroPadding3DLayer)
+    MaskLayer, MaskZeroLayer, PReLULayer, SeparableConvolution1D,
+    Subsampling1DLayer, Subsampling3DLayer, Upsampling1D, Upsampling3D,
+    ZeroPadding1DLayer, ZeroPadding3DLayer)

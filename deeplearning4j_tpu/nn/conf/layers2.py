@@ -745,3 +745,267 @@ class CapsuleStrengthLayer(Layer):
     def apply(self, params, x, training=False, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
         return jnp.sqrt(jnp.sum(x * x, axis=-1) + 1e-12), state
+
+
+@register_layer
+@dataclasses.dataclass
+class Deconvolution3D(Layer):
+    """Transposed 3-D convolution over (N,D,H,W,C) volumes (ref:
+    conf.layers.Deconvolution3D; Keras Conv3DTranspose). NDHWC, TPU-native
+    like Convolution3D."""
+    kernel_size: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Any = 0
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel_size = _triple(self.kernel_size)
+        self.stride = _triple(self.stride)
+        if not isinstance(self.padding, str):
+            self.padding = _triple(self.padding)
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        same = isinstance(self.padding, str) and self.padding.lower() == "same"
+        dims = (input_type.depth, input_type.height, input_type.width)
+        if same:
+            d, h, w = (s * st for s, st in zip(dims, self.stride))
+        else:
+            # "valid" string = zero padding (not just the int/tuple form)
+            pads = ((0, 0, 0) if isinstance(self.padding, str)
+                    else self.padding)
+            d, h, w = (st * (s - 1) + k - 2 * p
+                       for s, st, k, p in zip(dims, self.stride,
+                                              self.kernel_size, pads))
+        return InputType.convolutional3d(d, h, w, self.n_out)
+
+    def param_shapes(self):
+        kd, kh, kw = self.kernel_size
+        shapes = {"W": (kd, kh, kw, self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        kd, kh, kw = self.kernel_size
+        vol = kd * kh * kw
+        p = {"W": _winit.init(self.weight_init, key,
+                              (kd, kh, kw, self.n_in, self.n_out),
+                              vol * self.n_in, vol * self.n_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        import jax.lax as lax
+
+        x = self._maybe_dropout(x, training, rng)
+        pad = (self.padding.upper() if isinstance(self.padding, str)
+               else [(p, p) for p in self.padding])
+        # true transposed conv (see Deconvolution2D): kernel as (..., O, I)
+        z = lax.conv_transpose(
+            x, params["W"].transpose(0, 1, 2, 4, 3), strides=self.stride,
+            padding=pad, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            transpose_kernel=True)
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution1D(Layer):
+    """Depthwise-separable 1-D conv over (N,T,C) (Keras SeparableConv1D;
+    the 1-D sibling of ref conf.layers.SeparableConvolution2D). Lowered to
+    the 2-D depthwise/pointwise kernels with a singleton width so the same
+    XLA conv path serves both."""
+    kernel_size: int = 3
+    stride: int = 1
+    padding: Any = 0
+    dilation: int = 1
+    depth_multiplier: int = 1
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    has_bias: bool = True
+
+    def __post_init__(self):
+        for f in ("kernel_size", "stride", "dilation"):
+            v = getattr(self, f)
+            if isinstance(v, (tuple, list)):
+                setattr(self, f, int(v[0]))
+        if not isinstance(self.padding, str) \
+                and isinstance(self.padding, (tuple, list)):
+            self.padding = int(self.padding[0])
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        from deeplearning4j_tpu.nn.conf.layers import conv_out_size
+        same = isinstance(self.padding, str) and self.padding.lower() == "same"
+        pad = 0 if isinstance(self.padding, str) else self.padding
+        t = conv_out_size(input_type.timeseries_length, self.kernel_size,
+                          self.stride, pad, self.dilation, same) \
+            if input_type.timeseries_length else None
+        return InputType.recurrent(self.n_out, t)
+
+    def param_shapes(self):
+        k = self.kernel_size
+        shapes = {"dW": (k, self.n_in, self.depth_multiplier),
+                  "pW": (self.n_in * self.depth_multiplier, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        import jax
+
+        k1, k2 = jax.random.split(key)
+        k = self.kernel_size
+        p = {"dW": _winit.init(self.weight_init, k1,
+                               (k, self.n_in, self.depth_multiplier),
+                               k * self.n_in, k * self.depth_multiplier),
+             "pW": _winit.init(self.weight_init, k2,
+                               (self.n_in * self.depth_multiplier,
+                                self.n_out),
+                               self.n_in * self.depth_multiplier,
+                               self.n_out)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init)
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        x = self._maybe_dropout(x, training, rng)
+        if isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            pad = [(self.padding, self.padding), (0, 0)]
+        x4 = x[:, :, None, :]                              # (N,T,1,C)
+        dw = params["dW"][:, None, :, :]                   # (k,1,C,dm)
+        z = exec_op("depthwise_conv2d", x4, dw,
+                    strides=(self.stride, 1), padding=pad,
+                    dilation=(self.dilation, 1))
+        z = z[:, :, 0, :]                                  # (N,T',C*dm)
+        z = z @ params["pW"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over (N,T,H,W,C) sequences (Keras ConvLSTM2D;
+    net-new vs the reference, which has no conv-recurrent layer). Gates are
+    2-D convs instead of matmuls; the time loop is one lax.scan so the
+    whole sequence compiles to a single XLA while with MXU conv steps.
+    Gate order i,f,c,o (Keras kernel layout) split on the channel axis."""
+    n_out: int = 1                       # filters
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Any = "valid"
+    n_in: Optional[int] = None
+    has_bias: bool = True
+    return_sequences: bool = False
+    recurrent_activation: str = "sigmoid"
+
+    def __post_init__(self):
+        self.kernel_size = (self.kernel_size,) * 2 \
+            if isinstance(self.kernel_size, int) else tuple(self.kernel_size)
+        self.stride = (self.stride,) * 2 \
+            if isinstance(self.stride, int) else tuple(self.stride)
+        if not (isinstance(self.padding, str)
+                and self.padding.lower() in ("same", "valid")):
+            raise ValueError(
+                f"ConvLSTM2D: padding must be 'same' or 'valid' (got "
+                f"{self.padding!r}); explicit numeric padding is not "
+                f"implemented for the recurrent conv")
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def set_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            self.n_in = input_type.channels
+
+    def _spatial(self, input_type):
+        from deeplearning4j_tpu.nn.conf.layers import conv_out_size
+        same = isinstance(self.padding, str) \
+            and self.padding.lower() == "same"
+        h = conv_out_size(input_type.height, self.kernel_size[0],
+                          self.stride[0], 0, 1, same)
+        w = conv_out_size(input_type.width, self.kernel_size[1],
+                          self.stride[1], 0, 1, same)
+        return h, w
+
+    def output_type(self, input_type: InputType) -> InputType:
+        h, w = self._spatial(input_type)
+        if self.return_sequences:
+            return InputType.convolutional3d(input_type.depth, h, w,
+                                             self.n_out)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, self.n_in, 4 * self.n_out),
+                  "RW": (kh, kw, self.n_out, 4 * self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (4 * self.n_out,)
+        return shapes
+
+    def init_params(self, key):
+        import jax
+
+        k1, k2 = jax.random.split(key)
+        kh, kw = self.kernel_size
+        f = self.n_out
+        p = {"W": _winit.init(self.weight_init, k1,
+                              (kh, kw, self.n_in, 4 * f),
+                              kh * kw * self.n_in, kh * kw * f),
+             "RW": _winit.init(self.weight_init, k2,
+                               (kh, kw, f, 4 * f), kh * kw * f, kh * kw * f)}
+        if self.has_bias:
+            b = jnp.zeros((4 * f,))
+            p["b"] = b.at[f:2 * f].set(1.0)   # unit forget-gate bias
+        return p
+
+    def apply(self, params, x, training=False, rng=None, state=None):
+        import jax
+        from jax import lax
+
+        x = self._maybe_dropout(x, training, rng)
+        from deeplearning4j_tpu.ops.registry import exec_op as _eop
+        pad = (self.padding.upper() if isinstance(self.padding, str)
+               else "VALID")
+        f = self.n_out
+        rec_act = {"sigmoid": jax.nn.sigmoid,
+                   "hard_sigmoid": jax.nn.hard_sigmoid}.get(
+                       self.recurrent_activation, jax.nn.sigmoid)
+
+        # input convs for ALL timesteps in one batched conv (MXU-friendly):
+        # (N,T,H,W,C) -> (N*T,H,W,C) -> conv -> (N,T,H',W',4F)
+        n, t = x.shape[0], x.shape[1]
+        xc = _eop("conv2d", x.reshape((n * t,) + x.shape[2:]), params["W"],
+                  params.get("b"), strides=self.stride, padding=pad)
+        xc = xc.reshape((n, t) + xc.shape[1:])
+        h0 = jnp.zeros((n,) + xc.shape[2:4] + (f,), x.dtype)
+        c0 = jnp.zeros_like(h0)
+
+        def step(carry, xc_t):
+            h_prev, c_prev = carry
+            z = xc_t + _eop("conv2d", h_prev, params["RW"], None,
+                            strides=(1, 1), padding="SAME")
+            i, fg, g, o = jnp.split(z, 4, axis=-1)
+            c = rec_act(fg) * c_prev + rec_act(i) * self._act(g)
+            h = rec_act(o) * self._act(c)
+            return (h, c), h
+
+        (h_t, _), hs = lax.scan(step, (h0, c0), jnp.moveaxis(xc, 1, 0))
+        if self.return_sequences:
+            return jnp.moveaxis(hs, 0, 1), state
+        return h_t, state

@@ -16,6 +16,7 @@ import dataclasses
 import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -102,6 +103,11 @@ class ElementWiseVertex(GraphVertex):
             out = inputs[0]
             for x in inputs[1:]:
                 out = jnp.maximum(out, x)
+            return out
+        if op == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
             return out
         raise ValueError(f"Unknown ElementWiseVertex op {self.op!r}")
 
@@ -216,6 +222,105 @@ class L2Vertex(GraphVertex):
 
     def output_type(self, input_types):
         return InputType.feed_forward(1)
+
+
+@register_vertex
+@dataclasses.dataclass
+class DotVertex(GraphVertex):
+    """Keras ``Dot`` merge: batch_dot of two inputs contracting ``axes``
+    (no reference DL4J analog — imported Keras functional graphs need it).
+    Output is (N, *rest_a, *rest_b) — e.g. two (N,T,D) inputs with axes=2
+    give the (N,T,T) similarity matrix; rank-2 inputs give (N,1) like
+    Keras. ``normalize`` L2-normalizes along the dot axes first (cosine
+    proximity)."""
+    axes: int = -1
+    normalize: bool = False
+
+    def _axes(self, ndim_a, ndim_b):
+        if isinstance(self.axes, (tuple, list)):
+            ax_a, ax_b = self.axes
+        else:
+            ax_a = ax_b = self.axes
+        return ax_a % ndim_a, ax_b % ndim_b
+
+    def apply(self, inputs):
+        from jax import lax
+
+        a, b = inputs[0], inputs[1]
+        ax_a, ax_b = self._axes(a.ndim, b.ndim)
+        if self.normalize:
+            a = a / jnp.maximum(jnp.linalg.norm(a, axis=ax_a, keepdims=True),
+                                1e-12)
+            b = b / jnp.maximum(jnp.linalg.norm(b, axis=ax_b, keepdims=True),
+                                1e-12)
+        out = lax.dot_general(a, b, (((ax_a,), (ax_b,)), ((0,), (0,))))
+        if out.ndim == 1:                       # rank-2 inputs: Keras (N,1)
+            out = out[:, None]
+        return out
+
+    def output_type(self, input_types):
+        ta, tb = input_types[0], input_types[1]
+        if ta.kind == "ff" and tb.kind == "ff":
+            return InputType.feed_forward(1)
+        if ta.kind == "rnn" and tb.kind == "rnn":
+            # (N,T,D)·(N,T',D) over the feature axis → (N,T,T')
+            ax_a, ax_b = self._axes(3, 3)
+            if ax_a == 2 and ax_b == 2:
+                return InputType.recurrent(tb.timeseries_length,
+                                           ta.timeseries_length)
+            # contracting time: (N,D,D')
+            return InputType.recurrent(tb.size, ta.size)
+        raise ValueError(
+            f"DotVertex: unsupported input kinds ({ta.kind}, {tb.kind})")
+
+
+@register_vertex
+@dataclasses.dataclass
+class DotProductAttentionVertex(GraphVertex):
+    """Dot-product attention over [query, value] or [query, value, key]
+    (Keras ``Attention`` layer with use_scale=False; no DL4J analog —
+    imported Keras functional graphs need it). q:(N,Tq,d), v:(N,Tv,dv),
+    k:(N,Tv,d); scores=q·kᵀ, softmax over keys, out=probs·v."""
+    causal: bool = False
+
+    def apply(self, inputs):
+        q, v = inputs[0], inputs[1]
+        k = inputs[2] if len(inputs) > 2 else v
+        s = jnp.einsum("nqd,nkd->nqk", q, k)
+        if self.causal:
+            tq, tk = s.shape[1], s.shape[2]
+            mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+            s = jnp.where(mask[None], s, -1e9)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("nqk,nkd->nqd", p, v)
+
+    def output_type(self, input_types):
+        return InputType.recurrent(input_types[1].size,
+                                   input_types[0].timeseries_length)
+
+
+@register_vertex
+@dataclasses.dataclass
+class AdditiveAttentionVertex(GraphVertex):
+    """Bahdanau-style additive attention over [query, value] (Keras
+    ``AdditiveAttention`` with use_scale=False): scores are
+    sum(tanh(q + k)) over features."""
+    causal: bool = False
+
+    def apply(self, inputs):
+        q, v = inputs[0], inputs[1]
+        k = inputs[2] if len(inputs) > 2 else v
+        s = jnp.sum(jnp.tanh(q[:, :, None, :] + k[:, None, :, :]), axis=-1)
+        if self.causal:
+            tq, tk = s.shape[1], s.shape[2]
+            mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+            s = jnp.where(mask[None], s, -1e9)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("nqk,nkd->nqd", p, v)
+
+    def output_type(self, input_types):
+        return InputType.recurrent(input_types[1].size,
+                                   input_types[0].timeseries_length)
 
 
 @register_vertex

@@ -92,6 +92,9 @@ SPECS = {
                      _x((2, 5, 3)), {}),
     "SelfAttentionLayer": (lambda: L.SelfAttentionLayer(
         n_in=4, n_out=4, n_heads=2, head_size=2), _x((2, 5, 4)), {}),
+    "SelfAttentionBias": (lambda: L.SelfAttentionLayer(
+        n_in=4, n_out=4, n_heads=2, head_size=2, qkv_bias=True),
+        _x((2, 5, 4)), {}),
     "MaskedLSTM": (lambda: L.LSTM(n_in=3, n_out=4), _x((2, 5, 3)),
                    {"mask": np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
                                      F32)}),
@@ -121,6 +124,18 @@ SPECS = {
         _x((2, 4, 4, 2)), {}),
     "LocallyConnected1D": (lambda: L.LocallyConnected1D(
         kernel_size=2, n_in=3, n_out=4, input_size=5), _x((2, 5, 3)), {}),
+    "SeparableConvolution1D": (lambda: L.SeparableConvolution1D(
+        kernel_size=3, n_in=2, n_out=3, depth_multiplier=2),
+        _x((2, 6, 2)), {}),
+    "Deconvolution3D": (lambda: L.Deconvolution3D(
+        kernel_size=(2, 2, 2), stride=(2, 2, 2), n_in=2, n_out=2),
+        _x((2, 2, 2, 2, 2)), {}),
+    "ConvLSTM2D": (lambda: L.ConvLSTM2D(
+        n_out=2, kernel_size=(2, 2), padding="same", n_in=2),
+        _x((2, 3, 3, 3, 2)), {}),
+    "ConvLSTM2DSeq": (lambda: L.ConvLSTM2D(
+        n_out=2, kernel_size=(2, 2), padding="same", n_in=2,
+        return_sequences=True), _x((2, 3, 3, 3, 2)), {}),
     "Cropping1D": (lambda: L.Cropping1D(cropping=(1, 1)),
                    _x((2, 5, 3)), {}),
     "Cropping3D": (lambda: L.Cropping3D(cropping=(1, 0, 1, 0, 0, 1)),
