@@ -65,6 +65,10 @@ def _padding(cfg) -> object:
     return "same" if cfg.get("padding", "valid") == "same" else 0
 
 
+def _as_seq(v):
+    return v if isinstance(v, (list, tuple)) else (v,)
+
+
 class _H5Weights:
     """Per-layer weight lookup that tolerates the nested group layouts of
     Keras 2 (`layer/layer/kernel:0`) and Keras 3 (`layer/model/layer/kernel`)."""
@@ -191,6 +195,12 @@ def _map_layer(cls: str, cfg: dict):
             dilation=_pair(cfg.get("dilation_rate", 1)),
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "Conv2DTranspose":
+        if any(int(p) != 0 for p in (cfg.get("output_padding") or ())) \
+                or any(int(d) != 1
+                       for d in _as_seq(cfg.get("dilation_rate", 1))):
+            raise UnsupportedKerasConfigurationException(
+                "Conv2DTranspose: output_padding/dilation_rate are not "
+                "supported — re-save with the defaults")
         return L.Deconvolution2D(
             name=name, n_out=cfg["filters"],
             kernel_size=_pair(cfg["kernel_size"]),
@@ -204,15 +214,25 @@ def _map_layer(cls: str, cfg: dict):
             depth_multiplier=cfg.get("depth_multiplier", 1),
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "SeparableConv1D":
-        # __post_init__ normalizes list/tuple kernel/stride/dilation to int
+        # __post_init__ normalizes list/tuple kernel/stride/dilation to int;
+        # "same"/"causal" pass through as strings (the layer left-pads for
+        # causal), anything else is valid = 0
+        pad = cfg.get("padding", "valid")
         return L.SeparableConvolution1D(
             name=name, n_out=cfg["filters"],
             kernel_size=cfg["kernel_size"],
             stride=cfg.get("strides", 1),
             dilation=cfg.get("dilation_rate", 1),
             depth_multiplier=cfg.get("depth_multiplier", 1),
-            padding=_padding(cfg), activation=act, has_bias=use_bias)
+            padding=pad if pad in ("same", "causal") else 0,
+            activation=act, has_bias=use_bias)
     if cls == "Conv3DTranspose":
+        if any(int(p) != 0 for p in (cfg.get("output_padding") or ())) \
+                or any(int(d) != 1
+                       for d in _as_seq(cfg.get("dilation_rate", 1))):
+            raise UnsupportedKerasConfigurationException(
+                "Conv3DTranspose: output_padding/dilation_rate are not "
+                "supported — re-save with the defaults")
         return L.Deconvolution3D(
             name=name, n_out=cfg["filters"],
             kernel_size=tuple(cfg["kernel_size"]),
@@ -222,6 +242,15 @@ def _map_layer(cls: str, cfg: dict):
         if cfg.get("go_backwards") or cfg.get("stateful"):
             raise UnsupportedKerasConfigurationException(
                 "ConvLSTM2D: go_backwards/stateful unsupported")
+        if any(int(d) != 1 for d in _as_seq(cfg.get("dilation_rate", 1))):
+            raise UnsupportedKerasConfigurationException(
+                "ConvLSTM2D: dilation_rate != 1 unsupported")
+        if cfg.get("recurrent_activation", "sigmoid") not in (
+                "sigmoid", "hard_sigmoid"):
+            raise UnsupportedKerasConfigurationException(
+                f"ConvLSTM2D: recurrent_activation "
+                f"{cfg.get('recurrent_activation')!r} unsupported "
+                f"(sigmoid/hard_sigmoid only)")
         return L.ConvLSTM2D(
             name=name, n_out=cfg["filters"],
             kernel_size=_pair(cfg["kernel_size"]),

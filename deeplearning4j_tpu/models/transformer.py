@@ -317,20 +317,31 @@ class TransformerLM:
         mask = jax.random.bernoulli(jax.random.fold_in(rng, i), keep, x.shape)
         return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
+    def _zero_aux(self):
+        """Per-block aux-telemetry zeros: (aux_loss, dropped_fraction,
+        expert_fraction (E,)) — fixed pytree so lax.scan carries it."""
+        c = self.config
+        e = c.moe.num_experts if c.moe is not None else 0
+        return (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                jnp.zeros((e,), jnp.float32))
+
     def _block_math(self, blk, x, rng, li, mesh):
         """One transformer block. ``mesh=None`` inside the pipeline body
         (sharding constraints/collectives are owned by shard_map there).
-        Returns (x, moe_aux_loss) — aux is 0.0 for the dense FFN."""
+        Returns (x, aux) with aux = (moe_aux_loss, dropped_fraction,
+        expert_fraction) — zeros for the dense FFN."""
         c = self.config
         a = self._attn(blk["attn"], self._ln(blk["ln1"], x), mesh)
         x = x + self._dropout(a, rng, 2 * li + 1)
         if mesh is not None:
             x = self._constrain(x)
         h = self._ln(blk["ln2"], x)
-        aux = jnp.zeros((), jnp.float32)
+        aux = self._zero_aux()
         if c.moe is not None:
             y, stats = moe_ffn(blk["moe"], h, c.moe, mesh)
-            aux = stats["aux_loss"].astype(jnp.float32)
+            aux = (stats["aux_loss"].astype(jnp.float32),
+                   stats["dropped_fraction"].astype(jnp.float32),
+                   stats["expert_fraction"].astype(jnp.float32))
         else:
             hdn = jax.nn.gelu(h @ blk["mlp"]["w_up"] + blk["mlp"]["b_up"])
             y = hdn @ blk["mlp"]["w_down"] + blk["mlp"]["b_down"]
@@ -393,7 +404,7 @@ class TransformerLM:
         x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
         x = self._dropout(x.astype(c.dtype), rng, 0)
         x = self._constrain(x)
-        aux_total = jnp.zeros((), jnp.float32)
+        aux_total = self._zero_aux()
 
         if (c.pipeline_stages > 1 and self.mesh is not None
                 and STAGE_AXIS in self.mesh.axis_names):
@@ -407,7 +418,7 @@ class TransformerLM:
                 if c.remat:
                     body = jax.checkpoint(body)
                 x, a = body(blk, x)
-                return (x, aux + a), None
+                return (x, jax.tree.map(jnp.add, aux, a)), None
 
             li_idx = jnp.arange(c.n_layers)
             (x, aux_total), _ = lax.scan(scan_body, (x, aux_total),
@@ -432,13 +443,18 @@ class TransformerLM:
                     static_argnums=(2,))
                 for li, blk in enumerate(blocks):
                     x, a = body(blk, x, li)
-                    aux_total = aux_total + a
+                    aux_total = jax.tree.map(jnp.add, aux_total, a)
             else:
                 for li, blk in enumerate(blocks):
                     x, a = self._block_math(blk, x, rng, li, self.mesh)
-                    aux_total = aux_total + a
+                    aux_total = jax.tree.map(jnp.add, aux_total, a)
         x = self._ln(params["ln_f"], x)
-        return x, params["tok_emb"], {"moe_aux_loss": aux_total}
+        aux_loss, dropped, frac = aux_total
+        n_moe = max(1, c.n_layers)        # per-layer means for telemetry
+        return x, params["tok_emb"], {
+            "moe_aux_loss": aux_loss,
+            "moe_dropped_fraction": dropped / n_moe,
+            "moe_expert_fraction": frac / n_moe}
 
     def apply(self, params, tokens, rng=None, return_aux=False):
         """tokens (B, T) int32 → logits (B, T, V). ``rng`` enables dropout

@@ -848,7 +848,9 @@ class SeparableConvolution1D(Layer):
 
     def output_type(self, input_type: InputType) -> InputType:
         from deeplearning4j_tpu.nn.conf.layers import conv_out_size
-        same = isinstance(self.padding, str) and self.padding.lower() == "same"
+        # same AND causal preserve ceil(T/s); "valid" string = zero pad
+        same = isinstance(self.padding, str) \
+            and self.padding.lower() in ("same", "causal")
         pad = 0 if isinstance(self.padding, str) else self.padding
         t = conv_out_size(input_type.timeseries_length, self.kernel_size,
                           self.stride, pad, self.dilation, same) \
@@ -882,7 +884,11 @@ class SeparableConvolution1D(Layer):
 
     def apply(self, params, x, training=False, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        if isinstance(self.padding, str):
+        if isinstance(self.padding, str) \
+                and self.padding.lower() == "causal":
+            # left-pad so step t sees only inputs ≤ t (Keras causal)
+            pad = [((self.kernel_size - 1) * self.dilation, 0), (0, 0)]
+        elif isinstance(self.padding, str):
             pad = self.padding.upper()
         else:
             pad = [(self.padding, self.padding), (0, 0)]
@@ -983,9 +989,14 @@ class ConvLSTM2D(Layer):
         pad = (self.padding.upper() if isinstance(self.padding, str)
                else "VALID")
         f = self.n_out
-        rec_act = {"sigmoid": jax.nn.sigmoid,
-                   "hard_sigmoid": jax.nn.hard_sigmoid}.get(
-                       self.recurrent_activation, jax.nn.sigmoid)
+        rec_acts = {"sigmoid": jax.nn.sigmoid,
+                    "hard_sigmoid": jax.nn.hard_sigmoid}
+        if self.recurrent_activation not in rec_acts:
+            raise ValueError(
+                f"ConvLSTM2D: recurrent_activation "
+                f"{self.recurrent_activation!r} unsupported "
+                f"(sigmoid/hard_sigmoid)")
+        rec_act = rec_acts[self.recurrent_activation]
 
         # input convs for ALL timesteps in one batched conv (MXU-friendly):
         # (N,T,H,W,C) -> (N*T,H,W,C) -> conv -> (N,T,H',W',4F)

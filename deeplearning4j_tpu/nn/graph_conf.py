@@ -274,6 +274,16 @@ class DotVertex(GraphVertex):
             f"DotVertex: unsupported input kinds ({ta.kind}, {tb.kind})")
 
 
+def _attend(scores, v, causal: bool):
+    """Shared mask→softmax→combine tail of the attention vertices."""
+    if causal:
+        tq, tk = scores.shape[1], scores.shape[2]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask[None], scores, -1e9)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("nqk,nkd->nqd", p, v)
+
+
 @register_vertex
 @dataclasses.dataclass
 class DotProductAttentionVertex(GraphVertex):
@@ -286,13 +296,7 @@ class DotProductAttentionVertex(GraphVertex):
     def apply(self, inputs):
         q, v = inputs[0], inputs[1]
         k = inputs[2] if len(inputs) > 2 else v
-        s = jnp.einsum("nqd,nkd->nqk", q, k)
-        if self.causal:
-            tq, tk = s.shape[1], s.shape[2]
-            mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
-            s = jnp.where(mask[None], s, -1e9)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("nqk,nkd->nqd", p, v)
+        return _attend(jnp.einsum("nqd,nkd->nqk", q, k), v, self.causal)
 
     def output_type(self, input_types):
         return InputType.recurrent(input_types[1].size,
@@ -311,12 +315,7 @@ class AdditiveAttentionVertex(GraphVertex):
         q, v = inputs[0], inputs[1]
         k = inputs[2] if len(inputs) > 2 else v
         s = jnp.sum(jnp.tanh(q[:, :, None, :] + k[:, None, :, :]), axis=-1)
-        if self.causal:
-            tq, tk = s.shape[1], s.shape[2]
-            mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
-            s = jnp.where(mask[None], s, -1e9)
-        p = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("nqk,nkd->nqd", p, v)
+        return _attend(s, v, self.causal)
 
     def output_type(self, input_types):
         return InputType.recurrent(input_types[1].size,

@@ -45,9 +45,10 @@ def _rss_mib() -> float:
 # caches accumulate can exhaust a small box (the round-3 judge run segfaulted
 # inside XLA compilation at ~96% of the suite on a 1-core container).
 _HEAVY_MODULES = {
-    "test_zoo", "test_bert_base_full", "test_bert_import", "test_e2e",
+    "test_zoo", "test_bert_base_full", "test_bert_import",
     "test_keras_import", "test_tf_import_corpus", "test_onnx_import",
-    "test_multihost", "test_transformer", "test_pipeline_parallel",
+    "test_multihost", "test_parallel", "test_compose",
+    "test_multidevice_products", "test_training_products",
 }
 
 

@@ -522,6 +522,30 @@ class TestLongTailLayers:
         assert got.shape == want.shape
         assert np.allclose(got, want, atol=1e-4), np.abs(got - want).max()
 
+    def test_separable_conv1d_causal_semantics(self):
+        """padding='causal' must left-pad by (k-1)*dilation (this tf.keras
+        build rejects causal on SeparableConv1D, so the reference here is a
+        manually left-padded VALID conv — Keras's own causal definition)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.layers2 import SeparableConvolution1D
+
+        x = np.random.RandomState(8).rand(2, 8, 3).astype("f4")
+        lc = SeparableConvolution1D(kernel_size=3, dilation=2, n_in=3,
+                                    n_out=4, padding="causal",
+                                    weight_init="xavier")
+        p = lc.init_params(jax.random.key(0))
+        got, _ = lc.apply(p, jnp.asarray(x))
+        lv = SeparableConvolution1D(kernel_size=3, dilation=2, n_in=3,
+                                    n_out=4, padding=0,
+                                    weight_init="xavier")
+        xp = np.pad(x, ((0, 0), (4, 0), (0, 0)))   # (k-1)*d = 4, left only
+        want, _ = lv.apply(p, jnp.asarray(xp))
+        assert got.shape == (2, 8, 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
     def test_conv_lstm_2d(self, tmp_path):
         for ret_seq in (False, True):
             m = tf.keras.Sequential([

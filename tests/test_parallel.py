@@ -572,6 +572,44 @@ class TestMoEInFlagship:
         # balance; it must be present, finite and near its floor by design
         assert all(np.isfinite(a) and a > 0.5 for a in auxes), auxes
         assert "lm_loss" in metrics
+        # load-balance telemetry rides the metrics (VERDICT r3 #10):
+        # drop rate scalar + per-expert routed fractions summing to ≤ 1
+        assert 0.0 <= float(metrics["moe_dropped_fraction"]) <= 1.0
+        frac = np.asarray(metrics["moe_expert_fraction"])
+        assert frac.shape == (4,)
+        assert 0.0 <= float(frac.sum()) <= 1.0 + 1e-5
+
+    def test_capacity_sweep_drop_rate_telemetry(self):
+        """Capacity sweep (VERDICT r3 #10): as capacity_factor rises the
+        measured dropped_fraction falls monotonically to 0 — the telemetry
+        is real measurement, not a constant."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu.parallel.moe import (MoEConfig,
+                                                     init_moe_params,
+                                                     moe_ffn)
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 16, 8)), jnp.float32)
+        drops = []
+        for cf in (0.25, 0.5, 1.0, 4.0):
+            cfg = MoEConfig(d_model=8, d_ff=16, num_experts=4,
+                            capacity_factor=cf)
+            params = init_moe_params(cfg, jax.random.key(1))
+            _, stats = moe_ffn(params, x, cfg)
+            drops.append(float(stats["dropped_fraction"]))
+        assert all(a >= b - 1e-6 for a, b in zip(drops, drops[1:])), drops
+        assert drops[0] > 0.0, ("cf=0.25 must drop tokens on a random "
+                                "router", drops)
+        assert drops[-1] == 0.0, drops
+        # routed fractions are a distribution over experts (minus drops)
+        cfg = MoEConfig(d_model=8, d_ff=16, num_experts=4,
+                        capacity_factor=4.0)
+        params = init_moe_params(cfg, jax.random.key(1))
+        _, stats = moe_ffn(params, x, cfg)
+        assert abs(float(jnp.sum(stats["expert_fraction"])) - 1.0) < 1e-5
 
     def test_ep_sharded_loss_matches_unsharded(self):
         import jax
