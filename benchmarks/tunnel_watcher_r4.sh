@@ -47,6 +47,10 @@ while true; do
       > benchmarks/ring_memory_live.txt 2>> "$LOG" \
       && echo "[watcher-r4] ring memory done" >> "$LOG"
 
+    timeout 1200 python benchmarks/zoo_fullsize_step.py \
+      > benchmarks/zoo_fullsize_live.txt 2>> "$LOG" \
+      && echo "[watcher-r4] zoo fullsize done: $(cat benchmarks/zoo_fullsize_live.txt)" >> "$LOG"
+
     if [ -f BENCH_r04_live.json ] && [ -f BENCH_r04_resnet.json ] && [ -f BENCH_r04_bert.json ]; then
       echo "[watcher-r4] all captures complete $(date -u +%H:%M:%S)" >> "$LOG"
       exit 0
