@@ -47,9 +47,13 @@ while true; do
       > benchmarks/ring_memory_live.txt 2>> "$LOG" \
       && echo "[watcher-r4] ring memory done" >> "$LOG"
 
-    timeout 1200 python benchmarks/zoo_fullsize_step.py \
-      > benchmarks/zoo_fullsize_live.txt 2>> "$LOG" \
-      && echo "[watcher-r4] zoo fullsize done: $(cat benchmarks/zoo_fullsize_live.txt)" >> "$LOG"
+    if [ ! -f benchmarks/zoo_fullsize_live.txt ] || ! grep -q '"finite": true' benchmarks/zoo_fullsize_live.txt; then
+      timeout 1200 python benchmarks/zoo_fullsize_step.py \
+        > benchmarks/zoo_fullsize_live.txt.tmp 2>> "$LOG" \
+        && grep -q '"metric"' benchmarks/zoo_fullsize_live.txt.tmp \
+        && mv benchmarks/zoo_fullsize_live.txt.tmp benchmarks/zoo_fullsize_live.txt \
+        && echo "[watcher-r4] zoo fullsize done: $(cat benchmarks/zoo_fullsize_live.txt)" >> "$LOG"
+    fi
 
     if [ -f BENCH_r04_live.json ] && [ -f BENCH_r04_resnet.json ] && [ -f BENCH_r04_bert.json ]; then
       echo "[watcher-r4] all captures complete $(date -u +%H:%M:%S)" >> "$LOG"

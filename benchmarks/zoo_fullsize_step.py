@@ -60,6 +60,7 @@ def main():
 
     for name in args.models:
         t0 = time.perf_counter()
+        m = net = None
         try:
             m = getattr(zoo, name)(num_classes=classes,
                                    input_shape=(side, side, 3),
@@ -89,7 +90,7 @@ def main():
                 "platform": platform, "error": str(e)[:300],
             }), flush=True)
         # free the model's buffers before the next architecture compiles
-        del m, net
+        m = net = None
         import gc
         gc.collect()
         jax.clear_caches()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import html as _html
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -36,6 +37,55 @@ def _svg_histogram(counts, lo, hi, width=220, height=80, title="") -> str:
             f'<text x="{width - 40}" y="{height - 3}" font-size="9">{hi:.3g}</text>'
             f'<text x="2" y="10" font-size="10">{_html.escape(title)}</text>'
             f'</svg>')
+
+
+_SERIES_COLORS = ("#1f77b4", "#ff7f0e", "#2ca02c", "#d62728", "#9467bd",
+                  "#8c564b", "#e377c2", "#7f7f7f", "#bcbd22", "#17becf")
+
+
+def _svg_multi_line(xs, series, width=720, height=240, pad=36,
+                    title="") -> str:
+    """Multi-series line chart with a legend (the reference overview tab's
+    log10 update:parameter ratio chart shape). ``series``: {name: [y...]}."""
+    all_y = [y for ys in series.values() for y in ys
+             if y is not None and math.isfinite(y)]
+    if not xs or not all_y:
+        return "<p>(no data)</p>"
+    lo, hi = min(all_y), max(all_y)
+    span = (hi - lo) or 1.0
+    x0, x1 = min(xs), max(xs)
+    xspan = (x1 - x0) or 1
+    polys, legends = "", ""
+    for i, (name, ys) in enumerate(sorted(series.items())):
+        c = _SERIES_COLORS[i % len(_SERIES_COLORS)]
+        pts = " ".join(
+            f"{pad + (x - x0) / xspan * (width - 2 * pad):.1f},"
+            f"{height - pad - (y - lo) / span * (height - 2 * pad):.1f}"
+            for x, y in zip(xs, ys)
+            if y is not None and math.isfinite(y))
+        polys += (f'<polyline points="{pts}" fill="none" stroke="{c}" '
+                  f'stroke-width="1.5"/>')
+        ly = 14 + i * 14
+        legends += (f'<rect x="{width - pad + 4}" y="{ly - 8}" width="10" '
+                    f'height="10" fill="{c}"/>'
+                    f'<text x="{width - pad + 18}" y="{ly}" font-size="10">'
+                    f'{_html.escape(str(name))}</text>')
+    return (
+        f'<svg width="{width + 140}" height="{height}" '
+        f'xmlns="http://www.w3.org/2000/svg">'
+        f'<text x="{pad}" y="14" font-size="12">{_html.escape(title)}</text>'
+        f'<line x1="{pad}" y1="{height - pad}" x2="{width - pad}" '
+        f'y2="{height - pad}" stroke="#999"/>'
+        f'<line x1="{pad}" y1="{pad}" x2="{pad}" y2="{height - pad}" '
+        f'stroke="#999"/>'
+        f'<text x="{pad}" y="{height - pad + 14}" font-size="10">{x0}</text>'
+        f'<text x="{width - pad}" y="{height - pad + 14}" font-size="10" '
+        f'text-anchor="end">{x1}</text>'
+        f'<text x="{pad - 4}" y="{height - pad}" font-size="10" '
+        f'text-anchor="end">{lo:.3g}</text>'
+        f'<text x="{pad - 4}" y="{pad + 4}" font-size="10" '
+        f'text-anchor="end">{hi:.3g}</text>'
+        f'{polys}{legends}</svg>')
 
 
 def _svg_line_chart(xs, ys, width=720, height=240, pad=36) -> str:
@@ -161,16 +211,43 @@ class UIServer:
                              f"<td>{s.get('mean', 0):.3e}</td>"
                              f"<td>{s.get('stdev', 0):.3e}</td>"
                              f"<td>{a_hist}</td></tr>")
+        # ---- log10 update:parameter ratio over time — the reference
+        # overview tab's signature debugging chart (a healthy net sits
+        # around 1e-3; flat-at-zero or exploding lines localize the layer)
+        ratio_series: dict = {}
+        ratio_xs = []
+        for u in ups:
+            if "updates" not in u or "parameters" not in u:
+                continue
+            ratio_xs.append(u["iteration"])
+            n = len(ratio_xs)
+            for name, ps in u["parameters"].items():
+                us = u["updates"].get(name, {})
+                r = (us.get("meanMagnitude", 0.0)
+                     / max(ps.get("meanMagnitude", 0.0), 1e-12))
+                ys_l = ratio_series.setdefault(name, [])
+                ys_l.extend([None] * (n - 1 - len(ys_l)))  # gap-fill late
+                ys_l.append(math.log10(r) if r > 0 else None)
+            for ys_l in ratio_series.values():             # absent this it
+                ys_l.extend([None] * (n - len(ys_l)))
+        ratio_chart = ""
+        if ratio_xs:
+            ratio_chart = ("<h3>log10 update : parameter ratio</h3>"
+                           + _svg_multi_line(ratio_xs, ratio_series))
         from urllib.parse import quote
         session_links = " ".join(
             f'<a href="/?sid={quote(s)}">{_html.escape(s)}</a>'
             for s in sessions)
         safe_sid = _html.escape(sid) if sid else "no session"
         return (
-            "<html><head><title>DL4J-TPU Training UI</title></head><body>"
-            f"<h2>Training UI</h2><p>Sessions: {session_links}</p>"
+            "<html><head><title>DL4J-TPU Training UI</title>"
+            '<meta http-equiv="refresh" content="10"></head><body>'
+            f"<h2>Training UI</h2><p>Sessions: {session_links} | "
+            f'<a href="/train/system">system</a> '
+            f"(auto-refresh 10s)</p>"
             f"<h3>{safe_sid} — {len(ups)} updates</h3>"
             + _svg_line_chart(xs, ys)
+            + ratio_chart
             + "<h3>Layer parameters (latest)</h3>"
               "<table border=1 cellpadding=4><tr><th>param</th>"
               "<th>mean |w|</th><th>stdev</th><th>update/param ratio</th>"
@@ -182,6 +259,37 @@ class UIServer:
                f"</tr>{act_rows}</table>" if act_rows else "")
             + model_svg
             + "</body></html>")
+
+    def render_system(self) -> str:
+        """The System tab (ref: the Vert.x app's hardware/memory page):
+        host + device snapshot recorded by StatsListener at session start."""
+        rows = ""
+        for sid in self._sessions():
+            info = next((u["systemInfo"] for u in self._updates(sid)
+                         if "systemInfo" in u), None)
+            if not info:
+                continue
+            info = dict(info)               # never mutate the stored record
+            devs = info.pop("devices", [])
+            kv = "".join(f"<tr><td>{_html.escape(str(k))}</td>"
+                         f"<td>{_html.escape(str(v))}</td></tr>"
+                         for k, v in info.items())
+            drows = "".join(
+                f"<tr><td>{d.get('id')}</td>"
+                f"<td>{_html.escape(str(d.get('kind', '')))}</td>"
+                f"<td>{d.get('memBytesInUse', '—')}</td>"
+                f"<td>{d.get('memBytesLimit', '—')}</td></tr>"
+                for d in devs)
+            rows += (f"<h3>{_html.escape(sid)}</h3>"
+                     f"<table border=1 cellpadding=4>{kv}</table>"
+                     + (f"<h4>Devices</h4><table border=1 cellpadding=4>"
+                        f"<tr><th>id</th><th>kind</th><th>mem in use</th>"
+                        f"<th>mem limit</th></tr>{drows}</table>"
+                        if drows else ""))
+        return ("<html><head><title>System</title></head><body>"
+                '<h2>System</h2><p><a href="/">overview</a></p>'
+                + (rows or "<p>(no system info recorded)</p>")
+                + "</body></html>")
 
     # --------------------------------------------------------------- serve
     def start(self):
@@ -217,6 +325,9 @@ class UIServer:
                 if parsed.path == "/train/sessions":
                     body = json.dumps(ui._sessions()).encode()
                     ctype = "application/json"
+                elif parsed.path == "/train/system":
+                    body = ui.render_system().encode()
+                    ctype = "text/html"
                 elif parsed.path == "/train/updates":
                     sid = q.get("sid", [None])[0]
                     body = json.dumps(ui._updates(sid)).encode()

@@ -69,6 +69,47 @@ class StatsListener(TrainingListener):
                 for name, nd in nodes.items()]
         return info or None
 
+    @staticmethod
+    def _system_info() -> dict:
+        """One-time host/device snapshot (ref: the System tab's
+        SystemInfo — JVM memory/hardware become process RSS + jax
+        devices/memory here)."""
+        import platform as _plat
+        import sys
+
+        import jax
+
+        info = {"python": sys.version.split()[0],
+                "jax": jax.__version__,
+                "host": _plat.node(),
+                "os": _plat.platform()}
+        try:
+            with open("/proc/self/statm") as f:
+                import os as _os
+                info["processRssMiB"] = round(
+                    int(f.read().split()[1])
+                    * _os.sysconf("SC_PAGE_SIZE") / 2**20, 1)
+        except Exception:
+            pass
+        try:
+            devs = jax.devices()
+            info["platform"] = devs[0].platform
+            info["deviceCount"] = len(devs)
+            dstats = []
+            for d in devs:
+                row = {"id": d.id, "kind": getattr(d, "device_kind", "")}
+                ms = d.memory_stats() or {} if hasattr(d, "memory_stats") \
+                    else {}
+                if ms.get("bytes_in_use") is not None:
+                    row["memBytesInUse"] = int(ms["bytes_in_use"])
+                if ms.get("bytes_limit"):
+                    row["memBytesLimit"] = int(ms["bytes_limit"])
+                dstats.append(row)
+            info["devices"] = dstats
+        except Exception:
+            pass
+        return info
+
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.update_frequency:
             return
@@ -83,6 +124,7 @@ class StatsListener(TrainingListener):
             info = self._model_info(model)
             if info:
                 record["modelInfo"] = info
+            record["systemInfo"] = self._system_info()
             self._sent_model_info = True
         if self.collect_histograms and hasattr(model, "paramTable"):
             params = {}

@@ -371,3 +371,35 @@ def test_device_profiler_produces_trace(tmp_path):
     # scoped annotation API is usable standalone
     with DeviceProfiler.annotate("section"):
         jax.block_until_ready(step(x))
+
+
+def test_ui_system_tab_and_ratio_chart():
+    """Round-4 D16 depth: the System tab serves the host/device snapshot
+    StatsListener records at session start, and the overview carries the
+    reference's log10 update:parameter ratio chart + auto-refresh."""
+    import urllib.request
+
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       UIServer)
+    storage = InMemoryStatsStorage()
+    net = _net()
+    net.setListeners(StatsListener(storage, session_id="sys"))
+    net.fit([_data()] * 3, epochs=2)
+
+    ups = storage.get_all_updates("sys")
+    info = next(u["systemInfo"] for u in ups if "systemInfo" in u)
+    assert info["deviceCount"] >= 1 and "jax" in info
+
+    server = UIServer(port=0).start()
+    try:
+        server.attach(storage)
+        html = urllib.request.urlopen(
+            server.get_address() + "/?sid=sys", timeout=5).read().decode()
+        assert "update : parameter ratio" in html
+        assert 'http-equiv="refresh"' in html
+        sys_html = urllib.request.urlopen(
+            server.get_address() + "/train/system",
+            timeout=5).read().decode()
+        assert "System" in sys_html and "deviceCount" in sys_html
+    finally:
+        server.stop()
