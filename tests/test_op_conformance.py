@@ -543,6 +543,88 @@ case("floor_int_passthrough", "to_int32",
      lambda x: x.astype(I32))
 
 
+
+
+# ---- round-4 tranche 2: scatter / morphology / image-box / ctc ----------
+def _torch():
+    import torch
+    return torch
+
+
+case("scatter_update", "scatter_update",
+     (np.zeros((5, 2), F32), np.array([3, 1]),
+      np.array([[1., 2.], [3., 4.]], F32)), {},
+     lambda r, i, u: _t(lambda a, b, c: tf.tensor_scatter_nd_update(
+         a, b[:, None], c), r, i, u))
+case("scatter_add_dup", "scatter_add",
+     (np.zeros((4,), F32), np.array([1, 1, 2]),
+      np.array([5., 6., 7.], F32)), {},
+     lambda r, i, u: _t(lambda a, b, c: tf.tensor_scatter_nd_add(
+         a, b[:, None], c), r, i, u))
+case("scatter_max", "scatter_max",
+     (np.ones((4,), F32), np.array([0, 0, 3]),
+      np.array([5., 2., -1.], F32)), {},
+     lambda r, i, u: _t(lambda a, b, c: tf.tensor_scatter_nd_max(
+         a, b[:, None], c), r, i, u))
+case("scatter_min", "scatter_min",
+     (np.ones((4,), F32), np.array([0, 0, 3]),
+      np.array([5., -2., 0.5], F32)), {},
+     lambda r, i, u: _t(lambda a, b, c: tf.tensor_scatter_nd_min(
+         a, b[:, None], c), r, i, u))
+case("scatter_sub", "scatter_sub",
+     (np.full((4,), 10.0, F32), np.array([2, 2]),
+      np.array([3., 4.], F32)), {},
+     lambda r, i, u: _t(lambda a, b, c: tf.tensor_scatter_nd_sub(
+         a, b[:, None], c), r, i, u))
+case("gather_elements", "gather_elements",
+     (x34, np.array([[0, 2, 1, 3], [3, 0, 0, 1], [2, 2, 2, 2]])),
+     {"axis": 1},
+     lambda x, i: np.take_along_axis(x, i, axis=1))
+
+_dil_img = rng.normal(size=(1, 6, 6, 2)).astype(F32)
+_dil_w = (rng.normal(size=(3, 3, 2)) * 0.2).astype(F32)
+case("dilation2d", "dilation2d", (_dil_img, _dil_w),
+     {"strides": (1, 1), "rates": (1, 1), "padding": "SAME"},
+     lambda x, w: _t(tf.nn.dilation2d, x, w, [1, 1, 1, 1], "SAME",
+                     "NHWC", [1, 1, 1, 1]))
+case("erosion2d", "erosion2d", (_dil_img, _dil_w),
+     {"strides": (1, 1), "rates": (1, 1), "padding": "SAME"},
+     lambda x, w: _t(tf.nn.erosion2d, x, w, [1, 1, 1, 1], "SAME",
+                     "NHWC", [1, 1, 1, 1]))
+
+_boxes = np.array([[0, 0, 1, 1], [0, 0, 0.9, 0.9], [0.5, 0.5, 1, 1],
+                   [0, 0.6, 0.4, 1.0]], F32)
+_scores = np.array([0.9, 0.8, 0.7, 0.6], F32)
+case("nms_indices", "non_max_suppression", (_boxes, _scores),
+     {"max_output_size": 4, "iou_threshold": 0.5},
+     lambda b, s: np.concatenate([
+         _t(tf.image.non_max_suppression, b, s, 4, 0.5),
+         -np.ones(4 - len(_t(tf.image.non_max_suppression, b, s, 4, 0.5)),
+                  np.int64)]),
+     dtype_strict=False)
+
+_cri = np.clip(rng.normal(size=(2, 6, 6, 3)).astype(F32), -1, 1)
+_crb = np.array([[0.1, 0.1, 0.8, 0.8], [0.0, 0.0, 1.0, 0.5]], F32)
+case("crop_and_resize", "crop_and_resize",
+     (_cri, _crb, np.array([0, 1], I32)), {"crop_size": (4, 4)},
+     lambda im, b, bi: _t(tf.image.crop_and_resize, im, b, bi, [4, 4]),
+     rtol=1e-4, atol=1e-5)
+case("embedding_lookup", "embedding_lookup",
+     (x34, np.array([2, 0, 1, 2], I32)), {},
+     lambda p, i: _t(tf.nn.embedding_lookup, p, i))
+case("percentile_linear", "percentile", (x34,), {"q": 30.0, "axis": 1},
+     lambda x: np.percentile(x, 30.0, axis=1).astype(np.float64),
+     dtype_strict=False)
+case("trapz", "trapz", (x34,), {"axis": 1},
+     lambda y: np.trapezoid(y, axis=1) if hasattr(np, "trapezoid")
+     else np.trapz(y, axis=1), dtype_strict=False)
+case("bucketize", "bucketize",
+     (np.array([-1., 0.5, 3., 10.], F32),),
+     {"boundaries": [0.0, 1.0, 5.0]},
+     lambda v: _t(lambda x: tf.raw_ops.Bucketize(
+         input=x, boundaries=[0.0, 1.0, 5.0]), v), dtype_strict=False)
+
+
 @pytest.mark.parametrize(
     "spec", CASES, ids=[c[0] for c in CASES])
 def test_op_matches_twin(spec):
@@ -579,3 +661,25 @@ def test_conformance_sweep_coverage_gate():
     assert len(swept) >= 150, (
         f"conformance sweep covers {len(swept)} registry ops; the gate "
         f"floor is 150 — do not shrink the sweep")
+
+
+def test_ctc_loss_matches_tf():
+    """CTC loss vs tf.nn.ctc_loss on a small lattice (blank=0 both)."""
+    rng = np.random.default_rng(3)
+    B, T, C, S = 2, 6, 5, 3
+    logits = rng.normal(size=(B, T, C)).astype(F32)
+    log_probs = np.asarray(jnp.asarray(logits)
+                           - jnp.log(jnp.sum(jnp.exp(logits), -1,
+                                             keepdims=True)))
+    labels = np.array([[1, 2, 3], [2, 2, 4]], np.int32)
+    logit_len = np.array([6, 5], np.int32)
+    label_len = np.array([3, 2], np.int32)
+    ours = exec_op("ctc_loss", log_probs, labels, logit_len, label_len,
+                   blank_id=0)
+    want = tf.nn.ctc_loss(
+        labels=tf.constant(labels), logits=tf.constant(logits),
+        label_length=tf.constant(label_len),
+        logit_length=tf.constant(logit_len),
+        logits_time_major=False, blank_index=0).numpy()
+    np.testing.assert_allclose(np.asarray(ours), want, rtol=1e-4,
+                               atol=1e-4)
