@@ -1052,9 +1052,44 @@ def _fq(ref: str) -> str:
     return ref if ":" in ref else ref + ":0"
 
 
+def _topo_sorted(nodes):
+    """Kahn-sort a node list on intra-list data+control edges, keeping the
+    original order among simultaneously-ready nodes.
+
+    TF does NOT guarantee GraphDef/FunctionDef node order is topological —
+    function-body graphs in particular come out in hash order that varies
+    with PYTHONHASHSEED (found as a flaky whole-suite import failure:
+    'consumes unknown tensor ... ReadVariableOp'). External references
+    (function args, captures, nodes of an outer graph) are not edges."""
+    from collections import deque
+
+    nodes = list(nodes)
+    by_name = {n.name: n for n in nodes}
+    indeg = {n.name: 0 for n in nodes}
+    children = {n.name: [] for n in nodes}
+    for n in nodes:
+        for ref in n.input:
+            base = ref.lstrip("^").split(":")[0]
+            if base in by_name and base != n.name:
+                indeg[n.name] += 1
+                children[base].append(n.name)
+    ready = deque(n.name for n in nodes if indeg[n.name] == 0)
+    order = []
+    while ready:
+        nm = ready.popleft()
+        order.append(by_name[nm])
+        for ch in children[nm]:
+            indeg[ch] -= 1
+            if indeg[ch] == 0:
+                ready.append(ch)
+    if len(order) != len(nodes):      # cycle — impossible in a valid
+        return nodes                  # GraphDef; fall back to input order
+    return order
+
+
 def _map_nodes(ctx: _ImportCtx, nodes, skip=frozenset()):
     """Shared per-node rule walk for GraphDef.node and FunctionDef.node_def."""
-    for node in nodes:
+    for node in _topo_sorted(nodes):
         ctx.node_defs[node.name] = node
         if node.name in skip or node.op == "NoOp":
             continue
@@ -1262,6 +1297,24 @@ def _map_nodes_v1(ctx: _ImportCtx, nodes, skip=frozenset()):
             continue
         plain.append(node)
     _map_nodes(ctx, plain, skip=skip)
+    # hash-ordered node lists can place a region's outer producers AFTER
+    # every member node, so the in-walk readiness checks all miss; retry
+    # pending regions now that the final flush mapped everything else,
+    # looping until a pass makes no progress (regions can unblock each
+    # other — a cond feeding a loop's Enter)
+    progress = True
+    while progress:
+        progress = False
+        for l in loops:
+            if id(l) not in emitted and loop_ready(l):
+                _emit_v1_loop(ctx, l)
+                emitted.add(id(l))
+                progress = True
+        for c in conds:
+            if id(c) not in emitted and _cond_ready(ctx, c):
+                _emit_v1_cond(ctx, c)
+                emitted.add(id(c))
+                progress = True
     missing = [l.frame for l in loops if id(l) not in emitted] \
         + [c.merges[0].name for c in conds if id(c) not in emitted]
     if missing:

@@ -559,7 +559,9 @@ class TestLongTailLayers:
             want = m.predict(x, verbose=0)
             got = np.asarray(net.output(x))
             assert got.shape == want.shape, (got.shape, want.shape)
-            assert np.allclose(got, want, atol=1e-4), (
+            # 4 recurrent conv steps amplify the oneDNN-vs-XLA f32 conv
+            # difference; 1e-4 was marginal under whole-suite conditions
+            assert np.allclose(got, want, atol=5e-4), (
                 ret_seq, np.abs(got - want).max())
 
     def _functional_parity(self, inputs, out, tmp_path, feeds, name,
