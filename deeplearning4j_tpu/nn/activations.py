@@ -16,7 +16,10 @@ _ACTIVATIONS = {
     "selu": jax.nn.selu,
     "gelu": jax.nn.gelu,
     "sigmoid": jax.nn.sigmoid,
-    "hardsigmoid": jax.nn.hard_sigmoid,
+    # DL4J ActivationHardSigmoid / Keras hard_sigmoid: clip(0.2x+0.5, 0, 1)
+    # — NOT jax.nn.hard_sigmoid (relu6(x+3)/6, slope 1/6): a 5e-3-scale
+    # divergence a whole-suite Keras-import parity run caught
+    "hardsigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
     "tanh": jnp.tanh,
     "hardtanh": lambda x: jnp.clip(x, -1.0, 1.0),
     "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),

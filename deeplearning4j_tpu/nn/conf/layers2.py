@@ -990,7 +990,9 @@ class ConvLSTM2D(Layer):
                else "VALID")
         f = self.n_out
         rec_acts = {"sigmoid": jax.nn.sigmoid,
-                    "hard_sigmoid": jax.nn.hard_sigmoid}
+                    # Keras hard_sigmoid: clip(0.2x+0.5, 0, 1)
+                    "hard_sigmoid": lambda z: jnp.clip(0.2 * z + 0.5,
+                                                       0.0, 1.0)}
         if self.recurrent_activation not in rec_acts:
             raise ValueError(
                 f"ConvLSTM2D: recurrent_activation "

@@ -69,7 +69,10 @@ register("softplus", jax.nn.softplus, aliases=["Softplus"])
 register("softsign", jax.nn.soft_sign, aliases=["Softsign"])
 register("swish", jax.nn.silu, aliases=["silu"])
 register("mish", jax.nn.mish)
-register("hard_sigmoid", jax.nn.hard_sigmoid, aliases=["HardSigmoid"])
+# reference/Keras/ONNX-default semantics clip(0.2x+0.5, 0, 1) — NOT
+# jax.nn.hard_sigmoid's relu6(x+3)/6
+register("hard_sigmoid", lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+         aliases=["HardSigmoid"])
 register("hard_tanh", lambda x: jnp.clip(x, -1.0, 1.0), aliases=["HardTanh"])
 register("leakyrelu", lambda x, alpha=0.01: jax.nn.leaky_relu(x, negative_slope=alpha),
          aliases=["LeakyRelu", "leaky_relu"])

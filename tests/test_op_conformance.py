@@ -97,6 +97,13 @@ case("gelu", "gelu", (x34,), {},
 case("swish", "swish", (x34,), {}, lambda x: _t(tf.nn.silu, x))
 case("leakyrelu", "leakyrelu", (x34,), {"alpha": 0.2},
      lambda x: _t(tf.nn.leaky_relu, x, alpha=0.2))
+# hard_sigmoid: the DL4J/Keras-2/ONNX-default definition clip(0.2x+0.5)
+# — pinned against an explicit twin because tf.keras.activations moved to
+# the slope-1/6 variant in Keras 3 (h5 artifacts are the legacy format,
+# whose layers mean the 0.2 slope)
+case("hard_sigmoid_ref_slope", "hard_sigmoid",
+     (np.array([-4., -1., 0., 1., 4.], F32),), {},
+     lambda x: np.clip(0.2 * x + 0.5, 0.0, 1.0).astype(F32))
 
 # ---- binary + int/negative edge semantics --------------------------------
 case("add", "add", (x34, x34[0]), {}, lambda a, b: _t(tf.add, a, b))
