@@ -38,8 +38,10 @@ Caveat (same stance as ``modelimport/dl4j_zip.py``): the schema was
 reconstructed from the upstream .fbs layout in a zero-egress build with an
 empty reference mount, so slot numbers are documented here and isolated in
 the ``_FG``/``_FV``/``_FN``/``_FA``/``_FP`` slot maps for easy adjustment
-against a real artifact. Control-flow subgraphs (the reference's LOGIC
-scopes) are outside this surface and refuse loudly.
+against a real artifact. Control-flow subgraphs serialize as SCOPED node
+regions (``scope_name = …__sub__/<op>/<key>/`` — the reference's
+LOGIC-scope shape) with the composite op recording its branch outputs in
+a ``__cf_subgraphs__`` property; only lambda ops refuse.
 """
 from __future__ import annotations
 
@@ -245,42 +247,76 @@ def _unjsonable(v):
     return v
 
 
-def to_flat_buffers(sd) -> bytes:
-    """Serialize a SameDiff graph to the FlatGraph binary (ref:
-    ``SameDiff#asFlatBuffers``)."""
-    from deeplearning4j_tpu.autodiff.samediff import VariableType
+_SUB = "__sub__/"
+_CF_KEY = "__cf_subgraphs__"
 
-    for op in sd._ops:
-        if op.subgraphs:
+
+def _collect_graph(sd, prefix: str, vars_out: list, nodes_out: list):
+    """Recursive flattening of a SameDiff (+ its control-flow subgraphs)
+    into prefixed variable/node records. Subgraph entities live under
+    ``<prefix>__sub__/<op>/<key>/`` and carry that path as the FlatNode
+    scope_name — the reference's scoped-LOGIC-region shape; a composite
+    op records its branch outputs in a ``__cf_subgraphs__`` attr so the
+    reader can reattach them."""
+    for name, v in sd._vars.items():
+        if _SUB in name:
             raise ValueError(
-                f"op {op.name!r} ({op.op_name}) carries control-flow "
-                f"subgraphs — the FlatBuffers surface covers flat graphs; "
-                f"use the native zip save for control flow")
+                f"variable name {name!r} contains the reserved scope "
+                f"marker {_SUB!r} and cannot be FlatGraph-serialized")
+        vars_out.append((prefix + name, v, sd._values.get(name)))
+    for op in sd._ops:
         if op.fn is not None:
             raise ValueError(f"lambda op {op.name!r} is not serializable")
+        if _SUB in op.name:
+            raise ValueError(
+                f"op name {op.name!r} contains the reserved scope marker "
+                f"{_SUB!r} and cannot be FlatGraph-serialized")
+        attrs = dict(op.attrs)
+        if op.subgraphs:
+            cf = {}
+            for k, sub in op.subgraphs.items():
+                sub_prefix = f"{prefix}{_SUB}{op.name}/{k}/"
+                _collect_graph(sub, sub_prefix, vars_out, nodes_out)
+                cf[k] = {"outputs": list(sub._branch_outputs)}
+            attrs[_CF_KEY] = json.dumps(cf)
+        nodes_out.append((prefix + op.name, op.op_name,
+                          [prefix + i for i in op.inputs],
+                          [prefix + o for o in op.outputs],
+                          attrs, prefix))
+
+
+def to_flat_buffers(sd) -> bytes:
+    """Serialize a SameDiff graph to the FlatGraph binary (ref:
+    ``SameDiff#asFlatBuffers``). Control-flow subgraphs serialize as
+    scoped node regions (see ``_collect_graph``)."""
+    from deeplearning4j_tpu.autodiff.samediff import VariableType
+
+    all_vars: list = []
+    all_nodes: list = []
+    _collect_graph(sd, "", all_vars, all_nodes)
 
     b = flatbuffers.Builder(1024 * 1024)
 
     # ---- id assignment: ops get 1..N; leaf vars continue after
-    op_ids = {op.name: i + 1 for i, op in enumerate(sd._ops)}
+    op_ids = {name: i + 1 for i, (name, *_r) in enumerate(all_nodes)}
     pair_of: Dict[str, tuple] = {}
-    for op in sd._ops:
-        for j, out in enumerate(op.outputs):
-            pair_of[out] = (op_ids[op.name], j)
-    next_id = len(sd._ops) + 1
-    for name, v in sd._vars.items():
+    for name, _opn, _ins, outs, _attrs, _scope in all_nodes:
+        for j, out in enumerate(outs):
+            pair_of[out] = (op_ids[name], j)
+    next_id = len(all_nodes) + 1
+    for name, v, _val in all_vars:
         if name not in pair_of:
             pair_of[name] = (next_id, 0)
             next_id += 1
 
     # ---- variables
     var_offs = []
-    for name, v in sd._vars.items():
+    for name, v, val in all_vars:
         name_off = b.CreateString(name)
         nd_off = None
         if v.var_type in (VariableType.VARIABLE, VariableType.CONSTANT) \
-                and name in sd._values:
-            nd_off = _write_flat_array(b, np.asarray(sd._values[name]))
+                and val is not None:
+            nd_off = _write_flat_array(b, np.asarray(val))
         shape_off = None
         if v.shape is not None and all(s is not None for s in v.shape):
             shape_off = b.CreateNumpyVector(
@@ -301,24 +337,26 @@ def to_flat_buffers(sd) -> bytes:
     variables_off = _offset_vector(b, var_offs)
 
     # ---- nodes
+    var_by_name = {name: v for name, v, _val in all_vars}
     node_offs = []
-    for op in sd._ops:
-        name_off = b.CreateString(op.name)
-        opname_off = b.CreateString(op.op_name)
+    for name, op_name, inputs, outputs, attrs, scope in all_nodes:
+        name_off = b.CreateString(name)
+        opname_off = b.CreateString(op_name)
+        scope_off = b.CreateString(scope) if scope else None
         prop_offs, metas = [], {}
-        for an, av in op.attrs.items():
+        for an, av in attrs.items():
             off, meta = _attr_to_property(b, an, av)
             prop_offs.append(off)
             metas[an] = meta
         moff, _ = _attr_to_property(b, _ATTR_META, json.dumps(metas))
         prop_offs.append(moff)
         props_off = _offset_vector(b, prop_offs)
-        pairs = [_write_int_pair(b, *pair_of[i]) for i in op.inputs]
+        pairs = [_write_int_pair(b, *pair_of[i]) for i in inputs]
         in_paired_off = _offset_vector(b, pairs)
-        out_names_off = _string_vector(b, op.outputs)
+        out_names_off = _string_vector(b, outputs)
         out_types = []
-        for o in op.outputs:
-            ov = sd._vars.get(o)
+        for o in outputs:
+            ov = var_by_name.get(o)
             dt = np.dtype(ov.dtype) if ov is not None and ov.dtype \
                 is not None else np.dtype("f4")
             out_types.append(_NP_TO_DTYPE.get(dt, 5))
@@ -328,11 +366,13 @@ def to_flat_buffers(sd) -> bytes:
         out_types_off = b.EndVector()
 
         b.StartObject(19)
-        b.PrependInt32Slot(_FN["id"], op_ids[op.name], 0)
+        b.PrependInt32Slot(_FN["id"], op_ids[name], 0)
         b.PrependUOffsetTRelativeSlot(_FN["name"], name_off, 0)
         b.PrependInt8Slot(_FN["opType"], _OP_TYPE_CUSTOM, 0)
         b.PrependUOffsetTRelativeSlot(_FN["properties"], props_off, 0)
         b.PrependUOffsetTRelativeSlot(_FN["inputPaired"], in_paired_off, 0)
+        if scope_off is not None:
+            b.PrependUOffsetTRelativeSlot(_FN["scope_name"], scope_off, 0)
         b.PrependUOffsetTRelativeSlot(_FN["outputNames"], out_names_off, 0)
         b.PrependUOffsetTRelativeSlot(_FN["opName"], opname_off, 0)
         b.PrependUOffsetTRelativeSlot(_FN["outputTypes"], out_types_off, 0)
@@ -504,9 +544,8 @@ def from_flat_buffers(data: bytes):
     root_pos = flatbuffers.encode.Get(NT.UOffsetTFlags.packer_type, buf, 0)
     g = _Tab(buf, root_pos)
 
-    sd = SameDiff()
     pair_to_name: Dict[tuple, str] = {}
-
+    var_recs = []                      # (full_name, vtype, shape, dt, value)
     for vt in g.table_vec(_FG["variables"]):
         name = vt.string(_FV["name"])
         code = vt.i8(_FV["dtype"])
@@ -515,14 +554,9 @@ def from_flat_buffers(data: bytes):
             if vt.has(_FV["shape"]) else None   # () scalar != absent
         vtype = VariableType(_VARTYPE_TO_OURS.get(
             int(vt.i8(_FV["variabletype"])), "ARRAY"))
-        v = SDVariable(sd, name, vtype, shape, dt)
-        sd._vars[name] = v
         nd = vt.table(_FV["ndarray"])
-        if nd is not None:
-            arr = _read_flat_array(nd)
-            sd._values[name] = jnp.asarray(arr)
-            if v.shape is None:
-                v.shape = arr.shape
+        val = _read_flat_array(nd) if nd is not None else None
+        var_recs.append((name, vtype, shape, dt, val))
         idp = vt.table(_FV["id"])
         if idp is not None:
             pair_to_name[(idp.i32(0), idp.i32(1))] = name
@@ -533,6 +567,8 @@ def from_flat_buffers(data: bytes):
         for j, out in enumerate(nt.string_vec(_FN["outputNames"])):
             pair_to_name.setdefault((nid, j), out)
 
+    node_recs = []   # (full_name, op_name, inputs, outputs, codes, attrs,
+                     #  scope)
     for nt in sorted(nodes, key=lambda t: t.i32(_FN["id"])):
         name = nt.string(_FN["name"])
         op_name = nt.string(_FN["opName"])
@@ -557,24 +593,86 @@ def from_flat_buffers(data: bytes):
                 raise ValueError(f"node {name!r} references unknown "
                                  f"producer {key}")
             inputs.append(pair_to_name[key])
-        outputs = nt.string_vec(_FN["outputNames"])
-        out_codes = nt.scalar_vec(_FN["outputTypes"], np.int8)
-        node = OpNode(name, op_name, inputs, outputs, attrs)
-        sd._ops.append(node)
-        for j, out in enumerate(outputs):
-            if out not in sd._vars:
-                dt = _DTYPE_TO_NP.get(int(out_codes[j]), np.dtype("f4")) \
-                    if j < len(out_codes) else np.dtype("f4")
-                sd._vars[out] = SDVariable(sd, out, VariableType.ARRAY,
-                                           None, dt)
-            sd._producer[out] = node
+        scope = nt.string(_FN["scope_name"]) or ""
+        if scope and not scope.endswith("/"):
+            # a foreign artifact's free-form scope label (not our
+            # __sub__/<op>/<key>/ convention): treat as top-level — the
+            # old reader ignored scope_name entirely
+            scope = ""
+        node_recs.append((name, op_name, inputs,
+                          nt.string_vec(_FN["outputNames"]),
+                          nt.scalar_vec(_FN["outputTypes"], np.int8),
+                          attrs, scope))
 
+    # ---- group by scope path (one pass) and build bottom-up (deepest
+    # first), so a composite op's subgraphs exist when its scope is built
+    def _var_scope(name):
+        i = name.rfind(_SUB)
+        if i < 0:
+            return ""
+        rest = name[i + len(_SUB):]          # "<op>/<key>/<local>"
+        parts = rest.split("/", 2)
+        if len(parts) < 3:
+            return ""                        # not our convention
+        return name[:i] + _SUB + parts[0] + "/" + parts[1] + "/"
+
+    vars_by_scope: Dict[str, list] = {}
+    for rec in var_recs:
+        vars_by_scope.setdefault(_var_scope(rec[0]), []).append(rec)
+    nodes_by_scope: Dict[str, list] = {}
+    for rec in node_recs:
+        nodes_by_scope.setdefault(rec[-1], []).append(rec)
+    scopes = sorted(set(vars_by_scope) | set(nodes_by_scope) | {""},
+                    key=len, reverse=True)
+    built: Dict[str, "SameDiff"] = {}
+    for scope in scopes:
+        sd = SameDiff()
+        for name, vtype, shape, dt, val in vars_by_scope.get(scope, []):
+            local = name[len(scope):]
+            v = SDVariable(sd, local, vtype, shape, dt)
+            sd._vars[local] = v
+            if val is not None:
+                sd._values[local] = jnp.asarray(val)
+                if v.shape is None:
+                    v.shape = val.shape
+        for name, op_name, inputs, outputs, out_codes, attrs, _nscope \
+                in nodes_by_scope.get(scope, []):
+            local = name[len(scope):]
+            subgraphs = None
+            if _CF_KEY in attrs:
+                cf = json.loads(attrs.pop(_CF_KEY))
+                subgraphs = {}
+                for k, meta in cf.items():
+                    sub_path = f"{scope}{_SUB}{local}/{k}/"
+                    sub = built.get(sub_path)
+                    if sub is None:
+                        raise ValueError(
+                            f"composite op {name!r} references missing "
+                            f"subgraph scope {sub_path!r}")
+                    sub._branch_outputs = list(meta.get("outputs", []))
+                    subgraphs[k] = sub
+            l_inputs = [i[len(scope):] for i in inputs]
+            l_outputs = [o[len(scope):] for o in outputs]
+            node = OpNode(local, op_name, l_inputs, l_outputs, attrs,
+                          subgraphs=subgraphs)
+            sd._ops.append(node)
+            for j, out in enumerate(l_outputs):
+                if out not in sd._vars:
+                    dt = _DTYPE_TO_NP.get(int(out_codes[j]),
+                                          np.dtype("f4")) \
+                        if j < len(out_codes) else np.dtype("f4")
+                    sd._vars[out] = SDVariable(sd, out, VariableType.ARRAY,
+                                               None, dt)
+                sd._producer[out] = node
+        sd._reseed_name_counters()
+        built[scope] = sd
+
+    sd = built[""]
     sd._loss_variables = g.string_vec(_FG["lossVariables"])
     tc = g.string(_FG["trainingConfig"])
     if tc:
         sd.training_config = TrainingConfig.from_dict(
             _unjsonable(json.loads(tc)))
-    sd._reseed_name_counters()
     return sd
 
 

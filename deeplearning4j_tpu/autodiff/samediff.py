@@ -1414,8 +1414,9 @@ class SameDiff:
     def save(self, path: str, save_updater_state: bool = False):
         """Persist graph + values. A ``.fb``/``.fbs``/``.sdfb`` path writes
         the reference's FlatGraph binary (ref: ``SameDiff#save`` writes
-        FlatBuffers); anything else uses the native zip container (which
-        also carries control-flow subgraphs and updater state)."""
+        FlatBuffers; control-flow subgraphs ride as scoped node regions);
+        anything else uses the native zip container (which additionally
+        carries updater state)."""
         if str(path).endswith((".fb", ".fbs", ".sdfb")):
             if save_updater_state and self._opt_state is not None:
                 import warnings

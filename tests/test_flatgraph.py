@@ -103,13 +103,33 @@ class TestRoundTrip:
         with pytest.raises(ValueError, match="neither a SameDiff zip"):
             SameDiff.load(p)
 
-    def test_control_flow_refuses_loudly(self):
+    def test_control_flow_roundtrips_as_scoped_regions(self):
+        """while/cond subgraphs serialize as scoped FlatNode regions (the
+        reference's LOGIC-scope shape) and execute identically after the
+        hop — including nested values inside the bodies."""
         sd = SameDiff.create()
+        x = sd.placeholder("x", (2,), np.float32)
         i0 = sd.constant(np.int32(0), name="i0")
-        sd.while_loop(lambda s, i: s._op("less", i, s.constant(np.int32(3))),
-                      lambda s, i: s._op("add", i, s.constant(np.int32(1))),
-                      i0)
-        with pytest.raises(ValueError, match="control-flow"):
+        out = sd.while_loop(
+            lambda s, i, a: s._op("less", i, s.constant(np.int32(4))),
+            lambda s, i, a: [s._op("add", i, s.constant(np.int32(1))),
+                             s._op("mul", a, s.constant(np.float32(2.0)))],
+            i0, x)
+        out[1].rename("doubled")
+        data = sd.as_flat_buffers()
+        sd2 = SameDiff.from_flat_buffers(data)
+        xv = np.array([1.5, -3.0], np.float32)
+        a = np.asarray(sd.output({"x": xv}, ["doubled"])["doubled"])
+        b = np.asarray(sd2.output({"x": xv}, ["doubled"])["doubled"])
+        np.testing.assert_allclose(a, xv * 16)
+        np.testing.assert_allclose(b, a)
+
+    def test_lambda_op_refuses_loudly(self):
+        import jax.numpy as jnp
+
+        sd = _linear_sd()
+        sd.lambda_op(lambda t: jnp.tanh(t), sd._vars["y"])
+        with pytest.raises(ValueError, match="lambda"):
             sd.as_flat_buffers()
 
 
