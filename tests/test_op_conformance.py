@@ -690,3 +690,84 @@ def test_ctc_loss_matches_tf():
         logits_time_major=False, blank_index=0).numpy()
     np.testing.assert_allclose(np.asarray(ours), want, rtol=1e-4,
                                atol=1e-4)
+
+
+# ---- round-4 tranche 3: linalg decompositions (ambiguity-aware) ---------
+class TestLinalgDecompositions:
+    """Decompositions are only defined up to sign/order/basis — compare
+    RECONSTRUCTIONS and invariants against numpy/TF, not raw factors."""
+
+    A = rng.normal(size=(5, 3)).astype(F32)
+    SQ = (rng.normal(size=(4, 4)) * 0.5).astype(F32)
+    SPD = (A.T @ A + 3 * np.eye(3)).astype(F32)
+
+    def test_svd_singular_values_and_reconstruction(self):
+        u, s, vt = exec_op("svd", jnp.asarray(self.A))
+        np.testing.assert_allclose(
+            np.asarray(s), np.linalg.svd(self.A, compute_uv=False),
+            rtol=1e-4, atol=1e-5)
+        rec = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(vt)
+        np.testing.assert_allclose(rec, self.A, atol=1e-4)
+
+    def test_qr_reconstruction_and_orthonormality(self):
+        q, r = exec_op("qr", jnp.asarray(self.A))
+        q, r = np.asarray(q), np.asarray(r)
+        np.testing.assert_allclose(q @ r, self.A, atol=1e-4)
+        np.testing.assert_allclose(q.T @ q, np.eye(q.shape[1]), atol=1e-4)
+        # R upper-triangular
+        np.testing.assert_allclose(r, np.triu(r), atol=1e-6)
+
+    def test_eigh_eigenvalues_match_numpy(self):
+        w, v = exec_op("self_adjoint_eig", jnp.asarray(self.SPD))
+        np.testing.assert_allclose(np.sort(np.asarray(w)),
+                                   np.sort(np.linalg.eigvalsh(self.SPD)),
+                                   rtol=1e-4, atol=1e-5)
+        rec = (np.asarray(v) * np.asarray(w)) @ np.asarray(v).T
+        np.testing.assert_allclose(rec, self.SPD, atol=1e-3)
+
+    def test_eig_general_eigenvalues(self):
+        w, _v = exec_op("eig", jnp.asarray(self.SQ))
+        want = np.linalg.eigvals(self.SQ)
+        got = np.asarray(w)
+        np.testing.assert_allclose(
+            np.sort_complex(got.astype(np.complex64)),
+            np.sort_complex(want.astype(np.complex64)), atol=1e-3)
+
+    def test_lu_reconstruction(self):
+        p, l, u = exec_op("lu", jnp.asarray(self.SQ))
+        rec = np.asarray(p) @ np.asarray(l) @ np.asarray(u)
+        np.testing.assert_allclose(rec, self.SQ, atol=1e-4)
+
+    def test_pinv_moore_penrose_conditions(self):
+        pv = np.asarray(exec_op("pinv", jnp.asarray(self.A)))
+        np.testing.assert_allclose(self.A @ pv @ self.A, self.A, atol=1e-3)
+        np.testing.assert_allclose(pv @ self.A @ pv, pv, atol=1e-3)
+
+    def test_lstsq_matches_numpy(self):
+        bvec = rng.normal(size=(5, 2)).astype(F32)
+        got = np.asarray(exec_op("lstsq", jnp.asarray(self.A),
+                                 jnp.asarray(bvec)))
+        want = np.linalg.lstsq(self.A, bvec, rcond=None)[0]
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_matrix_power_and_rank(self):
+        got = np.asarray(exec_op("matrix_power", jnp.asarray(self.SQ), 3))
+        np.testing.assert_allclose(got,
+                                   np.linalg.matrix_power(self.SQ, 3),
+                                   rtol=1e-3, atol=1e-4)
+        lowrank = np.outer(np.arange(1, 5), np.arange(1, 5)).astype(F32)
+        assert int(exec_op("matrix_rank", jnp.asarray(lowrank))) == 1
+
+    def test_sqrtm_squares_back(self):
+        r = np.asarray(exec_op("sqrtm", jnp.asarray(self.SPD)))
+        np.testing.assert_allclose(r @ r, self.SPD, atol=1e-3)
+
+    def test_monotonic_predicates_match_tf(self):
+        inc = np.array([1., 2., 2., 3.], F32)
+        strict = np.array([1., 2., 3., 4.], F32)
+        dec = np.array([3., 1., 2.], F32)
+        for arr in (inc, strict, dec):
+            assert bool(exec_op("is_non_decreasing", arr)) \
+                == bool(tf.math.is_non_decreasing(arr).numpy())
+            assert bool(exec_op("is_strictly_increasing", arr)) \
+                == bool(tf.math.is_strictly_increasing(arr).numpy())
