@@ -272,9 +272,11 @@ def _map_layer(cls: str, cfg: dict):
             kernel_size=_pair(cfg.get("pool_size", 2)),
             stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
             padding=_padding(cfg))
-    if cls in ("GlobalMaxPooling2D", "GlobalMaxPooling1D"):
+    if cls in ("GlobalMaxPooling2D", "GlobalMaxPooling1D",
+               "GlobalMaxPooling3D"):
         return L.GlobalPoolingLayer(name=name, pooling_type="max")
-    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D"):
+    if cls in ("GlobalAveragePooling2D", "GlobalAveragePooling1D",
+               "GlobalAveragePooling3D"):
         return L.GlobalPoolingLayer(name=name, pooling_type="avg")
     if cls == "BatchNormalization":
         return L.BatchNormalization(name=name,
@@ -297,7 +299,9 @@ def _map_layer(cls: str, cfg: dict):
             crops = (t, b, l, r)
         return L.Cropping2D(name=name, cropping=crops)
     if cls == "UpSampling2D":
-        return L.Upsampling2D(name=name, size=_pair(cfg.get("size", 2)))
+        return L.Upsampling2D(name=name, size=_pair(cfg.get("size", 2)),
+                              interpolation=cfg.get("interpolation",
+                                                    "nearest"))
     # ---- tranche-2 layer mappings (ref KerasDepthwiseConvolution2D,
     # KerasPReLU, KerasThresholdedReLU, KerasMasking, KerasLocallyConnected,
     # the 1D/3D structural family — deeplearning4j-modelimport layers.*)

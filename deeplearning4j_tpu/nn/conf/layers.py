@@ -620,6 +620,7 @@ class SubsamplingLayer(Layer):
 @dataclasses.dataclass
 class Upsampling2D(Layer):
     size: Tuple[int, int] = (2, 2)
+    interpolation: str = "nearest"     # Keras UpSampling2D: nearest|bilinear
 
     def __post_init__(self):
         self.size = _pair(self.size)
@@ -629,6 +630,9 @@ class Upsampling2D(Layer):
                                        input_type.width * self.size[1], input_type.channels)
 
     def apply(self, params, x, training=False, rng=None, state=None):
+        if self.interpolation == "bilinear":
+            h, w = x.shape[1] * self.size[0], x.shape[2] * self.size[1]
+            return exec_op("resize_bilinear", x, size=(h, w)), state
         return exec_op("upsampling2d", x, size=self.size), state
 
 
@@ -675,7 +679,7 @@ class GlobalPoolingLayer(Layer):
     pooling_type: str = "max"
 
     def output_type(self, input_type: InputType) -> InputType:
-        if input_type.kind == "cnn":
+        if input_type.kind in ("cnn", "cnn3d"):
             return InputType.feed_forward(input_type.channels)
         if input_type.kind == "rnn":
             return InputType.feed_forward(input_type.size)

@@ -55,6 +55,8 @@ SPECS = {
     "SubsamplingLayerMax": (lambda: L.SubsamplingLayer(
         pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
         _x((2, 4, 4, 2)), {}),
+    "Upsampling2DBilinear": (lambda: L.Upsampling2D(
+        size=(2, 2), interpolation="bilinear"), _x((2, 3, 3, 2)), {}),
     "Upsampling2D": (lambda: L.Upsampling2D(size=(2, 2)),
                      _x((2, 3, 3, 2)), {}),
     "FlattenLayer": (lambda: L.FlattenLayer(), _x((2, 3, 4)), {}),

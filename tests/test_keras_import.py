@@ -617,6 +617,36 @@ class TestLongTailLayers:
         self._functional_parity(inp, add, tmp_path,
                                 rs.rand(2, 7, 6).astype("f4"), "addatt.h5")
 
+    def test_upsampling_bilinear_and_global_pool_3d(self, tmp_path):
+        """UpSampling2D(interpolation='bilinear') must not silently run
+        nearest; Global{Max,Average}Pooling3D map onto the generic global
+        pool."""
+        m = tf.keras.Sequential([
+            tf.keras.Input((4, 4, 3)),
+            tf.keras.layers.UpSampling2D(2, interpolation="bilinear"),
+        ])
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            _save(m, tmp_path, name="up.h5"))
+        x = np.random.RandomState(9).rand(2, 4, 4, 3).astype("f4")
+        want = m.predict(x, verbose=0)
+        got = np.asarray(net.output(x))
+        assert got.shape == want.shape
+        assert np.allclose(got, want, atol=1e-5), np.abs(got - want).max()
+
+        for kcls, red in ((tf.keras.layers.GlobalMaxPooling3D, "max"),
+                          (tf.keras.layers.GlobalAveragePooling3D, "avg")):
+            m3 = tf.keras.Sequential([
+                tf.keras.Input((2, 3, 3, 4)), kcls(),
+                tf.keras.layers.Dense(2),
+            ])
+            net3 = KerasModelImport.import_keras_sequential_model_and_weights(
+                _save(m3, tmp_path, name=f"gp3_{red}.h5"))
+            x3 = np.random.RandomState(10).rand(2, 2, 3, 3, 4).astype("f4")
+            want3 = m3.predict(x3, verbose=0)
+            got3 = np.asarray(net3.output(x3))
+            assert got3.shape == want3.shape
+            assert np.allclose(got3, want3, atol=1e-5), red
+
     def test_multi_head_attention_self(self, tmp_path):
         rs = np.random.RandomState(6)
         inp = tf.keras.Input((5, 8))
