@@ -72,6 +72,17 @@ def main():
         step = model.make_train_step(opt)
         toks = jnp.asarray(toks_np, jnp.int32)
         tgts = jnp.roll(toks, -1, axis=1)
+        # XLA's own memory accounting for the compiled step: temp bytes =
+        # live activations/workspace. Validates the O(M/S)-microbatch queue
+        # claim with compiler numbers rather than arithmetic.
+        temp_mib = None
+        try:
+            ma = step.lower(params, opt_state, toks,
+                            tgts).compile().memory_analysis()
+            if ma is not None:
+                temp_mib = round(ma.temp_size_in_bytes / 2**20, 1)
+        except Exception as e:
+            print(f"# memory_analysis unavailable: {e!r}", file=sys.stderr)
         p, s, loss = step(params, opt_state, toks, tgts)   # compile+warm
         float(loss)
         runs = []
@@ -90,6 +101,7 @@ def main():
             "bubble_theory": round((S - 1) / (M + S - 1), 4),
             "tokens_per_sec": round(args.global_batch * args.seq / step_s,
                                     1),
+            "xla_temp_mib": temp_mib,
         }), flush=True)
     if len(rows) >= 2:
         # utilisation vs the best rung: the measured analog of 1-bubble
