@@ -13,6 +13,7 @@ Run: python benchmarks/zoo_fullsize_step.py [--smoke]
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import sys
@@ -91,7 +92,6 @@ def main():
             }), flush=True)
         # free the model's buffers before the next architecture compiles
         m = net = None
-        import gc
         gc.collect()
         jax.clear_caches()
 

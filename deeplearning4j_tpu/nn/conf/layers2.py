@@ -802,8 +802,6 @@ class Deconvolution3D(Layer):
         return p
 
     def apply(self, params, x, training=False, rng=None, state=None):
-        import jax.lax as lax
-
         x = self._maybe_dropout(x, training, rng)
         pad = (self.padding.upper() if isinstance(self.padding, str)
                else [(p, p) for p in self.padding])
@@ -866,8 +864,6 @@ class SeparableConvolution1D(Layer):
         return shapes
 
     def init_params(self, key):
-        import jax
-
         k1, k2 = jax.random.split(key)
         k = self.kernel_size
         p = {"dW": _winit.init(self.weight_init, k1,
@@ -965,8 +961,6 @@ class ConvLSTM2D(Layer):
         return shapes
 
     def init_params(self, key):
-        import jax
-
         k1, k2 = jax.random.split(key)
         kh, kw = self.kernel_size
         f = self.n_out
@@ -981,11 +975,7 @@ class ConvLSTM2D(Layer):
         return p
 
     def apply(self, params, x, training=False, rng=None, state=None):
-        import jax
-        from jax import lax
-
         x = self._maybe_dropout(x, training, rng)
-        from deeplearning4j_tpu.ops.registry import exec_op as _eop
         pad = (self.padding.upper() if isinstance(self.padding, str)
                else "VALID")
         f = self.n_out
@@ -1003,7 +993,7 @@ class ConvLSTM2D(Layer):
         # input convs for ALL timesteps in one batched conv (MXU-friendly):
         # (N,T,H,W,C) -> (N*T,H,W,C) -> conv -> (N,T,H',W',4F)
         n, t = x.shape[0], x.shape[1]
-        xc = _eop("conv2d", x.reshape((n * t,) + x.shape[2:]), params["W"],
+        xc = exec_op("conv2d", x.reshape((n * t,) + x.shape[2:]), params["W"],
                   params.get("b"), strides=self.stride, padding=pad)
         xc = xc.reshape((n, t) + xc.shape[1:])
         h0 = jnp.zeros((n,) + xc.shape[2:4] + (f,), x.dtype)
@@ -1011,8 +1001,8 @@ class ConvLSTM2D(Layer):
 
         def step(carry, xc_t):
             h_prev, c_prev = carry
-            z = xc_t + _eop("conv2d", h_prev, params["RW"], None,
-                            strides=(1, 1), padding="SAME")
+            z = xc_t + exec_op("conv2d", h_prev, params["RW"], None,
+                               strides=(1, 1), padding="SAME")
             i, fg, g, o = jnp.split(z, 4, axis=-1)
             c = rec_act(fg) * c_prev + rec_act(i) * self._act(g)
             h = rec_act(o) * self._act(c)
