@@ -465,6 +465,20 @@ case("resize_bilinear_up", "resize_bilinear", (imr,), {"size": (7, 9)},
      rtol=1e-4, atol=1e-5)
 case("resize_nearest", "resize_nearest_neighbor", (imr,), {"size": (9, 7)},
      lambda x: _t(tf.image.resize, x, [9, 7], method="nearest"))
+# DOWNSCALE is the divergence hotspot (kernel-footprint choices differ
+# across libraries); bilinear/nearest match TF tightly, bicubic agrees to
+# ~1e-3 (slightly different cubic weighting constants — locked here)
+case("resize_bilinear_down", "resize_bilinear",
+     (rng.normal(size=(1, 8, 8, 3)).astype(F32),), {"size": (3, 5)},
+     lambda x: _t(tf.image.resize, x, [3, 5], method="bilinear"),
+     rtol=1e-4, atol=1e-5)
+case("resize_nearest_down", "resize_nearest_neighbor",
+     (rng.normal(size=(1, 8, 8, 3)).astype(F32),), {"size": (3, 5)},
+     lambda x: _t(tf.image.resize, x, [3, 5], method="nearest"))
+case("resize_bicubic_down", "resize_bicubic",
+     (rng.normal(size=(1, 8, 8, 3)).astype(F32),), {"size": (3, 5)},
+     lambda x: _t(tf.image.resize, x, [3, 5], method="bicubic"),
+     rtol=5e-2, atol=2e-3)
 case("rgb_to_hsv", "rgb_to_hsv", (imr,), {},
      lambda x: _t(tf.image.rgb_to_hsv, x), rtol=1e-4, atol=1e-5)
 case("hsv_to_rgb", "hsv_to_rgb",
