@@ -404,6 +404,10 @@ class TransformerLM:
         x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
         x = self._dropout(x.astype(c.dtype), rng, 0)
         x = self._constrain(x)
+        # dense (non-MoE) models carry NO aux through the layer stack: the
+        # telemetry would be all-zero anyway, and threading it through the
+        # lax.scan carry keeps dead adds alive in the compiled step
+        dense = c.moe is None
         aux_total = self._zero_aux()
 
         if (c.pipeline_stages > 1 and self.mesh is not None
@@ -411,18 +415,23 @@ class TransformerLM:
             x = self._apply_pipelined(params, x, rng)
         elif c.scan_layers:
             def scan_body(carry, blk_li):
-                x, aux = carry
+                x, aux = carry if not dense else (carry, None)
                 blk, li = blk_li
                 body = (lambda b, x_: self._block_math(
                     b, x_, rng, li, self.mesh))
                 if c.remat:
                     body = jax.checkpoint(body)
                 x, a = body(blk, x)
+                if dense:
+                    return x, None
                 return (x, jax.tree.map(jnp.add, aux, a)), None
 
             li_idx = jnp.arange(c.n_layers)
-            (x, aux_total), _ = lax.scan(scan_body, (x, aux_total),
-                                         (params["blocks"], li_idx))
+            init = x if dense else (x, aux_total)
+            out, _ = lax.scan(scan_body, init, (params["blocks"], li_idx))
+            x = out if dense else out[0]
+            if not dense:
+                aux_total = out[1]
         else:
             blocks = params["blocks"]
             if c.pipeline_stages > 1:
@@ -443,11 +452,13 @@ class TransformerLM:
                     static_argnums=(2,))
                 for li, blk in enumerate(blocks):
                     x, a = body(blk, x, li)
-                    aux_total = jax.tree.map(jnp.add, aux_total, a)
+                    if not dense:
+                        aux_total = jax.tree.map(jnp.add, aux_total, a)
             else:
                 for li, blk in enumerate(blocks):
                     x, a = self._block_math(blk, x, rng, li, self.mesh)
-                    aux_total = jax.tree.map(jnp.add, aux_total, a)
+                    if not dense:
+                        aux_total = jax.tree.map(jnp.add, aux_total, a)
         x = self._ln(params["ln_f"], x)
         aux_loss, dropped, frac = aux_total
         n_moe = max(1, c.n_layers)        # per-layer means for telemetry

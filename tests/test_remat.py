@@ -139,3 +139,43 @@ def test_scan_layers_sharded_step():
     params, opt_state, loss = step(params, opt_state, toks,
                                    jnp.roll(toks, -1, axis=1))
     assert np.isfinite(float(loss))
+
+
+def test_dense_step_carries_no_moe_aux():
+    """Regression guard (round-4 driver bench): a dense (non-MoE) model's
+    train step must not thread MoE aux telemetry through the layer stack —
+    the scan carry is the hidden state alone, and the jaxpr contains no
+    dead zero-aux adds. Deterministic twin of the CPU-ratio check, immune
+    to machine-load noise."""
+    import optax
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+
+    for scan in (False, True):
+        cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=4,
+                                d_model=64, max_len=32, scan_layers=scan,
+                                fused_qkv=True)
+        m = TransformerLM(cfg, mesh=None)
+        p = m.init_params(jax.random.key(0))
+        opt = optax.adamw(1e-3)
+        s = jax.eval_shape(opt.init, p)
+        toks = jnp.zeros((2, 32), jnp.int32)
+
+        def step(p_, s_, t_, g_):
+            loss, grads = jax.value_and_grad(m.loss_fn)(p_, t_, g_)
+            up, s2 = opt.update(grads, s_, p_)
+            return optax.apply_updates(p_, up), s2, loss
+
+        jaxpr = jax.make_jaxpr(step)(p, s, toks, toks)
+        txt = str(jaxpr)
+        assert "moe" not in txt.lower()
+        if scan:
+            # the scan carry of a dense model is (x,) — params are consts,
+            # so every scan op's carry has exactly one (B,T,D)-shaped slot
+            scans = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "scan"]
+            assert scans, "scan_layers=True must lower to lax.scan"
+            for e in scans:
+                n_carry = e.params["num_carry"]
+                assert n_carry <= 1, (
+                    f"dense scan carry grew to {n_carry} slots — dead aux "
+                    "telemetry is riding the layer stack again")
