@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-4 tunnel watcher: polls the axon TPU tunnel; on a live window it
+# captures, in judge-priority order (VERDICT r3 next-round #1/#3/#9):
+#   1. resnet_bench.py    -> BENCH_r05_resnet.json   (north-star row 1)
+#   2. bert_bench.py      -> BENCH_r05_bert.json     (north-star row 2)
+#   3. bench.py flagship  -> BENCH_r05_live.json     (interleaved >=1.0 goal)
+#   4. ring --memory      -> benchmarks/ring_memory_live.txt (HBM telemetry)
+# Each capture is wedge-proof behind its own timeout; a window that dies
+# mid-list costs only the remaining items (north-stars bank first).
+# Exits after the flagship capture succeeds, or when the kill file appears.
+cd /root/repo
+LOG=benchmarks/tunnel_watcher.log
+KILL=/tmp/stop_tunnel_watcher_r5
+echo "[watcher-r5] started $(date -u +%H:%M:%S)" >> "$LOG"
+while true; do
+  [ -f "$KILL" ] && { echo "[watcher-r5] stopped" >> "$LOG"; exit 0; }
+  if timeout 75 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" 2>/dev/null; then
+    echo "[watcher-r5] TUNNEL LIVE $(date -u +%H:%M:%S) — capturing" >> "$LOG"
+
+    if [ ! -f BENCH_r05_resnet.json ] || ! grep -q '"platform": "\(tpu\|axon\)"' BENCH_r05_resnet.json; then
+      timeout 900 python benchmarks/resnet_bench.py > BENCH_r05_resnet.json.tmp 2>> "$LOG" \
+        && grep -q '"platform": "\(tpu\|axon\)"' BENCH_r05_resnet.json.tmp \
+        && mv BENCH_r05_resnet.json.tmp BENCH_r05_resnet.json \
+        && echo "[watcher-r5] resnet done: $(cat BENCH_r05_resnet.json)" >> "$LOG"
+    fi
+
+    if [ ! -f BENCH_r05_bert.json ] || ! grep -q '"platform": "\(tpu\|axon\)"' BENCH_r05_bert.json; then
+      timeout 1100 python benchmarks/bert_bench.py > BENCH_r05_bert.json.tmp 2>> "$LOG" \
+        && grep -q '"platform": "\(tpu\|axon\)"' BENCH_r05_bert.json.tmp \
+        && mv BENCH_r05_bert.json.tmp BENCH_r05_bert.json \
+        && echo "[watcher-r5] bert done: $(cat BENCH_r05_bert.json)" >> "$LOG"
+    fi
+
+    if ! timeout 75 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" 2>/dev/null; then
+      echo "[watcher-r5] window closed before flagship — resuming watch" >> "$LOG"
+      sleep 180
+      continue
+    fi
+
+    timeout 1700 python bench.py > BENCH_r05_live.json.tmp 2>> "$LOG" \
+      && grep -q '"platform": "\(tpu\|axon\)"' BENCH_r05_live.json.tmp \
+      && mv BENCH_r05_live.json.tmp BENCH_r05_live.json \
+      && echo "[watcher-r5] flagship done: $(cat BENCH_r05_live.json)" >> "$LOG"
+
+    timeout 900 python benchmarks/ring_attention_bench.py --tpu --memory \
+      --seqs 8192 16384 32768 49152 --devices 8 --heads 8 --dim 128 \
+      > benchmarks/ring_memory_live.txt 2>> "$LOG" \
+      && echo "[watcher-r5] ring memory done" >> "$LOG"
+
+    if [ ! -f benchmarks/zoo_fullsize_live.txt ] || ! grep -q '"finite": true' benchmarks/zoo_fullsize_live.txt; then
+      timeout 1200 python benchmarks/zoo_fullsize_step.py \
+        > benchmarks/zoo_fullsize_live.txt.tmp 2>> "$LOG" \
+        && grep -q '"metric"' benchmarks/zoo_fullsize_live.txt.tmp \
+        && mv benchmarks/zoo_fullsize_live.txt.tmp benchmarks/zoo_fullsize_live.txt \
+        && echo "[watcher-r5] zoo fullsize done: $(cat benchmarks/zoo_fullsize_live.txt)" >> "$LOG"
+    fi
+
+    if [ -f BENCH_r05_live.json ] && [ -f BENCH_r05_resnet.json ] && [ -f BENCH_r05_bert.json ]; then
+      echo "[watcher-r5] all captures complete $(date -u +%H:%M:%S)" >> "$LOG"
+      exit 0
+    fi
+    echo "[watcher-r5] partial capture — resuming watch for the rest" >> "$LOG"
+    sleep 180
+  else
+    sleep 180
+  fi
+done

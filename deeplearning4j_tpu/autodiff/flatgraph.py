@@ -22,7 +22,16 @@ the table field slots reconstructed from the upstream schema:
                      outputTypes:[DType], scalar:FlatArray, controlDeps,
                      varControlDeps, controlDepFor, extraTypes,
                      extraStrings:[string]
-- ``FlatArray``    : shape:[long], buffer:[byte], dtype, byteOrder
+- ``FlatArray``    : shape:[long], buffer:[byte], dtype, byteOrder —
+                     ``shape`` holds the full nd4j shapeInfo descriptor
+                     ``[rank, dims…, strides…, extras, ews, order]``
+                     (ref: BaseNDArray#toFlatArray writes
+                     shapeInfoDataBuffer); the reader also accepts bare
+                     dims for pre-r5 self-written artifacts
+- ``UpdaterState``  : paramName, updaterStateKeys:[string],
+                     updaterStateValues:[FlatArray] — written by
+                     ``save(…, save_updater_state=True)`` so Adam
+                     moments survive a ``.fb`` resume
 - ``FlatProperties``: name, i:[int], l:[long], d:[double], a:[FlatArray],
                      b:[bool], s:[string], shape:[int]
 - ``IntPair``      : first:int, second:int
@@ -75,7 +84,10 @@ _VARTYPE_TO_OURS = {0: "VARIABLE", 1: "CONSTANT", 2: "ARRAY",
                     3: "PLACEHOLDER"}
 _OURS_TO_VARTYPE = {v: k for k, v in _VARTYPE_TO_OURS.items()}
 
-_OP_TYPE_CUSTOM = 22          # org.nd4j.graph.OpType.CUSTOM
+# org.nd4j.graph.OpType: TRANSFORM_FLOAT..RANDOM enumerate 0..20, so
+# CUSTOM = 21 (ADVICE r4: 22 would be GRAPH). The reader below keys on
+# opName and does not validate this constant.
+_OP_TYPE_CUSTOM = 21
 _BYTE_ORDER_LE = 0            # org.nd4j.graph.ByteOrder.LE
 
 # field slot numbers (declaration order in the .fbs — voffset = 4 + 2*slot)
@@ -92,6 +104,10 @@ _FN = {"id": 0, "name": 1, "opType": 2, "opNum": 3, "properties": 4,
 _FG = {"id": 0, "variables": 1, "nodes": 2, "outputs": 3,
        "configuration": 4, "placeholders": 5, "lossVariables": 6,
        "trainingConfig": 7, "updaterState": 8}
+# org.nd4j.graph.UpdaterState: per-parameter named updater moments
+# (ref: graph.fbs ``table UpdaterState { paramName; updaterStateKeys;
+# updaterStateValues }`` — SameDiff#save persists Adam M/V through it)
+_US = {"paramName": 0, "updaterStateKeys": 1, "updaterStateValues": 2}
 
 _ATTR_META = "__attr_meta__"
 
@@ -105,13 +121,28 @@ def _write_int_pair(b, first: int, second: int):
     return b.EndObject()
 
 
+def _shape_info(shape) -> np.ndarray:
+    """nd4j shapeInfo descriptor for a C-order dense array: ``[rank,
+    dims…, elementStrides…, extras, ews, order]`` (len = 2·rank+4 — ref:
+    ``BaseNDArray#toFlatArray`` writes shapeInfoDataBuffer, layout in
+    ``libnd4j helpers/shape.h``). extras=0, ews=1, order='c'=99."""
+    rank = len(shape)
+    strides = []
+    acc = 1
+    for d in reversed(shape):
+        strides.append(acc)
+        acc *= int(d)
+    strides.reverse()
+    return np.asarray([rank, *shape, *strides, 0, 1, ord("c")],
+                      dtype=np.int64)
+
+
 def _write_flat_array(b, arr: np.ndarray):
     arr = np.asarray(arr)
     if arr.dtype not in _NP_TO_DTYPE:
         raise ValueError(f"dtype {arr.dtype} has no FlatBuffers DType code")
     buf_off = b.CreateByteVector(arr.tobytes(order="C"))
-    shape_off = b.CreateNumpyVector(
-        np.asarray(arr.shape, dtype=np.int64))
+    shape_off = b.CreateNumpyVector(_shape_info(arr.shape))
     b.StartObject(4)
     b.PrependUOffsetTRelativeSlot(_FA["shape"], shape_off, 0)
     b.PrependUOffsetTRelativeSlot(_FA["buffer"], buf_off, 0)
@@ -230,6 +261,11 @@ def _jsonable(v):
         return {"__dtype__": v.name}
     if isinstance(v, type) and issubclass(v, np.generic):
         return {"__dtype__": np.dtype(v).name}
+    if isinstance(v, np.ndarray) or type(v).__module__.startswith("jax"):
+        # arrays nested inside lists/dicts take the json path (the
+        # top-level ndarray path uses FlatArray) — ADVICE r4 #4
+        a = np.asarray(v)
+        return {"__nd__": a.tolist(), "__nd_dtype__": a.dtype.name}
     if isinstance(v, (list, tuple)):
         return [_jsonable(e) for e in v]
     if isinstance(v, dict):
@@ -240,6 +276,9 @@ def _jsonable(v):
 def _unjsonable(v):
     if isinstance(v, dict) and "__dtype__" in v:
         return np.dtype(v["__dtype__"])
+    if isinstance(v, dict) and "__nd__" in v:
+        return np.asarray(v["__nd__"],
+                          dtype=np.dtype(v.get("__nd_dtype__", "f4")))
     if isinstance(v, dict):
         return {k: _unjsonable(x) for k, x in v.items()}
     if isinstance(v, list):
@@ -285,10 +324,12 @@ def _collect_graph(sd, prefix: str, vars_out: list, nodes_out: list):
                           attrs, prefix))
 
 
-def to_flat_buffers(sd) -> bytes:
+def to_flat_buffers(sd, include_updater_state: bool = False) -> bytes:
     """Serialize a SameDiff graph to the FlatGraph binary (ref:
     ``SameDiff#asFlatBuffers``). Control-flow subgraphs serialize as
-    scoped node regions (see ``_collect_graph``)."""
+    scoped node regions (see ``_collect_graph``); with
+    ``include_updater_state`` the per-parameter optimizer moments ride
+    the ``updaterState:[UpdaterState]`` vector (ref: ``SameDiff#save``)."""
     from deeplearning4j_tpu.autodiff.samediff import VariableType
 
     all_vars: list = []
@@ -388,6 +429,27 @@ def to_flat_buffers(sd) -> bytes:
         tc_off = b.CreateString(json.dumps(
             _jsonable(sd.training_config.to_dict())))
 
+    us_off = None
+    if include_updater_state:
+        state = sd._updater_state_by_param()
+        if state:
+            us_offs = []
+            for pname in sorted(state):
+                entries = state[pname]
+                pn_off = b.CreateString(pname)
+                keys = sorted(entries)
+                keys_off = _string_vector(b, keys)
+                vals_off = _offset_vector(
+                    b, [_write_flat_array(b, entries[k]) for k in keys])
+                b.StartObject(3)
+                b.PrependUOffsetTRelativeSlot(_US["paramName"], pn_off, 0)
+                b.PrependUOffsetTRelativeSlot(
+                    _US["updaterStateKeys"], keys_off, 0)
+                b.PrependUOffsetTRelativeSlot(
+                    _US["updaterStateValues"], vals_off, 0)
+                us_offs.append(b.EndObject())
+            us_off = _offset_vector(b, us_offs)
+
     b.StartObject(9)
     b.PrependUOffsetTRelativeSlot(_FG["variables"], variables_off, 0)
     b.PrependUOffsetTRelativeSlot(_FG["nodes"], nodes_off, 0)
@@ -395,6 +457,8 @@ def to_flat_buffers(sd) -> bytes:
     b.PrependUOffsetTRelativeSlot(_FG["lossVariables"], loss_off, 0)
     if tc_off is not None:
         b.PrependUOffsetTRelativeSlot(_FG["trainingConfig"], tc_off, 0)
+    if us_off is not None:
+        b.PrependUOffsetTRelativeSlot(_FG["updaterState"], us_off, 0)
     root = b.EndObject()
     b.Finish(root)
     return bytes(b.Output())
@@ -469,6 +533,27 @@ class _Tab:
                 for j in range(n)]
 
 
+def _decode_shape(vec: np.ndarray, n_elems: int) -> tuple:
+    """FlatArray.shape → (dims, order). Reference artifacts store the
+    full nd4j shapeInfo descriptor (``[rank, dims…, strides…, extras,
+    ews, order]``, len = 2·rank+4); our pre-r5 artifacts stored bare
+    dims (always C order). Detect by layout, disambiguating rare
+    collisions via the buffer element count. The order char matters: an
+    f-order reference array's buffer is laid out column-major."""
+    vals = [int(x) for x in vec]
+    n = len(vals)
+    if n >= 4 and vals[0] >= 0 and n == 2 * vals[0] + 4:
+        dims = tuple(vals[1:1 + vals[0]])
+        si_elems = int(np.prod(dims)) if dims else 1
+        bare_elems = int(np.prod(vals)) if vals else 1
+        # both layouts possible only when n == 2*vals[0]+4 AND the bare
+        # product matches the buffer — prefer whichever is consistent
+        if si_elems == n_elems or bare_elems != n_elems:
+            order = "F" if vals[-1] == ord("f") else "C"
+            return dims, order
+    return tuple(vals), "C"
+
+
 def _read_flat_array(tab: _Tab) -> np.ndarray:
     shape = tab.scalar_vec(_FA["shape"], np.int64)
     code = tab.i8(_FA["dtype"])
@@ -477,7 +562,8 @@ def _read_flat_array(tab: _Tab) -> np.ndarray:
         raise ValueError(f"FlatArray dtype code {code} unsupported")
     raw = tab.scalar_vec(_FA["buffer"], np.uint8)
     arr = np.frombuffer(bytes(raw.tobytes()), dtype=dt)
-    return arr.reshape(tuple(int(s) for s in shape))
+    dims, order = _decode_shape(shape, arr.size)
+    return np.reshape(arr, dims, order=order)
 
 
 def _property_value(tab: _Tab, meta: dict):
@@ -673,6 +759,16 @@ def from_flat_buffers(data: bytes):
     if tc:
         sd.training_config = TrainingConfig.from_dict(
             _unjsonable(json.loads(tc)))
+    us_tabs = g.table_vec(_FG["updaterState"])
+    if us_tabs:
+        named: Dict[str, dict] = {}
+        for ut in us_tabs:
+            pname = ut.string(_US["paramName"]) or ""
+            keys = ut.string_vec(_US["updaterStateKeys"])
+            vals = [_read_flat_array(a)
+                    for a in ut.table_vec(_US["updaterStateValues"])]
+            named[pname] = dict(zip(keys, vals))
+        sd._pending_opt_named = named
     return sd
 
 

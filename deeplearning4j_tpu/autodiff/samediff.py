@@ -637,6 +637,8 @@ class SameDiff:
         self._train_sig = None
         self._opt_state = None
         self._pending_opt_leaves = None
+        self._pending_opt_named = None   # {paramName: {key: array}} from a
+                                         # FlatGraph UpdaterState table
         self._seed = 12345
         self.listeners: List[Any] = []
         self.epoch_count = 0
@@ -987,6 +989,50 @@ class SameDiff:
         return [n for n, v in self._vars.items()
                 if v.var_type == VariableType.PLACEHOLDER]
 
+    # ---- updater-state naming (FlatGraph UpdaterState table) -----------
+    @staticmethod
+    def _opt_leaf_key(path, trainable: set):
+        """A tree-path of the optax state → (paramName, stateKey). Leaves
+        whose path crosses a trainable param's dict key group under that
+        param (e.g. Adam's mu['w'] → ('w', '0/mu')); global leaves (step
+        count) group under paramName '' — the reference's per-parameter
+        ``UpdaterState{paramName, updaterStateKeys, updaterStateValues}``
+        shape."""
+        pname, parts = "", []
+        for entry in path:
+            k = getattr(entry, "key", None)
+            if k is None:
+                k = getattr(entry, "name", None)
+            if k is None:
+                k = getattr(entry, "idx", None)
+            if isinstance(k, str) and not pname and k in trainable:
+                pname = k
+            else:
+                parts.append(str(k))
+        return pname, "/".join(parts) or "_"
+
+    def _updater_state_by_param(self):
+        """Current optimizer state grouped per parameter (None when no
+        state exists) — the FlatGraph ``updaterState`` payload."""
+        if self._opt_state is None:
+            return None
+        from jax.tree_util import tree_flatten_with_path
+
+        trainable = set(self.trainable_names())
+        flat, _ = tree_flatten_with_path(self._opt_state)
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for path, leaf in flat:
+            pname, key = self._opt_leaf_key(path, trainable)
+            out.setdefault(pname, {})[key] = np.asarray(leaf)
+        # record WHICH updater produced the state: a different but
+        # key-compatible updater (RMSProp's nu ⊂ Adam's state) must not
+        # silently adopt the wrong moments on restore
+        upd = getattr(self.training_config, "updater", None)
+        if upd is not None:
+            out.setdefault("", {})["__updater__"] = np.frombuffer(
+                type(upd).__name__.encode("utf-8"), np.uint8).copy()
+        return out
+
     def ops(self) -> List[OpNode]:
         return list(self._ops)
 
@@ -1238,6 +1284,41 @@ class SameDiff:
             if len(leaves) == treedef.num_leaves:
                 init_state = jax.tree.unflatten(treedef, leaves)
             self._pending_opt_leaves = None
+        elif self._pending_opt_named is not None:
+            # per-parameter state from a FlatGraph UpdaterState table:
+            # match each fresh leaf by its (paramName, stateKey) path —
+            # robust to leaf ORDER, unlike the flat-leaves zip path
+            from jax.tree_util import tree_flatten_with_path
+
+            ok = True
+            saved_upd = self._pending_opt_named.get("", {}).pop(
+                "__updater__", None)
+            if saved_upd is not None and tc.updater is not None:
+                saved_name = bytes(np.asarray(
+                    saved_upd, np.uint8)).decode("utf-8")
+                if saved_name != type(tc.updater).__name__:
+                    ok = False          # key-compatible ≠ state-compatible
+            tset = set(trainable)
+            flat, _ = tree_flatten_with_path(init_state)
+            new_leaves = []
+            for path, leaf in (flat if ok else []):
+                pname, key = self._opt_leaf_key(path, tset)
+                arr = self._pending_opt_named.get(pname, {}).get(key)
+                if arr is None or tuple(np.shape(arr)) != tuple(leaf.shape):
+                    ok = False
+                    break
+                new_leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+            if ok:
+                init_state = jax.tree.unflatten(
+                    jax.tree.structure(init_state), new_leaves)
+            else:
+                import warnings
+
+                warnings.warn(
+                    "saved updaterState does not match the updater's "
+                    "state tree (different updater config?) — starting "
+                    "from fresh optimizer state", stacklevel=2)
+            self._pending_opt_named = None
         return jitted, init_state
 
     def evaluate(self, iterator, output_name: str, evaluation=None,
@@ -1326,7 +1407,8 @@ class SameDiff:
             # them onto the mesh alongside the values
             keep_state = (self._train_sig == sig
                           and self._opt_state is not None
-                          and self._pending_opt_leaves is None)
+                          and self._pending_opt_leaves is None
+                          and self._pending_opt_named is None)
             if self.mesh is not None:
                 self._replicate_values()
             self._train_step, fresh_state = self._build_train_step(sig)
@@ -1393,12 +1475,15 @@ class SameDiff:
                         f"{prefix}__sub__/{op.name}/{k}/"))
         return out
 
-    def as_flat_buffers(self) -> bytes:
+    def as_flat_buffers(self, include_updater_state: bool = False) -> bytes:
         """The graph as a reference-schema FlatGraph binary (ref:
-        ``SameDiff#asFlatBuffers`` — org.nd4j.graph FlatBuffers schema)."""
+        ``SameDiff#asFlatBuffers`` — org.nd4j.graph FlatBuffers schema).
+        ``include_updater_state`` writes the per-parameter UpdaterState
+        table so Adam moments survive a ``.fb`` round-trip."""
         from deeplearning4j_tpu.autodiff import flatgraph
 
-        return flatgraph.to_flat_buffers(self)
+        return flatgraph.to_flat_buffers(
+            self, include_updater_state=include_updater_state)
 
     asFlatBuffers = as_flat_buffers
 
@@ -1415,18 +1500,15 @@ class SameDiff:
         """Persist graph + values. A ``.fb``/``.fbs``/``.sdfb`` path writes
         the reference's FlatGraph binary (ref: ``SameDiff#save`` writes
         FlatBuffers; control-flow subgraphs ride as scoped node regions);
-        anything else uses the native zip container (which additionally
-        carries updater state)."""
+        anything else uses the native zip container. With
+        ``save_updater_state=True`` BOTH formats persist the optimizer
+        moments — the fb path through the UpdaterState table, the zip
+        through ``updater.npz`` — so a resumed fine-tune continues
+        exactly (ref: SameDiff#save includes updater state)."""
         if str(path).endswith((".fb", ".fbs", ".sdfb")):
-            if save_updater_state and self._opt_state is not None:
-                import warnings
-
-                warnings.warn(
-                    "save_updater_state=True is not representable in the "
-                    "FlatGraph binary — updater moments are NOT saved; use "
-                    "the native zip container to persist them", stacklevel=2)
             with open(path, "wb") as f:
-                f.write(self.as_flat_buffers())
+                f.write(self.as_flat_buffers(
+                    include_updater_state=save_updater_state))
             return
         d = self.to_dict()
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:

@@ -225,3 +225,92 @@ def test_imported_bert_mini_survives_fb_save_load(tmp_path):
 
     losses = sd2.fit([MultiDataSet([ids, mask], [y])] * 3, epochs=1)
     assert all(np.isfinite(losses))
+
+
+class TestUpdaterState:
+    """FlatGraph ``updaterState:[UpdaterState]`` (VERDICT r4 Missing #2;
+    ref: ``SameDiff#save`` persists Adam moments through graph.fbs's
+    UpdaterState table so a resumed fine-tune continues exactly)."""
+
+    def _trained(self, steps=6):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        sd = _linear_sd()
+        lab = sd.placeholder("label", (None, 2), np.float32)
+        sd.loss.mse(lab, sd._vars["y"]).rename("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.05), data_set_feature_mapping=["x"],
+            data_set_label_mapping=["label"], loss_variables=["loss"]))
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(32, 3)).astype(np.float32)
+        W = np.array([[1.0, -1.0], [0.5, 2.0], [-0.3, 0.7]], np.float32)
+        ds = DataSet(X, X @ W)
+        sd.fit([ds] * steps, epochs=1)
+        return sd, ds
+
+    def test_resume_identical_to_uninterrupted(self, tmp_path):
+        """save(.fb, save_updater_state=True) → load → fit produces the
+        SAME losses as never stopping (Adam moments intact)."""
+        sd, ds = self._trained()
+        p = str(tmp_path / "ckpt.fb")
+        sd.save(p, save_updater_state=True)
+        uninterrupted = sd.fit([ds] * 5, epochs=1)
+
+        sd2 = SameDiff.load(p)
+        assert sd2._pending_opt_named is not None
+        resumed = sd2.fit([ds] * 5, epochs=1)
+        np.testing.assert_allclose(list(resumed), list(uninterrupted),
+                                   rtol=1e-5)
+
+    def test_without_state_restarts_moments(self, tmp_path):
+        """Default save omits the table; the resumed run differs from the
+        uninterrupted one (fresh moments) — proving the state matters."""
+        sd, ds = self._trained()
+        p = str(tmp_path / "ckpt.fb")
+        sd.save(p)                                # no updater state
+        uninterrupted = sd.fit([ds] * 5, epochs=1)
+        sd2 = SameDiff.load(p)
+        assert sd2._pending_opt_named is None
+        resumed = sd2.fit([ds] * 5, epochs=1)
+        assert not np.allclose(list(resumed), list(uninterrupted), rtol=1e-6)
+
+    def test_mismatched_updater_falls_back_fresh(self, tmp_path):
+        """Loading state under a different updater config warns and starts
+        fresh instead of crashing or silently mis-mapping."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.optim.updaters import RmsProp
+
+        sd, ds = self._trained()
+        p = str(tmp_path / "ckpt.fb")
+        sd.save(p, save_updater_state=True)
+        sd2 = SameDiff.load(p)
+        # RMSProp's nu is a KEY-COMPATIBLE subset of Adam's state — only
+        # the persisted updater identity catches this; silently adopting
+        # Adam's second moments as RMSProp state would be wrong
+        sd2.training_config.updater = RmsProp(0.05)
+        with pytest.warns(UserWarning, match="updaterState"):
+            h = sd2.fit([ds] * 2, epochs=1)
+        assert np.isfinite(h[-1])
+
+    def test_shape_info_layout_and_backcompat(self):
+        """FlatArray.shape is the nd4j shapeInfo descriptor (ADVICE r4
+        medium): [rank, dims, strides, extras, ews, order], len 2r+4 —
+        and the reader still accepts pre-r5 bare-dims artifacts."""
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        si = flatgraph._shape_info(a.shape)
+        assert list(si) == [3, 2, 3, 4, 12, 4, 1, 0, 1, ord("c")]
+        assert flatgraph._decode_shape(si, a.size) == ((2, 3, 4), "C")
+        # bare-dims back-compat
+        assert flatgraph._decode_shape(
+            np.asarray([2, 3, 4], np.int64), 24) == ((2, 3, 4), "C")
+        # scalar
+        assert flatgraph._decode_shape(
+            flatgraph._shape_info(()), 1) == ((), "C")
+        # collision case: bare dims (3,2,2,2,2,1,1,1,1,1) has len 10 ==
+        # 2*3+4 but its product disambiguates via the buffer size
+        bare = np.asarray([3, 2, 2, 2, 2, 1, 1, 1, 1, 1], np.int64)
+        assert flatgraph._decode_shape(bare, 48) == (tuple(bare), "C")
+        # an f-order reference descriptor reshapes column-major
+        fsi = np.asarray([2, 2, 3, 1, 2, 0, 1, ord("f")], np.int64)
+        assert flatgraph._decode_shape(fsi, 6) == ((2, 3), "F")
