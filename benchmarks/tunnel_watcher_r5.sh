@@ -42,10 +42,14 @@ while true; do
       && mv BENCH_r05_live.json.tmp BENCH_r05_live.json \
       && echo "[watcher-r5] flagship done: $(cat BENCH_r05_live.json)" >> "$LOG"
 
-    timeout 900 python benchmarks/ring_attention_bench.py --tpu --memory \
-      --seqs 8192 16384 32768 49152 --devices 8 --heads 8 --dim 128 \
-      > benchmarks/ring_memory_live.txt 2>> "$LOG" \
-      && echo "[watcher-r5] ring memory done" >> "$LOG"
+    if [ ! -f benchmarks/ring_memory_live.txt ] || ! grep -q "seq" benchmarks/ring_memory_live.txt; then
+      timeout 900 python benchmarks/ring_attention_bench.py --tpu --memory \
+        --seqs 8192 16384 32768 49152 --devices 8 --heads 8 --dim 128 \
+        > benchmarks/ring_memory_live.txt.tmp 2>> "$LOG" \
+        && grep -q "seq" benchmarks/ring_memory_live.txt.tmp \
+        && mv benchmarks/ring_memory_live.txt.tmp benchmarks/ring_memory_live.txt \
+        && echo "[watcher-r5] ring memory done" >> "$LOG"
+    fi
 
     if [ ! -f benchmarks/zoo_fullsize_live.txt ] || ! grep -q '"finite": true' benchmarks/zoo_fullsize_live.txt; then
       timeout 1200 python benchmarks/zoo_fullsize_step.py \

@@ -769,6 +769,12 @@ def from_flat_buffers(data: bytes):
                     for a in ut.table_vec(_US["updaterStateValues"])]
             named[pname] = dict(zip(keys, vals))
         sd._pending_opt_named = named
+        # identity of the updater that produced the state: the artifact's
+        # trainingConfig updater (guards the rehydrate against a
+        # key-compatible but different updater)
+        upd = getattr(sd.training_config, "updater", None)
+        if upd is not None:
+            sd._pending_opt_updater = type(upd).__name__
     return sd
 
 

@@ -639,6 +639,8 @@ class SameDiff:
         self._pending_opt_leaves = None
         self._pending_opt_named = None   # {paramName: {key: array}} from a
                                          # FlatGraph UpdaterState table
+        self._pending_opt_updater = None  # class name of the updater that
+                                          # produced the pending state
         self._seed = 12345
         self.listeners: List[Any] = []
         self.epoch_count = 0
@@ -1013,8 +1015,13 @@ class SameDiff:
 
     def _updater_state_by_param(self):
         """Current optimizer state grouped per parameter (None when no
-        state exists) — the FlatGraph ``updaterState`` payload."""
+        state exists) — the FlatGraph ``updaterState`` payload. A graph
+        loaded from a checkpoint but not yet re-fit still holds its state
+        as ``_pending_opt_named`` — re-saving must not drop it."""
         if self._opt_state is None:
+            if self._pending_opt_named is not None:
+                return {p: dict(kv)
+                        for p, kv in self._pending_opt_named.items()}
             return None
         from jax.tree_util import tree_flatten_with_path
 
@@ -1024,13 +1031,6 @@ class SameDiff:
         for path, leaf in flat:
             pname, key = self._opt_leaf_key(path, trainable)
             out.setdefault(pname, {})[key] = np.asarray(leaf)
-        # record WHICH updater produced the state: a different but
-        # key-compatible updater (RMSProp's nu ⊂ Adam's state) must not
-        # silently adopt the wrong moments on restore
-        upd = getattr(self.training_config, "updater", None)
-        if upd is not None:
-            out.setdefault("", {})["__updater__"] = np.frombuffer(
-                type(upd).__name__.encode("utf-8"), np.uint8).copy()
         return out
 
     def ops(self) -> List[OpNode]:
@@ -1279,11 +1279,16 @@ class SameDiff:
             # updater state loaded from a checkpoint: rehydrate into the
             # freshly-built optax tree structure (ref: SameDiff#load restoring
             # updater moments so Adam state survives resume)
+            same_upd = (self._pending_opt_updater is None
+                        or tc.updater is None
+                        or self._pending_opt_updater
+                        == type(tc.updater).__name__)
             treedef = jax.tree.structure(init_state)
             leaves = [jnp.asarray(l) for l in self._pending_opt_leaves]
-            if len(leaves) == treedef.num_leaves:
+            if same_upd and len(leaves) == treedef.num_leaves:
                 init_state = jax.tree.unflatten(treedef, leaves)
             self._pending_opt_leaves = None
+            self._pending_opt_updater = None
         elif self._pending_opt_named is not None:
             # per-parameter state from a FlatGraph UpdaterState table:
             # match each fresh leaf by its (paramName, stateKey) path —
@@ -1291,13 +1296,14 @@ class SameDiff:
             from jax.tree_util import tree_flatten_with_path
 
             ok = True
-            saved_upd = self._pending_opt_named.get("", {}).pop(
-                "__updater__", None)
-            if saved_upd is not None and tc.updater is not None:
-                saved_name = bytes(np.asarray(
-                    saved_upd, np.uint8)).decode("utf-8")
-                if saved_name != type(tc.updater).__name__:
-                    ok = False          # key-compatible ≠ state-compatible
+            # identity of the updater that PRODUCED the state = the
+            # artifact's trainingConfig updater (recorded at load); a
+            # key-compatible but different updater (RMSProp's nu ⊂
+            # Adam's state) must not silently adopt the wrong moments
+            if self._pending_opt_updater is not None \
+                    and tc.updater is not None \
+                    and self._pending_opt_updater != type(tc.updater).__name__:
+                ok = False
             tset = set(trainable)
             flat, _ = tree_flatten_with_path(init_state)
             new_leaves = []
@@ -1319,6 +1325,7 @@ class SameDiff:
                     "state tree (different updater config?) — starting "
                     "from fresh optimizer state", stacklevel=2)
             self._pending_opt_named = None
+            self._pending_opt_updater = None
         return jitted, init_state
 
     def evaluate(self, iterator, output_name: str, evaluation=None,
@@ -1516,12 +1523,32 @@ class SameDiff:
             buf = io.BytesIO()
             np.savez(buf, **self._gather_values())
             zf.writestr("values.npz", buf.getvalue())
-            if save_updater_state and self._opt_state is not None:
-                leaves = jax.tree.leaves(self._opt_state)
-                buf = io.BytesIO()
-                np.savez(buf, **{f"leaf{i}": np.asarray(l)
-                                 for i, l in enumerate(leaves)})
-                zf.writestr("updater.npz", buf.getvalue())
+            if save_updater_state:
+                if self._opt_state is not None:
+                    leaves = [np.asarray(l)
+                              for l in jax.tree.leaves(self._opt_state)]
+                elif self._pending_opt_leaves is not None:
+                    # loaded-but-not-refit checkpoint: re-saving must not
+                    # drop the state it still carries
+                    leaves = [np.asarray(l)
+                              for l in self._pending_opt_leaves]
+                elif self._pending_opt_named is not None:
+                    # named fb-style state has no defined flat order for
+                    # the zip container — write the named form instead
+                    buf = io.BytesIO()
+                    np.savez(buf, **{
+                        f"{p}||{k}": np.asarray(v)
+                        for p, kv in self._pending_opt_named.items()
+                        for k, v in kv.items()})
+                    zf.writestr("updater_named.npz", buf.getvalue())
+                    leaves = None
+                else:
+                    leaves = None
+                if leaves is not None:
+                    buf = io.BytesIO()
+                    np.savez(buf, **{f"leaf{i}": l
+                                     for i, l in enumerate(leaves)})
+                    zf.writestr("updater.npz", buf.getvalue())
 
     @staticmethod
     def load(path: str) -> "SameDiff":
@@ -1543,6 +1570,7 @@ class SameDiff:
                     f"truncated?) nor a readable FlatGraph binary: "
                     f"{e!r}") from e
         opt_leaves = None
+        opt_named = None
         with zipfile.ZipFile(path) as zf:
             d = json.loads(zf.read("graph.json"))
             with zf.open("values.npz") as f:
@@ -1551,8 +1579,20 @@ class SameDiff:
                 with zf.open("updater.npz") as f:
                     raw = dict(np.load(io.BytesIO(f.read())))
                 opt_leaves = [raw[f"leaf{i}"] for i in range(len(raw))]
+            elif "updater_named.npz" in zf.namelist():
+                with zf.open("updater_named.npz") as f:
+                    raw = dict(np.load(io.BytesIO(f.read())))
+                opt_named = {}
+                for key, arr in raw.items():
+                    pname, _, skey = key.partition("||")
+                    opt_named.setdefault(pname, {})[skey] = arr
         sd = SameDiff._restore(d, values)
         sd._pending_opt_leaves = opt_leaves
+        sd._pending_opt_named = opt_named
+        if (opt_leaves is not None or opt_named is not None):
+            upd = getattr(sd.training_config, "updater", None)
+            if upd is not None:
+                sd._pending_opt_updater = type(upd).__name__
         return sd
 
     @staticmethod

@@ -314,3 +314,36 @@ class TestUpdaterState:
         # an f-order reference descriptor reshapes column-major
         fsi = np.asarray([2, 2, 3, 1, 2, 0, 1, ord("f")], np.int64)
         assert flatgraph._decode_shape(fsi, 6) == ((2, 3), "F")
+
+    def test_resave_without_refit_keeps_state(self, tmp_path):
+        """load → save (no fit in between) must not drop the updater
+        state the artifact carried (r5 review finding)."""
+        sd, ds = self._trained()
+        p1 = str(tmp_path / "a.fb")
+        sd.save(p1, save_updater_state=True)
+        uninterrupted = sd.fit([ds] * 5, epochs=1)
+
+        mid = SameDiff.load(p1)                 # no fit
+        p2 = str(tmp_path / "b.fb")
+        mid.save(p2, save_updater_state=True)   # re-save a copy
+        sd2 = SameDiff.load(p2)
+        resumed = sd2.fit([ds] * 5, epochs=1)
+        np.testing.assert_allclose(list(resumed), list(uninterrupted),
+                                   rtol=1e-5)
+
+    def test_fb_state_survives_zip_resave(self, tmp_path):
+        """fb → load → save as ZIP (named form) → load → resume parity:
+        the state crosses container formats."""
+        sd, ds = self._trained()
+        pfb = str(tmp_path / "a.fb")
+        sd.save(pfb, save_updater_state=True)
+        uninterrupted = sd.fit([ds] * 4, epochs=1)
+
+        mid = SameDiff.load(pfb)
+        pzip = str(tmp_path / "b.sdz")
+        mid.save(pzip, save_updater_state=True)
+        sd2 = SameDiff.load(pzip)
+        assert sd2._pending_opt_named is not None
+        resumed = sd2.fit([ds] * 4, epochs=1)
+        np.testing.assert_allclose(list(resumed), list(uninterrupted),
+                                   rtol=1e-5)
