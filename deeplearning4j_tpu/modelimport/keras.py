@@ -195,16 +195,13 @@ def _map_layer(cls: str, cfg: dict):
             dilation=_pair(cfg.get("dilation_rate", 1)),
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "Conv2DTranspose":
-        if any(int(p) != 0 for p in (cfg.get("output_padding") or ())) \
-                or any(int(d) != 1
-                       for d in _as_seq(cfg.get("dilation_rate", 1))):
-            raise UnsupportedKerasConfigurationException(
-                "Conv2DTranspose: output_padding/dilation_rate are not "
-                "supported — re-save with the defaults")
+        op = cfg.get("output_padding")
         return L.Deconvolution2D(
             name=name, n_out=cfg["filters"],
             kernel_size=_pair(cfg["kernel_size"]),
             stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            output_padding=_pair(op) if op is not None else None,
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "SeparableConv2D":
         return L.SeparableConvolution2D(
@@ -227,16 +224,14 @@ def _map_layer(cls: str, cfg: dict):
             padding=pad if pad in ("same", "causal") else 0,
             activation=act, has_bias=use_bias)
     if cls == "Conv3DTranspose":
-        if any(int(p) != 0 for p in (cfg.get("output_padding") or ())) \
-                or any(int(d) != 1
-                       for d in _as_seq(cfg.get("dilation_rate", 1))):
-            raise UnsupportedKerasConfigurationException(
-                "Conv3DTranspose: output_padding/dilation_rate are not "
-                "supported — re-save with the defaults")
+        op3 = cfg.get("output_padding")
+        d3 = cfg.get("dilation_rate", 1)
         return L.Deconvolution3D(
             name=name, n_out=cfg["filters"],
             kernel_size=tuple(cfg["kernel_size"]),
             stride=tuple(cfg.get("strides", (1, 1, 1))),
+            dilation=tuple(d3) if not isinstance(d3, int) else (d3,) * 3,
+            output_padding=tuple(op3) if op3 is not None else None,
             padding=_padding(cfg), activation=act, has_bias=use_bias)
     if cls == "ConvLSTM2D":
         if cfg.get("go_backwards") or cfg.get("stateful"):
@@ -245,12 +240,6 @@ def _map_layer(cls: str, cfg: dict):
         if any(int(d) != 1 for d in _as_seq(cfg.get("dilation_rate", 1))):
             raise UnsupportedKerasConfigurationException(
                 "ConvLSTM2D: dilation_rate != 1 unsupported")
-        if cfg.get("recurrent_activation", "sigmoid") not in (
-                "sigmoid", "hard_sigmoid"):
-            raise UnsupportedKerasConfigurationException(
-                f"ConvLSTM2D: recurrent_activation "
-                f"{cfg.get('recurrent_activation')!r} unsupported "
-                f"(sigmoid/hard_sigmoid only)")
         return L.ConvLSTM2D(
             name=name, n_out=cfg["filters"],
             kernel_size=_pair(cfg["kernel_size"]),
@@ -332,15 +321,12 @@ def _map_layer(cls: str, cfg: dict):
         if _padding(cfg) not in (0, (0, 0), "valid", "VALID"):
             raise UnsupportedKerasConfigurationException(
                 f"{cls}: only 'valid' padding")
-        if int(cfg.get("implementation", 1)) != 1:
-            # implementation=2/3 store the kernel in a permuted axis order
-            # with the same element count — a silent np.reshape onto our
-            # (positions, kh*kw*in, filters) layout would load permuted
-            # weights and produce wrong outputs
+        if int(cfg.get("implementation", 1)) not in (1, 2):
+            # implementation=3 stores a scipy-sparse kernel whose
+            # get_weights layout is backend-dependent — still refused
             raise UnsupportedKerasConfigurationException(
-                f"{cls}: only implementation=1 kernels are importable "
-                f"(got implementation={cfg.get('implementation')}; "
-                f"re-save the model with implementation=1)")
+                f"{cls}: implementation=3 (sparse) kernels are not "
+                f"importable; re-save with implementation=1 or 2")
         if cls == "LocallyConnected2D":
             return L.LocallyConnected2D(
                 name=name, n_out=cfg["filters"],
@@ -565,8 +551,10 @@ def _load_weights_into(layer, w: Dict[str, np.ndarray], params: dict,
                              ("Wv", "value/kernel")):
             arr = find(theirs)
             if arr is not None:
+                # einsum kernel (C, H, dh) — C is the SOURCE's feature dim
+                # (differs per projection for cross attention)
                 params.setdefault(lkey, {})[ours] = jnp.asarray(
-                    arr.reshape(layer.n_in, hs))
+                    arr.reshape(arr.shape[0], hs))
         arr = find("attention_output/kernel")
         if arr is not None:
             params.setdefault(lkey, {})["Wo"] = jnp.asarray(
@@ -587,17 +575,44 @@ def _load_weights_into(layer, w: Dict[str, np.ndarray], params: dict,
     elif isinstance(layer, L.PReLULayer):
         put("alpha", "alpha")
     elif isinstance(layer, (L.LocallyConnected2D, L.LocallyConnected1D)):
-        # Keras LC kernel: (positions, kh*kw*in, filters), feature axis in
-        # (*k, C) order — exactly the layer's internal patch layout, so a
-        # pure reshape onto the position grid suffices; bias is
-        # per-position in both
+        # Keras LC implementation=1 kernel: (positions, kh*kw*in, filters),
+        # feature axis in (*k, C) order — exactly the layer's internal
+        # patch layout, so a pure reshape onto the position grid suffices.
+        # implementation=2 stores the FULL masked dense kernel
+        # (in_spatial…, cin, out_spatial…, filters); the local weights are
+        # its banded diagonal — extracted below (r5 closes that refusal).
         for pname in ("kernel", "bias"):
             arr = w.get(pname)
-            if arr is not None:
-                our = "W" if pname == "kernel" else "b"
-                tgt = layer.param_shapes()[our]
-                params.setdefault(lkey, {})[our] = jnp.asarray(
-                    np.reshape(np.asarray(arr), tgt))
+            if arr is None:
+                continue
+            arr = np.asarray(arr)
+            our = "W" if pname == "kernel" else "b"
+            tgt = layer.param_shapes()[our]
+            if pname == "kernel" and isinstance(
+                    layer, L.LocallyConnected2D) and arr.ndim == 6:
+                oh, ow, fd, f = tgt
+                kh, kw = layer.kernel_size
+                sh, sw = layer.stride
+                cin = fd // (kh * kw)
+                out = np.empty((oh, ow, kh, kw, cin, f), arr.dtype)
+                for dh in range(kh):
+                    for dw in range(kw):
+                        sub = arr[dh:dh + oh * sh:sh,
+                                  dw:dw + ow * sw:sw]
+                        out[:, :, dh, dw] = np.einsum("ijcijf->ijcf", sub)
+                arr = out
+            elif pname == "kernel" and isinstance(
+                    layer, L.LocallyConnected1D) and arr.ndim == 4:
+                ol, fd, f = tgt
+                k, s = layer.kernel_size, layer.stride
+                cin = fd // k
+                out = np.empty((ol, k, cin, f), arr.dtype)
+                for d in range(k):
+                    out[:, d] = np.einsum("icif->icf",
+                                          arr[d:d + ol * s:s])
+                arr = out
+            params.setdefault(lkey, {})[our] = jnp.asarray(
+                np.reshape(arr, tgt))
     elif isinstance(layer, L.BatchNormalization):
         put("gamma", "gamma")
         put("beta", "beta")
@@ -823,10 +838,6 @@ class KerasModelImport:
                     g.add_vertex(name, vcls(
                         causal=bool(lcfg.get("causal", False))), *srcs)
                 elif cls == "MultiHeadAttention":
-                    if len(set(srcs)) != 1:
-                        raise UnsupportedKerasConfigurationException(
-                            "MultiHeadAttention: only the self-attention "
-                            "form (query is value is key) is importable")
                     if lcfg.get("value_dim") not in (None,
                                                      lcfg.get("key_dim")):
                         raise UnsupportedKerasConfigurationException(
@@ -836,11 +847,19 @@ class KerasModelImport:
                         raise UnsupportedKerasConfigurationException(
                             "MultiHeadAttention: custom output_shape "
                             "unsupported")
-                    lyr = L.SelfAttentionLayer(
-                        name=name, n_heads=int(lcfg["num_heads"]),
-                        head_size=int(lcfg["key_dim"]),
-                        qkv_bias=bool(lcfg.get("use_bias", True)))
-                    g.add_layer(name, lyr, srcs[0])
+                    if len(set(srcs)) == 1:
+                        lyr = L.SelfAttentionLayer(
+                            name=name, n_heads=int(lcfg["num_heads"]),
+                            head_size=int(lcfg["key_dim"]),
+                            qkv_bias=bool(lcfg.get("use_bias", True)))
+                        g.add_layer(name, lyr, srcs[0])
+                    else:
+                        # cross form: Keras call order (query, value[, key])
+                        lyr = L.CrossAttentionLayer(
+                            name=name, n_heads=int(lcfg["num_heads"]),
+                            head_size=int(lcfg["key_dim"]),
+                            qkv_bias=bool(lcfg.get("use_bias", True)))
+                        g.add_layer(name, lyr, *srcs)
                     mapped[name] = lyr
                 else:
                     out = _map_layer(cls, lcfg)

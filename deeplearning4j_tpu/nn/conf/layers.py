@@ -497,20 +497,65 @@ class ConvolutionLayer(_ConvBase):
         return self._act(z), state
 
 
+def deconv_pad_pairs(kernel_size, stride, dilation, padding,
+                     output_padding):
+    """Explicit (lo, hi) pairs for ``lax.conv_transpose``, which applies
+    them to the LHS-DILATED input (out = (in−1)·s + lo + hi − k_eff + 2).
+    The transposed conv with forward padding p is lo = hi = k_eff − 1 − p;
+    Keras output_padding extends the high side. Shared by
+    Deconvolution2D/3D (any spatial rank). 'same' semantics:
+
+    - output_padding=None: Keras out = in·s  ⇒  pad_total = k_eff − s
+      (TF's forward-same split, more padding on the high side)
+    - output_padding given: Keras deconv_output_length uses p = k_eff//2
+      ⇒  pad_total = 2·(k_eff//2) − op
+    """
+    keff = tuple((k - 1) * d + 1 for k, d in zip(kernel_size, dilation))
+    op = output_padding or (0,) * len(keff)
+    if isinstance(padding, str) and padding.lower() == "same":
+        pairs = []
+        for k, s, o in zip(keff, stride, op):
+            total = (max(k - s, 0) if output_padding is None
+                     else 2 * (k // 2) - o)
+            lo_f = total // 2
+            pairs.append((k - 1 - lo_f, k - 1 - (total - lo_f)))
+        return pairs
+    pads = (0,) * len(keff) if isinstance(padding, str) else padding
+    return [(k - 1 - p, k - 1 - p + o) for k, p, o in zip(keff, pads, op)]
+
+
 @register_layer
 @dataclasses.dataclass
 class Deconvolution2D(_ConvBase):
-    """Transposed conv (ref: conf.layers.Deconvolution2D)."""
+    """Transposed conv (ref: conf.layers.Deconvolution2D; Keras
+    Conv2DTranspose incl. output_padding/dilation — r5 closes that
+    refusal). ``output_padding`` adds rows/cols to the bottom/right of
+    the output (Keras deconv_output_length semantics); ``dilation``
+    dilates the kernel (effective size (k−1)·d+1)."""
+    output_padding: Optional[Tuple[int, int]] = None
+
+    def _k_eff(self):
+        return tuple((k - 1) * d + 1
+                     for k, d in zip(self.kernel_size, self.dilation))
+
+    def _pad_pairs(self):
+        return deconv_pad_pairs(self.kernel_size, self.stride,
+                                self.dilation, self.padding,
+                                self.output_padding)
 
     def output_type(self, input_type: InputType) -> InputType:
         same = isinstance(self.padding, str) and self.padding.lower() == "same"
-        if same:
+        if same and not self.output_padding \
+                and all(d == 1 for d in self.dilation):
             h = input_type.height * self.stride[0]
             w = input_type.width * self.stride[1]
         else:
-            ph, pw = self.padding
-            h = self.stride[0] * (input_type.height - 1) + self.kernel_size[0] - 2 * ph
-            w = self.stride[1] * (input_type.width - 1) + self.kernel_size[1] - 2 * pw
+            keff = self._k_eff()
+            pairs = self._pad_pairs()
+            h = (self.stride[0] * (input_type.height - 1) + sum(pairs[0])
+                 - keff[0] + 2)
+            w = (self.stride[1] * (input_type.width - 1) + sum(pairs[1])
+                 - keff[1] + 2)
         return InputType.convolutional(h, w, self.n_out)
 
     def param_shapes(self):
@@ -531,13 +576,19 @@ class Deconvolution2D(_ConvBase):
 
     def apply(self, params, x, training=False, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        pad = self.padding.upper() if isinstance(self.padding, str) else [(p, p) for p in self.padding]
+        plain = (not self.output_padding
+                 and all(d == 1 for d in self.dilation))
+        if plain and isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            pad = self._pad_pairs()
         # transpose_kernel=True = the TRUE transposed conv (gradient of the
         # forward conv — reference Deconvolution2D / tf conv2d_transpose
         # semantics, numerically verified vs tf.nn.conv2d_transpose); the
         # flag wants the kernel as (kh, kw, O, I)
         z = lax.conv_transpose(x, params["W"].transpose(0, 1, 3, 2),
                                strides=self.stride, padding=pad,
+                               rhs_dilation=self.dilation,
                                dimension_numbers=("NHWC", "HWIO", "NHWC"),
                                transpose_kernel=True)
         if self.has_bias:
@@ -1243,6 +1294,90 @@ class SelfAttentionLayer(Layer):
             out = out @ params["Wo"]
             if self.qkv_bias:
                 out = out + params["bo"]
+        return self._act(out), state
+
+
+@register_layer
+@dataclasses.dataclass
+class CrossAttentionLayer(SelfAttentionLayer):
+    """Multi-head CROSS attention: queries from the first input, keys and
+    values from the second (Keras ``MultiHeadAttention(query, value[,
+    key])`` general form — r5 closes the self-attention-only refusal).
+    Inputs: ``[query (N,Tq,Cq), value (N,Tkv,Ckv)[, key (N,Tkv,Ckv)]]``;
+    output (N, Tq, n_out)."""
+    kv_in: Optional[int] = None        # value feature dim
+    key_in: Optional[int] = None       # key feature dim (defaults kv_in)
+    multi_input = True                 # _forward hands apply ALL inputs
+
+    def set_n_in_multi(self, input_types):
+        self.set_n_in(input_types[0])
+        if self.kv_in is None and len(input_types) > 1 \
+                and input_types[1] is not None:
+            self.kv_in = getattr(input_types[1], "size", None) or self.n_in
+        if self.key_in is None and len(input_types) > 2 \
+                and input_types[2] is not None:
+            self.key_in = getattr(input_types[2], "size", None)
+
+    def _dims(self):
+        kv = self.kv_in if self.kv_in is not None else self.n_in
+        return kv, (self.key_in if self.key_in is not None else kv)
+
+    def param_shapes(self):
+        hs = self.n_heads * self.head_size
+        kv, kk = self._dims()
+        shapes = {"Wq": (self.n_in, hs), "Wk": (kk, hs), "Wv": (kv, hs),
+                  "Wo": (hs, self.n_out)}
+        if self.qkv_bias:
+            shapes.update({"bq": (hs,), "bk": (hs,), "bv": (hs,),
+                           "bo": (self.n_out,)})
+        return shapes
+
+    def init_params(self, key):
+        ks = jax.random.split(key, 4)
+        hs = self.n_heads * self.head_size
+        kv, kk = self._dims()
+        p = {"Wq": _winit.init(self.weight_init, ks[0], (self.n_in, hs),
+                               self.n_in, hs),
+             "Wk": _winit.init(self.weight_init, ks[1], (kk, hs), kk, hs),
+             "Wv": _winit.init(self.weight_init, ks[2], (kv, hs), kv, hs),
+             "Wo": _winit.init(self.weight_init, ks[3], (hs, self.n_out),
+                               hs, self.n_out)}
+        if self.qkv_bias:
+            p.update({"bq": jnp.zeros((hs,)), "bk": jnp.zeros((hs,)),
+                      "bv": jnp.zeros((hs,)), "bo": jnp.zeros((self.n_out,))})
+        return p
+
+    def apply(self, params, xs, training=False, rng=None, state=None,
+              mask=None):
+        if mask is not None:
+            # the graph's single sequence mask is QUERY-axis (self-attn
+            # convention); attention needs a KEY/VALUE-sequence mask here,
+            # which a second input's mask channel does not yet carry —
+            # refuse rather than mask the wrong axis
+            raise ValueError(
+                "CrossAttentionLayer does not support sequence masks: the "
+                "network mask follows the query input, but attention "
+                "masking needs the key/value sequence's mask")
+        xq = xs[0]
+        xv = xs[1]
+        xk = xs[2] if len(xs) > 2 else xv
+        n, tq, _ = xq.shape
+
+        def proj(x, w, b):
+            z = x @ params[w]
+            if self.qkv_bias:
+                z = z + params[b]
+            return z.reshape(z.shape[0], z.shape[1], self.n_heads,
+                             self.head_size).transpose(0, 2, 1, 3)
+
+        q = proj(xq, "Wq", "bq")
+        k = proj(xk, "Wk", "bk")
+        v = proj(xv, "Wv", "bv")
+        out = exec_op("dot_product_attention", q, k, v)
+        out = out.transpose(0, 2, 1, 3).reshape(n, tq, -1)
+        out = out @ params["Wo"]
+        if self.qkv_bias:
+            out = out + params["bo"]
         return self._act(out), state
 
 

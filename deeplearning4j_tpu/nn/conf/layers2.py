@@ -751,11 +751,14 @@ class CapsuleStrengthLayer(Layer):
 @dataclasses.dataclass
 class Deconvolution3D(Layer):
     """Transposed 3-D convolution over (N,D,H,W,C) volumes (ref:
-    conf.layers.Deconvolution3D; Keras Conv3DTranspose). NDHWC, TPU-native
+    conf.layers.Deconvolution3D; Keras Conv3DTranspose incl.
+    output_padding/dilation — r5 closes that refusal). NDHWC, TPU-native
     like Convolution3D."""
     kernel_size: Tuple[int, int, int] = (3, 3, 3)
     stride: Tuple[int, int, int] = (1, 1, 1)
     padding: Any = 0
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    output_padding: Optional[Tuple[int, int, int]] = None
     n_in: Optional[int] = None
     n_out: Optional[int] = None
     has_bias: bool = True
@@ -763,6 +766,7 @@ class Deconvolution3D(Layer):
     def __post_init__(self):
         self.kernel_size = _triple(self.kernel_size)
         self.stride = _triple(self.stride)
+        self.dilation = _triple(self.dilation)
         if not isinstance(self.padding, str):
             self.padding = _triple(self.padding)
 
@@ -770,18 +774,28 @@ class Deconvolution3D(Layer):
         if self.n_in is None:
             self.n_in = input_type.channels
 
+    def _k_eff(self):
+        return tuple((k - 1) * d + 1
+                     for k, d in zip(self.kernel_size, self.dilation))
+
+    def _pad_pairs(self):
+        from deeplearning4j_tpu.nn.conf.layers import deconv_pad_pairs
+        return deconv_pad_pairs(self.kernel_size, self.stride,
+                                self.dilation, self.padding,
+                                self.output_padding)
+
     def output_type(self, input_type: InputType) -> InputType:
         same = isinstance(self.padding, str) and self.padding.lower() == "same"
         dims = (input_type.depth, input_type.height, input_type.width)
-        if same:
+        if same and not self.output_padding \
+                and all(x == 1 for x in self.dilation):
             d, h, w = (s * st for s, st in zip(dims, self.stride))
         else:
-            # "valid" string = zero padding (not just the int/tuple form)
-            pads = ((0, 0, 0) if isinstance(self.padding, str)
-                    else self.padding)
-            d, h, w = (st * (s - 1) + k - 2 * p
-                       for s, st, k, p in zip(dims, self.stride,
-                                              self.kernel_size, pads))
+            keff = self._k_eff()
+            pairs = self._pad_pairs()
+            d, h, w = (st * (s - 1) + sum(pr) - k + 2
+                       for s, st, k, pr in zip(dims, self.stride, keff,
+                                               pairs))
         return InputType.convolutional3d(d, h, w, self.n_out)
 
     def param_shapes(self):
@@ -803,12 +817,20 @@ class Deconvolution3D(Layer):
 
     def apply(self, params, x, training=False, rng=None, state=None):
         x = self._maybe_dropout(x, training, rng)
-        pad = (self.padding.upper() if isinstance(self.padding, str)
-               else [(p, p) for p in self.padding])
+        plain = (not self.output_padding
+                 and all(d == 1 for d in self.dilation))
+        if plain and isinstance(self.padding, str):
+            pad = self.padding.upper()
+        else:
+            # lax applies explicit pairs to the LHS-DILATED input — the
+            # pair math lives in _pad_pairs (fixes the former numeric-
+            # padding path, which passed forward-conv pads raw)
+            pad = self._pad_pairs()
         # true transposed conv (see Deconvolution2D): kernel as (..., O, I)
         z = lax.conv_transpose(
             x, params["W"].transpose(0, 1, 2, 4, 3), strides=self.stride,
-            padding=pad, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            padding=pad, rhs_dilation=self.dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
             transpose_kernel=True)
         if self.has_bias:
             z = z + params["b"]
@@ -983,12 +1005,13 @@ class ConvLSTM2D(Layer):
                     # Keras hard_sigmoid: clip(0.2x+0.5, 0, 1)
                     "hard_sigmoid": lambda z: jnp.clip(0.2 * z + 0.5,
                                                        0.0, 1.0)}
-        if self.recurrent_activation not in rec_acts:
-            raise ValueError(
-                f"ConvLSTM2D: recurrent_activation "
-                f"{self.recurrent_activation!r} unsupported "
-                f"(sigmoid/hard_sigmoid)")
-        rec_act = rec_acts[self.recurrent_activation]
+        if self.recurrent_activation in rec_acts:
+            rec_act = rec_acts[self.recurrent_activation]
+        else:
+            # any registry activation works as a gate squasher (Keras
+            # allows arbitrary recurrent_activation; r5 closes the refusal)
+            from deeplearning4j_tpu.nn import activations as _acts
+            rec_act = _acts.get(self.recurrent_activation)
 
         # input convs for ALL timesteps in one batched conv (MXU-friendly):
         # (N,T,H,W,C) -> (N*T,H,W,C) -> conv -> (N,T,H',W',4F)

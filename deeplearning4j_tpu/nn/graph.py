@@ -173,10 +173,14 @@ class ComputationGraph:
                 elif training and getattr(self.conf, "remat", False) \
                         and name not in out_names:
                     from deeplearning4j_tpu.nn._remat import remat_apply
-                    h, st = remat_apply(node.layer, lp, srcs[0], lst, lrng,
+                    lx = (srcs if getattr(node.layer, "multi_input", False)
+                          else srcs[0])
+                    h, st = remat_apply(node.layer, lp, lx, lst, lrng,
                                         kwargs)
                 else:
-                    h, st = node.layer.apply(lp, srcs[0],
+                    lx = (srcs if getattr(node.layer, "multi_input", False)
+                          else srcs[0])
+                    h, st = node.layer.apply(lp, lx,
                                              training=training, rng=lrng,
                                              state=lst, **kwargs)
                 if lst is not None and st is not None:

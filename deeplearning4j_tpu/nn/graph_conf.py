@@ -576,7 +576,10 @@ class ComputationGraphConfiguration:
             if node.layer is not None:
                 node.layer.apply_global_defaults(defaults)
                 if in_types and in_types[0] is not None:
-                    node.layer.set_n_in(in_types[0])
+                    if hasattr(node.layer, "set_n_in_multi"):
+                        node.layer.set_n_in_multi(in_types)
+                    else:
+                        node.layer.set_n_in(in_types[0])
                     types[name] = node.layer.output_type(in_types[0])
             else:
                 if all(t is not None for t in in_types) and in_types:

@@ -100,6 +100,12 @@ SPECS = {
     "MaskedLSTM": (lambda: L.LSTM(n_in=3, n_out=4), _x((2, 5, 3)),
                    {"mask": np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
                                      F32)}),
+    "CrossAttentionLayer": (lambda: L.CrossAttentionLayer(
+        n_in=4, kv_in=3, n_out=4, n_heads=2, head_size=2),
+        [_x((2, 5, 4)), _x((2, 7, 3))], {"multi_input": True}),
+    "CrossAttentionBias": (lambda: L.CrossAttentionLayer(
+        n_in=4, kv_in=3, n_out=4, n_heads=2, head_size=2, qkv_bias=True),
+        [_x((2, 5, 4)), _x((2, 7, 3))], {"multi_input": True}),
     "LearnedSelfAttentionLayer": (lambda: L.LearnedSelfAttentionLayer(
         n_in=4, n_out=4, n_heads=2, head_size=2, n_queries=3),
         _x((2, 5, 4)), {}),
@@ -208,6 +214,9 @@ def _check(layer, x, opts):
     if int_input:
         fn = lambda p: run(p, jnp.asarray(x))
         tree = params
+    elif opts.get("multi_input"):
+        fn = lambda t: run(t["params"], list(t["x"]))
+        tree = {"params": params, "x": [jnp.asarray(a) for a in x]}
     else:
         fn = lambda t: run(t["params"], t["x"])
         tree = {"params": params, "x": jnp.asarray(x)}
