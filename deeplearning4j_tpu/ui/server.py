@@ -88,9 +88,22 @@ def _svg_multi_line(xs, series, width=720, height=240, pad=36,
         f'{polys}{legends}</svg>')
 
 
-def _svg_line_chart(xs, ys, width=720, height=240, pad=36) -> str:
+def _svg_line_chart(xs, ys, width=720, height=240, pad=36,
+                    svg_id=None) -> str:
+    """Score chart; with ``svg_id`` the polyline/label get ids so the
+    overview page's EventSource JS can redraw them live."""
+    ids = (f' id="{svg_id}-poly"', f' id="{svg_id}-label"') if svg_id \
+        else ("", "")
     if not xs:
-        return "<svg/>"
+        # still emit the addressable skeleton so a live stream can fill it
+        return (
+            f'<svg width="{width}" height="{height}" '
+            f'xmlns="http://www.w3.org/2000/svg">'
+            f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
+            f'<polyline{ids[0]} fill="none" stroke="#1f77b4" '
+            f'stroke-width="1.5" points=""/>'
+            f'<text{ids[1]} x="{pad}" y="16" font-size="12">score '
+            f'(no data yet)</text></svg>')
     xmin, xmax = min(xs), max(xs)
     ymin, ymax = min(ys), max(ys)
     if ymax == ymin:
@@ -104,9 +117,9 @@ def _svg_line_chart(xs, ys, width=720, height=240, pad=36) -> str:
         f'<svg width="{width}" height="{height}" '
         f'xmlns="http://www.w3.org/2000/svg">'
         f'<rect width="{width}" height="{height}" fill="#fafafa"/>'
-        f'<polyline fill="none" stroke="#1f77b4" stroke-width="1.5" '
+        f'<polyline{ids[0]} fill="none" stroke="#1f77b4" stroke-width="1.5" '
         f'points="{" ".join(pts)}"/>'
-        f'<text x="{pad}" y="16" font-size="12">score '
+        f'<text{ids[1]} x="{pad}" y="16" font-size="12">score '
         f'(min {ymin:.4g}, max {ymax:.4g})</text></svg>')
 
 
@@ -143,12 +156,36 @@ class UIServer:
             out.extend(st.list_session_ids())
         return out
 
-    def _updates(self, sid):
+    def _updates(self, sid, since: Optional[int] = None):
         for st in self._storages:
             ups = st.get_all_updates(sid)
             if ups:
+                if since is not None:
+                    ups = [u for u in ups
+                           if u.get("iteration", -1) > since]
                 return ups
         return []
+
+    def _subscribe(self):
+        """Queue fed by every attached storage's listener hook — the SSE
+        fan-out (ref: the Vert.x app pushing StatsListener records to the
+        browser over the event bus). Returns (queue, unsubscribe)."""
+        import queue
+
+        q: "queue.Queue" = queue.Queue()
+        subscribed = []
+        for st in self._storages:
+            cb = q.put
+            st.register_stats_storage_listener(cb)
+            subscribed.append((st, cb))
+
+        def unsubscribe():
+            for st, cb in subscribed:
+                try:
+                    st._listeners.remove(cb)
+                except ValueError:
+                    pass
+        return q, unsubscribe
 
     def render_overview(self, sid: Optional[str] = None) -> str:
         sessions = self._sessions()
@@ -238,15 +275,57 @@ class UIServer:
         session_links = " ".join(
             f'<a href="/?sid={quote(s)}">{_html.escape(s)}</a>'
             for s in sessions)
+        compare_link = ""
+        if len(sessions) > 1:
+            compare_link = (' | <a href="/train/compare?sids='
+                            + quote(",".join(sessions))
+                            + '">compare sessions</a>')
         safe_sid = _html.escape(sid) if sid else "no session"
+        # live score streaming: EventSource over /train/stream appends
+        # points and redraws the polyline client-side — charts update
+        # WITHOUT page reloads (the slow meta-refresh only renews tables)
+        live_js = ""
+        if sid:
+            live_js = ("""
+<script>
+(function(){
+  var xs=%s, ys=%s;
+  var W=720,H=240,P=36;
+  function redraw(){
+    var poly=document.getElementById('score-poly');
+    var label=document.getElementById('score-label');
+    if(!poly||xs.length===0)return;
+    var x0=Math.min.apply(null,xs),x1=Math.max.apply(null,xs);
+    var y0=Math.min.apply(null,ys),y1=Math.max.apply(null,ys);
+    if(y1===y0)y1=y0+1;
+    var pts=xs.map(function(x,i){
+      var px=P+(x-x0)/Math.max(x1-x0,1e-12)*(W-2*P);
+      var py=H-P-(ys[i]-y0)/(y1-y0)*(H-2*P);
+      return px.toFixed(1)+','+py.toFixed(1);}).join(' ');
+    poly.setAttribute('points',pts);
+    if(label)label.textContent='score (min '+y0.toPrecision(5)+
+      ', max '+y1.toPrecision(5)+') — live, '+xs.length+' updates';
+  }
+  var es=new EventSource('/train/stream?sid=%s');
+  es.onmessage=function(ev){
+    var r=JSON.parse(ev.data);
+    if(typeof r.iteration==='number'&&typeof r.score==='number'
+       &&(xs.length===0||r.iteration>xs[xs.length-1])){
+      xs.push(r.iteration);ys.push(r.score);redraw();}
+  };
+  redraw();
+})();
+</script>""" % (json.dumps(xs), json.dumps(ys), quote(sid)))
         return (
             "<html><head><title>DL4J-TPU Training UI</title>"
-            '<meta http-equiv="refresh" content="10"></head><body>'
-            f"<h2>Training UI</h2><p>Sessions: {session_links} | "
+            '<meta http-equiv="refresh" content="60"></head><body>'
+            f"<h2>Training UI</h2><p>Sessions: {session_links}"
+            f"{compare_link} | "
             f'<a href="/train/system">system</a> '
-            f"(auto-refresh 10s)</p>"
+            f"(live score stream; tables refresh 60s)</p>"
             f"<h3>{safe_sid} — {len(ups)} updates</h3>"
-            + _svg_line_chart(xs, ys)
+            + _svg_line_chart(xs, ys, svg_id="score")
+            + live_js
             + ratio_chart
             + "<h3>Layer parameters (latest)</h3>"
               "<table border=1 cellpadding=4><tr><th>param</th>"
@@ -259,6 +338,39 @@ class UIServer:
                f"</tr>{act_rows}</table>" if act_rows else "")
             + model_svg
             + "</body></html>")
+
+    def render_compare(self, sids: List[str]) -> str:
+        """Side-by-side view of ≥2 sessions from one storage: overlaid
+        score curves + per-session summary (ref: the Vert.x UI's
+        multi-session dropdown/compare behavior)."""
+        series = {}
+        all_xs: set = set()
+        summaries = ""
+        for sid in sids:
+            ups = self._updates(sid)
+            xs = [u["iteration"] for u in ups]
+            series[sid] = (xs, [u.get("score") for u in ups])
+            all_xs.update(xs)
+            last = ups[-1] if ups else {}
+            summaries += (
+                f"<tr><td>{_html.escape(sid)}</td><td>{len(ups)}</td>"
+                f"<td>{last.get('score', float('nan')):.5g}</td>"
+                f"<td>{min((u.get('score') for u in ups), default=float('nan')):.5g}"
+                f"</td></tr>")
+        grid = sorted(all_xs)
+        aligned = {}
+        for sid, (xs, ys) in series.items():
+            by_x = dict(zip(xs, ys))
+            aligned[sid] = [by_x.get(x) for x in grid]
+        chart = _svg_multi_line(grid, aligned, title="score vs iteration") \
+            if grid else "<p>(no data)</p>"
+        return ("<html><head><title>Compare sessions</title></head><body>"
+                "<h2>Session comparison</h2>"
+                '<p><a href="/">overview</a></p>'
+                + chart
+                + "<table border=1 cellpadding=4><tr><th>session</th>"
+                  "<th>updates</th><th>last score</th><th>best score</th>"
+                  f"</tr>{summaries}</table></body></html>")
 
     def render_system(self) -> str:
         """The System tab (ref: the Vert.x app's hardware/memory page):
@@ -319,18 +431,68 @@ class UIServer:
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
+            def _stream(self, sid):
+                """SSE: replay the session so far, then push records live
+                as storages receive them (no page reloads — ref: the
+                Vert.x UI's live StatsListener telemetry stream)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+
+                def emit(rec):
+                    data = json.dumps(rec).encode()
+                    self.wfile.write(b"data: " + data + b"\n\n")
+                    self.wfile.flush()
+
+                q, unsubscribe = ui._subscribe()
+                try:
+                    last = -1
+                    for rec in ui._updates(sid):
+                        emit(rec)
+                        last = max(last, rec.get("iteration", -1))
+                    import queue as _queue
+                    while True:
+                        try:
+                            rec = q.get(timeout=15.0)
+                        except _queue.Empty:
+                            self.wfile.write(b": keepalive\n\n")
+                            self.wfile.flush()
+                            continue
+                        if sid and rec.get("sessionId") != sid:
+                            continue
+                        if rec.get("iteration", -1) <= last \
+                                and "iteration" in rec:
+                            continue
+                        emit(rec)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass                      # client went away
+                finally:
+                    unsubscribe()
+
             def do_GET(self):
                 parsed = urlparse(self.path)
                 q = parse_qs(parsed.query)
+                if parsed.path == "/train/stream":
+                    self._stream(q.get("sid", [None])[0])
+                    return
                 if parsed.path == "/train/sessions":
                     body = json.dumps(ui._sessions()).encode()
                     ctype = "application/json"
                 elif parsed.path == "/train/system":
                     body = ui.render_system().encode()
                     ctype = "text/html"
+                elif parsed.path == "/train/compare":
+                    sids = [s for s in
+                            q.get("sids", [""])[0].split(",") if s]
+                    body = ui.render_compare(sids).encode()
+                    ctype = "text/html"
                 elif parsed.path == "/train/updates":
                     sid = q.get("sid", [None])[0]
-                    body = json.dumps(ui._updates(sid)).encode()
+                    since = q.get("since", [None])[0]
+                    body = json.dumps(ui._updates(
+                        sid, int(since) if since is not None else None)
+                    ).encode()
                     ctype = "application/json"
                 else:
                     sid = q.get("sid", [None])[0]
