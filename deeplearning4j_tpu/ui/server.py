@@ -134,6 +134,8 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._receiver = None     # lazily created for remote-router POSTs
+        self._stream_subs: List = []       # live-SSE queues
+        self._subs_lock = threading.Lock()
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -143,11 +145,22 @@ class UIServer:
 
     getInstance = get_instance
 
+    def _fanout(self, record):
+        with self._subs_lock:
+            for q in self._stream_subs:
+                q.put(record)
+
     def attach(self, storage):
         self._storages.append(storage)
+        # every attached storage — including ones attached AFTER clients
+        # connected (the lazily-created remote receiver) — feeds the same
+        # server-level fan-out, so open SSE streams see its records
+        storage.register_stats_storage_listener(self._fanout)
 
     def detach(self, storage):
         self._storages.remove(storage)
+        if hasattr(storage, "deregister_stats_storage_listener"):
+            storage.deregister_stats_storage_listener(self._fanout)
 
     # --------------------------------------------------------------- render
     def _sessions(self):
@@ -167,22 +180,20 @@ class UIServer:
         return []
 
     def _subscribe(self):
-        """Queue fed by every attached storage's listener hook — the SSE
-        fan-out (ref: the Vert.x app pushing StatsListener records to the
-        browser over the event bus). Returns (queue, unsubscribe)."""
+        """Queue fed by the server-level fan-out (every attached storage,
+        present AND future — the SSE mechanism; ref: the Vert.x app
+        pushing StatsListener records to the browser over the event bus).
+        Returns (queue, unsubscribe)."""
         import queue
 
         q: "queue.Queue" = queue.Queue()
-        subscribed = []
-        for st in self._storages:
-            cb = q.put
-            st.register_stats_storage_listener(cb)
-            subscribed.append((st, cb))
+        with self._subs_lock:
+            self._stream_subs.append(q)
 
         def unsubscribe():
-            for st, cb in subscribed:
+            with self._subs_lock:
                 try:
-                    st._listeners.remove(cb)
+                    self._stream_subs.remove(q)
                 except ValueError:
                     pass
         return q, unsubscribe
@@ -347,16 +358,19 @@ class UIServer:
         all_xs: set = set()
         summaries = ""
         for sid in sids:
-            ups = self._updates(sid)
+            # a record without a numeric score (arbitrary remote POSTs
+            # are accepted) must not break the whole compare page
+            ups = [u for u in self._updates(sid)
+                   if isinstance(u.get("score"), (int, float))
+                   and "iteration" in u]
             xs = [u["iteration"] for u in ups]
-            series[sid] = (xs, [u.get("score") for u in ups])
+            series[sid] = (xs, [u["score"] for u in ups])
             all_xs.update(xs)
-            last = ups[-1] if ups else {}
+            last_s = ups[-1]["score"] if ups else float("nan")
+            best_s = min((u["score"] for u in ups), default=float("nan"))
             summaries += (
                 f"<tr><td>{_html.escape(sid)}</td><td>{len(ups)}</td>"
-                f"<td>{last.get('score', float('nan')):.5g}</td>"
-                f"<td>{min((u.get('score') for u in ups), default=float('nan')):.5g}"
-                f"</td></tr>")
+                f"<td>{last_s:.5g}</td><td>{best_s:.5g}</td></tr>")
         grid = sorted(all_xs)
         aligned = {}
         for sid, (xs, ys) in series.items():
@@ -441,7 +455,13 @@ class UIServer:
                 self.end_headers()
 
                 def emit(rec):
-                    data = json.dumps(rec).encode()
+                    # compact events: the live chart needs only
+                    # iteration/score — full histogram-laden records
+                    # would make every replay O(session bytes)
+                    slim = {k: rec[k] for k in
+                            ("sessionId", "iteration", "score", "epoch")
+                            if k in rec}
+                    data = json.dumps(slim).encode()
                     self.wfile.write(b"data: " + data + b"\n\n")
                     self.wfile.flush()
 
@@ -489,10 +509,12 @@ class UIServer:
                     ctype = "text/html"
                 elif parsed.path == "/train/updates":
                     sid = q.get("sid", [None])[0]
-                    since = q.get("since", [None])[0]
-                    body = json.dumps(ui._updates(
-                        sid, int(since) if since is not None else None)
-                    ).encode()
+                    since_raw = q.get("since", [None])[0]
+                    try:
+                        since = int(since_raw) if since_raw else None
+                    except ValueError:
+                        since = None       # malformed param = full list
+                    body = json.dumps(ui._updates(sid, since)).encode()
                     ctype = "application/json"
                 else:
                     sid = q.get("sid", [None])[0]

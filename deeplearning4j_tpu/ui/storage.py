@@ -34,6 +34,14 @@ class BaseStatsStorage:
 
     registerStatsStorageListener = register_stats_storage_listener
 
+    def deregister_stats_storage_listener(self, cb: Callable):
+        try:
+            self._listeners.remove(cb)
+        except ValueError:
+            pass
+
+    deregisterStatsStorageListener = deregister_stats_storage_listener
+
     # ---- read path
     def list_session_ids(self) -> List[str]:
         return sorted({r["sessionId"] for r in self._all()})
