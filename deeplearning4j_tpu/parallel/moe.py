@@ -30,6 +30,13 @@ class MoEConfig:
     num_experts: int = 8
     capacity_factor: float = 1.25
     router_noise: float = 0.0       # jitter for load-balancing exploration
+    top_k: int = 1                  # 1 = Switch; 2 = GShard top-2 routing
+                                    # (renormalized gates, second choices
+                                    # queue behind ALL first choices)
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2 (got {self.top_k})")
 
 
 def _check_resolved(cfg: MoEConfig):
@@ -84,10 +91,10 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh: Optional[Mesh] = None,
             rng, logits.shape, minval=1.0 - cfg.router_noise,
             maxval=1.0 + cfg.router_noise)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)          # (G,)
+    expert_idx = jnp.argmax(probs, axis=-1)          # (G,) first choice
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
 
-    C = int(np.ceil(G / E * cfg.capacity_factor))
+    C = int(np.ceil(G / E * cfg.capacity_factor * cfg.top_k))
     onehot = jax.nn.one_hot(expert_idx, E, dtype=x.dtype)       # (G, E)
     # position of each token within its expert's queue
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # (G, E)
@@ -95,6 +102,30 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh: Optional[Mesh] = None,
     pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=x.dtype)  # (G,E,C)
     dispatch = pos_oh * keep.astype(x.dtype)[..., None]          # (G, E, C)
     combine = dispatch * gate[:, None, None]
+    n_routed = jnp.asarray(float(G), x.dtype)
+
+    if cfg.top_k == 2:
+        # GShard top-2: second choice = argmax with the first masked out;
+        # gates renormalized over the two winners; second choices queue
+        # BEHIND every first choice in each expert's capacity
+        probs2 = probs * (1.0 - onehot)
+        idx2 = jnp.argmax(probs2, axis=-1)
+        gate2_raw = jnp.take_along_axis(probs2, idx2[:, None],
+                                        axis=-1)[:, 0]
+        denom = gate + gate2_raw + 1e-9
+        g1 = gate / denom
+        g2 = gate2_raw / denom
+        onehot2 = jax.nn.one_hot(idx2, E, dtype=x.dtype)
+        first_counts = jnp.sum(onehot, axis=0, keepdims=True)    # (1, E)
+        pos2 = (jnp.cumsum(onehot2, axis=0) + first_counts) \
+            * onehot2 - 1.0
+        keep2 = (pos2 >= 0) & (pos2 < C)
+        pos2_oh = jax.nn.one_hot(pos2.astype(jnp.int32), C, dtype=x.dtype)
+        dispatch2 = pos2_oh * keep2.astype(x.dtype)[..., None]
+        combine = (dispatch * g1[:, None, None]
+                   + dispatch2 * g2[:, None, None])
+        dispatch = dispatch + dispatch2
+        n_routed = jnp.asarray(float(2 * G), x.dtype)
 
     # token → expert buffers; sharding hint puts E on the expert axis so
     # GSPMD routes via all-to-all over ICI
@@ -111,28 +142,40 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh: Optional[Mesh] = None,
             out_e, NamedSharding(mesh, P(EXPERT_AXIS)))
     y = jnp.einsum("gec,ecd->gd", combine, out_e)                # (G, d)
 
-    # Switch aux loss: E * Σ_e fraction_tokens_e · mean_prob_e
+    # Switch/GShard aux loss: E * Σ_e fraction_first_choice_e · mean_prob_e
     frac = jnp.mean(onehot, axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(frac * mean_prob)
-    dropped = jnp.maximum(0.0, 1.0 - jnp.sum(dispatch) / G)
+    dropped = jnp.maximum(0.0, 1.0 - jnp.sum(dispatch) / n_routed)
     return y.reshape(B, T, d), {"aux_loss": aux_loss,
                                 "dropped_fraction": dropped,
                                 "expert_fraction": frac}
 
 
 def moe_reference_dense(params, x, cfg: MoEConfig):
-    """Unrouted check path: every token through its argmax expert with no
+    """Unrouted check path: every token through its top-k expert(s) with no
     capacity limit (the semantics dispatch must match when nothing drops)."""
     B, T, d = x.shape
     xt = x.reshape(-1, d)
     probs = jax.nn.softmax(xt @ params["Wg"], axis=-1)
+
+    def expert_out(idx):
+        W1 = params["W1"][idx]        # (G, d, f)
+        h = jax.nn.gelu(jnp.einsum("gd,gdf->gf", xt, W1)
+                        + params["b1"][idx])
+        return jnp.einsum("gf,gfd->gd", h, params["W2"][idx]) \
+            + params["b2"][idx]
+
     idx = jnp.argmax(probs, axis=-1)
     gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
-    W1 = params["W1"][idx]            # (G, d, f)
-    b1 = params["b1"][idx]
-    W2 = params["W2"][idx]
-    b2 = params["b2"][idx]
-    h = jax.nn.gelu(jnp.einsum("gd,gdf->gf", xt, W1) + b1)
-    y = (jnp.einsum("gf,gfd->gd", h, W2) + b2) * gate[:, None]
+    if cfg.top_k == 1:
+        y = expert_out(idx) * gate[:, None]
+    else:
+        probs2 = probs * (1.0 - jax.nn.one_hot(idx, cfg.num_experts,
+                                               dtype=x.dtype))
+        idx2 = jnp.argmax(probs2, axis=-1)
+        gate2 = jnp.take_along_axis(probs2, idx2[:, None], axis=-1)[:, 0]
+        denom = gate + gate2 + 1e-9
+        y = expert_out(idx) * (gate / denom)[:, None] \
+            + expert_out(idx2) * (gate2 / denom)[:, None]
     return y.reshape(B, T, d)

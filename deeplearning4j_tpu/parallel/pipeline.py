@@ -147,3 +147,103 @@ def gpipe(stage_fn: Callable, mesh: Mesh, num_stages: Optional[int] = None,
         return out[:M] if pad else out
 
     return run
+
+
+def one_f_one_b(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                num_stages: Optional[int] = None):
+    """1F1B pipeline schedule (SURVEY P5; VERDICT r4 #9): a TRAINING step
+    ``run(stacked_params, x_micro, tgt_micro) -> (loss, grads)`` where the
+    backward of micro-batch m starts the moment its forward leaves the
+    last stage — per-stage live activations are bounded by the schedule
+    depth 2(S−1), NOT by the micro-batch count M as in the
+    differentiate-the-whole-GPipe-schedule formulation.
+
+    Mechanics (one jitted shard_map program, no autodiff through the
+    schedule): each tick every stage runs at most one forward
+    (micro-batch t−s) and one backward (micro-batch t−2(S−1)+s) using an
+    explicit ``jax.vjp`` of ``stage_fn`` re-taped from the stored INPUT
+    activation (rematerialization — only inputs are kept, in a ring
+    buffer of 2S−1 slots). Activations hop up the ``stage`` ring via
+    ``lax.ppermute``; cotangents hop down; the last stage seeds them from
+    ``loss_fn``'s gradient in the same tick its forward completes (the
+    1F1B signature). Parameter cotangents accumulate per stage across
+    micro-batches — the grads come back stage-stacked, matching
+    ``stack_stage_params`` layout. ``loss_fn(h, tgt) -> scalar`` is
+    summed over micro-batches.
+
+    Inputs/targets are replicated across stages (the O(M) input queue is
+    one tensor; the memory the schedule bounds is the O(L) per-layer
+    activation set, which dominates in deep stacks)."""
+    S = num_stages or axis_size(mesh, STAGE_AXIS)
+
+    def local(params_slice, x_all, tgt_all):
+        p = jax.tree.map(lambda a: a[0], params_slice)
+        stage_id = lax.axis_index(STAGE_AXIS)
+        M = x_all.shape[0]
+        mb_shape = x_all.shape[1:]
+        R = 2 * S - 1                     # ring: lifetime ≤ 2(S−1) ticks
+        T = M + 2 * (S - 1)
+
+        down = [(i, (i - 1) % S) for i in range(S)]
+        up = [(i, (i + 1) % S) for i in range(S)]
+
+        def fwd_only(pp, h):
+            return stage_fn(pp, h)
+
+        def tick(t, carry):
+            h_chan, g_chan, buf, dp, loss = carry
+            # ---------------- forward slot: micro-batch t − s
+            mf = t - stage_id
+            f_active = (mf >= 0) & (mf < M)
+            feed = lax.dynamic_index_in_dim(x_all, jnp.clip(mf, 0, M - 1),
+                                            0, keepdims=False)
+            h_in = jnp.where(stage_id == 0, feed, h_chan)
+            h_out = jnp.where(f_active, stage_fn(p, h_in), h_in)
+            buf = jnp.where(
+                f_active,
+                lax.dynamic_update_index_in_dim(
+                    buf, h_in, jnp.mod(jnp.clip(mf, 0), R), 0),
+                buf)
+            # ---------------- backward slot: micro-batch t − 2(S−1) + s
+            mb_ = t - 2 * (S - 1) + stage_id
+            b_active = (mb_ >= 0) & (mb_ < M)
+            h_saved = lax.dynamic_index_in_dim(
+                buf, jnp.mod(jnp.clip(mb_, 0), R), 0, keepdims=False)
+            # last stage: cotangent = dL/dh of the forward JUST computed
+            tgt = lax.dynamic_index_in_dim(
+                tgt_all, jnp.clip(mb_, 0, M - 1), 0, keepdims=False)
+
+            out_b, vjp = jax.vjp(lambda pp, hh: stage_fn(pp, hh),
+                                 p, h_saved)
+            l_m, dloss = jax.value_and_grad(loss_fn)(out_b, tgt)
+            is_last = stage_id == S - 1
+            g_seed = jnp.where(is_last, dloss, g_chan)
+            dp_m, dh_m = vjp(g_seed.astype(out_b.dtype))
+            live = b_active
+            dp = jax.tree.map(
+                lambda acc, g: acc + jnp.where(live, g, 0.0), dp, dp_m)
+            loss = loss + jnp.where(live & is_last, l_m, 0.0)
+            # cotangent hops DOWN to the previous stage; activation UP
+            g_chan = lax.ppermute(jnp.where(live, dh_m,
+                                            jnp.zeros_like(dh_m)),
+                                  STAGE_AXIS, down)
+            h_chan = lax.ppermute(h_out, STAGE_AXIS, up)
+            return h_chan, g_chan, buf, dp, loss
+
+        z = jnp.zeros(mb_shape, x_all.dtype)
+        dp0 = jax.tree.map(jnp.zeros_like, p)
+        buf0 = jnp.zeros((R,) + mb_shape, x_all.dtype)
+        _, _, _, dp, loss = lax.fori_loop(
+            0, T, tick, (z, z, buf0, dp0, jnp.zeros((), jnp.float32)))
+        # loss lives on the last stage; grads are per-stage slices
+        loss = lax.psum(loss, STAGE_AXIS)    # only last stage is nonzero
+        return loss, jax.tree.map(lambda a: a[None], dp)
+
+    def run(stacked_params, x_micro, tgt_micro):
+        pspecs = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
+        f = shard_map(local, mesh=mesh,
+                      in_specs=(pspecs, P(), P()),
+                      out_specs=(P(), pspecs), check_vma=False)
+        return f(stacked_params, x_micro, tgt_micro)
+
+    return run
