@@ -42,6 +42,13 @@ while true; do
       && mv BENCH_r05_live.json.tmp BENCH_r05_live.json \
       && echo "[watcher-r5] flagship done: $(cat BENCH_r05_live.json)" >> "$LOG"
 
+    if [ ! -f BENCH_r05_mfu.json ]; then
+      timeout 5400 python benchmarks/mfu_ladder.py > BENCH_r05_mfu.json.tmp 2>> "$LOG" \
+        && grep -q '"metric"' BENCH_r05_mfu.json.tmp \
+        && mv BENCH_r05_mfu.json.tmp BENCH_r05_mfu.json \
+        && echo "[watcher-r5] mfu ladder done" >> "$LOG"
+    fi
+
     if [ ! -f benchmarks/ring_memory_live.txt ] || ! grep -q "seq" benchmarks/ring_memory_live.txt; then
       timeout 900 python benchmarks/ring_attention_bench.py --tpu --memory \
         --seqs 8192 16384 32768 49152 --devices 8 --heads 8 --dim 128 \
