@@ -112,6 +112,10 @@ class TransformerConfig:
     pipeline_stages: int = 0          # >1: GPipe the block stack over the
                                       # ``stage`` mesh axis (parallel/pipeline)
     microbatches: int = 0             # GPipe micro-batch count (0 = 2·stages)
+    pipeline_schedule: str = "gpipe"  # "gpipe": autodiff through the
+                                      # schedule; "1f1b": custom-vjp 1F1B
+                                      # backward — live activations bounded
+                                      # by depth, not micro-batch count
     moe: Optional["MoEConfig"] = None  # replace the dense FFN with a
                                       # Switch-MoE FFN (parallel/moe); expert
                                       # axis shards over ``expert`` when the
@@ -135,6 +139,10 @@ class TransformerConfig:
                 self.moe,
                 d_model=self.moe.d_model or self.d_model,
                 d_ff=self.moe.d_ff or self.d_ff)
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipeline_schedule must be 'gpipe' or '1f1b' "
+                f"(got {self.pipeline_schedule!r})")
         if self.pipeline_stages > 1:
             assert self.n_layers % self.pipeline_stages == 0, \
                 "n_layers must divide into pipeline_stages"
@@ -385,7 +393,13 @@ class TransformerLM:
                 "and data parallelism contributes no throughput",
                 B // M, self.mesh.shape[DATA_AXIS])
         batch_ax = DATA_AXIS if dp_ok else None
-        run = gpipe(stage_fn, self.mesh, S, batch_axis=batch_ax)
+        if c.pipeline_schedule == "1f1b":
+            from deeplearning4j_tpu.parallel.pipeline import (
+                pipeline_trunk_1f1b)
+            run = pipeline_trunk_1f1b(stage_fn, self.mesh, S,
+                                      batch_axis=batch_ax)
+        else:
+            run = gpipe(stage_fn, self.mesh, S, batch_axis=batch_ax)
         y = run(params["blocks"], x.reshape(M, B // M, t, d))
         return y.reshape(B, t, d)
 

@@ -149,101 +149,132 @@ def gpipe(stage_fn: Callable, mesh: Mesh, num_stages: Optional[int] = None,
     return run
 
 
-def one_f_one_b(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
-                num_stages: Optional[int] = None):
-    """1F1B pipeline schedule (SURVEY P5; VERDICT r4 #9): a TRAINING step
-    ``run(stacked_params, x_micro, tgt_micro) -> (loss, grads)`` where the
-    backward of micro-batch m starts the moment its forward leaves the
-    last stage — per-stage live activations are bounded by the schedule
-    depth 2(S−1), NOT by the micro-batch count M as in the
-    differentiate-the-whole-GPipe-schedule formulation.
+def pipeline_trunk_1f1b(stage_fn: Callable, mesh: Mesh,
+                        num_stages: Optional[int] = None,
+                        batch_axis: Optional[str] = None):
+    """A differentiable pipelined trunk with a **1F1B backward**: forward
+    is the GPipe schedule (`gpipe`), but reverse-mode runs the 1F1B
+    wavefront (explicit per-tick vjp, cotangents ppermuted down, ring-
+    buffer remat) instead of autodiff-through-the-schedule — so the
+    backward's live activations are bounded by the schedule depth, not
+    the micro-batch count, while the result composes with surrounding
+    autodiff (embedding below, head/loss above) like any jax function.
 
-    Mechanics (one jitted shard_map program, no autodiff through the
-    schedule): each tick every stage runs at most one forward
-    (micro-batch t−s) and one backward (micro-batch t−2(S−1)+s) using an
-    explicit ``jax.vjp`` of ``stage_fn`` re-taped from the stored INPUT
-    activation (rematerialization — only inputs are kept, in a ring
-    buffer of 2S−1 slots). Activations hop up the ``stage`` ring via
-    ``lax.ppermute``; cotangents hop down; the last stage seeds them from
-    ``loss_fn``'s gradient in the same tick its forward completes (the
-    1F1B signature). Parameter cotangents accumulate per stage across
-    micro-batches — the grads come back stage-stacked, matching
-    ``stack_stage_params`` layout. ``loss_fn(h, tgt) -> scalar`` is
-    summed over micro-batches.
-
-    Inputs/targets are replicated across stages (the O(M) input queue is
-    one tensor; the memory the schedule bounds is the O(L) per-layer
-    activation set, which dominates in deep stacks)."""
+    ``stage_fn(stage_params, h[, mb_idx])`` as in ``gpipe``. Returns
+    ``fn(stacked_params, x_micro) -> y_micro`` usable under jax.grad."""
     S = num_stages or axis_size(mesh, STAGE_AXIS)
+    import inspect
+    takes_mb = len(inspect.signature(stage_fn).parameters) >= 3
+    fwd_run = gpipe(stage_fn, mesh, S, batch_axis=batch_axis)
 
-    def local(params_slice, x_all, tgt_all):
+    def bwd_local(params_slice, x_all, dy_all):
         p = jax.tree.map(lambda a: a[0], params_slice)
         stage_id = lax.axis_index(STAGE_AXIS)
         M = x_all.shape[0]
         mb_shape = x_all.shape[1:]
-        R = 2 * S - 1                     # ring: lifetime ≤ 2(S−1) ticks
+        R = 2 * S - 1
         T = M + 2 * (S - 1)
-
         down = [(i, (i - 1) % S) for i in range(S)]
         up = [(i, (i + 1) % S) for i in range(S)]
 
-        def fwd_only(pp, h):
-            return stage_fn(pp, h)
+        def call(pp, hh, m):
+            return stage_fn(pp, hh, jnp.clip(m, 0)) if takes_mb \
+                else stage_fn(pp, hh)
 
         def tick(t, carry):
-            h_chan, g_chan, buf, dp, loss = carry
-            # ---------------- forward slot: micro-batch t − s
+            h_chan, g_chan, buf, dp, dx = carry
             mf = t - stage_id
             f_active = (mf >= 0) & (mf < M)
             feed = lax.dynamic_index_in_dim(x_all, jnp.clip(mf, 0, M - 1),
                                             0, keepdims=False)
             h_in = jnp.where(stage_id == 0, feed, h_chan)
-            h_out = jnp.where(f_active, stage_fn(p, h_in), h_in)
+            h_out = jnp.where(f_active, call(p, h_in, mf), h_in)
             buf = jnp.where(
                 f_active,
                 lax.dynamic_update_index_in_dim(
                     buf, h_in, jnp.mod(jnp.clip(mf, 0), R), 0),
                 buf)
-            # ---------------- backward slot: micro-batch t − 2(S−1) + s
             mb_ = t - 2 * (S - 1) + stage_id
             b_active = (mb_ >= 0) & (mb_ < M)
             h_saved = lax.dynamic_index_in_dim(
                 buf, jnp.mod(jnp.clip(mb_, 0), R), 0, keepdims=False)
-            # last stage: cotangent = dL/dh of the forward JUST computed
-            tgt = lax.dynamic_index_in_dim(
-                tgt_all, jnp.clip(mb_, 0, M - 1), 0, keepdims=False)
-
-            out_b, vjp = jax.vjp(lambda pp, hh: stage_fn(pp, hh),
-                                 p, h_saved)
-            l_m, dloss = jax.value_and_grad(loss_fn)(out_b, tgt)
-            is_last = stage_id == S - 1
-            g_seed = jnp.where(is_last, dloss, g_chan)
-            dp_m, dh_m = vjp(g_seed.astype(out_b.dtype))
-            live = b_active
+            _, vjp = jax.vjp(lambda pp, hh: call(pp, hh, mb_), p, h_saved)
+            dy_m = lax.dynamic_index_in_dim(
+                dy_all, jnp.clip(mb_, 0, M - 1), 0, keepdims=False)
+            g_seed = jnp.where(stage_id == S - 1, dy_m, g_chan)
+            dp_m, dh_m = vjp(g_seed.astype(h_saved.dtype))
             dp = jax.tree.map(
-                lambda acc, g: acc + jnp.where(live, g, 0.0), dp, dp_m)
-            loss = loss + jnp.where(live & is_last, l_m, 0.0)
-            # cotangent hops DOWN to the previous stage; activation UP
-            g_chan = lax.ppermute(jnp.where(live, dh_m,
-                                            jnp.zeros_like(dh_m)),
-                                  STAGE_AXIS, down)
+                lambda acc, g: acc + jnp.where(b_active, g, 0.0), dp, dp_m)
+            # stage 0's input cotangent IS dL/dx for this micro-batch
+            dx = jnp.where(
+                b_active & (stage_id == 0),
+                lax.dynamic_update_index_in_dim(
+                    dx, dh_m, jnp.clip(mb_, 0, M - 1), 0),
+                dx)
+            g_chan = lax.ppermute(
+                jnp.where(b_active, dh_m, jnp.zeros_like(dh_m)),
+                STAGE_AXIS, down)
             h_chan = lax.ppermute(h_out, STAGE_AXIS, up)
-            return h_chan, g_chan, buf, dp, loss
+            return h_chan, g_chan, buf, dp, dx
 
         z = jnp.zeros(mb_shape, x_all.dtype)
         dp0 = jax.tree.map(jnp.zeros_like, p)
         buf0 = jnp.zeros((R,) + mb_shape, x_all.dtype)
-        _, _, _, dp, loss = lax.fori_loop(
-            0, T, tick, (z, z, buf0, dp0, jnp.zeros((), jnp.float32)))
-        # loss lives on the last stage; grads are per-stage slices
-        loss = lax.psum(loss, STAGE_AXIS)    # only last stage is nonzero
-        return loss, jax.tree.map(lambda a: a[None], dp)
+        dx0 = jnp.zeros_like(x_all)
+        _, _, _, dp, dx = lax.fori_loop(0, T, tick, (z, z, buf0, dp0, dx0))
+        # dx is populated only on stage 0; psum makes it uniform so the
+        # replicated out-spec is valid
+        dx = lax.psum(dx, STAGE_AXIS)
+        if batch_axis is not None:
+            # params replicate over the data axis, so each data shard's
+            # dp is a PARTIAL sum over its mb slice — reduce explicitly
+            # (autodiff-of-shard_map would have inserted this psum; a
+            # custom_vjp must do it by hand)
+            dp = jax.tree.map(lambda g: lax.psum(g, batch_axis), dp)
+        return jax.tree.map(lambda a: a[None], dp), dx
+
+    @jax.custom_vjp
+    def trunk(stacked_params, x_micro):
+        return fwd_run(stacked_params, x_micro)
+
+    def trunk_fwd(stacked_params, x_micro):
+        return fwd_run(stacked_params, x_micro), (stacked_params, x_micro)
+
+    def trunk_bwd(res, dy):
+        stacked_params, x_micro = res
+        pspecs = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
+        # activations replicate over stage; the mb dim may shard over a
+        # data axis (PP x DP) — the schedule is elementwise across mb
+        aspec = P(*([None, batch_axis] + [None] * (x_micro.ndim - 2))) \
+            if batch_axis else P()
+        f = shard_map(bwd_local, mesh=mesh,
+                      in_specs=(pspecs, aspec, aspec),
+                      out_specs=(pspecs, aspec), check_vma=False)
+        return f(stacked_params, x_micro, dy)
+
+    trunk.defvjp(trunk_fwd, trunk_bwd)
+    return trunk
+
+
+def one_f_one_b(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                num_stages: Optional[int] = None):
+    """1F1B pipeline TRAINING step (SURVEY P5; VERDICT r4 #9):
+    ``run(stacked_params, x_micro, tgt_micro) -> (loss, grads)`` with the
+    backward of each micro-batch starting the moment its forward leaves
+    the last stage — per-stage live activations bounded by the schedule
+    depth, not the micro-batch count.
+
+    Implemented as ``value_and_grad`` over :func:`pipeline_trunk_1f1b`
+    (ONE copy of the 1F1B tick machinery lives there): the trunk's
+    custom_vjp routes reverse-mode through the explicit 1F1B wavefront,
+    and the per-micro-batch ``loss_fn(h, tgt) -> scalar`` (summed over
+    micro-batches) differentiates on top like any jax function."""
+    trunk = pipeline_trunk_1f1b(stage_fn, mesh, num_stages)
 
     def run(stacked_params, x_micro, tgt_micro):
-        pspecs = jax.tree.map(lambda _: P(STAGE_AXIS), stacked_params)
-        f = shard_map(local, mesh=mesh,
-                      in_specs=(pspecs, P(), P()),
-                      out_specs=(P(), pspecs), check_vma=False)
-        return f(stacked_params, x_micro, tgt_micro)
+        def total_loss(sp):
+            y = trunk(sp, x_micro)
+            return jnp.sum(jax.vmap(loss_fn)(y, tgt_micro))
+        return jax.value_and_grad(total_loss)(stacked_params)
 
     return run
