@@ -378,13 +378,44 @@ class UIServer:
             aligned[sid] = [by_x.get(x) for x in grid]
         chart = _svg_multi_line(grid, aligned, title="score vs iteration") \
             if grid else "<p>(no data)</p>"
+        # per-layer side-by-side: latest mean|w| and update:param ratio of
+        # every param name any session reports, one column pair per session
+        latest = {sid: (self._updates(sid) or [{}])[-1] for sid in sids}
+        pnames = sorted({n for u in latest.values()
+                         for n in u.get("parameters", {})})
+        layer_tbl = ""
+        if pnames:
+            head = "".join(
+                f"<th colspan=2>{_html.escape(sid)}</th>" for sid in sids)
+            sub = "".join("<th>mean |w|</th><th>upd:param</th>"
+                          for _ in sids)
+            rows = ""
+            for n in pnames:
+                cells = ""
+                for sid in sids:
+                    ps = latest[sid].get("parameters", {}).get(n)
+                    us = latest[sid].get("updates", {}).get(n, {})
+                    if ps is None:
+                        cells += "<td>—</td><td>—</td>"
+                    else:
+                        ratio = (us.get("meanMagnitude", 0.0)
+                                 / max(ps.get("meanMagnitude", 0.0), 1e-12))
+                        cells += (f"<td>{ps.get('meanMagnitude', 0):.3e}"
+                                  f"</td><td>{ratio:.3e}</td>")
+                rows += (f"<tr><td>{_html.escape(n)}</td>{cells}</tr>")
+            layer_tbl = (
+                "<h3>Per-layer (latest update)</h3>"
+                "<table border=1 cellpadding=4>"
+                f"<tr><th rowspan=2>param</th>{head}</tr>"
+                f"<tr>{sub}</tr>{rows}</table>")
         return ("<html><head><title>Compare sessions</title></head><body>"
                 "<h2>Session comparison</h2>"
                 '<p><a href="/">overview</a></p>'
                 + chart
                 + "<table border=1 cellpadding=4><tr><th>session</th>"
                   "<th>updates</th><th>last score</th><th>best score</th>"
-                  f"</tr>{summaries}</table></body></html>")
+                  f"</tr>{summaries}</table>"
+                + layer_tbl + "</body></html>")
 
     def render_system(self) -> str:
         """The System tab (ref: the Vert.x app's hardware/memory page):

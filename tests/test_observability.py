@@ -492,6 +492,18 @@ def test_ui_two_session_compare_render():
             .decode()
         assert "run-a" in page and "run-b" in page
         assert page.count("<polyline") >= 2      # one curve per session
+        # per-layer side-by-side columns (one pair per session)
+        storage.put_update("run-a", {"iteration": 4, "score": 0.9,
+            "parameters": {"0_W": {"meanMagnitude": 0.1}},
+            "updates": {"0_W": {"meanMagnitude": 0.001}}})
+        storage.put_update("run-b", {"iteration": 4, "score": 0.8,
+            "parameters": {"0_W": {"meanMagnitude": 0.2}},
+            "updates": {"0_W": {"meanMagnitude": 0.004}}})
+        page2 = urllib.request.urlopen(
+            base + "/train/compare?sids=run-a,run-b", timeout=5).read() \
+            .decode()
+        assert "Per-layer" in page2 and "0_W" in page2
+        assert "1.000e-02" in page2 and "2.000e-02" in page2  # the ratios
         # overview links to the comparison when several sessions exist
         over = urllib.request.urlopen(base + "/", timeout=5).read().decode()
         assert "/train/compare?sids=" in over
