@@ -44,7 +44,9 @@ def main():
     # ---- save as a FlatGraph binary and reload
     with tempfile.TemporaryDirectory() as td:
         path = os.path.join(td, "linear.fb")
-        sd.save(path)                     # .fb extension → FlatBuffers
+        # save_updater_state: Adam moments ride the UpdaterState table so
+        # the resumed fine-tune continues EXACTLY (r5)
+        sd.save(path, save_updater_state=True)   # .fb → FlatBuffers
         print(f"saved {os.path.getsize(path)} bytes of FlatGraph")
         sd2 = SameDiff.load(path)
 
