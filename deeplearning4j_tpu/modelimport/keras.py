@@ -915,10 +915,16 @@ def _inbound_layer_names(inbound_nodes) -> List[str]:
                 if k != "keras_history":
                     walk(v)
         elif isinstance(obj, list):
-            # keras2 node: ["layer_name", node_idx, tensor_idx, {...}]
+            # keras2 node: ["layer_name", node_idx, tensor_idx, {kwargs}]
             if (len(obj) >= 3 and isinstance(obj[0], str)
                     and isinstance(obj[1], int) and isinstance(obj[2], int)):
                 names.append(obj[0])
+                # call-KWARG tensors ride the 4th slot (Keras 2 saves
+                # MultiHeadAttention's value/key as {"value": [name,0,0]})
+                # — in insertion order, preserving (query, value[, key])
+                if len(obj) >= 4 and isinstance(obj[3], dict):
+                    for v in obj[3].values():
+                        walk(v)
             else:
                 for v in obj:
                     walk(v)
