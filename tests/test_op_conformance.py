@@ -1302,6 +1302,163 @@ case("broadcast_dynamic_shape", "broadcast_dynamic_shape",
      dtype_strict=False)
 
 
+# ---- round-5 tranche 3: the _bp family vs tf.GradientTape -----------------
+# Registry _bp ops take (forward inputs..., upstream gradient) and return
+# the input cotangents; the TF twin is GradientTape with output_gradients.
+# Gradients are where silent divergence hides (SAME-padding asymmetry,
+# pool tie-breaks, normalization statistics terms).
+def _tape_g(fn, g, *xs):
+    ts = [tf.constant(x) for x in xs]
+    with tf.GradientTape() as tp:
+        for t in ts:
+            tp.watch(t)
+        y = fn(*ts)
+    out = tp.gradient(y, ts, output_gradients=tf.constant(g))
+    return [np.asarray(o) for o in out]
+
+
+g775 = rng.normal(size=(1, 7, 7, 5)).astype(F32)
+case("conv2d_bp", "conv2d_bp", (img, ker, g775),
+     {"strides": (1, 1), "padding": "SAME"},
+     lambda x, k, g: _tape_g(
+         lambda a, b: tf.nn.conv2d(a, b, [1, 1, 1, 1], "SAME"), g, x, k),
+     out=(0, 1), rtol=1e-4, atol=1e-4)
+case("conv1d_bp", "conv1d_bp",
+     (rng.normal(size=(2, 8, 3)).astype(F32),
+      rng.normal(size=(3, 3, 4)).astype(F32),
+      rng.normal(size=(2, 8, 4)).astype(F32)),
+     {"stride": 1, "padding": "SAME"},
+     lambda x, w, g: _tape_g(
+         lambda a, b: tf.nn.conv1d(a, b, 1, "SAME"), g, x, w),
+     out=(0, 1), rtol=1e-4, atol=1e-4)
+vol3 = rng.normal(size=(1, 3, 4, 4, 2)).astype(F32)
+ker3 = rng.normal(size=(2, 2, 2, 2, 3)).astype(F32) * 0.3
+case("conv3d_bp", "conv3d_bp",
+     (vol3, ker3, rng.normal(size=(1, 3, 4, 4, 3)).astype(F32)),
+     {"strides": (1, 1, 1), "padding": "SAME"},
+     lambda x, w, g: _tape_g(
+         lambda a, b: tf.nn.conv3d(a, b, (1, 1, 1, 1, 1), "SAME"), g, x, w),
+     out=(0, 1), rtol=1e-4, atol=1e-4)
+case("depthwise_conv2d_bp", "depthwise_conv2d_bp",
+     (img, dker, rng.normal(size=(1, 7, 7, 6)).astype(F32)),
+     {"strides": (1, 1), "padding": "SAME"},
+     lambda x, k, g: _tape_g(
+         lambda a, b: tf.nn.depthwise_conv2d(a, b, [1, 1, 1, 1], "SAME"),
+         g, x, k),
+     out=(0, 1), rtol=1e-4, atol=1e-4)
+g443 = rng.normal(size=(1, 4, 4, 3)).astype(F32)
+case("maxpool2d_bp", "maxpool2d_bp", (img, g443),
+     {"kernel": (3, 3), "strides": (2, 2), "padding": "SAME"},
+     lambda x, g: _tape_g(
+         lambda t: tf.nn.max_pool2d(t, 3, 2, "SAME"), g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("maxpool2d_bp_ties", "maxpool2d_bp",
+     (np.ones((1, 4, 4, 1), F32),
+      rng.normal(size=(1, 2, 2, 1)).astype(F32)),
+     {"kernel": (2, 2), "strides": (2, 2), "padding": "VALID"},
+     lambda x, g: _tape_g(
+         lambda t: tf.nn.max_pool2d(t, 2, 2, "VALID"), g, x)[0],
+     rtol=1e-6, atol=0)
+case("avgpool2d_bp", "avgpool2d_bp", (img, g443),
+     {"kernel": (3, 3), "strides": (2, 2), "padding": "SAME"},
+     lambda x, g: _tape_g(
+         lambda t: tf.nn.avg_pool2d(t, 3, 2, "SAME"), g, x)[0],
+     rtol=1e-5, atol=1e-6)
+vol4 = rng.normal(size=(1, 4, 4, 4, 2)).astype(F32)
+g222 = rng.normal(size=(1, 2, 2, 2, 2)).astype(F32)
+case("maxpool3d_bp", "maxpool3d_bp", (vol4, g222),
+     {"kernel": (2, 2, 2), "strides": (2, 2, 2), "padding": "VALID"},
+     lambda x, g: _tape_g(
+         lambda t: tf.nn.max_pool3d(t, 2, 2, "VALID"), g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("avgpool3d_bp", "avgpool3d_bp", (vol4, g222),
+     {"kernel": (2, 2, 2), "strides": (2, 2, 2), "padding": "VALID"},
+     lambda x, g: _tape_g(
+         lambda t: tf.nn.avg_pool3d(t, 2, 2, "VALID"), g, x)[0],
+     rtol=1e-5, atol=1e-6)
+xlrn = rng.normal(size=(1, 4, 4, 8)).astype(F32)
+case("lrn_bp", "lrn_bp", (xlrn, rng.normal(size=(1, 4, 4, 8)).astype(F32)),
+     {"depth_radius": 2, "bias": 1.0, "alpha": 1e-3, "beta": 0.75},
+     lambda x, g: _tape_g(
+         lambda t: tf.nn.local_response_normalization(
+             t, depth_radius=2, bias=1.0, alpha=1e-3, beta=0.75), g, x)[0],
+     rtol=1e-4, atol=1e-5)
+gln = rng.normal(size=(2, 3, 4)).astype(F32)
+case("layer_norm_bp", "layer_norm_bp",
+     (x234, xr4 * 0.5 + 1.0, xr4 - 0.3, gln),
+     {"axis": -1, "epsilon": 1e-5},
+     lambda x, ga, be, g: _tape_g(
+         lambda t, w, b: (t - tf.reduce_mean(t, -1, keepdims=True))
+         * tf.math.rsqrt(tf.math.reduce_variance(t, -1, keepdims=True)
+                         + 1e-5) * w + b, g, x, ga, be),
+     out=(0, 1, 2), rtol=1e-4, atol=1e-4)
+case("batchnorm_bp", "batchnorm_bp",
+     (x234, xr4, np.abs(xr4) + 0.2, xr4 * 0.5 + 1.0, xr4 - 0.3, gln),
+     {"epsilon": 1e-3},
+     lambda x, m, v, ga, be, g: _tape_g(
+         lambda t, w, b: tf.nn.batch_normalization(t, m, v, b, w, 1e-3),
+         g, x, ga, be),
+     out=(0, 1, 2), rtol=1e-4, atol=1e-4)
+case("biasadd_bp", "biasadd_bp",
+     (rng.normal(size=(2, 3, 4, 5)).astype(F32),
+      rng.normal(size=(5,)).astype(F32),
+      rng.normal(size=(2, 3, 4, 5)).astype(F32)), {},
+     lambda x, b, g: _tape_g(tf.nn.bias_add, g, x, b),
+     out=(0, 1), rtol=1e-5, atol=1e-6)
+xsm = rng.normal(size=(2, 3, 2, 4)).astype(F32)
+gsm = rng.normal(size=(2, 3, 2, 4)).astype(F32)
+case("upsampling2d_bp", "upsampling2d_bp",
+     (rng.normal(size=(2, 2, 3, 2)).astype(F32),
+      rng.normal(size=(2, 4, 6, 2)).astype(F32)), {"size": 2},
+     lambda x, g: _tape_g(
+         lambda t: tf.repeat(tf.repeat(t, 2, 1), 2, 2), g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("upsampling3d_bp", "upsampling3d_bp",
+     (rng.normal(size=(1, 2, 2, 2, 3)).astype(F32),
+      rng.normal(size=(1, 4, 4, 4, 3)).astype(F32)), {"scale": 2},
+     lambda x, g: _tape_g(
+         lambda t: tf.repeat(tf.repeat(tf.repeat(t, 2, 1), 2, 2), 2, 3),
+         g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("softmax_bp", "softmax_bp", (xsm, gsm), {},
+     lambda x, g: _tape_g(tf.nn.softmax, g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("log_softmax_bp", "log_softmax_bp", (xsm, gsm), {},
+     lambda x, g: _tape_g(tf.nn.log_softmax, g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("tanh_bp", "tanh_bp", (x34, x34 * 0.5), {},
+     lambda x, g: _tape_g(tf.tanh, g, x)[0], rtol=1e-5, atol=1e-6)
+case("sigmoid_bp", "sigmoid_bp", (x34, x34 * 0.5), {},
+     lambda x, g: _tape_g(tf.sigmoid, g, x)[0], rtol=1e-5, atol=1e-6)
+case("prelu_bp", "prelu_bp",
+     (x34, np.array([0.1, 0.2, 0.3, 0.4], F32), x34 * 0.5), {},
+     lambda x, a, g: _tape_g(
+         lambda t, al: tf.maximum(t, 0.0) + al * tf.minimum(t, 0.0),
+         g, x, a),
+     out=(0, 1), rtol=1e-5, atol=1e-6)
+case("im2col_bp", "im2col_bp",
+     (rng.normal(size=(1, 5, 6, 3)).astype(F32),
+      rng.normal(size=(1, 4, 2, 18)).astype(F32)),
+     {"kernel": (2, 3), "strides": (1, 2), "padding": "VALID"},
+     lambda x, g: _tape_g(
+         lambda t: (lambda p: tf.reshape(tf.transpose(tf.reshape(
+             p, tf.concat([tf.shape(p)[:3], [2, 3, 3]], 0)),
+             [0, 1, 2, 5, 3, 4]), tf.shape(p)))(
+             tf.image.extract_patches(t, [1, 2, 3, 1], [1, 1, 2, 1],
+                                      [1, 1, 1, 1], "VALID")), g, x)[0],
+     rtol=1e-5, atol=1e-6)
+case("gelu_derivative", "gelu_derivative", (x34,), {},
+     lambda x: _tape(tf.nn.gelu, x, approximate=True),
+     rtol=1e-4, atol=1e-5)
+case("leakyrelu_derivative", "leakyrelu_derivative",
+     (np.array([-2.5, -0.7, 0.3, 1.8], F32),), {},
+     lambda x: _tape(tf.nn.leaky_relu, x, alpha=0.01))
+case("hardsigmoid_derivative", "hardsigmoid_derivative",
+     (np.array([-3.0, -1.7, 0.0, 1.7, 3.0], F32),), {},
+     lambda x: np.where(np.abs(x) < 2.5, np.float32(0.2),
+                        np.float32(0.0)))
+
+
 @pytest.mark.parametrize(
     "spec", CASES, ids=[c[0] for c in CASES])
 def test_op_matches_twin(spec):
@@ -1335,9 +1492,9 @@ def test_conformance_sweep_coverage_gate():
     swept = {c[1] for c in CASES}
     missing = swept - reg
     assert not missing, f"cases name unregistered ops: {sorted(missing)}"
-    assert len(swept) >= 400, (
+    assert len(swept) >= 420, (
         f"conformance sweep covers {len(swept)} registry ops; the gate "
-        f"floor is 400 — do not shrink the sweep")
+        f"floor is 420 — do not shrink the sweep")
 
 
 def test_ctc_loss_matches_tf():
