@@ -59,13 +59,18 @@ def main():
     x = rng.normal(size=(batch, side, side, 3)).astype(np.float32)
     y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, batch)]
 
+    # BN-less VGG diverges from scratch at 1e-2 (He-init logits are large);
+    # the reference trains it from pretrained weights — use a gentler lr
+    lr_by_model = {"VGG16": 1e-3, "VGG19": 1e-3, "AlexNet": 1e-3}
     for name in args.models:
         t0 = time.perf_counter()
         m = net = None
         try:
             m = getattr(zoo, name)(num_classes=classes,
                                    input_shape=(side, side, 3),
-                                   updater=Nesterovs(0.01, momentum=0.9),
+                                   updater=Nesterovs(
+                                       lr_by_model.get(name, 0.01),
+                                       momentum=0.9),
                                    data_type=dtype)
             net = m.init_model()
             net.fit(x, y)                      # warmup = compile + step 1

@@ -25,6 +25,10 @@ class ZooModel:
     #: subclasses set: default input shape (H, W, C)
     input_shape: Tuple[int, int, int] = (224, 224, 3)
     num_classes: int = 1000
+    #: training-config overrides every zoo builder accepts (ref: ZooModel
+    #: builders' .updater(...); data_type is the TPU bf16-policy extension)
+    updater = None
+    data_type: str = "float32"
 
     def conf(self):
         """The network configuration (MultiLayerConfiguration or

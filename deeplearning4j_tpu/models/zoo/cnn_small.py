@@ -24,16 +24,20 @@ class LeNet(ZooModel):
     num_classes = 10
 
     def __init__(self, num_classes: int = 10, seed: int = 123,
-                 input_shape=(28, 28, 1)):
+                 input_shape=(28, 28, 1), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
-                .updater(Adam(1e-3))
+                .updater(self.updater or Adam(1e-3))
+                .data_type(self.data_type)
                 .weight_init("xavier")
                 .list()
                 .layer(ConvolutionLayer(kernel_size=(5, 5), stride=(1, 1),
@@ -57,16 +61,20 @@ class SimpleCNN(ZooModel):
     input_shape = (48, 48, 3)
 
     def __init__(self, num_classes: int = 10, seed: int = 123,
-                 input_shape=(48, 48, 3)):
+                 input_shape=(48, 48, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         b = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater or Adam(1e-3))
+             .data_type(self.data_type)
              .weight_init("relu")
              .activation("relu")
              .list())
@@ -91,16 +99,20 @@ class AlexNet(ZooModel):
     input_shape = (224, 224, 3)
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(224, 224, 3)):
+                 input_shape=(224, 224, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
-                .updater(Nesterovs(1e-2, 0.9))
+                .updater(self.updater or Nesterovs(1e-2, 0.9))
+                .data_type(self.data_type)
                 .weight_init("normal")
                 .activation("relu")
                 .list()
@@ -130,16 +142,20 @@ class TextGenerationLSTM(ZooModel):
     """ref: zoo.model.TextGenerationLSTM — char-level 2xLSTM(256)."""
 
     def __init__(self, total_unique_characters: int = 47, seed: int = 123,
-                 tbptt_length: int = 50):
+                 tbptt_length: int = 50, updater=None,
+                 data_type: str = "float32"):
         self.n_chars = total_unique_characters
         self.seed = seed
         self.tbptt_length = tbptt_length
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         from deeplearning4j_tpu.nn.conf.configuration import BackpropType
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
-                .updater(Adam(1e-3))
+                .updater(self.updater or Adam(1e-3))
+                .data_type(self.data_type)
                 .weight_init("xavier")
                 .list()
                 .layer(LSTM(n_in=self.n_chars, n_out=256, activation="tanh"))

@@ -74,16 +74,20 @@ class Darknet19(ZooModel):
     input_shape = (224, 224, 3)
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(224, 224, 3)):
+                 input_shape=(224, 224, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-3, 0.9))
+             .updater(self.updater or Nesterovs(1e-3, 0.9))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")
@@ -105,18 +109,22 @@ class TinyYOLO(ZooModel):
     input_shape = (416, 416, 3)
 
     def __init__(self, num_classes: int = 20, seed: int = 123,
-                 input_shape=(416, 416, 3), priors=_TINY_YOLO_PRIORS):
+                 input_shape=(416, 416, 3), priors=_TINY_YOLO_PRIORS,
+                 updater=None, data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
         self.priors = priors
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         nb = len(self.priors)
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater or Adam(1e-3))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")
@@ -142,18 +150,22 @@ class YOLO2(ZooModel):
     input_shape = (416, 416, 3)
 
     def __init__(self, num_classes: int = 20, seed: int = 123,
-                 input_shape=(416, 416, 3), priors=_YOLO2_PRIORS):
+                 input_shape=(416, 416, 3), priors=_YOLO2_PRIORS,
+                 updater=None, data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
         self.priors = priors
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         nb = len(self.priors)
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-3))
+             .updater(self.updater or Adam(1e-3))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")

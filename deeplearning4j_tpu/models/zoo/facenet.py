@@ -31,7 +31,8 @@ class FaceNetNN4Small2(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  input_shape=(96, 96, 3), embedding_size: int = 128,
                  width_mult: float = 1.0, updater=None,
-                 alpha: float = 0.05, lambda_: float = 2e-4):
+                 alpha: float = 0.05, lambda_: float = 2e-4,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
@@ -40,6 +41,7 @@ class FaceNetNN4Small2(ZooModel):
         self.updater = updater
         self.alpha = alpha
         self.lambda_ = lambda_
+        self.data_type = data_type
 
     def _w(self, n):
         return max(4, int(n * self.width_mult))
@@ -97,6 +99,7 @@ class FaceNetNN4Small2(ZooModel):
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
              .updater(self.updater or Adam(1e-3))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")

@@ -33,13 +33,15 @@ class InceptionResNetV1(ZooModel):
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  input_shape=(160, 160, 3), blocks=(5, 10, 5),
-                 embedding_size: int = 128, updater=None):
+                 embedding_size: int = 128, updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
         self.blocks = tuple(blocks)
         self.embedding_size = embedding_size
         self.updater = updater
+        self.data_type = data_type
 
     def _cba(self, g, name, inp, n_out, kernel, stride=(1, 1), pad="same"):
         g.add_layer(name, ConvolutionLayer(kernel_size=kernel, stride=stride,
@@ -92,6 +94,7 @@ class InceptionResNetV1(ZooModel):
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
              .updater(self.updater or RmsProp(0.1))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")
@@ -156,13 +159,15 @@ class NASNet(ZooModel):
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  input_shape=(224, 224, 3), penultimate_filters: int = 1056,
-                 num_blocks: int = 4, updater=None):
+                 num_blocks: int = 4, updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
         self.penultimate_filters = penultimate_filters
         self.num_blocks = num_blocks
         self.updater = updater
+        self.data_type = data_type
 
     def _sep(self, g, name, inp, n_out, kernel, stride=(1, 1)):
         g.add_layer(name + "_relu", ActivationLayer(activation="relu"), inp)
@@ -213,6 +218,7 @@ class NASNet(ZooModel):
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
              .updater(self.updater or Adam(1e-3))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")

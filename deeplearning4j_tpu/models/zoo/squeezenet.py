@@ -18,10 +18,13 @@ class SqueezeNet(ZooModel):
     input_shape = (227, 227, 3)
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(227, 227, 3)):
+                 input_shape=(227, 227, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def _fire(self, g, name, inp, squeeze, expand):
         g.add_layer(name + "_sq", ConvolutionLayer(kernel_size=(1, 1),
@@ -38,7 +41,8 @@ class SqueezeNet(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-2, 0.9))
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .data_type(self.data_type)
              .weight_init("relu")
              .activation("relu")
              .graph_builder()

@@ -18,10 +18,13 @@ class UNet(ZooModel):
     input_shape = (512, 512, 3)
 
     def __init__(self, num_classes: int = 1, seed: int = 123,
-                 input_shape=(512, 512, 3)):
+                 input_shape=(512, 512, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def _conv2(self, g, name, inp, n_out, dropout=None):
         g.add_layer(name + "_1", ConvolutionLayer(kernel_size=(3, 3),
@@ -40,7 +43,8 @@ class UNet(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Adam(1e-4))
+             .updater(self.updater or Adam(1e-4))
+             .data_type(self.data_type)
              .weight_init("relu")
              .activation("relu")
              .graph_builder()

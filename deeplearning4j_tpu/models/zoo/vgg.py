@@ -19,16 +19,20 @@ class VGG16(ZooModel):
     _blocks = _VGG16_BLOCKS
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(224, 224, 3)):
+                 input_shape=(224, 224, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def conf(self):
         h, w, c = self.input_shape
         b = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-2, 0.9))
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .data_type(self.data_type)
              .weight_init("relu")
              .activation("relu")
              .list())

@@ -19,10 +19,13 @@ class Xception(ZooModel):
     input_shape = (299, 299, 3)
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(299, 299, 3)):
+                 input_shape=(299, 299, 3), updater=None,
+                 data_type: str = "float32"):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = tuple(input_shape)
+        self.updater = updater
+        self.data_type = data_type
 
     def _conv_bn(self, g, name, inp, n_out, kernel, stride=(1, 1), act=True):
         g.add_layer(name, ConvolutionLayer(kernel_size=kernel, stride=stride,
@@ -55,7 +58,8 @@ class Xception(ZooModel):
         h, w, c = self.input_shape
         g = (NeuralNetConfiguration.builder()
              .seed(self.seed)
-             .updater(Nesterovs(1e-2, 0.9))
+             .updater(self.updater or Nesterovs(1e-2, 0.9))
+             .data_type(self.data_type)
              .weight_init("relu")
              .graph_builder()
              .add_inputs("input")

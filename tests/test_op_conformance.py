@@ -1447,6 +1447,82 @@ case("im2col_bp", "im2col_bp",
              tf.image.extract_patches(t, [1, 2, 3, 1], [1, 1, 2, 1],
                                       [1, 1, 1, 1], "VALID")), g, x)[0],
      rtol=1e-5, atol=1e-6)
+# ---- recurrent cells/layers vs tf.keras with explicitly mapped weights ----
+# Ours: fused w (input+hidden, 4H), gate order i,f,g,o == keras i,f,c,o;
+# keras folds forget bias into the bias vector, so forget_bias=0 aligns.
+# GRU: keras kernel order is z,r,h (reset_after=False); ours is r,z + w_h.
+_RH, _RI, _RB, _RT = 5, 3, 2, 4
+_rw = (rng.normal(size=(_RI + _RH, 4 * _RH)) * 0.4).astype(F32)
+_rb = (rng.normal(size=(4 * _RH,)) * 0.1).astype(F32)
+_rx = rng.normal(size=(_RB, _RI)).astype(F32)
+_rh0 = rng.normal(size=(_RB, _RH)).astype(F32)
+_rc0 = rng.normal(size=(_RB, _RH)).astype(F32)
+_rxs = rng.normal(size=(_RB, _RT, _RI)).astype(F32)
+_rwrz = (rng.normal(size=(_RI + _RH, 2 * _RH)) * 0.4).astype(F32)
+_rwh = (rng.normal(size=(_RI + _RH, _RH)) * 0.4).astype(F32)
+_rbrz = (rng.normal(size=(2 * _RH,)) * 0.1).astype(F32)
+_rbh = (rng.normal(size=(_RH,)) * 0.1).astype(F32)
+
+
+def _keras_lstm_cell_twin(x, h, c, w, b):
+    cell = tf.keras.layers.LSTMCell(_RH)
+    cell.build((None, _RI))
+    cell.set_weights([w[:_RI], w[_RI:], b])
+    out, st = cell(tf.constant(x), [tf.constant(h), tf.constant(c)])
+    return [np.asarray(out), np.asarray(st[1])]
+
+
+def _gru_keras_weights(wrz, wh, brz, bh):
+    kern = np.concatenate([wrz[:_RI, _RH:], wrz[:_RI, :_RH], wh[:_RI]], 1)
+    rec = np.concatenate([wrz[_RI:, _RH:], wrz[_RI:, :_RH], wh[_RI:]], 1)
+    bias = np.concatenate([brz[_RH:], brz[:_RH], bh])
+    return kern, rec, bias
+
+
+def _keras_gru_cell_twin(x, h, wrz, wh, brz, bh):
+    kern, rec, bias = _gru_keras_weights(wrz, wh, brz, bh)
+    cell = tf.keras.layers.GRUCell(_RH, reset_after=False)
+    cell.build((None, _RI))
+    cell.set_weights([kern, rec, bias])
+    out, _st = cell(tf.constant(x), [tf.constant(h)])
+    return np.asarray(out)
+
+
+def _keras_lstm_layer_twin(x, h, c, w, b):
+    lay = tf.keras.layers.LSTM(_RH, return_sequences=True)
+    lay.build((None, None, _RI))
+    lay.set_weights([w[:_RI], w[_RI:], b])
+    return np.asarray(lay(tf.constant(x),
+                          initial_state=[tf.constant(h), tf.constant(c)]))
+
+
+def _keras_gru_layer_twin(x, h, wrz, wh, brz, bh):
+    kern, rec, bias = _gru_keras_weights(wrz, wh, brz, bh)
+    lay = tf.keras.layers.GRU(_RH, reset_after=False, return_sequences=True)
+    lay.build((None, None, _RI))
+    lay.set_weights([kern, rec, bias])
+    return np.asarray(lay(tf.constant(x), initial_state=tf.constant(h)))
+
+
+case("lstm_cell_keras", "lstm_cell", (_rx, _rh0, _rc0, _rw, _rb),
+     {"forget_bias": 0.0}, _keras_lstm_cell_twin, out=(0, 1),
+     rtol=1e-5, atol=1e-5)
+case("gru_cell_keras", "gru_cell",
+     (_rx, _rh0, _rwrz, _rwh, _rbrz, _rbh), {}, _keras_gru_cell_twin,
+     rtol=1e-5, atol=1e-5)
+case("lstm_layer_keras", "lstm_layer", (_rxs, _rh0, _rc0, _rw, _rb),
+     {"forget_bias": 0.0}, _keras_lstm_layer_twin, out=0,
+     rtol=1e-4, atol=1e-5)
+# lstm_block's TF-style forget_bias default (+1.0 on the f gate) must equal
+# keras with the +1 folded into the f-block of the bias vector
+case("lstm_block_keras", "lstm_block", (_rxs, _rh0, _rc0, _rw, _rb), {},
+     lambda x, h, c, w, b: _keras_lstm_layer_twin(
+         x, h, c, w, np.concatenate(
+             [b[:_RH], b[_RH:2 * _RH] + 1.0, b[2 * _RH:]]).astype(F32)),
+     out=0, rtol=1e-4, atol=1e-5)
+case("gru_layer_keras", "gru_layer",
+     (_rxs, _rh0, _rwrz, _rwh, _rbrz, _rbh), {}, _keras_gru_layer_twin,
+     out=0, rtol=1e-4, atol=1e-5)
 case("gelu_derivative", "gelu_derivative", (x34,), {},
      lambda x: _tape(tf.nn.gelu, x, approximate=True),
      rtol=1e-4, atol=1e-5)
@@ -1463,7 +1539,11 @@ case("hardsigmoid_derivative", "hardsigmoid_derivative",
     "spec", CASES, ids=[c[0] for c in CASES])
 def test_op_matches_twin(spec):
     id_, op, args, attrs, twin, rtol, atol, out, dtype_strict = spec
-    got = exec_op(op, *[jnp.asarray(a) for a in args], **attrs)
+    # This jax build's platform default lowers f32 matmuls to bf16 passes
+    # (TPU-style); the sweep compares SEMANTICS against f32 twins, so pin
+    # true-f32 contractions for the op under test.
+    with jax.default_matmul_precision("highest"):
+        got = exec_op(op, *[jnp.asarray(a) for a in args], **attrs)
     want = twin(*args)
     gots = list(got) if isinstance(got, (tuple, list)) else [got]
     wants = want if isinstance(want, list) else [want]

@@ -285,3 +285,24 @@ def test_facenet_nn4_small2_forward_and_center_loss_train():
     assert not is_weight_param("centers", centers, lyr)
     assert is_weight_param("W", np.zeros((3, 3)), lyr)
     assert is_weight_param("centers", centers)  # shape rule without a layer
+
+
+def test_every_zoo_builder_accepts_updater_and_data_type():
+    """Every zoo architecture takes the common builder overrides (ref:
+    ZooModel builders' .updater(...); data_type is the TPU bf16-policy
+    extension). Guard against the drift that broke zoo_fullsize_step.py
+    when only some constructors had the kwargs."""
+    import inspect
+
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.models.zoo.base import ZooModel
+
+    classes = [c for n in dir(zoo)
+               for c in [getattr(zoo, n)]
+               if inspect.isclass(c) and issubclass(c, ZooModel)
+               and c is not ZooModel]
+    assert len(classes) >= 16
+    for cls in classes:
+        params = inspect.signature(cls.__init__).parameters
+        assert "updater" in params, f"{cls.__name__} lacks updater kwarg"
+        assert "data_type" in params, f"{cls.__name__} lacks data_type kwarg"
