@@ -1523,6 +1523,137 @@ case("lstm_block_keras", "lstm_block", (_rxs, _rh0, _rc0, _rw, _rb), {},
 case("gru_layer_keras", "gru_layer",
      (_rxs, _rh0, _rwrz, _rwh, _rbrz, _rbh), {}, _keras_gru_layer_twin,
      out=0, rtol=1e-4, atol=1e-5)
+# ---- ONNX recurrent ops vs torch.nn with mapped weights -------------------
+# ONNX gate orders: LSTM i,o,f,c / GRU z,r,h; torch: LSTM i,f,g,o / GRU
+# r,z,n (torch GRU == linear_before_reset=1). Weights are drawn as ONNX-
+# layout case args; twins load the inverse-reordered blocks into torch.
+_OT, _OB, _OI, _OH = 4, 2, 3, 5
+_ox = rng.normal(size=(_OT, _OB, _OI)).astype(F32)
+_olW = (rng.normal(size=(1, 4 * _OH, _OI)) * 0.4).astype(F32)
+_olR = (rng.normal(size=(1, 4 * _OH, _OH)) * 0.4).astype(F32)
+_olB = (rng.normal(size=(1, 8 * _OH)) * 0.1).astype(F32)
+_ogW = (rng.normal(size=(1, 3 * _OH, _OI)) * 0.4).astype(F32)
+_ogR = (rng.normal(size=(1, 3 * _OH, _OH)) * 0.4).astype(F32)
+_ogB = (rng.normal(size=(1, 6 * _OH)) * 0.1).astype(F32)
+_orW = (rng.normal(size=(1, _OH, _OI)) * 0.4).astype(F32)
+_orR = (rng.normal(size=(1, _OH, _OH)) * 0.4).astype(F32)
+_orB = (rng.normal(size=(1, 2 * _OH)) * 0.1).astype(F32)
+
+
+def _onnx2torch_lstm(a):
+    i, o, f, c = np.split(a, 4, 0)
+    return np.concatenate([i, f, c, o], 0)
+
+
+def _onnx2torch_gru(a):
+    z, r, h = np.split(a, 3, 0)
+    return np.concatenate([r, z, h], 0)
+
+
+def _torch_lstm_twin(x, w, r, b):
+    torch = _torch()
+    m = torch.nn.LSTM(_OI, _OH, bias=True)
+    with torch.no_grad():
+        m.weight_ih_l0.copy_(torch.tensor(_onnx2torch_lstm(w[0])))
+        m.weight_hh_l0.copy_(torch.tensor(_onnx2torch_lstm(r[0])))
+        m.bias_ih_l0.copy_(torch.tensor(
+            _onnx2torch_lstm(b[0, :4 * _OH])))
+        m.bias_hh_l0.copy_(torch.tensor(
+            _onnx2torch_lstm(b[0, 4 * _OH:])))
+        y, _ = m(torch.tensor(x))
+    return y.numpy()[:, None]                    # (T,B,H) -> (T,D=1,B,H)
+
+
+def _torch_gru_twin(x, w, r, b):
+    torch = _torch()
+    m = torch.nn.GRU(_OI, _OH, bias=True)
+    with torch.no_grad():
+        m.weight_ih_l0.copy_(torch.tensor(_onnx2torch_gru(w[0])))
+        m.weight_hh_l0.copy_(torch.tensor(_onnx2torch_gru(r[0])))
+        m.bias_ih_l0.copy_(torch.tensor(_onnx2torch_gru(b[0, :3 * _OH])))
+        m.bias_hh_l0.copy_(torch.tensor(_onnx2torch_gru(b[0, 3 * _OH:])))
+        y, _ = m(torch.tensor(x))
+    return y.numpy()[:, None]
+
+
+def _torch_rnn_twin(x, w, r, b):
+    torch = _torch()
+    m = torch.nn.RNN(_OI, _OH, bias=True, nonlinearity="tanh")
+    with torch.no_grad():
+        m.weight_ih_l0.copy_(torch.tensor(w[0]))
+        m.weight_hh_l0.copy_(torch.tensor(r[0]))
+        m.bias_ih_l0.copy_(torch.tensor(b[0, :_OH]))
+        m.bias_hh_l0.copy_(torch.tensor(b[0, _OH:]))
+        y, _ = m(torch.tensor(x))
+    return y.numpy()[:, None]
+
+
+def _torch_bilstm_twin(x, w, r, b):
+    torch = _torch()
+    m = torch.nn.LSTM(_OI, _OH, bias=True, bidirectional=True)
+    with torch.no_grad():
+        for di, sfx in ((0, ""), (1, "_reverse")):
+            getattr(m, "weight_ih_l0" + sfx).copy_(
+                torch.tensor(_onnx2torch_lstm(w[di])))
+            getattr(m, "weight_hh_l0" + sfx).copy_(
+                torch.tensor(_onnx2torch_lstm(r[di])))
+            getattr(m, "bias_ih_l0" + sfx).copy_(
+                torch.tensor(_onnx2torch_lstm(b[di, :4 * _OH])))
+            getattr(m, "bias_hh_l0" + sfx).copy_(
+                torch.tensor(_onnx2torch_lstm(b[di, 4 * _OH:])))
+        y, _ = m(torch.tensor(x))
+    return (y.numpy().reshape(_OT, _OB, 2, _OH)
+            .transpose(0, 2, 1, 3))              # (T,B,2H) -> (T,D,B,H)
+
+
+case("static_rnn_lstm", "static_rnn", (_rxs, _rh0, _rc0, _rw, _rb),
+     {"cell": "lstm", "forget_bias": 0.0},
+     _keras_lstm_layer_twin, out=0, rtol=1e-4, atol=1e-5)
+
+
+def _sru_ref(x, c0, w, b):
+    """SRU recurrence restated independently in numpy (Lei et al. 2017,
+    eq. 3-7 with highway connection on the raw input)."""
+    n, t, d = x.shape
+    proj = x.astype(np.float64) @ w.astype(np.float64)
+    xt_, f_, r_ = np.split(proj, 3, -1)
+    bf, br = np.split(b.astype(np.float64), 2)
+    f = 1 / (1 + np.exp(-(f_ + bf)))
+    r = 1 / (1 + np.exp(-(r_ + br)))
+    c = c0.astype(np.float64)
+    hs = []
+    for k in range(t):
+        c = f[:, k] * c + (1 - f[:, k]) * xt_[:, k]
+        hs.append(r[:, k] * np.tanh(c) + (1 - r[:, k]) * x[:, k])
+    return [np.stack(hs, 1).astype(F32), c.astype(F32)]
+
+
+_sx = rng.normal(size=(2, 4, 5)).astype(F32)
+_sc0 = rng.normal(size=(2, 5)).astype(F32)
+_sw = (rng.normal(size=(5, 15)) * 0.4).astype(F32)
+_sb = (rng.normal(size=(10,)) * 0.1).astype(F32)
+case("sru", "sru", (_sx, _sc0, _sw, _sb), {}, _sru_ref,
+     out=(0, 1), rtol=1e-5, atol=1e-5)
+case("sru_cell", "sru_cell", (_sx[:, 0], _sc0, _sw, _sb), {},
+     lambda x, c, w, b: (lambda hs, cn: [hs[:, 0], cn])(
+         *_sru_ref(x[:, None], c, w, b)),
+     out=(0, 1), rtol=1e-5, atol=1e-5)
+case("onnx_lstm_torch", "onnx_lstm", (_ox, _olW, _olR, _olB), {},
+     _torch_lstm_twin, out=0, rtol=1e-5, atol=1e-5)
+case("onnx_gru_torch", "onnx_gru", (_ox, _ogW, _ogR, _ogB),
+     {"linear_before_reset": 1}, _torch_gru_twin, out=0,
+     rtol=1e-5, atol=1e-5)
+case("onnx_rnn_torch", "onnx_rnn", (_ox, _orW, _orR, _orB), {},
+     _torch_rnn_twin, out=0, rtol=1e-5, atol=1e-5)
+_olW2 = (rng.normal(size=(1, 4 * _OH, _OI)) * 0.4).astype(F32)
+_olR2 = (rng.normal(size=(1, 4 * _OH, _OH)) * 0.4).astype(F32)
+_olB2 = (rng.normal(size=(1, 8 * _OH)) * 0.1).astype(F32)
+case("onnx_lstm_bidir_torch", "onnx_lstm",
+     (_ox, np.concatenate([_olW, _olW2]),
+      np.concatenate([_olR, _olR2]),
+      np.concatenate([_olB, _olB2])),
+     {"direction": "bidirectional"}, _torch_bilstm_twin, out=0,
+     rtol=1e-5, atol=1e-5)
 case("gelu_derivative", "gelu_derivative", (x34,), {},
      lambda x: _tape(tf.nn.gelu, x, approximate=True),
      rtol=1e-4, atol=1e-5)
@@ -1572,9 +1703,9 @@ def test_conformance_sweep_coverage_gate():
     swept = {c[1] for c in CASES}
     missing = swept - reg
     assert not missing, f"cases name unregistered ops: {sorted(missing)}"
-    assert len(swept) >= 420, (
+    assert len(swept) >= 430, (
         f"conformance sweep covers {len(swept)} registry ops; the gate "
-        f"floor is 420 — do not shrink the sweep")
+        f"floor is 430 — do not shrink the sweep")
 
 
 def test_ctc_loss_matches_tf():
