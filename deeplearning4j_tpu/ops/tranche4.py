@@ -142,12 +142,15 @@ def ada_max_updater(grad, m, u, lr=2e-3, beta1=0.9, beta2=0.999, eps=1e-8,
 @register("nadam_updater", num_outputs=3)
 def nadam_updater(grad, m, v, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
                   iteration=0):
+    # Dozat's NAdam (= reference NadamUpdater, = optax nesterov adam): the
+    # look-ahead momentum term is bias-corrected at t+1, the raw-grad term
+    # at t — conformance-swept vs optax.scale_by_adam(nesterov=True)
     t = iteration + 1
     m_new = beta1 * m + (1 - beta1) * grad
     v_new = beta2 * v + (1 - beta2) * grad * grad
-    m_hat = m_new / (1 - beta1 ** t)
     v_hat = v_new / (1 - beta2 ** t)
-    nud = beta1 * m_hat + (1 - beta1) * grad / (1 - beta1 ** t)
+    nud = (beta1 * m_new / (1 - beta1 ** (t + 1))
+           + (1 - beta1) * grad / (1 - beta1 ** t))
     return lr * nud / (jnp.sqrt(v_hat) + eps), m_new, v_new
 
 

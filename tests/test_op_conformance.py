@@ -1523,6 +1523,108 @@ case("lstm_block_keras", "lstm_block", (_rxs, _rh0, _rc0, _rw, _rb), {},
 case("gru_layer_keras", "gru_layer",
      (_rxs, _rh0, _rwrz, _rwh, _rbrz, _rbh), {}, _keras_gru_layer_twin,
      out=0, rtol=1e-4, atol=1e-5)
+# ---- updater ops vs optax / torch.optim -----------------------------------
+# Each registry updater maps (grad, state...) -> (update, new state...).
+# Anchors chosen where the eps placement matches: optax for adam/nadam/
+# nesterovs (trace isomorphism v = -lr*trace), torch.optim for rmsprop/
+# adagrad/adadelta/amsgrad (eps outside the sqrt, like nd4j). Adamax gets
+# an explicit-formula twin: torch puts eps inside the max (|g|+eps) where
+# nd4j adds it to the denominator (u+eps) — equal at these magnitudes but
+# not in general, so torch is not a safe anchor there.
+_ug = rng.normal(size=(4,)).astype(F32)
+_um = rng.normal(size=(4,)).astype(F32) * 0.1
+_uv = np.abs(rng.normal(size=(4,))).astype(F32) * 0.1
+_uv2 = np.abs(rng.normal(size=(4,))).astype(F32) * 0.1
+
+
+def _torch_step(optcls, state, kw, g):
+    torch = _torch()
+    p = torch.zeros(4, requires_grad=True)
+    opt = optcls([p], **kw)
+    for k, v in state.items():
+        opt.state[p][k] = torch.tensor(v)
+    p.grad = torch.tensor(g)
+    before = p.detach().clone()
+    opt.step()
+    return (before - p.detach()).numpy()
+
+
+def _optax_adam_twin(nesterov):
+    def twin(g, m, v):
+        import optax
+        tx = optax.scale_by_adam(0.9, 0.999, 1e-8, nesterov=nesterov)
+        st = optax.ScaleByAdamState(count=jnp.asarray(3),
+                                    mu=jnp.asarray(m), nu=jnp.asarray(v))
+        u, stn = tx.update(jnp.asarray(g), st)
+        return [0.01 * np.asarray(u), np.asarray(stn.mu),
+                np.asarray(stn.nu)]
+    return twin
+
+
+case("sgd_updater", "sgd_updater", (_ug,), {"lr": 0.05},
+     lambda g: (0.05 * g).astype(F32))
+case("adam_updater_optax", "adam_updater", (_ug, _um, _uv),
+     {"lr": 0.01, "iteration": 3}, _optax_adam_twin(False),
+     out=(0, 1, 2), rtol=1e-5, atol=1e-6)
+case("nadam_updater_optax", "nadam_updater", (_ug, _um, _uv),
+     {"lr": 0.01, "iteration": 3}, _optax_adam_twin(True),
+     out=(0, 1, 2), rtol=1e-5, atol=1e-6)
+
+
+def _nesterovs_twin(g, v):
+    import optax
+    tx = optax.trace(decay=0.9, nesterov=True)
+    st = optax.TraceState(trace=jnp.asarray(-v / 0.01))
+    u, stn = tx.update(jnp.asarray(g), st)
+    return [0.01 * np.asarray(u), -0.01 * np.asarray(stn.trace)]
+
+
+case("nesterovs_updater_optax", "nesterovs_updater",
+     (_ug, _um), {"lr": 0.01, "momentum": 0.9}, _nesterovs_twin,
+     out=(0, 1), rtol=1e-5, atol=1e-6)
+case("rms_prop_updater_torch", "rms_prop_updater", (_ug, _uv),
+     {"lr": 0.01, "decay": 0.95},
+     lambda g, v: _torch_step(
+         _torch().optim.RMSprop,
+         {"step": np.float32(1.0), "square_avg": v},
+         dict(lr=0.01, alpha=0.95, eps=1e-8), g),
+     rtol=1e-5, atol=1e-7)
+case("ada_grad_updater_torch", "ada_grad_updater", (_ug, _uv),
+     {"lr": 0.01},
+     lambda g, h: _torch_step(
+         _torch().optim.Adagrad, {"step": np.float32(1.0), "sum": h},
+         dict(lr=0.01, eps=1e-8), g),
+     rtol=1e-5, atol=1e-7)
+case("ada_delta_updater_torch", "ada_delta_updater",
+     (_ug, _uv, _uv2), {"rho": 0.95},
+     lambda g, msg, msdx: _torch_step(
+         _torch().optim.Adadelta,
+         {"step": np.float32(1.0), "square_avg": msg, "acc_delta": msdx},
+         dict(lr=1.0, rho=0.95, eps=1e-6), g),
+     out=0, rtol=1e-5, atol=1e-6)
+case("ams_grad_updater_torch", "ams_grad_updater",
+     (_ug, _um, _uv, (_uv * 1.5).astype(F32)),
+     {"lr": 0.01, "iteration": 3},
+     lambda g, m, v, vh: _torch_step(
+         _torch().optim.Adam,
+         {"step": np.float32(3.0), "exp_avg": m, "exp_avg_sq": v,
+          "max_exp_avg_sq": vh},
+         dict(lr=0.01, betas=(0.9, 0.999), eps=1e-8, amsgrad=True), g),
+     out=0, rtol=1e-5, atol=1e-7)
+def _adamax_ref(g, m, u):
+    """nd4j AdaMaxUpdater restated: u = max(b2*u, |g|); update =
+    lr*m_new/((1-b1^t)*(u_new+eps)), t=4."""
+    m_new = 0.9 * m + 0.1 * g
+    u_new = np.maximum(0.999 * u, np.abs(g))
+    return [(0.002 * m_new / ((1 - 0.9 ** 4) * (u_new + 1e-8)))
+            .astype(F32), m_new.astype(F32), u_new.astype(F32)]
+
+
+case("ada_max_updater_ref", "ada_max_updater",
+     (_ug, _um, _uv), {"lr": 0.002, "iteration": 3}, _adamax_ref,
+     out=(0, 1, 2), rtol=1e-5, atol=1e-7)
+
+
 # ---- ONNX recurrent ops vs torch.nn with mapped weights -------------------
 # ONNX gate orders: LSTM i,o,f,c / GRU z,r,h; torch: LSTM i,f,g,o / GRU
 # r,z,n (torch GRU == linear_before_reset=1). Weights are drawn as ONNX-
@@ -1703,9 +1805,9 @@ def test_conformance_sweep_coverage_gate():
     swept = {c[1] for c in CASES}
     missing = swept - reg
     assert not missing, f"cases name unregistered ops: {sorted(missing)}"
-    assert len(swept) >= 430, (
+    assert len(swept) >= 440, (
         f"conformance sweep covers {len(swept)} registry ops; the gate "
-        f"floor is 430 — do not shrink the sweep")
+        f"floor is 440 — do not shrink the sweep")
 
 
 def test_ctc_loss_matches_tf():
