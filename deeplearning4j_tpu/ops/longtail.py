@@ -84,15 +84,19 @@ def mirror_pad(x, paddings, mode="REFLECT"):
 
 @register("col2im")
 def col2im(cols, kernel, out_hw, strides=(1, 1), padding="VALID"):
-    """Inverse of im2col: scatter-add (N,OH,OW,KH·KW·C) patches back to the
-    (N,H,W,C) image (ref: libnd4j col2im helper — conv backward building
-    block)."""
+    """Inverse of im2col: scatter-add (N,OH,OW,C·KH·KW) patches (channel-
+    major feature packing, matching im2col) back to the (N,H,W,C) image
+    (ref: libnd4j col2im helper — conv backward building block)."""
     kh, kw = (int(k) for k in kernel)
     sh, sw = (int(s) for s in strides)
     h, w = (int(v) for v in out_hw)
     n, oh, ow, _ = cols.shape
     c = cols.shape[-1] // (kh * kw)
-    cols = cols.reshape(n, oh, ow, kh, kw, c)
+    # im2col (conv_general_dilated_patches) packs features channel-major
+    # (C, KH, KW); unpack the same way so col2im is its exact adjoint
+    # (ordering bug caught by the conformance sweep's tape-adjoint twin —
+    # the previous all-ones roundtrip test was permutation-blind)
+    cols = cols.reshape(n, oh, ow, c, kh, kw).transpose(0, 1, 2, 4, 5, 3)
     if padding.upper() == "SAME":
         ph = max((oh - 1) * sh + kh - h, 0)
         pw = max((ow - 1) * sw + kw - w, 0)

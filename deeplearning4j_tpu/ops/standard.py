@@ -485,7 +485,9 @@ def resize_bilinear(x, size):
 
 @register("im2col")
 def im2col(x, kernel, strides=(1, 1), padding="VALID"):
-    """Patch extraction (ref: libnd4j im2col helper); NHWC → (N, OH, OW, KH*KW*C)."""
+    """Patch extraction (ref: libnd4j im2col helper); NHWC → (N, OH, OW,
+    C*KH*KW) — channel-major feature packing, the
+    conv_general_dilated_patches layout; col2im consumes the same."""
     kh, kw = kernel
     patches = lax.conv_general_dilated_patches(
         x, (kh, kw), tuple(strides),

@@ -1523,6 +1523,47 @@ case("lstm_block_keras", "lstm_block", (_rxs, _rh0, _rc0, _rw, _rb), {},
 case("gru_layer_keras", "gru_layer",
      (_rxs, _rh0, _rwrz, _rwh, _rbrz, _rbh), {}, _keras_gru_layer_twin,
      out=0, rtol=1e-4, atol=1e-5)
+# ---- round-5 final tranche: adjoints, no-op edges, infra ops --------------
+def _im2col_adjoint_twin(p):
+    """Tape-adjoint of the (C,KH,KW)-reordered extract_patches: the ground
+    truth col2im must reproduce (caught a channel-ordering bug in col2im)."""
+    t = tf.constant(np.zeros((1, 5, 6, 3), F32))
+    with tf.GradientTape() as tp:
+        tp.watch(t)
+        q = tf.image.extract_patches(t, [1, 2, 3, 1], [1, 1, 2, 1],
+                                     [1, 1, 1, 1], "VALID")
+        q = tf.reshape(tf.transpose(tf.reshape(q, (1, 4, 2, 2, 3, 3)),
+                                    [0, 1, 2, 5, 3, 4]), (1, 4, 2, 18))
+    return tp.gradient(q, t, output_gradients=tf.constant(p)).numpy()
+
+
+case("col2im_adjoint", "col2im",
+     (rng.normal(size=(1, 4, 2, 18)).astype(F32), (2, 3), (5, 6)),
+     {"strides": (1, 2), "padding": "VALID"},
+     lambda p, k, hw: _im2col_adjoint_twin(p), rtol=1e-6, atol=1e-7)
+_dkey = np.asarray(jax.random.PRNGKey(0))
+case("dropout_rate0_identity", "dropout",
+     (x34, _dkey), {"rate": 0.0}, lambda x, k: x)
+case("dropout_inverted_p1_identity", "dropout_inverted",
+     (x34, _dkey), {"p": 1.0}, lambda x, k: x)
+case("alpha_dropout_p0_identity", "alpha_dropout",
+     (x34,), {"p": 0.0}, lambda x: x)
+case("broadcastgradientargs", "broadcastgradientargs",
+     (np.array([3, 1, 4], I32), np.array([3, 4], I32)), {},
+     lambda a, b: [np.asarray(tf.raw_ops.BroadcastGradientArgs(
+         s0=tf.constant(a), s1=tf.constant(b)).r0),
+         np.asarray(tf.raw_ops.BroadcastGradientArgs(
+             s0=tf.constant(a), s1=tf.constant(b)).r1)],
+     out=(0, 1), dtype_strict=False)
+case("compat_sparse_to_dense", "compat_sparse_to_dense",
+     (np.array([[0, 1], [2, 0]], np.int64), np.array([3, 3], np.int64),
+      np.array([5.0, 7.0], F32)), {"default": -1.0},
+     lambda i, s, v: _t(tf.compat.v1.sparse_to_dense, i, s, v, -1.0))
+case("match_condition_transform", "match_condition_transform",
+     (np.array([1., -2., 0., 3.], F32),), {"condition": "gte", "value": 0.0},
+     lambda x: (x >= 0.0))
+
+
 # ---- updater ops vs optax / torch.optim -----------------------------------
 # Each registry updater maps (grad, state...) -> (update, new state...).
 # Anchors chosen where the eps placement matches: optax for adam/nadam/
@@ -1805,9 +1846,9 @@ def test_conformance_sweep_coverage_gate():
     swept = {c[1] for c in CASES}
     missing = swept - reg
     assert not missing, f"cases name unregistered ops: {sorted(missing)}"
-    assert len(swept) >= 440, (
+    assert len(swept) >= 450, (
         f"conformance sweep covers {len(swept)} registry ops; the gate "
-        f"floor is 440 — do not shrink the sweep")
+        f"floor is 450 — do not shrink the sweep")
 
 
 def test_ctc_loss_matches_tf():
