@@ -1,0 +1,75 @@
+"""Training-UI monitoring: live SSE streaming + two-session compare.
+
+The analog of the reference's UIServer example (ref: org.deeplearning4j.ui
+VertxUIServer + StatsListener usage in dl4j-examples): attach a
+StatsListener to a network, open the browser at the printed address, and
+watch the score chart update live over Server-Sent Events while training
+runs. Trains TWO sessions with different learning rates and prints the
+compare-view URL that renders them side by side.
+
+Run: python examples/ui_monitoring.py [--steps N] [--port P]
+"""
+import argparse
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--port", type=int, default=9007)
+    ap.add_argument("--keep-serving", action="store_true",
+                    help="block at the end so the page stays browsable")
+    args = ap.parse_args()
+
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+    server = UIServer.get_instance(port=args.port)
+    storage = InMemoryStatsStorage()
+    server.attach(storage)
+    server.start()
+    print(f"UI at {server.get_address()}  (score chart updates over SSE "
+          f"at /train/stream)")
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 8)).astype(np.float32)
+    w_true = rng.normal(size=(8, 3)).astype(np.float32)
+    logits = x @ w_true
+    y = np.eye(3, dtype=np.float32)[logits.argmax(1)]
+
+    sids = []
+    for lr in (1e-2, 1e-3):
+        conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(lr))
+                .weight_init("xavier").list()
+                .layer(L.DenseLayer(n_in=8, n_out=32, activation="relu"))
+                .layer(L.OutputLayer(n_in=32, n_out=3, activation="softmax",
+                                     loss_function="negativeloglikelihood"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        sid = f"adam_lr{lr:g}"
+        net.setListeners(StatsListener(storage, session_id=sid))
+        for _ in range(args.steps):
+            net.fit(x, y)
+        sids.append(sid)
+        print(f"session {sid}: final score {float(net.score()):.4f}")
+
+    print(f"compare the runs: {server.get_address()}/train/compare"
+          f"?sids={','.join(sids)}")
+    if args.keep_serving:
+        print("serving — ctrl-c to exit")
+        import time
+        while True:
+            time.sleep(60)
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()
