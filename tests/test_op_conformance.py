@@ -1666,6 +1666,34 @@ case("ada_max_updater_ref", "ada_max_updater",
      out=(0, 1, 2), rtol=1e-5, atol=1e-7)
 
 
+def _lstm_block_cell_twin(x, h, c, w, b):
+    z = np.zeros((_RH,), F32)
+    t = tf.raw_ops.LSTMBlockCell(
+        x=x, cs_prev=c, h_prev=h, w=w, wci=z, wcf=z, wco=z, b=b,
+        forget_bias=1.0, use_peephole=False)
+    return [np.asarray(v) for v in (t.i, t.cs, t.f, t.o, t.ci, t.co, t.h)]
+
+
+# gate order i,c,f,o (TF LSTMBlockCell) — NOT lstm_cell's i,f,g,o
+case("lstm_block_cell_tf", "lstm_block_cell",
+     (_rx, _rh0, _rc0, _rw, _rb), {"forget_bias": 1.0},
+     _lstm_block_cell_twin, out=(0, 1, 2, 3, 4, 5, 6),
+     rtol=1e-4, atol=1e-4)
+case("self_adjoint_eig_values", "self_adjoint_eig",
+     ((lambda a: (a + a.T) / 2)(rng.normal(size=(5, 5)).astype(F32)),), {},
+     lambda s: np.linalg.eigvalsh(s).astype(F32), out=0,
+     rtol=1e-4, atol=1e-5)
+case("dynamic_bidirectional_rnn_keras", "dynamic_bidirectional_rnn",
+     (_rxs, _rh0, _rc0, _rw, _rb,
+      _rh0 * 0.5, _rc0 * 0.5, (_rw * 0.8).astype(F32),
+      (_rb * 0.8).astype(F32)),
+     {"cell": "lstm", "forget_bias": 0.0},
+     lambda x, hf, cf, wf, bf, hb, cb, wb, bb: [
+         _keras_lstm_layer_twin(x, hf, cf, wf, bf),
+         _keras_lstm_layer_twin(x[:, ::-1], hb, cb, wb, bb)[:, ::-1]],
+     out=(0, 1), rtol=1e-4, atol=1e-5)
+
+
 # ---- ONNX recurrent ops vs torch.nn with mapped weights -------------------
 # ONNX gate orders: LSTM i,o,f,c / GRU z,r,h; torch: LSTM i,f,g,o / GRU
 # r,z,n (torch GRU == linear_before_reset=1). Weights are drawn as ONNX-
@@ -1797,6 +1825,128 @@ case("onnx_lstm_bidir_torch", "onnx_lstm",
       np.concatenate([_olB, _olB2])),
      {"direction": "bidirectional"}, _torch_bilstm_twin, out=0,
      rtol=1e-5, atol=1e-5)
+# ---- registry tail: conv variants, NCHW twins, legacy activations ---------
+case("deconv2d_tf_kernel", "deconv2d",
+     (rng.normal(size=(1, 4, 4, 5)).astype(F32),
+      rng.normal(size=(3, 3, 2, 5)).astype(F32) * 0.3),
+     {"strides": (2, 2), "padding": "SAME", "transpose_kernel": True},
+     lambda x, w: _t(lambda a, f: tf.nn.conv2d_transpose(
+         a, f, [1, 8, 8, 2], [1, 2, 2, 1], "SAME"), x, w),
+     rtol=1e-4, atol=1e-5)
+case("pointwise_conv2d", "pointwise_conv2d",
+     (img, rng.normal(size=(1, 1, 3, 6)).astype(F32)), {},
+     lambda x, w: _t(tf.nn.conv2d, x, w, [1, 1, 1, 1], "VALID"),
+     rtol=1e-4, atol=1e-5)
+case("sconv2d", "sconv2d",
+     (img, dker, rng.normal(size=(1, 1, 6, 4)).astype(F32) * 0.3),
+     {"strides": (1, 1), "padding": "SAME"},
+     lambda x, dw, pw: _t(tf.nn.separable_conv2d, x, dw, pw,
+                          [1, 1, 1, 1], "SAME"),
+     rtol=1e-4, atol=1e-4)
+case("conv2d_nchw", "conv2d_nchw",
+     (rng.normal(size=(1, 3, 5, 5)).astype(F32),
+      rng.normal(size=(4, 3, 3, 3)).astype(F32) * 0.3),
+     {"strides": (1, 1), "padding": ((1, 1), (1, 1))},
+     lambda x, w: _t(lambda a, f: tf.transpose(tf.nn.conv2d(
+         tf.transpose(a, [0, 2, 3, 1]), tf.transpose(f, [2, 3, 1, 0]),
+         [1, 1, 1, 1], "SAME"), [0, 3, 1, 2]), x, w),
+     rtol=1e-4, atol=1e-5)
+case("batchnorm_nchw", "batchnorm_nchw",
+     (rng.normal(size=(2, 4, 3, 3)).astype(F32), xr4 * 0.5 + 1.0,
+      xr4 - 0.3, xr4, np.abs(xr4) + 0.2), {"epsilon": 1e-3},
+     lambda x, s, o, m, v: _t(lambda t: tf.transpose(
+         tf.nn.batch_normalization(tf.transpose(t, [0, 2, 3, 1]),
+                                   m, v, o, s, 1e-3), [0, 3, 1, 2]), x),
+     rtol=1e-4, atol=1e-5)
+case("global_avgpool_nchw", "global_avgpool_nchw",
+     (rng.normal(size=(2, 3, 4, 5)).astype(F32),), {},
+     lambda x: x.mean((2, 3), keepdims=True))
+case("global_maxpool_nchw", "global_maxpool_nchw",
+     (rng.normal(size=(2, 3, 4, 5)).astype(F32),), {},
+     lambda x: x.max((2, 3), keepdims=True))
+case("rationaltanh", "rationaltanh", (x34,), {},
+     lambda x: (1.7159 * np.tanh(2.0 * x / 3.0)).astype(F32),
+     rtol=1e-5, atol=1e-6)
+case("rationaltanh_derivative", "rationaltanh_derivative", (x34,), {},
+     lambda x: _tape(lambda t: 1.7159 * tf.tanh(2.0 * t / 3.0), x),
+     rtol=1e-4, atol=1e-5)
+case("rectifiedtanh", "rectifiedtanh",
+     (np.array([-1.5, -0.2, 0.4, 2.0], F32),), {},
+     lambda x: np.maximum(0.0, np.tanh(x)).astype(F32))
+case("rectifiedtanh_derivative", "rectifiedtanh_derivative",
+     (np.array([-1.5, -0.2, 0.4, 2.0], F32),), {},
+     lambda x: _tape(lambda t: tf.nn.relu(tf.tanh(t)), x),
+     rtol=1e-5, atol=1e-6)
+case("cosine_distance_ax", "cosine_distance", (x34, x34 * 0.5 + 0.1), {},
+     lambda a, b: (1.0 - np.sum(a * b, -1)
+                   / (np.linalg.norm(a, axis=-1)
+                      * np.linalg.norm(b, axis=-1))).astype(F32),
+     rtol=1e-5, atol=1e-6)
+case("cosinesim_full", "cosinesim", (x34, x34 * 2.0), {},
+     lambda a, b: np.float32(np.sum(a * b)
+                             / (np.linalg.norm(a) * np.linalg.norm(b))),
+     rtol=1e-5, atol=1e-6)
+case("hamming_distance_ext", "hamming_distance",
+     (np.array([1., 2., 3.], F32), np.array([1., 0., 3.], F32)), {},
+     lambda a, b: np.int64(1), dtype_strict=False)
+case("jaccard_distance_ax", "jaccard_distance",
+     (np.abs(x34) + 0.1, np.abs(x34[::-1]) + 0.1), {},
+     lambda a, b: (1.0 - np.minimum(a, b).sum(-1)
+                   / np.maximum(a, b).sum(-1)).astype(F32),
+     rtol=1e-5, atol=1e-6)
+case("flatten_2d", "flatten_2d",
+     (rng.normal(size=(2, 3, 4)).astype(F32),), {"axis": 1},
+     lambda x: x.reshape(2, 12))
+case("logdet_pd", "logdet",
+     (np.array([[4., 1.], [1., 3.]], F32),), {},
+     lambda x: np.linalg.slogdet(x)[1].astype(F32),
+     rtol=1e-5, atol=1e-6)
+_pdm = np.array([[4., 1.], [1., 3.]], F32)
+case("cholesky_solve", "cholesky_solve",
+     (np.linalg.cholesky(_pdm).astype(F32),
+      np.array([[1.], [2.]], F32)), {},
+     lambda L, b: np.linalg.solve(L @ L.T, b).astype(F32),
+     rtol=1e-4, atol=1e-5)
+case("log_entropy", "log_entropy", (np.array([0.2, 0.3, 0.5], F32),), {},
+     lambda p: np.log(-(p * np.log(p)).sum()).astype(F32),
+     rtol=1e-5, atol=1e-6)
+case("logentropy_legacy", "logentropy", (np.array([0.2, 0.3, 0.5], F32),),
+     {}, lambda p: np.log(-(p * np.log(p)).sum()).astype(F32),
+     rtol=1e-5, atol=1e-6)
+case("compare_and_set", "compare_and_set",
+     (np.array([1.0, 2.0, 1.0], F32), 1.0, 9.0), {"eps": 1e-6},
+     lambda x, c, s: np.where(np.abs(x - c) < 1e-6,
+                              np.float32(s), x).astype(F32))
+case("grs_to_rgb", "grs_to_rgb",
+     (rng.normal(size=(2, 3, 3, 1)).astype(F32),), {},
+     lambda x: np.broadcast_to(x, x.shape[:-1] + (3,)))
+case("static_bidirectional_rnn", "static_bidirectional_rnn",
+     (_rxs, _rh0, _rc0, _rw, _rb, _rh0 * 0.5, _rc0 * 0.5,
+      (_rw * 0.8).astype(F32), (_rb * 0.8).astype(F32)),
+     {"cell": "lstm", "forget_bias": 0.0},
+     lambda x, hf, cf, wf, bf, hb, cb, wb, bb: np.concatenate([
+         _keras_lstm_layer_twin(x, hf, cf, wf, bf),
+         _keras_lstm_layer_twin(x[:, ::-1], hb, cb, wb, bb)[:, ::-1]], -1),
+     out=0, rtol=1e-4, atol=1e-5)
+case("sru_bi", "sru_bi",
+     (_sx, _sc0, _sw, _sb, _sc0 * 0.5, (_sw * 0.8).astype(F32),
+      (_sb * 0.8).astype(F32)), {},
+     lambda x, cf, wf, bf, cb, wb, bb: np.concatenate([
+         _sru_ref(x, cf, wf, bf)[0],
+         _sru_ref(x[:, ::-1].copy(), cb, wb, bb)[0][:, ::-1]], -1),
+     out=0, rtol=1e-5, atol=1e-5)
+case("dot_product_attention", "dot_product_attention",
+     (rng.normal(size=(2, 2, 4, 8)).astype(F32),
+      rng.normal(size=(2, 2, 4, 8)).astype(F32),
+      rng.normal(size=(2, 2, 4, 8)).astype(F32)), {"scaled": True},
+     lambda q, k, v: (lambda s: (np.exp(s - s.max(-1, keepdims=True))
+                                 / np.exp(s - s.max(-1, keepdims=True))
+                                 .sum(-1, keepdims=True)) @ v)
+     (np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(8.0)).astype(F32),
+     rtol=1e-4, atol=1e-5)
+
+
+
 case("gelu_derivative", "gelu_derivative", (x34,), {},
      lambda x: _tape(tf.nn.gelu, x, approximate=True),
      rtol=1e-4, atol=1e-5)
@@ -1846,9 +1996,9 @@ def test_conformance_sweep_coverage_gate():
     swept = {c[1] for c in CASES}
     missing = swept - reg
     assert not missing, f"cases name unregistered ops: {sorted(missing)}"
-    assert len(swept) >= 450, (
+    assert len(swept) >= 470, (
         f"conformance sweep covers {len(swept)} registry ops; the gate "
-        f"floor is 450 — do not shrink the sweep")
+        f"floor is 470 — do not shrink the sweep")
 
 
 def test_ctc_loss_matches_tf():
