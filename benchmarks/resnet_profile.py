@@ -1,0 +1,86 @@
+"""Device-side op-level diff of our zoo ResNet-50 step vs the flax twin.
+
+The round-5 captures put ours at 0.895x flax (device-traced). This script
+hunts the missing 10%: one traced window per side, then the "XLA Ops"
+kernel aggregation per side, printed as (op, total_ms, count) tables plus
+the module-level step times. Run on a live TPU window only.
+
+Usage: python benchmarks/resnet_profile.py [--batch 32] [--iters 6]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def trace_side(label, window, match, top=30):
+    import statistics
+
+    import jax
+
+    from device_timing import module_times, op_times
+
+    logdir = tempfile.mkdtemp(prefix=f"rn_prof_{label}_")
+    with jax.profiler.trace(logdir):
+        window()
+    times = module_times(logdir)
+    step_ms = None
+    for base, durs in times.items():
+        if base.startswith(match):
+            step_ms = statistics.median(durs) * 1e3
+    rows = op_times(logdir, top=100000)
+    print(f"\n=== {label}: module {match} median {step_ms and round(step_ms,3)} ms ===")
+    total = sum(r[1] for r in rows)
+    for name, tot, cnt in rows[:top]:
+        print(f"  {tot*1e3:9.3f} ms  x{cnt:<4d} {name[:110]}")
+    print(f"  (ALL-op total {total*1e3:.1f} ms across the window, {len(rows)} distinct)")
+    # category sums: where does the step time live?
+    cats = {}
+    for name, tot, cnt in rows:
+        if "convolution" in name or "conv" in name.split("=")[0]:
+            c = "conv"
+        elif "select_and_scatter" in name:
+            c = "maxpool_bwd"
+        elif "reduce" in name:
+            c = "reduce_fusion"
+        elif "copy" in name:
+            c = "copy"
+        elif "fusion" in name:
+            c = "other_fusion"
+        else:
+            c = "other"
+        a = cats.setdefault(c, [0.0, 0])
+        a[0] += tot
+        a[1] += cnt
+    for c, (tot, cnt) in sorted(cats.items(), key=lambda kv: -kv[1][0]):
+        print(f"  [{c:>14}] {tot*1e3:9.2f} ms  x{cnt}")
+    import shutil
+    shutil.rmtree(logdir, ignore_errors=True)
+    return step_ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=6)
+    args = ap.parse_args()
+
+    from resnet_bench import measure_flax, measure_ours
+
+    img_hw, classes, dtype = (224, 224), 1000, "bfloat16"
+    ours = measure_ours(img_hw, classes, args.batch, args.iters, 0.1, dtype=dtype)
+    ours_ms = trace_side("ours", ours, "jit__train_step")
+    flax_w = measure_flax(img_hw, classes, args.batch, args.iters, 0.1, dtype=dtype)
+    flax_ms = trace_side("flax", flax_w, "jit_step")
+    if ours_ms and flax_ms:
+        print(f"\nstep ms: ours {ours_ms:.3f} vs flax {flax_ms:.3f} "
+              f"-> ratio {flax_ms/ours_ms:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.ops.moments import one_pass_moments
 from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS,
                                               MODEL_AXIS, SEQ_AXIS,
                                               STAGE_AXIS)
@@ -275,8 +276,7 @@ class TransformerLM:
     def _ln(self, p, x):
         # layernorm statistics in f32 regardless of compute dtype
         xf = x.astype(jnp.float32)
-        mu = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.var(xf, axis=-1, keepdims=True)
+        mu, var = one_pass_moments(xf, -1, keepdims=True)
         y = (xf - mu) * lax.rsqrt(var + 1e-5)
         y = y * p["g"].astype(jnp.float32) + p["b"].astype(jnp.float32)
         return y.astype(x.dtype)

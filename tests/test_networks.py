@@ -7,6 +7,7 @@ wrapper-layer serialization, ComputationGraph save/load, mask plumbing.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.configuration import (
@@ -721,3 +722,45 @@ def test_depthwise_conv_rejects_inconsistent_n_out():
     ok = DepthwiseConvolution2D(kernel_size=(3, 3), depth_multiplier=2)
     ok.set_n_in(InputType.convolutional(8, 8, 2))
     assert ok.n_out == 4
+
+
+def test_one_pass_moments_clamp_and_parity():
+    """ops/moments.one_pass_moments: parity with jnp.var where stable, and
+    the var>=0 clamp under the f32 catastrophic-cancellation regime that
+    the one-pass E[x^2]-E[x]^2 form is exposed to (large |mean| vs tiny
+    std) — a negative variance would NaN every rsqrt(var+eps) downstream."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.moments import one_pass_moments
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(2.0, 3.0, (64, 32)).astype(np.float32))
+    mean, var = one_pass_moments(x, 0)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(jnp.mean(x, 0)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(jnp.var(x, 0)),
+                               rtol=1e-4, atol=1e-5)
+    # cancellation regime: mean ~3e3, std ~1e-3 -> E[x^2]-mean^2 underflows
+    # f32 and can go negative; the clamp must keep it >= 0 (finite rsqrt)
+    bad = jnp.asarray(
+        (3000.0 + rng.normal(0, 1e-3, (256,))).astype(np.float32))
+    _, v = one_pass_moments(bad, 0)
+    assert float(v) >= 0.0
+    assert np.isfinite(float(jax.lax.rsqrt(v + 1e-5)))
+
+
+def test_batchnorm_layer_survives_large_mean_activations():
+    """BatchNormalization.apply with offset-heavy inputs: running var stays
+    >= 0 and the normalized output is finite (regression for the one-pass
+    moments change)."""
+    from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+
+    bn = BatchNormalization()
+    bn.n_out = 4
+    params = bn.init_params(jax.random.key(0))
+    state = bn.init_state()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        (1500.0 + rng.normal(0, 1e-3, (32, 4))).astype(np.float32))
+    out, new_state = bn.apply(params, x, training=True, state=state)
+    assert np.all(np.isfinite(np.asarray(out)))
+    assert np.all(np.asarray(new_state["var"]) >= 0.0)
