@@ -42,15 +42,19 @@ def trace_side(label, window, match, top=30):
     # category sums: where does the step time live?
     cats = {}
     for name, tot, cnt in rows:
-        if "convolution" in name or "conv" in name.split("=")[0]:
+        # classify by the RESULT name only (before '='): matching the whole
+        # HLO line binned every convert_reduce fusion reading a convolution
+        # operand as "conv" and zeroed the reduce bucket (review finding)
+        head = name.split("=")[0].lstrip("%")
+        if head.startswith("convolution") or head.startswith("conv_"):
             c = "conv"
-        elif "select_and_scatter" in name:
+        elif "select_and_scatter" in head:
             c = "maxpool_bwd"
-        elif "reduce" in name:
+        elif "reduce" in head:
             c = "reduce_fusion"
-        elif "copy" in name:
+        elif head.startswith("copy"):
             c = "copy"
-        elif "fusion" in name:
+        elif "fusion" in head:
             c = "other_fusion"
         else:
             c = "other"

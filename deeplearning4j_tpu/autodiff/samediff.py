@@ -1081,11 +1081,12 @@ class SameDiff:
         self._train_step = None
 
     # ---- emission (the AbstractSession topo-walk → HLO emitter) --------
-    def _needed_ops(self, outputs: Sequence[str]) -> List[OpNode]:
+    def _needed_ops(self, outputs: Sequence[str],
+                    ops: Optional[List[OpNode]] = None) -> List[OpNode]:
         """Ops needed to compute `outputs`, in graph order."""
         needed_vars = set(outputs)
         needed_ops: List[OpNode] = []
-        for op in reversed(self._ops):
+        for op in reversed(self._ops if ops is None else ops):
             if any(o in needed_vars for o in op.outputs):
                 needed_ops.append(op)
                 needed_vars.update(op.inputs)
@@ -1097,7 +1098,11 @@ class SameDiff:
         One pass over the (pruned) op list in insertion order — insertion
         order is topological by construction in a define-then-run builder.
         """
-        ops = self._needed_ops(outputs)
+        # emission-time peepholes (autodiff/passes): rewrites run on a
+        # copy — the stored graph/serialization is untouched. Pruning
+        # happens AFTER the rewrite so orphaned motif remnants drop out.
+        from deeplearning4j_tpu.autodiff.passes import optimize_for_emission
+        ops = self._needed_ops(outputs, optimize_for_emission(self._ops))
 
         def fn(values: Dict[str, jnp.ndarray],
                placeholders: Dict[str, jnp.ndarray],

@@ -29,6 +29,14 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def one_pass_variance(x, mean, axes, keepdims: bool = False):
+    """``max(E[x^2] - mean^2, 0)`` given an already-computed ``mean`` over
+    the same reduction — the single home of the clamp-against-cancellation
+    decision (also used by the emission peephole in autodiff/passes)."""
+    ex2 = jnp.mean(jnp.square(x), axis=axes, keepdims=keepdims)
+    return jnp.maximum(ex2 - jnp.square(mean), 0)
+
+
 def one_pass_moments(xf, axes, keepdims: bool = False):
     """Return ``(mean, var)`` over ``axes`` in ``xf``'s dtype.
 
@@ -36,6 +44,4 @@ def one_pass_moments(xf, axes, keepdims: bool = False):
     lose too much in the squares otherwise). ``var`` is clamped to ``>= 0``.
     """
     mean = jnp.mean(xf, axis=axes, keepdims=keepdims)
-    var = jnp.mean(jnp.square(xf), axis=axes, keepdims=keepdims) \
-        - jnp.square(mean)
-    return mean, jnp.maximum(var, 0)
+    return mean, one_pass_variance(xf, mean, axes, keepdims)

@@ -412,3 +412,157 @@ def test_namespace_registry_fallthrough():
         out = out[0] if isinstance(out, tuple) else out
         vals = sd.output({}, out.name)[out.name]
         assert np.isfinite(np.asarray(vals)).all(), (ns, op)
+
+
+class TestEmissionPeepholes:
+    """autodiff/passes: the two-pass-variance motif rewrite (GraphOptimizer
+    analog). The stored graph must be untouched; values AND training
+    gradients must match the unoptimized emission exactly (the rewrite is
+    gradient-equivalent by construction — see the module docstring)."""
+
+    def _moments_graph(self):
+        """The literal motif a frozen tf.nn.moments/LayerNorm produces:
+        Mean -> SquaredDifference(x, StopGradient(mean)) -> Mean."""
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 8))
+        m = sd._op("Mean", x, axis=(1,), keepdims=True)
+        sg = sd._op("Identity", m)               # StopGradient import form
+        sq = sd._op("SquaredDifference", x, sg)
+        v = sd._op("Mean", sq, axis=(1,), keepdims=True).rename("var")
+        return sd, v
+
+    def test_motif_rewrite_matches_two_pass_value(self):
+        from deeplearning4j_tpu.autodiff.passes import fuse_two_pass_moments
+
+        sd, _ = self._moments_graph()
+        rewritten, n = fuse_two_pass_moments(sd.ops())
+        assert n == 1
+        assert any(op.op_name == "one_pass_variance" for op in rewritten)
+        # stored graph untouched (serialization sees the original motif)
+        assert all(op.op_name != "one_pass_variance" for op in sd.ops())
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(3.0, 2.0, (4, 8)).astype(np.float32)
+        got = np.asarray(sd.output({"x": X}, "var")["var"])
+        want = np.var(X, axis=1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_rewrite_off_switch_and_value_parity(self, monkeypatch):
+        rng = np.random.default_rng(1)
+        X = rng.normal(-2.0, 0.5, (4, 8)).astype(np.float32)
+
+        sd, _ = self._moments_graph()
+        on = np.asarray(sd.output({"x": X}, "var")["var"])
+        monkeypatch.setenv("DL4J_TPU_GRAPH_OPT", "0")
+        sd2, _ = self._moments_graph()
+        off = np.asarray(sd2.output({"x": X}, "var")["var"])
+        np.testing.assert_allclose(on, off, rtol=1e-5, atol=1e-6)
+
+    def test_training_gradients_match_unoptimized(self, monkeypatch):
+        """Fine-tune THROUGH the motif (layernorm-style normalization a la
+        the imported-BERT hot path): per-step losses with the peephole on
+        must track the peephole-off run to f32 noise."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.optim.updaters import Sgd
+
+        def build():
+            sd = SameDiff.create()
+            x = sd.placeholder("x", (8, 6))
+            w = sd.var("w", init=np.eye(6, dtype=np.float32))
+            h = x.mmul(w)
+            m = sd._op("Mean", h, axis=(1,), keepdims=True)
+            sg = sd._op("Identity", m)
+            sq = sd._op("SquaredDifference", h, sg)
+            v = sd._op("Mean", sq, axis=(1,), keepdims=True)
+            inv = sd._op("rsqrt", v + sd.constant(np.float32(1e-5)))
+            yhat = (h - m) * inv
+            yph = sd.placeholder("y", (8, 6))
+            sd.loss.mse(yph, yhat).rename("loss")
+            sd.set_loss_variables("loss")
+            sd.set_training_config(TrainingConfig(
+                updater=Sgd(0.05),
+                data_set_feature_mapping=["x"],
+                data_set_label_mapping=["y"]))
+            return sd
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(1.0, 1.0, (8, 6)).astype(np.float32)
+        Y = rng.normal(0.0, 1.0, (8, 6)).astype(np.float32)
+        data = [DataSet(X, Y)] * 6
+
+        hist_on = build().fit(data, epochs=2)
+        monkeypatch.setenv("DL4J_TPU_GRAPH_OPT", "0")
+        hist_off = build().fit(data, epochs=2)
+        np.testing.assert_allclose(hist_on.loss_curve(),
+                                   hist_off.loss_curve(),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_tf_imported_moments_rewrites_and_matches(self):
+        """Live-TF e2e: a frozen graph using tf.nn.moments imports and the
+        emitted program matches TF's own output (the BERT-layernorm path)."""
+        tf = pytest.importorskip("tensorflow")
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+        from deeplearning4j_tpu.autodiff.passes import fuse_two_pass_moments
+        from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
+
+        @tf.function
+        def f(x):
+            m, v = tf.nn.moments(x, axes=[-1], keepdims=True)
+            return (x - m) * tf.math.rsqrt(v + 1e-5)
+
+        frozen = convert_variables_to_constants_v2(
+            f.get_concrete_function(tf.TensorSpec((3, 16), tf.float32)))
+        gd = frozen.graph.as_graph_def()
+
+        sd = TFGraphMapper.import_graph(gd)
+        _, n = fuse_two_pass_moments(sd.ops())
+        assert n == 1, "imported tf.nn.moments motif must match the pass"
+
+        rng = np.random.default_rng(3)
+        # zero-mean data: tight parity (the one-pass form's cancellation
+        # error scales with (mean/std)^2 * 2^-23 — at mean 5/std 0.3 the
+        # delta vs TF is ~8e-5, still well inside training noise)
+        X = rng.normal(0.0, 1.0, (3, 16)).astype(np.float32)
+        want = f(tf.constant(X)).numpy()
+        got = np.asarray(list(sd.output({"x": X}).values())[0])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        Xoff = rng.normal(5.0, 0.3, (3, 16)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(list(sd.output({"x": Xoff}).values())[0]),
+            f(tf.constant(Xoff)).numpy(), rtol=5e-3, atol=5e-4)
+
+    def test_native_stop_gradient_motif_fuses_mean_side_only(self):
+        """A native stop_gradient on the MEAN side must still fuse (the
+        gradient-equivalent transform); one on the ACTIVATION side must
+        block the rewrite (fusing there would change gradients)."""
+        from deeplearning4j_tpu.autodiff.passes import fuse_two_pass_moments
+
+        def graph(sg_on_x):
+            sd = SameDiff.create()
+            x = sd.placeholder("x", (4, 8))
+            m = sd._op("Mean", x, axis=(1,), keepdims=True)
+            msg = sd._op("stop_gradient", m)
+            xs = sd._op("stop_gradient", x) if sg_on_x else x
+            sq = sd._op("SquaredDifference", xs, msg)
+            sd._op("Mean", sq, axis=(1,), keepdims=True).rename("var")
+            return sd
+
+        _, n_mean_side = fuse_two_pass_moments(graph(False).ops())
+        assert n_mean_side == 1
+        _, n_x_side = fuse_two_pass_moments(graph(True).ops())
+        assert n_x_side == 0
+
+    def test_keep_dims_attr_spelling_fuses_and_runs(self):
+        """reduce_mean accepts keep_dims= too; the rewritten node's copied
+        attrs must execute (review regression: TypeError at emission)."""
+        sd = SameDiff.create()
+        x = sd.placeholder("x", (4, 8))
+        m = sd._op("Mean", x, axis=(1,), keep_dims=True)
+        sq = sd._op("SquaredDifference", x, m)
+        sd._op("Mean", sq, axis=(1,), keep_dims=True).rename("var")
+        rng = np.random.default_rng(5)
+        X = rng.normal(0, 1, (4, 8)).astype(np.float32)
+        got = np.asarray(sd.output({"x": X})["var"])
+        np.testing.assert_allclose(got, np.var(X, 1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
