@@ -51,7 +51,9 @@ def one_pass_variance(x, mean, axis=None, keepdims=False, keep_dims=None):
         one_pass_variance as _opv)
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
     kd = keepdims if keep_dims is None else keep_dims
-    return _opv(x, mean, ax, bool(kd))
+    # cast back: the Mean node this replaces produced x.dtype (f32 inside
+    # still defeats bf16 cancellation)
+    return _opv(x, mean, ax, bool(kd)).astype(x.dtype)
 
 
 def _canon(name: str) -> str:

@@ -105,9 +105,11 @@ def _shannon_entropy(p, axis=None):
 
 @register("moments", num_outputs=2, aliases=["Moments"])
 def _moments(x, axes=None, keepdims=False):
+    # tf.nn.moments computes half-precision stats in f32 then casts back
+    from deeplearning4j_tpu.ops.moments import one_pass_moments
     axes = tuple(axes) if axes is not None else None
-    return (jnp.mean(x, axis=axes, keepdims=keepdims),
-            jnp.var(x, axis=axes, keepdims=keepdims))
+    mean, var = one_pass_moments(x, axes, keepdims=keepdims)
+    return mean.astype(x.dtype), var.astype(x.dtype)
 
 
 @register("normalize_moments", num_outputs=2, aliases=["NormalizeMoments"])

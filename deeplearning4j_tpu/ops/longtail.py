@@ -679,15 +679,15 @@ def fused_batch_norm(x, scale, offset, mean=None, variance=None,
     the returned batch_var is Bessel-corrected (N/(N-1)) — what TF feeds
     the moving-variance update."""
     if is_training or mean is None:
+        from deeplearning4j_tpu.ops.moments import one_pass_moments
         n = float(np.prod([x.shape[i] for i in (0, 1, 2)]))
-        mean = jnp.mean(x, axis=(0, 1, 2))
-        variance = jnp.var(x, axis=(0, 1, 2))
+        mean, variance = one_pass_moments(x, (0, 1, 2))
         var_out = variance * (n / max(n - 1.0, 1.0))
     else:
         var_out = variance
     inv = lax.rsqrt(variance + epsilon)
     y = (x - mean) * inv * scale + offset
-    return y, mean, var_out
+    return y.astype(x.dtype), mean, var_out
 
 
 @register("histogram", aliases=["Histogram"])
@@ -941,10 +941,10 @@ def group_norm(x, scale, bias, num_groups, epsilon=1e-5):
     n, c = x.shape[0], x.shape[1]
     g = int(num_groups)
     xg = x.reshape(n, g, c // g, *x.shape[2:])
+    from deeplearning4j_tpu.ops.moments import one_pass_moments
     axes = tuple(range(2, xg.ndim))
-    mu = jnp.mean(xg, axis=axes, keepdims=True)
-    var = jnp.var(xg, axis=axes, keepdims=True)
-    xn = ((xg - mu) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    mu, var = one_pass_moments(xg, axes, keepdims=True)   # stats >= f32
+    xn = ((xg - mu) * lax.rsqrt(var + epsilon)).reshape(x.shape).astype(x.dtype)
     shape = (1, c) + (1,) * (x.ndim - 2)
     return xn * scale.reshape(shape) + bias.reshape(shape)
 

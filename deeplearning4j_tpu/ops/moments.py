@@ -32,16 +32,23 @@ import jax.numpy as jnp
 def one_pass_variance(x, mean, axes, keepdims: bool = False):
     """``max(E[x^2] - mean^2, 0)`` given an already-computed ``mean`` over
     the same reduction — the single home of the clamp-against-cancellation
-    decision (also used by the emission peephole in autodiff/passes)."""
-    ex2 = jnp.mean(jnp.square(x), axis=axes, keepdims=keepdims)
-    return jnp.maximum(ex2 - jnp.square(mean), 0)
+    decision (also used by the emission peephole in autodiff/passes).
+
+    Accumulates in >= f32 regardless of input dtype and returns the
+    accumulation dtype: in bf16 the squares cancel totally at modest
+    offsets (mean 30/std 0.5 -> variance exactly 0 after the clamp, vs
+    0.25 true), and TF itself computes half-precision norm statistics in
+    f32. Callers that need the input dtype back cast at their boundary.
+    """
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    ex2 = jnp.mean(jnp.square(x.astype(acc)), axis=axes, keepdims=keepdims)
+    return jnp.maximum(ex2 - jnp.square(mean.astype(acc)), 0)
 
 
 def one_pass_moments(xf, axes, keepdims: bool = False):
-    """Return ``(mean, var)`` over ``axes`` in ``xf``'s dtype.
-
-    Accumulate in >= f32: callers cast ``xf`` before the call (bf16 inputs
-    lose too much in the squares otherwise). ``var`` is clamped to ``>= 0``.
-    """
+    """Return ``(mean, var)`` over ``axes`` in the >=f32 accumulation
+    dtype (see ``one_pass_variance``). ``var`` is clamped to ``>= 0``."""
+    acc = jnp.promote_types(xf.dtype, jnp.float32)
+    xf = xf.astype(acc)
     mean = jnp.mean(xf, axis=axes, keepdims=keepdims)
     return mean, one_pass_variance(xf, mean, axes, keepdims)

@@ -513,14 +513,14 @@ def batchnorm(x, mean, variance, gamma=None, beta=None, epsilon=1e-5, axis=-1):
 
 @register("layer_norm", aliases=["LayerNorm"])
 def layer_norm(x, gamma=None, beta=None, axis=-1, epsilon=1e-5):
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
+    from deeplearning4j_tpu.ops.moments import one_pass_moments
+    mean, var = one_pass_moments(x, axis, keepdims=True)   # stats >= f32
     out = (x - mean) * lax.rsqrt(var + epsilon)
     if gamma is not None:
         out = out * gamma
     if beta is not None:
         out = out + beta
-    return out
+    return out.astype(x.dtype)
 
 
 @register("lrn", aliases=["LRN"])

@@ -364,3 +364,29 @@ class TestSpectralAndLinalgTranche:
         np.testing.assert_allclose(
             float(exec_op("norm", a, ord="fro")),
             float(np.linalg.norm(np.asarray(a))), rtol=1e-6)
+
+
+def test_norm_op_stats_survive_bf16_offset_inputs():
+    """One-pass moments must accumulate in f32 for half inputs: bf16
+    activations at mean 30/std 0.5 cancel to variance 0 in bf16 (vs 0.25
+    true) — stats f32-accumulated, outputs back in the op's input dtype
+    (TF half-precision norm semantics)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.registry import exec_op
+
+    rng = np.random.default_rng(0)
+    base = rng.normal(30.0, 0.5, (32, 24)).astype(np.float32)
+    xb = jnp.asarray(base, jnp.bfloat16)
+    true_var = float(np.var(np.asarray(xb, np.float32), axis=None))
+
+    m, v = exec_op("moments", xb, axes=(0, 1))
+    assert m.dtype == jnp.bfloat16 and v.dtype == jnp.bfloat16
+    assert abs(float(v) - true_var) / true_var < 0.05, (float(v), true_var)
+
+    y = exec_op("layer_norm", xb, jnp.ones((24,), jnp.bfloat16),
+                jnp.zeros((24,), jnp.bfloat16))
+    assert y.dtype == jnp.bfloat16
+    yf = np.asarray(y, np.float32)
+    # a collapsed variance would blow the normalized scale up ~sqrt(1/eps)
+    assert np.abs(yf).max() < 10.0, np.abs(yf).max()
