@@ -1,0 +1,42 @@
+"""Device-side op-level diff of the TF-imported BERT step vs FlaxBert.
+
+Same methodology as resnet_profile.py: one traced window per side,
+module-level step time + per-op and per-prefix aggregation. Hunts the
+residual imported-graph vs flax gap after the two-pass-variance peephole.
+
+Run on a live TPU window: python benchmarks/bert_profile.py [--iters 4]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from resnet_profile import trace_side  # noqa: E402 — shared tracer
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=4)
+    args = ap.parse_args()
+
+    from bert_bench import build_frozen_bert, measure_flax, measure_ours
+
+    batch, seq, layers, hidden, heads, inter, vocab = \
+        32, 128, 12, 768, 12, 3072, 30522
+    gd = build_frozen_bert(batch, seq, layers, hidden, heads, inter, vocab)
+    ours = measure_ours(gd, hidden, batch, seq, vocab, args.iters, 2e-5)
+    ours_ms = trace_side("ours", ours, "jit__train", top=25)
+    flax_w = measure_flax(batch, seq, layers, hidden, heads, inter, vocab,
+                          args.iters, 2e-5)
+    flax_ms = trace_side("flax", flax_w, "jit_flax_step", top=25)
+    if ours_ms and flax_ms:
+        print(f"\nstep ms: ours {ours_ms:.3f} vs flax {flax_ms:.3f} "
+              f"-> ratio {flax_ms/ours_ms:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

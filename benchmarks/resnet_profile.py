@@ -63,6 +63,18 @@ def trace_side(label, window, match, top=30):
         a[1] += cnt
     for c, (tot, cnt) in sorted(cats.items(), key=lambda kv: -kv[1][0]):
         print(f"  [{c:>14}] {tot*1e3:9.2f} ms  x{cnt}")
+    # prefix histogram inside the noisy buckets: the op-count diff between
+    # sides lives in thousands of tiny kernels, not the top-30
+    pref = {}
+    for name, tot, cnt in rows:
+        head = name.split("=")[0].lstrip("%").strip()
+        base = head.split(".")[0]
+        a = pref.setdefault(base, [0.0, 0])
+        a[0] += tot
+        a[1] += cnt
+    print("  -- by op prefix (top 25 by time) --")
+    for b, (tot, cnt) in sorted(pref.items(), key=lambda kv: -kv[1][0])[:25]:
+        print(f"  {tot*1e3:9.2f} ms x{cnt:<6d} {b}")
     import shutil
     shutil.rmtree(logdir, ignore_errors=True)
     return step_ms

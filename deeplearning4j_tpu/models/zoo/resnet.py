@@ -35,8 +35,15 @@ class ResNet50(ZooModel):
     # ----- blocks (ref: ResNet50#convBlock / #identityBlock)
     def _conv_bn_act(self, g, name, inp, n_out, kernel, stride=(1, 1),
                      padding=(0, 0), act=True):
+        # hasBias=false on every conv that feeds a BatchNormalization: BN
+        # re-centers, so the bias is mathematically redundant — and its
+        # gradient is a full-activation reduction per conv (53 of them)
+        # that the original ResNet design (and the flax/torchvision
+        # twins) never pays. The reference builder exposes the same knob
+        # (ConvolutionLayer.Builder#hasBias).
         g.add_layer(name, ConvolutionLayer(kernel_size=kernel, stride=stride,
                                            padding=padding, n_out=n_out,
+                                           has_bias=False,
                                            activation="identity"), inp)
         g.add_layer(name + "_bn", BatchNormalization(), name)
         if act:
