@@ -46,8 +46,8 @@ def main():
     import jax
 
     if platform is None or platform == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices or 8)
+        from deeplearning4j_tpu.utils import force_cpu_devices
+        force_cpu_devices(args.devices or 8)
 
     import jax.numpy as jnp
     import numpy as np

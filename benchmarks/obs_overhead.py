@@ -1,0 +1,93 @@
+"""Instrumentation overhead guard (observability PR acceptance tool).
+
+Measures the lenet train step with the observability substrate enabled
+(default) vs disabled (``DL4J_TPU_METRICS=0``) and prints the overhead %.
+The acceptance bar is <5% on CPU; future PRs adding instrumentation points
+run this to keep the cost honest.
+
+Each mode runs in a fresh subprocess: the kill switch is applied at
+instrument creation, so flipping it in-process after modules warmed up
+would measure the wrong thing.
+
+Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_WORKER = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.data.dataset import DataSet
+
+steps = int(sys.argv[1])
+batch = int(sys.argv[2])
+
+net = zoo.LeNet().init_model()
+rng = np.random.RandomState(0)
+x = rng.rand(batch, 28 * 28).astype("f4")
+y = np.eye(10, dtype="f4")[rng.randint(0, 10, batch)]
+ds = DataSet(x, y)
+
+net.fit(ds)                       # compile + warm caches outside the window
+net.fit(ds)
+
+t0 = time.perf_counter()
+for _ in range(steps):
+    net.fit(ds)
+wall = time.perf_counter() - t0
+print(json.dumps({"seconds_per_step": wall / steps,
+                  "metrics": os.environ.get("DL4J_TPU_METRICS", "1")}))
+"""
+
+
+def _run(steps: int, batch: int, metrics: str) -> float:
+    env = dict(os.environ, DL4J_TPU_METRICS=metrics)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _WORKER, str(steps), str(batch)],
+        capture_output=True, text=True, env=env, check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])["seconds_per_step"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="interleaved A/B process pairs; min per mode wins")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    # interleaved A/B pairs with a min-estimator: a lone pair is dominated
+    # by host warmup noise (the first subprocess routinely runs 1.5x slower
+    # than steady state regardless of mode)
+    offs, ons = [], []
+    for _ in range(args.repeats):
+        offs.append(_run(args.steps, args.batch, "0"))
+        ons.append(_run(args.steps, args.batch, "1"))
+    off, on = min(offs), min(ons)
+    overhead = (on - off) / off * 100.0
+    result = {"lenet_step_seconds_uninstrumented": off,
+              "lenet_step_seconds_instrumented": on,
+              "overhead_percent": overhead,
+              "steps": args.steps, "batch": args.batch}
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"lenet step, batch={args.batch}, {args.steps} steps/mode")
+        print(f"  uninstrumented (DL4J_TPU_METRICS=0): {off * 1e3:8.3f} ms")
+        print(f"  instrumented   (default):            {on * 1e3:8.3f} ms")
+        print(f"  overhead: {overhead:+.2f}%  (acceptance bar: < 5%)")
+    return overhead
+
+
+if __name__ == "__main__":
+    main()

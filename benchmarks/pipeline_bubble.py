@@ -37,9 +37,8 @@ def main():
     args = ap.parse_args()
 
     if not args.tpu:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", max(8, args.stages))
+        from deeplearning4j_tpu.utils import force_cpu_devices
+        force_cpu_devices(max(8, args.stages))
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -165,9 +165,8 @@ def main():
         return
 
     if not args.tpu:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.devices)
+        from deeplearning4j_tpu.utils import force_cpu_devices
+        force_cpu_devices(args.devices)
     import jax
     import jax.numpy as jnp
     import numpy as np

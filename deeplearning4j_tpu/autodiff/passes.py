@@ -136,10 +136,18 @@ def fuse_two_pass_moments(ops: List) -> Tuple[List, int]:
     return out, n
 
 
+def graph_opt_enabled() -> bool:
+    """Live value of the ``DL4J_TPU_GRAPH_OPT`` kill switch. Callers that
+    cache emitted/jitted functions MUST fold this into their cache key —
+    otherwise flipping the flag mid-session silently serves programs built
+    under the previous setting."""
+    return os.environ.get("DL4J_TPU_GRAPH_OPT", "1") != "0"
+
+
 def optimize_for_emission(ops: List) -> List:
     """All enabled peepholes, in order. Disable with
     ``DL4J_TPU_GRAPH_OPT=0`` (config/flags surface, SURVEY §5.6)."""
-    if os.environ.get("DL4J_TPU_GRAPH_OPT", "1") == "0":
+    if not graph_opt_enabled():
         return ops
     ops, _ = fuse_two_pass_moments(ops)
     return ops

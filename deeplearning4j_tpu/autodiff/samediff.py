@@ -1217,7 +1217,11 @@ class SameDiff:
                    if p not in ph and p in needed_inputs]
         if missing:
             raise ValueError(f"missing placeholders: {missing}")
-        key = (tuple(outputs),
+        # the graph-opt flag is part of the key: toggling it mid-session
+        # must re-emit, not silently reuse programs built under the other
+        # setting (the peepholes run at emission time)
+        from deeplearning4j_tpu.autodiff.passes import graph_opt_enabled
+        key = (tuple(outputs), graph_opt_enabled(),
                tuple(sorted((k, v.shape, str(v.dtype)) for k, v in ph.items())))
         if key not in self._compiled_cache:
             emitted = self._emit(outputs)
@@ -1411,7 +1415,12 @@ class SameDiff:
         # once per fit() call, not per batch — the graph cannot change
         # mid-loop, and json.dumps of the config per step is measurable
         # host overhead on large imported graphs (BERT-base: ~600 values)
+        # the graph-opt flag rides in the signature for the same reason it
+        # rides in the output cache key: the train step is emitted through
+        # the same peephole pass
+        from deeplearning4j_tpu.autodiff.passes import graph_opt_enabled
         sig = (tuple(trainable), tuple(self._loss_variables),
+               graph_opt_enabled(),
                json.dumps(tc.to_dict(), sort_keys=True, default=str))
         if self._train_step is None or self._train_sig != sig:
             # a placement-only rebuild (set_mesh with unchanged graph sig)

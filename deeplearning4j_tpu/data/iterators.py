@@ -7,11 +7,35 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.observability import global_registry, on_registry_reset
+
+_obs_cache: dict = {}
+
+
+def _data_obs(kind: str):
+    """(batches counter, wait histogram) label-bound per iterator class."""
+    handles = _obs_cache.get(kind)
+    if handles is None:
+        reg = global_registry()
+        handles = _obs_cache[kind] = (
+            reg.counter("dl4j_data_batches_total",
+                        "minibatches produced by data iterators",
+                        label_names=("iterator",)).labels(iterator=kind),
+            reg.histogram("dl4j_data_wait_seconds",
+                          "host time blocked waiting on the data pipeline",
+                          label_names=("iterator",)).labels(iterator=kind))
+    return handles
+
+
+@on_registry_reset
+def _drop_data_obs():
+    _obs_cache.clear()
 
 
 class DataSetIterator:
@@ -24,7 +48,9 @@ class DataSetIterator:
     def __next__(self) -> DataSet:
         if not self.has_next():
             raise StopIteration
-        return self.next()
+        ds = self.next()
+        _data_obs(type(self).__name__)[0].inc()
+        return ds
 
     def has_next(self) -> bool:
         raise NotImplementedError
@@ -137,7 +163,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self._advance()
 
     def _advance(self):
+        # queue.get blocking time IS the pipeline stall the prefetch thread
+        # exists to hide — export it so a starved trainer is diagnosable
+        t0 = time.perf_counter()
         item = self._queue.get()
+        _data_obs(type(self).__name__)[1].observe(time.perf_counter() - t0)
         self._next_item = None if item is self._SENTINEL else item
 
     def has_next(self) -> bool:

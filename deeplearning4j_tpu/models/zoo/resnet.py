@@ -40,7 +40,9 @@ class ResNet50(ZooModel):
         # gradient is a full-activation reduction per conv (53 of them)
         # that the original ResNet design (and the flax/torchvision
         # twins) never pays. The reference builder exposes the same knob
-        # (ConvolutionLayer.Builder#hasBias).
+        # (ConvolutionLayer.Builder#hasBias). Checkpoints saved before
+        # this switch carry orphaned conv ``b`` arrays — ModelSerializer
+        # restores them tolerantly (warn + skip, never a shape mismatch).
         g.add_layer(name, ConvolutionLayer(kernel_size=kernel, stride=stride,
                                            padding=padding, n_out=n_out,
                                            has_bias=False,

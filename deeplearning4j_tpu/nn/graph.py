@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import functools
 import inspect as _inspect
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -18,6 +19,8 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.multilayer import _grad_transform
@@ -279,20 +282,30 @@ class ComputationGraph:
                                 _ds_masks(data, "features"),
                                 _ds_masks(data, "labels"))
             return self
+        # iterator protocol — pulling the next batch is timed as the
+        # step's data_wait phase (observability step-time decomposition)
         for _ in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
             if hasattr(data, "reset"):
                 data.reset()
-            for ds in data:
+            it = iter(data)
+            while True:
+                t0 = time.perf_counter()
+                with _span("data_wait", model="ComputationGraph"):
+                    ds = next(it, None)
+                if ds is None:
+                    break
                 self._fit_batch(_as_tuple(ds.features), _as_tuple(ds.labels),
-                                _ds_masks(ds, "features"), _ds_masks(ds, "labels"))
+                                _ds_masks(ds, "features"), _ds_masks(ds, "labels"),
+                                data_wait=time.perf_counter() - t0)
             for lst in self._listeners:
                 lst.on_epoch_end(self, self._epoch)
             self._epoch += 1
+            _tm.for_model(self).epochs.inc()
         return self
 
-    def _fit_batch(self, inputs, labels, fmasks=(), lmasks=()):
+    def _fit_batch(self, inputs, labels, fmasks=(), lmasks=(), data_wait=None):
         if not self._initialized:
             self.init()
         inputs = tuple(jnp.asarray(_unwrap(x)) for x in inputs)
@@ -301,18 +314,29 @@ class ComputationGraph:
         lmasks = tuple(jnp.asarray(_unwrap(m)) for m in lmasks if m is not None) or None
         if (getattr(self.conf, "backprop_type", "standard") == "tbptt"
                 and any(x.ndim == 3 for x in inputs)):
-            self._fit_tbptt(inputs, labels, fmasks, lmasks)
+            self._fit_tbptt(inputs, labels, fmasks, lmasks,
+                            data_wait=data_wait)
             return
-        self._key, rng = jax.random.split(self._key)
-        self._params, self._opt_state, self._states, loss, _ = self._train_step(
-            self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng,
-            None, frozenset(self._frozen))
-        self._score = float(loss)
+        batch_n = int(inputs[0].shape[0]) if inputs else 0
+        t0 = time.perf_counter()
+        with _span("train_step", model="ComputationGraph",
+                   iteration=self._iteration, batch=batch_n):
+            self._key, rng = jax.random.split(self._key)
+            self._params, self._opt_state, self._states, loss, _ = self._train_step(
+                self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng,
+                None, frozenset(self._frozen))
+            # float() blocks until the device step completes, so t1-t0
+            # bounds dispatch + device compute — no extra sync added
+            self._score = float(loss)
+        t1 = time.perf_counter()
         self._iteration += 1
-        for lst in self._listeners:
-            lst.iteration_done(self, self._iteration, self._epoch, self._score)
+        with _span("listeners", model="ComputationGraph"):
+            for lst in self._listeners:
+                lst.iteration_done(self, self._iteration, self._epoch, self._score)
+        _tm.for_model(self).record_step(batch_n, self._score, t1 - t0,
+                                        time.perf_counter() - t1, data_wait)
 
-    def _fit_tbptt(self, inputs, labels, fmasks, lmasks):
+    def _fit_tbptt(self, inputs, labels, fmasks, lmasks, data_wait=None):
         """Truncated BPTT for graphs (ref: ComputationGraph#doTruncatedBPTT):
         time-chunk every 3-D input/label, carry recurrent state across
         chunks; gradients stop at chunk boundaries."""
@@ -330,17 +354,27 @@ class ComputationGraph:
             end = min(start + fwd, t_total)
             fm = chunk(fmasks, start, end, min_ndim=2) if fmasks else None
             lm = chunk(lmasks, start, end, min_ndim=2) if lmasks else None
-            self._key, rng = jax.random.split(self._key)
-            (self._params, self._opt_state, self._states, loss,
-             carries) = self._train_step(
-                self._params, self._opt_state, self._states,
-                chunk(inputs, start, end), chunk(labels, start, end),
-                fm, lm, rng, carries, frozenset(self._frozen))
-            self._score = float(loss)
+            t0 = time.perf_counter()
+            with _span("train_step_tbptt", model="ComputationGraph",
+                       iteration=self._iteration, t_start=start):
+                self._key, rng = jax.random.split(self._key)
+                (self._params, self._opt_state, self._states, loss,
+                 carries) = self._train_step(
+                    self._params, self._opt_state, self._states,
+                    chunk(inputs, start, end), chunk(labels, start, end),
+                    fm, lm, rng, carries, frozenset(self._frozen))
+                self._score = float(loss)
+            t1 = time.perf_counter()
             self._iteration += 1
             for lst in self._listeners:
                 lst.iteration_done(self, self._iteration, self._epoch,
                                    self._score)
+            # examples (and data_wait) count once per BATCH, not per
+            # time-chunk — every chunk sees the same examples
+            _tm.for_model(self).record_step(
+                int(inputs[0].shape[0]) if inputs and start == 0 else 0,
+                self._score, t1 - t0, time.perf_counter() - t1,
+                data_wait if start == 0 else None)
 
     # ------------------------------------------------------------- inference
     @functools.partial(jax.jit, static_argnums=(0,))

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -22,6 +23,8 @@ import optax
 
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn import params as _flat
+from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.nn.conf.configuration import BackpropType, MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn._precision import (_COMPUTE_DTYPES, _cast_float,
@@ -322,22 +325,31 @@ class MultiLayerNetwork:
                                 getattr(data, "features_mask", None),
                                 getattr(data, "labels_mask", None))
             return self
-        # iterator protocol
+        # iterator protocol — pulling the next batch is timed as the
+        # step's data_wait phase (observability step-time decomposition)
         for ep in range(epochs):
             for lst in self._listeners:
                 lst.on_epoch_start(self, self._epoch)
             if hasattr(data, "reset"):
                 data.reset()
-            for ds in data:
+            it = iter(data)
+            while True:
+                t0 = time.perf_counter()
+                with _span("data_wait", model="MultiLayerNetwork"):
+                    ds = next(it, None)
+                if ds is None:
+                    break
                 self._fit_batch(ds.features, ds.labels,
                                 getattr(ds, "features_mask", None),
-                                getattr(ds, "labels_mask", None))
+                                getattr(ds, "labels_mask", None),
+                                data_wait=time.perf_counter() - t0)
             for lst in self._listeners:
                 lst.on_epoch_end(self, self._epoch)
             self._epoch += 1
+            _tm.for_model(self).epochs.inc()
         return self
 
-    def _fit_batch(self, x, y, fmask=None, lmask=None):
+    def _fit_batch(self, x, y, fmask=None, lmask=None, data_wait=None):
         if not self._initialized:
             self.init()
         x = jnp.asarray(_unwrap(x))
@@ -352,18 +364,28 @@ class MultiLayerNetwork:
                for l in self._listeners):
             self._last_input = x
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT and x.ndim == 3):
-            self._fit_tbptt(x, y, fmask, lmask)
+            self._fit_tbptt(x, y, fmask, lmask, data_wait=data_wait)
         else:
-            self._key, rng = jax.random.split(self._key)
-            self._params, self._opt_state, self._states, loss, _ = self._train_step(
-                self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None,
-                frozenset(self._frozen))
-            self._score = float(loss)
+            t0 = time.perf_counter()
+            with _span("train_step", model="MultiLayerNetwork",
+                       iteration=self._iteration, batch=int(x.shape[0])):
+                self._key, rng = jax.random.split(self._key)
+                self._params, self._opt_state, self._states, loss, _ = self._train_step(
+                    self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None,
+                    frozenset(self._frozen))
+                # float() blocks until the device step completes, so t1-t0
+                # bounds dispatch + device compute — no extra sync added
+                self._score = float(loss)
+            t1 = time.perf_counter()
             self._iteration += 1
-            for lst in self._listeners:
-                lst.iteration_done(self, self._iteration, self._epoch, self._score)
+            with _span("listeners", model="MultiLayerNetwork"):
+                for lst in self._listeners:
+                    lst.iteration_done(self, self._iteration, self._epoch, self._score)
+            _tm.for_model(self).record_step(
+                self._last_batch_size, self._score, t1 - t0,
+                time.perf_counter() - t1, data_wait)
 
-    def _fit_tbptt(self, x, y, fmask, lmask):
+    def _fit_tbptt(self, x, y, fmask, lmask, data_wait=None):
         """Truncated BPTT (ref: MultiLayerNetwork#doTruncatedBPTT): chunk the
         time axis, carry RNN state across chunks, gradients stop at chunk
         boundaries (carries enter the next jitted step as constants)."""
@@ -376,14 +398,24 @@ class MultiLayerNetwork:
             y_chunk = y[:, start:end] if y.ndim == 3 else y
             fm = fmask[:, start:end] if fmask is not None else None
             lm = lmask[:, start:end] if lmask is not None else None
-            self._key, rng = jax.random.split(self._key)
-            self._params, self._opt_state, self._states, loss, carries = self._train_step(
-                self._params, self._opt_state, self._states, x_chunk, y_chunk, fm, lm, rng,
-                carries, frozenset(self._frozen))
-            self._score = float(loss)
+            t0 = time.perf_counter()
+            with _span("train_step_tbptt", model="MultiLayerNetwork",
+                       iteration=self._iteration, t_start=start):
+                self._key, rng = jax.random.split(self._key)
+                self._params, self._opt_state, self._states, loss, carries = self._train_step(
+                    self._params, self._opt_state, self._states, x_chunk, y_chunk, fm, lm, rng,
+                    carries, frozenset(self._frozen))
+                self._score = float(loss)
+            t1 = time.perf_counter()
             self._iteration += 1
             for lst in self._listeners:
                 lst.iteration_done(self, self._iteration, self._epoch, self._score)
+            # examples (and data_wait) count once per BATCH, not per
+            # time-chunk — every chunk sees the same examples
+            _tm.for_model(self).record_step(
+                self._last_batch_size if start == 0 else 0, self._score,
+                t1 - t0, time.perf_counter() - t1,
+                data_wait if start == 0 else None)
 
     # ------------------------------------------------------------- pretrain
     def pretrain(self, data, epochs: int = 1):

@@ -1,0 +1,53 @@
+"""Unified observability core (SURVEY §5.5/J12 north star): a process-wide
+metrics registry + structured tracing that every layer — training loops,
+``ParallelInference`` serving, data pipeline, collectives, checkpoints —
+publishes into, with Prometheus exposition on ``UIServer /metrics`` and
+Chrome-trace JSON export for Perfetto.
+
+Quick tour::
+
+    from deeplearning4j_tpu.observability import metrics, span, trace_sink
+
+    reqs = metrics().counter("my_requests_total", "requests", ("route",))
+    reqs.labels(route="/infer").inc()
+
+    with span("preprocess", batch=32):
+        ...
+
+    print(metrics().render_prometheus())      # scrape payload
+    trace_sink().export_json("/tmp/trace.json")   # load in Perfetto
+
+Kill switch: ``DL4J_TPU_METRICS=0`` (instruments and spans become no-ops).
+"""
+from deeplearning4j_tpu.observability.registry import (
+    Counter, Gauge, Histogram, MetricsRegistry, DEFAULT_BUCKETS,
+    global_registry, metrics_enabled, on_registry_reset,
+    reset_global_registry)
+from deeplearning4j_tpu.observability.tracing import (
+    Span, SpanRecord, TraceSink, current_span, global_trace_sink,
+    reset_global_trace_sink, span)
+from deeplearning4j_tpu.observability.straggler import StragglerDetector
+
+#: ergonomic aliases
+metrics = global_registry
+trace_sink = global_trace_sink
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "global_registry", "metrics", "metrics_enabled", "on_registry_reset",
+    "reset_global_registry",
+    "Span", "SpanRecord", "TraceSink", "current_span", "global_trace_sink",
+    "reset_global_trace_sink", "span", "trace_sink",
+    "StragglerDetector", "MetricsReportingListener",
+]
+
+
+def __getattr__(name):
+    # lazy: MetricsReportingListener lives on the listener bus
+    # (optim.listeners) which itself publishes into this package — a lazy
+    # re-export avoids the import cycle
+    if name == "MetricsReportingListener":
+        from deeplearning4j_tpu.optim.listeners import (
+            MetricsReportingListener)
+        return MetricsReportingListener
+    raise AttributeError(name)

@@ -1,0 +1,228 @@
+"""Structured tracing: nested ``span()`` context managers + Chrome-trace
+export (tentpole of the observability PR).
+
+Spans record wall-clock duration with host thread + nesting depth, buffer
+into a process-wide ring (bounded memory — a week-long trainer cannot OOM
+the host by tracing), and export as Chrome trace-event JSON: a list of
+complete events (``ph: "X"`` with ``ts``/``dur`` in microseconds) that
+loads directly in Perfetto / ``chrome://tracing``. This is the portable
+twin of the device timeline ``profiler.xprof`` captures — host phases
+(data wait, dispatch, callbacks) live here, XLA kernels live there.
+
+Usage::
+
+    from deeplearning4j_tpu.observability import span
+
+    with span("fit.step", iteration=i):
+        with span("data_wait"):
+            batch = next(it)
+        ...
+
+Same kill switch as the metrics registry (``DL4J_TPU_METRICS=0``): spans
+become no-op context managers.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.observability.registry import metrics_enabled
+
+#: default ring capacity — ~200k spans at <100 bytes each stays tens of MB
+_DEFAULT_CAPACITY = 65536
+
+# trace clock: perf_counter is monotonic; anchor it once so ts values are
+# comparable across threads and roughly epoch-aligned
+_EPOCH_ANCHOR = time.time() - time.perf_counter()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() + _EPOCH_ANCHOR) * 1e6
+
+
+class SpanRecord:
+    """One finished span (complete event)."""
+
+    __slots__ = ("name", "ts_us", "dur_us", "tid", "depth", "attrs")
+
+    def __init__(self, name: str, ts_us: float, dur_us: float, tid: int,
+                 depth: int, attrs: Optional[Dict[str, Any]]):
+        self.name = name
+        self.ts_us = ts_us
+        self.dur_us = dur_us
+        self.tid = tid
+        self.depth = depth
+        self.attrs = attrs
+
+    def to_chrome_event(self) -> Dict[str, Any]:
+        ev = {"name": self.name, "ph": "X", "ts": self.ts_us,
+              "dur": self.dur_us, "pid": os.getpid(), "tid": self.tid,
+              "cat": "host"}
+        if self.attrs:
+            ev["args"] = {k: (v if isinstance(v, (int, float, bool, str)
+                                             ) or v is None else str(v))
+                          for k, v in self.attrs.items()}
+        return ev
+
+
+class TraceSink:
+    """Ring-buffered in-memory span store with Chrome-trace export."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._buf: List[Optional[SpanRecord]] = [None] * capacity
+        self._head = 0          # next write slot
+        self._total = 0         # spans ever recorded (drops = total - kept)
+        self._lock = threading.Lock()
+
+    def record(self, rec: SpanRecord):
+        with self._lock:
+            self._buf[self._head] = rec
+            self._head = (self._head + 1) % self.capacity
+            self._total += 1
+
+    def __len__(self) -> int:
+        return min(self._total, self.capacity)
+
+    @property
+    def total_recorded(self) -> int:
+        return self._total
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._total - self.capacity)
+
+    def spans(self) -> List[SpanRecord]:
+        """Retained spans, oldest first."""
+        with self._lock:
+            if self._total <= self.capacity:
+                out = self._buf[:self._head]
+            else:
+                out = self._buf[self._head:] + self._buf[:self._head]
+            return [r for r in out if r is not None]
+
+    def clear(self):
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._head = 0
+            self._total = 0
+
+    # ------------------------------------------------------------- export
+    def to_chrome_trace(self) -> List[Dict[str, Any]]:
+        """The JSON-array flavor of the chrome trace format (what Perfetto
+        and chrome://tracing load): a list of ``ph``/``ts``/``dur`` events."""
+        return [r.to_chrome_event() for r in self.spans()]
+
+    def export_json(self, path: Optional[str] = None) -> str:
+        payload = json.dumps(self.to_chrome_trace())
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(payload)
+        return payload
+
+
+_global_sink: Optional[TraceSink] = None
+_sink_lock = threading.Lock()
+_tls = threading.local()
+
+
+def global_trace_sink() -> TraceSink:
+    global _global_sink
+    if _global_sink is None:
+        with _sink_lock:
+            if _global_sink is None:
+                _global_sink = TraceSink()
+    return _global_sink
+
+
+def reset_global_trace_sink(capacity: int = _DEFAULT_CAPACITY) -> TraceSink:
+    global _global_sink
+    with _sink_lock:
+        _global_sink = TraceSink(capacity)
+    return _global_sink
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class Span:
+    """Context manager measuring one named section; nests via a
+    thread-local stack so ``depth`` reflects the live call structure."""
+
+    __slots__ = ("name", "attrs", "sink", "_t0", "_ts", "depth")
+
+    def __init__(self, name: str, sink: Optional[TraceSink] = None,
+                 **attrs):
+        self.name = name
+        self.attrs = attrs or None
+        self.sink = sink
+
+    def set_attr(self, key: str, value):
+        """Attach/overwrite an attribute while the span is open."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+        return self
+
+    def __enter__(self):
+        st = _stack()
+        self.depth = len(st)
+        st.append(self)
+        self._ts = _now_us()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = (time.perf_counter() - self._t0) * 1e6
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        else:                       # tolerate out-of-order exits
+            try:
+                st.remove(self)
+            except ValueError:
+                pass
+        # explicit None check: an EMPTY TraceSink is falsy (__len__ == 0),
+        # so `or` would silently reroute the first span to the global sink
+        sink = self.sink if self.sink is not None else global_trace_sink()
+        sink.record(SpanRecord(
+            self.name, self._ts, dur, threading.get_ident(), self.depth,
+            self.attrs))
+        return False
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set_attr(self, key, value):
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+def span(name: str, sink: Optional[TraceSink] = None, **attrs):
+    """``with span("name", **attrs):`` — the one tracing entry point."""
+    if not metrics_enabled():
+        return _NOOP
+    return Span(name, sink, **attrs)
+
+
+def current_span() -> Optional[Span]:
+    st = getattr(_tls, "stack", None)
+    return st[-1] if st else None

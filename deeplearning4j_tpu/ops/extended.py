@@ -105,11 +105,15 @@ def _shannon_entropy(p, axis=None):
 
 @register("moments", num_outputs=2, aliases=["Moments"])
 def _moments(x, axes=None, keepdims=False):
-    # tf.nn.moments computes half-precision stats in f32 then casts back
+    # tf.nn.moments computes half-precision stats in f32 then casts back —
+    # but only for inexact inputs: integer x must keep FLOAT statistics
+    # (casting the mean of [0, 1] back to int32 would yield 0)
     from deeplearning4j_tpu.ops.moments import one_pass_moments
     axes = tuple(axes) if axes is not None else None
     mean, var = one_pass_moments(x, axes, keepdims=keepdims)
-    return mean.astype(x.dtype), var.astype(x.dtype)
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return mean.astype(x.dtype), var.astype(x.dtype)
+    return mean, var
 
 
 @register("normalize_moments", num_outputs=2, aliases=["NormalizeMoments"])

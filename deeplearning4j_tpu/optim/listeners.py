@@ -114,6 +114,51 @@ class CollectScoresListener(TrainingListener):
         self.scores.append(float(score))
 
 
+class MetricsReportingListener(TrainingListener):
+    """Bridge the TrainingListener bus into the observability registry.
+
+    The built-in fit loops already publish the step-time decomposition;
+    this listener covers everything that drives models through the
+    *listener* contract instead — external training loops (arbiter
+    hyperparameter search, RL), imported-graph trainers, custom solvers —
+    so their iterations/scores land in the same ``/metrics`` series. An
+    optional ``prefix`` namespaces a run (e.g. per arbiter candidate).
+    """
+
+    def __init__(self, prefix: str = "dl4j_listener"):
+        from deeplearning4j_tpu.observability import global_registry
+        reg = global_registry()
+        self._iters = reg.counter(
+            f"{prefix}_iterations_total",
+            "iterations observed on the TrainingListener bus",
+            label_names=("model",))
+        self._score = reg.gauge(
+            f"{prefix}_score", "last score seen on the listener bus",
+            label_names=("model",))
+        self._epochs = reg.counter(
+            f"{prefix}_epochs_total",
+            "epochs completed on the TrainingListener bus",
+            label_names=("model",))
+        self._last_t: Optional[float] = None
+        self._iter_seconds = reg.histogram(
+            f"{prefix}_iteration_seconds",
+            "wall time between consecutive iteration_done callbacks",
+            label_names=("model",))
+
+    def iteration_done(self, model, iteration, epoch, score):
+        kind = type(model).__name__
+        self._iters.labels(model=kind).inc()
+        if score == score:                       # skip NaN
+            self._score.labels(model=kind).set(float(score))
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._iter_seconds.labels(model=kind).observe(now - self._last_t)
+        self._last_t = now
+
+    def on_epoch_end(self, model, epoch):
+        self._epochs.labels(model=type(model).__name__).inc()
+
+
 class CheckpointListener(TrainingListener):
     """Periodic rotating checkpoints with a retention policy
     (ref: org.deeplearning4j.optimize.listeners.CheckpointListener, SURVEY 5.4).
@@ -141,10 +186,26 @@ class CheckpointListener(TrainingListener):
 
     def _save(self, model):
         import os
+
+        from deeplearning4j_tpu.observability import global_registry, span
         self._count += 1
         name = f"checkpoint_{self._count}_{type(model).__name__}.zip"
         path = os.path.join(self.directory, name)
-        model.save(path)
+        t0 = time.perf_counter()
+        with span("checkpoint.save", path=name):
+            model.save(path)
+        reg = global_registry()
+        reg.histogram("dl4j_checkpoint_save_seconds",
+                      "wall time of one checkpoint save").observe(
+            time.perf_counter() - t0)
+        reg.counter("dl4j_checkpoints_total",
+                    "checkpoints written by CheckpointListener").inc()
+        try:
+            reg.counter("dl4j_checkpoint_bytes_total",
+                        "bytes written to checkpoint files").inc(
+                os.path.getsize(path))
+        except OSError:
+            pass
         self._saved.append((self._count, path))
         # retention: keep last N + every keep_every-th
         removable = self._saved[:-self.keep_last] if self.keep_last else []

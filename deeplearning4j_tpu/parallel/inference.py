@@ -18,10 +18,61 @@ from typing import List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import global_registry, on_registry_reset
+from deeplearning4j_tpu.observability import span as _span
+
 
 class InferenceMode:
     INSTANT = "INSTANT"
     BATCHED = "BATCHED"
+
+
+class _ServingMetrics:
+    """Label-bound serving instruments (shared across instances — the
+    registry aggregates; per-instance series would leak one label value
+    per short-lived ParallelInference in tests)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        reg = global_registry()
+        lat = reg.histogram(
+            "dl4j_inference_latency_seconds",
+            "end-to-end ParallelInference.output latency (enqueue + batch "
+            "window + device forward)", label_names=("mode",))
+        self.latency = {m: lat.labels(mode=m)
+                        for m in (InferenceMode.INSTANT, InferenceMode.BATCHED)}
+        req = reg.counter("dl4j_inference_requests_total",
+                          "ParallelInference requests served",
+                          label_names=("mode",))
+        self.requests = {m: req.labels(mode=m)
+                         for m in (InferenceMode.INSTANT, InferenceMode.BATCHED)}
+        self.errors = reg.counter("dl4j_inference_errors_total",
+                                  "ParallelInference requests that raised")
+        self.queue_depth = reg.gauge(
+            "dl4j_inference_queue_depth",
+            "requests waiting in the batching queue (sampled per transition)")
+        self.batch_occupancy = reg.histogram(
+            "dl4j_inference_batch_occupancy",
+            "coalesced examples / batch_limit per device call (1.0 = full "
+            "batch, the padded-executable reuse sweet spot)",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        self.batches = reg.counter("dl4j_inference_batches_total",
+                                   "device calls issued by the serve loop")
+
+    @classmethod
+    def get(cls) -> "_ServingMetrics":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+
+@on_registry_reset
+def _drop_serving_metrics():
+    _ServingMetrics._instance = None
 
 
 class _Request:
@@ -134,8 +185,18 @@ class ParallelInference:
 
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
+        obs = _ServingMetrics.get()
+        t0 = time.perf_counter()
         if self.mode == InferenceMode.INSTANT:
-            return self._forward(x)
+            try:
+                out = self._forward(x)
+            except Exception:
+                obs.errors.inc()
+                raise
+            obs.latency[InferenceMode.INSTANT].observe(
+                time.perf_counter() - t0)
+            obs.requests[InferenceMode.INSTANT].inc()
+            return out
         req = _Request(x)
         while True:
             # non-blocking put under the lock: a blocking put here would
@@ -145,12 +206,16 @@ class ParallelInference:
                     raise RuntimeError("ParallelInference has been shut down")
                 try:
                     self._queue.put_nowait(req)
+                    obs.queue_depth.set(self._queue.qsize())
                     break
                 except queue.Full:
                     pass
             time.sleep(0.001)
         req.event.wait()
+        obs.latency[InferenceMode.BATCHED].observe(time.perf_counter() - t0)
+        obs.requests[InferenceMode.BATCHED].inc()
         if req.error is not None:
+            obs.errors.inc()
             raise req.error
         return req.result
 
@@ -172,6 +237,7 @@ class ParallelInference:
     def _serve_loop(self):
         import time as _time
 
+        obs = _ServingMetrics.get()
         held: Optional[_Request] = None  # overflow from the previous window
         while not self._stop.is_set():
             if held is not None:
@@ -181,6 +247,7 @@ class ParallelInference:
                     first = self._queue.get(timeout=0.1)
                 except queue.Empty:
                     continue
+            obs.queue_depth.set(self._queue.qsize())
             batch: List[_Request] = [first]
             total = first.x.shape[0]
             # coalesce within ONE wait window, never exceeding batch_limit
@@ -212,7 +279,11 @@ class ParallelInference:
                     pad = np.zeros((self.batch_limit - n,) + X.shape[1:],
                                    X.dtype)
                     X = np.concatenate([X, pad], axis=0)
-                out = self._forward(X)[:n]
+                obs.batch_occupancy.observe(n / max(self.batch_limit, 1))
+                obs.batches.inc()
+                with _span("inference_batch", requests=len(batch),
+                           examples=n):
+                    out = self._forward(X)[:n]
                 off = 0
                 for r in batch:
                     k = r.x.shape[0]

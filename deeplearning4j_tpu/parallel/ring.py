@@ -20,7 +20,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:         # pre-0.6 jax: experimental home, same signature
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import (
@@ -56,9 +59,10 @@ def _block_attn_update(q, k, v, m, l, o, q_start, k_start, causal, scale):
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
-                          vary_axes=()):
-    """Per-shard body under shard_map. q/k/v: (B, T/P, H, D) local blocks."""
-    p_size = lax.axis_size(axis_name)
+                          p_size: int, vary_axes=()):
+    """Per-shard body under shard_map. q/k/v: (B, T/P, H, D) local blocks.
+    ``p_size`` is passed statically by the caller (from the mesh): older jax
+    has no ``lax.axis_size`` and the ring-unroll needs a concrete int."""
     my_idx = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -69,9 +73,11 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     o0 = jnp.zeros((b, tq, h, d), jnp.float32)
     # mark accumulators device-varying over every axis the block inputs vary
     # on, so the fori_loop carry type matches the body output (shard_map vma
-    # typing)
-    vary = tuple(vary_axes) or (axis_name,)
-    m0, l0, o0 = (lax.pcast(a, vary, to="varying") for a in (m0, l0, o0))
+    # typing; pre-vma jax has no pcast and needs no marking)
+    if hasattr(lax, "pcast"):
+        vary = tuple(vary_axes) or (axis_name,)
+        m0, l0, o0 = (lax.pcast(a, vary, to="varying")
+                      for a in (m0, l0, o0))
     perm = [(j, (j + 1) % p_size) for j in range(p_size)]
 
     def body(i, carry):
@@ -105,10 +111,17 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str = SEQ_AXIS,
                and q.shape[2] % axis_size(mesh, MODEL_AXIS) == 0 else None)
     spec = P(batch_ax, seq_axis, head_ax, None)
     vary = tuple(a for a in (batch_ax, seq_axis, head_ax) if a is not None)
+    kw = {}
+    if not hasattr(lax, "pcast"):
+        # pre-vma jax can't express "carry becomes device-varying in the
+        # loop body" — its replication checker rejects the ring accumulators,
+        # so disable it (the modern path proves the same property via pcast)
+        kw["check_rep"] = False
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=seq_axis,
-                          causal=causal, vary_axes=vary),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, p_size=axis_size(mesh, seq_axis),
+                          vary_axes=vary),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kw)
     return fn(q, k, v)
 
 

@@ -12,6 +12,7 @@ BASELINE.md).
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -19,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ndarray.ndarray import _unwrap
+from deeplearning4j_tpu.observability import global_registry
+from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.parallel import mesh as _mesh
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, DATA_AXIS
 from deeplearning4j_tpu.parallel.sharding import replicate_tree, tp_shardings
@@ -52,6 +55,8 @@ class ShardedTrainer:
         # all-gather, cutting per-chip optimizer memory by the DP degree
         self.shard_optimizer_state = shard_optimizer_state
         self._placed = False
+        self._grad_bytes = 0     # per-step gradient allreduce payload
+        self._obs = None         # lazily-bound collective instruments
 
     # ------------------------------------------------------------------ setup
     def _place(self):
@@ -73,6 +78,33 @@ class ShardedTrainer:
         if self.shard_optimizer_state:
             net._opt_state = jax.device_put(
                 net._opt_state, self._opt_state_shardings(net._opt_state))
+        # observability: the synchronous data-parallel step allreduces every
+        # gradient leaf once — the payload is exactly the param-tree bytes
+        # (GSPMD fuses the collective into the step, so duration is the
+        # sharded step's wall time; bytes are exact)
+        n_data = _mesh.axis_size(self.mesh, DATA_AXIS) \
+            if DATA_AXIS in self.mesh.axis_names else 1
+        self._grad_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(net._params)
+            if hasattr(leaf, "size")) if n_data > 1 else 0
+        reg = global_registry()
+        self._obs = (
+            reg.counter("dl4j_collective_bytes_total",
+                        "bytes moved per collective op (gradient allreduce "
+                        "payload = param bytes x steps)",
+                        label_names=("collective",)).labels(
+                            collective="allreduce"),
+            reg.histogram("dl4j_collective_step_seconds",
+                          "wall time of the sharded train step (compute + "
+                          "fused gradient allreduce)",
+                          label_names=("collective",)).labels(
+                              collective="allreduce"),
+            reg.gauge("dl4j_mesh_devices", "devices in the active mesh",
+                      label_names=("axis",)))
+        for axis in self.mesh.axis_names:
+            self._obs[2].labels(axis=str(axis)).set(
+                _mesh.axis_size(self.mesh, axis))
         self._placed = True
 
     def _opt_state_shardings(self, opt_state):
@@ -215,13 +247,19 @@ class ShardedTrainer:
         y = self._shard_batch(y)
         fmask = self._shard_batch(fmask)
         lmask = self._shard_batch(lmask)
-        if isinstance(self.net, MultiLayerNetwork):
-            self.net._fit_batch(x, y, fmask, lmask)
-        else:  # ComputationGraph: tuple-valued inputs/labels/masks
-            tup = lambda v: (() if v is None
-                             else tuple(v) if isinstance(v, (tuple, list))
-                             else (v,))
-            self.net._fit_batch(tup(x), tup(y), tup(fmask), tup(lmask))
+        t0 = time.perf_counter()
+        with _span("sharded_step", grad_bytes=self._grad_bytes):
+            if isinstance(self.net, MultiLayerNetwork):
+                self.net._fit_batch(x, y, fmask, lmask)
+            else:  # ComputationGraph: tuple-valued inputs/labels/masks
+                tup = lambda v: (() if v is None
+                                 else tuple(v) if isinstance(v, (tuple, list))
+                                 else (v,))
+                self.net._fit_batch(tup(x), tup(y), tup(fmask), tup(lmask))
+        if self._obs is not None:
+            if self._grad_bytes:
+                self._obs[0].inc(self._grad_bytes)
+            self._obs[1].observe(time.perf_counter() - t0)
 
     # --------------------------------------------------------------- inference
     def output(self, x):

@@ -8,10 +8,14 @@ compiled code, and ``jax.config.jax_debug_nans`` for in-jit NaN panics; both
 are toggled by :func:`ProfilerConfig.apply`). This profiler instruments the
 registry's eager ``exec_op`` dispatch, which is exactly the layer the
 reference instrumented.
+
+Observability refactor: timings publish into the process-wide metrics
+registry (``dl4j_eager_op_seconds{op=...}`` histogram, scrapeable at
+``/metrics``); :class:`OpStats` is now a *view* over that series —
+``reset()`` re-bases the views, the registry stays cumulative.
 """
 from __future__ import annotations
 
-import collections
 import dataclasses
 import time
 from typing import Dict, Optional
@@ -19,6 +23,8 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.observability import global_registry, on_registry_reset
+from deeplearning4j_tpu.observability.registry import Histogram
 from deeplearning4j_tpu.ops import registry as _registry
 
 
@@ -37,15 +43,45 @@ class ProfilerConfig:
         return self
 
 
-@dataclasses.dataclass
 class OpStats:
-    invocations: int = 0
-    total_seconds: float = 0.0
+    """Windowed view over one op's registry series (re-based by reset)."""
+
+    __slots__ = ("_hist", "_n0", "_s0")
+
+    def __init__(self, hist_child):
+        self._hist = hist_child
+        self._n0 = 0
+        self._s0 = 0.0
+
+    def _rebase(self):
+        self._n0 = self._hist.count
+        self._s0 = self._hist.sum
+
+    @property
+    def invocations(self) -> int:
+        return self._hist.count - self._n0
+
+    @property
+    def total_seconds(self) -> float:
+        return self._hist.sum - self._s0
 
     @property
     def average_ms(self) -> float:
         return (self.total_seconds / self.invocations * 1e3
                 if self.invocations else 0.0)
+
+
+class _StatsView(dict):
+    """``profiler.stats[name]`` — lazily binds a view to the op's series."""
+
+    def __init__(self, profiler: "OpProfiler"):
+        super().__init__()
+        self._profiler = profiler
+
+    def __missing__(self, name: str) -> OpStats:
+        st = OpStats(self._profiler._hist.labels(op=name))
+        self[name] = st
+        return st
 
 
 class OpProfiler:
@@ -56,9 +92,22 @@ class OpProfiler:
 
     def __init__(self):
         self.config = ProfilerConfig()
-        self.stats: Dict[str, OpStats] = collections.defaultdict(OpStats)
+        self._bind()
         self._installed = False
         self._orig_exec = None
+
+    def _bind(self):
+        self._hist = global_registry().histogram(
+            "dl4j_eager_op_seconds",
+            "per-op wall time on the eager exec_op dispatch path "
+            "(OpProfiler op_timing mode)", label_names=("op",))
+        if not self._hist._enabled:
+            # DL4J_TPU_METRICS=0 silences the EXPORT, not this explicitly
+            # opted-into tool: fall back to a private (unscraped) series so
+            # stats/print_results keep working under the kill switch
+            self._hist = Histogram("dl4j_eager_op_seconds",
+                                   label_names=("op",), _enabled=True)
+        self.stats: Dict[str, OpStats] = _StatsView(self)
 
     @classmethod
     def get_instance(cls) -> "OpProfiler":
@@ -94,9 +143,8 @@ class OpProfiler:
                 # eager timing: block on the result like the reference's
                 # per-op sync (inside jit this wrapper never runs)
                 jax.block_until_ready(out)
-                st = profiler.stats[name]
-                st.invocations += 1
-                st.total_seconds += time.perf_counter() - t0
+                profiler.stats[name]._hist.observe(
+                    time.perf_counter() - t0)
             if profiler.config.verbose:
                 print(f"[op] {name}")
             if profiler.config.check_for_nan or profiler.config.check_for_inf:
@@ -136,12 +184,16 @@ class OpProfiler:
 
     # ------------------------------------------------------------- reports
     def reset(self):
-        self.stats.clear()
+        """Zero the report window (registry series stay cumulative)."""
+        for st in self.stats.values():
+            st._rebase()
 
     def print_results(self) -> str:
         lines = [f"{'op':<28}{'calls':>8}{'total ms':>12}{'avg ms':>10}"]
         for name, st in sorted(self.stats.items(),
                                key=lambda kv: -kv[1].total_seconds):
+            if not st.invocations:
+                continue
             lines.append(f"{name:<28}{st.invocations:>8}"
                          f"{st.total_seconds * 1e3:>12.2f}"
                          f"{st.average_ms:>10.3f}")
@@ -150,3 +202,9 @@ class OpProfiler:
         return out
 
     printResults = print_results
+
+
+@on_registry_reset
+def _rebind_profiler():
+    if OpProfiler._instance is not None:
+        OpProfiler._instance._bind()

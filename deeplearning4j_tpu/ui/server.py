@@ -11,6 +11,7 @@ import html as _html
 import json
 import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
@@ -136,6 +137,7 @@ class UIServer:
         self._receiver = None     # lazily created for remote-router POSTs
         self._stream_subs: List = []       # live-SSE queues
         self._subs_lock = threading.Lock()
+        self._started_at = time.time()
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -527,7 +529,32 @@ class UIServer:
                 if parsed.path == "/train/stream":
                     self._stream(q.get("sid", [None])[0])
                     return
-                if parsed.path == "/train/sessions":
+                if parsed.path == "/metrics":
+                    # Prometheus text exposition of the process-wide
+                    # registry (the observability scrape surface)
+                    from deeplearning4j_tpu.observability import metrics
+                    body = metrics().render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif parsed.path == "/health":
+                    from deeplearning4j_tpu.observability import (
+                        metrics_enabled, trace_sink)
+                    body = json.dumps({
+                        "status": "ok",
+                        "uptime_seconds": round(
+                            time.time() - ui._started_at, 3),
+                        "sessions": len(ui._sessions()),
+                        "storages": len(ui._storages),
+                        "metrics_enabled": metrics_enabled(),
+                        "spans_recorded": trace_sink().total_recorded,
+                    }).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/train/trace":
+                    # Chrome trace-event JSON of the in-memory span ring —
+                    # save and load in Perfetto / chrome://tracing
+                    from deeplearning4j_tpu.observability import trace_sink
+                    body = trace_sink().export_json().encode()
+                    ctype = "application/json"
+                elif parsed.path == "/train/sessions":
                     body = json.dumps(ui._sessions()).encode()
                     ctype = "application/json"
                 elif parsed.path == "/train/system":

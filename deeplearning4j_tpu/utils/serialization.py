@@ -15,12 +15,15 @@ from __future__ import annotations
 
 import io
 import json
+import logging
 import zipfile
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 def _save_npz_bytes(**arrays) -> bytes:
@@ -79,7 +82,15 @@ class ModelSerializer:
 
     @staticmethod
     def _restore_into(net, zf, load_updater: bool):
-        """Shared param/state/updater restore for both network runtimes."""
+        """Shared param/state/updater restore for both network runtimes.
+
+        Tolerant by design: architecture evolution leaves checkpoints with
+        orphaned entries (e.g. conv ``b`` arrays saved before ResNet50
+        switched its BN-fed convs to ``has_bias=False``) or missing ones.
+        Orphans are skipped with a warning; missing/shape-mismatched params
+        keep their fresh initialization with a warning — never a hard
+        shape-mismatch crash deep inside the first jitted step.
+        """
         net.init()
         with np.load(io.BytesIO(zf.read("coefficients.npz"))) as z:
             params = {}
@@ -87,15 +98,53 @@ class ModelSerializer:
                 lkey, pname = key.split("/", 1)
                 params.setdefault(lkey, {})[pname] = jnp.asarray(z[key])
         # keep canonical ordering from the freshly initialized net
-        net._params = {lkey: {pname: params[lkey][pname] for pname in net._params[lkey]}
-                       for lkey in net._params}
+        restored = {}
+        for lkey in net._params:
+            restored[lkey] = {}
+            for pname, fresh in net._params[lkey].items():
+                saved = params.get(lkey, {}).pop(pname, None)
+                if saved is None:
+                    log.warning(
+                        "checkpoint has no parameter %s/%s; keeping fresh "
+                        "initialization", lkey, pname)
+                    restored[lkey][pname] = fresh
+                elif tuple(saved.shape) != tuple(fresh.shape):
+                    log.warning(
+                        "checkpoint parameter %s/%s has shape %s but the "
+                        "model expects %s; keeping fresh initialization",
+                        lkey, pname, tuple(saved.shape), tuple(fresh.shape))
+                    restored[lkey][pname] = fresh
+                else:
+                    restored[lkey][pname] = saved
+        for lkey, rest in params.items():
+            for pname in rest:
+                log.warning(
+                    "ignoring orphaned checkpoint parameter %s/%s (saved by "
+                    "an older architecture, e.g. a conv bias from before "
+                    "has_bias=False)", lkey, pname)
+        net._params = restored
         if "state.npz" in zf.namelist():
             with np.load(io.BytesIO(zf.read("state.npz"))) as z:
                 states = {}
                 for key in z.files:
                     lkey, sname = key.split("/", 1)
                     states.setdefault(lkey, {})[sname] = jnp.asarray(z[key])
-            net._states = states
+            # same tolerance as params: fresh-net structure wins, saved
+            # values fill matching slots
+            merged = {}
+            for lkey in net._states:
+                merged[lkey] = {}
+                for sname, fresh in net._states[lkey].items():
+                    saved = states.get(lkey, {}).get(sname)
+                    if saved is not None and \
+                            tuple(saved.shape) == tuple(fresh.shape):
+                        merged[lkey][sname] = saved
+                    else:
+                        log.warning(
+                            "checkpoint state %s/%s missing or mismatched; "
+                            "keeping fresh value", lkey, sname)
+                        merged[lkey][sname] = fresh
+            net._states = merged
         if load_updater and "updaterState.npz" in zf.namelist():
             with np.load(io.BytesIO(zf.read("updaterState.npz"))) as z:
                 leaves = [jnp.asarray(z[f"leaf_{i}"]) for i in range(len(z.files))]

@@ -13,8 +13,8 @@ import jax
 # a real slice. (Don't probe jax.default_backend() here — that would
 # initialize the backend before the config can be changed.)
 if not os.environ.get("DL4J_TPU_EXAMPLES_TPU"):
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from deeplearning4j_tpu.utils import force_cpu_devices
+    force_cpu_devices(8)
 
 import jax.numpy as jnp
 import numpy as np

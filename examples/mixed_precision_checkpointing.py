@@ -1,8 +1,8 @@
 """Round-2 TPU extensions in one place: bf16 mixed precision, gradient
 checkpointing (rematerialisation), and orbax sharded checkpoints.
 
-Run: python -c "import jax; jax.config.update('jax_platforms','cpu');
-jax.config.update('jax_num_cpu_devices', 8); import runpy;
+Run: python -c "from deeplearning4j_tpu.utils import force_cpu_devices;
+force_cpu_devices(8); import runpy;
 runpy.run_path('examples/mixed_precision_checkpointing.py',
 run_name='__main__')"
 """

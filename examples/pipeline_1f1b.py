@@ -18,8 +18,9 @@ Both produce the same gradients (tests/test_parallel.py::Test1F1B).
 """
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from deeplearning4j_tpu.utils import force_cpu_devices
+
+force_cpu_devices(8)
 
 import jax.numpy as jnp
 import numpy as np

@@ -12,8 +12,8 @@ import os
 import jax
 
 if not os.environ.get("DL4J_TPU_EXAMPLES_TPU"):
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from deeplearning4j_tpu.utils import force_cpu_devices
+    force_cpu_devices(8)
 
 from deeplearning4j_tpu.data import TinyImageNetDataSetIterator
 from deeplearning4j_tpu.models import zoo

@@ -63,6 +63,29 @@ def main():
 
     print(f"compare the runs: {server.get_address()}/train/compare"
           f"?sids={','.join(sids)}")
+
+    # ---- observability: scrape /metrics alongside the stats UI ----------
+    # the same server exposes the process-wide registry in Prometheus text
+    # format (counters/gauges/histograms every layer publishes into) plus a
+    # JSON health probe — point a real Prometheus at this URL in production
+    import urllib.request
+    metrics_text = urllib.request.urlopen(
+        server.get_address() + "/metrics", timeout=5).read().decode()
+    interesting = [l for l in metrics_text.splitlines()
+                   if l.startswith(("dl4j_training_step_seconds_count",
+                                    "dl4j_training_examples_total",
+                                    "dl4j_training_score",
+                                    "dl4j_slow_steps_total",
+                                    "dl4j_data_batches_total"))]
+    print(f"\nscraped {server.get_address()}/metrics "
+          f"({len(metrics_text.splitlines())} lines); highlights:")
+    for line in interesting:
+        print("  " + line)
+    import json as _json
+    health = _json.loads(urllib.request.urlopen(
+        server.get_address() + "/health", timeout=5).read())
+    print(f"health: {health}")
+
     if args.keep_serving:
         print("serving — ctrl-c to exit")
         import time

@@ -17,11 +17,21 @@ if os.environ.get("DL4J_TPU_TESTS") == "1":
     import jax  # noqa: F401
 else:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # XLA reads this env var at CPU-backend init, so it must be set before
+    # the first device access; it is the only spelling older jax accepts
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
     import jax  # noqa: E402
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # pre-0.5 jax: the XLA_FLAGS fallback above handles it
 
 import pytest  # noqa: E402
 

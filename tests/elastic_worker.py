@@ -35,7 +35,10 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        pass  # pre-0.5 jax: the XLA_FLAGS env var above handles it
 
     from deeplearning4j_tpu.parallel.master import DistributedConfig
 

@@ -71,7 +71,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count={ndev}"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", {ndev})
+try:
+    jax.config.update("jax_num_cpu_devices", {ndev})
+except AttributeError:
+    pass  # pre-0.5 jax: the XLA_FLAGS env var above handles it
 import numpy as np
 import sys
 sys.path.insert(0, {REPO!r})

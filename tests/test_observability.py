@@ -511,3 +511,467 @@ def test_ui_two_session_compare_render():
         assert "EventSource" in over and "/train/stream" in over
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Unified observability core: metrics registry + structured tracing
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry_counter_gauge_histogram_labels():
+    from deeplearning4j_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("obs_req_total", "requests", label_names=("route", "code"))
+    c.labels(route="/a", code="200").inc()
+    c.labels("/a", "200").inc(2.5)          # positional labels, same child
+    c.labels(route="/b", code="500").inc()
+    assert c.labels(route="/a", code="200").value == 3.5
+    assert c.labels(route="/b", code="500").value == 1.0
+    with pytest.raises(ValueError):
+        c.labels(route="/a", code="200").inc(-1)      # counters only go up
+    with pytest.raises(ValueError):
+        c.labels("/only-one")                          # label arity enforced
+
+    g = reg.gauge("obs_depth", "depth")
+    g.set(7); g.inc(); g.dec(3)
+    assert g.value == 5.0
+
+    h = reg.histogram("obs_lat_seconds", "latency", label_names=("mode",),
+                      buckets=(0.01, 0.1, 1.0))
+    child = h.labels(mode="fast")
+    for v in (0.005, 0.05, 0.5, 5.0):
+        child.observe(v)
+    assert child.count == 4 and abs(child.sum - 5.555) < 1e-9
+    assert child.bucket_counts() == [1, 1, 1, 1]      # last = +Inf overflow
+    # quantiles come from the reservoir (exact over the window)
+    assert child.quantile(0.0) == 0.005 and child.quantile(1.0) == 5.0
+    p = child.percentiles((0.5, 0.95, 0.99))
+    assert p[0.5] <= p[0.95] <= p[0.99]
+
+    # get-or-create: same name -> same instrument; kind clash is an error
+    assert reg.counter("obs_req_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("obs_req_total")
+
+
+def test_metrics_registry_thread_safety():
+    import threading
+
+    from deeplearning4j_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("obs_conc_total", "c", label_names=("t",))
+    h = reg.histogram("obs_conc_seconds", "h")
+
+    def work(i):
+        for _ in range(1000):
+            c.labels(t=str(i % 4)).inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(c.labels(t=str(i)).value for i in range(4))
+    assert total == 8000 and h.count == 8000
+
+
+def test_prometheus_exposition_format():
+    from deeplearning4j_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("obs_a_total", "a counter", ("op",)).labels(op="x").inc(3)
+    reg.gauge("obs_g", "a gauge").set(1.5)
+    reg.histogram("obs_h_seconds", "a histogram",
+                  buckets=(0.1, 1.0)).observe(0.5)
+    text = reg.render_prometheus()
+    lines = text.strip().splitlines()
+    # HELP/TYPE headers precede every family, families sorted by name
+    assert "# HELP obs_a_total a counter" in lines
+    assert "# TYPE obs_a_total counter" in lines
+    assert 'obs_a_total{op="x"} 3' in lines
+    assert "# TYPE obs_g gauge" in lines and "obs_g 1.5" in lines
+    assert "# TYPE obs_h_seconds histogram" in lines
+    assert 'obs_h_seconds_bucket{le="0.1"} 0' in lines
+    assert 'obs_h_seconds_bucket{le="1"} 1' in lines
+    assert 'obs_h_seconds_bucket{le="+Inf"} 1' in lines
+    assert "obs_h_seconds_sum 0.5" in lines
+    assert "obs_h_seconds_count 1" in lines
+    # label values escape quotes/backslashes/newlines per the format spec
+    reg.counter("obs_esc_total", "esc", ("p",)).labels(p='a"b\\c\nd').inc()
+    assert r'obs_esc_total{p="a\"b\\c\nd"} 1' in reg.render_prometheus()
+
+
+def test_span_nesting_and_chrome_trace_json():
+    from deeplearning4j_tpu.observability import TraceSink, span
+
+    sink = TraceSink(capacity=16)
+    with span("outer", sink=sink, phase="fit"):
+        with span("inner", sink=sink):
+            pass
+        with span("inner2", sink=sink):
+            pass
+    events = sink.to_chrome_trace()
+    # children close before the parent -> parent is last; array-of-events
+    # chrome format: every entry has ph/ts/dur
+    names = [e["name"] for e in events]
+    assert names == ["inner", "inner2", "outer"]
+    for e in events:
+        assert e["ph"] == "X" and "ts" in e and "dur" in e and "pid" in e
+    outer = events[-1]
+    assert outer["args"]["phase"] == "fit"
+    # parent duration covers both children; timestamps nest
+    inner = events[0]
+    assert outer["ts"] <= inner["ts"]
+    assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+    # depths reflect nesting
+    spans = sink.spans()
+    assert spans[-1].depth == 0 and spans[0].depth == 1
+    # the export is valid JSON loadable as a list
+    parsed = json.loads(sink.export_json())
+    assert isinstance(parsed, list) and len(parsed) == 3
+
+
+def test_trace_sink_ring_buffer_bounds_memory():
+    from deeplearning4j_tpu.observability import TraceSink, span
+
+    sink = TraceSink(capacity=8)
+    for i in range(20):
+        with span(f"s{i}", sink=sink):
+            pass
+    assert len(sink) == 8 and sink.total_recorded == 20
+    assert sink.dropped == 12
+    # oldest dropped first: only the last 8 remain, in order
+    assert [r.name for r in sink.spans()] == [f"s{i}" for i in range(12, 20)]
+
+
+def test_training_fit_populates_step_metrics_and_spans():
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry,
+                                                  reset_global_trace_sink)
+
+    reset_global_registry()
+    sink = reset_global_trace_sink()
+    net = _net()
+    net.fit(ListDataSetIterator([_data()] * 3), epochs=2)
+    reg = metrics()
+    step = reg.get("dl4j_training_step_seconds").labels(
+        model="MultiLayerNetwork")
+    assert step.count == 6
+    phases = reg.get("dl4j_training_phase_seconds")
+    for phase in ("data_wait", "device_compute", "host_callback"):
+        assert phases.labels(model="MultiLayerNetwork",
+                             phase=phase).count >= 6, phase
+    assert reg.get("dl4j_training_examples_total").labels(
+        model="MultiLayerNetwork").value == 6 * 32
+    assert reg.get("dl4j_training_epochs_total").labels(
+        model="MultiLayerNetwork").value == 2
+    # device compute dominates a CPU step; all phases sum close to total
+    text = reg.render_prometheus()
+    assert "dl4j_training_step_seconds_bucket" in text
+    assert 'model="MultiLayerNetwork"' in text
+    # spans: train_step spans nested under nothing, data_wait spans present
+    names = {r.name for r in sink.spans()}
+    assert {"train_step", "data_wait", "listeners"} <= names
+
+
+def test_straggler_detector_counts_slow_steps():
+    from deeplearning4j_tpu.observability import (StragglerDetector,
+                                                  reset_global_registry)
+
+    reset_global_registry()
+    det = StragglerDetector(phase="unit", threshold=3.0, window=16, warmup=2)
+    for _ in range(10):
+        assert not det.observe(0.010)
+    assert det.observe(0.050)            # 5x median -> flagged
+    assert not det.observe(0.012)
+    assert det.slow_count == 1
+    from deeplearning4j_tpu.observability import metrics
+    text = metrics().render_prometheus()
+    assert 'dl4j_slow_steps_total{phase="unit"} 1' in text
+
+
+def test_parallel_inference_latency_histogram_population():
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry)
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+
+    reset_global_registry()
+    net = _net()
+    x = np.random.RandomState(0).rand(4, 4).astype("f4")
+
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.INSTANT).build())
+    pi.output(x)
+    pb = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED).batch_limit(8).build())
+    try:
+        for i in range(3):
+            pb.output(x[i:i + 1])
+    finally:
+        pb.shutdown()
+        pi.shutdown()
+    reg = metrics()
+    lat = reg.get("dl4j_inference_latency_seconds")
+    assert lat.labels(mode="INSTANT").count == 1
+    batched = lat.labels(mode="BATCHED")
+    assert batched.count == 3
+    assert batched.quantile(0.5) <= batched.quantile(0.99)
+    assert reg.get("dl4j_inference_requests_total").labels(
+        mode="BATCHED").value == 3
+    occ = reg.get("dl4j_inference_batch_occupancy")
+    assert occ.count >= 1                  # at least one device call
+    assert reg.get("dl4j_inference_batches_total").value >= 1
+    # the full serving picture renders for a scrape
+    text = reg.render_prometheus()
+    assert "dl4j_inference_latency_seconds_bucket" in text
+    assert "dl4j_inference_queue_depth" in text
+
+
+def test_metrics_endpoint_serves_live_series():
+    """Acceptance: GET /metrics returns valid Prometheus text including
+    training-step, inference-latency, and collective-bytes series from a
+    live run."""
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry)
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+    from deeplearning4j_tpu.ui import UIServer
+
+    reset_global_registry()
+    net = _net()
+    net.fit(_data(), epochs=2)                           # training series
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.INSTANT).build())
+    pi.output(np.zeros((2, 4), "f4"))                    # inference series
+    pi.shutdown()
+    trainer = ShardedTrainer(net, MeshSpec.data_parallel(8))
+    trainer.fit(_data())                                 # collective series
+
+    server = UIServer(port=0).start()
+    try:
+        body = urllib.request.urlopen(
+            server.get_address() + "/metrics", timeout=5)
+        text = body.read().decode()
+        assert body.headers["Content-Type"].startswith("text/plain")
+        assert "dl4j_training_step_seconds_count" in text
+        assert "dl4j_inference_latency_seconds_count" in text
+        assert 'dl4j_collective_bytes_total{collective="allreduce"}' in text
+        # every non-comment line is "name{labels} value"
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name_part, _, value = line.rpartition(" ")
+            assert name_part and float(value) is not None
+
+        health = json.loads(urllib.request.urlopen(
+            server.get_address() + "/health", timeout=5).read())
+        assert health["status"] == "ok"
+        assert health["uptime_seconds"] >= 0
+        assert isinstance(health["metrics_enabled"], bool)
+
+        trace = json.loads(urllib.request.urlopen(
+            server.get_address() + "/train/trace", timeout=5).read())
+        assert isinstance(trace, list) and trace
+        assert all(e["ph"] == "X" and "ts" in e and "dur" in e
+                   for e in trace)
+    finally:
+        server.stop()
+
+
+def test_metrics_kill_switch(monkeypatch):
+    """DL4J_TPU_METRICS=0: instruments and spans become no-ops."""
+    monkeypatch.setenv("DL4J_TPU_METRICS", "0")
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry,
+                                                  reset_global_trace_sink,
+                                                  span)
+
+    reset_global_registry()
+    sink = reset_global_trace_sink()
+    net = _net()
+    net.fit(_data())
+    reg = metrics()
+    step = reg.get("dl4j_training_step_seconds")
+    assert step is None or step.labels(
+        model="MultiLayerNetwork").count == 0
+    with span("dead"):
+        pass
+    assert sink.total_recorded == 0
+    monkeypatch.delenv("DL4J_TPU_METRICS")
+    reset_global_registry()
+
+
+def test_metrics_reporting_listener_bridges_bus():
+    from deeplearning4j_tpu.observability import (MetricsReportingListener,
+                                                  metrics,
+                                                  reset_global_registry)
+
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    reset_global_registry()
+    net = _net()
+    net.setListeners(MetricsReportingListener())
+    net.fit(ListDataSetIterator([_data()] * 2), epochs=2)
+    reg = metrics()
+    assert reg.get("dl4j_listener_iterations_total").labels(
+        model="MultiLayerNetwork").value == 4
+    assert reg.get("dl4j_listener_epochs_total").labels(
+        model="MultiLayerNetwork").value == 2
+    score = reg.get("dl4j_listener_score").labels(
+        model="MultiLayerNetwork").value
+    assert score == score and score > 0
+
+
+def test_checkpoint_listener_publishes_save_metrics(tmp_path):
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry)
+    from deeplearning4j_tpu.optim.listeners import CheckpointListener
+
+    reset_global_registry()
+    net = _net()
+    net.setListeners(CheckpointListener(str(tmp_path),
+                                        save_every_n_iterations=2))
+    net.fit([_data()] * 4, epochs=1)
+    reg = metrics()
+    assert reg.get("dl4j_checkpoints_total").value == 2
+    assert reg.get("dl4j_checkpoint_save_seconds").count == 2
+    assert reg.get("dl4j_checkpoint_bytes_total").value > 0
+
+
+def test_op_profiler_publishes_into_registry():
+    """Refactor check: OpProfiler timings land in the registry series and
+    the legacy stats view re-bases on reset."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry)
+    from deeplearning4j_tpu.ops import registry as ops_registry
+    from deeplearning4j_tpu.profiler import OpProfiler, ProfilerConfig
+
+    reset_global_registry()
+    prof = OpProfiler.get_instance()
+    prof.set_config(ProfilerConfig(op_timing=True))
+    try:
+        ops_registry.exec_op("relu", jnp.asarray([-1.0, 2.0]))
+        ops_registry.exec_op("relu", jnp.asarray([1.0]))
+    finally:
+        prof.set_config(ProfilerConfig())
+    hist = metrics().get("dl4j_eager_op_seconds")
+    assert hist.labels(op="relu").count == 2
+    assert prof.stats["relu"].invocations == 2
+    prof.reset()
+    assert prof.stats["relu"].invocations == 0          # view re-based
+    assert hist.labels(op="relu").count == 2            # series cumulative
+
+
+def test_performance_tracker_publishes_into_registry():
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry)
+    from deeplearning4j_tpu.profiler import PerformanceTracker
+
+    reset_global_registry()
+    t = PerformanceTracker()
+    t.record_iteration(16)
+    t.add_transfer_bytes(host_to_device=2048, device_to_host=512)
+    reg = metrics()
+    assert reg.get("dl4j_perf_examples_total").value == 16
+    tb = reg.get("dl4j_transfer_bytes_total")
+    assert tb.labels(direction="h2d").value == 2048
+    assert tb.labels(direction="d2h").value == 512
+    assert t.examples == 16
+    t.reset()                                # view window re-bases
+    assert t.examples == 0
+    assert reg.get("dl4j_perf_examples_total").value == 16
+
+
+def test_data_iterator_metrics():
+    from deeplearning4j_tpu.data.iterators import (AsyncDataSetIterator,
+                                                   ListDataSetIterator)
+    from deeplearning4j_tpu.observability import (metrics,
+                                                  reset_global_registry)
+
+    reset_global_registry()
+    base = ListDataSetIterator([_data()] * 3)
+    it = AsyncDataSetIterator(base, queue_size=2)
+    n = sum(1 for _ in it)
+    assert n == 3
+    reg = metrics()
+    assert reg.get("dl4j_data_batches_total").labels(
+        iterator="AsyncDataSetIterator").value == 3
+    assert reg.get("dl4j_data_wait_seconds").labels(
+        iterator="AsyncDataSetIterator").count >= 3
+
+
+def test_tolerant_checkpoint_loading_orphaned_conv_bias(tmp_path, caplog):
+    """Checkpoints saved before has_bias=False carry orphaned conv ``b``
+    entries — restore must warn and skip them, never shape-mismatch."""
+    import logging as _logging
+    import zipfile
+
+    net = _net()
+    net.fit(_data())
+    path = os.path.join(str(tmp_path), "old.zip")
+    net.save(path)
+
+    # rewrite the artifact with an injected orphan parameter (the old
+    # architecture's conv bias) and one missing parameter
+    path2 = os.path.join(str(tmp_path), "tampered.zip")
+    import io as _io
+
+    import numpy as _np
+    with zipfile.ZipFile(path) as zin:
+        names = zin.namelist()
+        coeffs = dict(_np.load(_io.BytesIO(zin.read("coefficients.npz"))))
+        coeffs["0/b_orphan"] = _np.zeros(8, "f4")     # orphan entry
+        missing = coeffs.pop("1/b")                   # dropped entry
+        buf = _io.BytesIO()
+        _np.savez(buf, **coeffs)
+        with zipfile.ZipFile(path2, "w") as zout:
+            for n in names:
+                if n == "coefficients.npz":
+                    zout.writestr(n, buf.getvalue())
+                elif n == "updaterState.npz":
+                    continue            # stale updater tolerated separately
+                else:
+                    zout.writestr(n, zin.read(n))
+
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    with caplog.at_level(_logging.WARNING, logger="deeplearning4j_tpu"):
+        restored = MultiLayerNetwork.load(path2)
+    msgs = " ".join(r.message for r in caplog.records)
+    assert "orphaned" in msgs and "0/b_orphan" in msgs
+    assert "keeping fresh initialization" in msgs
+    # restored net is fully usable: same weights where present
+    assert np.allclose(np.asarray(restored._params["0"]["W"]),
+                       np.asarray(net._params["0"]["W"]))
+    assert restored._params["1"]["b"].shape == missing.shape
+    restored.output(np.zeros((2, 4), "f4"))
+
+
+def test_graph_opt_flag_in_emission_cache_key(monkeypatch):
+    """ADVICE r5: toggling DL4J_TPU_GRAPH_OPT mid-session must re-emit
+    rather than silently reuse programs built under the other setting."""
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x", (2, 3))
+    w = sd.var("w", init=np.ones((3, 3), np.float32))
+    (x @ w).rename("y")
+    xin = np.random.RandomState(0).rand(2, 3).astype("f4")
+
+    monkeypatch.setenv("DL4J_TPU_GRAPH_OPT", "1")
+    out1 = sd.output({"x": xin}, ["y"])["y"]
+    n1 = len(sd._compiled_cache)
+    monkeypatch.setenv("DL4J_TPU_GRAPH_OPT", "0")
+    out2 = sd.output({"x": xin}, ["y"])["y"]
+    assert len(sd._compiled_cache) == n1 + 1     # new entry, not stale hit
+    assert np.allclose(np.asarray(out1), np.asarray(out2))
+    monkeypatch.setenv("DL4J_TPU_GRAPH_OPT", "1")
+    sd.output({"x": xin}, ["y"])
+    assert len(sd._compiled_cache) == n1 + 1     # flag=1 entry reused

@@ -390,3 +390,18 @@ def test_norm_op_stats_survive_bf16_offset_inputs():
     yf = np.asarray(y, np.float32)
     # a collapsed variance would blow the normalized scale up ~sqrt(1/eps)
     assert np.abs(yf).max() < 10.0, np.abs(yf).max()
+
+
+def test_moments_integer_input_keeps_float_statistics():
+    """ADVICE r5: the cast back to x.dtype applies only to INEXACT inputs
+    — integer x would truncate mean/var (mean([0,1]) -> 0) otherwise."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.registry import exec_op
+
+    m, v = exec_op("moments", jnp.asarray([0, 1, 2, 3], jnp.int32))
+    assert jnp.issubdtype(m.dtype, jnp.floating)
+    assert jnp.issubdtype(v.dtype, jnp.floating)
+    assert float(m) == 1.5 and float(v) == 1.25
+    # inexact inputs keep the cast-back contract
+    mb, vb = exec_op("moments", jnp.asarray([0.0, 1.0], jnp.bfloat16))
+    assert mb.dtype == jnp.bfloat16 and vb.dtype == jnp.bfloat16
