@@ -7,7 +7,7 @@ Each rung is ONE subprocess (fresh backend, wedge-proof behind a hard
 timeout, env-delivered XLA flags) that device-times the flagship train
 step via the XPlane trace (benchmarks/device_timing.py — host wall-clock
 through the tunnel over-reports). One JSON line per rung is appended to
-``benchmarks/mfu_ladder_live.jsonl`` AS EACH RUNG FINISHES, so a dying
+``benchmarks/ab/mfu_ladder_live.jsonl`` AS EACH RUNG FINISHES, so a dying
 window keeps everything banked so far; the stdout summary at the end
 carries vs-base ratios.
 
@@ -23,7 +23,7 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-OUT = os.path.join(HERE, "mfu_ladder_live.jsonl")
+OUT = os.path.join(HERE, "ab", "mfu_ladder_live.jsonl")
 RUNG_TIMEOUT_S = 600
 V5E_PEAK_BF16 = 197e12
 

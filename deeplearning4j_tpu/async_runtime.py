@@ -1,0 +1,77 @@
+"""Async hot-path configuration — one home for the knobs and kill switch.
+
+The training and serving hot paths overlap host work with device work
+(classic input-pipeline / transfer-compute overlap, Abadi et al.
+arXiv:1605.08695 §4.2, Awan et al. arXiv:1810.11112):
+
+- ``DevicePrefetchIterator`` (data/iterators.py) moves batch *k+1* to the
+  device while step *k* computes;
+- the fit loops (nn/multilayer.py, nn/graph.py) defer the blocking
+  ``float(loss)`` fetch so JAX's async dispatch keeps several steps
+  enqueued instead of round-tripping per step;
+- ``ParallelInference`` (parallel/inference.py) runs a batcher →
+  dispatcher → completer pipeline with several device batches in flight
+  and pads to power-of-two shape buckets instead of ``batch_limit``.
+
+Kill switch: ``DL4J_TPU_ASYNC=0`` restores the fully synchronous
+behavior everywhere (one batch in flight, per-step loss sync,
+pad-to-``batch_limit`` serving). All values are read per call so tests
+can flip them with ``monkeypatch.setenv``.
+
+Knobs (env var → default):
+
+============================  =======  ==========================================
+``DL4J_TPU_ASYNC``            ``1``    master switch; ``0`` = fully synchronous
+``DL4J_TPU_PREFETCH_DEPTH``   ``2``    device batches buffered ahead of the step
+``DL4J_TPU_SCORE_EVERY``      ``16``   steps between loss materializations
+``DL4J_TPU_INFLIGHT``         ``2``    serving batches dispatched but uncompleted
+============================  =======  ==========================================
+"""
+from __future__ import annotations
+
+import os
+
+
+def async_enabled() -> bool:
+    """The documented kill switch (read per call so tests can flip it)."""
+    return os.environ.get("DL4J_TPU_ASYNC", "1") != "0"
+
+
+def _int_env(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, default)))
+    except (TypeError, ValueError):
+        return default
+
+
+def prefetch_depth() -> int:
+    """Device batches the prefetch thread keeps ready ahead of the step."""
+    return _int_env("DL4J_TPU_PREFETCH_DEPTH", 2)
+
+
+def score_sync_every() -> int:
+    """Steps between blocking loss materializations in a deferred fit loop.
+    Bounds how far the host can run ahead of the device (and how stale
+    ``score()`` can be mid-epoch); the fetch always happens at epoch end."""
+    return _int_env("DL4J_TPU_SCORE_EVERY", 16)
+
+
+def inflight_limit() -> int:
+    """Serving pipeline depth: device batches dispatched but not yet
+    completed (dispatch batch k+1 while k's results transfer back)."""
+    return _int_env("DL4J_TPU_INFLIGHT", 2)
+
+
+def default_buckets(batch_limit: int) -> tuple:
+    """Power-of-two padding buckets up to and including ``batch_limit``.
+
+    Each bucket is one compiled executable; padding to the next bucket
+    instead of to ``batch_limit`` trades a small bounded set of compiles
+    (log2(limit) + 1) for far less padded compute at partial occupancy.
+    """
+    out, b = [], 1
+    while b < batch_limit:
+        out.append(b)
+        b <<= 1
+    out.append(batch_limit)
+    return tuple(sorted(set(out)))

@@ -12,6 +12,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 
@@ -30,6 +31,31 @@ def _data_obs(kind: str):
             reg.histogram("dl4j_data_wait_seconds",
                           "host time blocked waiting on the data pipeline",
                           label_names=("iterator",)).labels(iterator=kind))
+    return handles
+
+
+def _prefetch_obs(kind: str):
+    """(ready counter, wait counter, overlap-ratio gauge) for the device
+    prefetch stage, label-bound per BACKING iterator class — "ready" means
+    the consumer found the next batch already on device (transfer fully
+    overlapped with compute). Labeling follows _data_obs: per-class, so two
+    pipelines (train + eval, two models) don't clobber one series."""
+    handles = _obs_cache.get(("__prefetch__", kind))
+    if handles is None:
+        reg = global_registry()
+        hit = reg.counter("dl4j_async_prefetch_total",
+                          "prefetched-batch handoffs by outcome: ready = "
+                          "batch was already on device, wait = consumer "
+                          "blocked on the prefetch thread",
+                          label_names=("outcome", "iterator"))
+        handles = _obs_cache[("__prefetch__", kind)] = (
+            hit.labels(outcome="ready", iterator=kind),
+            hit.labels(outcome="wait", iterator=kind),
+            reg.gauge("dl4j_async_overlap_ratio",
+                      "fraction of batches whose device transfer fully "
+                      "overlapped compute (ready / all handoffs, this "
+                      "epoch)", label_names=("iterator",)).labels(
+                          iterator=kind))
     return handles
 
 
@@ -192,6 +218,219 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch(self) -> int:
         return self._backing.batch()
+
+
+def _put_tree(v, put):
+    """Apply ``put`` to every array in a (possibly tuple-valued) field."""
+    if v is None:
+        return None
+    if isinstance(v, (tuple, list)):
+        return type(v)(_put_tree(e, put) for e in v)
+    return put(v)
+
+
+def _place_dataset(ds, put):
+    """Shallow-copy a DataSet/MultiDataSet with every array field run
+    through ``put`` (device placement). Unknown extra attributes survive
+    because the copy starts from ``copy.copy``."""
+    import copy
+
+    out = copy.copy(ds)
+    for field in ("features", "labels", "features_mask", "labels_mask",
+                  "features_masks", "labels_masks"):
+        if hasattr(out, field):
+            setattr(out, field, _put_tree(getattr(out, field), put))
+    return out
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Double-buffered device prefetch: the AsyncDataSetIterator idea moved
+    one hop further down the pipeline. A background thread pulls batch
+    *k+1* from the backing iterator and runs ``jax.device_put`` on it while
+    step *k* computes, so the fit loop dequeues batches that are ALREADY on
+    device and the host→device transfer rides under device compute
+    (transfer/compute overlap, Awan et al. arXiv:1810.11112 §3).
+
+    Donation-safe by construction: the jitted train steps donate params /
+    optimizer state / layer states only — never the input batch — so a
+    prefetched buffer is never aliased by the step that consumes the
+    previous one.
+
+    ``placement`` customizes where batches land (e.g. ``ShardedTrainer``
+    passes its mesh-sharding put); it runs on the prefetch thread and must
+    be thread-safe (``jax.device_put`` is).
+    """
+
+    _SENTINEL = object()
+
+    class _Failure:
+        __slots__ = ("error",)
+
+        def __init__(self, error):
+            self.error = error
+
+    def __init__(self, backing: DataSetIterator, depth: Optional[int] = None,
+                 placement=None):
+        self._backing = backing
+        self._depth = max(1, depth if depth is not None
+                          else _async.prefetch_depth())
+        self._placement = placement
+        self._hits = 0
+        self._waits = 0
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._next_item = None
+        self._error: Optional[BaseException] = None
+        # lazy start: fit loops call reset() (twice — fit + __iter__) before
+        # consuming; spawning the thread on first access instead of here
+        # avoids burning thread spawns and device transfers per reset
+
+    @classmethod
+    def wrap(cls, iterator, depth: Optional[int] = None, placement=None):
+        """Wrap a DataSetIterator for device prefetch when the async
+        runtime is enabled; anything else (plain lists, generators,
+        already-wrapped iterators, kill switch off) passes through."""
+        if (not _async.async_enabled()
+                or isinstance(iterator, DevicePrefetchIterator)
+                or not isinstance(iterator, DataSetIterator)):
+            return iterator
+        return cls(iterator, depth=depth, placement=placement)
+
+    def _place(self, ds):
+        if self._placement is not None:
+            return self._placement(ds)
+        import jax
+
+        return _place_dataset(ds, jax.device_put)
+
+    def _start(self):
+        # q/stop are CLOSURE LOCALS, not self attributes: if close()'s join
+        # times out (producer wedged in a long device_put), reset() replaces
+        # self._queue/self._stop — a stale thread holding only its own
+        # locals can never feed the new epoch's queue or miss its stop flag
+        q = self._queue = queue.Queue(maxsize=self._depth)
+        stop = self._stop = threading.Event()
+        backing, place = self._backing, self._place
+
+        def put_stop_aware(item) -> bool:
+            # never park forever on a consumer that went away mid-epoch:
+            # close()/reset() set the stop flag, then drain
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                while not stop.is_set():
+                    try:
+                        # has_next() inside the try too: an iterator that
+                        # raises probing for data (corrupt shard, IO error)
+                        # must surface to the consumer, not be laundered
+                        # into a clean end-of-epoch by the finally-sentinel
+                        if not backing.has_next():
+                            break
+                        item = place(backing.next())
+                    except Exception as e:  # surface on the consumer side
+                        item = DevicePrefetchIterator._Failure(e)
+                    put_stop_aware(item)
+                    if isinstance(item, DevicePrefetchIterator._Failure):
+                        return
+            finally:
+                # the sentinel MUST be delivered (a full queue here is the
+                # normal case — the consumer still owes `depth` reads), so
+                # block for it; the stop flag keeps close() live
+                put_stop_aware(self._SENTINEL)
+
+        self._thread = threading.Thread(target=producer, daemon=True,
+                                        name="dl4j-device-prefetch")
+        self._thread.start()
+        self._advance()
+
+    def _ensure_started(self):
+        if self._thread is None:
+            self._start()
+
+    def _advance(self):
+        obs = _data_obs(type(self).__name__)
+        hit, wait, ratio = _prefetch_obs(type(self._backing).__name__)
+        t0 = time.perf_counter()
+        try:
+            item = self._queue.get_nowait()
+            self._hits += 1
+            hit.inc()
+        except queue.Empty:
+            item = self._queue.get()
+            self._waits += 1
+            wait.inc()
+        obs[1].observe(time.perf_counter() - t0)
+        total = self._hits + self._waits
+        if total:
+            ratio.set(self._hits / total)
+        if isinstance(item, DevicePrefetchIterator._Failure):
+            # don't raise here: next() calls _advance AFTER taking the
+            # (valid) current batch — raising now would drop it. Surface
+            # the error on the NEXT has_next()/next() access instead.
+            self._error = item.error
+            self._next_item = None
+            return
+        self._next_item = None if item is self._SENTINEL else item
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def has_next(self) -> bool:
+        self._ensure_started()
+        self._raise_pending()
+        return self._next_item is not None
+
+    def next(self) -> DataSet:
+        self._ensure_started()
+        self._raise_pending()
+        if self._next_item is None:
+            # past the end there is no producer left to feed the queue —
+            # blocking in _advance would hang forever (DL4J's next() throws
+            # NoSuchElementException here)
+            raise StopIteration("DevicePrefetchIterator exhausted")
+        ds = self._next_item
+        self._advance()
+        return ds
+
+    def close(self):
+        """Stop the prefetch thread without restarting (terminal)."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+        self._thread = None
+        self._next_item = None
+
+    def reset(self):
+        self.close()
+        self._error = None
+        # per-epoch overlap accounting: a late-epoch transfer regression
+        # should move the gauge, not be averaged into ancient history
+        self._hits = 0
+        self._waits = 0
+        self._backing.reset()
+
+    def batch(self) -> int:
+        return self._backing.batch()
+
+    def overlap_ratio(self) -> float:
+        """Fraction of handoffs where the batch was already on device."""
+        total = self._hits + self._waits
+        return self._hits / total if total else 0.0
 
 
 class MultipleEpochsIterator(DataSetIterator):

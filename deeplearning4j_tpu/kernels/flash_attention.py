@@ -23,6 +23,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# this container's jax 0.4.x spells it TPUCompilerParams; newer jax renamed
+# it to CompilerParams — accept either (same repair family as the
+# shard_map/jax_num_cpu_devices fallbacks from the observability PR)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 # 512x1024 tiles: hardware-measured best on v5e (2026-07-31 crossover
 # sweep, benchmarks/flash_crossover.py — beat 256/512 at every T probed,
 # 17.2 ms vs 19.8 ms at T=8192); clamped to seq_len below, so short
@@ -137,7 +143,7 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
@@ -57,6 +58,12 @@ class ComputationGraph:
         self._iteration = 0
         self._epoch = 0
         self._score = float("nan")
+        self._pending_score = None   # device-side loss not yet materialized
+        #: steps between blocking loss fetches in a deferred (async) fit
+        #: loop; bounds host run-ahead. None = follow DL4J_TPU_SCORE_EVERY
+        #: live (so the env knob works after construction); set an int to
+        #: pin it per net. See async_runtime.
+        self.score_every: Optional[int] = None
         self._listeners = []
         self._key = jax.random.key(conf.seed)
         self._initialized = False
@@ -283,27 +290,53 @@ class ComputationGraph:
                                 _ds_masks(data, "labels"))
             return self
         # iterator protocol — pulling the next batch is timed as the
-        # step's data_wait phase (observability step-time decomposition)
-        for _ in range(epochs):
-            for lst in self._listeners:
-                lst.on_epoch_start(self, self._epoch)
-            if hasattr(data, "reset"):
-                data.reset()
-            it = iter(data)
-            while True:
-                t0 = time.perf_counter()
-                with _span("data_wait", model="ComputationGraph"):
-                    ds = next(it, None)
-                if ds is None:
-                    break
-                self._fit_batch(_as_tuple(ds.features), _as_tuple(ds.labels),
-                                _ds_masks(ds, "features"), _ds_masks(ds, "labels"),
-                                data_wait=time.perf_counter() - t0)
-            for lst in self._listeners:
-                lst.on_epoch_end(self, self._epoch)
-            self._epoch += 1
-            _tm.for_model(self).epochs.inc()
+        # step's data_wait phase (observability step-time decomposition).
+        # Under the async runtime the iterator is wrapped for device
+        # prefetch: batch k+1's host->device transfer overlaps step k.
+        from deeplearning4j_tpu.data.iterators import DevicePrefetchIterator
+        wrapped = DevicePrefetchIterator.wrap(data)
+        we_wrapped, data = wrapped is not data, wrapped
+        try:
+            for _ in range(epochs):
+                for lst in self._listeners:
+                    lst.on_epoch_start(self, self._epoch)
+                if hasattr(data, "reset"):
+                    data.reset()
+                it = iter(data)
+                while True:
+                    t0 = time.perf_counter()
+                    with _span("data_wait", model="ComputationGraph"):
+                        ds = next(it, None)
+                    if ds is None:
+                        break
+                    self._fit_batch(_as_tuple(ds.features),
+                                    _as_tuple(ds.labels),
+                                    _ds_masks(ds, "features"),
+                                    _ds_masks(ds, "labels"),
+                                    data_wait=time.perf_counter() - t0)
+                # epoch boundary is a mandatory sync point: listeners and
+                # score() must see this epoch's final loss
+                self._sync_score()
+                for lst in self._listeners:
+                    lst.on_epoch_end(self, self._epoch)
+                self._epoch += 1
+                _tm.for_model(self).epochs.inc()
+        finally:
+            if we_wrapped:
+                # an exceptional exit (preemption, Ctrl-C, bad batch) must
+                # not strand the prefetch thread spinning on a full queue
+                # with device batches pinned
+                data.close()
         return self
+
+    def _sync_score(self) -> float:
+        """Materialize a deferred device-side loss, if any (the only place
+        the async fit loop blocks on the device outside sync points)."""
+        pend = self._pending_score
+        if pend is not None:
+            self._pending_score = None
+            self._score = float(pend)
+        return self._score
 
     def _fit_batch(self, inputs, labels, fmasks=(), lmasks=(), data_wait=None):
         if not self._initialized:
@@ -318,6 +351,14 @@ class ComputationGraph:
                             data_wait=data_wait)
             return
         batch_n = int(inputs[0].shape[0]) if inputs else 0
+        # deferred scalar fetch (async runtime): the loss stays a device
+        # array so JAX's async dispatch keeps N steps enqueued instead of
+        # round-tripping per step (see MultiLayerNetwork._fit_batch)
+        defer_mode = _async.async_enabled() and not self._listeners
+        score_every = (self.score_every if self.score_every is not None
+                       else _async.score_sync_every())
+        sync_now = (not defer_mode
+                    or (self._iteration + 1) % max(1, score_every) == 0)
         t0 = time.perf_counter()
         with _span("train_step", model="ComputationGraph",
                    iteration=self._iteration, batch=batch_n):
@@ -325,16 +366,22 @@ class ComputationGraph:
             self._params, self._opt_state, self._states, loss, _ = self._train_step(
                 self._params, self._opt_state, self._states, inputs, labels, fmasks, lmasks, rng,
                 None, frozenset(self._frozen))
-            # float() blocks until the device step completes, so t1-t0
-            # bounds dispatch + device compute — no extra sync added
-            self._score = float(loss)
+            if sync_now:
+                # float() blocks until the device step completes, so t1-t0
+                # bounds dispatch + device compute of every step enqueued
+                # since the last sync
+                self._pending_score = None
+                self._score = float(loss)
+            else:
+                self._pending_score = loss
         t1 = time.perf_counter()
         self._iteration += 1
         with _span("listeners", model="ComputationGraph"):
             for lst in self._listeners:
                 lst.iteration_done(self, self._iteration, self._epoch, self._score)
-        _tm.for_model(self).record_step(batch_n, self._score, t1 - t0,
-                                        time.perf_counter() - t1, data_wait)
+        _tm.for_model(self).record_step(
+            batch_n, self._score if sync_now else float("nan"), t1 - t0,
+            time.perf_counter() - t1, data_wait, pipelined=defer_mode)
 
     def _fit_tbptt(self, inputs, labels, fmasks, lmasks, data_wait=None):
         """Truncated BPTT for graphs (ref: ComputationGraph#doTruncatedBPTT):
@@ -343,6 +390,7 @@ class ComputationGraph:
         t_total = max(x.shape[1] for x in inputs if x.ndim == 3)
         fwd = self.conf.tbptt_fwd_length
         carries = {}
+        self._pending_score = None   # TBPTT stays per-chunk synchronous
 
         def chunk(seq, start, end, min_ndim=3):
             # masks are (N, T): slice them at 2-D too (min_ndim=2); static
@@ -447,7 +495,7 @@ class ComputationGraph:
 
     def score(self, dataset=None) -> float:
         if dataset is None:
-            return self._score
+            return self._sync_score()
         inputs = _as_tuple(dataset.features)
         labels = _as_tuple(dataset.labels)
         loss, _ = self._loss_fn(self._params, self._states,

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn import params as _flat
 from deeplearning4j_tpu.observability import span as _span
@@ -96,6 +97,12 @@ class MultiLayerNetwork:
         self._iteration = 0
         self._epoch = 0
         self._score = float("nan")
+        self._pending_score = None   # device-side loss not yet materialized
+        #: steps between blocking loss fetches in a deferred (async) fit
+        #: loop; bounds host run-ahead. None = follow DL4J_TPU_SCORE_EVERY
+        #: live (so the env knob works after construction); set an int to
+        #: pin it per net. See async_runtime.
+        self.score_every: Optional[int] = None
         self._listeners = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep carries
         self._last_input = None                # StatsListener activation hist
@@ -309,6 +316,7 @@ class MultiLayerNetwork:
             self._params, self._states, x, labels,
             None if mask is None else jnp.asarray(_unwrap(mask)),
             None if label_mask is None else jnp.asarray(_unwrap(label_mask)), rng, None)
+        self._pending_score = None
         self._score = float(loss)
         return self._score, grads
 
@@ -326,28 +334,52 @@ class MultiLayerNetwork:
                                 getattr(data, "labels_mask", None))
             return self
         # iterator protocol — pulling the next batch is timed as the
-        # step's data_wait phase (observability step-time decomposition)
-        for ep in range(epochs):
-            for lst in self._listeners:
-                lst.on_epoch_start(self, self._epoch)
-            if hasattr(data, "reset"):
-                data.reset()
-            it = iter(data)
-            while True:
-                t0 = time.perf_counter()
-                with _span("data_wait", model="MultiLayerNetwork"):
-                    ds = next(it, None)
-                if ds is None:
-                    break
-                self._fit_batch(ds.features, ds.labels,
-                                getattr(ds, "features_mask", None),
-                                getattr(ds, "labels_mask", None),
-                                data_wait=time.perf_counter() - t0)
-            for lst in self._listeners:
-                lst.on_epoch_end(self, self._epoch)
-            self._epoch += 1
-            _tm.for_model(self).epochs.inc()
+        # step's data_wait phase (observability step-time decomposition).
+        # Under the async runtime the iterator is wrapped for device
+        # prefetch: batch k+1's host->device transfer overlaps step k.
+        from deeplearning4j_tpu.data.iterators import DevicePrefetchIterator
+        wrapped = DevicePrefetchIterator.wrap(data)
+        we_wrapped, data = wrapped is not data, wrapped
+        try:
+            for ep in range(epochs):
+                for lst in self._listeners:
+                    lst.on_epoch_start(self, self._epoch)
+                if hasattr(data, "reset"):
+                    data.reset()
+                it = iter(data)
+                while True:
+                    t0 = time.perf_counter()
+                    with _span("data_wait", model="MultiLayerNetwork"):
+                        ds = next(it, None)
+                    if ds is None:
+                        break
+                    self._fit_batch(ds.features, ds.labels,
+                                    getattr(ds, "features_mask", None),
+                                    getattr(ds, "labels_mask", None),
+                                    data_wait=time.perf_counter() - t0)
+                # epoch boundary is a mandatory sync point: listeners and
+                # score() must see this epoch's final loss
+                self._sync_score()
+                for lst in self._listeners:
+                    lst.on_epoch_end(self, self._epoch)
+                self._epoch += 1
+                _tm.for_model(self).epochs.inc()
+        finally:
+            if we_wrapped:
+                # an exceptional exit (preemption, Ctrl-C, bad batch) must
+                # not strand the prefetch thread spinning on a full queue
+                # with device batches pinned
+                data.close()
         return self
+
+    def _sync_score(self) -> float:
+        """Materialize a deferred device-side loss, if any (the only place
+        the async fit loop blocks on the device outside sync points)."""
+        pend = self._pending_score
+        if pend is not None:
+            self._pending_score = None
+            self._score = float(pend)
+        return self._score
 
     def _fit_batch(self, x, y, fmask=None, lmask=None, data_wait=None):
         if not self._initialized:
@@ -366,6 +398,17 @@ class MultiLayerNetwork:
         if (self.conf.backprop_type == BackpropType.TruncatedBPTT and x.ndim == 3):
             self._fit_tbptt(x, y, fmask, lmask, data_wait=data_wait)
         else:
+            # deferred scalar fetch (async runtime): the loss stays a device
+            # array so JAX's async dispatch keeps N steps enqueued instead
+            # of round-tripping per step. Listeners receive a float score
+            # every iteration, so their presence forces the sync; otherwise
+            # the fetch happens every ``score_every`` steps, at epoch end,
+            # and lazily on score() access.
+            defer_mode = _async.async_enabled() and not self._listeners
+            score_every = (self.score_every if self.score_every is not None
+                           else _async.score_sync_every())
+            sync_now = (not defer_mode
+                        or (self._iteration + 1) % max(1, score_every) == 0)
             t0 = time.perf_counter()
             with _span("train_step", model="MultiLayerNetwork",
                        iteration=self._iteration, batch=int(x.shape[0])):
@@ -373,17 +416,23 @@ class MultiLayerNetwork:
                 self._params, self._opt_state, self._states, loss, _ = self._train_step(
                     self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None,
                     frozenset(self._frozen))
-                # float() blocks until the device step completes, so t1-t0
-                # bounds dispatch + device compute — no extra sync added
-                self._score = float(loss)
+                if sync_now:
+                    # float() blocks until the device step completes, so
+                    # t1-t0 bounds dispatch + device compute of every step
+                    # enqueued since the last sync
+                    self._pending_score = None
+                    self._score = float(loss)
+                else:
+                    self._pending_score = loss
             t1 = time.perf_counter()
             self._iteration += 1
             with _span("listeners", model="MultiLayerNetwork"):
                 for lst in self._listeners:
                     lst.iteration_done(self, self._iteration, self._epoch, self._score)
             _tm.for_model(self).record_step(
-                self._last_batch_size, self._score, t1 - t0,
-                time.perf_counter() - t1, data_wait)
+                self._last_batch_size, self._score if sync_now else float("nan"),
+                t1 - t0, time.perf_counter() - t1, data_wait,
+                pipelined=defer_mode)
 
     def _fit_tbptt(self, x, y, fmask, lmask, data_wait=None):
         """Truncated BPTT (ref: MultiLayerNetwork#doTruncatedBPTT): chunk the
@@ -392,6 +441,7 @@ class MultiLayerNetwork:
         t_total = x.shape[1]
         fwd = self.conf.tbptt_fwd_length
         carries = {}
+        self._pending_score = None   # TBPTT stays per-chunk synchronous
         for start in range(0, t_total, fwd):
             end = min(start + fwd, t_total)
             x_chunk = x[:, start:end]
@@ -453,6 +503,7 @@ class MultiLayerNetwork:
             updates, ostate = opt.update(g, ostate, lp)
             return optax.apply_updates(lp, updates), ostate, loss
 
+        self._pending_score = None   # pretraining scores are synchronous
         for _ in range(epochs):
             if hasattr(data, "reset"):
                 data.reset()
@@ -499,7 +550,7 @@ class MultiLayerNetwork:
     def score(self, dataset=None) -> float:
         """Last minibatch score, or score of a given DataSet (ref: #score)."""
         if dataset is None:
-            return self._score
+            return self._sync_score()
         x = jnp.asarray(_unwrap(dataset.features))
         y = jnp.asarray(_unwrap(dataset.labels))
         loss, _ = self._loss_fn(self._params, self._states, x, y, None, None, None, None)

@@ -67,7 +67,8 @@ class TrainingMetrics:
 
     def record_step(self, batch_size: int, score: float,
                     compute_seconds: float, callback_seconds: float,
-                    data_wait_seconds: Optional[float] = None):
+                    data_wait_seconds: Optional[float] = None,
+                    pipelined: bool = False):
         total = compute_seconds + callback_seconds
         if data_wait_seconds is not None:
             self.data_wait.observe(data_wait_seconds)
@@ -80,7 +81,13 @@ class TrainingMetrics:
             self.examples.inc(batch_size)
         if score == score:                      # skip NaN
             self.score.set(score)
-        self.straggler.observe(total)
+        if not pipelined:
+            # under the async runtime's deferred loss fetch, per-call wall
+            # time is dispatch-only for most steps and a whole window of
+            # queued device work at sync points — every sync step would
+            # read as a straggler against the dispatch-time median, so the
+            # detector only sees honestly per-step-synchronous loops
+            self.straggler.observe(total)
 
 
 def for_model(model) -> TrainingMetrics:

@@ -677,7 +677,15 @@ def fused_batch_norm(x, scale, offset, mean=None, variance=None,
     """TF training-mode FusedBatchNorm semantics: returns (y, batch_mean,
     batch_var); NHWC. y normalizes with the BIASED batch variance, while
     the returned batch_var is Bessel-corrected (N/(N-1)) — what TF feeds
-    the moving-variance update."""
+    the moving-variance update.
+
+    Batch statistics are computed in f32 (one_pass_moments) but returned in
+    the MOVING-VARIABLE dtype — the dtype of the incoming moving mean/var,
+    falling back to scale's. The imported graph's moving-average update
+    site (assign_sub on the stored variables) consumes these outputs
+    directly; returning f32 there would silently promote a bf16 imported
+    model's stored statistics to f32."""
+    stat_dtype = getattr(mean if mean is not None else scale, "dtype", None)
     if is_training or mean is None:
         from deeplearning4j_tpu.ops.moments import one_pass_moments
         n = float(np.prod([x.shape[i] for i in (0, 1, 2)]))
@@ -687,6 +695,9 @@ def fused_batch_norm(x, scale, offset, mean=None, variance=None,
         var_out = variance
     inv = lax.rsqrt(variance + epsilon)
     y = (x - mean) * inv * scale + offset
+    if stat_dtype is not None:
+        mean = jnp.asarray(mean).astype(stat_dtype)
+        var_out = jnp.asarray(var_out).astype(stat_dtype)
     return y.astype(x.dtype), mean, var_out
 
 

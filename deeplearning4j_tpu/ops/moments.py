@@ -14,7 +14,12 @@ negative variance turns ``rsqrt(var + eps)`` into NaN. The clamp to zero
 restores ``jnp.var``'s non-negativity guarantee (gradients are unaffected
 wherever the clamp is inactive, i.e. everywhere the statistics are usable).
 Short of the clamp, relative accuracy degrades as (mean/std)^2 * 2^-23 —
-e.g. mean~1e3, std~1 loses ~12% of the variance. This is the SAME tradeoff
+e.g. mean~1e3, std~1 loses ~12% of the variance. The clamp regime — where
+cancellation is total and the returned variance collapses to exactly 0 —
+begins where that relative error reaches ~1, i.e. (mean/std)^2 ≳ 2^23, or
+|mean|/std ≳ 2^11.5 ≈ 2.9e3 in f32 (in bf16's 8-bit mantissa the same
+threshold is |mean|/std ≳ 2^4 = 16, which is why the accumulation below is
+forced to >= f32). This is the SAME tradeoff
 flax.linen.normalization makes (its ``_compute_stats`` uses the identical
 one-pass form), i.e. parity with the ecosystem twin, and normalization-layer
 inputs in practice sit near zero mean; callers with pathological offsets

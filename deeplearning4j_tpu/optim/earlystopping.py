@@ -287,7 +287,11 @@ class EarlyStoppingTrainer:
                 self.train_data.reset()
             self.network.fit(self.train_data, epochs=1)
             # iteration-level conditions checked against the training score
-            tscore = getattr(self.network, "_score", float("nan"))
+            # (score() is a sync point: it materializes a loss the async
+            # fit loop may have left on device)
+            tscore = (self.network.score()
+                      if callable(getattr(self.network, "score", None))
+                      else getattr(self.network, "_score", float("nan")))
             for cond in cfg.iteration_conditions:
                 if cond.terminate(tscore):
                     reason = "IterationTerminationCondition"

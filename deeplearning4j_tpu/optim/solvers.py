@@ -50,6 +50,9 @@ class BaseOptimizer:
 
     def _iteration_done(self, score):
         net = self.net
+        # drop any deferred device-side loss a prior async fit left behind —
+        # a later score() must not overwrite this fresh value with it
+        net._pending_score = None
         net._score = float(score)
         net._iteration += 1
         for lst in net._listeners:

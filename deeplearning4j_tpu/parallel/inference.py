@@ -5,19 +5,38 @@ per-device model replicas with INSTANT / BATCHED modes. TPU-first collapse:
 there is ONE compiled program; "replicas" are the mesh's data-axis shards,
 and XLA already pipelines concurrent calls. What survives is the *dynamic
 batching* queue: BATCHED mode coalesces concurrent small requests into one
-device call (padding to the configured batch size so the executable is
-reused), which is where serving throughput on an accelerator comes from.
+device call (padding so the executable is reused), which is where serving
+throughput on an accelerator comes from.
+
+Async pipeline (default; kill switch ``DL4J_TPU_ASYNC=0``): the serve loop
+is split into three stages so several device batches stay in flight —
+
+    producers → request queue → **batcher** (coalesce + pad to a
+    power-of-two shape bucket) → **dispatcher** (non-blocking device
+    dispatch, up to ``inflight_limit`` batches queued on the device) →
+    **completer** (blocks on the device→host transfer, distributes
+    per-request slices)
+
+Batch *k+1* dispatches while batch *k*'s results transfer back. Padding
+goes to the next power-of-two bucket ≤ ``batch_limit`` instead of always
+``batch_limit``: a small bounded set of compiled executables
+(log2(limit)+1) in exchange for far less padded compute at partial
+occupancy. Under ``DL4J_TPU_ASYNC=0`` the original single-threaded loop
+runs: one batch in flight, pad-to-``batch_limit``, byte-identical
+synchronous behavior.
 """
 from __future__ import annotations
 
+import bisect
 import queue
 import threading
 import time
 import weakref
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
+from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
 
@@ -60,6 +79,22 @@ class _ServingMetrics:
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
         self.batches = reg.counter("dl4j_inference_batches_total",
                                    "device calls issued by the serve loop")
+        self.inflight = reg.gauge(
+            "dl4j_inference_inflight_batches",
+            "device batches dispatched but not yet completed (serving "
+            "pipeline depth; bounded by inflight_limit)")
+        bucket = reg.counter(
+            "dl4j_inference_bucket_total",
+            "shape-bucket outcomes per device call: hit = padded shape "
+            "already compiled for this instance, miss = first use",
+            label_names=("outcome",))
+        self.bucket_hits = bucket.labels(outcome="hit")
+        self.bucket_misses = bucket.labels(outcome="miss")
+        self.bucket_fill = reg.histogram(
+            "dl4j_inference_bucket_fill",
+            "coalesced examples / padded bucket size per device call "
+            "(1.0 = zero padded compute waste)",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
 
     @classmethod
     def get(cls) -> "_ServingMetrics":
@@ -89,7 +124,7 @@ class ParallelInference:
     """ref API: ParallelInference.Builder(model).inferenceMode(...)
     .batchLimit(n).queueLimit(n).build(); output(x).
 
-    Instances own a serve thread (BATCHED mode); call :meth:`shutdown` (or
+    Instances own serve threads (BATCHED mode); call :meth:`shutdown` (or
     use as a context manager) when done. :meth:`shutdown_all` stops every
     live instance — the test harness's safety net against leaked serve
     threads keeping the process's jit caches and buffers alive."""
@@ -98,11 +133,33 @@ class ParallelInference:
 
     def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64,
-                 max_wait_ms: float = 5.0, workers: Optional[int] = None):
+                 max_wait_ms: float = 5.0, workers: Optional[int] = None,
+                 inflight_limit: Optional[int] = None,
+                 bucket_sizes: Optional[Sequence[int]] = None):
         self.model = model
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
+        # pipeline depth + padding buckets (async serving; see module doc).
+        # Both resolved here so a running instance has stable behavior even
+        # if the env knobs change mid-flight.
+        self.inflight_limit = max(1, inflight_limit if inflight_limit
+                                  is not None else _async.inflight_limit())
+        if bucket_sizes:
+            buckets = tuple(sorted({int(b) for b in bucket_sizes
+                                    if 0 < int(b) <= batch_limit}))
+            if not buckets:
+                # refuse loudly: silently swapping in the defaults would
+                # hand the caller six compiled shapes they never asked for
+                raise ValueError(
+                    f"bucket_sizes {tuple(bucket_sizes)} has no entry in "
+                    f"(0, batch_limit={batch_limit}]")
+        else:
+            buckets = _async.default_buckets(batch_limit)
+        self.bucket_sizes = buckets + ((batch_limit,)
+                                       if buckets[-1] != batch_limit else ())
+        self._async = _async.async_enabled()
+        self._seen_buckets: set = set()
         # workers: shard the forward over the first N devices (the
         # reference's per-device replicas become one data-parallel SPMD
         # program); None = single-program forward on the default device
@@ -116,15 +173,29 @@ class ParallelInference:
                                            devices=jax.devices()[:n])
             self._n_dev = n
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
-        self._worker: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         # serializes enqueue vs shutdown-drain so a request can never be
-        # enqueued after the drain and hang forever
+        # enqueued after the drain and hang forever; the condition wakes
+        # producers blocked on a full queue the instant the batcher drains
+        # it (no busy-wait poll)
         self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._held: Optional[_Request] = None  # window overflow carry
         if self.mode == InferenceMode.BATCHED:
-            self._worker = threading.Thread(target=self._serve_loop,
-                                            daemon=True)
-            self._worker.start()
+            if self._async:
+                self._dispatch_q: queue.Queue = queue.Queue(maxsize=2)
+                self._complete_q: queue.Queue = queue.Queue(
+                    maxsize=self.inflight_limit)
+                targets = (self._batch_loop, self._dispatch_loop,
+                           self._complete_loop)
+            else:
+                targets = (self._serve_loop,)
+            for tgt in targets:
+                t = threading.Thread(target=tgt, daemon=True,
+                                     name=f"dl4j-serve-{tgt.__name__}")
+                t.start()
+                self._threads.append(t)
         ParallelInference._live.add(self)
 
     def __enter__(self):
@@ -136,7 +207,7 @@ class ParallelInference:
 
     @classmethod
     def shutdown_all(cls):
-        """Stop every live instance's serve thread (test-harness teardown)."""
+        """Stop every live instance's serve threads (test-harness teardown)."""
         for pi in list(cls._live):
             pi.shutdown()
 
@@ -167,21 +238,40 @@ class ParallelInference:
             self._kw["workers"] = n
             return self
 
+        def inflight_limit(self, n):
+            self._kw["inflight_limit"] = n
+            return self
+
+        inflightLimit = inflight_limit
+
+        def bucket_sizes(self, sizes):
+            self._kw["bucket_sizes"] = tuple(sizes)
+            return self
+
+        bucketSizes = bucket_sizes
+
         def build(self):
             return ParallelInference(self._model, **self._kw)
 
     # ----------------------------------------------------------------- api
     def _forward(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._forward_async(x))
+
+    def _forward_async(self, x: np.ndarray):
+        """Dispatch the forward and return the DEVICE result without
+        blocking (JAX async dispatch) — the completer stage materializes
+        it. ``np.asarray`` on the return value is the device→host sync."""
         if self._trainer is None:
-            return np.asarray(self.model.output(x))
+            out = self.model.output(x)
+            return out.buf() if hasattr(out, "buf") else out
         # pad ragged batches up to the device count so the sharded program
         # always sees a divisible leading axis
         pad = (-x.shape[0]) % self._n_dev
         if pad:
-            xp = np.concatenate(
+            x = np.concatenate(
                 [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-            return np.asarray(self._trainer.output(xp))[: x.shape[0]]
-        return np.asarray(self._trainer.output(x))
+        out = self._trainer.output(x)
+        return out.buf() if hasattr(out, "buf") else out
 
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
@@ -189,7 +279,7 @@ class ParallelInference:
         t0 = time.perf_counter()
         if self.mode == InferenceMode.INSTANT:
             try:
-                out = self._forward(x)
+                out = self._forward(x)[: x.shape[0]]
             except Exception:
                 obs.errors.inc()
                 raise
@@ -198,10 +288,12 @@ class ParallelInference:
             obs.requests[InferenceMode.INSTANT].inc()
             return out
         req = _Request(x)
-        while True:
-            # non-blocking put under the lock: a blocking put here would
-            # hold the lock while the queue is full and deadlock shutdown()
-            with self._lock:
+        # condition-based enqueue: a producer facing a full queue sleeps on
+        # the condition and is woken by the batcher the moment it drains a
+        # request — no 1 ms busy-wait poll, no burned CPU. The timeout is
+        # belt-and-braces against a lost wakeup racing shutdown.
+        with self._not_full:
+            while True:
                 if self._stop.is_set():
                     raise RuntimeError("ParallelInference has been shut down")
                 try:
@@ -209,8 +301,7 @@ class ParallelInference:
                     obs.queue_depth.set(self._queue.qsize())
                     break
                 except queue.Full:
-                    pass
-            time.sleep(0.001)
+                    self._not_full.wait(timeout=0.1)
         req.event.wait()
         obs.latency[InferenceMode.BATCHED].observe(time.perf_counter() - t0)
         obs.requests[InferenceMode.BATCHED].inc()
@@ -221,8 +312,12 @@ class ParallelInference:
 
     def shutdown(self):
         self._stop.set()
-        if self._worker is not None:
-            self._worker.join(timeout=2.0)
+        # wake producers parked on the not-full condition so they observe
+        # the stop flag instead of waiting out their timeout
+        with self._not_full:
+            self._not_full.notify_all()
+        for t in self._threads:
+            t.join(timeout=2.0)
         # fail any requests that were still queued so callers never hang
         with self._lock:
             while True:
@@ -232,68 +327,228 @@ class ParallelInference:
                     break
                 req.error = RuntimeError("ParallelInference shut down")
                 req.event.set()
+        # stage-queue sweep: a batcher put can race the dispatcher's exit
+        # (fail those — never dispatched), and if a join above timed out a
+        # completed-but-unclaimed batch may remain (finish those)
+        if getattr(self, "_dispatch_q", None) is not None:
+            obs = _ServingMetrics.get()
+            while True:
+                try:
+                    _, batch, _ = self._dispatch_q.get_nowait()
+                except queue.Empty:
+                    break
+                self._fail(batch, RuntimeError("ParallelInference shut down"))
+            while True:
+                try:
+                    item = self._complete_q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is self._DONE:
+                    # re-deliver: a completer whose join timed out is still
+                    # parked on get() and exits only on this marker —
+                    # swallowing it would strand that thread forever. The
+                    # marker is always last in FIFO order, so stop here.
+                    self._complete_q.put(item)
+                    break
+                self._complete_one(obs, *item)
 
-    # ---------------------------------------------------------- batch loop
-    def _serve_loop(self):
-        import time as _time
+    # ------------------------------------------------------- batching stage
+    def _take_request(self, timeout: float) -> Optional[_Request]:
+        """Pop one request (or the held window overflow), waking any
+        producer blocked on the full queue."""
+        if self._held is not None:
+            req, self._held = self._held, None
+            return req
+        try:
+            req = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        with self._not_full:
+            self._not_full.notify()
+        return req
 
+    def _next_window(self) -> Optional[List[_Request]]:
+        """Coalesce one batch window, never exceeding batch_limit (the
+        shared heart of both the sync loop and the async batcher)."""
+        first = self._take_request(timeout=0.1)
+        if first is None:
+            return None
         obs = _ServingMetrics.get()
-        held: Optional[_Request] = None  # overflow from the previous window
+        obs.queue_depth.set(self._queue.qsize())
+        batch: List[_Request] = [first]
+        total = first.x.shape[0]
+        deadline = time.monotonic() + self.max_wait_ms / 1e3
+        while total < self.batch_limit:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            nxt = self._take_request(timeout=remaining)
+            if nxt is None:
+                break
+            if total + nxt.x.shape[0] > self.batch_limit:
+                # too big for this batch: hold it locally to seed the
+                # next one — putting it back on a bounded queue that
+                # producers may have refilled would deadlock the sole
+                # consumer (and break FIFO order)
+                self._held = nxt
+                break
+            batch.append(nxt)
+            total += nxt.x.shape[0]
+        return batch
+
+    def _bucket_for(self, n: int) -> int:
+        """Smallest configured bucket that fits ``n`` examples."""
+        i = bisect.bisect_left(self.bucket_sizes, n)
+        return self.bucket_sizes[min(i, len(self.bucket_sizes) - 1)]
+
+    def _pad_concat(self, batch: List[_Request], target: int):
+        """Concatenate a window and zero-pad the leading axis to ``target``
+        so the compiled executable for that shape is reused."""
+        X = np.concatenate([r.x for r in batch], axis=0)
+        n = X.shape[0]
+        if n < target:
+            pad = np.zeros((target - n,) + X.shape[1:], X.dtype)
+            X = np.concatenate([X, pad], axis=0)
+        return X, n
+
+    @staticmethod
+    def _fail(batch: List[_Request], error: BaseException):
+        for r in batch:
+            r.error = error
+            r.event.set()
+
+    @staticmethod
+    def _distribute(batch: List[_Request], out: np.ndarray):
+        off = 0
+        for r in batch:
+            k = r.x.shape[0]
+            r.result = out[off:off + k]
+            off += k
+            r.event.set()
+
+    def _observe_batch(self, obs: "_ServingMetrics", n: int, target: int):
+        obs.batch_occupancy.observe(n / max(self.batch_limit, 1))
+        obs.bucket_fill.observe(n / max(target, 1))
+        key = (target,)
+        if key in self._seen_buckets:
+            obs.bucket_hits.inc()
+        else:
+            self._seen_buckets.add(key)
+            obs.bucket_misses.inc()
+        obs.batches.inc()
+
+    # ------------------------------------------------- sync loop (ASYNC=0)
+    def _serve_loop(self):
+        """Single-threaded synchronous serve loop: one batch in flight,
+        pad to batch_limit — the DL4J_TPU_ASYNC=0 behavior."""
+        obs = _ServingMetrics.get()
         while not self._stop.is_set():
-            if held is not None:
-                first, held = held, None
-            else:
-                try:
-                    first = self._queue.get(timeout=0.1)
-                except queue.Empty:
-                    continue
-            obs.queue_depth.set(self._queue.qsize())
-            batch: List[_Request] = [first]
-            total = first.x.shape[0]
-            # coalesce within ONE wait window, never exceeding batch_limit
-            # (exceeding it would skip the fixed-shape padding and trigger
-            # an XLA recompile per distinct total)
-            deadline = _time.monotonic() + self.max_wait_ms / 1e3
-            while total < self.batch_limit:
-                remaining = deadline - _time.monotonic()
-                if remaining <= 0:
-                    break
-                try:
-                    nxt = self._queue.get(timeout=remaining)
-                except queue.Empty:
-                    break
-                if total + nxt.x.shape[0] > self.batch_limit:
-                    # too big for this batch: hold it locally to seed the
-                    # next one — putting it back on a bounded queue that
-                    # producers may have refilled would deadlock the sole
-                    # consumer (and break FIFO order)
-                    held = nxt
-                    break
-                batch.append(nxt)
-                total += nxt.x.shape[0]
+            batch = self._next_window()
+            if batch is None:
+                continue
             try:
-                X = np.concatenate([r.x for r in batch], axis=0)
-                n = X.shape[0]
-                # pad to batch_limit so the compiled executable is reused
-                if n < self.batch_limit:
-                    pad = np.zeros((self.batch_limit - n,) + X.shape[1:],
-                                   X.dtype)
-                    X = np.concatenate([X, pad], axis=0)
-                obs.batch_occupancy.observe(n / max(self.batch_limit, 1))
-                obs.batches.inc()
+                X, n = self._pad_concat(batch, self.batch_limit)
+                self._observe_batch(obs, n, self.batch_limit)
                 with _span("inference_batch", requests=len(batch),
                            examples=n):
                     out = self._forward(X)[:n]
-                off = 0
-                for r in batch:
-                    k = r.x.shape[0]
-                    r.result = out[off:off + k]
-                    off += k
-                    r.event.set()
+                self._distribute(batch, out)
             except Exception as e:             # surface errors to callers
-                for r in batch:
-                    r.error = e
-                    r.event.set()
-        if held is not None:                   # don't strand the overflow
-            held.error = RuntimeError("ParallelInference shut down")
-            held.event.set()
+                self._fail(batch, e)
+        if self._held is not None:             # don't strand the overflow
+            self._held.error = RuntimeError("ParallelInference shut down")
+            self._held.event.set()
+            self._held = None
+
+    # ------------------------------------------- async pipeline (default)
+    def _put_stage(self, q: queue.Queue, item) -> bool:
+        """Stop-aware bounded put between pipeline stages (backpressure:
+        a full downstream queue throttles this stage)."""
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _batch_loop(self):
+        """Stage 1 — coalesce request windows, pad to the shape bucket."""
+        obs = _ServingMetrics.get()
+        while not self._stop.is_set():
+            batch = self._next_window()
+            if batch is None:
+                continue
+            try:
+                total = sum(r.x.shape[0] for r in batch)
+                target = self._bucket_for(total)
+                X, n = self._pad_concat(batch, target)
+                self._observe_batch(obs, n, target)
+            except Exception as e:
+                self._fail(batch, e)
+                continue
+            if not self._put_stage(self._dispatch_q, (X, batch, n)):
+                self._fail(batch,
+                           RuntimeError("ParallelInference shut down"))
+        if self._held is not None:             # don't strand the overflow
+            self._held.error = RuntimeError("ParallelInference shut down")
+            self._held.event.set()
+            self._held = None
+
+    _DONE = object()    # dispatcher→completer end-of-stream marker
+
+    def _dispatch_loop(self):
+        """Stage 2 — non-blocking device dispatch; up to inflight_limit
+        batches queued on the device while earlier results transfer back."""
+        obs = _ServingMetrics.get()
+        try:
+            while True:
+                try:
+                    X, batch, n = self._dispatch_q.get(timeout=0.05)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        break
+                    continue
+                try:
+                    with _span("inference_dispatch", requests=len(batch),
+                               examples=n):
+                        dev = self._forward_async(X)
+                except Exception as e:         # trace/compile-time errors
+                    self._fail(batch, e)
+                    continue
+                if self._put_stage(self._complete_q, (dev, batch, n)):
+                    obs.inflight.set(self._complete_q.qsize())
+                else:
+                    # shutdown raced the handoff: materialize inline so
+                    # the callers still get their (valid) results
+                    self._complete_one(obs, dev, batch, n)
+        finally:
+            # end-of-stream marker: a plain blocking put is safe because
+            # the completer consumes until it sees the marker (it cannot
+            # exit first), and it happens-after every real put from this
+            # thread — so no dispatched batch is stranded behind the
+            # completer's exit check (that race existed with a
+            # stop-flag-only exit)
+            self._complete_q.put(self._DONE)
+
+    def _complete_one(self, obs, dev, batch, n):
+        try:
+            with _span("inference_complete", requests=len(batch),
+                       examples=n):
+                out = np.asarray(dev)[:n]      # device→host sync point
+            self._distribute(batch, out)
+        except Exception as e:                 # execution-time errors
+            self._fail(batch, e)
+
+    def _complete_loop(self):
+        """Stage 3 — block on the device→host transfer, hand out slices.
+        Exits only on the dispatcher's end-of-stream marker, which follows
+        every real item in queue order — in-flight batches always land."""
+        obs = _ServingMetrics.get()
+        while True:
+            item = self._complete_q.get()
+            if item is self._DONE:
+                break
+            dev, batch, n = item
+            self._complete_one(obs, dev, batch, n)
+            obs.inflight.set(self._complete_q.qsize())

@@ -216,19 +216,39 @@ class ShardedTrainer:
                             self._ds_mask(data, "labels"))
             self._check_preemption()
             return self
-        for _ in range(epochs):
-            for lst in net._listeners:
-                lst.on_epoch_start(net, net._epoch)
-            if hasattr(data, "reset"):
-                data.reset()
-            for ds in data:
-                self._fit_batch(ds.features, ds.labels,
-                                self._ds_mask(ds, "features"),
-                                self._ds_mask(ds, "labels"))
-                self._check_preemption()
-            for lst in net._listeners:
-                lst.on_epoch_end(net, net._epoch)
-            net._epoch += 1
+        # device prefetch with the trainer's own placement: batch k+1 is
+        # sharded onto the mesh on a background thread while step k
+        # computes (skipped multi-host — the global-array assembly there
+        # must happen on the thread that owns the per-process partition)
+        we_wrapped = False
+        if jax.process_count() == 1:
+            from deeplearning4j_tpu.data.iterators import (
+                DevicePrefetchIterator, _place_dataset)
+            wrapped = DevicePrefetchIterator.wrap(
+                data, placement=lambda ds: _place_dataset(
+                    ds, self._shard_batch))
+            we_wrapped, data = wrapped is not data, wrapped
+        try:
+            for _ in range(epochs):
+                for lst in net._listeners:
+                    lst.on_epoch_start(net, net._epoch)
+                if hasattr(data, "reset"):
+                    data.reset()
+                for ds in data:
+                    self._fit_batch(ds.features, ds.labels,
+                                    self._ds_mask(ds, "features"),
+                                    self._ds_mask(ds, "labels"))
+                    self._check_preemption()
+                # epoch boundary is a mandatory sync point (deferred loss)
+                net._sync_score()
+                for lst in net._listeners:
+                    lst.on_epoch_end(net, net._epoch)
+                net._epoch += 1
+        finally:
+            if we_wrapped:
+                # preemption/interrupt must not strand the prefetch thread
+                # with sharded device batches pinned
+                data.close()
         return self
 
     @staticmethod
@@ -269,7 +289,7 @@ class ShardedTrainer:
         return self.net.output(x)
 
     def score(self):
-        return self.net._score
+        return self.net._sync_score()
 
 
 class ParallelWrapper:

@@ -82,7 +82,7 @@ def main():
     if proc_id == 0:
         flat = np.asarray(net.params().buf())
         np.save(out_path, flat)
-        print(f"worker0 done score={net._score:.6f}")
+        print(f"worker0 done score={net.score():.6f}")
     else:
         print("worker1 done")
 

@@ -1,0 +1,377 @@
+"""Async hot paths (device prefetch, deferred loss fetch, multi-in-flight
+bucketed serving) — equivalence with the synchronous behavior, pipeline
+correctness under load and shutdown, and the shape-bucket executable reuse.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (ArrayDataSetIterator,
+                                               DevicePrefetchIterator)
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.listeners import CollectScoresListener
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                   ParallelInference)
+
+
+def _mlp_conf(seed=7):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).updater(Adam(1e-2)).list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+
+
+def _data(n=48, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 4).astype("f4")
+    y = np.eye(3, dtype="f4")[rng.randint(0, 3, n)]
+    return x, y
+
+
+def _params_flat(net):
+    return np.asarray(net.params())
+
+
+# --------------------------------------------------------------- training
+def _fit_once(monkeypatch, async_mode, listeners=(), epochs=2,
+              score_every=None):
+    monkeypatch.setenv("DL4J_TPU_ASYNC", async_mode)
+    if score_every is not None:
+        monkeypatch.setenv("DL4J_TPU_SCORE_EVERY", str(score_every))
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    if listeners:
+        net.setListeners(*listeners)
+    x, y = _data()
+    it = ArrayDataSetIterator(x, y, 8)
+    net.fit(it, epochs=epochs)
+    return net
+
+
+def test_async_equals_sync_fit_iterator(monkeypatch):
+    """DL4J_TPU_ASYNC on vs off: identical params and final score for the
+    iterator fit path (the deferred fetch and device prefetch change WHEN
+    the host blocks, never what the device computes)."""
+    sync = _fit_once(monkeypatch, "0")
+    asyn = _fit_once(monkeypatch, "1", score_every=3)
+    np.testing.assert_array_equal(_params_flat(sync), _params_flat(asyn))
+    assert sync.score() == pytest.approx(asyn.score(), rel=0, abs=0)
+
+
+def test_async_equals_sync_with_listeners(monkeypatch):
+    """Listeners need a float score every iteration, so their presence
+    forces the per-step sync — the collected score sequence must be
+    identical either way."""
+    l_sync = CollectScoresListener()
+    l_async = CollectScoresListener()
+    sync = _fit_once(monkeypatch, "0", listeners=(l_sync,))
+    asyn = _fit_once(monkeypatch, "1", listeners=(l_async,))
+    assert l_sync.scores == l_async.scores
+    np.testing.assert_array_equal(_params_flat(sync), _params_flat(asyn))
+
+
+def test_deferred_score_materializes_on_access(monkeypatch):
+    """fit(DataSet) defers the loss fetch (no listeners); score() is the
+    lazy sync point and must return the true last-step loss."""
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    monkeypatch.setenv("DL4J_TPU_SCORE_EVERY", "1000")
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    x, y = _data(16)
+    ds = DataSet(x, y)
+    for _ in range(3):
+        net.fit(ds)
+    assert net._pending_score is not None      # fetch actually deferred
+    s = net.score()
+    assert net._pending_score is None
+    assert np.isfinite(s)
+
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "0")
+    ref = MultiLayerNetwork(_mlp_conf()).init()
+    for _ in range(3):
+        ref.fit(ds)
+    assert s == pytest.approx(ref.score(), rel=0, abs=0)
+
+
+def test_computation_graph_async_equivalence(monkeypatch):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    def build():
+        return (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+                .graph_builder()
+                .add_inputs("in")
+                .set_input_types(InputType.feed_forward(4))
+                .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                              loss_function="mcxent"), "d")
+                .set_outputs("out").build())
+
+    x, y = _data(24)
+    it = ArrayDataSetIterator(x, y, 8)
+
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "0")
+    sync = ComputationGraph(build()).init()
+    sync.fit(it, epochs=2)
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    monkeypatch.setenv("DL4J_TPU_SCORE_EVERY", "3")
+    asyn = ComputationGraph(build()).init()
+    asyn.fit(ArrayDataSetIterator(x, y, 8), epochs=2)
+    assert sync.score() == pytest.approx(asyn.score(), rel=0, abs=0)
+    for name in sync._params:
+        for pname in sync._params[name]:
+            np.testing.assert_array_equal(
+                np.asarray(sync._params[name][pname]),
+                np.asarray(asyn._params[name][pname]))
+
+
+# --------------------------------------------------------- device prefetch
+def test_device_prefetch_matches_backing(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    x, y = _data(32)
+    ref = [(np.asarray(d.features), np.asarray(d.labels))
+           for d in ArrayDataSetIterator(x, y, 8)]
+    pre = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 8), depth=2)
+    got = [(np.asarray(d.features), np.asarray(d.labels)) for d in pre]
+    assert len(got) == len(ref)
+    for (fx, fy), (gx, gy) in zip(ref, got):
+        np.testing.assert_array_equal(fx, gx)
+        np.testing.assert_array_equal(fy, gy)
+    # batches arrive as committed device arrays (the whole point)
+    import jax
+    first = next(iter(pre))
+    assert isinstance(first.features, jax.Array)
+    # a second full pass after reset must see the same data
+    again = [(np.asarray(d.features), np.asarray(d.labels)) for d in pre]
+    assert len(again) == len(ref)
+    pre.close()
+    # next() past the end raises instead of blocking on a dead producer
+    tail = DevicePrefetchIterator(ArrayDataSetIterator(x, y, 16), depth=2)
+    while tail.has_next():
+        tail.next()
+    with pytest.raises(StopIteration):
+        tail.next()
+    tail.close()
+
+
+def test_device_prefetch_wrap_respects_kill_switch(monkeypatch):
+    x, y = _data(16)
+    it = ArrayDataSetIterator(x, y, 8)
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "0")
+    assert DevicePrefetchIterator.wrap(it) is it
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    wrapped = DevicePrefetchIterator.wrap(it)
+    assert isinstance(wrapped, DevicePrefetchIterator)
+    # no double wrap; non-iterators pass through
+    assert DevicePrefetchIterator.wrap(wrapped) is wrapped
+    assert DevicePrefetchIterator.wrap([1, 2]) == [1, 2]
+    wrapped.close()
+
+
+def test_device_prefetch_surfaces_producer_error(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+
+    class Exploding(ArrayDataSetIterator):
+        def next(self):
+            if self._pos >= 8:
+                raise ValueError("boom")
+            return super().next()
+
+    x, y = _data(32)
+    pre = DevicePrefetchIterator(Exploding(x, y, 8), depth=2)
+    with pytest.raises(ValueError, match="boom"):
+        while pre.has_next():
+            pre.next()
+    pre.close()
+
+
+# ------------------------------------------------------------------ serving
+def _net():
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    return net
+
+
+class _ShapeRecorder:
+    """Model proxy that records the padded batch sizes hitting the device."""
+
+    def __init__(self, net):
+        self._net = net
+        self.sizes = []
+        self._lock = threading.Lock()
+
+    def output(self, x):
+        with self._lock:
+            self.sizes.append(int(np.asarray(x).shape[0]))
+        return self._net.output(x)
+
+
+def test_bucketed_padding_reuses_one_shape(monkeypatch):
+    """Two request sizes in the same power-of-two bucket must produce ONE
+    padded device shape (one compiled executable), not two."""
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    net = _net()
+    rec = _ShapeRecorder(net)
+    pi = (ParallelInference.Builder(rec)
+          .inference_mode(InferenceMode.BATCHED)
+          .batch_limit(32).build())
+    try:
+        x, _ = _data(16)
+        r5 = pi.output(x[:5])
+        r7 = pi.output(x[:7])
+        assert r5.shape[0] == 5 and r7.shape[0] == 7
+        assert set(rec.sizes) == {8}, rec.sizes   # both padded to bucket 8
+        direct = np.asarray(net.output(x[:7]))
+        np.testing.assert_allclose(np.asarray(r7), direct, atol=1e-5)
+    finally:
+        pi.shutdown()
+
+
+def test_sync_mode_pads_to_batch_limit(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "0")
+    net = _net()
+    rec = _ShapeRecorder(net)
+    pi = (ParallelInference.Builder(rec)
+          .inference_mode(InferenceMode.BATCHED)
+          .batch_limit(16).build())
+    try:
+        x, _ = _data(8)
+        pi.output(x[:5])
+        assert rec.sizes == [16]                  # byte-identical old path
+    finally:
+        pi.shutdown()
+
+
+def test_inflight_pipeline_concurrent_correctness(monkeypatch):
+    """Many concurrent callers through the batcher->dispatcher->completer
+    pipeline: every caller gets exactly its slice back."""
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    net = _net()
+    x, _ = _data(64, seed=3)
+    direct = np.asarray(net.output(x))
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED)
+          .batch_limit(8).queue_limit(4).inflight_limit(3).build())
+    results, errors = {}, []
+
+    def call(off, n):
+        try:
+            results[off] = pi.output(x[off:off + n])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    sizes = [1, 3, 2, 3, 1, 3, 2, 1, 3, 2, 3, 1, 3, 2, 1, 1, 4, 2, 3, 1]
+    threads, off = [], 0
+    for n in sizes:
+        threads.append(threading.Thread(target=call, args=(off, n)))
+        off += n
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "pipeline deadlocked"
+        assert not errors, errors
+        off = 0
+        for n in sizes:
+            np.testing.assert_allclose(results[off], direct[off:off + n],
+                                       atol=1e-5)
+            off += n
+    finally:
+        pi.shutdown()
+
+
+def test_shutdown_under_load_never_hangs(monkeypatch):
+    """Shutdown racing active callers: every caller either gets a correct
+    result or a RuntimeError — nobody blocks forever."""
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    net = _net()
+    x, _ = _data(64, seed=5)
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED)
+          .batch_limit(4).queue_limit(2).build())
+    outcomes = []
+
+    def call(off):
+        try:
+            r = pi.output(x[off:off + 2])
+            outcomes.append(("ok", off, r))
+        except RuntimeError:
+            outcomes.append(("shutdown", off, None))
+
+    threads = [threading.Thread(target=call, args=(i * 2,))
+               for i in range(16)]
+    for t in threads:
+        t.start()
+    pi.shutdown()
+    for t in threads:
+        t.join(timeout=20)
+    assert not any(t.is_alive() for t in threads), "caller hung in shutdown"
+    direct = np.asarray(net.output(x))
+    for kind, off, r in outcomes:
+        if kind == "ok":
+            np.testing.assert_allclose(r, direct[off:off + 2], atol=1e-5)
+    with pytest.raises(RuntimeError):
+        pi.output(x[:1])
+
+
+def test_full_queue_producer_wakes_without_busy_wait(monkeypatch):
+    """A producer blocked on a full request queue parks on the condition
+    variable and completes once the batcher drains — covers the
+    notify-on-consume path for both serve-loop variants."""
+    for mode in ("0", "1"):
+        monkeypatch.setenv("DL4J_TPU_ASYNC", mode)
+        net = _net()
+        x, _ = _data(32, seed=9)
+        direct = np.asarray(net.output(x))
+        pi = (ParallelInference.Builder(net)
+              .inference_mode(InferenceMode.BATCHED)
+              .batch_limit(4).queue_limit(1).build())
+        results = {}
+
+        def call(off):
+            results[off] = pi.output(x[off:off + 2])
+
+        threads = [threading.Thread(target=call, args=(i * 2,))
+                   for i in range(8)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not any(t.is_alive() for t in threads), \
+                f"producer starved (async={mode})"
+            for off in results:
+                np.testing.assert_allclose(results[off],
+                                           direct[off:off + 2], atol=1e-5)
+        finally:
+            pi.shutdown()
+
+
+def test_sharded_trainer_prefetch_and_deferred_score(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec
+    from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+    x, y = _data(32)
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "0")
+    ref = MultiLayerNetwork(_mlp_conf()).init()
+    ShardedTrainer(ref, MeshSpec.data_parallel(2),
+                   devices=jax.devices()[:2]).fit(
+        ArrayDataSetIterator(x, y, 8), epochs=2)
+    ref_score = ref.score()
+
+    monkeypatch.setenv("DL4J_TPU_ASYNC", "1")
+    net = MultiLayerNetwork(_mlp_conf()).init()
+    tr = ShardedTrainer(net, MeshSpec.data_parallel(2),
+                        devices=jax.devices()[:2])
+    tr.fit(ArrayDataSetIterator(x, y, 8), epochs=2)
+    assert tr.score() == pytest.approx(ref_score, rel=0, abs=0)
+    np.testing.assert_array_equal(_params_flat(ref), _params_flat(net))

@@ -372,6 +372,25 @@ class TestTranche4:
         # batch_variance output is the Bessel-corrected one (TF semantics)
         np.testing.assert_allclose(np.asarray(v), tv.numpy(), rtol=1e-4)
 
+    def test_fused_batch_norm_keeps_moving_variable_dtype(self):
+        """ADVICE r5: the moving-average update site consumes the batch
+        mean/var outputs directly — a bf16 imported model's stored state
+        must not silently promote to the f32 the stats are computed in."""
+        import jax.numpy as jnp
+        x = jnp.asarray(rnd(2, 4, 4, 3, seed=94), jnp.bfloat16)
+        scale = jnp.asarray(np.abs(rnd(3, seed=95)) + 0.5, jnp.bfloat16)
+        offset = jnp.zeros((3,), jnp.bfloat16)
+        # training mode, no moving stats passed: stat dtype falls back to
+        # the (bf16) scale variable
+        y, m, v = exec_op("fused_batch_norm", x, scale, offset)
+        assert y.dtype == jnp.bfloat16
+        assert m.dtype == jnp.bfloat16 and v.dtype == jnp.bfloat16
+        # f32 variables keep f32 stats (no behavior change)
+        y32, m32, v32 = exec_op("fused_batch_norm", np.asarray(x, "f4"),
+                                np.asarray(scale, "f4"),
+                                np.asarray(offset, "f4"))
+        assert m32.dtype == jnp.float32 and v32.dtype == jnp.float32
+
     def test_histogram(self):
         x = np.array([0.0, 0.1, 0.9, 1.0, 0.5], np.float32)
         h = exec_op("histogram", x, num_bins=2)
