@@ -1,12 +1,18 @@
 """Instrumentation overhead guard (observability PR acceptance tool).
 
-Measures the lenet train step with the observability substrate enabled
-(default) vs disabled (``DL4J_TPU_METRICS=0``) and prints the overhead %.
-The acceptance bar is <5% on CPU; future PRs adding instrumentation points
-run this to keep the cost honest.
+Measures the lenet train step in three modes, interleaved A/B/C with a
+min-estimator:
 
-Each mode runs in a fresh subprocess: the kill switch is applied at
-instrument creation, so flipping it in-process after modules warmed up
+- ``off``      — ``DL4J_TPU_METRICS=0`` (everything no-ops)
+- ``no_trace`` — metrics on, ``DL4J_TPU_TRACE=0`` (spans + trace-context
+  propagation disabled; isolates the causal-tracing cost)
+- ``on``       — full default instrumentation
+
+Acceptance bars: total overhead (on vs off) <5%; trace-id propagation
+overhead (on vs no_trace) <2%.
+
+Each mode runs in a fresh subprocess: the kill switches are applied at
+instrument creation, so flipping them in-process after modules warmed up
 would measure the wrong thing.
 
 Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
@@ -48,8 +54,8 @@ print(json.dumps({"seconds_per_step": wall / steps,
 """
 
 
-def _run(steps: int, batch: int, metrics: str) -> float:
-    env = dict(os.environ, DL4J_TPU_METRICS=metrics)
+def _run(steps: int, batch: int, metrics: str, trace: str = "1") -> float:
+    env = dict(os.environ, DL4J_TPU_METRICS=metrics, DL4J_TPU_TRACE=trace)
     env.setdefault("JAX_PLATFORMS", "cpu")
     out = subprocess.run(
         [sys.executable, "-c", _WORKER, str(steps), str(batch)],
@@ -62,30 +68,39 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--repeats", type=int, default=3,
-                    help="interleaved A/B process pairs; min per mode wins")
+                    help="interleaved A/B/C process triples; min per mode "
+                         "wins")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
-    # interleaved A/B pairs with a min-estimator: a lone pair is dominated
-    # by host warmup noise (the first subprocess routinely runs 1.5x slower
+    # interleaved triples with a min-estimator: a lone run is dominated by
+    # host warmup noise (the first subprocess routinely runs 1.5x slower
     # than steady state regardless of mode)
-    offs, ons = [], []
+    offs, no_traces, ons = [], [], []
     for _ in range(args.repeats):
         offs.append(_run(args.steps, args.batch, "0"))
+        no_traces.append(_run(args.steps, args.batch, "1", trace="0"))
         ons.append(_run(args.steps, args.batch, "1"))
-    off, on = min(offs), min(ons)
+    off, no_trace, on = min(offs), min(no_traces), min(ons)
     overhead = (on - off) / off * 100.0
+    trace_overhead = (on - no_trace) / no_trace * 100.0
     result = {"lenet_step_seconds_uninstrumented": off,
+              "lenet_step_seconds_metrics_only": no_trace,
               "lenet_step_seconds_instrumented": on,
               "overhead_percent": overhead,
+              "trace_overhead_percent": trace_overhead,
               "steps": args.steps, "batch": args.batch}
     if args.json:
         print(json.dumps(result, indent=2))
     else:
         print(f"lenet step, batch={args.batch}, {args.steps} steps/mode")
         print(f"  uninstrumented (DL4J_TPU_METRICS=0): {off * 1e3:8.3f} ms")
+        print(f"  metrics only   (DL4J_TPU_TRACE=0):   "
+              f"{no_trace * 1e3:8.3f} ms")
         print(f"  instrumented   (default):            {on * 1e3:8.3f} ms")
-        print(f"  overhead: {overhead:+.2f}%  (acceptance bar: < 5%)")
+        print(f"  total overhead: {overhead:+.2f}%  (bar: < 5%)")
+        print(f"  trace-context overhead: {trace_overhead:+.2f}%  "
+              f"(bar: < 2%)")
     return overhead
 
 

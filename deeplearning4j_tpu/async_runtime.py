@@ -26,6 +26,17 @@ Knobs (env var → default):
 ``DL4J_TPU_SCORE_EVERY``      ``16``   steps between loss materializations
 ``DL4J_TPU_INFLIGHT``         ``2``    serving batches dispatched but uncompleted
 ============================  =======  ==========================================
+
+Because the async pipelines are exactly what a hung run was doing when it
+hung, :func:`snapshot` returns every live knob value — the flight recorder
+(observability/flight_recorder.py) folds it into each postmortem bundle.
+Related observability knobs (read by that package, listed here for one
+discoverable table): ``DL4J_TPU_TRACE=0`` disables span recording while
+metrics stay live, ``DL4J_TPU_HANG_SECONDS`` sets the no-progress watchdog
+threshold (default 300), ``DL4J_TPU_POSTMORTEM_DIR`` the bundle directory,
+``DL4J_TPU_POSTMORTEM_KEEP`` the retained-bundle cap (default 8),
+``DL4J_TPU_FLIGHT_RECORDER=0`` disables the watchdog + crash hooks, and
+``DL4J_TPU_POSTMORTEM_ON_EXIT=1`` dumps a bundle at interpreter exit.
 """
 from __future__ import annotations
 
@@ -60,6 +71,18 @@ def inflight_limit() -> int:
     """Serving pipeline depth: device batches dispatched but not yet
     completed (dispatch batch k+1 while k's results transfer back)."""
     return _int_env("DL4J_TPU_INFLIGHT", 2)
+
+
+def snapshot() -> dict:
+    """Every live knob value — the async-runtime half of a postmortem
+    bundle (a hang report without the pipeline depths that shaped the hang
+    is not actionable)."""
+    return {
+        "async_enabled": async_enabled(),
+        "prefetch_depth": prefetch_depth(),
+        "score_sync_every": score_sync_every(),
+        "inflight_limit": inflight_limit(),
+    }
 
 
 def default_buckets(batch_limit: int) -> tuple:

@@ -15,6 +15,9 @@ import numpy as np
 from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
+from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability.tracing import (current_context,
+                                                      trace_context)
 
 _obs_cache: dict = {}
 
@@ -311,6 +314,12 @@ class DevicePrefetchIterator(DataSetIterator):
         q = self._queue = queue.Queue(maxsize=self._depth)
         stop = self._stop = threading.Event()
         backing, place = self._backing, self._place
+        # causal handoff: capture the CONSUMER's trace context (the fit
+        # loop that first pulls a batch) so every prefetch span on the
+        # producer thread parents into the fit trace — Perfetto then draws
+        # the fit→prefetch arrows instead of orphan fragments
+        ctx = current_context()
+        kind = type(backing).__name__
 
         def put_stop_aware(item) -> bool:
             # never park forever on a consumer that went away mid-epoch:
@@ -324,26 +333,31 @@ class DevicePrefetchIterator(DataSetIterator):
             return False
 
         def producer():
-            try:
-                while not stop.is_set():
-                    try:
-                        # has_next() inside the try too: an iterator that
-                        # raises probing for data (corrupt shard, IO error)
-                        # must surface to the consumer, not be laundered
-                        # into a clean end-of-epoch by the finally-sentinel
-                        if not backing.has_next():
-                            break
-                        item = place(backing.next())
-                    except Exception as e:  # surface on the consumer side
-                        item = DevicePrefetchIterator._Failure(e)
-                    put_stop_aware(item)
-                    if isinstance(item, DevicePrefetchIterator._Failure):
-                        return
-            finally:
-                # the sentinel MUST be delivered (a full queue here is the
-                # normal case — the consumer still owes `depth` reads), so
-                # block for it; the stop flag keeps close() live
-                put_stop_aware(self._SENTINEL)
+            with trace_context(ctx):
+                try:
+                    while not stop.is_set():
+                        try:
+                            # has_next() inside the try too: an iterator
+                            # that raises probing for data (corrupt shard,
+                            # IO error) must surface to the consumer, not
+                            # be laundered into a clean end-of-epoch by
+                            # the finally-sentinel
+                            if not backing.has_next():
+                                break
+                            with _span("prefetch_place", iterator=kind):
+                                item = place(backing.next())
+                        except Exception as e:  # surface on consumer side
+                            item = DevicePrefetchIterator._Failure(e)
+                        put_stop_aware(item)
+                        if isinstance(item,
+                                      DevicePrefetchIterator._Failure):
+                            return
+                finally:
+                    # the sentinel MUST be delivered (a full queue here is
+                    # the normal case — the consumer still owes `depth`
+                    # reads), so block for it; the stop flag keeps close()
+                    # live
+                    put_stop_aware(self._SENTINEL)
 
         self._thread = threading.Thread(target=producer, daemon=True,
                                         name="dl4j-device-prefetch")

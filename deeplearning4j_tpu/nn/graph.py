@@ -22,6 +22,8 @@ from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_flight_recorder as _flight)
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.multilayer import _grad_transform
@@ -277,7 +279,16 @@ class ComputationGraph:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(inputs, labels) | fit(DataSet/MultiDataSet) | fit(iterator)."""
+        """fit(inputs, labels) | fit(DataSet/MultiDataSet) | fit(iterator).
+
+        Runs under a root ``fit`` span (one trace across steps + the
+        prefetch thread) and armed on the flight recorder (no step
+        progress for DL4J_TPU_HANG_SECONDS ⇒ postmortem bundle)."""
+        with _flight().arm("fit:ComputationGraph"), \
+                _span("fit", model="ComputationGraph", epochs=epochs):
+            return self._fit_impl(data, labels, epochs)
+
+    def _fit_impl(self, data, labels=None, epochs: int = 1):
         if labels is not None:
             for _ in range(epochs):
                 self._fit_batch(_as_tuple(data), _as_tuple(labels))
@@ -382,6 +393,7 @@ class ComputationGraph:
         _tm.for_model(self).record_step(
             batch_n, self._score if sync_now else float("nan"), t1 - t0,
             time.perf_counter() - t1, data_wait, pipelined=defer_mode)
+        _flight().progress("train_step")
 
     def _fit_tbptt(self, inputs, labels, fmasks, lmasks, data_wait=None):
         """Truncated BPTT for graphs (ref: ComputationGraph#doTruncatedBPTT):
@@ -423,6 +435,7 @@ class ComputationGraph:
                 int(inputs[0].shape[0]) if inputs and start == 0 else 0,
                 self._score, t1 - t0, time.perf_counter() - t1,
                 data_wait if start == 0 else None)
+            _flight().progress("train_step")
 
     # ------------------------------------------------------------- inference
     @functools.partial(jax.jit, static_argnums=(0,))

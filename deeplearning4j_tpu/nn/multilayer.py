@@ -26,6 +26,8 @@ from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn import params as _flat
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_flight_recorder as _flight)
 from deeplearning4j_tpu.nn.conf.configuration import BackpropType, MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn._precision import (_COMPUTE_DTYPES, _cast_float,
@@ -322,7 +324,17 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(x, y) | fit(DataSet) | fit(iterator[, epochs]) (ref surface)."""
+        """fit(x, y) | fit(DataSet) | fit(iterator[, epochs]) (ref surface).
+
+        The whole call runs under a root ``fit`` span — per-step spans and
+        the prefetch thread's spans parent into ONE trace — and armed on
+        the flight recorder, so a fit that stops making step progress for
+        ``DL4J_TPU_HANG_SECONDS`` dumps a postmortem bundle."""
+        with _flight().arm("fit:MultiLayerNetwork"), \
+                _span("fit", model="MultiLayerNetwork", epochs=epochs):
+            return self._fit_impl(data, labels, epochs)
+
+    def _fit_impl(self, data, labels=None, epochs: int = 1):
         if labels is not None:
             for _ in range(epochs):
                 self._fit_batch(data, labels)
@@ -433,6 +445,7 @@ class MultiLayerNetwork:
                 self._last_batch_size, self._score if sync_now else float("nan"),
                 t1 - t0, time.perf_counter() - t1, data_wait,
                 pipelined=defer_mode)
+            _flight().progress("train_step")
 
     def _fit_tbptt(self, x, y, fmask, lmask, data_wait=None):
         """Truncated BPTT (ref: MultiLayerNetwork#doTruncatedBPTT): chunk the
@@ -466,6 +479,7 @@ class MultiLayerNetwork:
                 self._last_batch_size if start == 0 else 0, self._score,
                 t1 - t0, time.perf_counter() - t1,
                 data_wait if start == 0 else None)
+            _flight().progress("train_step")
 
     # ------------------------------------------------------------- pretrain
     def pretrain(self, data, epochs: int = 1):

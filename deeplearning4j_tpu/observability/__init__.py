@@ -1,8 +1,23 @@
 """Unified observability core (SURVEY §5.5/J12 north star): a process-wide
-metrics registry + structured tracing that every layer — training loops,
+metrics registry + causal tracing that every layer — training loops,
 ``ParallelInference`` serving, data pipeline, collectives, checkpoints —
 publishes into, with Prometheus exposition on ``UIServer /metrics`` and
 Chrome-trace JSON export for Perfetto.
+
+Three pillars:
+
+- **Metrics** (`registry.py`): labeled counters/gauges/histograms with
+  reservoir quantiles and OpenMetrics exemplars (tail buckets carry the
+  trace_id of a request that landed there).
+- **Causal tracing** (`tracing.py`): nested ``span()`` with
+  trace_id/span_id/parent_id, explicit cross-thread propagation
+  (``current_context`` / ``trace_context`` / ``record_span``), and
+  Chrome-trace export with flow events so Perfetto draws request arrows
+  across the serving pipeline and prefetch threads.
+- **Health** (`slo.py`, `flight_recorder.py`): declarative SLO rules
+  driving ``/health`` (503 on failing) and ``/alerts``, plus a hang
+  watchdog / crash hook that dumps postmortem bundles (span ring, metrics
+  snapshot, all thread stacks, async-runtime config).
 
 Quick tour::
 
@@ -17,16 +32,24 @@ Quick tour::
     print(metrics().render_prometheus())      # scrape payload
     trace_sink().export_json("/tmp/trace.json")   # load in Perfetto
 
-Kill switch: ``DL4J_TPU_METRICS=0`` (instruments and spans become no-ops).
+Kill switches: ``DL4J_TPU_METRICS=0`` (instruments and spans become
+no-ops), ``DL4J_TPU_TRACE=0`` (spans only), ``DL4J_TPU_FLIGHT_RECORDER=0``
+(watchdog + crash hooks).
 """
 from deeplearning4j_tpu.observability.registry import (
     Counter, Gauge, Histogram, MetricsRegistry, DEFAULT_BUCKETS,
     global_registry, metrics_enabled, on_registry_reset,
     reset_global_registry)
 from deeplearning4j_tpu.observability.tracing import (
-    Span, SpanRecord, TraceSink, current_span, global_trace_sink,
-    reset_global_trace_sink, span)
+    Span, SpanRecord, TraceContext, TraceSink, current_context,
+    current_span, global_trace_sink, now_us, record_span,
+    reset_global_trace_sink, span, trace_context, tracing_enabled)
 from deeplearning4j_tpu.observability.straggler import StragglerDetector
+from deeplearning4j_tpu.observability.flight_recorder import (
+    FlightRecorder, global_flight_recorder, reset_global_flight_recorder)
+from deeplearning4j_tpu.observability.slo import (
+    ErrorRateRule, GaugeThresholdRule, LatencyQuantileRule, SLOEngine,
+    SLORule, default_rules, global_slo_engine, reset_global_slo_engine)
 
 #: ergonomic aliases
 metrics = global_registry
@@ -36,9 +59,16 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
     "global_registry", "metrics", "metrics_enabled", "on_registry_reset",
     "reset_global_registry",
-    "Span", "SpanRecord", "TraceSink", "current_span", "global_trace_sink",
-    "reset_global_trace_sink", "span", "trace_sink",
+    "Span", "SpanRecord", "TraceContext", "TraceSink", "current_context",
+    "current_span", "global_trace_sink", "now_us", "record_span",
+    "reset_global_trace_sink", "span", "trace_context", "tracing_enabled",
+    "trace_sink",
     "StragglerDetector", "MetricsReportingListener",
+    "FlightRecorder", "global_flight_recorder",
+    "reset_global_flight_recorder",
+    "ErrorRateRule", "GaugeThresholdRule", "LatencyQuantileRule",
+    "SLOEngine", "SLORule", "default_rules", "global_slo_engine",
+    "reset_global_slo_engine",
 ]
 
 

@@ -102,6 +102,14 @@ class _Instrument:
         with self._lock:
             return list(self._children.items())
 
+    def series(self) -> List[Tuple[Tuple[str, ...], "_Instrument"]]:
+        """Live ``(label_values, child)`` pairs WITHOUT creating any — the
+        unlabeled instrument is its own sole child. The public
+        enumeration surface for renderers and SLO rules."""
+        if self.label_names:
+            return self._series()
+        return [((), self)]
+
 
 class Counter(_Instrument):
     kind = "counter"
@@ -182,11 +190,17 @@ class Histogram(_Instrument):
         self._count = 0
         self._reservoir: List[float] = []
         self._res_i = 0                        # ring cursor once full
+        # last exemplar per bucket index: (value, labels, unix_ts) —
+        # memory bounded by bucket count; a tail bucket's exemplar carries
+        # the trace_id of a request that actually landed there, linking a
+        # /metrics scrape straight to its trace (OpenMetrics exemplars)
+        self._exemplars: Dict[int, Tuple[float, Dict[str, str], float]] = {}
 
     def _make_child(self) -> "Histogram":
         return Histogram(buckets=self.buckets, _enabled=self._enabled)
 
-    def observe(self, value: float):
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None):
         if not self._enabled:
             return
         value = float(value)
@@ -195,11 +209,18 @@ class Histogram(_Instrument):
             self._counts[idx] += 1
             self._sum += value
             self._count += 1
+            if exemplar:
+                self._exemplars[idx] = (value, dict(exemplar), time.time())
             if len(self._reservoir) < _RESERVOIR_MAX:
                 self._reservoir.append(value)
             else:   # ring overwrite: bounded memory, recency-biased
                 self._reservoir[self._res_i] = value
                 self._res_i = (self._res_i + 1) % _RESERVOIR_MAX
+
+    def exemplars(self) -> Dict[int, Tuple[float, Dict[str, str], float]]:
+        """Snapshot of the per-bucket-index exemplars."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def time(self):
         """``with hist.time(): ...`` — observe the block's wall seconds."""
@@ -312,41 +333,67 @@ class MetricsRegistry:
             self._instruments.clear()
 
     # --------------------------------------------------- prometheus render
-    def render_prometheus(self) -> str:
-        """Text exposition format 0.0.4 (the /metrics payload)."""
+    def render_prometheus(self, openmetrics: bool = False) -> str:
+        """Text exposition (the /metrics payload). Default is strict
+        format 0.0.4 — exemplars are NOT legal there and would fail a real
+        Prometheus scrape, so they only render under ``openmetrics=True``
+        (the OpenMetrics-flavored output, ``# EOF``-terminated), which
+        UIServer serves on Accept-header negotiation."""
         out: List[str] = []
         with self._lock:
             insts = [self._instruments[n] for n in sorted(self._instruments)]
         for inst in insts:
-            out.append(f"# HELP {inst.name} {inst.description or inst.name}")
-            out.append(f"# TYPE {inst.name} {inst.kind}")
-            children = (inst._series() if inst.label_names
-                        else [((), inst)])
-            for lvals, child in children:
+            # OpenMetrics names counter FAMILIES without the _total suffix
+            # (samples keep it); a strict OM parser rejects a suffix-less
+            # counter sample, which would take the whole target down
+            family = inst.name
+            if (openmetrics and inst.kind == "counter"
+                    and family.endswith("_total")):
+                family = family[:-len("_total")]
+            out.append(f"# HELP {family} {inst.description or inst.name}")
+            out.append(f"# TYPE {family} {inst.kind}")
+            for lvals, child in inst.series():
                 if inst.kind == "histogram":
-                    self._render_histogram(out, inst, lvals, child)
+                    self._render_histogram(out, inst, lvals, child,
+                                           exemplars=openmetrics)
                 else:
                     out.append(
                         f"{inst.name}"
                         f"{_fmt_labels(inst.label_names, lvals)} "
                         f"{_fmt_value(child.value)}")
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + ("\n" if out else "")
 
     @staticmethod
-    def _render_histogram(out: List[str], inst, lvals, child: Histogram):
+    def _fmt_exemplar(ex) -> str:
+        """OpenMetrics exemplar suffix: `` # {labels} value timestamp``.
+        Appended only to bucket lines that have one; plain lines keep the
+        0.0.4 shape, and the suffix still ends in a float so naive
+        line-splitting scrapers keep working."""
+        if ex is None:
+            return ""
+        value, labels, ts = ex
+        body = _fmt_labels((), (), tuple(labels.items()))
+        return f" # {body} {_fmt_value(value)} {ts:.3f}"
+
+    @classmethod
+    def _render_histogram(cls, out: List[str], inst, lvals, child: Histogram,
+                          exemplars: bool = False):
         cum = 0
         counts = child.bucket_counts()
-        for bound, c in zip(child.buckets, counts):
+        exs = child.exemplars() if exemplars else {}
+        for i, (bound, c) in enumerate(zip(child.buckets, counts)):
             cum += c
             out.append(
                 f"{inst.name}_bucket"
                 f"{_fmt_labels(inst.label_names, lvals, (('le', _fmt_value(bound)),))}"
-                f" {cum}")
+                f" {cum}{cls._fmt_exemplar(exs.get(i))}")
         cum += counts[-1]
         out.append(
             f"{inst.name}_bucket"
             f"{_fmt_labels(inst.label_names, lvals, (('le', '+Inf'),))}"
-            f" {cum}")
+            f" {cum}{cls._fmt_exemplar(exs.get(len(child.buckets)))}")
         out.append(f"{inst.name}_sum"
                    f"{_fmt_labels(inst.label_names, lvals)}"
                    f" {_fmt_value(child.sum)}")

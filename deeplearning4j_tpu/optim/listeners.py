@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Optional
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -139,7 +138,10 @@ class MetricsReportingListener(TrainingListener):
             f"{prefix}_epochs_total",
             "epochs completed on the TrainingListener bus",
             label_names=("model",))
-        self._last_t: Optional[float] = None
+        # per model KIND, matching the label: one listener attached to
+        # several models (arbiter candidates, RL actors) must not record
+        # cross-model gaps as either model's iteration time
+        self._last_t: dict = {}
         self._iter_seconds = reg.histogram(
             f"{prefix}_iteration_seconds",
             "wall time between consecutive iteration_done callbacks",
@@ -151,9 +153,10 @@ class MetricsReportingListener(TrainingListener):
         if score == score:                       # skip NaN
             self._score.labels(model=kind).set(float(score))
         now = time.perf_counter()
-        if self._last_t is not None:
-            self._iter_seconds.labels(model=kind).observe(now - self._last_t)
-        self._last_t = now
+        last = self._last_t.get(kind)
+        if last is not None:
+            self._iter_seconds.labels(model=kind).observe(now - last)
+        self._last_t[kind] = now
 
     def on_epoch_end(self, model, epoch):
         self._epochs.labels(model=type(model).__name__).inc()

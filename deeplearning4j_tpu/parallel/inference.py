@@ -39,6 +39,11 @@ import numpy as np
 from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_flight_recorder as _flight)
+from deeplearning4j_tpu.observability.straggler import StragglerDetector
+from deeplearning4j_tpu.observability.tracing import (current_context,
+                                                      now_us, record_span)
 
 
 class InferenceMode:
@@ -95,6 +100,12 @@ class _ServingMetrics:
             "coalesced examples / padded bucket size per device call "
             "(1.0 = zero padded compute waste)",
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        # serving-side straggler flag (the detector previously watched
+        # train steps only): per-device-batch dispatch→complete wall time
+        # against its rolling median, so one slow padded-shape compile or
+        # a wedged transfer shows up in a scrape without a trace
+        self.straggler = StragglerDetector(phase="inference_batch",
+                                           registry=reg)
 
     @classmethod
     def get(cls) -> "_ServingMetrics":
@@ -111,13 +122,19 @@ def _drop_serving_metrics():
 
 
 class _Request:
-    __slots__ = ("x", "event", "result", "error")
+    __slots__ = ("x", "event", "result", "error", "ctx", "t_enqueue_us")
 
     def __init__(self, x):
         self.x = x
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # causal trace context captured at enqueue: the serve threads stamp
+        # this request's queue_wait/bucket_pad/dispatch/device/complete
+        # phases into ITS trace, so one trace_id follows the request across
+        # the batcher→dispatcher→completer pipeline
+        self.ctx = None
+        self.t_enqueue_us = 0.0
 
 
 class ParallelInference:
@@ -273,41 +290,78 @@ class ParallelInference:
         out = self._trainer.output(x)
         return out.buf() if hasattr(out, "buf") else out
 
+    @staticmethod
+    def _exemplar(ctx):
+        """Histogram exemplar linking a latency observation to its trace
+        (a `/metrics` tail bucket then names the trace_id to pull from
+        `/train/trace`)."""
+        return {"trace_id": ctx.trace_id} if ctx is not None else None
+
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
         obs = _ServingMetrics.get()
         t0 = time.perf_counter()
         if self.mode == InferenceMode.INSTANT:
-            try:
-                out = self._forward(x)[: x.shape[0]]
-            except Exception:
-                obs.errors.inc()
-                raise
+            with _span("inference_request", mode=InferenceMode.INSTANT,
+                       examples=int(x.shape[0])):
+                ctx = current_context()
+                try:
+                    out = self._forward(x)[: x.shape[0]]
+                except Exception:
+                    # failed requests still count in the requests_total
+                    # denominator (same as the BATCHED path) — otherwise
+                    # ErrorRateRule's min_requests gate would read a 100%
+                    # INSTANT outage as "no traffic, ok"
+                    obs.latency[InferenceMode.INSTANT].observe(
+                        time.perf_counter() - t0,
+                        exemplar=self._exemplar(ctx))
+                    obs.requests[InferenceMode.INSTANT].inc()
+                    obs.errors.inc()
+                    raise
             obs.latency[InferenceMode.INSTANT].observe(
-                time.perf_counter() - t0)
+                time.perf_counter() - t0, exemplar=self._exemplar(ctx))
             obs.requests[InferenceMode.INSTANT].inc()
             return out
         req = _Request(x)
-        # condition-based enqueue: a producer facing a full queue sleeps on
-        # the condition and is woken by the batcher the moment it drains a
-        # request — no 1 ms busy-wait poll, no burned CPU. The timeout is
-        # belt-and-braces against a lost wakeup racing shutdown.
-        with self._not_full:
-            while True:
-                if self._stop.is_set():
-                    raise RuntimeError("ParallelInference has been shut down")
-                try:
-                    self._queue.put_nowait(req)
-                    obs.queue_depth.set(self._queue.qsize())
-                    break
-                except queue.Full:
-                    self._not_full.wait(timeout=0.1)
-        req.event.wait()
-        obs.latency[InferenceMode.BATCHED].observe(time.perf_counter() - t0)
+        # the per-request END-TO-END span: everything the serve threads do
+        # for this request parents under it (they stamp phase records with
+        # req.ctx), and the flight recorder treats the outstanding request
+        # as in-flight work whose completion must keep making progress
+        with _flight().arm("inference_request"), \
+                _span("inference_request", mode=InferenceMode.BATCHED,
+                      examples=int(x.shape[0])):
+            req.ctx = current_context()
+            req.t_enqueue_us = now_us()
+            # condition-based enqueue: a producer facing a full queue
+            # sleeps on the condition and is woken by the batcher the
+            # moment it drains a request — no 1 ms busy-wait poll, no
+            # burned CPU. The timeout is belt-and-braces against a lost
+            # wakeup racing shutdown.
+            with self._not_full:
+                while True:
+                    if self._stop.is_set():
+                        raise RuntimeError(
+                            "ParallelInference has been shut down")
+                    try:
+                        self._queue.put_nowait(req)
+                        obs.queue_depth.set(self._queue.qsize())
+                        break
+                    except queue.Full:
+                        self._not_full.wait(timeout=0.1)
+            req.event.wait()
+            if req.error is not None:
+                # raise INSIDE the request span so the trace and
+                # dl4j_span_errors_total agree with
+                # dl4j_inference_errors_total about this request failing
+                obs.latency[InferenceMode.BATCHED].observe(
+                    time.perf_counter() - t0,
+                    exemplar=self._exemplar(req.ctx))
+                obs.requests[InferenceMode.BATCHED].inc()
+                obs.errors.inc()
+                raise req.error
+        obs.latency[InferenceMode.BATCHED].observe(
+            time.perf_counter() - t0, exemplar=self._exemplar(req.ctx))
         obs.requests[InferenceMode.BATCHED].inc()
-        if req.error is not None:
-            obs.errors.inc()
-            raise req.error
         return req.result
 
     def shutdown(self):
@@ -327,6 +381,10 @@ class ParallelInference:
                     break
                 req.error = RuntimeError("ParallelInference shut down")
                 req.event.set()
+        # the queue-depth gauge must not freeze at the pre-shutdown burst
+        # level — the SLO rule reads it live, and a stale >threshold value
+        # would pin /health degraded/failing on a drained instance
+        _ServingMetrics.get().queue_depth.set(self._queue.qsize())
         # stage-queue sweep: a batcher put can race the dispatcher's exit
         # (fail those — never dispatched), and if a join above timed out a
         # completed-but-unclaimed batch may remain (finish those)
@@ -365,6 +423,13 @@ class ParallelInference:
             return None
         with self._not_full:
             self._not_full.notify()
+        # the request's queue_wait phase ends the moment the batcher owns
+        # it; start was stamped by the producer thread at enqueue (a held
+        # overflow request re-enters through self._held above and is not
+        # double-counted)
+        if req.ctx is not None:
+            record_span("queue_wait", req.t_enqueue_us, ctx=req.ctx,
+                        examples=int(req.x.shape[0]))
         return req
 
     def _next_window(self) -> Optional[List[_Request]]:
@@ -426,6 +491,16 @@ class ParallelInference:
             off += k
             r.event.set()
 
+    @staticmethod
+    def _record_phase(name: str, batch: List[_Request], start_us: float,
+                      end_us: float, **attrs):
+        """Stamp one pipeline phase into EVERY member request's trace —
+        the per-request decomposition the batch-level spans can't give
+        (a batch mixes requests from different traces)."""
+        for r in batch:
+            if r.ctx is not None:
+                record_span(name, start_us, end_us, ctx=r.ctx, **attrs)
+
     def _observe_batch(self, obs: "_ServingMetrics", n: int, target: int):
         obs.batch_occupancy.observe(n / max(self.batch_limit, 1))
         obs.bucket_fill.observe(n / max(target, 1))
@@ -447,12 +522,26 @@ class ParallelInference:
             if batch is None:
                 continue
             try:
+                t_pad = now_us()
                 X, n = self._pad_concat(batch, self.batch_limit)
+                self._record_phase("bucket_pad", batch, t_pad, now_us(),
+                                   bucket=self.batch_limit)
                 self._observe_batch(obs, n, self.batch_limit)
+                t0 = time.perf_counter()
+                t_dev = now_us()
                 with _span("inference_batch", requests=len(batch),
                            examples=n):
+                    # sync loop: dispatch + device + transfer are one
+                    # blocking call, so the whole thing is the request's
+                    # "device" phase
                     out = self._forward(X)[:n]
+                t_done = now_us()
+                self._record_phase("device", batch, t_dev, t_done,
+                                   examples=n)
+                obs.straggler.observe(time.perf_counter() - t0)
                 self._distribute(batch, out)
+                self._record_phase("complete", batch, t_done, now_us())
+                _flight().progress("inference_batch")
             except Exception as e:             # surface errors to callers
                 self._fail(batch, e)
         if self._held is not None:             # don't strand the overflow
@@ -482,7 +571,10 @@ class ParallelInference:
             try:
                 total = sum(r.x.shape[0] for r in batch)
                 target = self._bucket_for(total)
+                t_pad = now_us()
                 X, n = self._pad_concat(batch, target)
+                self._record_phase("bucket_pad", batch, t_pad, now_us(),
+                                   bucket=target)
                 self._observe_batch(obs, n, target)
             except Exception as e:
                 self._fail(batch, e)
@@ -509,19 +601,24 @@ class ParallelInference:
                     if self._stop.is_set():
                         break
                     continue
+                t_disp = time.perf_counter()
                 try:
+                    t_us = now_us()
                     with _span("inference_dispatch", requests=len(batch),
                                examples=n):
                         dev = self._forward_async(X)
+                    self._record_phase("dispatch", batch, t_us, now_us(),
+                                       examples=n)
                 except Exception as e:         # trace/compile-time errors
                     self._fail(batch, e)
                     continue
-                if self._put_stage(self._complete_q, (dev, batch, n)):
+                if self._put_stage(self._complete_q,
+                                   (dev, batch, n, t_disp)):
                     obs.inflight.set(self._complete_q.qsize())
                 else:
                     # shutdown raced the handoff: materialize inline so
                     # the callers still get their (valid) results
-                    self._complete_one(obs, dev, batch, n)
+                    self._complete_one(obs, dev, batch, n, t_disp)
         finally:
             # end-of-stream marker: a plain blocking put is safe because
             # the completer consumes until it sees the marker (it cannot
@@ -531,12 +628,23 @@ class ParallelInference:
             # stop-flag-only exit)
             self._complete_q.put(self._DONE)
 
-    def _complete_one(self, obs, dev, batch, n):
+    def _complete_one(self, obs, dev, batch, n, t_dispatch=None):
         try:
+            t_dev = now_us()
             with _span("inference_complete", requests=len(batch),
                        examples=n):
                 out = np.asarray(dev)[:n]      # device→host sync point
+            t_done = now_us()
+            # "device" = dispatch→materialize (execution + transfer tail);
+            # "complete" = slicing the host buffer out to callers
+            self._record_phase("device", batch, t_dev, t_done, examples=n)
             self._distribute(batch, out)
+            self._record_phase("complete", batch, t_done, now_us())
+            if t_dispatch is not None:
+                # straggler check over the batch's dispatch→complete wall
+                # time — the serving analog of a slow train step
+                obs.straggler.observe(time.perf_counter() - t_dispatch)
+            _flight().progress("inference_batch")
         except Exception as e:                 # execution-time errors
             self._fail(batch, e)
 
@@ -549,6 +657,5 @@ class ParallelInference:
             item = self._complete_q.get()
             if item is self._DONE:
                 break
-            dev, batch, n = item
-            self._complete_one(obs, dev, batch, n)
+            self._complete_one(obs, *item)
             obs.inflight.set(self._complete_q.qsize())

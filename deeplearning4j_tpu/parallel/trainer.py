@@ -22,6 +22,8 @@ import numpy as np
 from deeplearning4j_tpu.ndarray.ndarray import _unwrap
 from deeplearning4j_tpu.observability import global_registry
 from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_flight_recorder as _flight)
 from deeplearning4j_tpu.parallel import mesh as _mesh
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, DATA_AXIS
 from deeplearning4j_tpu.parallel.sharding import replicate_tree, tp_shardings
@@ -202,7 +204,16 @@ class ShardedTrainer:
 
     def fit(self, data, labels=None, epochs: int = 1):
         """Same surface as the wrapped net's fit; batches are sharded over the
-        ``data`` axis before entering the jitted step."""
+        ``data`` axis before entering the jitted step. Runs under a root
+        ``fit`` span (steps + the mesh-placement prefetch thread share one
+        trace) and armed on the flight recorder — a wedged collective
+        shows up as a postmortem bundle, not a silent hang."""
+        with _flight().arm("fit:ShardedTrainer"), \
+                _span("fit", model=type(self.net).__name__, sharded=True,
+                      epochs=epochs):
+            return self._fit_impl(data, labels, epochs)
+
+    def _fit_impl(self, data, labels=None, epochs: int = 1):
         if not self._placed:
             self._place()
         net = self.net

@@ -4,6 +4,12 @@ A dependency-free ``http.server`` that renders the attached StatsStorage:
 score-vs-iteration chart (inline SVG), per-layer parameter/update summary
 table, and a JSON API (``/train/sessions``, ``/train/updates?sid=``) —
 the same surfaces the reference's Vert.x app exposes, minus the JS bundle.
+
+Observability surfaces: ``/metrics`` (Prometheus text with OpenMetrics
+exemplars), ``/health`` (SLO-driven ok/degraded/failing, HTTP 503 when
+failing), ``/alerts`` (active violations + transitions), ``/train/trace``
+(Chrome trace of the span ring), ``/debug/dump`` (write a flight-recorder
+postmortem bundle now).
 """
 from __future__ import annotations
 
@@ -526,20 +532,40 @@ class UIServer:
             def do_GET(self):
                 parsed = urlparse(self.path)
                 q = parse_qs(parsed.query)
+                code = 200
                 if parsed.path == "/train/stream":
                     self._stream(q.get("sid", [None])[0])
                     return
                 if parsed.path == "/metrics":
                     # Prometheus text exposition of the process-wide
-                    # registry (the observability scrape surface)
+                    # registry (the observability scrape surface).
+                    # Exemplars are only legal in OpenMetrics, so they
+                    # render only when the scraper negotiates it (real
+                    # Prometheus sends this Accept when exemplar scraping
+                    # is on; the 0.0.4 payload stays strictly parseable)
                     from deeplearning4j_tpu.observability import metrics
-                    body = metrics().render_prometheus().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    om = ("application/openmetrics-text"
+                          in (self.headers.get("Accept") or ""))
+                    body = metrics().render_prometheus(
+                        openmetrics=om).encode()
+                    ctype = ("application/openmetrics-text; version=1.0.0; "
+                             "charset=utf-8" if om else
+                             "text/plain; version=0.0.4; charset=utf-8")
                 elif parsed.path == "/health":
+                    # SLO-driven: status is MEASURED (p99 latency, error
+                    # rate, queue depth, prefetch overlap) — failing
+                    # returns 503 so load balancers eject the replica,
+                    # degraded keeps 200 but names the violated rules
                     from deeplearning4j_tpu.observability import (
                         metrics_enabled, trace_sink)
+                    from deeplearning4j_tpu.observability.slo import (
+                        FAILING, global_slo_engine)
+                    report = global_slo_engine().evaluate()
                     body = json.dumps({
-                        "status": "ok",
+                        "status": report["status"],
+                        "failing_rules": report["failing_rules"],
+                        "degraded_rules": report["degraded_rules"],
+                        "rules": report["rules"],
                         "uptime_seconds": round(
                             time.time() - ui._started_at, 3),
                         "sessions": len(ui._sessions()),
@@ -547,6 +573,36 @@ class UIServer:
                         "metrics_enabled": metrics_enabled(),
                         "spans_recorded": trace_sink().total_recorded,
                     }).encode()
+                    ctype = "application/json"
+                    if report["status"] == FAILING:
+                        code = 503
+                elif parsed.path == "/alerts":
+                    # active SLO violations (with since-when) + recent
+                    # status transitions
+                    from deeplearning4j_tpu.observability.slo import (
+                        global_slo_engine)
+                    body = json.dumps(global_slo_engine().alerts()).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/dump":
+                    # live postmortem: write a flight-recorder bundle NOW
+                    # (span ring, metrics snapshot, all thread stacks,
+                    # async-runtime config) and report where it landed.
+                    # An unwritable postmortem dir (read-only fs, full
+                    # disk) must answer 500 JSON, not kill the response
+                    # mid-incident
+                    import os as _os
+
+                    from deeplearning4j_tpu.observability import (
+                        global_flight_recorder)
+                    try:
+                        bundle = global_flight_recorder().dump("http")
+                        body = json.dumps({
+                            "bundle": bundle,
+                            "files": sorted(_os.listdir(bundle)),
+                        }).encode()
+                    except Exception as e:
+                        body = json.dumps({"error": repr(e)}).encode()
+                        code = 500
                     ctype = "application/json"
                 elif parsed.path == "/train/trace":
                     # Chrome trace-event JSON of the in-memory span ring —
@@ -578,7 +634,7 @@ class UIServer:
                     sid = q.get("sid", [None])[0]
                     body = ui.render_overview(sid).encode()
                     ctype = "text/html"
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -68,6 +68,7 @@ def main():
     # the same server exposes the process-wide registry in Prometheus text
     # format (counters/gauges/histograms every layer publishes into) plus a
     # JSON health probe — point a real Prometheus at this URL in production
+    import urllib.error
     import urllib.request
     metrics_text = urllib.request.urlopen(
         server.get_address() + "/metrics", timeout=5).read().decode()
@@ -81,10 +82,60 @@ def main():
           f"({len(metrics_text.splitlines())} lines); highlights:")
     for line in interesting:
         print("  " + line)
+    # ---- exemplar → trace lookup (causal observability) -----------------
+    # serve a few requests so the latency histogram gets bucket exemplars:
+    # each observation carries the trace_id of the request that produced
+    # it, linking a /metrics tail bucket straight to its trace
     import json as _json
-    health = _json.loads(urllib.request.urlopen(
-        server.get_address() + "/health", timeout=5).read())
-    print(f"health: {health}")
+
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED).batch_limit(8).build())
+    try:
+        for i in range(8):
+            pi.output(x[i:i + 2])
+    finally:
+        pi.shutdown()
+    # exemplars render only in the OpenMetrics flavor (real Prometheus
+    # negotiates this Accept when exemplar scraping is enabled; the plain
+    # 0.0.4 payload stays strictly parseable)
+    om_req = urllib.request.Request(
+        server.get_address() + "/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    metrics_text = urllib.request.urlopen(om_req, timeout=5).read().decode()
+    ex_line = next(
+        (l for l in metrics_text.splitlines()
+         if l.startswith("dl4j_inference_latency_seconds_bucket")
+         and "# {" in l), None)
+    if ex_line:
+        trace_id = ex_line.split('trace_id="')[1].split('"')[0]
+        print(f"\nexemplar bucket: {ex_line}")
+        trace = _json.loads(urllib.request.urlopen(
+            server.get_address() + "/train/trace", timeout=5).read())
+        phases = sorted(
+            (e for e in trace if e["ph"] == "X"
+             and e.get("args", {}).get("trace_id") == trace_id),
+            key=lambda e: e["ts"])
+        print(f"trace {trace_id} — the request behind that bucket:")
+        for e in phases:
+            print(f"  {e['name']:<20} {e['dur'] / 1e3:8.3f} ms "
+                  f"(tid {e['tid']})")
+
+    # ---- SLO-driven health + alerts -------------------------------------
+    # /health grades measured SLOs (p99 latency, error rate, queue depth,
+    # prefetch overlap) and returns HTTP 503 when a rule fails; /alerts
+    # lists active violations; /debug/dump writes a postmortem bundle
+    try:
+        health = _json.loads(urllib.request.urlopen(
+            server.get_address() + "/health", timeout=5).read())
+    except urllib.error.HTTPError as e:      # 503 when an SLO rule fails
+        health = _json.loads(e.read())
+    print(f"\nhealth: {health['status']}"
+          f" (degraded={health['degraded_rules']},"
+          f" failing={health['failing_rules']})")
+    for rule in health["rules"]:
+        print(f"  {rule['rule']:<32} {rule['status']}")
 
     if args.keep_serving:
         print("serving — ctrl-c to exit")
