@@ -1,19 +1,22 @@
 """Instrumentation overhead guard (observability PR acceptance tool).
 
-Measures the lenet train step in three modes, interleaved A/B/C with a
+Measures the lenet train step in four modes, interleaved A/B/C/D with a
 min-estimator:
 
 - ``off``      — ``DL4J_TPU_METRICS=0`` (everything no-ops)
 - ``no_trace`` — metrics on, ``DL4J_TPU_TRACE=0`` (spans + trace-context
   propagation disabled; isolates the causal-tracing cost)
+- ``no_obs``   — metrics + tracing on, ``DL4J_TPU_NUMERICS=0
+  DL4J_TPU_COMPILE_WATCH=0`` (isolates the PR-4 observatory: in-graph
+  numerics terms + compile probes)
 - ``on``       — full default instrumentation
 
 Acceptance bars: total overhead (on vs off) <5%; trace-id propagation
-overhead (on vs no_trace) <2%.
+overhead (on vs no_trace) <2%; observatory overhead (on vs no_obs) <2%.
 
 Each mode runs in a fresh subprocess: the kill switches are applied at
-instrument creation, so flipping them in-process after modules warmed up
-would measure the wrong thing.
+instrument creation (and, for numerics, at trace time), so flipping them
+in-process after modules warmed up would measure the wrong thing.
 
 Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
 """
@@ -53,9 +56,19 @@ print(json.dumps({"seconds_per_step": wall / steps,
                   "metrics": os.environ.get("DL4J_TPU_METRICS", "1")}))
 """
 
+#: mode name -> env overrides on top of the caller's environment
+MODES = {
+    "off": {"DL4J_TPU_METRICS": "0"},
+    "no_trace": {"DL4J_TPU_METRICS": "1", "DL4J_TPU_TRACE": "0"},
+    "no_obs": {"DL4J_TPU_METRICS": "1", "DL4J_TPU_TRACE": "1",
+               "DL4J_TPU_NUMERICS": "0", "DL4J_TPU_COMPILE_WATCH": "0"},
+    "on": {"DL4J_TPU_METRICS": "1", "DL4J_TPU_TRACE": "1",
+           "DL4J_TPU_NUMERICS": "1", "DL4J_TPU_COMPILE_WATCH": "1"},
+}
 
-def _run(steps: int, batch: int, metrics: str, trace: str = "1") -> float:
-    env = dict(os.environ, DL4J_TPU_METRICS=metrics, DL4J_TPU_TRACE=trace)
+
+def _run(steps: int, batch: int, mode: str) -> float:
+    env = dict(os.environ, **MODES[mode])
     env.setdefault("JAX_PLATFORMS", "cpu")
     out = subprocess.run(
         [sys.executable, "-c", _WORKER, str(steps), str(batch)],
@@ -68,39 +81,51 @@ def main():
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--repeats", type=int, default=3,
-                    help="interleaved A/B/C process triples; min per mode "
-                         "wins")
+                    help="interleaved mode quadruples; min per mode wins")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
-    # interleaved triples with a min-estimator: a lone run is dominated by
-    # host warmup noise (the first subprocess routinely runs 1.5x slower
-    # than steady state regardless of mode)
-    offs, no_traces, ons = [], [], []
-    for _ in range(args.repeats):
-        offs.append(_run(args.steps, args.batch, "0"))
-        no_traces.append(_run(args.steps, args.batch, "1", trace="0"))
-        ons.append(_run(args.steps, args.batch, "1"))
-    off, no_trace, on = min(offs), min(no_traces), min(ons)
-    overhead = (on - off) / off * 100.0
-    trace_overhead = (on - no_trace) / no_trace * 100.0
-    result = {"lenet_step_seconds_uninstrumented": off,
-              "lenet_step_seconds_metrics_only": no_trace,
-              "lenet_step_seconds_instrumented": on,
+    # interleaved quadruples with a min-estimator: a lone run is dominated
+    # by host warmup noise (the first subprocess routinely runs 1.5x slower
+    # than steady state regardless of mode). The mode order ROTATES per
+    # repeat — on this cpu-shares-throttled box, host speed drifts
+    # monotonically across minutes, and a fixed order hands whichever mode
+    # runs last a systematic (once observed: 30%) advantage
+    samples = {m: [] for m in MODES}
+    order = list(MODES)
+    for r in range(args.repeats):
+        for m in order[r % len(order):] + order[:r % len(order)]:
+            samples[m].append(_run(args.steps, args.batch, m))
+    best = {m: min(v) for m, v in samples.items()}
+    overhead = (best["on"] - best["off"]) / best["off"] * 100.0
+    trace_overhead = ((best["on"] - best["no_trace"])
+                      / best["no_trace"] * 100.0)
+    obs_overhead = (best["on"] - best["no_obs"]) / best["no_obs"] * 100.0
+    result = {"lenet_step_seconds_uninstrumented": best["off"],
+              "lenet_step_seconds_metrics_only": best["no_trace"],
+              "lenet_step_seconds_no_observatory": best["no_obs"],
+              "lenet_step_seconds_instrumented": best["on"],
               "overhead_percent": overhead,
               "trace_overhead_percent": trace_overhead,
+              "observatory_overhead_percent": obs_overhead,
               "steps": args.steps, "batch": args.batch}
     if args.json:
         print(json.dumps(result, indent=2))
     else:
         print(f"lenet step, batch={args.batch}, {args.steps} steps/mode")
-        print(f"  uninstrumented (DL4J_TPU_METRICS=0): {off * 1e3:8.3f} ms")
+        print(f"  uninstrumented (DL4J_TPU_METRICS=0): "
+              f"{best['off'] * 1e3:8.3f} ms")
         print(f"  metrics only   (DL4J_TPU_TRACE=0):   "
-              f"{no_trace * 1e3:8.3f} ms")
-        print(f"  instrumented   (default):            {on * 1e3:8.3f} ms")
+              f"{best['no_trace'] * 1e3:8.3f} ms")
+        print(f"  no observatory (NUMERICS=0, COMPILE_WATCH=0): "
+              f"{best['no_obs'] * 1e3:8.3f} ms")
+        print(f"  instrumented   (default):            "
+              f"{best['on'] * 1e3:8.3f} ms")
         print(f"  total overhead: {overhead:+.2f}%  (bar: < 5%)")
         print(f"  trace-context overhead: {trace_overhead:+.2f}%  "
               f"(bar: < 2%)")
+        print(f"  observatory overhead (numerics + compile watch): "
+              f"{obs_overhead:+.2f}%  (bar: < 2%)")
     return overhead
 
 

@@ -31,12 +31,20 @@ Because the async pipelines are exactly what a hung run was doing when it
 hung, :func:`snapshot` returns every live knob value — the flight recorder
 (observability/flight_recorder.py) folds it into each postmortem bundle.
 Related observability knobs (read by that package, listed here for one
-discoverable table): ``DL4J_TPU_TRACE=0`` disables span recording while
-metrics stay live, ``DL4J_TPU_HANG_SECONDS`` sets the no-progress watchdog
-threshold (default 300), ``DL4J_TPU_POSTMORTEM_DIR`` the bundle directory,
+discoverable table; the full reference lives in README "Environment knob
+reference" and is lint-enforced by ``tools/check_env_knobs.py``):
+``DL4J_TPU_TRACE=0`` disables span recording while metrics stay live,
+``DL4J_TPU_HANG_SECONDS`` sets the no-progress watchdog threshold
+(default 300), ``DL4J_TPU_POSTMORTEM_DIR`` the bundle directory,
 ``DL4J_TPU_POSTMORTEM_KEEP`` the retained-bundle cap (default 8),
-``DL4J_TPU_FLIGHT_RECORDER=0`` disables the watchdog + crash hooks, and
-``DL4J_TPU_POSTMORTEM_ON_EXIT=1`` dumps a bundle at interpreter exit.
+``DL4J_TPU_FLIGHT_RECORDER=0`` disables the watchdog + crash hooks,
+``DL4J_TPU_POSTMORTEM_ON_EXIT=1`` dumps a bundle at interpreter exit,
+``DL4J_TPU_COMPILE_WATCH=0`` disables the trace/compile accounting,
+``DL4J_TPU_NUMERICS=0`` keeps the in-graph numerics health out of newly
+traced train steps, and ``DL4J_TPU_NUMERICS_SKIP=1`` opts into skipping
+the optimizer update on non-finite gradients. The numerics fetch cadence
+deliberately has NO knob of its own: it rides ``DL4J_TPU_SCORE_EVERY``
+(one sync schedule, one mental model).
 """
 from __future__ import annotations
 
@@ -77,12 +85,26 @@ def snapshot() -> dict:
     """Every live knob value — the async-runtime half of a postmortem
     bundle (a hang report without the pipeline depths that shaped the hang
     is not actionable)."""
-    return {
+    out = {
         "async_enabled": async_enabled(),
         "prefetch_depth": prefetch_depth(),
         "score_sync_every": score_sync_every(),
         "inflight_limit": inflight_limit(),
     }
+    try:
+        # the observatory switches shape what a wedged step was computing
+        # (numerics terms in-graph?) and what the bundle can explain
+        # (retraces counted?) — resolve their live values here too
+        from deeplearning4j_tpu.observability.compile_watch import (
+            compile_watch_enabled)
+        from deeplearning4j_tpu.observability.numerics import (
+            numerics_enabled, skip_on_nonfinite)
+        out["compile_watch_enabled"] = compile_watch_enabled()
+        out["numerics_enabled"] = numerics_enabled()
+        out["numerics_skip_on_nonfinite"] = skip_on_nonfinite()
+    except Exception:
+        pass
+    return out
 
 
 def default_buckets(batch_limit: int) -> tuple:

@@ -24,6 +24,8 @@ import optax
 from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn import params as _flat
+from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import numerics as _num
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.observability.flight_recorder import (
@@ -100,6 +102,9 @@ class MultiLayerNetwork:
         self._epoch = 0
         self._score = float("nan")
         self._pending_score = None   # device-side loss not yet materialized
+        self._pending_health = []    # device-side numerics not yet fetched
+        #: last published numerics health (floats) — listener-visible
+        self.last_numerics = None
         #: steps between blocking loss fetches in a deferred (async) fit
         #: loop; bounds host run-ahead. None = follow DL4J_TPU_SCORE_EVERY
         #: live (so the env knob works after construction); set an int to
@@ -295,19 +300,38 @@ class MultiLayerNetwork:
     @functools.partial(jax.jit, static_argnums=(0, 10), donate_argnums=(1, 2, 3))
     def _train_step(self, params, opt_state, states, x, labels, mask, label_mask, rng, carries,
                     frozen=frozenset()):
+        # this body only executes while jax TRACES it — the probe counts
+        # exactly the (re)compiles of this entry point and records the
+        # arg signature that triggered each one (compile_watch)
+        _cw.note_trace("MultiLayerNetwork._train_step",
+                       (x, labels, mask, label_mask))
         (loss, (new_states, new_carries)), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(params, states, x, labels, mask, label_mask, rng, carries)
         if frozen:
             grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in frozen else g)
                      for k, g in grads.items()}
-        updates, opt_state = self._opt.update(grads, opt_state, params)
+        updates, new_opt_state = self._opt.update(grads, opt_state, params)
         if frozen:
             # zero the *updates* too: decoupled weight decay (e.g. adamw)
             # contributes updates even with zero gradients
             updates = {k: (jax.tree.map(jnp.zeros_like, u) if k in frozen else u)
                        for k, u in updates.items()}
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, new_states, loss, new_carries
+        new_params = optax.apply_updates(params, updates)
+        # in-graph numerics health — a handful of isfinite/norm reductions
+        # XLA fuses into the backward pass, fetched on the deferred-score
+        # cadence (flag read at trace time; disabled = identical program).
+        # TBPTT carries stay un-gated: they are activations, not params.
+        health = None
+        if _num.numerics_enabled():
+            health = _num.health_terms(loss, grads, params, updates)
+            if _num.skip_on_nonfinite():
+                ok = jnp.logical_and(health["loss_finite"],
+                                     health["grads_finite"])
+                new_params = _num.select(ok, new_params, params)
+                new_opt_state = _num.select(ok, new_opt_state, opt_state)
+                new_states = _num.select(ok, new_states, states)
+                health["skipped"] = jnp.logical_not(ok)
+        return new_params, new_opt_state, new_states, loss, new_carries, health
 
     def computeGradientAndScore(self, x, labels, mask=None, label_mask=None):
         """Eager gradient computation (ref: Model#computeGradientAndScore).
@@ -391,7 +415,16 @@ class MultiLayerNetwork:
         if pend is not None:
             self._pending_score = None
             self._score = float(pend)
+        self._drain_numerics()
         return self._score
+
+    def _drain_numerics(self):
+        """Publish accumulated per-step numerics health (deferred-score
+        cadence: the scalars are long computed by the time a sync point
+        fetches them)."""
+        pend, self._pending_health = self._pending_health, []
+        if pend:
+            _num.publish(self, pend)
 
     def _fit_batch(self, x, y, fmask=None, lmask=None, data_wait=None):
         if not self._initialized:
@@ -425,17 +458,30 @@ class MultiLayerNetwork:
             with _span("train_step", model="MultiLayerNetwork",
                        iteration=self._iteration, batch=int(x.shape[0])):
                 self._key, rng = jax.random.split(self._key)
-                self._params, self._opt_state, self._states, loss, _ = self._train_step(
+                (self._params, self._opt_state, self._states, loss, _,
+                 health) = self._train_step(
                     self._params, self._opt_state, self._states, x, y, fmask, lmask, rng, None,
                     frozenset(self._frozen))
+                if health is not None:
+                    self._pending_health.append(_num.stamp_step(health))
                 if sync_now:
                     # float() blocks until the device step completes, so
                     # t1-t0 bounds dispatch + device compute of every step
                     # enqueued since the last sync
                     self._pending_score = None
                     self._score = float(loss)
+                    self._drain_numerics()
                 else:
                     self._pending_score = loss
+                    if len(self._pending_health) >= 64:
+                        # direct fit(x, y) loops never hit the epoch-end
+                        # sync point — bound the backlog by draining only
+                        # the OLDER half (steps ≥32 back are long done;
+                        # fetching the newest entry here would silently
+                        # clamp the async run-ahead to the backlog size)
+                        old = self._pending_health[:32]
+                        self._pending_health = self._pending_health[32:]
+                        _num.publish(self, old)
             t1 = time.perf_counter()
             self._iteration += 1
             with _span("listeners", model="MultiLayerNetwork"):
@@ -465,10 +511,14 @@ class MultiLayerNetwork:
             with _span("train_step_tbptt", model="MultiLayerNetwork",
                        iteration=self._iteration, t_start=start):
                 self._key, rng = jax.random.split(self._key)
-                self._params, self._opt_state, self._states, loss, carries = self._train_step(
+                (self._params, self._opt_state, self._states, loss, carries,
+                 health) = self._train_step(
                     self._params, self._opt_state, self._states, x_chunk, y_chunk, fm, lm, rng,
                     carries, frozenset(self._frozen))
                 self._score = float(loss)
+                if health is not None:          # per-chunk synchronous
+                    self._pending_health.append(_num.stamp_step(health))
+                    self._drain_numerics()
             t1 = time.perf_counter()
             self._iteration += 1
             for lst in self._listeners:
@@ -539,6 +589,10 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------- inference
     @functools.partial(jax.jit, static_argnums=(0,))
     def _output_jit(self, params, states, x, mask):
+        # serving path: every ParallelInference shape bucket compiles one
+        # executable of THIS function — the probe ties bucket misses to
+        # the compiles they cause (compile_watch.note_cause)
+        _cw.note_trace("MultiLayerNetwork._output_jit", (x, mask))
         h, _, _ = self._forward(params, states, x, False, None, mask=mask)
         return h
 

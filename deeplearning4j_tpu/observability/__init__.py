@@ -17,7 +17,14 @@ Three pillars:
 - **Health** (`slo.py`, `flight_recorder.py`): declarative SLO rules
   driving ``/health`` (503 on failing) and ``/alerts``, plus a hang
   watchdog / crash hook that dumps postmortem bundles (span ring, metrics
-  snapshot, all thread stacks, async-runtime config).
+  snapshot, all thread stacks, async-runtime config, compile ring,
+  numerics snapshot, device memory).
+- **Training-health observatory** (`compile_watch.py`, `numerics.py`,
+  `device_memory.py`): XLA trace/retrace accounting with the triggering
+  arg signatures (``GET /debug/compiles``, retrace-storm SLO rule),
+  in-graph non-finite/grad-norm/update-ratio health fused into the train
+  step (divergence SLO rule, opt-in skip-on-nonfinite policy), and
+  per-device HBM gauges from ``Device.memory_stats()``.
 
 Quick tour::
 
@@ -34,7 +41,10 @@ Quick tour::
 
 Kill switches: ``DL4J_TPU_METRICS=0`` (instruments and spans become
 no-ops), ``DL4J_TPU_TRACE=0`` (spans only), ``DL4J_TPU_FLIGHT_RECORDER=0``
-(watchdog + crash hooks).
+(watchdog + crash hooks), ``DL4J_TPU_COMPILE_WATCH=0`` (trace/compile
+accounting), ``DL4J_TPU_NUMERICS=0`` (in-graph numerics terms). The full
+knob table lives in README "Environment knob reference"
+(lint: tools/check_env_knobs.py).
 """
 from deeplearning4j_tpu.observability.registry import (
     Counter, Gauge, Histogram, MetricsRegistry, DEFAULT_BUCKETS,
@@ -50,6 +60,12 @@ from deeplearning4j_tpu.observability.flight_recorder import (
 from deeplearning4j_tpu.observability.slo import (
     ErrorRateRule, GaugeThresholdRule, LatencyQuantileRule, SLOEngine,
     SLORule, default_rules, global_slo_engine, reset_global_slo_engine)
+from deeplearning4j_tpu.observability.compile_watch import (
+    CompileWatch, RetraceStormRule, compile_watch_enabled,
+    global_compile_watch, reset_global_compile_watch)
+from deeplearning4j_tpu.observability.numerics import (
+    DivergenceRule, numerics_enabled, skip_on_nonfinite)
+from deeplearning4j_tpu.observability import device_memory
 
 #: ergonomic aliases
 metrics = global_registry
@@ -69,6 +85,10 @@ __all__ = [
     "ErrorRateRule", "GaugeThresholdRule", "LatencyQuantileRule",
     "SLOEngine", "SLORule", "default_rules", "global_slo_engine",
     "reset_global_slo_engine",
+    "CompileWatch", "RetraceStormRule", "compile_watch_enabled",
+    "global_compile_watch", "reset_global_compile_watch",
+    "DivergenceRule", "numerics_enabled", "skip_on_nonfinite",
+    "device_memory",
 ]
 
 

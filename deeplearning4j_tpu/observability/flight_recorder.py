@@ -28,7 +28,12 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
 - ``metrics.prom`` — Prometheus snapshot of the global registry
 - ``threads.txt``  — every thread's Python stack (``sys._current_frames``)
 - ``config.json``  — reason, async_runtime knob snapshot, armed operations,
-  progress counters, SLO health report, and the ``DL4J_TPU_*`` environment
+  progress counters, SLO health report, device-memory snapshot, and the
+  ``DL4J_TPU_*`` environment
+- ``compiles.json`` — compile-watch ring: every XLA trace of the jitted
+  entry points with the arg signature that triggered it
+- ``numerics.json`` — recent non-finite loss/grad events + last published
+  numerics health per model kind
 
 Kill switch: ``DL4J_TPU_FLIGHT_RECORDER=0`` disables the watchdog and the
 crash hooks; explicit ``dump()`` calls always work.
@@ -301,6 +306,11 @@ class FlightRecorder:
         section("metrics.prom", self._write_metrics)
         section("threads.txt", self._write_threads)
         section("config.json", lambda p: self._write_config(p, reason))
+        # the PR-4 observatory: which signatures compiled what (a hang
+        # during a retrace storm is a compile, not a collective) and the
+        # numerics health at the moment of death
+        section("compiles.json", self._write_compiles)
+        section("numerics.json", self._write_numerics)
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -329,6 +339,20 @@ class FlightRecorder:
             self.dumps.append(bundle)
             self.dumps = [p for p in self.dumps if os.path.isdir(p)]
         return bundle
+
+    @staticmethod
+    def _write_compiles(path: str):
+        from deeplearning4j_tpu.observability.compile_watch import (
+            global_compile_watch)
+        with open(path, "w") as f:
+            json.dump(global_compile_watch().snapshot(), f, indent=2,
+                      default=str)
+
+    @staticmethod
+    def _write_numerics(path: str):
+        from deeplearning4j_tpu.observability import numerics
+        with open(path, "w") as f:
+            json.dump(numerics.snapshot(), f, indent=2, default=str)
 
     @staticmethod
     def _write_metrics(path: str):
@@ -370,6 +394,11 @@ class FlightRecorder:
             cfg["health"] = global_slo_engine().evaluate()
         except Exception as e:
             cfg["health"] = {"error": repr(e)}
+        try:        # HBM at the moment of death (None per device on CPU)
+            from deeplearning4j_tpu.observability import device_memory
+            cfg["device_memory"] = device_memory.snapshot()
+        except Exception as e:
+            cfg["device_memory"] = {"error": repr(e)}
         with open(path, "w") as f:
             json.dump(cfg, f, indent=2, default=str)
 

@@ -1,0 +1,285 @@
+"""In-graph numerics health: non-finite detection, gradient norms, and
+update-to-weight ratios fused into the jitted train step.
+
+A diverged run (NaN/Inf loss or gradients) burns accelerator-hours
+producing garbage, and the usual detector — a host-side ``isnan`` on the
+fetched loss — both misses non-finite *gradients* that haven't reached
+the loss yet and adds a device round-trip per step. Here the health
+terms are computed INSIDE the already-jitted train step (a handful of
+``jnp.isfinite`` / norm reductions XLA fuses into the backward pass), so
+they ride the deferred-score cadence of the async runtime (PR 2): the
+fit loop accumulates the per-step device scalars and materializes them
+only at the sync points where ``float(loss)`` already blocks — no extra
+host sync, async-safe.
+
+Published series (per model kind):
+
+- ``dl4j_numerics_nonfinite_total{model,kind}`` — steps whose loss
+  (``kind="loss"``) or gradients (``kind="grad"``) went non-finite
+- ``dl4j_numerics_grad_norm`` / ``dl4j_numerics_update_ratio``
+  histograms — global L2 gradient norm and update-norm / param-norm
+  ratio (the classic divergence leading indicators: the ratio of a
+  healthy net sits around 1e-3, explosion shows here first)
+- ``dl4j_numerics_skipped_steps_total{model}`` — steps whose optimizer
+  update was skipped by the policy below
+
+Divergence feeds :class:`DivergenceRule` → ``/health`` flips failing
+(and ``/alerts`` names the rule) while the event is recent on both the
+step and wall clocks.
+
+Skip policy (opt-in, ``DL4J_TPU_NUMERICS_SKIP=1``): on non-finite
+gradients the step keeps its params/optimizer-state/running-stats
+unchanged (an in-graph ``where`` select — the data batch is consumed,
+the model survives). Skips are counted, recorded into the trace
+(``numerics_skip`` span), and listener-visible via ``model.last_numerics``.
+
+Kill switches: ``DL4J_TPU_NUMERICS=0`` (health terms never enter the
+graph — the compiled step is byte-identical to pre-PR-4) under the
+``DL4J_TPU_METRICS=0`` master. The flag is read at TRACE time: flipping
+it affects newly-traced steps (fresh nets), not already-compiled ones.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.observability.registry import (global_registry,
+                                                       metrics_enabled,
+                                                       on_registry_reset)
+from deeplearning4j_tpu.observability.slo import FAILING, OK, SLORule
+
+
+def numerics_enabled() -> bool:
+    """Kill switch — read at trace time (see module doc)."""
+    return (metrics_enabled()
+            and os.environ.get("DL4J_TPU_NUMERICS", "1") != "0")
+
+
+def skip_on_nonfinite() -> bool:
+    """Opt-in policy: skip the optimizer update on non-finite grads."""
+    return os.environ.get("DL4J_TPU_NUMERICS_SKIP", "0") == "1"
+
+
+def health_terms(loss, grads, params, updates) -> Dict[str, object]:
+    """The in-graph health scalars (all jnp 0-d arrays; no host sync).
+
+    Called from inside the jitted train step, AFTER the optimizer
+    transform, so clipping/normalization is reflected in ``updates`` but
+    the raw divergence signal (``grads``) is pre-clip.
+
+    Gradient finiteness is derived from the L2 norm instead of a second
+    elementwise ``isfinite`` pass: any NaN/Inf leaf propagates through
+    the square-sum, so ``isfinite(grad_norm)`` covers the whole tree in
+    the one reduction the norm already needs. (Caveat: finite gradients
+    whose square-sum overflows f32 — leaves around 1e19 — also read
+    non-finite; at that magnitude the run has diverged by any name.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _sq_sum(tree):
+        leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+        if not leaves:
+            return jnp.zeros((), jnp.float32)
+        return sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                   for l in leaves)
+
+    loss_finite = jnp.all(jnp.isfinite(loss))
+    grad_norm = jnp.sqrt(_sq_sum(grads))
+    grads_finite = jnp.isfinite(grad_norm)
+    update_norm = jnp.sqrt(_sq_sum(updates))
+    param_norm = jnp.sqrt(_sq_sum(params))
+    return {
+        "loss_finite": loss_finite,
+        "grads_finite": grads_finite,
+        "grad_norm": grad_norm,
+        "update_ratio": update_norm / (param_norm + 1e-12),
+        "skipped": jnp.zeros((), jnp.bool_),   # set by select() if policy on
+    }
+
+
+def select(ok, new_tree, old_tree):
+    """In-graph skip: keep ``old_tree`` when ``ok`` is False. Donated
+    input buffers are still readable inside the computation — only the
+    Python-side references die with donation."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                        new_tree, old_tree)
+
+
+# --------------------------------------------------------- host-side state
+class _DivergenceTracker:
+    """Recent non-finite events on both clocks (step index + wall time),
+    the state :class:`DivergenceRule` grades from."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[dict] = []    # {step, unix_ts, kind, model}
+        self._last: Dict[str, dict] = {}  # model kind -> last published
+
+    def record_nonfinite(self, model_kind: str, kind: str, step: int):
+        with self._lock:
+            self._events.append({"model": model_kind, "kind": kind,
+                                 "step": step, "unix_ts": time.time()})
+            del self._events[:-64]
+
+    def note_publish(self, model_kind: str, values: dict):
+        with self._lock:
+            self._last[model_kind] = values
+
+    def recent(self, window_steps: int, window_seconds: float,
+               current_step: int) -> List[dict]:
+        now = time.time()
+        with self._lock:
+            return [dict(e) for e in self._events
+                    if current_step - e["step"] <= window_steps
+                    and now - e["unix_ts"] <= window_seconds]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"nonfinite_events": [dict(e) for e in self._events],
+                    "last_published": {k: dict(v)
+                                       for k, v in self._last.items()}}
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._last.clear()
+
+
+_tracker = _DivergenceTracker()
+
+
+def tracker() -> _DivergenceTracker:
+    return _tracker
+
+
+def _current_step() -> int:
+    """The shared fit-iteration clock (train_metrics.total_iterations) —
+    the same clock the divergence window ages against."""
+    from deeplearning4j_tpu.observability.train_metrics import (
+        total_iterations)
+    return total_iterations()
+
+
+def stamp_step(health: Dict[str, object]) -> Dict[str, object]:
+    """Stamp the CURRENT step index onto a just-produced health dict —
+    called at the step, not at the (possibly ~64-steps-later) deferred
+    publish, so divergence events carry the step they happened at."""
+    health["step"] = _current_step()
+    return health
+
+
+def publish(model, pending: List[Dict[str, object]]) -> Optional[dict]:
+    """Materialize and publish a batch of per-step health dicts (device
+    scalars accumulated since the last sync point). Called where the fit
+    loop already blocks — the arrays are computed, fetching them is a
+    copy, not a pipeline stall. Returns the LAST step's values as floats
+    (also stored on ``model.last_numerics`` for listener-level access).
+    """
+    if not pending:
+        return None
+    import jax
+
+    model_kind = type(model).__name__
+    host = jax.device_get(pending)
+    reg = global_registry()
+    nonfinite = reg.counter(
+        "dl4j_numerics_nonfinite_total",
+        "train steps with a non-finite loss or gradient, by kind",
+        label_names=("model", "kind"))
+    skipped_c = reg.counter(
+        "dl4j_numerics_skipped_steps_total",
+        "optimizer updates skipped by DL4J_TPU_NUMERICS_SKIP on "
+        "non-finite gradients",
+        label_names=("model",))
+    grad_h = reg.histogram(
+        "dl4j_numerics_grad_norm",
+        "global L2 norm of the gradients, per train step",
+        label_names=("model",),
+        buckets=(1e-4, 1e-3, 1e-2, 0.1, 0.3, 1.0, 3.0, 10.0, 100.0, 1e4))
+    ratio_h = reg.histogram(
+        "dl4j_numerics_update_ratio",
+        "update L2 norm / param L2 norm, per train step (healthy nets "
+        "sit around 1e-3)",
+        label_names=("model",),
+        buckets=(1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0))
+    fallback_step = _current_step()
+    last = None
+    for h in host:
+        # the step index was stamped when the step ran (stamp_step) —
+        # the publish may lag it by a whole deferred window
+        step = int(h.pop("step", fallback_step))
+        loss_ok = bool(h["loss_finite"])
+        grads_ok = bool(h["grads_finite"])
+        if not loss_ok:
+            nonfinite.labels(model=model_kind, kind="loss").inc()
+            _tracker.record_nonfinite(model_kind, "loss", step)
+        if not grads_ok:
+            nonfinite.labels(model=model_kind, kind="grad").inc()
+            _tracker.record_nonfinite(model_kind, "grad", step)
+        gn = float(h["grad_norm"])
+        ur = float(h["update_ratio"])
+        if gn == gn:                                   # NaN-safe observe
+            grad_h.labels(model=model_kind).observe(gn)
+        if ur == ur:
+            ratio_h.labels(model=model_kind).observe(ur)
+        skipped = bool(h.get("skipped", False))
+        if skipped:
+            skipped_c.labels(model=model_kind).inc()
+            # traced: the skip is visible on the timeline next to its step
+            from deeplearning4j_tpu.observability.tracing import (now_us,
+                                                                  record_span)
+            t = now_us()
+            record_span("numerics_skip", t, t, model=model_kind,
+                        loss_finite=loss_ok, grads_finite=grads_ok)
+        last = {"loss_finite": loss_ok, "grads_finite": grads_ok,
+                "grad_norm": gn, "update_ratio": ur, "skipped": skipped}
+    if last is not None:
+        _tracker.note_publish(model_kind, last)
+        # listener-visible: the bus passes `model`, so a listener (or any
+        # caller) reads the freshest health without touching the registry
+        model.last_numerics = last
+    return last
+
+
+class DivergenceRule(SLORule):
+    """Non-finite loss/gradients recently ⇒ ``failing`` — a diverged
+    trainer must page immediately (every further step is wasted hours).
+    Recovers once the event ages out of BOTH windows (or after a registry
+    reset / fresh process)."""
+
+    def __init__(self, name: str = "numerics_divergence",
+                 window_steps: int = 200, window_seconds: float = 600.0,
+                 description: str = ""):
+        super().__init__(name, description or
+                         "non-finite loss/gradients in the recent window")
+        self.window_steps = window_steps
+        self.window_seconds = window_seconds
+
+    def _evaluate(self, registry) -> dict:
+        recent = _tracker.recent(self.window_steps, self.window_seconds,
+                                 _current_step())
+        if not recent:
+            return {"status": OK, "value": 0}
+        worst = recent[-1]
+        return {"status": FAILING, "value": len(recent),
+                "detail": f"last: non-finite {worst['kind']} "
+                          f"({worst['model']}) at step {worst['step']}"}
+
+
+def snapshot() -> dict:
+    """Bundle payload: recent non-finite events + last published health
+    per model kind (the numerics half of a postmortem)."""
+    return {"enabled": numerics_enabled(),
+            "skip_on_nonfinite": skip_on_nonfinite(),
+            **_tracker.snapshot()}
+
+
+@on_registry_reset
+def _clear_tracker():
+    # a fresh registry restarts the step clock (test isolation)
+    _tracker.clear()

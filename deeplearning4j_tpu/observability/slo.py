@@ -192,8 +192,13 @@ class GaugeThresholdRule(SLORule):
 
 def default_rules() -> List[SLORule]:
     """The serving/training SLOs every deployment cares about. Perf-only
-    signals (prefetch overlap) cap at ``degraded`` — slow is a page, not
-    an ejection."""
+    signals (prefetch overlap, retrace churn) cap short of ejection —
+    slow is a page; divergence IS an ejection (every further step is
+    wasted accelerator time)."""
+    # lazy: compile_watch/numerics import SLORule from this module
+    from deeplearning4j_tpu.observability.compile_watch import (
+        RetraceStormRule)
+    from deeplearning4j_tpu.observability.numerics import DivergenceRule
     return [
         LatencyQuantileRule(
             "inference_p99_latency_seconds",
@@ -217,6 +222,8 @@ def default_rules() -> List[SLORule]:
             min_activity=256,
             description="fraction of batches already on device when the "
                         "step asked (transfer/compute overlap health)"),
+        RetraceStormRule(),
+        DivergenceRule(),
     ]
 
 

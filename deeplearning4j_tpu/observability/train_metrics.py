@@ -19,12 +19,24 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from deeplearning4j_tpu.observability import device_memory
 from deeplearning4j_tpu.observability.registry import (global_registry,
                                                        on_registry_reset)
 from deeplearning4j_tpu.observability.straggler import StragglerDetector
 
 _instances: Dict[str, "TrainingMetrics"] = {}
 _lock = threading.Lock()
+
+
+def total_iterations() -> int:
+    """THE process-wide fit-iteration clock: completed iterations summed
+    over model kinds. The observatory (compile_watch's retrace-storm
+    window, numerics' divergence window) ages events against this one
+    definition — do not reimplement it per consumer."""
+    inst = global_registry().get("dl4j_training_iterations_total")
+    if inst is None:
+        return 0
+    return int(sum(child.value for _, child in inst.series()))
 
 
 class TrainingMetrics:
@@ -88,6 +100,9 @@ class TrainingMetrics:
             # read as a straggler against the dispatch-time median, so the
             # detector only sees honestly per-step-synchronous loops
             self.straggler.observe(total)
+        # step boundary = the safe moment to read the PJRT allocator
+        # (throttled internally; no-op latch on stat-less CPU backends)
+        device_memory.sample()
 
 
 def for_model(model) -> TrainingMetrics:

@@ -146,12 +146,22 @@ class MetricsReportingListener(TrainingListener):
             f"{prefix}_iteration_seconds",
             "wall time between consecutive iteration_done callbacks",
             label_names=("model",))
+        # divergence visibility for EXTERNAL loops: the built-in fit loops
+        # detect non-finite loss/grads in-graph (observability/numerics),
+        # but a custom solver driving the bus only hands us its score —
+        # count the non-finite ones so those runs page too
+        self._nonfinite = reg.counter(
+            f"{prefix}_nonfinite_scores_total",
+            "non-finite scores observed on the TrainingListener bus",
+            label_names=("model",))
 
     def iteration_done(self, model, iteration, epoch, score):
         kind = type(model).__name__
         self._iters.labels(model=kind).inc()
-        if score == score:                       # skip NaN
+        if score == score and abs(score) != float("inf"):
             self._score.labels(model=kind).set(float(score))
+        else:
+            self._nonfinite.labels(model=kind).inc()
         now = time.perf_counter()
         last = self._last_t.get(kind)
         if last is not None:

@@ -37,6 +37,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu import async_runtime as _async
+from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import device_memory as _devmem
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability.flight_recorder import (
@@ -510,6 +512,19 @@ class ParallelInference:
         else:
             self._seen_buckets.add(key)
             obs.bucket_misses.inc()
+            # first use of this padded shape — the trace/compile it
+            # provokes in the model's _output_jit claims this cause, so
+            # /debug/compiles names the bucket behind the compile. The
+            # cause is noted per MODEL, not per instance: the jit cache
+            # lives on the model, so a second ParallelInference over the
+            # same net records a (per-instance) miss that compiles
+            # nothing — a pending cause there would mislabel the next
+            # unrelated compile within the claim window
+            model_seen = self.model.__dict__.setdefault(
+                "_cw_seen_buckets", set())
+            if key not in model_seen:
+                model_seen.add(key)
+                _cw.note_cause("bucket_miss", bucket=target)
         obs.batches.inc()
 
     # ------------------------------------------------- sync loop (ASYNC=0)
@@ -542,6 +557,7 @@ class ParallelInference:
                 self._distribute(batch, out)
                 self._record_phase("complete", batch, t_done, now_us())
                 _flight().progress("inference_batch")
+                _devmem.sample()
             except Exception as e:             # surface errors to callers
                 self._fail(batch, e)
         if self._held is not None:             # don't strand the overflow
@@ -645,6 +661,9 @@ class ParallelInference:
                 # time — the serving analog of a slow train step
                 obs.straggler.observe(time.perf_counter() - t_dispatch)
             _flight().progress("inference_batch")
+            # batch boundary: sample device memory (throttled; no-op on
+            # stat-less CPU backends)
+            _devmem.sample()
         except Exception as e:                 # execution-time errors
             self._fail(batch, e)
 

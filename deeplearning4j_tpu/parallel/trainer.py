@@ -20,6 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.ndarray.ndarray import _unwrap
+from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import device_memory as _devmem
 from deeplearning4j_tpu.observability import global_registry
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability.flight_recorder import (
@@ -107,6 +109,13 @@ class ShardedTrainer:
         for axis in self.mesh.axis_names:
             self._obs[2].labels(axis=str(axis)).set(
                 _mesh.axis_size(self.mesh, axis))
+        # re-homing params onto the mesh changes the step's sharding
+        # signature — the wrapped net's _train_step retraces once, and
+        # the compile watch attributes that compile to this placement
+        _cw.note_cause("sharded_placement",
+                       mesh_axes=",".join(str(a)
+                                          for a in self.mesh.axis_names))
+        _devmem.sample()        # post-placement HBM baseline
         self._placed = True
 
     def _opt_state_shardings(self, opt_state):

@@ -9,7 +9,8 @@ Observability surfaces: ``/metrics`` (Prometheus text with OpenMetrics
 exemplars), ``/health`` (SLO-driven ok/degraded/failing, HTTP 503 when
 failing), ``/alerts`` (active violations + transitions), ``/train/trace``
 (Chrome trace of the span ring), ``/debug/dump`` (write a flight-recorder
-postmortem bundle now).
+postmortem bundle now), ``/debug/compiles`` (compile-watch ring: every XLA
+trace of the jitted entry points + the retrace-storm grade).
 """
 from __future__ import annotations
 
@@ -603,6 +604,26 @@ class UIServer:
                     except Exception as e:
                         body = json.dumps({"error": repr(e)}).encode()
                         code = 500
+                    ctype = "application/json"
+                elif parsed.path == "/debug/compiles":
+                    # compile-watch ring: every XLA trace of the jitted
+                    # entry points with the triggering arg signature,
+                    # per-fn counts, and the retrace-storm rule's current
+                    # grade — the first stop when step time jumps 40×
+                    from deeplearning4j_tpu.observability import (
+                        global_compile_watch, global_slo_engine, metrics)
+                    from deeplearning4j_tpu.observability.compile_watch import (
+                        RetraceStormRule)
+                    payload = global_compile_watch().snapshot()
+                    # grade with THE engine's configured rule instance so
+                    # this surface cannot disagree with /health over
+                    # customized windows/thresholds
+                    storm_rule = next(
+                        (r for r in global_slo_engine().rules
+                         if isinstance(r, RetraceStormRule)),
+                        None) or RetraceStormRule()
+                    payload["storm"] = storm_rule.evaluate(metrics())
+                    body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/train/trace":
                     # Chrome trace-event JSON of the in-memory span ring —

@@ -122,10 +122,29 @@ def main():
             print(f"  {e['name']:<20} {e['dur'] / 1e3:8.3f} ms "
                   f"(tid {e['tid']})")
 
+    # ---- compile watch: /debug/compiles ---------------------------------
+    # every XLA trace of the jitted entry points, with the arg signature
+    # that triggered it: the training fit compiled the train step once,
+    # and each ParallelInference shape bucket above compiled one output
+    # executable whose event carries cause=bucket_miss. When a step
+    # suddenly runs 40x median, this ring answers "did we just recompile,
+    # and what shape caused it" before you ever open a profile
+    compiles = _json.loads(urllib.request.urlopen(
+        server.get_address() + "/debug/compiles", timeout=5).read())
+    print(f"\n/debug/compiles: {compiles['total_traces']} traces, "
+          f"storm status {compiles['storm']['status']}")
+    for ev in compiles["events"]:
+        cause = ev.get("cause")
+        print(f"  #{ev['seq']} {ev['fn']}({ev['signature']})"
+              + (f" [{cause['cause']}]" if cause else "")
+              + (f" compiled in {ev['compile_seconds']:.3f}s"
+                 if ev.get("compile_seconds") is not None else ""))
+
     # ---- SLO-driven health + alerts -------------------------------------
     # /health grades measured SLOs (p99 latency, error rate, queue depth,
-    # prefetch overlap) and returns HTTP 503 when a rule fails; /alerts
-    # lists active violations; /debug/dump writes a postmortem bundle
+    # prefetch overlap, retrace storms, numerics divergence) and returns
+    # HTTP 503 when a rule fails; /alerts lists active violations;
+    # /debug/dump writes a postmortem bundle
     try:
         health = _json.loads(urllib.request.urlopen(
             server.get_address() + "/health", timeout=5).read())

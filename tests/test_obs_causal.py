@@ -311,8 +311,8 @@ def test_flight_recorder_dump_bundle_contents(tmp_path):
     rec = FlightRecorder(hang_seconds=60, out_dir=str(tmp_path))
     bundle = rec.dump("unit-test")
     files = sorted(os.listdir(bundle))
-    assert files == ["config.json", "metrics.prom", "threads.txt",
-                     "trace.json"]
+    assert files == ["compiles.json", "config.json", "metrics.prom",
+                     "numerics.json", "threads.txt", "trace.json"]
     trace = json.loads(open(os.path.join(bundle, "trace.json")).read())
     assert any(e.get("name") == "doomed_section" for e in trace)
     prom = open(os.path.join(bundle, "metrics.prom")).read()
@@ -326,6 +326,13 @@ def test_flight_recorder_dump_bundle_contents(tmp_path):
     assert "async_runtime" in cfg and "prefetch_depth" in cfg["async_runtime"]
     assert "health" in cfg and cfg["health"]["status"] in (
         "ok", "degraded", "failing")
+    # PR 4 observatory sections: device memory in config, compile ring +
+    # numerics snapshot as their own files
+    assert "device_memory" in cfg
+    compiles = json.loads(open(os.path.join(bundle, "compiles.json")).read())
+    assert "by_fn" in compiles and "events" in compiles
+    numerics = json.loads(open(os.path.join(bundle, "numerics.json")).read())
+    assert "nonfinite_events" in numerics
     # the dump itself is a metric
     assert metrics().get("dl4j_postmortem_dumps_total").labels(
         trigger="unit-test").value == 1

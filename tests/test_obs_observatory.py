@@ -1,0 +1,373 @@
+"""Training-health observatory (ISSUE 4): compile/retrace accounting,
+in-graph numerics health, device-memory telemetry, env-knob lint."""
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import (global_compile_watch,
+                                              global_slo_engine, metrics,
+                                              reset_global_registry,
+                                              reset_global_slo_engine)
+from deeplearning4j_tpu.optim.updaters import Adam
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MLN_STEP = "MultiLayerNetwork._train_step"
+CG_STEP = "ComputationGraph._train_step"
+
+
+def _net():
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+        .weight_init("xavier").list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.feed_forward(4)).build()).init()
+
+
+def _graph_net():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("dense", DenseLayer(n_out=8, activation="relu"),
+                       "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "dense")
+            .set_outputs("out").build())
+    return ComputationGraph(conf).init()
+
+
+def _data(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4).astype("f4")
+    return DataSet(X, np.eye(3)[rng.randint(0, 3, n)].astype("f4"))
+
+
+# ---------------------------------------------------------------------------
+# compile watch: count, signature, step-1 settle
+# ---------------------------------------------------------------------------
+
+def test_mln_fixed_shape_traces_train_step_exactly_once():
+    """Acceptance: fixed-shape training traces the train step ONCE across
+    multiple epochs — including step 1. The step-1 signature settle
+    (weak-type stripping before opt init, nn/multilayer.py:~133) holds:
+    were a weak-typed leaf to survive init, step 2 would present a new
+    signature and this count would read 2."""
+    reset_global_registry()
+    watch = global_compile_watch()
+    net = _net()
+    ds = _data()
+    net.fit(ds)                                       # step 1
+    after_step1 = watch.count_for(MLN_STEP)
+    assert after_step1 == 1
+    net.fit([ds] * 4, epochs=3)                       # 12 more fixed-shape
+    assert watch.count_for(MLN_STEP) == after_step1 == 1
+    ev = next(e for e in watch.events() if e["fn"] == MLN_STEP)
+    assert ev["signature"] == "f32[16,4], f32[16,3]"
+    assert ev["first_compile_of_fn"] is True
+    # the counter series agrees with the ring
+    assert metrics().get("dl4j_compile_total").labels(
+        fn=MLN_STEP).value == 1
+
+
+def test_cg_fixed_shape_traces_train_step_exactly_once():
+    reset_global_registry()
+    watch = global_compile_watch()
+    net = _graph_net()
+    ds = _data()
+    net.fit(ds)
+    assert watch.count_for(CG_STEP) == 1
+    net.fit([ds] * 4, epochs=3)
+    assert watch.count_for(CG_STEP) == 1
+
+
+def test_shape_churn_trips_retrace_storm_on_alerts():
+    """Acceptance: a deliberately shape-churned run (a new batch size per
+    step — the classic unbucketed-serving/ragged-tail mistake) shows up
+    as an active retrace_storm violation on /alerts."""
+    from deeplearning4j_tpu.ui import UIServer
+
+    reset_global_registry()
+    reset_global_slo_engine()
+    net = _net()
+    for n in range(2, 14):                  # 12 distinct shapes = 11 recompiles
+        net.fit(_data(n=n))
+    assert global_compile_watch().count_for(MLN_STEP) == 12
+    server = UIServer(port=0).start()
+    try:
+        alerts = json.loads(urllib.request.urlopen(
+            server.get_address() + "/alerts", timeout=5).read())
+        active = {a["rule"]: a for a in alerts["active"]}
+        assert "retrace_storm" in active
+        assert active["retrace_storm"]["status"] == "failing"
+    finally:
+        server.stop()
+        reset_global_registry()
+        reset_global_slo_engine()
+
+
+def test_first_compiles_are_not_a_storm():
+    """Cold compiles of distinct entry points never grade the rule: only
+    RE-compiles of an already-compiled fn count."""
+    from deeplearning4j_tpu.observability import RetraceStormRule
+
+    reset_global_registry()
+    watch = global_compile_watch()
+    net = _net()
+    net.fit(_data())                        # first train-step compile
+    net.output(_data().features)            # first output compile
+    rule = RetraceStormRule()
+    res = rule.evaluate(metrics())
+    assert res["status"] == "ok" and res["value"] == 0
+    assert watch.count_for("MultiLayerNetwork._output_jit") == 1
+
+
+def test_debug_compiles_endpoint_and_bucket_miss_cause():
+    """GET /debug/compiles serves the ring; a serving shape-bucket miss
+    is correlated with the _output_jit compile it causes."""
+    from deeplearning4j_tpu.parallel.inference import (InferenceMode,
+                                                       ParallelInference)
+    from deeplearning4j_tpu.ui import UIServer
+
+    reset_global_registry()
+    net = _net()
+    net.fit(_data())
+    pi = (ParallelInference.Builder(net)
+          .inference_mode(InferenceMode.BATCHED).batch_limit(8).build())
+    try:
+        for _ in range(4):
+            pi.output(np.random.rand(3, 4).astype("f4"))
+    finally:
+        pi.shutdown()
+    server = UIServer(port=0).start()
+    try:
+        payload = json.loads(urllib.request.urlopen(
+            server.get_address() + "/debug/compiles", timeout=5).read())
+        assert payload["enabled"] is True
+        assert payload["by_fn"][MLN_STEP] == 1
+        assert payload["storm"]["status"] in ("ok", "degraded", "failing")
+        out_events = [e for e in payload["events"]
+                      if e["fn"] == "MultiLayerNetwork._output_jit"]
+        assert out_events, "bucket executable compile not recorded"
+        assert any(e.get("cause", {}) and
+                   e["cause"]["cause"] == "bucket_miss"
+                   and e["cause"]["bucket"] == 4 for e in out_events)
+    finally:
+        server.stop()
+        reset_global_registry()
+
+
+def test_compile_watch_kill_switch(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_COMPILE_WATCH", "0")
+    reset_global_registry()
+    net = _net()
+    net.fit(_data())
+    assert global_compile_watch().total == 0
+    assert metrics().get("dl4j_compile_total") is None
+
+
+# ---------------------------------------------------------------------------
+# numerics: non-finite injection, skip policy, kill switch
+# ---------------------------------------------------------------------------
+
+def _poisoned(n=16):
+    ds = _data(n=n)
+    X = np.asarray(ds.features).copy()
+    X[0, 0] = np.nan
+    return DataSet(X, ds.labels)
+
+
+def test_nonfinite_injection_counts_and_fails_health(tmp_path):
+    """Acceptance: poison one batch → the nonfinite counter increments,
+    the divergence SLO rule flips /health to failing (HTTP 503), and the
+    postmortem bundle carries compiles.json + the numerics snapshot."""
+    from deeplearning4j_tpu.observability import FlightRecorder
+    from deeplearning4j_tpu.ui import UIServer
+
+    reset_global_registry()
+    reset_global_slo_engine()
+    net = _net()
+    net.score_every = 1                     # publish on every step
+    net.fit(_data())
+    net.fit(_poisoned())                    # the poisoned batch
+    nonfinite = metrics().get("dl4j_numerics_nonfinite_total")
+    assert nonfinite.labels(model="MultiLayerNetwork", kind="loss").value == 1
+    assert nonfinite.labels(model="MultiLayerNetwork", kind="grad").value == 1
+    assert net.last_numerics["loss_finite"] is False
+
+    server = UIServer(port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(server.get_address() + "/health",
+                                   timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert "numerics_divergence" in body["failing_rules"]
+    finally:
+        server.stop()
+
+    rec = FlightRecorder(hang_seconds=60, out_dir=str(tmp_path))
+    bundle = rec.dump("divergence-test")
+    rec.stop()
+    files = set(os.listdir(bundle))
+    assert {"compiles.json", "numerics.json"} <= files
+    numerics = json.loads(open(os.path.join(bundle, "numerics.json")).read())
+    assert any(e["kind"] == "grad" for e in numerics["nonfinite_events"])
+    assert numerics["last_published"]["MultiLayerNetwork"][
+        "grads_finite"] is False
+    compiles = json.loads(open(os.path.join(bundle, "compiles.json")).read())
+    assert compiles["by_fn"][MLN_STEP] == 1
+    reset_global_registry()
+    reset_global_slo_engine()
+
+
+def test_skip_policy_leaves_params_unchanged(monkeypatch):
+    """DL4J_TPU_NUMERICS_SKIP=1: the poisoned step consumes the batch but
+    keeps params/opt-state untouched (in-graph where-select), counts the
+    skip, and training recovers on the next clean batch."""
+    import jax
+
+    monkeypatch.setenv("DL4J_TPU_NUMERICS_SKIP", "1")
+    reset_global_registry()
+    net = _net()
+    net.score_every = 1
+    net.fit(_data())
+    before = jax.device_get((net.param_tree(), net._opt_state))
+    net.fit(_poisoned())
+    after = jax.device_get((net.param_tree(), net._opt_state))
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert net.last_numerics["skipped"] is True
+    assert metrics().get("dl4j_numerics_skipped_steps_total").labels(
+        model="MultiLayerNetwork").value == 1
+    net.fit(_data(seed=3))                  # recovery: clean step applies
+    assert net.last_numerics["skipped"] is False
+    assert np.isfinite(net.score())
+    reset_global_registry()
+
+
+def test_numerics_deferred_cadence_publishes_at_sync(monkeypatch):
+    """Async-safe: with the deferred-score cadence the per-step health
+    stays on device until a sync point (score()) materializes it."""
+    monkeypatch.setenv("DL4J_TPU_SCORE_EVERY", "1000")
+    reset_global_registry()
+    net = _net()
+    ds = _data()
+    for _ in range(3):
+        net.fit(ds)
+    assert len(net._pending_health) == 3        # nothing fetched yet
+    assert metrics().get("dl4j_numerics_grad_norm") is None
+    net.score()                                 # sync point drains
+    assert net._pending_health == []
+    assert metrics().get("dl4j_numerics_grad_norm").labels(
+        model="MultiLayerNetwork").count == 3
+    reset_global_registry()
+
+
+def test_numerics_kill_switch(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_NUMERICS", "0")
+    reset_global_registry()
+    net = _net()
+    net.score_every = 1
+    net.fit(_data())
+    assert net._pending_health == [] and net.last_numerics is None
+    assert metrics().get("dl4j_numerics_grad_norm") is None
+    assert metrics().get("dl4j_numerics_nonfinite_total") is None
+    reset_global_registry()
+
+
+def test_listener_bus_counts_nonfinite_scores():
+    """External loops (custom solvers) drive the bus directly — their
+    non-finite scores count without the in-graph terms."""
+    from deeplearning4j_tpu.optim.listeners import MetricsReportingListener
+
+    reset_global_registry()
+    lst = MetricsReportingListener(prefix="dl4j_unitbus")
+    net = _net()
+    lst.iteration_done(net, 1, 0, 0.5)
+    lst.iteration_done(net, 2, 0, float("nan"))
+    lst.iteration_done(net, 3, 0, float("inf"))
+    c = metrics().get("dl4j_unitbus_nonfinite_scores_total")
+    assert c.labels(model="MultiLayerNetwork").value == 2
+    assert metrics().get("dl4j_unitbus_score").labels(
+        model="MultiLayerNetwork").value == 0.5
+    reset_global_registry()
+
+
+# ---------------------------------------------------------------------------
+# device memory
+# ---------------------------------------------------------------------------
+
+def test_device_memory_graceful_on_cpu():
+    """The CPU test mesh reports no allocator stats: sample() latches
+    unsupported (no gauge series, no repeated PJRT calls) and snapshot()
+    still enumerates devices with memory_stats null."""
+    from deeplearning4j_tpu.observability import device_memory
+
+    reset_global_registry()
+    device_memory.reset_for_tests()
+    assert device_memory.sample(min_interval_s=0.0) is False
+    assert metrics().get("dl4j_device_memory_bytes") is None
+    snap = device_memory.snapshot()
+    assert snap["devices"] and all(d["memory_stats"] is None
+                                   for d in snap["devices"])
+    device_memory.reset_for_tests()
+
+
+def test_device_memory_publishes_when_stats_exist(monkeypatch):
+    """With a stats-bearing device (faked), gauges land with the
+    device/kind labels and bundles would carry the same numbers."""
+    from deeplearning4j_tpu.observability import device_memory
+
+    class FakeDev:
+        id = 7
+        platform = "tpu"
+        device_kind = "fake-v5e"
+
+        @staticmethod
+        def memory_stats():
+            return {"bytes_in_use": 1024, "peak_bytes_in_use": 2048,
+                    "bytes_limit": 4096}
+
+    reset_global_registry()
+    device_memory.reset_for_tests()
+    import jax
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: [FakeDev()])
+    assert device_memory.sample(min_interval_s=0.0) is True
+    g = metrics().get("dl4j_device_memory_bytes")
+    assert g.labels(device="7", kind="in_use").value == 1024
+    assert g.labels(device="7", kind="peak").value == 2048
+    assert g.labels(device="7", kind="limit").value == 4096
+    snap = device_memory.snapshot()
+    assert snap["devices"][0]["memory_stats"]["bytes_limit"] == 4096
+    device_memory.reset_for_tests()
+    reset_global_registry()
+
+
+# ---------------------------------------------------------------------------
+# lint: env-knob reference table
+# ---------------------------------------------------------------------------
+
+def test_env_knob_reference_table_is_complete():
+    """Every DL4J_TPU_* knob referenced in code appears in README's
+    reference table and vice versa (tools/check_env_knobs.py)."""
+    spec = importlib.util.spec_from_file_location(
+        "check_env_knobs",
+        os.path.join(_REPO_ROOT, "tools", "check_env_knobs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    violations = mod.check_repo(_REPO_ROOT)
+    assert violations == [], "\n".join(str(v) for v in violations)

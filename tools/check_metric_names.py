@@ -8,7 +8,9 @@ Rules (on every ``.counter("name", ...)`` / ``.gauge(...)`` /
 - names match ``dl4j_[a-z0-9_]+`` (the namespace prefix; lowercase snake)
 - counters end in ``_total``; nothing else may end in ``_total``
 - histograms carry a unit suffix (``_seconds`` / ``_bytes`` / ``_ratio``/
-  ``_us``) — except two grandfathered dimensionless series from PR 2
+  ``_us`` / ``_norm`` — the last marks unitless L2-magnitude series like
+  the gradient norm) — except two grandfathered dimensionless series
+  from PR 2
 - a non-empty description (HELP text) is provided
 - label names are lowercase snake (``[a-z][a-z0-9_]*``)
 
@@ -28,7 +30,7 @@ from typing import List, NamedTuple, Optional
 
 NAME_RE = re.compile(r"^dl4j_[a-z0-9]+(_[a-z0-9]+)*$")
 LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_us")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_us", "_norm")
 
 #: dimensionless 0..1 histograms that predate this lint; new fraction
 #: metrics must use ``_ratio``
