@@ -22,6 +22,11 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
   ``ParallelWrapper``; transport replaced by XLA collectives).
 - ``models``   — model zoo (ref: ``org.deeplearning4j.zoo``).
 - ``utils``    — serialization, checkpointing, common helpers.
+- ``observability`` — metrics registry, causal tracing, SLO health,
+  flight recorder, training-health observatory.
+- ``resilience``    — fault injection, retry/deadline/circuit-breaker
+  policies, admission control, self-healing training (exceeds the
+  reference's Spark-retry + checkpoint story).
 """
 
 __version__ = "0.1.0"

@@ -104,6 +104,17 @@ def snapshot() -> dict:
         out["numerics_skip_on_nonfinite"] = skip_on_nonfinite()
     except Exception:
         pass
+    try:
+        # resilience posture: whether policies were armed, what chaos was
+        # configured, and the default serving deadline — a hang under
+        # injected faults must say so in the bundle
+        from deeplearning4j_tpu.resilience.faults import resilience_enabled
+        from deeplearning4j_tpu.resilience.policy import default_deadline_ms
+        out["resilience_enabled"] = resilience_enabled()
+        out["fault_spec"] = os.environ.get("DL4J_TPU_FAULTS", "")
+        out["default_deadline_ms"] = default_deadline_ms()
+    except Exception:
+        pass
     return out
 
 

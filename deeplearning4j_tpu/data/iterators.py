@@ -18,6 +18,7 @@ from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       trace_context)
+from deeplearning4j_tpu.resilience import faults as _faults
 
 _obs_cache: dict = {}
 
@@ -78,6 +79,12 @@ class DataSetIterator:
         if not self.has_next():
             raise StopIteration
         ds = self.next()
+        if _faults.armed():
+            # chaos injection point: a corrupt shard / flaky loader is an
+            # error raised here; a nan fault poisons the yielded batch
+            # (the caller's copy — the backing store is never mutated)
+            _faults.check("data.next_batch")
+            ds = _faults.corrupt_dataset("data.next_batch", ds)
         _data_obs(type(self).__name__)[0].inc()
         return ds
 
@@ -89,6 +96,14 @@ class DataSetIterator:
 
     def reset(self):
         raise NotImplementedError
+
+    def reset_replay(self):
+        """Rewind for a SAME-epoch replay (restore-resume fast-forward):
+        re-present the exact batch order of the pass in progress. The
+        default is a plain ``reset()`` — correct for any iterator that is
+        deterministic across resets; iterators that re-shuffle on reset
+        must override to re-draw the interrupted pass's permutation."""
+        self.reset()
 
     def batch(self) -> int:
         raise NotImplementedError
@@ -157,6 +172,11 @@ class ArrayDataSetIterator(DataSetIterator):
         self._pos = 0
         self._epoch += 1
         self._maybe_shuffle()
+
+    def reset_replay(self):
+        # no epoch bump, no re-shuffle: self._order still holds the
+        # permutation the interrupted pass was walking
+        self._pos = 0
 
     def batch(self) -> int:
         return self.batch_size

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.observability.flight_recorder import (
     global_flight_recorder as _flight)
+from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.multilayer import _grad_transform
@@ -385,6 +386,13 @@ class ComputationGraph:
         labels = tuple(jnp.asarray(_unwrap(y)) for y in labels)
         fmasks = tuple(jnp.asarray(_unwrap(m)) for m in fmasks if m is not None) or None
         lmasks = tuple(jnp.asarray(_unwrap(m)) for m in lmasks if m is not None) or None
+        if _faults.armed():
+            # chaos injection point — before the jitted step consumes its
+            # donated buffers (retry-in-place safe; nan composes with the
+            # numerics skip)
+            _faults.check("train.step")
+            inputs = tuple(jnp.asarray(v) for v in
+                           _faults.corrupt("train.step", inputs))
         if (getattr(self.conf, "backprop_type", "standard") == "tbptt"
                 and any(x.ndim == 3 for x in inputs)):
             self._fit_tbptt(inputs, labels, fmasks, lmasks,

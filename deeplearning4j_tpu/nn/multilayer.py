@@ -30,6 +30,7 @@ from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.observability.flight_recorder import (
     global_flight_recorder as _flight)
+from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.nn.conf.configuration import BackpropType, MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn._precision import (_COMPUTE_DTYPES, _cast_float,
@@ -433,6 +434,12 @@ class MultiLayerNetwork:
         y = jnp.asarray(_unwrap(y))
         fmask = None if fmask is None else jnp.asarray(_unwrap(fmask))
         lmask = None if lmask is None else jnp.asarray(_unwrap(lmask))
+        if _faults.armed():
+            # chaos injection point: fires BEFORE the jitted step touches
+            # its donated buffers, so a transient fault is retry-in-place
+            # safe; a nan corruption composes with the numerics skip
+            _faults.check("train.step")
+            x = jnp.asarray(_faults.corrupt("train.step", x))
         self._last_batch_size = x.shape[0]
         # pinned only when a listener collects activation histograms —
         # otherwise a large device batch would stay referenced for the

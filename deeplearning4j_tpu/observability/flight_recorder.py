@@ -34,6 +34,9 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
   entry points with the arg signature that triggered it
 - ``numerics.json`` — recent non-finite loss/grad events + last published
   numerics health per model kind
+- ``resilience.json`` — fault plan + injection counts, circuit-breaker
+  states, and the resilience event ring (retries, sheds, breaker
+  transitions, restores, quarantines)
 
 Kill switch: ``DL4J_TPU_FLIGHT_RECORDER=0`` disables the watchdog and the
 crash hooks; explicit ``dump()`` calls always work.
@@ -311,6 +314,10 @@ class FlightRecorder:
         # numerics health at the moment of death
         section("compiles.json", self._write_compiles)
         section("numerics.json", self._write_numerics)
+        # the PR-5 resilience layer: what was injected, which circuits
+        # were open, and the retry/shed/restore/quarantine event trail —
+        # a hang during a chaos run must name the chaos
+        section("resilience.json", self._write_resilience)
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -353,6 +360,12 @@ class FlightRecorder:
         from deeplearning4j_tpu.observability import numerics
         with open(path, "w") as f:
             json.dump(numerics.snapshot(), f, indent=2, default=str)
+
+    @staticmethod
+    def _write_resilience(path: str):
+        from deeplearning4j_tpu import resilience
+        with open(path, "w") as f:
+            json.dump(resilience.snapshot(), f, indent=2, default=str)
 
     @staticmethod
     def _write_metrics(path: str):

@@ -195,10 +195,12 @@ def default_rules() -> List[SLORule]:
     signals (prefetch overlap, retrace churn) cap short of ejection —
     slow is a page; divergence IS an ejection (every further step is
     wasted accelerator time)."""
-    # lazy: compile_watch/numerics import SLORule from this module
+    # lazy: compile_watch/numerics (and resilience.policy) import SLORule
+    # from this module
     from deeplearning4j_tpu.observability.compile_watch import (
         RetraceStormRule)
     from deeplearning4j_tpu.observability.numerics import DivergenceRule
+    from deeplearning4j_tpu.resilience.policy import CircuitOpenRule
     return [
         LatencyQuantileRule(
             "inference_p99_latency_seconds",
@@ -224,6 +226,9 @@ def default_rules() -> List[SLORule]:
                         "step asked (transfer/compute overlap health)"),
         RetraceStormRule(),
         DivergenceRule(),
+        # an OPEN circuit means callers are being failed fast — eject the
+        # replica; half-open (recovery probing) is a page, not an ejection
+        CircuitOpenRule(),
     ]
 
 

@@ -63,6 +63,13 @@ class TrainingMetrics:
             "dl4j_training_iterations_total",
             "completed fit iterations",
             label_names=("model",)).labels(model=model_kind)
+        # incremented by the resilience layer (ResilientTrainer) when a
+        # step raises past its in-place retries — the training analog of
+        # dl4j_inference_errors_total
+        self.step_failures = reg.counter(
+            "dl4j_training_step_failures_total",
+            "fit iterations that raised (after any in-place retries)",
+            label_names=("model",)).labels(model=model_kind)
         self.examples = reg.counter(
             "dl4j_training_examples_total",
             "training examples consumed",

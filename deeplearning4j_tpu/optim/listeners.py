@@ -201,12 +201,15 @@ class CheckpointListener(TrainingListener):
         import os
 
         from deeplearning4j_tpu.observability import global_registry, span
+        from deeplearning4j_tpu.resilience import faults as _faults
+        from deeplearning4j_tpu.utils.serialization import save_model_atomic
         self._count += 1
         name = f"checkpoint_{self._count}_{type(model).__name__}.zip"
         path = os.path.join(self.directory, name)
         t0 = time.perf_counter()
         with span("checkpoint.save", path=name):
-            model.save(path)
+            _faults.check("checkpoint.save")
+            save_model_atomic(model, path)
         reg = global_registry()
         reg.histogram("dl4j_checkpoint_save_seconds",
                       "wall time of one checkpoint save").observe(

@@ -24,6 +24,24 @@ goes to the next power-of-two bucket ≤ ``batch_limit`` instead of always
 occupancy. Under ``DL4J_TPU_ASYNC=0`` the original single-threaded loop
 runs: one batch in flight, pad-to-``batch_limit``, byte-identical
 synchronous behavior.
+
+Resilience (kill switch ``DL4J_TPU_RESILIENCE=0``): requests may carry a
+deadline (``output(x, deadline_ms=...)``, ``Builder.deadline_ms`` or the
+``DL4J_TPU_DEADLINE_MS`` default) — the batcher sheds already-expired
+requests before padding/dispatch, the completer fails expired ones with
+``DeadlineExceeded``, and a window that expired whole is dropped before it
+occupies an in-flight slot. ``Builder.max_queue_depth``/``shed_policy``
+turn the parked-producer full-queue behavior into bounded-queue load
+shedding (``reject_newest`` refuses the arriving request with
+``ShedError``; ``reject_oldest`` evicts the head of the queue instead).
+A per-instance ``CircuitBreaker`` watches device execution: consecutive
+failures open it and callers fail fast with ``CircuitOpenError`` instead
+of queueing behind a dead device; timed half-open probe batches close it.
+Sheds are counted in ``dl4j_inference_shed_total{reason}``, breaker state
+is ``dl4j_circuit_state{op}``, and transient injected dispatch faults are
+retried under a budgeted ``RetryPolicy``. Shutdown failures now raise the
+typed ``ShutdownError`` (a ``RuntimeError``) so callers and error-rate
+SLOs can tell a drained instance from a dying device.
 """
 from __future__ import annotations
 
@@ -46,11 +64,25 @@ from deeplearning4j_tpu.observability.flight_recorder import (
 from deeplearning4j_tpu.observability.straggler import StragglerDetector
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       now_us, record_span)
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.policy import (CircuitBreaker,
+                                                  CircuitOpenError, Deadline,
+                                                  DeadlineExceeded,
+                                                  RetryPolicy, ShedError,
+                                                  ShutdownError,
+                                                  default_deadline_ms)
 
 
 class InferenceMode:
     INSTANT = "INSTANT"
     BATCHED = "BATCHED"
+
+
+#: lifecycle/admission outcomes — typed results a caller routes on, not
+#: device errors; excluded from dl4j_inference_errors_total and from the
+#: circuit breaker's failure accounting
+_TYPED_OUTCOMES = (ShedError, DeadlineExceeded, ShutdownError,
+                   CircuitOpenError)
 
 
 class _ServingMetrics:
@@ -102,6 +134,13 @@ class _ServingMetrics:
             "coalesced examples / padded bucket size per device call "
             "(1.0 = zero padded compute waste)",
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        shed = reg.counter(
+            "dl4j_inference_shed_total",
+            "requests shed by admission control: queue_full (bounded-queue "
+            "reject), deadline (expired before completion), circuit_open "
+            "(failed fast on an open breaker)", label_names=("reason",))
+        self.shed = {r: shed.labels(reason=r)
+                     for r in ("queue_full", "deadline", "circuit_open")}
         # serving-side straggler flag (the detector previously watched
         # train steps only): per-device-batch dispatch→complete wall time
         # against its rolling median, so one slow padded-shape compile or
@@ -124,7 +163,8 @@ def _drop_serving_metrics():
 
 
 class _Request:
-    __slots__ = ("x", "event", "result", "error", "ctx", "t_enqueue_us")
+    __slots__ = ("x", "event", "result", "error", "ctx", "t_enqueue_us",
+                 "deadline", "_claim_lock", "_claimed")
 
     def __init__(self, x):
         self.x = x
@@ -137,6 +177,23 @@ class _Request:
         # the batcher→dispatcher→completer pipeline
         self.ctx = None
         self.t_enqueue_us = 0.0
+        # optional Deadline: checked by the batcher before padding, the
+        # dispatcher before an in-flight slot is taken, and the completer
+        # before handing the slice back
+        self.deadline = None
+        # exactly-once resolution: every path that would set
+        # result/error (completer, _fail, shed, the caller's deadline
+        # walk-away) must win claim() first — two racing resolvers can
+        # never both count a shed or overwrite each other's outcome
+        self._claim_lock = threading.Lock()
+        self._claimed = False
+
+    def claim(self) -> bool:
+        with self._claim_lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
 
 
 class ParallelInference:
@@ -154,11 +211,38 @@ class ParallelInference:
                  batch_limit: int = 32, queue_limit: int = 64,
                  max_wait_ms: float = 5.0, workers: Optional[int] = None,
                  inflight_limit: Optional[int] = None,
-                 bucket_sizes: Optional[Sequence[int]] = None):
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 max_queue_depth: Optional[int] = None,
+                 shed_policy: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.model = model
         self.mode = inference_mode
         self.batch_limit = batch_limit
         self.max_wait_ms = max_wait_ms
+        # resilience posture, resolved at construction so a running
+        # instance is stable even if the env knobs change mid-flight.
+        # DL4J_TPU_RESILIENCE=0 ⇒ all of it inert (byte-identical paths).
+        self._resilience = _faults.resilience_enabled()
+        if shed_policy is not None and shed_policy not in (
+                "reject_newest", "reject_oldest"):
+            raise ValueError("shed_policy must be 'reject_newest' or "
+                             f"'reject_oldest', got {shed_policy!r}")
+        if max_queue_depth is not None and self._resilience:
+            # under the kill switch the bounded queue must NOT apply
+            # either: pre-resilience behavior is the default-depth queue
+            # with producer parking, not a shrunk queue without shedding
+            queue_limit = max(1, int(max_queue_depth))
+            shed_policy = shed_policy or "reject_newest"
+        self._shed_policy = shed_policy if self._resilience else None
+        self.default_deadline_ms = (deadline_ms if deadline_ms is not None
+                                    else default_deadline_ms())
+        self._breaker = None
+        if self._resilience:
+            self._breaker = breaker if breaker is not None else \
+                CircuitBreaker("inference.device_execute")
+            self._retry = RetryPolicy(max_retries=2,
+                                      base_delay_seconds=0.01)
         # pipeline depth + padding buckets (async serving; see module doc).
         # Both resolved here so a running instance has stable behavior even
         # if the env knobs change mid-flight.
@@ -269,6 +353,30 @@ class ParallelInference:
 
         bucketSizes = bucket_sizes
 
+        def max_queue_depth(self, n):
+            """Bound the request queue at ``n`` and shed instead of
+            parking producers (admission control)."""
+            self._kw["max_queue_depth"] = n
+            return self
+
+        maxQueueDepth = max_queue_depth
+
+        def shed_policy(self, policy):
+            """``reject_newest`` (refuse the arriving request) or
+            ``reject_oldest`` (evict the head of the queue)."""
+            self._kw["shed_policy"] = policy
+            return self
+
+        shedPolicy = shed_policy
+
+        def deadline_ms(self, ms):
+            """Default per-request deadline (overrides
+            ``DL4J_TPU_DEADLINE_MS``); 0 disables."""
+            self._kw["deadline_ms"] = ms
+            return self
+
+        deadlineMs = deadline_ms
+
         def build(self):
             return ParallelInference(self._model, **self._kw)
 
@@ -299,32 +407,79 @@ class ParallelInference:
         `/train/trace`)."""
         return {"trace_id": ctx.trace_id} if ctx is not None else None
 
-    def output(self, x) -> np.ndarray:
+    def _resolve_deadline(self, deadline_ms) -> Optional[Deadline]:
+        if not self._resilience:
+            return None
+        ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
+        return Deadline.after_ms(ms) if ms and ms > 0 else None
+
+    def _shed(self, reason: str):
+        _ServingMetrics.get().shed[reason].inc()
+        _faults.record_event("shed", op="inference", reason=reason)
+
+    def _check_admission(self):
+        """Fail fast on an open circuit — a dead device must reject at the
+        door, not after a queue+batch+dispatch round trip."""
+        if self._breaker is not None and not self._breaker.allow():
+            self._shed("circuit_open")
+            raise CircuitOpenError(
+                "inference circuit open (consecutive device-execution "
+                "failures); retry after the reset timeout")
+
+    def output(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
         x = np.asarray(x)
         obs = _ServingMetrics.get()
         t0 = time.perf_counter()
+        dl = self._resolve_deadline(deadline_ms)
         if self.mode == InferenceMode.INSTANT:
             with _span("inference_request", mode=InferenceMode.INSTANT,
                        examples=int(x.shape[0])):
                 ctx = current_context()
                 try:
+                    self._check_admission()
+                    if dl is not None and dl.expired():
+                        self._shed("deadline")
+                        raise DeadlineExceeded(
+                            "request expired before dispatch")
+                    if self._resilience:
+                        # same retry parity as the serve loops: transient
+                        # dispatch faults are absorbed under the budget
+                        self._retry.call(
+                            lambda: _faults.check("inference.dispatch"),
+                            op="inference.dispatch")
+                        _faults.check("inference.device_execute")
                     out = self._forward(x)[: x.shape[0]]
-                except Exception:
+                    if self._breaker is not None:
+                        self._breaker.record_success()
+                    if dl is not None and dl.expired():
+                        # the device answered, but late — a late answer is
+                        # wrong by the same policy _distribute applies in
+                        # BATCHED mode (the breaker still saw a success:
+                        # the device itself is healthy)
+                        self._shed("deadline")
+                        raise DeadlineExceeded(
+                            "request expired during device execution")
+                except Exception as e:
                     # failed requests still count in the requests_total
                     # denominator (same as the BATCHED path) — otherwise
                     # ErrorRateRule's min_requests gate would read a 100%
                     # INSTANT outage as "no traffic, ok"
+                    if (self._breaker is not None
+                            and not isinstance(e, _TYPED_OUTCOMES)):
+                        self._breaker.record_failure()
                     obs.latency[InferenceMode.INSTANT].observe(
                         time.perf_counter() - t0,
                         exemplar=self._exemplar(ctx))
                     obs.requests[InferenceMode.INSTANT].inc()
-                    obs.errors.inc()
+                    if not isinstance(e, _TYPED_OUTCOMES):
+                        obs.errors.inc()
                     raise
             obs.latency[InferenceMode.INSTANT].observe(
                 time.perf_counter() - t0, exemplar=self._exemplar(ctx))
             obs.requests[InferenceMode.INSTANT].inc()
             return out
         req = _Request(x)
+        req.deadline = dl
         # the per-request END-TO-END span: everything the serve threads do
         # for this request parents under it (they stamp phase records with
         # req.ctx), and the flight recorder treats the outstanding request
@@ -334,32 +489,111 @@ class ParallelInference:
                       examples=int(x.shape[0])):
             req.ctx = current_context()
             req.t_enqueue_us = now_us()
+            try:
+                self._check_admission()
+            except CircuitOpenError:
+                # fail-fast rejections are still traffic: without the
+                # requests_total increment a 100% circuit-open outage
+                # would read as "no traffic, ok" to ErrorRateRule's
+                # min_requests gate (INSTANT mode already counts these)
+                obs.latency[InferenceMode.BATCHED].observe(
+                    time.perf_counter() - t0,
+                    exemplar=self._exemplar(req.ctx))
+                obs.requests[InferenceMode.BATCHED].inc()
+                raise
             # condition-based enqueue: a producer facing a full queue
             # sleeps on the condition and is woken by the batcher the
             # moment it drains a request — no 1 ms busy-wait poll, no
             # burned CPU. The timeout is belt-and-braces against a lost
             # wakeup racing shutdown.
-            with self._not_full:
-                while True:
-                    if self._stop.is_set():
-                        raise RuntimeError(
-                            "ParallelInference has been shut down")
-                    try:
-                        self._queue.put_nowait(req)
-                        obs.queue_depth.set(self._queue.qsize())
-                        break
-                    except queue.Full:
-                        self._not_full.wait(timeout=0.1)
-            req.event.wait()
-            if req.error is not None:
-                # raise INSIDE the request span so the trace and
-                # dl4j_span_errors_total agree with
-                # dl4j_inference_errors_total about this request failing
+            try:
+                with self._not_full:
+                    while True:
+                        if self._stop.is_set():
+                            raise ShutdownError(
+                                "ParallelInference has been shut down")
+                        if (req.deadline is not None
+                                and req.deadline.expired()):
+                            self._shed("deadline")
+                            raise DeadlineExceeded(
+                                "request expired while waiting to enqueue")
+                        try:
+                            self._queue.put_nowait(req)
+                            obs.queue_depth.set(self._queue.qsize())
+                            break
+                        except queue.Full:
+                            if self._shed_policy == "reject_newest":
+                                self._shed("queue_full")
+                                raise ShedError(
+                                    "inference queue full "
+                                    f"({self._queue.maxsize} requests); "
+                                    "request rejected (reject_newest)")
+                            if self._shed_policy == "reject_oldest":
+                                try:
+                                    old = self._queue.get_nowait()
+                                except queue.Empty:
+                                    continue  # batcher drained it — retry
+                                self._shed_request(
+                                    old, "queue_full", ShedError(
+                                        "shed from a full inference queue "
+                                        "by a newer request (reject_oldest)"))
+                                continue
+                            self._not_full.wait(timeout=0.1)
+            except (ShedError, DeadlineExceeded, ShutdownError):
+                # pre-enqueue rejections count as requests too — same
+                # denominator invariant as the error path below
                 obs.latency[InferenceMode.BATCHED].observe(
                     time.perf_counter() - t0,
                     exemplar=self._exemplar(req.ctx))
                 obs.requests[InferenceMode.BATCHED].inc()
-                obs.errors.inc()
+                raise
+            # deadline-aware wait: the batcher/dispatcher/completer checks
+            # cover the queue and the pad/dispatch boundaries, but a
+            # WEDGED device batch resolves nothing — the caller must be
+            # able to walk away at its deadline instead of hanging
+            if req.deadline is None:
+                req.event.wait()
+            else:
+                while not req.event.is_set():
+                    rem = req.deadline.remaining()
+                    if rem <= 0:
+                        break
+                    req.event.wait(timeout=rem)
+                if not req.event.is_set():
+                    # walk away: atomically CLAIM the request so pipeline
+                    # stages skip it (no second shed count when the
+                    # wedged batch finally resolves). Losing the claim
+                    # race means another path is resolving RIGHT NOW —
+                    # wait for its outcome instead of inventing one.
+                    if req.claim():
+                        req.error = DeadlineExceeded(
+                            "request expired while awaiting device results")
+                        req.event.set()
+                        self._shed("deadline")
+                    else:
+                        req.event.wait(timeout=5.0)
+                        if req.error is None and req.result is None:
+                            # the claim winner stalled past the grace
+                            # window too — resolve locally rather than
+                            # fall through to a None "result" (nobody
+                            # reads the winner's late outcome)
+                            req.error = DeadlineExceeded(
+                                "request expired while awaiting device "
+                                "results (resolution stalled)")
+                # falls through to the common error accounting below
+            if req.error is not None:
+                # raise INSIDE the request span so the trace and
+                # dl4j_span_errors_total agree with
+                # dl4j_inference_errors_total about this request failing.
+                # Typed resilience outcomes (shed/deadline/shutdown) are
+                # lifecycle results, not device errors — they count as
+                # requests but must not move the error-rate SLO.
+                obs.latency[InferenceMode.BATCHED].observe(
+                    time.perf_counter() - t0,
+                    exemplar=self._exemplar(req.ctx))
+                obs.requests[InferenceMode.BATCHED].inc()
+                if not isinstance(req.error, _TYPED_OUTCOMES):
+                    obs.errors.inc()
                 raise req.error
         obs.latency[InferenceMode.BATCHED].observe(
             time.perf_counter() - t0, exemplar=self._exemplar(req.ctx))
@@ -374,6 +608,9 @@ class ParallelInference:
             self._not_full.notify_all()
         for t in self._threads:
             t.join(timeout=2.0)
+        if self._breaker is not None:
+            # a dead instance's open circuit must not pin /health failing
+            self._breaker.retire()
         # fail any requests that were still queued so callers never hang
         with self._lock:
             while True:
@@ -381,7 +618,9 @@ class ParallelInference:
                     req = self._queue.get_nowait()
                 except queue.Empty:
                     break
-                req.error = RuntimeError("ParallelInference shut down")
+                if not req.claim():
+                    continue
+                req.error = ShutdownError("ParallelInference shut down")
                 req.event.set()
         # the queue-depth gauge must not freeze at the pre-shutdown burst
         # level — the SLO rule reads it live, and a stale >threshold value
@@ -397,7 +636,7 @@ class ParallelInference:
                     _, batch, _ = self._dispatch_q.get_nowait()
                 except queue.Empty:
                     break
-                self._fail(batch, RuntimeError("ParallelInference shut down"))
+                self._fail(batch, ShutdownError("ParallelInference shut down"))
             while True:
                 try:
                     item = self._complete_q.get_nowait()
@@ -413,26 +652,49 @@ class ParallelInference:
                 self._complete_one(obs, *item)
 
     # ------------------------------------------------------- batching stage
+    def _shed_request(self, req: _Request, reason: str,
+                      error: BaseException):
+        """Fail one request with a typed shed outcome (never dispatched).
+        A request another path already resolved (claimed) is skipped —
+        it was shed/completed once; counting it again would lie."""
+        if not req.claim():
+            return
+        self._shed(reason)
+        if req.ctx is not None:
+            record_span("shed", now_us(), ctx=req.ctx, reason=reason)
+        req.error = error
+        req.event.set()
+
     def _take_request(self, timeout: float) -> Optional[_Request]:
         """Pop one request (or the held window overflow), waking any
-        producer blocked on the full queue."""
-        if self._held is not None:
-            req, self._held = self._held, None
+        producer blocked on the full queue. Requests whose deadline
+        already expired are shed here — before any padding or dispatch
+        work is spent on them."""
+        wait_until = time.monotonic() + timeout
+        while True:
+            if self._held is not None:
+                req, self._held = self._held, None
+            else:
+                try:
+                    req = self._queue.get(
+                        timeout=max(0.0, wait_until - time.monotonic()))
+                except queue.Empty:
+                    return None
+                with self._not_full:
+                    self._not_full.notify()
+                # the request's queue_wait phase ends the moment the
+                # batcher owns it; start was stamped by the producer thread
+                # at enqueue (a held overflow request re-enters through
+                # self._held above and is not double-counted)
+                if req.ctx is not None:
+                    record_span("queue_wait", req.t_enqueue_us, ctx=req.ctx,
+                                examples=int(req.x.shape[0]))
+            if (self._resilience and req.deadline is not None
+                    and req.deadline.expired()):
+                self._shed_request(req, "deadline", DeadlineExceeded(
+                    "request expired in the batching queue"))
+                continue
             return req
-        try:
-            req = self._queue.get(timeout=timeout)
-        except queue.Empty:
-            return None
-        with self._not_full:
-            self._not_full.notify()
-        # the request's queue_wait phase ends the moment the batcher owns
-        # it; start was stamped by the producer thread at enqueue (a held
-        # overflow request re-enters through self._held above and is not
-        # double-counted)
-        if req.ctx is not None:
-            record_span("queue_wait", req.t_enqueue_us, ctx=req.ctx,
-                        examples=int(req.x.shape[0]))
-        return req
 
     def _next_window(self) -> Optional[List[_Request]]:
         """Coalesce one batch window, never exceeding batch_limit (the
@@ -481,17 +743,44 @@ class ParallelInference:
     @staticmethod
     def _fail(batch: List[_Request], error: BaseException):
         for r in batch:
+            if not r.claim():
+                continue               # caller already walked away
             r.error = error
             r.event.set()
 
-    @staticmethod
-    def _distribute(batch: List[_Request], out: np.ndarray):
+    def _distribute(self, batch: List[_Request], out: np.ndarray):
         off = 0
         for r in batch:
             k = r.x.shape[0]
+            if (self._resilience and r.deadline is not None
+                    and r.deadline.expired()):
+                # the work is done but the caller's deadline has passed —
+                # a late answer is a wrong answer to a deadline'd caller
+                off += k
+                self._shed_request(r, "deadline", DeadlineExceeded(
+                    "request expired before results were distributed"))
+                continue
+            if not r.claim():
+                off += k               # caller already walked away
+                continue
             r.result = out[off:off + k]
             off += k
             r.event.set()
+
+    def _drop_if_window_expired(self, batch: List[_Request]) -> bool:
+        """True when EVERY member of the window has expired — the window
+        is shed whole and must not occupy an in-flight slot. A partially
+        expired window still dispatches (the padded buffer is positional;
+        the completer sheds the expired members at distribute time)."""
+        if not self._resilience or not batch:
+            return False
+        if all(r.deadline is not None and r.deadline.expired()
+               for r in batch):
+            for r in batch:
+                self._shed_request(r, "deadline", DeadlineExceeded(
+                    "request expired before dispatch"))
+            return True
+        return False
 
     @staticmethod
     def _record_phase(name: str, batch: List[_Request], start_us: float,
@@ -536,6 +825,8 @@ class ParallelInference:
             batch = self._next_window()
             if batch is None:
                 continue
+            if self._drop_if_window_expired(batch):
+                continue
             try:
                 t_pad = now_us()
                 X, n = self._pad_concat(batch, self.batch_limit)
@@ -548,21 +839,35 @@ class ParallelInference:
                            examples=n):
                     # sync loop: dispatch + device + transfer are one
                     # blocking call, so the whole thing is the request's
-                    # "device" phase
+                    # "device" phase (both serving fault points fire here).
+                    # Parity with the async dispatcher: transient DISPATCH
+                    # faults retry under the budget; device-execution
+                    # faults surface (breaker food)
+                    if self._resilience:
+                        self._retry.call(
+                            lambda: _faults.check("inference.dispatch"),
+                            op="inference.dispatch")
+                        _faults.check("inference.device_execute")
                     out = self._forward(X)[:n]
                 t_done = now_us()
                 self._record_phase("device", batch, t_dev, t_done,
                                    examples=n)
                 obs.straggler.observe(time.perf_counter() - t0)
+                if self._breaker is not None:
+                    self._breaker.record_success()
                 self._distribute(batch, out)
                 self._record_phase("complete", batch, t_done, now_us())
                 _flight().progress("inference_batch")
                 _devmem.sample()
             except Exception as e:             # surface errors to callers
+                if self._breaker is not None:
+                    self._breaker.record_failure()
                 self._fail(batch, e)
         if self._held is not None:             # don't strand the overflow
-            self._held.error = RuntimeError("ParallelInference shut down")
-            self._held.event.set()
+            if self._held.claim():
+                self._held.error = ShutdownError(
+                    "ParallelInference shut down")
+                self._held.event.set()
             self._held = None
 
     # ------------------------------------------- async pipeline (default)
@@ -597,10 +902,12 @@ class ParallelInference:
                 continue
             if not self._put_stage(self._dispatch_q, (X, batch, n)):
                 self._fail(batch,
-                           RuntimeError("ParallelInference shut down"))
+                           ShutdownError("ParallelInference shut down"))
         if self._held is not None:             # don't strand the overflow
-            self._held.error = RuntimeError("ParallelInference shut down")
-            self._held.event.set()
+            if self._held.claim():
+                self._held.error = ShutdownError(
+                    "ParallelInference shut down")
+                self._held.event.set()
             self._held = None
 
     _DONE = object()    # dispatcher→completer end-of-stream marker
@@ -617,15 +924,29 @@ class ParallelInference:
                     if self._stop.is_set():
                         break
                     continue
+                if self._drop_if_window_expired(batch):
+                    continue   # expired whole: never takes an in-flight slot
                 t_disp = time.perf_counter()
                 try:
                     t_us = now_us()
                     with _span("inference_dispatch", requests=len(batch),
                                examples=n):
-                        dev = self._forward_async(X)
+                        if self._resilience:
+                            # transient injected dispatch faults are
+                            # retried under the budgeted policy; real
+                            # errors surface immediately
+                            def _dispatch(X=X):
+                                _faults.check("inference.dispatch")
+                                return self._forward_async(X)
+                            dev = self._retry.call(
+                                _dispatch, op="inference.dispatch")
+                        else:
+                            dev = self._forward_async(X)
                     self._record_phase("dispatch", batch, t_us, now_us(),
                                        examples=n)
                 except Exception as e:         # trace/compile-time errors
+                    if self._breaker is not None:
+                        self._breaker.record_failure()
                     self._fail(batch, e)
                     continue
                 if self._put_stage(self._complete_q,
@@ -649,6 +970,8 @@ class ParallelInference:
             t_dev = now_us()
             with _span("inference_complete", requests=len(batch),
                        examples=n):
+                if self._resilience:
+                    _faults.check("inference.device_execute")
                 out = np.asarray(dev)[:n]      # device→host sync point
             t_done = now_us()
             # "device" = dispatch→materialize (execution + transfer tail);
@@ -661,10 +984,14 @@ class ParallelInference:
                 # time — the serving analog of a slow train step
                 obs.straggler.observe(time.perf_counter() - t_dispatch)
             _flight().progress("inference_batch")
+            if self._breaker is not None:
+                self._breaker.record_success()
             # batch boundary: sample device memory (throttled; no-op on
             # stat-less CPU backends)
             _devmem.sample()
         except Exception as e:                 # execution-time errors
+            if self._breaker is not None:
+                self._breaker.record_failure()
             self._fail(batch, e)
 
     def _complete_loop(self):

@@ -28,6 +28,7 @@ from deeplearning4j_tpu.observability.flight_recorder import (
     global_flight_recorder as _flight)
 from deeplearning4j_tpu.parallel import mesh as _mesh
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, DATA_AXIS
+from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.parallel.sharding import replicate_tree, tp_shardings
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -199,11 +200,12 @@ class ShardedTrainer:
                 PreemptionSafeListener.FINAL_NAME.format(
                     model=type(self.net).__name__))
             if jax.process_index() == 0:
+                from deeplearning4j_tpu.utils.serialization import (
+                    save_model_atomic)
                 os.makedirs(self.checkpoint_dir, exist_ok=True)
-                # write-then-rename: a hard kill after the grace window
-                # must never leave a torn zip for resume_or_new to trust
-                self.net.save(path + ".tmp")
-                os.replace(path + ".tmp", path)
+                # atomic: a hard kill after the grace window must never
+                # leave a torn zip for resume_or_new to trust
+                save_model_atomic(self.net, path)
         # no cross-rank barrier (a single-rank latch would deadlock one);
         # non-zero ranks keep the REAL path but flag it possibly in flight
         raise TrainingPreempted(path or "<no checkpoint_dir configured>",
@@ -283,6 +285,11 @@ class ShardedTrainer:
         pass-through and GSPMD does the rest."""
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+        if _faults.armed():
+            # chaos injection point for the collective path: fires before
+            # the batch is placed on the mesh, i.e. before the sharded
+            # step (and its fused gradient allreduce) owns any buffer
+            _faults.check("allreduce")
         x = self._shard_batch(x)
         y = self._shard_batch(y)
         fmask = self._shard_batch(fmask)

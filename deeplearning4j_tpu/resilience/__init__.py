@@ -1,0 +1,82 @@
+"""Resilience layer: fault injection, deadlines, retries, circuit
+breaking, admission control, and self-healing training.
+
+The observability stack (PR 1/3/4) can *see* failures; this package lets
+the system *survive* them — and lets tests drive every failure path
+deterministically:
+
+- :mod:`~deeplearning4j_tpu.resilience.faults` — seeded fault-injection
+  registry (``DL4J_TPU_FAULTS`` spec / programmatic plans) with named
+  points threaded through the hot paths; every injection counted
+  (``dl4j_faults_injected_total{point,kind}``), traced, and logged to the
+  shared resilience event ring.
+- :mod:`~deeplearning4j_tpu.resilience.policy` — :class:`RetryPolicy`
+  (backoff + jitter under a token-bucket retry budget),
+  :class:`Deadline` / :class:`DeadlineExceeded`, :class:`CircuitBreaker`
+  (``dl4j_circuit_state{op}`` + :class:`CircuitOpenRule` on ``/health``),
+  and the typed failure taxonomy (:class:`ShutdownError`,
+  :class:`ShedError`, :class:`CircuitOpenError`, ...).
+- :mod:`~deeplearning4j_tpu.resilience.recovery` —
+  :class:`ResilientTrainer` (restore newest checkpoint → fast-forward →
+  resume, bounded restarts) and :class:`SkippingIterator` (quarantine
+  repeatedly failing batches, ``dl4j_data_quarantined_total``).
+
+Admission control (bounded-queue load shedding, per-request deadlines,
+fail-fast circuit gating) lives in ``parallel/inference.py`` and publishes
+``dl4j_inference_shed_total{reason}``.
+
+Kill switch: ``DL4J_TPU_RESILIENCE=0`` disarms everything — behavior is
+byte-identical to the pre-resilience tree. :func:`snapshot` feeds the
+flight recorder's ``resilience.json`` bundle section and
+``UIServer GET /debug/resilience``.
+"""
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.faults import (FaultPlan, FaultSpec,
+                                                  InjectedFault,
+                                                  resilience_enabled)
+from deeplearning4j_tpu.resilience.policy import (CircuitBreaker,
+                                                  CircuitOpenError,
+                                                  CircuitOpenRule, Deadline,
+                                                  DeadlineExceeded,
+                                                  ResilienceError,
+                                                  RestartBudgetExhausted,
+                                                  RetryBudget, RetryPolicy,
+                                                  ShedError, ShutdownError,
+                                                  TransientError,
+                                                  default_deadline_ms,
+                                                  is_transient)
+
+__all__ = [
+    "faults", "FaultPlan", "FaultSpec", "InjectedFault",
+    "resilience_enabled",
+    "CircuitBreaker", "CircuitOpenError", "CircuitOpenRule", "Deadline",
+    "DeadlineExceeded", "ResilienceError", "RestartBudgetExhausted",
+    "RetryBudget", "RetryPolicy", "ShedError", "ShutdownError",
+    "TransientError", "default_deadline_ms", "is_transient",
+    "ResilientTrainer", "SkippingIterator", "newest_checkpoint",
+    "snapshot",
+]
+
+
+def snapshot() -> dict:
+    """Everything a postmortem needs about the resilience layer: fault
+    plan + injection counts, live circuit-breaker states, the default
+    deadline, and the recent event ring (injections, retries, sheds,
+    breaker transitions, restores, quarantines)."""
+    from deeplearning4j_tpu.resilience import policy
+    return {
+        "enabled": resilience_enabled(),
+        "faults": faults.snapshot(),
+        "circuits": policy.circuit_snapshot(),
+        "default_deadline_ms": policy.default_deadline_ms(),
+        "events": faults.events(),
+    }
+
+
+def __getattr__(name):
+    # recovery imports the data/listener layers — lazy so importing the
+    # resilience package from those layers' hot paths can never cycle
+    if name in ("ResilientTrainer", "SkippingIterator", "newest_checkpoint"):
+        from deeplearning4j_tpu.resilience import recovery
+        return getattr(recovery, name)
+    raise AttributeError(name)

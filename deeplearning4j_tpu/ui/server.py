@@ -10,7 +10,9 @@ exemplars), ``/health`` (SLO-driven ok/degraded/failing, HTTP 503 when
 failing), ``/alerts`` (active violations + transitions), ``/train/trace``
 (Chrome trace of the span ring), ``/debug/dump`` (write a flight-recorder
 postmortem bundle now), ``/debug/compiles`` (compile-watch ring: every XLA
-trace of the jitted entry points + the retrace-storm grade).
+trace of the jitted entry points + the retrace-storm grade),
+``/debug/resilience`` (fault-injection counts, circuit-breaker states,
+and the retry/shed/restore/quarantine event ring).
 """
 from __future__ import annotations
 
@@ -624,6 +626,16 @@ class UIServer:
                         None) or RetraceStormRule()
                     payload["storm"] = storm_rule.evaluate(metrics())
                     body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/resilience":
+                    # resilience layer state: fault plan + injection
+                    # counts, circuit-breaker states, default deadline,
+                    # and the recent event ring (retries, sheds, breaker
+                    # transitions, restores, quarantines) — the serving
+                    # analog of /debug/compiles for failure handling
+                    from deeplearning4j_tpu import resilience
+                    body = json.dumps(resilience.snapshot(),
+                                      default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/train/trace":
                     # Chrome trace-event JSON of the in-memory span ring —

@@ -113,32 +113,52 @@ class PreemptionSafeListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch, score):
         if not self.handler.preempted:
             return
+        from deeplearning4j_tpu.resilience import faults as _faults
+        from deeplearning4j_tpu.utils.serialization import save_model_atomic
         path = os.path.join(self.directory,
                             self.FINAL_NAME.format(model=type(model).__name__))
-        model.save(path)
+        _faults.check("checkpoint.save")
+        # atomic: a crash mid-save (the grace window running out) must
+        # never leave a torn preempt_final_*.zip that the next start
+        # would trust
+        save_model_atomic(model, path)
         self.checkpoint_path = path
         if self.raise_on_preempt:
             raise TrainingPreempted(path, iteration)
 
 
+def _final_checkpoints(directory: str):
+    """``preempt_final_*`` checkpoints, NEWEST first — the shared
+    ``checkpoint_candidates`` ranking (mtime, skip ``.tmp``/torn files),
+    so this resume path and ResilientTrainer's can never disagree about
+    the same directory. A directory holding checkpoints for several model
+    kinds resumes from the latest run, not the alphabetically-first file."""
+    from deeplearning4j_tpu.utils.serialization import checkpoint_candidates
+    return checkpoint_candidates(directory, prefix="preempt_final_")
+
+
 def find_final_checkpoint(directory: str) -> Optional[str]:
-    if not os.path.isdir(directory):
-        return None
-    for name in sorted(os.listdir(directory)):
-        if name.startswith("preempt_final_"):
-            return os.path.join(directory, name)
-    return None
+    paths = _final_checkpoints(directory)
+    return paths[0] if paths else None
 
 
 def resume_or_new(directory: str, conf_factory):
-    """Restart entry point: restore the preemption checkpoint if present
-    (with updater state, so Adam moments and the iteration counter survive),
-    else build fresh from ``conf_factory()``. Returns (net, resumed)."""
+    """Restart entry point: restore the newest preemption checkpoint
+    (with updater state, so Adam moments and the iteration counter
+    survive), else build fresh from ``conf_factory()``. Unreadable/torn
+    checkpoints are skipped with a warning — a corrupt file must degrade
+    to the next-newest (or a fresh start), never crash the restart.
+    Returns (net, resumed)."""
+    import logging
+
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    path = find_final_checkpoint(directory)
-    if path is not None:
-        return MultiLayerNetwork.load(path, load_updater=True), True
+    log = logging.getLogger("deeplearning4j_tpu")
+    for path in _final_checkpoints(directory):
+        try:
+            return MultiLayerNetwork.load(path, load_updater=True), True
+        except Exception as e:
+            log.warning("skipping unreadable checkpoint %s: %r", path, e)
     net = MultiLayerNetwork(conf_factory())
     net.init()
     return net, False

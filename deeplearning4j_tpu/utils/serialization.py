@@ -42,6 +42,59 @@ def _tree_to_flat_dict(tree, prefix=""):
     return flat
 
 
+def checkpoint_candidates(directory: str, prefix: Optional[str] = None):
+    """Checkpoint zips in ``directory``, NEWEST first — THE one spelling
+    of "which checkpoint do I trust" (ResilientTrainer restore and the
+    preemption resume path both rank through it, so they can never
+    disagree on the same directory). Ranked by mtime, then the
+    ``checkpoint_<n>_`` counter for same-mtime files, then name.
+    ``*.tmp`` in-flight writes are excluded; torn files (not a readable
+    zip) are skipped with a warning, never trusted."""
+    import os
+    import re
+
+    if not os.path.isdir(directory):
+        return []
+    idx_re = re.compile(r"checkpoint_(\d+)_")
+
+    def rank(path):
+        name = os.path.basename(path)
+        m = idx_re.search(name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        return (mtime, int(m.group(1)) if m else -1, name)
+
+    out = []
+    for name in os.listdir(directory):
+        if not name.endswith(".zip"):
+            continue  # also excludes in-flight atomic writes ("x.zip.tmp")
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if zipfile.is_zipfile(path):
+                out.append(path)
+                continue
+        except OSError:
+            pass
+        log.warning("skipping unreadable checkpoint %s", path)
+    return sorted(out, key=rank, reverse=True)
+
+
+def save_model_atomic(net, path: str, save_updater: bool = True):
+    """Write-then-rename checkpoint save: a crash mid-write can never
+    leave a torn zip at ``path`` for a restore path to trust — the
+    directory holds either the previous complete file or the new one.
+    THE one spelling of the idiom (CheckpointListener, the preemption
+    listeners, and ResilientTrainer all save through it)."""
+    import os
+    tmp = path + ".tmp"
+    net.save(tmp, save_updater)
+    os.replace(tmp, path)
+
+
 class ModelSerializer:
     @staticmethod
     def write_model(net, path, save_updater: bool = True, normalizer=None):

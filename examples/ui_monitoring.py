@@ -140,6 +140,17 @@ def main():
               + (f" compiled in {ev['compile_seconds']:.3f}s"
                  if ev.get("compile_seconds") is not None else ""))
 
+    # ---- resilience: /debug/resilience ----------------------------------
+    # fault-injection counts (chaos runs are auditable), circuit-breaker
+    # states, the default serving deadline, and the recent event ring
+    # (retries, sheds, breaker transitions, restores, quarantines)
+    res = _json.loads(urllib.request.urlopen(
+        server.get_address() + "/debug/resilience", timeout=5).read())
+    circuits = [f"{c['op']}={c['state']}" for c in res["circuits"]]
+    print(f"\n/debug/resilience: enabled={res['enabled']}, "
+          f"injected={res['faults']['injected']}, circuits={circuits}, "
+          f"{len(res['events'])} events")
+
     # ---- SLO-driven health + alerts -------------------------------------
     # /health grades measured SLOs (p99 latency, error rate, queue depth,
     # prefetch overlap, retrace storms, numerics divergence) and returns
