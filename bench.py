@@ -45,6 +45,25 @@ PROBE_TIMEOUT_S = 120
 V5E_PEAK_BF16 = 197e12  # TPU v5e peak bf16 FLOP/s (scaling-book table)
 PEAK_FLOPS = {"tpu": V5E_PEAK_BF16, "axon": V5E_PEAK_BF16}
 
+#: analytic-vs-cost-model FLOPs disagreement above this flags the estimate
+FLOPS_DISAGREE_WARN = 0.10
+
+
+def cost_analysis_flops(step, *args):
+    """XLA cost-model FLOPs per execution of the jitted ``step`` — an AOT
+    ``lower()`` (trace only, no compile, no execution; MUST run before the
+    warmup donates the param buffers) + ``cost_analysis()``. Best-effort:
+    None when the backend doesn't report flops."""
+    try:
+        # the observatory owns the jax-version-dependent result parsing
+        from deeplearning4j_tpu.observability.cost_model import (
+            parse_cost_analysis)
+        flops, _ = parse_cost_analysis(step.lower(*args).cost_analysis())
+        return flops or None
+    except Exception as e:
+        print(f"[bench] cost_analysis failed: {e!r}", file=sys.stderr)
+        return None
+
 
 def probe_accelerator():
     """Check in THROWAWAY subprocesses whether the default jax backend
@@ -328,6 +347,10 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
         toks = jnp.asarray(
             rng.integers(0, cfg.vocab_size, (batch, cfg.max_len)), jnp.int32)
         tgts = jnp.roll(toks, -1, axis=1)
+        # cost-model cross-check input: lowered BEFORE the warmup executes
+        # (donation leaves the param buffers deleted afterwards); the trace
+        # is cached, so the warmup's compile reuses it
+        cost_flops = cost_analysis_flops(step, params, opt_state, toks, tgts)
         try:
             phase(f"warmup (compile) batch={batch} remat={remat}")
             ours = StepTimer(step, params, opt_state, toks, tgts, iters)
@@ -408,6 +431,37 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
     # rather than report nonsense (a tunnel/relay timing artifact)
     timing_suspect = bool(mfu is not None and mfu > 1.0)
 
+    # --- analytic vs. XLA-cost-model FLOPs cross-check -------------------
+    # The 6·N counting that prices the MFU is an ESTIMATE; the compiled
+    # step's own cost analysis is the ground truth for what the program
+    # computes (unoptimized HLO — remat re-computation shows up here, so
+    # remat configs legitimately exceed 6·N). >10% disagreement on a
+    # non-remat config means the estimate (and the MFU built on it) is off.
+    analytic_step_flops = float(flops_per_token) * toks.shape[0] * cfg.max_len
+    flops_disagreement = None
+    flops_estimate_suspect = False
+    if cost_flops:
+        flops_disagreement = abs(cost_flops - analytic_step_flops) \
+            / analytic_step_flops
+        flops_estimate_suspect = bool(not cfg.remat
+                                      and flops_disagreement
+                                      > FLOPS_DISAGREE_WARN)
+        if flops_estimate_suspect:
+            print(f"[bench] WARNING: analytic 6·N FLOPs/step "
+                  f"({analytic_step_flops:.3e}) disagrees with "
+                  f"cost_analysis ({cost_flops:.3e}) by "
+                  f"{flops_disagreement:.1%} (> {FLOPS_DISAGREE_WARN:.0%}) "
+                  f"— the reported MFU inherits that error",
+                  file=sys.stderr)
+        # feed the live observatory the same numbers so a long-running
+        # process started from this entry point serves them on /debug/perf
+        try:
+            from deeplearning4j_tpu.observability import cost_model as _cost
+            _cost.global_cost_model().record_cost(
+                "bench.TransformerLM.step", cost_flops)
+        except Exception:
+            pass
+
     out = {
         "metric": "transformer_lm_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
@@ -424,6 +478,10 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
         "host_tokens_per_sec": round(host_tps, 1) if host_tps else None,
         "flax_tokens_per_sec": round(flax_reported, 1) if flax_reported else None,
         "n_params": n_params,
+        "analytic_flops_per_step": analytic_step_flops,
+        "cost_model_flops_per_step": cost_flops,
+        "flops_disagreement": (round(flops_disagreement, 4)
+                               if flops_disagreement is not None else None),
         "config": {"layers": cfg.n_layers, "d_model": cfg.d_model,
                    "seq": cfg.max_len, "batch": batch, "remat": cfg.remat,
                    "dtype": str(getattr(cfg.dtype, "__name__", cfg.dtype))},
@@ -431,6 +489,8 @@ def measure(rung: str, force_cpu: bool = False) -> dict:
         "flash_probe_error": transformer_mod._FLASH_PROBE_ERROR,
         "loss": float(ours.loss),
     }
+    if flops_estimate_suspect:
+        out["flops_estimate_suspect"] = True
     if timing_suspect:
         out["timing_suspect"] = True
         print("[bench] WARNING: computed MFU > 1.0 — step timing is not "

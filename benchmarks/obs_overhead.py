@@ -1,6 +1,6 @@
 """Instrumentation overhead guard (observability PR acceptance tool).
 
-Measures the lenet train step in five modes, interleaved with a
+Measures the lenet train step in six modes, interleaved with a
 min-estimator:
 
 - ``off``      — ``DL4J_TPU_METRICS=0`` (everything no-ops)
@@ -12,11 +12,15 @@ min-estimator:
 - ``no_res``   — everything on, ``DL4J_TPU_RESILIENCE=0`` (isolates the
   PR-5 resilience layer: armed-but-idle fault checks and policies, no
   faults configured)
+- ``no_cost``  — everything on, ``DL4J_TPU_COST_MODEL=0`` (isolates the
+  PR-6 cost observatory: per-step duration feed + the once-per-compile
+  AOT cost lowering)
 - ``on``       — full default instrumentation + armed resilience
 
 Acceptance bars: total overhead (on vs off) <5%; trace-id propagation
 overhead (on vs no_trace) <2%; observatory overhead (on vs no_obs) <2%;
-resilience overhead (on vs no_res, policies armed / no faults) <2%.
+resilience overhead (on vs no_res, policies armed / no faults) <2%;
+cost-observatory overhead (on vs no_cost) <2%.
 
 Each mode runs in a fresh subprocess: the kill switches are applied at
 instrument creation (and, for numerics, at trace time), so flipping them
@@ -69,9 +73,12 @@ MODES = {
     "no_res": {"DL4J_TPU_METRICS": "1", "DL4J_TPU_TRACE": "1",
                "DL4J_TPU_NUMERICS": "1", "DL4J_TPU_COMPILE_WATCH": "1",
                "DL4J_TPU_RESILIENCE": "0"},
+    "no_cost": {"DL4J_TPU_METRICS": "1", "DL4J_TPU_TRACE": "1",
+                "DL4J_TPU_NUMERICS": "1", "DL4J_TPU_COMPILE_WATCH": "1",
+                "DL4J_TPU_RESILIENCE": "1", "DL4J_TPU_COST_MODEL": "0"},
     "on": {"DL4J_TPU_METRICS": "1", "DL4J_TPU_TRACE": "1",
            "DL4J_TPU_NUMERICS": "1", "DL4J_TPU_COMPILE_WATCH": "1",
-           "DL4J_TPU_RESILIENCE": "1"},
+           "DL4J_TPU_RESILIENCE": "1", "DL4J_TPU_COST_MODEL": "1"},
 }
 
 
@@ -110,15 +117,18 @@ def main():
                       / best["no_trace"] * 100.0)
     obs_overhead = (best["on"] - best["no_obs"]) / best["no_obs"] * 100.0
     res_overhead = (best["on"] - best["no_res"]) / best["no_res"] * 100.0
+    cost_overhead = (best["on"] - best["no_cost"]) / best["no_cost"] * 100.0
     result = {"lenet_step_seconds_uninstrumented": best["off"],
               "lenet_step_seconds_metrics_only": best["no_trace"],
               "lenet_step_seconds_no_observatory": best["no_obs"],
               "lenet_step_seconds_no_resilience": best["no_res"],
+              "lenet_step_seconds_no_cost_model": best["no_cost"],
               "lenet_step_seconds_instrumented": best["on"],
               "overhead_percent": overhead,
               "trace_overhead_percent": trace_overhead,
               "observatory_overhead_percent": obs_overhead,
               "resilience_overhead_percent": res_overhead,
+              "cost_overhead_percent": cost_overhead,
               "steps": args.steps, "batch": args.batch}
     if args.json:
         print(json.dumps(result, indent=2))
@@ -132,6 +142,8 @@ def main():
               f"{best['no_obs'] * 1e3:8.3f} ms")
         print(f"  no resilience  (DL4J_TPU_RESILIENCE=0):       "
               f"{best['no_res'] * 1e3:8.3f} ms")
+        print(f"  no cost model  (DL4J_TPU_COST_MODEL=0):       "
+              f"{best['no_cost'] * 1e3:8.3f} ms")
         print(f"  instrumented   (default):            "
               f"{best['on'] * 1e3:8.3f} ms")
         print(f"  total overhead: {overhead:+.2f}%  (bar: < 5%)")
@@ -141,6 +153,8 @@ def main():
               f"{obs_overhead:+.2f}%  (bar: < 2%)")
         print(f"  resilience overhead (policies armed, no faults): "
               f"{res_overhead:+.2f}%  (bar: < 2%)")
+        print(f"  cost-observatory overhead (MFU feed + AOT cost "
+              f"lowering): {cost_overhead:+.2f}%  (bar: < 2%)")
     return overhead
 
 

@@ -21,6 +21,7 @@ import optax
 from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import cost_model as _cost
 from deeplearning4j_tpu.observability import numerics as _num
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
@@ -435,6 +436,18 @@ class ComputationGraph:
                     self._pending_health = self._pending_health[32:]
                     _num.publish(self, old)
         t1 = time.perf_counter()
+        # cost observatory: live MFU from the measured step duration; a
+        # fresh compile (counted by compile_watch's probe) triggers one
+        # AOT re-lowering for cost_analysis() — a jaxpr-cache hit, no
+        # retrace (see MultiLayerNetwork._fit_batch)
+        _cost.on_step(
+            "ComputationGraph._train_step",
+            getattr(self, "_cost_fn_name", None)
+            or "ComputationGraph._train_step",
+            t1 - t0,
+            lambda: type(self)._train_step.lower(
+                self, self._params, self._opt_state, self._states, inputs,
+                labels, fmasks, lmasks, rng, None, frozenset(self._frozen)))
         self._iteration += 1
         with _span("listeners", model="ComputationGraph"):
             for lst in self._listeners:
@@ -497,6 +510,16 @@ class ComputationGraph:
         _cw.note_trace("ComputationGraph._output_jit", (inputs, masks))
         acts, _ = self._forward(params, states, inputs, False, None, masks=masks)
         return tuple(acts[n] for n in self.conf.network_outputs)
+
+    def _lower_output(self, x, mask=None):
+        """AOT-lower the serving entry point at ``x``'s signature (cost
+        accounting; see MultiLayerNetwork._lower_output). Serving drives
+        graphs through the single-input ``output(x)`` surface, so the
+        lowering mirrors that arity."""
+        arrs = (jnp.asarray(_unwrap(x)),)
+        return type(self)._output_jit.lower(
+            self, self._params, self._states, arrs,
+            None if mask is None else (jnp.asarray(_unwrap(mask)),))
 
     def output(self, *inputs, masks=None):
         """Forward pass → output activations; single output unwrapped

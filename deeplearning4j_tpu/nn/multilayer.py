@@ -25,6 +25,7 @@ from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
 from deeplearning4j_tpu.nn import params as _flat
 from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import cost_model as _cost
 from deeplearning4j_tpu.observability import numerics as _num
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
@@ -490,6 +491,19 @@ class MultiLayerNetwork:
                         self._pending_health = self._pending_health[32:]
                         _num.publish(self, old)
             t1 = time.perf_counter()
+            # cost observatory: feed the measured step duration into the
+            # live MFU, and — only when compile_watch counted a fresh
+            # trace — AOT re-lower the step at this exact signature (a
+            # jaxpr-cache hit: no retrace, no compile) for
+            # cost_analysis() FLOPs/bytes. Steady state: one int compare.
+            _cost.on_step(
+                "MultiLayerNetwork._train_step",
+                getattr(self, "_cost_fn_name", None)
+                or "MultiLayerNetwork._train_step",
+                t1 - t0,
+                lambda: type(self)._train_step.lower(
+                    self, self._params, self._opt_state, self._states, x, y,
+                    fmask, lmask, rng, None, frozenset(self._frozen)))
             self._iteration += 1
             with _span("listeners", model="MultiLayerNetwork"):
                 for lst in self._listeners:
@@ -602,6 +616,14 @@ class MultiLayerNetwork:
         _cw.note_trace("MultiLayerNetwork._output_jit", (x, mask))
         h, _, _ = self._forward(params, states, x, False, None, mask=mask)
         return h
+
+    def _lower_output(self, x, mask=None):
+        """AOT-lower the serving entry point at ``x``'s signature (cost
+        accounting: ``.lower().cost_analysis()`` — a jaxpr-cache hit when
+        the shape already compiled, never an execution)."""
+        x = jnp.asarray(_unwrap(x))
+        return type(self)._output_jit.lower(
+            self, self._params, self._states, x, mask)
 
     def output(self, x, train: bool = False, mask=None) -> NDArray:
         """Forward pass returning output-layer activations (ref: #output)."""

@@ -25,6 +25,11 @@ Three pillars:
   in-graph non-finite/grad-norm/update-ratio health fused into the train
   step (divergence SLO rule, opt-in skip-on-nonfinite policy), and
   per-device HBM gauges from ``Device.memory_stats()``.
+- **Performance observatory** (`cost_model.py`, `profile_capture.py`):
+  per-entry-point FLOPs/bytes from ``cost_analysis()`` on every
+  (re)compile, live MFU + roofline verdicts against an env-overridable
+  peak table (``GET /debug/perf``, perf-regression SLO rule), and
+  on-demand device profiling (``GET /debug/profile?steps=N``).
 
 Quick tour::
 
@@ -66,6 +71,13 @@ from deeplearning4j_tpu.observability.compile_watch import (
 from deeplearning4j_tpu.observability.numerics import (
     DivergenceRule, numerics_enabled, skip_on_nonfinite)
 from deeplearning4j_tpu.observability import device_memory
+from deeplearning4j_tpu.observability.cost_model import (
+    CostModel, cost_model_enabled, global_cost_model,
+    reset_global_cost_model)
+from deeplearning4j_tpu.observability.slo import PerfRegressionRule
+from deeplearning4j_tpu.observability.profile_capture import (
+    ProfileCapture, global_profile_capture, profile_enabled,
+    reset_global_profile_capture)
 
 #: ergonomic aliases
 metrics = global_registry
@@ -89,6 +101,10 @@ __all__ = [
     "global_compile_watch", "reset_global_compile_watch",
     "DivergenceRule", "numerics_enabled", "skip_on_nonfinite",
     "device_memory",
+    "CostModel", "cost_model_enabled", "global_cost_model",
+    "reset_global_cost_model", "PerfRegressionRule",
+    "ProfileCapture", "global_profile_capture", "profile_enabled",
+    "reset_global_profile_capture",
 ]
 
 

@@ -45,6 +45,7 @@ under the ``DL4J_TPU_METRICS=0`` master.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -383,9 +384,31 @@ def _ensure_listener() -> None:
         pass
 
 
+# cost-model AOT re-lowerings re-enter the jitted bodies on a jaxpr-cache
+# miss; their traces compile nothing, so the probes must stay silent for
+# the duration (thread-local: the lowering happens on the caller's thread)
+_suppress_tls = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_probes():
+    """``with suppress_probes(): f.lower(...)`` — body re-entries inside
+    the block are not counted as compiles (cost_model's AOT lowering)."""
+    prev = getattr(_suppress_tls, "active", False)
+    _suppress_tls.active = True
+    try:
+        yield
+    finally:
+        _suppress_tls.active = prev
+
+
+def probes_suppressed() -> bool:
+    return getattr(_suppress_tls, "active", False)
+
+
 def note_trace(fn: str, *arg_trees, **attrs) -> None:
     """Module-level probe the jitted bodies call (see CompileWatch)."""
-    if not compile_watch_enabled():
+    if not compile_watch_enabled() or probes_suppressed():
         return
     _ensure_listener()
     global_compile_watch().note_trace(fn, *arg_trees, **attrs)

@@ -37,6 +37,9 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
 - ``resilience.json`` — fault plan + injection counts, circuit-breaker
   states, and the resilience event ring (retries, sheds, breaker
   transitions, restores, quarantines)
+- ``perf.json`` — the cost observatory: per-entry-point FLOPs/bytes,
+  live MFU vs. its rolling baseline, and roofline verdicts (was the
+  process slow BEFORE it died?)
 
 Kill switch: ``DL4J_TPU_FLIGHT_RECORDER=0`` disables the watchdog and the
 crash hooks; explicit ``dump()`` calls always work.
@@ -318,6 +321,9 @@ class FlightRecorder:
         # were open, and the retry/shed/restore/quarantine event trail —
         # a hang during a chaos run must name the chaos
         section("resilience.json", self._write_resilience)
+        # the PR-6 cost observatory: per-fn cost/MFU/roofline at the
+        # moment of death — a postmortem for "it got slow, then it hung"
+        section("perf.json", self._write_perf)
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -366,6 +372,14 @@ class FlightRecorder:
         from deeplearning4j_tpu import resilience
         with open(path, "w") as f:
             json.dump(resilience.snapshot(), f, indent=2, default=str)
+
+    @staticmethod
+    def _write_perf(path: str):
+        from deeplearning4j_tpu.observability.cost_model import (
+            global_cost_model)
+        with open(path, "w") as f:
+            json.dump(global_cost_model().snapshot(), f, indent=2,
+                      default=str)
 
     @staticmethod
     def _write_metrics(path: str):

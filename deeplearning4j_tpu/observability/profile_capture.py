@@ -1,0 +1,256 @@
+"""On-demand device profiling: drive the ``profiler/xprof.py``
+DeviceProfiler from an HTTP endpoint and serve a parsed top-K per-op
+device-time table.
+
+bench.py proved the XPlane protocol (device-measured picosecond durations
+that transport timing cannot fake — ``benchmarks/device_timing.py``); this
+module makes the same capture available to a RUNNING process without
+restarting it under a profiler:
+
+    GET /debug/profile?steps=N   — trace until N more work units (fit
+                                   iterations + serving device batches)
+                                   complete, bounded by ``timeout_s``
+    GET /debug/profile           — the retained parsed captures
+
+A capture is one ``jax.profiler`` trace written under the postmortem
+directory (``profile-<pid>-<nonce>-<seq>``), parsed into:
+
+- ``top_ops``  — per-op device time, aggregated and sorted (the "XLA Ops"
+  line of the device planes; on stat-less CPU backends the per-op events
+  live on host execution planes and the parser falls back to those)
+- ``modules``  — per-XLA-module execution durations (the step-level view
+  bench.py's device timing uses)
+
+Retention is capped like postmortem bundles: trace directories beyond
+``DL4J_TPU_POSTMORTEM_KEEP`` are evicted oldest-first (trace files are
+multi-MB; the parsed tables are small and ride a bounded ring). One
+capture runs at a time — the jax profiler is process-global.
+
+Kill switch: ``DL4J_TPU_PROFILE=0`` refuses captures (HTTP 403).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.observability.flight_recorder import (_keep_bundles,
+                                                              postmortem_dir)
+from deeplearning4j_tpu.observability.registry import global_registry
+
+#: retained parsed capture records (metadata + tables, small)
+_RING_CAPACITY = 16
+
+#: hard ceiling on one capture's wall time, whatever the caller asked for
+_MAX_TIMEOUT_S = 60.0
+
+
+class ProfileDisabled(RuntimeError):
+    """DL4J_TPU_PROFILE=0 — captures are refused."""
+
+
+class CaptureBusy(RuntimeError):
+    """A capture is already running (the jax profiler is process-global)."""
+
+
+def profile_enabled() -> bool:
+    """Kill switch (read per call so tests can flip it)."""
+    return os.environ.get("DL4J_TPU_PROFILE", "1") != "0"
+
+
+def _work_units() -> int:
+    """Completed work units the capture waits on: fit iterations + serving
+    device batches — the same clocks the flight recorder's progress
+    channels beat on."""
+    from deeplearning4j_tpu.observability.train_metrics import (
+        total_iterations)
+    n = total_iterations()
+    inst = global_registry().get("dl4j_inference_batches_total")
+    if inst is not None:
+        n += int(sum(child.value for _, child in inst.series()))
+    return n
+
+
+# ------------------------------------------------------------- xplane parse
+def _load_xplanes(logdir: str):
+    # deferred: the xplane proto ships inside tensorflow (tsl) and is heavy
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    spaces = []
+    for f in glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                       recursive=True):
+        sp = xplane_pb2.XSpace()
+        with open(f, "rb") as fh:
+            sp.ParseFromString(fh.read())
+        spaces.append(sp)
+    return spaces
+
+
+def parse_top_ops(logdir: str, top: int = 20) -> Dict[str, List[dict]]:
+    """Aggregate per-op and per-module device time out of a trace dir.
+
+    Device planes ("/device:TPU:*" — durations measured by the chip) are
+    authoritative; when none carry events (CPU backend), per-op events on
+    the host execution planes (every line except the python tracer) are
+    the fallback, which is exactly what the CPU test mesh produces."""
+    op_agg: Dict[str, List[float]] = {}
+    mod_agg: Dict[str, List[float]] = {}
+    host_agg: Dict[str, List[float]] = {}
+    for space in _load_xplanes(logdir):
+        for plane in space.planes:
+            meta = plane.event_metadata
+            device = plane.name.startswith("/device:") \
+                and "CUSTOM" not in plane.name
+            for line in plane.lines:
+                if device and line.name == "XLA Ops":
+                    for ev in line.events:
+                        if ev.duration_ps <= 0:
+                            continue
+                        a = op_agg.setdefault(meta[ev.metadata_id].name,
+                                              [0.0, 0])
+                        a[0] += ev.duration_ps / 1e12
+                        a[1] += 1
+                elif device and "module" in line.name.lower():
+                    for ev in line.events:
+                        name = meta[ev.metadata_id].name.split("(")[0]
+                        a = mod_agg.setdefault(name, [0.0, 0])
+                        a[0] += ev.duration_ps / 1e12
+                        a[1] += 1
+                elif not device and line.name != "python":
+                    for ev in line.events:
+                        if ev.duration_ps <= 0:
+                            continue
+                        name = meta[ev.metadata_id].name
+                        if ".py:" in name:     # python-tracer frames, not ops
+                            continue
+                        a = host_agg.setdefault(name, [0.0, 0])
+                        a[0] += ev.duration_ps / 1e12
+                        a[1] += 1
+    src = op_agg or host_agg
+    rows = sorted(((k, v[0], v[1]) for k, v in src.items()),
+                  key=lambda r: -r[1])[:top]
+    return {
+        "top_ops": [{"op": k, "total_seconds": s, "count": c}
+                    for k, s, c in rows],
+        "modules": [{"module": k, "total_seconds": s, "count": c}
+                    for k, s, c in sorted(
+                        ((k, v[0], v[1]) for k, v in mod_agg.items()),
+                        key=lambda r: -r[1])],
+        "source": "device" if op_agg else "host",
+    }
+
+
+class ProfileCapture:
+    """Bounded ring of parsed captures + the capture mutex. One
+    process-wide instance via :func:`global_profile_capture`."""
+
+    def __init__(self, out_dir: Optional[str] = None):
+        self._out_dir = out_dir
+        self._busy = threading.Lock()
+        self._ring_lock = threading.Lock()
+        self._ring: deque = deque(maxlen=_RING_CAPACITY)
+        self._seq = 0
+        self._instance = os.urandom(3).hex()
+
+    def _base_dir(self) -> str:
+        return self._out_dir or postmortem_dir()
+
+    def capture(self, steps: int = 1, timeout_s: float = 5.0,
+                top: int = 20) -> dict:
+        """Profile until ``steps`` more work units complete (or
+        ``timeout_s``), parse, retain, return the record."""
+        if not profile_enabled():
+            raise ProfileDisabled("device profiling disabled "
+                                  "(DL4J_TPU_PROFILE=0)")
+        if not self._busy.acquire(blocking=False):
+            raise CaptureBusy("a profile capture is already running")
+        try:
+            from deeplearning4j_tpu.profiler.xprof import DeviceProfiler
+
+            with self._ring_lock:
+                self._seq += 1
+                seq = self._seq
+            trace_dir = os.path.join(
+                self._base_dir(),
+                f"profile-{os.getpid()}-{self._instance}-{seq:03d}")
+            timeout_s = min(max(0.1, float(timeout_s)), _MAX_TIMEOUT_S)
+            steps = max(1, int(steps))
+            prof = DeviceProfiler(trace_dir)
+            base = _work_units()
+            t0 = time.monotonic()
+            prof.start()
+            try:
+                while (time.monotonic() - t0 < timeout_s
+                       and _work_units() - base < steps):
+                    time.sleep(0.02)
+            finally:
+                prof.stop()
+            record = {
+                "id": f"{os.getpid()}-{self._instance}-{seq:03d}",
+                "trace_dir": trace_dir,
+                "unix_ts": time.time(),
+                "duration_seconds": time.monotonic() - t0,
+                "steps_requested": steps,
+                "steps_seen": _work_units() - base,
+            }
+            try:
+                record.update(parse_top_ops(trace_dir, top=top))
+            except Exception as e:      # TF absent / proto drift: the trace
+                record["parse_error"] = repr(e)   # dir still exists on disk
+            self._prune()
+            with self._ring_lock:
+                self._ring.append(record)
+            return record
+        finally:
+            self._busy.release()
+
+    def _prune(self):
+        """Evict trace dirs beyond the postmortem retention cap (the same
+        knob bundles honor — trace files are multi-MB)."""
+        keep = _keep_bundles()
+        base = self._base_dir()
+        try:
+            entries = [os.path.join(base, e) for e in os.listdir(base)
+                       if e.startswith("profile-")
+                       and os.path.isdir(os.path.join(base, e))]
+            entries.sort(key=lambda p: (os.path.getmtime(p), p))
+            # the just-written trace dir is in the listing (newest) — the
+            # same oldest-first eviction bundles use
+            for old in entries[:-keep]:
+                shutil.rmtree(old, ignore_errors=True)
+        except OSError:
+            pass
+
+    def snapshot(self) -> dict:
+        with self._ring_lock:
+            captures = [dict(r) for r in self._ring]
+        return {"enabled": profile_enabled(), "captures": captures}
+
+    def clear(self):
+        with self._ring_lock:
+            self._ring.clear()
+
+
+_global_capture: Optional[ProfileCapture] = None
+_capture_lock = threading.Lock()
+
+
+def global_profile_capture() -> ProfileCapture:
+    """THE process-wide capture ring ``/debug/profile`` serves."""
+    global _global_capture
+    if _global_capture is None:
+        with _capture_lock:
+            if _global_capture is None:
+                _global_capture = ProfileCapture()
+    return _global_capture
+
+
+def reset_global_profile_capture(**kw) -> ProfileCapture:
+    global _global_capture
+    with _capture_lock:
+        _global_capture = ProfileCapture(**kw)
+    return _global_capture

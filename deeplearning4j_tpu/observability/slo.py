@@ -190,6 +190,54 @@ class GaugeThresholdRule(SLORule):
                 f"failing_{key}": self.failing}
 
 
+class PerfRegressionRule(SLORule):
+    """Live MFU sustained below its own rolling baseline — the cost
+    observatory's per-fn MFU (cost_model FLOPs / rolling-mean step time)
+    is compared against the slow-EWMA reference the model keeps for each
+    entry point. A sustained drop means the same program got slower:
+    input starvation, a background process, a degraded interconnect, or
+    a silently worse executable. Perf-only signal: degrades, never fails
+    (slow is a page, not an ejection). Thin-data gated — a fn needs
+    ``min_samples`` timed executions before it can grade."""
+
+    def __init__(self, name: str = "perf_regression",
+                 drop: Optional[float] = None,
+                 min_samples: int = 24, description: str = ""):
+        if drop is None:
+            # ONE constant shared with the baseline's freeze margin
+            # (cost_model) — a drop this rule flags can never erode its
+            # own reference. A custom smaller drop loses that guarantee.
+            from deeplearning4j_tpu.observability.cost_model import (
+                PERF_REGRESSION_DROP)
+            drop = PERF_REGRESSION_DROP
+        super().__init__(name, description or
+                         f"live MFU > {drop:.0%} below its rolling baseline")
+        self.drop = drop
+        self.min_samples = min_samples
+
+    def _evaluate(self, registry) -> dict:
+        # lazy: cost_model imports nothing from here, but keeping the
+        # import out of module scope matches the other observatory rules
+        from deeplearning4j_tpu.observability.cost_model import (
+            global_cost_model)
+        worst = None
+        for fn, mfu, baseline, samples in global_cost_model(
+                ).regression_view():
+            if samples < self.min_samples or not baseline:
+                continue
+            ratio = mfu / baseline
+            if worst is None or ratio < worst[1]:
+                worst = (fn, ratio, mfu, baseline)
+        if worst is None:
+            return {"status": OK, "detail": f"<{self.min_samples} samples"}
+        fn, ratio, mfu, baseline = worst
+        status = DEGRADED if ratio < 1.0 - self.drop else OK
+        return {"status": status, "value": ratio,
+                "degraded_below": 1.0 - self.drop,
+                "detail": f"{fn}: mfu {mfu:.4g} vs baseline "
+                          f"{baseline:.4g}"}
+
+
 def default_rules() -> List[SLORule]:
     """The serving/training SLOs every deployment cares about. Perf-only
     signals (prefetch overlap, retrace churn) cap short of ejection —
@@ -226,6 +274,9 @@ def default_rules() -> List[SLORule]:
                         "step asked (transfer/compute overlap health)"),
         RetraceStormRule(),
         DivergenceRule(),
+        # the same program getting slower (MFU under its own rolling
+        # baseline) pages; like retrace churn it never ejects the replica
+        PerfRegressionRule(),
         # an OPEN circuit means callers are being failed fast — eject the
         # replica; half-open (recovery probing) is a page, not an ejection
         CircuitOpenRule(),

@@ -56,6 +56,7 @@ import numpy as np
 
 from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import cost_model as _cost
 from deeplearning4j_tpu.observability import device_memory as _devmem
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
@@ -852,7 +853,14 @@ class ParallelInference:
                 t_done = now_us()
                 self._record_phase("device", batch, t_dev, t_done,
                                    examples=n)
-                obs.straggler.observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                obs.straggler.observe(dt)
+                # cost observatory: the sync loop's sole executable (pad
+                # to batch_limit) — account once (the dispatch above
+                # already compiled it; the lowering is a cache hit), then
+                # feed every batch's device wall time into its MFU
+                _cost.maybe_account_bucket(self.model, self.batch_limit, X)
+                _cost.observe_bucket_time(self.model, self.batch_limit, dt)
                 if self._breaker is not None:
                     self._breaker.record_success()
                 self._distribute(batch, out)
@@ -949,13 +957,18 @@ class ParallelInference:
                         self._breaker.record_failure()
                     self._fail(batch, e)
                     continue
+                # first dispatch of a padded shape just compiled the
+                # bucket executable — account its cost now (AOT lowering
+                # at the same signature = cache hit, no second compile)
+                _cost.maybe_account_bucket(self.model, X.shape[0], X)
                 if self._put_stage(self._complete_q,
-                                   (dev, batch, n, t_disp)):
+                                   (dev, batch, n, t_disp, X.shape[0])):
                     obs.inflight.set(self._complete_q.qsize())
                 else:
                     # shutdown raced the handoff: materialize inline so
                     # the callers still get their (valid) results
-                    self._complete_one(obs, dev, batch, n, t_disp)
+                    self._complete_one(obs, dev, batch, n, t_disp,
+                                       X.shape[0])
         finally:
             # end-of-stream marker: a plain blocking put is safe because
             # the completer consumes until it sees the marker (it cannot
@@ -965,7 +978,8 @@ class ParallelInference:
             # stop-flag-only exit)
             self._complete_q.put(self._DONE)
 
-    def _complete_one(self, obs, dev, batch, n, t_dispatch=None):
+    def _complete_one(self, obs, dev, batch, n, t_dispatch=None,
+                      target=None):
         try:
             t_dev = now_us()
             with _span("inference_complete", requests=len(batch),
@@ -982,7 +996,12 @@ class ParallelInference:
             if t_dispatch is not None:
                 # straggler check over the batch's dispatch→complete wall
                 # time — the serving analog of a slow train step
-                obs.straggler.observe(time.perf_counter() - t_dispatch)
+                dt = time.perf_counter() - t_dispatch
+                obs.straggler.observe(dt)
+                if target is not None:
+                    # bucket MFU from the same duration (includes pipeline
+                    # queueing under multi-in-flight — a lower bound)
+                    _cost.observe_bucket_time(self.model, target, dt)
             _flight().progress("inference_batch")
             if self._breaker is not None:
                 self._breaker.record_success()

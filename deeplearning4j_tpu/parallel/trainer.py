@@ -21,6 +21,7 @@ import numpy as np
 
 from deeplearning4j_tpu.ndarray.ndarray import _unwrap
 from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import cost_model as _cost
 from deeplearning4j_tpu.observability import device_memory as _devmem
 from deeplearning4j_tpu.observability import global_registry
 from deeplearning4j_tpu.observability import span as _span
@@ -61,6 +62,8 @@ class ShardedTrainer:
         self.shard_optimizer_state = shard_optimizer_state
         self._placed = False
         self._grad_bytes = 0     # per-step gradient allreduce payload
+        self._collective_bytes = {}    # per-op bytes/step expectation
+        self._collective_counters = {}
         self._obs = None         # lazily-bound collective instruments
 
     # ------------------------------------------------------------------ setup
@@ -89,17 +92,44 @@ class ShardedTrainer:
         # sharded step's wall time; bytes are exact)
         n_data = _mesh.axis_size(self.mesh, DATA_AXIS) \
             if DATA_AXIS in self.mesh.axis_names else 1
-        self._grad_bytes = sum(
+        param_bytes = sum(
             leaf.size * leaf.dtype.itemsize
             for leaf in jax.tree.leaves(net._params)
-            if hasattr(leaf, "size")) if n_data > 1 else 0
+            if hasattr(leaf, "size"))
+        self._grad_bytes = param_bytes if n_data > 1 else 0
+        # per-collective traffic expectation (analytic): the plain
+        # synchronous step allreduces the whole gradient tree once; under
+        # ZeRO-style weight-update sharding XLA rewrites that into a
+        # reduce-scatter + all-gather pair, each moving (n-1)/n of the
+        # param bytes over the wire (ring schedule). Counted per step
+        # into dl4j_collective_bytes_total{collective} and served next to
+        # the measured cost-model numbers on /debug/perf.
+        if n_data > 1 and self.shard_optimizer_state:
+            wire = param_bytes * (n_data - 1) // n_data
+            self._collective_bytes = {"reduce_scatter": wire,
+                                      "all_gather": wire}
+        elif n_data > 1:
+            self._collective_bytes = {"allreduce": param_bytes}
+        else:
+            self._collective_bytes = {}
         reg = global_registry()
+        bytes_c = reg.counter(
+            "dl4j_collective_bytes_total",
+            "bytes moved per collective op (gradient allreduce payload = "
+            "param bytes x steps; ZeRO mode splits into reduce-scatter + "
+            "all-gather wire bytes)",
+            label_names=("collective",))
+        expected_g = reg.gauge(
+            "dl4j_collective_expected_bytes",
+            "analytic per-step traffic expectation of each collective the "
+            "sharded train step fuses (compare against the cost model's "
+            "bytes accessed on /debug/perf)",
+            label_names=("collective",))
+        self._collective_counters = {}
+        for op, nbytes in self._collective_bytes.items():
+            self._collective_counters[op] = bytes_c.labels(collective=op)
+            expected_g.labels(collective=op).set(nbytes)
         self._obs = (
-            reg.counter("dl4j_collective_bytes_total",
-                        "bytes moved per collective op (gradient allreduce "
-                        "payload = param bytes x steps)",
-                        label_names=("collective",)).labels(
-                            collective="allreduce"),
             reg.histogram("dl4j_collective_step_seconds",
                           "wall time of the sharded train step (compute + "
                           "fused gradient allreduce)",
@@ -108,8 +138,18 @@ class ShardedTrainer:
             reg.gauge("dl4j_mesh_devices", "devices in the active mesh",
                       label_names=("axis",)))
         for axis in self.mesh.axis_names:
-            self._obs[2].labels(axis=str(axis)).set(
+            self._obs[1].labels(axis=str(axis)).set(
                 _mesh.axis_size(self.mesh, axis))
+        # cost observatory: steps through this trainer account under their
+        # own entry (global-program FLOPs over a mesh-sized peak). The
+        # placement recompile often hits the jaxpr cache WITHOUT a retrace,
+        # so the entry is invalidated explicitly — the next step
+        # re-lowers at the sharded signature
+        _cost.global_cost_model().set_scale(
+            "ShardedTrainer.step", self.mesh.size)
+        _cost.global_cost_model().note_collectives(
+            "ShardedTrainer.step", self._collective_bytes)
+        _cost.global_cost_model().invalidate("ShardedTrainer.step")
         # re-homing params onto the mesh changes the step's sharding
         # signature — the wrapped net's _train_step retraces once, and
         # the compile watch attributes that compile to this placement
@@ -295,18 +335,27 @@ class ShardedTrainer:
         fmask = self._shard_batch(fmask)
         lmask = self._shard_batch(lmask)
         t0 = time.perf_counter()
-        with _span("sharded_step", grad_bytes=self._grad_bytes):
-            if isinstance(self.net, MultiLayerNetwork):
-                self.net._fit_batch(x, y, fmask, lmask)
-            else:  # ComputationGraph: tuple-valued inputs/labels/masks
-                tup = lambda v: (() if v is None
-                                 else tuple(v) if isinstance(v, (tuple, list))
-                                 else (v,))
-                self.net._fit_batch(tup(x), tup(y), tup(fmask), tup(lmask))
+        # only steps driven THROUGH the trainer book under the sharded
+        # entry (mesh-scaled peak); cleared so a later direct net.fit()
+        # reverts to the single-device entry
+        self.net._cost_fn_name = "ShardedTrainer.step"
+        try:
+            with _span("sharded_step", grad_bytes=self._grad_bytes):
+                if isinstance(self.net, MultiLayerNetwork):
+                    self.net._fit_batch(x, y, fmask, lmask)
+                else:  # ComputationGraph: tuple-valued inputs/labels/masks
+                    tup = lambda v: (() if v is None
+                                     else tuple(v) if isinstance(v, (tuple,
+                                                                     list))
+                                     else (v,))
+                    self.net._fit_batch(tup(x), tup(y), tup(fmask),
+                                        tup(lmask))
+        finally:
+            self.net._cost_fn_name = None
         if self._obs is not None:
-            if self._grad_bytes:
-                self._obs[0].inc(self._grad_bytes)
-            self._obs[1].observe(time.perf_counter() - t0)
+            for op, counter in self._collective_counters.items():
+                counter.inc(self._collective_bytes[op])
+            self._obs[0].observe(time.perf_counter() - t0)
 
     # --------------------------------------------------------------- inference
     def output(self, x):

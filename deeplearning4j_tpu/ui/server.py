@@ -12,7 +12,10 @@ failing), ``/alerts`` (active violations + transitions), ``/train/trace``
 postmortem bundle now), ``/debug/compiles`` (compile-watch ring: every XLA
 trace of the jitted entry points + the retrace-storm grade),
 ``/debug/resilience`` (fault-injection counts, circuit-breaker states,
-and the retry/shed/restore/quarantine event ring).
+and the retry/shed/restore/quarantine event ring), ``/debug/perf`` (the
+cost observatory: per-entry-point FLOPs/bytes, live MFU, roofline
+verdicts), ``/debug/profile`` (on-demand device profiling: ``?steps=N``
+captures N work units and serves the parsed top-K per-op table).
 """
 from __future__ import annotations
 
@@ -637,6 +640,60 @@ class UIServer:
                     body = json.dumps(resilience.snapshot(),
                                       default=str).encode()
                     ctype = "application/json"
+                elif parsed.path == "/debug/perf":
+                    # cost observatory: per-entry-point FLOPs / bytes
+                    # accessed (XLA cost model), live MFU vs. its rolling
+                    # baseline, roofline verdicts, and the peak table in
+                    # force — the first stop for "is this step fast?"
+                    from deeplearning4j_tpu.observability.cost_model import (
+                        global_cost_model)
+                    body = json.dumps(global_cost_model().snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/profile":
+                    # on-demand device profiling: ?steps=N traces until N
+                    # more work units complete (fit iterations + serving
+                    # device batches, bounded by ?timeout_s=) and serves
+                    # the parsed per-op device-time table; a plain GET
+                    # lists the retained captures. 403 when
+                    # DL4J_TPU_PROFILE=0, 409 while a capture is running
+                    # (the jax profiler is process-global)
+                    from deeplearning4j_tpu.observability import (
+                        profile_capture as _pc)
+                    ctype = "application/json"
+                    steps_raw = q.get("steps", [None])[0]
+                    if steps_raw is None:
+                        body = json.dumps(
+                            _pc.global_profile_capture().snapshot(),
+                            default=str).encode()
+                    else:
+                        try:
+                            steps = max(1, int(steps_raw))
+                        except ValueError:
+                            steps = 1
+                        try:
+                            timeout_s = float(
+                                q.get("timeout_s", ["5.0"])[0])
+                        except ValueError:
+                            timeout_s = 5.0
+                        try:
+                            top = int(q.get("top", ["20"])[0])
+                        except ValueError:
+                            top = 20
+                        try:
+                            record = _pc.global_profile_capture().capture(
+                                steps=steps, timeout_s=timeout_s, top=top)
+                            body = json.dumps(record,
+                                              default=str).encode()
+                        except _pc.ProfileDisabled as e:
+                            body = json.dumps({"error": str(e)}).encode()
+                            code = 403
+                        except _pc.CaptureBusy as e:
+                            body = json.dumps({"error": str(e)}).encode()
+                            code = 409
+                        except Exception as e:
+                            body = json.dumps({"error": repr(e)}).encode()
+                            code = 500
                 elif parsed.path == "/train/trace":
                     # Chrome trace-event JSON of the in-memory span ring —
                     # save and load in Perfetto / chrome://tracing

@@ -140,6 +140,57 @@ def main():
               + (f" compiled in {ev['compile_seconds']:.3f}s"
                  if ev.get("compile_seconds") is not None else ""))
 
+    # ---- performance observatory: /debug/perf ---------------------------
+    # per-entry-point FLOPs/bytes from the XLA cost model (accounted once
+    # per compile), live MFU against the peak table in force, and the
+    # roofline verdict — "is this step fast?" without running a bench.
+    # The train step above and each serving bucket executable have rows
+    perf = _json.loads(urllib.request.urlopen(
+        server.get_address() + "/debug/perf", timeout=5).read())
+    print(f"\n/debug/perf: platform={perf['platform']}, "
+          f"peak={perf['peak_flops']:.3g} FLOP/s, "
+          f"ridge={perf['ridge_intensity']:.2f} FLOPs/byte")
+    for fn, rec in perf["fns"].items():
+        if rec.get("flops") is None:
+            continue
+        mfu = rec.get("mfu")
+        # intensity/verdict are None when the backend reports no bytes
+        intensity = rec.get("arithmetic_intensity")
+        print(f"  {fn:<40} {rec['flops']:.3g} FLOPs "
+              + (f"intensity={intensity:.2f} " if intensity is not None
+                 else "")
+              + f"[{rec.get('roofline_verdict') or 'no-bytes'}]"
+              + (f" mfu={mfu:.4f}" if mfu is not None else ""))
+
+    # ---- on-demand device profiling: /debug/profile ---------------------
+    # drives the jax profiler against THIS running process (no restart)
+    # until N more work units complete, and serves the parsed top-K
+    # per-op device-time table; captures are retained under the
+    # postmortem retention cap and refused when DL4J_TPU_PROFILE=0
+    import threading as _threading
+    prof_net = net
+
+    def _background_steps():
+        for _ in range(10):
+            prof_net.fit(x, y)
+
+    t = _threading.Thread(target=_background_steps, daemon=True)
+    t.start()
+    try:
+        cap = _json.loads(urllib.request.urlopen(
+            server.get_address() + "/debug/profile?steps=3&timeout_s=30",
+            timeout=60).read())
+        print(f"\n/debug/profile capture {cap['id']}: "
+              f"{cap['steps_seen']} work units in "
+              f"{cap['duration_seconds']:.2f}s "
+              f"(source={cap.get('source', '?')})")
+        for row in cap.get("top_ops", [])[:5]:
+            print(f"  {row['op']:<48} {row['total_seconds'] * 1e3:9.3f} ms "
+                  f"x{row['count']}")
+    except urllib.error.HTTPError as e:     # 403 kill switch / 409 busy
+        print(f"\n/debug/profile refused: {e.code} {e.read().decode()}")
+    t.join()
+
     # ---- resilience: /debug/resilience ----------------------------------
     # fault-injection counts (chaos runs are auditable), circuit-breaker
     # states, the default serving deadline, and the recent event ring

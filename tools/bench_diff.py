@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Bench trajectory diff: grade the ``BENCH_r*.json`` history for
+SUSTAINED performance regressions, noise-aware for this box.
+
+Every round the driver runs ``bench.py`` once and archives the JSON as
+``BENCH_r<NN>.json`` (plus suffixed extras like ``BENCH_r05_bert.json``).
+Naively diffing raw tokens/sec across rounds is exactly wrong here: the
+box's load drifts by ±40% between minutes (the round-4 "regression" —
+0.908x at the SAME commit that measured 1.0–1.13x interactively — was
+pure drift). Three rules make the comparison meaningful:
+
+1. **Compare interleaved ratios, not raw single samples.** Each bench
+   round already measures the model under test against a plain-Flax
+   denominator INTERLEAVED (A,B,A,B windows; ``ratio_method:
+   paired_window_median`` = the median of paired-window ratios, i.e. a
+   min-of-N-style robust estimator over N interleaved pairs) — drift
+   hits both sides of a pair and divides out. The trajectory is graded
+   on that ``vs_baseline`` series and on device-trace MFU (chip-measured
+   picoseconds, immune to host load); raw host tokens/sec is reported
+   but never gated on.
+2. **Same platform only.** A CPU-fallback round (tunnel died) is not
+   comparable to a TPU round; each metric's trajectory is filtered to
+   the platform of its newest round.
+3. **Sustained only.** A regression must hold for the trailing
+   ``sustain`` rounds (default 2) against the MEDIAN of the prior
+   comparable rounds, with a tolerance sized to the residual noise of
+   the ratio estimator (default 25%). One bad round is weather; two in a
+   row under a 25% drop is climate.
+
+Run standalone (``python tools/bench_diff.py [root]``, exit code =
+sustained regressions found) or from tests (tests/test_obs_perf.py
+imports ``check_trajectory`` with synthetic histories and ``main`` over
+the real repo history, like check_metric_names).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+#: trailing rounds that must ALL violate before a regression is real
+DEFAULT_SUSTAIN = 2
+
+#: fractional drop below the prior-round median that counts as a
+#: violation — sized to the residual noise of the interleaved ratio
+#: estimator on this box, NOT to the ±40% raw-throughput drift
+DEFAULT_TOLERANCE = 0.25
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)[^/]*\.json$")
+
+
+class Sample(NamedTuple):
+    round: int
+    path: str
+    metric: str
+    platform: Optional[str]
+    vs_baseline: Optional[float]
+    mfu: Optional[float]
+    device_timed: bool
+    value: Optional[float]
+
+
+class Regression(NamedTuple):
+    metric: str
+    series: str            # "vs_baseline" | "device_mfu"
+    reference: float
+    trailing: Tuple[float, ...]
+    rounds: Tuple[int, ...]
+    tolerance: float
+
+    def __str__(self):
+        return (f"{self.metric} [{self.series}]: trailing rounds "
+                f"{list(self.rounds)} = {[round(v, 3) for v in self.trailing]}"
+                f" all > {self.tolerance:.0%} below prior-round median "
+                f"{self.reference:.3f}")
+
+
+def _parse_record(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    # driver wrapper format {n, cmd, rc, tail, parsed: {...}} or raw bench
+    rec = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else doc
+    return rec if isinstance(rec, dict) and rec.get("metric") else None
+
+
+def _file_mtime(path: str) -> float:
+    """mtime, 0.0 when the path doesn't exist (synthetic test Samples) —
+    equal keys keep the later glob-sorted file, the pre-mtime behavior."""
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
+def load_samples(root: str) -> List[Sample]:
+    out: List[Sample] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = _ROUND_RE.search(path)
+        rec = _parse_record(path)
+        if m is None or rec is None:
+            continue
+        value = rec.get("value")
+        out.append(Sample(
+            round=int(m.group(1)),
+            path=path,
+            metric=str(rec["metric"]),
+            platform=rec.get("platform"),
+            vs_baseline=(float(rec["vs_baseline"])
+                         if isinstance(rec.get("vs_baseline"), (int, float))
+                         else None),
+            mfu=(float(rec["mfu"])
+                 if isinstance(rec.get("mfu"), (int, float)) else None),
+            device_timed=rec.get("timing_source") == "device_trace",
+            value=(float(value)
+                   if isinstance(value, (int, float)) else None)))
+    return out
+
+
+def _grade_series(metric: str, series: str, points: List[Tuple[int, float]],
+                  tolerance: float, sustain: int) -> Optional[Regression]:
+    """One trajectory: trailing ``sustain`` points vs. the median of
+    everything before them. Needs at least sustain+1 points."""
+    if len(points) < sustain + 1:
+        return None
+    points = sorted(points)
+    prior = [v for _, v in points[:-sustain]]
+    trailing = points[-sustain:]
+    reference = statistics.median(prior)
+    if reference <= 0:
+        return None
+    floor = reference * (1.0 - tolerance)
+    if all(v < floor for _, v in trailing):
+        return Regression(metric, series, reference,
+                          tuple(v for _, v in trailing),
+                          tuple(r for r, _ in trailing), tolerance)
+    return None
+
+
+def check_trajectory(samples: List[Sample],
+                     tolerance: float = DEFAULT_TOLERANCE,
+                     sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade every metric's history; returns the sustained regressions."""
+    by_metric: Dict[str, List[Sample]] = {}
+    for s in samples:
+        by_metric.setdefault(s.metric, []).append(s)
+    out: List[Regression] = []
+    for metric, group in sorted(by_metric.items()):
+        group.sort(key=lambda s: s.round)
+        # newest FILE per round by mtime FIRST (a round may archive
+        # several files for one metric; glob order would let a stale
+        # suffixed archive shadow a fresh plain one — '_' sorts after
+        # '.')
+        newest: Dict[int, Sample] = {}
+        for s in group:
+            prev = newest.get(s.round)
+            if prev is None or _file_mtime(s.path) >= _file_mtime(prev.path):
+                newest[s.round] = s
+        # rule 2: only rounds on the platform the trajectory is currently
+        # being measured on are comparable — "currently" read from the
+        # newest round's authoritative (mtime-newest) file, so a stale
+        # archive can't flip the trajectory's platform either
+        platform = newest[max(newest)].platform
+        ordered = [newest[r] for r in sorted(newest)
+                   if newest[r].platform == platform]
+        ratio_pts = [(s.round, s.vs_baseline) for s in ordered
+                     if s.vs_baseline is not None]
+        reg = _grade_series(metric, "vs_baseline", ratio_pts,
+                            tolerance, sustain)
+        if reg is not None:
+            out.append(reg)
+        # device-trace MFU: chip-clocked, so the tighter signal when the
+        # rounds have it (host-load drift cannot touch picosecond sums)
+        mfu_pts = [(s.round, s.mfu) for s in ordered
+                   if s.mfu is not None and s.device_timed]
+        reg = _grade_series(metric, "device_mfu", mfu_pts,
+                            tolerance, sustain)
+        if reg is not None:
+            out.append(reg)
+    return out
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    root = args[0] if args else os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir))
+    samples = load_samples(root)
+    regressions = check_trajectory(samples)
+    for s in samples:
+        marks = []
+        if s.vs_baseline is not None:
+            marks.append(f"vs_baseline={s.vs_baseline:.3f}")
+        if s.mfu is not None and s.device_timed:
+            marks.append(f"device_mfu={s.mfu:.4f}")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + (" ".join(marks) or f"value={s.value}"))
+    for reg in regressions:
+        print(f"SUSTAINED REGRESSION: {reg}")
+    if not regressions:
+        print(f"bench trajectory OK ({len(samples)} samples under {root})")
+    return len(regressions)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
