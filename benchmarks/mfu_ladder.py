@@ -32,7 +32,14 @@ V5E_PEAK_BF16 = 197e12
 RUNGS = [
     ("base_12L_d1024_T1024_b8", {}, {}),
     ("no_fused_qkv", {"fused_qkv": False}, {}),
-    ("scan_layers", {"scan_layers": True}, {}),
+    # plain scan_layers OOM'd the window (bf16 [12,8,1024,...] HLO temps:
+    # the scan saves every layer's activations); remat bounds the live set
+    # to one layer. The "dots" save policy keeps matmul outputs resident
+    # so backward replays only the cheap ops instead of re-paying the MXU
+    # — the two rungs A/B full-recompute vs save-dots under scan
+    ("scan_layers", {"scan_layers": True, "remat": True}, {}),
+    ("scan_layers_remat_dots",
+     {"scan_layers": True, "remat": True, "remat_policy": "dots"}, {}),
     ("opt_state_bf16", {"opt_bf16": True}, {}),
     ("latency_hiding_scheduler", {},
      {"LIBTPU_INIT_ARGS": "--xla_tpu_enable_latency_hiding_scheduler=true"}),
@@ -69,7 +76,9 @@ def measure_rung(overrides: dict, smoke: bool) -> dict:
             vocab_size=512, n_layers=2, n_heads=4, d_model=128,
             max_len=128,
             dtype=jnp.float32, fused_qkv=overrides.get("fused_qkv", True),
-            scan_layers=overrides.get("scan_layers", False))
+            scan_layers=overrides.get("scan_layers", False),
+            remat=overrides.get("remat", False),
+            remat_policy=overrides.get("remat_policy"))
         batch = 2
         iters, repeats = 2, 1
     else:
@@ -78,7 +87,9 @@ def measure_rung(overrides: dict, smoke: bool) -> dict:
             max_len=int(overrides.get("max_len", 1024)),
             dtype=jnp.bfloat16,
             fused_qkv=overrides.get("fused_qkv", True),
-            scan_layers=overrides.get("scan_layers", False))
+            scan_layers=overrides.get("scan_layers", False),
+            remat=overrides.get("remat", False),
+            remat_policy=overrides.get("remat_policy"))
         batch = int(overrides.get("batch", 8))
         iters, repeats = 10, 2
 

@@ -94,4 +94,6 @@ def dense_from_sparse(encoded: jnp.ndarray, size: int) -> jnp.ndarray:
     idx = jnp.abs(entries) - 1
     safe = jnp.where(valid, idx, 0)
     vals = jnp.where(valid, jnp.sign(entries), 0).astype(jnp.int8)
-    return jnp.zeros((size,), jnp.int8).at[safe].max(vals)
+    # scatter-ADD: wire indices are unique, invalid slots contribute 0 at
+    # index 0 (a .max scatter would lose every -1 against the 0 init)
+    return jnp.zeros((size,), jnp.int8).at[safe].add(vals)

@@ -28,6 +28,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.nn._remat import remat as _remat
 from deeplearning4j_tpu.ops.moments import one_pass_moments
 from deeplearning4j_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS,
                                               MODEL_AXIS, SEQ_AXIS,
@@ -106,6 +107,12 @@ class TransformerConfig:
     remat: bool = False               # jax.checkpoint each block: trade
                                       # recompute FLOPs for HBM (SURVEY §7
                                       # rematerialisation lever)
+    remat_policy: Optional[str] = None  # named jax.checkpoint save policy
+                                      # ("dots" = keep matmul outputs, only
+                                      # replay cheap ops in backward); None
+                                      # = full recompute. See nn/_remat.py
+                                      # — scan_layers + remat without a
+                                      # policy double-pays the MXU
     scan_layers: bool = False         # lax.scan over stacked block params:
                                       # compile time/HLO size O(1) in depth
                                       # instead of O(L) — the deep-model
@@ -378,7 +385,7 @@ class TransformerLM:
                 body = (lambda b, h_, li: self._block_math(
                     b, h_, rng_mb, li, mesh=None)[0])
                 if c.remat:
-                    body = jax.checkpoint(body)
+                    body = _remat(body, c.remat_policy)
                 h = body(blk, h, stage * lps + i)
             return h
 
@@ -434,7 +441,10 @@ class TransformerLM:
                 body = (lambda b, x_: self._block_math(
                     b, x_, rng, li, self.mesh))
                 if c.remat:
-                    body = jax.checkpoint(body)
+                    # a policy ("dots") keeps matmul outputs saved so the
+                    # scan backward doesn't recompute the MXU work — the
+                    # fix for the scan_layers ladder rung's HLO-temp OOM
+                    body = _remat(body, c.remat_policy)
                 x, a = body(blk, x)
                 if dense:
                     return x, None
@@ -460,10 +470,10 @@ class TransformerLM:
             if c.remat:
                 # recompute each block's activations in backward instead
                 # of saving them: O(L·T·d) residuals shrink to O(T·d)
-                body = jax.checkpoint(
+                body = _remat(
                     lambda b, x_, li: self._block_math(
                         b, x_, rng, li, self.mesh),
-                    static_argnums=(2,))
+                    c.remat_policy, static_argnums=(2,))
                 for li, blk in enumerate(blocks):
                     x, a = body(blk, x, li)
                     if not dense:

@@ -1,14 +1,63 @@
 """Shared rematerialisation (jax.checkpoint) hook for the layer-API
-runtimes (SURVEY §7 lever; one place for future checkpoint-policy
-changes)."""
-def remat_apply(layer, lp, h, lst, lrng, kwargs):
+runtimes and the flagship transformer (SURVEY §7 lever; one place for
+checkpoint-policy changes).
+
+Plain remat recomputes EVERYTHING in backward — including the matmuls,
+which on TPU means paying the MXU twice. A *policy* keeps chosen
+primitives' outputs saved: ``"dots"`` (jax.checkpoint save-dots) keeps
+matmul/einsum results resident so remat only replays the cheap
+elementwise/norm ops — the standard fix for a scan-over-layers stack that
+otherwise either OOMs (no remat: all-layer activations live) or
+double-pays the FLOPs (full remat).
+"""
+from typing import Optional
+
+#: name → jax.checkpoint policy resolver. Names are config-surface
+#: strings (JSON-serializable) so MultiLayerConfiguration and
+#: TransformerConfig can carry them.
+_POLICY_NAMES = ("dots", "dots_no_batch", "nothing")
+
+
+def checkpoint_policy(name: Optional[str]):
+    """Resolve a policy name to a ``jax.checkpoint`` policy callable.
+    ``None``/empty = full remat (recompute everything, the historical
+    default)."""
+    import jax
+
+    if not name:
+        return None
+    pols = jax.checkpoint_policies
+    if name == "dots":
+        # save matmul outputs (with or without batch dims): backward
+        # recomputes only the cheap non-contraction ops
+        return pols.checkpoint_dots
+    if name == "dots_no_batch":
+        return pols.checkpoint_dots_with_no_batch_dims
+    if name == "nothing":
+        return pols.nothing_saveable
+    raise ValueError(
+        f"unknown remat policy {name!r} (one of {_POLICY_NAMES} or None)")
+
+
+def remat(fn, policy_name: Optional[str] = None, **checkpoint_kwargs):
+    """``jax.checkpoint`` with a named save policy — THE one spelling all
+    remat call sites (MLN/CG layer apply, transformer block/scan/pipeline
+    bodies) route through."""
+    import jax
+
+    policy = checkpoint_policy(policy_name)
+    if policy is not None:
+        checkpoint_kwargs["policy"] = policy
+    return jax.checkpoint(fn, **checkpoint_kwargs)
+
+
+def remat_apply(layer, lp, h, lst, lrng, kwargs, policy_name=None):
     """jax.checkpoint a layer's training-mode apply (shared by the MLN and
     ComputationGraph forward paths — one place for future checkpoint-policy
     changes)."""
-    import jax
 
     def _apply(lp_, h_, lst_, lrng_):
         return layer.apply(lp_, h_, training=True, rng=lrng_, state=lst_,
                            **kwargs)
 
-    return jax.checkpoint(_apply)(lp, h, lst, lrng)
+    return remat(_apply, policy_name)(lp, h, lst, lrng)

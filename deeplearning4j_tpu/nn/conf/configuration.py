@@ -160,12 +160,19 @@ class ListBuilder:
         self._backprop_type = t
         return self
 
-    def gradient_checkpointing(self, enabled: bool = True) -> "ListBuilder":
+    def gradient_checkpointing(self, enabled: bool = True,
+                               policy: Optional[str] = None) -> "ListBuilder":
         """jax.checkpoint every hidden layer during training: backward
         recomputes activations instead of saving them — the SURVEY §7
         rematerialisation lever (HBM for FLOPs). TPU extension; the
-        reference bounds memory with workspaces instead."""
+        reference bounds memory with workspaces instead.
+
+        ``policy`` names a jax.checkpoint save policy (see nn/_remat.py:
+        ``"dots"`` keeps matmul outputs resident so backward replays only
+        the cheap ops instead of double-paying the MXU); None = full
+        recompute."""
         self._remat = bool(enabled)
+        self._remat_policy = policy
         return self
 
     gradientCheckpointing = gradient_checkpointing
@@ -199,6 +206,7 @@ class ListBuilder:
             grad_norm_threshold=c._grad_norm_threshold,
             input_pre_processors=self._preprocessors,
             remat=getattr(self, "_remat", False),
+            remat_policy=getattr(self, "_remat_policy", None),
         )
 
 
@@ -219,6 +227,7 @@ class MultiLayerConfiguration:
     grad_norm_threshold: float = 1.0
     input_pre_processors: dict = dataclasses.field(default_factory=dict)
     remat: bool = False
+    remat_policy: Optional[str] = None
 
     def recompute_shapes(self):
         """Re-run config-time shape inference after layer edits
@@ -245,6 +254,7 @@ class MultiLayerConfiguration:
             "input_pre_processors": {str(k): v.to_dict() for k, v in
                                      self.input_pre_processors.items()},
             "remat": self.remat,
+            "remat_policy": self.remat_policy,
         }, indent=2)
 
     @staticmethod
@@ -265,4 +275,5 @@ class MultiLayerConfiguration:
                 int(k): _preproc.preprocessor_from_dict(v)
                 for k, v in (d.get("input_pre_processors") or {}).items()},
             remat=d.get("remat", False),
+            remat_policy=d.get("remat_policy"),
         )

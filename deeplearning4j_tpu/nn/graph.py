@@ -77,6 +77,8 @@ class ComputationGraph:
         self._key = jax.random.key(conf.seed)
         self._initialized = False
         self._frozen: set = set()          # transfer-learning frozen layer names
+        #: error-feedback gradient-compression state (see MultiLayerNetwork)
+        self._grad_compression_state = None
 
     # ------------------------------------------------------------------ init
     def init(self) -> "ComputationGraph":
@@ -194,8 +196,9 @@ class ComputationGraph:
                     from deeplearning4j_tpu.nn._remat import remat_apply
                     lx = (srcs if getattr(node.layer, "multi_input", False)
                           else srcs[0])
-                    h, st = remat_apply(node.layer, lp, lx, lst, lrng,
-                                        kwargs)
+                    h, st = remat_apply(
+                        node.layer, lp, lx, lst, lrng, kwargs,
+                        policy_name=getattr(self.conf, "remat_policy", None))
                 else:
                     lx = (srcs if getattr(node.layer, "multi_input", False)
                           else srcs[0])

@@ -481,10 +481,13 @@ class GraphBuilder:
 
     setOutputs = set_outputs
 
-    def gradient_checkpointing(self, enabled: bool = True) -> "GraphBuilder":
+    def gradient_checkpointing(self, enabled: bool = True,
+                               policy: Optional[str] = None) -> "GraphBuilder":
         """jax.checkpoint every hidden layer node during training (see
-        ListBuilder.gradient_checkpointing)."""
+        ListBuilder.gradient_checkpointing; ``policy`` names a save
+        policy — nn/_remat.py)."""
         self._remat = bool(enabled)
+        self._remat_policy = policy
         return self
 
     gradientCheckpointing = gradient_checkpointing
@@ -509,6 +512,7 @@ class GraphBuilder:
             updater=c._updater,
             dtype=c._dtype,
             remat=getattr(self, "_remat", False),
+            remat_policy=getattr(self, "_remat_policy", None),
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_bwd_length=self._tbptt_bwd,
@@ -532,6 +536,7 @@ class ComputationGraphConfiguration:
     updater: object = None
     dtype: str = "float32"
     remat: bool = False
+    remat_policy: Optional[str] = None
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     tbptt_bwd_length: int = 20
@@ -604,6 +609,7 @@ class ComputationGraphConfiguration:
             "updater": self.updater.to_dict() if self.updater is not None else None,
             "dtype": self.dtype,
             "remat": self.remat,
+            "remat_policy": self.remat_policy,
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_bwd_length": self.tbptt_bwd_length,
@@ -629,6 +635,7 @@ class ComputationGraphConfiguration:
             updater=_upd.Updater.from_dict(d["updater"]) if d.get("updater") else None,
             dtype=d.get("dtype", "float32"),
             remat=d.get("remat", False),
+            remat_policy=d.get("remat_policy"),
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_bwd_length=d.get("tbptt_bwd_length", 20),

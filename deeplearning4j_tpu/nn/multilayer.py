@@ -114,6 +114,10 @@ class MultiLayerNetwork:
         self.score_every: Optional[int] = None
         self._listeners = []
         self._rnn_state: Dict[str, Any] = {}   # streaming rnnTimeStep carries
+        #: error-feedback gradient-compression state (residual buckets +
+        #: thresholds) — owned by ShardedTrainer, homed here so the
+        #: checkpoint zip carries it (see utils/serialization)
+        self._grad_compression_state = None
         self._last_input = None                # StatsListener activation hist
         self._frozen: set = set()              # transfer-learning frozen layer idxs
         self._last_batch_size = 0
@@ -246,7 +250,9 @@ class MultiLayerNetwork:
                     # rematerialise: don't save this layer's activations
                     # for backward — recompute them (HBM ↔ FLOPs trade)
                     from deeplearning4j_tpu.nn._remat import remat_apply
-                    h, st = remat_apply(layer, lp, h, lst, lrng, kwargs)
+                    h, st = remat_apply(
+                        layer, lp, h, lst, lrng, kwargs,
+                        policy_name=getattr(self.conf, "remat_policy", None))
                 else:
                     h, st = layer.apply(lp, h, training=training, rng=lrng, state=lst, **kwargs)
                 if lst is not None and st is not None:

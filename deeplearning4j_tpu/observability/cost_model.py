@@ -195,6 +195,7 @@ class CostModel:
         self._lock = threading.Lock()
         self._entries: Dict[str, _Entry] = {}
         self._collectives: Dict[str, Dict[str, float]] = {}
+        self._compression: Dict[str, Dict[str, object]] = {}
         self._scales: Dict[str, int] = {}     # fn -> devices executing it
 
     # -------------------------------------------------------- accounting
@@ -280,6 +281,15 @@ class CostModel:
             self._collectives[fn] = {k: float(v)
                                      for k, v in bytes_by_op.items()}
 
+    def note_compression(self, fn: str, info: Dict[str, object]):
+        """Attach (merge) gradient-compression facts to an entry — the
+        ThresholdAlgorithm in force, the analytic wire payload vs dense
+        bytes, and the last synced encoded fraction — served as
+        ``grad_compression`` next to the collective bytes on /debug/perf
+        and in perf.json bundles."""
+        with self._lock:
+            self._compression.setdefault(fn, {}).update(info)
+
     def set_scale(self, fn: str, devices: int):
         """Sharded entries report GLOBAL program FLOPs — their roofline
         peak is ``devices`` chips, not one."""
@@ -350,6 +360,7 @@ class CostModel:
             items = [(fn, e, list(e.times))
                      for fn, e in self._entries.items()]
             collectives = {k: dict(v) for k, v in self._collectives.items()}
+            compression = {k: dict(v) for k, v in self._compression.items()}
             scales = dict(self._scales)
         for fn, e, times in items:
             mean_s = (sum(times) / len(times)) if times else None
@@ -378,6 +389,8 @@ class CostModel:
             }
             if fn in collectives:
                 rec["collective_bytes_per_step"] = collectives[fn]
+            if fn in compression:
+                rec["grad_compression"] = compression[fn]
             fns[fn] = rec
         return {
             "enabled": cost_model_enabled(),
@@ -392,6 +405,7 @@ class CostModel:
         with self._lock:
             self._entries.clear()
             self._collectives.clear()
+            self._compression.clear()
             self._scales.clear()
 
 

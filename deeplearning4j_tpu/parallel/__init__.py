@@ -16,3 +16,5 @@ from deeplearning4j_tpu.parallel.master import (  # noqa: F401
     DistributedConfig, ParameterAveragingTrainingMaster, SharedTrainingMaster,
     SparkComputationGraph, SparkDl4jMultiLayer, TrainingMaster)
 from deeplearning4j_tpu.parallel.ring import ring_attention  # noqa: F401
+from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
+    AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm, ThresholdAlgorithm)

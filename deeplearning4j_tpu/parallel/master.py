@@ -10,10 +10,13 @@ TPU-native redesign (SURVEY §5.8 north star): the TrainingMaster API shape
 survives as a thin facade that (a) builds the device mesh, (b) shards the
 input pipeline over the ``data`` axis, and (c) runs the whole step as one
 GSPMD program whose gradient allreduce rides ICI within a slice and DCN
-across slices. Spark, Aeron, the threshold codec, and the accumulator are
-deleted — there is no transport code to configure. Multi-host bootstrap is
-``jax.distributed.initialize`` (the ``VoidConfiguration`` analog is
-``DistributedConfig`` below).
+across slices. Spark, Aeron, and the UDP transport are deleted — there is
+no transport code to configure. The threshold codec + accumulator SURVIVE
+as the opt-in compressed gradient exchange (parallel/compression.py):
+``SharedTrainingMaster(threshold_algorithm=...)`` routes the trainer
+through error-feedback threshold collectives instead of the dense
+allreduce. Multi-host bootstrap is ``jax.distributed.initialize`` (the
+``VoidConfiguration`` analog is ``DistributedConfig`` below).
 
 Semantics divergence (documented, BASELINE.md): updates are synchronous and
 dense; ``ParameterAveragingTrainingMaster(averaging_frequency=N)`` degrades
@@ -75,16 +78,31 @@ class TrainingMaster:
 class SharedTrainingMaster(TrainingMaster):
     """ref: org.deeplearning4j.spark.parameterserver.training.SharedTrainingMaster.
 
-    Threshold/residual knobs are accepted for source-compat and ignored —
-    the codec exists only for the optional cross-DCN path (Pallas op
-    ``encode_threshold`` in ops/standard.py keeps behavioral parity where
-    a sparse path is explicitly wanted)."""
+    ``threshold_algorithm`` is HONORED: passing one (a
+    ``parallel.compression.ThresholdAlgorithm`` — Fixed/Adaptive, or a
+    spec string) routes the built trainer through the compressed
+    error-feedback gradient exchange (the EncodedGradientsAccumulator
+    analog; see parallel/compression.py). With no algorithm the exchange
+    stays the dense GSPMD allreduce, and the ``DL4J_TPU_GRAD_COMPRESS``
+    env knob still applies (``0`` = kill switch either way)."""
 
     def __init__(self, batch_size_per_worker: int = 32, workers: Optional[int] = None,
-                 threshold: float = 1e-3, threshold_algorithm=None,
+                 threshold: Optional[float] = None, threshold_algorithm=None,
                  workers_per_node: Optional[int] = None, **_ignored):
         super().__init__(batch_size_per_worker, workers or workers_per_node)
-        self.threshold = threshold
+        # an EXPLICIT threshold without an algorithm implies fixed:t (the
+        # reference's threshold always configured the codec) — both
+        # spellings, constructor and Builder, behave identically; leaving
+        # both unset keeps the dense exchange
+        if threshold is not None and threshold_algorithm is None:
+            threshold_algorithm = "fixed:%g" % float(threshold)
+        self.threshold = 1e-3 if threshold is None else threshold
+        self.threshold_algorithm = threshold_algorithm
+
+    def make_trainer(self, net) -> ShardedTrainer:
+        return ShardedTrainer(net, self.mesh_spec(),
+                              tensor_parallel=bool(self.tensor_parallel),
+                              grad_compression=self.threshold_algorithm)
 
     class Builder:
         def __init__(self, *args):
@@ -107,6 +125,13 @@ class SharedTrainingMaster(TrainingMaster):
             return self
 
         thresholdAlgorithm = threshold_algorithm
+
+        def threshold(self, t):
+            """ref: Builder#threshold — shorthand for a fixed algorithm
+            at ``t`` (the constructor derives ``fixed:t`` when no explicit
+            threshold_algorithm is set)."""
+            self._kw["threshold"] = t
+            return self
 
         def build(self):
             return SharedTrainingMaster(**self._kw)

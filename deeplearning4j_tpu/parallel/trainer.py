@@ -6,32 +6,49 @@ and the Aeron gradient-sharing stack (EncodedGradientsAccumulator +
 threshold codec + UDP mesh). TPU-native design: ONE jitted train step whose
 inputs carry shardings — batch sharded over ``data``, params sharded over
 ``model`` (TP) or replicated — and XLA GSPMD emits the gradient allreduce
-over ICI. There is no accumulator, residual, or transport; synchronous dense
-allreduce replaces async sparse updates (convergence-parity note in
-BASELINE.md).
+over ICI. Synchronous dense allreduce replaces async sparse updates by
+default (convergence-parity note in BASELINE.md); the reference's
+threshold-codec accumulator survives as the OPT-IN compressed exchange
+(``grad_compression`` / ``DL4J_TPU_GRAD_COMPRESS`` → error-feedback
+threshold collectives, parallel/compression.py).
 """
 from __future__ import annotations
 
+import functools
+import logging
 import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
+from deeplearning4j_tpu import async_runtime as _async
 from deeplearning4j_tpu.ndarray.ndarray import _unwrap
 from deeplearning4j_tpu.observability import compile_watch as _cw
 from deeplearning4j_tpu.observability import cost_model as _cost
 from deeplearning4j_tpu.observability import device_memory as _devmem
 from deeplearning4j_tpu.observability import global_registry
+from deeplearning4j_tpu.observability import numerics as _num
 from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.observability.flight_recorder import (
     global_flight_recorder as _flight)
+from deeplearning4j_tpu.parallel import compression as _comp
 from deeplearning4j_tpu.parallel import mesh as _mesh
 from deeplearning4j_tpu.parallel.mesh import MeshSpec, DATA_AXIS
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.parallel.sharding import replicate_tree, tp_shardings
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax spells it jax.experimental.shard_map
+    from jax.experimental.shard_map import shard_map
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class ShardedTrainer:
@@ -44,10 +61,24 @@ class ShardedTrainer:
     def __init__(self, net, mesh_spec: Optional[MeshSpec] = None, devices=None,
                  tensor_parallel: bool = False,
                  shard_optimizer_state: bool = False,
-                 preemption_handler=None, checkpoint_dir: Optional[str] = None):
+                 preemption_handler=None, checkpoint_dir: Optional[str] = None,
+                 grad_compression=None):
         self.net = net
         self.mesh = (mesh_spec or MeshSpec.data_parallel()).build(devices)
         self.tensor_parallel = tensor_parallel
+        # compressed gradient exchange (Strom 2015 error-feedback threshold
+        # collectives — the EncodedGradientsAccumulator analog): a
+        # ThresholdAlgorithm / spec string / True enables it; None defers
+        # to the DL4J_TPU_GRAD_COMPRESS env knob; the env knob "0" is the
+        # kill switch (dense path, byte-identical) either way. Resolved at
+        # placement time so the knob is read live.
+        self.grad_compression = grad_compression
+        self._compression = None      # resolved ThresholdAlgorithm
+        self._comp_layout = None      # bucketed-flattening plan
+        self._comp_step = None        # cached jitted compressed step
+        self._comp_obs = None         # (sparsity gauge, residual-norm hist)
+        self._pending_comp_stats = [] # device scalars awaiting a sync point
+        self._comp_fallback_warned = False
         # preemption safety (SURVEY §5.3): when a handler is given (or one is
         # installed process-wide), fit() checks the latch at every batch
         # boundary, writes a final checkpoint into ``checkpoint_dir`` and
@@ -97,14 +128,28 @@ class ShardedTrainer:
             for leaf in jax.tree.leaves(net._params)
             if hasattr(leaf, "size"))
         self._grad_bytes = param_bytes if n_data > 1 else 0
+        # compressed gradient exchange: resolve the knob/arg LIVE at every
+        # placement (the kill switch must also disarm an already-built
+        # trainer on re-place) and seed/restore the error-feedback state
+        self._resolve_compression(n_data)
         # per-collective traffic expectation (analytic): the plain
         # synchronous step allreduces the whole gradient tree once; under
         # ZeRO-style weight-update sharding XLA rewrites that into a
         # reduce-scatter + all-gather pair, each moving (n-1)/n of the
-        # param bytes over the wire (ring schedule). Counted per step
-        # into dl4j_collective_bytes_total{collective} and served next to
-        # the measured cost-model numbers on /debug/perf.
-        if n_data > 1 and self.shard_optimizer_state:
+        # param bytes over the wire (ring schedule); the compressed path
+        # moves the int8 sign payload + per-bucket scales instead. Counted
+        # per step into dl4j_collective_bytes_total{collective} and served
+        # next to the measured cost-model numbers on /debug/perf.
+        self._fallback_bytes = {}
+        if n_data > 1 and self._compression is not None:
+            self._collective_bytes = {
+                "compressed_allreduce":
+                    _comp.payload_bytes(self._comp_layout, n_data)}
+            # an indivisible batch falls back to the dense exchange for
+            # that batch — its traffic books as a plain allreduce, never
+            # as compressed wire bytes
+            self._fallback_bytes = {"allreduce": param_bytes}
+        elif n_data > 1 and self.shard_optimizer_state:
             wire = param_bytes * (n_data - 1) // n_data
             self._collective_bytes = {"reduce_scatter": wire,
                                       "all_gather": wire}
@@ -126,8 +171,9 @@ class ShardedTrainer:
             "bytes accessed on /debug/perf)",
             label_names=("collective",))
         self._collective_counters = {}
-        for op, nbytes in self._collective_bytes.items():
+        for op in {**self._fallback_bytes, **self._collective_bytes}:
             self._collective_counters[op] = bytes_c.labels(collective=op)
+        for op, nbytes in self._collective_bytes.items():
             expected_g.labels(collective=op).set(nbytes)
         self._obs = (
             reg.histogram("dl4j_collective_step_seconds",
@@ -149,6 +195,34 @@ class ShardedTrainer:
             "ShardedTrainer.step", self.mesh.size)
         _cost.global_cost_model().note_collectives(
             "ShardedTrainer.step", self._collective_bytes)
+        if self._compression is not None:
+            payload = _comp.payload_bytes(self._comp_layout, n_data)
+            dense = _comp.dense_bytes(self._comp_layout)
+            ratio = dense / max(1, payload)
+            reg.gauge(
+                "dl4j_grad_compression_ratio",
+                "dense gradient bytes / encoded wire payload bytes of the "
+                "compressed exchange (sign-mask int8 + per-bucket scale)"
+            ).set(ratio)
+            self._comp_obs = (
+                reg.gauge(
+                    "dl4j_grad_compression_sparsity_ratio",
+                    "fraction of gradient elements whose magnitude cleared "
+                    "the threshold in the last synced compressed step "
+                    "(the reference's 'sparsity ratio')"),
+                reg.histogram(
+                    "dl4j_grad_residual_norm",
+                    "global L2 norm of the error-feedback residual after "
+                    "each compressed step (mass deferred to later steps)"))
+            _cost.global_cost_model().note_compression(
+                "ShardedTrainer.step", {
+                    **self._compression.describe(),
+                    "buckets": list(zip(self._comp_layout.bucket_dtypes,
+                                        self._comp_layout.bucket_sizes)),
+                    "wire_payload_bytes": payload,
+                    "dense_bytes": dense,
+                    "compression_ratio": ratio,
+                })
         _cost.global_cost_model().invalidate("ShardedTrainer.step")
         # re-homing params onto the mesh changes the step's sharding
         # signature — the wrapped net's _train_step retraces once, and
@@ -182,6 +256,305 @@ class ShardedTrainer:
             return NamedSharding(self.mesh, P())
 
         return jax.tree.map(spec_for, opt_state)
+
+    # ------------------------------------------------- compressed exchange
+    def _resolve_compression(self, n_data: int):
+        """Resolve the builder arg + env knob into an active algorithm and
+        seed (or restore) the error-feedback state. Runs at every
+        placement so the kill switch works live."""
+        self._compression = None
+        self._comp_step = None
+        algo = _comp.resolve_compression(self.grad_compression)
+        reason = (None if algo is None
+                  else self._compression_unsupported_reason())
+        if algo is None or reason is not None:
+            if reason is not None:
+                log.warning("gradient compression requested but %s; using "
+                            "the dense exchange", reason)
+            # drop any carried error-feedback state: a dense run must not
+            # keep checkpointing (or pin in device memory) a residual that
+            # goes stale with every dense step — re-enabling compression
+            # later re-seeds at zero instead of resuming stale mass
+            if getattr(self.net, "_grad_compression_state", None) is not None:
+                log.warning("dropping carried gradient-compression state "
+                            "(dense exchange in force; re-enabling later "
+                            "re-seeds the residual at zero)")
+                self.net._grad_compression_state = None
+            return
+        self._compression = algo
+        self._comp_layout = _comp.build_layout(self.net._params)
+        self._init_comp_state(n_data)
+
+    def _compression_unsupported_reason(self) -> Optional[str]:
+        from deeplearning4j_tpu.nn.conf.configuration import BackpropType
+        if DATA_AXIS not in self.mesh.axis_names:
+            return "the mesh has no data axis to exchange over"
+        for axis in self.mesh.axis_names:
+            if axis != DATA_AXIS and _mesh.axis_size(self.mesh, axis) > 1:
+                return (f"the mesh shards over {axis!r} too (threshold "
+                        "collectives are data-parallel only)")
+        if jax.process_count() > 1:
+            return "multi-host meshes are not supported yet"
+        if getattr(self.net.conf, "backprop_type", None) == \
+                BackpropType.TruncatedBPTT:
+            return ("TBPTT carries cross jitted-step boundaries (the "
+                    "compressed step has no carry slot)")
+        return None
+
+    def _init_comp_state(self, n_data: int):
+        """Attach the residual/threshold state to the NET (the checkpoint
+        unit — ModelSerializer rides it as ``gradCompression.npz``, so
+        ResilientTrainer restore-resume replays byte-equal), placed on the
+        mesh: residual buckets shard over ``data`` (one residual per
+        replica), thresholds replicate."""
+        state = getattr(self.net, "_grad_compression_state", None)
+        if not _comp.state_matches(state, self._comp_layout, n_data):
+            if state is not None:
+                log.warning(
+                    "restored gradient-compression state does not match "
+                    "the current layout/mesh; re-seeding the residual at "
+                    "zero")
+            state = _comp.init_state(self._comp_layout, self._compression,
+                                     n_data)
+        rshard = NamedSharding(self.mesh, P(DATA_AXIS, None))
+        rep = NamedSharding(self.mesh, P())
+        self.net._grad_compression_state = {
+            "residual": [jax.device_put(jnp.asarray(r, jnp.float32), rshard)
+                         for r in state["residual"]],
+            "threshold": [jax.device_put(jnp.asarray(t, jnp.float32), rep)
+                          for t in state["threshold"]],
+        }
+
+    def _build_compressed_step(self):
+        """The compressed train step: per-replica local gradients under
+        shard_map, error-feedback threshold encode (dense int8 sign mask +
+        per-bucket scale — static shapes), ONE sign-sum exchange per
+        dtype-homogeneous bucket over the ``data`` axis, decode, then the
+        replicated optimizer update outside the shard_map (which composes
+        with ZeRO optimizer-state sharding: XLA re-shards the update onto
+        the data-sharded moments as reduce-scatter + sharded update)."""
+        net = self.net
+        mesh = self.mesh
+        layout = self._comp_layout
+        algo = self._compression
+        n = _mesh.axis_size(mesh, DATA_AXIS)
+        total = layout.total_elements()
+
+        def exchange(params, states, residual, thresholds, x, y, fmask,
+                     lmask, rng):
+            # per-replica half: runs on each replica's batch shard; params
+            # and thresholds arrive replicated, residual arrives as this
+            # replica's (1, size) block
+            if n > 1:
+                # distinct dropout streams per replica (the dense GSPMD
+                # path shards one global mask instead; documented
+                # divergence — same distribution, different draw)
+                rng2 = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+            else:
+                rng2 = rng
+            (loss, (new_states, _)), grads = jax.value_and_grad(
+                net._loss_fn, has_aux=True)(
+                params, states, x, y, fmask, lmask, rng2, None)
+            loss = lax.pmean(loss, DATA_AXIS)
+            # running stats (batchnorm etc.) average like the dense
+            # global-batch computation would
+            new_states = jax.tree.map(
+                lambda a: lax.pmean(a, DATA_AXIS)
+                if jnp.issubdtype(a.dtype, jnp.inexact) else a, new_states)
+            gb = _comp.flatten_buckets(grads, layout)
+            decoded, new_res, new_thr = [], [], []
+            frac_weighted = jnp.float32(0.0)
+            res_sq = jnp.float32(0.0)
+            for i, g in enumerate(gb):
+                acc = g + residual[i].reshape(-1)     # error feedback
+                t = thresholds[i]
+                # the shared encode/scale/psum/decode pipeline (one
+                # spelling — the allreduce A/B bench runs the same fn)
+                dec, sent, _, frac = _comp.exchange_bucket(
+                    acc, t, DATA_AXIS, n)
+                decoded.append(dec)
+                new_res.append((acc - sent)[None, :])
+                new_thr.append(algo.update(t, frac))
+                frac_weighted = frac_weighted + frac * (g.size / total)
+                res_sq = res_sq + lax.psum(jnp.sum(jnp.square(acc - sent)),
+                                           DATA_AXIS)
+            stats = {"encoded_fraction": frac_weighted,
+                     "residual_norm": jnp.sqrt(res_sq)}
+            return loss, new_states, decoded, new_res, new_thr, stats
+
+        @functools.partial(jax.jit, static_argnums=(10,),
+                           donate_argnums=(0, 1, 2, 3, 4))
+        def step(params, opt_state, states, residual, thresholds, x, y,
+                 fmask, lmask, rng, frozen):
+            # trace probe: counts exactly the (re)compiles of the
+            # compressed entry point (compile_watch)
+            _cw.note_trace("ShardedTrainer._compressed_step",
+                           (x, y, fmask, lmask))
+            sm = shard_map(
+                exchange, mesh=mesh,
+                in_specs=(P(), P(), P(DATA_AXIS, None), P(), P(DATA_AXIS),
+                          P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+                out_specs=(P(), P(), P(), P(DATA_AXIS, None), P(), P()),
+                check_rep=False)
+            loss, new_states, decoded, new_res, new_thr, stats = sm(
+                params, states, residual, thresholds, x, y, fmask, lmask,
+                rng)
+            grads = _comp.unflatten_buckets(decoded, layout)
+            if frozen:
+                grads = {k: (jax.tree.map(jnp.zeros_like, g)
+                             if k in frozen else g)
+                         for k, g in grads.items()}
+            updates, new_opt_state = net._opt.update(grads, opt_state,
+                                                     params)
+            if frozen:
+                updates = {k: (jax.tree.map(jnp.zeros_like, u)
+                               if k in frozen else u)
+                           for k, u in updates.items()}
+            new_params = optax.apply_updates(params, updates)
+            # in-graph numerics health, mirroring the dense train step; a
+            # skipped (non-finite) step must ALSO keep the old residual /
+            # threshold — the poison is inside the accumulator otherwise
+            health = None
+            if _num.numerics_enabled():
+                health = _num.health_terms(loss, grads, params, updates)
+                if _num.skip_on_nonfinite():
+                    ok = jnp.logical_and(health["loss_finite"],
+                                         health["grads_finite"])
+                    new_params = _num.select(ok, new_params, params)
+                    new_opt_state = _num.select(ok, new_opt_state,
+                                                opt_state)
+                    new_states = _num.select(ok, new_states, states)
+                    new_res = _num.select(ok, new_res, residual)
+                    new_thr = _num.select(ok, new_thr, thresholds)
+                    health["skipped"] = jnp.logical_not(ok)
+            return (new_params, new_opt_state, new_states, loss, new_res,
+                    new_thr, stats, health)
+
+        return step
+
+    def _compressible_batch(self, x) -> bool:
+        """The shard_map step needs the batch divisible over the data
+        axis; an indivisible (e.g. final partial) batch falls back to the
+        dense step for that batch — the residual simply carries over."""
+        first = x[0] if isinstance(x, (tuple, list)) else x
+        n_data = _mesh.axis_size(self.mesh, DATA_AXIS)
+        ok = first is not None and hasattr(first, "shape") and \
+            first.shape[0] % n_data == 0
+        if not ok and not self._comp_fallback_warned:
+            self._comp_fallback_warned = True
+            log.warning(
+                "batch of %s examples is not divisible by the %d-way data "
+                "axis; falling back to the dense exchange for such batches",
+                getattr(first, "shape", ("?",))[0], n_data)
+        return ok
+
+    def _fit_batch_compressed(self, x, y, fmask, lmask):
+        """Compressed-exchange twin of the net's ``_fit_batch`` tail:
+        same deferred-score cadence, listener/metrics/flight bookkeeping,
+        and cost-observatory feed — with the error-feedback state carried
+        through the step and re-attached to the net (so the NEXT
+        checkpoint write snapshots residuals consistent with the params)."""
+        net = self.net
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if self._comp_step is None:
+            self._comp_step = self._build_compressed_step()
+        if not isinstance(net, MultiLayerNetwork):
+            tup = lambda v: (() if v is None
+                             else tuple(v) if isinstance(v, (tuple, list))
+                             else (v,))
+            x, y, fmask, lmask = tup(x), tup(y), tup(fmask), tup(lmask)
+        if _faults.armed():
+            # same chaos point as the dense twin: fires BEFORE the jitted
+            # step touches its donated buffers (retry-in-place safe; a nan
+            # corruption composes with the numerics skip, which on this
+            # path also preserves the residual/threshold state)
+            _faults.check("train.step")
+            if isinstance(x, tuple):
+                x = tuple(jnp.asarray(v) for v in
+                          _faults.corrupt("train.step", x))
+            else:
+                x = jnp.asarray(_faults.corrupt("train.step", x))
+        batch_n = int((x[0] if isinstance(x, tuple) else x).shape[0])
+        net._last_batch_size = batch_n
+        # pinned only when a listener collects activation histograms (same
+        # contract as the dense _fit_batch — StatsListener reads it)
+        if any(getattr(l, "collect_activations", False)
+               for l in net._listeners):
+            net._last_input = x[0] if isinstance(x, tuple) else x
+        comp = net._grad_compression_state
+        defer_mode = _async.async_enabled() and not net._listeners
+        score_every = (net.score_every if net.score_every is not None
+                       else _async.score_sync_every())
+        sync_now = (not defer_mode
+                    or (net._iteration + 1) % max(1, score_every) == 0)
+        t0 = time.perf_counter()
+        with _span("train_step", model=type(net).__name__,
+                   iteration=net._iteration, batch=batch_n,
+                   compressed=True):
+            net._key, rng = jax.random.split(net._key)
+            (net._params, net._opt_state, net._states, loss, new_res,
+             new_thr, stats, health) = self._comp_step(
+                net._params, net._opt_state, net._states, comp["residual"],
+                comp["threshold"], x, y, fmask, lmask, rng,
+                frozenset(net._frozen))
+            net._grad_compression_state = {"residual": new_res,
+                                           "threshold": new_thr}
+            if health is not None:
+                net._pending_health.append(_num.stamp_step(health))
+            self._pending_comp_stats.append(stats)
+            if sync_now:
+                net._pending_score = None
+                net._score = float(loss)
+                net._drain_numerics()
+                self._publish_comp_stats()
+            else:
+                net._pending_score = loss
+                if len(net._pending_health) >= 64:
+                    old = net._pending_health[:32]
+                    net._pending_health = net._pending_health[32:]
+                    _num.publish(net, old)
+                if len(self._pending_comp_stats) >= 64:
+                    # same older-half drain as the numerics backlog: the
+                    # newest entries may still be in flight on device
+                    old, self._pending_comp_stats = (
+                        self._pending_comp_stats[:32],
+                        self._pending_comp_stats[32:])
+                    self._publish_comp_stats(old)
+        t1 = time.perf_counter()
+        _cost.on_step(
+            "ShardedTrainer._compressed_step", "ShardedTrainer.step",
+            t1 - t0,
+            lambda: self._comp_step.lower(
+                net._params, net._opt_state, net._states,
+                net._grad_compression_state["residual"],
+                net._grad_compression_state["threshold"],
+                x, y, fmask, lmask, rng, frozenset(net._frozen)))
+        net._iteration += 1
+        with _span("listeners", model=type(net).__name__):
+            for lst in net._listeners:
+                lst.iteration_done(net, net._iteration, net._epoch,
+                                   net._score)
+        _tm.for_model(net).record_step(
+            batch_n, net._score if sync_now else float("nan"),
+            t1 - t0, time.perf_counter() - t1, None, pipelined=defer_mode)
+        _flight().progress("train_step")
+
+    def _publish_comp_stats(self, pend=None):
+        """Materialize deferred compression scalars (sparsity fraction,
+        residual norm) — called only at the sync points the deferred-score
+        cadence already pays for."""
+        if pend is None:
+            pend, self._pending_comp_stats = self._pending_comp_stats, []
+        if not pend or self._comp_obs is None:
+            return
+        spars_g, res_h = self._comp_obs
+        last = None
+        for s in pend:
+            last = float(s["encoded_fraction"])
+            res_h.observe(float(s["residual_norm"]))
+        spars_g.set(last)
+        _cost.global_cost_model().note_compression(
+            "ShardedTrainer.step", {"encoded_fraction_last": last})
 
     def _shard_batch(self, x):
         if x is None:
@@ -301,8 +674,10 @@ class ShardedTrainer:
                                     self._ds_mask(ds, "features"),
                                     self._ds_mask(ds, "labels"))
                     self._check_preemption()
-                # epoch boundary is a mandatory sync point (deferred loss)
+                # epoch boundary is a mandatory sync point (deferred loss
+                # + the compression sparsity/residual scalars)
                 net._sync_score()
+                self._publish_comp_stats()
                 for lst in net._listeners:
                     lst.on_epoch_end(net, net._epoch)
                 net._epoch += 1
@@ -334,6 +709,17 @@ class ShardedTrainer:
         y = self._shard_batch(y)
         fmask = self._shard_batch(fmask)
         lmask = self._shard_batch(lmask)
+        if self._compression is not None and self._compressible_batch(x):
+            t0 = time.perf_counter()
+            with _span("sharded_step",
+                       grad_bytes=self._collective_bytes.get(
+                           "compressed_allreduce", 0)):
+                self._fit_batch_compressed(x, y, fmask, lmask)
+            if self._obs is not None:
+                for op, nbytes in self._collective_bytes.items():
+                    self._collective_counters[op].inc(nbytes)
+                self._obs[0].observe(time.perf_counter() - t0)
+            return
         t0 = time.perf_counter()
         # only steps driven THROUGH the trainer book under the sharded
         # entry (mesh-scaled peak); cleared so a later direct net.fit()
@@ -353,8 +739,12 @@ class ShardedTrainer:
         finally:
             self.net._cost_fn_name = None
         if self._obs is not None:
-            for op, counter in self._collective_counters.items():
-                counter.inc(self._collective_bytes[op])
+            # under active compression this tail only runs for the
+            # indivisible-batch fallback, whose exchange was DENSE
+            books = (self._fallback_bytes if self._compression is not None
+                     else self._collective_bytes)
+            for op, nbytes in books.items():
+                self._collective_counters[op].inc(nbytes)
             self._obs[0].observe(time.perf_counter() - t0)
 
     # --------------------------------------------------------------- inference
@@ -365,7 +755,9 @@ class ShardedTrainer:
         return self.net.output(x)
 
     def score(self):
-        return self.net._sync_score()
+        score = self.net._sync_score()
+        self._publish_comp_stats()
+        return score
 
 
 class ParallelWrapper:

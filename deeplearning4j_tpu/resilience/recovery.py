@@ -414,6 +414,11 @@ class ResilientTrainer:
         net.set_param_tree(restored._params)
         net._states = strengthen_dtypes(restored._states)
         net._opt_state = restored._opt_state
+        # compressed-exchange error-feedback state rides the checkpoint:
+        # without it a restore-resume run replays with a zero residual and
+        # diverges from the uninterrupted one
+        net._grad_compression_state = getattr(
+            restored, "_grad_compression_state", None)
         net._iteration = restored._iteration
         # epoch bookkeeping stays ours (the checkpoint's epoch counter may
         # lag the restart loop); pending device-side fetches are stale

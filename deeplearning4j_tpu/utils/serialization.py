@@ -118,6 +118,16 @@ class ModelSerializer:
                 upd = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)
                        if hasattr(l, "shape")}
                 zf.writestr("updaterState.npz", _save_npz_bytes(**upd))
+            comp_state = getattr(net, "_grad_compression_state", None)
+            if comp_state is not None:
+                # error-feedback compression state (ShardedTrainer
+                # threshold collectives): the per-replica residual buckets
+                # + per-bucket thresholds must ride the checkpoint or a
+                # restore-resume run diverges from the uninterrupted one
+                from deeplearning4j_tpu.parallel.compression import (
+                    state_to_arrays)
+                zf.writestr("gradCompression.npz",
+                            _save_npz_bytes(**state_to_arrays(comp_state)))
             if normalizer is not None:
                 state = normalizer.state_dict()
                 meta = {k: v for k, v in state.items() if not isinstance(v, np.ndarray)}
@@ -210,6 +220,12 @@ class ModelSerializer:
                     net._opt_state = jax.tree.unflatten(treedef, leaves)
             except Exception:  # updater config changed; keep fresh state
                 pass
+        if "gradCompression.npz" in zf.namelist():
+            from deeplearning4j_tpu.parallel.compression import (
+                state_from_arrays)
+            with np.load(io.BytesIO(zf.read("gradCompression.npz"))) as z:
+                net._grad_compression_state = state_from_arrays(
+                    {k: z[k] for k in z.files})
         if "meta.json" in zf.namelist():
             meta = json.loads(zf.read("meta.json"))
             net._iteration = meta.get("iteration", 0)

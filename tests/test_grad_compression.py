@@ -1,0 +1,595 @@
+"""Compressed gradient exchange (error-feedback threshold collectives) —
+parallel/compression.py + the ShardedTrainer compressed step, on the
+8-device virtual CPU mesh.
+
+Contracts under test (ISSUE 7 acceptance):
+- ``DL4J_TPU_GRAD_COMPRESS=0`` (and no builder arg) = byte-identical
+  dense path;
+- compressed + error-feedback training converges to within tolerance of
+  the dense run on a fixed seed (exact-family updater: plain SGD);
+- the residual/threshold state is first-class training state: checkpoint
+  round-trips byte-exact and ResilientTrainer restore-resume converges
+  byte-equal to a fault-free compressed run;
+- the analytic wire accounting (``dl4j_collective_expected_bytes``) drops
+  below dense param bytes and ``dl4j_grad_compression_ratio`` is
+  published + visible on /debug/perf (cost-model snapshot).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import (AdaptiveThresholdAlgorithm,
+                                         FixedThresholdAlgorithm, MeshSpec,
+                                         SharedTrainingMaster, ShardedTrainer)
+from deeplearning4j_tpu.parallel import compression as comp
+
+
+def _conf(seed=1, updater=None):
+    return (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss_function="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, 8), dtype=np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+def _params_bytes(net):
+    return {k: np.asarray(v.buf()).tobytes()
+            for k, v in net.paramTable().items()}
+
+
+@pytest.fixture(autouse=True)
+def _no_env_knob(monkeypatch):
+    monkeypatch.delenv(comp.ENV_KNOB, raising=False)
+
+
+# --------------------------------------------------------------- algorithms
+class TestThresholdAlgorithms:
+    def test_spec_parsing(self):
+        assert comp.algorithm_from_spec(None) is None
+        assert comp.algorithm_from_spec("0") is None
+        assert comp.algorithm_from_spec("") is None
+        assert isinstance(comp.algorithm_from_spec("1"),
+                          AdaptiveThresholdAlgorithm)
+        a = comp.algorithm_from_spec("fixed:0.05")
+        assert isinstance(a, FixedThresholdAlgorithm)
+        assert a.initial_threshold == pytest.approx(0.05)
+        a = comp.algorithm_from_spec("adaptive:1e-2:1e-3:0.5")
+        assert a.initial_threshold == pytest.approx(1e-2)
+        assert a.min_target_fraction == pytest.approx(1e-3)
+        assert a.max_target_fraction == pytest.approx(0.5)
+        passthrough = FixedThresholdAlgorithm(2.0)
+        assert comp.algorithm_from_spec(passthrough) is passthrough
+        with pytest.raises(ValueError):
+            comp.algorithm_from_spec("bogus")
+        # wrong arity is a mis-config that RAISES — never a silent
+        # fall-back to default target bands
+        with pytest.raises(ValueError, match="adaptive takes"):
+            comp.algorithm_from_spec("adaptive:1e-3:1e-3")
+        with pytest.raises(ValueError, match="fixed takes"):
+            comp.algorithm_from_spec("fixed:1e-3:7")
+
+    def test_kill_switch_beats_builder_arg(self, monkeypatch):
+        monkeypatch.setenv(comp.ENV_KNOB, "0")
+        assert comp.resolve_compression(FixedThresholdAlgorithm()) is None
+        monkeypatch.setenv(comp.ENV_KNOB, "adaptive")
+        assert isinstance(comp.resolve_compression(None),
+                          AdaptiveThresholdAlgorithm)
+        # explicit arg wins over a non-zero env spec
+        assert isinstance(comp.resolve_compression(FixedThresholdAlgorithm()),
+                          FixedThresholdAlgorithm)
+
+    def test_adaptive_update_moves_toward_target(self):
+        a = AdaptiveThresholdAlgorithm(initial_threshold=1e-3,
+                                       min_target_fraction=1e-4,
+                                       max_target_fraction=1e-2)
+        t = jnp.float32(1e-3)
+        # too many encoded -> threshold grows
+        t_up = a.update(t, jnp.float32(0.5))
+        assert float(t_up) > float(t)
+        # too few encoded -> threshold decays
+        t_down = a.update(t, jnp.float32(0.0))
+        assert float(t_down) < float(t)
+        # in-band -> unchanged
+        t_same = a.update(t, jnp.float32(5e-3))
+        assert float(t_same) == pytest.approx(float(t))
+        # fixed never moves
+        f = FixedThresholdAlgorithm(1e-3)
+        assert float(f.update(t, jnp.float32(0.9))) == pytest.approx(1e-3)
+
+
+# ------------------------------------------------------------------ buckets
+class TestBucketedFlattening:
+    def test_roundtrip_mixed_dtypes(self):
+        tree = {"a": {"W": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                      "b": jnp.ones((3,), jnp.bfloat16)},
+                "c": {"W": jnp.full((4,), 2.0, jnp.float32)}}
+        layout = comp.build_layout(tree)
+        assert layout.n_buckets == 2          # one per dtype, not per leaf
+        assert sorted(layout.bucket_dtypes) == ["bfloat16", "float32"]
+        buckets = comp.flatten_buckets(tree, layout)
+        assert all(b.ndim == 1 and b.dtype == jnp.float32 for b in buckets)
+        back = comp.unflatten_buckets(buckets, layout)
+        assert jax.tree.structure(back) == jax.tree.structure(tree)
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+            assert a.dtype == b.dtype
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32))
+
+    def test_non_float_leaf_rejected(self):
+        with pytest.raises(ValueError, match="non-float"):
+            comp.build_layout({"i": jnp.arange(3)})
+
+    def test_payload_below_dense(self):
+        tree = {"W": jnp.zeros((100, 10), jnp.float32)}
+        layout = comp.build_layout(tree)
+        assert comp.payload_bytes(layout, 8) < comp.dense_bytes(layout)
+        # int8 wire: ~4x below dense f32
+        assert comp.dense_bytes(layout) / comp.payload_bytes(layout, 8) \
+            > 3.5
+        # wide meshes fall back to an int16 wire (sign-sum range)
+        assert comp.wire_dtype(8) == jnp.int8
+        assert comp.wire_dtype(200) == jnp.int16
+
+
+# ------------------------------------------------------------ trainer paths
+class TestCompressedTrainer:
+    def test_kill_switch_dense_path_byte_identical(self, monkeypatch):
+        x, y = _data(16)
+        runs = {}
+        for tag, env, arg in (("dense", None, None),
+                              ("killed", "0", FixedThresholdAlgorithm(1e-4))):
+            if env is None:
+                monkeypatch.delenv(comp.ENV_KNOB, raising=False)
+            else:
+                monkeypatch.setenv(comp.ENV_KNOB, env)
+            net = MultiLayerNetwork(_conf(seed=7))
+            tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                                grad_compression=arg)
+            for _ in range(3):
+                tr.fit(x, y)
+            assert tr._compression is None
+            assert net._grad_compression_state is None
+            runs[tag] = _params_bytes(net)
+        assert runs["dense"] == runs["killed"]
+
+    def test_compressed_sgd_matches_dense_within_tolerance(self):
+        """EF threshold compression with a plain-SGD updater is the
+        theoretically exact-family combo (Karimireddy et al. EF-signSGD):
+        the compressed run must land within a tight tolerance of dense."""
+        x, y = _data()
+        scores = {}
+        for tag, algo in (("dense", None),
+                          ("compressed", FixedThresholdAlgorithm(1e-4))):
+            net = MultiLayerNetwork(_conf(seed=3, updater=Sgd(0.1)))
+            tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                                grad_compression=algo)
+            for _ in range(100):
+                tr.fit(x, y)
+            scores[tag] = tr.score()
+        s0 = MultiLayerNetwork(_conf(seed=3, updater=Sgd(0.1))).init()
+        from deeplearning4j_tpu.data.dataset import DataSet
+        start = s0.score(DataSet(x, y))
+        assert scores["compressed"] < start * 0.95   # actually trained
+        assert scores["compressed"] == pytest.approx(scores["dense"],
+                                                     rel=0.05)
+
+    def test_compressed_adaptive_adam_converges(self):
+        x, y = _data()
+        net = MultiLayerNetwork(_conf(seed=5))
+        tr = ShardedTrainer(
+            net, MeshSpec.data_parallel(8),
+            grad_compression=AdaptiveThresholdAlgorithm(
+                max_target_fraction=0.2))
+        tr.fit(x, y)
+        s0 = tr.score()
+        for _ in range(60):
+            tr.fit(x, y)
+        assert tr.score() < s0 * 0.9
+        st = net._grad_compression_state
+        assert [tuple(r.shape) for r in st["residual"]] == [(8, 212)]
+        # residual really carries deferred mass
+        assert float(jnp.sum(jnp.abs(st["residual"][0]))) > 0.0
+
+    def test_kill_switch_replace_drops_stale_state(self, monkeypatch):
+        """Disabling compression on a re-place drops the carried residual:
+        a dense run must not keep checkpointing (or later resume from)
+        error-feedback mass that every dense step makes staler."""
+        x, y = _data(16)
+        net = MultiLayerNetwork(_conf(seed=17))
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        for _ in range(2):
+            tr.fit(x, y)
+        assert net._grad_compression_state is not None
+        monkeypatch.setenv(comp.ENV_KNOB, "0")
+        tr._place()                        # kill switch read live
+        assert tr._compression is None
+        assert net._grad_compression_state is None
+        tr.fit(x, y)                       # dense, and saves carry no state
+
+    def test_env_knob_enables_compression(self, monkeypatch):
+        monkeypatch.setenv(comp.ENV_KNOB, "fixed:1e-4")
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8))
+        x, y = _data(16)
+        tr.fit(x, y)
+        assert isinstance(tr._compression, FixedThresholdAlgorithm)
+        assert net._grad_compression_state is not None
+
+    def test_residual_error_feedback_bookkeeping(self):
+        """decoded + mean-residual-delta must reconstruct the mean
+        accumulator: sum over replicas of (sent_r)/n == decoded, i.e. the
+        exchange loses exactly what the residual keeps."""
+        x, y = _data(16)
+        net = MultiLayerNetwork(_conf(seed=11, updater=Sgd(0.05)))
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-3))
+        tr.fit(x, y)                       # step 1: residual_0 = 0
+        st = net._grad_compression_state
+        res = np.asarray(st["residual"][0])          # (8, size)
+        assert res.shape[0] == 8
+        # replicas saw different shards -> different residuals
+        assert not np.allclose(res[0], res[1])
+
+    def test_indivisible_batch_falls_back_dense(self):
+        from deeplearning4j_tpu.observability import (global_registry,
+                                                      reset_global_registry)
+        # fresh registry: earlier tests' compressed steps already pushed
+        # the shared dl4j_collective_bytes_total counter
+        reset_global_registry()
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        x, y = _data(12)                   # 12 % 8 != 0
+        tr.fit(x, y)                       # must not raise
+        assert np.isfinite(tr.score())
+        # residual untouched by the dense fallback
+        assert float(jnp.sum(jnp.abs(
+            net._grad_compression_state["residual"][0]))) == 0.0
+        # the fallback's traffic books as a DENSE allreduce — never as
+        # compressed wire bytes the step didn't move
+        text = global_registry().render_prometheus()
+        for line in text.splitlines():
+            if line.startswith("dl4j_collective_bytes_total"):
+                if 'collective="compressed_allreduce"' in line:
+                    assert float(line.rsplit(" ", 1)[1]) == 0.0
+                if 'collective="allreduce"' in line:
+                    assert float(line.rsplit(" ", 1)[1]) > 0.0
+
+    def test_train_step_fault_fires_under_compression(self):
+        """The compressed twin keeps the dense step's 'train.step' chaos
+        point: an injected crash fires (and counts) instead of silently
+        no-opping a chaos campaign."""
+        from deeplearning4j_tpu.resilience import FaultPlan, faults
+        from deeplearning4j_tpu.resilience.faults import InjectedFault
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        x, y = _data(16)
+        tr.fit(x, y)                       # place + one clean step
+        try:
+            faults.install(FaultPlan.parse("train.step:crash:1.0:1",
+                                           seed=7))
+            with pytest.raises(InjectedFault):
+                tr.fit(x, y)
+        finally:
+            faults.reset()
+
+    def test_tensor_parallel_mesh_refuses_compression(self):
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedTrainer(net, MeshSpec.dp_tp(4, 2), tensor_parallel=True,
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        x, y = _data(16)
+        tr.fit(x, y)                       # warns + dense, never crashes
+        assert tr._compression is None
+
+    def test_zero_sharded_optimizer_composes(self):
+        """Compression + ZeRO optimizer-state sharding: same math as
+        compressed-unsharded (the decoded gradient is replicated; XLA
+        re-shards the update onto the data-sharded moments)."""
+        x, y = _data()
+        nets = {}
+        for tag, zero in (("plain", False), ("zero", True)):
+            net = MultiLayerNetwork(_conf(seed=13, updater=Sgd(0.1)))
+            tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                                shard_optimizer_state=zero,
+                                grad_compression=FixedThresholdAlgorithm(
+                                    1e-4))
+            for _ in range(5):
+                tr.fit(x, y)
+            nets[tag] = net
+        np.testing.assert_allclose(
+            np.asarray(nets["plain"].params().buf()),
+            np.asarray(nets["zero"].params().buf()), rtol=2e-5, atol=1e-6)
+
+    def test_computation_graph_compressed_trains(self):
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        gb = (NeuralNetConfiguration.builder().seed(2).updater(Sgd(0.1))
+              .graph_builder().add_inputs("in")
+              .set_input_types(InputType.feed_forward(6)))
+        gb.add_layer("d", L.DenseLayer(n_out=12, activation="relu"), "in")
+        gb.add_layer("out", L.OutputLayer(
+            n_out=3, activation="softmax",
+            loss_function="negativeloglikelihood"), "d")
+        gb.set_outputs("out")
+        net = ComputationGraph(gb.build())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        rng = np.random.RandomState(1)
+        x = rng.rand(16, 6).astype("float32")
+        y = np.eye(3, dtype="float32")[rng.randint(0, 3, 16)]
+        tr.fit(x, y)
+        s0 = tr.score()
+        for _ in range(20):
+            tr.fit(x, y)
+        assert tr.score() < s0
+        assert net._grad_compression_state is not None
+
+    def test_shared_training_master_threshold_algorithm_honored(self):
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        x, y = _data(64)
+        tm = (SharedTrainingMaster.Builder()
+              .batch_size_per_worker(4).workers_per_node(8)
+              .threshold_algorithm(AdaptiveThresholdAlgorithm())
+              .build())
+        assert isinstance(tm.threshold_algorithm, AdaptiveThresholdAlgorithm)
+        # both threshold spellings imply fixed:t identically; neither set
+        # = dense
+        for tm2 in (SharedTrainingMaster(threshold=1e-4),
+                    SharedTrainingMaster.Builder().threshold(1e-4).build()):
+            assert tm2.threshold_algorithm == "fixed:0.0001"
+        assert SharedTrainingMaster().threshold_algorithm is None
+        from deeplearning4j_tpu.parallel import SparkDl4jMultiLayer
+        spark_net = SparkDl4jMultiLayer(None, _conf(), tm)
+        assert spark_net._trainer.grad_compression is tm.threshold_algorithm
+        it = ArrayDataSetIterator(x, y, batch_size=32)
+        out = spark_net.fit(it, epochs=1)
+        assert np.isfinite(out.score())
+        assert spark_net._trainer._compression is not None
+
+    def test_listeners_see_synced_score(self):
+        seen = []
+
+        class Listener:
+            def iteration_done(self, net, it, ep, score):
+                seen.append(score)
+
+            def on_epoch_start(self, net, ep):
+                pass
+
+            def on_epoch_end(self, net, ep):
+                pass
+
+        net = MultiLayerNetwork(_conf())
+        net.setListeners(Listener())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        x, y = _data(16)
+        for _ in range(3):
+            tr.fit(x, y)
+        assert len(seen) == 3 and all(np.isfinite(s) for s in seen)
+
+
+# -------------------------------------------------- observability surfaces
+class TestCompressionObservability:
+    def test_expected_bytes_below_dense_and_ratio_published(self):
+        from deeplearning4j_tpu.observability import global_registry
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        x, y = _data(16)
+        tr.fit(x, y)
+        dense_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(net._params))
+        assert tr._collective_bytes == {
+            "compressed_allreduce":
+                comp.payload_bytes(tr._comp_layout, 8)}
+        assert tr._collective_bytes["compressed_allreduce"] < dense_bytes
+        text = global_registry().render_prometheus()
+        assert "dl4j_grad_compression_ratio" in text
+        assert 'dl4j_collective_expected_bytes{collective=' \
+               '"compressed_allreduce"}' in text
+        tr.score()                      # sync point publishes the scalars
+        text = global_registry().render_prometheus()
+        assert "dl4j_grad_compression_sparsity_ratio" in text
+        assert "dl4j_grad_residual_norm" in text
+
+    def test_debug_perf_carries_compression_record(self):
+        from deeplearning4j_tpu.observability.cost_model import (
+            global_cost_model)
+        net = MultiLayerNetwork(_conf())
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=AdaptiveThresholdAlgorithm())
+        x, y = _data(16)
+        tr.fit(x, y)
+        tr.score()
+        rec = global_cost_model().snapshot()["fns"].get(
+            "ShardedTrainer.step", {})
+        gc = rec.get("grad_compression")
+        assert gc is not None
+        assert gc["algorithm"] == "AdaptiveThresholdAlgorithm"
+        assert gc["wire_payload_bytes"] < gc["dense_bytes"]
+        assert gc["compression_ratio"] > 3.0
+        assert "encoded_fraction_last" in gc
+        assert rec.get("collective_bytes_per_step", {}).get(
+            "compressed_allreduce") == gc["wire_payload_bytes"]
+
+
+# ------------------------------------------------------------ codec parity
+class TestCodecParity:
+    """The three codec forms (ISSUE 7 satellite): kernels/threshold.py's
+    jitted sparse ±(idx+1) wire format ↔ ops/standard.py's dense sign-mask
+    device form ↔ the native/ host op — all encode the SAME set of
+    entries, convert losslessly, and keep identical residual books."""
+
+    def _grad(self, n=96, seed=4):
+        return np.random.RandomState(seed).randn(n).astype("f4")
+
+    def test_dense_mask_to_wire_matches_jitted_encoder(self):
+        from deeplearning4j_tpu.kernels.threshold import (
+            sparse_from_dense, threshold_encode)
+        from deeplearning4j_tpu.ops.standard import encode_threshold
+        g = self._grad()
+        thr = 1.0
+        signs, _ = encode_threshold(jnp.asarray(g), thr)
+        wire_a = np.asarray(sparse_from_dense(signs, capacity=96))
+        wire_b, _ = threshold_encode(jnp.asarray(g), thr, capacity=96)
+        wire_b = np.asarray(wire_b)
+        assert wire_a[0] == wire_b[0]
+        n = int(wire_a[0])
+        # same entries in the same (flat-index) order
+        np.testing.assert_array_equal(wire_a[1:1 + n], wire_b[1:1 + n])
+
+    def test_wire_to_dense_roundtrip(self):
+        from deeplearning4j_tpu.kernels.threshold import (
+            dense_from_sparse, sparse_from_dense)
+        from deeplearning4j_tpu.ops.standard import encode_threshold
+        g = self._grad()
+        signs, _ = encode_threshold(jnp.asarray(g), 0.8)
+        back = dense_from_sparse(sparse_from_dense(signs, capacity=96), 96)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(signs))
+        # jit-compatible: both conversions trace with static shapes
+        f = jax.jit(lambda s: dense_from_sparse(
+            sparse_from_dense(s, 96), 96))
+        np.testing.assert_array_equal(np.asarray(f(signs)),
+                                      np.asarray(signs))
+
+    def test_native_host_op_parity(self):
+        from deeplearning4j_tpu import native
+        from deeplearning4j_tpu.kernels.threshold import (
+            dense_from_sparse, threshold_decode)
+        from deeplearning4j_tpu.ops.standard import encode_threshold
+        g = self._grad(seed=7)
+        thr = 1.0
+        enc_h, res_h = native.threshold_encode_host(g, thr, 96)
+        # host wire → dense sign mask == the in-graph dense form
+        signs, res_d = encode_threshold(jnp.asarray(g), thr)
+        np.testing.assert_array_equal(
+            np.asarray(dense_from_sparse(jnp.asarray(enc_h), 96)),
+            np.asarray(signs))
+        # residual books agree across host and device forms
+        np.testing.assert_allclose(res_h, np.asarray(res_d), atol=1e-6)
+        # host decode == jitted decode of the same buffer
+        dec_h = native.threshold_decode_host(enc_h, thr,
+                                             np.zeros(96, "f4"))
+        dec_j = threshold_decode(jnp.asarray(enc_h), thr, (96,))
+        np.testing.assert_allclose(dec_h, np.asarray(dec_j), atol=1e-6)
+
+    def test_capacity_overflow_ordering(self):
+        """All three encoders cap at ``capacity`` entries taken FIRST BY
+        FLAT INDEX (the reference's capped buffer), and the overflow mass
+        stays whole in the residual."""
+        from deeplearning4j_tpu import native
+        from deeplearning4j_tpu.kernels.threshold import (
+            sparse_from_dense, threshold_encode)
+        g = np.full(20, 3.0, dtype="f4")
+        g[::2] *= -1.0                      # alternating signs, all firing
+        cap = 8
+        enc_j, res_j = threshold_encode(jnp.asarray(g), 1.0, cap)
+        enc_h, res_h = native.threshold_encode_host(g, 1.0, cap)
+        enc_j, res_j = np.asarray(enc_j), np.asarray(res_j)
+        assert enc_j[0] == enc_h[0] == cap
+        np.testing.assert_array_equal(enc_j[1:1 + cap], enc_h[1:1 + cap])
+        # first-by-index: exactly flat indices 0..cap-1 were taken
+        np.testing.assert_array_equal(np.abs(enc_j[1:1 + cap]),
+                                      np.arange(1, cap + 1))
+        # residual bookkeeping: encoded entries gave up ±threshold, the
+        # overflow tail kept its full mass
+        np.testing.assert_allclose(np.abs(res_j[:cap]), 2.0, atol=1e-6)
+        np.testing.assert_allclose(np.abs(res_j[cap:]), 3.0, atol=1e-6)
+        np.testing.assert_allclose(res_h, res_j, atol=1e-6)
+        # dense→wire conversion under the same cap picks the same prefix
+        signs = jnp.asarray(np.sign(g), jnp.int8)
+        wire = np.asarray(sparse_from_dense(signs, cap))
+        np.testing.assert_array_equal(wire[1:1 + cap], enc_j[1:1 + cap])
+
+
+# ------------------------------------------------------ state + checkpoint
+class TestCompressionCheckpointing:
+    def test_state_npz_roundtrip(self):
+        layout = comp.build_layout({"W": jnp.zeros((5, 3), jnp.float32)})
+        st = comp.init_state(layout, FixedThresholdAlgorithm(0.25), 4)
+        st["residual"][0] = st["residual"][0] + 0.5
+        arrays = comp.state_to_arrays(st)
+        back = comp.state_from_arrays(
+            {k: np.asarray(v) for k, v in arrays.items()})
+        assert comp.state_matches(back, layout, 4)
+        np.testing.assert_array_equal(np.asarray(back["residual"][0]),
+                                      np.asarray(st["residual"][0]))
+        assert float(back["threshold"][0]) == pytest.approx(0.25)
+        # mismatched mesh width re-seeds instead of crashing
+        assert not comp.state_matches(back, layout, 8)
+
+    def test_checkpoint_roundtrip_preserves_residual_bytes(self, tmp_path):
+        x, y = _data(16)
+        net = MultiLayerNetwork(_conf(seed=21))
+        tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                            grad_compression=FixedThresholdAlgorithm(1e-4))
+        for _ in range(3):
+            tr.fit(x, y)
+        path = str(tmp_path / "comp.zip")
+        net.save(path)
+        restored = MultiLayerNetwork.load(path)
+        st0, st1 = net._grad_compression_state, \
+            restored._grad_compression_state
+        assert st1 is not None
+        for a, b in zip(st0["residual"], st1["residual"]):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        for a, b in zip(st0["threshold"], st1["threshold"]):
+            assert float(a) == float(b)
+
+    def test_resilient_restore_resumes_byte_equal(self, tmp_path):
+        """The headline first-class-state contract: a compressed training
+        run that crashes and restore-resumes through ResilientTrainer
+        converges byte-equal to the fault-free compressed run — only true
+        if the residual/threshold state rides the checkpoint."""
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.resilience import FaultPlan, faults
+        from deeplearning4j_tpu.resilience.recovery import ResilientTrainer
+
+        x, y = _data(32, seed=9)
+
+        def run(ckpt_dir, plan):
+            net = MultiLayerNetwork(_conf(seed=31, updater=Sgd(0.1)))
+            tr = ShardedTrainer(net, MeshSpec.data_parallel(8),
+                                grad_compression=FixedThresholdAlgorithm(
+                                    1e-4))
+            rt = ResilientTrainer(tr, checkpoint_dir=str(ckpt_dir),
+                                  max_restarts=3)
+            try:
+                if plan is not None:
+                    faults.install(plan)
+                rt.fit(ArrayDataSetIterator(x, y, batch_size=8), epochs=2)
+            finally:
+                faults.reset()
+            return net
+
+        clean = run(tmp_path / "clean", None)
+        faulted = run(
+            tmp_path / "faulted",
+            FaultPlan.parse("allreduce:crash:1.0:1", seed=123))
+        assert _params_bytes(clean) == _params_bytes(faulted)
+        a = clean._grad_compression_state
+        b = faulted._grad_compression_state
+        for ra, rb in zip(a["residual"], b["residual"]):
+            assert np.asarray(ra).tobytes() == np.asarray(rb).tobytes()

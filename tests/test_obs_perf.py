@@ -470,6 +470,48 @@ def test_bench_diff_grades_device_mfu_series():
     assert len(regs) == 1 and regs[0].series == "device_mfu"
 
 
+def test_bench_diff_empty_or_missing_trajectory_is_clean(tmp_path):
+    """A fresh checkout (no BENCH_r*/MULTICHIP_r* archives) or a bogus
+    root grades clean — exit 0, no crash, an explicit message."""
+    mod = _load_tool("bench_diff")
+    assert mod.main([str(tmp_path)]) == 0
+    assert mod.main([str(tmp_path / "never_created")]) == 0
+    assert mod.check_trajectory([]) == []
+    assert mod.check_multichip([]) == []
+
+
+def test_bench_diff_learns_multichip_dryruns(tmp_path):
+    """MULTICHIP_r*.json driver dryruns ({n_devices, rc, ok, skipped,
+    tail} — no 'metric' key) load as a boolean trajectory: newest
+    non-skipped round failing = a break; an OLD failure healed by a
+    newer pass, and skipped rounds, stay green. Unreadable/alien JSON is
+    ignored, never fatal."""
+    import json as _json
+    mod = _load_tool("bench_diff")
+
+    def write(rnd, **doc):
+        p = tmp_path / f"MULTICHIP_r{rnd:02d}.json"
+        p.write_text(_json.dumps(doc))
+        return p
+
+    write(1, n_devices=8, rc=1, ok=False, skipped=False, tail="boom")
+    write(2, n_devices=8, rc=0, ok=True, skipped=False, tail="OK")
+    write(3, skipped=True)
+    (tmp_path / "MULTICHIP_r04.json").write_text("not json {")
+    samples = mod.load_multichip(str(tmp_path))
+    assert [(s.round, s.ok, s.skipped) for s in samples] == [
+        (1, False, False), (2, True, False), (3, False, True)]
+    # newest non-skipped round (r02) passes → the r01 failure is history
+    assert mod.check_multichip(samples) == []
+    assert mod.main([str(tmp_path)]) == 0
+    # a failing newest round IS a break (boolean — no noise to sustain)
+    write(5, n_devices=8, rc=3, ok=False, skipped=False, tail="died")
+    samples = mod.load_multichip(str(tmp_path))
+    breaks = mod.check_multichip(samples)
+    assert len(breaks) == 1 and "r05" in breaks[0]
+    assert mod.main([str(tmp_path)]) == 1
+
+
 # ---------------------------------------------------------------------------
 # lints: metric naming + env-knob table stay green with the new series
 # ---------------------------------------------------------------------------

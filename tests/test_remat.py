@@ -48,6 +48,78 @@ def test_remat_json_roundtrip():
     assert back.remat is True
 
 
+def test_remat_policy_matches_plain_training():
+    """A save policy ("dots": keep matmul outputs) changes only what is
+    rematerialised, never the math — training under it is numerically
+    identical to plain remat and to no remat."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 8).astype("float32")
+    y = np.eye(4, dtype="float32")[rng.randint(0, 4, 8)]
+    ref = MultiLayerNetwork(_conf(False)).init()
+    b = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2)).list()
+         .layer(L.DenseLayer(n_out=16, activation="relu"))
+         .layer(L.DenseLayer(n_out=16, activation="tanh"))
+         .layer(L.OutputLayer(n_out=4, activation="softmax",
+                              loss_function="negativeloglikelihood"))
+         .set_input_type(InputType.feed_forward(8)))
+    b.gradient_checkpointing(policy="dots")
+    net = MultiLayerNetwork(b.build()).init()
+    for _ in range(5):
+        ref.fit(x, y)
+        net.fit(x, y)
+    assert np.isclose(ref.score(), net.score(), rtol=1e-5)
+    for a, c in zip(jax.tree.leaves(ref._params),
+                    jax.tree.leaves(net._params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_remat_policy_json_roundtrip_and_validation():
+    from deeplearning4j_tpu.nn._remat import checkpoint_policy
+    from deeplearning4j_tpu.nn.conf.configuration import (
+        MultiLayerConfiguration, NeuralNetConfiguration)
+    b = (NeuralNetConfiguration.builder().seed(1).list()
+         .layer(L.OutputLayer(n_out=2, activation="softmax",
+                              loss_function="negativeloglikelihood"))
+         .set_input_type(InputType.feed_forward(4)))
+    b.gradient_checkpointing(policy="dots")
+    conf = b.build()
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.remat_policy == "dots"
+    assert checkpoint_policy(None) is None
+    assert checkpoint_policy("dots") is not None
+    import pytest
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        checkpoint_policy("bogus")
+
+
+def test_transformer_scan_remat_dots_matches():
+    """The scan_layers OOM-fix combo (scan + remat + dots policy) is
+    numerically identical to the plain loop — only backward memory
+    scheduling differs (see benchmarks/ab/mfu_ladder_scan_remat_cpu.json
+    for the compiled temp-bytes A/B)."""
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+
+    toks = jnp.asarray(np.random.default_rng(2).integers(0, 32, (2, 16)),
+                       jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+    outs = {}
+    for tag, kw in (("loop", {}),
+                    ("scan_dots", {"scan_layers": True, "remat": True,
+                                   "remat_policy": "dots"})):
+        cfg = TransformerConfig(vocab_size=32, n_layers=3, n_heads=2,
+                                d_model=32, max_len=16, **kw)
+        m = TransformerLM(cfg, mesh=None)
+        p = m.init_params(jax.random.key(0))
+        loss, grads = jax.value_and_grad(m.loss_fn)(p, toks, tgts)
+        outs[tag] = (float(loss), grads)
+    assert np.isclose(outs["loop"][0], outs["scan_dots"][0], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(outs["loop"][1]["tok_emb"]),
+        np.asarray(outs["scan_dots"][1]["tok_emb"]), rtol=1e-5, atol=1e-6)
+
+
 def test_transformer_remat_matches():
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        TransformerLM)

@@ -51,6 +51,7 @@ DEFAULT_SUSTAIN = 2
 DEFAULT_TOLERANCE = 0.25
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)[^/]*\.json$")
+_MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -123,6 +124,61 @@ def load_samples(root: str) -> List[Sample]:
     return out
 
 
+class DryrunSample(NamedTuple):
+    round: int
+    path: str
+    ok: bool
+    skipped: bool
+    n_devices: Optional[int]
+
+
+def load_multichip(root: str) -> List[DryrunSample]:
+    """The driver's ``MULTICHIP_r*.json`` dryrun records — a different
+    schema from bench rounds ({n_devices, rc, ok, skipped, tail}: a
+    pass/fail smoke of the sharded paths, no throughput numbers). They
+    are graded as a boolean trajectory, never as a perf series."""
+    out: List[DryrunSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        m = _MULTICHIP_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict) or not (
+                "ok" in doc or "rc" in doc or "skipped" in doc):
+            continue
+        ok = bool(doc.get("ok", doc.get("rc", 1) == 0))
+        nd = doc.get("n_devices")
+        out.append(DryrunSample(
+            round=int(m.group(1)), path=path, ok=ok,
+            skipped=bool(doc.get("skipped")),
+            n_devices=int(nd) if isinstance(nd, (int, float)) else None))
+    return out
+
+
+def check_multichip(samples: List[DryrunSample]) -> List[str]:
+    """The NEWEST non-skipped dryrun per round must pass; a failing
+    newest round is a break (boolean — one failure is real, there is no
+    noise to sustain through)."""
+    newest: Dict[int, DryrunSample] = {}
+    for s in samples:
+        if s.skipped:
+            continue
+        prev = newest.get(s.round)
+        if prev is None or _file_mtime(s.path) >= _file_mtime(prev.path):
+            newest[s.round] = s
+    if not newest:
+        return []
+    latest = newest[max(newest)]
+    if latest.ok:
+        return []
+    return [f"MULTICHIP dryrun FAILING at r{latest.round:02d} "
+            f"({latest.path})"]
+
+
 def _grade_series(metric: str, series: str, points: List[Tuple[int, float]],
                   tolerance: float, sustain: int) -> Optional[Regression]:
     """One trajectory: trailing ``sustain`` points vs. the median of
@@ -191,7 +247,15 @@ def main(argv=None) -> int:
     root = args[0] if args else os.path.normpath(os.path.join(
         os.path.dirname(os.path.abspath(__file__)), os.pardir))
     samples = load_samples(root)
+    dryruns = load_multichip(root)
+    if not samples and not dryruns:
+        # a fresh checkout / pre-first-bench tree has no trajectory at
+        # all — that is a clean state, not an error
+        print(f"no bench trajectory under {root} (0 samples) — "
+              "nothing to grade")
+        return 0
     regressions = check_trajectory(samples)
+    breaks = check_multichip(dryruns)
     for s in samples:
         marks = []
         if s.vs_baseline is not None:
@@ -200,11 +264,18 @@ def main(argv=None) -> int:
             marks.append(f"device_mfu={s.mfu:.4f}")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + (" ".join(marks) or f"value={s.value}"))
+    for d in dryruns:
+        state = ("skipped" if d.skipped else "ok" if d.ok else "FAIL")
+        dev = f" devices={d.n_devices}" if d.n_devices else ""
+        print(f"r{d.round:02d} multichip_dryrun {state}{dev}")
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
-    if not regressions:
-        print(f"bench trajectory OK ({len(samples)} samples under {root})")
-    return len(regressions)
+    for b in breaks:
+        print(b)
+    if not regressions and not breaks:
+        print(f"bench trajectory OK ({len(samples)} bench + "
+              f"{len(dryruns)} dryrun samples under {root})")
+    return len(regressions) + len(breaks)
 
 
 if __name__ == "__main__":
