@@ -108,11 +108,14 @@ def snapshot() -> dict:
         # resilience posture: whether policies were armed, what chaos was
         # configured, and the default serving deadline — a hang under
         # injected faults must say so in the bundle
+        from deeplearning4j_tpu.resilience.elastic import elastic_enabled
         from deeplearning4j_tpu.resilience.faults import resilience_enabled
         from deeplearning4j_tpu.resilience.policy import default_deadline_ms
         out["resilience_enabled"] = resilience_enabled()
         out["fault_spec"] = os.environ.get("DL4J_TPU_FAULTS", "")
         out["default_deadline_ms"] = default_deadline_ms()
+        # elastic posture: whether host loss is a restorable fault here
+        out["elastic_enabled"] = elastic_enabled()
     except Exception:
         pass
     return out

@@ -37,6 +37,8 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
 - ``resilience.json`` — fault plan + injection counts, circuit-breaker
   states, and the resilience event ring (retries, sheds, breaker
   transitions, restores, quarantines)
+- ``elastic.json`` — elastic posture: device-capacity view, mesh
+  reshape history, and the sharded-manifest checkpoint stores
 - ``perf.json`` — the cost observatory: per-entry-point FLOPs/bytes,
   live MFU vs. its rolling baseline, and roofline verdicts (was the
   process slow BEFORE it died?)
@@ -321,6 +323,9 @@ class FlightRecorder:
         # were open, and the retry/shed/restore/quarantine event trail —
         # a hang during a chaos run must name the chaos
         section("resilience.json", self._write_resilience)
+        # the elastic layer: capacity view, reshape history, and the
+        # manifest stores — a death mid-shrink must name the topology
+        section("elastic.json", self._write_elastic)
         # the PR-6 cost observatory: per-fn cost/MFU/roofline at the
         # moment of death — a postmortem for "it got slow, then it hung"
         section("perf.json", self._write_perf)
@@ -372,6 +377,12 @@ class FlightRecorder:
         from deeplearning4j_tpu import resilience
         with open(path, "w") as f:
             json.dump(resilience.snapshot(), f, indent=2, default=str)
+
+    @staticmethod
+    def _write_elastic(path: str):
+        from deeplearning4j_tpu.resilience import elastic
+        with open(path, "w") as f:
+            json.dump(elastic.snapshot(), f, indent=2, default=str)
 
     @staticmethod
     def _write_perf(path: str):

@@ -342,6 +342,61 @@ def state_matches(state: Optional[dict], layout: BucketLayout,
                for r, size in zip(res, layout.bucket_sizes))
 
 
+def reshape_state(state: Optional[dict], layout: BucketLayout,
+                  n_replicas: int):
+    """Carry a restored compression state across a TOPOLOGY change
+    (checkpoint written on an M-replica mesh, restoring onto N replicas).
+
+    Returns ``(state, mode)``:
+
+    - ``("match")``      — same replica count, state reused as-is;
+    - ``("rebucketed")`` — residual rows re-bucketed onto the new replica
+      count: shrink (M % N == 0) group-MEANS consecutive rows, expand
+      (N % M == 0) tiles each row — both preserve the replica-mean
+      deferred mass the next step's error feedback contributes (the
+      decode is a replica mean, so mean-preserving maps keep the
+      effective update trajectory; byte-exact replay is impossible
+      across a reshape and the caller warns);
+    - ``("reseeded")``   — indivisible replica counts: residuals restart
+      at zero;
+    - ``(None, "layout_mismatch")`` — the bucket layout itself differs
+      (architecture change): nothing is salvageable, caller re-inits.
+
+    In every non-None case the THRESHOLD state is kept: thresholds are
+    layout-keyed (one scalar per dtype bucket), not replica-keyed, and
+    the adaptive algorithm's learned operating point survives reshaping.
+    """
+    if not isinstance(state, dict):
+        return None, "layout_mismatch"
+    res = state.get("residual")
+    thr = state.get("threshold")
+    if res is None or thr is None or len(res) != layout.n_buckets \
+            or len(thr) != layout.n_buckets \
+            or any(np.ndim(r) != 2 or np.shape(r)[1] != size
+                   for r, size in zip(res, layout.bucket_sizes)):
+        return None, "layout_mismatch"
+    old_n = int(np.shape(res[0])[0])
+    if any(int(np.shape(r)[0]) != old_n for r in res):
+        return None, "layout_mismatch"
+    thresholds = [jnp.asarray(t, jnp.float32) for t in thr]
+    if old_n == n_replicas:
+        return {"residual": [jnp.asarray(r, jnp.float32) for r in res],
+                "threshold": thresholds}, "match"
+    if old_n % n_replicas == 0:
+        g = old_n // n_replicas
+        new_res = [jnp.mean(jnp.asarray(r, jnp.float32).reshape(
+            n_replicas, g, -1), axis=1) for r in res]
+        return {"residual": new_res, "threshold": thresholds}, "rebucketed"
+    if n_replicas % old_n == 0:
+        g = n_replicas // old_n
+        new_res = [jnp.repeat(jnp.asarray(r, jnp.float32), g, axis=0)
+                   for r in res]
+        return {"residual": new_res, "threshold": thresholds}, "rebucketed"
+    new_res = [jnp.zeros((n_replicas, size), jnp.float32)
+               for size in layout.bucket_sizes]
+    return {"residual": new_res, "threshold": thresholds}, "reseeded"
+
+
 def state_to_arrays(state: dict) -> Dict[str, np.ndarray]:
     """Checkpoint form (``gradCompression.npz`` entries): residuals are
     fetched as the GLOBAL (n_replicas, size) array — the gather across

@@ -25,12 +25,107 @@ to sync-every-step, which strictly dominates it in convergence per step.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+import os
+from typing import Optional, Tuple
 
 import jax
 
 from deeplearning4j_tpu.parallel.mesh import MeshSpec
 from deeplearning4j_tpu.parallel.trainer import ShardedTrainer
+
+# one probe per process: the answer cannot change while jaxlib doesn't
+_MULTIPROC_PROBE: Optional[Tuple[bool, str]] = None
+
+_PROBE_SCRIPT = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + sys.argv[2],
+                           num_processes=2, process_id=int(sys.argv[1]))
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.asarray(jax.devices()), ("data",))
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), np.ones((1,), np.float32))
+s = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+print("PSUM_OK", float(s), flush=True)
+"""
+
+
+def multiprocess_cpu_collectives_supported(
+        timeout_s: float = 120.0) -> Tuple[bool, str]:
+    """Runtime capability probe: can THIS jax/jaxlib run a cross-process
+    collective on the CPU backend? Some builds (this container's among
+    them) bootstrap ``jax.distributed`` fine and then fail the first
+    multi-process computation with ``Multiprocess computations aren't
+    implemented on the CPU backend`` — so the probe must run a REAL
+    cross-process reduction, not just the handshake.
+
+    Two throwaway subprocesses form a 2-process loopback mesh and psum
+    one scalar. Cached per process (one ~5 s probe, then free); the
+    ``DL4J_TPU_MULTIHOST_PROBE`` knob overrides it (``1`` = assume
+    supported, ``0`` = assume not) for CI that already knows its
+    platform. Returns ``(supported, reason)``.
+    """
+    global _MULTIPROC_PROBE
+    override = os.environ.get("DL4J_TPU_MULTIHOST_PROBE", "")
+    if override == "1":
+        return True, "forced by DL4J_TPU_MULTIHOST_PROBE=1"
+    if override == "0":
+        return False, "forced by DL4J_TPU_MULTIHOST_PROBE=0"
+    if _MULTIPROC_PROBE is not None:
+        return _MULTIPROC_PROBE
+    import socket
+    import subprocess
+    import sys
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)       # the probe pins its own platform
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PROBE_SCRIPT, str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    ok = True
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                out = (out or "") + "\n<probe timeout>"
+                ok = False
+            outs.append(out or "")
+            ok = ok and p.returncode == 0 and "PSUM_OK" in outs[-1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    if ok:
+        _MULTIPROC_PROBE = (True, "2-process loopback psum succeeded")
+    else:
+        # surface the decisive line (the XlaRuntimeError message) so a
+        # skip names WHY, not just "probe failed"
+        reason = "2-process loopback psum failed"
+        for out in outs:
+            for line in reversed(out.strip().splitlines()):
+                if "Error" in line or "error" in line or "<probe" in line:
+                    reason = line.strip()[:200]
+                    break
+            else:
+                continue
+            break
+        _MULTIPROC_PROBE = (False, reason)
+    return _MULTIPROC_PROBE
 
 
 @dataclasses.dataclass

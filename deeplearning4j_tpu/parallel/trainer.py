@@ -64,7 +64,10 @@ class ShardedTrainer:
                  preemption_handler=None, checkpoint_dir: Optional[str] = None,
                  grad_compression=None):
         self.net = net
-        self.mesh = (mesh_spec or MeshSpec.data_parallel()).build(devices)
+        # the declarative spec is kept so elastic shrink/re-expand can
+        # rebuild the mesh over a different device set (resize_mesh)
+        self._mesh_spec = mesh_spec or MeshSpec.data_parallel()
+        self.mesh = self._mesh_spec.build(devices)
         self.tensor_parallel = tensor_parallel
         # compressed gradient exchange (Strom 2015 error-feedback threshold
         # collectives — the EncodedGradientsAccumulator analog): a
@@ -233,6 +236,22 @@ class ShardedTrainer:
         _devmem.sample()        # post-placement HBM baseline
         self._placed = True
 
+    def resize_mesh(self, devices=None):
+        """Rebuild the mesh over a different device set (elastic shrink
+        after host/device loss, re-expand when capacity returns). The
+        next batch re-places params/opt-state/compression state onto the
+        new mesh (``_place`` handles warm re-placement and replica-count
+        reshaping of replica-keyed state); cached jitted steps keyed on
+        the old mesh are dropped."""
+        old = self.mesh.size
+        self.mesh = self._mesh_spec.build(devices)
+        self._placed = False
+        self._comp_step = None
+        self._comp_fallback_warned = False
+        log.warning("mesh resized: %d -> %d devices (re-placement on the "
+                    "next batch)", old, self.mesh.size)
+        return self
+
     def _opt_state_shardings(self, opt_state):
         """Data-axis sharding for param-shaped optimizer moments: leaves
         whose largest dim divides the DP degree shard on that dim, scalars/
@@ -310,12 +329,32 @@ class ShardedTrainer:
         state = getattr(self.net, "_grad_compression_state", None)
         if not _comp.state_matches(state, self._comp_layout, n_data):
             if state is not None:
-                log.warning(
-                    "restored gradient-compression state does not match "
-                    "the current layout/mesh; re-seeding the residual at "
-                    "zero")
-            state = _comp.init_state(self._comp_layout, self._compression,
-                                     n_data)
+                # topology change (elastic shrink/expand, or a checkpoint
+                # from a different mesh): replica-keyed residuals cannot
+                # survive byte-exactly — re-bucket them mean-preservingly
+                # (or re-seed at zero when the counts don't divide) but
+                # KEEP the layout-keyed threshold state either way
+                reshaped, mode = _comp.reshape_state(
+                    state, self._comp_layout, n_data)
+                if reshaped is not None:
+                    old_n = int(np.shape(state["residual"][0])[0])
+                    log.warning(
+                        "gradient-compression state was written on a "
+                        "%d-replica mesh, restoring onto %d replicas: "
+                        "residuals %s (replica-keyed state cannot survive "
+                        "a reshape byte-exactly), thresholds kept",
+                        old_n, n_data, mode)
+                    state = reshaped
+                else:
+                    log.warning(
+                        "restored gradient-compression state does not "
+                        "match the current layout; re-seeding the "
+                        "residual at zero")
+                    state = _comp.init_state(
+                        self._comp_layout, self._compression, n_data)
+            else:
+                state = _comp.init_state(self._comp_layout,
+                                         self._compression, n_data)
         rshard = NamedSharding(self.mesh, P(DATA_AXIS, None))
         rep = NamedSharding(self.mesh, P())
         self.net._grad_compression_state = {

@@ -54,6 +54,7 @@ __all__ = [
     "RetryBudget", "RetryPolicy", "ShedError", "ShutdownError",
     "TransientError", "default_deadline_ms", "is_transient",
     "ResilientTrainer", "SkippingIterator", "newest_checkpoint",
+    "ElasticCheckpointer", "HostLostError", "elastic_enabled",
     "snapshot",
 ]
 
@@ -61,14 +62,17 @@ __all__ = [
 def snapshot() -> dict:
     """Everything a postmortem needs about the resilience layer: fault
     plan + injection counts, live circuit-breaker states, the default
-    deadline, and the recent event ring (injections, retries, sheds,
-    breaker transitions, restores, quarantines)."""
-    from deeplearning4j_tpu.resilience import policy
+    deadline, the elastic posture, and the recent event ring
+    (injections, retries, sheds, breaker transitions, restores,
+    reshapes, quarantines)."""
+    from deeplearning4j_tpu.resilience import elastic, policy
     return {
         "enabled": resilience_enabled(),
         "faults": faults.snapshot(),
         "circuits": policy.circuit_snapshot(),
         "default_deadline_ms": policy.default_deadline_ms(),
+        "elastic": {"enabled": elastic.elastic_enabled(),
+                    "capacity": elastic.global_capacity().snapshot()},
         "events": faults.events(),
     }
 
@@ -79,4 +83,7 @@ def __getattr__(name):
     if name in ("ResilientTrainer", "SkippingIterator", "newest_checkpoint"):
         from deeplearning4j_tpu.resilience import recovery
         return getattr(recovery, name)
+    if name in ("ElasticCheckpointer", "HostLostError", "elastic_enabled"):
+        from deeplearning4j_tpu.resilience import elastic
+        return getattr(elastic, name)
     raise AttributeError(name)

@@ -69,11 +69,16 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 POINTS = ("data.next_batch", "inference.dispatch", "inference.device_execute",
-          "train.step", "checkpoint.save", "checkpoint.restore", "allreduce")
-KINDS = ("error", "crash", "latency", "nan")
+          "train.step", "checkpoint.save", "checkpoint.restore",
+          "checkpoint.manifest", "allreduce")
+KINDS = ("error", "crash", "latency", "nan", "host_loss")
 # nan corrupts a batch, so it only fires at points that own an array —
 # accepting it elsewhere would validate a chaos spec that never injects
 NAN_POINTS = ("data.next_batch", "train.step")
+# host_loss simulates losing devices mid-step, so it only fires at the
+# points a sharded step actually crosses; it needs the elastic layer to
+# mean anything (DL4J_TPU_ELASTIC=0 disarms it — pre-elastic behavior)
+HOST_LOSS_POINTS = ("train.step", "allreduce")
 
 
 def resilience_enabled() -> bool:
@@ -115,6 +120,11 @@ class FaultSpec:
                 f"kind 'nan' corrupts a batch and only fires at "
                 f"{NAN_POINTS}; point {point!r} owns no array — use "
                 "'error', 'crash', or 'latency' there")
+        if kind == "host_loss" and point not in HOST_LOSS_POINTS:
+            raise ValueError(
+                f"kind 'host_loss' loses devices mid-step and only fires "
+                f"at {HOST_LOSS_POINTS}; point {point!r} never crosses "
+                "the mesh")
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"rate must be in [0, 1], got {rate}")
         self.point = point
@@ -291,6 +301,28 @@ class FaultRegistry:
             kind = st.spec.kind
             if kind == "nan":
                 continue
+            if kind == "host_loss":
+                # a host-loss fault only means something when the elastic
+                # layer can act on it; under DL4J_TPU_ELASTIC=0 the spec
+                # is inert (byte-identical pre-elastic behavior)
+                from deeplearning4j_tpu.resilience import elastic as _el
+                if not _el.elastic_enabled():
+                    continue
+                with self._lock:
+                    fire = self._draw(st)
+                if not fire:
+                    continue
+                # capacity drops BEFORE the error propagates: the
+                # recovery path reads the shrunken capacity when it
+                # decides the new mesh size. When no device CAN be lost
+                # (already down to one survivor) nothing happened — the
+                # injection is not counted and no error is raised, the
+                # same never-count-a-no-op rule as the nan kind
+                lost = _el.global_capacity().mark_host_loss()
+                if lost <= 0:
+                    continue
+                self._note(point, kind)
+                raise _el.HostLostError(point, lost=lost)
             with self._lock:
                 fire = self._draw(st)
             if not fire:

@@ -35,6 +35,7 @@ import threading
 from typing import Dict, Optional
 
 from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.resilience import elastic as _elastic
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.policy import (RestartBudgetExhausted,
                                                   RetryPolicy, is_transient)
@@ -122,6 +123,33 @@ class SkippingIterator(DataSetIterator):
         return sorted(self._quarantined)
 
 
+class _ElasticSaveListener:
+    """Cadence listener of the elastic posture: every N iterations,
+    queue an ASYNC sharded save (state snapshot on this thread — cheap
+    host fetch — serialization/fsync/manifest commit on the
+    checkpointer's background thread). The zip CheckpointListener's
+    elastic twin; chaos coverage of the save path stays via the
+    ``checkpoint.save`` point."""
+
+    def __init__(self, ckpt: "_elastic.ElasticCheckpointer", target,
+                 every: int):
+        self.ckpt = ckpt
+        self.target = target
+        self.every = max(1, int(every))
+
+    def on_epoch_start(self, net, epoch):
+        pass
+
+    def on_epoch_end(self, net, epoch):
+        pass
+
+    def iteration_done(self, net, iteration, epoch, score):
+        if iteration % self.every == 0:
+            _faults.check("checkpoint.save")
+            self.ckpt.save(iteration, net,
+                           mesh=getattr(self.target, "mesh", None))
+
+
 def newest_checkpoint(directory: str) -> Optional[str]:
     """Newest *readable* checkpoint zip in ``directory`` (mtime, then
     the CheckpointListener counter, then name — the shared
@@ -152,7 +180,9 @@ class ResilientTrainer:
                  checkpoint_every_iterations: int = 1,
                  keep_checkpoints: int = 3,
                  retry_policy: Optional[RetryPolicy] = None,
-                 quarantine_after: int = 2):
+                 quarantine_after: int = 2,
+                 elastic: bool = False,
+                 elastic_dir: Optional[str] = None):
         self.target = target
         self.net = getattr(target, "net", target)
         self.checkpoint_dir = checkpoint_dir
@@ -165,6 +195,24 @@ class ResilientTrainer:
         self.quarantine_after = max(1, int(quarantine_after))
         self.restarts = 0
         self._lock = threading.Lock()
+        #: elastic mode (DL4J_TPU_ELASTIC=0 kill switch read live at each
+        #: fit): async SHARDED manifest checkpoints instead of zip saves,
+        #: and host/device loss (HostLostError) handled by shrinking the
+        #: mesh, restoring the manifest onto the smaller topology, and
+        #: re-expanding when capacity returns. Needs a ShardedTrainer
+        #: target (mesh reshaping is meaningless on a bare net).
+        self.elastic = bool(elastic)
+        self.elastic_dir = elastic_dir or os.path.join(checkpoint_dir,
+                                                       "elastic")
+        self._elastic_ckpt: Optional[_elastic.ElasticCheckpointer] = None
+        self._elastic_live = False     # resolved once per fit() call
+        self._elastic_warned = False
+        # the trainer's CONFIGURED device pool, recorded at the first
+        # elastic fit: shrink/re-expand moves within this list only — a
+        # trainer built on a device subset must never be "expanded" onto
+        # devices it was not configured to use just because the host has
+        # more (capacity is global, the pool is this trainer's)
+        self._elastic_devices = None
 
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, epochs: int = 1):
@@ -185,10 +233,34 @@ class ResilientTrainer:
         from deeplearning4j_tpu.observability import span as _span
         from deeplearning4j_tpu.observability.flight_recorder import (
             global_flight_recorder as _flight)
-        ckpt = CheckpointListener(
-            self.checkpoint_dir,
-            save_every_n_iterations=self.checkpoint_every,
-            keep_last=self.keep_checkpoints)
+        # elastic posture resolved ONCE per fit (kill switch read live);
+        # elastic without a mesh-bearing target degrades to the plain
+        # zip path with a warning — reshaping a bare net is meaningless
+        self._elastic_live = (self.elastic and _elastic.elastic_enabled()
+                              and hasattr(self.target, "resize_mesh"))
+        if self.elastic and _elastic.elastic_enabled() \
+                and not self._elastic_live and not self._elastic_warned:
+            self._elastic_warned = True
+            log.warning("elastic mode requested but the target has no "
+                        "mesh to reshape; using plain zip checkpoints")
+        if self._elastic_live:
+            if self._elastic_devices is None:
+                self._elastic_devices = list(self.target.mesh.devices.flat)
+            if self._elastic_ckpt is None:
+                # one shard file per mesh device — the single-host analog
+                # of per-host shards at pod scale (keeps each file small
+                # enough to stream, and a lost shard tears only its set)
+                self._elastic_ckpt = _elastic.ElasticCheckpointer(
+                    self.elastic_dir, max_to_keep=self.keep_checkpoints,
+                    n_shards=self.target.mesh.size)
+            ckpt = _ElasticSaveListener(self._elastic_ckpt, self.target,
+                                        self.checkpoint_every)
+            _elastic.set_mesh_size(self.target.mesh.size)
+        else:
+            ckpt = CheckpointListener(
+                self.checkpoint_dir,
+                save_every_n_iterations=self.checkpoint_every,
+                keep_last=self.keep_checkpoints)
         net.addListeners(ckpt)
         try:
             # ONE root span + flight-recorder arm for the whole fit (the
@@ -200,6 +272,28 @@ class ResilientTrainer:
                 self._fit_epochs(it, epochs)
         finally:
             net._listeners.remove(ckpt)
+            if self._elastic_live and self._elastic_ckpt is not None:
+                # never leave the final async save in flight: fit()
+                # returning promises the newest manifest is durable
+                self._elastic_ckpt.wait()
+                if self._elastic_ckpt.last_error is not None:
+                    # an async failure is only a log line + counter while
+                    # training runs — but here we are about to RETURN, so
+                    # "durable" must be made true inline (one sync
+                    # attempt; failing that, warn loudly rather than
+                    # discard the completed training by raising)
+                    self._elastic_ckpt.last_error = None
+                    try:
+                        self._elastic_ckpt.save(
+                            net._iteration, net,
+                            mesh=getattr(self.target, "mesh", None),
+                            sync=True)
+                    except Exception as e:
+                        log.warning(
+                            "final elastic save failed after an async "
+                            "failure (%s: %s); the newest durable "
+                            "manifest may predate the last steps",
+                            type(e).__name__, e)
         # same return as the delegate branch above (the wrapped fit
         # returns its target) — callers chain identically in both postures
         return self.target
@@ -249,6 +343,7 @@ class ResilientTrainer:
                     step_iter0 = net._iteration
                     self._step(ds)
                     target = it.position() + 1
+                    self._elastic_heartbeat()
             except (TrainingPreempted, KeyboardInterrupt,
                     RestartBudgetExhausted):
                 raise
@@ -259,8 +354,13 @@ class ResilientTrainer:
                 # batch is innocent and must not be blamed/quarantined
                 landed = (step_iter0 is not None
                           and net._iteration != step_iter0)
+                # a lost host is never the batch's fault either — the
+                # same batch replays fine on the shrunken mesh
+                host_lost = isinstance(e, _elastic.HostLostError)
                 target = self._recover(e, it, iter0, target,
-                                       blame_batch=not landed)
+                                       blame_batch=(not landed
+                                                    and not host_lost),
+                                       host_lost=host_lost)
 
     def _fit_one(self, ds):
         """One batch through the per-batch entry BELOW the public fit:
@@ -321,8 +421,40 @@ class ResilientTrainer:
             raise
 
     # ------------------------------------------------------------- recovery
+    def _elastic_pool_size(self) -> int:
+        """How many of THIS trainer's configured devices the global
+        capacity view currently allows: the global loss count is charged
+        against the pool, floored at one device — a subset trainer never
+        grows past its configured devices, and never shrinks to zero."""
+        cap = _elastic.global_capacity()
+        pool = len(self._elastic_devices)
+        lost_global = cap.total() - cap.available()
+        return max(1, pool - min(lost_global, pool - 1))
+
+    def _elastic_heartbeat(self):
+        """After each healthy step in elastic mode: feed the capacity
+        tracker and, when capacity came back, re-expand the mesh (warm
+        re-place on the next batch — params/opt-state are live, so no
+        restore is needed on the way UP). Re-expansion is capped at the
+        trainer's CONFIGURED device pool."""
+        if not self._elastic_live:
+            return
+        _elastic.global_capacity().note_step()
+        avail = self._elastic_pool_size()
+        cur = self.target.mesh.size
+        if avail > cur:
+            log.warning("capacity returned (%d -> %d devices); "
+                        "re-expanding the mesh", cur, avail)
+            self._resize_mesh(avail, "expand")
+
+    def _resize_mesh(self, n_devices: int, direction: str):
+        self.target.resize_mesh(self._elastic_devices[:n_devices])
+        _elastic.count_reshape(direction)
+        _elastic.set_mesh_size(self.target.mesh.size)
+
     def _recover(self, error: BaseException, it: SkippingIterator,
-                 iter0: int, target: int, blame_batch: bool = True) -> int:
+                 iter0: int, target: int, blame_batch: bool = True,
+                 host_lost: bool = False) -> int:
         """Count the restart, mark the failing batch, restore the newest
         checkpoint; returns the batch position to fast-forward to."""
         self.restarts += 1
@@ -339,6 +471,21 @@ class ResilientTrainer:
         _faults.record_event("restart", restarts=self.restarts,
                              error=type(error).__name__,
                              detail=str(error)[:200])
+        if host_lost and self._elastic_live:
+            # SHRINK before restoring: the restore must land on the mesh
+            # that will actually run (buffers on the lost devices are
+            # gone; replaying onto the full mesh would touch them)
+            avail = self._elastic_pool_size()
+            if avail < self.target.mesh.size:
+                log.warning("shrinking the mesh to the %d surviving "
+                            "device(s) before restore", avail)
+                self._resize_mesh(avail, "shrink")
+        elif host_lost:
+            # non-elastic posture: the zip restore below re-runs on the
+            # SAME mesh and nothing will ever feed note_step, so leaving
+            # the process-wide capacity view degraded would poison a
+            # later elastic fit in this process with a phantom loss
+            _elastic.global_capacity().restore_capacity()
         # only the batch actually being APPLIED can be at fault —
         # positions below ``target`` are already inside the params (a
         # flaky re-pull during fast-forward must not quarantine them:
@@ -370,7 +517,49 @@ class ResilientTrainer:
             pos += 1
         return pos
 
+    def _restore_latest_elastic(self, min_iteration: int) -> Optional[int]:
+        """Restore from the newest COMPLETE sharded manifest onto the
+        CURRENT (possibly just-shrunken) mesh. Returns the restored
+        iteration, or None to fall through to the zip path (no manifest
+        yet, or the manifest store is unreadable)."""
+        from deeplearning4j_tpu.parallel import mesh as _mesh
+        from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+        n_replicas = _mesh.axis_size(self.target.mesh, DATA_AXIS) \
+            if DATA_AXIS in self.target.mesh.axis_names \
+            else self.target.mesh.size
+
+        def _do():
+            _faults.check("checkpoint.restore")
+            return self._elastic_ckpt.restore(
+                self.net, min_iteration=min_iteration,
+                target_replicas=n_replicas)
+        try:
+            restored = self.retry.call(_do, op="checkpoint.restore")
+        except (TrainingPreempted, KeyboardInterrupt):
+            raise
+        except Exception as e:
+            log.warning("elastic manifest restore failed (%s: %s); "
+                        "falling back to zip checkpoints",
+                        type(e).__name__, e)
+            return None
+        if restored is None:
+            return None
+        if hasattr(self.target, "_placed"):
+            # restored state is host arrays — warm re-place onto the
+            # (possibly reshaped) mesh before the next step
+            self.target._placed = False
+        _restores_counter().inc()
+        _faults.record_event("restore", path="elastic_manifest",
+                             iteration=restored)
+        log.warning("restored elastic manifest (iteration %d) onto a "
+                    "%d-replica mesh", restored, n_replicas)
+        return restored
+
     def _restore_latest(self, min_iteration: int = 0) -> int:
+        if self._elastic_live and self._elastic_ckpt is not None:
+            restored = self._restore_latest_elastic(min_iteration)
+            if restored is not None:
+                return restored
         from deeplearning4j_tpu.utils import strengthen_dtypes
         from deeplearning4j_tpu.utils.serialization import (
             ModelSerializer, checkpoint_candidates)
@@ -442,6 +631,17 @@ class ResilientTrainer:
         with an empty directory is recoverable too."""
         from deeplearning4j_tpu.utils.serialization import save_model_atomic
         net = self.net
+        if self._elastic_live and self._elastic_ckpt is not None:
+            # elastic boundary saves are SYNCHRONOUS: the epoch must not
+            # start until its restore anchor is durable (the cadence
+            # saves inside the epoch stay async/off the critical path)
+            def _do_elastic():
+                _faults.check("checkpoint.save")
+                self._elastic_ckpt.save(net._iteration, net,
+                                        mesh=self.target.mesh, sync=True)
+
+            self.retry.call(_do_elastic, op="checkpoint.save")
+            return
         path = os.path.join(self.checkpoint_dir,
                             f"resilient_boundary_{type(net).__name__}.zip")
 

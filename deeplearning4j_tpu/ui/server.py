@@ -12,7 +12,9 @@ failing), ``/alerts`` (active violations + transitions), ``/train/trace``
 postmortem bundle now), ``/debug/compiles`` (compile-watch ring: every XLA
 trace of the jitted entry points + the retrace-storm grade),
 ``/debug/resilience`` (fault-injection counts, circuit-breaker states,
-and the retry/shed/restore/quarantine event ring), ``/debug/perf`` (the
+and the retry/shed/restore/quarantine event ring), ``/debug/elastic``
+(device-capacity view, mesh shrink/expand history, and the sharded
+elastic checkpoint manifests), ``/debug/perf`` (the
 cost observatory: per-entry-point FLOPs/bytes, live MFU, roofline
 verdicts), ``/debug/profile`` (on-demand device profiling: ``?steps=N``
 captures N work units and serves the parsed top-K per-op table).
@@ -638,6 +640,15 @@ class UIServer:
                     # analog of /debug/compiles for failure handling
                     from deeplearning4j_tpu import resilience
                     body = json.dumps(resilience.snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/elastic":
+                    # elastic training state: device-capacity view, mesh
+                    # reshape history (shrink/expand), and the sharded
+                    # manifest stores with their newest complete step —
+                    # the first stop after a preemption/host-loss event
+                    from deeplearning4j_tpu.resilience import elastic
+                    body = json.dumps(elastic.snapshot(),
                                       default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/debug/perf":

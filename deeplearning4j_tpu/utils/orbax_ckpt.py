@@ -16,6 +16,17 @@ single-file artifact for parity with the reference's
   stepping while orbax writes,
 - rotating retention via CheckpointManager (the CheckpointListener
   keep-last-N policy, SURVEY 5.4, at pod scale).
+
+The ELASTIC path (`resilience/elastic.py`) builds on the same design —
+async sharded saves, restore onto a different topology — but owns its
+manifest format (per-shard content digests, torn-shard-set detection,
+the ``checkpoint.manifest`` fault point) because the self-healing layer
+must be able to rank/verify/skip checkpoints with the exact semantics
+of ``utils/serialization.checkpoint_candidates``; use THIS module for
+orbax-native pytree checkpoints, the elastic manifest store when
+``ResilientTrainer(elastic=True)`` drives restore-resume. Replica-keyed
+state restored across topologies is reshaped by
+``parallel.compression.reshape_state`` in both paths.
 """
 from __future__ import annotations
 

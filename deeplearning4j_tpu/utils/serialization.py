@@ -83,16 +83,49 @@ def checkpoint_candidates(directory: str, prefix: Optional[str] = None):
     return sorted(out, key=rank, reverse=True)
 
 
+def fsync_dir(path: str):
+    """Best-effort fsync of a DIRECTORY entry (after an atomic rename,
+    the new name is only crash-durable once the directory itself is
+    synced). Tolerates filesystems that refuse it — THE one spelling,
+    shared by the zip checkpoint path and the elastic manifest store."""
+    import os
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:      # not every filesystem supports directory fsync
+        pass
+
+
 def save_model_atomic(net, path: str, save_updater: bool = True):
     """Write-then-rename checkpoint save: a crash mid-write can never
     leave a torn zip at ``path`` for a restore path to trust — the
     directory holds either the previous complete file or the new one.
     THE one spelling of the idiom (CheckpointListener, the preemption
-    listeners, and ResilientTrainer all save through it)."""
+    listeners, and ResilientTrainer all save through it).
+
+    Durability ordering: the tmp file is flushed AND fsynced before the
+    rename, and the directory entry is fsynced after it — without the
+    file fsync a SIGKILL between rename and writeback can surface an
+    EMPTY (or torn) file under the final name on crash-recovery, which
+    the restore ranking would then trust. The ``checkpoint.manifest``
+    fault point fires between the fsync and the rename: a crash injected
+    there must leave the previous complete checkpoint in charge
+    (fault-injection proof of the ordering)."""
     import os
     tmp = path + ".tmp"
     net.save(tmp, save_updater)
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    from deeplearning4j_tpu.resilience import faults as _faults
+    _faults.check("checkpoint.manifest")
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
 
 
 class ModelSerializer:

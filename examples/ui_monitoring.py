@@ -202,6 +202,18 @@ def main():
           f"injected={res['faults']['injected']}, circuits={circuits}, "
           f"{len(res['events'])} events")
 
+    # ---- elastic training: /debug/elastic -------------------------------
+    # device-capacity view (host losses shrink it, healthy steps on the
+    # degraded mesh restore it), mesh reshape history, and the sharded
+    # manifest checkpoint stores with their newest complete step
+    el = _json.loads(urllib.request.urlopen(
+        server.get_address() + "/debug/elastic", timeout=5).read())
+    cap = el["capacity"]
+    print(f"\n/debug/elastic: enabled={el['enabled']}, "
+          f"capacity={cap['available']}/{cap['total_devices']}, "
+          f"reshapes={el['reshapes']}, "
+          f"{len(el['checkpointers'])} manifest store(s)")
+
     # ---- SLO-driven health + alerts -------------------------------------
     # /health grades measured SLOs (p99 latency, error rate, queue depth,
     # prefetch overlap, retrace storms, numerics divergence) and returns
