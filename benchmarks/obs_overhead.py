@@ -35,8 +35,15 @@ min-of-N with rotating order, proving the background save path keeps
 armed step-time overhead under the 2% bar at that cadence while
 showing what the synchronous spelling would cost.
 
+``--warmup-ab`` runs the serving AOT-warmup A/B: first-request latency
+through ``ServingRouter`` for a cold deploy (no warmup — the request
+pays the whole-program XLA compile) vs. an AOT-warmed deploy (the
+request should sit within box noise of steady state), interleaved
+min-of-N in fresh subprocesses so every cold arm is genuinely cold.
+
 Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
      python benchmarks/obs_overhead.py --elastic-ab [--json]
+     python benchmarks/obs_overhead.py --warmup-ab [--json]
 """
 from __future__ import annotations
 
@@ -196,6 +203,89 @@ def elastic_ab(steps: int, batch: int, repeats: int,
     return async_overhead
 
 
+#: serving warmup A/B worker: deploy a version with vs. without AOT
+#: bucket warmup in a FRESH process (compiles must be cold), then time
+#: the first routed request against steady state. The warm arm's first
+#: request should sit within box noise of steady state; the cold arm
+#: pays the whole-program XLA compile on live traffic.
+_WARMUP_WORKER = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.serving import ModelRegistry, ServingRouter
+
+warm = sys.argv[1] == "warm"
+batch = int(sys.argv[2])
+
+net = zoo.LeNet().init_model()
+x = np.random.RandomState(0).rand(batch, 28 * 28).astype("f4")
+reg = ModelRegistry()
+reg.deploy("v1", net, sample_input=x[:1] if warm else None, warmup=warm,
+           batch_limit=batch, max_wait_ms=1.0)
+router = ServingRouter(reg, "v1")
+t0 = time.perf_counter()
+router.output(x)
+first = time.perf_counter() - t0
+steady = []
+for _ in range(20):
+    t0 = time.perf_counter()
+    router.output(x)
+    steady.append(time.perf_counter() - t0)
+reg.shutdown()
+print(json.dumps({"first_ms": first * 1e3,
+                  "steady_ms": min(steady) * 1e3}))
+"""
+
+
+def _run_warmup(batch: int, mode: str) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _WARMUP_WORKER, mode, str(batch)],
+        capture_output=True, text=True, env=env, check=True)
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def warmup_ab(batch: int, repeats: int, as_json: bool) -> float:
+    """Interleaved min-of-N A/B (rotating arm order — the noisy-box
+    protocol): first-request latency through ``ServingRouter`` with AOT
+    deploy warmup vs. without. The acceptance claim: with warmup, the
+    first request is within noise of steady state; without it, it eats
+    the whole-program compile."""
+    samples = {"cold": [], "warm": []}
+    order = ["cold", "warm"]
+    for r in range(repeats):
+        for m in order[r % 2:] + order[:r % 2]:
+            samples[m].append(_run_warmup(batch, m))
+    cold_first = min(s["first_ms"] for s in samples["cold"])
+    warm_first = min(s["first_ms"] for s in samples["warm"])
+    steady = min(s["steady_ms"] for s in samples["warm"])
+    result = {"first_request_ms_cold": cold_first,
+              "first_request_ms_warm": warm_first,
+              "steady_state_ms": steady,
+              "cold_over_warm": cold_first / warm_first,
+              "warm_first_over_steady": warm_first / steady,
+              "batch": batch, "repeats": repeats}
+    if as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"serving warmup A/B (lenet, batch={batch}, min of "
+              f"{repeats} interleaved repeats)")
+        print(f"  first request, cold deploy (no warmup): "
+              f"{cold_first:9.2f} ms")
+        print(f"  first request, AOT-warmed deploy:       "
+              f"{warm_first:9.2f} ms")
+        print(f"  steady state:                           "
+              f"{steady:9.2f} ms")
+        print(f"  cold/warm first-request ratio: "
+              f"{cold_first / warm_first:6.1f}x")
+        print(f"  warm first-request vs steady:  "
+              f"{warm_first / steady:6.2f}x  (bar: within box noise)")
+    return warm_first / steady
+
+
 #: mode name -> env overrides on top of the caller's environment
 MODES = {
     "off": {"DL4J_TPU_METRICS": "0"},
@@ -228,6 +318,9 @@ def main():
     ap.add_argument("--elastic-ab", action="store_true",
                     help="run the elastic async-checkpoint A/B instead "
                          "of the kill-switch ladder")
+    ap.add_argument("--warmup-ab", action="store_true",
+                    help="run the serving AOT-warmup A/B: first-request "
+                         "latency with vs. without deploy warmup")
     ap.add_argument("--save-every", type=int, default=8,
                     help="elastic A/B checkpoint cadence in steps (the "
                          "perf posture; the exact-resume drills save "
@@ -237,6 +330,8 @@ def main():
     if args.elastic_ab:
         return elastic_ab(args.steps, args.batch, args.repeats, args.json,
                           args.save_every)
+    if args.warmup_ab:
+        return warmup_ab(args.batch, args.repeats, args.json)
 
     # a lone run is dominated by host warmup noise (the first subprocess
     # routinely runs 1.5x slower than steady state regardless of mode) —

@@ -27,6 +27,9 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 - ``resilience``    — fault injection, retry/deadline/circuit-breaker
   policies, admission control, self-healing training (exceeds the
   reference's Spark-retry + checkpoint story).
+- ``serving``       — zero-downtime versioned deploys over
+  ``ParallelInference``: AOT bucket warmup + persistent compile cache,
+  SLO-gated canary rollout with auto-rollback, graceful drain.
 """
 
 __version__ = "0.1.0"

@@ -25,6 +25,7 @@ Knobs (env var → default):
 ``DL4J_TPU_PREFETCH_DEPTH``   ``2``    device batches buffered ahead of the step
 ``DL4J_TPU_SCORE_EVERY``      ``16``   steps between loss materializations
 ``DL4J_TPU_INFLIGHT``         ``2``    serving batches dispatched but uncompleted
+``DL4J_TPU_COMPILE_CACHE``    unset    persistent XLA compile-cache directory
 ============================  =======  ==========================================
 
 Because the async pipelines are exactly what a hung run was doing when it
@@ -81,6 +82,54 @@ def inflight_limit() -> int:
     return _int_env("DL4J_TPU_INFLIGHT", 2)
 
 
+def compile_cache_dir():
+    """``DL4J_TPU_COMPILE_CACHE``: persistent XLA compilation-cache
+    directory (unset/empty = no persistent cache). Serving deploys call
+    :func:`configure_compile_cache` so re-deploys and restarts retrieve
+    executables from disk instead of recompiling them."""
+    return os.environ.get("DL4J_TPU_COMPILE_CACHE") or None
+
+
+_cache_dir_applied = None
+
+
+def configure_compile_cache():
+    """Idempotently point jax's persistent compilation cache at
+    ``DL4J_TPU_COMPILE_CACHE``. Returns the directory in force (None =
+    persistent caching off). The min-compile-time / min-entry-size gates
+    are zeroed so every serving-bucket executable is eligible — the whole
+    point is skipping the small-but-many bucket compiles, and the CPU
+    test meshes compile fast enough that the 1 s default would exclude
+    everything."""
+    global _cache_dir_applied
+    path = compile_cache_dir()
+    if path is None or path == _cache_dir_applied:
+        return _cache_dir_applied
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        for knob, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1)):
+            try:
+                jax.config.update(knob, value)
+            except Exception:      # older jax without the gate: fine
+                pass
+        try:
+            # jax memoizes its cache decision at the FIRST backend
+            # compile; a deploy that follows model-init compiles (the
+            # normal order) would otherwise never engage the dir. The
+            # reset drops only that memo — jit dispatch caches survive.
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+        _cache_dir_applied = path
+    except Exception:              # cache is an optimization, never fatal
+        return None
+    return _cache_dir_applied
+
+
 def snapshot() -> dict:
     """Every live knob value — the async-runtime half of a postmortem
     bundle (a hang report without the pipeline depths that shaped the hang
@@ -90,6 +139,7 @@ def snapshot() -> dict:
         "prefetch_depth": prefetch_depth(),
         "score_sync_every": score_sync_every(),
         "inflight_limit": inflight_limit(),
+        "compile_cache_dir": compile_cache_dir(),
     }
     try:
         # the observatory switches shape what a wedged step was computing

@@ -1,0 +1,65 @@
+"""Shared tail of every jitted train step: freeze masking, optimizer
+apply, in-graph numerics health.
+
+Three entry points used to carry byte-for-byte copies of the same ~20
+lines — ``MultiLayerNetwork._train_step``, ``ComputationGraph._train_step``
+and the ShardedTrainer compressed step (the known-deferred cleanup from
+the compressed-gradient PR). The sequence is subtle enough to deserve one
+home: frozen layers must zero BOTH the gradients and the resulting
+updates (decoupled weight decay contributes updates even at zero grad),
+the numerics health terms must be computed on the *masked* grads, and a
+skipped (non-finite) step has to keep the old value of every piece of
+carried state — params, optimizer state, layer states, and any extra
+accumulators (the compressed step's error-feedback residual/thresholds)
+— or the poison survives inside an accumulator.
+
+This function is traced INTO the jitted step bodies; it must stay free of
+host-side effects.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.observability import numerics as _num
+
+
+def _mask_frozen(tree, frozen):
+    return {k: (jax.tree.map(jnp.zeros_like, v) if k in frozen else v)
+            for k, v in tree.items()}
+
+
+def finish_train_step(opt, params, opt_state, grads, loss, frozen,
+                      guarded=()):
+    """Apply the optimizer + numerics tail shared by the train steps.
+
+    ``guarded`` is a tuple of ``(new_tree, old_tree)`` pairs — state
+    beyond params/opt_state that a skipped non-finite step must also
+    roll back (layer states; the compressed step's residual and
+    thresholds). Returns ``(new_params, new_opt_state, guarded_news,
+    health)`` where ``guarded_news`` preserves the pair order.
+    """
+    if frozen:
+        grads = _mask_frozen(grads, frozen)
+    updates, new_opt_state = opt.update(grads, opt_state, params)
+    if frozen:
+        # zero the *updates* too: decoupled weight decay (e.g. adamw)
+        # contributes updates even with zero gradients
+        updates = _mask_frozen(updates, frozen)
+    new_params = optax.apply_updates(params, updates)
+    news = [new for new, _ in guarded]
+    # in-graph numerics health — a handful of isfinite/norm reductions
+    # XLA fuses into the backward pass, fetched on the deferred-score
+    # cadence (flag read at trace time; disabled = identical program)
+    health = None
+    if _num.numerics_enabled():
+        health = _num.health_terms(loss, grads, params, updates)
+        if _num.skip_on_nonfinite():
+            ok = jnp.logical_and(health["loss_finite"],
+                                 health["grads_finite"])
+            new_params = _num.select(ok, new_params, params)
+            new_opt_state = _num.select(ok, new_opt_state, opt_state)
+            news = [_num.select(ok, new, old) for new, old in guarded]
+            health["skipped"] = jnp.logical_not(ok)
+    return new_params, new_opt_state, news, health

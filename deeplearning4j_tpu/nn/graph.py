@@ -28,6 +28,7 @@ from deeplearning4j_tpu.observability import train_metrics as _tm
 from deeplearning4j_tpu.observability.flight_recorder import (
     global_flight_recorder as _flight)
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.nn._step_tail import finish_train_step
 from deeplearning4j_tpu.nn.conf import layers as L
 from deeplearning4j_tpu.nn.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.multilayer import _grad_transform
@@ -279,28 +280,10 @@ class ComputationGraph:
         (loss, (new_states, new_carries)), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(
             params, states, inputs, labels, masks, label_masks, rng, carries)
-        if frozen:
-            grads = {k: (jax.tree.map(jnp.zeros_like, g) if k in frozen else g)
-                     for k, g in grads.items()}
-        updates, new_opt_state = self._opt.update(grads, opt_state, params)
-        if frozen:
-            # zero the *updates* too: decoupled weight decay (e.g. adamw)
-            # contributes updates even with zero gradients
-            updates = {k: (jax.tree.map(jnp.zeros_like, u) if k in frozen else u)
-                       for k, u in updates.items()}
-        new_params = optax.apply_updates(params, updates)
-        # in-graph numerics health on the deferred-score cadence (see
-        # MultiLayerNetwork._train_step)
-        health = None
-        if _num.numerics_enabled():
-            health = _num.health_terms(loss, grads, params, updates)
-            if _num.skip_on_nonfinite():
-                ok = jnp.logical_and(health["loss_finite"],
-                                     health["grads_finite"])
-                new_params = _num.select(ok, new_params, params)
-                new_opt_state = _num.select(ok, new_opt_state, opt_state)
-                new_states = _num.select(ok, new_states, states)
-                health["skipped"] = jnp.logical_not(ok)
+        # shared freeze/optimizer/numerics tail (nn/_step_tail.py)
+        new_params, new_opt_state, (new_states,), health = finish_train_step(
+            self._opt, params, opt_state, grads, loss, frozen,
+            guarded=((new_states, states),))
         return new_params, new_opt_state, new_states, loss, new_carries, health
 
     # ------------------------------------------------------------------- fit

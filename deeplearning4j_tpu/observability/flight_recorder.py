@@ -39,6 +39,8 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
   transitions, restores, quarantines)
 - ``elastic.json`` — elastic posture: device-capacity view, mesh
   reshape history, and the sharded-manifest checkpoint stores
+- ``deploy.json`` — versioned serving: deployed versions (lifecycle,
+  warmup, in-flight), rollout stage/share and its SLO verdicts
 - ``perf.json`` — the cost observatory: per-entry-point FLOPs/bytes,
   live MFU vs. its rolling baseline, and roofline verdicts (was the
   process slow BEFORE it died?)
@@ -326,6 +328,10 @@ class FlightRecorder:
         # the elastic layer: capacity view, reshape history, and the
         # manifest stores — a death mid-shrink must name the topology
         section("elastic.json", self._write_elastic)
+        # the serving layer: deployed versions, rollout stage/share and
+        # the SLO verdicts behind them — a death mid-canary must name
+        # which model had the traffic
+        section("deploy.json", self._write_deploy)
         # the PR-6 cost observatory: per-fn cost/MFU/roofline at the
         # moment of death — a postmortem for "it got slow, then it hung"
         section("perf.json", self._write_perf)
@@ -383,6 +389,12 @@ class FlightRecorder:
         from deeplearning4j_tpu.resilience import elastic
         with open(path, "w") as f:
             json.dump(elastic.snapshot(), f, indent=2, default=str)
+
+    @staticmethod
+    def _write_deploy(path: str):
+        from deeplearning4j_tpu import serving
+        with open(path, "w") as f:
+            json.dump(serving.snapshot(), f, indent=2, default=str)
 
     @staticmethod
     def _write_perf(path: str):

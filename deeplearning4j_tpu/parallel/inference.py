@@ -66,7 +66,8 @@ from deeplearning4j_tpu.observability.straggler import StragglerDetector
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       now_us, record_span)
 from deeplearning4j_tpu.resilience import faults as _faults
-from deeplearning4j_tpu.resilience.policy import (CircuitBreaker,
+from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
+                                                  CircuitBreaker,
                                                   CircuitOpenError, Deadline,
                                                   DeadlineExceeded,
                                                   RetryPolicy, ShedError,
@@ -79,11 +80,10 @@ class InferenceMode:
     BATCHED = "BATCHED"
 
 
-#: lifecycle/admission outcomes — typed results a caller routes on, not
-#: device errors; excluded from dl4j_inference_errors_total and from the
-#: circuit breaker's failure accounting
-_TYPED_OUTCOMES = (ShedError, DeadlineExceeded, ShutdownError,
-                   CircuitOpenError)
+#: excluded from dl4j_inference_errors_total and from the circuit
+#: breaker's failure accounting (see policy.TYPED_OUTCOMES — shared with
+#: the serving router so the two error-rate surfaces cannot diverge)
+_TYPED_OUTCOMES = TYPED_OUTCOMES
 
 
 class _ServingMetrics:

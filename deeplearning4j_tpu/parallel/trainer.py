@@ -33,6 +33,7 @@ from deeplearning4j_tpu.observability import global_registry
 from deeplearning4j_tpu.observability import numerics as _num
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability import train_metrics as _tm
+from deeplearning4j_tpu.nn._step_tail import finish_train_step
 from deeplearning4j_tpu.observability.flight_recorder import (
     global_flight_recorder as _flight)
 from deeplearning4j_tpu.parallel import compression as _comp
@@ -439,33 +440,14 @@ class ShardedTrainer:
                 params, states, residual, thresholds, x, y, fmask, lmask,
                 rng)
             grads = _comp.unflatten_buckets(decoded, layout)
-            if frozen:
-                grads = {k: (jax.tree.map(jnp.zeros_like, g)
-                             if k in frozen else g)
-                         for k, g in grads.items()}
-            updates, new_opt_state = net._opt.update(grads, opt_state,
-                                                     params)
-            if frozen:
-                updates = {k: (jax.tree.map(jnp.zeros_like, u)
-                               if k in frozen else u)
-                           for k, u in updates.items()}
-            new_params = optax.apply_updates(params, updates)
-            # in-graph numerics health, mirroring the dense train step; a
+            # shared freeze/optimizer/numerics tail (nn/_step_tail.py); a
             # skipped (non-finite) step must ALSO keep the old residual /
             # threshold — the poison is inside the accumulator otherwise
-            health = None
-            if _num.numerics_enabled():
-                health = _num.health_terms(loss, grads, params, updates)
-                if _num.skip_on_nonfinite():
-                    ok = jnp.logical_and(health["loss_finite"],
-                                         health["grads_finite"])
-                    new_params = _num.select(ok, new_params, params)
-                    new_opt_state = _num.select(ok, new_opt_state,
-                                                opt_state)
-                    new_states = _num.select(ok, new_states, states)
-                    new_res = _num.select(ok, new_res, residual)
-                    new_thr = _num.select(ok, new_thr, thresholds)
-                    health["skipped"] = jnp.logical_not(ok)
+            (new_params, new_opt_state,
+             (new_states, new_res, new_thr), health) = finish_train_step(
+                net._opt, params, opt_state, grads, loss, frozen,
+                guarded=((new_states, states), (new_res, residual),
+                         (new_thr, thresholds)))
             return (new_params, new_opt_state, new_states, loss, new_res,
                     new_thr, stats, health)
 

@@ -14,6 +14,7 @@ Named injection points are threaded through the hot paths:
 ``data.next_batch``         DataSetIterator ``__next__`` (all iterators)
 ``inference.dispatch``      ParallelInference dispatcher, before the forward
 ``inference.device_execute``ParallelInference completer / sync serve loop
+``serving.canary``          ServingRouter, on the canary version's path only
 ``train.step``              MLN/CG ``_fit_batch`` before the jitted step
 ``checkpoint.save``         CheckpointListener / preemption / recovery saves
 ``checkpoint.restore``      ResilientTrainer checkpoint restore
@@ -69,8 +70,8 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 POINTS = ("data.next_batch", "inference.dispatch", "inference.device_execute",
-          "train.step", "checkpoint.save", "checkpoint.restore",
-          "checkpoint.manifest", "allreduce")
+          "serving.canary", "train.step", "checkpoint.save",
+          "checkpoint.restore", "checkpoint.manifest", "allreduce")
 KINDS = ("error", "crash", "latency", "nan", "host_loss")
 # nan corrupts a batch, so it only fires at points that own an array —
 # accepting it elsewhere would validate a chaos spec that never injects

@@ -79,6 +79,15 @@ class RestartBudgetExhausted(ResilienceError):
     pass
 
 
+#: lifecycle/admission outcomes — typed results a caller routes on, not
+#: device errors. THE canonical tuple: ParallelInference and the serving
+#: router both exclude exactly these from their error counters (and from
+#: breaker failure accounting); a new typed outcome added here reaches
+#: every accounting site at once.
+TYPED_OUTCOMES = (ShedError, DeadlineExceeded, ShutdownError,
+                  CircuitOpenError)
+
+
 def is_transient(exc: BaseException) -> bool:
     """Retry-safe failures: anything carrying ``transient=True`` —
     :class:`TransientError` subclasses and transient

@@ -1,0 +1,54 @@
+"""Zero-downtime serving: versioned deploys, canary rollout, drain.
+
+Production model serving is not ``ParallelInference`` alone — it is the
+lifecycle around it: a new version must be **warmed before it sees
+traffic** (whole-program XLA compiles on the first request are exactly
+the cold-start the AOT-everything posture of Fishman et al.
+arXiv:1810.09868 exists to kill), promoted **gradually** under measured
+SLOs, and **rolled back automatically** when it grades worse than the
+incumbent — with in-flight requests drained, never dropped. The DL4J
+heritage here is the model-zoo/serving layer (PAPER.md); the SLO gating
+reuses the PR-3 rule engine and the PR-5 typed-failure machinery.
+
+Three modules:
+
+- :mod:`~deeplearning4j_tpu.serving.registry` — :class:`ModelRegistry`:
+  ``deploy(version, net)`` builds a ``ParallelInference`` per version and
+  AOT-warms every shape-bucket executable before the version is eligible
+  for traffic (persistent compile cache under ``DL4J_TPU_COMPILE_CACHE``
+  makes re-deploys and restarts skip compilation entirely);
+  ``retire(version)`` goes through graceful drain.
+- :mod:`~deeplearning4j_tpu.serving.rollout` — :class:`CanaryRollout`:
+  the shadow → canary → ramp → full / rolled-back state machine, graded
+  by per-version SLO rules (latency-quantile ratio, error rate, shadow
+  divergence) evaluated through a PR-3 :class:`SLOEngine`.
+- :mod:`~deeplearning4j_tpu.serving.router` — :class:`ServingRouter`:
+  the ``output()`` front-end that splits traffic deterministically by
+  request hash, records ``dl4j_serving_version_*`` metrics, fires the
+  ``serving.canary`` chaos point on the canary path, and under
+  ``DL4J_TPU_ROLLOUT=0`` degrades to a byte-identical single-version
+  passthrough.
+
+Surfaces: ``UIServer GET /debug/deploy`` and ``deploy.json`` in
+flight-recorder bundles both serve :func:`snapshot`.
+"""
+from deeplearning4j_tpu.serving.registry import DeployedVersion, ModelRegistry
+from deeplearning4j_tpu.serving.rollout import (CanaryRollout, RolloutPolicy,
+                                                RolloutState)
+from deeplearning4j_tpu.serving.router import ServingRouter, rollout_enabled
+
+__all__ = [
+    "ModelRegistry", "DeployedVersion", "CanaryRollout", "RolloutPolicy",
+    "RolloutState", "ServingRouter", "rollout_enabled", "snapshot",
+]
+
+
+def snapshot() -> dict:
+    """The ``/debug/deploy`` + bundle ``deploy.json`` payload: every live
+    registry's versions (state, warmup, traffic) and every live router's
+    rollout state machine."""
+    return {
+        "rollout_enabled": rollout_enabled(),
+        "registries": [r.snapshot() for r in list(ModelRegistry._live)],
+        "routers": [r.snapshot() for r in list(ServingRouter._live)],
+    }

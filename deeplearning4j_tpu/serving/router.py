@@ -1,0 +1,225 @@
+"""ServingRouter: the versioned ``output()`` front-end.
+
+Composes the registry's versions with the PR-5 policies that already
+live inside each ``ParallelInference`` (per-version deadlines, bounded
+queues/shedding, a per-version circuit breaker) and adds the rollout
+split on top:
+
+- traffic is split **deterministically by request hash** — the same
+  request (or explicit ``request_key``) always lands on the same
+  version, so a client retry during a rollout cannot flap between
+  models;
+- the candidate path fires the ``serving.canary`` chaos point, so a
+  rollout can be rehearsed under injected latency/error faults and the
+  SLO gate proven to roll back;
+- every routed request lands in the ``dl4j_serving_version_*`` series
+  the rollout grader reads.
+
+Kill switch ``DL4J_TPU_ROLLOUT=0`` (resolved at construction, like the
+other hot-path switches): ``output()`` is a byte-identical passthrough
+to the primary version's ``ParallelInference.output`` — no hashing, no
+extra series, no fault point — and ``begin_rollout`` refuses.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
+                                                  ShutdownError)
+from deeplearning4j_tpu.serving.metrics import serving_metrics
+from deeplearning4j_tpu.serving.rollout import (CanaryRollout, RolloutPolicy,
+                                                RolloutState)
+
+#: excluded from the per-version error counters — THE shared tuple from
+#: resilience.policy, so this surface cannot diverge from
+#: dl4j_inference_errors_total (typed outcomes are routing results, not
+#: model failures; InjectedFault and real device errors DO count)
+_TYPED_OUTCOMES = TYPED_OUTCOMES
+
+
+def rollout_enabled() -> bool:
+    """``DL4J_TPU_ROLLOUT`` kill switch (``0`` = single-version
+    passthrough, byte-identical to direct ``ParallelInference`` use)."""
+    return os.environ.get("DL4J_TPU_ROLLOUT", "1") != "0"
+
+
+def request_fraction(x, request_key=None) -> float:
+    """Deterministic [0, 1) routing coordinate for one request: the hash
+    of ``request_key`` when given, else of the request payload (a bounded
+    prefix of the bytes + shape/dtype — enough that distinct requests
+    spread uniformly while the same request always routes the same
+    way)."""
+    if request_key is not None:
+        data = repr(request_key).encode()
+    else:
+        arr = np.asarray(x)
+        data = (arr.tobytes()[:4096] + str(arr.shape).encode()
+                + str(arr.dtype).encode())
+    digest = hashlib.blake2b(data, digest_size=8).digest()
+    return int.from_bytes(digest, "big") / 2.0 ** 64
+
+
+class ServingRouter:
+    """Routes ``output()`` across a registry's versions; owns at most
+    one active :class:`CanaryRollout` at a time."""
+
+    _live: "weakref.WeakSet[ServingRouter]" = weakref.WeakSet()
+
+    def __init__(self, registry, primary: str):
+        self._registry = registry
+        self._primary = registry.get(primary)
+        self._enabled = rollout_enabled()
+        self._rollout: Optional[CanaryRollout] = None
+        self._lock = threading.Lock()
+        ServingRouter._live.add(self)
+        if self._enabled:
+            serving_metrics().traffic(self._primary.version).set(1.0)
+
+    @property
+    def primary(self):
+        return self._primary
+
+    @property
+    def rollout(self) -> Optional[CanaryRollout]:
+        return self._rollout
+
+    # ------------------------------------------------------------ rollout
+    def begin_rollout(self, candidate: str,
+                      policy: Optional[RolloutPolicy] = None) -> CanaryRollout:
+        """Start canarying ``candidate`` against the current primary."""
+        if not self._enabled:
+            raise RuntimeError(
+                "rollouts are disabled (DL4J_TPU_ROLLOUT=0): deploy/retire "
+                "still work, but traffic stays on the primary version")
+        with self._lock:
+            if self._rollout is not None and self._rollout.active:
+                raise RuntimeError(
+                    f"a rollout of {self._rollout.candidate.version!r} is "
+                    "already active")
+            cand = self._registry.get(candidate)
+            if cand is self._primary:
+                raise ValueError("candidate is already the primary")
+            if not cand.admitting:
+                raise RuntimeError(
+                    f"candidate {candidate!r} is not live "
+                    f"(state={cand.state})")
+            self._rollout = CanaryRollout(self, self._registry,
+                                          self._primary, cand,
+                                          policy or RolloutPolicy())
+            return self._rollout
+
+    def _promote(self, rollout: CanaryRollout):
+        """Rollout hit FULL: the candidate becomes primary and the old
+        incumbent drains gracefully (in-flight requests complete)."""
+        old, self._primary = self._primary, rollout.candidate
+        old.drain(timeout_s=rollout.policy.drain_timeout_s)
+
+    # ------------------------------------------------------------- output
+    def output(self, x, deadline_ms: Optional[float] = None,
+               request_key=None) -> np.ndarray:
+        if not self._enabled:
+            # kill switch: byte-identical single-version passthrough
+            return self._primary.pi.output(x, deadline_ms=deadline_ms)
+        rollout = self._rollout
+        if rollout is None or not rollout.active:
+            return self._serve(self._primary, x, deadline_ms)
+        frac = request_fraction(x, request_key)
+        candidate = rollout.candidate
+        if (rollout.share > 0.0 and frac < rollout.share
+                and candidate.admitting):
+            try:
+                return self._serve(candidate, x, deadline_ms, canary=True)
+            finally:
+                rollout.record_candidate_event()
+        out = self._serve(self._primary, x, deadline_ms)
+        if (rollout.stage == RolloutState.SHADOW and candidate.admitting
+                and frac < rollout.policy.shadow_fraction):
+            try:
+                self._shadow_score(rollout, x, out)
+            finally:
+                rollout.record_candidate_event()
+        return out
+
+    @staticmethod
+    def _account(dv, t0: float, error: Optional[BaseException] = None):
+        """One routed request's per-version accounting (success and
+        every error path share it): latency + requests always, errors
+        only for non-typed failures."""
+        obs = serving_metrics()
+        obs.latency(dv.version).observe(time.perf_counter() - t0)
+        obs.requests(dv.version).inc()
+        if error is not None and not isinstance(error, _TYPED_OUTCOMES):
+            obs.errors(dv.version).inc()
+
+    def _serve(self, dv, x, deadline_ms, canary: bool = False) -> np.ndarray:
+        # capture the pipeline BEFORE tracking: a concurrent drain nulls
+        # dv.pi after its in-flight wait — a request racing that window
+        # must land on the (shut down) instance and resolve typed, not
+        # explode on None
+        pi = dv.pi
+        if not dv.admitting or pi is None:
+            raise ShutdownError(
+                f"version {dv.version!r} is not admitting "
+                f"(state={dv.state})")
+        t0 = time.perf_counter()
+        try:
+            with dv.track():
+                if canary and _faults.armed():
+                    # the canary chaos point: latency faults stretch the
+                    # measured canary latency, error faults feed its
+                    # error rate — exactly what the SLO gate grades
+                    _faults.check("serving.canary")
+                out = pi.output(x, deadline_ms=deadline_ms)
+        except Exception as e:
+            self._account(dv, t0, error=e)
+            raise
+        self._account(dv, t0)
+        return out
+
+    def _shadow_score(self, rollout: CanaryRollout, x, incumbent_out):
+        """Score the same request on the candidate and compare outputs.
+        Shadow work must never affect the user's response: errors are
+        absorbed into the candidate's series, not raised."""
+        dv = rollout.candidate
+        obs = serving_metrics()
+        pi = dv.pi
+        if pi is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            with dv.track():
+                if _faults.armed():
+                    _faults.check("serving.canary")
+                out = pi.output(x)
+        except Exception as e:
+            self._account(dv, t0, error=e)
+            obs.shadow(dv.version, "error").inc()
+            return
+        self._account(dv, t0)
+        policy = rollout.policy
+        try:
+            match = bool(np.allclose(np.asarray(out),
+                                     np.asarray(incumbent_out),
+                                     rtol=policy.divergence_rtol,
+                                     atol=policy.divergence_atol))
+        except Exception:         # shape mismatch IS a divergence
+            match = False
+        obs.shadow(dv.version, "match" if match else "diverged").inc()
+
+    # ------------------------------------------------------------ queries
+    def snapshot(self) -> dict:
+        rollout = self._rollout
+        return {
+            "enabled": self._enabled,
+            "primary": self._primary.version,
+            "primary_state": self._primary.state,
+            "rollout": rollout.snapshot() if rollout is not None else None,
+        }

@@ -14,7 +14,9 @@ trace of the jitted entry points + the retrace-storm grade),
 ``/debug/resilience`` (fault-injection counts, circuit-breaker states,
 and the retry/shed/restore/quarantine event ring), ``/debug/elastic``
 (device-capacity view, mesh shrink/expand history, and the sharded
-elastic checkpoint manifests), ``/debug/perf`` (the
+elastic checkpoint manifests), ``/debug/deploy`` (versioned serving:
+deployed versions, rollout stage/share, SLO verdicts, drain states),
+``/debug/perf`` (the
 cost observatory: per-entry-point FLOPs/bytes, live MFU, roofline
 verdicts), ``/debug/profile`` (on-demand device profiling: ``?steps=N``
 captures N work units and serves the parsed top-K per-op table).
@@ -640,6 +642,16 @@ class UIServer:
                     # analog of /debug/compiles for failure handling
                     from deeplearning4j_tpu import resilience
                     body = json.dumps(resilience.snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/deploy":
+                    # versioned serving state: every registry's versions
+                    # (lifecycle, warmup record, in-flight counts) and
+                    # every router's rollout state machine (stage, share,
+                    # last SLO report, transition history) — the first
+                    # stop for "which model is taking traffic and why"
+                    from deeplearning4j_tpu import serving
+                    body = json.dumps(serving.snapshot(),
                                       default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/debug/elastic":

@@ -12,16 +12,17 @@ import time
 import numpy as np
 import pytest
 
-tf = pytest.importorskip("tensorflow")
-transformers = pytest.importorskip("transformers")
-
-from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
+# tensorflow/transformers are imported INSIDE the fixture: both tests are
+# @slow, and a `-m 'not slow'` tier-1 run must not pay ~25s of heavy
+# imports at collection time for two deselected tests
 
 BATCH, SEQ = 2, 128
 
 
 @pytest.fixture(scope="module")
 def bert_base_frozen():
+    tf = pytest.importorskip("tensorflow")
+    pytest.importorskip("transformers")
     from transformers import BertConfig, TFBertModel
     from tensorflow.python.framework.convert_to_constants import (
         convert_variables_to_constants_v2)
@@ -54,6 +55,9 @@ def bert_base_frozen():
 
 @pytest.mark.slow
 def test_bert_base_imports_with_parity(bert_base_frozen):
+    import tensorflow as tf
+
+    from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
     f, gd = bert_base_frozen
     t0 = time.perf_counter()
     sd = TFGraphMapper.import_graph(gd)
@@ -84,6 +88,7 @@ def test_bert_base_imports_with_parity(bert_base_frozen):
 @pytest.mark.slow
 def test_bert_base_fine_tunes_three_steps(bert_base_frozen):
     from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.modelimport.tfimport import TFGraphMapper
     from tests.bert_helpers import (attach_classifier_head,
                                     promote_weight_constants)
 

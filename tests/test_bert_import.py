@@ -41,6 +41,9 @@ def bert_frozen():
     return f, frozen.graph.as_graph_def()
 
 
+@pytest.mark.slow
+
+
 def test_bert_imports_with_numerical_parity(bert_frozen):
     f, gd = bert_frozen
     sd = TFGraphMapper.import_graph(gd)

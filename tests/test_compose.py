@@ -2,11 +2,15 @@
 dp/tp/sp mesh x scan-over-layers x remat x bf16 on the flagship, and
 DP+TP x ZeRO x bf16 x remat on the layer API. Catches pairwise
 integration breaks that per-feature tests cannot."""
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.parallel import MeshSpec
+
+
+@pytest.mark.slow
 
 
 def test_flagship_all_features_compose():
@@ -33,6 +37,9 @@ def test_flagship_all_features_compose():
     assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(params))
 
 
+@pytest.mark.slow
+
+
 def test_layer_api_all_features_compose():
     from deeplearning4j_tpu.models import zoo
     from deeplearning4j_tpu.optim.updaters import Adam
@@ -56,6 +63,8 @@ def test_layer_api_all_features_compose():
 class TestFusedQKV:
     """fused_qkv: one (d, 3d) projection — must be numerically identical to
     the three-matmul form on the same weights."""
+
+    @pytest.mark.slow
 
     def test_parity_with_unfused(self):
         import jax
@@ -82,6 +91,8 @@ class TestFusedQKV:
         np.testing.assert_allclose(np.asarray(mf.apply(pf, toks)),
                                    np.asarray(mu.apply(pu, toks)),
                                    rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.slow
 
     def test_fused_trains(self):
         import jax
@@ -133,6 +144,8 @@ class TestChunkedCE:
         lc = float(mc.loss_fn(p, toks, tgts))
         lu = float(mu.loss_fn(p, toks, tgts))
         assert abs(lc - lu) < 1e-5, (lc, lu)
+
+    @pytest.mark.slow
 
     def test_gradient_parity(self):
         import jax

@@ -11,6 +11,7 @@ import gzip
 import struct
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.data import (Cifar10DataSetIterator,
                                      EmnistDataSetIterator,
@@ -48,6 +49,8 @@ class TestCifar10:
         np.testing.assert_allclose(it._ds.features[0], expect, atol=1e-6)
         assert int(np.argmax(it._ds.labels[0])) == int(lab0)
 
+    @pytest.mark.slow
+
     def test_synthetic_is_learnable(self, tmp_path):
         from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
         from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -75,6 +78,7 @@ class TestCifar10:
 
 
 class TestEmnist:
+    @pytest.mark.slow
     def test_variant_class_counts(self, tmp_path):
         for which, n in [("digits", 10), ("letters", 26), ("balanced", 47),
                          ("byclass", 62)]:

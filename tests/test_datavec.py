@@ -199,6 +199,9 @@ def test_image_pipeline(tmp_path):
     assert pipe.transform(img).shape == img.shape
 
 
+@pytest.mark.slow
+
+
 def test_train_from_csv_end_to_end(tmp_path):
     """The canonical DataVec→DL4J flow: CSV → TransformProcess →
     RecordReaderDataSetIterator → MultiLayerNetwork.fit."""

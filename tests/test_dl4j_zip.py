@@ -621,7 +621,16 @@ def _zoo_names():
     return [n for n in Z.__all__ if n not in ("ZooModel", "PretrainedType")]
 
 
-@pytest.mark.parametrize("name", _zoo_names())
+# tier-1 keeps three cheap representatives (one sequential CNN, one
+# fire-module graph, one detection head); the full-zoo sweep (~210s on
+# the CI box) runs under -m slow
+_ZOO_FAST = {"SimpleCNN", "SqueezeNet", "TinyYOLO"}
+
+
+@pytest.mark.parametrize(
+    "name", [n if n in _ZOO_FAST
+             else pytest.param(n, marks=pytest.mark.slow)
+             for n in _zoo_names()])
 def test_zoo_architecture_roundtrips_reference_zip(name, tmp_path):
     """VERDICT r4 #5: EVERY zoo architecture's config + params survive the
     reference-style DL4J zip (Jackson JSON + Nd4j.write flat vector) with

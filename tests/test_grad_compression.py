@@ -170,6 +170,8 @@ class TestCompressedTrainer:
             runs[tag] = _params_bytes(net)
         assert runs["dense"] == runs["killed"]
 
+    @pytest.mark.slow
+
     def test_compressed_sgd_matches_dense_within_tolerance(self):
         """EF threshold compression with a plain-SGD updater is the
         theoretically exact-family combo (Karimireddy et al. EF-signSGD):
@@ -319,6 +321,8 @@ class TestCompressedTrainer:
         np.testing.assert_allclose(
             np.asarray(nets["plain"].params().buf()),
             np.asarray(nets["zero"].params().buf()), rtol=2e-5, atol=1e-6)
+
+    @pytest.mark.slow
 
     def test_computation_graph_compressed_trains(self):
         from deeplearning4j_tpu.nn.conf import layers as L
@@ -557,6 +561,8 @@ class TestCompressionCheckpointing:
             assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
         for a, b in zip(st0["threshold"], st1["threshold"]):
             assert float(a) == float(b)
+
+    @pytest.mark.slow
 
     def test_resilient_restore_resumes_byte_equal(self, tmp_path):
         """The headline first-class-state contract: a compressed training

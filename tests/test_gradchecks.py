@@ -223,7 +223,22 @@ def _check(layer, x, opts):
     assert grad_check(fn, tree, subset=8, max_rel_error=2e-3)
 
 
-@pytest.mark.parametrize("name", sorted(SPECS))
+# recurrent/attention/capsule checks cost 3-56s EACH in f64 central-FD
+# on the CI box (~300s of the module's 360s); tier-1 keeps the cheap
+# layers and the full sweep runs under -m slow. The coverage gate below
+# counts SPECS, so the no-unchecked-layer guarantee is unaffected.
+_GRADCHECK_SLOW = {
+    "Bidirectional", "RecurrentAttentionLayer", "MaskedLSTM", "GravesLSTM",
+    "MaskZeroLayer", "GRU", "ConvLSTM2DSeq", "ConvLSTM2D", "LSTM",
+    "LastTimeStep", "CrossAttentionBias", "Convolution3D",
+    "PrimaryCapsules", "LearnedSelfAttentionLayer", "CapsuleLayer",
+    "LocallyConnected1D", "LocallyConnected2D", "SimpleRnn",
+}
+
+
+@pytest.mark.parametrize(
+    "name", [pytest.param(n, marks=pytest.mark.slow)
+             if n in _GRADCHECK_SLOW else n for n in sorted(SPECS)])
 def test_layer_gradcheck(name):
     factory, x, opts = SPECS[name]
     _check(factory(), x, opts)
@@ -251,6 +266,7 @@ def test_center_loss_gradcheck():
                       subset=10, max_rel_error=2e-3)
 
 
+@pytest.mark.slow
 def test_yolo2_loss_gradcheck():
     """Yolo2 is a loss head: check d(loss)/d(activations)."""
     boxes = [(1.0, 1.5), (2.0, 1.0)]
@@ -288,6 +304,9 @@ def test_cnn_loss_layer_gradcheck():
                       max_rel_error=2e-3)
 
 
+@pytest.mark.slow
+
+
 def test_vae_pretrain_loss_gradcheck():
     """VAE negative-ELBO gradcheck over ALL params (encoder, posterior,
     decoder, reconstruction head) with a fixed reparameterisation rng."""
@@ -302,6 +321,9 @@ def test_vae_pretrain_loss_gradcheck():
 
     assert grad_check(lambda p: vae.pretrain_loss(p, x, rng), params,
                       subset=6, max_rel_error=2e-3)
+
+
+@pytest.mark.slow
 
 
 def test_vae_bernoulli_pretrain_loss_gradcheck():

@@ -17,6 +17,9 @@ def _save(model, tmp_path, name="m.h5"):
     return p
 
 
+@pytest.mark.slow
+
+
 def test_sequential_dense(tmp_path):
     m = tf.keras.Sequential([
         tf.keras.Input((6,)),
@@ -79,6 +82,9 @@ def test_locally_connected_impl2_dense_kernel_extraction():
                                np.asarray(pb["0"]["W"]), atol=0)
 
 
+@pytest.mark.slow
+
+
 def test_sequential_cnn_with_bn(tmp_path):
     m = tf.keras.Sequential([
         tf.keras.Input((12, 12, 3)),
@@ -118,6 +124,9 @@ def test_sequential_separable_conv(tmp_path):
                        atol=1e-5)
 
 
+@pytest.mark.slow
+
+
 def test_sequential_lstm(tmp_path):
     m = tf.keras.Sequential([
         tf.keras.Input((7, 5)),
@@ -131,6 +140,9 @@ def test_sequential_lstm(tmp_path):
     expected = m.predict(x, verbose=0)
     got = np.asarray(net.output(x))
     assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+@pytest.mark.slow
 
 
 def test_sequential_gru(tmp_path):
@@ -272,6 +284,9 @@ def test_sequential_timedistributed_dense(tmp_path):
     expected = m.predict(x, verbose=0)
     got = np.asarray(net.output(x))
     assert np.allclose(got, expected, atol=1e-5)
+
+
+@pytest.mark.slow
 
 
 def test_sequential_bidirectional_lstm(tmp_path):
@@ -578,6 +593,8 @@ class TestLongTailLayers:
         assert got.shape == (2, 8, 4)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5)
+
+    @pytest.mark.slow
 
     def test_conv_lstm_2d(self, tmp_path):
         for ret_seq in (False, True):

@@ -144,6 +144,9 @@ class TestNativeHostOps:
         assert sorted(a.tolist()) == list(range(100))
 
 
+@pytest.mark.slow
+
+
 def test_transformer_flash_path_matches_plain():
     """Forcing the flash backend must not change TransformerLM outputs
     (the cuDNN-crosscheck analog at model level)."""

@@ -1,6 +1,7 @@
 """Mixed-precision policy (ref: NeuralNetConfiguration.Builder#dataType /
 DataType.HALF; BASELINE.md protocol "bf16 + f32 accum"): hidden compute in
 bfloat16, f32 master params / loss / running stats / carries."""
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -68,6 +69,8 @@ class TestMLNMixedPrecision:
         # same trajectory to low precision: scores within 10% relative
         assert abs(nets["bfloat16"] - nets["float32"]) \
             < 0.1 * abs(nets["float32"]) + 0.05
+
+    @pytest.mark.slow
 
     def test_bf16_tbptt_lstm(self):
         conf = (NeuralNetConfiguration.builder()

@@ -11,6 +11,9 @@ import pytest
 from deeplearning4j_tpu.parallel import MeshSpec, ShardedTrainer
 
 
+@pytest.mark.slow
+
+
 def test_resnet50_dp_step_matches_single_device():
     """Zoo ResNet-50 (CG config): a dp=8 sharded train step equals the
     single-device step up to f32 reduction-order noise.

@@ -296,6 +296,9 @@ class _FakeProfiler:
             f.write("fake")
 
 
+@pytest.mark.slow
+
+
 def test_profile_capture_retention_cap(tmp_path, monkeypatch):
     """Trace dirs beyond DL4J_TPU_POSTMORTEM_KEEP are evicted
     oldest-first, while the parsed ring keeps every record."""
@@ -337,6 +340,10 @@ def test_debug_profile_http_roundtrip(tmp_path, monkeypatch):
     from deeplearning4j_tpu.profiler import xprof
     from deeplearning4j_tpu.ui import UIServer
 
+    # pre-pay the xplane-proto (tensorflow) import OUTSIDE the HTTP
+    # request: on this box it costs ~20s cold, and paying it inside the
+    # capture handler blows the client's socket timeout
+    pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
     monkeypatch.setattr(xprof, "DeviceProfiler", _FakeProfiler)
     monkeypatch.setenv("DL4J_TPU_POSTMORTEM_DIR", str(tmp_path))
     reset_global_registry()

@@ -350,6 +350,9 @@ def test_parallel_transform_executor_matches_local():
     assert dist == local and len(dist) == 37
 
 
+@pytest.mark.slow
+
+
 def test_device_profiler_produces_trace(tmp_path):
     """jax-profiler bridge (SURVEY 5.1 'jax profiler → XProf'): tracing a
     jitted step writes an XPlane trace TensorBoard can open."""

@@ -167,6 +167,8 @@ class TestLossTail:
         np.testing.assert_allclose(np.asarray(lp), [np.exp(0.5) - 2 * 0.5],
                                    rtol=1e-6)
 
+    @pytest.mark.slow
+
     def test_ctc_loss_grad_matches_autodiff(self):
         B, T, C, S = 2, 5, 4, 2
         logp = jax.nn.log_softmax(

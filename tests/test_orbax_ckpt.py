@@ -71,6 +71,7 @@ class TestShardedCheckpointer:
 
 
 class TestTrainerIntegration:
+    @pytest.mark.slow
     def test_listener_checkpoints_and_resume(self, tmp_path):
         from deeplearning4j_tpu.models import zoo
 

@@ -127,6 +127,8 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(ring), np.asarray(plain),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
+
     def test_grad_flows(self):
         mesh = MeshSpec.dp_tp_sp(1, 1, 8).build()
         rng = np.random.default_rng(0)
@@ -147,6 +149,8 @@ class TestGraftEntry:
         fn, args = ge.entry()
         out = jax.jit(fn)(*args)
         assert out.shape == (2, 64, 256)
+
+    @pytest.mark.slow
 
     def test_dryrun_multichip_8(self):
         import __graft_entry__ as ge
@@ -445,6 +449,8 @@ class TestPipelineInFlagship:
         np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
+
     def test_pipelined_training_loss_decreases(self):
         import jax
         import jax.numpy as jnp
@@ -464,6 +470,8 @@ class TestPipelineInFlagship:
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.7, losses
 
+    @pytest.mark.slow
+
     def test_pp_times_dp_composition_trains(self):
         import jax
         import jax.numpy as jnp
@@ -479,6 +487,8 @@ class TestPipelineInFlagship:
         tgts = jnp.roll(toks, -1, axis=1)
         l0 = float(step(params, opt_state, toks, tgts)[2])
         assert np.isfinite(l0)
+
+    @pytest.mark.slow
 
     def test_pp_dp_grads_match_single_device(self):
         """PP×DP gradient CORRECTNESS: the sharded pipeline's grads equal a
@@ -549,6 +559,8 @@ class TestMoEInFlagship:
         assert params["blocks"][0]["moe"]["W1"].shape == (4, 32, 128)
         assert "mlp" not in params["blocks"][0]
 
+    @pytest.mark.slow
+
     def test_aux_loss_in_metrics_and_loss_decreases(self):
         import jax
         import jax.numpy as jnp
@@ -610,6 +622,8 @@ class TestMoEInFlagship:
         params = init_moe_params(cfg, jax.random.key(1))
         _, stats = moe_ffn(params, x, cfg)
         assert abs(float(jnp.sum(stats["expert_fraction"])) - 1.0) < 1e-5
+
+    @pytest.mark.slow
 
     def test_ep_sharded_loss_matches_unsharded(self):
         import jax
@@ -769,6 +783,8 @@ class Test1F1B:
         tgt = jnp.asarray(rng.normal(size=(M, mb, d)), jnp.float32)
         return mesh, stacked, stage_fn, loss_fn, x, tgt, S
 
+    @pytest.mark.slow
+
     def test_1f1b_matches_straight_through_gradients(self):
         import jax
 
@@ -794,6 +810,8 @@ class Test1F1B:
                                        np.asarray(rg[k]),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
+
     def test_1f1b_matches_gpipe_gradients(self):
         """Same gradients as differentiating the GPipe schedule — two
         independent pipelined formulations agreeing."""
@@ -816,6 +834,8 @@ class Test1F1B:
             np.testing.assert_allclose(np.asarray(grads_1f1b[k]),
                                        np.asarray(grads_gp[k]),
                                        rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.slow
 
     def test_1f1b_temp_memory_below_gpipe(self):
         """XLA's own memory accounting (the r4 bubble-sweep protocol):
@@ -850,6 +870,9 @@ class Test1F1B:
         t1, t2 = temp_bytes(c1), temp_bytes(c2)
         assert t1 < t2, (f"1F1B temp {t1} must undercut GPipe-autodiff "
                          f"temp {t2}")
+
+
+@pytest.mark.slow
 
 
 def test_flagship_1f1b_schedule_matches_gpipe():

@@ -4,6 +4,7 @@ gradients with and without remat; only the backward-pass memory changes."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -22,6 +23,9 @@ def _conf(remat):
     if remat:
         b.gradient_checkpointing()
     return b.build()
+
+
+@pytest.mark.slow
 
 
 def test_remat_matches_plain_training():
@@ -93,6 +97,9 @@ def test_remat_policy_json_roundtrip_and_validation():
         checkpoint_policy("bogus")
 
 
+@pytest.mark.slow
+
+
 def test_transformer_scan_remat_dots_matches():
     """The scan_layers OOM-fix combo (scan + remat + dots policy) is
     numerically identical to the plain loop — only backward memory
@@ -118,6 +125,9 @@ def test_transformer_scan_remat_dots_matches():
     np.testing.assert_allclose(
         np.asarray(outs["loop"][1]["tok_emb"]),
         np.asarray(outs["scan_dots"][1]["tok_emb"]), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
 
 
 def test_transformer_remat_matches():
@@ -191,6 +201,9 @@ def test_scan_layers_matches_loop():
     np.testing.assert_allclose(
         np.asarray(outs[False][1]["tok_emb"]),
         np.asarray(outs[True][1]["tok_emb"]), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
 
 
 def test_scan_layers_sharded_step():

@@ -32,6 +32,9 @@ def test_replay_buffer():
     assert obs.shape == (4, 3) and rew.min() >= 15   # only recent kept
 
 
+@pytest.mark.slow
+
+
 def test_dqn_gridworld_learns():
     conf = QLearningConfiguration(seed=0, max_step=3000, batch_size=32,
                                   update_start=50, target_dqn_update_freq=100,
@@ -43,6 +46,9 @@ def test_dqn_gridworld_learns():
     # greedy policy should walk straight right: 7 steps, reward 1 - 6*0.01
     reward = policy.play(GridWorld(8), max_steps=20)
     assert reward > 0.9
+
+
+@pytest.mark.slow
 
 
 def test_dqn_cartpole_improves():
@@ -67,6 +73,9 @@ def test_dueling_double_dqn_builds():
     learner.train()
     q = learner.q_values(GridWorld(5).reset())
     assert q.shape == (2,)
+
+
+@pytest.mark.slow
 
 
 def test_a2c_gridworld_learns():
@@ -98,6 +107,9 @@ def test_parameter_spaces():
     d = DiscreteParameterSpace("relu", "tanh")
     assert d.value_for(0.1) == "relu" and d.value_for(0.9) == "tanh"
     assert d.grid_values(5) == ["relu", "tanh"]
+
+
+@pytest.mark.slow
 
 
 def test_random_search_finds_good_lr():
@@ -175,6 +187,9 @@ def test_grid_search_enumerates():
     assert best.score > 0.8
 
 
+@pytest.mark.slow
+
+
 def test_a3c_async_workers_learn_gridworld():
     """True async A3C (ref: A3CDiscreteDense + AsyncGlobal/AsyncThread):
     multiple worker threads against private MDPs, shared params updated
@@ -191,6 +206,9 @@ def test_a3c_async_workers_learn_gridworld():
     # a random walk on the corridor pays -0.01 per step; the learned
     # policy walks straight to the +1 goal
     assert final > 0.0, final
+
+
+@pytest.mark.slow
 
 
 def test_async_nstep_qlearning_learns_gridworld():

@@ -504,6 +504,9 @@ def test_bert_mini_imports_with_numerical_parity():
     _run_case(_bert_fn(_bert_weights()), {"ids": ids}, atol=2e-4)
 
 
+@pytest.mark.slow
+
+
 def test_bert_mini_finetunes_through_fit():
     """BASELINE north star: TF-import BERT fine-tune path. Import, convert
     weight constants to trainables, attach a loss head, sd.fit."""

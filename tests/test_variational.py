@@ -5,6 +5,7 @@ Ref: ``org.deeplearning4j.nn.layers.variational.VariationalAutoencoder``
 deeplearning4j-core tests; plus Convolution1DLayer / Convolution3D /
 CnnLossLayer network integration (SURVEY D3).
 """
+import pytest
 import numpy as np
 
 import jax
@@ -45,6 +46,8 @@ class TestVAE:
         assert names == ["e0W", "e0b", "e1W", "e1b",
                          "pZXMeanW", "pZXMeanb", "pZXLogStd2W", "pZXLogStd2b",
                          "d0W", "d0b", "pXZW", "pXZb"]
+
+    @pytest.mark.slow
 
     def test_pretrain_elbo_decreases(self):
         net = MultiLayerNetwork(_vae_net()).init()
