@@ -1,0 +1,366 @@
+"""DecodeEngine: jitted KV-cache generation entry points + sampling.
+
+The model-layer half of the generative decode path (the serving half —
+continuous batching — is ``parallel/generation.py``). Wraps one
+:class:`~deeplearning4j_tpu.models.transformer.TransformerLM` and its
+params with exactly three jitted executables:
+
+- **prefill** — the causal trunk over a (1|B, T_bucket) prompt, returning
+  the sampled first token, the full logits, and the per-layer k/v the
+  forward computed. Prompt lengths pad to a small set of fixed buckets
+  (powers of two), so the executable set is bounded like the serving
+  batch buckets (PR 2).
+- **decode_step** — one token for a whole slot batch: single-query
+  attention against the preallocated cache, position-indexed
+  ``dynamic_update_slice`` writes, in-graph sampling. The cache is
+  donated, so steady-state decode allocates nothing and — the contract
+  the tests pin via ``compile_watch`` — triggers **zero** new XLA traces.
+- **insert_slot** — copy a prefill's k/v into one slot's cache pages
+  (traced slot index: one executable per prefill bucket, not per slot).
+
+Sampling is in-graph and seeded: greedy argmax or top-k/temperature
+(``SamplerConfig``), with the step counter folded into the engine's base
+key so a run is reproducible from its seed.
+
+Attention backends: prefill routes through the model's normal policy
+(flash kernel eligible — ``DL4J_TPU_ATTN_BACKEND`` forces ``xla`` or
+``flash``); the decode step is XLA-native single-query attention and
+NEVER consults the Pallas capability probe — a per-token probe would
+dominate decode latency (pinned by a test counting ``_flash_lowers``
+calls across steps).
+
+``naive_generate`` is the honest O(T²) baseline the decode benchmark
+A/Bs against: re-run the full forward over the (fixed-padded) sequence
+per emitted token — one executable, no cache, per-token cost linear in
+the whole sequence length instead of constant.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.observability import compile_watch as _cw
+from deeplearning4j_tpu.observability import cost_model as _cost
+
+#: compile-watch / cost-model entry-point names (the zero-steady-state-
+#: retrace assertions and /debug/perf rows key on these)
+PREFILL_FN = "TransformerLM.prefill"
+DECODE_FN = "TransformerLM.decode_step"
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerConfig:
+    """In-graph sampling policy. ``greedy`` ignores the rng; ``topk``
+    draws from the temperature-scaled top-``top_k`` logits (``top_k=0``
+    = full-vocab categorical)."""
+
+    kind: str = "greedy"              # "greedy" | "topk"
+    top_k: int = 0
+    temperature: float = 1.0
+
+    def __post_init__(self):
+        if self.kind not in ("greedy", "topk"):
+            raise ValueError(
+                f"sampler kind must be 'greedy' or 'topk', got {self.kind!r}")
+        if self.temperature <= 0.0:
+            raise ValueError("temperature must be > 0 (use kind='greedy' "
+                             "for deterministic decoding)")
+
+
+def sample_tokens(logits, rng, sampler: SamplerConfig):
+    """(…, V) logits → (…,) int32 tokens under ``sampler`` (traceable)."""
+    if sampler.kind == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = (logits / sampler.temperature).astype(jnp.float32)
+    if sampler.top_k and sampler.top_k > 0:
+        vals, idxs = lax.top_k(scaled, sampler.top_k)
+        choice = jax.random.categorical(rng, vals, axis=-1)
+        return jnp.take_along_axis(
+            idxs, choice[..., None], axis=-1)[..., 0].astype(jnp.int32)
+    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+
+def default_prefill_buckets(max_len: int, lo: int = 16) -> Tuple[int, ...]:
+    """Power-of-two prompt-length buckets up to ``max_len`` (always
+    including ``max_len`` itself) — the bounded-executable-set tradeoff
+    the serving batch buckets already make."""
+    out: List[int] = []
+    b = lo
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+class DecodeEngine:
+    """See module doc. One engine = one (model, params) pair + one
+    sampler config; every jitted entry point compiles once per
+    (batch-bucket, length-bucket) signature."""
+
+    def __init__(self, model, params, max_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 sampler: Optional[SamplerConfig] = None, seed: int = 0):
+        c = model.config
+        self.model = model
+        self.params = params
+        self.max_len = int(max_len if max_len is not None else c.max_len)
+        if not 0 < self.max_len <= c.max_len:
+            raise ValueError(
+                f"max_len {self.max_len} must be in (0, "
+                f"config.max_len={c.max_len}] — positions beyond the "
+                "learned pos_emb table cannot decode")
+        self.sampler = sampler if sampler is not None else SamplerConfig()
+        if prefill_buckets:
+            buckets = tuple(sorted({int(b) for b in prefill_buckets
+                                    if 0 < int(b) <= self.max_len}))
+            if not buckets:
+                raise ValueError(
+                    f"prefill_buckets {tuple(prefill_buckets)} has no "
+                    f"entry in (0, max_len={self.max_len}]")
+        else:
+            buckets = default_prefill_buckets(self.max_len)
+        self.prefill_buckets = buckets
+        self._base_key = jax.random.key(int(seed))
+        sampler_cfg = self.sampler
+
+        def _prefill(params, tokens, last_idx, step):
+            _cw.note_trace(PREFILL_FN, tokens)
+            logits, kv = model.prefill(params, tokens)
+            rng = jax.random.fold_in(self._base_key, step)
+            last = jnp.take(logits, last_idx, axis=1)        # (B, V)
+            first = sample_tokens(last, rng, sampler_cfg)
+            return first, logits, kv
+
+        def _decode(params, cache, tokens, positions, step):
+            _cw.note_trace(DECODE_FN, tokens, positions)
+            logits, cache = model.decode_step_math(
+                params, cache, tokens, positions)
+            rng = jax.random.fold_in(self._base_key, step)
+            nxt = sample_tokens(logits, rng, sampler_cfg)
+            # positions advance in-graph so a device-resident generate
+            # loop never round-trips them through the host
+            return nxt, logits, cache, positions + 1
+
+        def _insert(cache, k, v, slot):
+            zero = jnp.zeros((), jnp.int32)
+            at = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+            return {"k": lax.dynamic_update_slice(cache["k"], k, at),
+                    "v": lax.dynamic_update_slice(cache["v"], v, at)}
+
+        self._prefill_jit = jax.jit(_prefill)
+        self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
+        self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- cache
+    def new_cache(self, slots: int) -> Dict:
+        return self.model.init_cache(slots, self.max_len)
+
+    @staticmethod
+    def cache_bytes(cache) -> int:
+        return int(sum(int(a.nbytes) for a in jax.tree.leaves(cache)))
+
+    # ----------------------------------------------------------- buckets
+    def prefill_bucket(self, length: int) -> int:
+        """Smallest configured bucket that fits a ``length``-token
+        prompt (raises when none does — the caller must shed, not
+        silently truncate a prompt)."""
+        i = bisect.bisect_left(self.prefill_buckets, length)
+        if i >= len(self.prefill_buckets):
+            raise ValueError(
+                f"prompt length {length} exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]}")
+        return self.prefill_buckets[i]
+
+    def _pad_prompt(self, prompt: np.ndarray) -> Tuple[np.ndarray, int]:
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        t = prompt.shape[1]
+        bucket = self.prefill_bucket(t)
+        if t < bucket:
+            prompt = np.concatenate(
+                [prompt, np.zeros((prompt.shape[0], bucket - t), np.int32)],
+                axis=1)
+        return prompt, t
+
+    # ------------------------------------------------------ entry points
+    def prefill(self, prompt: np.ndarray, step: int = 0):
+        """Pad ``prompt`` (B, T) to its length bucket and run the jitted
+        prefill. Returns (first_token (B,), logits (B, T_bucket, V),
+        kv, real_length)."""
+        padded, t = self._pad_prompt(prompt)
+        args = (self.params, jnp.asarray(padded),
+                jnp.asarray(t - 1, jnp.int32), jnp.asarray(step, jnp.int32))
+        first, logits, kv = self._prefill_jit(*args)
+        self._maybe_account(PREFILL_FN, self._prefill_jit, args)
+        return first, logits, kv, t
+
+    def decode(self, cache, tokens: np.ndarray, positions: np.ndarray,
+               step: int):
+        """One jitted decode step. ``cache`` is donated — the caller
+        must use the returned one. Returns (next_tokens (B,), logits
+        (B, V), cache). (The jitted body also returns the advanced
+        positions; step-wise callers that own their position book — the
+        continuous batcher — ignore it.)"""
+        nxt, logits, cache, _pos = self._decode_jit(
+            self.params, cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32), jnp.asarray(step, jnp.int32))
+        return nxt, logits, cache
+
+    def insert_slot(self, cache, kv, slot: int):
+        """Write a prefill's (L, Bp, T_bucket, H, hd) k/v into the cache
+        starting at ``slot`` (donates the cache). The slot index is
+        traced: joining slot 3 reuses slot 0's executable."""
+        return self._insert_jit(cache, kv["k"], kv["v"],
+                                jnp.asarray(slot, jnp.int32))
+
+    def warm(self, slots: int, note=None) -> List[int]:
+        """Compile the engine's whole executable set against a THROWAWAY
+        cache: one prefill + one slot-insert per length bucket, plus one
+        decode step at the (``slots``, max_len) signature. The jit
+        caches live on this engine, so the first real traffic afterward
+        is a pure cache hit. One spelling shared by
+        ``ModelRegistry._warmup_generative`` and the decode benchmark —
+        the bench must warm exactly what a production deploy warms.
+        ``note(**attrs)`` (optional) is called before each compile-
+        provoking step so the caller can declare compile causes.
+        Returns the warmed prefill buckets."""
+        warmed: List[int] = []
+        cache = self.new_cache(slots)
+        for bucket in self.prefill_buckets:
+            if note is not None:
+                note(bucket=bucket)
+            first, _logits, kv, _t = self.prefill(
+                np.zeros((1, bucket), np.int32), step=0)
+            np.asarray(first)                  # execute + block
+            cache = self.insert_slot(cache, kv, 0)
+            warmed.append(bucket)
+        if note is not None:
+            note(decode_slots=slots)
+        tokens = np.zeros((slots,), np.int32)
+        positions = np.zeros((slots,), np.int32)
+        nxt, _logits, cache = self.decode(cache, tokens, positions, 0)
+        np.asarray(nxt)                        # decode executable seeded
+        self.account_decode(cache, tokens, positions, 0)
+        return warmed
+
+    def decode_compile_count(self) -> int:
+        """Compile-watch trace count of the decode entry point — the
+        steady-state-zero-retrace assertion surface."""
+        return _cw.global_compile_watch().count_for(DECODE_FN)
+
+    def _maybe_account(self, fn: str, jitted, args):
+        """Cost-model accounting, once per fresh compile of ``fn`` (the
+        re-``lower()`` at the signature that just ran is a jaxpr-cache
+        hit — same contract as ``maybe_account_bucket``)."""
+        try:
+            cm = _cost.global_cost_model()
+            if _cost.cost_model_enabled() and cm.needs_account(fn, fn):
+                cm.account(fn, lambda: jitted.lower(*args), probe_fn=fn)
+        except Exception:       # accounting is telemetry, never the path
+            pass
+
+    def account_decode(self, cache, tokens, positions, step: int):
+        """Decode-step cost accounting at the signature in flight (the
+        pipeline calls this after a step that followed a fresh trace)."""
+        self._maybe_account(
+            DECODE_FN, self._decode_jit,
+            (self.params, cache, jnp.asarray(tokens, jnp.int32),
+             jnp.asarray(positions, jnp.int32),
+             jnp.asarray(step, jnp.int32)))
+
+    # ------------------------------------------------- convenience loop
+    def generate(self, prompts, max_new_tokens: int,
+                 eos_id: Optional[int] = None, return_logits: bool = False):
+        """Single-batch generation without the serving pipeline: prefill
+        once, then ``max_new_tokens − 1`` decode steps. ``prompts``
+        (B, T) share one length. Returns (B, n_generated) int32 — or
+        (tokens, per-step logits list) with ``return_logits``."""
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim == 1:
+            prompts = prompts[None]
+        B, T = prompts.shape
+        if T + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the cache length {self.max_len}")
+        first, logits, kv, t = self.prefill(prompts, step=0)
+        cache = self.insert_slot(self.new_cache(B), kv, 0)
+        # device-resident loop: tokens/positions stay on device between
+        # steps; the host syncs per step ONLY when it must look at the
+        # tokens (eos streaming / logits collection) — otherwise the
+        # whole continuation is one async dispatch chain with a single
+        # fetch at the end
+        out = [first]
+        logit_steps = [np.asarray(logits)[:, t - 1]] if return_logits else []
+        tokens = first
+        positions = jnp.full((B,), t, jnp.int32)
+        done = (np.asarray(first) == eos_id) if eos_id is not None else None
+        for step in range(1, max_new_tokens):
+            if done is not None and bool(np.all(done)):
+                break
+            tokens, logits, cache, positions = self._decode_jit(
+                self.params, cache, tokens, positions,
+                jnp.asarray(step, jnp.int32))
+            if step == 1:
+                self._maybe_account(
+                    DECODE_FN, self._decode_jit,
+                    (self.params, cache, tokens, positions,
+                     jnp.asarray(step, jnp.int32)))
+            out.append(tokens)
+            if return_logits:
+                logit_steps.append(np.asarray(logits))
+            if done is not None:
+                # running mask over just THIS step's tokens — no O(n²)
+                # re-scan of the whole history
+                done |= np.asarray(tokens) == eos_id
+        toks = np.stack([np.asarray(o) for o in out], axis=1).astype(
+            np.int32)
+        if return_logits:
+            return toks, logit_steps
+        return toks
+
+
+def naive_generate(model, params, prompts, max_new_tokens: int,
+                   pad_to: Optional[int] = None,
+                   sampler: Optional[SamplerConfig] = None, seed: int = 0):
+    """The full-recompute baseline: one fixed-shape ``apply`` executable
+    re-run over the WHOLE padded sequence per emitted token (greedy by
+    default). O(T) forwards of O(T²) attention each — what serving costs
+    without a KV cache. Returns (B, max_new_tokens) int32."""
+    prompts = np.asarray(prompts, np.int32)
+    if prompts.ndim == 1:
+        prompts = prompts[None]
+    B, T = prompts.shape
+    pad_to = int(pad_to or model.config.max_len)
+    if T + max_new_tokens > pad_to:
+        raise ValueError(f"prompt ({T}) + max_new_tokens "
+                         f"({max_new_tokens}) exceeds pad_to {pad_to}")
+    sampler = sampler or SamplerConfig()
+    # one jit wrapper per MODEL (cached on it): interleaved bench repeats
+    # must not retrace per call
+    fwd = model.__dict__.get("_naive_apply_jit")
+    if fwd is None:
+        fwd = jax.jit(lambda p, toks: model.apply(p, toks))
+        model.__dict__["_naive_apply_jit"] = fwd
+    key = jax.random.key(int(seed))
+    seq = np.zeros((B, pad_to), np.int32)
+    seq[:, :T] = prompts
+    out = []
+    for i in range(max_new_tokens):
+        logits = fwd(params, jnp.asarray(seq))
+        # slice the sampled position on DEVICE — shipping the whole
+        # (B, T, V) logits tensor to the host every token would be a
+        # strawman baseline, not the naive path's real cost
+        nxt = np.asarray(sample_tokens(logits[:, T + i - 1],
+                                       jax.random.fold_in(key, i), sampler))
+        seq[:, T + i] = nxt
+        out.append(nxt)
+    return np.stack(out, axis=1).astype(np.int32)

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, Optional
+import os
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +80,16 @@ def _flash_lowers() -> bool:
 
 
 def _use_flash_attention(seq_len: Optional[int] = None) -> bool:
+    # env override first: "xla"/"flash" force a backend, "auto" (default)
+    # keeps the measured-crossover policy below. Consulted at TRACE time
+    # only — a compiled executable never re-reads it (the decode path in
+    # particular must never run a per-token Pallas probe; see
+    # models/generation.py and the test pinning _flash_lowers call counts)
+    backend = os.environ.get("DL4J_TPU_ATTN_BACKEND", "auto").lower()
+    if backend == "xla":
+        return False
+    if backend == "flash":
+        return True
     if FLASH_ATTENTION is not None:
         return FLASH_ATTENTION
     if seq_len is not None and seq_len < FLASH_MIN_SEQ:
@@ -288,7 +299,10 @@ class TransformerLM:
         y = y * p["g"].astype(jnp.float32) + p["b"].astype(jnp.float32)
         return y.astype(x.dtype)
 
-    def _attn(self, p, x, mesh):
+    def _qkv(self, p, x):
+        """Project one (B, T, C) activation into (B, T, H, hd) q/k/v —
+        shared by the training/scoring attention and the prefill path
+        (which must cache exactly the k/v the full forward would see)."""
         c = self.config
         b, t, _ = x.shape
         h, hd = c.n_heads, c.d_model // c.n_heads
@@ -302,6 +316,12 @@ class TransformerLM:
             q = (x @ p["wq"]).reshape(b, t, h, hd)
             k = (x @ p["wk"]).reshape(b, t, h, hd)
             v = (x @ p["wv"]).reshape(b, t, h, hd)
+        return q, k, v
+
+    def _attn(self, p, x, mesh, return_kv: bool = False):
+        c = self.config
+        b, t, _ = x.shape
+        q, k, v = self._qkv(p, x)
         if mesh is not None and SEQ_AXIS in mesh.axis_names:
             o = ring_attention(q, k, v, mesh, causal=c.causal)
         elif _use_flash_attention(t):
@@ -314,7 +334,10 @@ class TransformerLM:
             o = o4.transpose(0, 2, 1, 3)
         else:
             o = _plain_attention(q, k, v, causal=c.causal)
-        return o.reshape(b, t, c.d_model) @ p["wo"]
+        out = o.reshape(b, t, c.d_model) @ p["wo"]
+        if return_kv:
+            return out, k, v
+        return out
 
     def _constrain(self, x):
         """Activation sharding hint: (B, T, C) → ('data', 'seq', None)."""
@@ -416,12 +439,9 @@ class TransformerLM:
         consumes the trunk directly so logits never materialize."""
         c = self.config
         t = tokens.shape[1]
-        if c.dtype != jnp.float32:
-            # mixed precision: f32 master params (init_params), compute in
-            # c.dtype — the grads/updates stay f32 on the outside
-            params = jax.tree.map(
-                lambda a: a.astype(c.dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+        # mixed precision: f32 master params (init_params), compute in
+        # c.dtype — the grads/updates stay f32 on the outside
+        params = self._cast_params(params)
         x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
         x = self._dropout(x.astype(c.dtype), rng, 0)
         x = self._constrain(x)
@@ -457,16 +477,11 @@ class TransformerLM:
             if not dense:
                 aux_total = out[1]
         else:
-            blocks = params["blocks"]
-            if c.pipeline_stages > 1:
-                # stage-stacked params but no stage mesh (single-device
-                # eval/inference of a pipeline-trained model): unstack and
-                # run the stack sequentially — same math, no pipeline
-                S = c.pipeline_stages
-                lps = c.n_layers // S
-                blocks = [jax.tree.map(lambda a, s=s, i=i: a[s][i],
-                                       params["blocks"])
-                          for s in range(S) for i in range(lps)]
+            # plain list — or stage-stacked params with no stage mesh
+            # (single-device eval/inference of a pipeline-trained model):
+            # unstack and run the stack sequentially — same math, no
+            # pipeline. One spelling with the decode path (_decode_blocks).
+            blocks = self._decode_blocks(params)
             if c.remat:
                 # recompute each block's activations in backward instead
                 # of saving them: O(L·T·d) residuals shrink to O(T·d)
@@ -551,6 +566,146 @@ class TransformerLM:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
         return step
+
+    # ----------------------------------------- prefill/decode (generation)
+    # The O(T²)-per-token naive alternative — re-running the full forward
+    # for every emitted token — is what these two entry points replace:
+    # ``prefill`` runs the causal trunk ONCE over the prompt and returns
+    # the per-layer k/v it computed; ``decode_step_math`` then extends the
+    # sequence one token at a time with single-query attention against
+    # that cache (O(T) per token). Both are pure math functions — the
+    # jit/bucket/sampling wrapper lives in models/generation.py
+    # (DecodeEngine), and the full-seq flash kernel is prefill-only: the
+    # decode step is XLA-native single-query attention, so it never
+    # consults the Pallas capability probe.
+
+    def _decode_blocks(self, params):
+        """Per-layer block pytrees regardless of the trunk's storage
+        layout (plain list, scan-stacked, or pipeline-stage-stacked) —
+        generation walks layers explicitly either way."""
+        c = self.config
+        blocks = params["blocks"]
+        if c.scan_layers:
+            return [jax.tree.map(lambda a, i=i: a[i], blocks)
+                    for i in range(c.n_layers)]
+        if c.pipeline_stages > 1:
+            S = c.pipeline_stages
+            lps = c.n_layers // S
+            return [jax.tree.map(lambda a, s=s, i=i: a[s][i], blocks)
+                    for s in range(S) for i in range(lps)]
+        return list(blocks)
+
+    def _cast_params(self, params):
+        """The trunk's mixed-precision cast (f32 master params, compute
+        in ``config.dtype``) — prefill/decode must see the same weights
+        the full forward computes with."""
+        c = self.config
+        if c.dtype == jnp.float32:
+            return params
+        return jax.tree.map(
+            lambda a: a.astype(c.dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+
+    def _ffn(self, blk, h, mesh):
+        """One block's feed-forward on (B, T, C) — the same math
+        ``_block_math`` inlines (MoE stats dropped: generation has no
+        aux loss to feed)."""
+        if self.config.moe is not None:
+            y, _ = moe_ffn(blk["moe"], h, self.config.moe, mesh)
+            return y
+        hdn = jax.nn.gelu(h @ blk["mlp"]["w_up"] + blk["mlp"]["b_up"])
+        return hdn @ blk["mlp"]["w_down"] + blk["mlp"]["b_down"]
+
+    def init_cache(self, batch: int, max_len: int,
+                   dtype: Optional[Any] = None) -> Dict:
+        """Preallocated per-layer KV cache: ``{"k","v"}`` of shape
+        (L, B, S, H, hd) in the compute dtype. S is a FIXED length bucket
+        — decode writes are position-indexed ``dynamic_update_slice``s
+        into it, so the executable never depends on how full it is."""
+        c = self.config
+        h, hd = c.n_heads, c.d_model // c.n_heads
+        dt = dtype if dtype is not None else c.dtype
+        shape = (c.n_layers, batch, max_len, h, hd)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def prefill(self, params, tokens) -> Tuple[Any, Dict]:
+        """tokens (B, T) int32 → (logits (B, T, V) f32, kv) where kv is
+        ``{"k","v"}: (L, B, T, H, hd)`` — the cache entries the causal
+        forward computed for every prompt position. Same math as
+        :meth:`apply` at inference (no dropout); the (T, T) attention
+        itself routes through the normal backend policy (flash kernel
+        eligible — this is the one generation phase where it pays)."""
+        c = self.config
+        params = self._cast_params(params)
+        t = tokens.shape[1]
+        x = jnp.take(params["tok_emb"], tokens, axis=0) + params["pos_emb"][:t]
+        x = x.astype(c.dtype)
+        if self.mesh is not None:
+            x = self._constrain(x)
+        ks, vs = [], []
+        for blk in self._decode_blocks(params):
+            a, k, v = self._attn(blk["attn"], self._ln(blk["ln1"], x),
+                                 self.mesh, return_kv=True)
+            x = x + a
+            if self.mesh is not None:
+                x = self._constrain(x)
+            x = x + self._ffn(blk, self._ln(blk["ln2"], x), self.mesh)
+            if self.mesh is not None:
+                x = self._constrain(x)
+            ks.append(k)
+            vs.append(v)
+        x = self._ln(params["ln_f"], x)
+        logits = jnp.matmul(x, params["tok_emb"].T,
+                            preferred_element_type=jnp.float32)
+        return logits, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+    def decode_step_math(self, params, cache, tokens, positions):
+        """One autoregressive step for a whole slot batch.
+
+        ``tokens`` (B,) int32 — the current token per slot; ``positions``
+        (B,) int32 — where it sits in its sequence. Writes each slot's
+        new k/v at its own position (vmapped ``dynamic_update_slice``)
+        and runs single-query attention over the cache masked to
+        ``pos <= positions`` — O(S) work, no (T, T) tensor, one fixed
+        executable per cache shape. Returns (logits (B, V) f32, cache).
+        """
+        c = self.config
+        params = self._cast_params(params)
+        B = tokens.shape[0]
+        S = cache["k"].shape[2]
+        h, hd = c.n_heads, c.d_model // c.n_heads
+        x = (jnp.take(params["tok_emb"], tokens, axis=0)
+             + jnp.take(params["pos_emb"], positions, axis=0))
+        x = x[:, None, :].astype(c.dtype)          # (B, 1, C)
+        # keys at cache position p are attendable when p <= current pos
+        # (the current token's k/v are written before attention below)
+        mask = jnp.arange(S)[None, :] <= positions[:, None]   # (B, S)
+
+        def write(cache_l, kv, p):                 # (S,H,hd), (H,hd), ()
+            return lax.dynamic_update_slice(cache_l, kv[None], (p, 0, 0))
+
+        new_k, new_v = [], []
+        for li, blk in enumerate(self._decode_blocks(params)):
+            q, k, v = self._qkv(blk["attn"], self._ln(blk["ln1"], x))
+            ck = jax.vmap(write)(cache["k"][li], k[:, 0], positions)
+            cv = jax.vmap(write)(cache["v"][li], v[:, 0], positions)
+            new_k.append(ck)
+            new_v.append(cv)
+            # single-query attention against the cache — the same
+            # max-subtract/f32-exp softmax _plain_attention runs, so the
+            # incremental logits match the full forward's to tolerance
+            s = jnp.einsum("bhd,bshd->bhs", q[:, 0], ck) / float(np.sqrt(hd))
+            s = jnp.where(mask[:, None, :], s, jnp.asarray(-1e30, s.dtype))
+            m = lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp((s - m).astype(jnp.float32))
+            p = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(x.dtype)
+            o = jnp.einsum("bhs,bshd->bhd", p, cv)
+            x = x + (o.reshape(B, 1, c.d_model) @ blk["attn"]["wo"])
+            x = x + self._ffn(blk, self._ln(blk["ln2"], x), None)
+        x = self._ln(params["ln_f"], x)
+        logits = jnp.matmul(x[:, 0], params["tok_emb"].T,
+                            preferred_element_type=jnp.float32)
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
 
 
 def make_sharded_lm(config: TransformerConfig, mesh: Mesh, optimizer=None,

@@ -41,6 +41,8 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
   reshape history, and the sharded-manifest checkpoint stores
 - ``deploy.json`` — versioned serving: deployed versions (lifecycle,
   warmup, in-flight), rollout stage/share and its SLO verdicts
+- ``generation.json`` — the generative decode layer: per-pipeline slot
+  tables (who was decoding, at which position), queue depth, cache size
 - ``perf.json`` — the cost observatory: per-entry-point FLOPs/bytes,
   live MFU vs. its rolling baseline, and roofline verdicts (was the
   process slow BEFORE it died?)
@@ -93,6 +95,7 @@ def postmortem_dir() -> str:
 _PROGRESS_CHANNELS = {
     "fit": ("train_step",),
     "inference_request": ("inference_batch",),
+    "generation_request": ("generation_step",),
 }
 
 
@@ -335,6 +338,9 @@ class FlightRecorder:
         # the PR-6 cost observatory: per-fn cost/MFU/roofline at the
         # moment of death — a postmortem for "it got slow, then it hung"
         section("perf.json", self._write_perf)
+        # the generative decode layer: slot table, positions, queue depth
+        # — a hang mid-generation must name which slots were decoding
+        section("generation.json", self._write_generation)
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -403,6 +409,18 @@ class FlightRecorder:
         with open(path, "w") as f:
             json.dump(global_cost_model().snapshot(), f, indent=2,
                       default=str)
+
+    @staticmethod
+    def _write_generation(path: str):
+        # never IMPORT the generation stack from a (possibly wedged)
+        # dump path — a process that never used it gets an empty
+        # section, not a fresh module-import under the import lock
+        import sys as _sys
+        gen = _sys.modules.get("deeplearning4j_tpu.parallel.generation")
+        pipelines = (gen.GenerationPipeline.live_snapshots()
+                     if gen is not None else [])
+        with open(path, "w") as f:
+            json.dump({"pipelines": pipelines}, f, indent=2, default=str)
 
     @staticmethod
     def _write_metrics(path: str):

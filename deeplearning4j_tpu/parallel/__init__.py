@@ -18,3 +18,8 @@ from deeplearning4j_tpu.parallel.master import (  # noqa: F401
 from deeplearning4j_tpu.parallel.ring import ring_attention  # noqa: F401
 from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
     AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm, ThresholdAlgorithm)
+# NOTE: parallel.generation is intentionally NOT imported here — the
+# flight recorder and test teardown check sys.modules to decide whether
+# the generation stack is in play, and every non-generating process
+# would otherwise pay its import at startup. Import it explicitly:
+# `from deeplearning4j_tpu.parallel.generation import GenerationPipeline`.

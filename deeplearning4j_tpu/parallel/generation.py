@@ -1,0 +1,650 @@
+"""GenerationPipeline: continuous batching for autoregressive decode.
+
+The serving half of the generative decode path (the model half is
+``models/generation.py``). ``ParallelInference``'s batcher coalesces
+*one-shot* requests into padded windows; generation is different — a
+request occupies device batch space for its whole multi-step lifetime,
+and windowed batching makes every member wait on the window's LONGEST
+member before any slot frees. Continuous batching fixes exactly that:
+
+- the decode batch is a fixed set of ``slots`` (one compiled
+  ``decode_step`` executable over all of them, occupied or not);
+- a finished/shed request frees its slot **at the step boundary**, and a
+  queued request joins in the freed slot immediately — its prefill runs
+  and its k/v land in that slot's cache pages
+  (``DecodeEngine.insert_slot``) while every other slot keeps decoding
+  on the next step;
+- steady-state decode triggers **zero** new XLA traces (fixed shapes
+  throughout; pinned via ``compile_watch`` counters in tests).
+
+The PR-5 policies apply unchanged: per-request deadlines (shed at
+admission, at the step boundary, and by the caller's walk-away),
+bounded-queue shedding (``reject_newest``/``reject_oldest``), a circuit
+breaker on the decode device path, transient-fault retries under a
+budget, and exactly-once resolution through the shared
+``_Request.claim()``. Chaos point ``generation.step`` fires once per
+step boundary. Trace phases per request: ``slot_wait`` (enqueue → slot
+granted), ``prefill``, and a batch-level ``decode_step`` span per step.
+
+Metrics (``dl4j_decode_*``): generated tokens, slot occupancy,
+prefill/decode latency split, cache bytes, sheds, queue depth — on
+``/metrics``, with decode/prefill MFU entries on ``/debug/perf`` via the
+cost model, and in flight-recorder bundles (``generation.json``).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.models.generation import (DECODE_FN, PREFILL_FN,
+                                                  DecodeEngine)
+from deeplearning4j_tpu.observability import cost_model as _cost
+from deeplearning4j_tpu.observability import global_registry, on_registry_reset
+from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_flight_recorder as _flight)
+from deeplearning4j_tpu.observability.tracing import (current_context,
+                                                      now_us, record_span)
+from deeplearning4j_tpu.parallel.inference import _Request
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
+                                                  CircuitBreaker,
+                                                  CircuitOpenError, Deadline,
+                                                  DeadlineExceeded,
+                                                  RetryPolicy, ShedError,
+                                                  ShutdownError,
+                                                  default_deadline_ms)
+
+_TYPED_OUTCOMES = TYPED_OUTCOMES
+
+
+class _GenMetrics:
+    """Label-bound decode instruments (shared across instances, same
+    rationale as ``_ServingMetrics``)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        reg = global_registry()
+        self.tokens = reg.counter(
+            "dl4j_decode_tokens_total",
+            "tokens emitted by the continuous-batching decode loop "
+            "(rate = serving tokens/s)")
+        self.steps = reg.counter(
+            "dl4j_decode_steps_total",
+            "decode step boundaries executed (each runs every occupied "
+            "slot one token forward)")
+        self.requests = reg.counter(
+            "dl4j_decode_requests_total",
+            "generation requests resolved (success, typed shed, or error)")
+        self.errors = reg.counter(
+            "dl4j_decode_errors_total",
+            "generation requests that raised a non-typed error")
+        shed = reg.counter(
+            "dl4j_decode_shed_total",
+            "generation requests shed by admission control or deadlines",
+            label_names=("reason",))
+        self.shed = {r: shed.labels(reason=r)
+                     for r in ("queue_full", "deadline", "circuit_open")}
+        self.occupancy = reg.histogram(
+            "dl4j_decode_slot_occupancy_ratio",
+            "occupied slots / total slots per decode step (1.0 = the "
+            "device batch is full — continuous batching's win condition)",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+        self.prefill_latency = reg.histogram(
+            "dl4j_decode_prefill_seconds",
+            "prompt prefill wall time (trunk forward + cache insert), "
+            "per joining request")
+        self.step_latency = reg.histogram(
+            "dl4j_decode_step_seconds",
+            "one decode step boundary's wall time (single-query "
+            "attention over every occupied slot + sampling)")
+        self.latency = reg.histogram(
+            "dl4j_decode_latency_seconds",
+            "end-to-end GenerationPipeline.generate latency (queue wait "
+            "+ prefill + all decode steps)")
+        self.cache_bytes = reg.gauge(
+            "dl4j_decode_cache_bytes",
+            "preallocated KV-cache footprint of live pipelines "
+            "(slots x max_len x layers x heads)")
+        self.slots_in_use = reg.gauge(
+            "dl4j_decode_slots_in_use",
+            "slots occupied by in-flight generations (sampled per step "
+            "boundary)")
+        self.queue_depth = reg.gauge(
+            "dl4j_decode_queue_depth",
+            "generation requests waiting for a free slot")
+
+    @classmethod
+    def get(cls) -> "_GenMetrics":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+
+@on_registry_reset
+def _drop_gen_metrics():
+    _GenMetrics._instance = None
+
+
+class _GenRequest(_Request):
+    """One generation request riding the shared exactly-once machinery
+    (``claim()``): ``x`` is the 1-D int32 prompt, ``out`` accumulates
+    emitted tokens while the request owns a slot."""
+
+    __slots__ = ("max_new_tokens", "eos_id", "out", "t_slot_us")
+
+    def __init__(self, x, max_new_tokens: int, eos_id: Optional[int]):
+        super().__init__(x)
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.out: List[int] = []
+        self.t_slot_us = 0.0
+
+
+class GenerationPipeline:
+    """Slot-based continuous batching over one :class:`DecodeEngine`.
+
+    Owns a decode-loop thread; call :meth:`shutdown` (or use as a
+    context manager) when done. :meth:`shutdown_all` stops every live
+    instance (test-harness teardown, like ``ParallelInference``)."""
+
+    _live: "weakref.WeakSet[GenerationPipeline]" = weakref.WeakSet()
+
+    def __init__(self, engine: DecodeEngine, slots: int = 4,
+                 queue_limit: int = 64,
+                 max_new_tokens: int = 32, eos_id: Optional[int] = None,
+                 max_queue_depth: Optional[int] = None,
+                 shed_policy: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None):
+        self.engine = engine
+        self.slots = int(slots)
+        if self.slots < 1:
+            # a zero-slot pipeline would warm, go live, and then park
+            # every request forever — refuse at construction
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.default_max_new_tokens = int(max_new_tokens)
+        self.default_eos_id = eos_id
+        self._resilience = _faults.resilience_enabled()
+        if shed_policy is not None and shed_policy not in (
+                "reject_newest", "reject_oldest"):
+            raise ValueError("shed_policy must be 'reject_newest' or "
+                             f"'reject_oldest', got {shed_policy!r}")
+        if max_queue_depth is not None and self._resilience:
+            queue_limit = max(1, int(max_queue_depth))
+            shed_policy = shed_policy or "reject_newest"
+        self._shed_policy = shed_policy if self._resilience else None
+        self.default_deadline_ms = (deadline_ms if deadline_ms is not None
+                                    else default_deadline_ms())
+        self._breaker = None
+        if self._resilience:
+            self._breaker = breaker if breaker is not None else \
+                CircuitBreaker("generation.step")
+            self._retry = RetryPolicy(max_retries=2,
+                                      base_delay_seconds=0.01)
+        self._queue: "queue.Queue[_GenRequest]" = queue.Queue(
+            maxsize=queue_limit)
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        # slot state, owned exclusively by the decode thread
+        self._slot_req: List[Optional[_GenRequest]] = [None] * self.slots
+        self._tokens = np.zeros((self.slots,), np.int32)
+        self._positions = np.zeros((self.slots,), np.int32)
+        self._cache = engine.new_cache(self.slots)
+        self._step = 0
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        daemon=True, name="dl4j-gen-decode")
+        self._thread.start()
+        GenerationPipeline._live.add(self)
+        self._publish_cache_bytes()
+
+    @classmethod
+    def _publish_cache_bytes(cls):
+        """The gauge is documented as the footprint of LIVE pipelines —
+        sum across them (a second deploy must not mask the first, and a
+        retired pipeline's bytes must leave the gauge)."""
+        total = 0
+        for gp in list(cls._live):
+            if gp._stop.is_set():
+                continue
+            total += gp._safe_cache_bytes() or 0
+        _GenMetrics.get().cache_bytes.set(total)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    @classmethod
+    def shutdown_all(cls):
+        for gp in list(cls._live):
+            gp.shutdown()
+
+    # ------------------------------------------------------------- API
+    def _resolve_deadline(self, deadline_ms) -> Optional[Deadline]:
+        if not self._resilience:
+            return None
+        ms = (deadline_ms if deadline_ms is not None
+              else self.default_deadline_ms)
+        return Deadline.after_ms(ms) if ms and ms > 0 else None
+
+    def _shed(self, reason: str):
+        _GenMetrics.get().shed[reason].inc()
+        _faults.record_event("shed", op="generation", reason=reason)
+
+    def _check_admission(self):
+        if self._breaker is not None and not self._breaker.allow():
+            self._shed("circuit_open")
+            raise CircuitOpenError(
+                "generation circuit open (consecutive decode-step "
+                "failures); retry after the reset timeout")
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Generate up to ``max_new_tokens`` continuation tokens for a
+        1-D int32 ``prompt``. Blocks until the request resolves; raises
+        the typed resilience outcomes (shed/deadline/circuit/shutdown)
+        or the device error that killed it. Returns the emitted tokens
+        (1-D int32, possibly shorter on ``eos_id``)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must contain at least one token")
+        n_new = int(max_new_tokens if max_new_tokens is not None
+                    else self.default_max_new_tokens)
+        if n_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # fail fast on prompts that can never decode (bucket overflow):
+        # a programming error, not a load condition — never typed
+        self.engine.prefill_bucket(prompt.size)
+        if prompt.size + 1 > self.engine.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size} tokens) leaves no room to "
+                f"decode in a {self.engine.max_len}-token cache")
+        obs = _GenMetrics.get()
+        t0 = time.perf_counter()
+        req = _GenRequest(prompt, n_new,
+                          eos_id if eos_id is not None
+                          else self.default_eos_id)
+        req.deadline = self._resolve_deadline(deadline_ms)
+        with _flight().arm("generation_request"), \
+                _span("generation_request", prompt_tokens=int(prompt.size),
+                      max_new_tokens=n_new):
+            req.ctx = current_context()
+            req.t_enqueue_us = now_us()
+
+            def _account(err: Optional[BaseException]):
+                obs.latency.observe(time.perf_counter() - t0)
+                obs.requests.inc()
+                if err is not None and not isinstance(err, _TYPED_OUTCOMES):
+                    obs.errors.inc()
+
+            try:
+                self._check_admission()
+                self._enqueue(req, obs)
+            except Exception as e:
+                _account(e)
+                raise
+            self._await(req)
+            if req.error is not None:
+                _account(req.error)
+                raise req.error
+        _account(None)
+        return req.result
+
+    def _enqueue(self, req: _GenRequest, obs: "_GenMetrics"):
+        """Bounded enqueue with the PI condition/shed semantics."""
+        with self._not_full:
+            while True:
+                if self._stop.is_set():
+                    raise ShutdownError(
+                        "GenerationPipeline has been shut down")
+                if req.deadline is not None and req.deadline.expired():
+                    self._shed("deadline")
+                    raise DeadlineExceeded(
+                        "request expired while waiting to enqueue")
+                try:
+                    self._queue.put_nowait(req)
+                    obs.queue_depth.set(self._queue.qsize())
+                    return
+                except queue.Full:
+                    if self._shed_policy == "reject_newest":
+                        self._shed("queue_full")
+                        raise ShedError(
+                            f"generation queue full "
+                            f"({self._queue.maxsize} requests); request "
+                            "rejected (reject_newest)")
+                    if self._shed_policy == "reject_oldest":
+                        try:
+                            old = self._queue.get_nowait()
+                        except queue.Empty:
+                            continue
+                        self._shed_request(old, "queue_full", ShedError(
+                            "shed from a full generation queue by a "
+                            "newer request (reject_oldest)"))
+                        continue
+                    self._not_full.wait(timeout=0.1)
+
+    def _await(self, req: _GenRequest):
+        """Deadline-aware wait with the walk-away claim (a wedged decode
+        step must not hang a deadline'd caller)."""
+        if req.deadline is None:
+            req.event.wait()
+            return
+        while not req.event.is_set():
+            rem = req.deadline.remaining()
+            if rem <= 0:
+                break
+            req.event.wait(timeout=rem)
+        if not req.event.is_set():
+            if req.claim():
+                req.error = DeadlineExceeded(
+                    "request expired while decoding")
+                req.event.set()
+                self._shed("deadline")
+            else:
+                req.event.wait(timeout=5.0)
+                if req.error is None and req.result is None:
+                    req.error = DeadlineExceeded(
+                        "request expired while decoding "
+                        "(resolution stalled)")
+
+    # --------------------------------------------------- decode thread
+    def _shed_request(self, req: _GenRequest, reason: str,
+                      error: BaseException):
+        if not req.claim():
+            return
+        self._shed(reason)
+        if req.ctx is not None:
+            record_span("shed", now_us(), ctx=req.ctx, reason=reason)
+        req.error = error
+        req.event.set()
+
+    def _resolve(self, req: _GenRequest):
+        """Successful completion (slot already freed by the caller)."""
+        if not req.claim():
+            return
+        req.result = np.asarray(req.out, np.int32)
+        req.event.set()
+
+    def _fail_request(self, req: _GenRequest, error: BaseException):
+        if not req.claim():
+            return
+        req.error = error
+        req.event.set()
+
+    def _n_active(self) -> int:
+        return sum(1 for r in self._slot_req if r is not None)
+
+    def _take_request(self, timeout: float) -> Optional[_GenRequest]:
+        """Pop one queued request (shedding already-expired ones), waking
+        any producer parked on the full queue."""
+        wait_until = time.monotonic() + timeout
+        while True:
+            try:
+                req = self._queue.get(
+                    timeout=max(0.0, wait_until - time.monotonic()))
+            except queue.Empty:
+                return None
+            with self._not_full:
+                self._not_full.notify()
+            if (self._resilience and req.deadline is not None
+                    and req.deadline.expired()):
+                self._shed_request(req, "deadline", DeadlineExceeded(
+                    "request expired waiting for a slot"))
+                continue
+            return req
+
+    def _start_request(self, req: _GenRequest, slot: int) -> bool:
+        """Prefill ``req`` into ``slot``'s cache pages. Returns True when
+        the slot is now occupied (False: resolved without occupying)."""
+        obs = _GenMetrics.get()
+        if req._claimed:
+            return False          # caller already walked away — no work
+        req.t_slot_us = now_us()
+        if req.ctx is not None:
+            # the join-latency phase continuous batching exists to shrink
+            record_span("slot_wait", req.t_enqueue_us, req.t_slot_us,
+                        ctx=req.ctx, slot=slot)
+        t0 = time.perf_counter()
+        t_us = now_us()
+        try:
+            with _span("prefill", slot=slot,
+                       prompt_tokens=int(req.x.size)):
+                first, _logits, kv, t = self.engine.prefill(
+                    req.x[None], step=self._step)
+        except Exception as e:
+            # prefill failed BEFORE the insert donated anything — the
+            # live cache is intact, only the joiner dies
+            if self._breaker is not None:
+                self._breaker.record_failure()
+            self._fail_request(req, e)
+            return False
+        try:
+            with _span("prefill", slot=slot, phase="insert"):
+                self._cache = self.engine.insert_slot(self._cache, kv, slot)
+                first_tok = int(np.asarray(first)[0])
+            dt = time.perf_counter() - t0
+            if req.ctx is not None:
+                record_span("prefill", t_us, now_us(), ctx=req.ctx,
+                            slot=slot, prompt_tokens=int(req.x.size))
+            obs.prefill_latency.observe(dt)
+            _cost.global_cost_model().observe_time(PREFILL_FN, dt)
+            if self._breaker is not None:
+                self._breaker.record_success()
+        except Exception as e:
+            # insert_slot DONATED the live cache before dying — its
+            # pages are gone, so every active generation is dead too:
+            # fail them all with the real insert error (not the
+            # deleted-buffer error one step later) and rebuild
+            if self._breaker is not None:
+                self._breaker.record_failure()
+            self._fail_request(req, e)
+            for s, other in enumerate(self._slot_req):
+                if other is not None:
+                    self._fail_request(other, e)
+                    self._slot_req[s] = None
+            self._cache = self.engine.new_cache(self.slots)
+            return False
+        req.out.append(first_tok)
+        # the generation budget may be clipped by the cache length —
+        # never write a position past the preallocated pages
+        cap = min(req.max_new_tokens, self.engine.max_len - t)
+        req.max_new_tokens = cap
+        done = (len(req.out) >= cap
+                or (req.eos_id is not None and first_tok == req.eos_id))
+        obs.tokens.inc()
+        if done:
+            self._resolve(req)
+            return False
+        self._slot_req[slot] = req
+        self._tokens[slot] = first_tok
+        self._positions[slot] = t
+        return True
+
+    def _admit(self):
+        """Join queued requests into free slots at this step boundary
+        (blocking briefly only when the whole pipeline is idle)."""
+        while not self._stop.is_set():
+            free = [i for i, r in enumerate(self._slot_req) if r is None]
+            if not free:
+                return
+            idle = len(free) == self.slots
+            req = self._take_request(timeout=0.05 if idle else 0.0)
+            if req is None:
+                return
+            _GenMetrics.get().queue_depth.set(self._queue.qsize())
+            self._start_request(req, free[0])
+
+    def _sweep_finished(self, stepped: List[int]):
+        """Post-step bookkeeping for every active slot: append the new
+        token, then resolve/free finished or expired requests."""
+        obs = _GenMetrics.get()
+        for slot in stepped:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            if req._claimed:
+                # another path already resolved it (the caller's
+                # deadline walk-away) — stop spending device steps on a
+                # request nobody will read (racy read is safe: worst
+                # case is one extra step before the slot frees)
+                self._slot_req[slot] = None
+                continue
+            tok = int(self._tokens[slot])
+            req.out.append(tok)
+            self._positions[slot] += 1
+            obs.tokens.inc()
+            expired = (self._resilience and req.deadline is not None
+                       and req.deadline.expired())
+            done = (len(req.out) >= req.max_new_tokens
+                    or (req.eos_id is not None and tok == req.eos_id))
+            if expired and not done:
+                self._shed_request(req, "deadline", DeadlineExceeded(
+                    "request expired at a decode step boundary"))
+                self._slot_req[slot] = None
+            elif done:
+                self._resolve(req)
+                self._slot_req[slot] = None
+
+    def _decode_loop(self):
+        while not self._stop.is_set():
+            # re-fetch per iteration: a registry reset mid-flight drops
+            # and re-binds the singleton (on_registry_reset) — a cached
+            # handle would keep writing to detached instruments
+            obs = _GenMetrics.get()
+            self._admit()
+            active = [i for i, r in enumerate(self._slot_req)
+                      if r is not None]
+            obs.slots_in_use.set(len(active))
+            if not active:
+                continue
+            try:
+                if self._resilience:
+                    self._retry.call(
+                        lambda: _faults.check("generation.step"),
+                        op="generation.step")
+                t0 = time.perf_counter()
+                with _span("decode_step", active=len(active),
+                           slots=self.slots):
+                    tokens, _logits, self._cache = self.engine.decode(
+                        self._cache, self._tokens, self._positions,
+                        self._step)
+                    toks = np.asarray(tokens)    # device→host sync point
+                dt = time.perf_counter() - t0
+                obs.step_latency.observe(dt)
+                obs.steps.inc()
+                obs.occupancy.observe(len(active) / max(1, self.slots))
+                _cost.global_cost_model().observe_time(DECODE_FN, dt)
+                if self._fresh_decode_compile():
+                    self.engine.account_decode(
+                        self._cache, self._tokens, self._positions,
+                        self._step)
+                if self._breaker is not None:
+                    self._breaker.record_success()
+                _flight().progress("generation_step")
+            except Exception as e:
+                if (self._breaker is not None
+                        and not isinstance(e, _TYPED_OUTCOMES)):
+                    self._breaker.record_failure()
+                # the step died mid-donation: the cache buffers are no
+                # longer trustworthy — fail every in-flight request and
+                # rebuild the pages (queued requests are untouched)
+                for slot, req in enumerate(self._slot_req):
+                    if req is not None:
+                        self._fail_request(req, e)
+                        self._slot_req[slot] = None
+                self._cache = self.engine.new_cache(self.slots)
+                self._step += 1
+                continue
+            self._step += 1
+            self._tokens[active] = toks[active]
+            self._sweep_finished(active)
+        # shutdown: resolve whatever still occupies a slot
+        for slot, req in enumerate(self._slot_req):
+            if req is not None:
+                self._fail_request(req, ShutdownError(
+                    "GenerationPipeline shut down"))
+                self._slot_req[slot] = None
+
+    def _fresh_decode_compile(self) -> bool:
+        """True when compile_watch counted a decode trace the cost model
+        has not analyzed yet (kept cheap: one counter compare)."""
+        try:
+            return _cost.global_cost_model().needs_account(DECODE_FN,
+                                                           DECODE_FN)
+        except Exception:
+            return False
+
+    # -------------------------------------------------------- lifecycle
+    def shutdown(self):
+        self._stop.set()
+        with self._not_full:
+            self._not_full.notify_all()
+        self._thread.join(timeout=5.0)
+        if self._breaker is not None:
+            self._breaker.retire()
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._fail_request(req, ShutdownError(
+                "GenerationPipeline shut down"))
+        _GenMetrics.get().queue_depth.set(self._queue.qsize())
+        self._publish_cache_bytes()
+
+    def snapshot(self) -> dict:
+        """Live pipeline state (``/debug/generation`` + the
+        flight-recorder ``generation.json`` payload)."""
+        slots = []
+        for i, req in enumerate(self._slot_req):
+            if req is None:
+                slots.append({"slot": i, "state": "free"})
+            else:
+                slots.append({
+                    "slot": i, "state": "decoding",
+                    "position": int(self._positions[i]),
+                    "generated": len(req.out),
+                    "max_new_tokens": req.max_new_tokens,
+                    "trace_id": (req.ctx.trace_id
+                                 if req.ctx is not None else None)})
+        return {
+            "slots": self.slots,
+            "active": self._n_active(),
+            "queue_depth": self._queue.qsize(),
+            "step": self._step,
+            "max_len": self.engine.max_len,
+            "prefill_buckets": list(self.engine.prefill_buckets),
+            "sampler": {"kind": self.engine.sampler.kind,
+                        "top_k": self.engine.sampler.top_k,
+                        "temperature": self.engine.sampler.temperature},
+            "cache_bytes": self._safe_cache_bytes(),
+            "slot_table": slots,
+        }
+
+    def _safe_cache_bytes(self):
+        """The decode thread may be mid-step (old cache donated away)
+        when a /debug or bundle snapshot races this read — answer None
+        for that instant rather than raising into the dump."""
+        try:
+            return DecodeEngine.cache_bytes(self._cache)
+        except Exception:
+            return None
+
+    @classmethod
+    def live_snapshots(cls) -> list:
+        return [gp.snapshot() for gp in list(cls._live)
+                if not gp._stop.is_set()]

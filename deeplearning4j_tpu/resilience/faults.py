@@ -15,6 +15,8 @@ Named injection points are threaded through the hot paths:
 ``inference.dispatch``      ParallelInference dispatcher, before the forward
 ``inference.device_execute``ParallelInference completer / sync serve loop
 ``serving.canary``          ServingRouter, on the canary version's path only
+``generation.step``         GenerationPipeline decode loop, once per step
+                            boundary (prefill joins + the decode step)
 ``train.step``              MLN/CG ``_fit_batch`` before the jitted step
 ``checkpoint.save``         CheckpointListener / preemption / recovery saves
 ``checkpoint.restore``      ResilientTrainer checkpoint restore
@@ -70,8 +72,9 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 POINTS = ("data.next_batch", "inference.dispatch", "inference.device_execute",
-          "serving.canary", "train.step", "checkpoint.save",
-          "checkpoint.restore", "checkpoint.manifest", "allreduce")
+          "serving.canary", "generation.step", "train.step",
+          "checkpoint.save", "checkpoint.restore", "checkpoint.manifest",
+          "allreduce")
 KINDS = ("error", "crash", "latency", "nan", "host_loss")
 # nan corrupts a batch, so it only fires at points that own an array —
 # accepting it elsewhere would validate a chaos spec that never injects

@@ -17,6 +17,9 @@ Three modules:
   AOT-warms every shape-bucket executable before the version is eligible
   for traffic (persistent compile cache under ``DL4J_TPU_COMPILE_CACHE``
   makes re-deploys and restarts skip compilation entirely);
+  ``deploy_generative(version, engine)`` does the same for a generative
+  decode version — a ``GenerationPipeline`` whose prefill, slot-insert,
+  and decode-step executables all warm before traffic;
   ``retire(version)`` goes through graceful drain.
 - :mod:`~deeplearning4j_tpu.serving.rollout` — :class:`CanaryRollout`:
   the shadow → canary → ramp → full / rolled-back state machine, graded

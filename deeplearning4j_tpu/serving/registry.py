@@ -54,15 +54,18 @@ WARMING, LIVE, DRAINING, RETIRED = "warming", "live", "draining", "retired"
 
 
 class DeployedVersion:
-    """One live model version: its ``ParallelInference``, lifecycle
-    state, warmup record, and the in-flight count graceful drain waits
-    on. The router enters :meth:`track` around every request it sends
-    here."""
+    """One live model version: its ``ParallelInference`` (scoring) or
+    ``GenerationPipeline`` (generative decode), lifecycle state, warmup
+    record, and the in-flight count graceful drain waits on. The router
+    enters :meth:`track` around every request it sends here."""
 
-    def __init__(self, version: str, net, pi: ParallelInference):
+    def __init__(self, version: str, net, pi: Optional[ParallelInference],
+                 gp=None):
         self.version = version
         self.net = net
         self.pi = pi
+        self.gp = gp
+        self.kind = "generative" if gp is not None else "scoring"
         self.state = WARMING
         self.admitting = False
         self.deployed_at = time.time()
@@ -120,11 +123,15 @@ class DeployedVersion:
             drained = self._inflight == 0
         if self.pi is not None:
             self.pi.shutdown()
+        if self.gp is not None:
+            self.gp.shutdown()
         with self._cond:
             self.state = RETIRED
         # release the strong refs so the executables and device buffers
-        # can go with the version (callers keep their own net reference)
+        # (including a generative version's KV-cache pages) can go with
+        # the version (callers keep their own net reference)
         self.pi = None
+        self.gp = None
         self.net = None
         self._drain_done.set()
         return drained
@@ -132,6 +139,7 @@ class DeployedVersion:
     def snapshot(self) -> dict:
         return {
             "version": self.version,
+            "kind": self.kind,
             "state": self.state,
             "admitting": self.admitting,
             "deployed_at": self.deployed_at,
@@ -153,18 +161,18 @@ class ModelRegistry:
         ModelRegistry._live.add(self)
 
     # ------------------------------------------------------------- deploy
-    def deploy(self, version: str, net, sample_input=None,
-               warmup: bool = True, **pi_kwargs) -> DeployedVersion:
-        """Build a ``ParallelInference`` over ``net`` and (with a
-        ``sample_input`` example to take shapes/dtype from) AOT-warm
-        every shape-bucket executable before marking the version
-        eligible for traffic. ``pi_kwargs`` pass through to the
-        ``ParallelInference`` constructor; a per-version circuit breaker
-        is installed unless the caller provides one."""
+    def _deploy_scaffold(self, version: str, build, warm) -> DeployedVersion:
+        """The shared deploy lifecycle both deploy kinds run: one atomic
+        name reservation (a concurrent deploy of the same name must fail
+        HERE, not both build a pipeline and silently orphan one), the
+        persistent compile cache (the warmup compiles are exactly what a
+        restart should retrieve from disk), registration, warmup with
+        cleanup-on-failure (a version that failed to warm must not
+        linger in WARMING with live serve threads, nor block a redeploy
+        of its name), and the LIVE/admitting flip. ``build()`` returns
+        the :class:`DeployedVersion`; ``warm(dv)`` returns the
+        warmed-bucket list."""
         with self._lock:
-            # one atomic reservation: a concurrent deploy of the same
-            # name must fail HERE, not both build a ParallelInference
-            # and silently orphan one of them
             existing = self._versions.get(version)
             if (version in self._reserving
                     or (existing is not None
@@ -175,25 +183,14 @@ class ModelRegistry:
                                  f"(state={state})")
             self._reserving.add(version)
         try:
-            # persistent compile cache first: the warmup compiles below
-            # are exactly what a restart should retrieve from disk
             _async.configure_compile_cache()
-            pi_kwargs.setdefault(
-                "breaker",
-                CircuitBreaker(f"inference.device_execute:{version}"))
-            pi = ParallelInference(net, **pi_kwargs)
-            dv = DeployedVersion(version, net, pi)
+            dv = build()
             with self._lock:
                 self._versions[version] = dv
             t0 = time.perf_counter()
             try:
-                if warmup and sample_input is not None:
-                    dv.warmed_buckets = self._warmup(
-                        dv, np.asarray(sample_input))
+                dv.warmed_buckets = warm(dv)
             except Exception:
-                # a version that failed to warm must not linger in
-                # WARMING with live serve threads, nor block a redeploy
-                # of its name — release everything and surface the error
                 dv.drain(timeout_s=0.0)
                 with self._lock:
                     self._versions.pop(version, None)
@@ -205,10 +202,74 @@ class ModelRegistry:
         serving_metrics().warmup_seconds(version).set(dv.warmup_seconds)
         dv.state = LIVE
         dv.admitting = True
+        return dv
+
+    def deploy(self, version: str, net, sample_input=None,
+               warmup: bool = True, **pi_kwargs) -> DeployedVersion:
+        """Build a ``ParallelInference`` over ``net`` and (with a
+        ``sample_input`` example to take shapes/dtype from) AOT-warm
+        every shape-bucket executable before marking the version
+        eligible for traffic. ``pi_kwargs`` pass through to the
+        ``ParallelInference`` constructor; a per-version circuit breaker
+        is installed unless the caller provides one."""
+        def build():
+            pi_kwargs.setdefault(
+                "breaker",
+                CircuitBreaker(f"inference.device_execute:{version}"))
+            return DeployedVersion(version, net,
+                                   ParallelInference(net, **pi_kwargs))
+
+        def warm(dv):
+            if warmup and sample_input is not None:
+                return self._warmup(dv, np.asarray(sample_input))
+            return []
+
+        dv = self._deploy_scaffold(version, build, warm)
         _faults.record_event("serving_deploy", version=version,
                             warmup_seconds=round(dv.warmup_seconds, 4),
                             buckets=len(dv.warmed_buckets))
         return dv
+
+    # -------------------------------------------------- generative deploy
+    def deploy_generative(self, version: str, engine, warmup: bool = True,
+                          **gp_kwargs) -> DeployedVersion:
+        """Deploy a generative version: a
+        :class:`~deeplearning4j_tpu.parallel.generation.GenerationPipeline`
+        over ``engine`` (a ``DecodeEngine``), AOT-warming every prefill
+        length-bucket executable, the slot-insert executables, and the
+        decode-step executable before the version admits traffic — the
+        first real ``generate`` request triggers zero new traces, the
+        same contract scoring deploys make. ``gp_kwargs`` pass through
+        to the pipeline constructor; a per-version circuit breaker is
+        installed unless the caller provides one."""
+        from deeplearning4j_tpu.parallel.generation import GenerationPipeline
+
+        def build():
+            gp_kwargs.setdefault(
+                "breaker", CircuitBreaker(f"generation.step:{version}"))
+            gp = GenerationPipeline(engine, **gp_kwargs)
+            return DeployedVersion(version, engine.model, None, gp=gp)
+
+        def warm(dv):
+            if warmup:
+                return self._warmup_generative(engine, dv.gp.slots)
+            return []
+
+        dv = self._deploy_scaffold(version, build, warm)
+        _faults.record_event("serving_deploy", version=version,
+                             generative=True,
+                             warmup_seconds=round(dv.warmup_seconds, 4),
+                             buckets=len(dv.warmed_buckets))
+        return dv
+
+    @staticmethod
+    def _warmup_generative(engine, slots: int) -> List[int]:
+        """Compile the whole generative executable set off the traffic
+        path (``DecodeEngine.warm`` — one spelling with the decode
+        benchmark); each compile it provokes is claimed as a warmup so
+        /debug/compiles names the deploy behind it."""
+        return engine.warm(
+            slots, note=lambda **a: _cw.note_cause("serving_warmup", **a))
 
     @staticmethod
     def _warmup(dv: DeployedVersion, sample: np.ndarray) -> List[int]:

@@ -63,6 +63,16 @@ class RolloutPolicy:
     canary_fraction: float = 0.05     # first real traffic share
     ramp_fractions: Tuple[float, ...] = (0.25, 0.5)
     window_requests: int = 32         # candidate samples per evaluation
+    window_seconds: Optional[float] = None
+    # ^ time-based evaluation mode: when set, windows close on the
+    # WALL CLOCK instead of on the candidate-sample count — a
+    # low-traffic (e.g. generative) version still advances or rolls
+    # back promptly instead of waiting forever for window_requests
+    # samples. A timed window still needs ``window_min_requests``
+    # candidate samples before it grades (zero-traffic candidates must
+    # not promote on elapsed time alone).
+    window_min_requests: int = 1      # candidate samples a timed window
+                                      # needs before it may close
     healthy_windows: int = 2          # consecutive ok windows to advance
     latency_quantile: float = 0.5
     latency_ratio_degraded: Optional[float] = 2.0
@@ -262,6 +272,7 @@ class CanaryRollout:
         ])
         self._lock = threading.RLock()
         self._window_samples = 0
+        self._window_started = time.monotonic()
         self._healthy_streak = 0
         self._ramp_idx = -1
         self.active = True
@@ -296,15 +307,47 @@ class CanaryRollout:
     # ---------------------------------------------------------- recording
     def record_candidate_event(self):
         """One candidate-involved request (canary-served or shadow-scored)
-        completed; every ``window_requests`` of them the SLO engine
-        grades the canary."""
+        completed. Request-count mode: every ``window_requests`` of them
+        the SLO engine grades the canary. Time mode
+        (``window_seconds`` set): the window closes on the wall clock
+        instead — checked here AND on every routed request
+        (:meth:`maybe_timed_evaluate`), so grading never needs the
+        candidate to be busy."""
         with self._lock:
             if not self.active:
                 return
             self._window_samples += 1
-            if self._window_samples < self.policy.window_requests:
+            if self.policy.window_seconds is not None:
+                if not self._timed_window_closed_locked():
+                    return
+            else:
+                if self._window_samples < self.policy.window_requests:
+                    return
+                self._window_samples = 0
+        self.evaluate()
+
+    def _timed_window_closed_locked(self) -> bool:
+        """Time-mode window close check (caller holds the lock): enough
+        wall time elapsed AND enough candidate samples landed. Resets
+        the window on close."""
+        p = self.policy
+        if time.monotonic() - self._window_started < p.window_seconds:
+            return False
+        if self._window_samples < max(1, p.window_min_requests):
+            return False
+        self._window_started = time.monotonic()
+        self._window_samples = 0
+        return True
+
+    def maybe_timed_evaluate(self):
+        """Time-mode grading tick, called by the router on EVERY routed
+        request while this rollout is active (cheap: one monotonic read
+        under the lock). No-op in request-count mode."""
+        if self.policy.window_seconds is None:
+            return
+        with self._lock:
+            if not self.active or not self._timed_window_closed_locked():
                 return
-            self._window_samples = 0
         self.evaluate()
 
     # --------------------------------------------------------- evaluation
@@ -395,6 +438,9 @@ class CanaryRollout:
                 "active": self.active,
                 "healthy_streak": self._healthy_streak,
                 "window_samples": self._window_samples,
+                "window_mode": ("time" if self.policy.window_seconds
+                                is not None else "requests"),
+                "window_seconds": self.policy.window_seconds,
                 "rollback_reason": self.rollback_reason,
                 "history": list(self.history),
                 "last_report": self.last_report,

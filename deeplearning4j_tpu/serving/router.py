@@ -107,6 +107,16 @@ class ServingRouter:
             cand = self._registry.get(candidate)
             if cand is self._primary:
                 raise ValueError("candidate is already the primary")
+            if cand.kind != self._primary.kind:
+                # a mis-kinded rollout would fail every canary-routed
+                # request with a wiring error the SLO gate never sees
+                # (raised before the per-version accounting) — refuse at
+                # the door instead
+                raise ValueError(
+                    f"candidate {candidate!r} is a {cand.kind} deploy "
+                    f"but the primary {self._primary.version!r} is "
+                    f"{self._primary.kind} — rollouts must not change "
+                    "the serving surface")
             if not cand.admitting:
                 raise RuntimeError(
                     f"candidate {candidate!r} is not live "
@@ -126,11 +136,27 @@ class ServingRouter:
     def output(self, x, deadline_ms: Optional[float] = None,
                request_key=None) -> np.ndarray:
         if not self._enabled:
-            # kill switch: byte-identical single-version passthrough
+            # kill switch: byte-identical single-version passthrough.
+            # A kind mismatch is a wiring error (ValueError); a scoring
+            # primary whose pi is gone was DRAINED — that is the typed
+            # lifecycle outcome, same as _serve raises
+            if self._primary.kind != "scoring":
+                raise ValueError(
+                    f"version {self._primary.version!r} is a "
+                    f"{self._primary.kind} deploy — output() needs a "
+                    "scoring deploy")
+            if self._primary.pi is None:
+                raise ShutdownError(
+                    f"version {self._primary.version!r} is not admitting "
+                    f"(state={self._primary.state})")
             return self._primary.pi.output(x, deadline_ms=deadline_ms)
         rollout = self._rollout
         if rollout is None or not rollout.active:
             return self._serve(self._primary, x, deadline_ms)
+        # time-mode rollouts grade on EVERY routed request, not only
+        # candidate-involved ones — a low-traffic candidate must not
+        # stall its own evaluation clock
+        rollout.maybe_timed_evaluate()
         frac = request_fraction(x, request_key)
         candidate = rollout.candidate
         if (rollout.share > 0.0 and frac < rollout.share
@@ -148,6 +174,117 @@ class ServingRouter:
                 rollout.record_candidate_event()
         return out
 
+    # ----------------------------------------------------------- generate
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 request_key=None) -> np.ndarray:
+        """Route one generation request across the registry's
+        GENERATIVE versions — same deterministic hash split, per-version
+        series, canary chaos point, and SLO-graded rollout as
+        :meth:`output`; shadow scoring compares the full emitted token
+        sequence (any mismatch is a divergence — sampled decode shadows
+        should pin greedy or share the engine seed)."""
+        if not self._enabled:
+            # same split as output(): kind mismatch = ValueError, a
+            # drained generative primary = typed ShutdownError
+            if self._primary.kind != "generative":
+                raise ValueError(
+                    f"version {self._primary.version!r} is a "
+                    f"{self._primary.kind} deploy — generate() needs a "
+                    "deploy_generative version")
+            gp = self._primary.gp
+            if gp is None:
+                raise ShutdownError(
+                    f"version {self._primary.version!r} is not admitting "
+                    f"generation (state={self._primary.state})")
+            return gp.generate(
+                prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                deadline_ms=deadline_ms)
+        rollout = self._rollout
+        if rollout is None or not rollout.active:
+            return self._serve_gen(self._primary, prompt, max_new_tokens,
+                                   eos_id, deadline_ms)
+        rollout.maybe_timed_evaluate()
+        frac = request_fraction(prompt, request_key)
+        candidate = rollout.candidate
+        if (rollout.share > 0.0 and frac < rollout.share
+                and candidate.admitting):
+            try:
+                return self._serve_gen(candidate, prompt, max_new_tokens,
+                                       eos_id, deadline_ms, canary=True)
+            finally:
+                rollout.record_candidate_event()
+        out = self._serve_gen(self._primary, prompt, max_new_tokens,
+                              eos_id, deadline_ms)
+        if (rollout.stage == RolloutState.SHADOW and candidate.admitting
+                and frac < rollout.policy.shadow_fraction):
+            # shadow work must never affect the user's response — and a
+            # full multi-token shadow GENERATION is seconds, not the one
+            # extra forward the scoring shadow costs. Run it off-path;
+            # the candidate event records when the shadow resolves, so
+            # windows grade against metrics that exist.
+            def _shadow(prompt=prompt, out=out):
+                try:
+                    self._shadow_generate(rollout, prompt, max_new_tokens,
+                                          eos_id, out)
+                finally:
+                    rollout.record_candidate_event()
+
+            threading.Thread(target=_shadow, daemon=True,
+                             name="dl4j-shadow-generate").start()
+        return out
+
+    def _serve_gen(self, dv, prompt, max_new_tokens, eos_id, deadline_ms,
+                   canary: bool = False) -> np.ndarray:
+        if dv.kind != "generative":
+            # a wiring error, not a lifecycle state — never typed
+            raise ValueError(
+                f"version {dv.version!r} is a {dv.kind} deploy — "
+                "generate() needs a deploy_generative version")
+        gp = dv.gp
+        if not dv.admitting or gp is None:
+            raise ShutdownError(
+                f"version {dv.version!r} is not admitting generation "
+                f"(state={dv.state})")
+        t0 = time.perf_counter()
+        try:
+            with dv.track():
+                if canary and _faults.armed():
+                    _faults.check("serving.canary")
+                out = gp.generate(prompt, max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id, deadline_ms=deadline_ms)
+        except Exception as e:
+            self._account(dv, t0, error=e)
+            raise
+        self._account(dv, t0)
+        return out
+
+    def _shadow_generate(self, rollout: CanaryRollout, prompt,
+                         max_new_tokens, eos_id, incumbent_out):
+        """Shadow-score one generation on the candidate (absorbed
+        errors, exact-sequence divergence)."""
+        dv = rollout.candidate
+        obs = serving_metrics()
+        gp = dv.gp
+        if gp is None:
+            return
+        t0 = time.perf_counter()
+        try:
+            with dv.track():
+                if _faults.armed():
+                    _faults.check("serving.canary")
+                out = gp.generate(prompt, max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id)
+        except Exception as e:
+            self._account(dv, t0, error=e)
+            obs.shadow(dv.version, "error").inc()
+            return
+        self._account(dv, t0)
+        match = bool(np.array_equal(np.asarray(out),
+                                    np.asarray(incumbent_out)))
+        obs.shadow(dv.version, "match" if match else "diverged").inc()
+
     @staticmethod
     def _account(dv, t0: float, error: Optional[BaseException] = None):
         """One routed request's per-version accounting (success and
@@ -160,6 +297,10 @@ class ServingRouter:
             obs.errors(dv.version).inc()
 
     def _serve(self, dv, x, deadline_ms, canary: bool = False) -> np.ndarray:
+        if dv.kind == "generative":
+            raise ValueError(
+                f"version {dv.version!r} is a generative deploy — use "
+                "generate(), not output()")
         # capture the pipeline BEFORE tracking: a concurrent drain nulls
         # dv.pi after its in-flight wait — a request racing that window
         # must land on the (shut down) instance and resolve typed, not

@@ -16,7 +16,8 @@ and the retry/shed/restore/quarantine event ring), ``/debug/elastic``
 (device-capacity view, mesh shrink/expand history, and the sharded
 elastic checkpoint manifests), ``/debug/deploy`` (versioned serving:
 deployed versions, rollout stage/share, SLO verdicts, drain states),
-``/debug/perf`` (the
+``/debug/generation`` (generative decode: per-pipeline slot tables,
+queue depth, KV-cache footprint), ``/debug/perf`` (the
 cost observatory: per-entry-point FLOPs/bytes, live MFU, roofline
 verdicts), ``/debug/profile`` (on-demand device profiling: ``?steps=N``
 captures N work units and serves the parsed top-K per-op table).
@@ -662,6 +663,24 @@ class UIServer:
                     from deeplearning4j_tpu.resilience import elastic
                     body = json.dumps(elastic.snapshot(),
                                       default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/generation":
+                    # generative decode state: every live pipeline's slot
+                    # table (who is decoding, at which position, under
+                    # which trace), queue depth, step counter, KV-cache
+                    # footprint — the first stop for "why is my
+                    # generation queued / slow". sys.modules guard like
+                    # the flight recorder: a process that never
+                    # generated answers empty without importing the
+                    # generation stack in the handler thread
+                    import sys as _sys
+                    _gen = _sys.modules.get(
+                        "deeplearning4j_tpu.parallel.generation")
+                    body = json.dumps(
+                        {"pipelines":
+                         (_gen.GenerationPipeline.live_snapshots()
+                          if _gen is not None else [])},
+                        default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/debug/perf":
                     # cost observatory: per-entry-point FLOPs / bytes

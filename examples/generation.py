@@ -1,0 +1,130 @@
+"""Generative decode end to end: KV cache, sampling, continuous batching.
+
+1. Builds a small ``TransformerLM`` and a ``DecodeEngine`` over it, then
+   generates greedily and with seeded top-k sampling — and shows the
+   incremental KV-cache decode emitting exactly the tokens the naive
+   full-recompute loop does, at a fraction of the work.
+2. Serves concurrent mixed-length requests through a
+   ``GenerationPipeline`` (continuous batching: requests join and leave
+   the slot batch at step boundaries) and prints the slot occupancy and
+   tokens/s the decode loop achieved.
+3. Deploys the engine as a generative version through
+   ``ModelRegistry.deploy_generative`` (prefill + decode AOT-warmed:
+   the first routed request compiles nothing) and walks
+   ``/debug/generation`` for the live slot table.
+
+Run: python examples/generation.py
+"""
+import os
+import sys
+
+if os.environ.get("DL4J_TPU_EXAMPLES_TPU") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+import jax
+
+from deeplearning4j_tpu.models.generation import (DecodeEngine,
+                                                  SamplerConfig,
+                                                  naive_generate)
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+from deeplearning4j_tpu.observability import compile_watch, global_registry
+from deeplearning4j_tpu.parallel.generation import GenerationPipeline
+from deeplearning4j_tpu.serving import ModelRegistry, ServingRouter
+from deeplearning4j_tpu.ui.server import UIServer
+
+VOCAB = 256
+
+
+def main():
+    cfg = TransformerConfig(vocab_size=VOCAB, n_layers=2, n_heads=4,
+                            d_model=64, max_len=128)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, VOCAB, (12,)).astype(np.int32)
+
+    # -- 1. the prefill/decode split -----------------------------------
+    engine = DecodeEngine(model, params, max_len=96)
+    t0 = time.perf_counter()
+    greedy = engine.generate(prompt[None], 24)[0]
+    kv_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ref = naive_generate(model, params, prompt[None], 24, pad_to=96)[0]
+    naive_s = time.perf_counter() - t0
+    assert np.array_equal(greedy, ref)
+    print(f"greedy continuation ({len(greedy)} tokens): "
+          f"{greedy[:10].tolist()}…")
+    print(f"  KV cache {kv_s * 1e3:.0f} ms vs naive full-recompute "
+          f"{naive_s * 1e3:.0f} ms — identical tokens")
+    sampled = DecodeEngine(
+        model, params, max_len=96, seed=7,
+        sampler=SamplerConfig(kind="topk", top_k=8, temperature=0.9)
+    ).generate(prompt[None], 24)[0]
+    print(f"top-k(8, T=0.9) sample, seed 7:   {sampled[:10].tolist()}…")
+
+    # -- 2. continuous batching ----------------------------------------
+    gp = GenerationPipeline(engine, slots=3, max_new_tokens=24)
+    done = []
+    # prompts drawn on the MAIN thread — numpy Generators are not
+    # thread-safe, and the workers only need their prompt, not the rng
+    prompts = [rng.integers(0, VOCAB, (4 + i,)).astype(np.int32)
+               for i in range(9)]
+
+    def one(i):
+        out = gp.generate(prompts[i], max_new_tokens=6 + (i * 7) % 18)
+        done.append(len(out))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(9)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    occ = global_registry().get("dl4j_decode_slot_occupancy_ratio")
+    print(f"continuous batching: {len(done)} mixed-length requests, "
+          f"{sum(done)} tokens in {wall:.2f}s "
+          f"({sum(done) / wall:.0f} tok/s)")
+    if occ is not None and occ.count:
+        print(f"  mean slot occupancy {occ.sum / occ.count:.2f} over "
+              f"{occ.count} steps")
+    gp.shutdown()
+
+    # -- 3. generative serving -----------------------------------------
+    registry = ModelRegistry()
+    dv = registry.deploy_generative(
+        "lm-v1", DecodeEngine(model, params, max_len=96), slots=2,
+        max_new_tokens=16)
+    router = ServingRouter(registry, "lm-v1")
+    watch = compile_watch.global_compile_watch()
+    before = watch.total
+    out = router.generate(prompt, max_new_tokens=8)
+    print(f"deployed 'lm-v1' (warmup {dv.warmup_seconds:.2f}s, buckets "
+          f"{dv.warmed_buckets}); first routed request -> {len(out)} "
+          f"tokens, {watch.total - before} new compiles")
+
+    ui = UIServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{ui.port}"
+        gen = json.loads(urllib.request.urlopen(
+            base + "/debug/generation", timeout=5).read())
+        print(f"/debug/generation -> {len(gen['pipelines'])} live "
+              "pipeline(s); slot table of the deployed version:")
+        for row in gen["pipelines"][0]["slot_table"]:
+            print(f"   {row}")
+    finally:
+        ui.stop()
+        registry.shutdown()
+
+
+if __name__ == "__main__":
+    main()

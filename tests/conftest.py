@@ -80,6 +80,13 @@ def _module_hygiene(request):
         ParallelInference.shutdown_all()
     except Exception:
         pass
+    try:
+        import sys
+        gen = sys.modules.get("deeplearning4j_tpu.parallel.generation")
+        if gen is not None:          # never import it just to shut it down
+            gen.GenerationPipeline.shutdown_all()
+    except Exception:
+        pass
     name = request.module.__name__.rpartition(".")[2]
     if name in _HEAVY_MODULES or _rss_mib() > 2500:
         import jax

@@ -519,6 +519,64 @@ def test_bench_diff_learns_multichip_dryruns(tmp_path):
     assert mod.main([str(tmp_path)]) == 1
 
 
+def test_bench_diff_learns_decode_schema(tmp_path):
+    """DECODE_r*.json decode-bench archives: the combined {kv, cb}
+    document loads both records, the A/B ratios + slot-occupancy mean
+    grade sustained-only like the bench ratios, raw tokens/s is never
+    gated, and alien/unreadable JSON is ignored."""
+    import json as _json
+    mod = _load_tool("bench_diff")
+
+    def write(rnd, kv_ratio, occ):
+        p = tmp_path / f"DECODE_r{rnd:02d}.json"
+        p.write_text(_json.dumps({
+            "kv": {"metric": "decode_kv_cache", "platform": "cpu",
+                   "vs_naive": kv_ratio, "value": 500.0},
+            "cb": {"metric": "decode_continuous_batching",
+                   "platform": "cpu", "vs_static": 1.4,
+                   "slot_occupancy": occ, "value": 700.0}}))
+
+    for rnd, ratio in enumerate([7.0, 6.6, 7.2], start=1):
+        write(rnd, ratio, [0.85, 0.9])
+    samples = mod.load_decode(str(tmp_path))
+    assert len(samples) == 6               # 2 records per round
+    assert {s.metric for s in samples} == {
+        "decode_kv_cache", "decode_continuous_batching"}
+    assert mod.check_decode(samples) == []
+    assert mod.main([str(tmp_path)]) == 0
+    # a single dip is weather; a sustained collapse is a regression
+    write(4, 2.0, [0.86])
+    assert mod.check_decode(mod.load_decode(str(tmp_path))) == []
+    write(5, 2.1, [0.87])
+    regs = mod.check_decode(mod.load_decode(str(tmp_path)))
+    assert len(regs) == 1
+    assert regs[0].metric == "decode_kv_cache"
+    assert regs[0].series == "ab_ratio" and regs[0].rounds == (4, 5)
+    assert mod.main([str(tmp_path)]) == 1
+    # occupancy trajectory collapse is graded the same way
+    write(4, 7.0, [0.3]), write(5, 7.0, [0.3])
+    regs = mod.check_decode(mod.load_decode(str(tmp_path)))
+    assert [r.series for r in regs] == ["slot_occupancy"]
+    # alien / unreadable JSON is ignored, never fatal
+    (tmp_path / "DECODE_r06.json").write_text("not json {")
+    (tmp_path / "DECODE_r07.json").write_text('{"whatever": 1}')
+    assert len(mod.load_decode(str(tmp_path))) == 10
+
+
+def test_bench_diff_decode_raw_rate_is_not_gated(tmp_path):
+    """Raw tokens/s may crater (box weather) without failing the gate —
+    only the interleaved A/B ratios and occupancy grade."""
+    import json as _json
+    mod = _load_tool("bench_diff")
+    for rnd, rate in enumerate([900.0, 880.0, 910.0, 100.0, 95.0],
+                               start=1):
+        (tmp_path / f"DECODE_r{rnd:02d}.json").write_text(_json.dumps(
+            {"kv": {"metric": "decode_kv_cache", "platform": "cpu",
+                    "vs_naive": 7.0, "value": rate}}))
+    assert mod.check_decode(mod.load_decode(str(tmp_path))) == []
+    assert mod.main([str(tmp_path)]) == 0
+
+
 # ---------------------------------------------------------------------------
 # lints: metric naming + env-knob table stay green with the new series
 # ---------------------------------------------------------------------------

@@ -184,6 +184,37 @@ def test_healthy_rollout_advances_to_full_and_promotes():
         reg.shutdown()
 
 
+def test_time_based_rollout_window_advances_on_low_traffic():
+    """``window_seconds`` mode: a trickle of traffic far below
+    ``window_requests`` still advances the rollout on the wall clock
+    (the low-traffic generative-version fix), while a zero-sample window
+    never closes (``window_min_requests`` gate)."""
+    net_a, net_b, _ = _nets()
+    reg = _deploy_pair(net_a, net_b)
+    try:
+        router = ServingRouter(reg, "v1")
+        ro = router.begin_rollout("v2", _fast_policy(
+            window_seconds=0.08, window_min_requests=1,
+            window_requests=10 ** 6,     # count mode would never fire
+            min_latency_count=10 ** 6, min_requests=10 ** 6,
+            min_shadow=10 ** 6))
+        assert ro.snapshot()["window_mode"] == "time"
+        # a candidate with NO samples must not advance on elapsed time
+        time.sleep(0.1)
+        ro.maybe_timed_evaluate()
+        assert ro.stage == RolloutState.CANARY
+        deadline = time.monotonic() + 30
+        i = 0
+        while ro.active and time.monotonic() < deadline:
+            router.output(_x(2, seed=i), request_key=i)
+            i += 1
+            time.sleep(0.02)             # ~4 requests per window
+        assert ro.stage == RolloutState.FULL
+        assert router.primary.version == "v2"
+    finally:
+        reg.shutdown()
+
+
 def test_degraded_canary_rolls_back_with_no_dropped_requests(tmp_path):
     """The acceptance chaos test: a canary degraded by injected error
     faults is auto-rolled-back by the SLO gate; every request resolves

@@ -27,6 +27,13 @@ pure drift). Three rules make the comparison meaningful:
    the ratio estimator (default 25%). One bad round is weather; two in a
    row under a 25% drop is climate.
 
+Also graded, each under its own schema: ``MULTICHIP_r*.json`` driver
+dryruns (a boolean trajectory — the newest non-skipped round must pass)
+and ``DECODE_r*.json`` decode-bench archives (the interleaved KV-vs-naive
+/ continuous-vs-static A/B ratios plus the slot-occupancy trajectory,
+sustained-only like the bench ratios; raw tokens/s is reported, never
+gated). Alien/unreadable JSON is ignored, never fatal.
+
 Run standalone (``python tools/bench_diff.py [root]``, exit code =
 sustained regressions found) or from tests (tests/test_obs_perf.py
 imports ``check_trajectory`` with synthetic histories and ``main`` over
@@ -52,6 +59,7 @@ DEFAULT_TOLERANCE = 0.25
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)[^/]*\.json$")
 _MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)[^/]*\.json$")
+_DECODE_RE = re.compile(r"DECODE_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -159,6 +167,75 @@ def load_multichip(root: str) -> List[DryrunSample]:
     return out
 
 
+class DecodeSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                  # "decode_kv_cache" | "decode_continuous_batching"
+    platform: Optional[str]
+    ratio: Optional[float]       # vs_naive / vs_static — the interleaved
+                                 # A/B ratio, the only host-timed series
+                                 # worth gating on (drift divides out)
+    occupancy: Optional[float]   # mean of the slot-occupancy trajectory
+    tokens_per_s: Optional[float]  # reported, never gated (raw host rate)
+
+
+def load_decode(root: str) -> List[DecodeSample]:
+    """``DECODE_r*.json`` decode-bench archives. Accepts the bench's
+    combined ``{"kv": {...}, "cb": {...}}`` document, a single record,
+    or the driver wrapper (``{"parsed": ...}``); anything without a
+    ``decode_*`` metric — alien JSON — is ignored, never fatal."""
+    out: List[DecodeSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "DECODE_r*.json"))):
+        m = _DECODE_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        records = [doc] if "metric" in doc else [
+            v for v in doc.values() if isinstance(v, dict)]
+        for rec in records:
+            metric = str(rec.get("metric", ""))
+            if not metric.startswith("decode_"):
+                continue
+            ratio = rec.get("vs_naive", rec.get("vs_static"))
+            occ = rec.get("slot_occupancy")
+            occupancy = (float(statistics.mean(occ))
+                         if isinstance(occ, list) and occ
+                         and all(isinstance(o, (int, float)) for o in occ)
+                         else None)
+            value = rec.get("value")
+            out.append(DecodeSample(
+                round=int(m.group(1)), path=path, metric=metric,
+                platform=rec.get("platform"),
+                ratio=(float(ratio)
+                       if isinstance(ratio, (int, float)) else None),
+                occupancy=occupancy,
+                tokens_per_s=(float(value)
+                              if isinstance(value, (int, float))
+                              else None)))
+    return out
+
+
+def check_decode(samples: List[DecodeSample],
+                 tolerance: float = DEFAULT_TOLERANCE,
+                 sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the decode trajectories with the SAME noise-aware rules as
+    the bench rounds: newest file per round by mtime, same-platform
+    only, sustained-only, and only the interleaved A/B ratio + the
+    slot-occupancy trajectory (raw tokens/s is ±40% weather here)."""
+    return _grade_metric_groups(samples, [
+        ("ab_ratio", lambda s: s.ratio),
+        ("slot_occupancy", lambda s: s.occupancy),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -177,6 +254,38 @@ def check_multichip(samples: List[DryrunSample]) -> List[str]:
         return []
     return [f"MULTICHIP dryrun FAILING at r{latest.round:02d} "
             f"({latest.path})"]
+
+
+def _grade_metric_groups(samples, series_extractors, tolerance: float,
+                         sustain: int) -> List[Regression]:
+    """Shared per-metric grading scaffold for every sample schema:
+    group by metric, keep the newest FILE per round by mtime (a round
+    may archive several files for one metric; glob order would let a
+    stale suffixed archive shadow a fresh plain one — '_' sorts after
+    '.'), filter to the platform of the newest round's authoritative
+    file (a stale archive can't flip the trajectory's platform either),
+    then grade each (series, extractor) trajectory sustained-only."""
+    by_metric: Dict[str, list] = {}
+    for s in samples:
+        by_metric.setdefault(s.metric, []).append(s)
+    out: List[Regression] = []
+    for metric, group in sorted(by_metric.items()):
+        group.sort(key=lambda s: s.round)
+        newest: Dict[int, object] = {}
+        for s in group:
+            prev = newest.get(s.round)
+            if prev is None or _file_mtime(s.path) >= _file_mtime(prev.path):
+                newest[s.round] = s
+        platform = newest[max(newest)].platform
+        ordered = [newest[r] for r in sorted(newest)
+                   if newest[r].platform == platform]
+        for series, extract in series_extractors:
+            pts = [(s.round, extract(s)) for s in ordered
+                   if extract(s) is not None]
+            reg = _grade_series(metric, series, pts, tolerance, sustain)
+            if reg is not None:
+                out.append(reg)
+    return out
 
 
 def _grade_series(metric: str, series: str, points: List[Tuple[int, float]],
@@ -202,44 +311,13 @@ def _grade_series(metric: str, series: str, points: List[Tuple[int, float]],
 def check_trajectory(samples: List[Sample],
                      tolerance: float = DEFAULT_TOLERANCE,
                      sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
-    """Grade every metric's history; returns the sustained regressions."""
-    by_metric: Dict[str, List[Sample]] = {}
-    for s in samples:
-        by_metric.setdefault(s.metric, []).append(s)
-    out: List[Regression] = []
-    for metric, group in sorted(by_metric.items()):
-        group.sort(key=lambda s: s.round)
-        # newest FILE per round by mtime FIRST (a round may archive
-        # several files for one metric; glob order would let a stale
-        # suffixed archive shadow a fresh plain one — '_' sorts after
-        # '.')
-        newest: Dict[int, Sample] = {}
-        for s in group:
-            prev = newest.get(s.round)
-            if prev is None or _file_mtime(s.path) >= _file_mtime(prev.path):
-                newest[s.round] = s
-        # rule 2: only rounds on the platform the trajectory is currently
-        # being measured on are comparable — "currently" read from the
-        # newest round's authoritative (mtime-newest) file, so a stale
-        # archive can't flip the trajectory's platform either
-        platform = newest[max(newest)].platform
-        ordered = [newest[r] for r in sorted(newest)
-                   if newest[r].platform == platform]
-        ratio_pts = [(s.round, s.vs_baseline) for s in ordered
-                     if s.vs_baseline is not None]
-        reg = _grade_series(metric, "vs_baseline", ratio_pts,
-                            tolerance, sustain)
-        if reg is not None:
-            out.append(reg)
-        # device-trace MFU: chip-clocked, so the tighter signal when the
-        # rounds have it (host-load drift cannot touch picosecond sums)
-        mfu_pts = [(s.round, s.mfu) for s in ordered
-                   if s.mfu is not None and s.device_timed]
-        reg = _grade_series(metric, "device_mfu", mfu_pts,
-                            tolerance, sustain)
-        if reg is not None:
-            out.append(reg)
-    return out
+    """Grade every metric's history; returns the sustained regressions.
+    device_mfu is chip-clocked, so it is the tighter signal when the
+    rounds have it (host-load drift cannot touch picosecond sums)."""
+    return _grade_metric_groups(samples, [
+        ("vs_baseline", lambda s: s.vs_baseline),
+        ("device_mfu", lambda s: s.mfu if s.device_timed else None),
+    ], tolerance, sustain)
 
 
 def main(argv=None) -> int:
@@ -248,13 +326,14 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__)), os.pardir))
     samples = load_samples(root)
     dryruns = load_multichip(root)
-    if not samples and not dryruns:
+    decodes = load_decode(root)
+    if not samples and not dryruns and not decodes:
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
               "nothing to grade")
         return 0
-    regressions = check_trajectory(samples)
+    regressions = check_trajectory(samples) + check_decode(decodes)
     breaks = check_multichip(dryruns)
     for s in samples:
         marks = []
@@ -268,13 +347,22 @@ def main(argv=None) -> int:
         state = ("skipped" if d.skipped else "ok" if d.ok else "FAIL")
         dev = f" devices={d.n_devices}" if d.n_devices else ""
         print(f"r{d.round:02d} multichip_dryrun {state}{dev}")
+    for s in decodes:
+        marks = []
+        if s.ratio is not None:
+            marks.append(f"ab_ratio={s.ratio:.3f}")
+        if s.occupancy is not None:
+            marks.append(f"occupancy={s.occupancy:.3f}")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + (" ".join(marks) or f"tokens/s={s.tokens_per_s}"))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
         print(b)
     if not regressions and not breaks:
         print(f"bench trajectory OK ({len(samples)} bench + "
-              f"{len(dryruns)} dryrun samples under {root})")
+              f"{len(dryruns)} dryrun + {len(decodes)} decode samples "
+              f"under {root})")
     return len(regressions) + len(breaks)
 
 
