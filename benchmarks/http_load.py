@@ -1,0 +1,487 @@
+#!/usr/bin/env python
+"""HTTP front-door load generator: heavy-tailed traffic, SLO grading,
+and the kill/respawn drill.
+
+Drives the REAL wire surface (``serving/frontdoor.py``) with a seeded
+open-loop load: Poisson arrivals at ``--qps`` with a heavy-tailed
+request mix — mostly cheap classifies, a Pareto-tailed minority of
+multi-token generations, a slice of SSE streams — because production
+traffic is never uniform and the tail is what kills SLOs. Grades the
+run with the SLO machinery (p50/p99 per route, goodput, shed/error
+ratios via the PR-3 ``_grade``) and emits ONE JSON line
+(``metric: http_serve``) the driver archives as ``SERVE_r*.json`` for
+``tools/bench_diff.py``'s sustained-only trajectory.
+
+Two modes:
+
+- **in-process** (default): one worker in this process; the classify
+  goodput is also measured DIRECT (in-process ``router.output``)
+  interleaved A/B-style, so ``vs_direct`` is the HTTP overhead ratio —
+  host-load drift divides out, which is the only host-timed series
+  worth gating on (the bench_diff discipline).
+- ``--workers N``: spawns a real ``tools/serve.py`` fleet (separate
+  processes + proxy + shared store) and drives it over the proxy.
+  ``--kill-drill`` additionally SIGKILLs one worker mid-load and
+  asserts the acceptance properties: **zero failed requests on the
+  survivors** (proxy failover), and the **respawned worker rejoins the
+  same rollout stage** from the shared store.
+
+Every run also pins streaming correctness: for one seeded prompt the
+SSE token sequence must equal the non-streamed result exactly, and the
+first-token latency must beat the full-sequence latency by a real
+margin (the reason per-token streaming exists).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+TYPED_CODES = (429, 503, 504)
+
+
+# ------------------------------------------------------------ HTTP client
+def _post(addr: str, path: str, doc: dict, timeout: float = 30.0):
+    req = urllib.request.Request(
+        addr + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(addr: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(addr + path, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _sse_generate(addr: str, doc: dict, timeout: float = 60.0):
+    """POST a streaming generate; returns (tokens, first_token_s,
+    total_s, done_payload)."""
+    req = urllib.request.Request(
+        addr + "/v1/generate",
+        data=json.dumps(dict(doc, stream=True)).encode(),
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    toks, first_at, done = [], None, None
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        ev = None
+        for line in r:
+            line = line.decode().rstrip("\n")
+            if line.startswith("event: "):
+                ev = line[7:]
+            elif line.startswith("data: "):
+                data = json.loads(line[6:])
+                if ev == "token":
+                    if first_at is None:
+                        first_at = time.perf_counter() - t0
+                    toks.append(data["token"])
+                elif ev == "done":
+                    done = data
+                elif ev == "error":
+                    raise RuntimeError(f"stream error: {data}")
+    return toks, first_at, time.perf_counter() - t0, done
+
+
+# ------------------------------------------------------------- load model
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.lat = {"classify": [], "generate": [], "stream": []}
+        self.ok = 0
+        self.typed = 0
+        self.failed = 0
+        self.conn_retries = 0
+        self.failures = []
+
+    def add(self, route: str, dt: float, outcome: str, detail=None):
+        with self.lock:
+            if outcome == "ok":
+                self.ok += 1
+                self.lat[route].append(dt)
+            elif outcome == "typed":
+                self.typed += 1
+            else:
+                self.failed += 1
+                if len(self.failures) < 16:
+                    self.failures.append(detail)
+
+
+def _quantile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(q * len(xs)))
+    return xs[i]
+
+
+def run_load(addr: str, rng, qps: float, duration_s: float,
+             max_new_cap: int = 24, prompt_len: int = 7,
+             stats: "_Stats" = None) -> "_Stats":
+    """Open-loop seeded load against ``addr`` for ``duration_s``:
+    Poisson arrivals, 70/20/10 classify/generate/stream mix, generation
+    lengths Pareto-tailed (clipped at ``max_new_cap``) — the heavy tail
+    that makes continuous batching and shedding earn their keep."""
+    stats = stats or _Stats()
+    threads = []
+    t_end = time.monotonic() + duration_s
+
+    def one(kind: str, n_new: int, seed: int, x):
+        t0 = time.perf_counter()
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                if kind == "classify":
+                    _post(addr, "/v1/classify",
+                          {"inputs": [x], "request_key": seed})
+                elif kind == "generate":
+                    _post(addr, "/v1/generate",
+                          {"prompt": [1 + seed % 50] * prompt_len,
+                           "max_new_tokens": n_new, "request_key": seed})
+                else:
+                    _sse_generate(addr, {
+                        "prompt": [1 + seed % 50] * prompt_len,
+                        "max_new_tokens": n_new, "request_key": seed})
+                stats.add(kind, time.perf_counter() - t0, "ok")
+                return
+            except urllib.error.HTTPError as e:
+                stats.add(kind, 0.0,
+                          "typed" if e.code in TYPED_CODES else "failed",
+                          detail=f"{kind}: HTTP {e.code}")
+                return
+            except Exception as e:
+                # connection-level death (a SIGKILLed worker's in-flight
+                # request, a reset mid-stream): standard client behavior
+                # is ONE retry — it must land on a survivor through the
+                # proxy's failover, which is exactly the property the
+                # drill grades. Retries are counted, never hidden.
+                if attempts <= 1:
+                    with stats.lock:
+                        stats.conn_retries += 1
+                    continue
+                stats.add(kind, 0.0, "failed", detail=f"{kind}: {e!r}")
+                return
+
+    i = 0
+    while time.monotonic() < t_end:
+        # Poisson arrivals; the request mix and tail are drawn from the
+        # SAME seeded rng, so two runs issue identical traffic
+        gap = rng.expovariate(qps) if qps > 0 else 0.0
+        time.sleep(min(gap, 1.0))
+        u = rng.random()
+        kind = ("classify" if u < 0.7 else
+                "generate" if u < 0.9 else "stream")
+        # Pareto tail (alpha 1.5) clipped to the cache budget
+        n_new = min(max_new_cap, max(2, int(2 * rng.paretovariate(1.5))))
+        # all randomness drawn HERE (one thread, one seeded rng): two
+        # runs with the same seed issue identical traffic
+        x = [round(rng.uniform(0, 1), 6) for _ in range(4)]
+        t = threading.Thread(target=one, args=(kind, n_new, i, x),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        i += 1
+    for t in threads:
+        t.join(timeout=60.0)
+    return stats
+
+
+def check_streaming(addr: str, prompt, n_new: int) -> dict:
+    """The streaming acceptance pins: byte-identical tokens and a real
+    first-token win."""
+    doc = {"prompt": list(prompt), "max_new_tokens": n_new}
+    _, plain = _post(addr, "/v1/generate", doc)
+    t0 = time.perf_counter()
+    _post(addr, "/v1/generate", doc)      # timed non-stream run
+    full_s = time.perf_counter() - t0
+    toks, first_s, total_s, done = _sse_generate(addr, doc)
+    return {
+        "matches": toks == plain["tokens"] and done["tokens"] == toks,
+        "n_tokens": len(toks),
+        "first_token_ms": round(first_s * 1e3, 3) if first_s else None,
+        "full_ms": round(full_s * 1e3, 3),
+        "stream_total_ms": round(total_s * 1e3, 3),
+        "first_token_speedup": (round(full_s / first_s, 3)
+                                if first_s and first_s > 0 else None),
+    }
+
+
+# ----------------------------------------------------------- in-process AB
+def run_inproc(args, rng) -> dict:
+    """One in-process worker; interleaved HTTP-vs-direct classify
+    windows give the drift-immune ``vs_direct`` ratio."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import serve as _serve
+
+    reg, router, gen_router = _serve._build_demo(args.slots, True)
+    from deeplearning4j_tpu.serving import FrontDoor
+    fd = FrontDoor(router, gen_router, port=0,
+                   max_inflight=args.max_inflight).start()
+    addr = fd.get_address()
+    try:
+        stream = check_streaming(addr, [3, 1, 4, 1, 5, 9, 2], 12)
+        stats = run_load(addr, rng, args.qps, args.duration_s, stats=None)
+        # interleaved A/B: paired HTTP / direct windows, median of
+        # per-pair ratios (bench.py's paired_window_median discipline)
+        ratios = []
+        x = np.asarray([[0.1, 0.2, 0.3, 0.4]], "f4")
+        for pair in range(5):
+            t0 = time.perf_counter()
+            for i in range(16):
+                _post(addr, "/v1/classify",
+                      {"inputs": x.tolist(), "request_key": (pair, i)})
+            http_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for i in range(16):
+                router.output(x, request_key=(pair, i))
+            direct_s = time.perf_counter() - t0
+            if http_s > 0:
+                ratios.append(direct_s / http_s)
+        vs_direct = statistics.median(ratios) if ratios else None
+        return _record(args, stats, stream, vs_direct=vs_direct,
+                       workers=1, kill_drill=None)
+    finally:
+        fd.stop()
+        reg.shutdown()
+
+
+# --------------------------------------------------------------- fleet mode
+def _fleet_store(state_dir):
+    from deeplearning4j_tpu.serving.shared_state import SharedStore
+    return SharedStore(state_dir)
+
+
+def run_fleet(args, rng) -> dict:
+    """Spawn a real tools/serve.py fleet, drive it over the proxy, and
+    (``--kill-drill``) SIGKILL + respawn one worker mid-load."""
+    state_dir = args.state_dir or f"/tmp/dl4j-http-load-{os.getpid()}"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve.py"),
+         "--workers", str(args.workers), "--port", "0",
+         "--state-dir", state_dir, "--slots", str(args.slots)],
+        stdout=subprocess.PIPE, text=True)
+    store = _fleet_store(state_dir)
+    try:
+        # read until the FLEET line (workers' announce lines may share
+        # the stream on older serve.py builds — never drive a worker
+        # directly: the drill's "survivors lose nothing" property is
+        # about the proxy)
+        fleet = None
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("tools/serve.py exited before "
+                                   "announcing the fleet")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "fleet" in doc:
+                fleet = doc
+                break
+        if fleet is None:
+            raise RuntimeError("fleet announce line never arrived")
+        addr = fleet["address"]
+        # wait until the fleet answers
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                _get(addr, "/debug/frontdoor", timeout=5.0)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet never answered")
+                time.sleep(0.5)
+        stream = check_streaming(addr, [3, 1, 4, 1, 5, 9, 2], 12)
+        # canary v2 with a fast shared policy: the fleet must advance it
+        # to FULL on aggregated windows while under load
+        _post(addr, "/admin/rollout", {
+            "lane": "scoring", "candidate": "v2",
+            "policy": {"window_seconds": max(0.5, args.duration_s / 10),
+                       "window_min_requests": 4, "healthy_windows": 1,
+                       "canary_fraction": 0.3, "ramp_fractions": [0.6]}})
+        stats = _Stats()
+        load = threading.Thread(
+            target=run_load,
+            args=(addr, rng, args.qps, args.duration_s),
+            kwargs={"stats": stats}, daemon=True)
+        load.start()
+        kill_drill = None
+        if args.kill_drill:
+            kill_drill = _kill_drill(store, addr, args)
+        load.join(timeout=args.duration_s + 120)
+        doc = store.read()
+        lane = (doc.get("lanes") or {}).get("scoring") or {}
+        ro = lane.get("rollout") or {}
+        rollout = {"final_stage": ro.get("stage"),
+                   "primary": lane.get("primary"),
+                   "history": [
+                       {k: e.get(k) for k in ("lane", "from", "to")}
+                       for e in doc.get("history", [])]}
+        return _record(args, stats, stream, vs_direct=None,
+                       workers=args.workers, kill_drill=kill_drill,
+                       rollout=rollout)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _kill_drill(store, addr: str, args) -> dict:
+    """SIGKILL one non-leader worker mid-load; wait for the parent to
+    respawn it; report the rejoin evidence. The zero-failed-on-survivors
+    assertion lands in the final record (stats.failed)."""
+    time.sleep(max(1.0, args.duration_s * 0.3))
+    doc = store.read()
+    workers = doc.get("workers") or {}
+    victims = sorted(workers)[1:] or sorted(workers)  # spare the leader
+    victim = victims[-1]
+    old_pid = int(workers[victim]["pid"])
+    stage_before = (((doc.get("lanes") or {}).get("scoring") or {})
+                    .get("rollout") or {}).get("stage")
+    os.kill(old_pid, signal.SIGKILL)
+    killed_at = time.time()
+    respawned = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        rec = (store.read().get("workers") or {}).get(victim) or {}
+        if (int(rec.get("pid", old_pid)) != old_pid
+                and float(rec.get("heartbeat", 0)) > killed_at):
+            respawned = rec
+            break
+        time.sleep(0.5)
+    doc = store.read()
+    stage_after = (((doc.get("lanes") or {}).get("scoring") or {})
+                   .get("rollout") or {}).get("stage")
+    rejoined_view = None
+    if respawned and respawned.get("port"):
+        try:
+            _, snap = _get(f"http://127.0.0.1:{respawned['port']}",
+                           "/debug/frontdoor")
+            sh = snap.get("shared") or {}
+            rollout = ((sh.get("lanes") or {}).get("scoring")
+                       or {}).get("rollout") or {}
+            rejoined_view = rollout.get("stage")
+        except Exception as e:
+            rejoined_view = f"unreachable: {e!r}"
+    return {
+        "victim": victim,
+        "old_pid": old_pid,
+        "respawned": bool(respawned),
+        "respawned_pid": int(respawned["pid"]) if respawned else None,
+        "stage_at_kill": stage_before,
+        "stage_after_respawn": stage_after,
+        "respawned_worker_sees_stage": rejoined_view,
+        # the stage the respawned worker reports must be the fleet's —
+        # "rejoins the same rollout stage"
+        "rejoined_same_stage": (rejoined_view == stage_after
+                                if respawned else False),
+    }
+
+
+# ----------------------------------------------------------------- record
+def _record(args, stats: "_Stats", stream: dict, vs_direct, workers,
+            kill_drill, rollout=None) -> dict:
+    from deeplearning4j_tpu.observability.slo import _grade
+    total = stats.ok + stats.typed + stats.failed
+    all_lat = [v for xs in stats.lat.values() for v in xs]
+    p50 = _quantile(all_lat, 0.50)
+    p99 = _quantile(all_lat, 0.99)
+    goodput = stats.ok / args.duration_s if args.duration_s > 0 else None
+    shed_ratio = stats.typed / total if total else 0.0
+    error_ratio = stats.failed / total if total else 0.0
+    slo = {
+        "p99": _grade(p99 or 0.0, args.p99_degraded_s, args.p99_failing_s),
+        "error_ratio": _grade(error_ratio, 0.01, 0.05),
+        "shed_ratio": _grade(shed_ratio, 0.2, 0.5),
+    }
+    try:
+        import jax
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
+    return {
+        "metric": "http_serve",
+        "platform": platform,
+        "value": goodput,
+        "unit": "ok_requests_per_s",
+        "goodput": goodput,
+        "vs_direct": vs_direct,
+        "ratio_method": "paired_window_median" if vs_direct else None,
+        "requests": total,
+        "ok": stats.ok,
+        "typed": stats.typed,
+        "failed": stats.failed,
+        "conn_retries": stats.conn_retries,
+        "failures": stats.failures,
+        "p50_ms": round(p50 * 1e3, 3) if p50 else None,
+        "p99_ms": round(p99 * 1e3, 3) if p99 else None,
+        "shed_ratio": round(shed_ratio, 4),
+        "error_ratio": round(error_ratio, 4),
+        "slo": slo,
+        "stream": stream,
+        "rollout": rollout,
+        "kill_drill": kill_drill,
+        "workers": workers,
+        "qps": args.qps,
+        "duration_s": args.duration_s,
+        "seed": args.seed,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--qps", type=float, default=20.0)
+    ap.add_argument("--duration-s", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="0 = in-process single worker; N = real fleet "
+                         "via tools/serve.py")
+    ap.add_argument("--kill-drill", action="store_true",
+                    help="SIGKILL one worker mid-load (needs "
+                         "--workers >= 2)")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-inflight", type=int, default=64)
+    ap.add_argument("--state-dir", default=None)
+    ap.add_argument("--p99-degraded-s", type=float, default=2.0)
+    ap.add_argument("--p99-failing-s", type=float, default=10.0)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    if args.kill_drill and args.workers < 2:
+        ap.error("--kill-drill needs --workers >= 2")
+    import random
+    rng = random.Random(args.seed)
+    rec = (run_fleet(args, rng) if args.workers
+           else run_inproc(args, rng))
+    line = json.dumps(rec)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    ok = (rec["failed"] == 0 and rec["stream"]["matches"]
+          and (rec["kill_drill"] is None
+               or (rec["kill_drill"]["respawned"]
+                   and rec["kill_drill"]["rejoined_same_stage"])))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

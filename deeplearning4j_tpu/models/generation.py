@@ -278,15 +278,27 @@ class DecodeEngine:
 
     # ------------------------------------------------- convenience loop
     def generate(self, prompts, max_new_tokens: int,
-                 eos_id: Optional[int] = None, return_logits: bool = False):
+                 eos_id: Optional[int] = None, return_logits: bool = False,
+                 on_token=None):
         """Single-batch generation without the serving pipeline: prefill
         once, then ``max_new_tokens − 1`` decode steps. ``prompts``
         (B, T) share one length. Returns (B, n_generated) int32 — or
-        (tokens, per-step logits list) with ``return_logits``."""
+        (tokens, per-step logits list) with ``return_logits``.
+
+        ``on_token(token, index)`` (optional, B=1 only) surfaces each
+        token at the step boundary that produced it — the same per-token
+        streaming contract ``GenerationPipeline.generate`` makes, minus
+        the cancel semantics (this loop has no slot to free; a callback
+        error simply propagates). Streaming forces a per-step host sync,
+        trading the single-fetch async dispatch chain for latency to
+        first token — exactly the tradeoff a streaming caller wants."""
         prompts = np.asarray(prompts, np.int32)
         if prompts.ndim == 1:
             prompts = prompts[None]
         B, T = prompts.shape
+        if on_token is not None and B != 1:
+            raise ValueError(
+                f"on_token streams a single sequence; got batch of {B}")
         if T + max_new_tokens > self.max_len:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
@@ -300,6 +312,8 @@ class DecodeEngine:
         # fetch at the end
         out = [first]
         logit_steps = [np.asarray(logits)[:, t - 1]] if return_logits else []
+        if on_token is not None:
+            on_token(int(np.asarray(first)[0]), 0)
         tokens = first
         positions = jnp.full((B,), t, jnp.int32)
         done = (np.asarray(first) == eos_id) if eos_id is not None else None
@@ -315,6 +329,8 @@ class DecodeEngine:
                     (self.params, cache, tokens, positions,
                      jnp.asarray(step, jnp.int32)))
             out.append(tokens)
+            if on_token is not None:
+                on_token(int(np.asarray(tokens)[0]), step)
             if return_logits:
                 logit_steps.append(np.asarray(logits))
             if done is not None:

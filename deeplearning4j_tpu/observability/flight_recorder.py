@@ -43,6 +43,8 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
   warmup, in-flight), rollout stage/share and its SLO verdicts
 - ``generation.json`` — the generative decode layer: per-pipeline slot
   tables (who was decoding, at which position), queue depth, cache size
+- ``frontdoor.json`` — the HTTP serving front door: in-flight gate,
+  lane routers, and the shared-store fleet view (multi-process mode)
 - ``perf.json`` — the cost observatory: per-entry-point FLOPs/bytes,
   live MFU vs. its rolling baseline, and roofline verdicts (was the
   process slow BEFORE it died?)
@@ -341,6 +343,10 @@ class FlightRecorder:
         # the generative decode layer: slot table, positions, queue depth
         # — a hang mid-generation must name which slots were decoding
         section("generation.json", self._write_generation)
+        # the HTTP front door: in-flight gate, lane routers, and (multi-
+        # process mode) the shared fleet view — a death under load must
+        # name what the wire surface was doing
+        section("frontdoor.json", self._write_frontdoor)
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -421,6 +427,16 @@ class FlightRecorder:
                      if gen is not None else [])
         with open(path, "w") as f:
             json.dump({"pipelines": pipelines}, f, indent=2, default=str)
+
+    @staticmethod
+    def _write_frontdoor(path: str):
+        # sys.modules guard, same rationale as _write_generation
+        import sys as _sys
+        fdm = _sys.modules.get("deeplearning4j_tpu.serving.frontdoor")
+        payload = (fdm.snapshot_all() if fdm is not None
+                   else {"frontdoors": []})
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
 
     @staticmethod
     def _write_metrics(path: str):

@@ -63,6 +63,14 @@ from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
 _TYPED_OUTCOMES = TYPED_OUTCOMES
 
 
+class StreamCancelled(ShedError):
+    """The streaming consumer walked away (its ``on_token`` callback
+    returned ``False`` or raised): the request stops decoding and its
+    slot frees at the next step boundary. A typed lifecycle outcome
+    (``ShedError`` subclass), never an error-rate event — a client
+    closing its SSE connection is load behavior, not a model failure."""
+
+
 class _GenMetrics:
     """Label-bound decode instruments (shared across instances, same
     rationale as ``_ServingMetrics``)."""
@@ -91,7 +99,8 @@ class _GenMetrics:
             "generation requests shed by admission control or deadlines",
             label_names=("reason",))
         self.shed = {r: shed.labels(reason=r)
-                     for r in ("queue_full", "deadline", "circuit_open")}
+                     for r in ("queue_full", "deadline", "circuit_open",
+                               "client_gone")}
         self.occupancy = reg.histogram(
             "dl4j_decode_slot_occupancy_ratio",
             "occupied slots / total slots per decode step (1.0 = the "
@@ -138,16 +147,20 @@ def _drop_gen_metrics():
 class _GenRequest(_Request):
     """One generation request riding the shared exactly-once machinery
     (``claim()``): ``x`` is the 1-D int32 prompt, ``out`` accumulates
-    emitted tokens while the request owns a slot."""
+    emitted tokens while the request owns a slot. ``on_token`` (when
+    set) streams each token out at the step boundary that produced it."""
 
-    __slots__ = ("max_new_tokens", "eos_id", "out", "t_slot_us")
+    __slots__ = ("max_new_tokens", "eos_id", "out", "t_slot_us",
+                 "on_token")
 
-    def __init__(self, x, max_new_tokens: int, eos_id: Optional[int]):
+    def __init__(self, x, max_new_tokens: int, eos_id: Optional[int],
+                 on_token=None):
         super().__init__(x)
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
         self.out: List[int] = []
         self.t_slot_us = 0.0
+        self.on_token = on_token
 
 
 class GenerationPipeline:
@@ -253,12 +266,23 @@ class GenerationPipeline:
 
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
-                 deadline_ms: Optional[float] = None) -> np.ndarray:
+                 deadline_ms: Optional[float] = None,
+                 on_token=None) -> np.ndarray:
         """Generate up to ``max_new_tokens`` continuation tokens for a
         1-D int32 ``prompt``. Blocks until the request resolves; raises
         the typed resilience outcomes (shed/deadline/circuit/shutdown)
         or the device error that killed it. Returns the emitted tokens
-        (1-D int32, possibly shorter on ``eos_id``)."""
+        (1-D int32, possibly shorter on ``eos_id``).
+
+        ``on_token(token, index)`` (optional) streams each emitted token
+        at the step boundary that produced it — the SSE per-token wire
+        surface rides this. It is called from the decode-loop thread, so
+        it must be fast and non-blocking (hand off to a queue, never
+        write a socket inline). Returning ``False`` or raising cancels
+        the request: it resolves with the typed :class:`StreamCancelled`
+        and its slot frees at the boundary — the disconnect-mid-stream
+        path can never leak a slot. The streamed sequence is exactly the
+        returned array: same tokens, same order, nothing elided."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -277,7 +301,7 @@ class GenerationPipeline:
         t0 = time.perf_counter()
         req = _GenRequest(prompt, n_new,
                           eos_id if eos_id is not None
-                          else self.default_eos_id)
+                          else self.default_eos_id, on_token=on_token)
         req.deadline = self._resolve_deadline(deadline_ms)
         with _flight().arm("generation_request"), \
                 _span("generation_request", prompt_tokens=int(prompt.size),
@@ -379,6 +403,22 @@ class GenerationPipeline:
         req.result = np.asarray(req.out, np.int32)
         req.event.set()
 
+    @staticmethod
+    def _emit_token(req: _GenRequest, tok: int) -> bool:
+        """Deliver one just-appended token to the request's streaming
+        callback (decode-thread context). Returns False when the
+        consumer cancelled — returned False or raised — and the caller
+        must shed the request (``client_gone``)."""
+        cb = req.on_token
+        if cb is None:
+            return True
+        try:
+            return cb(tok, len(req.out) - 1) is not False
+        except Exception:
+            # a broken consumer must never kill the decode loop the
+            # other slots are riding — treat exactly like a walk-away
+            return False
+
     def _fail_request(self, req: _GenRequest, error: BaseException):
         if not req.claim():
             return
@@ -466,6 +506,13 @@ class GenerationPipeline:
         done = (len(req.out) >= cap
                 or (req.eos_id is not None and first_tok == req.eos_id))
         obs.tokens.inc()
+        if not self._emit_token(req, first_tok):
+            if done:
+                self._resolve(req)       # complete anyway: result is whole
+            else:
+                self._shed_request(req, "client_gone", StreamCancelled(
+                    "streaming consumer cancelled during prefill"))
+            return False
         if done:
             self._resolve(req)
             return False
@@ -511,6 +558,13 @@ class GenerationPipeline:
                        and req.deadline.expired())
             done = (len(req.out) >= req.max_new_tokens
                     or (req.eos_id is not None and tok == req.eos_id))
+            if not self._emit_token(req, tok) and not done:
+                # consumer gone mid-stream: free the slot NOW — other
+                # slots keep decoding, nothing leaks
+                self._shed_request(req, "client_gone", StreamCancelled(
+                    "streaming consumer cancelled mid-stream"))
+                self._slot_req[slot] = None
+                continue
             if expired and not done:
                 self._shed_request(req, "deadline", DeadlineExceeded(
                     "request expired at a decode step boundary"))

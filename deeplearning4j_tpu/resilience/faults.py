@@ -17,6 +17,8 @@ Named injection points are threaded through the hot paths:
 ``serving.canary``          ServingRouter, on the canary version's path only
 ``generation.step``         GenerationPipeline decode loop, once per step
                             boundary (prefill joins + the decode step)
+``http.request``            FrontDoor, at the door of every ``/v1/*``
+                            request (after admission, before routing)
 ``train.step``              MLN/CG ``_fit_batch`` before the jitted step
 ``checkpoint.save``         CheckpointListener / preemption / recovery saves
 ``checkpoint.restore``      ResilientTrainer checkpoint restore
@@ -72,7 +74,7 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 POINTS = ("data.next_batch", "inference.dispatch", "inference.device_execute",
-          "serving.canary", "generation.step", "train.step",
+          "serving.canary", "generation.step", "http.request", "train.step",
           "checkpoint.save", "checkpoint.restore", "checkpoint.manifest",
           "allreduce")
 KINDS = ("error", "crash", "latency", "nan", "host_loss")

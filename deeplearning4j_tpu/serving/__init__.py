@@ -32,17 +32,34 @@ Three modules:
   ``DL4J_TPU_ROLLOUT=0`` degrades to a byte-identical single-version
   passthrough.
 
+Two further modules grow this into a *network* serving tier (the HTTP
+front door PR):
+
+- :mod:`~deeplearning4j_tpu.serving.frontdoor` — :class:`FrontDoor`:
+  the HTTP/SSE wire surface (``POST /v1/classify``, ``POST /v1/generate``
+  with per-token streaming, typed-error → status mapping, admission
+  control, the ``http.request`` chaos point, ``dl4j_http_*`` metrics).
+- :mod:`~deeplearning4j_tpu.serving.shared_state` — :class:`SharedStore`
+  + :class:`SharedServingState`: the file-backed CAS store N worker
+  processes coordinate through (one version set, consistent canary
+  splits, fleet-aggregated SLO windows, shared drains).
+
 Surfaces: ``UIServer GET /debug/deploy`` and ``deploy.json`` in
 flight-recorder bundles both serve :func:`snapshot`.
 """
+from deeplearning4j_tpu.serving.frontdoor import (FrontDoor,
+                                                  frontdoor_enabled)
 from deeplearning4j_tpu.serving.registry import DeployedVersion, ModelRegistry
 from deeplearning4j_tpu.serving.rollout import (CanaryRollout, RolloutPolicy,
                                                 RolloutState)
 from deeplearning4j_tpu.serving.router import ServingRouter, rollout_enabled
+from deeplearning4j_tpu.serving.shared_state import (SharedServingState,
+                                                     SharedStore)
 
 __all__ = [
     "ModelRegistry", "DeployedVersion", "CanaryRollout", "RolloutPolicy",
     "RolloutState", "ServingRouter", "rollout_enabled", "snapshot",
+    "FrontDoor", "frontdoor_enabled", "SharedStore", "SharedServingState",
 ]
 
 

@@ -1,0 +1,733 @@
+"""HTTP/SSE serving front door: the network wire surface on the router.
+
+The serving stack ended at Python call boundaries — ``ServingRouter``
+had no wire surface at all, which is exactly the gap between "a serving
+library" and "a server" (the reference DL4J shipped its Vert.x HTTP
+serving/UI layer as a first-class product; the TensorFlow system paper,
+Abadi et al. arXiv:1605.08695 §9, treats the network serving tier as
+part of the system). :class:`FrontDoor` is that tier, built on the same
+dependency-free ``ThreadingHTTPServer`` pattern as ``ui/server.py`` but
+hardened as a traffic surface:
+
+- ``POST /v1/classify`` — JSON in/out through the router's versioned
+  ``output()`` (hash-split rollout, per-version SLOs, drains — all of
+  PR 9 behind one URL).
+- ``POST /v1/generate`` — KV-cache generation; with ``"stream": true``
+  the response is **server-sent events, one event per token**, emitted
+  at the decode step boundary that produced each token (the
+  ``on_token`` plumbing through router → pipeline → decode loop). The
+  streamed sequence is byte-identical to the non-streamed result for
+  the same seed/version; a client that disconnects mid-stream cancels
+  its request at the next step boundary — the slot frees, typed as
+  ``StreamCancelled``, never leaked.
+- **Typed errors map to HTTP statuses**: shed/admission → 429, circuit
+  open / shutdown / disabled → 503, deadline → 504, wiring errors →
+  400, unknown version → 404, everything else → 500. Per-request
+  deadlines ride the body (``deadline_ms``) into the same
+  ``Deadline`` machinery the in-process callers use.
+- Every response carries the request's causal ``X-Dl4j-Trace-Id``
+  header, so a slow HTTP request can be joined against ``/train/trace``
+  spans and flight-recorder bundles.
+- Admission control: a bounded in-flight gate (``max_inflight``) sheds
+  with 429 before a traffic spike can pile threads onto the device
+  queues; the ``http.request`` chaos point fires at the door so the
+  whole surface is drivable under injected faults.
+- **Multi-process mode**: constructed with a
+  :class:`~deeplearning4j_tpu.serving.shared_state.SharedServingState`,
+  routing decisions (primary, canary split, stage) come from the shared
+  store — N worker processes answer as one fleet — and a background
+  sync thread heartbeats, publishes SLO windows, and applies the
+  leader's stage transitions (promote/drain) locally.
+
+Observability: ``dl4j_http_*`` series on ``/metrics``, a ``/debug/
+frontdoor`` endpoint (also folded into flight-recorder bundles as
+``frontdoor.json`` and mirrored by the UI server), and the live kill
+switch ``DL4J_TPU_FRONTDOOR=0`` (resolved per request) that answers 503
+on ``/v1/*`` while keeping the debug surfaces up — the "drain this
+replica at the load balancer" lever.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.observability import global_registry, on_registry_reset
+from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability.tracing import (current_context,
+                                                      trace_context)
+from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
+                                                  CircuitOpenError,
+                                                  DeadlineExceeded, ShedError,
+                                                  ShutdownError)
+from deeplearning4j_tpu.serving.router import request_fraction
+# ONE bind-host knob for both HTTP surfaces (the UI server owns the
+# spelling) — the two servers must never drift on what the knob means
+from deeplearning4j_tpu.ui.server import default_bind_host  # noqa: F401
+
+#: request bodies above this are refused with 413 BEFORE buffering — a
+#: hardened door must not let one Content-Length header OOM the process
+MAX_BODY_BYTES = 16 << 20
+
+
+def frontdoor_enabled() -> bool:
+    """``DL4J_TPU_FRONTDOOR`` kill switch, resolved LIVE (per request —
+    flipping it 503s new traffic without restarting the process; the
+    debug/metrics surfaces stay up)."""
+    return os.environ.get("DL4J_TPU_FRONTDOOR", "1") != "0"
+
+
+class BadRequest(ValueError):
+    """Malformed request body/params — HTTP 400, never an error-rate
+    event (client bugs are not model failures)."""
+
+
+class PayloadTooLarge(ValueError):
+    """Request body over :data:`MAX_BODY_BYTES` — HTTP 413, refused
+    before a byte of it is buffered."""
+
+
+def http_status(exc: BaseException) -> int:
+    """The typed-outcome → HTTP status mapping (one spelling: the JSON
+    error path, the SSE error event, and the tests all read this)."""
+    if isinstance(exc, (ShedError,)):          # incl. StreamCancelled
+        return 429
+    if isinstance(exc, DeadlineExceeded):
+        return 504
+    if isinstance(exc, (CircuitOpenError, ShutdownError)):
+        return 503
+    if isinstance(exc, KeyError):              # unknown version
+        return 404
+    if isinstance(exc, PayloadTooLarge):
+        return 413
+    if isinstance(exc, (BadRequest, ValueError, TypeError)):
+        return 400
+    return 500                                 # device errors, InjectedFault
+
+
+class _HttpMetrics:
+    """Label-bound ``dl4j_http_*`` instruments (registry-reset safe,
+    the serving/_GenMetrics pattern)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        reg = global_registry()
+        self._requests = reg.counter(
+            "dl4j_http_requests_total",
+            "front-door HTTP requests answered, by route and status code",
+            label_names=("route", "code"))
+        self._latency = reg.histogram(
+            "dl4j_http_latency_seconds",
+            "front-door request wall time from parse to last byte, by "
+            "route (streams: until the final SSE event)",
+            label_names=("route",))
+        self.inflight = reg.gauge(
+            "dl4j_http_inflight",
+            "front-door requests currently being served (admission gate "
+            "sheds above max_inflight)")
+        shed = reg.counter(
+            "dl4j_http_shed_total",
+            "front-door requests shed at the door, by reason",
+            label_names=("reason",))
+        self.shed = {r: shed.labels(reason=r)
+                     for r in ("inflight", "disabled")}
+        self.stream_tokens = reg.counter(
+            "dl4j_http_stream_tokens_total",
+            "tokens emitted over SSE streams (rate = streamed tokens/s "
+            "on the wire)")
+        self.first_token = reg.histogram(
+            "dl4j_http_first_token_seconds",
+            "SSE streams: request start to the first token event on the "
+            "wire (the latency streaming exists to shrink)")
+        self.disconnects = reg.counter(
+            "dl4j_http_disconnects_total",
+            "clients that went away mid-response (streams cancel at the "
+            "next step boundary, slots freed)")
+
+    def requests(self, route: str, code: int):
+        return self._requests.labels(route=route, code=str(code))
+
+    def latency(self, route: str):
+        return self._latency.labels(route=route)
+
+    @classmethod
+    def get(cls) -> "_HttpMetrics":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+        return cls._instance
+
+
+@on_registry_reset
+def _drop_http_metrics():
+    _HttpMetrics._instance = None
+
+
+def _route_of(path: str) -> str:
+    if path == "/v1/classify":
+        return "classify"
+    if path == "/v1/generate":
+        return "generate"
+    if path.startswith("/admin/"):
+        return "admin"
+    if path.startswith("/debug/") or path in ("/metrics", "/health"):
+        return "debug"
+    return "other"
+
+
+class FrontDoor:
+    """One worker's HTTP front door. ``router`` serves the scoring lane
+    (``/v1/classify``), ``gen_router`` the generative lane
+    (``/v1/generate``); either may be None (the route 404s). With
+    ``shared`` set, routing state comes from the shared store (see
+    module doc) and a sync thread coordinates with the fleet."""
+
+    _live: "weakref.WeakSet[FrontDoor]" = weakref.WeakSet()
+
+    def __init__(self, router=None, gen_router=None, *, shared=None,
+                 host: Optional[str] = None, port: int = 0,
+                 max_inflight: int = 64,
+                 sync_interval_s: float = 0.25,
+                 worker_id: Optional[str] = None,
+                 reuse_port: bool = False):
+        self.router = router
+        self.gen_router = gen_router
+        self.shared = shared
+        self.worker_id = worker_id or (shared.worker_id if shared else "w0")
+        self.host = host
+        self.port = int(port)
+        self.max_inflight = int(max_inflight)
+        self.reuse_port = bool(reuse_port)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._sync_interval = float(sync_interval_s)
+        self._sync_stop = threading.Event()
+        self._sync_thread: Optional[threading.Thread] = None
+        self._started_at = time.time()
+        FrontDoor._live.add(self)
+
+    # ------------------------------------------------------------- lanes
+    def _lane_router(self, lane: str):
+        return self.router if lane == "scoring" else self.gen_router
+
+    def classify(self, x, deadline_ms=None, request_key=None):
+        """One classify request through whichever routing mode is wired
+        (shared store split or the local rollout machinery)."""
+        if self.router is None:
+            raise KeyError("no scoring deploy behind this front door")
+        if self.shared is None:
+            return self.router.output(x, deadline_ms=deadline_ms,
+                                      request_key=request_key), None
+        frac = request_fraction(x, request_key)
+        version, canary = self.shared.pick("scoring", frac)
+        if version is None:
+            raise KeyError("scoring lane has no primary in the shared "
+                           "store")
+        t0 = time.perf_counter()
+        try:
+            out = self.router.output_on(version, x, deadline_ms=deadline_ms,
+                                        canary=canary)
+        except Exception as e:
+            self.shared.record(version,
+                               ok=isinstance(e, TYPED_OUTCOMES),
+                               latency_s=time.perf_counter() - t0)
+            raise
+        self.shared.record(version, ok=True,
+                           latency_s=time.perf_counter() - t0)
+        return out, version
+
+    def generate(self, prompt, max_new_tokens=None, eos_id=None,
+                 deadline_ms=None, request_key=None, on_token=None):
+        if self.gen_router is None:
+            raise KeyError("no generative deploy behind this front door")
+        if self.shared is None:
+            return self.gen_router.generate(
+                prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                deadline_ms=deadline_ms, request_key=request_key,
+                on_token=on_token), None
+        frac = request_fraction(prompt, request_key)
+        version, canary = self.shared.pick("generative", frac)
+        if version is None:
+            raise KeyError("generative lane has no primary in the shared "
+                           "store")
+        t0 = time.perf_counter()
+        try:
+            out = self.gen_router.generate_on(
+                version, prompt, max_new_tokens=max_new_tokens,
+                eos_id=eos_id, deadline_ms=deadline_ms, canary=canary,
+                on_token=on_token)
+        except Exception as e:
+            self.shared.record(version,
+                               ok=isinstance(e, TYPED_OUTCOMES),
+                               latency_s=time.perf_counter() - t0)
+            raise
+        self.shared.record(version, ok=True,
+                           latency_s=time.perf_counter() - t0)
+        return out, version
+
+    # ----------------------------------------------------- shared syncing
+    def _apply_event(self, event: dict):
+        """Apply one leader transition locally: FULL → repoint this
+        worker's lane router and gracefully drain the old incumbent;
+        ROLLED_BACK → drain the local candidate. Errors are absorbed
+        (a version this worker never deployed is not its transition)."""
+        lane = event.get("lane")
+        router = self._lane_router(lane or "")
+        if router is None:
+            return
+        registry = router._registry
+        try:
+            if event.get("to") == "full":
+                router.repoint(event["candidate"])
+                old = event.get("old_primary")
+                if old and old != event["candidate"]:
+                    registry.retire(old)
+            elif event.get("to") == "rolled_back":
+                cand = event.get("candidate")
+                if cand:
+                    registry.retire(cand)
+        except Exception:
+            pass
+
+    def sync_once(self):
+        """One shared-store beat (the background thread's body; tests
+        and single-stepped drills call it directly)."""
+        if self.shared is None:
+            return []
+        events = self.shared.sync()
+        for e in events:
+            self._apply_event(e)
+        return events
+
+    def _sync_loop(self):
+        while not self._sync_stop.wait(self._sync_interval):
+            try:
+                self.sync_once()
+            except Exception:
+                # coordination must never kill the serving process; the
+                # next beat retries (store contention, transient fs)
+                pass
+
+    # -------------------------------------------------------------- serve
+    def start(self) -> "FrontDoor":
+        fd = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):     # quiet, like the UI server
+                pass
+
+            # ------------------------------------------------- plumbing
+            def _tid(self):
+                """This request's trace id: captured inside the span
+                (so ERROR replies emitted after it closed still carry
+                it), falling back to any live ambient context."""
+                tid = getattr(self, "_trace_id", None)
+                if tid is not None:
+                    return tid
+                ctx = current_context()
+                return ctx.trace_id if ctx is not None else None
+
+            def _reply(self, code: int, payload: dict, route: str,
+                       t0: float, extra_headers=()):
+                body = json.dumps(payload, default=str).encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    tid = self._tid()
+                    if tid is not None:
+                        self.send_header("X-Dl4j-Trace-Id", str(tid))
+                    for k, v in extra_headers:
+                        self.send_header(k, v)
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    _HttpMetrics.get().disconnects.inc()
+                obs = _HttpMetrics.get()
+                obs.requests(route, code).inc()
+                obs.latency(route).observe(time.perf_counter() - t0)
+
+            def _error(self, exc: BaseException, route: str, t0: float):
+                self._reply(http_status(exc), {
+                    "error": type(exc).__name__,
+                    "detail": str(exc),
+                }, route, t0)
+
+            def _read_json(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                if n > MAX_BODY_BYTES:
+                    raise PayloadTooLarge(
+                        f"body of {n} bytes exceeds the "
+                        f"{MAX_BODY_BYTES}-byte limit")
+                raw = self.rfile.read(n) if n > 0 else b"{}"
+                try:
+                    doc = json.loads(raw or b"{}")
+                except ValueError as e:
+                    raise BadRequest(f"body is not JSON: {e}")
+                if not isinstance(doc, dict):
+                    raise BadRequest("body must be a JSON object")
+                return doc
+
+            # --------------------------------------------------- routes
+            def do_POST(self):
+                path = urlparse(self.path).path
+                route = _route_of(path)
+                t0 = time.perf_counter()
+                self._trace_id = None
+                obs = _HttpMetrics.get()
+                if path not in ("/v1/classify", "/v1/generate",
+                                "/admin/rollout", "/admin/rollback"):
+                    self._reply(404, {"error": "NotFound", "path": path},
+                                route, t0)
+                    return
+                if path.startswith("/v1/") and not frontdoor_enabled():
+                    obs.shed["disabled"].inc()
+                    self._reply(503, {"error": "FrontDoorDisabled",
+                                      "detail": "DL4J_TPU_FRONTDOOR=0"},
+                                route, t0)
+                    return
+                admitted = False
+                if path.startswith("/v1/"):
+                    with fd._inflight_lock:
+                        if fd._inflight >= fd.max_inflight:
+                            obs.shed["inflight"].inc()
+                        else:
+                            fd._inflight += 1
+                            admitted = True
+                            obs.inflight.set(fd._inflight)
+                    if not admitted:
+                        self._reply(429, {
+                            "error": "ShedError",
+                            "detail": f"front door at max_inflight="
+                                      f"{fd.max_inflight}"}, route, t0)
+                        return
+                try:
+                    with _span("http_request", route=route):
+                        # capture the id while the span is OPEN: error
+                        # replies run after it closes and must still
+                        # carry the header (the join-to-traces contract
+                        # matters MOST for failing requests)
+                        ctx = current_context()
+                        self._trace_id = (ctx.trace_id
+                                          if ctx is not None else None)
+                        try:
+                            if _faults.armed():
+                                _faults.check("http.request")
+                            body = self._read_json()
+                            if path == "/v1/classify":
+                                self._classify(body, route, t0)
+                            elif path == "/v1/generate":
+                                self._generate(body, route, t0)
+                            elif path == "/admin/rollout":
+                                self._rollout(body, route, t0)
+                            else:
+                                self._rollback(body, route, t0)
+                        except Exception as e:
+                            self._error(e, route, t0)
+                finally:
+                    if admitted:
+                        with fd._inflight_lock:
+                            fd._inflight -= 1
+                            obs.inflight.set(fd._inflight)
+
+            def _classify(self, body: dict, route: str, t0: float):
+                if "inputs" not in body:
+                    raise BadRequest("missing 'inputs'")
+                try:
+                    x = np.asarray(body["inputs"], dtype="f4")
+                except (ValueError, TypeError) as e:
+                    raise BadRequest(f"inputs not numeric: {e}")
+                out, version = fd.classify(
+                    x, deadline_ms=body.get("deadline_ms"),
+                    request_key=body.get("request_key"))
+                payload = {"outputs": np.asarray(out).tolist(),
+                           "worker": fd.worker_id}
+                if version is not None:
+                    payload["version"] = version
+                self._reply(200, payload, route, t0)
+
+            def _parse_generate(self, body: dict):
+                if "prompt" not in body:
+                    raise BadRequest("missing 'prompt'")
+                try:
+                    prompt = np.asarray(body["prompt"],
+                                        np.int32).reshape(-1)
+                except (ValueError, TypeError) as e:
+                    raise BadRequest(f"prompt not integral: {e}")
+                mnt = body.get("max_new_tokens")
+                return prompt, (int(mnt) if mnt is not None else None)
+
+            def _generate(self, body: dict, route: str, t0: float):
+                prompt, mnt = self._parse_generate(body)
+                kw = dict(max_new_tokens=mnt, eos_id=body.get("eos_id"),
+                          deadline_ms=body.get("deadline_ms"),
+                          request_key=body.get("request_key"))
+                if body.get("stream"):
+                    self._generate_stream(prompt, kw, t0)
+                    return
+                out, version = fd.generate(prompt, **kw)
+                payload = {"tokens": np.asarray(out).tolist(),
+                           "worker": fd.worker_id}
+                if version is not None:
+                    payload["version"] = version
+                self._reply(200, payload, route, t0)
+
+            def _generate_stream(self, prompt, kw: dict, t0: float):
+                """SSE per-token streaming. The decode thread hands each
+                token to a bounded queue via ``on_token`` (never touching
+                the socket); this handler thread drains it onto the wire.
+                A write failure (client gone) flips ``dead`` — the next
+                callback returns False and the pipeline frees the slot
+                at the step boundary (typed ``StreamCancelled``)."""
+                obs = _HttpMetrics.get()
+                q: "queue.Queue" = queue.Queue(maxsize=4096)
+                dead = threading.Event()
+
+                def on_token(tok, idx):
+                    if dead.is_set():
+                        return False
+                    try:
+                        q.put_nowait((idx, int(tok)))
+                    except queue.Full:
+                        return False       # pathologically slow consumer
+                    return True
+
+                result: dict = {}
+                # the generation runs on a worker thread: hand the HTTP
+                # request's trace context across so the pipeline's spans
+                # join the SAME trace id the response header names
+                run_ctx = current_context()
+
+                def run():
+                    try:
+                        with trace_context(run_ctx):
+                            out, version = fd.generate(
+                                prompt, on_token=on_token, **kw)
+                        result["tokens"] = np.asarray(out).tolist()
+                        result["version"] = version
+                    except BaseException as e:
+                        result["error"] = e
+                    finally:
+                        q.put(None)
+
+                threading.Thread(target=run, daemon=True,
+                                 name="dl4j-frontdoor-gen").start()
+                # block for the FIRST token (or resolution) before
+                # committing to SSE: a request that dies at the door —
+                # shed, expired, unknown version — answers its real
+                # HTTP status, not a 200 stream with an error event
+                first_item = q.get()
+                if first_item is None:
+                    err = result.get("error")
+                    if err is not None:
+                        raise err
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                tid = self._tid()
+                if tid is not None:
+                    self.send_header("X-Dl4j-Trace-Id", str(tid))
+                self.end_headers()
+
+                def emit(text: str) -> bool:
+                    if dead.is_set():
+                        return False
+                    try:
+                        self.wfile.write(text.encode())
+                        self.wfile.flush()
+                        return True
+                    except (BrokenPipeError, ConnectionResetError,
+                            OSError):
+                        dead.set()
+                        obs.disconnects.inc()
+                        return False
+
+                first_at = None
+                item = first_item
+                while item is not None:            # None = resolution
+                    if item is not False:          # False = keepalive tick
+                        idx, tok = item
+                        if emit(f"event: token\ndata: "
+                                f"{json.dumps({'index': idx, 'token': tok})}"
+                                f"\n\n"):
+                            obs.stream_tokens.inc()
+                            if first_at is None:
+                                first_at = time.perf_counter()
+                                obs.first_token.observe(first_at - t0)
+                    try:
+                        item = q.get(timeout=1.0)
+                    except queue.Empty:
+                        emit(": keepalive\n\n")    # forces disconnect
+                        item = False               # detection when idle
+                err = result.get("error")
+                code = 200
+                if err is not None and not dead.is_set():
+                    code = http_status(err)
+                    emit(f"event: error\ndata: "
+                         + json.dumps({"error": type(err).__name__,
+                                       "detail": str(err),
+                                       "status": code}) + "\n\n")
+                elif err is None:
+                    done = {"tokens": result.get("tokens"),
+                            "n": len(result.get("tokens") or ()),
+                            "worker": fd.worker_id}
+                    if result.get("version") is not None:
+                        done["version"] = result["version"]
+                    emit("event: done\ndata: " + json.dumps(done) + "\n\n")
+                obs.requests("stream", code).inc()
+                obs.latency("stream").observe(time.perf_counter() - t0)
+
+            def _rollout(self, body: dict, route: str, t0: float):
+                lane = body.get("lane", "scoring")
+                candidate = body.get("candidate")
+                if not candidate:
+                    raise BadRequest("missing 'candidate'")
+                if fd.shared is not None:
+                    fd.shared.begin_rollout(lane, candidate,
+                                            body.get("policy"))
+                    self._reply(200, fd.shared.routing(lane), route, t0)
+                    return
+                router = fd._lane_router(lane)
+                if router is None:
+                    raise KeyError(f"no {lane} router on this front door")
+                from deeplearning4j_tpu.serving.rollout import RolloutPolicy
+                policy = RolloutPolicy(**(body.get("policy") or {}))
+                ro = router.begin_rollout(candidate, policy)
+                self._reply(200, ro.snapshot(), route, t0)
+
+            def _rollback(self, body: dict, route: str, t0: float):
+                lane = body.get("lane", "scoring")
+                reason = body.get("reason", "manual")
+                if fd.shared is not None:
+                    fd.shared.rollback(lane, reason)
+                    self._reply(200, fd.shared.routing(lane), route, t0)
+                    return
+                router = fd._lane_router(lane)
+                if router is None or router.rollout is None:
+                    raise KeyError(f"no active {lane} rollout")
+                router.rollout.rollback(reason)
+                self._reply(200, router.snapshot(), route, t0)
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                route = _route_of(path)
+                t0 = time.perf_counter()
+                self._trace_id = None
+                try:
+                    if path == "/debug/frontdoor":
+                        self._reply(200, fd.snapshot(), route, t0)
+                    elif path == "/metrics":
+                        from deeplearning4j_tpu.observability import metrics
+                        body = metrics().render_prometheus().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        obs = _HttpMetrics.get()
+                        obs.requests(route, 200).inc()
+                    elif path == "/health":
+                        from deeplearning4j_tpu.observability.slo import (
+                            FAILING, global_slo_engine)
+                        report = global_slo_engine().evaluate()
+                        self._reply(
+                            503 if report["status"] == FAILING else 200,
+                            {"status": report["status"],
+                             "failing_rules": report["failing_rules"],
+                             "degraded_rules": report["degraded_rules"],
+                             "worker": fd.worker_id,
+                             "uptime_seconds": round(
+                                 time.time() - fd._started_at, 3)},
+                            route, t0)
+                    else:
+                        self._reply(404, {"error": "NotFound",
+                                          "path": path}, route, t0)
+                except Exception as e:
+                    self._error(e, route, t0)
+
+        host = self.host if self.host is not None else default_bind_host()
+        if self.reuse_port:
+            # kernel-level scale-out (tools/serve.py --reuseport): every
+            # worker binds the SAME port; the kernel spreads accepts
+            import socket as _socket
+            self._httpd = ThreadingHTTPServer((host, self.port), Handler,
+                                              bind_and_activate=False)
+            self._httpd.socket.setsockopt(
+                _socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+            self._httpd.server_bind()
+            self._httpd.server_activate()
+        else:
+            self._httpd = ThreadingHTTPServer((host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="dl4j-frontdoor-http")
+        self._thread.start()
+        if self.shared is not None:
+            self._sync_thread = threading.Thread(
+                target=self._sync_loop, daemon=True,
+                name="dl4j-frontdoor-sync")
+            self._sync_thread.start()
+        return self
+
+    def stop(self):
+        self._sync_stop.set()
+        if self._sync_thread is not None:
+            self._sync_thread.join(timeout=5.0)
+            self._sync_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def get_address(self) -> str:
+        host = self.host or "127.0.0.1"
+        if host == "0.0.0.0":
+            host = "127.0.0.1"
+        return f"http://{host}:{self.port}"
+
+    # ------------------------------------------------------------ queries
+    def snapshot(self) -> dict:
+        out = {
+            "worker_id": self.worker_id,
+            "address": (self.get_address()
+                        if self._httpd is not None else None),
+            "enabled": frontdoor_enabled(),
+            "mode": "shared" if self.shared is not None else "local",
+            "inflight": self._inflight,
+            "max_inflight": self.max_inflight,
+            "uptime_seconds": round(time.time() - self._started_at, 3),
+            "scoring": (self.router.snapshot()
+                        if self.router is not None else None),
+            "generative": (self.gen_router.snapshot()
+                           if self.gen_router is not None else None),
+        }
+        if self.shared is not None:
+            out["shared"] = self.shared.snapshot()
+        return out
+
+
+def snapshot_all() -> dict:
+    """Every live front door's state — the ``/debug/frontdoor`` payload
+    on the UI server and ``frontdoor.json`` in flight-recorder bundles."""
+    return {"enabled": frontdoor_enabled(),
+            "frontdoors": [f.snapshot() for f in list(FrontDoor._live)
+                           if f._httpd is not None]}

@@ -178,13 +178,16 @@ class ServingRouter:
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 request_key=None) -> np.ndarray:
+                 request_key=None, on_token=None) -> np.ndarray:
         """Route one generation request across the registry's
         GENERATIVE versions — same deterministic hash split, per-version
         series, canary chaos point, and SLO-graded rollout as
         :meth:`output`; shadow scoring compares the full emitted token
         sequence (any mismatch is a divergence — sampled decode shadows
-        should pin greedy or share the engine seed)."""
+        should pin greedy or share the engine seed). ``on_token``
+        streams per-token at step boundaries (the HTTP/SSE surface) —
+        threaded to whichever version the hash split serves; shadow
+        generations never stream."""
         if not self._enabled:
             # same split as output(): kind mismatch = ValueError, a
             # drained generative primary = typed ShutdownError
@@ -200,11 +203,11 @@ class ServingRouter:
                     f"generation (state={self._primary.state})")
             return gp.generate(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                deadline_ms=deadline_ms)
+                deadline_ms=deadline_ms, on_token=on_token)
         rollout = self._rollout
         if rollout is None or not rollout.active:
             return self._serve_gen(self._primary, prompt, max_new_tokens,
-                                   eos_id, deadline_ms)
+                                   eos_id, deadline_ms, on_token=on_token)
         rollout.maybe_timed_evaluate()
         frac = request_fraction(prompt, request_key)
         candidate = rollout.candidate
@@ -212,11 +215,12 @@ class ServingRouter:
                 and candidate.admitting):
             try:
                 return self._serve_gen(candidate, prompt, max_new_tokens,
-                                       eos_id, deadline_ms, canary=True)
+                                       eos_id, deadline_ms, canary=True,
+                                       on_token=on_token)
             finally:
                 rollout.record_candidate_event()
         out = self._serve_gen(self._primary, prompt, max_new_tokens,
-                              eos_id, deadline_ms)
+                              eos_id, deadline_ms, on_token=on_token)
         if (rollout.stage == RolloutState.SHADOW and candidate.admitting
                 and frac < rollout.policy.shadow_fraction):
             # shadow work must never affect the user's response — and a
@@ -236,7 +240,7 @@ class ServingRouter:
         return out
 
     def _serve_gen(self, dv, prompt, max_new_tokens, eos_id, deadline_ms,
-                   canary: bool = False) -> np.ndarray:
+                   canary: bool = False, on_token=None) -> np.ndarray:
         if dv.kind != "generative":
             # a wiring error, not a lifecycle state — never typed
             raise ValueError(
@@ -253,7 +257,8 @@ class ServingRouter:
                 if canary and _faults.armed():
                     _faults.check("serving.canary")
                 out = gp.generate(prompt, max_new_tokens=max_new_tokens,
-                                  eos_id=eos_id, deadline_ms=deadline_ms)
+                                  eos_id=eos_id, deadline_ms=deadline_ms,
+                                  on_token=on_token)
         except Exception as e:
             self._account(dv, t0, error=e)
             raise
@@ -354,6 +359,54 @@ class ServingRouter:
         except Exception:         # shape mismatch IS a divergence
             match = False
         obs.shadow(dv.version, "match" if match else "diverged").inc()
+
+    # ----------------------------------------------- shared-store serving
+    # The multi-process front door routes by the SHARED store's stage and
+    # share (every worker must agree on the split), then serves the
+    # chosen version through these — the same per-version accounting,
+    # drain tracking, and canary chaos point as the local rollout path,
+    # without the local CanaryRollout state machine (the store's leader
+    # grades the fleet-aggregated windows instead).
+
+    def output_on(self, version: str, x,
+                  deadline_ms: Optional[float] = None,
+                  canary: bool = False) -> np.ndarray:
+        """Serve one scoring request on the NAMED version."""
+        return self._serve(self._registry.get(version), x, deadline_ms,
+                           canary=canary)
+
+    def generate_on(self, version: str, prompt,
+                    max_new_tokens: Optional[int] = None,
+                    eos_id: Optional[int] = None,
+                    deadline_ms: Optional[float] = None,
+                    canary: bool = False, on_token=None) -> np.ndarray:
+        """Serve one generation request on the NAMED version."""
+        return self._serve_gen(self._registry.get(version), prompt,
+                               max_new_tokens, eos_id, deadline_ms,
+                               canary=canary, on_token=on_token)
+
+    def repoint(self, version: str):
+        """Re-point the primary at ``version`` (shared-store promotion:
+        the store's leader declared FULL; this worker adopts it and the
+        caller drains the old incumbent). Refuses a non-admitting or
+        mis-kinded target — the same wiring guards begin_rollout makes."""
+        with self._lock:
+            dv = self._registry.get(version)
+            if dv is self._primary:
+                return
+            if dv.kind != self._primary.kind:
+                raise ValueError(
+                    f"version {version!r} is a {dv.kind} deploy but the "
+                    f"primary {self._primary.version!r} is "
+                    f"{self._primary.kind} — repoint must not change the "
+                    "serving surface")
+            if not dv.admitting:
+                raise ShutdownError(
+                    f"version {version!r} is not admitting "
+                    f"(state={dv.state})")
+            self._primary = dv
+            if self._enabled:
+                serving_metrics().traffic(dv.version).set(1.0)
 
     # ------------------------------------------------------------ queries
     def snapshot(self) -> dict:

@@ -1,0 +1,526 @@
+"""Shared rollout state for multi-process serving: one version set, N workers.
+
+One Python process used to own the whole deploy story (the PR-9 gap):
+registry, rollout stage, and drain state all lived in process memory, so
+a second server process could disagree with the first about which
+version was primary, where the canary split sat, or whether a drain had
+finished. This module moves that state to a **file-backed shared store**
+so ``tools/serve.py --workers N`` processes serve ONE consistent version
+set:
+
+- :class:`SharedStore` — a single JSON document with an atomic
+  compare-and-swap write path: every commit goes through
+  tmp + ``os.replace`` + fsync (the ``utils/serialization`` atomic-write
+  discipline) under an ``fcntl`` file lock, and carries a monotonically
+  increasing ``rev`` stamp. Readers never lock (rename is atomic — a
+  read sees a complete document or the previous one, never a torn one);
+  writers CAS on ``rev`` (:meth:`SharedStore.try_replace`) or serialize
+  through :meth:`SharedStore.update`. The lock is crash-safe: flock
+  releases when a SIGKILLed worker's fd closes.
+- :class:`SharedServingState` — the coordination layer the front door
+  rides: worker registration + heartbeats + leader election (lowest
+  alive worker id), two serving *lanes* (``scoring`` / ``generative``)
+  each with a primary and an optional shared rollout, deterministic
+  hash-split routing every worker computes identically
+  (``request_fraction`` is content-hashed, the share comes from the
+  store — so the same request canaries on every worker or on none), and
+  **fleet-aggregated SLO windows**: every worker publishes its
+  per-version request/error/latency counters into the store; the leader
+  closes time windows over the *aggregate* deltas and advances or rolls
+  back the shared stage. Transitions land in a sequenced history each
+  worker applies locally (promote → repoint + drain the old incumbent;
+  rolled_back → drain the candidate) — graceful drains happen in every
+  process, driven by one decision.
+
+A SIGKILLed worker's already-published window counters keep counting
+toward the current window (its traffic happened); a respawned worker
+reads the store at startup and **rejoins the same rollout stage** — the
+kill/respawn drill in ``benchmarks/http_load.py`` pins both properties.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+try:
+    import fcntl
+except ImportError:                      # pragma: no cover - POSIX only
+    fcntl = None
+
+from deeplearning4j_tpu.observability.slo import DEGRADED, FAILING, OK, _grade
+
+#: the two serving surfaces a fleet coordinates (a lane = one primary +
+#: at most one rollout; classify rides scoring, generate rides generative)
+LANES = ("scoring", "generative")
+
+#: shared-rollout stages (the store's state machine starts at canary —
+#: shadow scoring needs request-level output comparison, which is a
+#: single-process concern the local CanaryRollout already owns)
+CANARY, RAMP, FULL, ROLLED_BACK = "canary", "ramp", "full", "rolled_back"
+
+#: grading policy of one shared rollout (stored IN the document so every
+#: worker — including one spawned mid-rollout — grades from the same
+#: thresholds; ``None`` disables a grade, like the local RolloutPolicy)
+DEFAULT_POLICY = {
+    "canary_fraction": 0.05,
+    "ramp_fractions": (0.25, 0.5),
+    "window_seconds": 0.5,          # wall-clock window the leader closes
+    "window_min_requests": 8,       # candidate samples a window needs
+    "healthy_windows": 2,           # consecutive ok windows to advance
+    "error_rate_degraded": 0.02,
+    "error_rate_failing": 0.10,
+    "latency_ratio_degraded": 2.0,  # candidate mean / primary mean
+    "latency_ratio_failing": 4.0,
+    "min_latency_n": 8,             # samples BOTH sides need for the ratio
+}
+
+#: heartbeats older than this mark a worker dead (leader re-election);
+#: sized generously above the front door's sync cadence
+WORKER_TTL_S = 3.0
+
+_HISTORY_CAP = 128
+
+
+class SharedStore:
+    """One JSON document, atomically replaced, rev-stamped. See module doc."""
+
+    def __init__(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self._file = os.path.join(path, "state.json")
+        self._lockfile = os.path.join(path, ".state.lock")
+
+    # -------------------------------------------------------------- read
+    def read(self) -> dict:
+        """Lock-free read of the current document (``{"rev": 0}`` before
+        the first commit). ``os.replace`` is atomic, so a reader racing
+        a writer sees the old complete document, never a torn one."""
+        try:
+            with open(self._file, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {"rev": 0}
+        return doc if isinstance(doc, dict) else {"rev": 0}
+
+    # ------------------------------------------------------------- write
+    @contextmanager
+    def _locked(self):
+        fd = os.open(self._lockfile, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            if fcntl is not None:
+                fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            if fcntl is not None:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    def _write(self, doc: dict):
+        """tmp + fsync + atomic rename + directory fsync — a torn
+        ``state.json`` must be impossible, even through a power cut
+        (the ``utils/serialization`` atomic-write discipline)."""
+        tmp = f"{self._file}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._file)
+        dirfd = os.open(os.path.dirname(self._file) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+
+    def try_replace(self, doc: dict, expected_rev: int) -> bool:
+        """Compare-and-swap: commit ``doc`` only if the store is still at
+        ``expected_rev``. Returns False (and writes nothing) on a lost
+        race — the caller re-reads and retries."""
+        with self._locked():
+            cur = self.read()
+            if int(cur.get("rev", 0)) != int(expected_rev):
+                return False
+            out = dict(doc)
+            out["rev"] = int(expected_rev) + 1
+            out["stamp"] = time.time()
+            self._write(out)
+            return True
+
+    def update(self, mutate: Callable[[dict], Optional[dict]]) -> dict:
+        """Serialized read-modify-write: run ``mutate(doc)`` (edit in
+        place or return a replacement) under the file lock and commit
+        with a bumped ``rev``. A raising ``mutate`` commits nothing."""
+        with self._locked():
+            doc = self.read()
+            rev = int(doc.get("rev", 0))
+            out = mutate(doc)
+            if out is None:
+                out = doc
+            out["rev"] = rev + 1
+            out["stamp"] = time.time()
+            self._write(out)
+            return out
+
+
+def _zero() -> dict:
+    return {"n": 0, "err": 0, "lat_sum": 0.0, "lat_n": 0}
+
+
+def _agg(windows: dict, version: str) -> dict:
+    """Sum one version's cumulative counters across every worker that
+    ever published (dead workers included — their traffic happened)."""
+    out = _zero()
+    for per_worker in windows.values():
+        w = per_worker.get(version)
+        if not isinstance(w, dict):
+            continue
+        out["n"] += int(w.get("n", 0))
+        out["err"] += int(w.get("err", 0))
+        out["lat_sum"] += float(w.get("lat_sum", 0.0))
+        out["lat_n"] += int(w.get("lat_n", 0))
+    return out
+
+
+def _delta(cur: dict, base: Optional[dict]) -> dict:
+    base = base or _zero()
+    return {k: max(0, cur[k] - base.get(k, 0)) if k != "lat_sum"
+            else max(0.0, cur[k] - base.get(k, 0.0)) for k in cur}
+
+
+class SharedServingState:
+    """One worker's handle on the shared store. See module doc."""
+
+    def __init__(self, store: SharedStore, worker_id: str,
+                 routing_ttl_s: float = 0.2):
+        self.store = store
+        self.worker_id = str(worker_id)
+        self._lock = threading.Lock()
+        self._pending: Dict[str, dict] = {}       # version -> delta counters
+        self._routing_ttl = float(routing_ttl_s)
+        self._routing_cache: Tuple[float, dict] = (0.0, {})
+        # history watermark starts at the store's CURRENT head: a fresh
+        # handle (respawned worker) must adopt the present state, never
+        # replay transitions it wasn't alive for (register() re-anchors
+        # it too, but the sync thread may beat register in a race)
+        self._applied_seq = int(store.read().get("hseq", 0))
+        self._is_leader = False
+
+    # ------------------------------------------------------- registration
+    def register(self, pid: int, port: int):
+        """Announce this worker (called once at startup; the respawn
+        drill re-registers under the same worker id and inherits the
+        store's current stage — nothing here resets rollout state)."""
+        wid = self.worker_id
+
+        def mutate(doc):
+            workers = doc.setdefault("workers", {})
+            workers[wid] = {"pid": int(pid), "port": int(port),
+                            "heartbeat": time.time(),
+                            "started": time.time()}
+            doc.setdefault("lanes", {})
+            doc.setdefault("windows", {}).setdefault(wid, {})
+            doc.setdefault("history", [])
+            doc.setdefault("hseq", 0)
+        self.store.update(mutate)
+        # a (re)registered worker must not re-apply the fleet's past
+        # transitions — its local deploys already reflect store state
+        self._applied_seq = int(self.store.read().get("hseq", 0))
+
+    def ensure_lane(self, lane: str, primary: str):
+        """Set the lane's primary IF the lane is new — a respawned
+        worker must adopt the fleet's current primary, not reset it."""
+        if lane not in LANES:
+            raise ValueError(f"unknown lane {lane!r}; one of {LANES}")
+
+        def mutate(doc):
+            lanes = doc.setdefault("lanes", {})
+            lanes.setdefault(lane, {"primary": primary, "rollout": None})
+        self.store.update(mutate)
+
+    # ------------------------------------------------------------ routing
+    def routing(self, lane: str) -> dict:
+        """The lane's live routing view (cached ``routing_ttl_s`` so the
+        hot path reads the store a few times a second, not per request):
+        ``{"primary", "candidate", "stage", "share", "active"}``."""
+        now = time.monotonic()
+        with self._lock:
+            at, cache = self._routing_cache
+            if now - at < self._routing_ttl and lane in cache:
+                return cache[lane]
+        doc = self.store.read()
+        view = {}
+        for ln, st in (doc.get("lanes") or {}).items():
+            ro = st.get("rollout") or {}
+            view[ln] = {
+                "primary": st.get("primary"),
+                "candidate": ro.get("candidate"),
+                "stage": ro.get("stage"),
+                "share": float(ro.get("share", 0.0)),
+                "active": bool(ro.get("active")),
+            }
+        with self._lock:
+            self._routing_cache = (now, view)
+        return view.get(lane, {"primary": None, "candidate": None,
+                               "stage": None, "share": 0.0,
+                               "active": False})
+
+    def pick(self, lane: str, frac: float) -> Tuple[Optional[str], bool]:
+        """Deterministic hash-split: ``(version, is_canary)`` for one
+        request's routing coordinate — every worker computes the same
+        answer for the same request because both inputs (content hash,
+        store share) are shared."""
+        r = self.routing(lane)
+        if (r["active"] and r["share"] > 0.0 and r["candidate"]
+                and frac < r["share"]):
+            return r["candidate"], True
+        return r["primary"], False
+
+    # ---------------------------------------------------------- recording
+    def record(self, version: str, ok: bool, latency_s: float):
+        """Accumulate one served request locally (flushed to the store by
+        :meth:`sync` — per-request store writes would serialize the
+        fleet on the file lock)."""
+        with self._lock:
+            w = self._pending.setdefault(version, _zero())
+            w["n"] += 1
+            if not ok:
+                w["err"] += 1
+            w["lat_sum"] += float(latency_s)
+            w["lat_n"] += 1
+
+    # ------------------------------------------------------------ rollout
+    def begin_rollout(self, lane: str, candidate: str,
+                      policy: Optional[dict] = None) -> dict:
+        """Start a shared rollout of ``candidate`` on ``lane`` (refused
+        while one is active — same contract as the local router)."""
+        pol = dict(DEFAULT_POLICY)
+        pol.update(policy or {})
+        pol["ramp_fractions"] = list(pol["ramp_fractions"])
+
+        def mutate(doc):
+            st = (doc.setdefault("lanes", {})
+                  .setdefault(lane, {"primary": None, "rollout": None}))
+            ro = st.get("rollout")
+            if ro and ro.get("active"):
+                raise RuntimeError(
+                    f"a shared rollout of {ro.get('candidate')!r} is "
+                    f"already active on lane {lane!r}")
+            if not st.get("primary"):
+                raise RuntimeError(
+                    f"lane {lane!r} has no primary to canary against "
+                    "(ensure_lane first)")
+            if st.get("primary") == candidate:
+                raise ValueError("candidate is already the primary")
+            windows = doc.get("windows") or {}
+            st["rollout"] = {
+                "candidate": candidate,
+                "stage": CANARY,
+                "share": float(pol["canary_fraction"]),
+                "ramp_idx": -1,
+                "healthy_streak": 0,
+                "active": True,
+                "reason": None,
+                "policy": pol,
+                "started": time.time(),
+                "window_started": time.time(),
+                # baseline at start: the fleet's lifetime counters must
+                # not grade this rollout (the delta discipline the local
+                # canary rules follow)
+                "window_base": {
+                    candidate: _agg(windows, candidate),
+                    st.get("primary"): _agg(windows, st.get("primary")),
+                },
+            }
+            self._note(doc, lane, None, CANARY, share=pol["canary_fraction"])
+        out = self.store.update(mutate)
+        self._invalidate()
+        return out
+
+    def rollback(self, lane: str, reason: str = "manual") -> dict:
+        def mutate(doc):
+            st = (doc.get("lanes") or {}).get(lane) or {}
+            ro = st.get("rollout")
+            if not ro or not ro.get("active"):
+                return
+            prev = ro["stage"]
+            ro.update(stage=ROLLED_BACK, share=0.0, active=False,
+                      reason=reason)
+            self._note(doc, lane, prev, ROLLED_BACK, share=0.0,
+                       reason=reason)
+        out = self.store.update(mutate)
+        self._invalidate()
+        return out
+
+    @staticmethod
+    def _note(doc: dict, lane: str, prev: Optional[str], new: str,
+              **attrs):
+        doc["hseq"] = int(doc.get("hseq", 0)) + 1
+        event = {"seq": doc["hseq"], "at": time.time(), "lane": lane,
+                 "from": prev, "to": new}
+        ro = ((doc.get("lanes") or {}).get(lane) or {}).get("rollout") or {}
+        event["candidate"] = ro.get("candidate")
+        event["primary"] = ((doc.get("lanes") or {}).get(lane)
+                            or {}).get("primary")
+        event.update(attrs)
+        history = doc.setdefault("history", [])
+        history.append(event)
+        del history[:-_HISTORY_CAP]
+
+    # ---------------------------------------------------------------- sync
+    def sync(self) -> List[dict]:
+        """One coordination beat (the front door's background thread
+        calls this a few times a second): flush locally-accumulated
+        window counters, heartbeat, and — when this worker is the leader
+        — close due windows over the FLEET aggregate and advance/roll
+        back the shared stage. Returns the history events this worker
+        has not yet applied locally (promotions/rollbacks → the caller
+        repoints and drains its local deploys)."""
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        wid = self.worker_id
+
+        def mutate(doc):
+            workers = doc.setdefault("workers", {})
+            me = workers.setdefault(wid, {"pid": os.getpid(), "port": 0,
+                                          "started": time.time()})
+            me["heartbeat"] = time.time()
+            mine = doc.setdefault("windows", {}).setdefault(wid, {})
+            for version, d in pending.items():
+                w = mine.setdefault(version, _zero())
+                w["n"] += d["n"]
+                w["err"] += d["err"]
+                w["lat_sum"] += d["lat_sum"]
+                w["lat_n"] += d["lat_n"]
+            alive = [w for w, rec in workers.items()
+                     if time.time() - float(rec.get("heartbeat", 0))
+                     <= WORKER_TTL_S]
+            self._is_leader = bool(alive) and min(alive) == wid
+            if self._is_leader:
+                for lane, st in (doc.get("lanes") or {}).items():
+                    self._evaluate_lane(doc, lane, st)
+        try:
+            doc = self.store.update(mutate)
+        except BaseException:
+            # a failed store write must not LOSE the popped window
+            # counters — merge them back so the next beat flushes them
+            # (dropped samples would let the leader grade a window that
+            # silently undercounts a failing candidate's errors)
+            with self._lock:
+                for version, d in pending.items():
+                    w = self._pending.setdefault(version, _zero())
+                    for k in d:
+                        w[k] += d[k]
+            raise
+        self._invalidate()
+        events = [e for e in doc.get("history", [])
+                  if int(e.get("seq", 0)) > self._applied_seq]
+        if events:
+            self._applied_seq = max(int(e["seq"]) for e in events)
+        return events
+
+    def _evaluate_lane(self, doc: dict, lane: str, st: dict):
+        """Leader-only: close the lane's window if due and grade the
+        fleet-aggregated deltas (error rate + latency-mean ratio; any
+        non-ok grade rolls back, ok streaks advance — the local
+        CanaryRollout's promotion discipline over shared counters)."""
+        ro = st.get("rollout")
+        if not ro or not ro.get("active"):
+            return
+        pol = ro.get("policy") or DEFAULT_POLICY
+        now = time.time()
+        if now - float(ro.get("window_started", now)) \
+                < float(pol["window_seconds"]):
+            return
+        windows = doc.get("windows") or {}
+        cand, prim = ro["candidate"], st.get("primary")
+        base = ro.get("window_base") or {}
+        cand_cur = _agg(windows, cand)
+        prim_cur = _agg(windows, prim)
+        d_cand = _delta(cand_cur, base.get(cand))
+        d_prim = _delta(prim_cur, base.get(prim))
+        if d_cand["n"] < int(pol["window_min_requests"]):
+            return          # window stays open until samples arrive
+        status = OK
+        detail = {}
+        rate = d_cand["err"] / d_cand["n"]
+        detail["error_rate"] = rate
+        status = _worst(status, _grade(rate, pol["error_rate_degraded"],
+                                       pol["error_rate_failing"]))
+        if (d_cand["lat_n"] >= int(pol["min_latency_n"])
+                and d_prim["lat_n"] >= int(pol["min_latency_n"])
+                and d_prim["lat_sum"] > 0):
+            ratio = ((d_cand["lat_sum"] / d_cand["lat_n"])
+                     / (d_prim["lat_sum"] / d_prim["lat_n"]))
+            detail["latency_ratio"] = ratio
+            status = _worst(status, _grade(
+                ratio, pol["latency_ratio_degraded"],
+                pol["latency_ratio_failing"]))
+        ro["window_started"] = now
+        ro["window_base"] = {cand: cand_cur, prim: prim_cur}
+        ro["last_report"] = dict(detail, status=status,
+                                 window_requests=d_cand["n"])
+        if status in (DEGRADED, FAILING):
+            prev = ro["stage"]
+            ro.update(stage=ROLLED_BACK, share=0.0, active=False,
+                      reason=f"slo:{status} {detail}")
+            self._note(doc, lane, prev, ROLLED_BACK, share=0.0,
+                       reason=ro["reason"])
+            return
+        ro["healthy_streak"] = int(ro.get("healthy_streak", 0)) + 1
+        if ro["healthy_streak"] < int(pol["healthy_windows"]):
+            return
+        ro["healthy_streak"] = 0
+        prev = ro["stage"]
+        ramp = list(pol.get("ramp_fractions") or ())
+        idx = int(ro.get("ramp_idx", -1)) + 1
+        if idx < len(ramp):
+            ro.update(stage=RAMP, share=float(ramp[idx]), ramp_idx=idx)
+            self._note(doc, lane, prev, RAMP, share=ro["share"])
+        else:
+            old_primary = st.get("primary")
+            ro.update(stage=FULL, share=1.0, active=False)
+            st["primary"] = ro["candidate"]
+            self._note(doc, lane, prev, FULL, share=1.0,
+                       old_primary=old_primary)
+
+    def _invalidate(self):
+        with self._lock:
+            self._routing_cache = (0.0, {})
+
+    # ------------------------------------------------------------ queries
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    def alive_workers(self, ttl_s: float = WORKER_TTL_S) -> Dict[str, dict]:
+        now = time.time()
+        return {w: rec for w, rec
+                in (self.store.read().get("workers") or {}).items()
+                if now - float(rec.get("heartbeat", 0)) <= ttl_s}
+
+    def snapshot(self) -> dict:
+        doc = self.store.read()
+        now = time.time()
+        workers = {
+            w: dict(rec, alive=(now - float(rec.get("heartbeat", 0))
+                                <= WORKER_TTL_S))
+            for w, rec in (doc.get("workers") or {}).items()}
+        return {
+            "path": self.store.path,
+            "rev": doc.get("rev", 0),
+            "worker_id": self.worker_id,
+            "is_leader": self._is_leader,
+            "lanes": doc.get("lanes", {}),
+            "workers": workers,
+            "history": doc.get("history", [])[-16:],
+        }
+
+
+_SEVERITY = {OK: 0, DEGRADED: 1, FAILING: 2}
+
+
+def _worst(a: str, b: str) -> str:
+    return a if _SEVERITY[a] >= _SEVERITY[b] else b

@@ -17,7 +17,9 @@ and the retry/shed/restore/quarantine event ring), ``/debug/elastic``
 elastic checkpoint manifests), ``/debug/deploy`` (versioned serving:
 deployed versions, rollout stage/share, SLO verdicts, drain states),
 ``/debug/generation`` (generative decode: per-pipeline slot tables,
-queue depth, KV-cache footprint), ``/debug/perf`` (the
+queue depth, KV-cache footprint), ``/debug/frontdoor`` (HTTP serving
+front doors: mode, in-flight gate, lane routers, shared-store fleet
+view), ``/debug/perf`` (the
 cost observatory: per-entry-point FLOPs/bytes, live MFU, roofline
 verdicts), ``/debug/profile`` (on-demand device profiling: ``?steps=N``
 captures N work units and serves the parsed top-K per-op table).
@@ -27,11 +29,20 @@ from __future__ import annotations
 import html as _html
 import json
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
+
+
+def default_bind_host() -> str:
+    """``DL4J_TPU_UI_HOST`` — bind host for the UI server AND the
+    serving front door (one knob, one meaning). Default stays loopback:
+    exposing training telemetry off-box is an explicit decision
+    (``0.0.0.0``), never an accident."""
+    return os.environ.get("DL4J_TPU_UI_HOST", "127.0.0.1")
 
 
 def _svg_histogram(counts, lo, hi, width=220, height=80, title="") -> str:
@@ -146,8 +157,9 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, host: Optional[str] = None):
         self.port = port
+        self.host = host            # None → DL4J_TPU_UI_HOST at start()
         self._storages: List = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -682,6 +694,22 @@ class UIServer:
                           if _gen is not None else [])},
                         default=str).encode()
                     ctype = "application/json"
+                elif parsed.path == "/debug/frontdoor":
+                    # HTTP front-door state: every live door's mode
+                    # (local / shared-store), in-flight gate, lane
+                    # router snapshots, and the shared fleet view
+                    # (workers, stages, history) — the first stop for
+                    # "which worker answered and at which stage".
+                    # sys.modules guard like /debug/generation: a
+                    # process with no front door answers empty
+                    import sys as _sys
+                    _fdm = _sys.modules.get(
+                        "deeplearning4j_tpu.serving.frontdoor")
+                    body = json.dumps(
+                        (_fdm.snapshot_all() if _fdm is not None
+                         else {"frontdoors": []}),
+                        default=str).encode()
+                    ctype = "application/json"
                 elif parsed.path == "/debug/perf":
                     # cost observatory: per-entry-point FLOPs / bytes
                     # accessed (XLA cost model), live MFU vs. its rolling
@@ -772,7 +800,9 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        host = self.host if self.host is not None else default_bind_host()
+        self._httpd = ThreadingHTTPServer((host, self.port), Handler)
+        self.host = self._httpd.server_address[0]
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -786,6 +816,9 @@ class UIServer:
             self._httpd = None
 
     def get_address(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        host = self.host or "127.0.0.1"
+        if host == "0.0.0.0":       # a wildcard bind is still reached
+            host = "127.0.0.1"      # locally via loopback
+        return f"http://{host}:{self.port}"
 
     getAddress = get_address

@@ -312,9 +312,9 @@ def test_flight_recorder_dump_bundle_contents(tmp_path):
     bundle = rec.dump("unit-test")
     files = sorted(os.listdir(bundle))
     assert files == ["compiles.json", "config.json", "deploy.json",
-                     "elastic.json", "generation.json", "metrics.prom",
-                     "numerics.json", "perf.json", "resilience.json",
-                     "threads.txt", "trace.json"]
+                     "elastic.json", "frontdoor.json", "generation.json",
+                     "metrics.prom", "numerics.json", "perf.json",
+                     "resilience.json", "threads.txt", "trace.json"]
     trace = json.loads(open(os.path.join(bundle, "trace.json")).read())
     assert any(e.get("name") == "doomed_section" for e in trace)
     prom = open(os.path.join(bundle, "metrics.prom")).read()

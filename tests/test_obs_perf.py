@@ -577,6 +577,57 @@ def test_bench_diff_decode_raw_rate_is_not_gated(tmp_path):
     assert mod.main([str(tmp_path)]) == 0
 
 
+def test_bench_diff_learns_serve_schema(tmp_path):
+    """SERVE_r*.json HTTP-load archives (benchmarks/http_load.py): the
+    interleaved vs_direct ratio + goodput grade sustained-only, raw
+    p50/p99 latency is never gated, driver wrappers are unwrapped, and
+    alien/unreadable JSON is ignored."""
+    import json as _json
+    mod = _load_tool("bench_diff")
+
+    def write(rnd, ratio, goodput, p99=150.0, wrap=False):
+        rec = {"metric": "http_serve", "platform": "cpu",
+               "vs_direct": ratio, "goodput": goodput, "value": goodput,
+               "p99_ms": p99, "failed": 0}
+        doc = {"n": rnd, "parsed": rec} if wrap else rec
+        (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(_json.dumps(doc))
+
+    for rnd, (ratio, gp) in enumerate(
+            [(0.5, 100.0), (0.46, 104.0), (0.52, 98.0)], start=1):
+        write(rnd, ratio, gp, wrap=(rnd == 2))   # wrapper unwrapped too
+    samples = mod.load_serve(str(tmp_path))
+    assert [s.round for s in samples] == [1, 2, 3]
+    assert samples[1].vs_direct == pytest.approx(0.46)
+    assert mod.check_serve(samples) == []
+    assert mod.main([str(tmp_path)]) == 0
+    # one bad round is weather...
+    write(4, 0.2, 101.0)
+    assert mod.check_serve(mod.load_serve(str(tmp_path))) == []
+    # ...two in a row is a sustained ratio regression
+    write(5, 0.21, 99.0)
+    regs = mod.check_serve(mod.load_serve(str(tmp_path)))
+    assert len(regs) == 1
+    assert regs[0].metric == "http_serve"
+    assert regs[0].series == "ab_ratio" and regs[0].rounds == (4, 5)
+    assert mod.main([str(tmp_path)]) == 1
+    # goodput collapse is graded the same way; p99 never is
+    write(4, 0.5, 20.0, p99=9000.0)
+    write(5, 0.5, 19.0, p99=9000.0)
+    regs = mod.check_serve(mod.load_serve(str(tmp_path)))
+    assert [r.series for r in regs] == ["goodput"]
+    # platform filter: CPU-fallback history doesn't grade a TPU round
+    write(4, 0.5, 100.0)
+    (tmp_path / "SERVE_r05.json").write_text(_json.dumps(
+        {"metric": "http_serve", "platform": "tpu", "vs_direct": 0.9,
+         "goodput": 5000.0}))
+    assert mod.check_serve(mod.load_serve(str(tmp_path))) == []
+    # alien / unreadable JSON is ignored, never fatal
+    (tmp_path / "SERVE_r06.json").write_text("not json {")
+    (tmp_path / "SERVE_r07.json").write_text('{"whatever": 1}')
+    assert len(mod.load_serve(str(tmp_path))) == 5
+    assert mod.main([str(tmp_path)]) == 0
+
+
 # ---------------------------------------------------------------------------
 # lints: metric naming + env-knob table stay green with the new series
 # ---------------------------------------------------------------------------

@@ -28,11 +28,15 @@ pure drift). Three rules make the comparison meaningful:
    row under a 25% drop is climate.
 
 Also graded, each under its own schema: ``MULTICHIP_r*.json`` driver
-dryruns (a boolean trajectory — the newest non-skipped round must pass)
-and ``DECODE_r*.json`` decode-bench archives (the interleaved KV-vs-naive
+dryruns (a boolean trajectory — the newest non-skipped round must pass),
+``DECODE_r*.json`` decode-bench archives (the interleaved KV-vs-naive
 / continuous-vs-static A/B ratios plus the slot-occupancy trajectory,
 sustained-only like the bench ratios; raw tokens/s is reported, never
-gated). Alien/unreadable JSON is ignored, never fatal.
+gated), and ``SERVE_r*.json`` HTTP-load archives
+(``benchmarks/http_load.py``: the interleaved HTTP-vs-direct
+``vs_direct`` ratio plus the goodput trajectory, sustained-only; raw
+p50/p99 milliseconds are reported, never gated — they are host-load
+weather). Alien/unreadable JSON is ignored, never fatal.
 
 Run standalone (``python tools/bench_diff.py [root]``, exit code =
 sustained regressions found) or from tests (tests/test_obs_perf.py
@@ -60,6 +64,7 @@ DEFAULT_TOLERANCE = 0.25
 _ROUND_RE = re.compile(r"BENCH_r(\d+)[^/]*\.json$")
 _MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)[^/]*\.json$")
 _DECODE_RE = re.compile(r"DECODE_r(\d+)[^/]*\.json$")
+_SERVE_RE = re.compile(r"SERVE_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -236,6 +241,71 @@ def check_decode(samples: List[DecodeSample],
     ], tolerance, sustain)
 
 
+class ServeSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                    # "http_serve"
+    platform: Optional[str]
+    vs_direct: Optional[float]     # interleaved HTTP/direct goodput
+                                   # ratio — drift divides out
+    goodput: Optional[float]       # ok requests/s (gated, with the
+                                   # sustained+tolerance noise shield)
+    p99_ms: Optional[float]        # reported, never gated (host weather)
+    failed: Optional[int]
+
+
+def load_serve(root: str) -> List[ServeSample]:
+    """``SERVE_r*.json`` HTTP-load archives (``benchmarks/http_load.py``
+    records, bare or driver-wrapped). Anything without an ``http_*``
+    metric — alien JSON — is ignored, never fatal."""
+    out: List[ServeSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "SERVE_r*.json"))):
+        m = _SERVE_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("http_"):
+            continue
+        goodput = doc.get("goodput", doc.get("value"))
+        out.append(ServeSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            vs_direct=(float(doc["vs_direct"])
+                       if isinstance(doc.get("vs_direct"), (int, float))
+                       else None),
+            goodput=(float(goodput)
+                     if isinstance(goodput, (int, float)) else None),
+            p99_ms=(float(doc["p99_ms"])
+                    if isinstance(doc.get("p99_ms"), (int, float))
+                    else None),
+            failed=(int(doc["failed"])
+                    if isinstance(doc.get("failed"), (int, float))
+                    else None)))
+    return out
+
+
+def check_serve(samples: List[ServeSample],
+                tolerance: float = DEFAULT_TOLERANCE,
+                sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the HTTP-serve trajectories under the same noise-aware
+    rules: newest file per round by mtime, same-platform only,
+    sustained-only — on the interleaved ``vs_direct`` ratio and the
+    goodput series (p50/p99 raw latencies are never gated)."""
+    return _grade_metric_groups(samples, [
+        ("ab_ratio", lambda s: s.vs_direct),
+        ("goodput", lambda s: s.goodput),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -327,13 +397,15 @@ def main(argv=None) -> int:
     samples = load_samples(root)
     dryruns = load_multichip(root)
     decodes = load_decode(root)
-    if not samples and not dryruns and not decodes:
+    serves = load_serve(root)
+    if not samples and not dryruns and not decodes and not serves:
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
               "nothing to grade")
         return 0
-    regressions = check_trajectory(samples) + check_decode(decodes)
+    regressions = (check_trajectory(samples) + check_decode(decodes)
+                   + check_serve(serves))
     breaks = check_multichip(dryruns)
     for s in samples:
         marks = []
@@ -355,14 +427,24 @@ def main(argv=None) -> int:
             marks.append(f"occupancy={s.occupancy:.3f}")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + (" ".join(marks) or f"tokens/s={s.tokens_per_s}"))
+    for s in serves:
+        marks = []
+        if s.vs_direct is not None:
+            marks.append(f"ab_ratio={s.vs_direct:.3f}")
+        if s.goodput is not None:
+            marks.append(f"goodput={s.goodput:.1f}/s")
+        if s.p99_ms is not None:
+            marks.append(f"p99={s.p99_ms:.1f}ms")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
         print(b)
     if not regressions and not breaks:
         print(f"bench trajectory OK ({len(samples)} bench + "
-              f"{len(dryruns)} dryrun + {len(decodes)} decode samples "
-              f"under {root})")
+              f"{len(dryruns)} dryrun + {len(decodes)} decode + "
+              f"{len(serves)} serve samples under {root})")
     return len(regressions) + len(breaks)
 
 

@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""Multi-process model serving: N front-door workers, one version set.
+
+``python tools/serve.py --workers 2 --port 8080 --state-dir /tmp/fleet``
+spawns N worker processes, each a full serving stack — demo model
+deploys with AOT warmup, a :class:`FrontDoor` bound to an ephemeral
+port, and a :class:`SharedServingState` handle on the file-backed store
+— plus a tiny connection proxy on ``--port`` that spreads client
+connections across the live workers. The pieces:
+
+- **Shared store** (``--state-dir``): registry/rollout/drain state every
+  worker agrees on. A canary started on ANY worker
+  (``POST /admin/rollout``) hash-splits identically on all of them; the
+  leader (lowest alive worker id) grades fleet-aggregated SLO windows
+  and advances/rolls back the shared stage; every worker applies
+  promotions/drains locally.
+- **Proxy** (default): port-per-worker + a thread-per-connection TCP
+  splice with connect-failover — a SIGKILLed worker's port refuses, the
+  proxy moves to the next live worker, and *no surviving worker fails a
+  request* (the drill ``benchmarks/http_load.py --kill-drill`` pins).
+  ``--reuseport`` instead binds every worker to ``--port`` with
+  ``SO_REUSEPORT`` and lets the kernel spread accepts (no proxy hop).
+- **Respawn**: the parent monitors children and respawns a dead worker
+  under its old worker id; the respawned process reads the store at
+  startup and rejoins the rollout at its CURRENT stage. The persistent
+  compile cache (``DL4J_TPU_COMPILE_CACHE``, defaulted into the state
+  dir) makes the respawned deploy a disk retrieval, not a recompile.
+
+Workers serve the demo version set (scoring ``v1``/``v2`` + generative
+``g1``) so the subsystem is drivable out of the box; real deployments
+embed :class:`FrontDoor` + :class:`SharedServingState` directly (see
+``examples/http_serving.py``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+# --------------------------------------------------------------- worker
+def _build_demo(slots: int, generative: bool):
+    """The demo deploys: two equivalent scoring nets (v1/v2 — a canary
+    of v2 should PASS its SLO gate) and one tiny greedy TransformerLM."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.serving import ModelRegistry, ServingRouter
+
+    def make_net(seed):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(seed).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    sample = np.zeros((1, 4), dtype="f4")
+    reg = ModelRegistry()
+    reg.deploy("v1", make_net(1), sample_input=sample, batch_limit=4,
+               max_wait_ms=1.0)
+    reg.deploy("v2", make_net(1), sample_input=sample, batch_limit=4,
+               max_wait_ms=1.0)
+    router = ServingRouter(reg, "v1")
+    gen_router = None
+    if generative:
+        from deeplearning4j_tpu.models.generation import DecodeEngine
+        from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                           TransformerLM)
+        cfg = TransformerConfig(vocab_size=61, n_layers=2, n_heads=2,
+                                d_model=32, max_len=64)
+        model = TransformerLM(cfg)
+        engine = DecodeEngine(model, model.init_params(jax.random.key(0)),
+                              max_len=48)
+        reg.deploy_generative("g1", engine, slots=slots, max_new_tokens=16)
+        gen_router = ServingRouter(reg, "g1")
+    return reg, router, gen_router
+
+
+def run_worker(args) -> int:
+    from deeplearning4j_tpu.serving import (FrontDoor, SharedServingState,
+                                            SharedStore)
+
+    reg, router, gen_router = _build_demo(args.slots,
+                                          not args.no_generative)
+    shared = SharedServingState(SharedStore(args.state_dir),
+                                args.worker_id)
+    shared.ensure_lane("scoring", "v1")
+    if gen_router is not None:
+        shared.ensure_lane("generative", "g1")
+    fd = FrontDoor(router, gen_router, shared=shared, host=args.host,
+                   port=(args.port if args.reuseport else 0),
+                   reuse_port=args.reuseport,
+                   max_inflight=args.max_inflight).start()
+    shared.register(os.getpid(), fd.port)
+    print(json.dumps({"worker": args.worker_id, "pid": os.getpid(),
+                      "port": fd.port, "address": fd.get_address()}),
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.is_set():
+        stop.wait(0.5)
+    fd.stop()
+    reg.shutdown()
+    return 0
+
+
+# ---------------------------------------------------------------- proxy
+class _Proxy:
+    """Thread-per-connection TCP splice with connect-failover: pick the
+    next live worker port (round robin over store heartbeats); a refused
+    connect moves on to the next — a freshly killed worker sheds onto
+    the survivors without a single client-visible failure on them."""
+
+    def __init__(self, store, host: str, port: int):
+        self._store = store
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="dl4j-proxy")
+        self._thread.start()
+
+    def _backends(self):
+        now = time.time()
+        doc = self._store.read()
+        ports = [int(rec["port"]) for _, rec in
+                 sorted((doc.get("workers") or {}).items())
+                 if rec.get("port")
+                 and now - float(rec.get("heartbeat", 0)) <= 3.0]
+        with self._lock:
+            self._rr += 1
+            off = self._rr
+        return ports[off % len(ports):] + ports[:off % len(ports)] \
+            if ports else []
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                client, _ = self._srv.accept()
+            except OSError:
+                # transient accept errors (ECONNABORTED from a client
+                # that RST'd while queued, fd-pressure blips) must not
+                # kill the accept loop — a dead accept loop lets the
+                # backlog fill and every later client gets refused,
+                # which is exactly the "survivors fail" outcome the
+                # proxy exists to prevent. Only a stop() is terminal.
+                if self._stop.is_set():
+                    return
+                time.sleep(0.01)
+                continue
+            try:
+                threading.Thread(target=self._splice, args=(client,),
+                                 daemon=True).start()
+            except RuntimeError:          # thread pressure: shed one
+                client.close()            # connection, keep accepting
+
+    def _splice(self, client: socket.socket):
+        upstream = None
+        for port in self._backends():
+            try:
+                upstream = socket.create_connection(("127.0.0.1", port),
+                                                    timeout=2.0)
+                break
+            except OSError:
+                continue            # dead worker: fail over, not fail
+        if upstream is None:
+            client.close()
+            return
+
+        def pump(src, dst):
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        t = threading.Thread(target=pump, args=(client, upstream),
+                             daemon=True)
+        t.start()
+        pump(upstream, client)
+        t.join(timeout=5.0)
+        for s in (client, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------- parent
+def _spawn(args, wid: str) -> subprocess.Popen:
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--worker-id", wid, "--state-dir", args.state_dir,
+           "--slots", str(args.slots),
+           "--max-inflight", str(args.max_inflight)]
+    if args.host is not None:
+        cmd += ["--host", args.host]
+    if args.no_generative:
+        cmd += ["--no-generative"]
+    if args.reuseport:
+        cmd += ["--reuseport", "--port", str(args.port)]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("PYTHONPATH",
+                   _REPO + os.pathsep + env.get("PYTHONPATH", ""))
+    # workers write to stderr so the PARENT's stdout stays a clean
+    # protocol stream (one fleet JSON line a driver can readline())
+    try:
+        worker_out = sys.stderr.fileno()
+    except (OSError, ValueError, AttributeError):
+        worker_out = subprocess.DEVNULL     # stderr is not a real fd
+    return subprocess.Popen(cmd, env=env, stdout=worker_out)
+
+
+def run_fleet(args) -> int:
+    from deeplearning4j_tpu.serving import SharedStore
+
+    os.makedirs(args.state_dir, exist_ok=True)
+    # warm spin-up: every worker (and every respawn) shares one
+    # persistent XLA compile cache unless the operator pointed elsewhere
+    os.environ.setdefault(
+        "DL4J_TPU_COMPILE_CACHE", os.path.join(args.state_dir, "xla-cache"))
+    store = SharedStore(args.state_dir)
+    wids = [f"w{i}" for i in range(args.workers)]
+    children = {wid: _spawn(args, wid) for wid in wids}
+    deadline = time.monotonic() + args.spinup_timeout_s
+    while time.monotonic() < deadline:
+        ports = {w: r.get("port") for w, r in
+                 (store.read().get("workers") or {}).items()}
+        if all(ports.get(w) for w in wids):
+            break
+        time.sleep(0.2)
+    else:
+        for p in children.values():
+            p.terminate()
+        print("workers failed to register in time", file=sys.stderr)
+        return 1
+    proxy = None
+    if not args.reuseport:
+        proxy = _Proxy(store, args.host or "127.0.0.1", args.port)
+    address = f"http://127.0.0.1:{proxy.port if proxy else args.port}"
+    print(json.dumps({
+        "fleet": {w: children[w].pid for w in wids},
+        "address": address,
+        "state_dir": args.state_dir,
+        "mode": "reuseport" if args.reuseport else "proxy",
+    }), flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+            for wid, proc in list(children.items()):
+                if proc.poll() is not None and args.respawn:
+                    # the respawned worker re-registers under its old id
+                    # and adopts the store's CURRENT stage — the
+                    # kill/respawn drill's rejoin property
+                    children[wid] = _spawn(args, wid)
+                    print(json.dumps({"respawned": wid,
+                                      "pid": children[wid].pid}),
+                          flush=True)
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        for proc in children.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in children.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--port", type=int, default=8080,
+                    help="proxy port (or the shared SO_REUSEPORT port)")
+    ap.add_argument("--host", default=None,
+                    help="bind host (default: DL4J_TPU_UI_HOST or "
+                         "127.0.0.1)")
+    ap.add_argument("--state-dir", default="/tmp/dl4j-tpu-fleet",
+                    help="shared rollout store directory")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-inflight", type=int, default=64)
+    ap.add_argument("--no-generative", action="store_true",
+                    help="skip the generative deploy (faster spin-up)")
+    ap.add_argument("--reuseport", action="store_true",
+                    help="SO_REUSEPORT kernel spreading instead of the "
+                         "proxy")
+    ap.add_argument("--no-respawn", dest="respawn", action="store_false")
+    ap.add_argument("--spinup-timeout-s", type=float, default=180.0)
+    ap.add_argument("--worker-id", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.worker_id is not None:
+        return run_worker(args)
+    return run_fleet(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
