@@ -25,6 +25,15 @@ Two modes:
   asserts the acceptance properties: **zero failed requests on the
   survivors** (proxy failover), and the **respawned worker rejoins the
   same rollout stage** from the shared store.
+- ``--tenants "a:2,b:1"``: the multi-tenant QoS flooding drill
+  (in-process): the named weighted victim tenants run the SAME seeded
+  load in two phases — alone (baseline), then alongside one flooding
+  tenant at ``--flood-factor`` x its request-rate quota. Emits ONE
+  JSON line (``metric: qos_drill``) the driver archives as
+  ``QOS_r*.json``: per-victim goodput/p99 ratios (same-run, so host
+  drift divides out), flooder shed counts, and the acceptance verdicts
+  (victim goodput >= 90% of baseline, p99 within 2x, flooder shed at
+  the door with Retry-After).
 
 Every run also pins streaming correctness: for one seeded prompt the
 SSE token sequence must equal the non-streamed result exactly, and the
@@ -53,10 +62,13 @@ TYPED_CODES = (429, 503, 504)
 
 
 # ------------------------------------------------------------ HTTP client
-def _post(addr: str, path: str, doc: dict, timeout: float = 30.0):
+def _post(addr: str, path: str, doc: dict, timeout: float = 30.0,
+          tenant: str = None):
+    headers = {"Content-Type": "application/json"}
+    if tenant is not None:
+        headers["X-Dl4j-Tenant"] = tenant
     req = urllib.request.Request(
-        addr + path, data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json"})
+        addr + path, data=json.dumps(doc).encode(), headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return r.status, json.loads(r.read())
 
@@ -258,6 +270,178 @@ def run_inproc(args, rng) -> dict:
     finally:
         fd.stop()
         reg.shutdown()
+
+
+# ----------------------------------------------------------- QoS drill mode
+def _parse_tenants(spec: str):
+    """``name:weight,name:weight`` → ordered (name, weight) list."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        out.append((name.strip(), float(w) if w else 1.0))
+    if not out:
+        raise ValueError(f"no tenants in spec {spec!r}")
+    return out
+
+
+def _tenant_load(addr: str, seed: int, tenant: str, qps: float,
+                 duration_s: float, stats: "_Stats"):
+    """One tenant's open-loop seeded classify stream (its own rng, so
+    the SAME traffic is issued in the baseline and flood phases)."""
+    import random
+    rng = random.Random(seed)
+    threads = []
+    t_end = time.monotonic() + duration_s
+
+    def one(x, key):
+        t0 = time.perf_counter()
+        try:
+            _post(addr, "/v1/classify",
+                  {"inputs": [x], "request_key": key}, tenant=tenant)
+            stats.add("classify", time.perf_counter() - t0, "ok")
+        except urllib.error.HTTPError as e:
+            stats.add("classify", 0.0,
+                      "typed" if e.code in TYPED_CODES else "failed",
+                      detail=f"{tenant}: HTTP {e.code}")
+        except Exception as e:
+            stats.add("classify", 0.0, "failed",
+                      detail=f"{tenant}: {e!r}")
+
+    i = 0
+    while time.monotonic() < t_end:
+        time.sleep(min(rng.expovariate(qps) if qps > 0 else 0.0, 1.0))
+        x = [round(rng.uniform(0, 1), 6) for _ in range(4)]
+        t = threading.Thread(target=one, args=(x, (tenant, i)),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        i += 1
+    for t in threads:
+        t.join(timeout=60.0)
+
+
+def run_qos_drill(args, rng) -> dict:
+    """The multi-tenant flooding drill (in-process worker): N weighted
+    victim tenants at a steady per-tenant QPS, one flooding tenant at
+    ``--flood-factor`` x its request-rate quota. Two phases with the
+    SAME seeded victim traffic — (A) victims alone (the no-flood
+    baseline), (B) victims + flooder — so each victim's goodput/p99
+    ratio is a same-run interleaved comparison and host drift divides
+    out. Acceptance: every victim's goodput holds >= 90% of its
+    baseline and its p99 stays within 2x, while the flooder is shed
+    (429 + Retry-After) at the door."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import serve as _serve
+
+    from deeplearning4j_tpu.resilience import qos
+    from deeplearning4j_tpu.serving import FrontDoor
+
+    victims = _parse_tenants(args.tenants)
+    flooder = args.flooder
+    treg = qos.global_tenants()
+    policies = {name: qos.TenantPolicy(name, weight=w)
+                for name, w in victims}
+    policies[flooder] = qos.TenantPolicy(
+        flooder, weight=1.0, request_rate=args.flooder_quota_qps,
+        request_burst=max(2.0, args.flooder_quota_qps))
+    treg.configure(policies)
+    reg, router, gen_router = _serve._build_demo(args.slots, False)
+    fd = FrontDoor(router, gen_router, port=0,
+                   max_inflight=args.max_inflight).start()
+    addr = fd.get_address()
+    phase_s = args.duration_s / 2
+
+    def run_phase(phase: str, with_flood: bool):
+        stats = {name: _Stats() for name, _ in victims}
+        threads = [threading.Thread(
+            target=_tenant_load,
+            args=(addr, args.seed + 1000 * k, name, args.victim_qps,
+                  phase_s, stats[name]), daemon=True)
+            for k, (name, _) in enumerate(victims)]
+        flood_stats = _Stats()
+        if with_flood:
+            threads.append(threading.Thread(
+                target=_tenant_load,
+                args=(addr, args.seed + 777, flooder,
+                      args.flood_factor * args.flooder_quota_qps,
+                      phase_s, flood_stats), daemon=True))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=phase_s + 120)
+        return stats, flood_stats
+
+    try:
+        baseline, _ = run_phase("baseline", with_flood=False)
+        flood, flood_stats = run_phase("flood", with_flood=True)
+    finally:
+        fd.stop()
+        reg.shutdown()
+
+    per_tenant = {}
+    goodput_ratios, p99_ratios = [], []
+    for name, w in victims:
+        b, f = baseline[name], flood[name]
+        b_good = b.ok / phase_s
+        f_good = f.ok / phase_s
+        b_p99 = _quantile(b.lat["classify"], 0.99)
+        f_p99 = _quantile(f.lat["classify"], 0.99)
+        g_ratio = (f_good / b_good) if b_good else None
+        p_ratio = (f_p99 / b_p99) if b_p99 and f_p99 else None
+        if g_ratio is not None:
+            goodput_ratios.append(g_ratio)
+        if p_ratio is not None:
+            p99_ratios.append(p_ratio)
+        per_tenant[name] = {
+            "weight": w,
+            "baseline_goodput": round(b_good, 3),
+            "flood_goodput": round(f_good, 3),
+            "goodput_ratio": (round(g_ratio, 4)
+                              if g_ratio is not None else None),
+            "baseline_p99_ms": (round(b_p99 * 1e3, 3) if b_p99 else None),
+            "flood_p99_ms": (round(f_p99 * 1e3, 3) if f_p99 else None),
+            "p99_ratio": (round(p_ratio, 4)
+                          if p_ratio is not None else None),
+            "typed": f.typed, "failed": f.failed,
+        }
+    victim_goodput_ratio = min(goodput_ratios) if goodput_ratios else None
+    victim_p99_ratio = max(p99_ratios) if p99_ratios else None
+    try:
+        import jax
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unknown"
+    snap = treg.snapshot()["tenants"].get(flooder, {})
+    return {
+        "metric": "qos_drill",
+        "platform": platform,
+        "value": victim_goodput_ratio,
+        "unit": "victim_goodput_ratio",
+        "ratio_method": "same_run_baseline_vs_flood",
+        "victim_goodput_ratio": victim_goodput_ratio,
+        "victim_p99_ratio": victim_p99_ratio,
+        "victims": per_tenant,
+        "flooder": flooder,
+        "flooder_quota_qps": args.flooder_quota_qps,
+        "flood_factor": args.flood_factor,
+        "flooder_sent": (flood_stats.ok + flood_stats.typed
+                         + flood_stats.failed),
+        "flooder_ok": flood_stats.ok,
+        "flooder_shed": flood_stats.typed,
+        "flooder_failed": flood_stats.failed,
+        "flooder_shed_counter": snap.get("shed"),
+        "goodput_holds": (victim_goodput_ratio is not None
+                          and victim_goodput_ratio >= 0.9),
+        "p99_holds": (victim_p99_ratio is not None
+                      and victim_p99_ratio <= 2.0),
+        "victim_qps": args.victim_qps,
+        "duration_s": args.duration_s,
+        "seed": args.seed,
+    }
 
 
 # --------------------------------------------------------------- fleet mode
@@ -463,12 +647,35 @@ def main(argv=None) -> int:
     ap.add_argument("--state-dir", default=None)
     ap.add_argument("--p99-degraded-s", type=float, default=2.0)
     ap.add_argument("--p99-failing-s", type=float, default=10.0)
+    ap.add_argument("--tenants", default=None,
+                    help="QoS flooding drill: victim tenants as "
+                         "'name:weight,name:weight' (in-process mode; "
+                         "archives QOS_r*.json)")
+    ap.add_argument("--flooder", default="flood",
+                    help="flooding tenant name (QoS drill)")
+    ap.add_argument("--flooder-quota-qps", type=float, default=4.0,
+                    help="the flooder's request-rate quota; it floods "
+                         "at --flood-factor x this")
+    ap.add_argument("--flood-factor", type=float, default=10.0)
+    ap.add_argument("--victim-qps", type=float, default=6.0,
+                    help="per-victim steady request rate (QoS drill)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.kill_drill and args.workers < 2:
         ap.error("--kill-drill needs --workers >= 2")
     import random
     rng = random.Random(args.seed)
+    if args.tenants:
+        rec = run_qos_drill(args, rng)
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        ok = (rec["goodput_holds"] and rec["p99_holds"]
+              and rec["flooder_shed"] > 0
+              and all(v["failed"] == 0 for v in rec["victims"].values()))
+        return 0 if ok else 1
     rec = (run_fleet(args, rng) if args.workers
            else run_inproc(args, rng))
     line = json.dumps(rec)

@@ -335,6 +335,14 @@ class CostModel:
                     ent.g_mfu = gauge
         gauge.set(mfu)
 
+    def flops_for(self, fn: str) -> float:
+        """Accounted FLOPs of one entry (0.0 when never analyzed) — the
+        cheap read the per-tenant cost attribution uses per batch/step
+        (one lock + dict lookup, no snapshot)."""
+        with self._lock:
+            e = self._entries.get(fn)
+            return e.flops if e is not None else 0.0
+
     # ----------------------------------------------------------- queries
     def regression_view(self) -> List[Tuple[str, float, float, int]]:
         """(fn, rolling_mfu, baseline_mfu, samples) for every entry with

@@ -37,6 +37,9 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
 - ``resilience.json`` — fault plan + injection counts, circuit-breaker
   states, and the resilience event ring (retries, sheds, breaker
   transitions, restores, quarantines)
+- ``tenants.json`` — multi-tenant QoS: per-tenant policies (weights,
+  tiers, quotas), live bucket levels, and request/token/shed/cost
+  counters (a death under load must name who was flooding)
 - ``elastic.json`` — elastic posture: device-capacity view, mesh
   reshape history, and the sharded-manifest checkpoint stores
 - ``deploy.json`` — versioned serving: deployed versions (lifecycle,
@@ -330,6 +333,10 @@ class FlightRecorder:
         # were open, and the retry/shed/restore/quarantine event trail —
         # a hang during a chaos run must name the chaos
         section("resilience.json", self._write_resilience)
+        # the multi-tenant QoS layer: policies, quota bucket levels,
+        # per-tenant counters — a death under a flooding tenant must
+        # name who was flooding and who was shed
+        section("tenants.json", self._write_tenants)
         # the elastic layer: capacity view, reshape history, and the
         # manifest stores — a death mid-shrink must name the topology
         section("elastic.json", self._write_elastic)
@@ -395,6 +402,12 @@ class FlightRecorder:
         from deeplearning4j_tpu import resilience
         with open(path, "w") as f:
             json.dump(resilience.snapshot(), f, indent=2, default=str)
+
+    @staticmethod
+    def _write_tenants(path: str):
+        from deeplearning4j_tpu.resilience import qos
+        with open(path, "w") as f:
+            json.dump(qos.snapshot(), f, indent=2, default=str)
 
     @staticmethod
     def _write_elastic(path: str):

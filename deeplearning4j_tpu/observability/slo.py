@@ -277,6 +277,16 @@ def default_rules() -> List[SLORule]:
         # the same program getting slower (MFU under its own rolling
         # baseline) pages; like retrace churn it never ejects the replica
         PerfRegressionRule(),
+        # per-tenant SLO: the WORST tenant's p99 grades /health (the
+        # worst-child-wins rule semantics — a drowning tenant must not
+        # hide behind the healthy aggregate; labels are bounded by the
+        # qos tenant_label top-N helper, so this scan stays small)
+        LatencyQuantileRule(
+            "tenant_p99_latency_seconds",
+            "dl4j_tenant_latency_seconds", quantile=0.99,
+            degraded=1.0, failing=5.0, min_count=16,
+            description="per-tenant end-to-end p99 latency (worst "
+                        "tenant wins; multi-tenant QoS)"),
         # an OPEN circuit means callers are being failed fast — eject the
         # replica; half-open (recovery probing) is a page, not an ejection
         CircuitOpenRule(),

@@ -30,6 +30,14 @@ Metrics (``dl4j_decode_*``): generated tokens, slot occupancy,
 prefill/decode latency split, cache bytes, sheds, queue depth — on
 ``/metrics``, with decode/prefill MFU entries on ``/debug/perf`` via the
 cost model, and in flight-recorder bundles (``generation.json``).
+
+Multi-tenant QoS (kill switch ``DL4J_TPU_QOS=0``, see
+``resilience/qos.py``): the slot-wait queue becomes a per-tenant DWRR
+``FairQueue`` (cost = one slot per request), full-queue shedding evicts
+the most over-share tenant's newest request, a higher-priority tenant
+may PREEMPT a lower-tier slot at a step boundary (the victim resolves
+with the typed ``PreemptedError``), and each request's tenant is charged
+its emitted tokens plus prefill + per-slot decode-step FLOPs shares.
 """
 from __future__ import annotations
 
@@ -52,6 +60,7 @@ from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       now_us, record_span)
 from deeplearning4j_tpu.parallel.inference import _Request
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import qos as _qos
 from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   CircuitBreaker,
                                                   CircuitOpenError, Deadline,
@@ -100,7 +109,7 @@ class _GenMetrics:
             label_names=("reason",))
         self.shed = {r: shed.labels(reason=r)
                      for r in ("queue_full", "deadline", "circuit_open",
-                               "client_gone")}
+                               "client_gone", "preempted")}
         self.occupancy = reg.histogram(
             "dl4j_decode_slot_occupancy_ratio",
             "occupied slots / total slots per decode step (1.0 = the "
@@ -151,7 +160,7 @@ class _GenRequest(_Request):
     set) streams each token out at the step boundary that produced it."""
 
     __slots__ = ("max_new_tokens", "eos_id", "out", "t_slot_us",
-                 "on_token")
+                 "on_token", "cost_flops")
 
     def __init__(self, x, max_new_tokens: int, eos_id: Optional[int],
                  on_token=None):
@@ -161,6 +170,10 @@ class _GenRequest(_Request):
         self.out: List[int] = []
         self.t_slot_us = 0.0
         self.on_token = on_token
+        # accounted device work attributed to this request (prefill +
+        # per-slot decode-step shares) — charged to its tenant at
+        # resolution under the QoS posture
+        self.cost_flops = 0.0
 
 
 class GenerationPipeline:
@@ -204,8 +217,15 @@ class GenerationPipeline:
                 CircuitBreaker("generation.step")
             self._retry = RetryPolicy(max_retries=2,
                                       base_delay_seconds=0.01)
-        self._queue: "queue.Queue[_GenRequest]" = queue.Queue(
-            maxsize=queue_limit)
+        # QoS posture: per-tenant DWRR queue (cost = 1 slot per
+        # request), same kill-switch discipline as ParallelInference
+        self._qos = self._resilience and _qos.qos_enabled()
+        if self._qos:
+            self._queue = _qos.FairQueue(queue_limit,
+                                         _qos.global_tenants())
+        else:
+            self._queue: "queue.Queue[_GenRequest]" = queue.Queue(
+                maxsize=queue_limit)
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -253,13 +273,15 @@ class GenerationPipeline:
               else self.default_deadline_ms)
         return Deadline.after_ms(ms) if ms and ms > 0 else None
 
-    def _shed(self, reason: str):
+    def _shed(self, reason: str, tenant=None):
         _GenMetrics.get().shed[reason].inc()
+        if tenant is not None:
+            _qos.global_tenants().count_shed(tenant, reason)
         _faults.record_event("shed", op="generation", reason=reason)
 
-    def _check_admission(self):
+    def _check_admission(self, tenant=None):
         if self._breaker is not None and not self._breaker.allow():
-            self._shed("circuit_open")
+            self._shed("circuit_open", tenant=tenant)
             raise CircuitOpenError(
                 "generation circuit open (consecutive decode-step "
                 "failures); retry after the reset timeout")
@@ -267,7 +289,7 @@ class GenerationPipeline:
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 on_token=None) -> np.ndarray:
+                 on_token=None, tenant=None) -> np.ndarray:
         """Generate up to ``max_new_tokens`` continuation tokens for a
         1-D int32 ``prompt``. Blocks until the request resolves; raises
         the typed resilience outcomes (shed/deadline/circuit/shutdown)
@@ -303,6 +325,8 @@ class GenerationPipeline:
                           eos_id if eos_id is not None
                           else self.default_eos_id, on_token=on_token)
         req.deadline = self._resolve_deadline(deadline_ms)
+        req.tenant = (_qos.global_tenants().resolve(tenant)
+                      if self._qos else None)
         with _flight().arm("generation_request"), \
                 _span("generation_request", prompt_tokens=int(prompt.size),
                       max_new_tokens=n_new):
@@ -314,9 +338,17 @@ class GenerationPipeline:
                 obs.requests.inc()
                 if err is not None and not isinstance(err, _TYPED_OUTCOMES):
                     obs.errors.inc()
+                if req.tenant is not None:
+                    reg = _qos.global_tenants()
+                    reg.observe_request(req.tenant,
+                                        time.perf_counter() - t0, err)
+                    if req.out:
+                        reg.account_tokens(req.tenant, len(req.out))
+                    if req.cost_flops:
+                        reg.account_cost(req.tenant, req.cost_flops)
 
             try:
-                self._check_admission()
+                self._check_admission(tenant=req.tenant)
                 self._enqueue(req, obs)
             except Exception as e:
                 _account(e)
@@ -336,7 +368,7 @@ class GenerationPipeline:
                     raise ShutdownError(
                         "GenerationPipeline has been shut down")
                 if req.deadline is not None and req.deadline.expired():
-                    self._shed("deadline")
+                    self._shed("deadline", tenant=req.tenant)
                     raise DeadlineExceeded(
                         "request expired while waiting to enqueue")
                 try:
@@ -344,8 +376,33 @@ class GenerationPipeline:
                     obs.queue_depth.set(self._queue.qsize())
                     return
                 except queue.Full:
+                    if self._qos and self._shed_policy is not None:
+                        # tenant-aware: evict the most over-share
+                        # tenant's newest request; None = the arriving
+                        # tenant is itself the most over-share (under
+                        # reject_oldest its OWN stale head gives way —
+                        # the pre-QoS policy meaning, tenant-scoped)
+                        victim = self._queue.pick_victim(req)
+                        if (victim is None
+                                and self._shed_policy == "reject_oldest"):
+                            victim = (self._queue.pop_oldest_of(
+                                req.tenant)
+                                or self._queue.pop_global_oldest())
+                        if victim is None:
+                            self._shed("queue_full", tenant=req.tenant)
+                            raise ShedError(
+                                f"generation queue full "
+                                f"({self._queue.maxsize} requests); "
+                                "request rejected (tenant over its "
+                                "fair share)")
+                        self._shed_request(victim, "queue_full",
+                                           ShedError(
+                                               "shed from a full "
+                                               "generation queue (most "
+                                               "over-share tenant)"))
+                        continue
                     if self._shed_policy == "reject_newest":
-                        self._shed("queue_full")
+                        self._shed("queue_full", tenant=req.tenant)
                         raise ShedError(
                             f"generation queue full "
                             f"({self._queue.maxsize} requests); request "
@@ -377,7 +434,7 @@ class GenerationPipeline:
                 req.error = DeadlineExceeded(
                     "request expired while decoding")
                 req.event.set()
-                self._shed("deadline")
+                self._shed("deadline", tenant=req.tenant)
             else:
                 req.event.wait(timeout=5.0)
                 if req.error is None and req.result is None:
@@ -390,7 +447,7 @@ class GenerationPipeline:
                       error: BaseException):
         if not req.claim():
             return
-        self._shed(reason)
+        self._shed(reason, tenant=req.tenant)
         if req.ctx is not None:
             record_span("shed", now_us(), ctx=req.ctx, reason=reason)
         req.error = error
@@ -482,6 +539,9 @@ class GenerationPipeline:
                             slot=slot, prompt_tokens=int(req.x.size))
             obs.prefill_latency.observe(dt)
             _cost.global_cost_model().observe_time(PREFILL_FN, dt)
+            if req.tenant is not None:
+                req.cost_flops += _cost.global_cost_model().flops_for(
+                    PREFILL_FN)
             if self._breaker is not None:
                 self._breaker.record_success()
         except Exception as e:
@@ -521,12 +581,52 @@ class GenerationPipeline:
         self._positions[slot] = t
         return True
 
+    def _maybe_preempt(self) -> bool:
+        """Priority preemption at a step boundary (QoS posture, slots
+        full): when the highest queued tier strictly exceeds some active
+        slot's tier, that slot's request is shed typed
+        (:class:`~deeplearning4j_tpu.resilience.qos.PreemptedError`) and
+        the slot freed. The victim: among lower-tier active slots, the
+        most over-share tenant's longest-running request (slots frees
+        and joins already happen exactly here — the preempted caller
+        resolves typed, never hangs). Default tiers (0 everywhere)
+        never preempt."""
+        pri = self._queue.peek_priority()
+        if pri is None:
+            return False
+        reg = _qos.global_tenants()
+        active = [(slot, r) for slot, r in enumerate(self._slot_req)
+                  if r is not None]
+        cands = [(slot, r) for slot, r in active
+                 if reg.priority(r.tenant) < pri]
+        if not cands:
+            return False
+        counts: dict = {}
+        for _, r in active:
+            counts[r.tenant] = counts.get(r.tenant, 0) + 1
+        wsum = sum(reg.weight(t) for t in counts) or 1.0
+
+        def over_share(t):
+            return counts[t] / max(1e-9,
+                                   len(active) * reg.weight(t) / wsum)
+
+        victim_slot, victim = max(
+            cands, key=lambda sr: (over_share(sr[1].tenant),
+                                   -sr[1].t_slot_us))
+        self._shed_request(victim, "preempted", _qos.PreemptedError(
+            f"generation slot {victim_slot} preempted by a higher-"
+            f"priority tenant at a decode step boundary"))
+        self._slot_req[victim_slot] = None
+        return True
+
     def _admit(self):
         """Join queued requests into free slots at this step boundary
         (blocking briefly only when the whole pipeline is idle)."""
         while not self._stop.is_set():
             free = [i for i, r in enumerate(self._slot_req) if r is None]
             if not free:
+                if self._qos and self._maybe_preempt():
+                    continue       # a slot was freed — re-scan and join
                 return
             idle = len(free) == self.slots
             req = self._take_request(timeout=0.05 if idle else 0.0)
@@ -539,6 +639,12 @@ class GenerationPipeline:
         """Post-step bookkeeping for every active slot: append the new
         token, then resolve/free finished or expired requests."""
         obs = _GenMetrics.get()
+        # each occupied slot owns 1/slots of the decode step's accounted
+        # FLOPs (the whole slot batch runs whether occupied or not —
+        # charging per OCCUPIED slot would make a lonely tenant look
+        # cheap while it monopolizes the executable)
+        step_share = (_cost.global_cost_model().flops_for(DECODE_FN)
+                      / max(1, self.slots)) if self._qos else 0.0
         for slot in stepped:
             req = self._slot_req[slot]
             if req is None:
@@ -554,6 +660,8 @@ class GenerationPipeline:
             req.out.append(tok)
             self._positions[slot] += 1
             obs.tokens.inc()
+            if req.tenant is not None:
+                req.cost_flops += step_share
             expired = (self._resilience and req.deadline is not None
                        and req.deadline.expired())
             done = (len(req.out) >= req.max_new_tokens
@@ -664,6 +772,7 @@ class GenerationPipeline:
         """Live pipeline state (``/debug/generation`` + the
         flight-recorder ``generation.json`` payload)."""
         slots = []
+        tenants: dict = {}
         for i, req in enumerate(self._slot_req):
             if req is None:
                 slots.append({"slot": i, "state": "free"})
@@ -673,9 +782,21 @@ class GenerationPipeline:
                     "position": int(self._positions[i]),
                     "generated": len(req.out),
                     "max_new_tokens": req.max_new_tokens,
+                    "tenant": req.tenant,
                     "trace_id": (req.ctx.trace_id
                                  if req.ctx is not None else None)})
+                if req.tenant is not None:
+                    t = tenants.setdefault(req.tenant,
+                                           {"active_slots": 0,
+                                            "queued": 0})
+                    t["active_slots"] += 1
+        if self._qos:
+            for t, n in self._queue.tenant_sizes().items():
+                tenants.setdefault(t, {"active_slots": 0,
+                                       "queued": 0})["queued"] = n
         return {
+            "qos": self._qos,
+            "tenants": tenants,
             "slots": self.slots,
             "active": self._n_active(),
             "queue_depth": self._queue.qsize(),

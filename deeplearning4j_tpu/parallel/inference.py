@@ -42,6 +42,16 @@ is ``dl4j_circuit_state{op}``, and transient injected dispatch faults are
 retried under a budgeted ``RetryPolicy``. Shutdown failures now raise the
 typed ``ShutdownError`` (a ``RuntimeError``) so callers and error-rate
 SLOs can tell a drained instance from a dying device.
+
+Multi-tenant QoS (kill switch ``DL4J_TPU_QOS=0``, see
+``resilience/qos.py``): requests may carry a tenant label
+(``output(x, tenant=...)``) — the single-FIFO queue becomes a
+deficit-weighted round-robin :class:`~deeplearning4j_tpu.resilience.qos.
+FairQueue` over per-tenant queues (service converges to the configured
+weight ratio while backlogged), full-queue shedding evicts from the most
+over-share tenant (never an under-share one), and every resolved request
+is accounted per tenant: requests/latency, usage tokens (examples), and
+the cost model's FLOPs share of the executed bucket.
 """
 from __future__ import annotations
 
@@ -66,6 +76,7 @@ from deeplearning4j_tpu.observability.straggler import StragglerDetector
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       now_us, record_span)
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import qos as _qos
 from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   CircuitBreaker,
                                                   CircuitOpenError, Deadline,
@@ -165,13 +176,16 @@ def _drop_serving_metrics():
 
 class _Request:
     __slots__ = ("x", "event", "result", "error", "ctx", "t_enqueue_us",
-                 "deadline", "_claim_lock", "_claimed")
+                 "deadline", "tenant", "_claim_lock", "_claimed")
 
     def __init__(self, x):
         self.x = x
         self.event = threading.Event()
         self.result = None
         self.error = None
+        # QoS tenant label (None when QoS is off — the request behaves
+        # exactly as pre-tenant requests did)
+        self.tenant = None
         # causal trace context captured at enqueue: the serve threads stamp
         # this request's queue_wait/bucket_pad/dispatch/device/complete
         # phases into ITS trace, so one trace_id follows the request across
@@ -276,7 +290,19 @@ class ParallelInference:
             self._trainer = ShardedTrainer(model, MeshSpec.data_parallel(n),
                                            devices=jax.devices()[:n])
             self._n_dev = n
-        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        # multi-tenant QoS posture (resolved at construction like the
+        # rest): the single FIFO becomes a deficit-weighted round-robin
+        # FairQueue over per-tenant queues. DL4J_TPU_QOS=0 (or the
+        # resilience kill switch) keeps the original queue.Queue —
+        # byte-identical pre-QoS behavior.
+        self._qos = self._resilience and _qos.qos_enabled()
+        if self._qos:
+            self._queue = _qos.FairQueue(
+                queue_limit, _qos.global_tenants(),
+                cost_fn=lambda r: int(r.x.shape[0]))
+        else:
+            self._queue: "queue.Queue[_Request]" = queue.Queue(
+                maxsize=queue_limit)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         # serializes enqueue vs shutdown-drain so a request can never be
@@ -414,32 +440,43 @@ class ParallelInference:
         ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
         return Deadline.after_ms(ms) if ms and ms > 0 else None
 
-    def _shed(self, reason: str):
+    def _shed(self, reason: str, tenant=None):
         _ServingMetrics.get().shed[reason].inc()
+        if tenant is not None:
+            _qos.global_tenants().count_shed(tenant, reason)
         _faults.record_event("shed", op="inference", reason=reason)
 
-    def _check_admission(self):
+    def _check_admission(self, tenant=None):
         """Fail fast on an open circuit — a dead device must reject at the
         door, not after a queue+batch+dispatch round trip."""
         if self._breaker is not None and not self._breaker.allow():
-            self._shed("circuit_open")
+            self._shed("circuit_open", tenant=tenant)
             raise CircuitOpenError(
                 "inference circuit open (consecutive device-execution "
                 "failures); retry after the reset timeout")
 
-    def output(self, x, deadline_ms: Optional[float] = None) -> np.ndarray:
+    def output(self, x, deadline_ms: Optional[float] = None,
+               tenant=None) -> np.ndarray:
         x = np.asarray(x)
         obs = _ServingMetrics.get()
         t0 = time.perf_counter()
         dl = self._resolve_deadline(deadline_ms)
+        # tenant identity rides the request only under the QoS posture;
+        # otherwise the kwarg is inert (byte-identical pre-QoS paths)
+        tn = _qos.global_tenants().resolve(tenant) if self._qos else None
+
+        def _tenant_account(err=None):
+            if tn is not None:
+                _qos.global_tenants().observe_request(
+                    tn, time.perf_counter() - t0, err)
         if self.mode == InferenceMode.INSTANT:
             with _span("inference_request", mode=InferenceMode.INSTANT,
                        examples=int(x.shape[0])):
                 ctx = current_context()
                 try:
-                    self._check_admission()
+                    self._check_admission(tenant=tn)
                     if dl is not None and dl.expired():
-                        self._shed("deadline")
+                        self._shed("deadline", tenant=tn)
                         raise DeadlineExceeded(
                             "request expired before dispatch")
                     if self._resilience:
@@ -457,7 +494,7 @@ class ParallelInference:
                         # wrong by the same policy _distribute applies in
                         # BATCHED mode (the breaker still saw a success:
                         # the device itself is healthy)
-                        self._shed("deadline")
+                        self._shed("deadline", tenant=tn)
                         raise DeadlineExceeded(
                             "request expired during device execution")
                 except Exception as e:
@@ -472,15 +509,20 @@ class ParallelInference:
                         time.perf_counter() - t0,
                         exemplar=self._exemplar(ctx))
                     obs.requests[InferenceMode.INSTANT].inc()
+                    _tenant_account(e)
                     if not isinstance(e, _TYPED_OUTCOMES):
                         obs.errors.inc()
                     raise
             obs.latency[InferenceMode.INSTANT].observe(
                 time.perf_counter() - t0, exemplar=self._exemplar(ctx))
             obs.requests[InferenceMode.INSTANT].inc()
+            _tenant_account()
+            if tn is not None:
+                _qos.global_tenants().account_tokens(tn, int(x.shape[0]))
             return out
         req = _Request(x)
         req.deadline = dl
+        req.tenant = tn
         # the per-request END-TO-END span: everything the serve threads do
         # for this request parents under it (they stamp phase records with
         # req.ctx), and the flight recorder treats the outstanding request
@@ -491,8 +533,8 @@ class ParallelInference:
             req.ctx = current_context()
             req.t_enqueue_us = now_us()
             try:
-                self._check_admission()
-            except CircuitOpenError:
+                self._check_admission(tenant=tn)
+            except CircuitOpenError as e:
                 # fail-fast rejections are still traffic: without the
                 # requests_total increment a 100% circuit-open outage
                 # would read as "no traffic, ok" to ErrorRateRule's
@@ -501,6 +543,7 @@ class ParallelInference:
                     time.perf_counter() - t0,
                     exemplar=self._exemplar(req.ctx))
                 obs.requests[InferenceMode.BATCHED].inc()
+                _tenant_account(e)
                 raise
             # condition-based enqueue: a producer facing a full queue
             # sleeps on the condition and is woken by the batcher the
@@ -515,7 +558,7 @@ class ParallelInference:
                                 "ParallelInference has been shut down")
                         if (req.deadline is not None
                                 and req.deadline.expired()):
-                            self._shed("deadline")
+                            self._shed("deadline", tenant=tn)
                             raise DeadlineExceeded(
                                 "request expired while waiting to enqueue")
                         try:
@@ -523,8 +566,39 @@ class ParallelInference:
                             obs.queue_depth.set(self._queue.qsize())
                             break
                         except queue.Full:
+                            if (self._qos
+                                    and self._shed_policy is not None):
+                                # tenant-aware shedding: evict from the
+                                # most over-share tenant; None means the
+                                # ARRIVING tenant is the most over-share
+                                # (or nobody is over) — never evict an
+                                # under-share tenant's work. In that
+                                # case reject_oldest keeps its pre-QoS
+                                # meaning WITHIN the tenant: the
+                                # arrival's own stale head gives way
+                                victim = self._queue.pick_victim(req)
+                                if (victim is None and
+                                        self._shed_policy
+                                        == "reject_oldest"):
+                                    victim = (self._queue.pop_oldest_of(
+                                        tn)
+                                        or self._queue
+                                        .pop_global_oldest())
+                                if victim is None:
+                                    self._shed("queue_full", tenant=tn)
+                                    raise ShedError(
+                                        "inference queue full "
+                                        f"({self._queue.maxsize} "
+                                        "requests); request rejected "
+                                        "(tenant over its fair share)")
+                                self._shed_request(
+                                    victim, "queue_full", ShedError(
+                                        "shed from a full inference "
+                                        "queue (most over-share "
+                                        "tenant)"))
+                                continue
                             if self._shed_policy == "reject_newest":
-                                self._shed("queue_full")
+                                self._shed("queue_full", tenant=tn)
                                 raise ShedError(
                                     "inference queue full "
                                     f"({self._queue.maxsize} requests); "
@@ -540,13 +614,14 @@ class ParallelInference:
                                         "by a newer request (reject_oldest)"))
                                 continue
                             self._not_full.wait(timeout=0.1)
-            except (ShedError, DeadlineExceeded, ShutdownError):
+            except (ShedError, DeadlineExceeded, ShutdownError) as e:
                 # pre-enqueue rejections count as requests too — same
                 # denominator invariant as the error path below
                 obs.latency[InferenceMode.BATCHED].observe(
                     time.perf_counter() - t0,
                     exemplar=self._exemplar(req.ctx))
                 obs.requests[InferenceMode.BATCHED].inc()
+                _tenant_account(e)
                 raise
             # deadline-aware wait: the batcher/dispatcher/completer checks
             # cover the queue and the pad/dispatch boundaries, but a
@@ -570,7 +645,7 @@ class ParallelInference:
                         req.error = DeadlineExceeded(
                             "request expired while awaiting device results")
                         req.event.set()
-                        self._shed("deadline")
+                        self._shed("deadline", tenant=tn)
                     else:
                         req.event.wait(timeout=5.0)
                         if req.error is None and req.result is None:
@@ -593,12 +668,14 @@ class ParallelInference:
                     time.perf_counter() - t0,
                     exemplar=self._exemplar(req.ctx))
                 obs.requests[InferenceMode.BATCHED].inc()
+                _tenant_account(req.error)
                 if not isinstance(req.error, _TYPED_OUTCOMES):
                     obs.errors.inc()
                 raise req.error
         obs.latency[InferenceMode.BATCHED].observe(
             time.perf_counter() - t0, exemplar=self._exemplar(req.ctx))
         obs.requests[InferenceMode.BATCHED].inc()
+        _tenant_account()
         return req.result
 
     def shutdown(self):
@@ -660,7 +737,7 @@ class ParallelInference:
         it was shed/completed once; counting it again would lie."""
         if not req.claim():
             return
-        self._shed(reason)
+        self._shed(reason, tenant=req.tenant)
         if req.ctx is not None:
             record_span("shed", now_us(), ctx=req.ctx, reason=reason)
         req.error = error
@@ -817,6 +894,25 @@ class ParallelInference:
                 _cw.note_cause("bucket_miss", bucket=target)
         obs.batches.inc()
 
+    def _charge_tenants(self, batch: List[_Request], target: int):
+        """Per-tenant usage + cost for one executed device batch: each
+        member is charged its examples as usage tokens and its share of
+        the bucket executable's accounted FLOPs (k/target of the padded
+        program — executed work is charged even when the caller already
+        walked away, because the device ran it)."""
+        if not self._qos:
+            return
+        flops = _cost.global_cost_model().flops_for(
+            _cost.bucket_fn(self.model, target))
+        reg = _qos.global_tenants()
+        for r in batch:
+            if r.tenant is None:
+                continue
+            k = int(r.x.shape[0])
+            reg.account_tokens(r.tenant, k)
+            if flops:
+                reg.account_cost(r.tenant, flops * k / max(1, target))
+
     # ------------------------------------------------- sync loop (ASYNC=0)
     def _serve_loop(self):
         """Single-threaded synchronous serve loop: one batch in flight,
@@ -861,6 +957,7 @@ class ParallelInference:
                 # feed every batch's device wall time into its MFU
                 _cost.maybe_account_bucket(self.model, self.batch_limit, X)
                 _cost.observe_bucket_time(self.model, self.batch_limit, dt)
+                self._charge_tenants(batch, self.batch_limit)
                 if self._breaker is not None:
                     self._breaker.record_success()
                 self._distribute(batch, out)
@@ -991,6 +1088,8 @@ class ParallelInference:
             # "device" = dispatch→materialize (execution + transfer tail);
             # "complete" = slicing the host buffer out to callers
             self._record_phase("device", batch, t_dev, t_done, examples=n)
+            if target is not None:
+                self._charge_tenants(batch, target)
             self._distribute(batch, out)
             self._record_phase("complete", batch, t_done, now_us())
             if t_dispatch is not None:

@@ -65,7 +65,7 @@ def snapshot() -> dict:
     deadline, the elastic posture, and the recent event ring
     (injections, retries, sheds, breaker transitions, restores,
     reshapes, quarantines)."""
-    from deeplearning4j_tpu.resilience import elastic, policy
+    from deeplearning4j_tpu.resilience import elastic, policy, qos
     return {
         "enabled": resilience_enabled(),
         "faults": faults.snapshot(),
@@ -73,6 +73,9 @@ def snapshot() -> dict:
         "default_deadline_ms": policy.default_deadline_ms(),
         "elastic": {"enabled": elastic.elastic_enabled(),
                     "capacity": elastic.global_capacity().snapshot()},
+        # per-tenant QoS breakdown (policies, bucket levels, counters) —
+        # the tenant-shed events in the ring need this to mean anything
+        "tenants": qos.snapshot(),
         "events": faults.events(),
     }
 

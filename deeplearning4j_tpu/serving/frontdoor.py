@@ -32,6 +32,16 @@ hardened as a traffic surface:
   with 429 before a traffic spike can pile threads onto the device
   queues; the ``http.request`` chaos point fires at the door so the
   whole surface is drivable under injected faults.
+- **Multi-tenant QoS** (``resilience/qos.py``; kill switch
+  ``DL4J_TPU_QOS=0``): the ``X-Dl4j-Tenant`` header names the caller
+  (absent = default tenant, behavior unchanged); per-tenant request-
+  rate / token-rate quotas are enforced AT the door (typed
+  ``QuotaExceeded`` → 429) and the label threads through the router
+  into both pipelines' weighted-fair queues. Every 429/503 shed
+  response carries a ``Retry-After`` header — quota sheds derive it
+  from the tenant's bucket refill time; the in-flight gate and other
+  sheds reuse the same surface with a default. ``GET /debug/tenants``
+  serves the live tenant table.
 - **Multi-process mode**: constructed with a
   :class:`~deeplearning4j_tpu.serving.shared_state.SharedServingState`,
   routing decisions (primary, canary split, stage) come from the shared
@@ -65,6 +75,7 @@ from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       trace_context)
 from deeplearning4j_tpu.resilience import faults as _faults
+from deeplearning4j_tpu.resilience import qos as _qos
 from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   CircuitOpenError,
                                                   DeadlineExceeded, ShedError,
@@ -77,6 +88,34 @@ from deeplearning4j_tpu.ui.server import default_bind_host  # noqa: F401
 #: request bodies above this are refused with 413 BEFORE buffering — a
 #: hardened door must not let one Content-Length header OOM the process
 MAX_BODY_BYTES = 16 << 20
+
+#: the tenant-identity request header (QoS posture; absent = default
+#: tenant, behavior unchanged)
+TENANT_HEADER = "X-Dl4j-Tenant"
+
+#: Retry-After for sheds that carry no quota refill time (the in-flight
+#: gate, an open circuit): "come back shortly", not a quota schedule
+DEFAULT_RETRY_AFTER_S = 1.0
+
+
+def retry_after_seconds(exc: Optional[BaseException]) -> float:
+    """The Retry-After a shed response should carry: a quota shed knows
+    its bucket's refill time (``QuotaExceeded.retry_after_s``); every
+    other 429/503 uses the default."""
+    v = getattr(exc, "retry_after_s", None)
+    try:
+        return max(0.0, float(v)) if v is not None else \
+            DEFAULT_RETRY_AFTER_S
+    except (TypeError, ValueError):
+        return DEFAULT_RETRY_AFTER_S
+
+
+def _retry_after_header(exc: Optional[BaseException] = None):
+    """RFC 7231 delta-seconds (integer, >= 1 so a client never busy-
+    loops on a sub-second value rounded to 0)."""
+    import math
+    return ("Retry-After",
+            str(max(1, math.ceil(retry_after_seconds(exc)))))
 
 
 def frontdoor_enabled() -> bool:
@@ -224,14 +263,16 @@ class FrontDoor:
     def _lane_router(self, lane: str):
         return self.router if lane == "scoring" else self.gen_router
 
-    def classify(self, x, deadline_ms=None, request_key=None):
+    def classify(self, x, deadline_ms=None, request_key=None,
+                 tenant=None):
         """One classify request through whichever routing mode is wired
         (shared store split or the local rollout machinery)."""
         if self.router is None:
             raise KeyError("no scoring deploy behind this front door")
         if self.shared is None:
             return self.router.output(x, deadline_ms=deadline_ms,
-                                      request_key=request_key), None
+                                      request_key=request_key,
+                                      tenant=tenant), None
         frac = request_fraction(x, request_key)
         version, canary = self.shared.pick("scoring", frac)
         if version is None:
@@ -240,7 +281,7 @@ class FrontDoor:
         t0 = time.perf_counter()
         try:
             out = self.router.output_on(version, x, deadline_ms=deadline_ms,
-                                        canary=canary)
+                                        canary=canary, tenant=tenant)
         except Exception as e:
             self.shared.record(version,
                                ok=isinstance(e, TYPED_OUTCOMES),
@@ -251,14 +292,15 @@ class FrontDoor:
         return out, version
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
-                 deadline_ms=None, request_key=None, on_token=None):
+                 deadline_ms=None, request_key=None, on_token=None,
+                 tenant=None):
         if self.gen_router is None:
             raise KeyError("no generative deploy behind this front door")
         if self.shared is None:
             return self.gen_router.generate(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                 deadline_ms=deadline_ms, request_key=request_key,
-                on_token=on_token), None
+                on_token=on_token, tenant=tenant), None
         frac = request_fraction(prompt, request_key)
         version, canary = self.shared.pick("generative", frac)
         if version is None:
@@ -269,7 +311,7 @@ class FrontDoor:
             out = self.gen_router.generate_on(
                 version, prompt, max_new_tokens=max_new_tokens,
                 eos_id=eos_id, deadline_ms=deadline_ms, canary=canary,
-                on_token=on_token)
+                on_token=on_token, tenant=tenant)
         except Exception as e:
             self.shared.record(version,
                                ok=isinstance(e, TYPED_OUTCOMES),
@@ -362,10 +404,19 @@ class FrontDoor:
                 obs.latency(route).observe(time.perf_counter() - t0)
 
             def _error(self, exc: BaseException, route: str, t0: float):
-                self._reply(http_status(exc), {
-                    "error": type(exc).__name__,
-                    "detail": str(exc),
-                }, route, t0)
+                code = http_status(exc)
+                payload = {"error": type(exc).__name__,
+                           "detail": str(exc)}
+                headers = ()
+                if code in (429, 503):
+                    # every shed response tells the client when to come
+                    # back: quota sheds derive it from the tenant's
+                    # bucket refill time, the rest use the default
+                    headers = (_retry_after_header(exc),)
+                    payload["retry_after_s"] = round(
+                        retry_after_seconds(exc), 3)
+                self._reply(code, payload, route, t0,
+                            extra_headers=headers)
 
             def _read_json(self) -> dict:
                 n = int(self.headers.get("Content-Length", 0) or 0)
@@ -398,8 +449,26 @@ class FrontDoor:
                     obs.shed["disabled"].inc()
                     self._reply(503, {"error": "FrontDoorDisabled",
                                       "detail": "DL4J_TPU_FRONTDOOR=0"},
-                                route, t0)
+                                route, t0,
+                                extra_headers=(_retry_after_header(),))
                     return
+                # tenant identity + quota admission (QoS posture; the
+                # kill switch leaves self._tenant None — the header is
+                # inert and no tenant series are touched)
+                self._tenant = None
+                if path.startswith("/v1/") and _qos.qos_enabled():
+                    tenants = _qos.global_tenants()
+                    try:
+                        self._tenant = tenants.admit(
+                            self.headers.get(TENANT_HEADER))
+                    except _qos.QuotaExceeded as e:
+                        # quota sheds are still per-tenant traffic: the
+                        # requests denominator must see a 100% -over-
+                        # quota tenant, not read it as "no traffic, ok"
+                        tenants.observe_request(
+                            e.tenant, time.perf_counter() - t0, e)
+                        self._error(e, route, t0)
+                        return
                 admitted = False
                 if path.startswith("/v1/"):
                     with fd._inflight_lock:
@@ -410,10 +479,15 @@ class FrontDoor:
                             admitted = True
                             obs.inflight.set(fd._inflight)
                     if not admitted:
+                        # the in-flight gate's shed reuses the same
+                        # Retry-After surface as the quota path
                         self._reply(429, {
                             "error": "ShedError",
                             "detail": f"front door at max_inflight="
-                                      f"{fd.max_inflight}"}, route, t0)
+                                      f"{fd.max_inflight}",
+                            "retry_after_s": DEFAULT_RETRY_AFTER_S},
+                            route, t0,
+                            extra_headers=(_retry_after_header(),))
                         return
                 try:
                     with _span("http_request", route=route):
@@ -453,7 +527,8 @@ class FrontDoor:
                     raise BadRequest(f"inputs not numeric: {e}")
                 out, version = fd.classify(
                     x, deadline_ms=body.get("deadline_ms"),
-                    request_key=body.get("request_key"))
+                    request_key=body.get("request_key"),
+                    tenant=self._tenant)
                 payload = {"outputs": np.asarray(out).tolist(),
                            "worker": fd.worker_id}
                 if version is not None:
@@ -475,7 +550,8 @@ class FrontDoor:
                 prompt, mnt = self._parse_generate(body)
                 kw = dict(max_new_tokens=mnt, eos_id=body.get("eos_id"),
                           deadline_ms=body.get("deadline_ms"),
-                          request_key=body.get("request_key"))
+                          request_key=body.get("request_key"),
+                          tenant=self._tenant)
                 if body.get("stream"):
                     self._generate_stream(prompt, kw, t0)
                     return
@@ -630,6 +706,11 @@ class FrontDoor:
                 try:
                     if path == "/debug/frontdoor":
                         self._reply(200, fd.snapshot(), route, t0)
+                    elif path == "/debug/tenants":
+                        # tenant policies, quota bucket levels, and
+                        # per-tenant lifetime counters — the multi-
+                        # tenant QoS view of this worker
+                        self._reply(200, _qos.snapshot(), route, t0)
                     elif path == "/metrics":
                         from deeplearning4j_tpu.observability import metrics
                         body = metrics().render_prometheus().encode()

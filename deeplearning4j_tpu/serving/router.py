@@ -134,7 +134,7 @@ class ServingRouter:
 
     # ------------------------------------------------------------- output
     def output(self, x, deadline_ms: Optional[float] = None,
-               request_key=None) -> np.ndarray:
+               request_key=None, tenant=None) -> np.ndarray:
         if not self._enabled:
             # kill switch: byte-identical single-version passthrough.
             # A kind mismatch is a wiring error (ValueError); a scoring
@@ -149,10 +149,12 @@ class ServingRouter:
                 raise ShutdownError(
                     f"version {self._primary.version!r} is not admitting "
                     f"(state={self._primary.state})")
-            return self._primary.pi.output(x, deadline_ms=deadline_ms)
+            return self._primary.pi.output(x, deadline_ms=deadline_ms,
+                                           tenant=tenant)
         rollout = self._rollout
         if rollout is None or not rollout.active:
-            return self._serve(self._primary, x, deadline_ms)
+            return self._serve(self._primary, x, deadline_ms,
+                               tenant=tenant)
         # time-mode rollouts grade on EVERY routed request, not only
         # candidate-involved ones — a low-traffic candidate must not
         # stall its own evaluation clock
@@ -162,10 +164,11 @@ class ServingRouter:
         if (rollout.share > 0.0 and frac < rollout.share
                 and candidate.admitting):
             try:
-                return self._serve(candidate, x, deadline_ms, canary=True)
+                return self._serve(candidate, x, deadline_ms, canary=True,
+                                   tenant=tenant)
             finally:
                 rollout.record_candidate_event()
-        out = self._serve(self._primary, x, deadline_ms)
+        out = self._serve(self._primary, x, deadline_ms, tenant=tenant)
         if (rollout.stage == RolloutState.SHADOW and candidate.admitting
                 and frac < rollout.policy.shadow_fraction):
             try:
@@ -178,7 +181,8 @@ class ServingRouter:
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 request_key=None, on_token=None) -> np.ndarray:
+                 request_key=None, on_token=None,
+                 tenant=None) -> np.ndarray:
         """Route one generation request across the registry's
         GENERATIVE versions — same deterministic hash split, per-version
         series, canary chaos point, and SLO-graded rollout as
@@ -203,11 +207,12 @@ class ServingRouter:
                     f"generation (state={self._primary.state})")
             return gp.generate(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                deadline_ms=deadline_ms, on_token=on_token)
+                deadline_ms=deadline_ms, on_token=on_token, tenant=tenant)
         rollout = self._rollout
         if rollout is None or not rollout.active:
             return self._serve_gen(self._primary, prompt, max_new_tokens,
-                                   eos_id, deadline_ms, on_token=on_token)
+                                   eos_id, deadline_ms, on_token=on_token,
+                                   tenant=tenant)
         rollout.maybe_timed_evaluate()
         frac = request_fraction(prompt, request_key)
         candidate = rollout.candidate
@@ -216,11 +221,12 @@ class ServingRouter:
             try:
                 return self._serve_gen(candidate, prompt, max_new_tokens,
                                        eos_id, deadline_ms, canary=True,
-                                       on_token=on_token)
+                                       on_token=on_token, tenant=tenant)
             finally:
                 rollout.record_candidate_event()
         out = self._serve_gen(self._primary, prompt, max_new_tokens,
-                              eos_id, deadline_ms, on_token=on_token)
+                              eos_id, deadline_ms, on_token=on_token,
+                              tenant=tenant)
         if (rollout.stage == RolloutState.SHADOW and candidate.admitting
                 and frac < rollout.policy.shadow_fraction):
             # shadow work must never affect the user's response — and a
@@ -240,7 +246,8 @@ class ServingRouter:
         return out
 
     def _serve_gen(self, dv, prompt, max_new_tokens, eos_id, deadline_ms,
-                   canary: bool = False, on_token=None) -> np.ndarray:
+                   canary: bool = False, on_token=None,
+                   tenant=None) -> np.ndarray:
         if dv.kind != "generative":
             # a wiring error, not a lifecycle state — never typed
             raise ValueError(
@@ -258,7 +265,7 @@ class ServingRouter:
                     _faults.check("serving.canary")
                 out = gp.generate(prompt, max_new_tokens=max_new_tokens,
                                   eos_id=eos_id, deadline_ms=deadline_ms,
-                                  on_token=on_token)
+                                  on_token=on_token, tenant=tenant)
         except Exception as e:
             self._account(dv, t0, error=e)
             raise
@@ -301,7 +308,8 @@ class ServingRouter:
         if error is not None and not isinstance(error, _TYPED_OUTCOMES):
             obs.errors(dv.version).inc()
 
-    def _serve(self, dv, x, deadline_ms, canary: bool = False) -> np.ndarray:
+    def _serve(self, dv, x, deadline_ms, canary: bool = False,
+               tenant=None) -> np.ndarray:
         if dv.kind == "generative":
             raise ValueError(
                 f"version {dv.version!r} is a generative deploy — use "
@@ -323,7 +331,8 @@ class ServingRouter:
                     # measured canary latency, error faults feed its
                     # error rate — exactly what the SLO gate grades
                     _faults.check("serving.canary")
-                out = pi.output(x, deadline_ms=deadline_ms)
+                out = pi.output(x, deadline_ms=deadline_ms,
+                                tenant=tenant)
         except Exception as e:
             self._account(dv, t0, error=e)
             raise
@@ -370,20 +379,22 @@ class ServingRouter:
 
     def output_on(self, version: str, x,
                   deadline_ms: Optional[float] = None,
-                  canary: bool = False) -> np.ndarray:
+                  canary: bool = False, tenant=None) -> np.ndarray:
         """Serve one scoring request on the NAMED version."""
         return self._serve(self._registry.get(version), x, deadline_ms,
-                           canary=canary)
+                           canary=canary, tenant=tenant)
 
     def generate_on(self, version: str, prompt,
                     max_new_tokens: Optional[int] = None,
                     eos_id: Optional[int] = None,
                     deadline_ms: Optional[float] = None,
-                    canary: bool = False, on_token=None) -> np.ndarray:
+                    canary: bool = False, on_token=None,
+                    tenant=None) -> np.ndarray:
         """Serve one generation request on the NAMED version."""
         return self._serve_gen(self._registry.get(version), prompt,
                                max_new_tokens, eos_id, deadline_ms,
-                               canary=canary, on_token=on_token)
+                               canary=canary, on_token=on_token,
+                               tenant=tenant)
 
     def repoint(self, version: str):
         """Re-point the primary at ``version`` (shared-store promotion:

@@ -19,7 +19,9 @@ deployed versions, rollout stage/share, SLO verdicts, drain states),
 ``/debug/generation`` (generative decode: per-pipeline slot tables,
 queue depth, KV-cache footprint), ``/debug/frontdoor`` (HTTP serving
 front doors: mode, in-flight gate, lane routers, shared-store fleet
-view), ``/debug/perf`` (the
+view), ``/debug/tenants`` (multi-tenant QoS: policies, quota bucket
+levels, per-tenant request/token/shed/cost counters),
+``/debug/perf`` (the
 cost observatory: per-entry-point FLOPs/bytes, live MFU, roofline
 verdicts), ``/debug/profile`` (on-demand device profiling: ``?steps=N``
 captures N work units and serves the parsed top-K per-op table).
@@ -709,6 +711,16 @@ class UIServer:
                         (_fdm.snapshot_all() if _fdm is not None
                          else {"frontdoors": []}),
                         default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/tenants":
+                    # multi-tenant QoS state: per-tenant policies
+                    # (weights, priority tiers, quotas), live token-
+                    # bucket levels, and lifetime request/token/shed/
+                    # cost counters — the first stop for "which tenant
+                    # is flooding and who is being shed"
+                    from deeplearning4j_tpu.resilience import qos as _qos
+                    body = json.dumps(_qos.snapshot(),
+                                      default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/debug/perf":
                     # cost observatory: per-entry-point FLOPs / bytes

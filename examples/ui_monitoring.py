@@ -202,6 +202,17 @@ def main():
           f"injected={res['faults']['injected']}, circuits={circuits}, "
           f"{len(res['events'])} events")
 
+    # ---- multi-tenant QoS: /debug/tenants -------------------------------
+    # tenant policies (weights, priority tiers, quotas), live token-
+    # bucket levels, and per-tenant request/token/shed/cost counters —
+    # which tenant is flooding and who is being shed
+    tn = _json.loads(urllib.request.urlopen(
+        server.get_address() + "/debug/tenants", timeout=5).read())
+    rows = [f"{name}: req={t['requests']} shed={t['shed']}"
+            for name, t in sorted(tn["tenants"].items())]
+    print(f"\n/debug/tenants: enabled={tn['enabled']}, "
+          f"top_n={tn['top_n']}, {rows or ['no tenants yet']}")
+
     # ---- elastic training: /debug/elastic -------------------------------
     # device-capacity view (host losses shrink it, healthy steps on the
     # degraded mesh restore it), mesh reshape history, and the sharded

@@ -36,7 +36,12 @@ gated), and ``SERVE_r*.json`` HTTP-load archives
 (``benchmarks/http_load.py``: the interleaved HTTP-vs-direct
 ``vs_direct`` ratio plus the goodput trajectory, sustained-only; raw
 p50/p99 milliseconds are reported, never gated — they are host-load
-weather). Alien/unreadable JSON is ignored, never fatal.
+weather), and ``QOS_r*.json`` multi-tenant flooding drills
+(``benchmarks/http_load.py --tenants``: the victim-tenant goodput
+ratio — flood phase / no-flood baseline, an interleaved same-run
+ratio so host drift divides out — sustained-only; raw victim p99
+ratios are reported, never gated). Alien/unreadable JSON is ignored,
+never fatal.
 
 Run standalone (``python tools/bench_diff.py [root]``, exit code =
 sustained regressions found) or from tests (tests/test_obs_perf.py
@@ -65,6 +70,7 @@ _ROUND_RE = re.compile(r"BENCH_r(\d+)[^/]*\.json$")
 _MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)[^/]*\.json$")
 _DECODE_RE = re.compile(r"DECODE_r(\d+)[^/]*\.json$")
 _SERVE_RE = re.compile(r"SERVE_r(\d+)[^/]*\.json$")
+_QOS_RE = re.compile(r"QOS_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -306,6 +312,68 @@ def check_serve(samples: List[ServeSample],
     ], tolerance, sustain)
 
 
+class QosSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                      # "qos_drill"
+    platform: Optional[str]
+    victim_goodput_ratio: Optional[float]  # min over victims of
+                                           # flood/baseline goodput —
+                                           # same-run ratio, drift-immune
+    victim_p99_ratio: Optional[float]      # reported, never gated
+    flooder_shed: Optional[int]
+
+
+def load_qos(root: str) -> List[QosSample]:
+    """``QOS_r*.json`` flooding-drill archives (``http_load.py
+    --tenants`` records, bare or driver-wrapped). Anything without a
+    ``qos_`` metric — alien JSON — is ignored, never fatal."""
+    out: List[QosSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "QOS_r*.json"))):
+        m = _QOS_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("qos_"):
+            continue
+        ratio = doc.get("victim_goodput_ratio", doc.get("value"))
+        out.append(QosSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            victim_goodput_ratio=(float(ratio)
+                                  if isinstance(ratio, (int, float))
+                                  else None),
+            victim_p99_ratio=(float(doc["victim_p99_ratio"])
+                              if isinstance(doc.get("victim_p99_ratio"),
+                                            (int, float)) else None),
+            flooder_shed=(int(doc["flooder_shed"])
+                          if isinstance(doc.get("flooder_shed"),
+                                        (int, float)) else None)))
+    return out
+
+
+def check_qos(samples: List[QosSample],
+              tolerance: float = DEFAULT_TOLERANCE,
+              sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the flooding-drill trajectory under the same noise-aware
+    rules: newest file per round by mtime, same-platform only,
+    sustained-only — on the victim-goodput ratio ONLY (it is a same-run
+    interleaved ratio; the raw p99 ratios are host weather and are
+    reported, never gated)."""
+    return _grade_metric_groups(samples, [
+        ("victim_goodput", lambda s: s.victim_goodput_ratio),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -398,14 +466,16 @@ def main(argv=None) -> int:
     dryruns = load_multichip(root)
     decodes = load_decode(root)
     serves = load_serve(root)
-    if not samples and not dryruns and not decodes and not serves:
+    qos = load_qos(root)
+    if (not samples and not dryruns and not decodes and not serves
+            and not qos):
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
               "nothing to grade")
         return 0
     regressions = (check_trajectory(samples) + check_decode(decodes)
-                   + check_serve(serves))
+                   + check_serve(serves) + check_qos(qos))
     breaks = check_multichip(dryruns)
     for s in samples:
         marks = []
@@ -437,6 +507,16 @@ def main(argv=None) -> int:
             marks.append(f"p99={s.p99_ms:.1f}ms")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + " ".join(marks))
+    for s in qos:
+        marks = []
+        if s.victim_goodput_ratio is not None:
+            marks.append(f"victim_goodput={s.victim_goodput_ratio:.3f}")
+        if s.victim_p99_ratio is not None:
+            marks.append(f"victim_p99_ratio={s.victim_p99_ratio:.2f}")
+        if s.flooder_shed is not None:
+            marks.append(f"flooder_shed={s.flooder_shed}")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
@@ -444,7 +524,8 @@ def main(argv=None) -> int:
     if not regressions and not breaks:
         print(f"bench trajectory OK ({len(samples)} bench + "
               f"{len(dryruns)} dryrun + {len(decodes)} decode + "
-              f"{len(serves)} serve samples under {root})")
+              f"{len(serves)} serve + {len(qos)} qos samples "
+              f"under {root})")
     return len(regressions) + len(breaks)
 
 

@@ -13,6 +13,11 @@ Rules (on every ``.counter("name", ...)`` / ``.gauge(...)`` /
   from PR 2
 - a non-empty description (HELP text) is provided
 - label names are lowercase snake (``[a-z][a-z0-9_]*``)
+- **label cardinality**: a ``.labels(tenant=...)`` binding must pass a
+  string literal or a value produced by the bounded ``tenant_label``
+  helper (``resilience/qos.py``: configured tenants + top-N, overflow
+  bucket beyond) — never a raw request string, which would let one
+  caller spraying tenant ids explode the registry
 
 Run standalone (``python tools/check_metric_names.py [root]``, exit code =
 violation count) or from tests (tests/test_obs_causal.py imports and runs
@@ -81,12 +86,44 @@ def _description(call: ast.Call) -> Optional[str]:
     return None
 
 
+def _is_tenant_label_call(node) -> bool:
+    """``tenant_label(...)`` / ``<anything>.tenant_label(...)`` — the
+    bounded-cardinality helper the ``{tenant}`` label must route
+    through."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name == "tenant_label"
+
+
 def check_source(source: str, path: str = "<string>") -> List[Violation]:
     out: List[Violation] = []
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Violation(path, e.lineno or 0, "<parse>", str(e))]
+    # the helper's home module is the ONE place allowed to bind an
+    # already-bounded label variable directly (every tenant series is
+    # born there); everywhere else must call tenant_label at the site
+    in_qos_module = path.replace(os.sep, "/").endswith(
+        "resilience/qos.py")
+    for node in ast.walk(tree):
+        if (not in_qos_module and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"):
+            for kw in node.keywords:
+                if kw.arg != "tenant":
+                    continue
+                if (_const_str(kw.value) is None
+                        and not _is_tenant_label_call(kw.value)):
+                    out.append(Violation(
+                        path, node.lineno, "{tenant}",
+                        "tenant label values must be string literals "
+                        "or routed through the bounded tenant_label() "
+                        "helper (resilience/qos.py) — raw request "
+                        "strings are unbounded cardinality"))
     for node in ast.walk(tree):
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
