@@ -33,11 +33,32 @@ calls across steps).
 A/Bs against: re-run the full forward over the (fixed-padded) sequence
 per emitted token — one executable, no cache, per-token cost linear in
 the whole sequence length instead of constant.
+
+PR 13 grows three composing levers (see ARCHITECTURE §20):
+
+- **Paged cache** (default; ``DL4J_TPU_KV_PAGE_TOKENS``, 0 = dense
+  kill switch): k/v live in a pool of fixed-size pages + a per-slot
+  page table (``DecodeState`` carries the pool, the host-side
+  ``PageAllocator`` free list, and the table); decode scatters/gathers
+  through the table, so which pages are allocated is DATA and the
+  zero-retrace pins carry over. ``free_slot`` returns pages;
+  exhaustion raises the typed ``CachePagesExhausted``.
+- **int8 pages** (``DL4J_TPU_KV_QUANT=1``): int8 rows + per-row f32
+  scales, dequantized on the fly in the attention; a deploy/warmup-
+  time numerics gate (eager probe vs the f32 dense reference) falls
+  back to f32 pages loudly when divergence exceeds ``quant_tol``.
+- **Speculative decoding** (``draft=`` + ``spec_k``; kill switch
+  ``DL4J_TPU_SPEC_DECODE=0``): one fused executable runs all k draft
+  steps, one W=k+1 windowed verify scores carry+proposals on the
+  target, and the host accept/resample loop keeps the emitted
+  distribution exactly the target's (greedy: byte-identical tokens).
 """
 from __future__ import annotations
 
 import bisect
 import dataclasses
+import logging
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -47,11 +68,141 @@ from jax import lax
 
 from deeplearning4j_tpu.observability import compile_watch as _cw
 from deeplearning4j_tpu.observability import cost_model as _cost
+from deeplearning4j_tpu.resilience.policy import CachePagesExhausted
+
+_log = logging.getLogger(__name__)
 
 #: compile-watch / cost-model entry-point names (the zero-steady-state-
 #: retrace assertions and /debug/perf rows key on these)
 PREFILL_FN = "TransformerLM.prefill"
 DECODE_FN = "TransformerLM.decode_step"
+VERIFY_FN = "TransformerLM.spec_verify"
+PROPOSE_FN = "DraftLM.spec_propose"
+
+#: default KV page size in tokens (``DL4J_TPU_KV_PAGE_TOKENS``; 0 = the
+#: dense per-slot preallocation, byte-identical pre-paged behavior)
+KV_PAGE_TOKENS_DEFAULT = 64
+
+
+def page_tokens_env() -> Optional[int]:
+    """``DL4J_TPU_KV_PAGE_TOKENS``: page size in tokens, ``0`` = dense
+    kill switch, unset = None (engine default). Read at engine
+    construction, like the other trace-time knobs. A malformed value
+    RAISES — this is the documented rollback lever, and an operator's
+    failed kill-switch attempt must never silently keep paging on."""
+    raw = os.environ.get("DL4J_TPU_KV_PAGE_TOKENS")
+    if raw is None or raw == "":
+        return None
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        raise ValueError(
+            f"DL4J_TPU_KV_PAGE_TOKENS={raw!r} is not an integer "
+            "(0 = dense kill switch)")
+
+
+def kv_quant_env() -> bool:
+    """``DL4J_TPU_KV_QUANT=1``: opt-in int8 KV storage (paged mode
+    only), gated by the deploy-time numerics check. Default off, and
+    STRICTLY ``1`` = on (the repo's default-off knob convention) — a
+    numerics-changing feature must never engage on ``false``/``off``."""
+    return os.environ.get("DL4J_TPU_KV_QUANT", "0") == "1"
+
+
+def spec_decode_env() -> bool:
+    """``DL4J_TPU_SPEC_DECODE``: speculative decoding master switch.
+    Engaged only when an engine is BUILT with a draft; ``0`` forces the
+    plain one-token decode path even then (the kill switch)."""
+    return os.environ.get("DL4J_TPU_SPEC_DECODE", "1") not in ("0", "")
+
+
+def pack_kv_pages(arr, page_tokens: int):
+    """(L, 1, Tb, H, hd) prefill k/v → (L, npb, P, H, hd) page rows,
+    zero-padded up to whole pages (pad rows sit past the prompt's
+    positions — masked until the slot's own decode writes overwrite
+    them). ONE spelling shared by the traced paged insert and the
+    eager numerics-gate probe: the gate must compare exactly the
+    packing production inserts use, or a layout change could slip past
+    it."""
+    L, _b, tb, h, hd = arr.shape
+    npb = -(-tb // page_tokens)
+    pad = npb * page_tokens - tb
+    a = jnp.pad(arr[:, 0], ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return a.reshape(L, npb, page_tokens, h, hd)
+
+
+class PageAllocator:
+    """Host-side free list over the physical page pool. Single-threaded
+    by design: the decode loop owns every alloc/free (the same
+    exclusivity the slot arrays already have), so there is no lock to
+    contend and exhaustion is decided at one place — the step
+    boundary."""
+
+    def __init__(self, total: int):
+        if total < 1:
+            raise ValueError(f"page pool must hold >= 1 page, got {total}")
+        self.total = int(total)
+        # LIFO free list: recently-freed pages are re-used first, which
+        # keeps the touched working set small
+        self._free: List[int] = list(range(self.total - 1, -1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.total - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` pages, or None when the pool cannot cover them —
+        all-or-nothing (a partial grant would leave a slot half-backed
+        and the caller with cleanup it cannot express)."""
+        if n <= 0:
+            return []
+        if n > len(self._free):
+            return None
+        got = self._free[-n:]
+        del self._free[-n:]
+        return got
+
+    def free(self, pages: Sequence[int]):
+        for p in pages:
+            if not 0 <= p < self.total:
+                raise ValueError(f"page {p} outside pool [0, {self.total})")
+        if pages:
+            if len(set(pages)) != len(pages):
+                # a duplicated id in one free() is the same corruption
+                # class as a double free: the page would enter the free
+                # list twice and later back two different slots
+                raise ValueError(f"duplicate pages in free: {list(pages)}")
+            seen = set(self._free)
+            dup = [p for p in pages if p in seen]
+            if dup:
+                raise ValueError(f"double free of pages {dup}")
+        self._free.extend(int(p) for p in pages)
+
+
+class DecodeState:
+    """Mutable cache state for ONE consumer (a pipeline or a generate
+    loop): the device cache arrays plus — in paged mode — the host-side
+    page allocator, per-slot page lists, and the page table mirror that
+    ships to the device. The decode thread owns it exclusively."""
+
+    __slots__ = ("mode", "slots", "arrays", "tables", "tables_dev",
+                 "alloc", "slot_pages", "draft_cache")
+
+    def __init__(self, mode: str, slots: int, arrays: Dict,
+                 tables: Optional[np.ndarray] = None,
+                 alloc: Optional[PageAllocator] = None):
+        self.mode = mode                   # "dense" | "paged"
+        self.slots = int(slots)
+        self.arrays = arrays               # dense cache or page pool
+        self.tables = tables               # (slots, pages_per_slot) int32
+        self.tables_dev = None             # device mirror, rebuilt lazily
+        self.alloc = alloc
+        self.slot_pages: List[List[int]] = [[] for _ in range(slots)]
+        self.draft_cache = None            # dense draft KV (spec mode)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +222,24 @@ class SamplerConfig:
         if self.temperature <= 0.0:
             raise ValueError("temperature must be > 0 (use kind='greedy' "
                              "for deterministic decoding)")
+
+
+def _dist_probs(logits_row: np.ndarray, sampler: SamplerConfig) -> np.ndarray:
+    """The host-side probability vector a sampler draws from (the
+    accept/resample loop needs p and q explicitly): greedy = a delta at
+    the argmax, top-k/temperature = softmax over the scaled top-k."""
+    v = logits_row.shape[-1]
+    if sampler.kind == "greedy":
+        p = np.zeros((v,), np.float64)
+        p[int(np.argmax(logits_row))] = 1.0
+        return p
+    scaled = logits_row.astype(np.float64) / sampler.temperature
+    if sampler.top_k and sampler.top_k > 0:
+        kth = np.sort(scaled)[-sampler.top_k]
+        scaled = np.where(scaled >= kth, scaled, -np.inf)
+    scaled -= scaled.max()
+    e = np.exp(scaled)
+    return e / e.sum()
 
 
 def sample_tokens(logits, rng, sampler: SamplerConfig):
@@ -106,7 +275,11 @@ class DecodeEngine:
 
     def __init__(self, model, params, max_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 sampler: Optional[SamplerConfig] = None, seed: int = 0):
+                 sampler: Optional[SamplerConfig] = None, seed: int = 0,
+                 page_tokens: Optional[int] = None,
+                 kv_quant: Optional[bool] = None,
+                 quant_tol: float = 0.05,
+                 draft: Optional["DecodeEngine"] = None, spec_k: int = 4):
         c = model.config
         self.model = model
         self.params = params
@@ -128,7 +301,54 @@ class DecodeEngine:
             buckets = default_prefill_buckets(self.max_len)
         self.prefill_buckets = buckets
         self._base_key = jax.random.key(int(seed))
+        self._seed = int(seed)
         sampler_cfg = self.sampler
+
+        # ---- paged cache / int8 quant / speculative posture (resolved
+        # at construction like the other trace-time knobs)
+        pt = page_tokens if page_tokens is not None else page_tokens_env()
+        pt = KV_PAGE_TOKENS_DEFAULT if pt is None else int(pt)
+        # a page longer than the cache would waste rows AND break the
+        # >=2x-slots admission math — clamp silently (power-of-two
+        # buckets keep the division exact in practice)
+        self.page_tokens = min(pt, self.max_len) if pt > 0 else 0
+        self.paged = self.page_tokens > 0
+        self.pages_per_slot = (-(-self.max_len // self.page_tokens)
+                               if self.paged else 0)
+        self.kv_quant = bool(kv_quant if kv_quant is not None
+                             else kv_quant_env())
+        if self.kv_quant and not self.paged:
+            _log.warning(
+                "DL4J_TPU_KV_QUANT requested with the dense cache "
+                "(DL4J_TPU_KV_PAGE_TOKENS=0) — int8 storage is per-page; "
+                "keeping the f32 dense cache")
+            self.kv_quant = False
+        self.quant_tol = float(quant_tol)
+        #: numerics-gate record (None until the gate has run); the gate
+        #: may flip ``kv_quant`` back to False with a loud warning
+        self.quant_gate: Optional[dict] = None
+        self.spec_k = int(spec_k)
+        if draft is not None:
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            dc = draft.model.config
+            if dc.vocab_size != c.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dc.vocab_size} != target vocab "
+                    f"{c.vocab_size} — accept/resample needs one "
+                    "distribution support")
+            if draft.max_len < self.max_len:
+                raise ValueError(
+                    f"draft max_len {draft.max_len} < target max_len "
+                    f"{self.max_len} — the draft must reach every "
+                    "position the target decodes")
+        self.draft = draft
+        #: speculative decoding engaged: a draft was provided AND the
+        #: DL4J_TPU_SPEC_DECODE kill switch is not set
+        self.spec = draft is not None and spec_decode_env()
+        #: cumulative accept-loop stats (the dl4j_spec_accept_ratio
+        #: gauge and the snapshot ``spec`` section read these)
+        self.spec_stats = {"rounds": 0, "proposed": 0, "accepted": 0}
 
         def _prefill(params, tokens, last_idx, step):
             _cw.note_trace(PREFILL_FN, tokens)
@@ -158,13 +378,289 @@ class DecodeEngine:
         self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
         self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
 
+        # ---- paged twins: same entry-point names (DECODE_FN), so the
+        # zero-steady-state-retrace pins and /debug/perf rows carry over
+        page_toks = self.page_tokens
+
+        def _decode_paged(params, pool, tables, tokens, positions, step):
+            _cw.note_trace(DECODE_FN, tokens, positions)
+            logits, pool = model.decode_window_paged(
+                params, pool, tables, tokens[:, None], positions,
+                page_toks)
+            logits = logits[:, 0]
+            rng = jax.random.fold_in(self._base_key, step)
+            nxt = sample_tokens(logits, rng, sampler_cfg)
+            return nxt, logits, pool
+
+        def _insert_paged(pool, k, v, page_ids):
+            # (L, 1, Tb, H, hd) prefill k/v → whole-page rows
+            # (pack_kv_pages) scattered into the slot's physical pages
+            kr = pack_kv_pages(k, page_toks)
+            vr = pack_kv_pages(v, page_toks)
+            if "k_scale" in pool:
+                from deeplearning4j_tpu.models import transformer as _tr
+                k8, ks = _tr.quantize_kv_rows(kr)
+                v8, vs = _tr.quantize_kv_rows(vr)
+                return {"k": pool["k"].at[:, page_ids].set(k8),
+                        "v": pool["v"].at[:, page_ids].set(v8),
+                        "k_scale": pool["k_scale"].at[:, page_ids].set(ks),
+                        "v_scale": pool["v_scale"].at[:, page_ids].set(vs)}
+            return {"k": pool["k"].at[:, page_ids].set(kr),
+                    "v": pool["v"].at[:, page_ids].set(vr)}
+
+        def _verify_paged(params, pool, tables, win, positions, step):
+            _cw.note_trace(VERIFY_FN, win, positions)
+            logits, pool = model.decode_window_paged(
+                params, pool, tables, win, positions, page_toks)
+            return logits, pool
+
+        def _verify_dense(params, cache, win, positions, step):
+            _cw.note_trace(VERIFY_FN, win, positions)
+            logits, cache = model.decode_window_math(
+                params, cache, win, positions)
+            return logits, cache
+
+        self._decode_paged_jit = jax.jit(_decode_paged, donate_argnums=(1,))
+        self._insert_paged_jit = jax.jit(_insert_paged, donate_argnums=(0,))
+        self._verify_paged_jit = jax.jit(_verify_paged, donate_argnums=(1,))
+        self._verify_dense_jit = jax.jit(_verify_dense, donate_argnums=(1,))
+
+        if draft is not None:
+            d_model, d_sampler = draft.model, draft.sampler
+            d_key, k_prop = draft._base_key, self.spec_k
+
+            def _propose(dparams, dcache, tokens, positions, step):
+                # k sequential draft decode steps fused into ONE
+                # executable — one dispatch proposes the whole window
+                # (per-step draft dispatches would eat the speculative
+                # win on dispatch-bound hosts)
+                _cw.note_trace(PROPOSE_FN, tokens, positions)
+                t, pos = tokens, positions
+                props, dlogits = [], []
+                for j in range(k_prop):
+                    logits, dcache = d_model.decode_step_math(
+                        dparams, dcache, t, pos)
+                    rng = jax.random.fold_in(d_key,
+                                             step * (k_prop + 1) + j)
+                    t = sample_tokens(logits, rng, d_sampler)
+                    props.append(t)
+                    dlogits.append(logits)
+                    pos = pos + 1
+                return (jnp.stack(props, axis=1),
+                        jnp.stack(dlogits, axis=1), dcache)
+
+            self._propose_jit = jax.jit(_propose, donate_argnums=(1,))
+
     # ------------------------------------------------------------- cache
-    def new_cache(self, slots: int) -> Dict:
-        return self.model.init_cache(slots, self.max_len)
+    def new_state(self, slots: int,
+                  pages: Optional[int] = None) -> DecodeState:
+        """Build the decode-side cache state for ``slots`` concurrent
+        sequences. Paged mode: a pool of ``pages`` physical pages
+        (default = the dense worst case, ``slots * pages_per_slot``;
+        pass FEWER to admit by actual cached tokens against a fixed
+        HBM budget) plus one reserved trash page that free slots' table
+        rows point at — a freed slot's stale writes can never land in a
+        page another slot owns. Spec mode adds the draft's dense KV."""
+        if not self.paged:
+            state = DecodeState("dense", slots,
+                                self.model.init_cache(slots, self.max_len))
+        else:
+            n = int(pages) if pages is not None \
+                else slots * self.pages_per_slot
+            if n < 1:
+                raise ValueError(f"page pool needs >= 1 page, got {n}")
+            pool = self.model.init_paged_cache(
+                n + 1, self.page_tokens, quant=self._quant_active())
+            tables = np.full((slots, self.pages_per_slot), n, np.int32)
+            state = DecodeState("paged", slots, pool, tables=tables,
+                                alloc=PageAllocator(n))
+        if self.spec:
+            # the draft's dense cache must hold every position the
+            # target decodes AND its own largest prefill bucket for the
+            # longest admissible prompt (its buckets may be coarser)
+            draft_len = max(self.max_len,
+                            self.draft.prefill_bucket(self.max_len))
+            state.draft_cache = self.draft.model.init_cache(
+                slots, draft_len)
+        return state
+
+    def new_cache(self, slots: int) -> DecodeState:
+        """Back-compat spelling of :meth:`new_state`."""
+        return self.new_state(slots)
 
     @staticmethod
     def cache_bytes(cache) -> int:
+        """Total device bytes of a cache/state (dense prealloc, or the
+        whole page pool + draft cache) — the worst-case footprint."""
+        if isinstance(cache, DecodeState):
+            total = sum(int(a.nbytes) for a in jax.tree.leaves(cache.arrays))
+            if cache.draft_cache is not None:
+                total += sum(int(a.nbytes)
+                             for a in jax.tree.leaves(cache.draft_cache))
+            return int(total)
         return int(sum(int(a.nbytes) for a in jax.tree.leaves(cache)))
+
+    def page_bytes(self) -> int:
+        """Device bytes one page costs ACROSS ALL LAYERS (the pool
+        carries every layer's k + v + scale rows for a page, so one
+        allocated page id pins ``n_layers`` stripes) —
+        ``pages_in_use x page_bytes`` is the actual resident cache, the
+        admission unit."""
+        if not self.paged:
+            return 0
+        c = self.model.config
+        h, hd = c.n_heads, c.d_model // c.n_heads
+        per_row = h * hd
+        if self._quant_active():
+            # int8 k + int8 v + one f32 scale each, per layer
+            return c.n_layers * self.page_tokens * (2 * per_row + 8)
+        itemsize = jnp.dtype(c.dtype).itemsize
+        return c.n_layers * self.page_tokens * 2 * per_row * itemsize
+
+    def resident_cache_bytes(self, state: DecodeState) -> int:
+        """ACTUAL resident TARGET-cache bytes: dense = the full
+        preallocation (all resident); paged = pages in use x page bytes
+        post-quantization — the admission unit the
+        dl4j_decode_cache_bytes gauge reports post-PR-13. The draft's
+        fixed dense cache is deliberately excluded (a constant, visible
+        in the snapshot's ``pool_bytes`` worst-case figure)."""
+        if state.mode != "paged":
+            return int(sum(int(a.nbytes)
+                           for a in jax.tree.leaves(state.arrays)))
+        return int(state.alloc.in_use * self.page_bytes())
+
+    # ------------------------------------------------------ page plumbing
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages covering ``n_tokens`` cache rows."""
+        return -(-int(n_tokens) // self.page_tokens) if self.paged else 0
+
+    def min_pages_for_prompt(self, prompt_len: int) -> int:
+        """Pages a request needs to ADMIT: the prefill writes its whole
+        padded bucket, and the first decode step writes at position
+        ``prompt_len`` — whichever reaches further."""
+        if not self.paged:
+            return 0
+        bucket = self.prefill_bucket(prompt_len)
+        return max(self.pages_for(bucket), self.pages_for(prompt_len + 1))
+
+    def ensure_slot_pages(self, state: DecodeState, slot: int,
+                          last_position: int) -> bool:
+        """Grow ``slot``'s page list to cover a write at
+        ``last_position``; False when the pool is exhausted (the caller
+        sheds/reclaims at the step boundary — nothing was allocated)."""
+        if state.mode != "paged":
+            return True
+        needed = int(last_position) // self.page_tokens + 1
+        have = len(state.slot_pages[slot])
+        if needed <= have:
+            return True
+        got = state.alloc.alloc(needed - have)
+        if got is None:
+            return False
+        state.slot_pages[slot].extend(got)
+        state.tables[slot, have:needed] = got
+        state.tables_dev = None
+        return True
+
+    def free_slot(self, state: DecodeState, slot: int):
+        """Return ``slot``'s pages to the pool and repoint its table row
+        at the trash page (stale writes from the freed slot become
+        harmless scribbles nobody's table references)."""
+        if state.mode != "paged":
+            return
+        pages = state.slot_pages[slot]
+        if pages:
+            state.alloc.free(pages)
+            state.slot_pages[slot] = []
+            state.tables[slot, :] = state.alloc.total
+            state.tables_dev = None
+
+    def _tables(self, state: DecodeState):
+        if state.tables_dev is None:
+            state.tables_dev = jnp.asarray(state.tables)
+        return state.tables_dev
+
+    # ---------------------------------------------------- quant numerics
+    def _quant_active(self) -> bool:
+        """int8 storage is live only after the deploy/warmup-time
+        numerics gate passes; a failed gate falls back to f32 pages
+        with a loud warning (the flash-kernel probe pattern)."""
+        if not self.kv_quant:
+            return False
+        if self.quant_gate is None:
+            self._run_quant_gate()
+        return self.kv_quant
+
+    def _run_quant_gate(self):
+        """Compare int8-cached decode logits against the f32 dense
+        reference on a small probe (eager, off every jit cache): prefill
+        the smallest bucket, teacher-force a few greedy steps through
+        BOTH paths, and compare per-step logits. Divergence beyond
+        ``quant_tol`` flips the engine back to f32 storage."""
+        from deeplearning4j_tpu.models import transformer as _tr
+        model, params = self.model, self.params
+        bucket = self.prefill_buckets[0]
+        if self.max_len - bucket < 1:
+            # the smallest bucket fills the cache — probe a shorter
+            # prompt so the gate has room to decode
+            bucket = self.prefill_bucket(max(1, self.max_len // 2))
+        steps = max(1, min(4, self.max_len - bucket))
+        rng = np.random.default_rng(1234)
+        prompt = rng.integers(0, model.config.vocab_size,
+                              (1, bucket)).astype(np.int32)
+        logits_p, kv = model.prefill(params, jnp.asarray(prompt))
+        # f32 dense reference cache
+        ref = model.init_cache(1, self.max_len)
+        zero = jnp.zeros((), jnp.int32)
+        at = (zero, zero, zero, zero, zero)
+        ref = {"k": lax.dynamic_update_slice(ref["k"], kv["k"], at),
+               "v": lax.dynamic_update_slice(ref["v"], kv["v"], at)}
+        # quantized paged probe: one slot, enough pages for the probe
+        n_pages = min(self.pages_for(bucket + steps), self.pages_per_slot)
+        pool = model.init_paged_cache(n_pages + 1, self.page_tokens,
+                                      quant=True)
+        tables = np.full((1, self.pages_per_slot), n_pages, np.int32)
+        tables[0, :n_pages] = np.arange(n_pages)
+        k8, ks = _tr.quantize_kv_rows(pack_kv_pages(kv["k"],
+                                                    self.page_tokens))
+        v8, vs = _tr.quantize_kv_rows(pack_kv_pages(kv["v"],
+                                                    self.page_tokens))
+        ids = np.arange(self.pages_for(bucket))
+        pool = {"k": pool["k"].at[:, ids].set(k8),
+                "v": pool["v"].at[:, ids].set(v8),
+                "k_scale": pool["k_scale"].at[:, ids].set(ks),
+                "v_scale": pool["v_scale"].at[:, ids].set(vs)}
+        tok = jnp.argmax(logits_p[:, bucket - 1], axis=-1).astype(jnp.int32)
+        pos = jnp.full((1,), bucket, jnp.int32)
+        max_diff = 0.0
+        argmax_agree = True
+        tables_dev = jnp.asarray(tables)
+        for _ in range(steps):
+            ref_logits, ref = model.decode_step_math(params, ref, tok, pos)
+            q_logits, pool = model.decode_window_paged(
+                params, pool, tables_dev, tok[:, None], pos,
+                self.page_tokens)
+            q_logits = q_logits[:, 0]
+            diff = float(jnp.max(jnp.abs(q_logits - ref_logits)))
+            max_diff = max(max_diff, diff)
+            if int(jnp.argmax(q_logits)) != int(jnp.argmax(ref_logits)):
+                argmax_agree = False
+            # teacher-force the REFERENCE continuation so quantization
+            # error is measured per step, never compounded by token
+            # divergence
+            tok = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
+            pos = pos + 1
+        passed = max_diff <= self.quant_tol
+        self.quant_gate = {"checked": True, "passed": passed,
+                           "max_abs_logit_diff": max_diff,
+                           "tol": self.quant_tol,
+                           "argmax_agree": argmax_agree}
+        if not passed:
+            self.kv_quant = False
+            _log.warning(
+                "int8 KV-cache numerics gate FAILED (max |logit diff| "
+                "%.4g > tol %.4g) — falling back to f32 page storage",
+                max_diff, self.quant_tol)
 
     # ----------------------------------------------------------- buckets
     def prefill_bucket(self, length: int) -> int:
@@ -205,50 +701,214 @@ class DecodeEngine:
     def decode(self, cache, tokens: np.ndarray, positions: np.ndarray,
                step: int):
         """One jitted decode step. ``cache`` is donated — the caller
-        must use the returned one. Returns (next_tokens (B,), logits
-        (B, V), cache). (The jitted body also returns the advanced
-        positions; step-wise callers that own their position book — the
-        continuous batcher — ignore it.)"""
-        nxt, logits, cache, _pos = self._decode_jit(
-            self.params, cache, jnp.asarray(tokens, jnp.int32),
+        must use the returned one (a :class:`DecodeState` is mutated in
+        place AND returned). Returns (next_tokens (B,), logits (B, V),
+        cache). Paged callers must have ensured pages for every write
+        position (:meth:`ensure_slot_pages`)."""
+        if isinstance(cache, DecodeState) and cache.mode == "paged":
+            # back every OCCUPIED slot's write position (positions are
+            # host values). Slots with no pages are free: their table
+            # rows point at the trash page, so their writes are
+            # harmless scribbles needing no allocation — same for
+            # past-the-end positions of retired slots.
+            for b, pos in enumerate(np.asarray(positions)):
+                if not cache.slot_pages[b] or int(pos) >= self.max_len:
+                    continue
+                if not self.ensure_slot_pages(cache, b, int(pos)):
+                    raise CachePagesExhausted(
+                        f"page pool exhausted backing slot {b} at "
+                        f"position {int(pos)}")
+            nxt, logits, cache.arrays = self._decode_paged_jit(
+                self.params, cache.arrays, self._tables(cache),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(step, jnp.int32))
+            return nxt, logits, cache
+        arrays = cache.arrays if isinstance(cache, DecodeState) else cache
+        nxt, logits, arrays, _pos = self._decode_jit(
+            self.params, arrays, jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32), jnp.asarray(step, jnp.int32))
-        return nxt, logits, cache
+        if isinstance(cache, DecodeState):
+            cache.arrays = arrays
+            return nxt, logits, cache
+        return nxt, logits, arrays
 
     def insert_slot(self, cache, kv, slot: int):
         """Write a prefill's (L, Bp, T_bucket, H, hd) k/v into the cache
-        starting at ``slot`` (donates the cache). The slot index is
-        traced: joining slot 3 reuses slot 0's executable."""
-        return self._insert_jit(cache, kv["k"], kv["v"],
-                                jnp.asarray(slot, jnp.int32))
+        starting at ``slot`` (donates the cache arrays). Dense: a traced
+        slot index — joining slot 3 reuses slot 0's executable. Paged: a
+        :class:`DecodeState` is required; the slot's pages are
+        allocated here (raises :class:`CachePagesExhausted` when the
+        pool cannot cover the prompt's bucket — nothing allocated,
+        nothing written)."""
+        if isinstance(cache, DecodeState) and cache.mode == "paged":
+            npb = self.pages_for(kv["k"].shape[2])
+            if cache.slot_pages[slot]:
+                self.free_slot(cache, slot)
+            pages = cache.alloc.alloc(npb)
+            if pages is None:
+                raise CachePagesExhausted(
+                    f"KV page pool exhausted: prompt bucket needs {npb} "
+                    f"pages, {cache.alloc.free_count} free of "
+                    f"{cache.alloc.total}")
+            cache.slot_pages[slot] = pages
+            cache.tables[slot, :npb] = pages
+            cache.tables_dev = None
+            cache.arrays = self._insert_paged_jit(
+                cache.arrays, kv["k"], kv["v"],
+                jnp.asarray(pages, jnp.int32))
+            return cache
+        arrays = cache.arrays if isinstance(cache, DecodeState) else cache
+        arrays = self._insert_jit(arrays, kv["k"], kv["v"],
+                                  jnp.asarray(slot, jnp.int32))
+        if isinstance(cache, DecodeState):
+            cache.arrays = arrays
+            return cache
+        return arrays
+
+    def insert_draft_slot(self, state: DecodeState, slot: int,
+                          prompt: np.ndarray, step: int = 0):
+        """Spec mode: run the DRAFT's prefill over the same prompt and
+        land its k/v in the draft's dense cache at ``slot`` — the draft
+        tracks every position the target decodes."""
+        _first, _logits, kv, _t = self.draft.prefill(prompt, step=step)
+        state.draft_cache = self.draft._insert_jit(
+            state.draft_cache, kv["k"], kv["v"],
+            jnp.asarray(slot, jnp.int32))
+
+    # -------------------------------------------------- speculative step
+    def spec_step(self, state: DecodeState, tokens: np.ndarray,
+                  positions: np.ndarray, step: int,
+                  active: Sequence[int]) -> Dict[int, List[int]]:
+        """One speculative round for the whole slot batch: the draft
+        proposes ``spec_k`` tokens per slot in ONE fused executable, the
+        target scores carry+proposals in ONE windowed verify step, and
+        the standard accept/resample loop keeps the emitted distribution
+        exactly the target's (greedy mode: byte-identical tokens to
+        plain decode). Returns ``{slot: [emitted...]}`` for active slots
+        (1..spec_k tokens each; the LAST emitted token is the next
+        carry). The caller advances tokens/positions from the emitted
+        lists; paged callers must have ensured pages through
+        ``positions + spec_k``. The all-accepted bonus token is
+        deliberately forfeited: emitting it would leave the draft cache
+        one position behind and force a non-uniform catch-up step."""
+        k = self.spec_k
+        if state.mode == "paged":
+            for b in active:
+                last = min(int(positions[b]) + k, self.max_len - 1)
+                if not self.ensure_slot_pages(state, b, last):
+                    raise CachePagesExhausted(
+                        f"page pool exhausted backing slot {b}'s verify "
+                        f"window through position {last}")
+        props, dlog, state.draft_cache = self._propose_jit(
+            self.draft.params, state.draft_cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(step, jnp.int32))
+        props = np.asarray(props)                       # (B, k)
+        win = np.concatenate([np.asarray(tokens, np.int32)[:, None],
+                              props], axis=1)           # (B, k+1)
+        if state.mode == "paged":
+            logits, state.arrays = self._verify_paged_jit(
+                self.params, state.arrays, self._tables(state),
+                jnp.asarray(win), jnp.asarray(positions, jnp.int32),
+                jnp.asarray(step, jnp.int32))
+        else:
+            logits, state.arrays = self._verify_dense_jit(
+                self.params, state.arrays, jnp.asarray(win),
+                jnp.asarray(positions, jnp.int32),
+                jnp.asarray(step, jnp.int32))
+        logits = np.asarray(logits)                     # (B, k+1, V)
+        greedy = (self.sampler.kind == "greedy"
+                  and self.draft.sampler.kind == "greedy")
+        dlog_h = None if greedy else np.asarray(dlog)
+        rng = (None if greedy
+               else np.random.default_rng((self._seed, 0x5BEC, step)))
+        emitted: Dict[int, List[int]] = {}
+        for b in active:
+            out: List[int] = []
+            accepted = 0
+            for j in range(k):
+                d = int(props[b, j])
+                if greedy:
+                    g = int(np.argmax(logits[b, j]))
+                    if d == g:
+                        out.append(d)
+                        accepted += 1
+                        continue
+                    out.append(g)       # the token plain decode emits
+                    break
+                p = _dist_probs(logits[b, j], self.sampler)
+                q = _dist_probs(dlog_h[b, j], self.draft.sampler)
+                if rng.random() < min(1.0, p[d] / max(q[d], 1e-20)):
+                    out.append(d)
+                    accepted += 1
+                    continue
+                resid = np.maximum(p - q, 0.0)
+                z = float(resid.sum())
+                if z <= 0.0:
+                    # draft == target distribution: any residual draw
+                    # is a no-op; emit from the target directly
+                    out.append(int(rng.choice(len(p), p=p)))
+                else:
+                    out.append(int(rng.choice(len(resid), p=resid / z)))
+                break
+            self.spec_stats["proposed"] += k
+            self.spec_stats["accepted"] += accepted
+            emitted[b] = out
+        self.spec_stats["rounds"] += 1
+        return emitted
+
+    def spec_accept_ratio(self) -> Optional[float]:
+        p = self.spec_stats["proposed"]
+        return (self.spec_stats["accepted"] / p) if p else None
 
     def warm(self, slots: int, note=None) -> List[int]:
         """Compile the engine's whole executable set against a THROWAWAY
-        cache: one prefill + one slot-insert per length bucket, plus one
-        decode step at the (``slots``, max_len) signature. The jit
-        caches live on this engine, so the first real traffic afterward
-        is a pure cache hit. One spelling shared by
+        state: one prefill + one slot-insert per length bucket, one
+        decode step at the (``slots``,) signature — and, in spec mode,
+        the draft's prefill/insert set, the fused k-token propose
+        executable, and the windowed verify executable, so a paired
+        draft+target deploy warms BOTH models before admitting traffic.
+        The quant numerics gate runs here too (first state build). The
+        jit caches live on this engine, so the first real traffic
+        afterward is a pure cache hit. One spelling shared by
         ``ModelRegistry._warmup_generative`` and the decode benchmark —
         the bench must warm exactly what a production deploy warms.
         ``note(**attrs)`` (optional) is called before each compile-
         provoking step so the caller can declare compile causes.
         Returns the warmed prefill buckets."""
         warmed: List[int] = []
-        cache = self.new_cache(slots)
+        state = self.new_state(slots)
         for bucket in self.prefill_buckets:
             if note is not None:
                 note(bucket=bucket)
             first, _logits, kv, _t = self.prefill(
                 np.zeros((1, bucket), np.int32), step=0)
             np.asarray(first)                  # execute + block
-            cache = self.insert_slot(cache, kv, 0)
+            state = self.insert_slot(state, kv, 0)
+            if self.spec:
+                self.insert_draft_slot(state, 0,
+                                       np.zeros((1, bucket), np.int32))
             warmed.append(bucket)
         if note is not None:
             note(decode_slots=slots)
         tokens = np.zeros((slots,), np.int32)
         positions = np.zeros((slots,), np.int32)
-        nxt, _logits, cache = self.decode(cache, tokens, positions, 0)
+        for s in range(slots):
+            self.ensure_slot_pages(state, s, 0)
+        nxt, _logits, state = self.decode(state, tokens, positions, 0)
         np.asarray(nxt)                        # decode executable seeded
-        self.account_decode(cache, tokens, positions, 0)
+        self.account_decode(state, tokens, positions, 0)
+        if self.spec:
+            if note is not None:
+                note(spec_k=self.spec_k)
+            for s in range(slots):
+                self.ensure_slot_pages(state, s, self.spec_k)
+            # seed propose + verify without touching the accept stats
+            stats = dict(self.spec_stats)
+            self.spec_step(state, tokens, positions, 0, range(slots))
+            self.spec_stats = stats
         return warmed
 
     def decode_compile_count(self) -> int:
@@ -267,12 +927,45 @@ class DecodeEngine:
         except Exception:       # accounting is telemetry, never the path
             pass
 
+    def account_spec(self, state: DecodeState, tokens, positions,
+                     step: int):
+        """Cost accounting for the speculative pair — the fused k-step
+        propose and the W=k+1 verify each get their own /debug/perf
+        entry (a spec round's work must never be booked against the
+        one-token decode executable that did not run)."""
+        win = jnp.zeros((len(np.asarray(tokens)), self.spec_k + 1),
+                        jnp.int32)
+        tok = jnp.asarray(tokens, jnp.int32)
+        pos = jnp.asarray(positions, jnp.int32)
+        stp = jnp.asarray(step, jnp.int32)
+        self._maybe_account(
+            PROPOSE_FN, self._propose_jit,
+            (self.draft.params, state.draft_cache, tok, pos, stp))
+        if state.mode == "paged":
+            self._maybe_account(
+                VERIFY_FN, self._verify_paged_jit,
+                (self.params, state.arrays, self._tables(state), win,
+                 pos, stp))
+        else:
+            self._maybe_account(
+                VERIFY_FN, self._verify_dense_jit,
+                (self.params, state.arrays, win, pos, stp))
+
     def account_decode(self, cache, tokens, positions, step: int):
         """Decode-step cost accounting at the signature in flight (the
         pipeline calls this after a step that followed a fresh trace)."""
+        if isinstance(cache, DecodeState) and cache.mode == "paged":
+            self._maybe_account(
+                DECODE_FN, self._decode_paged_jit,
+                (self.params, cache.arrays, self._tables(cache),
+                 jnp.asarray(tokens, jnp.int32),
+                 jnp.asarray(positions, jnp.int32),
+                 jnp.asarray(step, jnp.int32)))
+            return
+        arrays = cache.arrays if isinstance(cache, DecodeState) else cache
         self._maybe_account(
             DECODE_FN, self._decode_jit,
-            (self.params, cache, jnp.asarray(tokens, jnp.int32),
+            (self.params, arrays, jnp.asarray(tokens, jnp.int32),
              jnp.asarray(positions, jnp.int32),
              jnp.asarray(step, jnp.int32)))
 
@@ -303,8 +996,31 @@ class DecodeEngine:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds the cache length {self.max_len}")
+        if self.spec:
+            if B != 1:
+                raise ValueError(
+                    "speculative generate decodes one sequence (the "
+                    "slot-batched path is GenerationPipeline)")
+            if return_logits:
+                raise ValueError("return_logits is not available under "
+                                 "speculative decoding (a verify step "
+                                 "has no single per-token logits row "
+                                 "for rejected proposals)")
+            return self._generate_spec(prompts, max_new_tokens, eos_id,
+                                       on_token)
         first, logits, kv, t = self.prefill(prompts, step=0)
-        cache = self.insert_slot(self.new_cache(B), kv, 0)
+        state = self.new_state(B)
+        if self.paged:
+            for b in range(B):
+                state = self.insert_slot(
+                    state, {"k": kv["k"][:, b:b + 1],
+                            "v": kv["v"][:, b:b + 1]}, b)
+            return self._generate_paged(state, first, logits, t, B,
+                                        max_new_tokens, eos_id,
+                                        return_logits, on_token)
+        # dense kill-switch path: the pre-paged device-resident loop,
+        # verbatim, on the raw cache arrays
+        cache = self.insert_slot(state, kv, 0).arrays
         # device-resident loop: tokens/positions stay on device between
         # steps; the host syncs per step ONLY when it must look at the
         # tokens (eos streaming / logits collection) — otherwise the
@@ -342,6 +1058,92 @@ class DecodeEngine:
         if return_logits:
             return toks, logit_steps
         return toks
+
+    def _generate_paged(self, state, first, logits, t, B,
+                        max_new_tokens: int, eos_id, return_logits,
+                        on_token):
+        """The paged twin of the dense generate loop: same step
+        semantics, cache writes scatter through the page table. Page
+        growth is arithmetic (position = t + step), so the host
+        allocates ahead of each step without syncing the tokens."""
+        out = [first]
+        logit_steps = [np.asarray(logits)[:, t - 1]] if return_logits else []
+        if on_token is not None:
+            on_token(int(np.asarray(first)[0]), 0)
+        tokens = first
+        positions = np.full((B,), t, np.int32)
+        done = (np.asarray(first) == eos_id) if eos_id is not None else None
+        for step in range(1, max_new_tokens):
+            if done is not None and bool(np.all(done)):
+                break
+            for b in range(B):
+                if not self.ensure_slot_pages(state, b, t + step):
+                    raise CachePagesExhausted(
+                        f"page pool exhausted at decode position "
+                        f"{t + step} (pool {state.alloc.total} pages)")
+            tokens, logits, state = self.decode(state, tokens, positions,
+                                                step)
+            positions = positions + 1
+            if step == 1:
+                self.account_decode(state, tokens, positions, step)
+            out.append(tokens)
+            if on_token is not None:
+                on_token(int(np.asarray(tokens)[0]), step)
+            if return_logits:
+                logit_steps.append(np.asarray(logits))
+            if done is not None:
+                done |= np.asarray(tokens) == eos_id
+        toks = np.stack([np.asarray(o) for o in out], axis=1).astype(
+            np.int32)
+        if return_logits:
+            return toks, logit_steps
+        return toks
+
+    def _generate_spec(self, prompts, max_new_tokens: int, eos_id,
+                       on_token):
+        """Draft-accelerated single-sequence generation: prefill both
+        models, then speculative rounds (one fused k-token propose +
+        one windowed verify per round) until the budget or eos."""
+        first, _logits, kv, t = self.prefill(prompts, step=0)
+        state = self.new_state(1)
+        state = self.insert_slot(state, kv, 0)
+        self.insert_draft_slot(state, 0, prompts)
+        carry = int(np.asarray(first)[0])
+        out = [carry]
+        if on_token is not None:
+            on_token(carry, 0)
+        if eos_id is not None and carry == eos_id:
+            return np.asarray([out], np.int32)
+        pos, step = t, 0
+        while len(out) < max_new_tokens:
+            if self.paged:
+                last = min(pos + self.spec_k, self.max_len - 1)
+                if not self.ensure_slot_pages(state, 0, last):
+                    raise CachePagesExhausted(
+                        f"page pool exhausted at decode position {last} "
+                        f"(pool {state.alloc.total} pages)")
+            emitted = self.spec_step(
+                state, np.asarray([carry], np.int32),
+                np.asarray([pos], np.int32), step, [0])[0]
+            stop = False
+            for tok in emitted:
+                if len(out) >= max_new_tokens:
+                    stop = True
+                    break
+                out.append(tok)
+                if on_token is not None:
+                    on_token(tok, len(out) - 1)
+                if eos_id is not None and tok == eos_id:
+                    stop = True
+                    break
+            if stop:
+                break
+            pos += len(emitted)
+            carry = emitted[-1]
+            step += 1
+            if pos + 1 >= self.max_len:
+                break               # no room for another cache write
+        return np.asarray([out], np.int32)
 
 
 def naive_generate(model, params, prompts, max_new_tokens: int,

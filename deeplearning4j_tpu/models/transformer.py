@@ -104,6 +104,21 @@ def _use_flash_attention(seq_len: Optional[int] = None) -> bool:
     return False
 
 
+def quantize_kv_rows(rows):
+    """Symmetric per-row int8 quantization of KV rows (…, H, hd) →
+    (int8 rows, f32 scale (…,)): scale = max|row| / 127, zeros keep
+    scale 1 so dequant is exact. Module-level ON PURPOSE — the
+    numerics-gate tests monkeypatch this with a corrupted scale to
+    prove the deploy-time gate trips and falls back to f32 storage
+    (see ``DecodeEngine`` in models/generation.py)."""
+    amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q8 = jnp.clip(jnp.round(rows.astype(jnp.float32)
+                            / scale[..., None, None]),
+                  -127, 127).astype(jnp.int8)
+    return q8, scale.astype(jnp.float32)
+
+
 @dataclasses.dataclass
 class TransformerConfig:
     vocab_size: int = 256
@@ -657,6 +672,13 @@ class TransformerLM:
         x = self._ln(params["ln_f"], x)
         logits = jnp.matmul(x, params["tok_emb"].T,
                             preferred_element_type=jnp.float32)
+        if not ks:
+            # zero-layer trunk (an embedding-only speculative draft):
+            # no attention, an empty (0, B, T, H, hd) cache
+            h, hd = c.n_heads, c.d_model // c.n_heads
+            b, t = tokens.shape
+            empty = jnp.zeros((0, b, t, h, hd), c.dtype)
+            return logits, {"k": empty, "v": empty}
         return logits, {"k": jnp.stack(ks), "v": jnp.stack(vs)}
 
     def decode_step_math(self, params, cache, tokens, positions):
@@ -705,7 +727,179 @@ class TransformerLM:
         x = self._ln(params["ln_f"], x)
         logits = jnp.matmul(x[:, 0], params["tok_emb"].T,
                             preferred_element_type=jnp.float32)
+        if not new_k:           # zero-layer trunk: cache untouched
+            return logits, {"k": cache["k"], "v": cache["v"]}
         return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    # ------------------------------------------ paged / windowed decode
+    # The paged twin of the dense cache above: k/v live in a POOL of
+    # fixed-size pages (L, n_pages, page_tokens, H, hd) shared by every
+    # slot, and a per-slot PAGE TABLE (B, pages_per_slot) int32 maps
+    # logical page j of slot b to a physical pool page. Decode writes
+    # scatter through the table, attention gathers through it — the
+    # executable depends only on the (static) pool/table shapes, never
+    # on which pages are allocated, so steady-state decode stays
+    # zero-retrace exactly like the dense path. ``decode_step_math`` is
+    # kept verbatim as the DL4J_TPU_KV_PAGE_TOKENS=0 kill-switch path.
+    #
+    # Both paged entry points take a W-token WINDOW per slot (W=1 is the
+    # plain decode step; W=k+1 is the speculative-verify step): token j
+    # of slot b sits at position ``positions[b]+j`` and attends cache
+    # entries at positions <= its own — writing the whole window before
+    # attention makes the in-window causal mask fall out of the same
+    # ``pos <= query_pos`` comparison the dense step uses.
+
+    def init_paged_cache(self, n_pages: int, page_tokens: int,
+                         quant: bool = False,
+                         dtype: Optional[Any] = None) -> Dict:
+        """Page pool: ``{"k","v"}`` of (L, n_pages, P, H, hd) — int8
+        plus per-row f32 scales ``{"k_scale","v_scale"}`` (L, n_pages,
+        P) under ``quant`` (one scale per cached token row, stored
+        page-wise: quantizing a row at write time needs no re-scan of
+        the page it lands in)."""
+        c = self.config
+        h, hd = c.n_heads, c.d_model // c.n_heads
+        shape = (c.n_layers, n_pages, page_tokens, h, hd)
+        if quant:
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(shape[:3], jnp.float32),
+                    "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+        dt = dtype if dtype is not None else c.dtype
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def _window_embed(self, params, tokens, positions):
+        """(B, W) tokens at (B, W) positions → (B, W, C) activations +
+        the (B, W, S-broadcastable) query positions."""
+        c = self.config
+        x = (jnp.take(params["tok_emb"], tokens, axis=0)
+             + jnp.take(params["pos_emb"], positions, axis=0))
+        return x.astype(c.dtype)
+
+    def _window_attend(self, q, ck, cv, mask, hd):
+        """Single-query attention generalized to a W-window: q (B, W,
+        H, hd) against gathered caches (B, S, H, hd) under mask (B, W,
+        S) — the same max-subtract/f32-exp softmax the dense step
+        runs."""
+        s = jnp.einsum("bwhd,bshd->bwhs", q, ck) / float(np.sqrt(hd))
+        s = jnp.where(mask[:, :, None, :], s, jnp.asarray(-1e30, s.dtype))
+        m = lax.stop_gradient(jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp((s - m).astype(jnp.float32))
+        p = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(q.dtype)
+        return jnp.einsum("bwhs,bshd->bwhd", p, cv)
+
+    def decode_window_math(self, params, cache, tokens, positions):
+        """Dense-cache W-window decode: ``tokens`` (B, W) int32 with
+        token j at position ``positions[b]+j``. Writes all W k/v rows,
+        then attends each window token under the causal ``pos <=
+        query_pos`` mask. Returns (logits (B, W, V) f32, cache). W=1
+        matches :meth:`decode_step_math`; W>1 is the speculative-verify
+        step on the dense kill-switch path."""
+        c = self.config
+        params = self._cast_params(params)
+        B, W = tokens.shape
+        S = cache["k"].shape[2]
+        hd = c.d_model // c.n_heads
+        pos_w = positions[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        x = self._window_embed(params, tokens, pos_w)
+        mask = jnp.arange(S)[None, None, :] <= pos_w[:, :, None]  # (B,W,S)
+
+        def write(cache_l, kv, p):        # (S,H,hd), (W,H,hd), (W,)
+            return cache_l.at[p].set(kv)
+
+        new_k, new_v = [], []
+        for li, blk in enumerate(self._decode_blocks(params)):
+            q, k, v = self._qkv(blk["attn"], self._ln(blk["ln1"], x))
+            ck = jax.vmap(write)(cache["k"][li], k, pos_w)
+            cv = jax.vmap(write)(cache["v"][li], v, pos_w)
+            new_k.append(ck)
+            new_v.append(cv)
+            o = self._window_attend(q, ck, cv, mask, hd)
+            x = x + (o.reshape(B, W, c.d_model) @ blk["attn"]["wo"])
+            x = x + self._ffn(blk, self._ln(blk["ln2"], x), None)
+        x = self._ln(params["ln_f"], x)
+        logits = jnp.matmul(x, params["tok_emb"].T,
+                            preferred_element_type=jnp.float32)
+        if not new_k:           # zero-layer trunk: cache untouched
+            return logits, {"k": cache["k"], "v": cache["v"]}
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+    def decode_window_paged(self, params, pool, tables, tokens, positions,
+                            page_tokens: int):
+        """Paged W-window decode/verify: scatter the window's k/v rows
+        into the pool through the per-slot page table, gather each
+        slot's logical pages back, and attend under the same causal
+        mask. ``tables`` (B, pages_per_slot) int32; quantized pools
+        (``k_scale`` present) dequantize ON THE FLY inside the
+        attention — int8 rows never round-trip through a dense f32
+        cache. Returns (logits (B, W, V) f32, pool)."""
+        c = self.config
+        params = self._cast_params(params)
+        B, W = tokens.shape
+        P = int(page_tokens)
+        S = tables.shape[1] * P
+        hd = c.d_model // c.n_heads
+        quant = "k_scale" in pool
+        pos_w = positions[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+        x = self._window_embed(params, tokens, pos_w)
+        mask = jnp.arange(S)[None, None, :] <= pos_w[:, :, None]  # (B,W,S)
+        # physical scatter coordinates of each window token's row. A
+        # window near the cache end can carry positions past the last
+        # logical page (the tail rows are never emitted); route those
+        # writes to the TRASH page — by pool-layout convention the LAST
+        # physical page, owned by no table row — instead of letting the
+        # gather clamp corrupt a page the slot legitimately owns.
+        bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        trash = pool["k"].shape[1] - 1
+        in_range = pos_w < S
+        phys = jnp.where(
+            in_range,
+            tables[bidx, jnp.minimum(pos_w // P, tables.shape[1] - 1)],
+            trash)                                               # (B, W)
+        off = pos_w % P                                          # (B, W)
+
+        def store(pool_l, scale_l, rows):
+            """Scatter W rows per slot into one layer's pool (+ scale
+            grid under quant), then gather every slot's pages back as a
+            dequantized (B, S, H, hd) view."""
+            if quant:
+                q8, sc = quantize_kv_rows(rows)
+                pool_l = pool_l.at[phys, off].set(q8)
+                scale_l = scale_l.at[phys, off].set(sc)
+                gath = pool_l[tables].reshape(B, S, *pool_l.shape[-2:])
+                gsc = scale_l[tables].reshape(B, S)
+                view = (gath.astype(jnp.float32)
+                        * gsc[:, :, None, None]).astype(c.dtype)
+                return pool_l, scale_l, view
+            pool_l = pool_l.at[phys, off].set(rows)
+            view = pool_l[tables].reshape(B, S, *pool_l.shape[-2:])
+            return pool_l, None, view
+
+        nk, nv, nks, nvs = [], [], [], []
+        for li, blk in enumerate(self._decode_blocks(params)):
+            q, k, v = self._qkv(blk["attn"], self._ln(blk["ln1"], x))
+            pk, sk, ck = store(pool["k"][li],
+                               pool["k_scale"][li] if quant else None, k)
+            pv, sv, cv = store(pool["v"][li],
+                               pool["v_scale"][li] if quant else None, v)
+            nk.append(pk)
+            nv.append(pv)
+            if quant:
+                nks.append(sk)
+                nvs.append(sv)
+            o = self._window_attend(q, ck, cv, mask, hd)
+            x = x + (o.reshape(B, W, c.d_model) @ blk["attn"]["wo"])
+            x = x + self._ffn(blk, self._ln(blk["ln2"], x), None)
+        x = self._ln(params["ln_f"], x)
+        logits = jnp.matmul(x, params["tok_emb"].T,
+                            preferred_element_type=jnp.float32)
+        if not nk:              # zero-layer trunk: pool untouched
+            return logits, pool
+        out = {"k": jnp.stack(nk), "v": jnp.stack(nv)}
+        if quant:
+            out["k_scale"] = jnp.stack(nks)
+            out["v_scale"] = jnp.stack(nvs)
+        return logits, out
 
 
 def make_sharded_lm(config: TransformerConfig, mesh: Mesh, optimizer=None,

@@ -38,6 +38,17 @@ the most over-share tenant's newest request, a higher-priority tenant
 may PREEMPT a lower-tier slot at a step boundary (the victim resolves
 with the typed ``PreemptedError``), and each request's tenant is charged
 its emitted tokens plus prefill + per-slot decode-step FLOPs shares.
+
+Paged admission (PR 13): with the paged engine (default), FREE PAGES —
+not free slots — are the admission unit. ``cache_pages=`` bounds the
+pool below the dense worst case; ``_admit`` parks a joiner the pool
+cannot back yet and retries it at every step boundary, and
+``_reclaim_pages`` sheds the youngest active generation with the typed
+``CachePagesExhausted`` when mid-decode growth exhausts the pool
+(pages return, admission resumes). Speculative engines emit 1..spec_k
+tokens per step boundary; ``_sweep_finished`` consumes per-slot token
+LISTS so eos/budget/deadline/stream-cancel semantics are per token,
+exactly as the one-token path behaved.
 """
 from __future__ import annotations
 
@@ -45,11 +56,12 @@ import queue
 import threading
 import time
 import weakref
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.models.generation import (DECODE_FN, PREFILL_FN,
+                                                  PROPOSE_FN, VERIFY_FN,
                                                   DecodeEngine)
 from deeplearning4j_tpu.observability import cost_model as _cost
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
@@ -62,6 +74,7 @@ from deeplearning4j_tpu.parallel.inference import _Request
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience import qos as _qos
 from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
+                                                  CachePagesExhausted,
                                                   CircuitBreaker,
                                                   CircuitOpenError, Deadline,
                                                   DeadlineExceeded,
@@ -109,7 +122,8 @@ class _GenMetrics:
             label_names=("reason",))
         self.shed = {r: shed.labels(reason=r)
                      for r in ("queue_full", "deadline", "circuit_open",
-                               "client_gone", "preempted")}
+                               "client_gone", "preempted",
+                               "pages_exhausted")}
         self.occupancy = reg.histogram(
             "dl4j_decode_slot_occupancy_ratio",
             "occupied slots / total slots per decode step (1.0 = the "
@@ -129,8 +143,9 @@ class _GenMetrics:
             "+ prefill + all decode steps)")
         self.cache_bytes = reg.gauge(
             "dl4j_decode_cache_bytes",
-            "preallocated KV-cache footprint of live pipelines "
-            "(slots x max_len x layers x heads)")
+            "ACTUAL resident KV-cache bytes of live pipelines: paged = "
+            "pages in use x page bytes (post-quantization), dense = the "
+            "full preallocation")
         self.slots_in_use = reg.gauge(
             "dl4j_decode_slots_in_use",
             "slots occupied by in-flight generations (sampled per step "
@@ -138,6 +153,19 @@ class _GenMetrics:
         self.queue_depth = reg.gauge(
             "dl4j_decode_queue_depth",
             "generation requests waiting for a free slot")
+        self.pages_in_use = reg.gauge(
+            "dl4j_decode_pages_in_use",
+            "KV-cache pages allocated to live generations across paged "
+            "pipelines (the admission unit)")
+        self.pages_total = reg.gauge(
+            "dl4j_decode_pages_capacity",
+            "KV-cache page pool capacity across live paged pipelines "
+            "(gauge: _total is counter-reserved by the metric lint)")
+        self.spec_accept = reg.gauge(
+            "dl4j_spec_accept_ratio",
+            "cumulative speculative-decode acceptance: accepted draft "
+            "tokens / proposed (per live spec engines; 1.0 = every "
+            "proposal verified)")
 
     @classmethod
     def get(cls) -> "_GenMetrics":
@@ -191,13 +219,23 @@ class GenerationPipeline:
                  max_queue_depth: Optional[int] = None,
                  shed_policy: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 cache_pages: Optional[int] = None):
         self.engine = engine
         self.slots = int(slots)
         if self.slots < 1:
             # a zero-slot pipeline would warm, go live, and then park
             # every request forever — refuse at construction
             raise ValueError(f"slots must be >= 1, got {slots}")
+        # paged admission pool: None = the dense worst case (every slot
+        # can hold max_len tokens); pass FEWER pages to run more slots
+        # against a fixed HBM budget and admit by ACTUAL cached tokens
+        self._cache_pages = cache_pages
+        if cache_pages is not None and engine.paged:
+            if int(cache_pages) < engine.pages_per_slot:
+                raise ValueError(
+                    f"cache_pages {cache_pages} cannot back even one "
+                    f"full-length slot ({engine.pages_per_slot} pages)")
         self.default_max_new_tokens = int(max_new_tokens)
         self.default_eos_id = eos_id
         self._resilience = _faults.resilience_enabled()
@@ -233,7 +271,10 @@ class GenerationPipeline:
         self._slot_req: List[Optional[_GenRequest]] = [None] * self.slots
         self._tokens = np.zeros((self.slots,), np.int32)
         self._positions = np.zeros((self.slots,), np.int32)
-        self._cache = engine.new_cache(self.slots)
+        self._cache = engine.new_state(self.slots, pages=cache_pages)
+        # a popped request the pool couldn't back yet — retried at every
+        # step boundary (pages free there) before the queue is touched
+        self._waiting: Optional[_GenRequest] = None
         self._step = 0
         self._thread = threading.Thread(target=self._decode_loop,
                                         daemon=True, name="dl4j-gen-decode")
@@ -243,15 +284,31 @@ class GenerationPipeline:
 
     @classmethod
     def _publish_cache_bytes(cls):
-        """The gauge is documented as the footprint of LIVE pipelines —
-        sum across them (a second deploy must not mask the first, and a
-        retired pipeline's bytes must leave the gauge)."""
-        total = 0
+        """The gauge is documented as the ACTUAL resident footprint of
+        LIVE pipelines — sum across them (a second deploy must not mask
+        the first, and a retired pipeline's bytes must leave the
+        gauge). Paged pipelines contribute pages-in-use x page-bytes
+        (post-quantization), dense ones their full preallocation."""
+        obs = _GenMetrics.get()
+        total = in_use = pages = 0
+        accepted = proposed = 0
         for gp in list(cls._live):
             if gp._stop.is_set():
                 continue
             total += gp._safe_cache_bytes() or 0
-        _GenMetrics.get().cache_bytes.set(total)
+            st = gp._cache
+            if st is not None and st.alloc is not None:
+                in_use += st.alloc.in_use
+                pages += st.alloc.total
+            if gp.engine.spec:
+                accepted += gp.engine.spec_stats["accepted"]
+                proposed += gp.engine.spec_stats["proposed"]
+        obs.cache_bytes.set(total)
+        obs.pages_in_use.set(in_use)
+        obs.pages_total.set(pages)
+        # 0 when no live spec engine has proposed anything — a retired
+        # spec deploy's final ratio must not outlive it on dashboards
+        obs.spec_accept.set(accepted / proposed if proposed else 0.0)
 
     def __enter__(self):
         return self
@@ -319,6 +376,14 @@ class GenerationPipeline:
             raise ValueError(
                 f"prompt ({prompt.size} tokens) leaves no room to "
                 f"decode in a {self.engine.max_len}-token cache")
+        if (self.engine.paged and self.engine.min_pages_for_prompt(
+                prompt.size) > self._cache.alloc.total):
+            # capacity misconfiguration, not load: this prompt could
+            # never admit even into an EMPTY pool
+            raise ValueError(
+                f"prompt ({prompt.size} tokens) needs "
+                f"{self.engine.min_pages_for_prompt(prompt.size)} pages "
+                f"but the pool holds {self._cache.alloc.total}")
         obs = _GenMetrics.get()
         t0 = time.perf_counter()
         req = _GenRequest(prompt, n_new,
@@ -504,6 +569,15 @@ class GenerationPipeline:
                 continue
             return req
 
+    def _free_slot(self, slot: int):
+        """Release ``slot``: request pointer, its cache pages (paged),
+        and the position/token books — every slot-freeing path must go
+        through here or pages leak."""
+        self._slot_req[slot] = None
+        self.engine.free_slot(self._cache, slot)
+        self._positions[slot] = 0
+        self._tokens[slot] = 0
+
     def _start_request(self, req: _GenRequest, slot: int) -> bool:
         """Prefill ``req`` into ``slot``'s cache pages. Returns True when
         the slot is now occupied (False: resolved without occupying)."""
@@ -532,6 +606,13 @@ class GenerationPipeline:
         try:
             with _span("prefill", slot=slot, phase="insert"):
                 self._cache = self.engine.insert_slot(self._cache, kv, slot)
+                if self.engine.spec:
+                    # the draft tracks the same prompt in its own dense
+                    # cache — a failure here cannot touch the target
+                    # pool (handled below)
+                    self.engine.insert_draft_slot(self._cache, slot,
+                                                  req.x[None],
+                                                  step=self._step)
                 first_tok = int(np.asarray(first)[0])
             dt = time.perf_counter() - t0
             if req.ctx is not None:
@@ -544,10 +625,17 @@ class GenerationPipeline:
                     PREFILL_FN)
             if self._breaker is not None:
                 self._breaker.record_success()
+        except CachePagesExhausted as e:
+            # raised BEFORE any device write (the paged insert checks
+            # the free list first): the live cache is intact, only the
+            # joiner sheds typed — _admit normally parks it first, so
+            # this is the belt-and-braces path
+            self._shed_request(req, "pages_exhausted", e)
+            return False
         except Exception as e:
-            # insert_slot DONATED the live cache before dying — its
-            # pages are gone, so every active generation is dead too:
-            # fail them all with the real insert error (not the
+            # insert DONATED live cache arrays before dying — its pages
+            # are gone, so every active generation is dead too: fail
+            # them all with the real insert error (not the
             # deleted-buffer error one step later) and rebuild
             if self._breaker is not None:
                 self._breaker.record_failure()
@@ -555,8 +643,9 @@ class GenerationPipeline:
             for s, other in enumerate(self._slot_req):
                 if other is not None:
                     self._fail_request(other, e)
-                    self._slot_req[s] = None
-            self._cache = self.engine.new_cache(self.slots)
+                self._free_slot(s)
+            self._cache = self.engine.new_state(self.slots,
+                                                pages=self._cache_pages)
             return False
         req.out.append(first_tok)
         # the generation budget may be clipped by the cache length —
@@ -572,26 +661,34 @@ class GenerationPipeline:
             else:
                 self._shed_request(req, "client_gone", StreamCancelled(
                     "streaming consumer cancelled during prefill"))
+            self.engine.free_slot(self._cache, slot)
             return False
         if done:
             self._resolve(req)
+            self.engine.free_slot(self._cache, slot)
             return False
         self._slot_req[slot] = req
         self._tokens[slot] = first_tok
         self._positions[slot] = t
         return True
 
-    def _maybe_preempt(self) -> bool:
-        """Priority preemption at a step boundary (QoS posture, slots
-        full): when the highest queued tier strictly exceeds some active
-        slot's tier, that slot's request is shed typed
+    def _maybe_preempt(self, pri: Optional[float] = None) -> bool:
+        """Priority preemption at a step boundary (QoS posture): when
+        the contending tier — the highest QUEUED tier by default, or an
+        explicit ``pri`` for a page-starved parked joiner — strictly
+        exceeds some active slot's tier, that slot's request is shed
+        typed
         (:class:`~deeplearning4j_tpu.resilience.qos.PreemptedError`) and
-        the slot freed. The victim: among lower-tier active slots, the
-        most over-share tenant's longest-running request (slots frees
-        and joins already happen exactly here — the preempted caller
-        resolves typed, never hangs). Default tiers (0 everywhere)
-        never preempt."""
-        pri = self._queue.peek_priority()
+        the slot freed (its cache pages with it: under the paged engine
+        the bottleneck is usually PAGES, not slots, and preemption must
+        fire there too or the PR-12 priority guarantee silently dies in
+        the default mode). The victim: among lower-tier active slots,
+        the most over-share tenant's longest-running request (slot
+        frees and joins already happen exactly here — the preempted
+        caller resolves typed, never hangs). Default tiers (0
+        everywhere) never preempt."""
+        if pri is None:
+            pri = self._queue.peek_priority()
         if pri is None:
             return False
         reg = _qos.global_tenants()
@@ -616,36 +713,114 @@ class GenerationPipeline:
         self._shed_request(victim, "preempted", _qos.PreemptedError(
             f"generation slot {victim_slot} preempted by a higher-"
             f"priority tenant at a decode step boundary"))
-        self._slot_req[victim_slot] = None
+        self._free_slot(victim_slot)
         return True
 
     def _admit(self):
-        """Join queued requests into free slots at this step boundary
-        (blocking briefly only when the whole pipeline is idle)."""
+        """Join queued requests into free slots at this step boundary.
+        Paged mode admits on FREE PAGES, not free slots: a popped
+        request whose prompt the pool cannot back yet is parked in
+        ``_waiting`` and retried at every boundary (pages free exactly
+        there) before the queue is touched — admission resumes the
+        moment reclamation or completions return enough pages.
+        (Blocking briefly only when the whole pipeline is idle.)"""
         while not self._stop.is_set():
             free = [i for i, r in enumerate(self._slot_req) if r is None]
             if not free:
                 if self._qos and self._maybe_preempt():
                     continue       # a slot was freed — re-scan and join
                 return
-            idle = len(free) == self.slots
-            req = self._take_request(timeout=0.05 if idle else 0.0)
+            req, self._waiting = self._waiting, None
+            if req is not None:
+                if req._claimed:
+                    continue        # parked caller already walked away
+                if (self._resilience and req.deadline is not None
+                        and req.deadline.expired()):
+                    self._shed_request(req, "deadline", DeadlineExceeded(
+                        "request expired waiting for cache pages"))
+                    continue
+            else:
+                idle = len(free) == self.slots
+                req = self._take_request(timeout=0.05 if idle else 0.0)
             if req is None:
+                return
+            if (self.engine.paged
+                    and self.engine.min_pages_for_prompt(req.x.size)
+                    > self._cache.alloc.free_count):
+                # can't back the prompt yet; active slots still hold
+                # pages (generate() pre-checked the empty-pool fit, so
+                # an idle pipeline always admits). A higher-tier
+                # tenant's joiner may PREEMPT a lower-tier slot for its
+                # pages — the paged twin of the slots-full preemption
+                # above (page pressure is the common overload state
+                # under a bounded pool)
+                if self._qos and self._maybe_preempt(
+                        pri=_qos.global_tenants().priority(req.tenant)):
+                    self._waiting = req
+                    continue       # pages came back — retry this joiner
+                self._waiting = req
                 return
             _GenMetrics.get().queue_depth.set(self._queue.qsize())
             self._start_request(req, free[0])
 
-    def _sweep_finished(self, stepped: List[int]):
-        """Post-step bookkeeping for every active slot: append the new
-        token, then resolve/free finished or expired requests."""
+    def _reclaim_pages(self, active: List[int]) -> List[int]:
+        """Step-boundary reclamation: grow every active slot's pages for
+        this step's writes (spec windows reach ``spec_k`` further); on
+        pool exhaustion the YOUNGEST active request is shed typed
+        (:class:`CachePagesExhausted`) and its pages return to the
+        pool, until the survivors fit. Returns the surviving active
+        list — deterministic, oldest generations win."""
+        if not self.engine.paged:
+            return active
+        reach = self.engine.spec_k if self.engine.spec else 0
+        for slot in sorted(active,
+                           key=lambda s: self._slot_req[s].t_slot_us):
+            req = self._slot_req[slot]
+            if req is None:
+                continue            # already shed as a victim below
+            last = min(int(self._positions[slot]) + reach,
+                       self.engine.max_len - 1)
+            while not self.engine.ensure_slot_pages(self._cache, slot,
+                                                    last):
+                # victim = the youngest ACTIVE request, whether or not
+                # it is the one needing the page — oldest generations
+                # win unconditionally (shedding an elder because a
+                # newcomer grew would invert the policy)
+                cands = [s for s in active
+                         if self._slot_req[s] is not None]
+                victim = max(cands,
+                             key=lambda s: self._slot_req[s].t_slot_us)
+                self._shed_request(
+                    self._slot_req[victim], "pages_exhausted",
+                    CachePagesExhausted(
+                        "KV page pool exhausted at a decode step "
+                        "boundary; request shed to reclaim pages"))
+                self._free_slot(victim)
+                if victim == slot:
+                    break
+        return [s for s in active if self._slot_req[s] is not None]
+
+    def _sweep_finished(self, emitted: Dict[int, List[int]]):
+        """Post-step bookkeeping for every stepped slot: append its
+        emitted tokens IN ORDER (one for a plain decode step, up to
+        ``spec_k`` for a speculative round), then resolve/free finished,
+        cancelled, or expired requests. A request finishing mid-window
+        simply ignores the window's tail — same semantics as plain
+        decode stopping at its boundary."""
         obs = _GenMetrics.get()
-        # each occupied slot owns 1/slots of the decode step's accounted
-        # FLOPs (the whole slot batch runs whether occupied or not —
-        # charging per OCCUPIED slot would make a lonely tenant look
-        # cheap while it monopolizes the executable)
-        step_share = (_cost.global_cost_model().flops_for(DECODE_FN)
-                      / max(1, self.slots)) if self._qos else 0.0
-        for slot in stepped:
+        # each occupied slot owns 1/slots of the step boundary's
+        # accounted FLOPs (the whole slot batch runs whether occupied or
+        # not — charging per OCCUPIED slot would make a lonely tenant
+        # look cheap while it monopolizes the executable). A spec round
+        # ran propose + verify, never the one-token decode executable —
+        # charge what actually executed.
+        step_share = 0.0
+        if self._qos:
+            cm = _cost.global_cost_model()
+            flops = ((cm.flops_for(VERIFY_FN) + cm.flops_for(PROPOSE_FN))
+                     if self.engine.spec else cm.flops_for(DECODE_FN))
+            step_share = flops / max(1, self.slots)
+        for slot, toks_l in emitted.items():
             req = self._slot_req[slot]
             if req is None:
                 continue
@@ -654,32 +829,37 @@ class GenerationPipeline:
                 # deadline walk-away) — stop spending device steps on a
                 # request nobody will read (racy read is safe: worst
                 # case is one extra step before the slot frees)
-                self._slot_req[slot] = None
+                self._free_slot(slot)
                 continue
-            tok = int(self._tokens[slot])
-            req.out.append(tok)
-            self._positions[slot] += 1
-            obs.tokens.inc()
             if req.tenant is not None:
                 req.cost_flops += step_share
+            done = cancelled = False
+            for tok in toks_l:
+                req.out.append(int(tok))
+                obs.tokens.inc()
+                done = (len(req.out) >= req.max_new_tokens
+                        or (req.eos_id is not None
+                            and int(tok) == req.eos_id))
+                if not self._emit_token(req, int(tok)) and not done:
+                    cancelled = True
+                    break
+                if done:
+                    break
             expired = (self._resilience and req.deadline is not None
                        and req.deadline.expired())
-            done = (len(req.out) >= req.max_new_tokens
-                    or (req.eos_id is not None and tok == req.eos_id))
-            if not self._emit_token(req, tok) and not done:
+            if cancelled:
                 # consumer gone mid-stream: free the slot NOW — other
                 # slots keep decoding, nothing leaks
                 self._shed_request(req, "client_gone", StreamCancelled(
                     "streaming consumer cancelled mid-stream"))
-                self._slot_req[slot] = None
-                continue
-            if expired and not done:
+                self._free_slot(slot)
+            elif expired and not done:
                 self._shed_request(req, "deadline", DeadlineExceeded(
                     "request expired at a decode step boundary"))
-                self._slot_req[slot] = None
+                self._free_slot(slot)
             elif done:
                 self._resolve(req)
-                self._slot_req[slot] = None
+                self._free_slot(slot)
 
     def _decode_loop(self):
         while not self._stop.is_set():
@@ -698,22 +878,53 @@ class GenerationPipeline:
                     self._retry.call(
                         lambda: _faults.check("generation.step"),
                         op="generation.step")
+                active = self._reclaim_pages(active)
+                if not active:
+                    self._step += 1
+                    self._publish_cache_bytes()
+                    continue
                 t0 = time.perf_counter()
-                with _span("decode_step", active=len(active),
-                           slots=self.slots):
-                    tokens, _logits, self._cache = self.engine.decode(
-                        self._cache, self._tokens, self._positions,
-                        self._step)
-                    toks = np.asarray(tokens)    # device→host sync point
+                if self.engine.spec:
+                    with _span("decode_step", active=len(active),
+                               slots=self.slots, spec=True):
+                        emitted = self.engine.spec_step(
+                            self._cache, self._tokens, self._positions,
+                            self._step, active)
+                    for slot, toks_l in emitted.items():
+                        # the last emitted token is the next carry; the
+                        # cache advanced one row per emitted token
+                        self._tokens[slot] = toks_l[-1]
+                        self._positions[slot] += len(toks_l)
+                else:
+                    with _span("decode_step", active=len(active),
+                               slots=self.slots):
+                        tokens, _logits, self._cache = self.engine.decode(
+                            self._cache, self._tokens, self._positions,
+                            self._step)
+                        toks = np.asarray(tokens)  # device→host sync
+                    self._tokens[active] = toks[active]
+                    self._positions[active] += 1
+                    emitted = {s: [int(toks[s])] for s in active}
                 dt = time.perf_counter() - t0
                 obs.step_latency.observe(dt)
                 obs.steps.inc()
                 obs.occupancy.observe(len(active) / max(1, self.slots))
-                _cost.global_cost_model().observe_time(DECODE_FN, dt)
-                if self._fresh_decode_compile():
-                    self.engine.account_decode(
-                        self._cache, self._tokens, self._positions,
-                        self._step)
+                if self.engine.spec:
+                    # the round's wall time covers the fused propose +
+                    # the windowed verify — book it against the verify
+                    # entry (the dominant executable), NEVER the
+                    # one-token decode step that did not run
+                    _cost.global_cost_model().observe_time(VERIFY_FN, dt)
+                    if self._fresh_spec_compile():
+                        self.engine.account_spec(
+                            self._cache, self._tokens, self._positions,
+                            self._step)
+                else:
+                    _cost.global_cost_model().observe_time(DECODE_FN, dt)
+                    if self._fresh_decode_compile():
+                        self.engine.account_decode(
+                            self._cache, self._tokens, self._positions,
+                            self._step)
                 if self._breaker is not None:
                     self._breaker.record_success()
                 _flight().progress("generation_step")
@@ -723,23 +934,34 @@ class GenerationPipeline:
                     self._breaker.record_failure()
                 # the step died mid-donation: the cache buffers are no
                 # longer trustworthy — fail every in-flight request and
-                # rebuild the pages (queued requests are untouched)
+                # rebuild the pages (queued requests are untouched; the
+                # fresh state resets the page allocator and, in spec
+                # mode, the draft cache with it)
                 for slot, req in enumerate(self._slot_req):
                     if req is not None:
                         self._fail_request(req, e)
-                        self._slot_req[slot] = None
-                self._cache = self.engine.new_cache(self.slots)
+                    self._slot_req[slot] = None
+                self._tokens[:] = 0
+                self._positions[:] = 0
+                self._cache = self.engine.new_state(
+                    self.slots, pages=self._cache_pages)
                 self._step += 1
+                self._publish_cache_bytes()
                 continue
             self._step += 1
-            self._tokens[active] = toks[active]
-            self._sweep_finished(active)
-        # shutdown: resolve whatever still occupies a slot
+            self._sweep_finished(emitted)
+            self._publish_cache_bytes()
+        # shutdown: resolve whatever still occupies a slot (and the
+        # parked joiner the pool never backed)
         for slot, req in enumerate(self._slot_req):
             if req is not None:
                 self._fail_request(req, ShutdownError(
                     "GenerationPipeline shut down"))
                 self._slot_req[slot] = None
+        if self._waiting is not None:
+            self._fail_request(self._waiting, ShutdownError(
+                "GenerationPipeline shut down"))
+            self._waiting = None
 
     def _fresh_decode_compile(self) -> bool:
         """True when compile_watch counted a decode trace the cost model
@@ -747,6 +969,16 @@ class GenerationPipeline:
         try:
             return _cost.global_cost_model().needs_account(DECODE_FN,
                                                            DECODE_FN)
+        except Exception:
+            return False
+
+    def _fresh_spec_compile(self) -> bool:
+        """The spec twin: a fresh propose OR verify trace pending cost
+        analysis."""
+        try:
+            cm = _cost.global_cost_model()
+            return (cm.needs_account(VERIFY_FN, VERIFY_FN)
+                    or cm.needs_account(PROPOSE_FN, PROPOSE_FN))
         except Exception:
             return False
 
@@ -794,6 +1026,33 @@ class GenerationPipeline:
             for t, n in self._queue.tenant_sizes().items():
                 tenants.setdefault(t, {"active_slots": 0,
                                        "queued": 0})["queued"] = n
+        eng = self.engine
+        pages = None
+        st = self._cache
+        if eng.paged and st is not None and st.alloc is not None:
+            pages = {
+                "page_tokens": eng.page_tokens,
+                "pages_per_slot": eng.pages_per_slot,
+                "in_use": st.alloc.in_use,
+                "total": st.alloc.total,
+                "page_bytes": eng.page_bytes(),
+                "quant": bool(eng.kv_quant),
+                "quant_gate": eng.quant_gate,
+                "waiting_for_pages": self._waiting is not None,
+                "slot_pages": [len(p) for p in st.slot_pages],
+            }
+        spec = None
+        if eng.draft is not None:
+            ratio = eng.spec_accept_ratio()
+            spec = {
+                "enabled": eng.spec,
+                "spec_k": eng.spec_k,
+                "rounds": eng.spec_stats["rounds"],
+                "proposed": eng.spec_stats["proposed"],
+                "accepted": eng.spec_stats["accepted"],
+                "accept_ratio": (round(ratio, 4)
+                                 if ratio is not None else None),
+            }
         return {
             "qos": self._qos,
             "tenants": tenants,
@@ -807,13 +1066,25 @@ class GenerationPipeline:
                         "top_k": self.engine.sampler.top_k,
                         "temperature": self.engine.sampler.temperature},
             "cache_bytes": self._safe_cache_bytes(),
+            "pool_bytes": self._safe_pool_bytes(),
+            "pages": pages,
+            "spec": spec,
             "slot_table": slots,
         }
 
     def _safe_cache_bytes(self):
         """The decode thread may be mid-step (old cache donated away)
         when a /debug or bundle snapshot races this read — answer None
-        for that instant rather than raising into the dump."""
+        for that instant rather than raising into the dump. Reports
+        ACTUAL resident bytes (paged: pages in use x page bytes)."""
+        try:
+            return self.engine.resident_cache_bytes(self._cache)
+        except Exception:
+            return None
+
+    def _safe_pool_bytes(self):
+        """Worst-case device footprint (the whole pool + draft cache) —
+        the snapshot reports it next to the resident number."""
         try:
             return DecodeEngine.cache_bytes(self._cache)
         except Exception:

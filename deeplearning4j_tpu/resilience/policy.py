@@ -65,6 +65,14 @@ class ShedError(ResilienceError):
     pass
 
 
+class CachePagesExhausted(ShedError):
+    """The paged KV-cache pool ran out of free pages — a LOAD outcome
+    (the pool admits by actual cached tokens, so a burst of long
+    generations can outgrow it), shed typed at a decode step boundary
+    or at admission. Retryable by the caller once resident pages drain;
+    never an error-rate event (``ShedError`` subclass)."""
+
+
 class CircuitOpenError(ResilienceError):
     pass
 

@@ -239,9 +239,17 @@ class ModelRegistry:
         length-bucket executable, the slot-insert executables, and the
         decode-step executable before the version admits traffic — the
         first real ``generate`` request triggers zero new traces, the
-        same contract scoring deploys make. ``gp_kwargs`` pass through
-        to the pipeline constructor; a per-version circuit breaker is
-        installed unless the caller provides one."""
+        same contract scoring deploys make. A speculative engine (built
+        with a ``draft=``) warms the PAIR: the draft's prefill/insert
+        set, the fused k-token propose executable, and the windowed
+        verify executable all compile here, and retire's drain releases
+        draft and target together (the engine owns both). The int8 KV
+        numerics gate also runs here (first cache build), so a
+        quant fallback is decided before traffic, never under it.
+        ``gp_kwargs`` pass through to the pipeline constructor
+        (``cache_pages=`` sizes the paged admission pool); a
+        per-version circuit breaker is installed unless the caller
+        provides one."""
         from deeplearning4j_tpu.parallel.generation import GenerationPipeline
 
         def build():

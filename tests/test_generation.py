@@ -416,7 +416,14 @@ def test_generation_snapshot_surfaces():
         snap = gp.snapshot()
         _json.dumps(snap)                    # must be JSON-serializable
         assert snap["slots"] == 2
-        assert snap["cache_bytes"] > 0
+        # cache_bytes now reports ACTUAL resident bytes (pages in use x
+        # page bytes) — zero once every generation drained; the
+        # worst-case pool footprint sits next to it
+        assert snap["cache_bytes"] == 0
+        assert snap["pool_bytes"] > 0
+        assert snap["pages"]["total"] > 0
+        assert snap["pages"]["in_use"] == 0
+        assert snap["pages"]["page_tokens"] == eng.page_tokens
         assert len(snap["slot_table"]) == 2
         assert snap["sampler"]["kind"] == "greedy"
         assert GenerationPipeline.live_snapshots()

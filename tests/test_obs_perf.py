@@ -577,6 +577,48 @@ def test_bench_diff_decode_raw_rate_is_not_gated(tmp_path):
     assert mod.main([str(tmp_path)]) == 0
 
 
+def test_bench_diff_learns_paged_quant_spec_fields(tmp_path):
+    """The PR-13 decode arms: vs_dense_cache / vs_f32 / vs_no_spec are
+    graded as each metric's A/B ratio (sustained-only), while the
+    speculative accept ratio is loaded and REPORTED but never gated —
+    an accept-rate collapse alone cannot fail the trajectory."""
+    import json as _json
+    mod = _load_tool("bench_diff")
+
+    def write(rnd, paged=2.0, quant=0.8, spec=1.5, accept=0.8):
+        (tmp_path / f"DECODE_r{rnd:02d}.json").write_text(_json.dumps({
+            "paged": {"metric": "decode_paged_cache", "platform": "cpu",
+                      "vs_dense_cache": paged, "value": 600.0},
+            "quant": {"metric": "decode_kv_quant", "platform": "cpu",
+                      "vs_f32": quant, "value": 450.0},
+            "spec": {"metric": "decode_speculative", "platform": "cpu",
+                     "vs_no_spec": spec, "spec_accept_ratio": accept,
+                     "value": 900.0}}))
+
+    for rnd in (1, 2, 3):
+        write(rnd)
+    samples = mod.load_decode(str(tmp_path))
+    assert {s.metric for s in samples} == {
+        "decode_paged_cache", "decode_kv_quant", "decode_speculative"}
+    spec = [s for s in samples if s.metric == "decode_speculative"][0]
+    assert spec.ratio == 1.5 and spec.accept_ratio == 0.8
+    assert mod.check_decode(samples) == []
+    # accept-rate collapse alone: reported, never a regression
+    write(4, accept=0.05), write(5, accept=0.05)
+    assert mod.check_decode(mod.load_decode(str(tmp_path))) == []
+    # a sustained vs_no_spec collapse IS one, attributed to its metric
+    write(4, spec=0.5, accept=0.8), write(5, spec=0.5, accept=0.8)
+    regs = mod.check_decode(mod.load_decode(str(tmp_path)))
+    assert [(r.metric, r.series) for r in regs] == [
+        ("decode_speculative", "ab_ratio")]
+    # same discipline for the paged and quant ratios
+    write(4, paged=0.9, spec=1.5), write(5, paged=0.9, spec=1.5)
+    regs = mod.check_decode(mod.load_decode(str(tmp_path)))
+    assert [(r.metric, r.series) for r in regs] == [
+        ("decode_paged_cache", "ab_ratio")]
+    assert mod.main([str(tmp_path)]) == 1
+
+
 def test_bench_diff_learns_serve_schema(tmp_path):
     """SERVE_r*.json HTTP-load archives (benchmarks/http_load.py): the
     interleaved vs_direct ratio + goodput grade sustained-only, raw

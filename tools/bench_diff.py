@@ -30,9 +30,10 @@ pure drift). Three rules make the comparison meaningful:
 Also graded, each under its own schema: ``MULTICHIP_r*.json`` driver
 dryruns (a boolean trajectory — the newest non-skipped round must pass),
 ``DECODE_r*.json`` decode-bench archives (the interleaved KV-vs-naive
-/ continuous-vs-static A/B ratios plus the slot-occupancy trajectory,
-sustained-only like the bench ratios; raw tokens/s is reported, never
-gated), and ``SERVE_r*.json`` HTTP-load archives
+/ continuous-vs-static / paged-vs-dense / int8-vs-f32 / spec-vs-plain
+A/B ratios plus the slot-occupancy trajectory, sustained-only like the
+bench ratios; raw tokens/s AND the speculative accept ratio are
+reported, never gated), and ``SERVE_r*.json`` HTTP-load archives
 (``benchmarks/http_load.py``: the interleaved HTTP-vs-direct
 ``vs_direct`` ratio plus the goodput trajectory, sustained-only; raw
 p50/p99 milliseconds are reported, never gated — they are host-load
@@ -182,12 +183,18 @@ class DecodeSample(NamedTuple):
     round: int
     path: str
     metric: str                  # "decode_kv_cache" | "decode_continuous_batching"
+                                 # | "decode_paged_cache" | "decode_kv_quant"
+                                 # | "decode_speculative"
     platform: Optional[str]
-    ratio: Optional[float]       # vs_naive / vs_static — the interleaved
+    ratio: Optional[float]       # vs_naive / vs_static / vs_dense_cache /
+                                 # vs_f32 / vs_no_spec — the interleaved
                                  # A/B ratio, the only host-timed series
                                  # worth gating on (drift divides out)
     occupancy: Optional[float]   # mean of the slot-occupancy trajectory
     tokens_per_s: Optional[float]  # reported, never gated (raw host rate)
+    accept_ratio: Optional[float]  # speculative accept rate — reported,
+                                   # NEVER gated (a property of the
+                                   # draft/target pair, not a perf series)
 
 
 def load_decode(root: str) -> List[DecodeSample]:
@@ -215,21 +222,29 @@ def load_decode(root: str) -> List[DecodeSample]:
             metric = str(rec.get("metric", ""))
             if not metric.startswith("decode_"):
                 continue
-            ratio = rec.get("vs_naive", rec.get("vs_static"))
+            ratio = None
+            for key in ("vs_naive", "vs_static", "vs_dense_cache",
+                        "vs_f32", "vs_no_spec"):
+                if isinstance(rec.get(key), (int, float)):
+                    ratio = float(rec[key])
+                    break
             occ = rec.get("slot_occupancy")
             occupancy = (float(statistics.mean(occ))
                          if isinstance(occ, list) and occ
                          and all(isinstance(o, (int, float)) for o in occ)
                          else None)
             value = rec.get("value")
+            accept = rec.get("spec_accept_ratio")
             out.append(DecodeSample(
                 round=int(m.group(1)), path=path, metric=metric,
                 platform=rec.get("platform"),
-                ratio=(float(ratio)
-                       if isinstance(ratio, (int, float)) else None),
+                ratio=ratio,
                 occupancy=occupancy,
                 tokens_per_s=(float(value)
                               if isinstance(value, (int, float))
+                              else None),
+                accept_ratio=(float(accept)
+                              if isinstance(accept, (int, float))
                               else None)))
     return out
 
@@ -239,8 +254,11 @@ def check_decode(samples: List[DecodeSample],
                  sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
     """Grade the decode trajectories with the SAME noise-aware rules as
     the bench rounds: newest file per round by mtime, same-platform
-    only, sustained-only, and only the interleaved A/B ratio + the
-    slot-occupancy trajectory (raw tokens/s is ±40% weather here)."""
+    only, sustained-only, and only the interleaved A/B ratio (per
+    metric: vs_naive / vs_static / vs_dense_cache / vs_f32 /
+    vs_no_spec) + the slot-occupancy trajectory. Raw tokens/s is ±40%
+    weather here, and the speculative accept ratio is a property of the
+    draft/target pair — both reported, never gated."""
     return _grade_metric_groups(samples, [
         ("ab_ratio", lambda s: s.ratio),
         ("slot_occupancy", lambda s: s.occupancy),
@@ -495,6 +513,8 @@ def main(argv=None) -> int:
             marks.append(f"ab_ratio={s.ratio:.3f}")
         if s.occupancy is not None:
             marks.append(f"occupancy={s.occupancy:.3f}")
+        if s.accept_ratio is not None:
+            marks.append(f"spec_accept={s.accept_ratio:.3f}")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + (" ".join(marks) or f"tokens/s={s.tokens_per_s}"))
     for s in serves:
