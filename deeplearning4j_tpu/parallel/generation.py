@@ -536,6 +536,8 @@ class GenerationPipeline:
             return True
         try:
             return cb(tok, len(req.out) - 1) is not False
+        # graftlint: disable=typed-errors — a broken consumer callback is
+        # resolved as a client_gone shed by the caller, not swallowed
         except Exception:
             # a broken consumer must never kill the decode loop the
             # other slots are riding — treat exactly like a walk-away
@@ -969,8 +971,8 @@ class GenerationPipeline:
         try:
             return _cost.global_cost_model().needs_account(DECODE_FN,
                                                            DECODE_FN)
-        except Exception:
-            return False
+        except Exception:  # graftlint: disable=typed-errors — best-effort
+            return False   # cost-telemetry probe; no request outcome here
 
     def _fresh_spec_compile(self) -> bool:
         """The spec twin: a fresh propose OR verify trace pending cost
@@ -979,8 +981,8 @@ class GenerationPipeline:
             cm = _cost.global_cost_model()
             return (cm.needs_account(VERIFY_FN, VERIFY_FN)
                     or cm.needs_account(PROPOSE_FN, PROPOSE_FN))
-        except Exception:
-            return False
+        except Exception:  # graftlint: disable=typed-errors — best-effort
+            return False   # cost-telemetry probe; no request outcome here
 
     # -------------------------------------------------------- lifecycle
     def shutdown(self):
@@ -1079,16 +1081,16 @@ class GenerationPipeline:
         ACTUAL resident bytes (paged: pages in use x page bytes)."""
         try:
             return self.engine.resident_cache_bytes(self._cache)
-        except Exception:
-            return None
+        except Exception:  # graftlint: disable=typed-errors — snapshot
+            return None    # reader racing the decode thread; answers None
 
     def _safe_pool_bytes(self):
         """Worst-case device footprint (the whole pool + draft cache) —
         the snapshot reports it next to the resident number."""
         try:
             return DecodeEngine.cache_bytes(self._cache)
-        except Exception:
-            return None
+        except Exception:  # graftlint: disable=typed-errors — snapshot
+            return None    # reader racing the decode thread; answers None
 
     @classmethod
     def live_snapshots(cls) -> list:

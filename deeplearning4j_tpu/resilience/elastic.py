@@ -470,6 +470,9 @@ class ElasticCheckpointer:
                 self._busy = True
             try:
                 self._write(step, arrays, meta)
+            # graftlint: disable=typed-errors — deliberate durability
+            # policy: the failure is counted, ringed, and surfaced via
+            # last_error; fit()'s finally re-saves synchronously
             except BaseException as e:   # an async save failing must not
                 self.last_error = e      # kill training — count + warn
                 _save_failures_counter().inc()

@@ -294,8 +294,8 @@ class FaultRegistry:
                 current_context, now_us, record_span)
             record_span("fault_injected", now_us(), ctx=current_context(),
                         point=point, kind=kind)
-        except Exception:
-            pass
+        except Exception:  # graftlint: disable=typed-errors — tracing is
+            pass           # best-effort; no request outcome flows here
 
     def check(self, point: str):
         """Fire error/crash/latency faults configured at ``point``.

@@ -243,8 +243,8 @@ def _republish_op(op: str):
             states = [b._state for b in list(_breakers.values())
                       if b.op == op]
         _circuit_gauge(op).set(max(states, default=CLOSED))
-    except Exception:
-        pass
+    except Exception:  # graftlint: disable=typed-errors — best-effort
+        pass           # gauge publish; no request outcome flows here
 
 
 class CircuitBreaker:
@@ -341,8 +341,8 @@ class CircuitBreaker:
             record_span("circuit_transition", now_us(),
                         ctx=current_context(), op=self.op,
                         to_state=_STATE_NAMES[new])
-        except Exception:
-            pass
+        except Exception:  # graftlint: disable=typed-errors — tracing is
+            pass           # best-effort; no request outcome flows here
 
     def _publish(self):
         # several instances may protect the same op (one breaker per
@@ -354,8 +354,8 @@ class CircuitBreaker:
                 peers = [b._state for b in list(_breakers.values())
                          if b.op == self.op]
             _circuit_gauge(self.op).set(max(peers, default=self._state))
-        except Exception:
-            pass
+        except Exception:  # graftlint: disable=typed-errors — best-effort
+            pass           # gauge publish; no request outcome flows here
 
     def state(self) -> int:
         return self._state

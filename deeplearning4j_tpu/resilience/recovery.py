@@ -288,6 +288,9 @@ class ResilientTrainer:
                             net._iteration, net,
                             mesh=getattr(self.target, "mesh", None),
                             sync=True)
+                    # graftlint: disable=typed-errors — durability
+                    # promise: never raise away a COMPLETED fit over a
+                    # failed final save; warned + last_error recorded
                     except Exception as e:
                         log.warning(
                             "final elastic save failed after an async "
@@ -537,6 +540,8 @@ class ResilientTrainer:
             restored = self.retry.call(_do, op="checkpoint.restore")
         except (TrainingPreempted, KeyboardInterrupt):
             raise
+        # graftlint: disable=typed-errors — documented fallback: an
+        # unrestorable manifest yields to the zip-checkpoint path
         except Exception as e:
             log.warning("elastic manifest restore failed (%s: %s); "
                         "falling back to zip checkpoints",
@@ -581,6 +586,8 @@ class ResilientTrainer:
                 r = self.retry.call(_do, op="checkpoint.restore")
             except (TrainingPreempted, KeyboardInterrupt):
                 raise
+            # graftlint: disable=typed-errors — documented fallback:
+            # skip-to-next-newest instead of killing fit()
             except Exception as e:
                 # structurally-valid-but-unrestorable zips (stray export,
                 # different model class, content corruption) rank like any

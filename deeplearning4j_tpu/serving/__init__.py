@@ -47,6 +47,7 @@ front door PR):
 Surfaces: ``UIServer GET /debug/deploy`` and ``deploy.json`` in
 flight-recorder bundles both serve :func:`snapshot`.
 """
+from deeplearning4j_tpu.serving.errors import RolloutConflictError
 from deeplearning4j_tpu.serving.frontdoor import (FrontDoor,
                                                   frontdoor_enabled)
 from deeplearning4j_tpu.serving.registry import DeployedVersion, ModelRegistry
@@ -60,6 +61,7 @@ __all__ = [
     "ModelRegistry", "DeployedVersion", "CanaryRollout", "RolloutPolicy",
     "RolloutState", "ServingRouter", "rollout_enabled", "snapshot",
     "FrontDoor", "frontdoor_enabled", "SharedStore", "SharedServingState",
+    "RolloutConflictError",
 ]
 
 

@@ -1,0 +1,22 @@
+"""Typed serving-control-plane errors.
+
+The data plane speaks the resilience taxonomy (ShedError /
+DeadlineExceeded / CircuitOpenError...); the rollout control plane used
+to raise bare ``RuntimeError`` for lifecycle refusals, which graftlint's
+typed-errors rule now forbids in ``serving/`` — callers (the front
+door's admin routes, drills, operators' scripts) need to distinguish "a
+rollout is already active" from a real failure.  Subclassing
+``RuntimeError`` keeps every pre-existing ``except RuntimeError`` /
+``pytest.raises(RuntimeError)`` caller working unchanged.
+
+Dependency-free on purpose: both ``router`` (jax-adjacent) and
+``shared_state`` (stdlib-only, multi-process) import it.
+"""
+from __future__ import annotations
+
+
+class RolloutConflictError(RuntimeError):
+    """A rollout lifecycle request was refused because of current state
+    (one already active, rollouts disabled, candidate not live, lane
+    has no primary) — retryable after the state changes; maps to HTTP
+    409 on the front door."""

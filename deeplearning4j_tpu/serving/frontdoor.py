@@ -80,6 +80,7 @@ from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   CircuitOpenError,
                                                   DeadlineExceeded, ShedError,
                                                   ShutdownError)
+from deeplearning4j_tpu.serving.errors import RolloutConflictError
 from deeplearning4j_tpu.serving.router import request_fraction
 # ONE bind-host knob for both HTTP surfaces (the UI server owns the
 # spelling) — the two servers must never drift on what the knob means
@@ -146,6 +147,8 @@ def http_status(exc: BaseException) -> int:
         return 503
     if isinstance(exc, KeyError):              # unknown version
         return 404
+    if isinstance(exc, RolloutConflictError):  # rollout lifecycle refusal
+        return 409
     if isinstance(exc, PayloadTooLarge):
         return 413
     if isinstance(exc, (BadRequest, ValueError, TypeError)):
@@ -342,8 +345,9 @@ class FrontDoor:
                 cand = event.get("candidate")
                 if cand:
                     registry.retire(cand)
-        except Exception:
-            pass
+        except Exception:  # graftlint: disable=typed-errors — replaying a
+            pass           # shared-store event is best-effort; no request
+                           # outcome flows through this handler
 
     def sync_once(self):
         """One shared-store beat (the background thread's body; tests
@@ -359,9 +363,10 @@ class FrontDoor:
         while not self._sync_stop.wait(self._sync_interval):
             try:
                 self.sync_once()
+            # graftlint: disable=typed-errors — coordination must never
+            # kill the serving process; the next beat retries
             except Exception:
-                # coordination must never kill the serving process; the
-                # next beat retries (store contention, transient fs)
+                # (store contention, transient fs)
                 pass
 
     # -------------------------------------------------------------- serve
@@ -595,6 +600,9 @@ class FrontDoor:
                                 prompt, on_token=on_token, **kw)
                         result["tokens"] = np.asarray(out).tolist()
                         result["version"] = version
+                    # graftlint: disable=typed-errors — resolved by
+                    # transport: the stored error is re-raised to the
+                    # HTTP caller via the SSE error event / status map
                     except BaseException as e:
                         result["error"] = e
                     finally:

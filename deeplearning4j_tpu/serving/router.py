@@ -34,6 +34,7 @@ import numpy as np
 from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   ShutdownError)
+from deeplearning4j_tpu.serving.errors import RolloutConflictError
 from deeplearning4j_tpu.serving.metrics import serving_metrics
 from deeplearning4j_tpu.serving.rollout import (CanaryRollout, RolloutPolicy,
                                                 RolloutState)
@@ -96,12 +97,12 @@ class ServingRouter:
                       policy: Optional[RolloutPolicy] = None) -> CanaryRollout:
         """Start canarying ``candidate`` against the current primary."""
         if not self._enabled:
-            raise RuntimeError(
+            raise RolloutConflictError(
                 "rollouts are disabled (DL4J_TPU_ROLLOUT=0): deploy/retire "
                 "still work, but traffic stays on the primary version")
         with self._lock:
             if self._rollout is not None and self._rollout.active:
-                raise RuntimeError(
+                raise RolloutConflictError(
                     f"a rollout of {self._rollout.candidate.version!r} is "
                     "already active")
             cand = self._registry.get(candidate)
@@ -118,7 +119,7 @@ class ServingRouter:
                     f"{self._primary.kind} — rollouts must not change "
                     "the serving surface")
             if not cand.admitting:
-                raise RuntimeError(
+                raise RolloutConflictError(
                     f"candidate {candidate!r} is not live "
                     f"(state={cand.state})")
             self._rollout = CanaryRollout(self, self._registry,
@@ -288,6 +289,9 @@ class ServingRouter:
                     _faults.check("serving.canary")
                 out = gp.generate(prompt, max_new_tokens=max_new_tokens,
                                   eos_id=eos_id)
+        # graftlint: disable=typed-errors — shadow traffic: a candidate
+        # failure is SCORED (error counted per version), never allowed
+        # to touch the incumbent's already-delivered response
         except Exception as e:
             self._account(dv, t0, error=e)
             obs.shadow(dv.version, "error").inc()
@@ -354,6 +358,9 @@ class ServingRouter:
                 if _faults.armed():
                     _faults.check("serving.canary")
                 out = pi.output(x)
+        # graftlint: disable=typed-errors — shadow traffic: a candidate
+        # failure is SCORED (error counted per version), never allowed
+        # to touch the incumbent's already-delivered response
         except Exception as e:
             self._account(dv, t0, error=e)
             obs.shadow(dv.version, "error").inc()
@@ -365,8 +372,8 @@ class ServingRouter:
                                      np.asarray(incumbent_out),
                                      rtol=policy.divergence_rtol,
                                      atol=policy.divergence_atol))
-        except Exception:         # shape mismatch IS a divergence
-            match = False
+        except Exception:  # graftlint: disable=typed-errors — comparison
+            match = False  # failure (shape mismatch) IS a divergence score
         obs.shadow(dv.version, "match" if match else "diverged").inc()
 
     # ----------------------------------------------- shared-store serving

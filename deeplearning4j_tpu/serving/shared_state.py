@@ -52,6 +52,7 @@ except ImportError:                      # pragma: no cover - POSIX only
     fcntl = None
 
 from deeplearning4j_tpu.observability.slo import DEGRADED, FAILING, OK, _grade
+from deeplearning4j_tpu.serving.errors import RolloutConflictError
 
 #: the two serving surfaces a fleet coordinates (a lane = one primary +
 #: at most one rollout; classify rides scoring, generate rides generative)
@@ -305,11 +306,11 @@ class SharedServingState:
                   .setdefault(lane, {"primary": None, "rollout": None}))
             ro = st.get("rollout")
             if ro and ro.get("active"):
-                raise RuntimeError(
+                raise RolloutConflictError(
                     f"a shared rollout of {ro.get('candidate')!r} is "
                     f"already active on lane {lane!r}")
             if not st.get("primary"):
-                raise RuntimeError(
+                raise RolloutConflictError(
                     f"lane {lane!r} has no primary to canary against "
                     "(ensure_lane first)")
             if st.get("primary") == candidate:

@@ -1,119 +1,35 @@
 #!/usr/bin/env python
-"""Environment-knob lint: every ``DL4J_TPU_*`` variable the code reads
-must appear in README's "Environment knob reference" table, and every
-documented knob must still exist in code.
+"""Environment-knob lint — back-compat shim.
 
-The knob surface had drifted: ``DL4J_TPU_DATA_DIR`` / ``RESOURCE_DIR`` /
-``ZOO_CACHE`` / ``GRAPH_OPT`` / ``POSTMORTEM_ON_EXIT`` were live but
-undocumented, and nothing stopped the next PR from adding more. This
-lint diffs the two sets:
+The real checker now lives in the graftlint suite
+(``tools/graftlint/checkers/env_knobs.py``, rule id ``env-knobs``).
+This shim keeps the original surface working unchanged:
 
-- **referenced**: regex scan of ``*.py`` under the package, tools/,
-  benchmarks/ (excluding the ``ab/`` scratch area), examples/, and
-  tests/ — any ``DL4J_TPU_[A-Z0-9_]+`` literal counts as a reference
-  (getenv, docstring table, or shell snippet alike: if code *mentions*
-  a knob it must be in the canonical table).
-- **documented**: knob names parsed from README.md's
-  "Environment knob reference" table rows
-  (``| `DL4J_TPU_<name>` | ... |``).
+- CLI: ``python tools/check_env_knobs.py [repo_root]`` (exit code =
+  violation count)
+- API: :func:`check_repo` / :class:`Violation`
+  (tests/test_obs_observatory.py imports these)
 
-Run standalone (``python tools/check_env_knobs.py [repo_root]``, exit
-code = violation count) or from the test suite (imports
-:func:`check_repo`), like ``check_metric_names.py``.
+Prefer ``python -m tools.graftlint --rule env-knobs`` for new tooling.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import List, NamedTuple, Set
 
-KNOB_RE = re.compile(r"DL4J_TPU_[A-Z][A-Z0-9_]*")
+_REPO_ROOT = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir))
+if _REPO_ROOT not in sys.path:          # loaded standalone (importlib /
+    sys.path.insert(0, _REPO_ROOT)      # direct script run)
 
-#: directories scanned for references, relative to the repo root
-SCAN_DIRS = ("deeplearning4j_tpu", "tools", "benchmarks", "examples",
-             "tests")
-
-#: scratch areas whose archived shell/json blobs are not "the code"
-SKIP_DIRS = {"__pycache__", "ab"}
-
-TABLE_HEADING = "### Environment knob reference"
-
-
-class Violation(NamedTuple):
-    knob: str
-    message: str
-
-    def __str__(self):
-        return f"{self.knob}: {self.message}"
-
-
-def referenced_knobs(root: str) -> Set[str]:
-    out: Set[str] = set()
-    for rel in SCAN_DIRS:
-        base = os.path.join(root, rel)
-        if not os.path.isdir(base):
-            continue
-        for dirpath, dirnames, filenames in os.walk(base):
-            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-            for fn in filenames:
-                if not fn.endswith((".py", ".sh")):
-                    continue
-                path = os.path.join(dirpath, fn)
-                try:
-                    with open(path, encoding="utf-8",
-                              errors="replace") as f:
-                        out.update(KNOB_RE.findall(f.read()))
-                except OSError:
-                    continue
-    return out
-
-
-def documented_knobs(readme_path: str) -> Set[str]:
-    """Knob names from the README reference table: rows shaped
-    ``| `DL4J_TPU_<name>` | default | what it does |`` under the
-    heading."""
-    try:
-        with open(readme_path, encoding="utf-8") as f:
-            text = f.read()
-    except OSError:
-        return set()
-    idx = text.find(TABLE_HEADING)
-    if idx < 0:
-        return set()
-    out: Set[str] = set()
-    for line in text[idx:].splitlines():
-        if line.startswith("## ") and TABLE_HEADING not in line:
-            break                               # next top-level section
-        if line.lstrip().startswith("|"):
-            m = KNOB_RE.search(line)
-            if m:
-                out.add(m.group(0))
-    return out
-
-
-def check_repo(root: str) -> List[Violation]:
-    referenced = referenced_knobs(root)
-    documented = documented_knobs(os.path.join(root, "README.md"))
-    out: List[Violation] = []
-    if not documented:
-        return [Violation("<table>",
-                          f"README.md has no '{TABLE_HEADING}' table")]
-    for knob in sorted(referenced - documented):
-        out.append(Violation(
-            knob, "referenced in code but missing from the README "
-                  "environment-knob reference table"))
-    for knob in sorted(documented - referenced):
-        out.append(Violation(
-            knob, "documented in README but referenced nowhere in code "
-                  "(stale row?)"))
-    return out
+from tools.graftlint.checkers.env_knobs import (  # noqa: E402,F401
+    KNOB_RE, SCAN_DIRS, SKIP_DIRS, TABLE_HEADING, Violation, check_repo,
+    documented_knobs, referenced_knobs)
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    root = args[0] if args else os.path.normpath(os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), os.pardir))
+    root = args[0] if args else _REPO_ROOT
     violations = check_repo(root)
     for v in violations:
         print(v)

@@ -1,180 +1,38 @@
 #!/usr/bin/env python
-"""Metric naming lint: enforce the Prometheus conventions the registry's
-series follow, over every instrument creation in the package.
+"""Metric naming lint — back-compat shim.
 
-Rules (on every ``.counter("name", ...)`` / ``.gauge(...)`` /
-``.histogram(...)`` call whose name is a string literal):
+The real checker now lives in the graftlint suite
+(``tools/graftlint/checkers/metric_names.py``, rule id
+``metric-names``) where it shares one AST parse per file with every
+other checker.  This shim keeps the original surface working unchanged:
 
-- names match ``dl4j_[a-z0-9_]+`` (the namespace prefix; lowercase snake)
-- counters end in ``_total``; nothing else may end in ``_total``
-- histograms carry a unit suffix (``_seconds`` / ``_bytes`` / ``_ratio``/
-  ``_us`` / ``_norm`` — the last marks unitless L2-magnitude series like
-  the gradient norm) — except two grandfathered dimensionless series
-  from PR 2
-- a non-empty description (HELP text) is provided
-- label names are lowercase snake (``[a-z][a-z0-9_]*``)
-- **label cardinality**: a ``.labels(tenant=...)`` binding must pass a
-  string literal or a value produced by the bounded ``tenant_label``
-  helper (``resilience/qos.py``: configured tenants + top-N, overflow
-  bucket beyond) — never a raw request string, which would let one
-  caller spraying tenant ids explode the registry
+- CLI: ``python tools/check_metric_names.py [root]`` (exit code =
+  violation count)
+- API: :func:`check_source` / :func:`check_package` / :class:`Violation`
+  (tests/test_obs_causal.py and tests/test_qos.py import these)
 
-Run standalone (``python tools/check_metric_names.py [root]``, exit code =
-violation count) or from tests (tests/test_obs_causal.py imports and runs
-``check_package``). AST-based: variables passed as names are skipped —
-the conventions bind the literal registration sites, which is where new
-series are born.
+Prefer ``python -m tools.graftlint --rule metric-names`` for new
+tooling.
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import List, NamedTuple, Optional
 
-NAME_RE = re.compile(r"^dl4j_[a-z0-9]+(_[a-z0-9]+)*$")
-LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_us", "_norm")
+_REPO_ROOT = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir))
+if _REPO_ROOT not in sys.path:          # loaded standalone (importlib /
+    sys.path.insert(0, _REPO_ROOT)      # direct script run)
 
-#: dimensionless 0..1 histograms that predate this lint; new fraction
-#: metrics must use ``_ratio``
-GRANDFATHERED = frozenset({
-    "dl4j_inference_batch_occupancy",
-    "dl4j_inference_bucket_fill",
-})
-
-_FACTORIES = {"counter", "gauge", "histogram"}
-
-
-class Violation(NamedTuple):
-    path: str
-    line: int
-    metric: str
-    message: str
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: {self.metric}: {self.message}"
-
-
-def _const_str(node) -> Optional[str]:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
-
-
-def _label_names(call: ast.Call):
-    """Literal label-name strings from the 3rd positional arg or the
-    ``label_names=`` keyword (non-literal containers are skipped)."""
-    node = None
-    if len(call.args) >= 3:
-        node = call.args[2]
-    for kw in call.keywords:
-        if kw.arg == "label_names":
-            node = kw.value
-    if not isinstance(node, (ast.Tuple, ast.List)):
-        return []
-    return [s for s in (_const_str(e) for e in node.elts) if s is not None]
-
-
-def _description(call: ast.Call) -> Optional[str]:
-    if len(call.args) >= 2:
-        return _const_str(call.args[1])
-    for kw in call.keywords:
-        if kw.arg == "description":
-            return _const_str(kw.value)
-    return None
-
-
-def _is_tenant_label_call(node) -> bool:
-    """``tenant_label(...)`` / ``<anything>.tenant_label(...)`` — the
-    bounded-cardinality helper the ``{tenant}`` label must route
-    through."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    name = fn.id if isinstance(fn, ast.Name) else (
-        fn.attr if isinstance(fn, ast.Attribute) else None)
-    return name == "tenant_label"
-
-
-def check_source(source: str, path: str = "<string>") -> List[Violation]:
-    out: List[Violation] = []
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [Violation(path, e.lineno or 0, "<parse>", str(e))]
-    # the helper's home module is the ONE place allowed to bind an
-    # already-bounded label variable directly (every tenant series is
-    # born there); everywhere else must call tenant_label at the site
-    in_qos_module = path.replace(os.sep, "/").endswith(
-        "resilience/qos.py")
-    for node in ast.walk(tree):
-        if (not in_qos_module and isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "labels"):
-            for kw in node.keywords:
-                if kw.arg != "tenant":
-                    continue
-                if (_const_str(kw.value) is None
-                        and not _is_tenant_label_call(kw.value)):
-                    out.append(Violation(
-                        path, node.lineno, "{tenant}",
-                        "tenant label values must be string literals "
-                        "or routed through the bounded tenant_label() "
-                        "helper (resilience/qos.py) — raw request "
-                        "strings are unbounded cardinality"))
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _FACTORIES and node.args):
-            continue
-        name = _const_str(node.args[0])
-        if name is None or not name:        # dynamic name: out of scope
-            continue
-        kind = node.func.attr
-
-        def bad(msg):
-            out.append(Violation(path, node.lineno, name, msg))
-
-        if not NAME_RE.match(name):
-            bad("must match dl4j_[a-z0-9_]+ (namespace prefix, "
-                "lowercase snake)")
-        if kind == "counter" and not name.endswith("_total"):
-            bad("counters must end in _total")
-        if kind != "counter" and name.endswith("_total"):
-            bad(f"_total is reserved for counters (this is a {kind})")
-        if (kind == "histogram" and name not in GRANDFATHERED
-                and not name.endswith(UNIT_SUFFIXES)):
-            bad("histograms need a unit suffix "
-                f"({'/'.join(UNIT_SUFFIXES)})")
-        desc = _description(node)
-        if desc is not None and not desc.strip():
-            bad("empty description (HELP text)")
-        for label in _label_names(node):
-            if not LABEL_RE.match(label):
-                bad(f"label {label!r} must be lowercase snake")
-    return out
-
-
-def check_package(root: str) -> List[Violation]:
-    out: List[Violation] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                out.extend(check_source(f.read(), path))
-    return out
+from tools.graftlint.checkers.metric_names import (  # noqa: E402,F401
+    GRANDFATHERED, LABEL_RE, NAME_RE, UNIT_SUFFIXES, Violation,
+    check_package, check_source, check_tree)
 
 
 def main(argv=None) -> int:
     args = (argv if argv is not None else sys.argv[1:])
-    root = args[0] if args else os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), os.pardir,
-        "deeplearning4j_tpu")
+    root = args[0] if args else os.path.join(_REPO_ROOT,
+                                             "deeplearning4j_tpu")
     violations = check_package(os.path.normpath(root))
     for v in violations:
         print(v)

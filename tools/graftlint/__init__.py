@@ -1,0 +1,325 @@
+"""graftlint — repo-wide static analysis encoding this codebase's
+hard-won invariants.
+
+Thirteen PRs of review hardening kept re-fixing the same bug classes:
+lock-held iteration races ("deque mutated during iteration" into
+``/debug/perf``), untyped ``RuntimeError``\\ s leaking from resilience
+paths, trace-time reads of ``DL4J_TPU_*`` env flags inside jitted
+functions, donated buffers read after the donating call, and broad
+``except Exception`` clauses swallowing the typed ShedError taxonomy the
+exactly-once machinery depends on.  Each checker here freezes one of
+those classes at dev time, the way ``tools/check_metric_names.py`` and
+``tools/check_env_knobs.py`` (now checkers in this suite) froze theirs.
+
+Framework pieces:
+
+- **shared file walker** — every ``*.py`` under the scan root is read
+  and AST-parsed exactly ONCE (:class:`FileContext` caches the tree);
+  all checkers visit the same parse.
+- **checker registry** — checkers self-register via :func:`register`;
+  a checker implements ``check_file(ctx)`` (per-file, shared AST)
+  and/or ``check_repo(repo_root, contexts)`` (whole-repo).
+- **finding model** — :class:`Finding` carries file:line, rule id,
+  message, and a fix hint.
+- **inline suppressions** — ``# graftlint: disable=<rule>[,<rule>...]``
+  on the offending line (or the line directly above) suppresses those
+  rules there; deliberate exemptions carry a one-line justification in
+  the same comment.
+- **baseline** — ``tools/graftlint_baseline.json`` freezes pre-existing
+  violations (matched by rule + path + source-line text, so plain line
+  drift doesn't resurrect them); anything NOT in the baseline fails.
+
+CLI: ``python -m tools.graftlint`` (``--rule``, ``--baseline-update``,
+``--list-rules``, ``--root``); exit code = number of new findings.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+__all__ = [
+    "Finding", "FileContext", "LintResult", "register", "all_checkers",
+    "walk_files", "run_lint", "write_baseline", "default_package_root",
+    "default_repo_root", "default_baseline_path",
+]
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def default_repo_root() -> str:
+    return os.path.normpath(os.path.join(_TOOLS_DIR, os.pardir, os.pardir))
+
+
+def default_package_root() -> str:
+    return os.path.join(default_repo_root(), "deeplearning4j_tpu")
+
+
+def default_baseline_path() -> str:
+    return os.path.join(default_repo_root(), "tools",
+                        "graftlint_baseline.json")
+
+
+class Finding(NamedTuple):
+    """One rule violation, anchored to a file:line."""
+    rule: str
+    path: str            # repo-relative, '/'-separated
+    line: int
+    message: str
+    hint: str = ""
+
+    def __str__(self):
+        s = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.hint:
+            s += f"  (fix: {self.hint})"
+        return s
+
+
+class FileContext:
+    """One scanned file: source read once, AST parsed once, shared by
+    every checker (the two pre-graftlint lints each parsed their own
+    tree; this is the single-parse fix)."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if self._tree is None and self.parse_error is None:
+            try:
+                self._tree = ast.parse(self.source)
+            except SyntaxError as e:
+                self.parse_error = e
+        return self._tree
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+# --------------------------------------------------------------- registry
+
+_CHECKERS: List[object] = []
+
+
+def register(checker_cls):
+    """Class decorator: instantiate and add to the suite. A checker
+    class needs ``rule`` (id), ``description``, and ``check_file(ctx)``
+    and/or ``check_repo(repo_root, contexts)``."""
+    _CHECKERS.append(checker_cls())
+    return checker_cls
+
+
+def all_checkers() -> List[object]:
+    # import-time self-registration: pulling in the package registers
+    # every bundled checker exactly once
+    from . import checkers  # noqa: F401
+    return list(_CHECKERS)
+
+
+# ----------------------------------------------------------------- walker
+
+def walk_files(root: str) -> List[FileContext]:
+    out: List[FileContext] = []
+    root = os.path.normpath(root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    out.append(FileContext(path, rel, f.read()))
+            except OSError:
+                continue
+    return out
+
+
+# ------------------------------------------------------------ suppressions
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([a-z0-9_,\- ]+)")
+
+
+def _suppressed_rules(line: str) -> frozenset:
+    m = _SUPPRESS_RE.search(line)
+    if not m:
+        return frozenset()
+    # the capture may trail into a justification ("disable=rule — why");
+    # tokenize on commas/whitespace and keep every token — unknown words
+    # are harmless, rule ids match exactly
+    return frozenset(t for t in re.split(r"[,\s]+", m.group(1)) if t)
+
+
+def is_suppressed(ctx: FileContext, finding: Finding) -> bool:
+    """True when the finding's line — or the contiguous block of
+    comment-only lines directly above it (multi-line justifications) —
+    carries ``# graftlint: disable=<rule>`` (or ``disable=all``)."""
+    rules = _suppressed_rules(ctx.line_text(finding.line))
+    if finding.rule in rules or "all" in rules:
+        return True
+    line_no = finding.line - 1
+    while line_no >= 1:
+        text = ctx.line_text(line_no)
+        if not text.startswith("#"):
+            break
+        rules = _suppressed_rules(text)
+        if finding.rule in rules or "all" in rules:
+            return True
+        line_no -= 1
+    return False
+
+
+# --------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> Dict[tuple, int]:
+    """Baseline entries as a multiset keyed (rule, path, context)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    out: Dict[tuple, int] = {}
+    for e in doc.get("entries", []):
+        key = (e.get("rule", ""), e.get("path", ""), e.get("context", ""))
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: Sequence[Finding],
+                  contexts: Dict[str, FileContext],
+                  preserve: Optional[Dict[tuple, int]] = None):
+    counts: Dict[tuple, int] = dict(preserve or {})
+    for f in findings:
+        ctx = contexts.get(f.path)
+        key = (f.rule, f.path, ctx.line_text(f.line) if ctx else "")
+        counts[key] = counts.get(key, 0) + 1
+    entries = [{"rule": r, "path": p, "context": c, "count": n}
+               for (r, p, c), n in sorted(counts.items())]
+    doc = {"comment": "graftlint frozen pre-existing violations — new "
+                      "violations fail; update via "
+                      "`python -m tools.graftlint --baseline-update`",
+           "entries": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------------------------ runner
+
+class LintResult(NamedTuple):
+    new: List[Finding]        # unsuppressed, not frozen in the baseline
+    baselined: List[Finding]  # matched a frozen baseline entry
+    suppressed: int           # inline-disabled findings
+    files: int                # files scanned
+    seconds: float
+
+
+def run_lint(root: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             repo_root: Optional[str] = None,
+             checkers: Optional[Sequence[object]] = None) -> LintResult:
+    """Run the suite: walk+parse once, fan the shared contexts through
+    every (selected) checker, apply suppressions then the baseline."""
+    t0 = time.perf_counter()
+    root = root if root is not None else default_package_root()
+    repo_root = repo_root if repo_root is not None else default_repo_root()
+    use = list(checkers) if checkers is not None else all_checkers()
+    # "parse" is the walker's own pseudo-rule (unparseable file); with a
+    # --rule filter active it reports only when explicitly selected, so
+    # a single-rule CI invocation can't fail on files its rule never
+    # inspects
+    emit_parse = True
+    if rules:
+        wanted = set(rules)
+        emit_parse = "parse" in wanted
+        unknown = wanted - {c.rule for c in use} - {"parse"}
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(have: {', '.join(sorted(c.rule for c in use))}, parse)")
+        use = [c for c in use if c.rule in wanted]
+
+    contexts = walk_files(root)
+    by_path = {c.relpath: c for c in contexts}
+
+    findings: List[Finding] = []
+    for ctx in contexts:
+        if ctx.tree is None:       # unparseable file is itself a finding
+            if emit_parse:
+                e = ctx.parse_error
+                findings.append(Finding(
+                    "parse", ctx.relpath, getattr(e, "lineno", 0) or 0,
+                    f"syntax error: {e}", "fix the syntax"))
+            continue
+        for checker in use:
+            check_file = getattr(checker, "check_file", None)
+            if check_file is not None:
+                findings.extend(check_file(ctx))
+    for checker in use:
+        check_repo = getattr(checker, "check_repo", None)
+        if check_repo is not None:
+            findings.extend(check_repo(repo_root, contexts))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        ctx = by_path.get(f.path)
+        if ctx is not None and is_suppressed(ctx, f):
+            suppressed += 1
+        else:
+            kept.append(f)
+
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None
+        else default_baseline_path())
+    new: List[Finding] = []
+    frozen: List[Finding] = []
+    for f in kept:
+        ctx = by_path.get(f.path)
+        key = (f.rule, f.path, ctx.line_text(f.line) if ctx else "")
+        if baseline.get(key, 0) > 0:
+            baseline[key] -= 1
+            frozen.append(f)
+        else:
+            new.append(f)
+    return LintResult(new, frozen, suppressed, len(contexts),
+                      time.perf_counter() - t0)
+
+
+def write_baseline(root: Optional[str] = None,
+                   baseline_path: Optional[str] = None,
+                   rules: Optional[Sequence[str]] = None,
+                   repo_root: Optional[str] = None) -> int:
+    """Freeze the current (unsuppressed) findings; returns how many.
+    With a rule filter, only the SELECTED rules' entries are replaced —
+    every other rule's frozen entries are preserved verbatim."""
+    res = run_lint(root=root, rules=rules, repo_root=repo_root,
+                   baseline_path=os.devnull)   # ignore the old baseline
+    contexts = {c.relpath: c for c in walk_files(
+        root if root is not None else default_package_root())}
+    path = baseline_path if baseline_path is not None \
+        else default_baseline_path()
+    preserve = None
+    if rules:
+        wanted = set(rules)
+        preserve = {key: n for key, n in load_baseline(path).items()
+                    if key[0] not in wanted}
+    save_baseline(path, res.new, contexts, preserve=preserve)
+    return len(res.new)

@@ -1,0 +1,112 @@
+"""Small shared AST helpers for graftlint checkers (pure stdlib)."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SCOPE_NODES = _FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+
+def dotted(node) -> Optional[str]:
+    """Dotted name for Name/Attribute chains (``os.environ.get``);
+    None when the chain roots in anything else (a call, subscript...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node) -> Optional[str]:
+    """Last identifier of a Name/Attribute (``self._perf_lock`` ->
+    ``_perf_lock``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Simple callee name: ``f(...)`` -> f, ``x.m(...)`` -> m."""
+    return terminal_name(call.func)
+
+
+def walk_scope(node) -> Iterator[ast.AST]:
+    """Walk a function's OWN statements: descend everywhere except into
+    nested function/class/lambda bodies (their code runs in a different
+    scope and, for jit purity, at a different time)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def parent_map(tree) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def functions(tree) -> List[ast.AST]:
+    """Every function/method def in the tree, nested included."""
+    return [n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+
+
+def enclosing_functions(tree) -> Dict[ast.AST, Optional[ast.AST]]:
+    """node -> nearest enclosing function def (None = module level)."""
+    out: Dict[ast.AST, Optional[ast.AST]] = {}
+
+    def visit(node, fn):
+        for child in ast.iter_child_nodes(node):
+            out[child] = fn
+            visit(child, child if isinstance(child, _FUNC_NODES) else fn)
+
+    visit(tree, None)
+    return out
+
+
+def names_in(node) -> List[str]:
+    """All simple identifiers mentioned in a subtree (Name ids and
+    Attribute attrs) — used to match exception-clause types loosely."""
+    out: List[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
+
+
+def const_int_tuple(node) -> Optional[tuple]:
+    """``(0, 2)`` / ``[1]`` / ``3`` literals -> tuple of ints."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def end_line(node) -> int:
+    return getattr(node, "end_lineno", None) or getattr(node, "lineno", 0)

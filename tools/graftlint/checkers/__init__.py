@@ -1,0 +1,6 @@
+"""Bundled checkers — importing this package registers each one with
+the graftlint registry (plugins self-register via ``@register`` at
+import time; a new checker is one new module plus one import line
+here)."""
+from . import (donation, env_knobs, jit_purity, lock_discipline,  # noqa: F401
+               metric_names, thread_hygiene, typed_errors)

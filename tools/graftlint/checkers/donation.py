@@ -1,0 +1,171 @@
+"""donation-safety: a buffer donated to a jitted call is dead to the
+caller.
+
+``jax.jit(..., donate_argnums=(1,))`` invalidates the argument's buffer
+the moment the call runs — reading it afterwards returns garbage (or
+raises on some backends, silently "works" on CPU test meshes, which is
+exactly why review keeps having to catch it).  This checker tracks, per
+file:
+
+- ``g = jax.jit(f, donate_argnums=...)`` local/module bindings,
+- ``self._g = jax.jit(f, donate_argnums=...)`` attribute bindings
+  (matched at ``self._g(...)`` call sites anywhere in the file), and
+- ``@functools.partial(jax.jit, donate_argnums=...)``-decorated methods
+  (donated indices include ``self``; call-site positions shift by one),
+
+then flags any read of a plain-name argument passed at a donated
+position AFTER the donating call (before the name is rebound).  The
+common correct idiom — ``cache = self._decode(params, cache)`` —
+rebinds on the same statement and is not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .. import Finding, register
+from ..astutil import (const_int_tuple, end_line, functions, keyword,
+                       walk_scope)
+
+
+def _is_jit_func(fn) -> bool:
+    return (isinstance(fn, ast.Name) and fn.id in ("jit", "pjit")) or \
+        (isinstance(fn, ast.Attribute) and fn.attr in ("jit", "pjit"))
+
+
+def _donated_positions(call: ast.Call) -> Optional[tuple]:
+    kw = keyword(call, "donate_argnums")
+    if kw is None:
+        return None
+    return const_int_tuple(kw)
+
+
+def _jit_binding(value) -> Optional[tuple]:
+    """``jax.jit(f, donate_argnums=...)`` -> donated positions."""
+    if isinstance(value, ast.Call) and _is_jit_func(value.func):
+        return _donated_positions(value)
+    return None
+
+
+def _partial_jit_decorator(fn_def) -> Optional[tuple]:
+    """``@functools.partial(jax.jit, donate_argnums=...)`` -> positions
+    (unbound indices — include ``self``)."""
+    for dec in fn_def.decorator_list:
+        if (isinstance(dec, ast.Call) and dec.args
+                and _is_jit_func(dec.args[0])):
+            pos = _donated_positions(dec)
+            if pos is not None:
+                return pos
+        if isinstance(dec, ast.Call) and _is_jit_func(dec.func):
+            pos = _donated_positions(dec)
+            if pos is not None:
+                return pos
+    return None
+
+
+@register
+class DonationSafetyChecker:
+    rule = "donation-safety"
+    description = ("arguments passed at a donate_argnums position must "
+                   "not be read after the donating call")
+
+    def check_file(self, ctx) -> List[Finding]:
+        if "donate_argnums" not in ctx.source:   # cheap pre-filter
+            return []
+        tree = ctx.tree
+        # attr/method name -> donated CALL-SITE positions (bound-call
+        # shift already applied for decorated methods)
+        attr_map: Dict[str, tuple] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                pos = _jit_binding(node.value)
+                if pos is None:
+                    continue
+                t = node.targets[0]
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id in ("self", "cls")):
+                    attr_map[t.attr] = pos
+        for fn_def in functions(tree):
+            pos = _partial_jit_decorator(fn_def)
+            if pos is not None:
+                args = fn_def.args.posonlyargs + fn_def.args.args
+                if args and args[0].arg in ("self", "cls"):
+                    # bound-call positions: signature index i is call
+                    # position i-1 (index 0 = self, not donatable at a
+                    # call site)
+                    attr_map[fn_def.name] = tuple(
+                        p - 1 for p in pos if p >= 1)
+                else:
+                    attr_map[fn_def.name] = pos
+
+        out: List[Finding] = []
+        for fn in functions(tree):
+            out.extend(self._check_function(ctx, fn, attr_map))
+        return out
+
+    # ------------------------------------------------------------------
+    def _check_function(self, ctx, fn, attr_map) -> List[Finding]:
+        # local bindings: g = jax.jit(f, donate_argnums=...)
+        local_map: Dict[str, tuple] = {}
+        for n in walk_scope(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                pos = _jit_binding(n.value)
+                if pos is not None:
+                    local_map[n.targets[0].id] = pos
+
+        # (donated-name, call line, call end line, jit name) events
+        events: List[Tuple[str, int, int, str]] = []
+        for n in walk_scope(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            pos: Optional[tuple] = None
+            label = None
+            if isinstance(n.func, ast.Name) and n.func.id in local_map:
+                pos, label = local_map[n.func.id], n.func.id
+            elif (isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in ("self", "cls")
+                    and n.func.attr in attr_map):
+                pos = attr_map[n.func.attr]
+                label = f"self.{n.func.attr}"
+            elif (isinstance(n, ast.Call) and isinstance(n.func, ast.Call)
+                    and _is_jit_func(n.func.func)):
+                # immediate call: jax.jit(f, donate_argnums=...)(x, ...)
+                pos = _donated_positions(n.func)
+                label = "jax.jit(...)"
+            if not pos:
+                continue
+            for p in pos:
+                if p < len(n.args) and isinstance(n.args[p], ast.Name):
+                    events.append((n.args[p].id, n.lineno,
+                                   end_line(n), label))
+
+        if not events:
+            return []
+
+        reads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for n in walk_scope(fn):
+            if isinstance(n, ast.Name):
+                book = reads if isinstance(n.ctx, ast.Load) else stores
+                book.setdefault(n.id, []).append(n.lineno)
+        out: List[Finding] = []
+        for var, line, endl, label in events:
+            store_after = min((s for s in stores.get(var, ())
+                               if s >= line), default=None)
+            if store_after is not None and store_after <= endl:
+                continue          # rebound by the donating statement
+            limit = store_after if store_after is not None else 1 << 30
+            bad = sorted(r for r in reads.get(var, ())
+                         if endl < r < limit)
+            if bad:
+                out.append(Finding(
+                    self.rule, ctx.relpath, bad[0],
+                    f"`{var}` was donated to `{label}` on line {line} "
+                    "and read afterwards — the donated buffer is "
+                    "invalidated by the call",
+                    "use the call's result (rebind the name) or drop "
+                    "it from donate_argnums"))
+        return out

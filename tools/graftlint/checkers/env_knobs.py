@@ -1,0 +1,114 @@
+"""env-knobs: every ``DL4J_TPU_*`` variable the code reads must appear
+in README's "Environment knob reference" table, and every documented
+knob must still exist in code (migrated from the original
+``tools/check_env_knobs.py``, now a thin CLI shim over this module).
+
+This is graftlint's one repo-level checker: it diffs a regex scan of
+the package/tools/benchmarks/examples/tests trees against the README
+table, so it runs once per lint invocation rather than per file.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import List, NamedTuple, Set
+
+from .. import Finding, register
+
+KNOB_RE = re.compile(r"DL4J_TPU_[A-Z][A-Z0-9_]*")
+
+#: directories scanned for references, relative to the repo root
+SCAN_DIRS = ("deeplearning4j_tpu", "tools", "benchmarks", "examples",
+             "tests")
+
+#: scratch areas whose archived shell/json blobs are not "the code"
+SKIP_DIRS = {"__pycache__", "ab"}
+
+TABLE_HEADING = "### Environment knob reference"
+
+
+class Violation(NamedTuple):
+    knob: str
+    message: str
+
+    def __str__(self):
+        return f"{self.knob}: {self.message}"
+
+
+def referenced_knobs(root: str) -> Set[str]:
+    out: Set[str] = set()
+    for rel in SCAN_DIRS:
+        base = os.path.join(root, rel)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+            for fn in filenames:
+                if not fn.endswith((".py", ".sh")):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8",
+                              errors="replace") as f:
+                        out.update(KNOB_RE.findall(f.read()))
+                except OSError:
+                    continue
+    return out
+
+
+def documented_knobs(readme_path: str) -> Set[str]:
+    """Knob names from the README reference table: rows shaped
+    ``| `DL4J_TPU_<name>` | default | what it does |`` under the
+    heading."""
+    try:
+        with open(readme_path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return set()
+    idx = text.find(TABLE_HEADING)
+    if idx < 0:
+        return set()
+    out: Set[str] = set()
+    for line in text[idx:].splitlines():
+        if line.startswith("## ") and TABLE_HEADING not in line:
+            break                               # next top-level section
+        if line.lstrip().startswith("|"):
+            m = KNOB_RE.search(line)
+            if m:
+                out.add(m.group(0))
+    return out
+
+
+def check_repo(root: str) -> List[Violation]:
+    referenced = referenced_knobs(root)
+    documented = documented_knobs(os.path.join(root, "README.md"))
+    out: List[Violation] = []
+    if not documented:
+        return [Violation("<table>",
+                          f"README.md has no '{TABLE_HEADING}' table")]
+    for knob in sorted(referenced - documented):
+        out.append(Violation(
+            knob, "referenced in code but missing from the README "
+                  "environment-knob reference table"))
+    for knob in sorted(documented - referenced):
+        out.append(Violation(
+            knob, "documented in README but referenced nowhere in code "
+                  "(stale row?)"))
+    return out
+
+
+@register
+class EnvKnobsChecker:
+    rule = "env-knobs"
+    description = ("DL4J_TPU_* knob surface matches the README "
+                   "reference table both ways")
+
+    def check_repo(self, repo_root, contexts) -> List[Finding]:
+        # a fixture root without a package/README isn't this repo —
+        # the knob table diff only means something at the real root
+        if not os.path.isdir(os.path.join(repo_root, SCAN_DIRS[0])):
+            return []
+        return [Finding(self.rule, "README.md", 0, str(v),
+                        "add/remove the knob row in README's "
+                        "'Environment knob reference' table")
+                for v in check_repo(repo_root)]

@@ -1,0 +1,192 @@
+"""jit-purity: no host side effects inside functions reachable from the
+known jitted entry points.
+
+The body of a jitted function executes only while jax TRACES it.  A
+``time.time()`` / ``os.environ`` read there is evaluated once and frozen
+into the compiled executable (the retrace-storm / stale-flag bug class
+compile_watch only catches in production); a ``print`` or lock
+acquisition silently stops happening on cached executions.  Env flags
+must be read at trace/builder time — OUTSIDE the traced body — and
+closed over.
+
+Roots: the repo's known jitted entry points by name (``_train_step``,
+``_output_jit`` bucket executables, ``decode_step_math``,
+``decode_window_paged``, ``spec_verify``, ``spec_propose``), any
+function decorated with ``jit``/``pjit`` (bare or via
+``functools.partial``), and any local function passed to a
+``jax.jit(...)`` call.  Reachability is propagated intra-module over
+simple-name call edges (cross-module edges are out of scope — each
+module's jitted surface is checked where it lives).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from .. import Finding, register
+from ..astutil import (call_name, dotted, functions, terminal_name,
+                       walk_scope)
+
+#: the repo's jitted entry points (ISSUE 14): the two fit-loop train
+#: steps, the serving bucket executable, and the decode/spec-decode math
+ROOT_NAMES = frozenset({
+    "_train_step", "_output_jit", "decode_step_math",
+    "decode_window_paged", "spec_verify", "spec_propose",
+})
+
+_JIT_NAMES = {"jit", "pjit"}
+
+_TIME_FNS = {"time", "time_ns", "perf_counter", "perf_counter_ns",
+             "monotonic", "monotonic_ns", "sleep"}
+
+
+def _mentions_jit(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _JIT_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _JIT_NAMES:
+            return True
+    return False
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """``jax.jit(f, ...)`` / ``jit(f)`` / ``pjit(f)`` — NOT
+    ``partial(jax.jit, ...)`` (that's a decorator factory, handled via
+    the decorator path)."""
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name in _JIT_NAMES
+
+
+@register
+class JitPurityChecker:
+    rule = "jit-purity"
+    description = ("no time/env/RNG/lock/print/IO inside functions "
+                   "reachable from jitted entry points (trace-time "
+                   "freeze / silent side-effect loss)")
+
+    def check_file(self, ctx) -> List[Finding]:
+        # cheap pre-filter: no jit spelling and no named root — no roots
+        if "jit" not in ctx.source and not any(
+                r in ctx.source for r in ROOT_NAMES):
+            return []
+        tree = ctx.tree
+        defs: Dict[str, List[ast.AST]] = {}
+        for fn in functions(tree):
+            defs.setdefault(fn.name, []).append(fn)
+        if not defs:
+            return []
+
+        roots: Set[ast.AST] = set()
+        for name, nodes in defs.items():
+            if name in ROOT_NAMES:
+                roots.update(nodes)
+            for fn in nodes:
+                if any(_mentions_jit(d) for d in fn.decorator_list):
+                    roots.add(fn)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call) and _is_jit_call(node)
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                roots.update(defs.get(node.args[0].id, ()))
+        if not roots:
+            return []
+
+        # intra-module call graph over simple names (f(...) / self.f(...))
+        edges: Dict[ast.AST, Set[ast.AST]] = {}
+        for nodes in defs.values():
+            for fn in nodes:
+                callees: Set[ast.AST] = set()
+                for n in walk_scope(fn):
+                    if isinstance(n, ast.Call):
+                        cn = call_name(n)
+                        if cn and cn in defs and cn != fn.name:
+                            callees.update(defs[cn])
+                edges[fn] = callees
+
+        reachable: Set[ast.AST] = set()
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            if fn in reachable:
+                continue
+            reachable.add(fn)
+            work.extend(edges.get(fn, ()))
+
+        out: List[Finding] = []
+        for fn in sorted(reachable, key=lambda f: f.lineno):
+            out.extend(self._scan(ctx, fn))
+        return out
+
+    # ---------------------------------------------------- impurity scan
+    def _scan(self, ctx, fn) -> Iterable[Finding]:
+        seen = set()
+
+        def emit(node, what, hint, category=None):
+            key = (node.lineno, category or what)
+            if key in seen:
+                return
+            seen.add(key)
+            yield Finding(
+                self.rule, ctx.relpath, node.lineno,
+                f"{what} inside jit-reachable `{fn.name}` — the body "
+                "executes only at TRACE time, so the value/effect is "
+                "frozen into the compiled executable", hint)
+
+        for n in walk_scope(fn):
+            if isinstance(n, (ast.Attribute, ast.Name)):
+                d = dotted(n)
+                if d is None:
+                    continue
+                if d.startswith("os.environ") or d == "os.getenv":
+                    yield from emit(
+                        n, f"env read `{d}`",
+                        "read the flag at builder/trace-call time and "
+                        "close over the value", category="env")
+                elif (d.startswith("time.")
+                        and d.split(".", 1)[1] in _TIME_FNS):
+                    yield from emit(
+                        n, f"host clock/sleep `{d}`",
+                        "take timestamps around the jitted call, not "
+                        "inside it")
+                elif d.startswith("random."):
+                    yield from emit(
+                        n, f"host RNG `{d}`",
+                        "thread a jax.random key through the function")
+                elif (d.startswith("np.random.")
+                        or d.startswith("numpy.random.")):
+                    yield from emit(
+                        n, f"host RNG `{d}`",
+                        "thread a jax.random key through the function")
+                elif (d.startswith("threading.") and d.rsplit(".", 1)[-1]
+                        in ("Lock", "RLock", "Condition", "Semaphore")):
+                    yield from emit(
+                        n, f"lock construction `{d}`",
+                        "locks belong to host code outside the traced "
+                        "body")
+            elif isinstance(n, ast.Call):
+                cn = call_name(n)
+                if isinstance(n.func, ast.Name) and cn == "print":
+                    yield from emit(
+                        n, "print(...)",
+                        "host print runs once at trace time; use "
+                        "jax.debug.print or log outside the jit")
+                elif isinstance(n.func, ast.Name) and cn == "open":
+                    yield from emit(
+                        n, "file open(...)",
+                        "do file I/O outside the traced body")
+                elif (isinstance(n.func, ast.Attribute)
+                        and cn == "acquire"):
+                    yield from emit(
+                        n, "lock .acquire()",
+                        "the lock is held at trace time only — hoist "
+                        "it out of the jitted body")
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    t = terminal_name(item.context_expr) or ""
+                    if "lock" in t.lower():
+                        yield from emit(
+                            n, f"`with {t}` lock acquisition",
+                            "the lock is held at trace time only — "
+                            "hoist it out of the jitted body")

@@ -1,0 +1,323 @@
+"""lock-discipline: what happens while a lock is held, and what must
+only happen while it is.
+
+Two sub-rules, both encoding production incidents:
+
+- **blocking call under a lock** — a registry/metrics/queue lock is a
+  latency fence for every other thread: no untimed ``queue.get()``,
+  ``device_put``/``block_until_ready`` device syncs, ``time.sleep``,
+  file ``open(...)`` or HTTP ``urlopen`` while holding one.  (The
+  serving pipeline stages and the metrics registry all take these locks
+  on hot paths.)
+- **shared deque/dict iterated outside its lock** — the exact PR-6
+  race: ``snapshot()`` iterated a ``deque`` while ``observe_time``
+  appended from the completer thread ⇒ ``deque mutated during
+  iteration`` into ``/debug/perf``.  In any class (or module) that owns
+  a lock, iterating a deque attribute outside a ``with <lock>`` block
+  is flagged; dict attributes are flagged when the same attribute IS
+  iterated under the lock elsewhere (evidence it's shared).
+
+Cross-function analysis is out of scope: a helper that blocks, called
+under a lock, won't be caught — the rule pins the direct spellings that
+actually bit.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import Finding, register
+from ..astutil import call_name, dotted, keyword, terminal_name
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_ITER_CALLS = frozenset({"list", "tuple", "sorted", "sum", "max", "min",
+                         "set", "frozenset"})
+_VIEW_CALLS = frozenset({"items", "keys", "values"})
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                             "BoundedSemaphore"})
+
+
+def _lockish_name(expr) -> Optional[str]:
+    t = terminal_name(expr)
+    if t and ("lock" in t.lower() or "cond" in t.lower()):
+        return t
+    # ``with self._lock:`` vs ``with self._lock.acquire_timeout(...)``-
+    # style wrappers: a call on a lock-named object still holds it
+    if isinstance(expr, ast.Call):
+        return _lockish_name(expr.func)
+    return None
+
+
+def _self_attr(expr) -> Optional[str]:
+    """``self.X`` -> ``X`` (load or store)."""
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+class _Shared:
+    """Shared-container attrs of one class (or the module)."""
+
+    def __init__(self):
+        self.deques: Set[str] = set()
+        self.dicts: Set[str] = set()
+        self.locks: Set[str] = set()
+
+
+def _classify_value(value) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        cn = call_name(value)
+        if cn == "deque":
+            return "deque"
+        if cn in _LOCK_FACTORIES:
+            return "lock"
+        if cn == "dict" or cn == "defaultdict" or cn == "OrderedDict":
+            return "dict"
+    if isinstance(value, ast.Dict):
+        return "dict"
+    return None
+
+
+def _walk_no_functions(node):
+    """Walk a subtree without descending into function/lambda bodies
+    (module-scope collection must not mistake a function-LOCAL
+    container or lock for a module-shared one)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _collect_shared(body_nodes, attr_of, descend_functions=True) -> _Shared:
+    """Scan assignments; ``attr_of(target)`` maps a target expression to
+    an attribute name or None.  Class scopes descend into methods
+    (``self.X = deque()`` lives in ``__init__``); the module scope must
+    NOT (a function-local ``cfg = {}`` is not module state)."""
+    shared = _Shared()
+    for node in body_nodes:
+        walk = ast.walk(node) if descend_functions else \
+            _walk_no_functions(node)
+        for n in walk:
+            if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else \
+                    [n.target]
+                value = n.value
+                if value is None:
+                    continue
+                kind = _classify_value(value)
+                if kind is None:
+                    continue
+                for t in targets:
+                    attr = attr_of(t)
+                    if attr is None:
+                        continue
+                    {"deque": shared.deques, "dict": shared.dicts,
+                     "lock": shared.locks}[kind].add(attr)
+    return shared
+
+
+class _IterUse:
+    __slots__ = ("attr", "line", "under_lock", "kind")
+
+    def __init__(self, attr, line, under_lock, kind):
+        self.attr, self.line = attr, line
+        self.under_lock, self.kind = under_lock, kind
+
+
+class _ScopeVisitor(ast.NodeVisitor):
+    """Walk one class/module scope tracking the with-lock stack; record
+    iterations over shared containers and blocking calls under locks."""
+
+    def __init__(self, checker, ctx, shared, attr_of):
+        self.checker, self.ctx = checker, ctx
+        self.shared, self.attr_of = shared, attr_of
+        self.lock_depth = 0
+        self.iters: List[_IterUse] = []
+        self.blocking: List[Finding] = []
+
+    # ------------------------------------------------------ lock stack
+    def _holds_lock(self, expr) -> bool:
+        """``with`` context holds a lock: lock-ish NAME, or an attr the
+        scope assigned a Lock/RLock/Condition factory to (catches
+        ``with self._cv:`` — a Condition is a lock however it's named)."""
+        if _lockish_name(expr):
+            return True
+        attr = self.attr_of(expr)
+        if attr is None and isinstance(expr, ast.Call):
+            attr = self.attr_of(expr.func)
+        return attr is not None and attr in self.shared.locks
+
+    def _visit_with(self, node):
+        held = sum(1 for item in node.items
+                   if self._holds_lock(item.context_expr))
+        self.lock_depth += held
+        self.generic_visit(node)
+        self.lock_depth -= held
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    # ------------------------------------------------- shared iteration
+    def _shared_attr_of(self, expr) -> Optional[Tuple[str, str]]:
+        """expr iterates a shared container? -> (attr, kind)."""
+        attr = self.attr_of(expr)
+        if attr is None and isinstance(expr, ast.Call) and not expr.args:
+            # d.items() / d.keys() / d.values()
+            if (isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr in _VIEW_CALLS):
+                attr = self.attr_of(expr.func.value)
+        if attr is None:
+            return None
+        if attr in self.shared.deques:
+            return attr, "deque"
+        if attr in self.shared.dicts:
+            return attr, "dict"
+        return None
+
+    def _note_iter(self, expr, line):
+        hit = self._shared_attr_of(expr)
+        if hit:
+            self.iters.append(_IterUse(hit[0], line,
+                                       self.lock_depth > 0, hit[1]))
+
+    def visit_For(self, node):
+        self._note_iter(node.iter, node.lineno)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        self._note_iter(node.iter, getattr(node.iter, "lineno", 0))
+        self.generic_visit(node)
+
+    # ------------------------------------------------- blocking calls
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id in _ITER_CALLS \
+                and len(node.args) == 1:
+            self._note_iter(node.args[0], node.lineno)
+        if self.lock_depth > 0:
+            self._check_blocking(node)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node):
+        cn = call_name(node)
+        d = dotted(node.func)
+        msg = hint = None
+        if (isinstance(node.func, ast.Attribute) and cn == "get"
+                and not node.args and keyword(node, "timeout") is None
+                and keyword(node, "block") is None):
+            msg = "blocking `.get()` (no timeout) while holding a lock"
+            hint = ("use get(timeout=...) / get_nowait() outside the "
+                    "lock — every other thread stalls on this lock "
+                    "while the queue is empty")
+        elif cn in ("device_put", "block_until_ready"):
+            msg = f"device sync `{cn}(...)` while holding a lock"
+            hint = ("move the transfer/sync outside the critical "
+                    "section; hold the lock only around the bookkeeping")
+        elif d == "time.sleep" or (isinstance(node.func, ast.Name)
+                                   and cn == "sleep"):
+            msg = "`sleep` while holding a lock"
+            hint = "sleep outside the critical section"
+        elif isinstance(node.func, ast.Name) and cn == "open":
+            msg = "file I/O `open(...)` while holding a lock"
+            hint = ("snapshot under the lock, do the I/O outside it")
+        elif cn in ("urlopen", "urlretrieve"):
+            msg = f"network I/O `{cn}(...)` while holding a lock"
+            hint = "never hold a lock across the network"
+        if msg:
+            self.blocking.append(Finding(
+                self.checker.rule, self.ctx.relpath, node.lineno,
+                msg, hint))
+
+    # don't descend into nested scopes whose bodies run later (a def
+    # under a with-block does not execute under that lock)
+    def visit_FunctionDef(self, node):
+        saved, self.lock_depth = self.lock_depth, 0
+        self.generic_visit(node)
+        self.lock_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        saved, self.lock_depth = self.lock_depth, 0
+        self.generic_visit(node)
+        self.lock_depth = saved
+
+    def visit_ClassDef(self, node):
+        pass    # a nested class is analyzed as its own scope
+
+
+@register
+class LockDisciplineChecker:
+    rule = "lock-discipline"
+    description = ("no blocking calls while holding a lock; no "
+                   "iteration over shared deques/dicts outside their "
+                   "lock (the PR-6 'deque mutated during iteration' "
+                   "race)")
+
+    def check_file(self, ctx) -> List[Finding]:
+        # cheap pre-filter: both sub-rules require a with-lock block
+        low = ctx.source.lower()
+        if "lock" not in low and "cond" not in low:
+            return []
+        tree = ctx.tree
+        out: List[Finding] = []
+        # per-class scopes
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_scope(
+                    ctx, node.body, _self_attr, f"self.{{}}",
+                    scope_name=node.name))
+        # module scope: module-global containers + module-global lock
+        mod_nodes = [n for n in tree.body
+                     if not isinstance(n, ast.ClassDef)]
+
+        def _global_name(t):
+            return t.id if isinstance(t, ast.Name) else None
+
+        out.extend(self._check_scope(ctx, mod_nodes, _global_name,
+                                     "{}", scope_name="<module>"))
+        return sorted(out, key=lambda f: f.line)
+
+    def _check_scope(self, ctx, body_nodes, attr_of, fmt,
+                     scope_name) -> List[Finding]:
+        shared = _collect_shared(body_nodes, attr_of,
+                                 descend_functions=scope_name != "<module>")
+        visitor = _ScopeVisitor(self, ctx, shared, attr_of)
+        for n in body_nodes:
+            visitor.visit(n)
+        out: List[Finding] = []
+        if shared.locks:
+            # deques: any unlocked iteration is the PR-6 race
+            for use in visitor.iters:
+                if use.kind == "deque" and not use.under_lock:
+                    out.append(Finding(
+                        self.rule, ctx.relpath, use.line,
+                        f"iteration over shared deque "
+                        f"`{fmt.format(use.attr)}` outside its lock "
+                        "('deque mutated during iteration' — the PR-6 "
+                        "/debug/perf race)",
+                        "copy under the lock first: `with <lock>: "
+                        f"snap = list({fmt.format(use.attr)})`"))
+            # dicts: flag unlocked iteration only when the same attr is
+            # iterated under the lock elsewhere (evidence it's shared)
+            locked_dicts = {u.attr for u in visitor.iters
+                            if u.kind == "dict" and u.under_lock}
+            for use in visitor.iters:
+                if (use.kind == "dict" and not use.under_lock
+                        and use.attr in locked_dicts):
+                    out.append(Finding(
+                        self.rule, ctx.relpath, use.line,
+                        f"iteration over shared dict "
+                        f"`{fmt.format(use.attr)}` outside the lock it "
+                        "is iterated under elsewhere (concurrent "
+                        "mutation ⇒ RuntimeError mid-iteration)",
+                        "copy under the lock first: `with <lock>: "
+                        f"snap = dict({fmt.format(use.attr)})`"))
+        # blocking-under-lock findings don't need a known lock attr —
+        # the with-statement itself is the evidence
+        out.extend(visitor.blocking)
+        return out

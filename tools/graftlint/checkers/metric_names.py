@@ -1,0 +1,186 @@
+"""metric-names: Prometheus naming conventions on every instrument
+creation (migrated from the original ``tools/check_metric_names.py``,
+which is now a thin CLI shim over this module).
+
+Rules (on every ``.counter("name", ...)`` / ``.gauge(...)`` /
+``.histogram(...)`` call whose name is a string literal):
+
+- names match ``dl4j_[a-z0-9_]+`` (the namespace prefix; lowercase snake)
+- counters end in ``_total``; nothing else may end in ``_total``
+- histograms carry a unit suffix (``_seconds`` / ``_bytes`` / ``_ratio``/
+  ``_us`` / ``_norm``) — except two grandfathered dimensionless series
+  from PR 2
+- a non-empty description (HELP text) is provided
+- label names are lowercase snake (``[a-z][a-z0-9_]*``)
+- **label cardinality**: a ``.labels(tenant=...)`` binding must pass a
+  string literal or a value produced by the bounded ``tenant_label``
+  helper (``resilience/qos.py``) — never a raw request string
+
+AST-based: variables passed as names are skipped — the conventions bind
+the literal registration sites, which is where new series are born.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, NamedTuple, Optional
+
+from .. import Finding, register
+
+NAME_RE = re.compile(r"^dl4j_[a-z0-9]+(_[a-z0-9]+)*$")
+LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_ratio", "_us", "_norm")
+
+#: dimensionless 0..1 histograms that predate this lint; new fraction
+#: metrics must use ``_ratio``
+GRANDFATHERED = frozenset({
+    "dl4j_inference_batch_occupancy",
+    "dl4j_inference_bucket_fill",
+})
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    metric: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.metric}: {self.message}"
+
+
+def _const_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _label_names(call: ast.Call):
+    """Literal label-name strings from the 3rd positional arg or the
+    ``label_names=`` keyword (non-literal containers are skipped)."""
+    node = None
+    if len(call.args) >= 3:
+        node = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "label_names":
+            node = kw.value
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return []
+    return [s for s in (_const_str(e) for e in node.elts) if s is not None]
+
+
+def _description(call: ast.Call) -> Optional[str]:
+    if len(call.args) >= 2:
+        return _const_str(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "description":
+            return _const_str(kw.value)
+    return None
+
+
+def _is_tenant_label_call(node) -> bool:
+    """``tenant_label(...)`` / ``<anything>.tenant_label(...)`` — the
+    bounded-cardinality helper the ``{tenant}`` label must route
+    through."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else None)
+    return name == "tenant_label"
+
+
+def check_tree(tree, path: str = "<string>") -> List[Violation]:
+    """All metric-convention violations in an already-parsed module
+    (graftlint hands every checker the same shared parse)."""
+    out: List[Violation] = []
+    # the helper's home module is the ONE place allowed to bind an
+    # already-bounded label variable directly (every tenant series is
+    # born there); everywhere else must call tenant_label at the site
+    in_qos_module = path.replace(os.sep, "/").endswith(
+        "resilience/qos.py")
+    for node in ast.walk(tree):
+        if (not in_qos_module and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"):
+            for kw in node.keywords:
+                if kw.arg != "tenant":
+                    continue
+                if (_const_str(kw.value) is None
+                        and not _is_tenant_label_call(kw.value)):
+                    out.append(Violation(
+                        path, node.lineno, "{tenant}",
+                        "tenant label values must be string literals "
+                        "or routed through the bounded tenant_label() "
+                        "helper (resilience/qos.py) — raw request "
+                        "strings are unbounded cardinality"))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _FACTORIES and node.args):
+            continue
+        name = _const_str(node.args[0])
+        if name is None or not name:        # dynamic name: out of scope
+            continue
+        kind = node.func.attr
+
+        def bad(msg):
+            out.append(Violation(path, node.lineno, name, msg))
+
+        if not NAME_RE.match(name):
+            bad("must match dl4j_[a-z0-9_]+ (namespace prefix, "
+                "lowercase snake)")
+        if kind == "counter" and not name.endswith("_total"):
+            bad("counters must end in _total")
+        if kind != "counter" and name.endswith("_total"):
+            bad(f"_total is reserved for counters (this is a {kind})")
+        if (kind == "histogram" and name not in GRANDFATHERED
+                and not name.endswith(UNIT_SUFFIXES)):
+            bad("histograms need a unit suffix "
+                f"({'/'.join(UNIT_SUFFIXES)})")
+        desc = _description(node)
+        if desc is not None and not desc.strip():
+            bad("empty description (HELP text)")
+        for label in _label_names(node):
+            if not LABEL_RE.match(label):
+                bad(f"label {label!r} must be lowercase snake")
+    return out
+
+
+def check_source(source: str, path: str = "<string>") -> List[Violation]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "<parse>", str(e))]
+    return check_tree(tree, path)
+
+
+def check_package(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                out.extend(check_source(f.read(), path))
+    return out
+
+
+@register
+class MetricNamesChecker:
+    rule = "metric-names"
+    description = ("Prometheus conventions at every literal instrument "
+                   "registration (dl4j_ prefix, _total counters, unit "
+                   "suffixes, bounded tenant labels)")
+
+    def check_file(self, ctx) -> List[Finding]:
+        return [Finding(self.rule, ctx.relpath, v.line,
+                        f"{v.metric}: {v.message}",
+                        "see tools/check_metric_names.py docstring for "
+                        "the full conventions")
+                for v in check_tree(ctx.tree, ctx.relpath)]

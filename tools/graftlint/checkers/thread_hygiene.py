@@ -1,0 +1,115 @@
+"""thread-hygiene: every thread started in package code is daemon or
+joined on a shutdown path.
+
+A non-daemon, never-joined thread keeps the process alive after main
+exits (the classic "test suite hangs at the end" failure) and hides
+shutdown-ordering bugs.  Accepted spellings:
+
+- ``threading.Thread(..., daemon=True)`` (or ``daemon=<expr>`` — an
+  explicit choice is an audited choice),
+- the assigned name/attribute gets ``.daemon = True`` before start, or
+- the assigned name/attribute is ``.join()``-ed somewhere in the same
+  file (shutdown paths live next to their spawn sites in this repo).
+
+Threads created inside list literals/comprehensions are accepted when
+the file ``.join()``s anything (worker-pool idiom: spawn list, join
+loop).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import Finding, register
+from ..astutil import dotted, keyword, parent_map
+
+
+def _is_thread_call(node: ast.Call) -> bool:
+    d = dotted(node.func)
+    return d == "threading.Thread" or d == "Thread"
+
+
+def _target_key(t) -> Optional[str]:
+    """Assignment target as a matchable key: ``t`` -> ``t``,
+    ``self._worker`` -> ``._worker`` (matched by attr name anywhere)."""
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute):
+        return "." + t.attr
+    return None
+
+
+def _expr_key(e) -> Optional[str]:
+    if isinstance(e, ast.Name):
+        return e.id
+    if isinstance(e, ast.Attribute):
+        return "." + e.attr
+    return None
+
+
+@register
+class ThreadHygieneChecker:
+    rule = "thread-hygiene"
+    description = ("every threading.Thread is daemon= or .join()-ed on "
+                   "a shutdown path")
+
+    def check_file(self, ctx) -> List[Finding]:
+        if "Thread(" not in ctx.source:          # cheap pre-filter
+            return []
+        tree = ctx.tree
+        thread_calls = [n for n in ast.walk(tree)
+                        if isinstance(n, ast.Call) and _is_thread_call(n)]
+        if not thread_calls:
+            return []
+        parents = parent_map(tree)
+
+        joined_keys = set()
+        daemon_keys = set()
+        any_join = False
+        for n in ast.walk(tree):
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "join"):
+                any_join = True
+                k = _expr_key(n.func.value)
+                if k:
+                    joined_keys.add(k)
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        k = _expr_key(t.value)
+                        if k:
+                            daemon_keys.add(k)
+
+        out: List[Finding] = []
+        for call in thread_calls:
+            if keyword(call, "daemon") is not None:
+                continue            # explicit daemon choice
+            parent = parents.get(call)
+            key = None
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+                key = _target_key(parent.targets[0])
+            if key is not None:
+                if key in daemon_keys or key in joined_keys:
+                    continue
+            else:
+                # list/comprehension worker-pool idiom: accept when the
+                # file joins anything
+                in_pool = False
+                p = parent
+                while p is not None:
+                    if isinstance(p, (ast.List, ast.ListComp, ast.Tuple,
+                                      ast.GeneratorExp)):
+                        in_pool = True
+                        break
+                    p = parents.get(p)
+                if in_pool and any_join:
+                    continue
+            out.append(Finding(
+                self.rule, ctx.relpath, call.lineno,
+                "thread is neither daemon nor joined on any shutdown "
+                "path in this file — it can outlive main and hang "
+                "process exit",
+                "pass daemon=True, or keep a handle and .join() it in "
+                "the shutdown/close path"))
+        return out

@@ -1,0 +1,133 @@
+"""typed-errors: the resilience/serving/parallel trees speak the typed
+error taxonomy, not bare RuntimeError/Exception.
+
+Two patterns, both bug classes this repo has re-fixed repeatedly:
+
+- ``raise RuntimeError(...)`` / ``raise Exception(...)`` in
+  ``resilience/``, ``serving/``, ``parallel/`` — callers dispatch on
+  the typed taxonomy (ShedError/DeadlineExceeded/CircuitOpenError/...),
+  and an untyped raise turns a shed into an unexplained 500.
+- ``except Exception`` (or bare ``except:``) in those trees that can
+  swallow a typed outcome: the exactly-once machinery depends on every
+  request resolving typed-or-correct through ``_Request.claim()``.  A
+  broad handler is accepted when a PRECEDING clause in the same ``try``
+  catches the taxonomy (``except ShedError: raise``), when the handler
+  re-raises, or when it resolves the request (``claim``/``_fail``/
+  ``_shed_request``/``_error``).  Module-level import guards are out of
+  scope.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import Finding, register
+from ..astutil import call_name, enclosing_functions, names_in, walk_scope
+
+#: package subtrees where the taxonomy is load-bearing
+TREES = frozenset({"resilience", "serving", "parallel"})
+
+#: the typed taxonomy (resilience/policy.py + qos/generation subclasses)
+#: — a preceding except clause naming any of these shields a later
+#: broad handler
+TYPED_NAMES = frozenset({
+    "TransientError", "DeadlineExceeded", "ShedError", "CircuitOpenError",
+    "ShutdownError", "RestartBudgetExhausted", "QuotaExceeded",
+    "PreemptedError", "StreamCancelled", "CachePagesExhausted",
+    "HostLostError", "_TYPED_OUTCOMES", "TYPED_OUTCOMES",
+})
+
+#: handler calls that RESOLVE the caught error instead of swallowing it
+#: (exactly-once resolution paths: _Request.claim() and its wrappers —
+#: _fail/_fail_request/_fail_all, _shed_request, the front door's
+#: _error response writer)
+RESOLVER_PREFIXES = ("_fail", "_shed", "_resolve")
+RESOLVER_NAMES = frozenset({"claim", "_error"})
+
+_BROAD = frozenset({"Exception", "BaseException"})
+_UNTYPED_RAISES = frozenset({"RuntimeError", "Exception"})
+
+
+def _in_tree(relpath: str) -> bool:
+    return bool(TREES.intersection(relpath.split("/")[:-1]))
+
+
+def _handler_is_ok(handler: ast.ExceptHandler) -> bool:
+    for n in walk_scope(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            cn = call_name(n)
+            if cn and (cn in RESOLVER_NAMES or "claim" in cn
+                       or cn.startswith(RESOLVER_PREFIXES)):
+                return True
+    return False
+
+
+@register
+class TypedErrorsChecker:
+    rule = "typed-errors"
+    description = ("no bare RuntimeError/Exception raises and no "
+                   "taxonomy-swallowing broad excepts in resilience/, "
+                   "serving/, parallel/")
+
+    def check_file(self, ctx) -> List[Finding]:
+        if not _in_tree(ctx.relpath):
+            return []
+        tree = ctx.tree
+        out: List[Finding] = []
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Raise):
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call) and isinstance(exc.func,
+                                                            ast.Name):
+                    name = exc.func.id
+                elif isinstance(exc, ast.Name):
+                    name = exc.id
+                if name in _UNTYPED_RAISES:
+                    out.append(Finding(
+                        self.rule, ctx.relpath, node.lineno,
+                        f"bare `raise {name}` in a {self._tree(ctx)} "
+                        "path — callers dispatch on the typed taxonomy",
+                        "raise a typed error (resilience/policy.py "
+                        "taxonomy or a domain subclass of "
+                        "RuntimeError)"))
+
+        enclosing = enclosing_functions(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            shielded = False
+            for handler in node.handlers:
+                mentioned = (set(names_in(handler.type))
+                             if handler.type is not None else set())
+                broad = handler.type is None or bool(mentioned & _BROAD)
+                # only a PRECEDING taxonomy clause shields — a handler
+                # like `except (ShedError, Exception):` names the
+                # taxonomy AND swallows it, which is the bug itself
+                prev_shielded = shielded
+                if mentioned & TYPED_NAMES:
+                    shielded = True
+                if not broad:
+                    continue
+                if enclosing.get(handler) is None:
+                    continue        # module-level import guard idiom
+                if prev_shielded or _handler_is_ok(handler):
+                    continue
+                out.append(Finding(
+                    self.rule, ctx.relpath, handler.lineno,
+                    "broad `except` can swallow the typed ShedError "
+                    "taxonomy the exactly-once machinery depends on",
+                    "catch-and-re-raise the taxonomy first (`except "
+                    "ShedError: raise`), re-raise, or resolve via "
+                    "_Request.claim()/_fail()"))
+        return out
+
+    @staticmethod
+    def _tree(ctx) -> str:
+        for part in ctx.relpath.split("/"):
+            if part in TREES:
+                return part
+        return "package"

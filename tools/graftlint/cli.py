@@ -1,0 +1,80 @@
+"""graftlint CLI — ``python -m tools.graftlint [options]``.
+
+Exit code = number of NEW findings (violations neither inline-disabled
+nor frozen in the baseline), capped at 100.  ``--baseline-update``
+refreezes the current findings and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import (all_checkers, default_baseline_path, default_package_root,
+               run_lint, write_baseline)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="repo-wide static analysis encoding this codebase's "
+                    "hard-won invariants")
+    p.add_argument("--root", default=None,
+                   help="directory to scan (default: the "
+                        "deeplearning4j_tpu package)")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="RULE",
+                   help="run only this rule (repeatable, or "
+                        "comma-separated)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: "
+                        "tools/graftlint_baseline.json)")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="freeze the current findings as the new "
+                        "baseline and exit 0")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report every finding)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="findings only, no summary line")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for c in sorted(all_checkers(), key=lambda c: c.rule):
+            print(f"{c.rule:18s} {c.description}")
+        return 0
+
+    rules = None
+    if args.rule:
+        rules = [r.strip() for spec in args.rule for r in spec.split(",")
+                 if r.strip()]
+
+    if args.baseline_update:
+        n = write_baseline(root=args.root, rules=rules,
+                           baseline_path=args.baseline)
+        print(f"graftlint: baseline updated "
+              f"({args.baseline or default_baseline_path()}): "
+              f"{n} frozen finding(s)")
+        return 0
+
+    try:
+        res = run_lint(root=args.root, rules=rules,
+                       baseline_path=(os.devnull if args.no_baseline
+                                      else args.baseline))
+    except ValueError as e:            # unknown --rule
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    for f in res.new:
+        print(f)
+    if not args.quiet:
+        root = args.root or default_package_root()
+        verdict = "OK" if not res.new else f"{len(res.new)} NEW finding(s)"
+        print(f"graftlint: {verdict} — {res.files} files, "
+              f"{len(res.baselined)} baselined, {res.suppressed} "
+              f"suppressed, {res.seconds:.2f}s under {root}")
+    return min(len(res.new), 100)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
