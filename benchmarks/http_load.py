@@ -63,10 +63,12 @@ TYPED_CODES = (429, 503, 504)
 
 # ------------------------------------------------------------ HTTP client
 def _post(addr: str, path: str, doc: dict, timeout: float = 30.0,
-          tenant: str = None):
+          tenant: str = None, idem_key: str = None):
     headers = {"Content-Type": "application/json"}
     if tenant is not None:
         headers["X-Dl4j-Tenant"] = tenant
+    if idem_key is not None:
+        headers["X-Dl4j-Idempotency-Key"] = idem_key
     req = urllib.request.Request(
         addr + path, data=json.dumps(doc).encode(), headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -78,13 +80,17 @@ def _get(addr: str, path: str, timeout: float = 10.0):
         return r.status, json.loads(r.read())
 
 
-def _sse_generate(addr: str, doc: dict, timeout: float = 60.0):
+def _sse_generate(addr: str, doc: dict, timeout: float = 60.0,
+                  idem_key: str = None):
     """POST a streaming generate; returns (tokens, first_token_s,
     total_s, done_payload)."""
+    headers = {"Content-Type": "application/json"}
+    if idem_key is not None:
+        headers["X-Dl4j-Idempotency-Key"] = idem_key
     req = urllib.request.Request(
         addr + "/v1/generate",
         data=json.dumps(dict(doc, stream=True)).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=headers)
     t0 = time.perf_counter()
     toks, first_at, done = [], None, None
     with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -581,6 +587,456 @@ def _kill_drill(store, addr: str, args) -> dict:
     }
 
 
+# ----------------------------------------------------------- fleet chaos
+_STAGE_RANK = {"canary": 1, "ramp": 2, "full": 3}
+
+
+def _chaos_load(addr: str, rng, qps: float, duration_s: float,
+                stats: "_Stats", prompt_len: int = 7,
+                max_new_cap: int = 16):
+    """The fleet-chaos load: like :func:`run_load` but EVERY request
+    carries a unique idempotency key and a connection-level death gets
+    ONE retry **with the same key** — through the proxy's failover the
+    retry lands on a survivor and the worker-side journal guarantees it
+    replays rather than re-executes. The drill audits exactly that."""
+    threads = []
+    t_end = time.monotonic() + duration_s
+
+    def one(kind: str, n_new: int, seed: int, x):
+        key = f"fc-{seed}"
+        t0 = time.perf_counter()
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                if kind == "classify":
+                    _post(addr, "/v1/classify",
+                          {"inputs": [x], "request_key": seed},
+                          timeout=30.0, idem_key=key)
+                else:
+                    _post(addr, "/v1/generate",
+                          {"prompt": [1 + seed % 50] * prompt_len,
+                           "max_new_tokens": n_new, "request_key": seed},
+                          timeout=30.0, idem_key=key)
+                stats.add(kind, time.perf_counter() - t0, "ok")
+                return
+            except urllib.error.HTTPError as e:
+                stats.add(kind, 0.0,
+                          "typed" if e.code in TYPED_CODES else "failed",
+                          detail=f"{kind}: HTTP {e.code}")
+                return
+            except Exception as e:
+                if attempts <= 1:
+                    with stats.lock:
+                        stats.conn_retries += 1
+                    continue
+                stats.add(kind, 0.0, "failed", detail=f"{kind}: {e!r}")
+                return
+
+    i = 0
+    while time.monotonic() < t_end:
+        gap = rng.expovariate(qps) if qps > 0 else 0.0
+        time.sleep(min(gap, 1.0))
+        u = rng.random()
+        kind = "classify" if u < 0.7 else "generate"
+        n_new = min(max_new_cap, max(2, int(2 * rng.paretovariate(1.5))))
+        x = [round(rng.uniform(0, 1), 6) for _ in range(4)]
+        t = threading.Thread(target=one, args=(kind, n_new, i, x),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        i += 1
+    for t in threads:
+        t.join(timeout=90.0)
+
+
+class _StageSampler:
+    """Polls the shared store: the rollout stage sequence (must never
+    move backward) and the leader (worker, term) sequence (terms must be
+    strictly monotonic, and every history event's term non-decreasing)."""
+
+    def __init__(self, store):
+        self._store = store
+        self.stages = []
+        self.leaders = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                doc = self._store.read()
+            except Exception:
+                self._stop.wait(0.2)
+                continue
+            lane = (doc.get("lanes") or {}).get("scoring") or {}
+            stage = (lane.get("rollout") or {}).get("stage")
+            if stage is not None and (not self.stages
+                                      or self.stages[-1] != stage):
+                self.stages.append(stage)
+            led = doc.get("leader") or {}
+            cur = (led.get("worker"), int(led.get("term", 0)))
+            if led and (not self.leaders or self.leaders[-1] != cur):
+                self.leaders.append(cur)
+            self._stop.wait(0.2)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def stage_regressed(self) -> bool:
+        ranks = [_STAGE_RANK.get(s) for s in self.stages]
+        if "rolled_back" in self.stages:
+            return True          # nothing in this drill should roll back
+        ranks = [r for r in ranks if r is not None]
+        return any(b < a for a, b in zip(ranks, ranks[1:]))
+
+    def terms_monotonic(self) -> bool:
+        """STRICTLY increasing across leadership changes: two leaders
+        sharing one term (the exact fence failure this drill exists to
+        catch) must fail, so ``>=`` would be wrong here. A corruption
+        rebuild's ``{"worker": None}`` carry-forward record is term
+        CONTINUITY (no one leads), not a transition — filtered out."""
+        seq = []
+        for w, t in self.leaders:
+            if w is None:
+                continue
+            if not seq or seq[-1] != (w, t):
+                seq.append((w, t))
+        terms = [t for _, t in seq]
+        return all(b > a for a, b in zip(terms, terms[1:]))
+
+
+def run_fleet_chaos(args, rng) -> dict:
+    """The graded fleet chaos drill: a 3-worker fleet under seeded load
+    while the drill (1) SIGSTOPs the LEADER past the worker TTL then
+    SIGCONTs it — the lease must move with a term bump, the woken
+    ex-leader must demote at write time, and no stale-term write may
+    land; (2) SIGKILLs a non-leader worker mid-stream — the proxy fails
+    over with the idempotency key, the parent respawns it; (3) corrupts
+    the store document once — it must be quarantined and rebuilt from
+    the workers' mirrors; (4) injects store.read/store.write faults in
+    every worker for the whole run. Graded: goodput >= 90%, ZERO
+    duplicate executions (audited via the per-worker idempotency
+    journals), leader terms strictly monotonic, rollout stage never
+    regresses."""
+    state_dir = args.state_dir or f"/tmp/dl4j-fleet-chaos-{os.getpid()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the whole run breathes injected store faults (seeded per process)
+    env["DL4J_TPU_FAULTS"] = args.fleet_faults
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve.py"),
+         "--workers", "3", "--port", "0", "--state-dir", state_dir,
+         "--slots", str(args.slots)],
+        stdout=subprocess.PIPE, text=True, env=env)
+    store = _fleet_store(state_dir)
+    sampler = None
+    try:
+        fleet = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("tools/serve.py exited before "
+                                   "announcing the fleet")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "fleet" in doc:
+                fleet = doc
+                break
+        if fleet is None:
+            raise RuntimeError("fleet announce line never arrived")
+        addr = fleet["address"]
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                _get(addr, "/debug/frontdoor", timeout=5.0)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet never answered")
+                time.sleep(0.5)
+        # shared canary under load: its stage trajectory is one of the
+        # graded invariants (forward-only). Retried: the workers run
+        # with store faults armed, so the admin write itself may eat an
+        # injected fault (500) a beat or two
+        for _ in range(8):
+            try:
+                code, _body = _post(addr, "/admin/rollout", {
+                    "lane": "scoring", "candidate": "v2",
+                    "policy": {
+                        "window_seconds": max(0.5, args.duration_s / 12),
+                        "window_min_requests": 4, "healthy_windows": 1,
+                        "canary_fraction": 0.3,
+                        "ramp_fractions": [0.6]}})
+                if code == 200:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        sampler = _StageSampler(store)
+        stats = _Stats()
+        load = threading.Thread(
+            target=_chaos_load,
+            args=(addr, rng, args.qps, args.duration_s, stats),
+            daemon=True)
+        load.start()
+
+        chaos: dict = {"corruptions": 0}
+
+        def run_chaos():
+            d = args.duration_s
+            # --- SIGSTOP the leader past TTL, then SIGCONT
+            time.sleep(d * 0.2)
+            doc = store.read()
+            leader = ((doc.get("leader") or {}).get("worker")
+                      or (min(doc.get("workers") or {"w0": 0})))
+            pid = int(((doc.get("workers") or {}).get(leader) or {})
+                      .get("pid", 0))
+            chaos["paused_leader"] = leader
+            if pid:
+                os.kill(pid, signal.SIGSTOP)
+                time.sleep(args.pause_s)
+                os.kill(pid, signal.SIGCONT)
+                chaos["pause_s"] = args.pause_s
+            # --- SIGKILL a non-leader worker MID-STREAM: pin several
+            # long SSE generations in flight first (round-robin puts
+            # some on the victim); their connection-level deaths retry
+            # with the SAME idempotency key through the proxy
+            time.sleep(d * 0.15)
+
+            def one_stream(k: int):
+                key = f"fcs-{k}"
+                t0 = time.perf_counter()
+                for attempt in (1, 2):
+                    try:
+                        _, _, _, done = _sse_generate(
+                            addr, {"prompt": [1 + k, 2, 3],
+                                   "max_new_tokens": 40,
+                                   "request_key": ("fcs", k)},
+                            timeout=60.0, idem_key=key)
+                        if done is None:
+                            # killed mid-stream: connection-close SSE
+                            # framing makes a dead worker look like a
+                            # clean (truncated) end — no terminal event
+                            # = a connection-level death, retry by key
+                            raise OSError("stream truncated (no done "
+                                          "event)")
+                        stats.add("stream",
+                                  time.perf_counter() - t0, "ok")
+                        return
+                    except urllib.error.HTTPError as e:
+                        stats.add("stream", 0.0,
+                                  "typed" if e.code in TYPED_CODES
+                                  else "failed",
+                                  detail=f"stream: HTTP {e.code}")
+                        return
+                    except Exception as e:
+                        if attempt == 1:
+                            with stats.lock:
+                                stats.conn_retries += 1
+                            continue
+                        stats.add("stream", 0.0, "failed",
+                                  detail=f"stream: {e!r}")
+                        return
+
+            streamers = [threading.Thread(target=one_stream, args=(k,),
+                                          daemon=True)
+                         for k in range(6)]
+            for t in streamers:
+                t.start()
+            time.sleep(0.15)         # streams are mid-flight NOW
+            doc = store.read()
+            leader = (doc.get("leader") or {}).get("worker")
+            victims = [w for w in sorted(doc.get("workers") or {})
+                       if w != leader and w != chaos.get("paused_leader")]
+            victim = (victims or [w for w in sorted(
+                doc.get("workers") or {}) if w != leader])[-1]
+            vpid = int(doc["workers"][victim]["pid"])
+            chaos["killed_worker"] = victim
+            chaos["killed_pid"] = vpid
+            os.kill(vpid, signal.SIGKILL)
+            for t in streamers:
+                t.join(timeout=60.0)
+            # --- corrupt the store document once (disk fault); retry
+            # the scribble until a reader actually quarantined it (an
+            # in-flight atomic writer may immediately replace garbage
+            # that nobody ever read)
+            time.sleep(d * 0.2)
+            state_file = os.path.join(state_dir, "state.json")
+            for _ in range(4):
+                try:
+                    with open(state_file, "w") as f:
+                        f.write('{"rev": "garbage", "workers": [')
+                except OSError:
+                    break
+                time.sleep(1.0)
+                quarantined = [fn for fn in os.listdir(state_dir)
+                               if fn.startswith("state.json.corrupt.")]
+                if quarantined:
+                    chaos["corruptions"] = len(quarantined)
+                    break
+
+        chaos_thread = threading.Thread(target=run_chaos, daemon=True)
+        chaos_thread.start()
+        load.join(timeout=args.duration_s + 180)
+        chaos_thread.join(timeout=60)
+        # settle: wait for the parent's respawn of the killed worker to
+        # register (its demo deploys may still be warming when the load
+        # window closes)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                rec_w = ((store.read().get("workers") or {})
+                         .get(chaos.get("killed_worker")) or {})
+            except Exception:
+                rec_w = {}
+            if (rec_w.get("port")
+                    and int(rec_w.get("pid", 0)) != chaos.get("killed_pid")
+                    and time.time() - float(rec_w.get("heartbeat", 0))
+                    <= 3.0):
+                break
+            time.sleep(0.5)
+        sampler.stop()
+        # ---------------------------------------------------- the audit
+        doc = store.read()
+        _killed_rec = ((doc.get("workers") or {})
+                       .get(chaos.get("killed_worker")) or {})
+        respawned = bool(
+            _killed_rec.get("port")
+            and int(_killed_rec.get("pid", 0)) != chaos.get("killed_pid"))
+        duplicate_execs = 0
+        demotions = 0
+        replays = 0
+        rebuilds = 0
+        per_worker = {}
+        audited_all = True
+        executed_on: dict = {}       # key -> live workers that executed it
+        for w, rec in sorted((doc.get("workers") or {}).items()):
+            port = rec.get("port")
+            if not port:
+                continue
+            # the workers run with store.read faults armed — a single
+            # fetch can 500 on an injected blip; retry before giving
+            # up, and an UNAUDITED worker fails the verdict (its
+            # journal could hide the duplicate the drill exists to
+            # catch — 'unreachable' must never grade green)
+            fl = err = None
+            for _ in range(6):
+                try:
+                    _, fl = _get(f"http://127.0.0.1:{port}",
+                                 "/debug/fleet", timeout=10.0)
+                    break
+                except Exception as e:
+                    err = e
+                    time.sleep(0.5)
+            if fl is None:
+                per_worker[w] = f"unreachable: {err!r}"
+                audited_all = False
+                continue
+            idem = fl.get("idempotency") or {}
+            duplicate_execs += int(idem.get("duplicate_executions", 0))
+            replays += int(idem.get("replays", 0))
+            for key, e in (idem.get("entries") or {}).items():
+                if int(e.get("executions", 0)) > 0:
+                    executed_on.setdefault(key, set()).add(w)
+            for d_ in fl.get("frontdoors") or ():
+                fence = ((d_.get("shared") or {}).get("fence") or {})
+                demotions += int(fence.get("demotions", 0))
+                rebuilds += int(fence.get("rebuilds", 0))
+            per_worker[w] = {
+                "journal_size": idem.get("size"),
+                "duplicate_executions": idem.get(
+                    "duplicate_executions"),
+                "replays": idem.get("replays"),
+            }
+        # cross-worker half of the audit: one key executed in TWO live
+        # journals is a duplicate the per-worker counts cannot see (the
+        # killed worker's pre-death execution died with its journal and
+        # is correctly not counted — nothing it charged survives)
+        cross_dups = sum(len(ws) - 1 for ws in executed_on.values()
+                         if len(ws) > 1)
+        duplicate_execs += cross_dups
+        history = doc.get("history") or []
+        hist_terms = [e.get("term") for e in history
+                      if e.get("term") is not None]
+        terms_monotonic = (
+            sampler.terms_monotonic()
+            and all(b >= a for a, b in zip(hist_terms, hist_terms[1:])))
+        stage_regressed = sampler.stage_regressed()
+        total = stats.ok + stats.typed + stats.failed
+        goodput_ratio = (stats.ok / total) if total else None
+        all_lat = [v for xs in stats.lat.values() for v in xs]
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+        lane = (doc.get("lanes") or {}).get("scoring") or {}
+        rec = {
+            "metric": "fleet_chaos",
+            "platform": platform,
+            "value": goodput_ratio,
+            "unit": "goodput_ratio",
+            "goodput_ratio": (round(goodput_ratio, 4)
+                              if goodput_ratio is not None else None),
+            "requests": total,
+            "ok": stats.ok,
+            "typed": stats.typed,
+            "failed": stats.failed,
+            "conn_retries": stats.conn_retries,
+            "failures": stats.failures,
+            "p50_ms": (round(_quantile(all_lat, 0.5) * 1e3, 3)
+                       if all_lat else None),
+            "p99_ms": (round(_quantile(all_lat, 0.99) * 1e3, 3)
+                       if all_lat else None),
+            "duplicate_executions": duplicate_execs,
+            "cross_worker_duplicates": cross_dups,
+            "double_charges": duplicate_execs,
+            "idempotent_replays": replays,
+            "terms_monotonic": terms_monotonic,
+            "leader_sequence": sampler.leaders,
+            "history_terms": hist_terms,
+            "demotions": demotions,
+            "stage_regressed": stage_regressed,
+            "stage_sequence": sampler.stages,
+            "final_stage": (lane.get("rollout") or {}).get("stage"),
+            "final_primary": lane.get("primary"),
+            "corruptions": chaos.get("corruptions", 0),
+            "rebuilds": rebuilds,
+            "proxy": doc.get("proxy"),
+            "paused_leader": chaos.get("paused_leader"),
+            "pause_s": chaos.get("pause_s"),
+            "killed_worker": chaos.get("killed_worker"),
+            "respawned": respawned,
+            "per_worker": per_worker,
+            "fleet_faults": args.fleet_faults,
+            "workers": 3,
+            "qps": args.qps,
+            "duration_s": args.duration_s,
+            "seed": args.seed,
+        }
+        rec["audited_all_workers"] = audited_all
+        rec["ok_verdict"] = bool(
+            goodput_ratio is not None and goodput_ratio >= 0.90
+            and duplicate_execs == 0 and audited_all
+            and terms_monotonic and not stage_regressed
+            and chaos.get("corruptions", 0) >= 1
+            and demotions >= 1 and respawned)
+        return rec
+    finally:
+        if sampler is not None:
+            sampler.stop()
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 # ----------------------------------------------------------------- record
 def _record(args, stats: "_Stats", stream: dict, vs_direct, workers,
             kill_drill, rollout=None) -> dict:
@@ -659,12 +1115,32 @@ def main(argv=None) -> int:
     ap.add_argument("--flood-factor", type=float, default=10.0)
     ap.add_argument("--victim-qps", type=float, default=6.0,
                     help="per-victim steady request rate (QoS drill)")
+    ap.add_argument("--fleet-chaos", action="store_true",
+                    help="the graded 3-worker chaos drill: SIGSTOP the "
+                         "leader past TTL, SIGKILL a worker mid-stream, "
+                         "corrupt the store doc once, store faults "
+                         "throughout; archives FLEET_r*.json")
+    ap.add_argument("--pause-s", type=float, default=4.5,
+                    help="fleet-chaos leader SIGSTOP duration (must "
+                         "exceed the 3 s worker TTL)")
+    ap.add_argument("--fleet-faults",
+                    default="store.read:error:0.02,store.write:error:0.02",
+                    help="DL4J_TPU_FAULTS spec injected into every "
+                         "fleet-chaos worker")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.kill_drill and args.workers < 2:
         ap.error("--kill-drill needs --workers >= 2")
     import random
     rng = random.Random(args.seed)
+    if args.fleet_chaos:
+        rec = run_fleet_chaos(args, rng)
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0 if rec.get("ok_verdict") else 1
     if args.tenants:
         rec = run_qos_drill(args, rng)
         line = json.dumps(rec)

@@ -354,6 +354,11 @@ class FlightRecorder:
         # process mode) the shared fleet view — a death under load must
         # name what the wire surface was doing
         section("frontdoor.json", self._write_frontdoor)
+        # the fleet robustness layer: leader lease/term, demotions,
+        # store corruption/rebuild evidence, idempotency journal — a
+        # death during a fleet chaos run must name who led, under which
+        # term, and what was (or was not) executed twice
+        section("fleet.json", self._write_fleet)
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -448,6 +453,22 @@ class FlightRecorder:
         fdm = _sys.modules.get("deeplearning4j_tpu.serving.frontdoor")
         payload = (fdm.snapshot_all() if fdm is not None
                    else {"frontdoors": []})
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
+
+    @staticmethod
+    def _write_fleet(path: str):
+        # sys.modules guard, same rationale as _write_generation
+        import sys as _sys
+        fdm = _sys.modules.get("deeplearning4j_tpu.serving.frontdoor")
+        if fdm is not None:
+            payload = fdm.fleet_snapshot()
+        else:
+            idm = _sys.modules.get(
+                "deeplearning4j_tpu.serving.idempotency")
+            payload = {"idempotency": (idm.snapshot() if idm is not None
+                                       else {}),
+                       "frontdoors": []}
         with open(path, "w") as f:
             json.dump(payload, f, indent=2, default=str)
 

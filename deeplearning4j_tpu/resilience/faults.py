@@ -19,6 +19,10 @@ Named injection points are threaded through the hot paths:
                             boundary (prefill joins + the decode step)
 ``http.request``            FrontDoor, at the door of every ``/v1/*``
                             request (after admission, before routing)
+``store.read``              SharedStore document read (routing falls back
+                            to its cached view; sync retries next beat)
+``store.write``             SharedStore atomic commit (sync merges its
+                            window counters back and retries)
 ``train.step``              MLN/CG ``_fit_batch`` before the jitted step
 ``checkpoint.save``         CheckpointListener / preemption / recovery saves
 ``checkpoint.restore``      ResilientTrainer checkpoint restore
@@ -76,7 +80,7 @@ log = logging.getLogger("deeplearning4j_tpu")
 POINTS = ("data.next_batch", "inference.dispatch", "inference.device_execute",
           "serving.canary", "generation.step", "http.request", "train.step",
           "checkpoint.save", "checkpoint.restore", "checkpoint.manifest",
-          "allreduce")
+          "store.read", "store.write", "allreduce")
 KINDS = ("error", "crash", "latency", "nan", "host_loss")
 # nan corrupts a batch, so it only fires at points that own an array —
 # accepting it elsewhere would validate a chaos spec that never injects

@@ -42,26 +42,45 @@ front door PR):
 - :mod:`~deeplearning4j_tpu.serving.shared_state` — :class:`SharedStore`
   + :class:`SharedServingState`: the file-backed CAS store N worker
   processes coordinate through (one version set, consistent canary
-  splits, fleet-aggregated SLO windows, shared drains).
+  splits, fleet-aggregated SLO windows, shared drains) — with
+  **lease-fenced leadership** (monotonic leader terms; a stale leader's
+  write loses at write time, ``DL4J_TPU_FLEET_FENCE``), digest-validated
+  reads with corruption quarantine + mirror-replay rebuild, and
+  negative-clock-delta clamping throughout.
+- :mod:`~deeplearning4j_tpu.serving.idempotency` — :class:`ResultJournal`:
+  the front door's bounded, TTL'd ``X-Dl4j-Idempotency-Key`` → outcome
+  journal (``DL4J_TPU_IDEMPOTENCY``): a retried key replays the original
+  outcome without re-executing, so QoS token debt is charged exactly
+  once per key — the safety the fleet proxy's connect-failover rides.
 
 Surfaces: ``UIServer GET /debug/deploy`` and ``deploy.json`` in
-flight-recorder bundles both serve :func:`snapshot`.
+flight-recorder bundles both serve :func:`snapshot`;
+``GET /debug/fleet`` and ``fleet.json`` serve
+:func:`~deeplearning4j_tpu.serving.frontdoor.fleet_snapshot` (fence
+state, corruption/rebuild evidence, the idempotency journal).
 """
-from deeplearning4j_tpu.serving.errors import RolloutConflictError
-from deeplearning4j_tpu.serving.frontdoor import (FrontDoor,
+from deeplearning4j_tpu.serving.errors import (RolloutConflictError,
+                                               StoreLockTimeout)
+from deeplearning4j_tpu.serving.frontdoor import (FrontDoor, fleet_snapshot,
                                                   frontdoor_enabled)
+from deeplearning4j_tpu.serving.idempotency import (IDEMPOTENCY_HEADER,
+                                                    ResultJournal,
+                                                    idempotency_enabled)
 from deeplearning4j_tpu.serving.registry import DeployedVersion, ModelRegistry
 from deeplearning4j_tpu.serving.rollout import (CanaryRollout, RolloutPolicy,
                                                 RolloutState)
 from deeplearning4j_tpu.serving.router import ServingRouter, rollout_enabled
 from deeplearning4j_tpu.serving.shared_state import (SharedServingState,
-                                                     SharedStore)
+                                                     SharedStore,
+                                                     fleet_fence_enabled)
 
 __all__ = [
     "ModelRegistry", "DeployedVersion", "CanaryRollout", "RolloutPolicy",
     "RolloutState", "ServingRouter", "rollout_enabled", "snapshot",
     "FrontDoor", "frontdoor_enabled", "SharedStore", "SharedServingState",
-    "RolloutConflictError",
+    "RolloutConflictError", "StoreLockTimeout", "fleet_fence_enabled",
+    "fleet_snapshot", "ResultJournal", "IDEMPOTENCY_HEADER",
+    "idempotency_enabled",
 ]
 
 

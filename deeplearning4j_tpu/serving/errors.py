@@ -20,3 +20,11 @@ class RolloutConflictError(RuntimeError):
     (one already active, rollouts disabled, candidate not live, lane
     has no primary) — retryable after the state changes; maps to HTTP
     409 on the front door."""
+
+
+class StoreLockTimeout(RuntimeError):
+    """The shared-store file lock could not be acquired within the
+    bounded wait — a writer crashed or was paused (SIGSTOP) INSIDE its
+    critical section.  Typed so the sync loop treats it like any other
+    transient store failure (window counters merge back, the next beat
+    retries) instead of the whole fleet wedging forever on ``flock``."""

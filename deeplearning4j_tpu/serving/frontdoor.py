@@ -80,6 +80,7 @@ from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   CircuitOpenError,
                                                   DeadlineExceeded, ShedError,
                                                   ShutdownError)
+from deeplearning4j_tpu.serving import idempotency as _idem
 from deeplearning4j_tpu.serving.errors import RolloutConflictError
 from deeplearning4j_tpu.serving.router import request_fraction
 # ONE bind-host knob for both HTTP surfaces (the UI server owns the
@@ -134,6 +135,24 @@ class BadRequest(ValueError):
 class PayloadTooLarge(ValueError):
     """Request body over :data:`MAX_BODY_BYTES` — HTTP 413, refused
     before a byte of it is buffered."""
+
+
+def charges_possible(exc: BaseException) -> bool:
+    """Could work that charged the tenant (or emitted tokens) have
+    happened before ``exc``?  Drives the idempotency journal's
+    resolve-vs-abandon split for typed outcomes: pre-charge rejections
+    (quota, queue-full shed, circuit open, shutdown) are abandoned so a
+    later retry gets a real attempt; anything that may carry partial
+    work (preemption and stream-cancel after partial decode, deadlines,
+    device errors) is resolved so a retry replays instead of
+    double-charging."""
+    if isinstance(exc, _qos.PreemptedError):
+        return True
+    if type(exc).__name__ == "StreamCancelled":
+        return True          # partial tokens were streamed and charged
+    if isinstance(exc, (ShedError, CircuitOpenError, ShutdownError)):
+        return False
+    return True
 
 
 def http_status(exc: BaseException) -> int:
@@ -388,8 +407,25 @@ class FrontDoor:
                 ctx = current_context()
                 return ctx.trace_id if ctx is not None else None
 
+            def _finish_idem(self, code: int, payload: dict, exc=None):
+                """Journal this request's final outcome under its
+                idempotency key (once): outcomes reached after execution
+                began resolve (a retry replays); pre-charge rejections
+                abandon (a retry gets a real attempt)."""
+                key = getattr(self, "_idem_key", None)
+                if key is None:
+                    return
+                self._idem_key = None
+                journal = _idem.global_journal()
+                if (getattr(self, "_idem_executing", False)
+                        and (exc is None or charges_possible(exc))):
+                    journal.resolve(key, code, payload)
+                else:
+                    journal.abandon(key)
+
             def _reply(self, code: int, payload: dict, route: str,
                        t0: float, extra_headers=()):
+                self._finish_idem(code, payload)
                 body = json.dumps(payload, default=str).encode()
                 try:
                     self.send_response(code)
@@ -412,6 +448,7 @@ class FrontDoor:
                 code = http_status(exc)
                 payload = {"error": type(exc).__name__,
                            "detail": str(exc)}
+                self._finish_idem(code, payload, exc=exc)
                 headers = ()
                 if code in (429, 503):
                     # every shed response tells the client when to come
@@ -422,6 +459,63 @@ class FrontDoor:
                         retry_after_seconds(exc), 3)
                 self._reply(code, payload, route, t0,
                             extra_headers=headers)
+
+            def _serve_replay(self, entry, route: str, t0: float):
+                """A retried idempotency key: wait for the original's
+                resolution (immediate when already done) and return THE
+                original outcome — nothing executes, nothing is charged."""
+                try:
+                    body = self._read_json()     # drain + stream flag
+                except Exception as e:
+                    self._error(e, route, t0)
+                    return
+                outcome = _idem.global_journal().await_outcome(entry)
+                if outcome is None:
+                    # original still executing past the bounded wait (or
+                    # the key was abandoned mid-wait): come back shortly
+                    self._reply(503, {
+                        "error": "IdempotentInFlight",
+                        "detail": "the original request under this "
+                                  "idempotency key is still executing",
+                        "retry_after_s": DEFAULT_RETRY_AFTER_S},
+                        route, t0,
+                        extra_headers=(_retry_after_header(),))
+                    return
+                code, payload = outcome
+                if (body.get("stream") and code == 200
+                        and isinstance(payload.get("tokens"), list)):
+                    self._replay_stream(payload, route, t0)
+                    return
+                self._reply(code, payload, route, t0,
+                            extra_headers=((_idem.REPLAY_HEADER, "1"),))
+
+            def _replay_stream(self, payload: dict, route: str,
+                               t0: float):
+                """Replay a journaled stream outcome as SSE: the same
+                token events the original emitted, from the journal."""
+                obs = _HttpMetrics.get()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header(_idem.REPLAY_HEADER, "1")
+                    tid = self._tid()
+                    if tid is not None:
+                        self.send_header("X-Dl4j-Trace-Id", str(tid))
+                    self.end_headers()
+                    for i, tok in enumerate(payload["tokens"]):
+                        self.wfile.write(
+                            (f"event: token\ndata: "
+                             f"{json.dumps({'index': i, 'token': int(tok)})}"
+                             f"\n\n").encode())
+                    self.wfile.write(("event: done\ndata: "
+                                      + json.dumps(payload)
+                                      + "\n\n").encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    obs.disconnects.inc()
+                obs.requests("stream", 200).inc()
+                obs.latency("stream").observe(time.perf_counter() - t0)
 
             def _read_json(self) -> dict:
                 n = int(self.headers.get("Content-Length", 0) or 0)
@@ -444,6 +538,8 @@ class FrontDoor:
                 route = _route_of(path)
                 t0 = time.perf_counter()
                 self._trace_id = None
+                self._idem_key = None
+                self._idem_executing = False
                 obs = _HttpMetrics.get()
                 if path not in ("/v1/classify", "/v1/generate",
                                 "/admin/rollout", "/admin/rollback"):
@@ -457,6 +553,20 @@ class FrontDoor:
                                 route, t0,
                                 extra_headers=(_retry_after_header(),))
                     return
+                # idempotent retries: a known key replays its journaled
+                # outcome (or attaches to the in-flight original) BEFORE
+                # quota/admission — a replay executes nothing, spends no
+                # quota, and charges no token debt (exactly-once per key)
+                if (path in ("/v1/classify", "/v1/generate")
+                        and _idem.idempotency_enabled()):
+                    key = self.headers.get(_idem.IDEMPOTENCY_HEADER)
+                    if key:
+                        entry, state = _idem.global_journal().begin(key)
+                        if entry is not None and state != _idem.NEW:
+                            self._serve_replay(entry, route, t0)
+                            return
+                        if entry is not None:
+                            self._idem_key = key
                 # tenant identity + quota admission (QoS posture; the
                 # kill switch leaves self._tenant None — the header is
                 # inert and no tenant series are touched)
@@ -507,6 +617,15 @@ class FrontDoor:
                             if _faults.armed():
                                 _faults.check("http.request")
                             body = self._read_json()
+                            if (self._idem_key is not None
+                                    and path in ("/v1/classify",
+                                                 "/v1/generate")):
+                                # past here, ANY outcome may carry
+                                # charged work: journal it, never
+                                # re-execute a retried key
+                                self._idem_executing = True
+                                _idem.global_journal().mark_executing(
+                                    self._idem_key)
                             if path == "/v1/classify":
                                 self._classify(body, route, t0)
                             elif path == "/v1/generate":
@@ -659,18 +778,23 @@ class FrontDoor:
                         item = False               # detection when idle
                 err = result.get("error")
                 code = 200
-                if err is not None and not dead.is_set():
-                    code = http_status(err)
-                    emit(f"event: error\ndata: "
-                         + json.dumps({"error": type(err).__name__,
-                                       "detail": str(err),
-                                       "status": code}) + "\n\n")
-                elif err is None:
+                if err is not None:
+                    err_payload = {"error": type(err).__name__,
+                                   "detail": str(err),
+                                   "status": http_status(err)}
+                    self._finish_idem(http_status(err), err_payload,
+                                      exc=err)
+                    if not dead.is_set():
+                        code = err_payload["status"]
+                        emit("event: error\ndata: "
+                             + json.dumps(err_payload) + "\n\n")
+                else:
                     done = {"tokens": result.get("tokens"),
                             "n": len(result.get("tokens") or ()),
                             "worker": fd.worker_id}
                     if result.get("version") is not None:
                         done["version"] = result["version"]
+                    self._finish_idem(200, done)
                     emit("event: done\ndata: " + json.dumps(done) + "\n\n")
                 obs.requests("stream", code).inc()
                 obs.latency("stream").observe(time.perf_counter() - t0)
@@ -714,6 +838,12 @@ class FrontDoor:
                 try:
                     if path == "/debug/frontdoor":
                         self._reply(200, fd.snapshot(), route, t0)
+                    elif path == "/debug/fleet":
+                        # the fleet robustness view: lease/term state,
+                        # demotions, store-corruption/rebuild evidence,
+                        # and the idempotency journal (the chaos drill's
+                        # duplicate-execution audit surface)
+                        self._reply(200, fleet_snapshot(), route, t0)
                     elif path == "/debug/tenants":
                         # tenant policies, quota bucket levels, and
                         # per-tenant lifetime counters — the multi-
@@ -820,3 +950,28 @@ def snapshot_all() -> dict:
     return {"enabled": frontdoor_enabled(),
             "frontdoors": [f.snapshot() for f in list(FrontDoor._live)
                            if f._httpd is not None]}
+
+
+def fleet_snapshot() -> dict:
+    """The ``/debug/fleet`` payload (also ``fleet.json`` in flight-
+    recorder bundles): lease-fenced leadership state (term, holder,
+    demotions), store corruption/rebuild evidence, and the idempotency
+    journal with per-key execution counts — the fleet chaos drill's
+    audit surface for "zero duplicate executions, strictly monotonic
+    terms"."""
+    from deeplearning4j_tpu.serving import shared_state as _ss
+    doors = []
+    for f in list(FrontDoor._live):
+        if f._httpd is None:
+            continue
+        doors.append({
+            "worker_id": f.worker_id,
+            "address": f.get_address(),
+            "shared": (f.shared.snapshot()
+                       if f.shared is not None else None),
+        })
+    return {
+        "fence_enabled": _ss.fleet_fence_enabled(),
+        "idempotency": _idem.snapshot(),
+        "frontdoors": doors,
+    }

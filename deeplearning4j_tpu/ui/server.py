@@ -712,6 +712,26 @@ class UIServer:
                          else {"frontdoors": []}),
                         default=str).encode()
                     ctype = "application/json"
+                elif parsed.path == "/debug/fleet":
+                    # fleet robustness state: lease/term leadership
+                    # (holder, term, demotions), store corruption/
+                    # rebuild evidence, and the idempotency journal —
+                    # the first stop for "did a stale leader write, did
+                    # anything execute twice". sys.modules guard like
+                    # /debug/frontdoor: a process with no front door
+                    # answers the idempotency/fence posture only
+                    import sys as _sys
+                    _fdm = _sys.modules.get(
+                        "deeplearning4j_tpu.serving.frontdoor")
+                    if _fdm is not None:
+                        payload = _fdm.fleet_snapshot()
+                    else:
+                        from deeplearning4j_tpu.serving import (
+                            idempotency as _idm)
+                        payload = {"idempotency": _idm.snapshot(),
+                                   "frontdoors": []}
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
                 elif parsed.path == "/debug/tenants":
                     # multi-tenant QoS state: per-tenant policies
                     # (weights, priority tiers, quotas), live token-

@@ -9,9 +9,13 @@ The end-to-end walkthrough of the network serving tier:
    ``POST /v1/classify`` with JSON, ``POST /v1/generate`` twice: once
    plain, once with ``"stream": true`` parsing the per-token SSE events
    (and checking the streamed sequence equals the non-streamed one);
-3. start a canary rollout of v2 over ``POST /admin/rollout``, drive
+3. retry a generation under an ``X-Dl4j-Idempotency-Key`` — the retry
+   replays the journaled outcome (same tokens, ``X-Dl4j-Idempotent-
+   Replay: 1``) without re-executing or re-charging;
+4. start a canary rollout of v2 over ``POST /admin/rollout``, drive
    traffic until the SLO-gated state machine promotes it;
-4. watch ``GET /debug/frontdoor`` narrate the whole thing.
+5. watch ``GET /debug/frontdoor`` and ``GET /debug/fleet`` narrate the
+   whole thing.
 
 Every request here is a real socket round-trip — the same surface
 ``tools/serve.py --workers N`` scales across processes (see the README
@@ -56,10 +60,12 @@ def make_net(seed):
     return MultiLayerNetwork(conf).init()
 
 
-def post(addr, path, doc):
+def post(addr, path, doc, idem_key=None):
+    headers = {"Content-Type": "application/json"}
+    if idem_key is not None:
+        headers["X-Dl4j-Idempotency-Key"] = idem_key
     req = urllib.request.Request(
-        addr + path, data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json"})
+        addr + path, data=json.dumps(doc).encode(), headers=headers)
     with urllib.request.urlopen(req, timeout=60) as r:
         return json.loads(r.read()), dict(r.headers)
 
@@ -138,7 +144,19 @@ def main():
     print(f"  first token {first_s * 1e3:.1f} ms vs full "
           f"{total_s * 1e3:.1f} ms\n")
 
-    # ---- 3. canary v2 through the admin surface ---------------------
+    # ---- 3. idempotent retry: same key, journaled replay ------------
+    print("POST /v1/generate with X-Dl4j-Idempotency-Key (then retry)")
+    body, _ = post(addr, "/v1/generate",
+                   {"prompt": prompt, "max_new_tokens": 8},
+                   idem_key="demo-key-1")
+    retry, headers = post(addr, "/v1/generate",
+                          {"prompt": prompt, "max_new_tokens": 8},
+                          idem_key="demo-key-1")
+    print(f"  retry tokens == original: {retry['tokens'] == body['tokens']}")
+    print(f"  replayed (not re-executed): "
+          f"{headers.get('X-Dl4j-Idempotent-Replay') == '1'}\n")
+
+    # ---- 4. canary v2 through the admin surface ---------------------
     print("POST /admin/rollout (canary v2, fast policy)")
     body, _ = post(addr, "/admin/rollout", {
         "candidate": "v2",
@@ -157,17 +175,24 @@ def main():
     print(f"  final stage = {ro.stage}, primary = "
           f"{fd.router.primary.version}\n")
 
-    # ---- 4. watch /debug/frontdoor ----------------------------------
+    # ---- 5. watch /debug/frontdoor + /debug/fleet -------------------
     print("GET /debug/frontdoor")
     with urllib.request.urlopen(addr + "/debug/frontdoor") as r:
         snap = json.loads(r.read())
     print(f"  mode={snap['mode']} inflight={snap['inflight']} "
           f"scoring primary={snap['scoring']['primary']} "
           f"rollout stage={snap['scoring']['rollout']['stage']}")
+    print("GET /debug/fleet")
+    with urllib.request.urlopen(addr + "/debug/fleet") as r:
+        fleet = json.loads(r.read())
+    idem = fleet["idempotency"]
+    print(f"  fence={fleet['fence_enabled']} journal size={idem['size']} "
+          f"replays={idem['replays']} "
+          f"duplicate_executions={idem['duplicate_executions']}")
     print("\nfor N processes serving ONE version set over a shared "
           "store:\n  python tools/serve.py --workers 2 --port 8080 "
           "--state-dir /tmp/fleet\n  python benchmarks/http_load.py "
-          "--workers 2 --kill-drill")
+          "--workers 3 --fleet-chaos")
 
     fd.stop()
     registry.shutdown()

@@ -461,6 +461,44 @@ def test_thread_hygiene_accepts_daemon_joined_and_pools(tmp_path):
 # migrated rules: metric-names + env-knobs run inside graftlint
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# store-discipline
+# ---------------------------------------------------------------------------
+
+def test_store_discipline_flags_raw_writes_in_serving(tmp_path):
+    bad = _lint(tmp_path, {"serving/sneaky.py": """
+        def hijack(store, doc):
+            store._write(doc)                       # bypasses everything
+            store.try_replace(doc, doc.get("rev"))  # bypasses the fence
+    """}, ["store-discipline"])
+    assert len(bad) == 2
+    assert all(f.rule == "store-discipline" for f in bad)
+    assert "leader fence" in bad[0].message
+
+
+def test_store_discipline_exempts_owner_and_outside_serving(tmp_path):
+    ok = _lint(tmp_path, {
+        # shared_state.py OWNS both spellings
+        "serving/shared_state.py": """
+            def update(store, doc):
+                store._write(doc)
+                store.try_replace(doc, 0)
+        """,
+        # sanctioned helpers are fine anywhere in serving/
+        "serving/fine.py": """
+            def beat(state, store):
+                store.update(lambda d: None)
+                state.sync()
+        """,
+        # outside serving/ is out of scope (drills/tests poke internals)
+        "tools_like.py": """
+            def drill(store, doc):
+                store.try_replace(doc, 0)
+        """,
+    }, ["store-discipline"])
+    assert ok == []
+
+
 def test_metric_names_runs_as_graftlint_rule(tmp_path):
     bad = _lint(tmp_path, {"mod.py": """
         def install(reg):

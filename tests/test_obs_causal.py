@@ -312,13 +312,16 @@ def test_flight_recorder_dump_bundle_contents(tmp_path):
     bundle = rec.dump("unit-test")
     files = sorted(os.listdir(bundle))
     assert files == ["compiles.json", "config.json", "deploy.json",
-                     "elastic.json", "frontdoor.json", "generation.json",
-                     "metrics.prom", "numerics.json", "perf.json",
-                     "resilience.json", "tenants.json", "threads.txt",
-                     "trace.json"]
+                     "elastic.json", "fleet.json", "frontdoor.json",
+                     "generation.json", "metrics.prom", "numerics.json",
+                     "perf.json", "resilience.json", "tenants.json",
+                     "threads.txt", "trace.json"]
     # the multi-tenant QoS section names the posture + tenant table
     tenants = json.loads(open(os.path.join(bundle, "tenants.json")).read())
     assert "enabled" in tenants and "tenants" in tenants
+    # the fleet robustness section carries the idempotency journal view
+    fleet = json.loads(open(os.path.join(bundle, "fleet.json")).read())
+    assert "idempotency" in fleet
     trace = json.loads(open(os.path.join(bundle, "trace.json")).read())
     assert any(e.get("name") == "doomed_section" for e in trace)
     prom = open(os.path.join(bundle, "metrics.prom")).read()

@@ -670,6 +670,68 @@ def test_bench_diff_learns_serve_schema(tmp_path):
     assert mod.main([str(tmp_path)]) == 0
 
 
+def test_bench_diff_learns_fleet_schema(tmp_path):
+    """FLEET_r*.json chaos-drill archives (http_load.py --fleet-chaos):
+    goodput-under-chaos + the duplicate-execution ratio grade
+    sustained-only, the leader-term/stage booleans gate like MULTICHIP
+    (newest round must pass), raw p99 is never gated, and alien/empty
+    JSON is green."""
+    import json as _json
+    mod = _load_tool("bench_diff")
+
+    def write(rnd, goodput, dups=0, terms=True, regressed=False,
+              p99=300.0, wrap=False):
+        rec = {"metric": "fleet_chaos", "platform": "cpu",
+               "goodput_ratio": goodput, "value": goodput,
+               "duplicate_executions": dups, "terms_monotonic": terms,
+               "stage_regressed": regressed, "p99_ms": p99}
+        doc = {"n": rnd, "parsed": rec} if wrap else rec
+        (tmp_path / f"FLEET_r{rnd:02d}.json").write_text(_json.dumps(doc))
+
+    for rnd, gp in enumerate([0.97, 0.95, 0.98], start=1):
+        write(rnd, gp, wrap=(rnd == 2))           # wrapper unwrapped too
+    samples = mod.load_fleet(str(tmp_path))
+    assert [s.round for s in samples] == [1, 2, 3]
+    assert samples[0].dup_free == pytest.approx(1.0)
+    assert mod.check_fleet(samples) == []
+    assert mod.check_fleet_bool(samples) == []
+    assert mod.main([str(tmp_path)]) == 0
+    # one bad goodput round is weather...
+    write(4, 0.5)
+    assert mod.check_fleet(mod.load_fleet(str(tmp_path))) == []
+    # ...two in a row is a sustained regression
+    write(5, 0.52)
+    regs = mod.check_fleet(mod.load_fleet(str(tmp_path)))
+    assert [r.series for r in regs] == ["goodput"]
+    assert regs[0].rounds == (4, 5)
+    assert mod.main([str(tmp_path)]) == 1
+    # duplicate executions drive the dup_free ratio below the floor
+    write(4, 0.97, dups=2)
+    write(5, 0.96, dups=1)
+    regs = mod.check_fleet(mod.load_fleet(str(tmp_path)))
+    assert [r.series for r in regs] == ["dup_free"]
+    # the boolean audit gates like MULTICHIP: newest round failing = break
+    write(4, 0.97)
+    write(5, 0.96, terms=False, regressed=True)
+    assert mod.check_fleet(mod.load_fleet(str(tmp_path))) == []
+    breaks = mod.check_fleet_bool(mod.load_fleet(str(tmp_path)))
+    assert len(breaks) == 2 and "leader-term" in breaks[0]
+    assert mod.main([str(tmp_path)]) == 2
+    # p99 collapse alone never gates
+    write(5, 0.97, p99=90000.0)
+    assert mod.check_fleet(mod.load_fleet(str(tmp_path))) == []
+    assert mod.check_fleet_bool(mod.load_fleet(str(tmp_path))) == []
+    # alien / unreadable JSON is ignored, never fatal; empty dir green
+    (tmp_path / "FLEET_r06.json").write_text("not json {")
+    (tmp_path / "FLEET_r07.json").write_text('{"whatever": 1}')
+    assert len(mod.load_fleet(str(tmp_path))) == 5
+    assert mod.main([str(tmp_path)]) == 0
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert mod.load_fleet(str(empty)) == []
+    assert mod.main([str(empty)]) == 0
+
+
 # ---------------------------------------------------------------------------
 # lints: metric naming + env-knob table stay green with the new series
 # ---------------------------------------------------------------------------

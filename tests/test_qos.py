@@ -484,6 +484,82 @@ def test_equal_tiers_never_preempt(gen_engine):
     gp.shutdown()
 
 
+def test_charge_path_midstream_death_exact_once_replay_zero(gen_engine):
+    """Charge-path satellite: a generation that dies typed mid-stream
+    after partial decode charges token debt for the tokens ACTUALLY
+    emitted, exactly once — and an idempotent retry of an executed key
+    replays the outcome and charges ZERO (per-tenant counters pinned
+    under retry)."""
+    from deeplearning4j_tpu.parallel.generation import GenerationPipeline
+    from deeplearning4j_tpu.serving import (FrontDoor, ModelRegistry,
+                                            ServingRouter)
+    from deeplearning4j_tpu.serving import idempotency as idem
+    idem.reset_global_journal()
+    # token_rate must be > 0; 1e-3/s makes refill negligible so the
+    # bucket level pins the exact debt charged
+    treg = _registry_with({"t1": qos.TenantPolicy(
+        "t1", token_rate=1e-3, token_burst=1000.0)})
+    gp = GenerationPipeline(gen_engine, slots=1, max_new_tokens=24)
+    emitted = []
+
+    def cancel_after_3(tok, idx):
+        emitted.append(int(tok))
+        return len(emitted) < 3
+
+    with pytest.raises(ShedError):           # typed StreamCancelled
+        gp.generate([3, 1, 4, 1, 5], max_new_tokens=24,
+                    on_token=cancel_after_3, tenant="t1")
+    time.sleep(0.1)
+    n = len(emitted)
+    assert n >= 3
+    inst = global_registry().get("dl4j_tenant_tokens_total")
+    series = {lv[0]: c.value for lv, c in inst.series()}
+    assert series.get("t1") == float(n)      # exactly once, exactly n
+    st = treg.snapshot()["tenants"]["t1"]
+    assert st["tokens"] == float(n)
+    assert st["token_bucket_level"] == pytest.approx(1000.0 - n,
+                                                     abs=0.1)
+    gp.shutdown()
+    # --- and through the front door, pinned under RETRY ---
+    reg = ModelRegistry()
+    reg.deploy_generative("g1", gen_engine, slots=2, max_new_tokens=16)
+    fd = FrontDoor(gen_router=ServingRouter(reg, "g1"), port=0).start()
+    try:
+        addr = fd.get_address()
+        doc = {"prompt": [3, 1, 4], "max_new_tokens": 5}
+        code, body, _ = _post(addr, "/v1/generate", doc, tenant="t1",
+                              idem_key="C1")
+        assert code == 200 and len(body["tokens"]) == 5
+        series = {lv[0]: c.value for lv, c
+                  in global_registry().get(
+                      "dl4j_tenant_tokens_total").series()}
+        assert series.get("t1") == float(n + 5)
+        req_series = {lv[0]: c.value for lv, c
+                      in global_registry().get(
+                          "dl4j_tenant_requests_total").series()}
+        # the retry replays: same tokens, ZERO further charge, and the
+        # per-tenant request/token counters do not move
+        code2, body2, headers2 = _post(addr, "/v1/generate", doc,
+                                       tenant="t1", idem_key="C1")
+        assert code2 == 200 and body2["tokens"] == body["tokens"]
+        assert headers2.get("X-Dl4j-Idempotent-Replay") == "1"
+        after_tok = {lv[0]: c.value for lv, c
+                     in global_registry().get(
+                         "dl4j_tenant_tokens_total").series()}
+        after_req = {lv[0]: c.value for lv, c
+                     in global_registry().get(
+                         "dl4j_tenant_requests_total").series()}
+        assert after_tok.get("t1") == float(n + 5)   # charged ZERO more
+        assert after_req == req_series
+        st = treg.snapshot()["tenants"]["t1"]
+        assert st["token_bucket_level"] == pytest.approx(
+            1000.0 - n - 5, abs=0.1)
+    finally:
+        fd.stop()
+        reg.shutdown()
+        idem.reset_global_journal()
+
+
 # ---------------------------------------------------------------------------
 # the flooding-tenant chaos drill
 # ---------------------------------------------------------------------------
@@ -620,10 +696,12 @@ def test_metric_lint_tenant_label_rule():
 # front door: quota admission, Retry-After, /debug/tenants
 # ---------------------------------------------------------------------------
 
-def _post(addr, path, doc, tenant=None, timeout=30.0):
+def _post(addr, path, doc, tenant=None, timeout=30.0, idem_key=None):
     headers = {"Content-Type": "application/json"}
     if tenant is not None:
         headers["X-Dl4j-Tenant"] = tenant
+    if idem_key is not None:
+        headers["X-Dl4j-Idempotency-Key"] = idem_key
     req = urllib.request.Request(
         addr + path, data=json.dumps(doc).encode(), headers=headers)
     try:

@@ -72,6 +72,7 @@ _MULTICHIP_RE = re.compile(r"MULTICHIP_r(\d+)[^/]*\.json$")
 _DECODE_RE = re.compile(r"DECODE_r(\d+)[^/]*\.json$")
 _SERVE_RE = re.compile(r"SERVE_r(\d+)[^/]*\.json$")
 _QOS_RE = re.compile(r"QOS_r(\d+)[^/]*\.json$")
+_FLEET_RE = re.compile(r"FLEET_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -392,6 +393,101 @@ def check_qos(samples: List[QosSample],
     ], tolerance, sustain)
 
 
+class FleetSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                      # "fleet_chaos"
+    platform: Optional[str]
+    goodput_ratio: Optional[float]   # ok / total under chaos — gated
+                                     # sustained-only
+    dup_free: Optional[float]        # 1 / (1 + duplicate executions):
+                                     # 1.0 = perfect exactly-once; any
+                                     # duplicate drops it below the
+                                     # tolerance floor — gated
+                                     # sustained-only like a ratio
+    p99_ms: Optional[float]          # reported, never gated (weather)
+    terms_monotonic: Optional[bool]  # boolean audit, gated like
+    stage_regressed: Optional[bool]  # MULTICHIP (newest round must pass)
+
+
+def load_fleet(root: str) -> List[FleetSample]:
+    """``FLEET_r*.json`` chaos-drill archives (``benchmarks/http_load.py
+    --fleet-chaos`` records, bare or driver-wrapped). Anything without a
+    ``fleet_`` metric — alien JSON — is ignored, never fatal."""
+    out: List[FleetSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "FLEET_r*.json"))):
+        m = _FLEET_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("fleet_"):
+            continue
+        good = doc.get("goodput_ratio", doc.get("value"))
+        dups = doc.get("duplicate_executions")
+        out.append(FleetSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            goodput_ratio=(float(good)
+                           if isinstance(good, (int, float)) else None),
+            dup_free=(1.0 / (1.0 + float(dups))
+                      if isinstance(dups, (int, float)) and dups >= 0
+                      else None),
+            p99_ms=(float(doc["p99_ms"])
+                    if isinstance(doc.get("p99_ms"), (int, float))
+                    else None),
+            terms_monotonic=(bool(doc["terms_monotonic"])
+                             if isinstance(doc.get("terms_monotonic"),
+                                           bool) else None),
+            stage_regressed=(bool(doc["stage_regressed"])
+                             if isinstance(doc.get("stage_regressed"),
+                                           bool) else None)))
+    return out
+
+
+def check_fleet(samples: List[FleetSample],
+                tolerance: float = DEFAULT_TOLERANCE,
+                sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the chaos-drill trajectory under the same noise-aware
+    rules: goodput-under-chaos and the duplicate-execution ratio
+    (1/(1+dups)) sustained-only; raw p99 is reported, never gated."""
+    return _grade_metric_groups(samples, [
+        ("goodput", lambda s: s.goodput_ratio),
+        ("dup_free", lambda s: s.dup_free),
+    ], tolerance, sustain)
+
+
+def check_fleet_bool(samples: List[FleetSample]) -> List[str]:
+    """The boolean invariants grade like MULTICHIP: the NEWEST round's
+    leader-term audit must hold and its stage must never have regressed
+    — one failure is real, there is no noise to sustain through."""
+    newest: Dict[int, FleetSample] = {}
+    for s in samples:
+        prev = newest.get(s.round)
+        if prev is None or _file_mtime(s.path) >= _file_mtime(prev.path):
+            newest[s.round] = s
+    if not newest:
+        return []
+    latest = newest[max(newest)]
+    out = []
+    if latest.terms_monotonic is False:
+        out.append(f"FLEET leader-term audit FAILING at "
+                   f"r{latest.round:02d} (non-monotonic terms — a "
+                   f"stale-term write landed; {latest.path})")
+    if latest.stage_regressed is True:
+        out.append(f"FLEET rollout stage REGRESSED at "
+                   f"r{latest.round:02d} ({latest.path})")
+    return out
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -485,16 +581,18 @@ def main(argv=None) -> int:
     decodes = load_decode(root)
     serves = load_serve(root)
     qos = load_qos(root)
+    fleet = load_fleet(root)
     if (not samples and not dryruns and not decodes and not serves
-            and not qos):
+            and not qos and not fleet):
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
               "nothing to grade")
         return 0
     regressions = (check_trajectory(samples) + check_decode(decodes)
-                   + check_serve(serves) + check_qos(qos))
-    breaks = check_multichip(dryruns)
+                   + check_serve(serves) + check_qos(qos)
+                   + check_fleet(fleet))
+    breaks = check_multichip(dryruns) + check_fleet_bool(fleet)
     for s in samples:
         marks = []
         if s.vs_baseline is not None:
@@ -537,6 +635,20 @@ def main(argv=None) -> int:
             marks.append(f"flooder_shed={s.flooder_shed}")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + " ".join(marks))
+    for s in fleet:
+        marks = []
+        if s.goodput_ratio is not None:
+            marks.append(f"goodput={s.goodput_ratio:.3f}")
+        if s.dup_free is not None:
+            marks.append(f"dup_free={s.dup_free:.3f}")
+        if s.terms_monotonic is not None:
+            marks.append(f"terms_monotonic={s.terms_monotonic}")
+        if s.stage_regressed is not None:
+            marks.append(f"stage_regressed={s.stage_regressed}")
+        if s.p99_ms is not None:
+            marks.append(f"p99={s.p99_ms:.1f}ms")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
@@ -544,8 +656,8 @@ def main(argv=None) -> int:
     if not regressions and not breaks:
         print(f"bench trajectory OK ({len(samples)} bench + "
               f"{len(dryruns)} dryrun + {len(decodes)} decode + "
-              f"{len(serves)} serve + {len(qos)} qos samples "
-              f"under {root})")
+              f"{len(serves)} serve + {len(qos)} qos + "
+              f"{len(fleet)} fleet samples under {root})")
     return len(regressions) + len(breaks)
 
 

@@ -1,0 +1,61 @@
+"""store-discipline: fleet-doc mutations go through the fenced helpers.
+
+The shared fleet document (``serving/shared_state.py``) is the
+coordination plane N worker processes agree through.  Its safety story
+has exactly two sanctioned write paths — the serialized
+``SharedStore.update`` transaction (inside which the leader fence and
+the corruption-rebuild hook run) and the rev-CAS ``try_replace`` used
+BY those helpers.  A direct ``._write(...)`` bypasses rev/digest
+stamping and the file lock entirely (a torn or stale doc the whole
+fleet then trusts), and a raw ``.try_replace(...)`` sprinkled through
+serving code bypasses the leader fence and the rebuild hook — exactly
+the stale-leader-write-lands bug the fence exists to kill.
+
+Rule: inside ``serving/``, any call spelled ``<obj>._write(...)`` or
+``<obj>.try_replace(...)`` is flagged — EXCEPT in
+``serving/shared_state.py`` itself, which owns both spellings.  Code
+outside ``serving/`` (tools, tests, benchmarks) is out of scope: drills
+deliberately corrupt the doc and tests poke internals.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import Finding, register
+
+#: the attribute spellings only shared_state.py may call
+_FORBIDDEN = frozenset({"_write", "try_replace"})
+
+_OWNER = "serving/shared_state.py"
+
+
+@register
+class StoreDisciplineChecker:
+    rule = "store-discipline"
+    description = ("serving/ mutates the shared fleet doc only through "
+                   "the fenced CAS/update helpers (no direct _write / "
+                   "raw try_replace outside shared_state.py)")
+
+    def check_file(self, ctx) -> List[Finding]:
+        rel = ctx.relpath
+        if not rel.startswith("serving/") or rel == _OWNER:
+            return []
+        if ("try_replace" not in ctx.source
+                and "._write(" not in ctx.source):   # cheap pre-filter
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FORBIDDEN):
+                continue
+            out.append(Finding(
+                self.rule, rel, node.lineno,
+                f"direct .{node.func.attr}() on the shared fleet doc "
+                "bypasses the leader fence, rev/digest stamping, and "
+                "the corruption-rebuild hook",
+                "go through SharedServingState's helpers (or "
+                "SharedStore.update) — only shared_state.py may spell "
+                "_write/try_replace"))
+        return out

@@ -92,6 +92,22 @@ def _build_demo(slots: int, generative: bool):
     return reg, router, gen_router
 
 
+def _retrying(what, fn, attempts: int = 8, delay_s: float = 0.1):
+    """Bounded retry for the worker's startup store writes: a chaos run
+    arms store.read/store.write faults in the WORKER env, and a startup
+    blip must cost a beat, not the whole process (the parent would
+    respawn it into the same weather)."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            if i == attempts - 1:
+                raise
+            print(f"worker startup: {what} failed ({e!r}); retrying",
+                  file=sys.stderr, flush=True)
+            time.sleep(delay_s)
+
+
 def run_worker(args) -> int:
     from deeplearning4j_tpu.serving import (FrontDoor, SharedServingState,
                                             SharedStore)
@@ -100,14 +116,17 @@ def run_worker(args) -> int:
                                           not args.no_generative)
     shared = SharedServingState(SharedStore(args.state_dir),
                                 args.worker_id)
-    shared.ensure_lane("scoring", "v1")
+    _retrying("ensure_lane(scoring)",
+              lambda: shared.ensure_lane("scoring", "v1"))
     if gen_router is not None:
-        shared.ensure_lane("generative", "g1")
+        _retrying("ensure_lane(generative)",
+                  lambda: shared.ensure_lane("generative", "g1"))
     fd = FrontDoor(router, gen_router, shared=shared, host=args.host,
                    port=(args.port if args.reuseport else 0),
                    reuse_port=args.reuseport,
                    max_inflight=args.max_inflight).start()
-    shared.register(os.getpid(), fd.port)
+    _retrying("register",
+              lambda: shared.register(os.getpid(), fd.port))
     print(json.dumps({"worker": args.worker_id, "pid": os.getpid(),
                       "port": fd.port, "address": fd.get_address()}),
           flush=True)
@@ -122,11 +141,14 @@ def run_worker(args) -> int:
 
 
 # ---------------------------------------------------------------- proxy
-class _Proxy:
+class _SpliceProxy:
     """Thread-per-connection TCP splice with connect-failover: pick the
     next live worker port (round robin over store heartbeats); a refused
     connect moves on to the next — a freshly killed worker sheds onto
-    the survivors without a single client-visible failure on them."""
+    the survivors without a single client-visible failure on them.
+    This is the pre-idempotency proxy, kept byte-identical as the
+    ``DL4J_TPU_IDEMPOTENCY=0`` kill path; the default fleet runs
+    :class:`_HttpProxy` (health ejection + safe failover)."""
 
     def __init__(self, store, host: str, port: int):
         self._store = store
@@ -144,16 +166,29 @@ class _Proxy:
 
     def _backends(self):
         now = time.time()
-        doc = self._store.read()
-        ports = [int(rec["port"]) for _, rec in
-                 sorted((doc.get("workers") or {}).items())
-                 if rec.get("port")
-                 and now - float(rec.get("heartbeat", 0)) <= 3.0]
+        try:
+            doc = self._store.read()
+            ports = [int(rec["port"]) for _, rec in
+                     sorted((doc.get("workers") or {}).items())
+                     if rec.get("port")
+                     and now - float(rec.get("heartbeat", 0)) <= 3.0]
+            if ports:
+                with self._lock:
+                    self._last_ports = ports
+        except Exception:
+            # a store read blip (injected store.read fault, transient
+            # fs) must not drop client connections: route on the last
+            # known-good backend set
+            ports = []
+        if not ports:
+            with self._lock:
+                ports = list(getattr(self, "_last_ports", ()))
+        if not ports:
+            return []
         with self._lock:
             self._rr += 1
             off = self._rr
-        return ports[off % len(ports):] + ports[:off % len(ports)] \
-            if ports else []
+        return ports[off % len(ports):] + ports[:off % len(ports)]
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -224,6 +259,172 @@ class _Proxy:
             pass
 
 
+class _HttpProxy(_SpliceProxy):
+    """HTTP-aware fleet proxy: per-backend **health ejection** (a
+    ``CircuitBreaker`` per worker port opens after consecutive connect/
+    first-byte failures — an ejected backend is skipped until its timed
+    half-open probe heals it) and **deadline-bounded failover** that is
+    safe by construction: the ENTIRE buffered request — including its
+    ``X-Dl4j-Idempotency-Key`` header — is re-sent to the next live
+    backend, so the worker-side result journal makes the retry replay
+    instead of re-execute.
+
+    Failover triggers: connect refused/reset (dead worker) and, for
+    **replay-safe** requests only (GET/HEAD, or any request carrying an
+    idempotency key), no response head within ``head_timeout_s``. The
+    head timeout is deliberately LONGER than a GC/SIGSTOP-class pause
+    (default 15 s): failing over away from a paused-but-alive worker
+    would let the original land later on a different worker than the
+    retry — the journal's exactly-once scope is per worker, so patience
+    beats a duplicate execution. A request with no key gets no head
+    timeout at all (there is no safe retry for it).
+
+    Once response bytes flow, the proxy degrades to a plain splice
+    (SSE streams pass through token by token). Failover/ejection counts
+    are published (throttled) into the shared store's ``proxy`` record,
+    which every worker re-exports as ``dl4j_fleet_failovers_total`` and
+    ``/debug/fleet`` surfaces."""
+
+    def __init__(self, store, host: str, port: int,
+                 head_timeout_s: float = 15.0):
+        self._head_timeout = float(head_timeout_s)
+        self._breakers = {}
+        self._failovers = 0
+        self._ejections = 0
+        self._pub_at = 0.0
+        super().__init__(store, host, port)
+
+    def _breaker(self, port: int):
+        from deeplearning4j_tpu.resilience.policy import CircuitBreaker
+        with self._lock:
+            brk = self._breakers.get(port)
+            if brk is None:
+                brk = self._breakers[port] = CircuitBreaker(
+                    f"proxy.connect:{port}", failure_threshold=3,
+                    reset_timeout_seconds=2.0)
+            return brk
+
+    def _note(self, failover: bool = False, ejection: bool = False):
+        with self._lock:
+            if failover:
+                self._failovers += 1
+            if ejection:
+                self._ejections += 1
+            now = time.monotonic()
+            if now - self._pub_at < 1.0:
+                return
+            self._pub_at = now
+            fo, ej = self._failovers, self._ejections
+
+        def mutate(doc):
+            doc["proxy"] = {"mode": "http", "failovers": fo,
+                            "ejections": ej, "at": time.time()}
+        try:
+            self._store.update(mutate)
+        except Exception:
+            pass            # stats are best-effort; next note retries
+
+    @staticmethod
+    def _read_request(client):
+        """Buffer one full HTTP request (line + headers + body by
+        Content-Length). Returns (raw_bytes, replay_safe) or None."""
+        client.settimeout(30.0)
+        f = client.makefile("rb")
+        line = f.readline(65536)
+        if not line:
+            return None
+        chunks = [line]
+        hmap = {}
+        while True:
+            h = f.readline(65536)
+            if h in (b"", b"\r\n", b"\n"):
+                chunks.append(b"\r\n")
+                break
+            chunks.append(h)
+            k, _, v = h.partition(b":")
+            hmap[k.strip().lower()] = v.strip()
+        try:
+            n = int(hmap.get(b"content-length", b"0") or 0)
+        except ValueError:
+            n = 0
+        if n > 0:
+            chunks.append(f.read(min(n, 16 << 20)))
+        method = line.split(b" ", 1)[0].upper()
+        replay_safe = (method in (b"GET", b"HEAD")
+                       or b"x-dl4j-idempotency-key" in hmap)
+        return b"".join(chunks), replay_safe
+
+    def _splice(self, client: socket.socket):
+        try:
+            req = self._read_request(client)
+        except (OSError, ValueError):
+            req = None
+        if req is None:
+            try:
+                client.close()
+            except OSError:
+                pass
+            return
+        raw, replay_safe = req
+        attempted = 0
+        for port in self._backends():
+            brk = self._breaker(port)
+            if not brk.allow():
+                self._note(ejection=True)    # health-ejected backend
+                continue
+            if attempted:
+                self._note(failover=True)
+            attempted += 1
+            upstream = None
+            delivered = False
+            try:
+                upstream = socket.create_connection(("127.0.0.1", port),
+                                                    timeout=2.0)
+                delivered = True    # from here bytes may have landed
+                upstream.sendall(raw)
+                upstream.settimeout(self._head_timeout if replay_safe
+                                    else None)
+                first = upstream.recv(65536)
+                if not first:
+                    raise OSError("upstream closed before response head")
+            except OSError:
+                if upstream is not None:
+                    try:
+                        upstream.close()
+                    except OSError:
+                        pass
+                brk.record_failure()
+                if delivered and not replay_safe:
+                    # the request may have EXECUTED before the death —
+                    # with no idempotency key there is no safe retry
+                    # (a re-send could double-execute / double-charge);
+                    # the client sees the reset and owns the decision
+                    break
+                continue            # next backend gets the same bytes
+            brk.record_success()
+            upstream.settimeout(None)
+            try:
+                client.sendall(first)
+                while True:
+                    data = upstream.recv(65536)
+                    if not data:
+                        break
+                    client.sendall(data)
+            except OSError:
+                pass                # client gone / upstream died mid-
+            finally:                # response: no safe retry, close out
+                for s in (client, upstream):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            return
+        try:
+            client.close()          # no live backend took the request
+        except OSError:
+            pass
+
+
 # --------------------------------------------------------------- parent
 def _spawn(args, wid: str) -> subprocess.Popen:
     cmd = [sys.executable, os.path.abspath(__file__),
@@ -262,8 +463,11 @@ def run_fleet(args) -> int:
     children = {wid: _spawn(args, wid) for wid in wids}
     deadline = time.monotonic() + args.spinup_timeout_s
     while time.monotonic() < deadline:
-        ports = {w: r.get("port") for w, r in
-                 (store.read().get("workers") or {}).items()}
+        try:
+            ports = {w: r.get("port") for w, r in
+                     (store.read().get("workers") or {}).items()}
+        except Exception:
+            ports = {}          # store blip (chaos env): keep waiting
         if all(ports.get(w) for w in wids):
             break
         time.sleep(0.2)
@@ -274,7 +478,15 @@ def run_fleet(args) -> int:
         return 1
     proxy = None
     if not args.reuseport:
-        proxy = _Proxy(store, args.host or "127.0.0.1", args.port)
+        # the HTTP-aware proxy (health ejection + key-forwarding
+        # failover) rides the idempotency posture; its kill switch
+        # restores the pre-journal TCP splice byte-identically
+        if os.environ.get("DL4J_TPU_IDEMPOTENCY", "1") != "0":
+            proxy = _HttpProxy(store, args.host or "127.0.0.1", args.port,
+                               head_timeout_s=args.failover_head_timeout_s)
+        else:
+            proxy = _SpliceProxy(store, args.host or "127.0.0.1",
+                                 args.port)
     address = f"http://127.0.0.1:{proxy.port if proxy else args.port}"
     print(json.dumps({
         "fleet": {w: children[w].pid for w in wids},
@@ -329,6 +541,13 @@ def main(argv=None) -> int:
                     help="SO_REUSEPORT kernel spreading instead of the "
                          "proxy")
     ap.add_argument("--no-respawn", dest="respawn", action="store_false")
+    ap.add_argument("--failover-head-timeout-s", type=float, default=15.0,
+                    help="proxy failover deadline for replay-safe "
+                         "requests (carrying an idempotency key): no "
+                         "response head within this long fails over to "
+                         "the next live worker; sized ABOVE GC/SIGSTOP-"
+                         "class pauses so a paused worker is waited "
+                         "out, never duplicated")
     ap.add_argument("--spinup-timeout-s", type=float, default=180.0)
     ap.add_argument("--worker-id", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
