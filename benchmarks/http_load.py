@@ -45,6 +45,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import statistics
 import subprocess
@@ -1037,6 +1038,216 @@ def run_fleet_chaos(args, rng) -> dict:
             proc.kill()
 
 
+def run_fleet_obs(args, rng) -> dict:
+    """The graded fleet observability drill (archives OBSFLEET_r*.json):
+    a 2-worker fleet behind the splice proxy with the fleet admin plane
+    up.  Phase 1 issues classify requests carrying caller-supplied
+    ``X-Dl4j-Trace-Id`` headers and checks the SAME id comes back on
+    every response, and that the proxy's recent ``proxy_request`` spans
+    carry a sent id (one trace id across proxy and worker).  Phase 2
+    times ``/metrics/fleet`` scrapes (scrape p99, reported never gated)
+    and checks every live worker appears as a ``worker="..."`` label
+    (federation completeness).  Phase 3 SIGKILLs one worker: traced
+    idempotent requests must keep echoing their ids through the
+    failover replay, and ``/metrics/fleet`` must keep answering 200
+    with partial data — never a 500 because one worker died.  Graded:
+    trace coverage >= 0.95, federation completeness == 1.0, the
+    single-trace check, and the partial scrape staying 200."""
+    state_dir = args.state_dir or f"/tmp/dl4j-fleet-obs-{os.getpid()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_FLEET_OBS", None)      # the drill grades the ON path
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve.py"),
+         "--workers", "2", "--port", "0", "--state-dir", state_dir,
+         "--slots", str(args.slots), "--no-respawn"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    store = _fleet_store(state_dir)
+    try:
+        fleet = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("tools/serve.py exited before "
+                                   "announcing the fleet")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "fleet" in doc:
+                fleet = doc
+                break
+        if fleet is None:
+            raise RuntimeError("fleet announce line never arrived")
+        addr = fleet["address"]
+        admin = fleet.get("admin_address")
+        if not admin:
+            raise RuntimeError("fleet announce carried no admin_address "
+                               "(is DL4J_TPU_FLEET_OBS off?)")
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                _get(addr, "/debug/frontdoor", timeout=5.0)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet never answered")
+                time.sleep(0.5)
+
+        sent_ids: set = set()
+        echoed = [0]
+        attempted = [0]
+
+        def traced_post(i: int, idem_key: str = None) -> bool:
+            """One classify through the proxy with a caller-supplied
+            trace id; True iff the response (ANY status — typed errors
+            must carry the header too) echoed the SAME id back."""
+            tid = f"{0xA0000000 + i:016x}"
+            sent_ids.add(tid)
+            attempted[0] += 1
+            headers = {"Content-Type": "application/json",
+                       "X-Dl4j-Trace-Id": tid}
+            if idem_key is not None:
+                headers["X-Dl4j-Idempotency-Key"] = idem_key
+            req = urllib.request.Request(
+                addr + "/v1/classify",
+                data=json.dumps({
+                    "inputs": [[round(rng.uniform(0, 1), 6)
+                                for _ in range(4)]],
+                    "request_key": i}).encode(),
+                headers=headers)
+            for attempt in (1, 2):
+                try:
+                    with urllib.request.urlopen(req, timeout=30.0) as r:
+                        r.read()
+                        got = r.headers.get("X-Dl4j-Trace-Id")
+                    break
+                except urllib.error.HTTPError as e:
+                    got = e.headers.get("X-Dl4j-Trace-Id")
+                    e.read()
+                    break
+                except Exception:
+                    # connection-level death (the SIGKILLed worker):
+                    # one retry — the replay must ride the proxy's
+                    # failover AND still echo the id
+                    if attempt == 2:
+                        return False
+            ok = got == tid
+            if ok:
+                echoed[0] += 1
+            return ok
+
+        # ---- phase 1: traced steady load + timed federation scrapes
+        for i in range(args.obs_requests):
+            traced_post(i)
+        live = sorted(w for w, r in (store.read().get("workers")
+                                     or {}).items()
+                      if r.get("port")
+                      and time.time() - float(r.get("heartbeat", 0))
+                      <= 3.0)
+        scrape_s = []
+        completeness = 0.0
+        label_re = re.compile(r'worker="([^"]+)"')
+        for _ in range(max(8, args.obs_scrapes)):
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(admin + "/metrics/fleet",
+                                        timeout=10.0) as r:
+                text = r.read().decode()
+            scrape_s.append(time.perf_counter() - t0)
+            seen = set(label_re.findall(text))
+            if live:
+                completeness = max(
+                    completeness,
+                    len([w for w in live if w in seen]) / len(live))
+            time.sleep(0.05)
+        # spans land in the ring on exit, AFTER the response bytes —
+        # give the proxy a beat before reading its recent spans
+        time.sleep(0.3)
+        single_trace_ok = False
+        try:
+            _, dbg = _get(admin, "/debug/proxy", timeout=10.0)
+            for sp in dbg.get("recent_proxy_spans") or ():
+                if (sp.get("trace_id") in sent_ids
+                        and (sp.get("attrs") or {}).get("worker")):
+                    single_trace_ok = True
+                    break
+        except Exception:
+            pass
+
+        # ---- phase 3: SIGKILL one worker; traced replays + partial scrape
+        doc = store.read()
+        leader = (doc.get("leader") or {}).get("worker")
+        victims = [w for w in sorted(doc.get("workers") or {})
+                   if w != leader] or sorted(doc.get("workers") or {})
+        victim = victims[-1]
+        vpid = int(doc["workers"][victim]["pid"])
+        survivors = [w for w in live if w != victim]
+        os.kill(vpid, signal.SIGKILL)
+        partial_codes = []
+        scrape_errors_seen = False
+        survivor_always = True
+        t_end = time.monotonic() + 3.0
+        while time.monotonic() < t_end:
+            try:
+                with urllib.request.urlopen(admin + "/metrics/fleet",
+                                            timeout=10.0) as r:
+                    text = r.read().decode()
+                    partial_codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                partial_codes.append(e.code)
+                e.read()
+                text = ""
+            if "dl4j_fleet_scrape_errors_total" in text:
+                scrape_errors_seen = True
+            seen = set(label_re.findall(text))
+            if survivors and not all(w in seen for w in survivors):
+                survivor_always = False
+            time.sleep(0.2)
+        for i in range(args.obs_requests, args.obs_requests + 10):
+            traced_post(i, idem_key=f"obs-{i}")
+        partial_scrape_ok = bool(
+            partial_codes and all(c == 200 for c in partial_codes)
+            and survivor_always)
+        trace_coverage = (echoed[0] / attempted[0]) if attempted[0] else 0.0
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+        rec = {
+            "metric": "obsfleet_drill",
+            "platform": platform,
+            "value": round(trace_coverage, 4),
+            "unit": "trace_coverage",
+            "trace_coverage": round(trace_coverage, 4),
+            "federation_completeness": round(completeness, 4),
+            "scrape_p50_ms": (round(_quantile(scrape_s, 0.5) * 1e3, 3)
+                              if scrape_s else None),
+            "scrape_p99_ms": (round(_quantile(scrape_s, 0.99) * 1e3, 3)
+                              if scrape_s else None),
+            "single_trace_ok": single_trace_ok,
+            "partial_scrape_ok": partial_scrape_ok,
+            "partial_scrape_codes": partial_codes,
+            "scrape_errors_seen": scrape_errors_seen,
+            "traced_requests": attempted[0],
+            "echoed": echoed[0],
+            "live_workers": live,
+            "killed_worker": victim,
+            "workers": 2,
+            "seed": args.seed,
+        }
+        rec["ok_verdict"] = bool(
+            trace_coverage >= 0.95 and completeness == 1.0
+            and partial_scrape_ok and single_trace_ok)
+        return rec
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 # ----------------------------------------------------------------- record
 def _record(args, stats: "_Stats", stream: dict, vs_direct, workers,
             kill_drill, rollout=None) -> dict:
@@ -1127,12 +1338,31 @@ def main(argv=None) -> int:
                     default="store.read:error:0.02,store.write:error:0.02",
                     help="DL4J_TPU_FAULTS spec injected into every "
                          "fleet-chaos worker")
+    ap.add_argument("--fleet-obs", action="store_true",
+                    help="the graded 2-worker observability drill: "
+                         "caller-supplied trace ids end-to-end, timed "
+                         "/metrics/fleet scrapes, SIGKILL one worker "
+                         "and check partial federation + traced "
+                         "failover replays; archives OBSFLEET_r*.json")
+    ap.add_argument("--obs-requests", type=int, default=40,
+                    help="traced requests in the fleet-obs drill's "
+                         "steady phase")
+    ap.add_argument("--obs-scrapes", type=int, default=20,
+                    help="timed /metrics/fleet scrapes (fleet-obs)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.kill_drill and args.workers < 2:
         ap.error("--kill-drill needs --workers >= 2")
     import random
     rng = random.Random(args.seed)
+    if args.fleet_obs:
+        rec = run_fleet_obs(args, rng)
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0 if rec.get("ok_verdict") else 1
     if args.fleet_chaos:
         rec = run_fleet_chaos(args, rng)
         line = json.dumps(rec)

@@ -41,9 +41,17 @@ pays the whole-program XLA compile) vs. an AOT-warmed deploy (the
 request should sit within box noise of steady state), interleaved
 min-of-N in fresh subprocesses so every cold arm is genuinely cold.
 
+``--fleet-obs-ab`` runs the fleet-observability-plane A/B: per-request
+latency through a live ``FrontDoor`` with a caller-supplied
+``X-Dl4j-Trace-Id`` header, ``DL4J_TPU_FLEET_OBS=0`` (the pre-PR
+request path: no inbound-context parse, no response trace header) vs
+``=1`` (the full cross-process propagation path). Bar: <2% — trace
+propagation must be free enough to leave on in production.
+
 Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
      python benchmarks/obs_overhead.py --elastic-ab [--json]
      python benchmarks/obs_overhead.py --warmup-ab [--json]
+     python benchmarks/obs_overhead.py --fleet-obs-ab [--json]
 """
 from __future__ import annotations
 
@@ -286,6 +294,99 @@ def warmup_ab(batch: int, repeats: int, as_json: bool) -> float:
     return warm_first / steady
 
 
+#: fleet-observability A/B worker: a live in-process FrontDoor (the
+#: same demo scoring net tools/serve.py deploys), timed urllib POSTs to
+#: /v1/classify each carrying a caller-supplied X-Dl4j-Trace-Id. The
+#: arms differ ONLY in DL4J_TPU_FLEET_OBS: 0 is the pre-PR request path
+#: (inbound header ignored, no trace header on the response), 1 parses
+#: the inbound context, joins the span, and echoes the id — the cost
+#: this A/B exists to bound.
+_FLEET_OBS_WORKER = r"""
+import json, os, sys, time, urllib.request
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.serving import ModelRegistry, ServingRouter
+from deeplearning4j_tpu.serving.frontdoor import FrontDoor
+
+steps = int(sys.argv[1])
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(1).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+reg = ModelRegistry()
+reg.deploy("v1", net, sample_input=np.zeros((1, 4), dtype="f4"),
+           batch_limit=4, max_wait_ms=1.0)
+door = FrontDoor(ServingRouter(reg, "v1"), None, port=0).start()
+addr = f"http://127.0.0.1:{door.port}"
+body = json.dumps({"inputs": [[0.1, 0.2, 0.3, 0.4]]}).encode()
+
+
+def one(i):
+    req = urllib.request.Request(
+        addr + "/v1/classify", data=body,
+        headers={"Content-Type": "application/json",
+                 "X-Dl4j-Trace-Id": f"{0xB0000000 + i:016x}"})
+    with urllib.request.urlopen(req, timeout=30.0) as r:
+        r.read()
+
+
+for i in range(10):               # compile + socket churn outside the window
+    one(i)
+t0 = time.perf_counter()
+for i in range(steps):
+    one(i)
+wall = time.perf_counter() - t0
+door.stop()
+reg.shutdown()
+print(json.dumps({"seconds_per_step": wall / steps,
+                  "fleet_obs": os.environ.get("DL4J_TPU_FLEET_OBS", "1")}))
+"""
+
+#: fleet-obs A/B arm -> env overrides
+FLEET_OBS_MODES = {
+    "obs_off": {"DL4J_TPU_FLEET_OBS": "0"},
+    "obs_on": {"DL4J_TPU_FLEET_OBS": "1"},
+}
+
+
+def fleet_obs_ab(steps: int, repeats: int, as_json: bool) -> float:
+    """Interleaved min-of-N A/B (rotating arm order — the noisy-box
+    protocol): does cross-process trace propagation (inbound header
+    parse + joined span + response header) keep per-request front-door
+    latency under the 2% bar?"""
+    best = _interleaved_min(
+        list(FLEET_OBS_MODES), repeats,
+        lambda m: _run_worker(_FLEET_OBS_WORKER, [steps],
+                              FLEET_OBS_MODES[m]))
+    overhead = ((best["obs_on"] - best["obs_off"])
+                / best["obs_off"] * 100.0)
+    result = {"request_seconds_fleet_obs_off": best["obs_off"],
+              "request_seconds_fleet_obs_on": best["obs_on"],
+              "fleet_obs_overhead_percent": overhead,
+              "steps": steps, "repeats": repeats}
+    if as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"fleet observability A/B (traced /v1/classify, {steps} "
+              f"requests/arm, min of {repeats} interleaved repeats)")
+        print(f"  fleet obs off (DL4J_TPU_FLEET_OBS=0): "
+              f"{best['obs_off'] * 1e3:8.3f} ms/request")
+        print(f"  fleet obs on  (trace propagation):    "
+              f"{best['obs_on'] * 1e3:8.3f} ms/request")
+        print(f"  trace-propagation overhead: {overhead:+.2f}%  "
+              f"(bar: < 2%)")
+    return overhead
+
+
 #: mode name -> env overrides on top of the caller's environment
 MODES = {
     "off": {"DL4J_TPU_METRICS": "0"},
@@ -321,6 +422,9 @@ def main():
     ap.add_argument("--warmup-ab", action="store_true",
                     help="run the serving AOT-warmup A/B: first-request "
                          "latency with vs. without deploy warmup")
+    ap.add_argument("--fleet-obs-ab", action="store_true",
+                    help="run the fleet-observability A/B: front-door "
+                         "request latency with DL4J_TPU_FLEET_OBS=0 vs 1")
     ap.add_argument("--save-every", type=int, default=8,
                     help="elastic A/B checkpoint cadence in steps (the "
                          "perf posture; the exact-resume drills save "
@@ -332,6 +436,8 @@ def main():
                           args.save_every)
     if args.warmup_ab:
         return warmup_ab(args.batch, args.repeats, args.json)
+    if args.fleet_obs_ab:
+        return fleet_obs_ab(max(args.steps, 60), args.repeats, args.json)
 
     # a lone run is dominated by host warmup noise (the first subprocess
     # routinely runs 1.5x slower than steady state regardless of mode) —

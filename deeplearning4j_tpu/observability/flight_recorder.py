@@ -359,6 +359,14 @@ class FlightRecorder:
         # death during a fleet chaos run must name who led, under which
         # term, and what was (or was not) executed twice
         section("fleet.json", self._write_fleet)
+        if reason.startswith("incident:"):
+            # a coordinated peer capture: stamp the fleet-wide incident
+            # id INTO the bundle so a postmortem directory groups every
+            # worker's view of the same event
+            inc_id = reason.split(":", 1)[1].strip()
+            section("incident.json", lambda p: _write_json_file(p, {
+                "incident_id": inc_id, "reason": reason,
+                "pid": os.getpid(), "unix_time": time.time()}))
         try:
             global_registry().counter(
                 "dl4j_postmortem_dumps_total",
@@ -386,6 +394,12 @@ class FlightRecorder:
         with self._lock:
             self.dumps.append(bundle)
             self.dumps = [p for p in self.dumps if os.path.isdir(p)]
+        pub = _incident_publisher
+        if pub is not None:
+            try:
+                pub(reason, bundle)
+            except Exception:   # a broken publisher never masks the dump
+                pass
         return bundle
 
     @staticmethod
@@ -521,8 +535,27 @@ class FlightRecorder:
             json.dump(cfg, f, indent=2, default=str)
 
 
+def _write_json_file(path: str, payload: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+
+
 _global_recorder: Optional[FlightRecorder] = None
 _recorder_lock = threading.Lock()
+
+# coordinated incident capture (fleet observability plane): a process-
+# wide hook called after every bundle write with (reason, bundle_path).
+# The serving front door wires this to the shared-store incident ledger
+# so the LEADER can fan the capture out to every live worker.
+_incident_publisher = None
+
+
+def set_incident_publisher(fn) -> None:
+    """Install (or clear, with None) the post-dump incident hook.  The
+    hook runs OUTSIDE the recorder's lock, best-effort: a broken
+    publisher must never mask the dump that tripped it."""
+    global _incident_publisher
+    _incident_publisher = fn
 
 # process-wide crash-hook plumbing: ONE set of excepthook wrappers + one
 # atexit callback, dispatching to the currently-installed recorder

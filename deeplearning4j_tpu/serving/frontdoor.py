@@ -70,6 +70,7 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import federation as _fed
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
 from deeplearning4j_tpu.observability.tracing import (current_context,
@@ -243,7 +244,10 @@ def _route_of(path: str) -> str:
         return "generate"
     if path.startswith("/admin/"):
         return "admin"
-    if path.startswith("/debug/") or path in ("/metrics", "/health"):
+    if path.startswith("/debug/") or path in ("/metrics", "/health",
+                                              "/metrics/fleet",
+                                              "/health/fleet",
+                                              "/alerts/fleet"):
         return "debug"
     return "other"
 
@@ -279,6 +283,8 @@ class FrontDoor:
         self._sync_stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._started_at = time.time()
+        self._fleet_health = None       # lazy federation.FleetHealth
+        self._fleet_pub_at = 0.0        # leader rollup publish throttle
         FrontDoor._live.add(self)
 
     # ------------------------------------------------------------- lanes
@@ -387,6 +393,41 @@ class FrontDoor:
             except Exception:
                 # (store contention, transient fs)
                 pass
+            try:
+                self._fleet_obs_beat()
+            # graftlint: disable=typed-errors — the observability plane
+            # must never kill the serving process; the next beat retries
+            except Exception:
+                pass
+
+    def _fleet_health_view(self):
+        """This worker's federated health engine (lazy: built on first
+        ``/health/fleet`` or leader rollup; only valid in shared mode)."""
+        if self._fleet_health is None:
+            self._fleet_health = _fed.FleetHealth(self.shared.store,
+                                                  worker_id=self.worker_id)
+        return self._fleet_health
+
+    def _fleet_obs_beat(self):
+        """One beat of the fleet observability plane (rides the sync
+        loop; tests single-step it directly): run the incident fan-out
+        protocol, and — on the LEADER only, throttled to
+        ``DL4J_TPU_FLEET_HEALTH_INTERVAL_S`` — publish the fleet health
+        rollup into the shared store so every worker's ``/debug/fleet``
+        shows one consistent verdict."""
+        if self.shared is None or not _fed.fleet_obs_enabled():
+            return
+        _fed.incident_beat(self.shared.store, self.worker_id,
+                           self.shared.is_leader)
+        if not self.shared.is_leader:
+            return
+        now = time.monotonic()
+        if now - self._fleet_pub_at < _fed.health_interval_s():
+            return
+        self._fleet_pub_at = now
+        _fed.publish_rollup(self.shared.store, self.worker_id,
+                            self.shared.leader_term,
+                            self._fleet_health_view().evaluate())
 
     # -------------------------------------------------------------- serve
     def start(self) -> "FrontDoor":
@@ -400,10 +441,16 @@ class FrontDoor:
             def _tid(self):
                 """This request's trace id: captured inside the span
                 (so ERROR replies emitted after it closed still carry
-                it), falling back to any live ambient context."""
+                it), falling back to any live ambient context.  The
+                fallback is gated on the fleet plane — the pre-plane
+                span site still opens an http_request span when the
+                switch is OFF, and its ambient context must not leak a
+                header onto byte-identical pre-plane responses."""
                 tid = getattr(self, "_trace_id", None)
                 if tid is not None:
                     return tid
+                if not _fed.fleet_obs_enabled():
+                    return None
                 ctx = current_context()
                 return ctx.trace_id if ctx is not None else None
 
@@ -532,12 +579,38 @@ class FrontDoor:
                     raise BadRequest("body must be a JSON object")
                 return doc
 
+            def _send_text(self, body: bytes, route: str):
+                """Plain-text 200 (the Prometheus exposition paths).
+                With the fleet plane off ``self._trace_id`` is None and
+                the bytes on the wire are identical to the pre-
+                federation ``/metrics`` writer."""
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                tid = getattr(self, "_trace_id", None)
+                if tid is not None:
+                    self.send_header("X-Dl4j-Trace-Id", str(tid))
+                self.end_headers()
+                self.wfile.write(body)
+                _HttpMetrics.get().requests(route, 200).inc()
+
             # --------------------------------------------------- routes
             def do_POST(self):
                 path = urlparse(self.path).path
                 route = _route_of(path)
                 t0 = time.perf_counter()
                 self._trace_id = None
+                self._obs_ctx = None
+                if _fed.fleet_obs_enabled():
+                    # fleet plane: join the caller's trace (or pre-
+                    # allocate a root id) BEFORE the early exits, so
+                    # EVERY response path — 404, disabled-503, quota/
+                    # inflight-429, idempotent replay — carries
+                    # X-Dl4j-Trace-Id
+                    self._obs_ctx = _fed.inbound_context(self.headers)
+                    self._trace_id = self._obs_ctx.trace_id
                 self._idem_key = None
                 self._idem_executing = False
                 obs = _HttpMetrics.get()
@@ -605,14 +678,24 @@ class FrontDoor:
                             extra_headers=(_retry_after_header(),))
                         return
                 try:
-                    with _span("http_request", route=route):
+                    # trace_context(None) is effect-free, so with the
+                    # fleet plane off this line is byte-identical to the
+                    # pre-federation span site; with it on, the root
+                    # span's trace/parent ids are the CALLER's
+                    with trace_context(self._obs_ctx), \
+                            _span("http_request", route=route):
                         # capture the id while the span is OPEN: error
                         # replies run after it closes and must still
                         # carry the header (the join-to-traces contract
-                        # matters MOST for failing requests)
-                        ctx = current_context()
-                        self._trace_id = (ctx.trace_id
-                                          if ctx is not None else None)
+                        # matters MOST for failing requests).  Gated on
+                        # the plane being ON — the span exists either
+                        # way, but with DL4J_TPU_FLEET_OBS=0 no header
+                        # may leak (byte-identical pre-plane responses)
+                        if self._obs_ctx is not None:
+                            ctx = current_context()
+                            self._trace_id = (ctx.trace_id
+                                              if ctx is not None
+                                              else self._trace_id)
                         try:
                             if _faults.armed():
                                 _faults.check("http.request")
@@ -835,6 +918,12 @@ class FrontDoor:
                 route = _route_of(path)
                 t0 = time.perf_counter()
                 self._trace_id = None
+                fleet_on = _fed.fleet_obs_enabled()
+                if fleet_on:
+                    # same join-at-the-door as do_POST: a caller-
+                    # supplied id echoes on every GET path too
+                    self._trace_id = _fed.inbound_context(
+                        self.headers).trace_id
                 try:
                     if path == "/debug/frontdoor":
                         self._reply(200, fd.snapshot(), route, t0)
@@ -852,15 +941,29 @@ class FrontDoor:
                     elif path == "/metrics":
                         from deeplearning4j_tpu.observability import metrics
                         body = metrics().render_prometheus().encode()
-                        self.send_response(200)
-                        self.send_header(
-                            "Content-Type",
-                            "text/plain; version=0.0.4; charset=utf-8")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                        obs = _HttpMetrics.get()
-                        obs.requests(route, 200).inc()
+                        self._send_text(body, route)
+                    elif (path == "/metrics/fleet" and fleet_on
+                          and fd.shared is not None):
+                        # the federated scrape: every live worker's
+                        # series with a `worker` label plus this
+                        # process's own — partial (200) when a peer is
+                        # unreachable, never a 500 because one died
+                        body = _fed.render_fleet(
+                            fd.shared.store,
+                            local_worker=fd.worker_id).encode()
+                        self._send_text(body, route)
+                    elif (path == "/health/fleet" and fleet_on
+                          and fd.shared is not None):
+                        from deeplearning4j_tpu.observability.slo import (
+                            FAILING)
+                        report = fd._fleet_health_view().evaluate()
+                        self._reply(
+                            503 if report["status"] == FAILING else 200,
+                            report, route, t0)
+                    elif (path == "/alerts/fleet" and fleet_on
+                          and fd.shared is not None):
+                        self._reply(200, fd._fleet_health_view().alerts(),
+                                    route, t0)
                     elif path == "/health":
                         from deeplearning4j_tpu.observability.slo import (
                             FAILING, global_slo_engine)
@@ -901,6 +1004,11 @@ class FrontDoor:
                                         name="dl4j-frontdoor-http")
         self._thread.start()
         if self.shared is not None:
+            # wire this worker's flight recorder into the coordinated-
+            # capture protocol (the hook itself checks the live
+            # DL4J_TPU_FLEET_OBS switch, so installing is inert when off)
+            _fed.install_incident_publisher(self.shared.store,
+                                            self.worker_id)
             self._sync_thread = threading.Thread(
                 target=self._sync_loop, daemon=True,
                 name="dl4j-frontdoor-sync")
@@ -970,8 +1078,24 @@ def fleet_snapshot() -> dict:
             "shared": (f.shared.snapshot()
                        if f.shared is not None else None),
         })
-    return {
+    out = {
         "fence_enabled": _ss.fleet_fence_enabled(),
         "idempotency": _idem.snapshot(),
         "frontdoors": doors,
     }
+    if _fed.fleet_obs_enabled():
+        # the leader-published rollup and the incident ledger: ONE
+        # consistent fleet verdict, whichever worker answered this GET
+        for f in list(FrontDoor._live):
+            if f.shared is None or f._httpd is None:
+                continue
+            try:
+                doc = f.shared.store.read()
+            # graftlint: disable=typed-errors — a torn store read must
+            # not break the debug surface; the base payload stands
+            except Exception:
+                break
+            out["fleet_health"] = doc.get("fleet_health")
+            out["incidents"] = doc.get("incidents") or []
+            break
+    return out

@@ -241,6 +241,74 @@ def main():
     for rule in health["rules"]:
         print(f"  {rule['rule']:<32} {rule['status']}")
 
+    # ---- fleet observability plane: /metrics/fleet + /health/fleet ------
+    # one worker registered in a shared store, a traced request through
+    # its front door (the caller's X-Dl4j-Trace-Id comes back on the
+    # response — the same id the worker's spans carry), then the
+    # federated scrape: every live worker's series merged under a
+    # worker="..." label, and the fleet health rollup graded over them
+    import os as _os
+    import tempfile as _tempfile
+
+    from deeplearning4j_tpu.serving import ModelRegistry, ServingRouter
+    from deeplearning4j_tpu.serving.frontdoor import FrontDoor
+    from deeplearning4j_tpu.serving.shared_state import (SharedServingState,
+                                                         SharedStore)
+
+    fleet_reg = ModelRegistry()
+    fleet_reg.deploy("v1", net, sample_input=x[:1], batch_limit=8,
+                     max_wait_ms=1.0)
+    fleet_store = SharedStore(_tempfile.mkdtemp(prefix="dl4j-ui-fleet-"))
+    shared = SharedServingState(fleet_store, "fw0")
+    shared.ensure_lane("scoring", "v1")
+    door = FrontDoor(ServingRouter(fleet_reg, "v1"), None, shared=shared,
+                     port=0).start()
+    shared.register(_os.getpid(), door.port)
+    try:
+        # let the sync loop take the leader lease (a leaderless fleet
+        # grades fleet_leader_staleness degraded — correctly)
+        import time as _time
+        for _ in range(40):
+            if (fleet_store.read().get("leader") or {}).get("worker"):
+                break
+            _time.sleep(0.1)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{door.port}/v1/classify",
+            data=_json.dumps({"inputs": x[:1].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Dl4j-Trace-Id": "cafe0000deadbeef"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            r.read()
+            echoed = r.headers.get("X-Dl4j-Trace-Id")
+        print(f"\ntraced request: sent trace id cafe0000deadbeef, "
+              f"response echoed {echoed}")
+        fleet_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{door.port}/metrics/fleet",
+            timeout=10).read().decode()
+        highlights = [l for l in fleet_text.splitlines()
+                      if 'worker="' in l
+                      and l.startswith(("dl4j_http_requests_total",
+                                        "dl4j_fleet_scrape"))][:6]
+        print(f"/metrics/fleet ({len(fleet_text.splitlines())} lines, "
+              f"every series labeled by worker); highlights:")
+        for line in highlights:
+            print("  " + line)
+        try:
+            fleet_health = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{door.port}/health/fleet",
+                timeout=10).read())
+        except urllib.error.HTTPError as e:   # 503 when the fleet FAILS
+            fleet_health = _json.loads(e.read())
+        print(f"/health/fleet: {fleet_health['status']} "
+              f"(workers scraped: {fleet_health['workers_scraped']})")
+        for rule in fleet_health["rules"]:
+            by = rule.get("worker")
+            print(f"  {rule['rule']:<32} {rule['status']}"
+                  + (f" (worst: {by})" if by else ""))
+    finally:
+        door.stop()
+        fleet_reg.shutdown()
+
     if args.keep_serving:
         print("serving — ctrl-c to exit")
         import time

@@ -73,6 +73,7 @@ _DECODE_RE = re.compile(r"DECODE_r(\d+)[^/]*\.json$")
 _SERVE_RE = re.compile(r"SERVE_r(\d+)[^/]*\.json$")
 _QOS_RE = re.compile(r"QOS_r(\d+)[^/]*\.json$")
 _FLEET_RE = re.compile(r"FLEET_r(\d+)[^/]*\.json$")
+_OBSFLEET_RE = re.compile(r"OBSFLEET_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -488,6 +489,72 @@ def check_fleet_bool(samples: List[FleetSample]) -> List[str]:
     return out
 
 
+class ObsFleetSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                      # "obsfleet_drill"
+    platform: Optional[str]
+    trace_coverage: Optional[float]  # fraction of requests whose caller
+                                     # trace id round-tripped — gated
+                                     # sustained-only
+    federation_completeness: Optional[float]  # live workers present in
+                                              # /metrics/fleet / live
+                                              # workers — gated
+    scrape_p99_ms: Optional[float]   # reported, never gated (weather)
+
+
+def load_obsfleet(root: str) -> List[ObsFleetSample]:
+    """``OBSFLEET_r*.json`` observability-drill archives
+    (``benchmarks/http_load.py --fleet-obs`` records, bare or
+    driver-wrapped). Anything without an ``obsfleet_`` metric — alien
+    JSON — is ignored, never fatal."""
+    out: List[ObsFleetSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "OBSFLEET_r*.json"))):
+        m = _OBSFLEET_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("obsfleet_"):
+            continue
+        cov = doc.get("trace_coverage", doc.get("value"))
+        comp = doc.get("federation_completeness")
+        out.append(ObsFleetSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            trace_coverage=(float(cov)
+                            if isinstance(cov, (int, float)) else None),
+            federation_completeness=(float(comp)
+                                     if isinstance(comp, (int, float))
+                                     else None),
+            scrape_p99_ms=(float(doc["scrape_p99_ms"])
+                           if isinstance(doc.get("scrape_p99_ms"),
+                                         (int, float)) else None)))
+    return out
+
+
+def check_obsfleet(samples: List[ObsFleetSample],
+                   tolerance: float = DEFAULT_TOLERANCE,
+                   sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the observability-drill trajectory under the same
+    noise-aware rules: trace coverage and federation completeness
+    sustained-only (both same-run fractions, drift-immune); the raw
+    scrape p99 is host weather — reported, never gated."""
+    return _grade_metric_groups(samples, [
+        ("trace_coverage", lambda s: s.trace_coverage),
+        ("federation_completeness",
+         lambda s: s.federation_completeness),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -582,8 +649,9 @@ def main(argv=None) -> int:
     serves = load_serve(root)
     qos = load_qos(root)
     fleet = load_fleet(root)
+    obsfleet = load_obsfleet(root)
     if (not samples and not dryruns and not decodes and not serves
-            and not qos and not fleet):
+            and not qos and not fleet and not obsfleet):
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
@@ -591,7 +659,7 @@ def main(argv=None) -> int:
         return 0
     regressions = (check_trajectory(samples) + check_decode(decodes)
                    + check_serve(serves) + check_qos(qos)
-                   + check_fleet(fleet))
+                   + check_fleet(fleet) + check_obsfleet(obsfleet))
     breaks = check_multichip(dryruns) + check_fleet_bool(fleet)
     for s in samples:
         marks = []
@@ -649,6 +717,17 @@ def main(argv=None) -> int:
             marks.append(f"p99={s.p99_ms:.1f}ms")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + " ".join(marks))
+    for s in obsfleet:
+        marks = []
+        if s.trace_coverage is not None:
+            marks.append(f"trace_coverage={s.trace_coverage:.3f}")
+        if s.federation_completeness is not None:
+            marks.append(
+                f"federation={s.federation_completeness:.3f}")
+        if s.scrape_p99_ms is not None:
+            marks.append(f"scrape_p99={s.scrape_p99_ms:.1f}ms")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
@@ -657,7 +736,8 @@ def main(argv=None) -> int:
         print(f"bench trajectory OK ({len(samples)} bench + "
               f"{len(dryruns)} dryrun + {len(decodes)} decode + "
               f"{len(serves)} serve + {len(qos)} qos + "
-              f"{len(fleet)} fleet samples under {root})")
+              f"{len(fleet)} fleet + {len(obsfleet)} obsfleet samples "
+              f"under {root})")
     return len(regressions) + len(breaks)
 
 

@@ -171,6 +171,12 @@ def check_package(root: str) -> List[Violation]:
     return out
 
 
+#: out-of-package files that register fleet series (the serve.py proxy
+#: is a tool, not package code, but its dl4j_* names land on the same
+#: /metrics/fleet surface — they obey the same conventions)
+EXTRA_FILES = ("tools/serve.py",)
+
+
 @register
 class MetricNamesChecker:
     rule = "metric-names"
@@ -184,3 +190,22 @@ class MetricNamesChecker:
                         "see tools/check_metric_names.py docstring for "
                         "the full conventions")
                 for v in check_tree(ctx.tree, ctx.relpath)]
+
+    def check_repo(self, repo_root: str, contexts) -> List[Finding]:
+        """This rule ALONE also covers :data:`EXTRA_FILES` outside the
+        package walk (a whole-repo walk would unleash every checker on
+        tool scripts that deliberately don't follow package invariants)."""
+        out: List[Finding] = []
+        for rel in EXTRA_FILES:
+            path = os.path.join(repo_root, *rel.split("/"))
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            out.extend(Finding(self.rule, rel, v.line,
+                               f"{v.metric}: {v.message}",
+                               "see tools/check_metric_names.py "
+                               "docstring for the full conventions")
+                       for v in check_source(source, rel))
+        return out

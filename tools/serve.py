@@ -48,6 +48,76 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 
+def _fleet_obs_on() -> bool:
+    """The fleet observability plane's kill switch, read LIVE and
+    without importing the package — with ``DL4J_TPU_FLEET_OBS=0`` the
+    proxy's wire path stays byte-identical to the pre-federation code
+    (no spans, no header injection, no admin server)."""
+    return os.environ.get("DL4J_TPU_FLEET_OBS", "1") != "0"
+
+
+class _ProxyMetrics:
+    """The proxy process's OWN ``dl4j_*`` series (fleet observability
+    satellite: before this, the failover/circuit counters were visible
+    only via the shared-store re-export inside workers).  Served on the
+    admin port's ``/metrics`` and folded into ``/metrics/fleet`` under
+    ``worker="proxy"``."""
+
+    _instance = None
+    _lock = threading.Lock()
+    _reset_hooked = False
+
+    def __init__(self):
+        from deeplearning4j_tpu.observability import global_registry
+        reg = global_registry()
+        self.failovers = reg.counter(
+            "dl4j_fleet_failovers_total",
+            "proxy requests re-sent to another worker after a backend "
+            "connect/first-byte failure")
+        self._connect_failures = reg.counter(
+            "dl4j_proxy_connect_failures_total",
+            "backend connect/first-byte failures seen by the proxy, by "
+            "worker port",
+            label_names=("port",))
+        self._ejections = reg.counter(
+            "dl4j_proxy_ejections_total",
+            "backends skipped while their circuit was open, by worker "
+            "port",
+            label_names=("port",))
+        self._circuit_open = reg.gauge(
+            "dl4j_proxy_circuit_open",
+            "1 while the proxy's breaker for a worker port is refusing "
+            "connects, else 0",
+            label_names=("port",))
+        self.inflight = reg.gauge(
+            "dl4j_proxy_inflight",
+            "client connections the proxy is currently serving (its "
+            "queue depth on the wire)")
+
+    def connect_failures(self, port):
+        return self._connect_failures.labels(port=str(port))
+
+    def ejections(self, port):
+        return self._ejections.labels(port=str(port))
+
+    def circuit_open(self, port):
+        return self._circuit_open.labels(port=str(port))
+
+    @classmethod
+    def get(cls) -> "_ProxyMetrics":
+        if cls._instance is None:
+            with cls._lock:
+                if cls._instance is None:
+                    cls._instance = cls()
+                    if not cls._reset_hooked:
+                        from deeplearning4j_tpu.observability import (
+                            on_registry_reset)
+                        on_registry_reset(
+                            lambda: setattr(cls, "_instance", None))
+                        cls._reset_hooked = True
+        return cls._instance
+
+
 # --------------------------------------------------------------- worker
 def _build_demo(slots: int, generative: bool):
     """The demo deploys: two equivalent scoring nets (v1/v2 — a canary
@@ -168,13 +238,17 @@ class _SpliceProxy:
         now = time.time()
         try:
             doc = self._store.read()
-            ports = [int(rec["port"]) for _, rec in
+            pairs = [(int(rec["port"]), wid) for wid, rec in
                      sorted((doc.get("workers") or {}).items())
                      if rec.get("port")
                      and now - float(rec.get("heartbeat", 0)) <= 3.0]
+            ports = [p for p, _ in pairs]
             if ports:
                 with self._lock:
                     self._last_ports = ports
+                    # port → worker id, so the proxy span can stamp WHO
+                    # it routed to (fleet observability plane)
+                    self._port_wids = dict(pairs)
         except Exception:
             # a store read blip (injected store.read fault, transient
             # fs) must not drop client connections: route on the last
@@ -324,10 +398,23 @@ class _HttpProxy(_SpliceProxy):
         except Exception:
             pass            # stats are best-effort; next note retries
 
+    def debug_snapshot(self) -> dict:
+        """The admin port's ``/debug/proxy`` extra: lifetime failover/
+        ejection counts and each backend breaker's live state."""
+        with self._lock:
+            out = {"mode": "http", "failovers": self._failovers,
+                   "ejections": self._ejections,
+                   "backends": dict(getattr(self, "_port_wids", {}))}
+            breakers = dict(self._breakers)
+        out["breakers"] = {str(port): str(getattr(brk, "state", "?"))
+                           for port, brk in sorted(breakers.items())}
+        return out
+
     @staticmethod
     def _read_request(client):
         """Buffer one full HTTP request (line + headers + body by
-        Content-Length). Returns (raw_bytes, replay_safe) or None."""
+        Content-Length). Returns (raw_bytes, replay_safe, header_map)
+        or None."""
         client.settimeout(30.0)
         f = client.makefile("rb")
         line = f.readline(65536)
@@ -352,7 +439,7 @@ class _HttpProxy(_SpliceProxy):
         method = line.split(b" ", 1)[0].upper()
         replay_safe = (method in (b"GET", b"HEAD")
                        or b"x-dl4j-idempotency-key" in hmap)
-        return b"".join(chunks), replay_safe
+        return b"".join(chunks), replay_safe, hmap
 
     def _splice(self, client: socket.socket):
         try:
@@ -365,15 +452,53 @@ class _HttpProxy(_SpliceProxy):
             except OSError:
                 pass
             return
-        raw, replay_safe = req
+        raw, replay_safe, hmap = req
+        if not _fleet_obs_on():
+            # kill-switch path: the pre-federation proxy, byte-for-byte
+            # (no span, no header rewrite, no proxy-local metrics)
+            self._forward(client, raw, replay_safe, None)
+            return
+        from deeplearning4j_tpu.observability import federation as fed
+        from deeplearning4j_tpu.observability.tracing import (span,
+                                                              trace_context)
+        metrics = _ProxyMetrics.get()
+        ctx = fed.trace_context_from_bytes(hmap)
+        metrics.inflight.inc(1)
+        try:
+            # the proxy's OWN span per connection: joined to the
+            # caller's context when the client sent one, and the parent
+            # of the worker's http_request span via the injected
+            # headers — ONE trace id across proxy, worker, and response
+            with trace_context(ctx):
+                with span("proxy_request",
+                          replay_safe=bool(replay_safe)) as sp:
+                    tid = getattr(sp, "trace_id", None) or ctx.trace_id
+                    parent = getattr(sp, "span_id", None) or ctx.span_id
+                    out = fed.inject_trace_headers(raw, tid, parent)
+                    self._forward(client, out, replay_safe, sp)
+        finally:
+            metrics.inflight.inc(-1)
+
+    def _forward(self, client: socket.socket, raw: bytes,
+                 replay_safe: bool, sp):
+        """The backend loop: connect → re-send the buffered request →
+        failover per the replay-safety rules.  ``sp`` is the open
+        ``proxy_request`` span (None on the kill-switch path, which
+        also disables the proxy-local metrics)."""
+        metrics = _ProxyMetrics.get() if sp is not None else None
         attempted = 0
         for port in self._backends():
             brk = self._breaker(port)
             if not brk.allow():
                 self._note(ejection=True)    # health-ejected backend
+                if metrics is not None:
+                    metrics.ejections(port).inc()
+                    metrics.circuit_open(port).set(1.0)
                 continue
             if attempted:
                 self._note(failover=True)
+                if metrics is not None:
+                    metrics.failovers.inc()
             attempted += 1
             upstream = None
             delivered = False
@@ -394,14 +519,29 @@ class _HttpProxy(_SpliceProxy):
                     except OSError:
                         pass
                 brk.record_failure()
+                if metrics is not None:
+                    metrics.connect_failures(port).inc()
                 if delivered and not replay_safe:
                     # the request may have EXECUTED before the death —
                     # with no idempotency key there is no safe retry
                     # (a re-send could double-execute / double-charge);
                     # the client sees the reset and owns the decision
+                    if sp is not None:
+                        sp.set_attr("outcome", "reset")
                     break
                 continue            # next backend gets the same bytes
             brk.record_success()
+            if metrics is not None:
+                metrics.circuit_open(port).set(0.0)
+            if sp is not None:
+                # stamp WHO served it (and how many hops it took): the
+                # cross-process join point for the access log
+                sp.set_attr("worker_port", port)
+                sp.set_attr(
+                    "worker",
+                    getattr(self, "_port_wids", {}).get(port))
+                sp.set_attr("failovers", attempted - 1)
+                sp.set_attr("outcome", "ok")
             upstream.settimeout(None)
             try:
                 client.sendall(first)
@@ -419,6 +559,8 @@ class _HttpProxy(_SpliceProxy):
                     except OSError:
                         pass
             return
+        if sp is not None:
+            sp.set_attr("outcome", "no_backend")
         try:
             client.close()          # no live backend took the request
         except OSError:
@@ -487,13 +629,34 @@ def run_fleet(args) -> int:
         else:
             proxy = _SpliceProxy(store, args.host or "127.0.0.1",
                                  args.port)
+    admin = None
+    if proxy is not None and _fleet_obs_on():
+        # the fleet observability plane's admin surface on the proxy:
+        # its own /metrics plus the federated /metrics/fleet,
+        # /health/fleet, /alerts/fleet and /debug/proxy views
+        try:
+            from deeplearning4j_tpu.observability.federation import (
+                FleetAdminServer)
+            _ProxyMetrics.get()     # register the proxy series up front
+            admin = FleetAdminServer(
+                store, host=args.host or "127.0.0.1",
+                port=args.admin_port, local_worker="proxy",
+                debug_extra=getattr(proxy, "debug_snapshot",
+                                    None)).start()
+        except Exception as e:
+            print(f"fleet admin server failed to start: {e!r}",
+                  file=sys.stderr, flush=True)
+            admin = None
     address = f"http://127.0.0.1:{proxy.port if proxy else args.port}"
-    print(json.dumps({
+    announce = {
         "fleet": {w: children[w].pid for w in wids},
         "address": address,
         "state_dir": args.state_dir,
         "mode": "reuseport" if args.reuseport else "proxy",
-    }), flush=True)
+    }
+    if admin is not None:
+        announce["admin_address"] = admin.get_address()
+    print(json.dumps(announce), flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
@@ -510,6 +673,8 @@ def run_fleet(args) -> int:
                                       "pid": children[wid].pid}),
                           flush=True)
     finally:
+        if admin is not None:
+            admin.stop()
         if proxy is not None:
             proxy.stop()
         for proc in children.values():
@@ -548,6 +713,12 @@ def main(argv=None) -> int:
                          "the next live worker; sized ABOVE GC/SIGSTOP-"
                          "class pauses so a paused worker is waited "
                          "out, never duplicated")
+    ap.add_argument("--admin-port", type=int, default=0,
+                    help="proxy admin/observability port (0 = "
+                         "ephemeral, announced as admin_address): "
+                         "serves /metrics, /metrics/fleet, "
+                         "/health/fleet, /alerts/fleet, /debug/proxy "
+                         "when the fleet observability plane is on")
     ap.add_argument("--spinup-timeout-s", type=float, default=180.0)
     ap.add_argument("--worker-id", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
