@@ -1248,6 +1248,267 @@ def run_fleet_obs(args, rng) -> dict:
             proc.kill()
 
 
+def run_trace_intel(args, rng) -> dict:
+    """The graded trace-intelligence drill (archives TRACEQ_r*.json):
+    a 2-worker fleet behind the splice proxy, trace store on with head
+    sampling at 0.1 and the tail rule at p90.  Phase 1 sends boring
+    classify traffic (the head-sample volume bound) and short generates
+    (warming the per-endpoint tail windows).  Phase 2 sends requests
+    that MUST be retained: bad-input 400s and tiny-deadline 504s (error
+    rule) under caller-supplied trace ids, then long generates that
+    overshoot the warmed p90 (latency-tail rule).  Each expected id is
+    then assembled through the proxy admin's ``/debug/trace/<id>`` and
+    must stitch proxy + worker spans into one waterfall (retention
+    coverage and assembly completeness, both gated).  Phase 3 SIGKILLs
+    one worker: fresh error requests ride the failover and must still
+    retain + assemble from the survivor, old ids must answer 200
+    (partial) or 404 — never a 5xx — and the boring head-sampled volume
+    must stay bounded.  Assembly latency p99 is reported, never gated
+    (host weather)."""
+    state_dir = args.state_dir or f"/tmp/dl4j-trace-intel-{os.getpid()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DL4J_TPU_TRACE_SAMPLE="0.1", DL4J_TPU_TRACE_TAIL_Q="0.9")
+    env.pop("DL4J_TPU_FLEET_OBS", None)     # the drill grades the ON path
+    env.pop("DL4J_TPU_TRACE_STORE", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve.py"),
+         "--workers", "2", "--port", "0", "--state-dir", state_dir,
+         "--slots", str(args.slots), "--no-respawn"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    store = _fleet_store(state_dir)
+    try:
+        fleet = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("tools/serve.py exited before "
+                                   "announcing the fleet")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "fleet" in doc:
+                fleet = doc
+                break
+        if fleet is None:
+            raise RuntimeError("fleet announce line never arrived")
+        addr = fleet["address"]
+        admin = fleet.get("admin_address")
+        if not admin:
+            raise RuntimeError("fleet announce carried no admin_address "
+                               "(is DL4J_TPU_FLEET_OBS off?)")
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                _get(addr, "/debug/frontdoor", timeout=5.0)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet never answered")
+                time.sleep(0.5)
+
+        def traced(path: str, doc: dict, tid: str, idem_key=None):
+            """POST with a caller-supplied trace id; returns the HTTP
+            status (connection death retries once — the failover path
+            must still produce a retained trace)."""
+            headers = {"Content-Type": "application/json",
+                       "X-Dl4j-Trace-Id": tid}
+            if idem_key is not None:
+                headers["X-Dl4j-Idempotency-Key"] = idem_key
+            req = urllib.request.Request(
+                addr + path, data=json.dumps(doc).encode(),
+                headers=headers)
+            for attempt in (1, 2):
+                try:
+                    with urllib.request.urlopen(req, timeout=60.0) as r:
+                        r.read()
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    e.read()
+                    return e.code
+                except Exception:
+                    if attempt == 2:
+                        return None
+            return None
+
+        assemble_s = []
+
+        def assemble(tid: str):
+            """GET the assembled waterfall through the proxy admin;
+            returns (status, doc-or-None), timing every call."""
+            t0 = time.perf_counter()
+            try:
+                code, doc = _get(admin, f"/debug/trace/{tid}",
+                                 timeout=10.0)
+            except urllib.error.HTTPError as e:
+                code, doc = e.code, None
+                e.read()
+            assemble_s.append(time.perf_counter() - t0)
+            return code, doc
+
+        def stitched(doc) -> bool:
+            """Does the assembled doc carry the proxy hop AND a serving
+            worker's spans under one trace?"""
+            if not doc:
+                return False
+            names = {s.get("name") for s in doc.get("waterfall") or ()}
+            workers = {s.get("worker") for s in doc.get("waterfall") or ()}
+            return ("proxy_request" in names and "http_request" in names
+                    and len(workers) >= 2)
+
+        # ---- phase 1: boring traffic (head bound) + tail-window warmup
+        boring_ids = [f"{0xC0000000 + i:016x}" for i in range(40)]
+        for i, tid in enumerate(boring_ids):
+            traced("/v1/classify", {
+                "inputs": [[round(rng.uniform(0, 1), 6)
+                            for _ in range(4)]],
+                "request_key": i}, tid)
+        for i in range(40):          # short generates warm BOTH workers'
+            traced("/v1/generate",   # /v1/generate tail windows past the
+                   {"prompt": [1 + i % 50, 2, 3],   # 16-sample minimum
+                    "max_new_tokens": 2, "request_key": 1000 + i},
+                   f"{0xD0000000 + i:016x}")
+
+        # ---- phase 2: requests the retention rules MUST keep
+        error_ids = []
+        for i in range(6):           # in-span 400s: bad input
+            tid = f"{0xA0000000 + i:016x}"
+            error_ids.append(tid)
+            traced("/v1/classify", {"oops": 1, "request_key": 2000 + i},
+                   tid)
+        for i in range(6, 12):       # in-span 504s: unmeetable deadline
+            tid = f"{0xA0000000 + i:016x}"
+            error_ids.append(tid)
+            traced("/v1/classify", {
+                "inputs": [[0.1, 0.2, 0.3, 0.4]],
+                "deadline_ms": 0.001, "request_key": 2000 + i}, tid)
+        tail_ids = []
+        for i in range(4):           # long generates overshoot the p90
+            tid = f"{0xB0000000 + i:016x}"
+            tail_ids.append(tid)
+            traced("/v1/generate",
+                   {"prompt": [1 + i, 2, 3], "max_new_tokens": 16,
+                    "request_key": 3000 + i}, tid)
+        time.sleep(0.3)              # spans land after response bytes
+
+        # ---- retention + assembly over every expected id
+        expected = error_ids + tail_ids
+        retained_ok = assembled_ok = 0
+        for tid in expected:
+            code, doc = assemble(tid)
+            if code == 200 and doc:
+                retained_ok += 1
+                if stitched(doc):
+                    assembled_ok += 1
+        retention_coverage = retained_ok / len(expected)
+        assembly_completeness = (assembled_ok / retained_ok
+                                 if retained_ok else 0.0)
+        chrome_ok = False
+        try:
+            code, cdoc = _get(
+                admin, f"/debug/trace/{expected[0]}?format=chrome",
+                timeout=10.0)
+            events = (cdoc.get("traceEvents")
+                      if isinstance(cdoc, dict) else cdoc)
+            chrome_ok = code == 200 and bool(events)
+        except Exception:
+            pass
+        reasons_seen = set()
+        try:
+            code, rec_doc = _get(admin, "/debug/trace/recent?limit=200",
+                                 timeout=10.0)
+            for t in rec_doc.get("traces") or ():
+                reasons_seen.add(t.get("reason"))
+        except Exception:
+            pass
+
+        # ---- phase 3: SIGKILL one worker; retention must survive
+        doc = store.read()
+        leader = (doc.get("leader") or {}).get("worker")
+        live = sorted(w for w, r in (doc.get("workers") or {}).items()
+                      if r.get("port")
+                      and time.time() - float(r.get("heartbeat", 0))
+                      <= 3.0)
+        victims = [w for w in sorted(doc.get("workers") or {})
+                   if w != leader] or sorted(doc.get("workers") or {})
+        victim = victims[-1]
+        vpid = int(doc["workers"][victim]["pid"])
+        os.kill(vpid, signal.SIGKILL)
+        postkill_ids = []
+        for i in range(6):           # fresh errors must ride failover
+            tid = f"{0xE0000000 + i:016x}"
+            postkill_ids.append(tid)
+            traced("/v1/classify", {"oops": 1, "request_key": 4000 + i},
+                   tid, idem_key=f"traceq-{i}")
+        time.sleep(0.3)
+        postkill_ok = 0
+        for tid in postkill_ids:
+            code, adoc = assemble(tid)
+            if code == 200 and adoc:
+                postkill_ok += 1
+        postkill_coverage = postkill_ok / len(postkill_ids)
+        # old ids: partial (200) or gone with the dead store (404) —
+        # a dead worker must NEVER turn assembly into a 5xx
+        partial_never_5xx = True
+        for tid in expected[:6]:
+            code, _doc2 = assemble(tid)
+            if code >= 500:
+                partial_never_5xx = False
+
+        # ---- head-sampled volume stays bounded
+        boring_retained = 0
+        for tid in boring_ids:
+            code, _doc3 = assemble(tid)
+            if code == 200:
+                boring_retained += 1
+        head_fraction = boring_retained / len(boring_ids)
+        head_bounded = head_fraction <= 0.5
+
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+        rec = {
+            "metric": "traceq_drill",
+            "platform": platform,
+            "value": round(retention_coverage, 4),
+            "unit": "retention_coverage",
+            "retention_coverage": round(retention_coverage, 4),
+            "assembly_completeness": round(assembly_completeness, 4),
+            "assembly_p50_ms": (round(_quantile(assemble_s, 0.5) * 1e3, 3)
+                                if assemble_s else None),
+            "assembly_p99_ms": (round(_quantile(assemble_s, 0.99) * 1e3, 3)
+                                if assemble_s else None),
+            "postkill_coverage": round(postkill_coverage, 4),
+            "partial_never_5xx": partial_never_5xx,
+            "chrome_export_ok": chrome_ok,
+            "reasons_seen": sorted(r for r in reasons_seen if r),
+            "head_sample_fraction": round(head_fraction, 4),
+            "head_bounded": head_bounded,
+            "error_requests": len(error_ids),
+            "tail_requests": len(tail_ids),
+            "postkill_requests": len(postkill_ids),
+            "live_workers": live,
+            "killed_worker": victim,
+            "workers": 2,
+            "seed": args.seed,
+        }
+        rec["ok_verdict"] = bool(
+            retention_coverage == 1.0 and assembly_completeness == 1.0
+            and postkill_coverage == 1.0 and partial_never_5xx
+            and head_bounded and chrome_ok
+            and {"error", "latency_tail"} <= reasons_seen)
+        return rec
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 # ----------------------------------------------------------------- record
 def _record(args, stats: "_Stats", stream: dict, vs_direct, workers,
             kill_drill, rollout=None) -> dict:
@@ -1349,12 +1610,27 @@ def main(argv=None) -> int:
                          "steady phase")
     ap.add_argument("--obs-scrapes", type=int, default=20,
                     help="timed /metrics/fleet scrapes (fleet-obs)")
+    ap.add_argument("--trace-intel", action="store_true",
+                    help="the graded 2-worker trace-intelligence "
+                         "drill: error/tail/head retention rules, "
+                         "cross-worker waterfall assembly through the "
+                         "proxy admin, SIGKILL one worker and check "
+                         "survivor retention + partial assembly; "
+                         "archives TRACEQ_r*.json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.kill_drill and args.workers < 2:
         ap.error("--kill-drill needs --workers >= 2")
     import random
     rng = random.Random(args.seed)
+    if args.trace_intel:
+        rec = run_trace_intel(args, rng)
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0 if rec.get("ok_verdict") else 1
     if args.fleet_obs:
         rec = run_fleet_obs(args, rng)
         line = json.dumps(rec)

@@ -387,6 +387,47 @@ def fleet_obs_ab(steps: int, repeats: int, as_json: bool) -> float:
     return overhead
 
 
+#: trace-store A/B arm -> env overrides. Arms differ ONLY in
+#: DL4J_TPU_TRACE_STORE: 0 is the pre-store span path (spans close into
+#: the ring sink and vanish), 1 adds the per-span open/close store hooks
+#: plus the retention decision at root close — the cost this A/B bounds.
+#: Sampling is pinned to the default head rate so the measured arm is
+#: the shipped posture, and the same traced front-door worker serves
+#: both fleet-obs and trace-store A/Bs (one request path, one protocol).
+TRACE_STORE_MODES = {
+    "store_off": {"DL4J_TPU_TRACE_STORE": "0"},
+    "store_on": {"DL4J_TPU_TRACE_STORE": "1"},
+}
+
+
+def trace_store_ab(steps: int, repeats: int, as_json: bool) -> float:
+    """Interleaved min-of-N A/B (rotating arm order — the noisy-box
+    protocol): do the trace-store hooks (note_open per span, feed +
+    retention decision at close) keep per-request front-door latency
+    under the 2% bar?"""
+    best = _interleaved_min(
+        list(TRACE_STORE_MODES), repeats,
+        lambda m: _run_worker(_FLEET_OBS_WORKER, [steps],
+                              TRACE_STORE_MODES[m]))
+    overhead = ((best["store_on"] - best["store_off"])
+                / best["store_off"] * 100.0)
+    result = {"request_seconds_trace_store_off": best["store_off"],
+              "request_seconds_trace_store_on": best["store_on"],
+              "trace_store_overhead_percent": overhead,
+              "steps": steps, "repeats": repeats}
+    if as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"trace-store A/B (traced /v1/classify, {steps} "
+              f"requests/arm, min of {repeats} interleaved repeats)")
+        print(f"  trace store off (DL4J_TPU_TRACE_STORE=0): "
+              f"{best['store_off'] * 1e3:8.3f} ms/request")
+        print(f"  trace store on  (retention hooks):        "
+              f"{best['store_on'] * 1e3:8.3f} ms/request")
+        print(f"  trace-store overhead: {overhead:+.2f}%  (bar: < 2%)")
+    return overhead
+
+
 #: mode name -> env overrides on top of the caller's environment
 MODES = {
     "off": {"DL4J_TPU_METRICS": "0"},
@@ -425,6 +466,9 @@ def main():
     ap.add_argument("--fleet-obs-ab", action="store_true",
                     help="run the fleet-observability A/B: front-door "
                          "request latency with DL4J_TPU_FLEET_OBS=0 vs 1")
+    ap.add_argument("--trace-store-ab", action="store_true",
+                    help="run the trace-store A/B: front-door request "
+                         "latency with DL4J_TPU_TRACE_STORE=0 vs 1")
     ap.add_argument("--save-every", type=int, default=8,
                     help="elastic A/B checkpoint cadence in steps (the "
                          "perf posture; the exact-resume drills save "
@@ -438,6 +482,8 @@ def main():
         return warmup_ab(args.batch, args.repeats, args.json)
     if args.fleet_obs_ab:
         return fleet_obs_ab(max(args.steps, 60), args.repeats, args.json)
+    if args.trace_store_ab:
+        return trace_store_ab(max(args.steps, 60), args.repeats, args.json)
 
     # a lone run is dominated by host warmup noise (the first subprocess
     # routinely runs 1.5x slower than steady state regardless of mode) —

@@ -59,6 +59,9 @@ from deeplearning4j_tpu.observability.tracing import (
     Span, SpanRecord, TraceContext, TraceSink, current_context,
     current_span, global_trace_sink, now_us, record_span,
     reset_global_trace_sink, span, trace_context, tracing_enabled)
+from deeplearning4j_tpu.observability.trace_store import (
+    TraceStore, global_trace_store, reset_global_trace_store,
+    store_span_close, store_span_open, trace_store_enabled)
 from deeplearning4j_tpu.observability.straggler import StragglerDetector
 from deeplearning4j_tpu.observability.flight_recorder import (
     FlightRecorder, global_flight_recorder, reset_global_flight_recorder)
@@ -91,6 +94,8 @@ __all__ = [
     "current_span", "global_trace_sink", "now_us", "record_span",
     "reset_global_trace_sink", "span", "trace_context", "tracing_enabled",
     "trace_sink",
+    "TraceStore", "global_trace_store", "reset_global_trace_store",
+    "store_span_close", "store_span_open", "trace_store_enabled",
     "StragglerDetector", "MetricsReportingListener",
     "FlightRecorder", "global_flight_recorder",
     "reset_global_flight_recorder",

@@ -51,10 +51,11 @@ import os
 import re
 import threading
 import time
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.observability.registry import (_fmt_labels,
                                                        _fmt_value,
@@ -62,6 +63,8 @@ from deeplearning4j_tpu.observability.registry import (_fmt_labels,
                                                        on_registry_reset)
 from deeplearning4j_tpu.observability.slo import (FAILING, OK, SLOEngine,
                                                   SLORule, _grade)
+from deeplearning4j_tpu.observability.trace_store import (
+    global_trace_store, trace_store_enabled)
 from deeplearning4j_tpu.observability.tracing import (TraceContext,
                                                       current_context,
                                                       global_trace_sink)
@@ -74,6 +77,8 @@ __all__ = [
     "scrape_workers", "render_fleet", "FleetHealth", "publish_rollup",
     "post_incident", "incident_beat", "install_incident_publisher",
     "FleetAdminServer",
+    "scrape_worker_traces", "fleet_recent_traces", "assemble_trace",
+    "assembled_chrome_trace", "handle_trace_route", "PHASES",
 ]
 
 #: the cross-process trace headers (the front door already EMITTED the
@@ -388,6 +393,316 @@ def render_fleet(store, local_worker: str = "proxy",
     return merge_prometheus(parts)
 
 
+# -------------------------------------------------------- trace assembly
+
+#: waterfall phase decomposition: assembled span names → the request
+#: phase they account to (the serving pipeline's queue→prefill→decode→
+#: dispatch shape; names are lint-bounded by the span-names checker)
+PHASES = {
+    "queue_wait": ("queue_wait", "slot_wait"),
+    "prefill": ("prefill",),
+    "decode": ("decode_step",),
+    "dispatch": ("inference_dispatch",),
+}
+
+
+def _fetch_worker_json(port: int, path: str,
+                       timeout: float) -> Optional[dict]:
+    """One worker debug-endpoint fetch; an HTTP 404 is a clean miss
+    (the worker simply doesn't hold that trace) and returns None, any
+    other failure raises for the caller's errors map."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{int(port)}{path}", timeout=timeout) as r:
+            doc = json.loads(r.read().decode("utf-8", "replace"))
+            return doc if isinstance(doc, dict) else None
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def _live_worker_ports(doc) -> List[Tuple[str, int]]:
+    now = time.time()
+    out: List[Tuple[str, int]] = []
+    for wid, rec in sorted((doc.get("workers") or {}).items()):
+        if not isinstance(rec, dict) or not rec.get("port"):
+            continue
+        if now - float(rec.get("heartbeat", 0) or 0) > _WORKER_TTL_S:
+            continue
+        out.append((wid, int(rec["port"])))
+    return out
+
+
+def scrape_worker_traces(store, trace_id: str
+                         ) -> Tuple[dict, Dict[str, dict],
+                                    Dict[str, str]]:
+    """Every live worker's LOCAL retained payload for ``trace_id`` (the
+    ``?local=1`` form — fan-out must never recurse into another
+    fan-out): ``(store_doc, {worker: payload}, {worker: error})``.
+    Workers that don't hold the id are absent, not errors; a dead
+    worker lands in ``errors`` exactly like a ``/metrics`` federation
+    scrape — partial assembly is an answer."""
+    try:
+        doc = store.read()
+    except Exception as e:
+        return {"error": repr(e)}, {}, {"__store__": repr(e)}
+    timeout = scrape_timeout_s()
+    payloads: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for wid, port in _live_worker_ports(doc):
+        try:
+            got = _fetch_worker_json(
+                port, f"/debug/trace/{trace_id}?local=1", timeout)
+            if got is not None:
+                payloads[wid] = got
+        except Exception as e:
+            errors[wid] = repr(e)
+            _scrape_error(wid).inc()
+    return doc, payloads, errors
+
+
+def fleet_recent_traces(store, local_worker: str = "proxy",
+                        limit: int = 64) -> dict:
+    """The fleet ``/debug/trace/recent`` payload: every live worker's
+    retained-trace summaries (scraped ``?local=1``) merged with the
+    local store's, each stamped with its holding worker, newest
+    first."""
+    try:
+        doc = store.read()
+    except Exception as e:
+        doc, errors = {"error": repr(e)}, {"__store__": repr(e)}
+        live = []
+    else:
+        errors = {}
+        live = _live_worker_ports(doc)
+    timeout = scrape_timeout_s()
+    entries: List[dict] = []
+    for wid, port in live:
+        try:
+            got = _fetch_worker_json(
+                port, f"/debug/trace/recent?local=1&limit={int(limit)}",
+                timeout)
+        except Exception as e:
+            errors[wid] = repr(e)
+            _scrape_error(wid).inc()
+            continue
+        for t in ((got or {}).get("traces") or []):
+            if isinstance(t, dict):
+                entries.append({**t, "worker": wid})
+    for t in global_trace_store().recent(limit=limit):
+        entries.append({**t, "worker": local_worker})
+    entries.sort(key=lambda t: -float(t.get("at", 0) or 0))
+    return {"traces": entries[:max(1, int(limit))],
+            "partial": bool(errors), "scrape_errors": errors}
+
+
+def _assembled_depths(spans: List[dict]) -> Dict[str, int]:
+    """Parent-chain depth across the ASSEMBLED span set (a worker span
+    whose parent lives in the proxy nests under it; each record's local
+    ``depth`` only knows its own process)."""
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    depths: Dict[str, int] = {}
+
+    def depth_of(sid: str, hops: int = 0) -> int:
+        if sid in depths:
+            return depths[sid]
+        if hops > 64:                      # cycle guard on hostile ids
+            return 0
+        s = by_id.get(sid)
+        parent = s.get("parent_id") if s else None
+        d = (depth_of(parent, hops + 1) + 1
+             if parent and parent in by_id else 0)
+        depths[sid] = d
+        return d
+
+    for s in spans:
+        if s.get("span_id"):
+            depth_of(s["span_id"])
+    return depths
+
+
+def assemble_trace(store, trace_id: str,
+                   local_payload: Optional[dict] = None,
+                   local_worker: str = "proxy") -> Optional[dict]:
+    """Stitch one trace id's spans from every live worker (plus the
+    local store's copy) into a single cross-worker waterfall: spans
+    tagged with their holding worker, phase decomposition per
+    :data:`PHASES`, per-span tenant attribution, and honest
+    ``partial``/``scrape_errors`` when a worker couldn't answer.
+    Returns None when NO process holds the id (the 404 case)."""
+    _doc, payloads, errors = scrape_worker_traces(store, trace_id)
+    if local_payload is not None:
+        payloads = {**payloads, local_worker: local_payload}
+    return _doc_from_payloads(trace_id, payloads, errors)
+
+
+def _doc_from_payloads(trace_id: str, payloads: Dict[str, dict],
+                       errors: Dict[str, str]) -> Optional[dict]:
+    if not payloads:
+        return None
+    spans: List[dict] = []
+    reasons: Dict[str, str] = {}
+    for wid in sorted(payloads):
+        p = payloads[wid]
+        if p.get("reason"):
+            reasons[wid] = p["reason"]
+        for s in (p.get("spans") or []):
+            if isinstance(s, dict):
+                spans.append({**s, "worker": wid})
+    if not spans:
+        return None
+    spans.sort(key=lambda s: float(s.get("ts_us", 0) or 0))
+    depths = _assembled_depths(spans)
+    ids = {s["span_id"] for s in spans if s.get("span_id")}
+    roots = [s for s in spans
+             if not s.get("parent_id") or s["parent_id"] not in ids]
+    root = max(roots or spans,
+               key=lambda s: float(s.get("dur_us", 0) or 0))
+    t0 = float(spans[0].get("ts_us", 0) or 0)
+    end = max(float(s.get("ts_us", 0) or 0)
+              + float(s.get("dur_us", 0) or 0) for s in spans)
+    phases = {
+        phase: round(sum(float(s.get("dur_us", 0) or 0) for s in spans
+                         if s.get("name") in names), 1)
+        for phase, names in PHASES.items()}
+    waterfall = [
+        {"name": s.get("name"), "worker": s["worker"],
+         "tenant": (s.get("attrs") or {}).get("tenant"),
+         "offset_us": round(float(s.get("ts_us", 0) or 0) - t0, 1),
+         "dur_us": round(float(s.get("dur_us", 0) or 0), 1),
+         "depth": depths.get(s.get("span_id"), 0),
+         "error": bool(s.get("error")
+                       or (s.get("attrs") or {}).get("error_type"))}
+        for s in spans]
+    return {
+        "trace_id": trace_id,
+        "workers": sorted(payloads),
+        "reasons": reasons,
+        "partial": bool(errors),
+        "scrape_errors": errors,
+        "root": {"name": root.get("name"), "worker": root["worker"],
+                 "error": bool(root.get("error")),
+                 "error_type": (root.get("error_type")
+                                or (root.get("attrs") or {})
+                                .get("error_type")),
+                 "attrs": root.get("attrs") or {}},
+        "duration_us": round(end - t0, 1),
+        "phases": phases,
+        "n_spans": len(spans),
+        "waterfall": waterfall,
+        "spans": spans,
+    }
+
+
+def assembled_chrome_trace(doc: dict) -> List[dict]:
+    """An assembled trace as Chrome trace events with per-worker
+    namespacing (satellite fix): each worker gets its own integer
+    ``pid`` (named via process_name metadata) so two workers' thread
+    ids can't collide on one track, and flow-event ids are namespaced
+    ``"<worker>:<span_id>"`` strings so concatenated exports from N
+    processes can't alias each other's arrows.  Flow pairs are emitted
+    for every parent→child edge that crosses a (worker, thread)
+    boundary — including the proxy→worker hop one process's export
+    could never draw."""
+    spans = doc.get("spans") or []
+    pid_of = {w: i + 1 for i, w in enumerate(sorted(doc.get("workers")
+                                                    or []))}
+    events: List[dict] = []
+    for wid, pid in sorted(pid_of.items(), key=lambda kv: kv[1]):
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": wid}})
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    for s in spans:
+        pid = pid_of.get(s.get("worker"), 0)
+        ev = {"name": s.get("name"), "ph": "X",
+              "ts": s.get("ts_us"), "dur": s.get("dur_us"),
+              "pid": pid, "tid": s.get("tid"), "cat": "host",
+              "args": {**(s.get("attrs") or {}),
+                       "trace_id": s.get("trace_id"),
+                       "span_id": s.get("span_id"),
+                       "parent_id": s.get("parent_id"),
+                       "worker": s.get("worker")}}
+        if s.get("error"):
+            ev["args"]["error"] = True
+            if s.get("error_type"):
+                ev["args"]["error_type"] = s["error_type"]
+        events.append(ev)
+        parent = by_id.get(s.get("parent_id")) if s.get("parent_id") \
+            else None
+        if parent is None:
+            continue
+        if (parent.get("worker"), parent.get("tid")) == (s.get("worker"),
+                                                         s.get("tid")):
+            continue            # same-track nesting needs no arrow
+        s_ts = min(float(parent.get("ts_us", 0) or 0),
+                   float(s.get("ts_us", 0) or 0))
+        flow_id = f"{s.get('worker')}:{s.get('span_id')}"
+        events.append({"name": "handoff", "cat": "flow", "ph": "s",
+                       "id": flow_id, "ts": s_ts,
+                       "pid": pid_of.get(parent.get("worker"), 0),
+                       "tid": parent.get("tid")})
+        events.append({"name": "handoff", "cat": "flow", "ph": "f",
+                       "bp": "e", "id": flow_id,
+                       "ts": max(float(s.get("ts_us", 0) or 0), s_ts),
+                       "pid": pid, "tid": s.get("tid")})
+    return events
+
+
+def handle_trace_route(path: str, query: Dict[str, list],
+                       store=None, local_worker: str = "local",
+                       fleet: bool = False) -> Tuple[int, object]:
+    """Shared ``/debug/trace*`` routing for all three HTTP surfaces
+    (front door, UIServer, proxy admin): ``(status, json_payload)``.
+
+    - ``/debug/trace/recent`` — retained summaries with why-kept
+      reasons; fleet surfaces fan out (``?local=1`` pins it local — the
+      form fan-out itself requests, so scrapes can't recurse).
+    - ``/debug/trace/<id>`` — the assembled cross-worker waterfall on
+      fleet surfaces, the raw local payload with ``?local=1`` or on a
+      plain worker; ``?format=chrome`` exports Perfetto-loadable
+      events.  Unknown/invalid ids are a 404, never a 500.
+    """
+    q = query or {}
+    local_only = (q.get("local", ["0"]) or ["0"])[0] == "1"
+    as_fleet = (fleet and store is not None and not local_only
+                and fleet_obs_enabled())
+    chrome = (q.get("format", [""]) or [""])[0] == "chrome"
+    st = global_trace_store()
+    p = path.rstrip("/")
+    if p in ("/debug/trace", "/debug/trace/recent"):
+        try:
+            limit = max(1, int((q.get("limit", ["64"]) or ["64"])[0]))
+        except (TypeError, ValueError):
+            limit = 64
+        if as_fleet:
+            return 200, fleet_recent_traces(store, local_worker, limit)
+        return 200, {"worker": local_worker,
+                     "traces": st.recent(limit=limit)}
+    tid = (parse_trace_id(p[len("/debug/trace/"):])
+           if p.startswith("/debug/trace/") else None)
+    if tid is None:
+        return 404, {"error": "NotFound", "path": path}
+    local = st.get(tid)
+    if local_only and not chrome:
+        # the fan-out wire format: the RAW store payload (reason +
+        # spans), exactly what scrape_worker_traces re-stitches
+        if local is None:
+            return 404, {"error": "NotFound", "trace_id": tid}
+        return 200, {**local, "worker": local_worker}
+    if as_fleet:
+        doc = assemble_trace(store, tid, local_payload=local,
+                             local_worker=local_worker)
+    else:
+        doc = _doc_from_payloads(tid, {local_worker: local} if local
+                                 else {}, {})
+    if doc is None:
+        return 404, {"error": "NotFound", "trace_id": tid}
+    if chrome:
+        return 200, assembled_chrome_trace(doc)
+    return 200, doc
+
+
 # ---------------------------------------------------------- fleet health
 
 class _FleetRule(SLORule):
@@ -700,6 +1015,12 @@ def incident_beat(store, worker_id: str, is_leader: bool,
         recorder = global_flight_recorder()
     dumped: List[str] = []
     for inc in todo:
+        if trace_store_enabled():
+            # the originating request's trace + everything completing
+            # around the incident are evidence on THIS worker too
+            st = global_trace_store()
+            st.pin(parse_trace_id(inc.get("trace_id")))
+            st.open_incident_window()
         # dump OUTSIDE any store transaction (bundles take real time);
         # the publisher hook skips incident-reason dumps, so the peer
         # capture can never re-post and ping-pong the fleet
@@ -730,12 +1051,29 @@ def install_incident_publisher(store, worker_id: str) -> None:
         if str(reason).startswith("incident"):
             return                       # peer capture: never re-post
         ctx = current_context()
+        if ctx is not None and trace_store_enabled():
+            # the live request's trace is evidence: eviction-exempt,
+            # and everything completing around the trip is kept too
+            st = global_trace_store()
+            st.pin(parse_trace_id(ctx.trace_id))
+            st.open_incident_window()
         try:
             post_incident(store, worker_id, reason, bundle,
                           trace_id=ctx.trace_id if ctx else None)
         except Exception:
             pass        # the store being down must never mask the dump
     _fr.set_incident_publisher(_publish)
+
+    def _assemble(tid: str) -> Optional[dict]:
+        # fleet-wide assembly for the bundle's traces.json: with the
+        # fleet plane off (or a single process) the recorder falls back
+        # to the local store's payload
+        if not (fleet_obs_enabled() and trace_store_enabled()):
+            return None
+        local = global_trace_store().get(tid)
+        return assemble_trace(store, tid, local_payload=local,
+                              local_worker=worker_id)
+    _fr.set_trace_assembler(_assemble)
 
 
 # ------------------------------------------------------ proxy admin port
@@ -796,6 +1134,13 @@ class FleetAdminServer:
                         self._json(200, srv.health.alerts())
                     elif path == "/debug/proxy":
                         self._json(200, srv.debug_snapshot())
+                    elif (path.startswith("/debug/trace")
+                            and trace_store_enabled()):
+                        q = parse_qs(urlparse(self.path).query)
+                        code, payload = handle_trace_route(
+                            path, q, srv.store, srv.local_worker,
+                            fleet=True)
+                        self._json(code, payload)
                     else:
                         self._json(404, {"error": "NotFound",
                                          "path": path})

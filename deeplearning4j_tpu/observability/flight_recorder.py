@@ -359,6 +359,14 @@ class FlightRecorder:
         # death during a fleet chaos run must name who led, under which
         # term, and what was (or was not) executed twice
         section("fleet.json", self._write_fleet)
+        # the trace-intelligence layer: the incident's pinned trace ids
+        # assembled FLEET-WIDE (via the installed assembler) — a
+        # coordinated capture ships the full cross-process request
+        # story, not one worker's ring slice
+        from deeplearning4j_tpu.observability.trace_store import (
+            trace_store_enabled)
+        if trace_store_enabled():
+            section("traces.json", self._write_traces)
         if reason.startswith("incident:"):
             # a coordinated peer capture: stamp the fleet-wide incident
             # id INTO the bundle so a postmortem directory groups every
@@ -487,6 +495,29 @@ class FlightRecorder:
             json.dump(payload, f, indent=2, default=str)
 
     @staticmethod
+    def _write_traces(path: str):
+        from deeplearning4j_tpu.observability.trace_store import (
+            global_trace_store)
+        store = global_trace_store()
+        pinned = store.pinned_ids()
+        assembler = _trace_assembler
+        traces = {}
+        for tid in pinned:
+            doc = None
+            if assembler is not None:
+                try:
+                    doc = assembler(tid)
+                except Exception as e:
+                    doc = {"error": repr(e)}
+            if doc is None:
+                doc = store.get(tid)    # single-process fallback
+            if doc is not None:
+                traces[tid] = doc
+        with open(path, "w") as f:
+            json.dump({"pinned": pinned, "recent": store.recent(),
+                       "traces": traces}, f, indent=2, default=str)
+
+    @staticmethod
     def _write_metrics(path: str):
         with open(path, "w") as f:
             f.write(global_registry().render_prometheus())
@@ -556,6 +587,20 @@ def set_incident_publisher(fn) -> None:
     publisher must never mask the dump that tripped it."""
     global _incident_publisher
     _incident_publisher = fn
+
+
+# fleet trace assembly for the bundle's traces.json: installed alongside
+# the incident publisher (federation.install_incident_publisher); takes
+# a trace id, returns the assembled cross-worker doc or None (then the
+# local store payload is used)
+_trace_assembler = None
+
+
+def set_trace_assembler(fn) -> None:
+    """Install (or clear, with None) the fleet trace assembler the
+    bundle's ``traces.json`` section uses for pinned trace ids."""
+    global _trace_assembler
+    _trace_assembler = fn
 
 # process-wide crash-hook plumbing: ONE set of excepthook wrappers + one
 # atexit callback, dispatching to the currently-installed recorder

@@ -54,6 +54,9 @@ from typing import Any, Dict, List, NamedTuple, Optional
 from deeplearning4j_tpu.observability.registry import (global_registry,
                                                        metrics_enabled,
                                                        on_registry_reset)
+# cycle-safe: trace_store imports only registry, never tracing
+from deeplearning4j_tpu.observability.trace_store import (store_span_close,
+                                                          store_span_open)
 
 #: default ring capacity — ~200k spans at <100 bytes each stays tens of MB
 _DEFAULT_CAPACITY = 65536
@@ -355,7 +358,7 @@ class Span:
     thread-local stack so ``depth`` reflects the live call structure, and
     carries trace context (see module doc) so cross-thread work links."""
 
-    __slots__ = ("name", "attrs", "sink", "_t0", "_ts", "depth",
+    __slots__ = ("name", "attrs", "sink", "_ts", "depth",
                  "trace_id", "span_id", "parent_id")
 
     def __init__(self, name: str, sink: Optional[TraceSink] = None,
@@ -384,13 +387,19 @@ class Span:
             else:                       # root: new trace
                 self.trace_id, self.parent_id = _new_id(), None
         self.span_id = _new_id()
+        if self.sink is None:
+            # global-sink spans also feed the completed-trace store: the
+            # open/close balance tells it when a trace's last span closed
+            store_span_open(self.trace_id)
         st.append(self)
         self._ts = _now_us()
-        self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        dur = (time.perf_counter() - self._t0) * 1e6
+        # dur shares self._ts's clock read: a second perf_counter
+        # capture at enter left a preemption window that could make a
+        # child's end time exceed its parent's (ts + dur must nest)
+        dur = _now_us() - self._ts
         st = _stack()
         if st and st[-1] is self:
             st.pop()
@@ -405,11 +414,14 @@ class Span:
         # explicit None check: an EMPTY TraceSink is falsy (__len__ == 0),
         # so `or` would silently reroute the first span to the global sink
         sink = self.sink if self.sink is not None else global_trace_sink()
-        sink.record(SpanRecord(
+        rec = SpanRecord(
             self.name, self._ts, dur, threading.get_ident(), self.depth,
             self.attrs, trace_id=self.trace_id, span_id=self.span_id,
             parent_id=self.parent_id, error=error,
-            error_type=exc_type.__name__ if error else None))
+            error_type=exc_type.__name__ if error else None)
+        sink.record(rec)
+        if self.sink is None:
+            store_span_close(rec, True)
         if error:
             _span_errors(self.name).inc()
         return False
@@ -458,7 +470,13 @@ def record_span(name: str, start_us: float, end_us: Optional[float] = None,
         trace_id=ctx.trace_id if ctx is not None else _new_id(),
         span_id=_new_id(),
         parent_id=ctx.span_id if ctx is not None else None)
-    (sink if sink is not None else global_trace_sink()).record(rec)
+    if sink is not None:
+        sink.record(rec)
+    else:
+        global_trace_sink().record(rec)
+        # externally-timed spans never opened on a stack; they complete a
+        # trace only when it has no still-open span() blocks
+        store_span_close(rec, False)
     return rec
 
 

@@ -66,13 +66,15 @@ import time
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.observability import current_span as _current_span
 from deeplearning4j_tpu.observability import federation as _fed
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability import trace_store as _trace_store
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       trace_context)
 from deeplearning4j_tpu.resilience import faults as _faults
@@ -473,6 +475,17 @@ class FrontDoor:
             def _reply(self, code: int, payload: dict, route: str,
                        t0: float, extra_headers=()):
                 self._finish_idem(code, payload)
+                if _trace_store.trace_store_enabled():
+                    # the retention rules read the ROOT span's attrs:
+                    # typed errors are caught INSIDE the http_request
+                    # span (it exits cleanly), so the status must ride
+                    # on the span for tail-based keep/drop to see it
+                    sp = _current_span()
+                    if sp is not None:
+                        sp.set_attr("status", code)
+                        tenant = getattr(self, "_tenant", None)
+                        if tenant is not None:
+                            sp.set_attr("tenant", tenant)
                 body = json.dumps(payload, default=str).encode()
                 try:
                     self.send_response(code)
@@ -495,6 +508,10 @@ class FrontDoor:
                 code = http_status(exc)
                 payload = {"error": type(exc).__name__,
                            "detail": str(exc)}
+                if _trace_store.trace_store_enabled():
+                    sp = _current_span()
+                    if sp is not None:
+                        sp.set_attr("error_type", type(exc).__name__)
                 self._finish_idem(code, payload, exc=exc)
                 headers = ()
                 if code in (429, 503):
@@ -861,6 +878,13 @@ class FrontDoor:
                         item = False               # detection when idle
                 err = result.get("error")
                 code = 200
+                if err is not None and _trace_store.trace_store_enabled():
+                    # streams bypass _reply/_error: stamp the root span
+                    # here so a failed stream is tail-retained too
+                    sp = _current_span()
+                    if sp is not None:
+                        sp.set_attr("status", http_status(err))
+                        sp.set_attr("error_type", type(err).__name__)
                 if err is not None:
                     err_payload = {"error": type(err).__name__,
                                    "detail": str(err),
@@ -964,6 +988,21 @@ class FrontDoor:
                           and fd.shared is not None):
                         self._reply(200, fd._fleet_health_view().alerts(),
                                     route, t0)
+                    elif (path.startswith("/debug/trace")
+                            and _trace_store.trace_store_enabled()):
+                        # trace intelligence: retained traces with
+                        # why-kept reasons, and any retained id
+                        # assembled into a cross-worker waterfall
+                        # (fan-out exactly like /metrics/fleet; the
+                        # ?local=1 form peers scrape stays local)
+                        q = parse_qs(urlparse(self.path).query)
+                        code, payload = _fed.handle_trace_route(
+                            path, q,
+                            store=(fd.shared.store
+                                   if fd.shared is not None else None),
+                            local_worker=fd.worker_id,
+                            fleet=fleet_on and fd.shared is not None)
+                        self._reply(code, payload, route, t0)
                     elif path == "/health":
                         from deeplearning4j_tpu.observability.slo import (
                             FAILING, global_slo_engine)

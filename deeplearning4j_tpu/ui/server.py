@@ -8,7 +8,10 @@ the same surfaces the reference's Vert.x app exposes, minus the JS bundle.
 Observability surfaces: ``/metrics`` (Prometheus text with OpenMetrics
 exemplars), ``/health`` (SLO-driven ok/degraded/failing, HTTP 503 when
 failing), ``/alerts`` (active violations + transitions), ``/train/trace``
-(Chrome trace of the span ring), ``/debug/dump`` (write a flight-recorder
+(Chrome trace of the span ring), ``/debug/trace/recent`` (trace store:
+retained traces with why-kept reasons) and ``/debug/trace/<id>`` (one
+retained trace's spans; ``?format=chrome`` exports Perfetto events),
+``/debug/dump`` (write a flight-recorder
 postmortem bundle now), ``/debug/compiles`` (compile-watch ring: every XLA
 trace of the jitted entry points + the retrace-storm grade),
 ``/debug/resilience`` (fault-injection counts, circuit-breaker states,
@@ -801,6 +804,24 @@ class UIServer:
                     # save and load in Perfetto / chrome://tracing
                     from deeplearning4j_tpu.observability import trace_sink
                     body = trace_sink().export_json().encode()
+                    ctype = "application/json"
+                elif parsed.path.startswith("/debug/trace"):
+                    # trace intelligence (LOCAL store view — the fleet-
+                    # assembled form lives on the front door / proxy
+                    # admin port): /debug/trace/recent lists retained
+                    # traces with why-kept reasons, /debug/trace/<id>
+                    # returns the retained payload (?format=chrome for
+                    # Perfetto).  404 when the store is off or the id
+                    # is unknown — never a 500
+                    from deeplearning4j_tpu.observability import (
+                        federation as _fed, trace_store as _ts)
+                    if _ts.trace_store_enabled():
+                        code, payload = _fed.handle_trace_route(
+                            parsed.path, q, local_worker="local")
+                    else:
+                        code, payload = 404, {"error": "NotFound",
+                                              "path": parsed.path}
+                    body = json.dumps(payload, default=str).encode()
                     ctype = "application/json"
                 elif parsed.path == "/train/sessions":
                     body = json.dumps(ui._sessions()).encode()

@@ -272,6 +272,9 @@ def main():
             if (fleet_store.read().get("leader") or {}).get("worker"):
                 break
             _time.sleep(0.1)
+        # keep every trace for the walk below (the default 1% head coin
+        # would usually discard this single boring request)
+        _os.environ["DL4J_TPU_TRACE_SAMPLE"] = "1.0"
         req = urllib.request.Request(
             f"http://127.0.0.1:{door.port}/v1/classify",
             data=_json.dumps({"inputs": x[:1].tolist()}).encode(),
@@ -305,6 +308,48 @@ def main():
             by = rule.get("worker")
             print(f"  {rule['rule']:<32} {rule['status']}"
                   + (f" (worst: {by})" if by else ""))
+
+        # ---- trace intelligence: /debug/trace ---------------------------
+        # the traced request above completed; the trace store ran its
+        # keep/discard decision on it (errors and latency-tail outliers
+        # are always kept; boring traffic rides the DL4J_TPU_TRACE_SAMPLE
+        # coin — forced to 1.0 above so this walk is deterministic).
+        # /debug/trace/recent lists retained traces with why-kept
+        # reasons; /debug/trace/<id> assembles the id across every live
+        # worker into one latency waterfall
+        recent = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{door.port}/debug/trace/recent",
+            timeout=10).read())
+        print(f"/debug/trace/recent: {len(recent['traces'])} retained")
+        for t in recent["traces"][:4]:
+            print(f"  {t['trace_id']} reason={t['reason']} "
+                  f"root={t['root']} {t['dur_us'] / 1e3:.2f} ms")
+        assembled = None
+        for _ in range(40):          # span close lands after the reply
+            try:
+                assembled = _json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{door.port}"
+                    "/debug/trace/cafe0000deadbeef", timeout=10).read())
+                break
+            except urllib.error.HTTPError:
+                _time.sleep(0.1)
+        if assembled:
+            print(f"waterfall for cafe0000deadbeef "
+                  f"(workers={assembled['workers']}, "
+                  f"reasons={assembled['reasons']}, "
+                  f"{assembled['duration_us'] / 1e3:.2f} ms total):")
+            for row in assembled["waterfall"]:
+                bar = "  " * row["depth"]
+                print(f"  {bar}{row['name']:<24} "
+                      f"+{row['offset_us'] / 1e3:7.3f} ms "
+                      f"{row['dur_us'] / 1e3:8.3f} ms "
+                      f"[{row['worker']}]"
+                      + (" ERROR" if row["error"] else ""))
+            # ?format=chrome exports the same assembly as Perfetto-
+            # loadable events (per-worker pids, cross-process flow
+            # arrows); unknown ids are a 404, never a 500
+        else:
+            print("trace cafe0000deadbeef not retained (store off?)")
     finally:
         door.stop()
         fleet_reg.shutdown()

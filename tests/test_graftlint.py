@@ -510,6 +510,43 @@ def test_metric_names_runs_as_graftlint_rule(tmp_path):
     assert all(f.rule == "metric-names" for f in bad)
 
 
+def test_span_names_flags_interpolated_and_bad_case(tmp_path):
+    bad = _lint(tmp_path, {"mod.py": """
+        from deeplearning4j_tpu.observability import record_span, span
+
+        def handle(i, name, t0):
+            with span(f"request_{i}"):            # f-string: unbounded
+                pass
+            with span("BadName"):                 # not snake_case
+                pass
+            record_span("wait-" + str(i), t0)     # concatenation
+            record_span(name, t0)                 # variable
+    """}, ["span-names"])
+    assert len(bad) == 4
+    assert all(f.rule == "span-names" for f in bad)
+    msgs = " | ".join(f.message for f in bad)
+    assert "f-string" in msgs
+    assert "snake_case" in msgs
+
+
+def test_span_names_accepts_literals_and_unrelated_calls(tmp_path):
+    ok = _lint(tmp_path, {"mod.py": """
+        import re
+        from deeplearning4j_tpu.observability import record_span, span
+        from deeplearning4j_tpu.observability import span as _span
+
+        def handle(i, m: "re.Match", t0):
+            with span("http_request", route="generate", shard=i):
+                pass
+            with _span("checkpoint.save", path="x"):  # dotted ok
+                pass
+            record_span("queue_wait", t0, attrs_id=i)
+            a, b = m.span(1)       # Attribute call: out of scope
+            span()                 # zero-arg: not a name site
+    """}, ["span-names"])
+    assert ok == []
+
+
 def test_back_compat_shims_serve_the_original_api():
     import importlib.util
 

@@ -756,3 +756,53 @@ def test_cost_model_module_has_no_date_dependence():
     json.dumps(snap, default=str)
     assert set(snap) >= {"enabled", "platform", "peak_flops",
                          "hbm_bytes_per_second", "ridge_intensity", "fns"}
+
+
+# ---------------------------------------------------------------------------
+# bench_diff: TRACEQ trace-intelligence trajectory grading
+# ---------------------------------------------------------------------------
+
+def test_bench_diff_learns_traceq_schema(tmp_path):
+    """TRACEQ_r*.json (http_load.py --trace-intel): retention coverage
+    and assembly completeness grade sustained-only, assembly p99 is
+    reported but never gated, driver wrappers unwrap, alien JSON is
+    ignored, empty dir is green."""
+    mod = _load_tool("bench_diff")
+    assert mod.load_traceq(str(tmp_path)) == []
+    assert mod.main([str(tmp_path)]) == 0               # empty = green
+
+    def write(rnd, cov, comp, p99=15.0, wrap=False):
+        rec = {"metric": "traceq_drill", "platform": "cpu",
+               "value": cov, "retention_coverage": cov,
+               "assembly_completeness": comp, "assembly_p99_ms": p99}
+        doc = {"n": rnd, "parsed": rec} if wrap else rec
+        (tmp_path / f"TRACEQ_r{rnd:02d}.json").write_text(
+            json.dumps(doc))
+
+    write(1, 1.0, 1.0)
+    write(2, 0.99, 1.0, wrap=True)                      # wrapper unwraps
+    write(3, 1.0, 1.0, p99=800.0)                       # p99 never gated
+    samples = mod.load_traceq(str(tmp_path))
+    assert [s.round for s in samples] == [1, 2, 3]
+    assert samples[1].retention_coverage == pytest.approx(0.99)
+    assert samples[2].assembly_p99_ms == pytest.approx(800.0)
+    assert mod.check_traceq(samples) == []
+    assert mod.main([str(tmp_path)]) == 0
+    # one bad round is weather...
+    write(4, 0.5, 1.0)
+    assert mod.check_traceq(mod.load_traceq(str(tmp_path))) == []
+    # ...two in a row is a sustained retention regression
+    write(5, 0.5, 1.0)
+    regs = mod.check_traceq(mod.load_traceq(str(tmp_path)))
+    assert [(r.metric, r.series) for r in regs] == [
+        ("traceq_drill", "retention_coverage")]
+    assert mod.main([str(tmp_path)]) == 1
+    # an assembly collapse grades the same way
+    write(4, 1.0, 0.4)
+    write(5, 1.0, 0.4)
+    regs = mod.check_traceq(mod.load_traceq(str(tmp_path)))
+    assert [r.series for r in regs] == ["assembly_completeness"]
+    # alien / unreadable JSON is ignored, never fatal
+    (tmp_path / "TRACEQ_r06.json").write_text("not json {")
+    (tmp_path / "TRACEQ_r07.json").write_text('{"whatever": 1}')
+    assert len(mod.load_traceq(str(tmp_path))) == 5

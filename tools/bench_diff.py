@@ -74,6 +74,7 @@ _SERVE_RE = re.compile(r"SERVE_r(\d+)[^/]*\.json$")
 _QOS_RE = re.compile(r"QOS_r(\d+)[^/]*\.json$")
 _FLEET_RE = re.compile(r"FLEET_r(\d+)[^/]*\.json$")
 _OBSFLEET_RE = re.compile(r"OBSFLEET_r(\d+)[^/]*\.json$")
+_TRACEQ_RE = re.compile(r"TRACEQ_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -555,6 +556,71 @@ def check_obsfleet(samples: List[ObsFleetSample],
     ], tolerance, sustain)
 
 
+class TraceqSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                      # "traceq_drill"
+    platform: Optional[str]
+    retention_coverage: Optional[float]  # error/tail requests retained /
+                                         # expected — gated sustained-only
+    assembly_completeness: Optional[float]  # retained ids that assembled
+                                            # to a cross-worker waterfall
+                                            # through the proxy — gated
+    assembly_p99_ms: Optional[float]  # reported, never gated (weather)
+
+
+def load_traceq(root: str) -> List[TraceqSample]:
+    """``TRACEQ_r*.json`` trace-intelligence drill archives
+    (``benchmarks/http_load.py --trace-intel`` records, bare or
+    driver-wrapped). Anything without a ``traceq_`` metric — alien
+    JSON — is ignored, never fatal."""
+    out: List[TraceqSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "TRACEQ_r*.json"))):
+        m = _TRACEQ_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("traceq_"):
+            continue
+        cov = doc.get("retention_coverage", doc.get("value"))
+        comp = doc.get("assembly_completeness")
+        out.append(TraceqSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            retention_coverage=(float(cov)
+                                if isinstance(cov, (int, float))
+                                else None),
+            assembly_completeness=(float(comp)
+                                   if isinstance(comp, (int, float))
+                                   else None),
+            assembly_p99_ms=(float(doc["assembly_p99_ms"])
+                             if isinstance(doc.get("assembly_p99_ms"),
+                                           (int, float)) else None)))
+    return out
+
+
+def check_traceq(samples: List[TraceqSample],
+                 tolerance: float = DEFAULT_TOLERANCE,
+                 sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the trace-intelligence trajectory under the same
+    noise-aware rules: retention coverage and assembly completeness
+    sustained-only (same-run fractions, drift-immune); the raw assembly
+    p99 is host weather — reported, never gated."""
+    return _grade_metric_groups(samples, [
+        ("retention_coverage", lambda s: s.retention_coverage),
+        ("assembly_completeness", lambda s: s.assembly_completeness),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -650,8 +716,9 @@ def main(argv=None) -> int:
     qos = load_qos(root)
     fleet = load_fleet(root)
     obsfleet = load_obsfleet(root)
+    traceq = load_traceq(root)
     if (not samples and not dryruns and not decodes and not serves
-            and not qos and not fleet and not obsfleet):
+            and not qos and not fleet and not obsfleet and not traceq):
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
@@ -659,7 +726,8 @@ def main(argv=None) -> int:
         return 0
     regressions = (check_trajectory(samples) + check_decode(decodes)
                    + check_serve(serves) + check_qos(qos)
-                   + check_fleet(fleet) + check_obsfleet(obsfleet))
+                   + check_fleet(fleet) + check_obsfleet(obsfleet)
+                   + check_traceq(traceq))
     breaks = check_multichip(dryruns) + check_fleet_bool(fleet)
     for s in samples:
         marks = []
@@ -728,6 +796,16 @@ def main(argv=None) -> int:
             marks.append(f"scrape_p99={s.scrape_p99_ms:.1f}ms")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + " ".join(marks))
+    for s in traceq:
+        marks = []
+        if s.retention_coverage is not None:
+            marks.append(f"retention={s.retention_coverage:.3f}")
+        if s.assembly_completeness is not None:
+            marks.append(f"assembly={s.assembly_completeness:.3f}")
+        if s.assembly_p99_ms is not None:
+            marks.append(f"assembly_p99={s.assembly_p99_ms:.1f}ms")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
@@ -736,8 +814,8 @@ def main(argv=None) -> int:
         print(f"bench trajectory OK ({len(samples)} bench + "
               f"{len(dryruns)} dryrun + {len(decodes)} decode + "
               f"{len(serves)} serve + {len(qos)} qos + "
-              f"{len(fleet)} fleet + {len(obsfleet)} obsfleet samples "
-              f"under {root})")
+              f"{len(fleet)} fleet + {len(obsfleet)} obsfleet + "
+              f"{len(traceq)} traceq samples under {root})")
     return len(regressions) + len(breaks)
 
 

@@ -3,5 +3,5 @@ the graftlint registry (plugins self-register via ``@register`` at
 import time; a new checker is one new module plus one import line
 here)."""
 from . import (donation, env_knobs, jit_purity, lock_discipline,  # noqa: F401
-               metric_names, store_discipline, thread_hygiene,
+               metric_names, span_names, store_discipline, thread_hygiene,
                typed_errors)

@@ -1,0 +1,126 @@
+"""span-names: literal snake_case names on every ``span(...)`` /
+``record_span(...)`` call.
+
+The trace store indexes completed traces by their root span's name (the
+per-endpoint tail-latency windows key on it) and ``dl4j_span_errors_total``
+labels by it — an f-string name carrying a request id or iteration number
+is unbounded cardinality in BOTH places, the exact bug class
+``tenant_label`` closed for metric labels.  Rules, on every call whose
+callee is the ``span``/``record_span`` entry point (including the
+``_span`` import alias):
+
+- the name argument must be a string LITERAL — f-strings (``JoinedStr``),
+  concatenation/formatting (``BinOp``), variables, and call results are
+  violations (a forwarding helper may suppress inline with a
+  justification, provided its own callers pass literals)
+- the literal must be dotted snake_case: ``[a-z][a-z0-9_]*`` segments
+  joined by ``.`` (``checkpoint.save`` is load-bearing — fault-point ids
+  dot-qualify)
+
+Attribute calls (``obj.span(...)``) are deliberately out of scope:
+``re.Match.span()`` and friends would false-positive, and this codebase
+always calls the tracing entry points as imported names.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, NamedTuple, Optional
+
+from .. import Finding, register
+
+SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)*$")
+
+#: imported-name spellings of the tracing entry points across the repo
+_ENTRY_POINTS = frozenset({"span", "_span", "record_span"})
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    name: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.name}: {self.message}"
+
+
+def _callee(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    return fn.id if isinstance(fn, ast.Name) else None
+
+
+def check_tree(tree, path: str = "<string>") -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee(node) not in _ENTRY_POINTS or not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant):
+            if not isinstance(arg.value, str):
+                continue            # not a span-name call shape
+            if not SPAN_NAME_RE.match(arg.value):
+                out.append(Violation(
+                    path, node.lineno, arg.value,
+                    "span names must be dotted snake_case "
+                    "([a-z][a-z0-9_]* segments)"))
+        else:
+            kind = type(arg).__name__
+            label = ("f-string" if isinstance(arg, ast.JoinedStr)
+                     else kind)
+            out.append(Violation(
+                path, node.lineno, f"<{kind}>",
+                f"span name must be a string literal, not {label} — "
+                "interpolated names are unbounded cardinality in the "
+                "trace-store index and dl4j_span_errors_total labels"))
+    return out
+
+
+def check_source(source: str, path: str = "<string>") -> List[Violation]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "<parse>", str(e))]
+    return check_tree(tree, path)
+
+
+#: out-of-package files that open spans on the shared trace surface (the
+#: serve.py proxy's proxy_request span lands in the same fleet assembly)
+EXTRA_FILES = ("tools/serve.py",)
+
+
+@register
+class SpanNamesChecker:
+    rule = "span-names"
+    description = ("span()/record_span() names must be literal dotted "
+                   "snake_case — interpolated names are unbounded "
+                   "cardinality in the trace-store index and span-error "
+                   "labels")
+
+    _HINT = ("name the span with a literal and carry variability in "
+             "attrs: span(\"fetch\", shard=i), never span(f\"fetch_{i}\")")
+
+    def check_file(self, ctx) -> List[Finding]:
+        return [Finding(self.rule, ctx.relpath, v.line,
+                        f"{v.name}: {v.message}", self._HINT)
+                for v in check_tree(ctx.tree, ctx.relpath)]
+
+    def check_repo(self, repo_root: str, contexts) -> List[Finding]:
+        """Also covers :data:`EXTRA_FILES` outside the package walk
+        (the metric-names posture: tool scripts publishing onto shared
+        observability surfaces obey the same naming invariants)."""
+        out: List[Finding] = []
+        for rel in EXTRA_FILES:
+            path = os.path.join(repo_root, *rel.split("/"))
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            out.extend(Finding(self.rule, rel, v.line,
+                               f"{v.name}: {v.message}", self._HINT)
+                       for v in check_source(source, rel))
+        return out

@@ -469,8 +469,13 @@ class _HttpProxy(_SpliceProxy):
             # caller's context when the client sent one, and the parent
             # of the worker's http_request span via the injected
             # headers — ONE trace id across proxy, worker, and response
+            try:
+                route = raw.split(b"\r\n", 1)[0].split(b" ")[1].decode(
+                    "latin-1")
+            except (IndexError, UnicodeDecodeError):
+                route = None
             with trace_context(ctx):
-                with span("proxy_request",
+                with span("proxy_request", route=route,
                           replay_safe=bool(replay_safe)) as sp:
                     tid = getattr(sp, "trace_id", None) or ctx.trace_id
                     parent = getattr(sp, "span_id", None) or ctx.span_id
@@ -542,6 +547,16 @@ class _HttpProxy(_SpliceProxy):
                     getattr(self, "_port_wids", {}).get(port))
                 sp.set_attr("failovers", attempted - 1)
                 sp.set_attr("outcome", "ok")
+                try:
+                    # the status from the response head the proxy
+                    # already holds: a forwarded 4xx/5xx must retain the
+                    # PROXY side of the trace too, or error waterfalls
+                    # would assemble with the proxy hop missing
+                    head = first.split(b" ", 2)
+                    if head[0].startswith(b"HTTP/"):
+                        sp.set_attr("status", int(head[1]))
+                except (IndexError, ValueError):
+                    pass
             upstream.settimeout(None)
             try:
                 client.sendall(first)
