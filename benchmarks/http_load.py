@@ -1509,6 +1509,234 @@ def run_trace_intel(args, rng) -> dict:
             proc.kill()
 
 
+def run_watchtower(args, rng) -> dict:
+    """The graded watchtower drill (archives WATCH_r*.json): a 2-worker
+    fleet with the detector windows drill-scaled (fast 3 s / slow 10 s,
+    hold 0.5 s, clear 3 s).  Phase 1 sends clean classify traffic long
+    enough to warm every detector baseline and asserts ZERO firing
+    alerts and zero alert-opened incidents (the false-positive gate).
+    Phase 2 injects a mid-run regression — a sustained burst of
+    unmeetable-deadline 504s — and polls ``/debug/alerts`` until the
+    error-burn page fires (detection latency, gated against the
+    ``--detect-budget-s`` window); the firing page must close the loop
+    into EXACTLY ONE ``alert:``-reason incident (two detectors or two
+    workers paging inside the cooldown coalesce) with the offending
+    retained traces pinned as evidence.  Phase 3 stops the burst and
+    polls until the alert walks firing → resolved (flap damping exits
+    cleanly after recovery)."""
+    state_dir = args.state_dir or f"/tmp/dl4j-watchtower-{os.getpid()}"
+    pm_dir = os.path.join(state_dir, "postmortem")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DL4J_TPU_POSTMORTEM_DIR=pm_dir,
+               DL4J_TPU_WATCHTOWER_INTERVAL_S="0.2",
+               DL4J_TPU_TIMESERIES_INTERVAL_S="0.2",
+               DL4J_TPU_WATCHTOWER_FAST_S="3.0",
+               DL4J_TPU_WATCHTOWER_SLOW_S="10.0",
+               DL4J_TPU_WATCHTOWER_HOLD_S="0.5",
+               DL4J_TPU_WATCHTOWER_CLEAR_S="3.0",
+               DL4J_TPU_WATCHTOWER_COOLDOWN_S="120.0",
+               DL4J_TPU_FLEET_HEALTH_INTERVAL_S="0.5")
+    env.pop("DL4J_TPU_WATCHTOWER", None)    # the drill grades the ON path
+    env.pop("DL4J_TPU_FLEET_OBS", None)
+    env.pop("DL4J_TPU_TRACE_STORE", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve.py"),
+         "--workers", "2", "--port", "0", "--state-dir", state_dir,
+         "--slots", str(args.slots), "--no-respawn"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        fleet = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("tools/serve.py exited before "
+                                   "announcing the fleet")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "fleet" in doc:
+                fleet = doc
+                break
+        if fleet is None:
+            raise RuntimeError("fleet announce line never arrived")
+        addr = fleet["address"]
+        admin = fleet.get("admin_address")
+        if not admin:
+            raise RuntimeError("fleet announce carried no admin_address "
+                               "(is DL4J_TPU_FLEET_OBS off?)")
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                _get(addr, "/debug/frontdoor", timeout=5.0)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet never answered")
+                time.sleep(0.5)
+
+        def classify(i: int, bad_deadline: bool = False):
+            doc = {"inputs": [[round(rng.uniform(0, 1), 6)
+                               for _ in range(4)]],
+                   "request_key": i}
+            if bad_deadline:
+                doc["deadline_ms"] = 0.001      # unmeetable: in-span 504
+            req = urllib.request.Request(
+                addr + "/v1/classify", data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    r.read()
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+            except Exception:
+                return None
+
+        def alerts_view():
+            """The fleet alert rollup through the proxy admin (never a
+            500); polling a worker's own /debug/alerts through the
+            splice drives its beat too."""
+            try:
+                _get(addr, "/debug/alerts", timeout=5.0)     # beat a worker
+                code, doc = _get(admin, "/debug/alerts", timeout=5.0)
+                return doc if code == 200 else {}
+            except Exception:
+                return {}
+
+        def firing_rules(view: dict):
+            rules = set()
+            for a in (view.get("watchtower") or {}).get("firing") or ():
+                rules.add(a.get("rule"))
+            for _wid, rec in (view.get("workers") or {}).items():
+                for a in rec.get("firing") or ():
+                    rules.add(a.get("rule"))
+            for a in (view.get("fleet") or {}).get("firing") or ():
+                rules.add(a.get("rule"))
+            return rules - {None}
+
+        def alert_incidents(view: dict):
+            return [i for i in view.get("incidents") or ()
+                    if str(i.get("reason", "")).startswith("alert:")]
+
+        # ---- phase 1: clean baseline — warm every detector, zero alerts
+        baseline_s = 10.0
+        base_false = set()
+        t0 = time.monotonic()
+        i = 0
+        while time.monotonic() - t0 < baseline_s:
+            classify(i)
+            i += 1
+            if i % 10 == 0:
+                base_false |= firing_rules(alerts_view())
+            time.sleep(0.05)
+        view = alerts_view()
+        base_false |= firing_rules(view)
+        baseline_incidents = len(alert_incidents(view))
+        fp_free = not base_false and baseline_incidents == 0
+
+        # ---- phase 2: regression — sustained 504 burst; detect + page
+        detect_budget_s = args.detect_budget_s
+        burst_t0 = time.monotonic()
+        detect_s = None
+        fired = set()
+        j = 0
+        while time.monotonic() - burst_t0 < detect_budget_s:
+            classify(10_000 + j, bad_deadline=True)
+            j += 1
+            if j % 5 == 0:
+                fired = firing_rules(alerts_view())
+                if "watch_http_error_burn" in fired:
+                    detect_s = time.monotonic() - burst_t0
+                    break
+            time.sleep(0.02)
+        detected = detect_s is not None
+
+        # keep the burst alive briefly so the capture fan-out completes,
+        # then grade the incident ledger: EXACTLY ONE alert incident,
+        # with pinned trace evidence attached
+        incidents = []
+        fan_deadline = time.monotonic() + 10.0
+        while time.monotonic() < fan_deadline:
+            classify(20_000 + j, bad_deadline=True)
+            j += 1
+            incidents = alert_incidents(alerts_view())
+            if incidents and len((incidents[0].get("captured") or {})) >= 2:
+                break
+            time.sleep(0.2)
+        single_incident = len(incidents) == 1
+        traces_attached = bool(incidents
+                               and incidents[0].get("trace_ids"))
+        captured_workers = sorted((incidents[0].get("captured") or {})
+                                  if incidents else ())
+
+        # ---- phase 3: recovery — the page must resolve, not flap
+        resolved = False
+        recover_t0 = time.monotonic()
+        k = 0
+        while time.monotonic() - recover_t0 < 30.0:
+            classify(30_000 + k)
+            k += 1
+            if k % 5 == 0:
+                view = alerts_view()
+                still = firing_rules(view)
+                if "watch_http_error_burn" not in still:
+                    res = set()
+                    for _wid, rec in (view.get("workers") or {}).items():
+                        for a in rec.get("resolved") or ():
+                            res.add(a.get("rule"))
+                    for a in ((view.get("watchtower") or {})
+                              .get("resolved") or ()):
+                        res.add(a.get("rule"))
+                    if "watch_http_error_burn" in res:
+                        resolved = True
+                        break
+            time.sleep(0.05)
+        final_incidents = alert_incidents(alerts_view())
+
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+        rec = {
+            "metric": "watch_drill",
+            "platform": platform,
+            "value": round(detect_s, 3) if detected else None,
+            "unit": "detect_latency_s",
+            "detected": detected,
+            "detect_latency_s": (round(detect_s, 3) if detected
+                                 else None),
+            "detect_budget_s": detect_budget_s,
+            "fp_free": fp_free,
+            "baseline_false_rules": sorted(base_false),
+            "fired_rules": sorted(fired),
+            "single_incident": single_incident,
+            "alert_incidents": len(final_incidents),
+            "traces_attached": traces_attached,
+            "trace_ids": ((final_incidents[0].get("trace_ids") or [])[:8]
+                          if final_incidents else []),
+            "captured_workers": captured_workers,
+            "resolved": resolved,
+            "baseline_requests": i,
+            "burst_requests": j,
+            "recovery_requests": k,
+            "workers": 2,
+            "seed": args.seed,
+        }
+        rec["ok_verdict"] = bool(detected and fp_free and single_incident
+                                 and traces_attached and resolved)
+        return rec
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 # ----------------------------------------------------------------- record
 def _record(args, stats: "_Stats", stream: dict, vs_direct, workers,
             kill_drill, rollout=None) -> dict:
@@ -1617,12 +1845,31 @@ def main(argv=None) -> int:
                          "proxy admin, SIGKILL one worker and check "
                          "survivor retention + partial assembly; "
                          "archives TRACEQ_r*.json")
+    ap.add_argument("--watchtower", action="store_true",
+                    help="the graded 2-worker watchtower drill: clean "
+                         "baseline must stay alert-free, a mid-run 504 "
+                         "burst must page the error-burn detector within "
+                         "the detection budget and close the loop into "
+                         "exactly one trace-attached incident, and the "
+                         "alert must resolve after recovery; archives "
+                         "WATCH_r*.json")
+    ap.add_argument("--detect-budget-s", type=float, default=15.0,
+                    help="--watchtower: seconds the burn-rate page may "
+                         "take to fire after the regression starts")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.kill_drill and args.workers < 2:
         ap.error("--kill-drill needs --workers >= 2")
     import random
     rng = random.Random(args.seed)
+    if args.watchtower:
+        rec = run_watchtower(args, rng)
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0 if rec.get("ok_verdict") else 1
     if args.trace_intel:
         rec = run_trace_intel(args, rng)
         line = json.dumps(rec)

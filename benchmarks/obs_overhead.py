@@ -48,10 +48,18 @@ request path: no inbound-context parse, no response trace header) vs
 ``=1`` (the full cross-process propagation path). Bar: <2% — trace
 propagation must be free enough to leave on in production.
 
+``--watchtower-ab`` runs the watchtower A/B: per-request front-door
+latency with a background thread beating the watchtower (timeseries
+scrape + burn/change-point detectors + alert lifecycle) at drill
+cadence, ``DL4J_TPU_WATCHTOWER=0`` (beats no-op — the pre-watchtower
+process) vs ``=1``. Bar: <2% — continuous detection must be free enough
+to leave on in production.
+
 Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
      python benchmarks/obs_overhead.py --elastic-ab [--json]
      python benchmarks/obs_overhead.py --warmup-ab [--json]
      python benchmarks/obs_overhead.py --fleet-obs-ab [--json]
+     python benchmarks/obs_overhead.py --watchtower-ab [--json]
 """
 from __future__ import annotations
 
@@ -428,6 +436,119 @@ def trace_store_ab(steps: int, repeats: int, as_json: bool) -> float:
     return overhead
 
 
+#: watchtower A/B worker: the same traced front-door request loop, but
+#: with a background thread beating the watchtower (timeseries scrape +
+#: detector evaluation + alert lifecycle) at drill cadence throughout
+#: the measurement window. The arms differ ONLY in DL4J_TPU_WATCHTOWER:
+#: 0 makes every beat a no-op (the pre-watchtower process), 1 runs the
+#: full scrape + detector + lifecycle machinery concurrently with the
+#: request path — the cost this A/B exists to bound.
+_WATCHTOWER_WORKER = r"""
+import json, os, sys, threading, time, urllib.request
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability.watchtower import global_watchtower
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.serving import ModelRegistry, ServingRouter
+from deeplearning4j_tpu.serving.frontdoor import FrontDoor
+
+steps = int(sys.argv[1])
+
+conf = (NeuralNetConfiguration.builder()
+        .seed(1).updater(Adam(1e-2)).list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+reg = ModelRegistry()
+reg.deploy("v1", net, sample_input=np.zeros((1, 4), dtype="f4"),
+           batch_limit=4, max_wait_ms=1.0)
+door = FrontDoor(ServingRouter(reg, "v1"), None, port=0).start()
+addr = f"http://127.0.0.1:{door.port}"
+body = json.dumps({"inputs": [[0.1, 0.2, 0.3, 0.4]]}).encode()
+
+stop = threading.Event()
+
+
+def beat_loop():                  # the sync-beat cadence, drill-scaled
+    while not stop.is_set():
+        global_watchtower().beat()
+        stop.wait(0.05)
+
+
+beater = threading.Thread(target=beat_loop, daemon=True)
+beater.start()
+
+
+def one(i):
+    req = urllib.request.Request(
+        addr + "/v1/classify", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30.0) as r:
+        r.read()
+
+
+for i in range(10):               # compile + socket churn outside the window
+    one(i)
+t0 = time.perf_counter()
+for i in range(steps):
+    one(i)
+wall = time.perf_counter() - t0
+stop.set()
+beater.join(timeout=2.0)
+door.stop()
+reg.shutdown()
+print(json.dumps({"seconds_per_step": wall / steps,
+                  "watchtower": os.environ.get("DL4J_TPU_WATCHTOWER",
+                                               "1")}))
+"""
+
+#: watchtower A/B arm -> env overrides. Both arms run the beat thread;
+#: with =0 every beat is a no-op (the byte-identical pre-watchtower
+#: posture), with =1 the scrape + detectors + lifecycle run at drill
+#: cadence concurrently with the request path.
+WATCHTOWER_MODES = {
+    "wt_off": {"DL4J_TPU_WATCHTOWER": "0"},
+    "wt_on": {"DL4J_TPU_WATCHTOWER": "1",
+              "DL4J_TPU_WATCHTOWER_INTERVAL_S": "0.1",
+              "DL4J_TPU_TIMESERIES_INTERVAL_S": "0.1"},
+}
+
+
+def watchtower_ab(steps: int, repeats: int, as_json: bool) -> float:
+    """Interleaved min-of-N A/B (rotating arm order — the noisy-box
+    protocol): does the watchtower machinery (periodic registry scrape
+    into the timeseries rings + burn/change-point detectors + alert
+    lifecycle, beating at drill cadence on a background thread) keep
+    per-request front-door latency under the 2% bar?"""
+    best = _interleaved_min(
+        list(WATCHTOWER_MODES), repeats,
+        lambda m: _run_worker(_WATCHTOWER_WORKER, [steps],
+                              WATCHTOWER_MODES[m]))
+    overhead = ((best["wt_on"] - best["wt_off"])
+                / best["wt_off"] * 100.0)
+    result = {"request_seconds_watchtower_off": best["wt_off"],
+              "request_seconds_watchtower_on": best["wt_on"],
+              "watchtower_overhead_percent": overhead,
+              "steps": steps, "repeats": repeats}
+    if as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"watchtower A/B (/v1/classify under a 10 Hz beat, {steps} "
+              f"requests/arm, min of {repeats} interleaved repeats)")
+        print(f"  watchtower off (DL4J_TPU_WATCHTOWER=0):  "
+              f"{best['wt_off'] * 1e3:8.3f} ms/request")
+        print(f"  watchtower on  (scrape + detectors):     "
+              f"{best['wt_on'] * 1e3:8.3f} ms/request")
+        print(f"  watchtower overhead: {overhead:+.2f}%  (bar: < 2%)")
+    return overhead
+
+
 #: mode name -> env overrides on top of the caller's environment
 MODES = {
     "off": {"DL4J_TPU_METRICS": "0"},
@@ -469,6 +590,10 @@ def main():
     ap.add_argument("--trace-store-ab", action="store_true",
                     help="run the trace-store A/B: front-door request "
                          "latency with DL4J_TPU_TRACE_STORE=0 vs 1")
+    ap.add_argument("--watchtower-ab", action="store_true",
+                    help="run the watchtower A/B: front-door request "
+                         "latency with DL4J_TPU_WATCHTOWER=0 vs 1 under "
+                         "a drill-cadence beat thread")
     ap.add_argument("--save-every", type=int, default=8,
                     help="elastic A/B checkpoint cadence in steps (the "
                          "perf posture; the exact-resume drills save "
@@ -484,6 +609,12 @@ def main():
         return fleet_obs_ab(max(args.steps, 60), args.repeats, args.json)
     if args.trace_store_ab:
         return trace_store_ab(max(args.steps, 60), args.repeats, args.json)
+    if args.watchtower_ab:
+        # a longer window than the other request A/Bs: the beat thread
+        # fires every 100 ms, so a 60-request (~0.2 s) window would
+        # sample 2 beats and grade scheduler noise instead
+        return watchtower_ab(max(args.steps, 200), args.repeats,
+                             args.json)
 
     # a lone run is dominated by host warmup noise (the first subprocess
     # routinely runs 1.5x slower than steady state regardless of mode) —

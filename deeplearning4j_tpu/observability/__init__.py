@@ -81,6 +81,13 @@ from deeplearning4j_tpu.observability.slo import PerfRegressionRule
 from deeplearning4j_tpu.observability.profile_capture import (
     ProfileCapture, global_profile_capture, profile_enabled,
     reset_global_profile_capture)
+from deeplearning4j_tpu.observability.timeseries import (
+    TimeseriesStore, global_timeseries, reset_global_timeseries,
+    watchtower_enabled)
+from deeplearning4j_tpu.observability.watchtower import (
+    AlertManager, BurnRateDetector, ChangePointDetector, Detector,
+    ThresholdDetector, Watchtower, default_detectors, global_watchtower,
+    reset_global_watchtower)
 
 #: ergonomic aliases
 metrics = global_registry
@@ -110,6 +117,11 @@ __all__ = [
     "reset_global_cost_model", "PerfRegressionRule",
     "ProfileCapture", "global_profile_capture", "profile_enabled",
     "reset_global_profile_capture",
+    "TimeseriesStore", "global_timeseries", "reset_global_timeseries",
+    "watchtower_enabled",
+    "AlertManager", "BurnRateDetector", "ChangePointDetector", "Detector",
+    "ThresholdDetector", "Watchtower", "default_detectors",
+    "global_watchtower", "reset_global_watchtower",
 ]
 
 

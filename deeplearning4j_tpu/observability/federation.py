@@ -62,12 +62,18 @@ from deeplearning4j_tpu.observability.registry import (_fmt_labels,
                                                        global_registry,
                                                        on_registry_reset)
 from deeplearning4j_tpu.observability.slo import (FAILING, OK, SLOEngine,
-                                                  SLORule, _grade)
+                                                  SLORule, _grade,
+                                                  global_slo_engine)
+from deeplearning4j_tpu.observability.timeseries import (
+    timeseries_payload, watchtower_enabled)
 from deeplearning4j_tpu.observability.trace_store import (
     global_trace_store, trace_store_enabled)
 from deeplearning4j_tpu.observability.tracing import (TraceContext,
                                                       current_context,
                                                       global_trace_sink)
+from deeplearning4j_tpu.observability.watchtower import (
+    PAGE, WARN, BurnRateDetector, ChangePointDetector, ThresholdDetector,
+    Watchtower, global_watchtower, incident_cooldown_s)
 
 __all__ = [
     "TRACE_HEADER", "PARENT_HEADER", "fleet_obs_enabled", "worker_top_n",
@@ -79,6 +85,8 @@ __all__ = [
     "FleetAdminServer",
     "scrape_worker_traces", "fleet_recent_traces", "assemble_trace",
     "assembled_chrome_trace", "handle_trace_route", "PHASES",
+    "FleetWatch", "fleet_default_detectors", "publish_alerts",
+    "handle_alerts_route",
 ]
 
 #: the cross-process trace headers (the front door already EMITTED the
@@ -954,29 +962,256 @@ def publish_rollup(store, worker_id: str, term, report: dict) -> None:
     store.update(mutate)
 
 
+# ----------------------------------------------------- fleet watchtower
+
+def fleet_default_detectors(fleet: "FleetWatch"):
+    """The LEADER's fleet-level watch rules, graded from the federated
+    scrape (the :class:`_FleetRule` posture lifted to the watchtower):
+    fleet-wide 5xx burn, worst-worker p99 step change, and a plain
+    bound on missing workers."""
+    return [
+        BurnRateDetector(
+            "fleet_error_burn", totals_fn=fleet.http_totals,
+            description="fleet-wide 5xx error-budget burn over the "
+                        "federated scrape (fast+slow window pair)",
+            severity=PAGE),
+        ChangePointDetector(
+            "fleet_p99_shift", fleet.worst_p99, direction="up",
+            description="worst-worker front-door p99 step change across "
+                        "the fleet",
+            severity=WARN),
+        ThresholdDetector(
+            "fleet_workers_missing", fleet.missing_workers,
+            firing_above=0.5,
+            description="registered workers heartbeat-stale or "
+                        "unreachable for scrape",
+            severity=WARN),
+    ]
+
+
+class FleetWatch:
+    """Leader-side fleet watchtower: a second :class:`Watchtower` whose
+    detectors read the :class:`FleetHealth` federated snapshot instead
+    of the local registry.  ``beat()`` rides the leader's alert-publish
+    cadence; a firing fleet page closes the detect→capture loop exactly
+    like a local one (the leader's bundle dump posts the incident the
+    fan-out protocol spreads)."""
+
+    def __init__(self, health: FleetHealth):
+        self.health = health
+        self.tower = Watchtower(detectors=fleet_default_detectors(self),
+                                scrape=False)
+
+    # ------------------------------------------------- detector inputs
+    def http_totals(self):
+        """Fleet-cumulative ``(5xx, total)`` of the front-door request
+        counter summed over every scraped worker."""
+        errors = total = 0.0
+        for _wid, parsed in sorted(
+                (self.health.snap.get("workers") or {}).items()):
+            for labels, value in parsed.get("dl4j_http_requests_total",
+                                            ()):
+                total += value
+                if str(labels.get("code", "")).startswith("5"):
+                    errors += value
+        return errors, total
+
+    def worst_p99(self, now) -> Optional[float]:
+        worst = None
+        for _wid, parsed in sorted(
+                (self.health.snap.get("workers") or {}).items()):
+            le_cum: Dict[float, float] = {}
+            for labels, value in parsed.get(
+                    "dl4j_http_latency_seconds_bucket", ()):
+                le = labels.get("le")
+                if le is None:
+                    continue
+                try:
+                    bound = float(le)
+                except ValueError:
+                    continue
+                le_cum[bound] = le_cum.get(bound, 0.0) + value
+            if le_cum.get(float("inf"), 0.0) < 8:
+                continue
+            q = _bucket_quantile(le_cum, 0.99)
+            if q == q and (worst is None or q > worst):
+                worst = q
+        return worst
+
+    def missing_workers(self, now) -> float:
+        doc = self.health.snap.get("doc") or {}
+        workers = {w: r for w, r in (doc.get("workers") or {}).items()
+                   if isinstance(r, dict)}
+        stale = {w for w, r in workers.items()
+                 if now - float(r.get("heartbeat", 0) or 0)
+                 > _WORKER_TTL_S}
+        unreachable = (set(self.health.snap.get("errors") or ())
+                       - {"__store__"})
+        return float(len(stale | (unreachable & set(workers))))
+
+    # ------------------------------------------------------------ beat
+    def beat(self, now: Optional[float] = None):
+        """Refresh the federated scrape and run one forced evaluation
+        (the caller owns the cadence); returns the transitions."""
+        self.health.refresh()
+        return self.tower.beat(now, force=True)
+
+    def snapshot(self) -> dict:
+        return self.tower.snapshot()
+
+
+#: published per-worker alert records older than this are pruned from
+#: the store doc — a long-dead worker must not haunt /debug/alerts
+_ALERTS_STALE_S = 600.0
+
+
+def publish_alerts(store, worker_id: str, term, local: dict,
+                   fleet: Optional[dict] = None,
+                   is_leader: bool = False) -> None:
+    """This worker's alert snapshot — and, on the LEADER, the
+    fleet-level snapshot — into the shared store's ``alerts`` doc, the
+    rollup every surface's ``/debug/alerts`` shows."""
+    at = time.time()
+    mine = {"at": at, "state": "ok" if not local.get("firing")
+            else "firing",
+            "firing": local.get("firing") or [],
+            "pending": local.get("pending") or [],
+            "resolved": local.get("resolved") or []}
+
+    def mutate(doc):
+        alerts = doc.get("alerts")
+        if not isinstance(alerts, dict):
+            alerts = {}
+        workers = alerts.get("workers")
+        if not isinstance(workers, dict):
+            workers = {}
+        workers[worker_id] = mine
+        alerts["workers"] = {
+            w: r for w, r in workers.items()
+            if isinstance(r, dict)
+            and at - float(r.get("at", 0) or 0) <= _ALERTS_STALE_S}
+        if is_leader and fleet is not None:
+            alerts["fleet"] = {"at": at, "by": worker_id, "term": term,
+                               "firing": fleet.get("firing") or [],
+                               "pending": fleet.get("pending") or [],
+                               "resolved": fleet.get("resolved") or []}
+        doc["alerts"] = alerts
+    store.update(mutate)
+
+
+def handle_alerts_route(path: str, query: Dict[str, list],
+                        store=None, local_worker: str = "local",
+                        fleet: bool = False) -> Tuple[int, object]:
+    """Shared ``/debug/alerts`` (and legacy ``/alerts``) routing for all
+    three HTTP surfaces: ``(status, json_payload)``.
+
+    The payload keeps the legacy SLO-engine keys (``status`` /
+    ``active`` / ``history`` — old consumers of ``GET /alerts`` still
+    parse) and adds the watchtower's lifecycle view; fleet surfaces add
+    the store rollup (leader's fleet alerts + per-worker snapshots),
+    the incident ledger, and an honest ``partial`` list naming live-
+    registered workers whose alerts are unknown — never a 500 because
+    a worker died.  With ``DL4J_TPU_WATCHTOWER=0`` the legacy path
+    answers the pre-watchtower payload byte-identically and the new
+    path 404s."""
+    p = path.rstrip("/")
+    if not watchtower_enabled():
+        if p == "/alerts":
+            return 200, global_slo_engine().alerts()
+        return 404, {"error": "NotFound", "path": path}
+    wt = global_watchtower()
+    wt.beat()           # throttled internally — the answer is current
+    payload = global_slo_engine().alerts()
+    payload["worker"] = local_worker
+    payload["watchtower"] = wt.snapshot()
+    if not (fleet and store is not None and fleet_obs_enabled()):
+        return 200, payload
+    try:
+        doc = store.read()
+    # graftlint: disable=typed-errors — a torn store read degrades to
+    # the local view; the alerts surface never 500s
+    except Exception as e:
+        payload["store_error"] = repr(e)
+        doc = {}
+    fleet_alerts = doc.get("alerts")
+    if not isinstance(fleet_alerts, dict):
+        fleet_alerts = {}
+    workers = fleet_alerts.get("workers")
+    payload["workers"] = workers if isinstance(workers, dict) else {}
+    payload["fleet"] = fleet_alerts.get("fleet")
+    now = time.time()
+    partial = []
+    for wid, rec in sorted((doc.get("workers") or {}).items()):
+        if not isinstance(rec, dict):
+            continue
+        if now - float(rec.get("heartbeat", 0) or 0) > _WORKER_TTL_S:
+            partial.append(wid)          # dead: its alerts are unknown
+        elif wid not in payload["workers"]:
+            partial.append(wid)          # live but not yet published
+    payload["partial"] = partial
+    payload["incidents"] = [i for i in (doc.get("incidents") or [])
+                            if isinstance(i, dict)]
+    return 200, payload
+
+
 # ------------------------------------------------------ incident capture
 
 def post_incident(store, worker_id: str, reason: str,
                   bundle: Optional[str],
-                  trace_id: Optional[str] = None) -> str:
+                  trace_id: Optional[str] = None,
+                  trace_ids: Optional[List[str]] = None) -> str:
     """Record a tripped flight recorder in the shared store: the record
-    carries the trace id of the request that was live when it tripped,
-    the originating worker's bundle name, and a fresh incident id the
-    leader will fan out so every peer captures under the SAME id."""
+    carries the trace id of the request that was live when it tripped
+    (plus any watchtower-pinned evidence ids), the originating worker's
+    bundle name, and a fresh incident id the leader will fan out so
+    every peer captures under the SAME id.
+
+    Watchtower dedup: two ``alert:<rule>`` incidents posted inside the
+    alert cooldown window coalesce onto ONE incident id — two detectors
+    paging on the same outage must yield one fleet-wide capture, not
+    two dump storms."""
     inc_id = os.urandom(6).hex()
     name = os.path.basename(bundle) if bundle else None
+    evidence = [t for t in (trace_ids or ()) if t]
     rec = {"id": inc_id, "worker": worker_id, "reason": str(reason),
-           "bundle": name, "trace_id": trace_id, "at": time.time(),
+           "bundle": name, "trace_id": trace_id,
+           "trace_ids": evidence, "at": time.time(),
            "fanned_out": False,
            "captured": ({worker_id: name} if name else {})}
+    coalesce = str(reason).startswith("alert:")
+    out = {"id": inc_id}
 
     def mutate(doc):
         incidents = [i for i in (doc.get("incidents") or [])
                      if isinstance(i, dict)]
+        if coalesce:
+            window = incident_cooldown_s()
+            now = time.time()
+            for i in reversed(incidents):
+                if (str(i.get("reason", "")).startswith("alert:")
+                        and now - float(i.get("at", 0) or 0) <= window):
+                    # same outage: fold this page onto the open incident
+                    if name:
+                        captured = dict(i.get("captured") or {})
+                        captured.setdefault(worker_id, name)
+                        i["captured"] = captured
+                    merged = list(i.get("trace_ids") or [])
+                    merged.extend(t for t in evidence
+                                  if t not in merged)
+                    i["trace_ids"] = merged[:32]
+                    also = list(i.get("coalesced") or [])
+                    if str(reason) != i.get("reason") \
+                            and str(reason) not in also:
+                        also.append(str(reason))
+                        i["coalesced"] = also
+                    out["id"] = i["id"]
+                    doc["incidents"] = incidents[-_INCIDENT_CAP:]
+                    return
         incidents.append(rec)
+        out["id"] = inc_id
         doc["incidents"] = incidents[-_INCIDENT_CAP:]
     store.update(mutate)
-    return inc_id
+    return out["id"]
 
 
 def incident_beat(store, worker_id: str, is_leader: bool,
@@ -1051,15 +1286,21 @@ def install_incident_publisher(store, worker_id: str) -> None:
         if str(reason).startswith("incident"):
             return                       # peer capture: never re-post
         ctx = current_context()
+        trace_ids = None
         if ctx is not None and trace_store_enabled():
             # the live request's trace is evidence: eviction-exempt,
             # and everything completing around the trip is kept too
             st = global_trace_store()
             st.pin(parse_trace_id(ctx.trace_id))
             st.open_incident_window()
+        if trace_store_enabled() and str(reason).startswith("alert:"):
+            # a watchtower page has no live request context — its
+            # evidence is the offending traces it pinned before dumping
+            trace_ids = global_trace_store().pinned_ids()[-8:]
         try:
             post_incident(store, worker_id, reason, bundle,
-                          trace_id=ctx.trace_id if ctx else None)
+                          trace_id=ctx.trace_id if ctx else None,
+                          trace_ids=trace_ids)
         except Exception:
             pass        # the store being down must never mask the dump
     _fr.set_incident_publisher(_publish)
@@ -1132,6 +1373,18 @@ class FleetAdminServer:
                             report)
                     elif path == "/alerts/fleet":
                         self._json(200, srv.health.alerts())
+                    elif (path == "/debug/alerts"
+                            and watchtower_enabled()):
+                        q = parse_qs(urlparse(self.path).query)
+                        code, payload = handle_alerts_route(
+                            path, q, srv.store, srv.local_worker,
+                            fleet=True)
+                        self._json(code, payload)
+                    elif (path == "/debug/timeseries"
+                            and watchtower_enabled()):
+                        q = parse_qs(urlparse(self.path).query)
+                        self._json(200, timeseries_payload(
+                            q, local_worker=srv.local_worker))
                     elif path == "/debug/proxy":
                         self._json(200, srv.debug_snapshot())
                     elif (path.startswith("/debug/trace")

@@ -367,6 +367,13 @@ class FlightRecorder:
             trace_store_enabled)
         if trace_store_enabled():
             section("traces.json", self._write_traces)
+        # the watchtower layer: the ringed registry timeseries (the
+        # minutes BEFORE the trip) and the alert lifecycle state at the
+        # moment of death — section absent with the switch off
+        from deeplearning4j_tpu.observability.timeseries import (
+            watchtower_enabled)
+        if watchtower_enabled():
+            section("timeseries.json", self._write_timeseries)
         if reason.startswith("incident:"):
             # a coordinated peer capture: stamp the fleet-wide incident
             # id INTO the bundle so a postmortem directory groups every
@@ -516,6 +523,22 @@ class FlightRecorder:
         with open(path, "w") as f:
             json.dump({"pinned": pinned, "recent": store.recent(),
                        "traces": traces}, f, indent=2, default=str)
+
+    @staticmethod
+    def _write_timeseries(path: str):
+        from deeplearning4j_tpu.observability.timeseries import (
+            global_timeseries)
+        # sys.modules guard for the watchtower (same rationale as
+        # _write_generation): a process that never beat it gets None,
+        # not a fresh import under the import lock
+        import sys as _sys
+        wt = _sys.modules.get(
+            "deeplearning4j_tpu.observability.watchtower")
+        payload = global_timeseries().snapshot()
+        payload["alerts"] = (wt.global_watchtower().alerts.snapshot()
+                             if wt is not None else None)
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
 
     @staticmethod
     def _write_metrics(path: str):

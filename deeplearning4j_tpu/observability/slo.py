@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 from deeplearning4j_tpu.observability.registry import (Histogram,
@@ -293,6 +294,13 @@ def default_rules() -> List[SLORule]:
     ]
 
 
+#: every live engine, global or privately held (FleetHealth, rollout
+#: gates) — a drill/test reset must clear ALL since/transition state,
+#: not just the global engine's, or fleet alert timestamps survive
+#: `reset_global_slo_engine()` and the next phase starts dirty
+_ALL_ENGINES: "weakref.WeakSet[SLOEngine]" = weakref.WeakSet()
+
+
 class SLOEngine:
     """Evaluates a rule set against a registry and tracks transitions."""
 
@@ -306,6 +314,7 @@ class SLOEngine:
         self._lock = threading.Lock()
         self._since: Dict[str, tuple] = {}     # rule -> (status, since_ts)
         self._history: List[dict] = []         # recent transitions
+        _ALL_ENGINES.add(self)
 
     def add_rule(self, rule: SLORule) -> "SLOEngine":
         self.rules.append(rule)
@@ -372,17 +381,26 @@ def global_slo_engine() -> SLOEngine:
     return _global_engine
 
 
+def _reset_all_engine_state():
+    for eng in list(_ALL_ENGINES):
+        eng.reset_state()
+
+
 def reset_global_slo_engine(
         rules: Optional[Sequence[SLORule]] = None) -> SLOEngine:
     global _global_engine
     with _engine_lock:
         _global_engine = SLOEngine(rules)
+    # every OTHER live engine too: alert since-timestamps must not
+    # survive the reset through a privately-held engine (the fleet
+    # health view, a rollout gate) — drills and tests start clean
+    _reset_all_engine_state()
     return _global_engine
 
 
 @on_registry_reset
 def _clear_engine_state():
     # a fresh registry invalidates since/transition state (tests reset the
-    # registry under a long-lived engine)
-    if _global_engine is not None:
-        _global_engine.reset_state()
+    # registry under a long-lived engine) — for every live engine, not
+    # just the global one
+    _reset_all_engine_state()

@@ -74,7 +74,9 @@ from deeplearning4j_tpu.observability import current_span as _current_span
 from deeplearning4j_tpu.observability import federation as _fed
 from deeplearning4j_tpu.observability import global_registry, on_registry_reset
 from deeplearning4j_tpu.observability import span as _span
+from deeplearning4j_tpu.observability import timeseries as _tms
 from deeplearning4j_tpu.observability import trace_store as _trace_store
+from deeplearning4j_tpu.observability import watchtower as _watchtower
 from deeplearning4j_tpu.observability.tracing import (current_context,
                                                       trace_context)
 from deeplearning4j_tpu.resilience import faults as _faults
@@ -287,6 +289,8 @@ class FrontDoor:
         self._started_at = time.time()
         self._fleet_health = None       # lazy federation.FleetHealth
         self._fleet_pub_at = 0.0        # leader rollup publish throttle
+        self._fleet_watch = None        # lazy federation.FleetWatch
+        self._alerts_pub_at = 0.0       # alert snapshot publish throttle
         FrontDoor._live.add(self)
 
     # ------------------------------------------------------------- lanes
@@ -410,17 +414,53 @@ class FrontDoor:
                                                   worker_id=self.worker_id)
         return self._fleet_health
 
+    def _fleet_watch_view(self):
+        """The LEADER's fleet-level watchtower (lazy; wraps the same
+        federated health view so detectors read one scrape shape)."""
+        if self._fleet_watch is None:
+            self._fleet_watch = _fed.FleetWatch(self._fleet_health_view())
+        return self._fleet_watch
+
+    def _maybe_publish_alerts(self):
+        """This worker's watchtower alert snapshot into the shared
+        store, throttled to the health interval; the LEADER also beats
+        the fleet-level detectors and publishes their rollup."""
+        if not _tms.watchtower_enabled():
+            return
+        now = time.monotonic()
+        if now - self._alerts_pub_at < _fed.health_interval_s():
+            return
+        self._alerts_pub_at = now
+        fleet = None
+        term = None
+        if self.shared.is_leader:
+            fw = self._fleet_watch_view()
+            fw.beat()
+            fleet = fw.snapshot()
+            term = self.shared.leader_term
+        _fed.publish_alerts(self.shared.store, self.worker_id, term,
+                            _watchtower.global_watchtower().snapshot(),
+                            fleet=fleet,
+                            is_leader=self.shared.is_leader)
+
     def _fleet_obs_beat(self):
         """One beat of the fleet observability plane (rides the sync
-        loop; tests single-step it directly): run the incident fan-out
-        protocol, and — on the LEADER only, throttled to
+        loop; tests single-step it directly): beat the local watchtower
+        (its own live kill switch + interval throttle — a page firing
+        here pins traces and dumps the bundle the incident publisher
+        fans out), run the incident fan-out protocol, publish this
+        worker's alert snapshot, and — on the LEADER only, throttled to
         ``DL4J_TPU_FLEET_HEALTH_INTERVAL_S`` — publish the fleet health
         rollup into the shared store so every worker's ``/debug/fleet``
         shows one consistent verdict."""
-        if self.shared is None or not _fed.fleet_obs_enabled():
+        if self.shared is None:
+            return
+        _watchtower.global_watchtower().beat()
+        if not _fed.fleet_obs_enabled():
             return
         _fed.incident_beat(self.shared.store, self.worker_id,
                            self.shared.is_leader)
+        self._maybe_publish_alerts()
         if not self.shared.is_leader:
             return
         now = time.monotonic()
@@ -988,6 +1028,26 @@ class FrontDoor:
                           and fd.shared is not None):
                         self._reply(200, fd._fleet_health_view().alerts(),
                                     route, t0)
+                    elif (path == "/debug/alerts"
+                          and _tms.watchtower_enabled()):
+                        # the unified alert surface: legacy SLO keys +
+                        # watchtower lifecycle + (fleet mode) the store
+                        # rollup with honest `partial` on dead workers
+                        q = parse_qs(urlparse(self.path).query)
+                        code, payload = _fed.handle_alerts_route(
+                            path, q,
+                            store=(fd.shared.store
+                                   if fd.shared is not None else None),
+                            local_worker=fd.worker_id,
+                            fleet=fleet_on and fd.shared is not None)
+                        self._reply(code, payload, route, t0)
+                    elif (path == "/debug/timeseries"
+                          and _tms.watchtower_enabled()):
+                        # the minutes BEFORE the trip: ringed registry
+                        # samples (?name=<prefix>&last=N)
+                        q = parse_qs(urlparse(self.path).query)
+                        self._reply(200, _tms.timeseries_payload(
+                            q, local_worker=fd.worker_id), route, t0)
                     elif (path.startswith("/debug/trace")
                             and _trace_store.trace_store_enabled()):
                         # trace intelligence: retained traces with
@@ -1136,5 +1196,10 @@ def fleet_snapshot() -> dict:
                 break
             out["fleet_health"] = doc.get("fleet_health")
             out["incidents"] = doc.get("incidents") or []
+            if _tms.watchtower_enabled():
+                # the published alert rollup (leader fleet verdict +
+                # per-worker snapshots) — key absent with the
+                # watchtower off, byte-identical to pre-watchtower
+                out["alerts"] = doc.get("alerts")
             break
     return out

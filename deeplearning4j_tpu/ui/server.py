@@ -604,12 +604,38 @@ class UIServer:
                     ctype = "application/json"
                     if report["status"] == FAILING:
                         code = 503
-                elif parsed.path == "/alerts":
-                    # active SLO violations (with since-when) + recent
-                    # status transitions
-                    from deeplearning4j_tpu.observability.slo import (
-                        global_slo_engine)
-                    body = json.dumps(global_slo_engine().alerts()).encode()
+                elif parsed.path in ("/alerts", "/debug/alerts"):
+                    # the unified alert surface (shared router with the
+                    # front door and proxy admin): legacy SLO keys
+                    # (status/active/history — old /alerts consumers
+                    # still parse) + the watchtower alert lifecycle.
+                    # The legacy path stays as an alias; with
+                    # DL4J_TPU_WATCHTOWER=0 it answers the
+                    # pre-watchtower payload byte-identically and the
+                    # new path 404s
+                    from deeplearning4j_tpu.observability import (
+                        federation as _fed)
+                    code, payload = _fed.handle_alerts_route(
+                        parsed.path, q, local_worker="local")
+                    body = json.dumps(payload, default=str).encode()
+                    ctype = "application/json"
+                elif parsed.path == "/debug/timeseries":
+                    # the minutes BEFORE the trip: ringed registry
+                    # samples from the periodic scrape
+                    # (?name=<prefix>&last=N); 404 with the watchtower
+                    # off — the ring does not exist then
+                    from deeplearning4j_tpu.observability import (
+                        timeseries as _tms)
+                    if _tms.watchtower_enabled():
+                        body = json.dumps(
+                            _tms.timeseries_payload(
+                                q, local_worker="local"),
+                            default=str).encode()
+                    else:
+                        code = 404
+                        body = json.dumps(
+                            {"error": "NotFound",
+                             "path": parsed.path}).encode()
                     ctype = "application/json"
                 elif parsed.path == "/debug/dump":
                     # live postmortem: write a flight-recorder bundle NOW

@@ -350,6 +350,63 @@ def main():
             # arrows); unknown ids are a 404, never a 500
         else:
             print("trace cafe0000deadbeef not retained (store off?)")
+
+        # ---- watchtower: fire an alert and watch the loop close ---------
+        # the detectors upstairs watch scraped series; here we make one
+        # page deterministically: scale the burn-rate windows down (env
+        # knobs are read live), then send a burst of unmeetable-deadline
+        # requests — every one sheds as an in-span 504, the error budget
+        # burns in BOTH windows, and watch_http_error_burn walks
+        # pending -> firing. Polling /debug/alerts drives the beats.
+        _os.environ["DL4J_TPU_WATCHTOWER_FAST_S"] = "1.0"
+        _os.environ["DL4J_TPU_WATCHTOWER_SLOW_S"] = "2.0"
+        _os.environ["DL4J_TPU_WATCHTOWER_HOLD_S"] = "0.0"
+        _os.environ["DL4J_TPU_WATCHTOWER_INTERVAL_S"] = "0.1"
+        _os.environ["DL4J_TPU_TIMESERIES_INTERVAL_S"] = "0.1"
+        firing = []
+        for k in range(80):
+            bad = urllib.request.Request(
+                f"http://127.0.0.1:{door.port}/v1/classify",
+                data=_json.dumps({"inputs": x[:1].tolist(),
+                                  "deadline_ms": 0.001}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(bad, timeout=10).read()
+            except urllib.error.HTTPError as e:     # the 504 we want
+                e.read()
+            alerts = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{door.port}/debug/alerts",
+                timeout=10).read())
+            firing = (alerts.get("watchtower") or {}).get("firing") or []
+            if any(a["rule"] == "watch_http_error_burn" for a in firing):
+                break
+            _time.sleep(0.1)
+        print("/debug/alerts after the 504 burst:")
+        for a in firing:
+            print(f"  FIRING {a['rule']} [{a['severity']}] — "
+                  f"{a.get('description', '')}")
+        if any(a["rule"] == "watch_http_error_burn" and
+               a["severity"] == "page" for a in firing):
+            # a PAGE going firing already closed the detect->capture
+            # loop: offending retained traces pinned, the incident
+            # window open, a flight-recorder bundle on disk — the
+            # postmortem existed before we looked
+            from deeplearning4j_tpu.observability import (
+                global_trace_store, global_watchtower)
+            snap = global_watchtower().snapshot()
+            print(f"  loop closed: incident="
+                  f"{snap['last_incident_reason']} "
+                  f"pinned={len(global_trace_store().pinned_ids())} "
+                  f"trace(s) as evidence")
+        # the same scrape history the detectors graded, as JSON rings —
+        # ?name= prefix-filters, ?last=N bounds the window
+        ts = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{door.port}"
+            "/debug/timeseries?name=dl4j_http_requests_total&last=5",
+            timeout=10).read())
+        for name, pts in sorted(ts["series"].items()):
+            vals = ", ".join(f"{v:g}" for _, v in pts)
+            print(f"  /debug/timeseries {name}: [{vals}]")
     finally:
         door.stop()
         fleet_reg.shutdown()

@@ -547,6 +547,50 @@ def test_span_names_accepts_literals_and_unrelated_calls(tmp_path):
     assert ok == []
 
 
+def test_detector_rule_names_flags_interpolated_and_bad_namespace(tmp_path):
+    bad = _lint(tmp_path, {"mod.py": """
+        from deeplearning4j_tpu.observability.watchtower import (
+            BurnRateDetector, ChangePointDetector, ThresholdDetector)
+
+        def build(name, fn):
+            return [
+                BurnRateDetector(f"watch_{name}"),          # f-string
+                ChangePointDetector(name, fn),              # variable
+                ThresholdDetector(rule="watch-bad", value_fn=fn,
+                                  firing_above=1.0),        # bad charset
+                BurnRateDetector("error_burn"),             # no namespace
+            ]
+    """}, ["detector-rule-names"])
+    assert len(bad) == 4
+    assert all(f.rule == "detector-rule-names" for f in bad)
+    msgs = " | ".join(f.message for f in bad)
+    assert "f-string" in msgs
+    assert "(watch|fleet)_" in msgs
+
+
+def test_detector_rule_names_accepts_literals_and_unrelated_calls(tmp_path):
+    ok = _lint(tmp_path, {"mod.py": """
+        from deeplearning4j_tpu.observability import watchtower as wt
+        from deeplearning4j_tpu.observability.watchtower import (
+            BurnRateDetector, Detector, ThresholdDetector)
+
+        def build(fn, totals):
+            return [
+                BurnRateDetector("watch_http_error_burn"),
+                wt.ChangePointDetector("watch_p99_shift", fn),
+                ThresholdDetector(rule="fleet_workers_missing",
+                                  value_fn=fn, firing_above=0.5),
+                BurnRateDetector("fleet_error_burn", totals_fn=totals),
+            ]
+
+        class _Double(Detector):
+            # subclassing the base is the extension point — out of scope
+            def __init__(self, rule):
+                super().__init__(rule)
+    """}, ["detector-rule-names"])
+    assert ok == []
+
+
 def test_back_compat_shims_serve_the_original_api():
     import importlib.util
 

@@ -1,0 +1,786 @@
+"""Watchtower suite (ARCHITECTURE.md §25): bounded timeseries rings fed
+by the periodic registry scrape, burn-rate / change-point / threshold
+detectors, the pending → firing → resolved alert lifecycle (hold-down +
+flap damping, ``dl4j_alerts_total`` transitions), the detect→capture
+closure (a firing page pins traces, opens the incident window, dumps a
+bundle whose publisher coalesces same-outage pages onto ONE incident),
+the unified ``/debug/alerts`` + ``/debug/timeseries`` surfaces on all
+three HTTP servers, and the ``DL4J_TPU_WATCHTOWER=0`` kill switch
+(byte-identical pre-watchtower behavior).  The live 2-worker drill is
+``benchmarks/http_load.py --watchtower`` (``slow``).
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import (global_registry,
+                                              reset_global_registry)
+from deeplearning4j_tpu.observability import federation as fed
+from deeplearning4j_tpu.observability import timeseries as tms
+from deeplearning4j_tpu.observability import watchtower as wt
+from deeplearning4j_tpu.observability.flight_recorder import FlightRecorder
+from deeplearning4j_tpu.observability.slo import (SLOEngine,
+                                                  global_slo_engine,
+                                                  reset_global_slo_engine)
+from deeplearning4j_tpu.observability.trace_store import (
+    reset_global_trace_store)
+from deeplearning4j_tpu.observability.tracing import SpanRecord
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.serving import (FrontDoor, ModelRegistry,
+                                        ServingRouter, SharedServingState,
+                                        SharedStore)
+
+import jax  # noqa: F401  (forces the CPU platform before nets build)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_global_registry()
+    tms.reset_global_timeseries()
+    wt.reset_global_watchtower()
+    yield
+    from deeplearning4j_tpu.observability import flight_recorder as _fr
+    _fr.set_incident_publisher(None)
+    reset_global_registry()
+    tms.reset_global_timeseries()
+    wt.reset_global_watchtower()
+
+
+_NET = None
+
+
+def _net():
+    global _NET
+    if _NET is None:
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(1e-2)).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                   loss_function="mcxent"))
+                .build())
+        _NET = MultiLayerNetwork(conf).init()
+    return _NET
+
+
+_SAMPLE = np.zeros((1, 4), dtype="f4")
+
+
+def _request(addr, path, timeout=30.0):
+    try:
+        with urllib.request.urlopen(addr + path, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _http_counter():
+    return global_registry().counter("dl4j_http_requests_total", "reqs",
+                                     ("route", "code"))
+
+
+# ---------------------------------------------------------------------------
+# timeseries rings
+# ---------------------------------------------------------------------------
+
+def test_timeseries_ring_bounded_delta_and_rate():
+    c = _http_counter()
+    store = tms.TimeseriesStore(maxlen=16)
+    c.labels(route="r", code="200").inc(10)
+    for i in range(40):                       # > maxlen: ring stays bounded
+        c.labels(route="r", code="200").inc(5)
+        store.scrape(now=100.0 + i)
+    samples = store.window("dl4j_http_requests_total", 1e9, now=140.0)
+    assert len(samples) == 16
+    assert store.latest("dl4j_http_requests_total") == 10 + 40 * 5
+    # delta/rate over the trailing window (5 per 1s step; the 10s
+    # window at t=139 holds the 11 samples 129..139 = 10 increments)
+    assert store.delta("dl4j_http_requests_total", 10.0, now=139.0) == \
+        pytest.approx(5.0 * 10)
+    assert store.rate("dl4j_http_requests_total", 10.0, now=139.0) == \
+        pytest.approx(5.0)
+
+
+def test_timeseries_counter_reset_reads_as_gap_not_negative():
+    store = tms.TimeseriesStore()
+    c = _http_counter()
+    c.labels(route="r", code="200").inc(100)
+    store.scrape(now=10.0)
+    # the registry resets (fresh process lifetime): cumulative drops
+    reset_global_registry()
+    c2 = _http_counter()
+    c2.labels(route="r", code="200").inc(1)
+    store.scrape(now=11.0)
+    c2.labels(route="r", code="200").inc(4)
+    store.scrape(now=12.0)
+    # positive increments only: 100 -> 1 is a gap, 1 -> 5 counts
+    assert store.delta("dl4j_http_requests_total", 100.0, now=12.0) == 4.0
+
+
+def test_timeseries_histogram_scrape_and_snapshot_filter():
+    h = global_registry().histogram("dl4j_http_latency_seconds", "lat",
+                                    ("route",))
+    for v in (0.01, 0.02, 0.03, 0.5):
+        h.labels(route="r").observe(v)
+    store = tms.TimeseriesStore()
+    store.scrape(now=50.0)
+    assert store.latest("dl4j_http_latency_seconds:count") == 4.0
+    assert store.latest("dl4j_http_latency_seconds:sum") == \
+        pytest.approx(0.56)
+    assert store.latest("dl4j_http_latency_seconds:p99") == \
+        pytest.approx(0.5, rel=0.1)       # reservoir quantile interpolates
+    snap = store.snapshot(names=["dl4j_http_latency_seconds"], last=1)
+    assert set(snap["series"]) == {"dl4j_http_latency_seconds:count",
+                                   "dl4j_http_latency_seconds:sum",
+                                   "dl4j_http_latency_seconds:p99"}
+    assert all(len(v) == 1 for v in snap["series"].values())
+    # self-instruments appeared (lazily, because the switch is ON)
+    assert "dl4j_timeseries_scrapes_total" in global_registry().names()
+
+
+def test_timeseries_disabled_is_a_noop(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER", "0")
+    _http_counter().labels(route="r", code="200").inc()
+    store = tms.TimeseriesStore()
+    before = sorted(global_registry().names())
+    assert store.scrape(now=1.0) == 0
+    assert store.maybe_scrape(now=2.0) is False
+    assert store.names() == []
+    # NO dl4j_timeseries_* series were created by the off path
+    assert sorted(global_registry().names()) == before
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+def test_burn_rate_fires_on_mid_stream_burst_only():
+    c = _http_counter()
+    d = wt.BurnRateDetector("watch_http_error_burn", fast_s=4.0,
+                            slow_s=8.0, min_requests=1.0)
+    t0 = 300.0
+    for i in range(10):                                   # clean phase
+        c.labels(route="r", code="200").inc(5)
+        r = d.observe(t0 + i * 0.5)
+    assert r["firing"] is False
+    for i in range(10, 30):                               # 5xx burst
+        c.labels(route="r", code="504").inc(5)
+        r = d.observe(t0 + i * 0.5)
+    assert r["firing"] is True
+    assert r["fast_burn"] >= d.threshold
+    assert r["slow_burn"] >= d.threshold
+    for i in range(30, 60):                               # recovery
+        c.labels(route="r", code="200").inc(5)
+        r = d.observe(t0 + i * 0.5)
+    assert r["firing"] is False
+
+
+def test_burn_rate_4xx_do_not_burn_budget():
+    c = _http_counter()
+    d = wt.BurnRateDetector("watch_http_error_burn", fast_s=4.0,
+                            slow_s=8.0, min_requests=1.0)
+    for i in range(30):
+        c.labels(route="r", code="400").inc(5)            # client errors
+        r = d.observe(100.0 + i * 0.5)
+    assert r["firing"] is False
+
+
+def test_burn_rate_needs_both_windows():
+    """A burst that ended long ago still inside the slow window (slow
+    burns, fast quiet) must NOT fire."""
+    c = _http_counter()
+    d = wt.BurnRateDetector("watch_http_error_burn", fast_s=2.0,
+                            slow_s=30.0, min_requests=1.0)
+    t0 = 100.0
+    for i in range(6):
+        c.labels(route="r", code="504").inc(5)
+        d.observe(t0 + i * 0.5)
+    for i in range(6, 30):                                # clean tail
+        c.labels(route="r", code="200").inc(5)
+        r = d.observe(t0 + i * 0.5)
+    assert r["firing"] is False
+    assert r["slow_burn"] > 0
+
+
+def test_burn_rate_survives_registry_reset():
+    c = _http_counter()
+    d = wt.BurnRateDetector("watch_http_error_burn", fast_s=4.0,
+                            slow_s=8.0, min_requests=1.0)
+    c.labels(route="r", code="504").inc(100)
+    d.observe(10.0)
+    reset_global_registry()                # cumulative totals drop to 0
+    c2 = _http_counter()
+    for i in range(10):
+        c2.labels(route="r", code="200").inc(5)
+        r = d.observe(11.0 + i * 0.5)
+    assert r["firing"] is False            # the reset read as a gap
+
+
+def test_change_point_warmup_sustain_and_adoption():
+    vals = [1.0] * 20 + [5.0] * 20
+    d = wt.ChangePointDetector("watch_p99_shift",
+                               lambda now: vals[int(now)], direction="up")
+    fired_at = None
+    resolved_after = None
+    for i in range(len(vals)):
+        r = d.observe(float(i))
+        if r["firing"] and fired_at is None:
+            fired_at = i
+        if fired_at is not None and not r["firing"] \
+                and resolved_after is None:
+            resolved_after = i
+    # fires on the `sustain`-th anomalous sample after the step at 20
+    assert fired_at == 20 + d.sustain - 1
+    # the new regime is eventually adopted and the detector quiets
+    assert resolved_after is not None
+
+
+def test_change_point_needs_warmup_and_direction():
+    # noisy warmup shorter than min_samples never fires
+    d = wt.ChangePointDetector("watch_p99_shift", lambda now: now * 100,
+                               direction="up", min_samples=12)
+    for i in range(8):
+        r = d.observe(float(i))
+    assert r["firing"] is False
+    # a DOWN detector ignores an up step
+    vals = [1.0] * 20 + [5.0] * 10
+    d2 = wt.ChangePointDetector("watch_throughput_drop",
+                                lambda now: vals[int(now)],
+                                direction="down")
+    for i in range(len(vals)):
+        r = d2.observe(float(i))
+    assert r["firing"] is False
+    with pytest.raises(ValueError):
+        wt.ChangePointDetector("watch_p99_shift", lambda now: 0,
+                               direction="sideways")
+
+
+def test_threshold_detector_bounds():
+    d = wt.ThresholdDetector("watch_queue_depth_limit", lambda now: 300.0,
+                             firing_above=256)
+    assert d.observe(1.0)["firing"] is True
+    d2 = wt.ThresholdDetector("watch_queue_depth_limit", lambda now: 10.0,
+                              firing_above=256)
+    assert d2.observe(1.0)["firing"] is False
+    with pytest.raises(ValueError):
+        wt.ThresholdDetector("watch_queue_depth_limit", lambda now: 0)
+    with pytest.raises(ValueError):
+        wt.ThresholdDetector("watch_queue_depth_limit", lambda now: 0,
+                             firing_above=1, firing_below=0)
+
+
+def test_detector_error_is_contained():
+    def boom(now):
+        raise RuntimeError("torn value source")
+    d = wt.ChangePointDetector("watch_p99_shift", boom)
+    r = d.observe(1.0)
+    assert r["firing"] is False
+    assert "detector error" in r["detail"]
+    assert r["rule"] == "watch_p99_shift"
+
+
+def test_default_detector_rule_names_are_closed_set():
+    rules = [d.rule for d in wt.default_detectors()]
+    assert rules == ["watch_http_error_burn", "watch_p99_shift",
+                     "watch_throughput_drop", "watch_shed_rate_spike",
+                     "watch_queue_depth_spike", "watch_mfu_slide",
+                     "watch_queue_depth_limit"]
+    severities = {d.rule: d.severity for d in wt.default_detectors()}
+    assert severities["watch_http_error_burn"] == wt.PAGE
+    assert severities["watch_p99_shift"] == wt.PAGE
+
+
+# ---------------------------------------------------------------------------
+# alert lifecycle
+# ---------------------------------------------------------------------------
+
+def _result(rule="watch_test", firing=True, severity=wt.PAGE):
+    return {"rule": rule, "severity": severity, "firing": firing,
+            "value": 1.0, "detail": "t"}
+
+
+def test_alert_lifecycle_hold_down_then_fire_then_resolve(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_HOLD_S", "1.0")
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_CLEAR_S", "2.0")
+    am = wt.AlertManager()
+    out = am.observe([_result()], 10.0)
+    assert [t["to"] for t in out] == [wt.PENDING]
+    assert am.firing() == []                    # hold-down: not yet
+    out = am.observe([_result()], 10.5)
+    assert out == []
+    out = am.observe([_result()], 11.2)         # held >= 1.0s
+    assert [t["to"] for t in out] == [wt.FIRING]
+    assert [a["rule"] for a in am.firing()] == ["watch_test"]
+    # quiet, but not for clear_s yet: still firing (flap damping)
+    out = am.observe([_result(firing=False)], 12.0)
+    assert out == [] and am.firing()
+    out = am.observe([_result(firing=False)], 13.5)
+    assert [t["to"] for t in out] == [wt.RESOLVED]
+    snap = am.snapshot()
+    assert snap["firing"] == [] and snap["pending"] == []
+    assert [a["rule"] for a in snap["resolved"]] == ["watch_test"]
+    assert [t["to"] for t in snap["transitions"]] == \
+        [wt.PENDING, wt.FIRING, wt.RESOLVED]
+    # transitions bumped dl4j_alerts_total{rule,state}
+    inst = global_registry().get("dl4j_alerts_total")
+    counts = {lv: c.value for lv, c in inst.series()}
+    assert counts[("watch_test", "pending")] == 1.0
+    assert counts[("watch_test", "firing")] == 1.0
+    assert counts[("watch_test", "resolved")] == 1.0
+
+
+def test_alert_blip_shorter_than_hold_drops_silently(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_HOLD_S", "5.0")
+    am = wt.AlertManager()
+    am.observe([_result()], 10.0)
+    out = am.observe([_result(firing=False)], 11.0)     # blip over
+    assert out == []
+    snap = am.snapshot()
+    assert snap["pending"] == [] and snap["firing"] == []
+    assert snap["resolved"] == []                       # never fired
+    # no firing/resolved series was ever minted for the blip
+    inst = global_registry().get("dl4j_alerts_total")
+    states = {lv[1] for lv, _c in inst.series()}
+    assert states == {"pending"}
+
+
+def test_alert_flapping_keeps_one_firing_alert(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_HOLD_S", "0.0")
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_CLEAR_S", "10.0")
+    am = wt.AlertManager()
+    for i in range(20):                       # fire/quiet every beat
+        am.observe([_result(firing=(i % 2 == 0))], 10.0 + i)
+    assert len(am.firing()) == 1              # damped: ONE alert, held
+    inst = global_registry().get("dl4j_alerts_total")
+    counts = {lv: c.value for lv, c in inst.series()}
+    assert counts[("watch_test", "firing")] == 1.0      # not 10
+
+
+# ---------------------------------------------------------------------------
+# the watchtower beat + detect→capture closure
+# ---------------------------------------------------------------------------
+
+def test_beat_throttles_and_scrapes(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_INTERVAL_S", "100.0")
+    monkeypatch.setenv("DL4J_TPU_TIMESERIES_INTERVAL_S", "0.05")
+    tower = wt.Watchtower(detectors=[])
+    t0 = time.time()
+    tower.beat(now=t0)
+    scrapes = tms.global_timeseries().scrapes
+    assert scrapes >= 1                        # the beat scraped
+    tower.beat(now=t0 + 1.0)                   # throttled: interval 100s
+    assert tms.global_timeseries().scrapes == scrapes
+    tower.beat(now=t0 + 1.0, force=True)       # forced: scrapes again
+    assert tms.global_timeseries().scrapes == scrapes + 1
+
+
+class _Flip(wt.Detector):
+    """Test detector whose firing state the test owns."""
+
+    def __init__(self, rule="watch_test", severity=wt.PAGE):
+        super().__init__(rule, "test", severity)
+        self.firing = True
+
+    def _evaluate(self, now):
+        return {"firing": self.firing, "value": 1.0}
+
+
+def test_page_alert_closes_the_detect_capture_loop(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_HOLD_S", "0.0")
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_COOLDOWN_S", "3600.0")
+    monkeypatch.setenv("DL4J_TPU_POSTMORTEM_DIR", str(tmp_path / "pm"))
+    from deeplearning4j_tpu.observability import flight_recorder as _fr
+    _fr.reset_global_flight_recorder()
+    st = reset_global_trace_store()
+    # a retained error trace = the evidence the page should pin
+    st.note_open("feedfacefeedface")
+    st.feed(SpanRecord("http_request", 0.0, 1000.0, 1, 0, None,
+                       trace_id="feedfacefeedface", span_id="s1",
+                       parent_id=None, error=True,
+                       error_type="RuntimeError"))
+    det = _Flip()
+    tower = wt.Watchtower(detectors=[det], scrape=False)
+    t0 = time.time()
+    tower.beat(now=t0, force=True)             # pending -> firing (hold 0)
+    transitions = tower.beat(now=t0 + 0.1, force=True)
+    if not any(t["to"] == wt.FIRING for t in transitions):
+        transitions = tower.beat(now=t0 + 0.2, force=True)
+    assert tower.last_incident_reason == "alert:watch_test"
+    # the offending trace is pinned and the retention window is open
+    assert "feedfacefeedface" in st.pinned_ids()
+    assert st.incident_active()
+    # ONE bundle landed, stamped with the alert reason
+    bundles = sorted((tmp_path / "pm").iterdir())
+    assert len(bundles) == 1
+    manifest = json.loads((bundles[0] / "config.json").read_text())
+    assert manifest["reason"] == "alert:watch_test"
+    # the bundle carries the timeseries rings + alert state
+    series = json.loads((bundles[0] / "timeseries.json").read_text())
+    assert "series" in series and "alerts" in series
+    # a SECOND page inside the cooldown does NOT dump again
+    det2 = _Flip(rule="watch_other")
+    tower.detectors.append(det2)
+    tower.beat(now=t0 + 1.0, force=True)
+    tower.beat(now=t0 + 1.2, force=True)
+    assert len(sorted((tmp_path / "pm").iterdir())) == 1
+    _fr.reset_global_flight_recorder()
+
+
+def test_warn_alert_does_not_open_an_incident(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_HOLD_S", "0.0")
+    monkeypatch.setenv("DL4J_TPU_POSTMORTEM_DIR", str(tmp_path / "pm"))
+    from deeplearning4j_tpu.observability import flight_recorder as _fr
+    _fr.reset_global_flight_recorder()
+    tower = wt.Watchtower(detectors=[_Flip(severity=wt.WARN)],
+                          scrape=False)
+    t0 = time.time()
+    for i in range(4):
+        tower.beat(now=t0 + i * 0.1, force=True)
+    assert tower.last_incident_reason is None
+    assert not (tmp_path / "pm").exists()
+    _fr.reset_global_flight_recorder()
+
+
+# ---------------------------------------------------------------------------
+# incident coalescing (the fan-out window) — satellite 3
+# ---------------------------------------------------------------------------
+
+def test_two_pages_inside_window_coalesce_to_one_incident(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FLEET_OBS", "1")
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_COOLDOWN_S", "3600.0")
+    store = SharedStore(str(tmp_path / "fleet"))
+    i1 = fed.post_incident(store, "w0", "alert:watch_http_error_burn",
+                           "/pm/bundle-1", trace_ids=["t1", "t2"])
+    i2 = fed.post_incident(store, "w1", "alert:watch_p99_shift",
+                           "/pm/bundle-2", trace_ids=["t2", "t3"])
+    assert i1 == i2
+    incidents = store.read()["incidents"]
+    assert len(incidents) == 1
+    inc = incidents[0]
+    assert set(inc["captured"]) == {"w0", "w1"}
+    assert inc["trace_ids"] == ["t1", "t2", "t3"]       # merged, deduped
+    assert inc["coalesced"] == ["alert:watch_p99_shift"]
+    # a NON-alert reason never coalesces (the watchdog is its own event)
+    i3 = fed.post_incident(store, "w0", "watchdog: wedged", "/pm/b3")
+    assert i3 != i1
+    assert len(store.read()["incidents"]) == 2
+    # outside the window: a fresh alert incident gets a fresh id
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER_COOLDOWN_S", "0.0")
+    time.sleep(0.02)
+    i4 = fed.post_incident(store, "w0", "alert:watch_http_error_burn",
+                           "/pm/b4")
+    assert i4 not in (i1, i3)
+
+
+def test_incident_beat_skips_worker_already_captured(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FLEET_OBS", "1")
+    store = SharedStore(str(tmp_path / "fleet"))
+    r1 = FlightRecorder(out_dir=str(tmp_path / "pm1"))
+    r2 = FlightRecorder(out_dir=str(tmp_path / "pm2"))
+    fed.post_incident(store, "w1", "alert:watch_http_error_burn",
+                      "/pm1/bundle-1")
+    # leader fans out; w1 originated (already in captured): NO dump
+    assert fed.incident_beat(store, "w1", True, recorder=r1) == []
+    assert not os.path.exists(str(tmp_path / "pm1"))
+    # w2 was not captured: dumps exactly once, then goes idempotent
+    dumped = fed.incident_beat(store, "w2", False, recorder=r2)
+    assert len(dumped) == 1
+    assert fed.incident_beat(store, "w2", False, recorder=r2) == []
+    captured = store.read()["incidents"][0]["captured"]
+    assert set(captured) == {"w1", "w2"}
+
+
+# ---------------------------------------------------------------------------
+# fleet watchtower + publishing
+# ---------------------------------------------------------------------------
+
+class _FakeHealth:
+    """A FleetHealth stand-in whose snap the test scripts."""
+
+    def __init__(self):
+        self.snap = {"workers": {}, "errors": {}, "doc": {}, "at": 0.0}
+
+    def refresh(self):
+        return self.snap
+
+
+def test_fleet_watch_detector_inputs():
+    health = _FakeHealth()
+    fw = fed.FleetWatch(health)
+    assert [d.rule for d in fw.tower.detectors] == \
+        ["fleet_error_burn", "fleet_p99_shift", "fleet_workers_missing"]
+    health.snap["workers"] = {
+        "w0": {"dl4j_http_requests_total": [
+            ({"route": "r", "code": "200"}, 90.0),
+            ({"route": "r", "code": "504"}, 10.0)]},
+        "w1": {"dl4j_http_requests_total": [
+            ({"route": "r", "code": "200"}, 100.0)],
+            "dl4j_http_latency_seconds_bucket": [
+            ({"le": "0.1"}, 50.0), ({"le": "1.0"}, 90.0),
+            ({"le": "+Inf"}, 100.0)]},
+    }
+    assert fw.http_totals() == (10.0, 200.0)
+    assert fw.worst_p99(time.time()) == pytest.approx(1.0)
+    # missing = stale-heartbeat ∪ (unreachable ∩ registered)
+    now = time.time()
+    health.snap["doc"] = {"workers": {
+        "w0": {"heartbeat": now}, "w1": {"heartbeat": now - 60}}}
+    health.snap["errors"] = {"w0": "refused", "ghost": "refused"}
+    assert fw.missing_workers(now) == 2.0      # w1 stale + w0 unreachable
+
+
+def test_publish_alerts_prunes_stale_workers(tmp_path, monkeypatch):
+    store = SharedStore(str(tmp_path / "fleet"))
+    local = {"firing": [], "pending": [], "resolved": []}
+    fed.publish_alerts(store, "w0", None, local)
+    # a worker record from the distant past is pruned on the next write
+    def age(doc):
+        doc["alerts"]["workers"]["dead"] = {"at": time.time() - 3600,
+                                            "state": "ok", "firing": []}
+    store.update(age)
+    fed.publish_alerts(store, "w1", 7, local,
+                       fleet={"firing": [{"rule": "fleet_error_burn"}],
+                              "pending": [], "resolved": []},
+                       is_leader=True)
+    alerts = store.read()["alerts"]
+    assert set(alerts["workers"]) == {"w0", "w1"}
+    assert alerts["fleet"]["by"] == "w1" and alerts["fleet"]["term"] == 7
+    assert [a["rule"] for a in alerts["fleet"]["firing"]] == \
+        ["fleet_error_burn"]
+
+
+def test_alerts_route_local_fleet_partial_and_store_error(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FLEET_OBS", "1")
+    store = SharedStore(str(tmp_path / "fleet"))
+    local = {"firing": [], "pending": [], "resolved": []}
+    fed.publish_alerts(store, "w0", None, local)
+    now = time.time()
+    store.update(lambda d: d.setdefault("workers", {}).update(
+        w0={"pid": 1, "port": 1, "heartbeat": now},
+        live_quiet={"pid": 1, "port": 2, "heartbeat": now},
+        dead={"pid": 1, "port": 3, "heartbeat": now - 60}))
+    status, payload = fed.handle_alerts_route(
+        "/debug/alerts", {}, store=store, local_worker="probe",
+        fleet=True)
+    assert status == 200
+    assert payload["worker"] == "probe"
+    assert set(payload["watchtower"]) >= {"enabled", "detectors",
+                                          "firing", "pending"}
+    assert set(payload["workers"]) == {"w0"}
+    # honest partial: the dead worker AND the live-but-unpublished one
+    assert payload["partial"] == ["dead", "live_quiet"]
+    assert payload["incidents"] == []
+    # legacy SLO keys survive for old consumers
+    assert {"status", "active", "history"} <= set(payload)
+
+    class _Torn:
+        def read(self):
+            raise OSError("torn store")
+    status, payload = fed.handle_alerts_route(
+        "/debug/alerts", {}, store=_Torn(), local_worker="probe",
+        fleet=True)
+    assert status == 200                       # never a 500
+    assert "torn store" in payload["store_error"]
+    assert payload["workers"] == {} and payload["partial"] == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces + kill switch byte-identity
+# ---------------------------------------------------------------------------
+
+def _scoring_door(**kw):
+    reg = ModelRegistry()
+    reg.deploy("v1", _net(), sample_input=_SAMPLE, batch_limit=4,
+               max_wait_ms=1.0)
+    return FrontDoor(ServingRouter(reg, "v1"), **kw).start(), reg
+
+
+def test_frontdoor_debug_alerts_and_timeseries(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_TIMESERIES_INTERVAL_S", "0.05")
+    fd_, reg = _scoring_door(port=0)
+    try:
+        addr = fd_.get_address()
+        status, body = _request(addr, "/debug/alerts")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["watchtower"]["enabled"] is True
+        rules = [d["rule"] for d in payload["watchtower"]["detectors"]]
+        assert "watch_http_error_burn" in rules
+        # the route's own beat scraped: timeseries has series
+        status, body = _request(addr, "/debug/timeseries?last=4")
+        assert status == 200
+        ts_payload = json.loads(body)
+        assert ts_payload["enabled"] is True
+        assert any(k.startswith("dl4j_") for k in ts_payload["series"])
+        # prefix filter narrows
+        status, body = _request(
+            addr, "/debug/timeseries?name=dl4j_http_requests_total")
+        names = set(json.loads(body)["series"])
+        assert names <= {"dl4j_http_requests_total"}
+    finally:
+        fd_.stop()
+        reg.shutdown()
+
+
+def test_frontdoor_routes_404_when_killed(monkeypatch):
+    fd_, reg = _scoring_door(port=0)
+    try:
+        addr = fd_.get_address()
+        monkeypatch.setenv("DL4J_TPU_WATCHTOWER", "0")   # read LIVE
+        for path in ("/debug/alerts", "/debug/timeseries"):
+            status, _body = _request(addr, path)
+            assert status == 404, path
+        # flipping back on restores the surfaces without a restart
+        monkeypatch.delenv("DL4J_TPU_WATCHTOWER")
+        status, _body = _request(addr, "/debug/alerts")
+        assert status == 200
+    finally:
+        fd_.stop()
+        reg.shutdown()
+
+
+def test_kill_switch_is_byte_identical(monkeypatch):
+    """With DL4J_TPU_WATCHTOWER=0: beats are no-ops, NO new registry
+    series appear, and the UI server's legacy /alerts body is byte-
+    identical to the pre-watchtower handler."""
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER", "0")
+    before = sorted(global_registry().names())
+    tower = wt.global_watchtower()
+    assert tower.beat(force=True) == []
+    assert tms.global_timeseries().scrape() == 0
+    assert sorted(global_registry().names()) == before
+    # the shared route answers the legacy payload exactly
+    status, payload = fed.handle_alerts_route("/alerts", {})
+    assert status == 200
+    legacy = global_slo_engine().alerts()
+    assert json.dumps(payload, default=str) == json.dumps(legacy,
+                                                          default=str)
+    assert "watchtower" not in payload
+    status, _payload = fed.handle_alerts_route("/debug/alerts", {})
+    assert status == 404
+
+
+def test_ui_server_alerts_alias_and_timeseries(monkeypatch):
+    from deeplearning4j_tpu.ui.server import UIServer
+    monkeypatch.setenv("DL4J_TPU_TIMESERIES_INTERVAL_S", "0.05")
+    server = UIServer(port=0).start()
+    try:
+        addr = f"http://127.0.0.1:{server.port}"
+        s1, b1 = _request(addr, "/alerts")
+        s2, b2 = _request(addr, "/debug/alerts")
+        assert s1 == s2 == 200
+        p1, p2 = json.loads(b1), json.loads(b2)
+        assert p1["watchtower"]["enabled"] is True
+        assert set(p1) == set(p2)              # one router, both paths
+        status, body = _request(addr, "/debug/timeseries")
+        assert status == 200
+        assert json.loads(body)["worker"] == "local"
+        # killed: legacy /alerts loses the watchtower keys (the
+        # pre-watchtower payload), the new surfaces 404
+        monkeypatch.setenv("DL4J_TPU_WATCHTOWER", "0")
+        status, body = _request(addr, "/alerts")
+        assert status == 200
+        legacy = json.loads(body)
+        assert set(legacy) == {"status", "active", "history"}
+        assert json.dumps(legacy, sort_keys=True) == json.dumps(
+            global_slo_engine().alerts(), sort_keys=True)
+        for path in ("/debug/alerts", "/debug/timeseries"):
+            status, _b = _request(addr, path)
+            assert status == 404, path
+    finally:
+        server.stop()
+
+
+def test_bundle_timeseries_section_gated_on_switch(tmp_path, monkeypatch):
+    _http_counter().labels(route="r", code="200").inc(3)
+    tms.global_timeseries().scrape(now=time.time())
+    r = FlightRecorder(out_dir=str(tmp_path / "pm_on"))
+    bundle = r.dump("test: watchtower on")
+    series = json.loads(
+        open(os.path.join(bundle, "timeseries.json")).read())
+    assert "dl4j_http_requests_total" in series["series"]
+    assert "alerts" in series
+    monkeypatch.setenv("DL4J_TPU_WATCHTOWER", "0")
+    r2 = FlightRecorder(out_dir=str(tmp_path / "pm_off"))
+    bundle2 = r2.dump("test: watchtower off")
+    assert not os.path.exists(os.path.join(bundle2, "timeseries.json"))
+
+
+def test_fleet_snapshot_alerts_key_gated(tmp_path, monkeypatch):
+    store = SharedStore(str(tmp_path / "fleet"))
+    reg = ModelRegistry()
+    reg.deploy("v1", _net(), sample_input=_SAMPLE, batch_limit=4,
+               max_wait_ms=1.0)
+    shared = SharedServingState(store, "w0")
+    shared.ensure_lane("scoring", "v1")
+    monkeypatch.setenv("DL4J_TPU_FLEET_OBS", "1")
+    monkeypatch.setenv("DL4J_TPU_FLEET_HEALTH_INTERVAL_S", "0.0")
+    fd_ = FrontDoor(ServingRouter(reg, "v1"), shared=shared,
+                    port=0).start()
+    try:
+        shared.register(os.getpid(), fd_.port)
+        shared.sync()
+        assert shared.is_leader
+        fd_._fleet_obs_beat()                  # publishes alerts + rollup
+        from deeplearning4j_tpu.serving.frontdoor import fleet_snapshot
+        snap = fleet_snapshot()
+        assert "w0" in snap["alerts"]["workers"]
+        assert snap["alerts"]["fleet"]["by"] == "w0"
+        # and the surface honors the kill switch on the NEXT snapshot
+        monkeypatch.setenv("DL4J_TPU_WATCHTOWER", "0")
+        snap = fleet_snapshot()
+        assert "alerts" not in snap
+    finally:
+        fd_.stop()
+        reg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SLO engine reset hygiene — satellite 2
+# ---------------------------------------------------------------------------
+
+def test_reset_global_slo_engine_clears_privately_held_engines():
+    """FleetHealth (and rollout gates) hold their OWN SLOEngine —
+    pre-watchtower, reset_global_slo_engine() left their since-when
+    timestamps and transition history alive across what tests treat as
+    a clean slate."""
+
+    class _AlwaysFail:
+        rule = "always_fail"
+
+        def evaluate(self, registry):
+            return {"rule": self.rule, "status": "failing",
+                    "detail": "t"}
+
+    private = SLOEngine(rules=[_AlwaysFail()])
+    private.evaluate()
+    since1 = private.alerts()["active"][0]["since"]
+    assert private.alerts()["history"]
+    reset_global_slo_engine()
+    # the private engine's alert state reset WITH the global one
+    assert private._since == {} and private._history == []
+    time.sleep(0.01)
+    since2 = private.alerts()["active"][0]["since"]
+    assert since2 > since1                     # a fresh since-when, not
+    # the pre-reset timestamp surviving through the private engine
+    # registry reset clears them too (the @on_registry_reset hook)
+    reset_global_registry()
+    assert private._since == {} and private._history == []
+
+
+def test_global_slo_engine_alerts_reset_with_engine():
+    eng = reset_global_slo_engine()
+    assert global_slo_engine() is eng
+    eng.evaluate()
+    reset_global_slo_engine()
+    assert global_slo_engine().alerts()["history"] == []

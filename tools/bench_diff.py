@@ -75,6 +75,7 @@ _QOS_RE = re.compile(r"QOS_r(\d+)[^/]*\.json$")
 _FLEET_RE = re.compile(r"FLEET_r(\d+)[^/]*\.json$")
 _OBSFLEET_RE = re.compile(r"OBSFLEET_r(\d+)[^/]*\.json$")
 _TRACEQ_RE = re.compile(r"TRACEQ_r(\d+)[^/]*\.json$")
+_WATCH_RE = re.compile(r"WATCH_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -621,6 +622,82 @@ def check_traceq(samples: List[TraceqSample],
     ], tolerance, sustain)
 
 
+class WatchSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                      # "watch_drill"
+    platform: Optional[str]
+    detected: Optional[float]        # page fired inside the budget (0/1)
+    fp_free: Optional[float]         # clean baseline stayed alert-free
+    single_incident: Optional[float]  # paging detectors coalesced to one
+    traces_attached: Optional[float]  # incident carries pinned trace ids
+    resolved: Optional[float]        # alert walked firing -> resolved
+    detect_latency_s: Optional[float]  # reported, never gated (weather)
+
+
+def _bool_frac(doc: dict, key: str) -> Optional[float]:
+    v = doc.get(key)
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    return None
+
+
+def load_watch(root: str) -> List[WatchSample]:
+    """``WATCH_r*.json`` watchtower drill archives
+    (``benchmarks/http_load.py --watchtower`` records, bare or
+    driver-wrapped). Anything without a ``watch_`` metric — alien
+    JSON — is ignored, never fatal."""
+    out: List[WatchSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "WATCH_r*.json"))):
+        m = _WATCH_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("watch_"):
+            continue
+        lat = doc.get("detect_latency_s")
+        out.append(WatchSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            detected=_bool_frac(doc, "detected"),
+            fp_free=_bool_frac(doc, "fp_free"),
+            single_incident=_bool_frac(doc, "single_incident"),
+            traces_attached=_bool_frac(doc, "traces_attached"),
+            resolved=_bool_frac(doc, "resolved"),
+            detect_latency_s=(float(lat)
+                              if isinstance(lat, (int, float))
+                              else None)))
+    return out
+
+
+def check_watch(samples: List[WatchSample],
+                tolerance: float = DEFAULT_TOLERANCE,
+                sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the watchtower trajectory sustained-only: detection,
+    false-positive freedom, incident coalescing, trace evidence, and
+    resolution are same-run booleans graded as 1.0/0.0 fractions (a
+    sustained fall to 0.0 is a real break, one flaky run is not); the
+    raw detection latency is host weather — reported, never gated."""
+    return _grade_metric_groups(samples, [
+        ("detected", lambda s: s.detected),
+        ("fp_free", lambda s: s.fp_free),
+        ("single_incident", lambda s: s.single_incident),
+        ("traces_attached", lambda s: s.traces_attached),
+        ("resolved", lambda s: s.resolved),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -717,8 +794,10 @@ def main(argv=None) -> int:
     fleet = load_fleet(root)
     obsfleet = load_obsfleet(root)
     traceq = load_traceq(root)
+    watch = load_watch(root)
     if (not samples and not dryruns and not decodes and not serves
-            and not qos and not fleet and not obsfleet and not traceq):
+            and not qos and not fleet and not obsfleet and not traceq
+            and not watch):
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
@@ -727,7 +806,7 @@ def main(argv=None) -> int:
     regressions = (check_trajectory(samples) + check_decode(decodes)
                    + check_serve(serves) + check_qos(qos)
                    + check_fleet(fleet) + check_obsfleet(obsfleet)
-                   + check_traceq(traceq))
+                   + check_traceq(traceq) + check_watch(watch))
     breaks = check_multichip(dryruns) + check_fleet_bool(fleet)
     for s in samples:
         marks = []
@@ -806,6 +885,18 @@ def main(argv=None) -> int:
             marks.append(f"assembly_p99={s.assembly_p99_ms:.1f}ms")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + " ".join(marks))
+    for s in watch:
+        marks = []
+        if s.detect_latency_s is not None:
+            marks.append(f"detect={s.detect_latency_s:.2f}s")
+        for name, v in (("detected", s.detected), ("fp_free", s.fp_free),
+                        ("single_incident", s.single_incident),
+                        ("traces", s.traces_attached),
+                        ("resolved", s.resolved)):
+            if v is not None:
+                marks.append(f"{name}={v:.0f}")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
@@ -815,7 +906,8 @@ def main(argv=None) -> int:
               f"{len(dryruns)} dryrun + {len(decodes)} decode + "
               f"{len(serves)} serve + {len(qos)} qos + "
               f"{len(fleet)} fleet + {len(obsfleet)} obsfleet + "
-              f"{len(traceq)} traceq samples under {root})")
+              f"{len(traceq)} traceq + {len(watch)} watch samples "
+              f"under {root})")
     return len(regressions) + len(breaks)
 
 

@@ -2,6 +2,6 @@
 the graftlint registry (plugins self-register via ``@register`` at
 import time; a new checker is one new module plus one import line
 here)."""
-from . import (donation, env_knobs, jit_purity, lock_discipline,  # noqa: F401
-               metric_names, span_names, store_discipline, thread_hygiene,
-               typed_errors)
+from . import (detector_rule_names, donation, env_knobs,  # noqa: F401
+               jit_purity, lock_discipline, metric_names, span_names,
+               store_discipline, thread_hygiene, typed_errors)

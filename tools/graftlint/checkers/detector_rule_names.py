@@ -1,0 +1,121 @@
+"""detector-rule-names: literal namespaced rule names on every
+watchtower detector construction.
+
+The alert lifecycle dedup-keys on the rule name, ``dl4j_alerts_total``
+labels by it, the incident ledger coalesces on ``alert:<rule>`` reasons,
+and ``/debug/alerts`` consumers (the drill grader, dashboards) match on
+the literal string — an interpolated rule name is unbounded label
+cardinality AND an un-greppable alert, the same bug class ``span-names``
+closes for trace names.  Rules, on every call whose callee names one of
+the concrete detector classes (``BurnRateDetector`` /
+``ChangePointDetector`` / ``ThresholdDetector``, as a bare imported name
+or a module attribute):
+
+- the rule argument (first positional, or the ``rule=`` keyword) must be
+  a string LITERAL — f-strings, concatenation, variables, and call
+  results are violations
+- the literal must match ``^(watch|fleet)_[a-z0-9_]+$``: ``watch_`` for
+  per-process detectors, ``fleet_`` for leader-evaluated fleet detectors
+  (the namespace tells an on-call reader which process evaluated it)
+
+Subclassing ``Detector`` directly is out of scope — the base class is
+the extension point and test doubles name themselves; the closed set of
+shipped constructors is where literal names are load-bearing.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, NamedTuple, Optional
+
+from .. import Finding, register
+
+RULE_NAME_RE = re.compile(r"^(watch|fleet)_[a-z0-9_]+$")
+
+#: the concrete detector constructors whose rule names are load-bearing
+_DETECTOR_CLASSES = frozenset({
+    "BurnRateDetector", "ChangePointDetector", "ThresholdDetector"})
+
+
+class Violation(NamedTuple):
+    path: str
+    line: int
+    name: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.name}: {self.message}"
+
+
+def _callee(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _rule_arg(node: ast.Call) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == "rule":
+            return kw.value
+    return node.args[0] if node.args else None
+
+
+def check_tree(tree, path: str = "<string>") -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _callee(node) not in _DETECTOR_CLASSES:
+            continue
+        arg = _rule_arg(node)
+        if arg is None:
+            continue                # ctor raises on its own
+        if isinstance(arg, ast.Constant):
+            if not isinstance(arg.value, str):
+                continue            # not a rule-name call shape
+            if not RULE_NAME_RE.match(arg.value):
+                out.append(Violation(
+                    path, node.lineno, arg.value,
+                    "detector rule names must match "
+                    "^(watch|fleet)_[a-z0-9_]+$ — the namespace tells "
+                    "the reader which process evaluates the rule"))
+        else:
+            kind = type(arg).__name__
+            label = ("f-string" if isinstance(arg, ast.JoinedStr)
+                     else kind)
+            out.append(Violation(
+                path, node.lineno, f"<{kind}>",
+                f"detector rule name must be a string literal, not "
+                f"{label} — interpolated rules are unbounded "
+                "cardinality in dl4j_alerts_total and break incident "
+                "coalescing on alert:<rule> reasons"))
+    return out
+
+
+def check_source(source: str, path: str = "<string>") -> List[Violation]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, "<parse>", str(e))]
+    return check_tree(tree, path)
+
+
+@register
+class DetectorRuleNamesChecker:
+    rule = "detector-rule-names"
+    description = ("watchtower detector constructions must pass a "
+                   "literal ^(watch|fleet)_[a-z0-9_]+$ rule name — the "
+                   "alert lifecycle, dl4j_alerts_total labels, and "
+                   "incident coalescing all key on it")
+
+    _HINT = ("name the rule with a literal and carry variability in the "
+             "description: BurnRateDetector(\"watch_http_error_burn\", "
+             "...), never BurnRateDetector(f\"watch_{name}\", ...)")
+
+    def check_file(self, ctx) -> List[Finding]:
+        return [Finding(self.rule, ctx.relpath, v.line,
+                        f"{v.name}: {v.message}", self._HINT)
+                for v in check_tree(ctx.tree, ctx.relpath)]
