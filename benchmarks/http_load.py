@@ -35,6 +35,14 @@ Two modes:
   (victim goodput >= 90% of baseline, p99 within 2x, flooder shed at
   the door with Retry-After).
 
+- ``--session-failover``: the graded exactly-once streaming drill
+  (archives ``SESS_r*.json``): a 2-worker fleet under
+  ``generation.step`` crash + ``generation.adopt`` faults, one worker
+  SIGKILLed with every SSE stream mid-flight — 100% of streams must
+  complete via survivor session adoption with gapless/duplicate-free
+  ``id:`` sequences and greedy tokens byte-identical to an undisturbed
+  in-process run (resume latency reported, never gated).
+
 Every run also pins streaming correctness: for one seeded prompt the
 SSE token sequence must equal the non-streamed result exactly, and the
 first-token latency must beat the full-sequence latency by a real
@@ -47,6 +55,7 @@ import json
 import os
 import re
 import signal
+import socket
 import statistics
 import subprocess
 import sys
@@ -1787,6 +1796,225 @@ def _record(args, stats: "_Stats", stream: dict, vs_direct, workers,
     }
 
 
+# ------------------------------------------------- session failover drill
+class _SseCollector(threading.Thread):
+    """One raw-socket SSE stream against the proxy: records every
+    ``id:`` line, token, and terminal event with receive timestamps —
+    the audit trail for the zero-duplicate/zero-missing assertion."""
+
+    def __init__(self, host: str, port: int, prompt, n_new: int):
+        super().__init__(daemon=True)
+        self.prompt, self.n_new = list(prompt), n_new
+        self._addr = (host, port)
+        self.ids, self.toks, self.at = [], [], []
+        self.done = None
+        self.error = None
+        self.exc = None
+
+    def run(self):
+        try:
+            body = json.dumps({"prompt": self.prompt,
+                               "max_new_tokens": self.n_new,
+                               "stream": True}).encode()
+            s = socket.create_connection(self._addr, timeout=180)
+            s.sendall(b"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: " + str(len(body)).encode()
+                      + b"\r\nConnection: close\r\n\r\n" + body)
+            s.settimeout(180)
+            buf, ev, cur_id = b"", None, None
+            while True:
+                try:
+                    data = s.recv(65536)
+                except OSError as e:
+                    self.exc = e
+                    break
+                if not data:
+                    break
+                buf += data
+                while b"\n" in buf:
+                    ln, _, buf = buf.partition(b"\n")
+                    ln = ln.strip()
+                    if ln.startswith(b"id:"):
+                        cur_id = int(ln[3:].strip())
+                    elif ln.startswith(b"event:"):
+                        ev = ln.split(b":", 1)[1].strip().decode()
+                    elif ln.startswith(b"data:"):
+                        d = json.loads(ln[5:].strip())
+                        if ev == "token":
+                            self.ids.append(cur_id)
+                            self.toks.append(d["token"])
+                            self.at.append(time.monotonic())
+                        elif ev == "done":
+                            self.done = d
+                        elif ev == "error":
+                            self.error = d
+            s.close()
+        except Exception as e:
+            self.exc = e
+
+
+def _session_baselines(prompts, n_new: int, slots: int):
+    """The undisturbed greedy token sequences, computed IN-PROCESS on
+    the same demo engine the fleet deploys (same config, same seed, no
+    faults) — what every chaos-run stream must match byte-for-byte."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.models.generation import DecodeEngine
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.parallel.generation import GenerationPipeline
+    cfg = TransformerConfig(vocab_size=61, n_layers=2, n_heads=2,
+                            d_model=32, max_len=64)
+    model = TransformerLM(cfg)
+    engine = DecodeEngine(model, model.init_params(jax.random.key(0)),
+                          max_len=48)
+    gp = GenerationPipeline(engine, slots=slots, max_new_tokens=n_new)
+    try:
+        return [[int(t) for t in
+                 gp.generate(np.asarray(p, np.int32),
+                             max_new_tokens=n_new)]
+                for p in prompts]
+    finally:
+        gp.shutdown()
+
+
+def run_session_failover(args, rng) -> dict:
+    """The graded exactly-once streaming drill (archives SESS_r*.json):
+    a 2-worker fleet under chaos — per-step decode latency, seeded
+    ``generation.step`` crashes (in-place resume), armed
+    ``generation.adopt`` faults (the adoption retry path) — then one
+    worker SIGKILLed with every stream mid-flight.  Every SSE stream
+    must still complete through the proxy's mid-stream failover with a
+    gapless, duplicate-free ``id:`` sequence and greedy tokens
+    byte-identical to the undisturbed in-process baseline.  Resume
+    latency (kill → first survivor token) is reported, never gated."""
+    n_streams = max(8, args.workers * 4)
+    n_new = 16
+    prompts = [[rng.randrange(1, 61) for _ in range(rng.randrange(4, 8))]
+               for _ in range(n_streams)]
+    baselines = _session_baselines(prompts, n_new, args.slots)
+
+    state_dir = args.state_dir or f"/tmp/dl4j-sess-drill-{os.getpid()}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DL4J_TPU_SESSIONS", None)       # the drill grades the ON path
+    env["DL4J_TPU_SESSION_JOURNAL_STEPS"] = "1"
+    env["DL4J_TPU_FAULTS"] = args.session_faults
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "tools", "serve.py"),
+         "--workers", "2", "--port", "0", "--state-dir", state_dir,
+         "--slots", str(max(args.slots, n_streams)), "--no-respawn"],
+        stdout=subprocess.PIPE, text=True, env=env)
+    store = _fleet_store(state_dir)
+    try:
+        fleet = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("tools/serve.py exited before "
+                                   "announcing the fleet")
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "fleet" in doc:
+                fleet = doc
+                break
+        if fleet is None:
+            raise RuntimeError("fleet announce line never arrived")
+        addr = fleet["address"]
+        host, port = addr.split("//")[1].split(":")
+        port = int(port)
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                _get(addr, "/debug/frontdoor", timeout=5.0)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("fleet never answered")
+                time.sleep(0.5)
+
+        workers = store.read().get("workers") or {}
+        victim = sorted(workers)[-1]            # spare the leader
+        victim_pid = int(workers[victim]["pid"])
+
+        streams = [_SseCollector(host, port, p, n_new) for p in prompts]
+        for st in streams:
+            st.start()
+            time.sleep(0.05)
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if all(len(st.ids) >= 2 for st in streams):
+                break
+            time.sleep(0.05)
+        inflight_at_kill = [len(st.ids) for st in streams]
+        os.kill(victim_pid, signal.SIGKILL)
+        killed_at = time.monotonic()
+        for st in streams:
+            st.join(timeout=300)
+
+        complete = seq_exact = match = 0
+        resume_lat = []
+        failures = []
+        for i, (st, base) in enumerate(zip(streams, baselines)):
+            gapless = st.ids == list(range(len(st.ids)))
+            ok_done = st.done is not None
+            ok_match = st.toks == base
+            complete += ok_done
+            seq_exact += gapless
+            match += ok_match
+            if not (gapless and ok_done and ok_match):
+                failures.append({
+                    "stream": i, "n": len(st.ids), "gapless": gapless,
+                    "done": ok_done, "match": ok_match,
+                    "error": st.error, "exc": repr(st.exc)})
+            post = [t for t in st.at if t > killed_at]
+            if inflight_at_kill[i] < n_new and post:
+                resume_lat.append(post[0] - killed_at)
+        sessions = {}
+        try:
+            sessions = _get(addr, "/debug/sessions", timeout=10.0)[1]
+        except Exception:
+            pass
+        frac = complete / max(1, n_streams)
+        rec = {
+            "metric": "sess_failover",
+            "platform": "cpu",
+            "value": round(frac, 4),
+            "unit": "completion_fraction",
+            "sess_completion": round(frac, 4),
+            "sess_seq_exact": seq_exact / max(1, n_streams),
+            "sess_greedy_match": match / max(1, n_streams),
+            "sess_streams": n_streams,
+            "inflight_at_kill": inflight_at_kill,
+            "resume_latency_ms": (round(max(resume_lat) * 1e3, 1)
+                                  if resume_lat else None),
+            "resume_latency_ms_all": [round(t * 1e3, 1)
+                                      for t in sorted(resume_lat)],
+            "resumed_streams": len(resume_lat),
+            "survivor_sessions": len(sessions.get("sessions") or []),
+            "survivor_worker": sessions.get("worker"),
+            "killed_worker": victim,
+            "failures": failures,
+            "session_faults": args.session_faults,
+            "workers": 2,
+            "seed": args.seed,
+            "audited_all_streams": len(streams) == n_streams,
+            "ok_verdict": (frac == 1.0 and seq_exact == n_streams
+                           and match == n_streams),
+        }
+        return rec
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--qps", type=float, default=20.0)
@@ -1856,12 +2084,34 @@ def main(argv=None) -> int:
     ap.add_argument("--detect-budget-s", type=float, default=15.0,
                     help="--watchtower: seconds the burn-rate page may "
                          "take to fire after the regression starts")
+    ap.add_argument("--session-failover", action="store_true",
+                    help="the graded exactly-once streaming drill: a "
+                         "2-worker fleet under generation.step crash + "
+                         "generation.adopt faults, one worker SIGKILLed "
+                         "with every SSE stream mid-flight — 100%% must "
+                         "complete via survivor adoption with gapless "
+                         "ids and greedy tokens byte-identical to an "
+                         "undisturbed run; archives SESS_r*.json")
+    ap.add_argument("--session-faults",
+                    default="generation.step:latency:1.0,"
+                            "generation.step:crash:0.02:2,"
+                            "generation.adopt:error:0.5:2",
+                    help="DL4J_TPU_FAULTS spec injected into every "
+                         "--session-failover worker")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.kill_drill and args.workers < 2:
         ap.error("--kill-drill needs --workers >= 2")
     import random
     rng = random.Random(args.seed)
+    if args.session_failover:
+        rec = run_session_failover(args, rng)
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0 if rec.get("ok_verdict") else 1
     if args.watchtower:
         rec = run_watchtower(args, rng)
         line = json.dumps(rec)

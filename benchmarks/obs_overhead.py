@@ -55,11 +55,19 @@ cadence, ``DL4J_TPU_WATCHTOWER=0`` (beats no-op — the pre-watchtower
 process) vs ``=1``. Bar: <2% — continuous detection must be free enough
 to leave on in production.
 
+``--session-ab`` runs the durable-session A/B: steady-state generate
+latency on an in-process ``GenerationPipeline``,
+``DL4J_TPU_SESSIONS=0`` (the pre-session decode path) vs ``=1``
+(per-request session minting, per-token ring append, batched journal
+flushes into a live ``SharedStore``). Bar: <2% — crash-safety must be
+free enough to leave on in production.
+
 Run: python benchmarks/obs_overhead.py [--steps N] [--batch B] [--json]
      python benchmarks/obs_overhead.py --elastic-ab [--json]
      python benchmarks/obs_overhead.py --warmup-ab [--json]
      python benchmarks/obs_overhead.py --fleet-obs-ab [--json]
      python benchmarks/obs_overhead.py --watchtower-ab [--json]
+     python benchmarks/obs_overhead.py --session-ab [--json]
 """
 from __future__ import annotations
 
@@ -436,6 +444,84 @@ def trace_store_ab(steps: int, repeats: int, as_json: bool) -> float:
     return overhead
 
 
+#: session A/B worker: an in-process GenerationPipeline on the demo
+#: TransformerLM (the same engine tools/serve.py deploys), timed
+#: generate() calls in steady state. The arms differ ONLY in
+#: DL4J_TPU_SESSIONS: 0 is the pre-session decode path (no record, no
+#: journal), 1 mints a session per request, appends every emitted token
+#: to its ring record, and journals batches into a live SharedStore at
+#: the default cadence off the hot path — the cost this A/B bounds.
+_SESSION_WORKER = r"""
+import json, os, sys, tempfile, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.models.generation import DecodeEngine
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+from deeplearning4j_tpu.parallel.generation import GenerationPipeline
+from deeplearning4j_tpu.serving import session as _sess
+from deeplearning4j_tpu.serving.shared_state import SharedStore
+
+steps = int(sys.argv[1])
+cfg = TransformerConfig(vocab_size=61, n_layers=2, n_heads=2,
+                        d_model=32, max_len=64)
+model = TransformerLM(cfg)
+engine = DecodeEngine(model, model.init_params(jax.random.key(0)),
+                      max_len=48)
+gp = GenerationPipeline(engine, slots=4, max_new_tokens=16)
+if _sess.sessions_enabled():
+    # the shipped posture: a live journal draining to a real store
+    store = SharedStore(tempfile.mkdtemp(prefix="dl4j-sess-ab-"))
+    _sess.global_journal().attach(store, "ab")
+prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+for _ in range(5):              # compile + slot churn outside the window
+    gp.generate(prompt, max_new_tokens=16)
+t0 = time.perf_counter()
+for _ in range(steps):
+    gp.generate(prompt, max_new_tokens=16)
+wall = time.perf_counter() - t0
+gp.shutdown()
+print(json.dumps({"seconds_per_step": wall / steps,
+                  "sessions": os.environ.get("DL4J_TPU_SESSIONS", "1")}))
+"""
+
+#: session A/B arm -> env overrides
+SESSION_MODES = {
+    "sess_off": {"DL4J_TPU_SESSIONS": "0"},
+    "sess_on": {"DL4J_TPU_SESSIONS": "1"},
+}
+
+
+def session_ab(steps: int, repeats: int, as_json: bool) -> float:
+    """Interleaved min-of-N A/B (rotating arm order — the noisy-box
+    protocol): does per-request session minting + per-token ring append
+    + batched store journaling keep steady-state generation latency
+    under the 2% bar?"""
+    best = _interleaved_min(
+        list(SESSION_MODES), repeats,
+        lambda m: _run_worker(_SESSION_WORKER, [steps],
+                              SESSION_MODES[m]))
+    overhead = ((best["sess_on"] - best["sess_off"])
+                / best["sess_off"] * 100.0)
+    result = {"generate_seconds_sessions_off": best["sess_off"],
+              "generate_seconds_sessions_on": best["sess_on"],
+              "session_overhead_percent": overhead,
+              "steps": steps, "repeats": repeats}
+    if as_json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(f"durable-session A/B (16-token generate, {steps} "
+              f"requests/arm, min of {repeats} interleaved repeats)")
+        print(f"  sessions off (DL4J_TPU_SESSIONS=0): "
+              f"{best['sess_off'] * 1e3:8.3f} ms/request")
+        print(f"  sessions on  (journal attached):    "
+              f"{best['sess_on'] * 1e3:8.3f} ms/request")
+        print(f"  session overhead: {overhead:+.2f}%  (bar: < 2%)")
+    return overhead
+
+
 #: watchtower A/B worker: the same traced front-door request loop, but
 #: with a background thread beating the watchtower (timeseries scrape +
 #: detector evaluation + alert lifecycle) at drill cadence throughout
@@ -594,6 +680,10 @@ def main():
                     help="run the watchtower A/B: front-door request "
                          "latency with DL4J_TPU_WATCHTOWER=0 vs 1 under "
                          "a drill-cadence beat thread")
+    ap.add_argument("--session-ab", action="store_true",
+                    help="run the durable-session A/B: steady-state "
+                         "generate latency with DL4J_TPU_SESSIONS=0 "
+                         "vs 1 (journal attached to a live store)")
     ap.add_argument("--save-every", type=int, default=8,
                     help="elastic A/B checkpoint cadence in steps (the "
                          "perf posture; the exact-resume drills save "
@@ -615,6 +705,12 @@ def main():
         # sample 2 beats and grade scheduler noise instead
         return watchtower_ab(max(args.steps, 200), args.repeats,
                              args.json)
+    if args.session_ab:
+        # floors: the per-request deltas at stake are ~100us, below the
+        # jitter of a fresh-subprocess min-of-3 — 60 requests x 5
+        # interleaved repeats keeps the estimator noise under the bar
+        return session_ab(max(args.steps, 60), max(args.repeats, 5),
+                          args.json)
 
     # a lone run is dominated by host warmup noise (the first subprocess
     # routinely runs 1.5x slower than steady state regardless of mode) —

@@ -46,6 +46,8 @@ A bundle is a directory under ``DL4J_TPU_POSTMORTEM_DIR`` (default
   warmup, in-flight), rollout stage/share and its SLO verdicts
 - ``generation.json`` — the generative decode layer: per-pipeline slot
   tables (who was decoding, at which position), queue depth, cache size
+- ``sessions.json`` — the durable generation sessions: journal
+  attachment, per-session status/seq/fence (what a survivor can adopt)
 - ``frontdoor.json`` — the HTTP serving front door: in-flight gate,
   lane routers, and the shared-store fleet view (multi-process mode)
 - ``perf.json`` — the cost observatory: per-entry-point FLOPs/bytes,
@@ -350,6 +352,11 @@ class FlightRecorder:
         # the generative decode layer: slot table, positions, queue depth
         # — a hang mid-generation must name which slots were decoding
         section("generation.json", self._write_generation)
+        # the durable-session layer: journal attachment, per-session
+        # status/seq/fence — a death mid-stream must name which
+        # sessions a survivor can adopt (section absent with
+        # DL4J_TPU_SESSIONS=0 never exercised)
+        section("sessions.json", self._write_sessions)
         # the HTTP front door: in-flight gate, lane routers, and (multi-
         # process mode) the shared fleet view — a death under load must
         # name what the wire surface was doing
@@ -474,6 +481,16 @@ class FlightRecorder:
                      if gen is not None else [])
         with open(path, "w") as f:
             json.dump({"pipelines": pipelines}, f, indent=2, default=str)
+
+    @staticmethod
+    def _write_sessions(path: str):
+        # sys.modules guard, same rationale as _write_generation
+        import sys as _sys
+        sm = _sys.modules.get("deeplearning4j_tpu.serving.session")
+        payload = (sm.snapshot() if sm is not None
+                   else {"enabled": None, "sessions": []})
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2, default=str)
 
     @staticmethod
     def _write_frontdoor(path: str):

@@ -49,6 +49,18 @@ cannot back yet and retries it at every step boundary, and
 tokens per step boundary; ``_sweep_finished`` consumes per-slot token
 LISTS so eos/budget/deadline/stream-cancel semantics are per token,
 exactly as the one-token path behaved.
+
+Durable sessions (PR 20, kill switch ``DL4J_TPU_SESSIONS=0``, see
+``serving/session.py``): every admitted generation carries a journaled
+session record; the decode loop's only added cost is a list append per
+token and an ``Event.set`` per step boundary. A device-level fault now
+RESUMES journaled sessions in place (re-prefill of prompt + emitted —
+deterministic because sampling is in-graph seeded) instead of failing
+every slot; ``resume(record)`` re-enters an adopted session from
+another worker's journal through the ordinary admission path; page
+reclamation prefers shedding unjournaled (new) sessions over journaled
+ones; and a fence-stolen session sheds typed (``session_lost``) at the
+next boundary so a stalled worker can never double-decode.
 """
 from __future__ import annotations
 
@@ -83,6 +95,15 @@ from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   default_deadline_ms)
 
 _TYPED_OUTCOMES = TYPED_OUTCOMES
+
+
+def _session_mod():
+    """Lazy ``serving.session`` import: ``parallel`` must not import the
+    ``serving`` package at module load (the registry there imports the
+    parallel modules back) — by the time a pipeline is constructed both
+    packages are fully loaded and the import is safe."""
+    from deeplearning4j_tpu.serving import session
+    return session
 
 
 class StreamCancelled(ShedError):
@@ -123,7 +144,7 @@ class _GenMetrics:
         self.shed = {r: shed.labels(reason=r)
                      for r in ("queue_full", "deadline", "circuit_open",
                                "client_gone", "preempted",
-                               "pages_exhausted")}
+                               "pages_exhausted", "session_lost")}
         self.occupancy = reg.histogram(
             "dl4j_decode_slot_occupancy_ratio",
             "occupied slots / total slots per decode step (1.0 = the "
@@ -188,20 +209,29 @@ class _GenRequest(_Request):
     set) streams each token out at the step boundary that produced it."""
 
     __slots__ = ("max_new_tokens", "eos_id", "out", "t_slot_us",
-                 "on_token", "cost_flops")
+                 "on_token", "cost_flops", "session", "resumes")
 
     def __init__(self, x, max_new_tokens: int, eos_id: Optional[int],
-                 on_token=None):
+                 on_token=None, session=None, out=None):
         super().__init__(x)
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
-        self.out: List[int] = []
+        # non-empty ``out`` = a RESUMED session: these tokens were
+        # already emitted (by this worker before a fault, or by a dead
+        # one — they came back from the journal) and prefill re-enters
+        # at prompt + out
+        self.out: List[int] = list(out) if out else []
         self.t_slot_us = 0.0
         self.on_token = on_token
         # accounted device work attributed to this request (prefill +
         # per-slot decode-step shares) — charged to its tenant at
         # resolution under the QoS posture
         self.cost_flops = 0.0
+        # the durable session record riding this request (None with
+        # DL4J_TPU_SESSIONS=0); ``resumes`` bounds the in-place
+        # fault-resume budget so a poisoned cache can't loop forever
+        self.session = session
+        self.resumes = 0
 
 
 class GenerationPipeline:
@@ -239,6 +269,9 @@ class GenerationPipeline:
         self.default_max_new_tokens = int(max_new_tokens)
         self.default_eos_id = eos_id
         self._resilience = _faults.resilience_enabled()
+        # durable-session posture (kill switch DL4J_TPU_SESSIONS=0):
+        # resolved once at construction, same discipline as _resilience
+        self._sessions = _session_mod().sessions_enabled()
         if shed_policy is not None and shed_policy not in (
                 "reject_newest", "reject_oldest"):
             raise ValueError("shed_policy must be 'reject_newest' or "
@@ -343,10 +376,88 @@ class GenerationPipeline:
                 "generation circuit open (consecutive decode-step "
                 "failures); retry after the reset timeout")
 
+    def _begin_session(self, prompt: np.ndarray, n_new: int, eos_id,
+                       tenant, session_version, session_id):
+        """Mint the durable session record for an admitted generation
+        (None under ``DL4J_TPU_SESSIONS=0``)."""
+        if not self._sessions:
+            return None
+        smod = _session_mod()
+        samp = self.engine.sampler
+        return smod.global_sessions().begin(
+            prompt.tolist(),
+            {"kind": samp.kind, "top_k": samp.top_k,
+             "temperature": samp.temperature},
+            getattr(self.engine, "_seed", None), n_new, eos_id,
+            tenant=tenant, version=session_version, sid=session_id)
+
+    @staticmethod
+    def _session_append(req: "_GenRequest", tok: int):
+        if req.session is not None:
+            req.session.append(tok)
+
+    def _run_request(self, req: "_GenRequest", obs: "_GenMetrics",
+                     t0: float, span_name: str, **span_kw) -> np.ndarray:
+        """Submit → await → account, shared by :meth:`generate` and
+        :meth:`resume` (identical lifecycle, different admission
+        preludes)."""
+        # span names stay literal (bounded trace-index cardinality);
+        # the two lifecycles are the only callers
+        span_cm = (_span("generation_resume", **span_kw)
+                   if span_name == "generation_resume"
+                   else _span("generation_request", **span_kw))
+        with _flight().arm(span_name), span_cm:
+            req.ctx = current_context()
+            req.t_enqueue_us = now_us()
+
+            def _account(err: Optional[BaseException]):
+                obs.latency.observe(time.perf_counter() - t0)
+                obs.requests.inc()
+                if err is not None and not isinstance(err, _TYPED_OUTCOMES):
+                    obs.errors.inc()
+                if req.tenant is not None:
+                    reg = _qos.global_tenants()
+                    reg.observe_request(req.tenant,
+                                        time.perf_counter() - t0, err)
+                    if req.out:
+                        reg.account_tokens(req.tenant, len(req.out))
+                    if req.cost_flops:
+                        reg.account_cost(req.tenant, req.cost_flops)
+
+            try:
+                self._check_admission(tenant=req.tenant)
+                self._enqueue(req, obs)
+            except Exception as e:
+                if req.session is not None:
+                    req.session.finish(
+                        "cancelled" if isinstance(e, _TYPED_OUTCOMES)
+                        else "failed")
+                _account(e)
+                raise
+            self._await(req)
+            if req.error is not None:
+                # the resolver paths (_resolve/_fail/_shed) run on the
+                # decode thread; the caller's walk-away resolves HERE —
+                # the session terminal status is stamped once, centrally
+                if req.session is not None:
+                    req.session.finish(
+                        "cancelled" if isinstance(req.error,
+                                                  _TYPED_OUTCOMES)
+                        else "failed")
+                _account(req.error)
+                raise req.error
+            if req.session is not None:
+                req.session.finish("done")
+        _account(None)
+        return req.result
+
     def generate(self, prompt, max_new_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 on_token=None, tenant=None) -> np.ndarray:
+                 on_token=None, tenant=None,
+                 session_id: Optional[str] = None,
+                 session=None,
+                 session_version: Optional[str] = None) -> np.ndarray:
         """Generate up to ``max_new_tokens`` continuation tokens for a
         1-D int32 ``prompt``. Blocks until the request resolves; raises
         the typed resilience outcomes (shed/deadline/circuit/shutdown)
@@ -361,7 +472,14 @@ class GenerationPipeline:
         the request: it resolves with the typed :class:`StreamCancelled`
         and its slot frees at the boundary — the disconnect-mid-stream
         path can never leak a slot. The streamed sequence is exactly the
-        returned array: same tokens, same order, nothing elided."""
+        returned array: same tokens, same order, nothing elided.
+
+        Under the durable-session posture every admitted generation
+        also gets a :mod:`~deeplearning4j_tpu.serving.session` record
+        (``session_id`` pins its id, ``session`` supplies a pre-built
+        record — the adoption path — and ``session_version`` stamps the
+        serving deploy it ran under); ``DL4J_TPU_SESSIONS=0`` makes all
+        three inert."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must contain at least one token")
@@ -386,44 +504,100 @@ class GenerationPipeline:
                 f"but the pool holds {self._cache.alloc.total}")
         obs = _GenMetrics.get()
         t0 = time.perf_counter()
-        req = _GenRequest(prompt, n_new,
-                          eos_id if eos_id is not None
-                          else self.default_eos_id, on_token=on_token)
+        real_eos = eos_id if eos_id is not None else self.default_eos_id
+        sess = session
+        if self._sessions and sess is None:
+            sess = self._begin_session(prompt, n_new, real_eos, tenant,
+                                       session_version, session_id)
+        req = _GenRequest(prompt, n_new, real_eos, on_token=on_token,
+                          session=sess)
         req.deadline = self._resolve_deadline(deadline_ms)
         req.tenant = (_qos.global_tenants().resolve(tenant)
                       if self._qos else None)
-        with _flight().arm("generation_request"), \
-                _span("generation_request", prompt_tokens=int(prompt.size),
-                      max_new_tokens=n_new):
-            req.ctx = current_context()
-            req.t_enqueue_us = now_us()
+        return self._run_request(req, obs, t0, "generation_request",
+                                 prompt_tokens=int(prompt.size),
+                                 max_new_tokens=n_new)
 
-            def _account(err: Optional[BaseException]):
-                obs.latency.observe(time.perf_counter() - t0)
-                obs.requests.inc()
-                if err is not None and not isinstance(err, _TYPED_OUTCOMES):
-                    obs.errors.inc()
-                if req.tenant is not None:
-                    reg = _qos.global_tenants()
-                    reg.observe_request(req.tenant,
-                                        time.perf_counter() - t0, err)
-                    if req.out:
-                        reg.account_tokens(req.tenant, len(req.out))
-                    if req.cost_flops:
-                        reg.account_cost(req.tenant, req.cost_flops)
+    def resume(self, record: dict, on_token=None,
+               deadline_ms: Optional[float] = None,
+               tenant=None, session=None) -> np.ndarray:
+        """Re-enter a journaled session (tentpole 2/3): replay the
+        journaled token log through ``on_token`` (indices ``0..k-1`` —
+        the caller's ``Last-Event-ID`` window dedups what its client
+        already received), then re-prefill ``prompt + emitted`` into a
+        free slot and continue the stream. Sampling is in-graph seeded,
+        so under greedy the continued stream is byte-identical to the
+        one the dead worker would have produced. Live slots are never
+        disturbed — a resume is an ordinary admission into a freed slot
+        (page pressure parks it exactly like any joiner).
 
-            try:
-                self._check_admission(tenant=req.tenant)
-                self._enqueue(req, obs)
-            except Exception as e:
-                _account(e)
-                raise
-            self._await(req)
-            if req.error is not None:
-                _account(req.error)
-                raise req.error
-        _account(None)
-        return req.result
+        ``record`` is the journal/store form (``prompt``, ``tokens``,
+        ``max_new_tokens``, ``eos_id``, ...); ``session`` (optional) is
+        the local :class:`~deeplearning4j_tpu.serving.session.Session`
+        mirror the continued tokens journal into — pass the
+        ``adopt_local`` result on the adoption path."""
+        prompt = np.asarray(record.get("prompt") or [],
+                            np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("session record has no prompt to resume")
+        emitted = [int(t) for t in (record.get("tokens") or [])]
+        n_new = int(record.get("max_new_tokens")
+                    or self.default_max_new_tokens)
+        eos = record.get("eos_id")
+        eos = int(eos) if eos is not None else None
+
+        def _replay() -> bool:
+            """Push the already-emitted log through the stream; False =
+            the consumer walked away."""
+            if on_token is None:
+                return True
+            for i, t in enumerate(emitted):
+                if on_token(int(t), i) is False:
+                    return False
+            return True
+
+        complete = (len(emitted) >= n_new
+                    or (eos is not None and emitted
+                        and emitted[-1] == eos))
+        if complete:
+            # nothing left to decode — the record IS the result (the
+            # done-status adoption / replay-only path)
+            _replay()
+            return np.asarray(emitted, np.int32)
+        total = prompt.size + len(emitted)
+        self.engine.prefill_bucket(total)
+        if total + 1 > self.engine.max_len:
+            raise ValueError(
+                f"resumed session ({total} cached tokens) leaves no "
+                f"room to decode in a {self.engine.max_len}-token cache")
+        if (self.engine.paged and self.engine.min_pages_for_prompt(total)
+                > self._cache.alloc.total):
+            raise ValueError(
+                f"resumed session ({total} tokens) needs "
+                f"{self.engine.min_pages_for_prompt(total)} pages but "
+                f"the pool holds {self._cache.alloc.total}")
+        obs = _GenMetrics.get()
+        t0 = time.perf_counter()
+        if not _replay():
+            # client gone before the resume even admitted — same typed
+            # outcome the mid-stream walk-away gets
+            raise StreamCancelled(
+                "streaming consumer cancelled during session replay")
+        req = _GenRequest(prompt, n_new, eos, on_token=on_token,
+                          session=session, out=emitted)
+        req.deadline = self._resolve_deadline(deadline_ms)
+        req.tenant = (_qos.global_tenants().resolve(
+            tenant if tenant is not None else record.get("tenant"))
+            if self._qos else None)
+        if self._sessions:
+            _session_mod().session_metrics().resumes.inc()
+            _faults.record_event("session_resume",
+                                 sid=record.get("sid"),
+                                 emitted=len(emitted))
+        return self._run_request(req, obs, t0, "generation_resume",
+                                 prompt_tokens=int(prompt.size),
+                                 replayed_tokens=len(emitted),
+                                 max_new_tokens=n_new)
 
     def _enqueue(self, req: _GenRequest, obs: "_GenMetrics"):
         """Bounded enqueue with the PI condition/shed semantics."""
@@ -580,6 +754,50 @@ class GenerationPipeline:
         self._positions[slot] = 0
         self._tokens[slot] = 0
 
+    def _rebuild_after_fault(self, error: BaseException):
+        """A device-level fault poisoned the cache: fail every in-flight
+        request EXCEPT the ones a durable session can deterministically
+        resume (tentpole 2 — only genuinely unjournaled work is lost,
+        bounded by the journal cadence), zero the slot books, and
+        rebuild the page pool. Returns the resumable survivors for
+        :meth:`_replace_survivors`. With sessions off every slot fails,
+        byte-identical to the pre-session behavior."""
+        survivors: List[_GenRequest] = []
+        for slot, req in enumerate(self._slot_req):
+            if req is not None:
+                if (self._sessions and req.session is not None
+                        and not req.session.stolen and not req._claimed
+                        and req.resumes < 3):
+                    req.resumes += 1
+                    survivors.append(req)
+                else:
+                    self._fail_request(req, error)
+            self._slot_req[slot] = None
+        self._tokens[:] = 0
+        self._positions[:] = 0
+        self._cache = self.engine.new_state(self.slots,
+                                            pages=self._cache_pages)
+        return survivors
+
+    def _replace_survivors(self, survivors: List[_GenRequest],
+                           error: BaseException):
+        """Re-prefill fault survivors into the rebuilt cache (all slots
+        are free when this runs). A survivor that cannot re-place —
+        pool too small for its grown context, or its re-prefill fails
+        again — resolves with the original fault."""
+        if not survivors:
+            return
+        _session_mod().session_metrics().resumes.inc(len(survivors))
+        _faults.record_event("session_resume_inplace",
+                             count=len(survivors))
+        slot_i = 0
+        for req in survivors:
+            if slot_i >= self.slots:
+                self._fail_request(req, error)
+                continue
+            if self._start_request(req, slot_i):
+                slot_i += 1
+
     def _start_request(self, req: _GenRequest, slot: int) -> bool:
         """Prefill ``req`` into ``slot``'s cache pages. Returns True when
         the slot is now occupied (False: resolved without occupying)."""
@@ -593,11 +811,18 @@ class GenerationPipeline:
                         ctx=req.ctx, slot=slot)
         t0 = time.perf_counter()
         t_us = now_us()
+        # a resumed request re-prefills prompt + already-emitted tokens:
+        # the cache rebuilds to exactly the state the lost slot held, and
+        # the in-graph seeded sampler continues the identical stream
+        # (byte-identical under greedy)
+        k_resumed = len(req.out)
+        x_in = (np.concatenate([req.x, np.asarray(req.out, np.int32)])
+                if k_resumed else req.x)
         try:
             with _span("prefill", slot=slot,
-                       prompt_tokens=int(req.x.size)):
+                       prompt_tokens=int(x_in.size)):
                 first, _logits, kv, t = self.engine.prefill(
-                    req.x[None], step=self._step)
+                    x_in[None], step=self._step)
         except Exception as e:
             # prefill failed BEFORE the insert donated anything — the
             # live cache is intact, only the joiner dies
@@ -613,7 +838,7 @@ class GenerationPipeline:
                     # cache — a failure here cannot touch the target
                     # pool (handled below)
                     self.engine.insert_draft_slot(self._cache, slot,
-                                                  req.x[None],
+                                                  x_in[None],
                                                   step=self._step)
                 first_tok = int(np.asarray(first)[0])
             dt = time.perf_counter() - t0
@@ -635,24 +860,31 @@ class GenerationPipeline:
             self._shed_request(req, "pages_exhausted", e)
             return False
         except Exception as e:
-            # insert DONATED live cache arrays before dying — its pages
-            # are gone, so every active generation is dead too: fail
-            # them all with the real insert error (not the
-            # deleted-buffer error one step later) and rebuild
             if self._breaker is not None:
                 self._breaker.record_failure()
             self._fail_request(req, e)
-            for s, other in enumerate(self._slot_req):
-                if other is not None:
-                    self._fail_request(other, e)
-                self._free_slot(s)
-            self._cache = self.engine.new_state(self.slots,
-                                                pages=self._cache_pages)
+            if isinstance(e, (ValueError, TypeError)):
+                # a POISONED REQUEST (bad shapes/dtypes/values raised by
+                # validation before any device write): the live cache is
+                # intact — one bad joiner must never take down every
+                # in-flight stream (blast-radius fix, pinned by a test)
+                return False
+            # device-level: insert DONATED live cache arrays before
+            # dying — its pages are gone, so every active generation
+            # lost its cache: rebuild the pages, resume the journaled
+            # sessions in place, and fail the rest with the real insert
+            # error (not the deleted-buffer error one step later)
+            survivors = self._rebuild_after_fault(e)
+            self._replace_survivors(survivors, e)
             return False
         req.out.append(first_tok)
+        self._session_append(req, first_tok)
         # the generation budget may be clipped by the cache length —
-        # never write a position past the preallocated pages
-        cap = min(req.max_new_tokens, self.engine.max_len - t)
+        # never write a position past the preallocated pages. On resume
+        # (len(out)-1 == k pre-existing tokens) the budget already spent
+        # k of its allowance; the cache-room clip applies to the REST
+        cap = min(req.max_new_tokens,
+                  (len(req.out) - 1) + self.engine.max_len - t)
         req.max_new_tokens = cap
         done = (len(req.out) >= cap
                 or (req.eos_id is not None and first_tok == req.eos_id))
@@ -747,7 +979,8 @@ class GenerationPipeline:
             if req is None:
                 return
             if (self.engine.paged
-                    and self.engine.min_pages_for_prompt(req.x.size)
+                    and self.engine.min_pages_for_prompt(
+                        req.x.size + len(req.out))
                     > self._cache.alloc.free_count):
                 # can't back the prompt yet; active slots still hold
                 # pages (generate() pre-checked the empty-pool fit, so
@@ -764,6 +997,19 @@ class GenerationPipeline:
                 return
             _GenMetrics.get().queue_depth.set(self._queue.qsize())
             self._start_request(req, free[0])
+
+    def _reclaim_victim_key(self, slot: int):
+        """Reclamation victim ordering (max wins): shed sessions with
+        NOTHING journaled before sessions the journal already made
+        durable, youngest first within each class — under page pressure
+        a worker sheds NEW sessions before evicting journaled ones
+        (tentpole 4). With sessions off every slot is "unjournaled" and
+        the key degenerates to the pre-session pure youngest-first."""
+        req = self._slot_req[slot]
+        unjournaled = True
+        if self._sessions and req.session is not None:
+            unjournaled = req.session.journaled == 0
+        return (unjournaled, req.t_slot_us)
 
     def _reclaim_pages(self, active: List[int]) -> List[int]:
         """Step-boundary reclamation: grow every active slot's pages for
@@ -790,8 +1036,7 @@ class GenerationPipeline:
                 # newcomer grew would invert the policy)
                 cands = [s for s in active
                          if self._slot_req[s] is not None]
-                victim = max(cands,
-                             key=lambda s: self._slot_req[s].t_slot_us)
+                victim = max(cands, key=self._reclaim_victim_key)
                 self._shed_request(
                     self._slot_req[victim], "pages_exhausted",
                     CachePagesExhausted(
@@ -833,11 +1078,24 @@ class GenerationPipeline:
                 # case is one extra step before the slot frees)
                 self._free_slot(slot)
                 continue
+            if req.session is not None and req.session.stolen:
+                # another worker fence-bumped this session away (it
+                # adopted the stream mid-failover while we were merely
+                # stalled): stop decoding NOW — continuing would
+                # double-decode, and our journal writes are already
+                # fenced off
+                self._shed_request(req, "session_lost",
+                                   _session_mod().SessionLost(
+                                       "session adopted by another "
+                                       "worker (lease fenced)"))
+                self._free_slot(slot)
+                continue
             if req.tenant is not None:
                 req.cost_flops += step_share
             done = cancelled = False
             for tok in toks_l:
                 req.out.append(int(tok))
+                self._session_append(req, tok)
                 obs.tokens.inc()
                 done = (len(req.out) >= req.max_new_tokens
                         or (req.eos_id is not None
@@ -930,28 +1188,29 @@ class GenerationPipeline:
                 if self._breaker is not None:
                     self._breaker.record_success()
                 _flight().progress("generation_step")
+            # graftlint: disable=typed-errors — the catch must be broad
+            # (any step fault poisons the donated cache); the taxonomy
+            # is resolved per-request via _fail_request/_shed_request
             except Exception as e:
                 if (self._breaker is not None
                         and not isinstance(e, _TYPED_OUTCOMES)):
                     self._breaker.record_failure()
                 # the step died mid-donation: the cache buffers are no
-                # longer trustworthy — fail every in-flight request and
-                # rebuild the pages (queued requests are untouched; the
+                # longer trustworthy — rebuild the pages, resume the
+                # journaled sessions in place (tentpole 2; the in-graph
+                # seed makes the continued stream deterministic), and
+                # fail the rest (queued requests are untouched; the
                 # fresh state resets the page allocator and, in spec
                 # mode, the draft cache with it)
-                for slot, req in enumerate(self._slot_req):
-                    if req is not None:
-                        self._fail_request(req, e)
-                    self._slot_req[slot] = None
-                self._tokens[:] = 0
-                self._positions[:] = 0
-                self._cache = self.engine.new_state(
-                    self.slots, pages=self._cache_pages)
+                survivors = self._rebuild_after_fault(e)
                 self._step += 1
+                self._replace_survivors(survivors, e)
+                self._notify_journal()
                 self._publish_cache_bytes()
                 continue
             self._step += 1
             self._sweep_finished(emitted)
+            self._notify_journal()
             self._publish_cache_bytes()
         # shutdown: resolve whatever still occupies a slot (and the
         # parked joiner the pool never backed)
@@ -964,6 +1223,14 @@ class GenerationPipeline:
             self._fail_request(self._waiting, ShutdownError(
                 "GenerationPipeline shut down"))
             self._waiting = None
+
+    def _notify_journal(self):
+        """Step-boundary poke for the session journal writer — an
+        ``Event.set``, the only hot-path cost journaling adds to the
+        decode loop (the batched store write happens on the journal's
+        own thread)."""
+        if self._sessions:
+            _session_mod().global_journal().notify()
 
     def _fresh_decode_compile(self) -> bool:
         """True when compile_watch counted a decode trace the cost model
@@ -1017,6 +1284,9 @@ class GenerationPipeline:
                     "generated": len(req.out),
                     "max_new_tokens": req.max_new_tokens,
                     "tenant": req.tenant,
+                    "session": (req.session.sid
+                                if req.session is not None else None),
+                    "resumes": req.resumes,
                     "trace_id": (req.ctx.trace_id
                                  if req.ctx is not None else None)})
                 if req.tenant is not None:
@@ -1057,6 +1327,7 @@ class GenerationPipeline:
             }
         return {
             "qos": self._qos,
+            "sessions": self._sessions,
             "tenants": tenants,
             "slots": self.slots,
             "active": self._n_active(),

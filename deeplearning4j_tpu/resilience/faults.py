@@ -17,6 +17,8 @@ Named injection points are threaded through the hot paths:
 ``serving.canary``          ServingRouter, on the canary version's path only
 ``generation.step``         GenerationPipeline decode loop, once per step
                             boundary (prefill joins + the decode step)
+``generation.adopt``        FrontDoor orphan-session adoption (the lease-
+                            fenced store takeover before a resume)
 ``http.request``            FrontDoor, at the door of every ``/v1/*``
                             request (after admission, before routing)
 ``store.read``              SharedStore document read (routing falls back
@@ -78,7 +80,8 @@ import numpy as np
 log = logging.getLogger("deeplearning4j_tpu")
 
 POINTS = ("data.next_batch", "inference.dispatch", "inference.device_execute",
-          "serving.canary", "generation.step", "http.request", "train.step",
+          "serving.canary", "generation.step", "generation.adopt",
+          "http.request", "train.step",
           "checkpoint.save", "checkpoint.restore", "checkpoint.manifest",
           "store.read", "store.write", "allreduce")
 KINDS = ("error", "crash", "latency", "nan", "host_loss")

@@ -52,6 +52,14 @@ front door PR):
   journal (``DL4J_TPU_IDEMPOTENCY``): a retried key replays the original
   outcome without re-executing, so QoS token debt is charged exactly
   once per key — the safety the fleet proxy's connect-failover rides.
+- :mod:`~deeplearning4j_tpu.serving.session` — :class:`Session` +
+  :class:`SessionJournal`: the durable generation-session layer
+  (``DL4J_TPU_SESSIONS``): every admitted generation journals its
+  prompt hash, sampler seed and emitted-token log into the shared
+  store at step boundaries, so a survivor worker can **adopt** an
+  orphaned stream (lease-fenced), re-prefill ``prompt + emitted`` and
+  continue the identical token sequence — mid-stream crash failover
+  with exactly-once delivery, byte-identical under greedy.
 
 Surfaces: ``UIServer GET /debug/deploy`` and ``deploy.json`` in
 flight-recorder bundles both serve :func:`snapshot`;
@@ -70,6 +78,9 @@ from deeplearning4j_tpu.serving.registry import DeployedVersion, ModelRegistry
 from deeplearning4j_tpu.serving.rollout import (CanaryRollout, RolloutPolicy,
                                                 RolloutState)
 from deeplearning4j_tpu.serving.router import ServingRouter, rollout_enabled
+from deeplearning4j_tpu.serving.session import (Session, SessionJournal,
+                                                SessionLost,
+                                                sessions_enabled)
 from deeplearning4j_tpu.serving.shared_state import (SharedServingState,
                                                      SharedStore,
                                                      fleet_fence_enabled)
@@ -80,7 +91,8 @@ __all__ = [
     "FrontDoor", "frontdoor_enabled", "SharedStore", "SharedServingState",
     "RolloutConflictError", "StoreLockTimeout", "fleet_fence_enabled",
     "fleet_snapshot", "ResultJournal", "IDEMPOTENCY_HEADER",
-    "idempotency_enabled",
+    "idempotency_enabled", "Session", "SessionJournal", "SessionLost",
+    "sessions_enabled",
 ]
 
 

@@ -83,9 +83,11 @@ from deeplearning4j_tpu.resilience import faults as _faults
 from deeplearning4j_tpu.resilience import qos as _qos
 from deeplearning4j_tpu.resilience.policy import (TYPED_OUTCOMES,
                                                   CircuitOpenError,
-                                                  DeadlineExceeded, ShedError,
+                                                  DeadlineExceeded,
+                                                  RetryPolicy, ShedError,
                                                   ShutdownError)
 from deeplearning4j_tpu.serving import idempotency as _idem
+from deeplearning4j_tpu.serving import session as _sess
 from deeplearning4j_tpu.serving.errors import RolloutConflictError
 from deeplearning4j_tpu.serving.router import request_fraction
 # ONE bind-host knob for both HTTP surfaces (the UI server owns the
@@ -99,6 +101,16 @@ MAX_BODY_BYTES = 16 << 20
 #: the tenant-identity request header (QoS posture; absent = default
 #: tenant, behavior unchanged)
 TENANT_HEADER = "X-Dl4j-Tenant"
+
+#: the durable-session id header (sessions posture): the proxy pins a
+#: stream's session here so its mid-stream failover can name the
+#: session a survivor must adopt; responses echo the minted id
+SESSION_HEADER = "X-Dl4j-Session-Id"
+
+#: the SSE resume header (standard EventSource semantics): the last
+#: ``id:`` the client received — a re-routed stream replays/regenerates
+#: everything AFTER it and nothing at or before it (exactly-once)
+LAST_EVENT_ID_HEADER = "Last-Event-ID"
 
 #: Retry-After for sheds that carry no quota refill time (the in-flight
 #: gate, an open circuit): "come back shortly", not a quota schedule
@@ -327,14 +339,15 @@ class FrontDoor:
 
     def generate(self, prompt, max_new_tokens=None, eos_id=None,
                  deadline_ms=None, request_key=None, on_token=None,
-                 tenant=None):
+                 tenant=None, session_id=None):
         if self.gen_router is None:
             raise KeyError("no generative deploy behind this front door")
         if self.shared is None:
             return self.gen_router.generate(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                 deadline_ms=deadline_ms, request_key=request_key,
-                on_token=on_token, tenant=tenant), None
+                on_token=on_token, tenant=tenant,
+                session_id=session_id), None
         frac = request_fraction(prompt, request_key)
         version, canary = self.shared.pick("generative", frac)
         if version is None:
@@ -345,7 +358,7 @@ class FrontDoor:
             out = self.gen_router.generate_on(
                 version, prompt, max_new_tokens=max_new_tokens,
                 eos_id=eos_id, deadline_ms=deadline_ms, canary=canary,
-                on_token=on_token, tenant=tenant)
+                on_token=on_token, tenant=tenant, session_id=session_id)
         except Exception as e:
             self.shared.record(version,
                                ok=isinstance(e, TYPED_OUTCOMES),
@@ -353,6 +366,70 @@ class FrontDoor:
             raise
         self.shared.record(version, ok=True,
                            latency_s=time.perf_counter() - t0)
+        return out, version
+
+    def adopt_session(self, sid: str) -> dict:
+        """Fence-bump ``sid``'s journaled record to THIS worker (fleet
+        failover: the proxy re-routed a dead worker's stream here). The
+        ``generation.adopt`` chaos point fires per attempt under the
+        standard retry budget — a transient store blip costs a retry,
+        not the stream. Raises ``KeyError`` when nothing durable
+        exists to adopt."""
+        if self.shared is None:
+            raise KeyError("session adoption needs the shared store")
+
+        def attempt():
+            if _faults.armed():
+                _faults.check("generation.adopt")
+            return _sess.adopt(self.shared.store, sid, self.worker_id)
+
+        if _faults.resilience_enabled():
+            return RetryPolicy(max_retries=2,
+                               base_delay_seconds=0.01).call(
+                attempt, op="generation.adopt")
+        return attempt()
+
+    def resume(self, record: dict, on_token=None, deadline_ms=None,
+               tenant=None):
+        """Continue an adopted session on this worker's slots: mirror
+        the record locally (the continued tokens journal under the
+        bumped fence) and re-enter through the pipeline's resume path.
+        Returns ``(tokens, version)`` like :meth:`generate`."""
+        if self.gen_router is None:
+            raise KeyError("no generative deploy behind this front door")
+        sess = _sess.global_sessions().adopt_local(record)
+        version = record.get("version")
+        if self.shared is not None and version is None:
+            version, _canary = self.shared.pick("generative", 0.0)
+        if version is None:
+            raise KeyError("adopted session names no generative version")
+        t0 = time.perf_counter()
+        try:
+            out = self.gen_router.resume_on(
+                version, record, on_token=on_token,
+                deadline_ms=deadline_ms, tenant=tenant, session=sess)
+        except KeyError:
+            # the dead worker served a version this one never deployed
+            # (mid-rollout death): fall back to the lane primary — the
+            # in-graph seed travels in the record, so greedy output is
+            # unchanged; a sampled stream continues best-effort
+            if self.shared is None:
+                raise
+            version, _canary = self.shared.pick("generative", 0.0)
+            if version is None:
+                raise
+            out = self.gen_router.resume_on(
+                version, record, on_token=on_token,
+                deadline_ms=deadline_ms, tenant=tenant, session=sess)
+        except Exception as e:
+            if self.shared is not None:
+                self.shared.record(version,
+                                   ok=isinstance(e, TYPED_OUTCOMES),
+                                   latency_s=time.perf_counter() - t0)
+            raise
+        if self.shared is not None:
+            self.shared.record(version, ok=True,
+                               latency_s=time.perf_counter() - t0)
         return out, version
 
     # ----------------------------------------------------- shared syncing
@@ -474,6 +551,13 @@ class FrontDoor:
     # -------------------------------------------------------------- serve
     def start(self) -> "FrontDoor":
         fd = self
+        if self.shared is not None and _sess.sessions_enabled():
+            # arm the session journal under this worker's lease: batched
+            # step-boundary writes into the same shared store the fleet
+            # plane rides (one daemon thread; kill switch leaves the
+            # journal detached and every session surface inert)
+            _sess.global_journal().attach(self.shared.store,
+                                          self.worker_id)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):     # quiet, like the UI server
@@ -816,23 +900,87 @@ class FrontDoor:
                           deadline_ms=body.get("deadline_ms"),
                           request_key=body.get("request_key"),
                           tenant=self._tenant)
+                sid = None
+                if _sess.sessions_enabled():
+                    last = self.headers.get(LAST_EVENT_ID_HEADER)
+                    sid = (self.headers.get(SESSION_HEADER)
+                           or body.get("session_id"))
+                    if (body.get("stream") and last is not None
+                            and sid and fd.shared is not None):
+                        # fleet failover re-entry: the proxy re-routed a
+                        # mid-stream death here with the session id and
+                        # the last event id its client received
+                        self._resume_stream(sid, last, kw, t0)
+                        return
+                    sid = sid or _sess.new_session_id()
+                    kw["session_id"] = sid
                 if body.get("stream"):
-                    self._generate_stream(prompt, kw, t0)
+                    self._generate_stream(prompt, kw, t0, sid=sid)
                     return
                 out, version = fd.generate(prompt, **kw)
                 payload = {"tokens": np.asarray(out).tolist(),
                            "worker": fd.worker_id}
+                if sid is not None:
+                    payload["session"] = sid
                 if version is not None:
                     payload["version"] = version
                 self._reply(200, payload, route, t0)
 
-            def _generate_stream(self, prompt, kw: dict, t0: float):
+            def _resume_stream(self, sid: str, last: str, kw: dict,
+                               t0: float):
+                """Adopt ``sid`` from the store (lease-fenced) and
+                continue its stream from the client's ``Last-Event-ID``:
+                the journal's token log replays through the same queue,
+                the pipeline regenerates the rest, and the dedup window
+                drops every index the client already has — exactly-once
+                across the failover."""
+                try:
+                    last_seq = int(last)
+                except (TypeError, ValueError):
+                    last_seq = -1
+                record = fd.adopt_session(sid)
+                tenant = self._tenant or record.get("tenant")
+                run_ctx = current_context()
+
+                def runner(on_token):
+                    with trace_context(run_ctx):
+                        return fd.resume(
+                            record, on_token=on_token,
+                            deadline_ms=kw.get("deadline_ms"),
+                            tenant=tenant)
+
+                self._stream_sse(runner, t0, sid=sid, last_seq=last_seq)
+
+            def _generate_stream(self, prompt, kw: dict, t0: float,
+                                 sid=None):
+                run_ctx = current_context()
+
+                def runner(on_token):
+                    # the generation runs on a worker thread: hand the
+                    # HTTP request's trace context across so the
+                    # pipeline's spans join the SAME trace id the
+                    # response header names
+                    with trace_context(run_ctx):
+                        return fd.generate(prompt, on_token=on_token,
+                                           **kw)
+
+                self._stream_sse(runner, t0, sid=sid)
+
+            def _stream_sse(self, runner, t0: float, sid=None,
+                            last_seq: int = -1):
                 """SSE per-token streaming. The decode thread hands each
                 token to a bounded queue via ``on_token`` (never touching
                 the socket); this handler thread drains it onto the wire.
                 A write failure (client gone) flips ``dead`` — the next
                 callback returns False and the pipeline frees the slot
-                at the step boundary (typed ``StreamCancelled``)."""
+                at the step boundary (typed ``StreamCancelled``).
+
+                With a session (``sid``), every token event carries its
+                sequence number as the SSE ``id:`` field — the resume
+                contract — and tokens at or below ``last_seq`` are
+                dropped before the queue (the failover dedup window).
+                With sessions off both are inert and the bytes on the
+                wire are identical to the pre-session stream."""
                 obs = _HttpMetrics.get()
                 q: "queue.Queue" = queue.Queue(maxsize=4096)
                 dead = threading.Event()
@@ -840,6 +988,8 @@ class FrontDoor:
                 def on_token(tok, idx):
                     if dead.is_set():
                         return False
+                    if idx <= last_seq:
+                        return True        # client already has it
                     try:
                         q.put_nowait((idx, int(tok)))
                     except queue.Full:
@@ -847,16 +997,10 @@ class FrontDoor:
                     return True
 
                 result: dict = {}
-                # the generation runs on a worker thread: hand the HTTP
-                # request's trace context across so the pipeline's spans
-                # join the SAME trace id the response header names
-                run_ctx = current_context()
 
                 def run():
                     try:
-                        with trace_context(run_ctx):
-                            out, version = fd.generate(
-                                prompt, on_token=on_token, **kw)
+                        out, version = runner(on_token)
                         result["tokens"] = np.asarray(out).tolist()
                         result["version"] = version
                     # graftlint: disable=typed-errors — resolved by
@@ -884,6 +1028,8 @@ class FrontDoor:
                 tid = self._tid()
                 if tid is not None:
                     self.send_header("X-Dl4j-Trace-Id", str(tid))
+                if sid is not None:
+                    self.send_header(SESSION_HEADER, str(sid))
                 self.end_headers()
 
                 def emit(text: str) -> bool:
@@ -904,7 +1050,12 @@ class FrontDoor:
                 while item is not None:            # None = resolution
                     if item is not False:          # False = keepalive tick
                         idx, tok = item
-                        if emit(f"event: token\ndata: "
+                        # the SSE id: field IS the seq number — an
+                        # EventSource (or the proxy's failover relay)
+                        # resumes with Last-Event-ID = the last id seen
+                        prefix = (f"id: {idx}\n" if sid is not None
+                                  else "")
+                        if emit(f"{prefix}event: token\ndata: "
                                 f"{json.dumps({'index': idx, 'token': tok})}"
                                 f"\n\n"):
                             obs.stream_tokens.inc()
@@ -939,6 +1090,8 @@ class FrontDoor:
                     done = {"tokens": result.get("tokens"),
                             "n": len(result.get("tokens") or ()),
                             "worker": fd.worker_id}
+                    if sid is not None:
+                        done["session"] = sid
                     if result.get("version") is not None:
                         done["version"] = result["version"]
                     self._finish_idem(200, done)
@@ -1002,6 +1155,11 @@ class FrontDoor:
                         # per-tenant lifetime counters — the multi-
                         # tenant QoS view of this worker
                         self._reply(200, _qos.snapshot(), route, t0)
+                    elif path == "/debug/sessions":
+                        # durable generation sessions: the in-memory
+                        # ring, journal watermarks, fences — the
+                        # failover drill's adoption audit surface
+                        self._reply(200, _sess.snapshot(), route, t0)
                     elif path == "/metrics":
                         from deeplearning4j_tpu.observability import metrics
                         body = metrics().render_prometheus().encode()

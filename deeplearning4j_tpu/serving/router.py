@@ -183,7 +183,7 @@ class ServingRouter:
                  eos_id: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  request_key=None, on_token=None,
-                 tenant=None) -> np.ndarray:
+                 tenant=None, session_id: Optional[str] = None) -> np.ndarray:
         """Route one generation request across the registry's
         GENERATIVE versions — same deterministic hash split, per-version
         series, canary chaos point, and SLO-graded rollout as
@@ -208,12 +208,14 @@ class ServingRouter:
                     f"generation (state={self._primary.state})")
             return gp.generate(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
-                deadline_ms=deadline_ms, on_token=on_token, tenant=tenant)
+                deadline_ms=deadline_ms, on_token=on_token, tenant=tenant,
+                session_id=session_id,
+                session_version=self._primary.version)
         rollout = self._rollout
         if rollout is None or not rollout.active:
             return self._serve_gen(self._primary, prompt, max_new_tokens,
                                    eos_id, deadline_ms, on_token=on_token,
-                                   tenant=tenant)
+                                   tenant=tenant, session_id=session_id)
         rollout.maybe_timed_evaluate()
         frac = request_fraction(prompt, request_key)
         candidate = rollout.candidate
@@ -222,12 +224,13 @@ class ServingRouter:
             try:
                 return self._serve_gen(candidate, prompt, max_new_tokens,
                                        eos_id, deadline_ms, canary=True,
-                                       on_token=on_token, tenant=tenant)
+                                       on_token=on_token, tenant=tenant,
+                                       session_id=session_id)
             finally:
                 rollout.record_candidate_event()
         out = self._serve_gen(self._primary, prompt, max_new_tokens,
                               eos_id, deadline_ms, on_token=on_token,
-                              tenant=tenant)
+                              tenant=tenant, session_id=session_id)
         if (rollout.stage == RolloutState.SHADOW and candidate.admitting
                 and frac < rollout.policy.shadow_fraction):
             # shadow work must never affect the user's response — and a
@@ -248,7 +251,7 @@ class ServingRouter:
 
     def _serve_gen(self, dv, prompt, max_new_tokens, eos_id, deadline_ms,
                    canary: bool = False, on_token=None,
-                   tenant=None) -> np.ndarray:
+                   tenant=None, session_id=None) -> np.ndarray:
         if dv.kind != "generative":
             # a wiring error, not a lifecycle state — never typed
             raise ValueError(
@@ -266,7 +269,9 @@ class ServingRouter:
                     _faults.check("serving.canary")
                 out = gp.generate(prompt, max_new_tokens=max_new_tokens,
                                   eos_id=eos_id, deadline_ms=deadline_ms,
-                                  on_token=on_token, tenant=tenant)
+                                  on_token=on_token, tenant=tenant,
+                                  session_id=session_id,
+                                  session_version=dv.version)
         except Exception as e:
             self._account(dv, t0, error=e)
             raise
@@ -396,12 +401,43 @@ class ServingRouter:
                     eos_id: Optional[int] = None,
                     deadline_ms: Optional[float] = None,
                     canary: bool = False, on_token=None,
-                    tenant=None) -> np.ndarray:
+                    tenant=None,
+                    session_id: Optional[str] = None) -> np.ndarray:
         """Serve one generation request on the NAMED version."""
         return self._serve_gen(self._registry.get(version), prompt,
                                max_new_tokens, eos_id, deadline_ms,
                                canary=canary, on_token=on_token,
-                               tenant=tenant)
+                               tenant=tenant, session_id=session_id)
+
+    def resume_on(self, version: str, record: dict, on_token=None,
+                  deadline_ms: Optional[float] = None, tenant=None,
+                  session=None) -> np.ndarray:
+        """Resume an ADOPTED session record on the NAMED version
+        (fleet failover: the dead worker's journal, this worker's
+        slots) — the same per-version accounting and drain tracking as
+        :meth:`generate_on`, entering the pipeline through
+        ``GenerationPipeline.resume``."""
+        dv = self._registry.get(version)
+        if dv.kind != "generative":
+            raise ValueError(
+                f"version {dv.version!r} is a {dv.kind} deploy — "
+                "resume_on() needs a deploy_generative version")
+        gp = dv.gp
+        if not dv.admitting or gp is None:
+            raise ShutdownError(
+                f"version {dv.version!r} is not admitting generation "
+                f"(state={dv.state})")
+        t0 = time.perf_counter()
+        try:
+            with dv.track():
+                out = gp.resume(record, on_token=on_token,
+                                deadline_ms=deadline_ms, tenant=tenant,
+                                session=session)
+        except Exception as e:
+            self._account(dv, t0, error=e)
+            raise
+        self._account(dv, t0)
+        return out
 
     def repoint(self, version: str):
         """Re-point the primary at ``version`` (shared-store promotion:

@@ -314,9 +314,9 @@ def test_flight_recorder_dump_bundle_contents(tmp_path):
     assert files == ["compiles.json", "config.json", "deploy.json",
                      "elastic.json", "fleet.json", "frontdoor.json",
                      "generation.json", "metrics.prom", "numerics.json",
-                     "perf.json", "resilience.json", "tenants.json",
-                     "threads.txt", "timeseries.json", "trace.json",
-                     "traces.json"]
+                     "perf.json", "resilience.json", "sessions.json",
+                     "tenants.json", "threads.txt", "timeseries.json",
+                     "trace.json", "traces.json"]
     # the multi-tenant QoS section names the posture + tenant table
     tenants = json.loads(open(os.path.join(bundle, "tenants.json")).read())
     assert "enabled" in tenants and "tenants" in tenants

@@ -76,6 +76,7 @@ _FLEET_RE = re.compile(r"FLEET_r(\d+)[^/]*\.json$")
 _OBSFLEET_RE = re.compile(r"OBSFLEET_r(\d+)[^/]*\.json$")
 _TRACEQ_RE = re.compile(r"TRACEQ_r(\d+)[^/]*\.json$")
 _WATCH_RE = re.compile(r"WATCH_r(\d+)[^/]*\.json$")
+_SESS_RE = re.compile(r"SESS_r(\d+)[^/]*\.json$")
 
 
 class Sample(NamedTuple):
@@ -698,6 +699,66 @@ def check_watch(samples: List[WatchSample],
     ], tolerance, sustain)
 
 
+class SessSample(NamedTuple):
+    round: int
+    path: str
+    metric: str                      # "sess_failover"
+    platform: Optional[str]
+    completion: Optional[float]      # streams completed / streams (gated)
+    seq_exact: Optional[float]       # gapless, duplicate-free id runs
+    greedy_match: Optional[float]    # byte-identical to undisturbed run
+    resume_latency_ms: Optional[float]  # reported, never gated (weather)
+
+
+def load_sess(root: str) -> List[SessSample]:
+    """``SESS_r*.json`` session-failover drill archives
+    (``benchmarks/http_load.py --session-failover`` records, bare or
+    driver-wrapped). Anything without a ``sess_`` metric — alien
+    JSON — is ignored, never fatal."""
+    out: List[SessSample] = []
+    for path in sorted(glob.glob(os.path.join(root, "SESS_r*.json"))):
+        m = _SESS_RE.search(path)
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        if isinstance(doc.get("parsed"), dict):
+            doc = doc["parsed"]
+        metric = str(doc.get("metric", ""))
+        if not metric.startswith("sess_"):
+            continue
+        lat = doc.get("resume_latency_ms")
+        out.append(SessSample(
+            round=int(m.group(1)), path=path, metric=metric,
+            platform=doc.get("platform"),
+            completion=_bool_frac(doc, "sess_completion"),
+            seq_exact=_bool_frac(doc, "sess_seq_exact"),
+            greedy_match=_bool_frac(doc, "sess_greedy_match"),
+            resume_latency_ms=(float(lat)
+                               if isinstance(lat, (int, float))
+                               else None)))
+    return out
+
+
+def check_sess(samples: List[SessSample],
+               tolerance: float = DEFAULT_TOLERANCE,
+               sustain: int = DEFAULT_SUSTAIN) -> List[Regression]:
+    """Grade the session-failover trajectory sustained-only: stream
+    completion, exact (gapless/duplicate-free) sequence delivery, and
+    greedy byte-identity are same-run fractions — drift-immune; the
+    raw resume latency is host weather — reported, never gated."""
+    return _grade_metric_groups(samples, [
+        ("sess_completion", lambda s: s.completion),
+        ("sess_seq_exact", lambda s: s.seq_exact),
+        ("sess_greedy_match", lambda s: s.greedy_match),
+    ], tolerance, sustain)
+
+
 def check_multichip(samples: List[DryrunSample]) -> List[str]:
     """The NEWEST non-skipped dryrun per round must pass; a failing
     newest round is a break (boolean — one failure is real, there is no
@@ -795,9 +856,10 @@ def main(argv=None) -> int:
     obsfleet = load_obsfleet(root)
     traceq = load_traceq(root)
     watch = load_watch(root)
+    sess = load_sess(root)
     if (not samples and not dryruns and not decodes and not serves
             and not qos and not fleet and not obsfleet and not traceq
-            and not watch):
+            and not watch and not sess):
         # a fresh checkout / pre-first-bench tree has no trajectory at
         # all — that is a clean state, not an error
         print(f"no bench trajectory under {root} (0 samples) — "
@@ -806,7 +868,8 @@ def main(argv=None) -> int:
     regressions = (check_trajectory(samples) + check_decode(decodes)
                    + check_serve(serves) + check_qos(qos)
                    + check_fleet(fleet) + check_obsfleet(obsfleet)
-                   + check_traceq(traceq) + check_watch(watch))
+                   + check_traceq(traceq) + check_watch(watch)
+                   + check_sess(sess))
     breaks = check_multichip(dryruns) + check_fleet_bool(fleet)
     for s in samples:
         marks = []
@@ -897,6 +960,17 @@ def main(argv=None) -> int:
                 marks.append(f"{name}={v:.0f}")
         print(f"r{s.round:02d} {s.metric} [{s.platform}] "
               + " ".join(marks))
+    for s in sess:
+        marks = []
+        for name, v in (("completion", s.completion),
+                        ("seq_exact", s.seq_exact),
+                        ("greedy_match", s.greedy_match)):
+            if v is not None:
+                marks.append(f"{name}={v:.3f}")
+        if s.resume_latency_ms is not None:
+            marks.append(f"resume={s.resume_latency_ms:.1f}ms")
+        print(f"r{s.round:02d} {s.metric} [{s.platform}] "
+              + " ".join(marks))
     for reg in regressions:
         print(f"SUSTAINED REGRESSION: {reg}")
     for b in breaks:
@@ -906,8 +980,8 @@ def main(argv=None) -> int:
               f"{len(dryruns)} dryrun + {len(decodes)} decode + "
               f"{len(serves)} serve + {len(qos)} qos + "
               f"{len(fleet)} fleet + {len(obsfleet)} obsfleet + "
-              f"{len(traceq)} traceq + {len(watch)} watch samples "
-              f"under {root})")
+              f"{len(traceq)} traceq + {len(watch)} watch + "
+              f"{len(sess)} sess samples under {root})")
     return len(regressions) + len(breaks)
 
 

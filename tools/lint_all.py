@@ -10,7 +10,8 @@ Runs, in order:
 2. ``tools/bench_diff.py`` over the repo's archived benchmark
    trajectory (``BENCH_r*.json`` / ``MULTICHIP_r*`` / ``DECODE_r*`` /
    ``SERVE_r*`` / ``QOS_r*`` / ``FLEET_r*`` / ``OBSFLEET_r*`` /
-   ``TRACEQ_r*`` / ``WATCH_r*``) — a sustained regression fails.
+   ``TRACEQ_r*`` / ``WATCH_r*`` / ``SESS_r*``) — a sustained
+   regression fails.
 
 Exit code 0 only when both gates pass.  Run from tests (tier-1 calls
 :func:`main` directly) or from a shell/CI step:

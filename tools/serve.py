@@ -56,6 +56,58 @@ def _fleet_obs_on() -> bool:
     return os.environ.get("DL4J_TPU_FLEET_OBS", "1") != "0"
 
 
+def _sessions_on() -> bool:
+    """The durable-session kill switch (``DL4J_TPU_SESSIONS=0``), read
+    LIVE and without importing the serving package — when off the
+    proxy's response pump stays byte-identical to the pre-session
+    code (no SSE parsing, no mid-stream failover)."""
+    return os.environ.get("DL4J_TPU_SESSIONS", "1") != "0"
+
+
+class _SseTail:
+    """Line scanner over relayed SSE bytes: tracks the last ``id:``
+    the client has been sent and whether a terminal ``event: done`` /
+    ``event: error`` closed the stream.  Fed the exact bytes the proxy
+    forwards, so ``last_id`` is precisely what a resuming request may
+    assert via ``Last-Event-ID`` (the survivor worker dedups the
+    overlap window against it — exactly-once delivery)."""
+
+    def __init__(self):
+        self._buf = b""
+        self.last_id = -1
+        self.terminal = False
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+        while b"\n" in self._buf:
+            line, _, self._buf = self._buf.partition(b"\n")
+            line = line.strip()
+            if line.startswith(b"id:"):
+                try:
+                    self.last_id = int(line[3:].strip())
+                except ValueError:
+                    pass
+            elif line in (b"event: done", b"event: error"):
+                self.terminal = True
+        if len(self._buf) > 65536:      # non-SSE payloads with no
+            self._buf = self._buf[-65536:]   # newlines must not pool
+
+
+def _with_resume_headers(raw: bytes, sid: str, last_id: int) -> bytes:
+    """The buffered client request, rewritten into a resume request:
+    ``Last-Event-ID`` pins the dedup floor and ``X-Dl4j-Session-Id``
+    names the journaled session the survivor must adopt.  Any client-
+    sent copies of either header are dropped first (the proxy's view
+    of delivered bytes is authoritative once it has relayed any)."""
+    head, sep, body = raw.partition(b"\r\n\r\n")
+    lines = [ln for ln in head.split(b"\r\n")
+             if not ln.lower().startswith(
+                 (b"last-event-id:", b"x-dl4j-session-id:"))]
+    lines.append(b"Last-Event-ID: " + str(int(last_id)).encode("ascii"))
+    lines.append(b"X-Dl4j-Session-Id: " + sid.encode("latin-1"))
+    return b"\r\n".join(lines) + (sep or b"\r\n\r\n") + body
+
+
 class _ProxyMetrics:
     """The proxy process's OWN ``dl4j_*`` series (fleet observability
     satellite: before this, the failover/circuit counters were visible
@@ -93,9 +145,17 @@ class _ProxyMetrics:
             "dl4j_proxy_inflight",
             "client connections the proxy is currently serving (its "
             "queue depth on the wire)")
+        self._stream_breaks = reg.counter(
+            "dl4j_proxy_stream_breaks_total",
+            "upstream connections that died mid-response (after the "
+            "head, before an SSE terminal event), by worker port",
+            label_names=("port",))
 
     def connect_failures(self, port):
         return self._connect_failures.labels(port=str(port))
+
+    def stream_breaks(self, port):
+        return self._stream_breaks.labels(port=str(port))
 
     def ejections(self, port):
         return self._ejections.labels(port=str(port))
@@ -558,6 +618,13 @@ class _HttpProxy(_SpliceProxy):
                 except (IndexError, ValueError):
                     pass
             upstream.settimeout(None)
+            if metrics is not None and _sessions_on():
+                # session-aware relay: an upstream death mid-SSE is
+                # re-routed to a survivor (Last-Event-ID) instead of
+                # silently truncating the client's stream
+                self._relay_stream(client, upstream, first, port, raw,
+                                   sp, metrics)
+                return
             try:
                 client.sendall(first)
                 while True:
@@ -580,6 +647,210 @@ class _HttpProxy(_SpliceProxy):
             client.close()          # no live backend took the request
         except OSError:
             pass
+
+    # ---------------------------------------------- mid-stream failover
+    @staticmethod
+    def _close_pair(client, upstream):
+        for s in (client, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_head(upstream, first: bytes = b"") -> bytes:
+        """Accumulate upstream bytes until the full response head
+        (``CRLFCRLF``) is buffered; body bytes past it ride along."""
+        blob = first
+        while b"\r\n\r\n" not in blob and len(blob) < 262144:
+            data = upstream.recv(65536)
+            if not data:
+                break
+            blob += data
+        return blob
+
+    @staticmethod
+    def _parse_head(blob: bytes):
+        """``(status, is_sse, session_id, body_offset)`` from a
+        buffered response head, or None if no complete head is there."""
+        end = blob.find(b"\r\n\r\n")
+        if end < 0:
+            return None
+        lines = blob[:end].split(b"\r\n")
+        status = 0
+        parts = lines[0].split(b" ")
+        if len(parts) >= 2 and parts[0].startswith(b"HTTP/"):
+            try:
+                status = int(parts[1])
+            except ValueError:
+                pass
+        is_sse, sid = False, None
+        for ln in lines[1:]:
+            k, _, v = ln.partition(b":")
+            k, v = k.strip().lower(), v.strip()
+            if (k == b"content-type"
+                    and v.lower().startswith(b"text/event-stream")):
+                is_sse = True
+            elif k == b"x-dl4j-session-id":
+                sid = v.decode("latin-1")
+        return status, is_sse, sid, end + 4
+
+    def _send_stream_error(self, client, detail: str, sp) -> None:
+        """A client whose stream broke and cannot be resumed gets a
+        typed terminal SSE ``error`` event (with the trace id) instead
+        of a silent connection reset."""
+        payload = {"error": "UpstreamLost", "status": 502,
+                   "detail": str(detail)}
+        tid = getattr(sp, "trace_id", None) if sp is not None else None
+        if tid:
+            payload["trace_id"] = str(tid)
+        try:
+            client.sendall(b"event: error\ndata: "
+                           + json.dumps(payload).encode("utf-8")
+                           + b"\n\n")
+        except OSError:
+            pass
+
+    def _resume_upstream(self, dead_port, raw, sid, last_id, sp,
+                         metrics):
+        """Re-route a broken stream: the client's buffered request is
+        re-sent — rewritten with ``Last-Event-ID`` + session headers —
+        to the next live backend.  Returns ``(upstream, port,
+        first_body_bytes)`` once a survivor answers 200 with a fresh
+        SSE head, else None."""
+        resume_raw = _with_resume_headers(raw, sid, last_id)
+        for port in self._backends():
+            if port == dead_port:
+                continue            # the corpse is still in the list
+            brk = self._breaker(port)
+            if not brk.allow():
+                self._note(ejection=True)
+                if metrics is not None:
+                    metrics.ejections(port).inc()
+                    metrics.circuit_open(port).set(1.0)
+                continue
+            try:
+                upstream = socket.create_connection(
+                    ("127.0.0.1", port), timeout=2.0)
+                upstream.sendall(resume_raw)
+                upstream.settimeout(self._head_timeout)
+                blob = self._read_head(upstream)
+            except OSError:
+                brk.record_failure()
+                if metrics is not None:
+                    metrics.connect_failures(port).inc()
+                continue
+            parsed = self._parse_head(blob)
+            if parsed is None or parsed[0] != 200 or not parsed[1]:
+                # the survivor refused the adoption (shed / admission /
+                # unknown session): its head is not relayable onto the
+                # half-sent stream, but it IS alive — no ejection
+                try:
+                    upstream.close()
+                except OSError:
+                    pass
+                brk.record_success()
+                continue
+            brk.record_success()
+            upstream.settimeout(None)
+            if metrics is not None:
+                metrics.circuit_open(port).set(0.0)
+            if sp is not None:
+                sp.set_attr("worker_port", port)
+                sp.set_attr("worker",
+                            getattr(self, "_port_wids", {}).get(port))
+                sp.set_attr("outcome", "resumed")
+            return upstream, port, blob[parsed[3]:]
+        return None
+
+    def _relay_stream(self, client, upstream, first, port, raw, sp,
+                      metrics):
+        """Session-aware response relay (sessions AND fleet obs on):
+        pumps bytes like the plain splice but watches the SSE tail, so
+        a mid-stream upstream death is never silent.  If the response
+        named a session (``X-Dl4j-Session-Id``) the proxy re-routes to
+        a live worker with ``Last-Event-ID`` — the survivor adopts the
+        journaled session, skips everything the client already has,
+        and the stream completes on the same client socket (exactly-
+        once, byte-identical under greedy).  Clients that can't resume
+        get the terminal typed ``error`` event; every break counts
+        ``dl4j_proxy_stream_breaks_total{port}``."""
+        try:
+            blob = self._read_head(upstream, first)
+        except OSError:
+            blob = first
+        parsed = self._parse_head(blob)
+        if parsed is None:          # unparseable head: plain close-out
+            try:
+                client.sendall(blob)
+            except OSError:
+                pass
+            self._close_pair(client, upstream)
+            return
+        status, is_sse, sid, body_at = parsed
+        try:
+            client.sendall(blob)
+        except OSError:
+            self._close_pair(client, upstream)
+            return
+        tail = _SseTail()
+        tail.feed(blob[body_at:])
+        attempts = 0
+        while True:
+            upstream_ended = client_dead = False
+            while True:
+                try:
+                    data = upstream.recv(65536)
+                except OSError:
+                    upstream_ended = True
+                    break
+                if not data:
+                    upstream_ended = True   # EOF — terminal check below
+                    break
+                try:
+                    client.sendall(data)
+                except OSError:
+                    client_dead = True
+                    break
+                tail.feed(data)
+            if client_dead or not upstream_ended:
+                break               # client gone: nothing to rescue
+            if not is_sse or tail.terminal or status != 200:
+                break               # the stream ended properly
+            # mid-stream upstream death with a live client
+            metrics.stream_breaks(port).inc()
+            self._breaker(port).record_failure()
+            if sp is not None:
+                sp.set_attr("outcome", "stream_break")
+                sp.set_attr("stream_failovers", attempts)
+            if not sid or attempts >= 3:
+                self._send_stream_error(
+                    client, "upstream died mid-stream"
+                    + ("" if sid else " (no session to resume)"), sp)
+                break
+            attempts += 1
+            nxt = self._resume_upstream(port, raw, sid, tail.last_id,
+                                        sp, metrics)
+            try:
+                upstream.close()
+            except OSError:
+                pass
+            if nxt is None:
+                self._send_stream_error(
+                    client,
+                    "no live backend could resume session " + sid, sp)
+                break
+            upstream, port, body0 = nxt
+            self._note(failover=True)
+            metrics.failovers.inc()
+            if sp is not None:
+                sp.set_attr("stream_failovers", attempts)
+            try:
+                client.sendall(body0)
+            except OSError:
+                break
+            tail.feed(body0)        # keep pumping from the survivor
+        self._close_pair(client, upstream)
 
 
 # --------------------------------------------------------------- parent
